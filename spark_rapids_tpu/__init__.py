@@ -1,0 +1,24 @@
+"""spark-rapids-tpu: a TPU-native columnar SQL execution framework.
+
+A ground-up re-design of the capabilities of the RAPIDS Accelerator for Apache
+Spark (reference: /root/reference, spark-rapids 24.12) for TPU hardware:
+columnar batches are shape-bucketed jax.Arrays in HBM, operators compile to
+XLA computations (jax.numpy / Pallas), distribution rides jax.sharding meshes
+with ICI/DCN collectives, and a tiered HBM->host->disk memory runtime provides
+spill + OOM-retry semantics.
+"""
+
+import jax as _jax
+
+# Spark semantics require real int64/float64 columns (bigint/double).
+# On TPU f64 is software-emulated by XLA; the planner prefers f32/bf16 where
+# the user opts into approximate float, but parity mode needs x64 on.
+_jax.config.update("jax_enable_x64", True)
+
+from .version import __version__
+from .types import Schema, StructField
+from .columnar import ColumnarBatch, DeviceColumn, HostColumn
+from .config import TpuConf
+
+__all__ = ["__version__", "Schema", "StructField", "ColumnarBatch",
+           "DeviceColumn", "HostColumn", "TpuConf"]

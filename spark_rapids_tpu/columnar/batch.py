@@ -1,0 +1,151 @@
+"""ColumnarBatch: an ordered set of columns with one logical row count.
+
+Reference analog: Spark's ColumnarBatch of GpuColumnVectors
+(GpuColumnVector.java:40 from(Table)/from(batch)); here the device side is a
+pytree of DeviceColumns so an entire batch can be an argument/result of a
+jitted operator kernel. Mixed batches (device + host columns) are first-class:
+the planner splits expression evaluation between the XLA kernel and vectorized
+Arrow host kernels.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..types import DataType, Schema, StructField, from_arrow
+from .bucketing import DEFAULT_BUCKETS, bucket_for
+from .column import DeviceColumn, HostColumn
+
+ColumnLike = Union[DeviceColumn, HostColumn]
+
+
+class ColumnarBatch:
+    __slots__ = ("columns", "num_rows", "schema")
+
+    def __init__(self, columns: Sequence[ColumnLike], num_rows: int,
+                 schema: Schema):
+        assert len(columns) == len(schema), (len(columns), len(schema))
+        for c in columns:
+            if isinstance(c, DeviceColumn) and c.padded_len < num_rows:
+                raise ValueError("device column shorter than num_rows")
+        self.columns = list(columns)
+        self.num_rows = int(num_rows)
+        self.schema = schema
+
+    # -- structure ---------------------------------------------------------
+    def __len__(self):
+        return self.num_rows
+
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    def column(self, i: int) -> ColumnLike:
+        return self.columns[i]
+
+    def column_by_name(self, name: str) -> ColumnLike:
+        return self.columns[self.schema.index_of(name)]
+
+    @property
+    def padded_len(self) -> int:
+        for c in self.columns:
+            if isinstance(c, DeviceColumn):
+                return c.padded_len
+        return self.num_rows
+
+    @property
+    def all_device(self) -> bool:
+        return all(isinstance(c, DeviceColumn) for c in self.columns)
+
+    def device_size_bytes(self) -> int:
+        return sum(c.nbytes() for c in self.columns if isinstance(c, DeviceColumn))
+
+    def host_size_bytes(self) -> int:
+        return sum(c.nbytes() for c in self.columns if isinstance(c, HostColumn))
+
+    def size_bytes(self) -> int:
+        return sum(c.nbytes() for c in self.columns)
+
+    def with_columns(self, columns: Sequence[ColumnLike], schema: Schema,
+                     num_rows: Optional[int] = None) -> "ColumnarBatch":
+        return ColumnarBatch(columns, self.num_rows if num_rows is None else num_rows,
+                             schema)
+
+    # -- conversions -------------------------------------------------------
+    @staticmethod
+    def from_arrow(table, buckets: Sequence[int] = DEFAULT_BUCKETS,
+                   pad: bool = True) -> "ColumnarBatch":
+        """Arrow table -> batch; device-backed types are H2D'd padded to the
+        row bucket (ref HostColumnarToGpu / GpuRowToColumnarExec device copy)."""
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        n = table.num_rows
+        p = bucket_for(n, buckets) if pad else n
+        cols: List[ColumnLike] = []
+        fields: List[StructField] = []
+        for name, col in zip(table.column_names, table.columns):
+            if isinstance(col, pa.ChunkedArray):
+                col = col.combine_chunks() if col.num_chunks != 1 else col.chunk(0)
+            dt = from_arrow(col.type)
+            fields.append(StructField(name, dt, True))
+            if dt.device_backed:
+                arr = col
+                if pa.types.is_date32(arr.type):
+                    arr = arr.cast(pa.int32())
+                elif pa.types.is_timestamp(arr.type):
+                    arr = arr.cast(pa.int64())
+                elif pa.types.is_decimal(arr.type):
+                    # unscaled int64 view for precision<=18
+                    arr = pc.multiply_checked(
+                        arr.cast(pa.decimal128(38, arr.type.scale)),
+                        10 ** arr.type.scale).cast(pa.int64())
+                mask = np.asarray(col.is_null())
+                vals = arr.fill_null(0).to_numpy(zero_copy_only=False)
+                cols.append(DeviceColumn.from_numpy(
+                    vals, dt, mask=~mask, padded_len=p))
+            else:
+                cols.append(HostColumn(col, dt))
+        return ColumnarBatch(cols, n, Schema(fields))
+
+    @staticmethod
+    def from_pandas(df, buckets: Sequence[int] = DEFAULT_BUCKETS) -> "ColumnarBatch":
+        import pyarrow as pa
+        return ColumnarBatch.from_arrow(pa.Table.from_pandas(df, preserve_index=False),
+                                        buckets)
+
+    def to_arrow(self):
+        import pyarrow as pa
+        arrays = [c.to_arrow(self.num_rows) for c in self.columns]
+        names = self.schema.names()
+        return pa.Table.from_arrays(arrays, names=names)
+
+    def to_pandas(self):
+        return self.to_arrow().to_pandas()
+
+    # -- ops used by the runtime ------------------------------------------
+    def slice(self, offset: int, length: int) -> "ColumnarBatch":
+        """Host-side logical slice (used by split-and-retry); produces a new
+        padded batch."""
+        import pyarrow as pa
+        t = self.to_arrow().slice(offset, length)
+        return ColumnarBatch.from_arrow(pa.table(t))
+
+    def __repr__(self):
+        kinds = "".join("D" if isinstance(c, DeviceColumn) else "H"
+                        for c in self.columns)
+        return (f"ColumnarBatch(rows={self.num_rows}, padded={self.padded_len}, "
+                f"cols=[{kinds}], {self.schema})")
+
+
+def concat_batches(batches: Sequence[ColumnarBatch],
+                   buckets: Sequence[int] = DEFAULT_BUCKETS) -> ColumnarBatch:
+    """Concatenate batches (ref GpuCoalesceBatches concatenation,
+    GpuCoalesceBatches.scala:112-176). Host-staged for simplicity and
+    correctness across mixed device/host columns; the hot device-only path is
+    overridden by exec/coalesce.py with an on-device concat kernel."""
+    import pyarrow as pa
+    assert batches, "empty concat"
+    if len(batches) == 1:
+        return batches[0]
+    tables = [b.to_arrow() for b in batches]
+    return ColumnarBatch.from_arrow(pa.concat_tables(tables), buckets)

@@ -1,0 +1,30 @@
+"""Row-count shape bucketing.
+
+TPU-specific core design (no reference analog — cudf kernels are shape-dynamic,
+XLA compiles per static shape, SURVEY.md section 7 "Hard parts" #1): every
+columnar batch is padded up to the nearest bucket in a geometric ladder so a
+compiled operator kernel is reused across all batches that land in the same
+bucket. Padding rows carry validity=False so masked kernels ignore them; the
+true row count travels as a dynamic scalar.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+DEFAULT_BUCKETS: List[int] = [1024, 8192, 65536, 262144, 1048576, 4194304]
+
+
+def bucket_for(num_rows: int, buckets: Sequence[int] = DEFAULT_BUCKETS) -> int:
+    """Smallest bucket >= num_rows; beyond the ladder, round up to the next
+    multiple of the largest bucket (keeps compilation count bounded)."""
+    if num_rows < 0:
+        raise ValueError("negative row count")
+    for b in buckets:
+        if num_rows <= b:
+            return b
+    top = buckets[-1]
+    return ((num_rows + top - 1) // top) * top
+
+
+def padded_len(num_rows: int, buckets: Sequence[int] = DEFAULT_BUCKETS) -> int:
+    return bucket_for(num_rows, buckets)

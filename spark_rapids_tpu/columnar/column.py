@@ -1,0 +1,164 @@
+"""Columnar vectors: HBM-resident (jax.Array) and host (Arrow) columns.
+
+TPU-native re-design of the reference's columnar data layer
+(GpuColumnVector.java:40 device vector over cudf; RapidsHostColumnVector for
+host side). On TPU a column is:
+
+  * ``DeviceColumn`` — a dense ``jax.Array`` ``data`` padded to a shape bucket
+    plus a ``validity`` bool mask (False for nulls AND for padding rows).
+    Registered as a pytree so whole batches flow through ``jax.jit``.
+  * ``HostColumn``  — a pyarrow Array for types XLA cannot hold densely
+    (strings, binary, nested). The planner's TypeSig tagging routes
+    expressions over these to vectorized host kernels (honest CPU fallback,
+    the analog of the reference's per-type fallback tagging).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..types import (DataType, DecimalType, STRING, TIMESTAMP, DATE,
+                     from_arrow, to_arrow)
+
+__all__ = ["DeviceColumn", "HostColumn", "Column"]
+
+
+class DeviceColumn:
+    """A typed device vector: ``data`` + ``validity`` jax arrays of equal
+    (padded) length. Slots where validity is False hold the dtype's default
+    value so arithmetic never sees garbage (NaN-free padding)."""
+
+    __slots__ = ("data", "validity", "dtype")
+
+    def __init__(self, data, validity, dtype: DataType):
+        self.data = data
+        self.validity = validity
+        self.dtype = dtype
+
+    # -- constructors ------------------------------------------------------
+    @staticmethod
+    def from_numpy(values: np.ndarray, dtype: DataType,
+                   mask: Optional[np.ndarray] = None,
+                   padded_len: Optional[int] = None) -> "DeviceColumn":
+        n = len(values)
+        p = padded_len if padded_len is not None else n
+        if p < n:
+            raise ValueError("padded_len < len(values)")
+        np_dt = dtype.np_dtype
+        assert np_dt is not None, f"{dtype} is not device-backed"
+        out = np.zeros(p, dtype=np_dt)
+        vals = np.asarray(values).astype(np_dt, copy=False)
+        valid = np.zeros(p, dtype=np.bool_)
+        if mask is None:
+            out[:n] = vals
+            valid[:n] = True
+        else:
+            m = np.asarray(mask, dtype=np.bool_)
+            out[:n] = np.where(m, vals, np_dt.type(0))
+            valid[:n] = m
+        return DeviceColumn(jnp.asarray(out), jnp.asarray(valid), dtype)
+
+    @staticmethod
+    def all_valid(data, dtype: DataType) -> "DeviceColumn":
+        return DeviceColumn(data, jnp.ones(data.shape, dtype=jnp.bool_), dtype)
+
+    # -- properties --------------------------------------------------------
+    @property
+    def padded_len(self) -> int:
+        return int(self.data.shape[0])
+
+    @property
+    def device_backed(self) -> bool:
+        return True
+
+    def nbytes(self) -> int:
+        return int(self.data.size * self.data.dtype.itemsize + self.validity.size)
+
+    # -- host materialization ---------------------------------------------
+    def to_numpy(self, num_rows: int):
+        """Return (values, validity) host arrays truncated to num_rows."""
+        d = np.asarray(jax.device_get(self.data))[:num_rows]
+        v = np.asarray(jax.device_get(self.validity))[:num_rows]
+        return d, v
+
+    def to_arrow(self, num_rows: int):
+        import pyarrow as pa
+        d, v = self.to_numpy(num_rows)
+        at = to_arrow(self.dtype)
+        if self.dtype == TIMESTAMP:
+            return pa.Array.from_pandas(d, mask=~v).cast(pa.int64()).cast(at)
+        if self.dtype == DATE:
+            return pa.Array.from_pandas(d, mask=~v).cast(pa.int32()).cast(at)
+        if isinstance(self.dtype, DecimalType):
+            import decimal as _dec
+            scale = self.dtype.scale
+            py = [None if not ok else _dec.Decimal(int(x)).scaleb(-scale)
+                  for x, ok in zip(d.tolist(), v.tolist())]
+            return pa.array(py, type=at)
+        return pa.Array.from_pandas(d, mask=~v, type=at)
+
+    def __repr__(self):
+        return f"DeviceColumn({self.dtype.name}, padded={self.padded_len})"
+
+
+def _flatten_device_column(c: DeviceColumn):
+    return (c.data, c.validity), c.dtype
+
+
+def _unflatten_device_column(dtype, children):
+    data, validity = children
+    return DeviceColumn(data, validity, dtype)
+
+
+jax.tree_util.register_pytree_node(
+    DeviceColumn, _flatten_device_column, _unflatten_device_column)
+
+
+class HostColumn:
+    """Arrow-backed host column for types without a dense device layout.
+
+    Reference analog: RapidsHostColumnVector + the per-type CPU fallback the
+    TypeSig machinery makes cheap to express (SURVEY.md section 7 hard part #2).
+    """
+
+    __slots__ = ("array", "dtype")
+
+    def __init__(self, array, dtype: Optional[DataType] = None):
+        import pyarrow as pa
+        if isinstance(array, pa.ChunkedArray):
+            array = array.combine_chunks()
+        self.array = array
+        self.dtype = dtype if dtype is not None else from_arrow(array.type)
+
+    @staticmethod
+    def from_pylist(values, dtype: DataType = STRING) -> "HostColumn":
+        import pyarrow as pa
+        return HostColumn(pa.array(values, type=to_arrow(dtype)), dtype)
+
+    @property
+    def device_backed(self) -> bool:
+        return False
+
+    @property
+    def padded_len(self) -> int:
+        return len(self.array)
+
+    def nbytes(self) -> int:
+        return self.array.nbytes
+
+    def to_arrow(self, num_rows: int):
+        return self.array.slice(0, num_rows)
+
+    def to_numpy(self, num_rows: int):
+        a = self.array.slice(0, num_rows)
+        v = ~np.asarray(a.is_null())
+        return a.to_numpy(zero_copy_only=False), v
+
+    def __repr__(self):
+        return f"HostColumn({self.dtype.name}, len={len(self.array)})"
+
+
+Column = (DeviceColumn, HostColumn)

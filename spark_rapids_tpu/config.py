@@ -1,0 +1,282 @@
+"""Typed configuration system.
+
+TPU-native analog of the reference's RapidsConf (sql-plugin/.../RapidsConf.scala:
+122-261 ConfEntry/ConfBuilder DSL, registry at 320-328, `help()` doc generation).
+Keys live under ``spark.rapids.tpu.*``. The registry is introspectable so
+``generate_docs()`` can emit docs/configs.md just like the reference.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = ["ConfEntry", "TpuConf", "register", "all_entries", "generate_docs"]
+
+_REGISTRY: Dict[str, "ConfEntry"] = {}
+_LOCK = threading.Lock()
+
+
+class ConfEntry:
+    def __init__(self, key: str, default: Any, doc: str, conv: Callable[[str], Any],
+                 internal: bool = False, startup_only: bool = False,
+                 commonly_used: bool = False):
+        self.key = key
+        self.default = default
+        self.doc = doc
+        self.conv = conv
+        self.internal = internal
+        self.startup_only = startup_only
+        self.commonly_used = commonly_used
+
+    def get(self, conf: "TpuConf") -> Any:
+        raw = conf.raw.get(self.key)
+        if raw is None:
+            env_key = self.key.upper().replace(".", "_")
+            raw = os.environ.get(env_key)
+        if raw is None:
+            return self.default
+        if isinstance(raw, str):
+            return self.conv(raw)
+        return raw
+
+
+def _bool(s: str) -> bool:
+    return s.strip().lower() in ("1", "true", "yes", "on")
+
+
+def _register(key: str, default, doc, conv, **kw) -> ConfEntry:
+    with _LOCK:
+        if key in _REGISTRY:
+            raise ValueError(f"duplicate conf key {key}")
+        e = ConfEntry(key, default, doc, conv, **kw)
+        _REGISTRY[key] = e
+        return e
+
+
+def register(key: str, default, doc: str, **kw) -> ConfEntry:
+    conv: Callable[[str], Any]
+    if isinstance(default, bool):
+        conv = _bool
+    elif isinstance(default, int):
+        conv = int
+    elif isinstance(default, float):
+        conv = float
+    else:
+        conv = str
+    return _register(key, default, doc, conv, **kw)
+
+
+def all_entries() -> List[ConfEntry]:
+    return sorted(_REGISTRY.values(), key=lambda e: e.key)
+
+
+# ---------------------------------------------------------------------------
+# Registered configs (counterparts of the reference's key knobs; reference
+# file:line cited per entry)
+# ---------------------------------------------------------------------------
+
+SQL_ENABLED = register(
+    "spark.rapids.tpu.sql.enabled", True,
+    "Enable plan replacement onto the TPU (ref RapidsConf spark.rapids.sql.enabled).",
+    commonly_used=True)
+
+EXPLAIN = register(
+    "spark.rapids.tpu.sql.explain", "NONE",
+    "NONE / NOT_ON_TPU / ALL: log why (parts of) a plan did or did not run on the "
+    "TPU (ref RapidsConf spark.rapids.sql.explain).", commonly_used=True)
+
+MODE = register(
+    "spark.rapids.tpu.sql.mode", "executeOnTPU",
+    "executeOnTPU or explainOnly (ref GpuOverrides.scala:4701 explain-only mode).")
+
+CONCURRENT_TPU_TASKS = register(
+    "spark.rapids.tpu.sql.concurrentTpuTasks", 2,
+    "Number of tasks that may hold the device semaphore concurrently "
+    "(ref RapidsConf.scala:545 concurrentGpuTasks / GpuSemaphore.scala:137).",
+    commonly_used=True)
+
+BATCH_SIZE_BYTES = register(
+    "spark.rapids.tpu.sql.batchSizeBytes", 512 * 1024 * 1024,
+    "Target columnar batch size; coalesce goal ceiling "
+    "(ref RapidsConf.scala:554 batchSizeBytes).", commonly_used=True)
+
+BATCH_SIZE_ROWS = register(
+    "spark.rapids.tpu.sql.batchSizeRows", 1 << 20,
+    "Target max rows per columnar batch (shape-bucket ceiling; TPU-specific: "
+    "bounds XLA recompilation via the bucket ladder).")
+
+ALLOC_FRACTION = register(
+    "spark.rapids.tpu.memory.hbm.allocFraction", 0.85,
+    "Fraction of HBM the pool manager budgets for columnar buffers "
+    "(ref RapidsConf spark.rapids.memory.gpu.allocFraction).", startup_only=True)
+
+HBM_LIMIT_BYTES = register(
+    "spark.rapids.tpu.memory.hbm.limitBytes", 0,
+    "Explicit HBM budget in bytes; 0 = derive from device "
+    "(ref GpuDeviceManager.computeRmmPoolSize).", startup_only=True)
+
+HOST_SPILL_LIMIT = register(
+    "spark.rapids.tpu.memory.host.spillStorageSize", 4 * 1024 * 1024 * 1024,
+    "Bytes of host memory for spilled buffers before going to disk "
+    "(ref RapidsHostMemoryStore.scala:41).")
+
+OOM_RETRY_ENABLED = register(
+    "spark.rapids.tpu.memory.oomRetry.enabled", True,
+    "Enable the per-thread OOM retry/split state machine "
+    "(ref RmmRapidsRetryIterator.scala:33).")
+
+SHUFFLE_MODE = register(
+    "spark.rapids.tpu.shuffle.mode", "MULTITHREADED",
+    "MULTITHREADED (host-staged) / ICI (device-resident collective exchange) / "
+    "CACHE_ONLY (single-process testing) "
+    "(ref RapidsShuffleInternalManagerBase.scala:1264-1276).", commonly_used=True)
+
+SHUFFLE_THREADS = register(
+    "spark.rapids.tpu.shuffle.multiThreaded.numThreads", 8,
+    "Writer/reader threads for the multithreaded shuffle "
+    "(ref RapidsShuffleThreadedWriterBase).")
+
+MULTITHREADED_READ_THREADS = register(
+    "spark.rapids.tpu.sql.multiThreadedRead.numThreads", 8,
+    "Host read thread-pool size for cloud/coalescing file readers "
+    "(ref Plugin.scala:269-281).")
+
+PARQUET_READER_TYPE = register(
+    "spark.rapids.tpu.sql.format.parquet.reader.type", "AUTO",
+    "PERFILE / COALESCING / MULTITHREADED / AUTO "
+    "(ref GpuParquetScan.scala reader factory:1070).")
+
+CBO_ENABLED = register(
+    "spark.rapids.tpu.sql.optimizer.enabled", False,
+    "Cost-based reversion of device subtrees to CPU when transition cost "
+    "exceeds benefit (ref CostBasedOptimizer.scala).")
+
+CPU_EXEC_COST_PER_ROW = register(
+    "spark.rapids.tpu.sql.optimizer.cpu.exec.defaultRowCost", 2.0e-4,
+    "CBO default CPU cost s/row (ref RapidsConf.scala:2133).", internal=True)
+
+TPU_EXEC_COST_PER_ROW = register(
+    "spark.rapids.tpu.sql.optimizer.tpu.exec.defaultRowCost", 1.0e-4,
+    "CBO default TPU cost s/row (ref RapidsConf.scala:2149).", internal=True)
+
+METRICS_LEVEL = register(
+    "spark.rapids.tpu.sql.metrics.level", "MODERATE",
+    "DEBUG / MODERATE / ESSENTIAL metric verbosity (ref GpuExec.scala:54-165).")
+
+STABLE_SORT = register(
+    "spark.rapids.tpu.sql.stableSort.enabled", False,
+    "Force stable device sorts (ref RapidsConf stableSort).")
+
+IMPROVED_FLOAT_OPS = register(
+    "spark.rapids.tpu.sql.improvedFloatOps.enabled", False,
+    "Allow float aggregation orderings that can differ from CPU bit-for-bit.")
+
+HAS_NANS = register(
+    "spark.rapids.tpu.sql.hasNans", True,
+    "Assume float columns may contain NaN (ref RapidsConf spark.rapids.sql.hasNans).")
+
+UDF_COMPILER_ENABLED = register(
+    "spark.rapids.tpu.sql.udfCompiler.enabled", False,
+    "Translate Python UDF bytecode into columnar expressions at plan time "
+    "(ref udf-compiler/, Plugin.scala:122-128).")
+
+SPILL_DIR = register(
+    "spark.rapids.tpu.memory.spillDir", "/tmp/srtpu_spill",
+    "Directory for disk-tier spill files (ref RapidsDiskStore.scala:38).")
+
+OOM_INJECTION = register(
+    "spark.rapids.tpu.memory.oomInjection.mode", "NONE",
+    "Test-only fault injection mode (ref RmmSpark.forceRetryOOM test hooks).",
+    internal=True)
+
+LORE_DUMP_PATH = register(
+    "spark.rapids.tpu.sql.lore.dumpPath", "",
+    "When set, operators tagged by lore ids dump input batches for offline "
+    "replay (ref lore/GpuLore.scala).")
+
+LORE_IDS = register(
+    "spark.rapids.tpu.sql.lore.idsToDump", "",
+    "Comma-separated lore ids to dump (ref GpuLore.tagForLore).")
+
+PROFILE_PATH = register(
+    "spark.rapids.tpu.profile.pathPrefix", "",
+    "When set, capture XLA/TPU profiler traces to this path "
+    "(ref profiler.scala ProfilerOnExecutor).")
+
+SHAPE_BUCKETS = register(
+    "spark.rapids.tpu.sql.shapeBuckets", "1024,8192,65536,262144,1048576,4194304",
+    "Row-count bucket ladder; batches pad up to the nearest bucket so each "
+    "operator compiles once per bucket (TPU-specific, no reference analog — "
+    "cudf is shape-dynamic, XLA is not).")
+
+CPU_FALLBACK_ENABLED = register(
+    "spark.rapids.tpu.sql.cpuFallback.enabled", True,
+    "Allow per-operator CPU fallback (off = fail when a plan node is unsupported).")
+
+TASK_TIMEOUT = register(
+    "spark.rapids.tpu.task.semaphore.timeoutSeconds", 600,
+    "Max seconds a task waits on the device semaphore before erroring.")
+
+
+class TpuConf:
+    """Immutable snapshot of raw key->string (or typed) settings.
+
+    Reference: RapidsConf wraps SQLConf the same way; the driver serializes the
+    conf map to executors (Plugin.scala:472) — here sessions pass TpuConf down
+    the plan explicitly.
+    """
+
+    def __init__(self, raw: Optional[Dict[str, Any]] = None):
+        self.raw = dict(raw or {})
+
+    def with_settings(self, **kv) -> "TpuConf":
+        new = dict(self.raw)
+        for k, v in kv.items():
+            new[k] = v
+        return TpuConf(new)
+
+    def set(self, key: str, value) -> "TpuConf":
+        new = dict(self.raw)
+        new[key] = value
+        return TpuConf(new)
+
+    def get(self, entry: ConfEntry):
+        return entry.get(self)
+
+    # convenience accessors mirroring RapidsConf's vals
+    @property
+    def sql_enabled(self) -> bool: return self.get(SQL_ENABLED)
+    @property
+    def explain(self) -> str: return str(self.get(EXPLAIN)).upper()
+    @property
+    def mode(self) -> str: return self.get(MODE)
+    @property
+    def concurrent_tpu_tasks(self) -> int: return self.get(CONCURRENT_TPU_TASKS)
+    @property
+    def batch_size_bytes(self) -> int: return self.get(BATCH_SIZE_BYTES)
+    @property
+    def batch_size_rows(self) -> int: return self.get(BATCH_SIZE_ROWS)
+    @property
+    def shuffle_mode(self) -> str: return str(self.get(SHUFFLE_MODE)).upper()
+    @property
+    def is_explain_only(self) -> bool: return self.get(MODE) == "explainOnly"
+    @property
+    def shape_buckets(self):
+        return [int(x) for x in str(self.get(SHAPE_BUCKETS)).split(",") if x]
+    @property
+    def cpu_fallback_enabled(self) -> bool: return self.get(CPU_FALLBACK_ENABLED)
+
+
+DEFAULT = TpuConf()
+
+
+def generate_docs() -> str:
+    """Emit markdown config docs (ref RapidsConf.help() -> docs/configs.md)."""
+    out = ["# spark-rapids-tpu configuration", "",
+           "Name | Description | Default", "--- | --- | ---"]
+    for e in all_entries():
+        if e.internal:
+            continue
+        out.append(f"{e.key} | {e.doc} | {e.default}")
+    return "\n".join(out) + "\n"

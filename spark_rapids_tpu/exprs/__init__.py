@@ -1,0 +1,17 @@
+from .base import (Alias, BoundReference, ColumnRef, DVal, EvalContext,
+                   Expression, Literal, Unsupported, promote_types)
+from .arithmetic import (Abs, Add, Divide, IntegralDivide, Multiply, Pmod,
+                         Remainder, Subtract, UnaryMinus)
+from .comparison import (EqualNullSafe, EqualTo, GreaterThan,
+                         GreaterThanOrEqual, In, IsNaN, IsNotNull, IsNull,
+                         LessThan, LessThanOrEqual, NotEqual)
+from .logical import And, Not, Or
+from .math_fns import (Acos, Asin, Atan, Atan2, Cbrt, Ceil, Cos, Cosh, Exp,
+                       Expm1, Floor, Log, Log1p, Log2, Log10, Pow, Rint,
+                       Round, Signum, Sin, Sinh, Sqrt, Tan, Tanh, ToDegrees,
+                       ToRadians)
+from .conditional import CaseWhen, Coalesce, If, NaNvl
+from .cast import Cast
+from .compiler import (DeviceProjector, compile_projection,
+                       eval_predicate_device, filter_batch_device,
+                       gather_batch_device)

@@ -1,0 +1,251 @@
+"""Arithmetic expressions with Spark semantics.
+
+Reference analog: sql-plugin arithmetic.scala (GpuAdd, GpuSubtract, ...,
+1,282 LoC). Spark (non-ANSI) semantics implemented:
+  * division / modulo by zero -> NULL (not inf/exception)
+  * `/` always produces double for integral inputs; `div` is integral division
+  * `%` takes the sign of the dividend (Java remainder)
+Device path is traced jax.numpy (fused by XLA); host path is masked numpy.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..types import (BOOL, DataType, FLOAT32, FLOAT64, INT64, Schema,
+                     numeric, TypeSig)
+from .base import DVal, EvalContext, Expression, null_and, promote_types
+
+__all__ = ["Add", "Subtract", "Multiply", "Divide", "IntegralDivide",
+           "Remainder", "Pmod", "UnaryMinus", "Abs", "host_binary_numpy",
+           "arrow_to_masked_numpy", "masked_numpy_to_arrow"]
+
+
+def arrow_to_masked_numpy(arr):
+    """pyarrow.Array -> (values ndarray, valid bool ndarray)."""
+    valid = ~np.asarray(arr.is_null())
+    vals = arr.fill_null(0).to_numpy(zero_copy_only=False) if arr.null_count \
+        else arr.to_numpy(zero_copy_only=False)
+    return vals, valid
+
+
+def masked_numpy_to_arrow(vals, valid, dtype: DataType):
+    import pyarrow as pa
+    from ..types import to_arrow
+    vals = np.asarray(vals)
+    if dtype.np_dtype is not None and vals.dtype != dtype.np_dtype:
+        vals = vals.astype(dtype.np_dtype)
+    return pa.Array.from_pandas(vals, mask=~np.asarray(valid), type=to_arrow(dtype))
+
+
+def host_binary_numpy(expr, batch, fn, out_dtype: DataType,
+                      cast_to=None, null_on_zero_rhs=False):
+    l, lv = arrow_to_masked_numpy(expr.children[0].eval_host(batch))
+    r, rv = arrow_to_masked_numpy(expr.children[1].eval_host(batch))
+    if cast_to is not None:
+        l = l.astype(cast_to)
+        r = r.astype(cast_to)
+    valid = lv & rv
+    if null_on_zero_rhs:
+        valid = valid & (r != 0)
+        r = np.where(r == 0, np.ones_like(r), r)
+    with np.errstate(all="ignore"):
+        vals = fn(l, r)
+    return masked_numpy_to_arrow(vals, valid, out_dtype)
+
+
+class BinaryArithmetic(Expression):
+    device_type_sig: TypeSig = numeric
+    symbol = "?"
+
+    def __init__(self, left: Expression, right: Expression):
+        self.children = [left, right]
+
+    def data_type(self, schema: Schema) -> DataType:
+        return promote_types(self.children[0].data_type(schema),
+                             self.children[1].data_type(schema))
+
+    def _promoted_device_operands(self, ctx: EvalContext):
+        dt = self.data_type(ctx.schema)
+        l = self.children[0].eval_device(ctx)
+        r = self.children[1].eval_device(ctx)
+        np_dt = dt.np_dtype
+        ld = l.data.astype(np_dt) if l.data.dtype != np_dt else l.data
+        rd = r.data.astype(np_dt) if r.data.dtype != np_dt else r.data
+        return ld, rd, null_and(l.validity, r.validity), dt
+
+    def key(self):
+        return f"{type(self).__name__}({self.children[0].key()},{self.children[1].key()})"
+
+    @property
+    def name_hint(self):
+        return (f"({self.children[0].name_hint} {self.symbol} "
+                f"{self.children[1].name_hint})")
+
+
+class Add(BinaryArithmetic):
+    symbol = "+"
+
+    def eval_device(self, ctx):
+        ld, rd, v, dt = self._promoted_device_operands(ctx)
+        return DVal(ld + rd, v, dt)
+
+    def eval_host(self, batch):
+        return host_binary_numpy(self, batch, np.add,
+                                 self.data_type(batch.schema))
+
+
+class Subtract(BinaryArithmetic):
+    symbol = "-"
+
+    def eval_device(self, ctx):
+        ld, rd, v, dt = self._promoted_device_operands(ctx)
+        return DVal(ld - rd, v, dt)
+
+    def eval_host(self, batch):
+        return host_binary_numpy(self, batch, np.subtract,
+                                 self.data_type(batch.schema))
+
+
+class Multiply(BinaryArithmetic):
+    symbol = "*"
+
+    def eval_device(self, ctx):
+        ld, rd, v, dt = self._promoted_device_operands(ctx)
+        return DVal(ld * rd, v, dt)
+
+    def eval_host(self, batch):
+        return host_binary_numpy(self, batch, np.multiply,
+                                 self.data_type(batch.schema))
+
+
+class Divide(BinaryArithmetic):
+    """Spark `/`: result is double for non-decimal inputs; 0 divisor -> NULL
+    (ref arithmetic.scala GpuDivide)."""
+    symbol = "/"
+
+    def data_type(self, schema: Schema) -> DataType:
+        base = super().data_type(schema)
+        return FLOAT32 if base == FLOAT32 else FLOAT64
+
+    def eval_device(self, ctx):
+        dt = self.data_type(ctx.schema)
+        l = self.children[0].eval_device(ctx)
+        r = self.children[1].eval_device(ctx)
+        ld = l.data.astype(dt.np_dtype)
+        rd = r.data.astype(dt.np_dtype)
+        zero = rd == 0
+        v = null_and(l.validity, r.validity, jnp.logical_not(zero))
+        safe = jnp.where(zero, jnp.ones_like(rd), rd)
+        return DVal(ld / safe, v, dt)
+
+    def eval_host(self, batch):
+        dt = self.data_type(batch.schema)
+        return host_binary_numpy(self, batch, np.divide, dt,
+                                 cast_to=dt.np_dtype, null_on_zero_rhs=True)
+
+
+class IntegralDivide(BinaryArithmetic):
+    """Spark `div`: integral division -> long; 0 divisor -> NULL."""
+    symbol = "div"
+
+    def data_type(self, schema: Schema) -> DataType:
+        return INT64
+
+    def eval_device(self, ctx):
+        l = self.children[0].eval_device(ctx)
+        r = self.children[1].eval_device(ctx)
+        ld = l.data.astype(jnp.int64)
+        rd = r.data.astype(jnp.int64)
+        zero = rd == 0
+        v = null_and(l.validity, r.validity, jnp.logical_not(zero))
+        safe = jnp.where(zero, jnp.ones_like(rd), rd)
+        # C-style truncation toward zero (Spark/Java), not Python floor
+        q = (jnp.abs(ld) // jnp.abs(safe)) * jnp.sign(ld) * jnp.sign(safe)
+        return DVal(q.astype(jnp.int64), v, INT64)
+
+    def eval_host(self, batch):
+        def f(l, r):
+            return (np.abs(l) // np.abs(r)) * np.sign(l) * np.sign(r)
+        return host_binary_numpy(self, batch, f, INT64, cast_to=np.int64,
+                                 null_on_zero_rhs=True)
+
+
+class Remainder(BinaryArithmetic):
+    """Spark `%`: sign of the dividend (Java); 0 divisor -> NULL."""
+    symbol = "%"
+
+    def eval_device(self, ctx):
+        ld, rd, v, dt = self._promoted_device_operands(ctx)
+        zero = rd == 0
+        v = null_and(v, jnp.logical_not(zero))
+        safe = jnp.where(zero, jnp.ones_like(rd), rd)
+        return DVal(jnp.fmod(ld, safe), v, dt)
+
+    def eval_host(self, batch):
+        return host_binary_numpy(self, batch, np.fmod,
+                                 self.data_type(batch.schema),
+                                 null_on_zero_rhs=True)
+
+
+class Pmod(BinaryArithmetic):
+    """Positive modulo (ref GpuPmod)."""
+    symbol = "pmod"
+
+    def eval_device(self, ctx):
+        ld, rd, v, dt = self._promoted_device_operands(ctx)
+        zero = rd == 0
+        v = null_and(v, jnp.logical_not(zero))
+        safe = jnp.where(zero, jnp.ones_like(rd), rd)
+        m = jnp.fmod(ld, safe)
+        m = jnp.where(m < 0, jnp.fmod(m + safe, safe), m)
+        return DVal(m, v, dt)
+
+    def eval_host(self, batch):
+        def f(l, r):
+            m = np.fmod(l, r)
+            return np.where(m < 0, np.fmod(m + r, r), m)
+        return host_binary_numpy(self, batch, f, self.data_type(batch.schema),
+                                 null_on_zero_rhs=True)
+
+
+class UnaryMinus(Expression):
+    device_type_sig = numeric
+
+    def __init__(self, child: Expression):
+        self.children = [child]
+
+    def data_type(self, schema):
+        return self.children[0].data_type(schema)
+
+    def eval_device(self, ctx):
+        c = self.children[0].eval_device(ctx)
+        return DVal(-c.data, c.validity, c.dtype)
+
+    def eval_host(self, batch):
+        v, ok = arrow_to_masked_numpy(self.children[0].eval_host(batch))
+        return masked_numpy_to_arrow(-v, ok, self.data_type(batch.schema))
+
+    def key(self):
+        return f"neg({self.children[0].key()})"
+
+
+class Abs(Expression):
+    device_type_sig = numeric
+
+    def __init__(self, child: Expression):
+        self.children = [child]
+
+    def data_type(self, schema):
+        return self.children[0].data_type(schema)
+
+    def eval_device(self, ctx):
+        c = self.children[0].eval_device(ctx)
+        return DVal(jnp.abs(c.data), c.validity, c.dtype)
+
+    def eval_host(self, batch):
+        v, ok = arrow_to_masked_numpy(self.children[0].eval_host(batch))
+        return masked_numpy_to_arrow(np.abs(v), ok, self.data_type(batch.schema))
+
+    def key(self):
+        return f"abs({self.children[0].key()})"

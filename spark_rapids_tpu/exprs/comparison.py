@@ -1,0 +1,298 @@
+"""Comparison predicates (ref sql-plugin predicates.scala GpuEqualTo etc.).
+
+Numeric comparisons promote operands; NaN handling follows Spark: NaN == NaN
+is true and NaN is largest for ordering (ref GpuGreaterThan docs / cudf NaN
+config spark.rapids.sql.hasNans).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..types import BOOL, DataType, Schema, comparable, STRING
+from .base import DVal, EvalContext, Expression, null_and, promote_types
+from .arithmetic import arrow_to_masked_numpy, masked_numpy_to_arrow
+
+__all__ = ["EqualTo", "EqualNullSafe", "NotEqual", "LessThan",
+           "LessThanOrEqual", "GreaterThan", "GreaterThanOrEqual",
+           "IsNull", "IsNotNull", "IsNaN", "In"]
+
+
+def _nan_eq(l, r):
+    base = l == r
+    if jnp.issubdtype(l.dtype, jnp.floating):
+        both_nan = jnp.logical_and(jnp.isnan(l), jnp.isnan(r))
+        return jnp.logical_or(base, both_nan)
+    return base
+
+
+def _nan_lt(l, r):
+    # Spark ordering: NaN is greater than everything
+    if jnp.issubdtype(l.dtype, jnp.floating):
+        ln, rn = jnp.isnan(l), jnp.isnan(r)
+        return jnp.where(rn, jnp.logical_not(ln), jnp.logical_and(
+            jnp.logical_not(ln), l < r))
+    return l < r
+
+
+class BinaryComparison(Expression):
+    device_type_sig = comparable
+    symbol = "?"
+
+    def __init__(self, left: Expression, right: Expression):
+        self.children = [left, right]
+
+    def data_type(self, schema: Schema) -> DataType:
+        return BOOL
+
+    def _operands(self, ctx: EvalContext):
+        l = self.children[0].eval_device(ctx)
+        r = self.children[1].eval_device(ctx)
+        ldt = self.children[0].data_type(ctx.schema)
+        rdt = self.children[1].data_type(ctx.schema)
+        if ldt != rdt:
+            wide = promote_types(ldt, rdt)
+            return (l.data.astype(wide.np_dtype), r.data.astype(wide.np_dtype),
+                    null_and(l.validity, r.validity))
+        return l.data, r.data, null_and(l.validity, r.validity)
+
+    def _host_operands(self, batch):
+        l, lv = arrow_to_masked_numpy(self.children[0].eval_host(batch))
+        r, rv = arrow_to_masked_numpy(self.children[1].eval_host(batch))
+        ldt = self.children[0].data_type(batch.schema)
+        rdt = self.children[1].data_type(batch.schema)
+        if ldt != rdt and ldt.device_backed and rdt.device_backed:
+            wide = promote_types(ldt, rdt).np_dtype
+            l, r = l.astype(wide), r.astype(wide)
+        return l, r, lv & rv
+
+    def key(self):
+        return f"{type(self).__name__}({self.children[0].key()},{self.children[1].key()})"
+
+    @property
+    def name_hint(self):
+        return (f"({self.children[0].name_hint} {self.symbol} "
+                f"{self.children[1].name_hint})")
+
+
+class EqualTo(BinaryComparison):
+    symbol = "="
+
+    def eval_device(self, ctx):
+        l, r, v = self._operands(ctx)
+        return DVal(_nan_eq(l, r), v, BOOL)
+
+    def eval_host(self, batch):
+        l, r, v = self._host_operands(batch)
+        with np.errstate(all="ignore"):
+            eq = l == r
+            if np.issubdtype(np.asarray(l).dtype, np.floating):
+                eq = eq | (np.isnan(l) & np.isnan(r))
+        return masked_numpy_to_arrow(eq, v, BOOL)
+
+
+class EqualNullSafe(BinaryComparison):
+    """<=> : never null; null <=> null is true."""
+    symbol = "<=>"
+
+    def eval_device(self, ctx):
+        l = self.children[0].eval_device(ctx)
+        r = self.children[1].eval_device(ctx)
+        eq = _nan_eq(l.data, r.data)
+        both_null = jnp.logical_and(~l.validity, ~r.validity)
+        both_valid = jnp.logical_and(l.validity, r.validity)
+        out = jnp.logical_or(both_null, jnp.logical_and(both_valid, eq))
+        return DVal(out, jnp.ones_like(out, dtype=jnp.bool_), BOOL)
+
+    def eval_host(self, batch):
+        l, lv = arrow_to_masked_numpy(self.children[0].eval_host(batch))
+        r, rv = arrow_to_masked_numpy(self.children[1].eval_host(batch))
+        with np.errstate(all="ignore"):
+            eq = l == r
+        out = (~lv & ~rv) | (lv & rv & eq)
+        return masked_numpy_to_arrow(out, np.ones_like(out, dtype=bool), BOOL)
+
+
+class NotEqual(BinaryComparison):
+    symbol = "!="
+
+    def eval_device(self, ctx):
+        l, r, v = self._operands(ctx)
+        return DVal(jnp.logical_not(_nan_eq(l, r)), v, BOOL)
+
+    def eval_host(self, batch):
+        l, r, v = self._host_operands(batch)
+        with np.errstate(all="ignore"):
+            eq = l == r
+            if np.issubdtype(np.asarray(l).dtype, np.floating):
+                eq = eq | (np.isnan(l) & np.isnan(r))
+        return masked_numpy_to_arrow(~eq, v, BOOL)
+
+
+def _host_cmp(op):
+    def f(self, batch):
+        l, r, v = self._host_operands(batch)
+        fl = np.issubdtype(np.asarray(l).dtype, np.floating)
+        with np.errstate(all="ignore"):
+            if fl:
+                # Spark float ordering: NaN compares greater than everything
+                ln, rn = np.isnan(l), np.isnan(r)
+                l2 = np.where(ln, 0, l)
+                r2 = np.where(rn, 0, r)
+                lt = np.where(rn, ~ln, ~ln & (l2 < r2))
+                eq = np.where(ln & rn, True, (~ln & ~rn) & (l2 == r2))
+                out = {"lt": lt, "le": lt | eq, "gt": ~(lt | eq), "ge": ~lt}[op]
+            else:
+                out = {"lt": l < r, "le": l <= r, "gt": l > r, "ge": l >= r}[op]
+        return masked_numpy_to_arrow(out, v, BOOL)
+    return f
+
+
+class LessThan(BinaryComparison):
+    symbol = "<"
+
+    def eval_device(self, ctx):
+        l, r, v = self._operands(ctx)
+        return DVal(_nan_lt(l, r), v, BOOL)
+
+    eval_host = _host_cmp("lt")
+
+
+class LessThanOrEqual(BinaryComparison):
+    symbol = "<="
+
+    def eval_device(self, ctx):
+        l, r, v = self._operands(ctx)
+        return DVal(jnp.logical_or(_nan_lt(l, r), _nan_eq(l, r)), v, BOOL)
+
+    eval_host = _host_cmp("le")
+
+
+class GreaterThan(BinaryComparison):
+    symbol = ">"
+
+    def eval_device(self, ctx):
+        l, r, v = self._operands(ctx)
+        return DVal(jnp.logical_not(
+            jnp.logical_or(_nan_lt(l, r), _nan_eq(l, r))), v, BOOL)
+
+    eval_host = _host_cmp("gt")
+
+
+class GreaterThanOrEqual(BinaryComparison):
+    symbol = ">="
+
+    def eval_device(self, ctx):
+        l, r, v = self._operands(ctx)
+        return DVal(jnp.logical_not(_nan_lt(l, r)), v, BOOL)
+
+    eval_host = _host_cmp("ge")
+
+
+class IsNull(Expression):
+    def __init__(self, child: Expression):
+        self.children = [child]
+
+    def data_type(self, schema):
+        return BOOL
+
+    def nullable(self, schema):
+        return False
+
+    def device_unsupported_reason(self, schema):
+        return None  # works for any child whose column is device-backed
+
+    def eval_device(self, ctx):
+        c = self.children[0].eval_device(ctx)
+        out = jnp.logical_not(c.validity)
+        # padding rows must not count as "null rows"
+        out = jnp.logical_and(out, ctx.row_mask())
+        return DVal(out, jnp.ones_like(out), BOOL)
+
+    def eval_host(self, batch):
+        import pyarrow.compute as pc
+        return pc.is_null(self.children[0].eval_host(batch))
+
+    def key(self):
+        return f"isnull({self.children[0].key()})"
+
+
+class IsNotNull(Expression):
+    def __init__(self, child: Expression):
+        self.children = [child]
+
+    def data_type(self, schema):
+        return BOOL
+
+    def nullable(self, schema):
+        return False
+
+    def device_unsupported_reason(self, schema):
+        return None
+
+    def eval_device(self, ctx):
+        c = self.children[0].eval_device(ctx)
+        return DVal(c.validity, jnp.ones_like(c.validity), BOOL)
+
+    def eval_host(self, batch):
+        import pyarrow.compute as pc
+        return pc.is_valid(self.children[0].eval_host(batch))
+
+    def key(self):
+        return f"isnotnull({self.children[0].key()})"
+
+
+class IsNaN(Expression):
+    def __init__(self, child: Expression):
+        self.children = [child]
+
+    def data_type(self, schema):
+        return BOOL
+
+    def eval_device(self, ctx):
+        c = self.children[0].eval_device(ctx)
+        if jnp.issubdtype(c.data.dtype, jnp.floating):
+            out = jnp.isnan(c.data)
+        else:
+            out = jnp.zeros_like(c.validity)
+        return DVal(out, c.validity, BOOL)
+
+    def eval_host(self, batch):
+        v, ok = arrow_to_masked_numpy(self.children[0].eval_host(batch))
+        out = np.isnan(v) if np.issubdtype(v.dtype, np.floating) \
+            else np.zeros(len(v), dtype=bool)
+        return masked_numpy_to_arrow(out, ok, BOOL)
+
+    def key(self):
+        return f"isnan({self.children[0].key()})"
+
+
+class In(Expression):
+    """value IN (literals...) (ref GpuInSet)."""
+
+    def __init__(self, child: Expression, values):
+        self.children = [child]
+        self.values = tuple(values)
+
+    def data_type(self, schema):
+        return BOOL
+
+    def eval_device(self, ctx):
+        c = self.children[0].eval_device(ctx)
+        out = jnp.zeros(ctx.padded_len, dtype=jnp.bool_)
+        for v in self.values:
+            if v is None:
+                continue
+            out = jnp.logical_or(out, c.data == v)
+        return DVal(out, c.validity, BOOL)
+
+    def eval_host(self, batch):
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        arr = self.children[0].eval_host(batch)
+        vals = pa.array([v for v in self.values if v is not None],
+                        type=arr.type)
+        return pc.is_in(arr, value_set=vals)
+
+    def key(self):
+        return f"in({self.children[0].key()},{self.values!r})"

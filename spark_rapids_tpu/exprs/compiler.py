@@ -1,0 +1,159 @@
+"""Expression -> XLA kernel compiler.
+
+The TPU-first heart of the execution layer: an operator's whole expression
+list is traced once per (expression-tree, shape-bucket, input-dtypes) into a
+single jitted XLA computation operating on padded (data, validity) arrays.
+XLA fuses all the elementwise work into a handful of HBM passes — the analog
+of (and improvement over) the reference's per-expression cudf kernel launches
+(GpuExpressions.scala columnarEval chain), and of its AST fusion subsystem
+(AstUtil.scala) which only fuses within join conditions.
+
+Also hosts the device row-compaction kernel used by filter (cumsum + scatter,
+O(n), no sort) — reference analog: cudf apply_boolean_mask behind
+GpuFilter (basicPhysicalOperators.scala:649).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import ColumnarBatch, DeviceColumn, HostColumn
+from ..columnar.bucketing import bucket_for
+from ..types import Schema, StructField
+from .base import DVal, EvalContext, Expression
+
+__all__ = ["compile_projection", "DeviceProjector", "filter_batch_device",
+           "gather_batch_device", "eval_predicate_device"]
+
+# global cache: key -> jitted fn (jit itself re-specializes per shape bucket)
+_KERNEL_CACHE: Dict[Tuple, "object"] = {}
+
+
+def _device_ordinals(schema: Schema) -> List[int]:
+    return [i for i, f in enumerate(schema.fields) if f.dtype.device_backed]
+
+
+class DeviceProjector:
+    """Evaluates a fixed list of device-supported expressions against batches
+    of a fixed input schema via one jitted kernel."""
+
+    def __init__(self, exprs: Sequence[Expression], schema: Schema):
+        self.exprs = list(exprs)
+        self.schema = schema
+        self.out_types = [e.data_type(schema) for e in self.exprs]
+        self._key = (tuple(e.key() for e in self.exprs),
+                     tuple((f.name, f.dtype.name) for f in schema.fields))
+        self._fn = _KERNEL_CACHE.get(self._key)
+        if self._fn is None:
+            self._fn = self._build()
+            _KERNEL_CACHE[self._key] = self._fn
+
+    def _build(self):
+        exprs, schema = self.exprs, self.schema
+        dtypes = [f.dtype for f in schema.fields]  # static, closed over
+
+        @functools.partial(jax.jit, static_argnums=(2,))
+        def kernel(cols, num_rows, padded_len):
+            dvals = [None if c is None else DVal(c[0], c[1], dt)
+                     for c, dt in zip(cols, dtypes)]
+            ctx = EvalContext(schema, dvals, num_rows, padded_len)
+            outs = []
+            for e in exprs:
+                v = e.eval_device(ctx)
+                # clamp validity so padding rows are always invalid
+                outs.append((v.data, jnp.logical_and(v.validity, ctx.row_mask())))
+            return outs
+
+        return kernel
+
+    def run(self, batch: ColumnarBatch) -> List[DeviceColumn]:
+        p = batch.padded_len
+        cols = []
+        for i, f in enumerate(batch.schema.fields):
+            c = batch.columns[i]
+            if isinstance(c, DeviceColumn):
+                cols.append((c.data, c.validity))
+            else:
+                cols.append(None)  # host column: device exprs must not touch it
+        num_rows = jnp.int32(batch.num_rows)
+        outs = self._fn(cols, num_rows, p)
+        return [DeviceColumn(d, v, dt)
+                for (d, v), dt in zip(outs, self.out_types)]
+
+
+def compile_projection(exprs: Sequence[Expression], schema: Schema) -> DeviceProjector:
+    return DeviceProjector(exprs, schema)
+
+
+# ---------------------------------------------------------------------------
+# filter / gather kernels
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _compact_kernel(arrays, keep, padded_len):
+    """Move rows where keep=True to the front preserving order.
+
+    arrays: list of (data, validity); keep: bool[P] (False on padding).
+    Returns compacted (data, validity) list + new row count (int32 scalar).
+    O(n) cumsum + scatter-with-drop, no sort.
+    """
+    count = jnp.sum(keep).astype(jnp.int32)
+    pos = jnp.where(keep, jnp.cumsum(keep) - 1, padded_len)
+    live = jnp.arange(padded_len, dtype=jnp.int32) < count
+    outs = []
+    for data, validity in arrays:
+        od = jnp.zeros_like(data).at[pos].set(data, mode="drop")
+        ov = jnp.zeros_like(validity).at[pos].set(validity, mode="drop")
+        outs.append((od, jnp.logical_and(ov, live)))
+    return outs, count
+
+
+def eval_predicate_device(pred: Expression, batch: ColumnarBatch) -> jnp.ndarray:
+    """bool[P] keep-mask: predicate true AND valid AND a real row."""
+    proj = compile_projection([pred], batch.schema)
+    col = proj.run(batch)[0]
+    return jnp.logical_and(col.data, col.validity)
+
+
+def filter_batch_device(pred: Expression, batch: ColumnarBatch) -> ColumnarBatch:
+    """Device filter over an all-device batch (host columns unsupported here —
+    the planner falls back for those)."""
+    keep = eval_predicate_device(pred, batch)
+    arrays = [(c.data, c.validity) for c in batch.columns]
+    outs, count = _compact_kernel(arrays, keep, batch.padded_len)
+    new_cols = [DeviceColumn(d, v, c.dtype)
+                for (d, v), c in zip(outs, batch.columns)]
+    return ColumnarBatch(new_cols, int(count), batch.schema)
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _gather_kernel(arrays, indices, out_len):
+    """Gather rows by index (int32[out_len]); index < 0 yields null row."""
+    idx = jnp.clip(indices, 0, None)
+    null_row = indices < 0
+    outs = []
+    for data, validity in arrays:
+        od = jnp.take(data, idx, mode="clip")
+        ov = jnp.logical_and(jnp.take(validity, idx, mode="clip"),
+                             jnp.logical_not(null_row))
+        outs.append((od, ov))
+    return outs
+
+
+def gather_batch_device(batch: ColumnarBatch, indices, num_rows: int,
+                        out_padded: Optional[int] = None) -> ColumnarBatch:
+    """Row gather (ref JoinGatherer.scala gather-map application). ``indices``
+    may be longer than num_rows (padding); negative index = null output row."""
+    out_p = out_padded if out_padded is not None else int(indices.shape[0])
+    arrays = [(c.data, c.validity) for c in batch.columns]
+    outs = _gather_kernel(arrays, indices, out_p)
+    live = np.arange(out_p) < num_rows
+    new_cols = []
+    for (d, v), c in zip(outs, batch.columns):
+        v = jnp.logical_and(v, jnp.asarray(live))
+        new_cols.append(DeviceColumn(d, v, c.dtype))
+    return ColumnarBatch(new_cols, num_rows, batch.schema)

@@ -1,0 +1,179 @@
+"""Conditional expressions (ref conditionalExpressions.scala: GpuIf,
+GpuCaseWhen, GpuCoalesce; nullExpressions GpuNaNvl)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..types import BOOL, DataType, Schema
+from .base import DVal, Expression, promote_types
+from .arithmetic import arrow_to_masked_numpy, masked_numpy_to_arrow
+
+__all__ = ["If", "CaseWhen", "Coalesce", "NaNvl"]
+
+
+def _common_type(schema: Schema, exprs) -> DataType:
+    dt = None
+    for e in exprs:
+        edt = e.data_type(schema)
+        if edt.name == "void":
+            continue
+        dt = edt if dt is None else promote_types(dt, edt)
+    return dt if dt is not None else exprs[0].data_type(schema)
+
+
+class If(Expression):
+    def __init__(self, pred, if_true, if_false):
+        self.children = [pred, if_true, if_false]
+
+    def data_type(self, schema):
+        return _common_type(schema, self.children[1:])
+
+    def eval_device(self, ctx):
+        dt = self.data_type(ctx.schema)
+        p = self.children[0].eval_device(ctx)
+        t = self.children[1].eval_device(ctx)
+        f = self.children[2].eval_device(ctx)
+        # null predicate selects the else branch (SQL semantics)
+        cond = jnp.logical_and(p.data, p.validity)
+        data = jnp.where(cond, t.data.astype(dt.np_dtype),
+                         f.data.astype(dt.np_dtype))
+        validity = jnp.where(cond, t.validity, f.validity)
+        return DVal(data, validity, dt)
+
+    def eval_host(self, batch):
+        dt = self.data_type(batch.schema)
+        p, pv = arrow_to_masked_numpy(self.children[0].eval_host(batch))
+        t, tv = arrow_to_masked_numpy(self.children[1].eval_host(batch))
+        f, fv = arrow_to_masked_numpy(self.children[2].eval_host(batch))
+        cond = p.astype(bool) & pv
+        np_dt = dt.np_dtype
+        data = np.where(cond, t.astype(np_dt), f.astype(np_dt))
+        valid = np.where(cond, tv, fv)
+        return masked_numpy_to_arrow(data, valid, dt)
+
+    def key(self):
+        return ("if(" + ",".join(c.key() for c in self.children) + ")")
+
+
+class CaseWhen(Expression):
+    """CASE WHEN p1 THEN v1 ... ELSE e END (ref GpuCaseWhen + CaseWhen JNI)."""
+
+    def __init__(self, branches, else_value=None):
+        # branches: list of (pred_expr, value_expr)
+        self.branches = list(branches)
+        self.else_value = else_value
+        self.children = [e for p, v in self.branches for e in (p, v)] + (
+            [else_value] if else_value is not None else [])
+
+    def data_type(self, schema):
+        vals = [v for _, v in self.branches] + (
+            [self.else_value] if self.else_value is not None else [])
+        return _common_type(schema, vals)
+
+    def eval_device(self, ctx):
+        dt = self.data_type(ctx.schema)
+        np_dt = dt.np_dtype
+        if self.else_value is not None:
+            e = self.else_value.eval_device(ctx)
+            data, validity = e.data.astype(np_dt), e.validity
+        else:
+            data = jnp.zeros(ctx.padded_len, dtype=np_dt)
+            validity = jnp.zeros(ctx.padded_len, dtype=jnp.bool_)
+        # apply branches in reverse so the first match wins
+        for pred, val in reversed(self.branches):
+            p = pred.eval_device(ctx)
+            v = val.eval_device(ctx)
+            cond = jnp.logical_and(p.data, p.validity)
+            data = jnp.where(cond, v.data.astype(np_dt), data)
+            validity = jnp.where(cond, v.validity, validity)
+        return DVal(data, validity, dt)
+
+    def eval_host(self, batch):
+        dt = self.data_type(batch.schema)
+        np_dt = dt.np_dtype
+        n = batch.num_rows
+        if self.else_value is not None:
+            data, valid = arrow_to_masked_numpy(self.else_value.eval_host(batch))
+            data = data.astype(np_dt)
+        else:
+            data = np.zeros(n, dtype=np_dt)
+            valid = np.zeros(n, dtype=bool)
+        for pred, val in reversed(self.branches):
+            p, pv = arrow_to_masked_numpy(pred.eval_host(batch))
+            v, vv = arrow_to_masked_numpy(val.eval_host(batch))
+            cond = p.astype(bool) & pv
+            data = np.where(cond, v.astype(np_dt), data)
+            valid = np.where(cond, vv, valid)
+        return masked_numpy_to_arrow(data, valid, dt)
+
+    def key(self):
+        b = ";".join(f"{p.key()}->{v.key()}" for p, v in self.branches)
+        e = self.else_value.key() if self.else_value is not None else ""
+        return f"case({b}|{e})"
+
+
+class Coalesce(Expression):
+    def __init__(self, *exprs):
+        self.children = list(exprs)
+
+    def data_type(self, schema):
+        return _common_type(schema, self.children)
+
+    def eval_device(self, ctx):
+        dt = self.data_type(ctx.schema)
+        np_dt = dt.np_dtype
+        data = jnp.zeros(ctx.padded_len, dtype=np_dt)
+        validity = jnp.zeros(ctx.padded_len, dtype=jnp.bool_)
+        for child in reversed(self.children):
+            c = child.eval_device(ctx)
+            data = jnp.where(c.validity, c.data.astype(np_dt), data)
+            validity = jnp.logical_or(validity, c.validity)
+        return DVal(data, validity, dt)
+
+    def eval_host(self, batch):
+        dt = self.data_type(batch.schema)
+        np_dt = dt.np_dtype
+        data = np.zeros(batch.num_rows, dtype=np_dt)
+        valid = np.zeros(batch.num_rows, dtype=bool)
+        for child in reversed(self.children):
+            v, vv = arrow_to_masked_numpy(child.eval_host(batch))
+            data = np.where(vv, v.astype(np_dt), data)
+            valid = valid | vv
+        return masked_numpy_to_arrow(data, valid, dt)
+
+    def key(self):
+        return "coalesce(" + ",".join(c.key() for c in self.children) + ")"
+
+
+class NaNvl(Expression):
+    """nanvl(a, b): b where a is NaN (ref GpuNaNvl)."""
+
+    def __init__(self, left, right):
+        self.children = [left, right]
+
+    def data_type(self, schema):
+        return _common_type(schema, self.children)
+
+    def eval_device(self, ctx):
+        dt = self.data_type(ctx.schema)
+        l = self.children[0].eval_device(ctx)
+        r = self.children[1].eval_device(ctx)
+        ld = l.data.astype(dt.np_dtype)
+        rd = r.data.astype(dt.np_dtype)
+        isnan = jnp.isnan(ld)
+        return DVal(jnp.where(isnan, rd, ld),
+                    jnp.where(isnan, r.validity, l.validity), dt)
+
+    def eval_host(self, batch):
+        dt = self.data_type(batch.schema)
+        l, lv = arrow_to_masked_numpy(self.children[0].eval_host(batch))
+        r, rv = arrow_to_masked_numpy(self.children[1].eval_host(batch))
+        ld = l.astype(dt.np_dtype)
+        rd = r.astype(dt.np_dtype)
+        isnan = np.isnan(ld)
+        return masked_numpy_to_arrow(np.where(isnan, rd, ld),
+                                     np.where(isnan, rv, lv), dt)
+
+    def key(self):
+        return f"nanvl({self.children[0].key()},{self.children[1].key()})"

@@ -1,0 +1,109 @@
+"""Logical AND/OR/NOT with SQL three-valued (Kleene) semantics.
+
+Reference: predicates.scala GpuAnd/GpuOr (cudf and_kleene/or_kleene).
+  FALSE AND NULL = FALSE;  TRUE OR NULL = TRUE; otherwise null propagates.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..types import BOOL, TypeSig, TypeEnum
+from .base import DVal, Expression
+from .arithmetic import arrow_to_masked_numpy, masked_numpy_to_arrow
+
+__all__ = ["And", "Or", "Not"]
+
+_bool_sig = TypeSig([TypeEnum.BOOLEAN])
+
+
+class And(Expression):
+    device_type_sig = _bool_sig
+    symbol = "AND"
+
+    def __init__(self, left, right):
+        self.children = [left, right]
+
+    def data_type(self, schema):
+        return BOOL
+
+    def eval_device(self, ctx):
+        l = self.children[0].eval_device(ctx)
+        r = self.children[1].eval_device(ctx)
+        ld = jnp.logical_and(l.data, l.validity)  # null -> "unknown"
+        rd = jnp.logical_and(r.data, r.validity)
+        # Kleene: result valid if both valid, OR either side is definite False
+        false_l = jnp.logical_and(l.validity, jnp.logical_not(l.data))
+        false_r = jnp.logical_and(r.validity, jnp.logical_not(r.data))
+        validity = jnp.logical_or(jnp.logical_and(l.validity, r.validity),
+                                  jnp.logical_or(false_l, false_r))
+        data = jnp.logical_and(ld, rd)
+        return DVal(data, validity, BOOL)
+
+    def eval_host(self, batch):
+        import pyarrow.compute as pc
+        return pc.and_kleene(self.children[0].eval_host(batch),
+                             self.children[1].eval_host(batch))
+
+    def key(self):
+        return f"and({self.children[0].key()},{self.children[1].key()})"
+
+    @property
+    def name_hint(self):
+        return f"({self.children[0].name_hint} AND {self.children[1].name_hint})"
+
+
+class Or(Expression):
+    device_type_sig = _bool_sig
+    symbol = "OR"
+
+    def __init__(self, left, right):
+        self.children = [left, right]
+
+    def data_type(self, schema):
+        return BOOL
+
+    def eval_device(self, ctx):
+        l = self.children[0].eval_device(ctx)
+        r = self.children[1].eval_device(ctx)
+        ld = jnp.logical_and(l.data, l.validity)
+        rd = jnp.logical_and(r.data, r.validity)
+        true_l = jnp.logical_and(l.validity, l.data)
+        true_r = jnp.logical_and(r.validity, r.data)
+        validity = jnp.logical_or(jnp.logical_and(l.validity, r.validity),
+                                  jnp.logical_or(true_l, true_r))
+        data = jnp.logical_or(ld, rd)
+        return DVal(data, validity, BOOL)
+
+    def eval_host(self, batch):
+        import pyarrow.compute as pc
+        return pc.or_kleene(self.children[0].eval_host(batch),
+                            self.children[1].eval_host(batch))
+
+    def key(self):
+        return f"or({self.children[0].key()},{self.children[1].key()})"
+
+    @property
+    def name_hint(self):
+        return f"({self.children[0].name_hint} OR {self.children[1].name_hint})"
+
+
+class Not(Expression):
+    device_type_sig = _bool_sig
+
+    def __init__(self, child):
+        self.children = [child]
+
+    def data_type(self, schema):
+        return BOOL
+
+    def eval_device(self, ctx):
+        c = self.children[0].eval_device(ctx)
+        return DVal(jnp.logical_not(c.data), c.validity, BOOL)
+
+    def eval_host(self, batch):
+        v, ok = arrow_to_masked_numpy(self.children[0].eval_host(batch))
+        return masked_numpy_to_arrow(~v.astype(bool), ok, BOOL)
+
+    def key(self):
+        return f"not({self.children[0].key()})"

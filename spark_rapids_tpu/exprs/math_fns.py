@@ -1,0 +1,223 @@
+"""Math functions (ref sql-plugin mathExpressions.scala, 820 LoC).
+
+Unary double functions follow Spark: input cast to double, domain errors
+produce NaN (not null) matching java.lang.Math.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..types import FLOAT64, INT64, Schema, numeric
+from .base import DVal, Expression, null_and
+from .arithmetic import arrow_to_masked_numpy, masked_numpy_to_arrow
+
+__all__ = ["Sqrt", "Exp", "Log", "Log10", "Sin", "Cos", "Tan", "Asin",
+           "Acos", "Atan", "Sinh", "Cosh", "Tanh", "Cbrt", "Floor", "Ceil",
+           "Round", "Pow", "Signum", "Expm1", "Log1p", "Log2", "Atan2",
+           "ToDegrees", "ToRadians", "Rint"]
+
+
+class _UnaryDouble(Expression):
+    device_type_sig = numeric
+    jnp_fn = None
+    np_fn = None
+
+    def __init__(self, child):
+        self.children = [child]
+
+    def data_type(self, schema: Schema):
+        return FLOAT64
+
+    def eval_device(self, ctx):
+        c = self.children[0].eval_device(ctx)
+        d = c.data.astype(jnp.float64)
+        return DVal(type(self).jnp_fn(d), c.validity, FLOAT64)
+
+    def eval_host(self, batch):
+        v, ok = arrow_to_masked_numpy(self.children[0].eval_host(batch))
+        with np.errstate(all="ignore"):
+            out = type(self).np_fn(v.astype(np.float64))
+        return masked_numpy_to_arrow(out, ok, FLOAT64)
+
+    def key(self):
+        return f"{type(self).__name__.lower()}({self.children[0].key()})"
+
+
+def _mk(name, jf, nf):
+    cls = type(name, (_UnaryDouble,), {"jnp_fn": staticmethod(jf),
+                                       "np_fn": staticmethod(nf)})
+    return cls
+
+
+Sqrt = _mk("Sqrt", jnp.sqrt, np.sqrt)
+Exp = _mk("Exp", jnp.exp, np.exp)
+Log = _mk("Log", jnp.log, np.log)
+Log10 = _mk("Log10", jnp.log10, np.log10)
+Log2 = _mk("Log2", jnp.log2, np.log2)
+Log1p = _mk("Log1p", jnp.log1p, np.log1p)
+Expm1 = _mk("Expm1", jnp.expm1, np.expm1)
+Sin = _mk("Sin", jnp.sin, np.sin)
+Cos = _mk("Cos", jnp.cos, np.cos)
+Tan = _mk("Tan", jnp.tan, np.tan)
+Asin = _mk("Asin", jnp.arcsin, np.arcsin)
+Acos = _mk("Acos", jnp.arccos, np.arccos)
+Atan = _mk("Atan", jnp.arctan, np.arctan)
+Sinh = _mk("Sinh", jnp.sinh, np.sinh)
+Cosh = _mk("Cosh", jnp.cosh, np.cosh)
+Tanh = _mk("Tanh", jnp.tanh, np.tanh)
+Cbrt = _mk("Cbrt", jnp.cbrt, np.cbrt)
+ToDegrees = _mk("ToDegrees", jnp.degrees, np.degrees)
+ToRadians = _mk("ToRadians", jnp.radians, np.radians)
+Rint = _mk("Rint", jnp.rint, np.rint)
+
+
+class Signum(_UnaryDouble):
+    jnp_fn = staticmethod(jnp.sign)
+    np_fn = staticmethod(np.sign)
+
+
+class Floor(Expression):
+    """floor(double) -> bigint (Spark)."""
+    device_type_sig = numeric
+
+    def __init__(self, child):
+        self.children = [child]
+
+    def data_type(self, schema):
+        return INT64
+
+    def eval_device(self, ctx):
+        c = self.children[0].eval_device(ctx)
+        return DVal(jnp.floor(c.data.astype(jnp.float64)).astype(jnp.int64),
+                    c.validity, INT64)
+
+    def eval_host(self, batch):
+        v, ok = arrow_to_masked_numpy(self.children[0].eval_host(batch))
+        with np.errstate(all="ignore"):
+            out = np.floor(v.astype(np.float64))
+            out = np.where(np.isfinite(out), out, 0)
+        return masked_numpy_to_arrow(out.astype(np.int64), ok, INT64)
+
+    def key(self):
+        return f"floor({self.children[0].key()})"
+
+
+class Ceil(Expression):
+    device_type_sig = numeric
+
+    def __init__(self, child):
+        self.children = [child]
+
+    def data_type(self, schema):
+        return INT64
+
+    def eval_device(self, ctx):
+        c = self.children[0].eval_device(ctx)
+        return DVal(jnp.ceil(c.data.astype(jnp.float64)).astype(jnp.int64),
+                    c.validity, INT64)
+
+    def eval_host(self, batch):
+        v, ok = arrow_to_masked_numpy(self.children[0].eval_host(batch))
+        with np.errstate(all="ignore"):
+            out = np.ceil(v.astype(np.float64))
+            out = np.where(np.isfinite(out), out, 0)
+        return masked_numpy_to_arrow(out.astype(np.int64), ok, INT64)
+
+    def key(self):
+        return f"ceil({self.children[0].key()})"
+
+
+class Round(Expression):
+    """round(x, d) HALF_UP like Spark (not banker's rounding)."""
+    device_type_sig = numeric
+
+    def __init__(self, child, decimals: int = 0):
+        self.children = [child]
+        self.decimals = int(decimals)
+
+    def data_type(self, schema):
+        return self.children[0].data_type(schema)
+
+    def eval_device(self, ctx):
+        c = self.children[0].eval_device(ctx)
+        if jnp.issubdtype(c.data.dtype, jnp.integer) and self.decimals >= 0:
+            return c
+        scale = 10.0 ** self.decimals
+        d = c.data.astype(jnp.float64)
+        # HALF_UP: round half away from zero
+        out = jnp.sign(d) * jnp.floor(jnp.abs(d) * scale + 0.5) / scale
+        return DVal(out.astype(c.data.dtype) if jnp.issubdtype(
+            c.data.dtype, jnp.integer) else out, c.validity,
+            self.data_type(ctx.schema))
+
+    def eval_host(self, batch):
+        v, ok = arrow_to_masked_numpy(self.children[0].eval_host(batch))
+        dt = self.data_type(batch.schema)
+        if np.issubdtype(v.dtype, np.integer) and self.decimals >= 0:
+            return masked_numpy_to_arrow(v, ok, dt)
+        scale = 10.0 ** self.decimals
+        d = v.astype(np.float64)
+        with np.errstate(all="ignore"):
+            out = np.sign(d) * np.floor(np.abs(d) * scale + 0.5) / scale
+            out = np.where(np.isfinite(d), out, d)
+        if np.issubdtype(v.dtype, np.integer):
+            out = out.astype(v.dtype)
+        return masked_numpy_to_arrow(out, ok, dt)
+
+    def key(self):
+        return f"round({self.children[0].key()},{self.decimals})"
+
+
+class Pow(Expression):
+    device_type_sig = numeric
+
+    def __init__(self, left, right):
+        self.children = [left, right]
+
+    def data_type(self, schema):
+        return FLOAT64
+
+    def eval_device(self, ctx):
+        l = self.children[0].eval_device(ctx)
+        r = self.children[1].eval_device(ctx)
+        return DVal(jnp.power(l.data.astype(jnp.float64),
+                              r.data.astype(jnp.float64)),
+                    null_and(l.validity, r.validity), FLOAT64)
+
+    def eval_host(self, batch):
+        l, lv = arrow_to_masked_numpy(self.children[0].eval_host(batch))
+        r, rv = arrow_to_masked_numpy(self.children[1].eval_host(batch))
+        with np.errstate(all="ignore"):
+            out = np.power(l.astype(np.float64), r.astype(np.float64))
+        return masked_numpy_to_arrow(out, lv & rv, FLOAT64)
+
+    def key(self):
+        return f"pow({self.children[0].key()},{self.children[1].key()})"
+
+
+class Atan2(Expression):
+    device_type_sig = numeric
+
+    def __init__(self, left, right):
+        self.children = [left, right]
+
+    def data_type(self, schema):
+        return FLOAT64
+
+    def eval_device(self, ctx):
+        l = self.children[0].eval_device(ctx)
+        r = self.children[1].eval_device(ctx)
+        return DVal(jnp.arctan2(l.data.astype(jnp.float64),
+                                r.data.astype(jnp.float64)),
+                    null_and(l.validity, r.validity), FLOAT64)
+
+    def eval_host(self, batch):
+        l, lv = arrow_to_masked_numpy(self.children[0].eval_host(batch))
+        r, rv = arrow_to_masked_numpy(self.children[1].eval_host(batch))
+        with np.errstate(all="ignore"):
+            out = np.arctan2(l.astype(np.float64), r.astype(np.float64))
+        return masked_numpy_to_arrow(out, lv & rv, FLOAT64)
+
+    def key(self):
+        return f"atan2({self.children[0].key()},{self.children[1].key()})"
