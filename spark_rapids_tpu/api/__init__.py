@@ -1,0 +1,4 @@
+from .dataframe import DataFrame, GroupedData, TpuSession
+from . import functions
+
+__all__ = ["DataFrame", "GroupedData", "TpuSession", "functions"]

@@ -1,0 +1,280 @@
+"""DataFrame + session API (PySpark-shaped front-end over the TPU planner).
+
+The reference plugs into Spark's session (SQLExecPlugin.scala:26); standalone
+we provide the session. `TpuSession.conf` toggles behave like RapidsConf —
+notably setting spark.rapids.tpu.sql.enabled=False runs the identical plan
+through the host (CPU-oracle) path, which is how the differential test
+harness mirrors the reference's with_cpu_session/with_gpu_session pattern
+(integration_tests spark_session.py:145-151).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+from ..config import TpuConf
+from ..exec.base import ExecContext
+from ..exprs.aggregates import AggregateExpression
+from ..exprs.base import Alias, ColumnRef, Expression
+from ..plan import logical as L
+from ..plan.overrides import explain_potential_tpu_plan, plan_query
+from ..types import Schema, from_arrow
+from .functions import Col, _to_expr, col as _col
+
+__all__ = ["TpuSession", "DataFrame", "GroupedData"]
+
+
+def _as_expr(c, alias_ok=True) -> Expression:
+    if isinstance(c, str):
+        return ColumnRef(c)
+    return _to_expr(c)
+
+
+class TpuSession:
+    def __init__(self, conf: Optional[TpuConf] = None):
+        self.conf = conf or TpuConf()
+        self._ctx: Optional[ExecContext] = None
+
+    # ------------------------------------------------------------- config
+    def set_conf(self, key: str, value) -> "TpuSession":
+        self.conf = self.conf.set(key, value)
+        self._ctx = None
+        return self
+
+    def exec_context(self) -> ExecContext:
+        if self._ctx is None:
+            self._ctx = ExecContext(self.conf)
+        return self._ctx
+
+    # ------------------------------------------------------------- sources
+    def create_dataframe(self, data, num_partitions: int = 1) -> "DataFrame":
+        import pandas as pd
+        import pyarrow as pa
+        if isinstance(data, pd.DataFrame):
+            table = pa.Table.from_pandas(data, preserve_index=False)
+        elif isinstance(data, pa.Table):
+            table = data
+        elif isinstance(data, dict):
+            table = pa.table(data)
+        else:  # list of dicts / rows
+            table = pa.Table.from_pylist(list(data))
+        schema = Schema.of(**{f.name: from_arrow(f.type)
+                              for f in table.schema})
+        if num_partitions <= 1:
+            parts = [table]
+        else:
+            n = table.num_rows
+            step = -(-n // num_partitions)
+            parts = [table.slice(i * step, step)
+                     for i in range(num_partitions)]
+        return DataFrame(self, L.LogicalScan(parts, schema))
+
+    def range(self, start: int, end: Optional[int] = None, step: int = 1,
+              num_partitions: int = 1) -> "DataFrame":
+        if end is None:
+            start, end = 0, start
+        return DataFrame(self, L.RangeRel(start, end, step, num_partitions))
+
+    def read_parquet(self, *paths: str,
+                     columns: Optional[List[str]] = None) -> "DataFrame":
+        from ..io.parquet import parquet_schema, expand_paths
+        files = expand_paths(paths)
+        schema = parquet_schema(files[0])
+        return DataFrame(self, L.ParquetScan(files, schema, columns))
+
+    def read_csv(self, *paths: str, schema=None, header=True) -> "DataFrame":
+        from ..io.text import csv_to_tables
+        tables, sch = csv_to_tables(paths, schema, header)
+        return DataFrame(self, L.LogicalScan(tables, sch))
+
+    def read_json(self, *paths: str, schema=None) -> "DataFrame":
+        from ..io.text import json_to_tables
+        tables, sch = json_to_tables(paths, schema)
+        return DataFrame(self, L.LogicalScan(tables, sch))
+
+
+class DataFrame:
+    def __init__(self, session: TpuSession, plan: L.LogicalPlan):
+        self.session = session
+        self.plan = plan
+
+    # ------------------------------------------------------------ plan ops
+    def select(self, *cols) -> "DataFrame":
+        exprs = [_as_expr(c) for c in cols]
+        return DataFrame(self.session, L.Project(exprs, self.plan))
+
+    def with_column(self, name: str, c) -> "DataFrame":
+        schema = self.plan.schema()
+        exprs: List[Expression] = []
+        replaced = False
+        for f in schema.fields:
+            if f.name == name:
+                exprs.append(Alias(_as_expr(c), name))
+                replaced = True
+            else:
+                exprs.append(ColumnRef(f.name))
+        if not replaced:
+            exprs.append(Alias(_as_expr(c), name))
+        return DataFrame(self.session, L.Project(exprs, self.plan))
+
+    withColumn = with_column
+
+    def filter(self, cond) -> "DataFrame":
+        return DataFrame(self.session, L.Filter(_as_expr(cond), self.plan))
+
+    where = filter
+
+    def group_by(self, *cols) -> "GroupedData":
+        return GroupedData(self, [_as_expr(c) for c in cols])
+
+    groupBy = group_by
+
+    def agg(self, *aggs) -> "DataFrame":
+        return GroupedData(self, []).agg(*aggs)
+
+    def order_by(self, *orders) -> "DataFrame":
+        from ..plan.logical import SortOrder
+        os = []
+        for o in orders:
+            if isinstance(o, SortOrder):
+                os.append(o)
+            elif isinstance(o, str):
+                os.append(SortOrder(ColumnRef(o), True))
+            elif isinstance(o, Col):
+                os.append(SortOrder(o.expr, True))
+            else:
+                os.append(o)
+        return DataFrame(self.session, L.Sort(os, self.plan))
+
+    orderBy = sort = order_by
+
+    def sort_within_partitions(self, *orders) -> "DataFrame":
+        df = self.order_by(*orders)
+        df.plan.global_sort = False
+        return df
+
+    def limit(self, n: int) -> "DataFrame":
+        return DataFrame(self.session, L.GlobalLimit(n, self.plan))
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        return DataFrame(self.session, L.Union([self.plan, other.plan]))
+
+    unionAll = union
+
+    def join(self, other: "DataFrame", on=None, how: str = "inner",
+             condition=None) -> "DataFrame":
+        lk, rk = [], []
+        if on is not None:
+            if isinstance(on, str):
+                on = [on]
+            for k in on:
+                if isinstance(k, str):
+                    lk.append(ColumnRef(k))
+                    rk.append(ColumnRef(k))
+                else:  # (left_col, right_col) pair
+                    lk.append(_as_expr(k[0]))
+                    rk.append(_as_expr(k[1]))
+        cond = _as_expr(condition) if condition is not None else None
+        return DataFrame(self.session,
+                         L.Join(self.plan, other.plan, how, lk, rk, cond))
+
+    def sample(self, fraction: float, seed: int = 42) -> "DataFrame":
+        return DataFrame(self.session, L.Sample(fraction, seed, self.plan))
+
+    def repartition(self, n: int, *cols) -> "DataFrame":
+        keys = [_as_expr(c) for c in cols]
+        mode = "hash" if keys else "roundrobin"
+        return DataFrame(self.session,
+                         L.Repartition(n, keys, self.plan, mode))
+
+    def drop(self, *names: str) -> "DataFrame":
+        keep = [f.name for f in self.plan.schema().fields
+                if f.name not in names]
+        return self.select(*keep)
+
+    def distinct(self) -> "DataFrame":
+        names = [f.name for f in self.plan.schema().fields]
+        return GroupedData(self, [ColumnRef(n) for n in names]).agg()
+
+    # ------------------------------------------------------------- actions
+    @property
+    def schema(self) -> Schema:
+        return self.plan.schema()
+
+    @property
+    def columns(self) -> List[str]:
+        return self.plan.schema().names()
+
+    def _physical(self):
+        return plan_query(self.plan, self.session.conf)
+
+    def collect_arrow(self):
+        physical = self._physical()
+        if self.session.conf.is_explain_only:
+            raise RuntimeError("session is in explainOnly mode")
+        return physical.collect(self.session.exec_context())
+
+    def to_pandas(self):
+        return self.collect_arrow().to_pandas()
+
+    toPandas = to_pandas
+
+    def collect(self):
+        return self.collect_arrow().to_pylist()
+
+    def count(self) -> int:
+        from .functions import count_star
+        t = self.agg(count_star().with_name("n")).collect_arrow()
+        return t.column("n")[0].as_py()
+
+    def write_parquet(self, path: str, mode: str = "overwrite",
+                      partition_by: Sequence[str] = ()):
+        df = DataFrame(self.session,
+                       L.WriteFile(path, "parquet", self.plan, mode,
+                                   partition_by))
+        return df.collect_arrow()
+
+    def explain(self, mode: str = "physical") -> str:
+        if mode == "logical":
+            s = self.plan.tree_string()
+        elif mode == "potential":
+            s = explain_potential_tpu_plan(self.plan, self.session.conf)
+        else:
+            s = self._physical().tree_string()
+        print(s)
+        return s
+
+
+class GroupedData:
+    def __init__(self, df: DataFrame, keys: List[Expression]):
+        self.df = df
+        self.keys = keys
+
+    def agg(self, *aggs) -> DataFrame:
+        parsed: List[AggregateExpression] = []
+        for a in aggs:
+            assert isinstance(a, AggregateExpression), \
+                f"expected aggregate function, got {a!r}"
+            parsed.append(a)
+        return DataFrame(self.df.session,
+                         L.Aggregate(self.keys, parsed, self.df.plan))
+
+    # pyspark-style helpers
+    def count(self) -> DataFrame:
+        from ..exprs.aggregates import CountStar
+        return self.agg(CountStar("count"))
+
+    def sum(self, *names: str) -> DataFrame:
+        from ..exprs.aggregates import Sum
+        return self.agg(*[Sum(ColumnRef(n)) for n in names])
+
+    def avg(self, *names: str) -> DataFrame:
+        from ..exprs.aggregates import Average
+        return self.agg(*[Average(ColumnRef(n)) for n in names])
+
+    def min(self, *names: str) -> DataFrame:
+        from ..exprs.aggregates import Min
+        return self.agg(*[Min(ColumnRef(n)) for n in names])
+
+    def max(self, *names: str) -> DataFrame:
+        from ..exprs.aggregates import Max
+        return self.agg(*[Max(ColumnRef(n)) for n in names])

@@ -1,0 +1,178 @@
+"""Column DSL + functions, PySpark-flavoured (the reference accelerates
+Spark's DataFrame API; standalone we provide the same surface).
+
+    from spark_rapids_tpu.api import functions as F
+    df.select(F.col("a") + 1, F.when(F.col("b") > 0, 1).otherwise(0))
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .. import exprs as E
+from ..exprs.aggregates import (Average, Count, CountStar, First, Last, Max,
+                                Min, StddevPop, StddevSamp, Sum, VariancePop,
+                                VarianceSamp)
+from ..types import (BOOL, DataType, FLOAT32, FLOAT64, INT8, INT16, INT32,
+                     INT64, STRING, DATE, TIMESTAMP)
+
+__all__ = ["Col", "col", "lit", "when", "coalesce", "isnan", "isnull",
+           "sqrt", "exp", "log", "sin", "cos", "tan", "floor", "ceil",
+           "round", "pow", "abs", "sum", "count", "count_star", "avg",
+           "mean", "min", "max", "first", "last", "stddev", "stddev_pop",
+           "var_samp", "var_pop", "cast", "asc", "desc"]
+
+_builtin_abs, _builtin_sum, _builtin_min, _builtin_max, _builtin_round = \
+    abs, sum, min, max, round
+
+
+def _to_expr(v) -> E.Expression:
+    if isinstance(v, Col):
+        return v.expr
+    if isinstance(v, E.Expression):
+        return v
+    return E.Literal(v)
+
+
+class Col:
+    """Wrapper giving Expression a PySpark-like operator surface."""
+
+    def __init__(self, expr: E.Expression):
+        self.expr = expr
+
+    # arithmetic
+    def __add__(self, o): return Col(E.Add(self.expr, _to_expr(o)))
+    def __radd__(self, o): return Col(E.Add(_to_expr(o), self.expr))
+    def __sub__(self, o): return Col(E.Subtract(self.expr, _to_expr(o)))
+    def __rsub__(self, o): return Col(E.Subtract(_to_expr(o), self.expr))
+    def __mul__(self, o): return Col(E.Multiply(self.expr, _to_expr(o)))
+    def __rmul__(self, o): return Col(E.Multiply(_to_expr(o), self.expr))
+    def __truediv__(self, o): return Col(E.Divide(self.expr, _to_expr(o)))
+    def __rtruediv__(self, o): return Col(E.Divide(_to_expr(o), self.expr))
+    def __mod__(self, o): return Col(E.Remainder(self.expr, _to_expr(o)))
+    def __neg__(self): return Col(E.UnaryMinus(self.expr))
+    def __pow__(self, o): return Col(E.Pow(self.expr, _to_expr(o)))
+
+    # comparison
+    def __eq__(self, o): return Col(E.EqualTo(self.expr, _to_expr(o)))
+    def __ne__(self, o): return Col(E.NotEqual(self.expr, _to_expr(o)))
+    def __lt__(self, o): return Col(E.LessThan(self.expr, _to_expr(o)))
+    def __le__(self, o): return Col(E.LessThanOrEqual(self.expr, _to_expr(o)))
+    def __gt__(self, o): return Col(E.GreaterThan(self.expr, _to_expr(o)))
+    def __ge__(self, o): return Col(E.GreaterThanOrEqual(self.expr, _to_expr(o)))
+    def eqNullSafe(self, o): return Col(E.EqualNullSafe(self.expr, _to_expr(o)))
+
+    # logic
+    def __and__(self, o): return Col(E.And(self.expr, _to_expr(o)))
+    def __or__(self, o): return Col(E.Or(self.expr, _to_expr(o)))
+    def __invert__(self): return Col(E.Not(self.expr))
+
+    # misc
+    def isNull(self): return Col(E.IsNull(self.expr))
+    def isNotNull(self): return Col(E.IsNotNull(self.expr))
+    def isin(self, *vals):
+        vals = vals[0] if len(vals) == 1 and isinstance(vals[0], (list, tuple)) \
+            else vals
+        return Col(E.In(self.expr, vals))
+
+    def alias(self, name: str): return Col(E.Alias(self.expr, name))
+    name = alias
+
+    def cast(self, dtype): return Col(E.Cast(self.expr, _dtype_of(dtype)))
+
+    def asc(self, nulls_first: Optional[bool] = None):
+        from ..plan.logical import SortOrder
+        return SortOrder(self.expr, True, nulls_first)
+
+    def desc(self, nulls_first: Optional[bool] = None):
+        from ..plan.logical import SortOrder
+        return SortOrder(self.expr, False, nulls_first)
+
+    def __hash__(self):
+        return id(self)
+
+    def __repr__(self):
+        return f"Col<{self.expr.name_hint}>"
+
+
+_DTYPES = {"boolean": BOOL, "bool": BOOL, "tinyint": INT8, "byte": INT8,
+           "smallint": INT16, "short": INT16, "int": INT32, "integer": INT32,
+           "bigint": INT64, "long": INT64, "float": FLOAT32,
+           "double": FLOAT64, "string": STRING, "date": DATE,
+           "timestamp": TIMESTAMP}
+
+
+def _dtype_of(d) -> DataType:
+    if isinstance(d, DataType):
+        return d
+    return _DTYPES[str(d).lower()]
+
+
+def col(name: str) -> Col:
+    return Col(E.ColumnRef(name))
+
+
+def lit(v) -> Col:
+    return Col(E.Literal(v))
+
+
+class _WhenBuilder:
+    def __init__(self, branches):
+        self.branches = branches
+
+    def when(self, cond, value) -> "_WhenBuilder":
+        return _WhenBuilder(self.branches + [(_to_expr(cond), _to_expr(value))])
+
+    def otherwise(self, value) -> Col:
+        return Col(E.CaseWhen(self.branches, _to_expr(value)))
+
+    @property
+    def col(self) -> Col:
+        return Col(E.CaseWhen(self.branches, None))
+
+
+def when(cond, value) -> _WhenBuilder:
+    return _WhenBuilder([(_to_expr(cond), _to_expr(value))])
+
+
+def coalesce(*cols) -> Col:
+    return Col(E.Coalesce(*[_to_expr(c) for c in cols]))
+
+
+def isnan(c) -> Col: return Col(E.IsNaN(_to_expr(c)))
+def isnull(c) -> Col: return Col(E.IsNull(_to_expr(c)))
+def sqrt(c) -> Col: return Col(E.Sqrt(_to_expr(c)))
+def exp(c) -> Col: return Col(E.Exp(_to_expr(c)))
+def log(c) -> Col: return Col(E.Log(_to_expr(c)))
+def sin(c) -> Col: return Col(E.Sin(_to_expr(c)))
+def cos(c) -> Col: return Col(E.Cos(_to_expr(c)))
+def tan(c) -> Col: return Col(E.Tan(_to_expr(c)))
+def floor(c) -> Col: return Col(E.Floor(_to_expr(c)))
+def ceil(c) -> Col: return Col(E.Ceil(_to_expr(c)))
+def round(c, scale: int = 0) -> Col: return Col(E.Round(_to_expr(c), scale))
+def pow(a, b) -> Col: return Col(E.Pow(_to_expr(a), _to_expr(b)))
+def abs(c) -> Col: return Col(E.Abs(_to_expr(c)))
+def cast(c, dtype) -> Col: return Col(E.Cast(_to_expr(c), _dtype_of(dtype)))
+
+
+def asc(name: str):
+    return col(name).asc()
+
+
+def desc(name: str):
+    return col(name).desc()
+
+
+# aggregates (return AggregateExpression, consumed by GroupedData/agg)
+def sum(c): return Sum(_to_expr(c))
+def count(c): return Count(_to_expr(c))
+def count_star(): return CountStar()
+def avg(c): return Average(_to_expr(c))
+mean = avg
+def min(c): return Min(_to_expr(c))
+def max(c): return Max(_to_expr(c))
+def first(c): return First(_to_expr(c))
+def last(c): return Last(_to_expr(c))
+def stddev(c): return StddevSamp(_to_expr(c))
+def stddev_pop(c): return StddevPop(_to_expr(c))
+def var_samp(c): return VarianceSamp(_to_expr(c))
+def var_pop(c): return VariancePop(_to_expr(c))

@@ -100,7 +100,8 @@ class ColumnarBatch:
                         arr.cast(pa.decimal128(38, arr.type.scale)),
                         10 ** arr.type.scale).cast(pa.int64())
                 mask = np.asarray(col.is_null())
-                vals = arr.fill_null(0).to_numpy(zero_copy_only=False)
+                fill = False if pa.types.is_boolean(arr.type) else 0
+                vals = arr.fill_null(fill).to_numpy(zero_copy_only=False)
                 cols.append(DeviceColumn.from_numpy(
                     vals, dt, mask=~mask, padded_len=p))
             else:

@@ -1,0 +1,12 @@
+from .base import ExecContext, TpuExec, Metric
+from .basic import (CoalesceBatchesExec, CpuFilterExec, CpuProjectExec,
+                    InMemoryScanExec, LimitExec, TpuExpandExec, TpuFilterExec,
+                    TpuProjectExec, TpuRangeExec, TpuSampleExec, UnionExec)
+from .aggregate import CpuAggregateExec, TpuHashAggregateExec
+from .sort import CpuSortExec, TpuSortExec
+
+__all__ = ["ExecContext", "TpuExec", "Metric", "CoalesceBatchesExec",
+           "CpuFilterExec", "CpuProjectExec", "InMemoryScanExec", "LimitExec",
+           "TpuExpandExec", "TpuFilterExec", "TpuProjectExec", "TpuRangeExec",
+           "TpuSampleExec", "UnionExec", "CpuAggregateExec",
+           "TpuHashAggregateExec", "CpuSortExec", "TpuSortExec"]

@@ -1,0 +1,105 @@
+"""Physical operator base (ref GpuExec.scala:274).
+
+A TpuExec produces an iterator of ColumnarBatch. Metrics mirror the
+reference's GpuMetric registry with verbosity levels (GpuExec.scala:54-165);
+the device semaphore gates concurrent device work (GpuSemaphore.scala:51).
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, Iterator, List, Optional
+
+from ..columnar import ColumnarBatch
+from ..config import TpuConf
+from ..types import Schema
+
+__all__ = ["ExecContext", "TpuExec", "Metric", "ESSENTIAL", "MODERATE",
+           "DEBUG"]
+
+ESSENTIAL, MODERATE, DEBUG = "ESSENTIAL", "MODERATE", "DEBUG"
+
+
+class Metric:
+    __slots__ = ("name", "level", "value")
+
+    def __init__(self, name: str, level: str = MODERATE):
+        self.name = name
+        self.level = level
+        self.value = 0
+
+    def add(self, v):
+        self.value += v
+
+    def set(self, v):
+        self.value = v
+
+
+class ExecContext:
+    """Per-query execution context: conf + shared runtime services.
+
+    Reference analog: the executor-process singletons (GpuSemaphore,
+    RapidsBufferCatalog, GpuTaskMetrics) — scoped per query here since we are
+    a library, not a long-lived executor."""
+
+    def __init__(self, conf: Optional[TpuConf] = None, semaphore=None,
+                 memory=None):
+        from ..mem.semaphore import DeviceSemaphore
+        from ..mem.manager import MemoryManager
+        self.conf = conf or TpuConf()
+        self.semaphore = semaphore or DeviceSemaphore(
+            self.conf.concurrent_tpu_tasks)
+        self.memory = memory or MemoryManager.get(self.conf)
+        self.metrics: Dict[str, Dict[str, Metric]] = {}
+
+    def metric(self, exec_id: str, name: str, level: str = MODERATE) -> Metric:
+        m = self.metrics.setdefault(exec_id, {})
+        if name not in m:
+            m[name] = Metric(name, level)
+        return m[name]
+
+
+class TpuExec:
+    """Base physical operator."""
+
+    #: True if this exec runs its compute on the device
+    is_tpu: bool = True
+
+    def __init__(self, children: List["TpuExec"]):
+        self.children = children
+        self._exec_id = f"{type(self).__name__}@{id(self):x}"
+
+    # -- interface ---------------------------------------------------------
+    def output_schema(self) -> Schema:
+        raise NotImplementedError
+
+    def execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        m = ctx.metric(self._exec_id, "opTime")
+        t0 = time.perf_counter()
+        it = self.do_execute(ctx)
+        m.add(time.perf_counter() - t0)
+        return it
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        raise NotImplementedError
+
+    # -- explain -----------------------------------------------------------
+    def describe(self) -> str:
+        return type(self).__name__
+
+    def tree_string(self, indent: int = 0) -> str:
+        marker = "*" if self.is_tpu else "!"
+        s = "  " * indent + marker + " " + self.describe() + "\n"
+        for c in self.children:
+            s += c.tree_string(indent + 1)
+        return s
+
+    def collect(self, ctx: Optional[ExecContext] = None):
+        """Materialize to a single Arrow table (drives the whole pipeline)."""
+        import pyarrow as pa
+        ctx = ctx or ExecContext()
+        tables = [b.to_arrow() for b in self.execute(ctx)]
+        if not tables:
+            from ..types import to_arrow
+            fields = [(f.name, to_arrow(f.dtype)) for f in self.output_schema()]
+            return pa.table({n: pa.array([], type=t) for n, t in fields})
+        return pa.concat_tables(tables)

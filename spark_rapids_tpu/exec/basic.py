@@ -1,0 +1,372 @@
+"""Basic physical operators: scan, project, filter, range, limit, union,
+sample, expand, coalesce (ref basicPhysicalOperators.scala: GpuProjectExec:365,
+GpuFilterExec:806, GpuRangeExec:1137; GpuCoalesceBatches.scala:112).
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import (ColumnarBatch, DeviceColumn, HostColumn,
+                        concat_batches)
+from ..columnar.bucketing import bucket_for
+from ..exprs.base import Expression
+from ..exprs.compiler import (compile_projection, eval_predicate_device,
+                              filter_batch_device, _compact_kernel)
+from ..types import INT64, Schema, StructField
+from .base import DEBUG, ESSENTIAL, ExecContext, TpuExec
+
+__all__ = ["InMemoryScanExec", "TpuProjectExec", "CpuProjectExec",
+           "TpuFilterExec", "CpuFilterExec", "TpuRangeExec", "LimitExec",
+           "UnionExec", "CoalesceBatchesExec", "TpuSampleExec",
+           "TpuExpandExec"]
+
+
+class InMemoryScanExec(TpuExec):
+    """Scan over pre-partitioned Arrow tables (ref GpuInMemoryTableScanExec)."""
+
+    def __init__(self, tables, schema: Schema, batch_rows: int = 1 << 20):
+        super().__init__([])
+        self.tables = list(tables)
+        self._schema = schema
+        self.batch_rows = batch_rows
+
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        rows_m = ctx.metric(self._exec_id, "numOutputRows", ESSENTIAL)
+        for t in self.tables:
+            off = 0
+            while off < t.num_rows or (t.num_rows == 0 and off == 0):
+                chunk = t.slice(off, self.batch_rows)
+                if chunk.num_rows == 0 and off > 0:
+                    break
+                with ctx.semaphore.held():
+                    b = ColumnarBatch.from_arrow(chunk)
+                rows_m.add(b.num_rows)
+                yield b
+                off += self.batch_rows
+                if t.num_rows == 0:
+                    break
+
+    def describe(self):
+        return f"InMemoryScan[{len(self.tables)} partitions]"
+
+
+class TpuProjectExec(TpuExec):
+    """Projection. Device-supported expressions compile into ONE fused XLA
+    kernel; host-only expressions (strings etc.) evaluate via Arrow and are
+    H2D'd when their output type is device-backed — per-expression fallback,
+    finer-grained than the reference's whole-exec fallback."""
+
+    def __init__(self, exprs: Sequence[Expression], child: TpuExec):
+        super().__init__([child])
+        self.exprs = list(exprs)
+        in_schema = child.output_schema()
+        self._schema = Schema([
+            StructField(e.name_hint, e.data_type(in_schema), True)
+            for e in self.exprs])
+        self.device_idx = []
+        self.host_idx = []
+        for i, e in enumerate(self.exprs):
+            if e.fully_device_supported(in_schema) is None:
+                self.device_idx.append(i)
+            else:
+                self.host_idx.append(i)
+        self._projector = None
+
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        child_schema = self.children[0].output_schema()
+        dev_exprs = [self.exprs[i] for i in self.device_idx]
+        rows_m = ctx.metric(self._exec_id, "numOutputRows", ESSENTIAL)
+        for batch in self.children[0].execute(ctx):
+            out: List[Optional[object]] = [None] * len(self.exprs)
+            if dev_exprs:
+                if self._projector is None:
+                    self._projector = compile_projection(dev_exprs,
+                                                         child_schema)
+                with ctx.semaphore.held():
+                    dcols = self._projector.run(batch)
+                for i, c in zip(self.device_idx, dcols):
+                    out[i] = c
+            for i in self.host_idx:
+                arr = self.exprs[i].eval_host(batch)
+                dt = self._schema.fields[i].dtype
+                if dt.device_backed:
+                    import pyarrow as pa
+                    hb = ColumnarBatch.from_arrow(
+                        pa.table({"c": arr}))
+                    out[i] = hb.columns[0]
+                else:
+                    out[i] = HostColumn(arr, dt)
+            rows_m.add(batch.num_rows)
+            yield ColumnarBatch(out, batch.num_rows, self._schema)
+
+    def describe(self):
+        tags = []
+        if self.host_idx:
+            tags.append(f"host_fallback={[self.exprs[i].name_hint for i in self.host_idx]}")
+        return ("Project[" + ", ".join(e.name_hint for e in self.exprs) + "]"
+                + (" " + " ".join(tags) if tags else ""))
+
+
+class CpuProjectExec(TpuExec):
+    """Whole-node host fallback (ref: plan stays on CPU after tagging)."""
+    is_tpu = False
+
+    def __init__(self, exprs: Sequence[Expression], child: TpuExec):
+        super().__init__([child])
+        self.exprs = list(exprs)
+        in_schema = child.output_schema()
+        self._schema = Schema([
+            StructField(e.name_hint, e.data_type(in_schema), True)
+            for e in self.exprs])
+
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        for batch in self.children[0].execute(ctx):
+            cols = []
+            for e, f in zip(self.exprs, self._schema.fields):
+                arr = e.eval_host(batch)
+                cols.append(HostColumn(arr, f.dtype))
+            yield ColumnarBatch(cols, batch.num_rows, self._schema)
+
+    def describe(self):
+        return "CpuProject[" + ", ".join(e.name_hint for e in self.exprs) + "]"
+
+
+class TpuFilterExec(TpuExec):
+    """Device filter with O(n) compaction (ref GpuFilterExec:806)."""
+
+    def __init__(self, condition: Expression, child: TpuExec):
+        super().__init__([child])
+        self.condition = condition
+
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema()
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        rows_m = ctx.metric(self._exec_id, "numOutputRows", ESSENTIAL)
+        for batch in self.children[0].execute(ctx):
+            with ctx.semaphore.held():
+                if batch.all_device:
+                    out = filter_batch_device(self.condition, batch)
+                else:
+                    out = self._filter_mixed(batch)
+            rows_m.add(out.num_rows)
+            yield out
+
+    def _filter_mixed(self, batch: ColumnarBatch) -> ColumnarBatch:
+        """Device columns compact on device; host columns filter via Arrow
+        with the same mask."""
+        keep = eval_predicate_device(self.condition, batch)
+        dev_pos = [i for i, c in enumerate(batch.columns)
+                   if isinstance(c, DeviceColumn)]
+        arrays = [(batch.columns[i].data, batch.columns[i].validity)
+                  for i in dev_pos]
+        outs, count = _compact_kernel(arrays, keep, batch.padded_len)
+        n = int(count)
+        keep_np = np.asarray(keep)[:batch.num_rows]
+        new_cols: List[object] = list(batch.columns)
+        for i, (d, v) in zip(dev_pos, outs):
+            new_cols[i] = DeviceColumn(d, v, batch.columns[i].dtype)
+        import pyarrow as pa
+        mask = pa.array(keep_np)
+        for i, c in enumerate(batch.columns):
+            if isinstance(c, HostColumn):
+                new_cols[i] = HostColumn(
+                    c.array.slice(0, batch.num_rows).filter(mask), c.dtype)
+        return ColumnarBatch(new_cols, n, batch.schema)
+
+    def describe(self):
+        return f"Filter[{self.condition.name_hint}]"
+
+
+class CpuFilterExec(TpuExec):
+    is_tpu = False
+
+    def __init__(self, condition: Expression, child: TpuExec):
+        super().__init__([child])
+        self.condition = condition
+
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema()
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        import pyarrow.compute as pc
+        for batch in self.children[0].execute(ctx):
+            mask = self.condition.eval_host(batch)
+            t = batch.to_arrow().filter(pc.fill_null(mask, False))
+            yield ColumnarBatch.from_arrow(t)
+
+    def describe(self):
+        return f"CpuFilter[{self.condition.name_hint}]"
+
+
+class TpuRangeExec(TpuExec):
+    """range(start, end, step) generated directly in HBM via iota
+    (ref GpuRangeExec basicPhysicalOperators.scala:1137)."""
+
+    def __init__(self, start: int, end: int, step: int, name: str = "id",
+                 batch_rows: int = 1 << 20):
+        super().__init__([])
+        self.start, self.end, self.step = start, end, step
+        self.name = name
+        self.batch_rows = batch_rows
+        self._schema = Schema([StructField(name, INT64, False)])
+
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        total = max(0, -(-(self.end - self.start) // self.step)
+                    if self.step > 0 else -((self.start - self.end) // -self.step))
+        emitted = 0
+        while emitted < total or (total == 0 and emitted == 0):
+            n = min(self.batch_rows, total - emitted)
+            p = bucket_for(max(n, 1))
+            with ctx.semaphore.held():
+                base = self.start + emitted * self.step
+                data = base + jnp.arange(p, dtype=jnp.int64) * self.step
+                valid = jnp.arange(p) < n
+                col = DeviceColumn(data, valid, INT64)
+            yield ColumnarBatch([col], n, self._schema)
+            emitted += n
+            if total == 0:
+                break
+
+    def describe(self):
+        return f"Range[{self.start},{self.end},{self.step}]"
+
+
+class LimitExec(TpuExec):
+    def __init__(self, n: int, child: TpuExec):
+        super().__init__([child])
+        self.n = n
+
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema()
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        remaining = self.n
+        for batch in self.children[0].execute(ctx):
+            if remaining <= 0:
+                break
+            if batch.num_rows <= remaining:
+                remaining -= batch.num_rows
+                yield batch
+            else:
+                yield batch.slice(0, remaining)
+                remaining = 0
+
+    def describe(self):
+        return f"Limit[{self.n}]"
+
+
+class UnionExec(TpuExec):
+    def __init__(self, children: List[TpuExec]):
+        super().__init__(children)
+
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema()
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        for c in self.children:
+            yield from c.execute(ctx)
+
+    def describe(self):
+        return f"Union[{len(self.children)}]"
+
+
+class CoalesceBatchesExec(TpuExec):
+    """Concatenate small batches up to a target size (ref
+    GpuCoalesceBatches.scala CoalesceGoal/TargetSize; RequireSingleBatch via
+    target_rows=None meaning 'all')."""
+
+    def __init__(self, child: TpuExec, target_rows: Optional[int] = None,
+                 target_bytes: Optional[int] = None):
+        super().__init__([child])
+        self.target_rows = target_rows
+        self.target_bytes = target_bytes
+
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema()
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        conf_bytes = self.target_bytes or ctx.conf.batch_size_bytes
+        conf_rows = self.target_rows or ctx.conf.batch_size_rows
+        pending: List[ColumnarBatch] = []
+        rows = 0
+        nbytes = 0
+        concat_m = ctx.metric(self._exec_id, "concatTime", DEBUG)
+        for batch in self.children[0].execute(ctx):
+            pending.append(batch)
+            rows += batch.num_rows
+            nbytes += batch.size_bytes()
+            if (self.target_rows is None and self.target_bytes is None):
+                continue  # single-batch goal: concat everything at the end
+            if rows >= conf_rows or nbytes >= conf_bytes:
+                yield concat_batches(pending)
+                pending, rows, nbytes = [], 0, 0
+        if pending:
+            yield concat_batches(pending)
+
+    def describe(self):
+        goal = "RequireSingleBatch" if (self.target_rows is None and
+                                        self.target_bytes is None) \
+            else f"TargetSize(rows={self.target_rows}, bytes={self.target_bytes})"
+        return f"CoalesceBatches[{goal}]"
+
+
+class TpuSampleExec(TpuExec):
+    """Bernoulli sample (ref GpuSampleExec)."""
+
+    def __init__(self, fraction: float, seed: int, child: TpuExec):
+        super().__init__([child])
+        self.fraction = fraction
+        self.seed = seed
+
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema()
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        rng = np.random.RandomState(self.seed)
+        for batch in self.children[0].execute(ctx):
+            mask = rng.random_sample(batch.num_rows) < self.fraction
+            import pyarrow as pa
+            t = batch.to_arrow().filter(pa.array(mask))
+            yield ColumnarBatch.from_arrow(t)
+
+
+class TpuExpandExec(TpuExec):
+    """Each input row emits one output row per projection set
+    (ref GpuExpandExec.scala)."""
+
+    def __init__(self, projections, names, child: TpuExec):
+        super().__init__([child])
+        self.projections = projections
+        self.names = names
+        cs = child.output_schema()
+        self._schema = Schema([StructField(n, e.data_type(cs), True)
+                               for n, e in zip(names, projections[0])])
+
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        child_schema = self.children[0].output_schema()
+        projectors = [compile_projection(p, child_schema)
+                      for p in self.projections]
+        for batch in self.children[0].execute(ctx):
+            for proj in projectors:
+                with ctx.semaphore.held():
+                    cols = proj.run(batch)
+                yield ColumnarBatch(cols, batch.num_rows, self._schema)

@@ -1,0 +1,69 @@
+"""Order-preserving key encodings for device sort / groupby / range-partition.
+
+TPU-native core trick: Spark orderings (asc/desc, nulls first/last, NaN
+greatest, -0.0 == 0.0) are implemented by turning every key column into sort
+operands whose XLA ordering equals the desired row order, then ONE
+``lax.sort`` over (keys..., payload...) does the whole job. The reference
+gets this from cudf's typed sort (GpuSortExec.scala); XLA has no typed
+multi-column null-aware sort, so the encoding IS the design.
+
+TPU constraint honoured here: no 64-bit bitcasts (XLA's x64-rewrite does not
+implement them on TPU), so
+  * integers sort as themselves; descending uses ``~x`` (= -x-1, an
+    overflow-free order reversal for two's complement)
+  * floats rely on XLA sort's total-order comparator, which places NaN above
+    +inf — exactly Spark's float ordering; descending negates (so -NaN sinks
+    to the front). -0.0 and NaN are canonicalized first so grouping treats
+    them as single values (ref NormalizeFloatingNumbers).
+Nulls travel as a leading uint8 rank operand per key.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..exprs.base import DVal
+
+__all__ = ["order_key_operands", "grouping_operands", "operands_equal",
+           "canonicalize_floats"]
+
+
+def canonicalize_floats(d):
+    """-0.0 -> 0.0, every NaN -> the canonical positive NaN."""
+    d = jnp.where(d == 0.0, jnp.zeros_like(d), d)
+    return jnp.where(jnp.isnan(d), jnp.full_like(d, jnp.nan), d)
+
+
+def order_key_operands(v: DVal, ascending: bool, nulls_first: bool):
+    """One SortOrder -> two sort operands (null_rank uint8, key)."""
+    d = v.data
+    if jnp.issubdtype(d.dtype, jnp.floating):
+        d = canonicalize_floats(d)
+        key = d if ascending else -d
+        key = jnp.where(v.validity, key, jnp.zeros_like(key))
+    elif d.dtype == jnp.bool_:
+        k = d.astype(jnp.int8)
+        key = k if ascending else (1 - k)
+        key = jnp.where(v.validity, key, jnp.zeros_like(key))
+    else:
+        key = d if ascending else ~d
+        key = jnp.where(v.validity, key, jnp.zeros_like(key))
+    if nulls_first:
+        null_rank = jnp.where(v.validity, jnp.uint8(1), jnp.uint8(0))
+    else:
+        null_rank = jnp.where(v.validity, jnp.uint8(0), jnp.uint8(1))
+    return [null_rank, key]
+
+
+def grouping_operands(v: DVal):
+    """Key operands for groupby (order irrelevant, equality must hold:
+    null == null forms one group, NaN == NaN one group)."""
+    return order_key_operands(v, ascending=True, nulls_first=False)
+
+
+def operands_equal(a, b):
+    """Row-wise equality for boundary detection over sorted key operands;
+    canonicalized NaNs must compare equal."""
+    eq = a == b
+    if jnp.issubdtype(a.dtype, jnp.floating):
+        eq = jnp.logical_or(eq, jnp.logical_and(jnp.isnan(a), jnp.isnan(b)))
+    return eq

@@ -1,0 +1,163 @@
+"""Sort exec (ref GpuSortExec.scala:86; out-of-core iterator :281).
+
+Device sort = encode each SortOrder into (null_rank u8, key u64) operands
+(exec/encoding.py) and run ONE stable ``lax.sort`` carrying every output
+column as payload. Global sort currently concatenates batches then sorts
+(single-batch goal) under the retry framework; the reference's out-of-core
+merge-sort with spillable pending queues is the planned widening.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, Iterator, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar import ColumnarBatch, DeviceColumn, concat_batches
+from ..exprs.base import DVal, EvalContext
+from ..mem import SpillableBatch, with_retry_no_split
+from ..plan.logical import SortOrder
+from ..types import Schema
+from .base import ExecContext, TpuExec
+from .encoding import order_key_operands
+
+__all__ = ["TpuSortExec", "CpuSortExec", "sort_batch_device"]
+
+
+def _np_total_order_key(v):
+    """uint64 whose unsigned order == Spark ascending order (host-side twin
+    of exec/encoding.py; numpy has no 64-bit bitcast restriction)."""
+    import numpy as np
+    v = np.asarray(v)
+    if np.issubdtype(v.dtype, np.floating):
+        d = v.astype(np.float64)
+        d = np.where(d == 0.0, 0.0, d)
+        d = np.where(np.isnan(d), np.nan, d)
+        b = d.view(np.uint64)
+        return np.where(b >> np.uint64(63) != 0, ~b,
+                        b | np.uint64(1 << 63))
+    if v.dtype == np.bool_:
+        return v.astype(np.uint64)
+    return v.astype(np.int64).view(np.uint64) ^ np.uint64(1 << 63)
+
+_SORT_KERNEL_CACHE: Dict[Tuple, object] = {}
+
+
+def _build_sort_kernel(orders: List[SortOrder], schema: Schema):
+    dtypes = [f.dtype for f in schema.fields]
+
+    @functools.partial(jax.jit, static_argnums=(2,))
+    def kernel(cols, num_rows, padded_len):
+        dvals = [None if c is None else DVal(c[0], c[1], dt)
+                 for c, dt in zip(cols, dtypes)]
+        ctx = EvalContext(schema, dvals, num_rows, padded_len)
+        row_mask = ctx.row_mask()
+        pad_flag = jnp.where(row_mask, jnp.uint8(0), jnp.uint8(1))
+        operands = [pad_flag]
+        for o in orders:
+            v = o.expr.eval_device(ctx)
+            operands.extend(order_key_operands(v, o.ascending, o.nulls_first))
+        payload = []
+        for dv in dvals:
+            payload.extend([dv.data, dv.validity])
+        n_ops = len(operands)
+        out = jax.lax.sort(tuple(operands + payload), num_keys=n_ops,
+                           is_stable=True)
+        res = []
+        pi = n_ops
+        for dv in dvals:
+            res.append((out[pi], out[pi + 1]))
+            pi += 2
+        return res
+
+    return kernel
+
+
+def sort_batch_device(orders: List[SortOrder], batch: ColumnarBatch) -> ColumnarBatch:
+    key = (tuple(f"{o.expr.key()}|{o.ascending}|{o.nulls_first}"
+                 for o in orders),
+           tuple((f.name, f.dtype.name) for f in batch.schema.fields))
+    kernel = _SORT_KERNEL_CACHE.get(key)
+    if kernel is None:
+        kernel = _build_sort_kernel(orders, batch.schema)
+        _SORT_KERNEL_CACHE[key] = kernel
+    cols = [(c.data, c.validity) for c in batch.columns]
+    outs = kernel(cols, jnp.int32(batch.num_rows), batch.padded_len)
+    new_cols = [DeviceColumn(d, v, c.dtype)
+                for (d, v), c in zip(outs, batch.columns)]
+    return ColumnarBatch(new_cols, batch.num_rows, batch.schema)
+
+
+class TpuSortExec(TpuExec):
+    def __init__(self, orders: List[SortOrder], child: TpuExec,
+                 global_sort: bool = True):
+        super().__init__([child])
+        self.orders = orders
+        self.global_sort = global_sort
+
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema()
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        if not self.global_sort:
+            for batch in self.children[0].execute(ctx):
+                with ctx.semaphore.held():
+                    yield sort_batch_device(self.orders, batch)
+            return
+        spillables = [SpillableBatch(b, ctx.memory)
+                      for b in self.children[0].execute(ctx)]
+        if not spillables:
+            return
+
+        def do_sort():
+            with ctx.semaphore.held():
+                big = concat_batches([sb.get() for sb in spillables])
+                return sort_batch_device(self.orders, big)
+
+        out = with_retry_no_split(do_sort, ctx.memory)
+        for sb in spillables:
+            sb.close()
+        yield out
+
+    def describe(self):
+        return "Sort[" + ", ".join(map(repr, self.orders)) + "]"
+
+
+class CpuSortExec(TpuExec):
+    is_tpu = False
+
+    def __init__(self, orders: List[SortOrder], child: TpuExec,
+                 global_sort: bool = True):
+        super().__init__([child])
+        self.orders = orders
+        self.global_sort = global_sort
+
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema()
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        import numpy as np
+        import pyarrow as pa
+        from ..exprs.arithmetic import arrow_to_masked_numpy
+        tables = [b.to_arrow() for b in self.children[0].execute(ctx)]
+        if not tables:
+            return
+        t = pa.concat_tables(tables)
+        batch = ColumnarBatch.from_arrow(t, pad=False)
+        # stable lexsort with per-key order/null-placement (Spark semantics:
+        # NaN greatest, -0.0 == 0.0, null rank independent per key)
+        lex_keys = []
+        for o in reversed(self.orders):  # np.lexsort: last key is primary
+            v, ok = arrow_to_masked_numpy(o.expr.eval_host(batch))
+            enc = _np_total_order_key(v)
+            if not o.ascending:
+                enc = ~enc
+            enc = np.where(ok, enc, np.uint64(0))
+            rank = np.where(ok, 1, 0) if o.nulls_first else np.where(ok, 0, 1)
+            lex_keys.extend([enc, rank.astype(np.uint8)])
+        idx = np.lexsort(tuple(lex_keys))
+        yield ColumnarBatch.from_arrow(t.take(pa.array(idx)))
+
+    def describe(self):
+        return "CpuSort[" + ", ".join(map(repr, self.orders)) + "]"

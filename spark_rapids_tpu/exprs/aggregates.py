@@ -1,0 +1,453 @@
+"""Aggregate functions (ref aggregate/aggregateFunctions.scala, 2,158 LoC;
+GpuAggregateFunction trait aggregateBase.scala:79).
+
+TPU-first design: groupby is SORT-BASED segmented reduction, not hash tables —
+`lax.sort` on encoded keys then `jax.ops.segment_*` over group ids, all static
+shapes (the XLA-native pattern; cudf uses hash groupby which has no efficient
+XLA analog). Each aggregate declares:
+  update   : per-row values  -> per-group partials      (first pass, per batch)
+  merge    : per-group partials -> per-group partials   (combining batches or
+             shuffle partitions — identical maths to the reference's
+             GpuMergeAggregateIterator pass, GpuAggregateExec.scala:718)
+  finalize : partials -> result column
+Spark null semantics: sum/min/max/avg ignore nulls, empty group -> null;
+count is never null. Float NaN: NaN is greatest for min/max.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..types import (BOOL, DataType, FLOAT64, INT64, Schema, numeric)
+from .base import DVal, Expression, Literal
+
+__all__ = ["AggregateExpression", "Sum", "Count", "CountStar", "Min", "Max",
+           "Average", "First", "Last", "StddevSamp", "StddevPop",
+           "VarianceSamp", "VariancePop"]
+
+
+def _seg_sum(data, valid, gid, num_segments):
+    masked = jnp.where(valid, data, jnp.zeros_like(data))
+    s = jax.ops.segment_sum(masked, gid, num_segments=num_segments)
+    cnt = jax.ops.segment_sum(valid.astype(jnp.int64), gid,
+                              num_segments=num_segments)
+    return s, cnt
+
+
+def _seg_min(data, valid, gid, num_segments):
+    if jnp.issubdtype(data.dtype, jnp.floating):
+        big = jnp.array(jnp.inf, dtype=data.dtype)
+        masked = jnp.where(valid & ~jnp.isnan(data), data, big)
+        has_nan = jax.ops.segment_max(
+            (valid & jnp.isnan(data)).astype(jnp.int32), gid,
+            num_segments=num_segments) > 0
+        non_nan_cnt = jax.ops.segment_sum(
+            (valid & ~jnp.isnan(data)).astype(jnp.int64), gid,
+            num_segments=num_segments)
+        m = jax.ops.segment_min(masked, gid, num_segments=num_segments)
+        # all-NaN group: min is NaN (NaN is greatest but it's all there is)
+        m = jnp.where((non_nan_cnt == 0) & has_nan,
+                      jnp.array(jnp.nan, dtype=data.dtype), m)
+        cnt = jax.ops.segment_sum(valid.astype(jnp.int64), gid,
+                                  num_segments=num_segments)
+        return m, cnt
+    info = jnp.iinfo(data.dtype) if jnp.issubdtype(data.dtype, jnp.integer) \
+        else None
+    big = jnp.array(info.max, dtype=data.dtype) if info is not None else True
+    masked = jnp.where(valid, data, big)
+    m = jax.ops.segment_min(masked, gid, num_segments=num_segments)
+    cnt = jax.ops.segment_sum(valid.astype(jnp.int64), gid,
+                              num_segments=num_segments)
+    return m, cnt
+
+
+def _seg_max(data, valid, gid, num_segments):
+    if jnp.issubdtype(data.dtype, jnp.floating):
+        small = jnp.array(-jnp.inf, dtype=data.dtype)
+        masked = jnp.where(valid & ~jnp.isnan(data), data, small)
+        has_nan = jax.ops.segment_max(
+            (valid & jnp.isnan(data)).astype(jnp.int32), gid,
+            num_segments=num_segments) > 0
+        m = jax.ops.segment_max(masked, gid, num_segments=num_segments)
+        # Spark: NaN is greatest, so any NaN -> max is NaN
+        m = jnp.where(has_nan, jnp.array(jnp.nan, dtype=data.dtype), m)
+        cnt = jax.ops.segment_sum(valid.astype(jnp.int64), gid,
+                                  num_segments=num_segments)
+        return m, cnt
+    info = jnp.iinfo(data.dtype) if jnp.issubdtype(data.dtype, jnp.integer) \
+        else None
+    small = jnp.array(info.min, dtype=data.dtype) if info is not None else False
+    masked = jnp.where(valid, data, small)
+    m = jax.ops.segment_max(masked, gid, num_segments=num_segments)
+    cnt = jax.ops.segment_sum(valid.astype(jnp.int64), gid,
+                              num_segments=num_segments)
+    return m, cnt
+
+
+class AggregateExpression:
+    """Base: not an Expression (cannot appear mid-row-expression); planner
+    handles it in Aggregate nodes only (ref GpuAggregateExpression:219)."""
+
+    def __init__(self, child: Optional[Expression], name: Optional[str] = None):
+        self.child = child
+        self._name = name
+
+    # ---- naming / typing -------------------------------------------------
+    @property
+    def name_hint(self) -> str:
+        if self._name:
+            return self._name
+        cn = self.child.name_hint if self.child is not None else "*"
+        return f"{type(self).__name__.lower()}({cn})"
+
+    def with_name(self, name: str) -> "AggregateExpression":
+        self._name = name
+        return self
+
+    def data_type(self, schema: Schema) -> DataType:
+        raise NotImplementedError
+
+    def device_unsupported_reason(self, schema: Schema) -> Optional[str]:
+        if self.child is None:
+            return None
+        r = self.child.fully_device_supported(schema)
+        if r:
+            return r
+        dt = self.child.data_type(schema)
+        if not dt.device_backed:
+            return f"{self.name_hint}: input type {dt.name} is host-only"
+        return None
+
+    # ---- device pipeline -------------------------------------------------
+    def input_exprs(self) -> List[Expression]:
+        return [self.child] if self.child is not None else []
+
+    def partial_types(self, schema: Schema) -> List[DataType]:
+        raise NotImplementedError
+
+    def update(self, vals: List[DVal], gid, num_segments, row_mask):
+        """per-row DVals -> list of per-group (data, validity) partials."""
+        raise NotImplementedError
+
+    def merge(self, partials: List[DVal], gid, num_segments):
+        raise NotImplementedError
+
+    def finalize(self, partials: List[DVal]) -> DVal:
+        raise NotImplementedError
+
+    # ---- host (CPU fallback + oracle) -----------------------------------
+    #: pandas groupby aggregation name used by the host aggregate exec
+    pandas_agg: str = "?"
+
+    def key(self) -> str:
+        c = self.child.key() if self.child is not None else "*"
+        return f"{type(self).__name__}({c})"
+
+
+class Sum(AggregateExpression):
+    pandas_agg = "sum"
+
+    def data_type(self, schema):
+        dt = self.child.data_type(schema)
+        if dt.name in ("tinyint", "smallint", "int", "bigint"):
+            return INT64
+        return FLOAT64 if dt.name in ("float", "double") else dt
+
+    def partial_types(self, schema):
+        return [self.data_type(schema)]
+
+    def update(self, vals, gid, num_segments, row_mask):
+        v = vals[0]
+        # promote to the accumulator type before summing
+        acc_dt = jnp.int64 if jnp.issubdtype(v.data.dtype, jnp.integer) \
+            else jnp.float64
+        s, cnt = _seg_sum(v.data.astype(acc_dt), v.validity, gid, num_segments)
+        return [(s, cnt > 0)]
+
+    def merge(self, partials, gid, num_segments):
+        p = partials[0]
+        s, cnt = _seg_sum(p.data, p.validity, gid, num_segments)
+        return [(s, cnt > 0)]
+
+    def finalize(self, partials):
+        return partials[0]
+
+
+class Count(AggregateExpression):
+    pandas_agg = "count"
+
+    def data_type(self, schema):
+        return INT64
+
+    def partial_types(self, schema):
+        return [INT64]
+
+    def update(self, vals, gid, num_segments, row_mask):
+        v = vals[0]
+        cnt = jax.ops.segment_sum(v.validity.astype(jnp.int64), gid,
+                                  num_segments=num_segments)
+        return [(cnt, jnp.ones_like(cnt, dtype=jnp.bool_))]
+
+    def merge(self, partials, gid, num_segments):
+        p = partials[0]
+        s, _ = _seg_sum(p.data, p.validity, gid, num_segments)
+        return [(s, jnp.ones_like(s, dtype=jnp.bool_))]
+
+    def finalize(self, partials):
+        p = partials[0]
+        # count is never null: empty merge slots become 0
+        return DVal(jnp.where(p.validity, p.data, jnp.zeros_like(p.data)),
+                    jnp.ones_like(p.validity), INT64)
+
+
+class CountStar(Count):
+    def __init__(self, name: Optional[str] = None):
+        super().__init__(None, name)
+
+    @property
+    def name_hint(self):
+        return self._name or "count(1)"
+
+    def input_exprs(self):
+        return [Literal(1)]
+
+    def update(self, vals, gid, num_segments, row_mask):
+        ones = row_mask.astype(jnp.int64)
+        cnt = jax.ops.segment_sum(ones, gid, num_segments=num_segments)
+        return [(cnt, jnp.ones_like(cnt, dtype=jnp.bool_))]
+
+
+class Min(AggregateExpression):
+    pandas_agg = "min"
+
+    def data_type(self, schema):
+        return self.child.data_type(schema)
+
+    def partial_types(self, schema):
+        return [self.data_type(schema)]
+
+    def update(self, vals, gid, num_segments, row_mask):
+        v = vals[0]
+        m, cnt = _seg_min(v.data, v.validity, gid, num_segments)
+        return [(m, cnt > 0)]
+
+    def merge(self, partials, gid, num_segments):
+        p = partials[0]
+        m, cnt = _seg_min(p.data, p.validity, gid, num_segments)
+        return [(m, cnt > 0)]
+
+    def finalize(self, partials):
+        return partials[0]
+
+
+class Max(AggregateExpression):
+    pandas_agg = "max"
+
+    def data_type(self, schema):
+        return self.child.data_type(schema)
+
+    def partial_types(self, schema):
+        return [self.data_type(schema)]
+
+    def update(self, vals, gid, num_segments, row_mask):
+        v = vals[0]
+        m, cnt = _seg_max(v.data, v.validity, gid, num_segments)
+        return [(m, cnt > 0)]
+
+    def merge(self, partials, gid, num_segments):
+        p = partials[0]
+        m, cnt = _seg_max(p.data, p.validity, gid, num_segments)
+        return [(m, cnt > 0)]
+
+    def finalize(self, partials):
+        return partials[0]
+
+
+class Average(AggregateExpression):
+    pandas_agg = "mean"
+
+    def data_type(self, schema):
+        return FLOAT64
+
+    def partial_types(self, schema):
+        return [FLOAT64, INT64]  # sum, count
+
+    def update(self, vals, gid, num_segments, row_mask):
+        v = vals[0]
+        s, cnt = _seg_sum(v.data.astype(jnp.float64), v.validity, gid,
+                          num_segments)
+        ok = cnt > 0
+        return [(s, ok), (cnt, jnp.ones_like(ok))]
+
+    def merge(self, partials, gid, num_segments):
+        s, _ = _seg_sum(partials[0].data, partials[0].validity, gid,
+                        num_segments)
+        c, _ = _seg_sum(partials[1].data, partials[1].validity, gid,
+                        num_segments)
+        return [(s, c > 0), (c, jnp.ones_like(c, dtype=jnp.bool_))]
+
+    def finalize(self, partials):
+        s, c = partials[0], partials[1]
+        ok = jnp.logical_and(s.validity, c.data > 0)
+        denom = jnp.where(c.data > 0, c.data, jnp.ones_like(c.data))
+        return DVal(s.data / denom.astype(jnp.float64), ok, FLOAT64)
+
+
+class First(AggregateExpression):
+    """first(x, ignoreNulls=True) — within-batch order; cross-batch order
+    follows batch arrival like the reference's first agg."""
+    pandas_agg = "first"
+
+    def data_type(self, schema):
+        return self.child.data_type(schema)
+
+    def partial_types(self, schema):
+        return [self.data_type(schema), INT64]  # value, first-row-index
+
+    def update(self, vals, gid, num_segments, row_mask):
+        v = vals[0]
+        n = v.data.shape[0]
+        idx = jnp.arange(n, dtype=jnp.int64)
+        big = jnp.array(np.iinfo(np.int64).max, dtype=jnp.int64)
+        first_idx = jax.ops.segment_min(jnp.where(v.validity, idx, big), gid,
+                                        num_segments=num_segments)
+        ok = first_idx < big
+        safe = jnp.where(ok, first_idx, 0)
+        val = jnp.take(v.data, safe, mode="clip")
+        return [(val, ok), (jnp.where(ok, first_idx, big), jnp.ones_like(ok))]
+
+    def merge(self, partials, gid, num_segments):
+        val, pos = partials[0], partials[1]
+        n = val.data.shape[0]
+        big = jnp.array(np.iinfo(np.int64).max, dtype=jnp.int64)
+        eff = jnp.where(val.validity, pos.data, big)
+        first_pos = jax.ops.segment_min(eff, gid, num_segments=num_segments)
+        ok = first_pos < big
+        # gather the value whose pos equals first_pos within the segment
+        is_first = jnp.logical_and(eff == jnp.take(first_pos, gid, mode="clip"),
+                                   val.validity)
+        out = jnp.zeros((num_segments,), dtype=val.data.dtype) \
+            .at[jnp.where(is_first, gid, num_segments)] \
+            .set(val.data, mode="drop")
+        return [(out, ok), (jnp.where(ok, first_pos, big), jnp.ones_like(ok))]
+
+    def finalize(self, partials):
+        return partials[0]
+
+
+class Last(AggregateExpression):
+    pandas_agg = "last"
+
+    def data_type(self, schema):
+        return self.child.data_type(schema)
+
+    def partial_types(self, schema):
+        return [self.data_type(schema), INT64]
+
+    def update(self, vals, gid, num_segments, row_mask):
+        v = vals[0]
+        n = v.data.shape[0]
+        idx = jnp.arange(n, dtype=jnp.int64)
+        small = jnp.array(-1, dtype=jnp.int64)
+        last_idx = jax.ops.segment_max(jnp.where(v.validity, idx, small), gid,
+                                       num_segments=num_segments)
+        ok = last_idx >= 0
+        safe = jnp.where(ok, last_idx, 0)
+        val = jnp.take(v.data, safe, mode="clip")
+        return [(val, ok), (jnp.where(ok, last_idx, small), jnp.ones_like(ok))]
+
+    def merge(self, partials, gid, num_segments):
+        val, pos = partials[0], partials[1]
+        small = jnp.array(-1, dtype=jnp.int64)
+        eff = jnp.where(val.validity, pos.data, small)
+        last_pos = jax.ops.segment_max(eff, gid, num_segments=num_segments)
+        ok = last_pos >= 0
+        is_last = jnp.logical_and(eff == jnp.take(last_pos, gid, mode="clip"),
+                                  val.validity)
+        out = jnp.zeros((num_segments,), dtype=val.data.dtype) \
+            .at[jnp.where(is_last, gid, num_segments)] \
+            .set(val.data, mode="drop")
+        return [(out, ok), (jnp.where(ok, last_pos, small), jnp.ones_like(ok))]
+
+    def finalize(self, partials):
+        return partials[0]
+
+
+class _MomentAgg(AggregateExpression):
+    """Shared machinery for variance/stddev: partials (count, sum, sum_sq)."""
+    ddof = 1
+
+    def data_type(self, schema):
+        return FLOAT64
+
+    def partial_types(self, schema):
+        return [INT64, FLOAT64, FLOAT64]
+
+    def update(self, vals, gid, num_segments, row_mask):
+        v = vals[0]
+        d = v.data.astype(jnp.float64)
+        s, cnt = _seg_sum(d, v.validity, gid, num_segments)
+        s2, _ = _seg_sum(d * d, v.validity, gid, num_segments)
+        ones = jnp.ones_like(cnt, dtype=jnp.bool_)
+        return [(cnt, ones), (s, ones), (s2, ones)]
+
+    def merge(self, partials, gid, num_segments):
+        outs = []
+        for p in partials:
+            s, _ = _seg_sum(p.data, p.validity, gid, num_segments)
+            outs.append((s, jnp.ones_like(s, dtype=jnp.bool_)))
+        return outs
+
+    def _moments(self, partials):
+        n = partials[0].data.astype(jnp.float64)
+        s = partials[1].data
+        s2 = partials[2].data
+        denom = jnp.where(n > 0, n, 1.0)
+        mean = s / denom
+        m2 = s2 - n * mean * mean
+        return n, m2
+
+
+class VariancePop(_MomentAgg):
+    pandas_agg = "var_pop"
+    ddof = 0
+
+    def finalize(self, partials):
+        n, m2 = self._moments(partials)
+        ok = n > 0
+        out = m2 / jnp.where(ok, n, 1.0)
+        return DVal(jnp.maximum(out, 0.0), ok, FLOAT64)
+
+
+class VarianceSamp(_MomentAgg):
+    pandas_agg = "var"
+
+    def finalize(self, partials):
+        n, m2 = self._moments(partials)
+        ok = n > 1
+        out = m2 / jnp.where(ok, n - 1.0, 1.0)
+        # n==1 -> NaN (Spark), n==0 -> null
+        out = jnp.where(n == 1, jnp.nan, jnp.maximum(out, 0.0))
+        return DVal(out, n > 0, FLOAT64)
+
+
+class StddevPop(VariancePop):
+    pandas_agg = "std_pop"
+
+    def finalize(self, partials):
+        v = super().finalize(partials)
+        return DVal(jnp.sqrt(v.data), v.validity, FLOAT64)
+
+
+class StddevSamp(VarianceSamp):
+    pandas_agg = "std"
+
+    def finalize(self, partials):
+        n, m2 = self._moments(partials)
+        ok = n > 1
+        out = jnp.sqrt(m2 / jnp.where(ok, n - 1.0, 1.0))
+        out = jnp.where(n == 1, jnp.nan, out)
+        return DVal(out, n > 0, FLOAT64)

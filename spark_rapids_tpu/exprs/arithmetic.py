@@ -23,9 +23,13 @@ __all__ = ["Add", "Subtract", "Multiply", "Divide", "IntegralDivide",
 
 def arrow_to_masked_numpy(arr):
     """pyarrow.Array -> (values ndarray, valid bool ndarray)."""
+    import pyarrow as pa
     valid = ~np.asarray(arr.is_null())
-    vals = arr.fill_null(0).to_numpy(zero_copy_only=False) if arr.null_count \
-        else arr.to_numpy(zero_copy_only=False)
+    if arr.null_count:
+        fill = False if pa.types.is_boolean(arr.type) else 0
+        vals = arr.fill_null(fill).to_numpy(zero_copy_only=False)
+    else:
+        vals = arr.to_numpy(zero_copy_only=False)
     return vals, valid
 
 

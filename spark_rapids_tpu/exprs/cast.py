@@ -27,6 +27,21 @@ def _int_bounds(np_dt):
     return info.min, info.max
 
 
+def _float_to_int_java(d, np_dt, xp):
+    """Java (int)/(long) cast semantics: NaN -> 0, truncate toward zero,
+    out-of-range saturates to min/max (ref GpuCast float->int handling).
+    `xp` is numpy or jax.numpy so device and host paths share one definition."""
+    lo, hi = _int_bounds(np_dt)
+    bits = np.iinfo(np_dt).bits
+    t_hi = 2.0 ** (bits - 1)               # first value that overflows
+    max_safe = np.nextafter(t_hi, 0.0)     # largest representable below 2^(b-1)
+    clean = xp.where(xp.isnan(d), xp.zeros_like(d), d)
+    safe = xp.clip(clean, float(lo), max_safe)
+    out = xp.trunc(safe).astype(np_dt)
+    out = xp.where(clean >= t_hi, xp.asarray(hi, dtype=np_dt), out)
+    return out.astype(np_dt)
+
+
 class Cast(Expression):
     device_type_sig = all_types  # per-pair support decided in reason check
 
@@ -63,10 +78,7 @@ class Cast(Expression):
             out = jnp.floor_divide(d, _MICROS_PER_DAY).astype(jnp.int32)
         elif (jnp.issubdtype(d.dtype, jnp.floating)
               and np.issubdtype(dst.np_dtype, np.integer)):
-            lo, hi = _int_bounds(dst.np_dtype)
-            clean = jnp.where(jnp.isnan(d), jnp.zeros_like(d), d)
-            clamped = jnp.clip(clean, float(lo), float(hi))
-            out = jnp.trunc(clamped).astype(dst.np_dtype)
+            out = _float_to_int_java(d, dst.np_dtype, jnp)
         else:
             out = d.astype(dst.np_dtype)
         return DVal(out, c.validity, dst)
@@ -96,10 +108,7 @@ class Cast(Expression):
                 out = np.floor_divide(iv, _MICROS_PER_DAY).astype(np.int32)
             elif (np.issubdtype(v.dtype, np.floating)
                   and np.issubdtype(dst.np_dtype, np.integer)):
-                lo, hi = _int_bounds(dst.np_dtype)
-                clean = np.where(np.isnan(v), 0.0, v)
-                out = np.trunc(np.clip(clean, float(lo), float(hi))) \
-                    .astype(dst.np_dtype)
+                out = _float_to_int_java(v, dst.np_dtype, np)
             else:
                 out = v.astype(dst.np_dtype)
             return masked_numpy_to_arrow(out, ok, dst)

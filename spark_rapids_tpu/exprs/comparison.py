@@ -292,7 +292,10 @@ class In(Expression):
         arr = self.children[0].eval_host(batch)
         vals = pa.array([v for v in self.values if v is not None],
                         type=arr.type)
-        return pc.is_in(arr, value_set=vals)
+        res = pc.is_in(arr, value_set=vals)
+        # Spark: null IN (...) -> NULL (pc.is_in yields false for nulls)
+        return pc.if_else(pc.is_valid(arr), res,
+                          pa.nulls(len(arr), pa.bool_()))
 
     def key(self):
         return f"in({self.children[0].key()},{self.values!r})"

@@ -22,6 +22,13 @@ def _common_type(schema: Schema, exprs) -> DataType:
     return dt if dt is not None else exprs[0].data_type(schema)
 
 
+def _arrow_if_else(pred_arr, true_arr, false_arr):
+    """SQL if: null predicate selects the else branch."""
+    import pyarrow.compute as pc
+    cond = pc.fill_null(pred_arr, False)
+    return pc.if_else(cond, true_arr, false_arr)
+
+
 class If(Expression):
     def __init__(self, pred, if_true, if_false):
         self.children = [pred, if_true, if_false]
@@ -43,6 +50,10 @@ class If(Expression):
 
     def eval_host(self, batch):
         dt = self.data_type(batch.schema)
+        if dt.np_dtype is None:  # string/nested: pure-arrow path
+            return _arrow_if_else(self.children[0].eval_host(batch),
+                                  self.children[1].eval_host(batch),
+                                  self.children[2].eval_host(batch))
         p, pv = arrow_to_masked_numpy(self.children[0].eval_host(batch))
         t, tv = arrow_to_masked_numpy(self.children[1].eval_host(batch))
         f, fv = arrow_to_masked_numpy(self.children[2].eval_host(batch))
@@ -93,6 +104,17 @@ class CaseWhen(Expression):
         dt = self.data_type(batch.schema)
         np_dt = dt.np_dtype
         n = batch.num_rows
+        if np_dt is None:  # string/nested: pure-arrow path
+            import pyarrow as pa
+            from ..types import to_arrow
+            if self.else_value is not None:
+                acc = self.else_value.eval_host(batch)
+            else:
+                acc = pa.nulls(n, type=to_arrow(dt))
+            for pred, val in reversed(self.branches):
+                acc = _arrow_if_else(pred.eval_host(batch),
+                                     val.eval_host(batch), acc)
+            return acc
         if self.else_value is not None:
             data, valid = arrow_to_masked_numpy(self.else_value.eval_host(batch))
             data = data.astype(np_dt)
@@ -134,6 +156,12 @@ class Coalesce(Expression):
     def eval_host(self, batch):
         dt = self.data_type(batch.schema)
         np_dt = dt.np_dtype
+        if np_dt is None:  # string/nested: pure-arrow path
+            import pyarrow.compute as pc
+            acc = self.children[0].eval_host(batch)
+            for child in self.children[1:]:
+                acc = pc.coalesce(acc, child.eval_host(batch))
+            return acc
         data = np.zeros(batch.num_rows, dtype=np_dt)
         valid = np.zeros(batch.num_rows, dtype=bool)
         for child in reversed(self.children):

@@ -1,0 +1,11 @@
+from .manager import (MemoryManager, OutOfDeviceMemory, RetryOOM,
+                      SplitAndRetryOOM)
+from .retry import (RetryStats, split_batch_in_half, with_retry,
+                    with_retry_no_split)
+from .semaphore import DeviceSemaphore
+from .spillable import SpillableBatch, SpillPriorities
+
+__all__ = ["MemoryManager", "OutOfDeviceMemory", "RetryOOM",
+           "SplitAndRetryOOM", "RetryStats", "split_batch_in_half",
+           "with_retry", "with_retry_no_split", "DeviceSemaphore",
+           "SpillableBatch", "SpillPriorities"]

@@ -1,0 +1,224 @@
+"""HBM budget manager + spill orchestration.
+
+Reference analog: RMM pool + RapidsBufferCatalog + DeviceMemoryEventHandler
+(RapidsBufferCatalog.scala:810-851, DeviceMemoryEventHandler.scala:36). On
+TPU, XLA owns physical HBM, so the framework performs *logical* accounting:
+every long-lived device buffer the runtime retains (shuffle partitions, agg
+partials, cached builds, spillable batches) is registered here; ``reserve``
+enforces the budget and, on pressure, synchronously spills registered buffers
+(device -> host -> disk) in spill-priority order, exactly the role of the
+reference's onAllocFailure callback. When spilling cannot satisfy a request,
+a RetryOOM/SplitAndRetryOOM is raised for the retry framework (retry.py).
+
+Fault injection (force_retry_oom / force_split_and_retry_oom) mirrors
+RmmSpark.forceRetryOOM — the backbone of the reference's OOM test suites
+(HashAggregateRetrySuite.scala:121-222).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from ..config import (ALLOC_FRACTION, HBM_LIMIT_BYTES, HOST_SPILL_LIMIT,
+                      SPILL_DIR, TpuConf)
+
+__all__ = ["MemoryManager", "RetryOOM", "SplitAndRetryOOM", "OutOfDeviceMemory"]
+
+
+class RetryOOM(RuntimeError):
+    """Allocation failed but retrying after spill may succeed
+    (ref GpuRetryOOM jni)."""
+
+
+class SplitAndRetryOOM(RuntimeError):
+    """Retry alone cannot succeed; caller must split its input
+    (ref GpuSplitAndRetryOOM jni)."""
+
+
+class OutOfDeviceMemory(RuntimeError):
+    """Unrecoverable: nothing left to spill and input cannot be split."""
+
+
+def _device_hbm_bytes() -> int:
+    import jax
+    try:
+        d = jax.local_devices()[0]
+        stats = d.memory_stats()
+        if stats and "bytes_limit" in stats:
+            return int(stats["bytes_limit"])
+    except Exception:
+        pass
+    return 8 * 1024 * 1024 * 1024  # assume 8 GiB if the backend won't say
+
+
+class MemoryManager:
+    _instances: Dict[int, "MemoryManager"] = {}
+    _global_lock = threading.Lock()
+
+    def __init__(self, budget_bytes: int, host_limit_bytes: int,
+                 spill_dir: str):
+        self.budget = budget_bytes
+        self.host_limit = host_limit_bytes
+        self.spill_dir = spill_dir
+        self._lock = threading.RLock()
+        self.device_used = 0
+        self.host_used = 0
+        self.disk_used = 0
+        self.max_device_used = 0
+        self.spill_to_host_bytes = 0
+        self.spill_to_disk_bytes = 0
+        # spillables: handle -> SpillableBatch, priority-ordered on demand
+        self._spillables: Dict[int, "object"] = {}
+        self._next_handle = 0
+        # fault injection: thread-ident -> [(kind, remaining_skips, count)]
+        self._inject: Dict[int, List] = {}
+
+    # ------------------------------------------------------------------ ctor
+    @classmethod
+    def get(cls, conf: Optional[TpuConf] = None) -> "MemoryManager":
+        conf = conf or TpuConf()
+        limit = conf.get(HBM_LIMIT_BYTES)
+        if not limit:
+            limit = int(_device_hbm_bytes() * conf.get(ALLOC_FRACTION))
+        key = limit
+        with cls._global_lock:
+            if key not in cls._instances:
+                cls._instances[key] = cls(limit, conf.get(HOST_SPILL_LIMIT),
+                                          conf.get(SPILL_DIR))
+            return cls._instances[key]
+
+    # ----------------------------------------------------------- registration
+    def register_spillable(self, spillable) -> int:
+        with self._lock:
+            h = self._next_handle
+            self._next_handle += 1
+            self._spillables[h] = spillable
+            return h
+
+    def unregister_spillable(self, handle: int):
+        with self._lock:
+            self._spillables.pop(handle, None)
+
+    # ------------------------------------------------------------ accounting
+    def reserve(self, nbytes: int, allow_spill: bool = True):
+        """Account for nbytes of device memory about to be retained.
+
+        On budget pressure: spill registered buffers; on injected or real
+        exhaustion raise RetryOOM / SplitAndRetryOOM
+        (ref DeviceMemoryEventHandler.onAllocFailure -> store.spill)."""
+        self._maybe_inject()
+        with self._lock:
+            if self.device_used + nbytes <= self.budget:
+                self.device_used += nbytes
+                self.max_device_used = max(self.max_device_used,
+                                           self.device_used)
+                return
+        if allow_spill:
+            freed = self.spill_device(nbytes - (self.budget - self.device_used))
+            with self._lock:
+                if self.device_used + nbytes <= self.budget:
+                    self.device_used += nbytes
+                    self.max_device_used = max(self.max_device_used,
+                                               self.device_used)
+                    return
+        if nbytes > self.budget:
+            raise SplitAndRetryOOM(
+                f"allocation of {nbytes} exceeds whole budget {self.budget}")
+        raise RetryOOM(f"could not reserve {nbytes} "
+                       f"(used={self.device_used}, budget={self.budget})")
+
+    def release(self, nbytes: int):
+        with self._lock:
+            self.device_used = max(0, self.device_used - nbytes)
+
+    def reserve_host(self, nbytes: int):
+        with self._lock:
+            self.host_used += nbytes
+
+    def release_host(self, nbytes: int):
+        with self._lock:
+            self.host_used = max(0, self.host_used - nbytes)
+
+    # --------------------------------------------------------------- spilling
+    def spill_device(self, need_bytes: int) -> int:
+        """Synchronously spill device-tier spillables in priority order until
+        need_bytes freed (ref RapidsBufferStore.synchronousSpill)."""
+        with self._lock:
+            candidates = sorted(
+                (s for s in self._spillables.values()
+                 if s.tier == "device"),
+                key=lambda s: s.spill_priority)
+        freed = 0
+        for s in candidates:
+            if freed >= need_bytes:
+                break
+            freed += s.spill_to_host()
+        # host pressure cascades to disk
+        with self._lock:
+            over = self.host_used - self.host_limit
+        if over > 0:
+            self.spill_host(over)
+        return freed
+
+    def spill_host(self, need_bytes: int) -> int:
+        with self._lock:
+            candidates = sorted(
+                (s for s in self._spillables.values() if s.tier == "host"),
+                key=lambda s: s.spill_priority)
+        freed = 0
+        for s in candidates:
+            if freed >= need_bytes:
+                break
+            freed += s.spill_to_disk()
+        return freed
+
+    # -------------------------------------------------------- fault injection
+    def force_retry_oom(self, num_ooms: int = 1, skip: int = 0,
+                        thread_id: Optional[int] = None):
+        """Next `num_ooms` reserves on the thread raise RetryOOM after
+        skipping `skip` (ref RmmSpark.forceRetryOOM)."""
+        tid = thread_id if thread_id is not None else threading.get_ident()
+        with self._lock:
+            self._inject.setdefault(tid, []).append(["retry", skip, num_ooms])
+
+    def force_split_and_retry_oom(self, num_ooms: int = 1, skip: int = 0,
+                                  thread_id: Optional[int] = None):
+        tid = thread_id if thread_id is not None else threading.get_ident()
+        with self._lock:
+            self._inject.setdefault(tid, []).append(["split", skip, num_ooms])
+
+    def clear_injections(self):
+        with self._lock:
+            self._inject.clear()
+
+    def _maybe_inject(self):
+        tid = threading.get_ident()
+        with self._lock:
+            queue = self._inject.get(tid)
+            if not queue:
+                return
+            entry = queue[0]
+            kind, skip, count = entry
+            if skip > 0:
+                entry[1] -= 1
+                return
+            entry[2] -= 1
+            if entry[2] <= 0:
+                queue.pop(0)
+                if not queue:
+                    self._inject.pop(tid, None)
+        if kind == "retry":
+            raise RetryOOM("injected RetryOOM")
+        raise SplitAndRetryOOM("injected SplitAndRetryOOM")
+
+    # ------------------------------------------------------------------ stats
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"device_used": self.device_used,
+                    "host_used": self.host_used,
+                    "disk_used": self.disk_used,
+                    "max_device_used": self.max_device_used,
+                    "budget": self.budget,
+                    "spill_to_host_bytes": self.spill_to_host_bytes,
+                    "spill_to_disk_bytes": self.spill_to_disk_bytes,
+                    "num_spillables": len(self._spillables)}

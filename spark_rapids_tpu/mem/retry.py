@@ -1,0 +1,102 @@
+"""The OOM retry / split-and-retry framework.
+
+Reference analog: RmmRapidsRetryIterator.scala:33-200 (withRetry /
+withRetryNoSplit / splitAndRetry), driven by GpuRetryOOM /
+GpuSplitAndRetryOOM thrown from the allocator. Semantics preserved:
+
+  * the attempted function must be idempotent over its (spillable) input
+  * RetryOOM     -> spill happened (or will), just run again
+  * SplitAndRetryOOM -> halve the input and process the pieces recursively
+  * bounded attempts, then OutOfDeviceMemory
+
+Used by every memory-hungry operator (aggregate merge, sort, join build,
+coalesce) exactly like the reference wraps theirs.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Iterator, List, Optional, TypeVar
+
+from .manager import (MemoryManager, OutOfDeviceMemory, RetryOOM,
+                      SplitAndRetryOOM)
+from .spillable import SpillableBatch
+
+__all__ = ["with_retry_no_split", "with_retry", "split_batch_in_half",
+           "RetryStats"]
+
+T = TypeVar("T")
+MAX_RETRIES = 100
+
+
+class RetryStats:
+    def __init__(self):
+        self.retries = 0
+        self.splits = 0
+
+
+def with_retry_no_split(fn: Callable[[], T], mm: Optional[MemoryManager] = None,
+                        stats: Optional[RetryStats] = None) -> T:
+    """Run fn; on RetryOOM spill+retry; SplitAndRetryOOM is fatal here
+    (ref withRetryNoSplit)."""
+    mm = mm or MemoryManager.get()
+    last = None
+    for attempt in range(MAX_RETRIES):
+        try:
+            return fn()
+        except RetryOOM as e:
+            last = e
+            stats and setattr(stats, "retries", stats.retries + 1)
+            mm.spill_device(0)
+            time.sleep(0)  # yield so other tasks can release
+        except SplitAndRetryOOM as e:
+            raise OutOfDeviceMemory(
+                f"operation cannot split its input: {e}") from e
+    raise OutOfDeviceMemory(f"exceeded {MAX_RETRIES} OOM retries: {last}")
+
+
+def split_batch_in_half(sb: SpillableBatch) -> List[SpillableBatch]:
+    """Default splitter (ref RmmRapidsRetryIterator splitSpillableInHalfByRows)."""
+    batch = sb.get()
+    n = batch.num_rows
+    if n < 2:
+        raise OutOfDeviceMemory("cannot split a batch with < 2 rows")
+    mid = n // 2
+    left = batch.slice(0, mid)
+    right = batch.slice(mid, n - mid)
+    mm = sb._mm
+    sb.close()
+    return [SpillableBatch(left, mm), SpillableBatch(right, mm)]
+
+
+def with_retry(inputs: List[SpillableBatch],
+               fn: Callable[[SpillableBatch], T],
+               mm: Optional[MemoryManager] = None,
+               splitter: Callable = split_batch_in_half,
+               stats: Optional[RetryStats] = None) -> Iterator[T]:
+    """Process each spillable input through fn with retry+split semantics
+    (ref withRetry + RetryIterator). Yields one result per (possibly split)
+    input piece, in order."""
+    mm = mm or MemoryManager.get()
+    queue: List[SpillableBatch] = list(inputs)
+    while queue:
+        item = queue.pop(0)
+        attempts = 0
+        while True:
+            try:
+                yield fn(item)
+                break
+            except RetryOOM:
+                attempts += 1
+                stats and setattr(stats, "retries", stats.retries + 1)
+                if attempts > MAX_RETRIES:
+                    raise OutOfDeviceMemory("retry limit exceeded")
+                mm.spill_device(0)
+            except SplitAndRetryOOM:
+                stats and setattr(stats, "splits", stats.splits + 1)
+                pieces = splitter(item)
+                # process pieces in order before the rest of the queue
+                queue = pieces + queue
+                item = None
+                break
+        if item is None:
+            continue

@@ -1,0 +1,58 @@
+"""Device admission semaphore (ref GpuSemaphore.scala:51).
+
+Gates how many tasks may have live device work at once
+(spark.rapids.tpu.sql.concurrentTpuTasks); tracks wait time the way
+GpuTaskMetrics records gpuSemaphoreWait (GpuTaskMetrics.scala:146).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+
+__all__ = ["DeviceSemaphore"]
+
+
+class DeviceSemaphore:
+    def __init__(self, permits: int, timeout_s: float = 600.0):
+        self._permits = max(1, int(permits))
+        self._sem = threading.BoundedSemaphore(self._permits)
+        self._timeout = timeout_s
+        self._lock = threading.Lock()
+        self.total_wait_s = 0.0
+        self.acquires = 0
+        self._held = threading.local()
+
+    @property
+    def permits(self) -> int:
+        return self._permits
+
+    def acquire(self):
+        if getattr(self._held, "count", 0) > 0:
+            self._held.count += 1  # reentrant per task thread
+            return
+        t0 = time.perf_counter()
+        if not self._sem.acquire(timeout=self._timeout):
+            raise TimeoutError(
+                f"device semaphore not acquired within {self._timeout}s")
+        wait = time.perf_counter() - t0
+        with self._lock:
+            self.total_wait_s += wait
+            self.acquires += 1
+        self._held.count = 1
+
+    def release(self):
+        c = getattr(self._held, "count", 0)
+        if c <= 0:
+            return
+        if c == 1:
+            self._sem.release()
+        self._held.count = c - 1
+
+    @contextmanager
+    def held(self):
+        self.acquire()
+        try:
+            yield self
+        finally:
+            self.release()

@@ -1,0 +1,140 @@
+"""SpillableBatch: a columnar batch that can migrate device -> host -> disk
+and come back on demand.
+
+Reference analog: SpillableColumnarBatch (SpillableColumnarBatch.scala:29) +
+the tiered stores (RapidsDeviceMemoryStore / RapidsHostMemoryStore /
+RapidsDiskStore). Device tier holds jax arrays (HBM); host tier holds an
+Arrow table; disk tier holds an Arrow IPC file in the spill directory.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import uuid
+from typing import Optional
+
+from ..columnar import ColumnarBatch
+from .manager import MemoryManager
+
+__all__ = ["SpillableBatch", "SpillPriorities"]
+
+
+class SpillPriorities:
+    """Lower spills first (ref SpillPriorities.scala)."""
+    OUTPUT_FOR_SHUFFLE = 0
+    ACTIVE_BATCHING = 50
+    ACTIVE_ON_DECK = 100
+
+
+class SpillableBatch:
+    """Wraps a ColumnarBatch; while registered it may be spilled by the
+    MemoryManager at any time, `get()` migrates it back to device."""
+
+    def __init__(self, batch: ColumnarBatch, mm: Optional[MemoryManager] = None,
+                 spill_priority: int = SpillPriorities.ACTIVE_BATCHING):
+        self._mm = mm or MemoryManager.get()
+        self._lock = threading.RLock()
+        self._batch: Optional[ColumnarBatch] = batch
+        self._host_table = None           # pyarrow.Table when tier=host
+        self._disk_path: Optional[str] = None
+        self.tier = "device"
+        self.spill_priority = spill_priority
+        self.num_rows = batch.num_rows
+        self.schema = batch.schema
+        self._device_bytes = batch.device_size_bytes()
+        self._mm.reserve(self._device_bytes)
+        self._handle = self._mm.register_spillable(self)
+        self._closed = False
+
+    # ------------------------------------------------------------- migration
+    def spill_to_host(self) -> int:
+        with self._lock:
+            if self.tier != "device" or self._closed:
+                return 0
+            self._host_table = self._batch.to_arrow()
+            nbytes = self._device_bytes
+            self._batch = None
+            self.tier = "host"
+            self._mm.release(nbytes)
+            self._mm.reserve_host(self._host_table.nbytes)
+            self._mm.spill_to_host_bytes += nbytes
+            return nbytes
+
+    def spill_to_disk(self) -> int:
+        import pyarrow as pa
+        import pyarrow.feather  # noqa: F401
+        with self._lock:
+            if self.tier != "host" or self._closed:
+                return 0
+            os.makedirs(self._mm.spill_dir, exist_ok=True)
+            path = os.path.join(self._mm.spill_dir, f"spill-{uuid.uuid4().hex}.arrow")
+            with pa.OSFile(path, "wb") as f:
+                with pa.ipc.new_file(f, self._host_table.schema) as w:
+                    w.write_table(self._host_table)
+            nbytes = self._host_table.nbytes
+            self._mm.release_host(nbytes)
+            self._mm.disk_used += os.path.getsize(path)
+            self._mm.spill_to_disk_bytes += nbytes
+            self._host_table = None
+            self._disk_path = path
+            self.tier = "disk"
+            return nbytes
+
+    def _unspill(self) -> ColumnarBatch:
+        import pyarrow as pa
+        if self.tier == "host":
+            table = self._host_table
+            self._mm.release_host(table.nbytes)
+            self._host_table = None
+        else:  # disk
+            with pa.memory_map(self._disk_path, "rb") as f:
+                table = pa.ipc.open_file(f).read_all()
+            try:
+                self._mm.disk_used -= os.path.getsize(self._disk_path)
+                os.unlink(self._disk_path)
+            except OSError:
+                pass
+            self._disk_path = None
+        batch = ColumnarBatch.from_arrow(table)
+        self._device_bytes = batch.device_size_bytes()
+        self._mm.reserve(self._device_bytes)
+        self.tier = "device"
+        return batch
+
+    # ------------------------------------------------------------------- api
+    def get(self) -> ColumnarBatch:
+        """Materialize on device (migrating back if spilled)."""
+        with self._lock:
+            if self._closed:
+                raise ValueError("closed SpillableBatch")
+            if self.tier != "device":
+                self._batch = self._unspill()
+            return self._batch
+
+    def size_bytes(self) -> int:
+        return self._device_bytes
+
+    def close(self):
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._mm.unregister_spillable(self._handle)
+            if self.tier == "device":
+                self._mm.release(self._device_bytes)
+            elif self.tier == "host" and self._host_table is not None:
+                self._mm.release_host(self._host_table.nbytes)
+                self._host_table = None
+            elif self.tier == "disk" and self._disk_path:
+                try:
+                    self._mm.disk_used -= os.path.getsize(self._disk_path)
+                    os.unlink(self._disk_path)
+                except OSError:
+                    pass
+            self._batch = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

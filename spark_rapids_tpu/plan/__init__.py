@@ -1,0 +1,6 @@
+from . import logical
+from .meta import PlanMeta
+from .overrides import explain_potential_tpu_plan, plan_query, wrap_plan
+
+__all__ = ["logical", "PlanMeta", "explain_potential_tpu_plan", "plan_query",
+           "wrap_plan"]

@@ -1,0 +1,313 @@
+"""Logical plan nodes.
+
+The reference is a plugin over Spark Catalyst and consumes Catalyst plans
+(GpuOverrides.scala:4480 wrapAndTagPlan). Standalone on TPU we own the plan
+representation: a small Catalyst-shaped logical algebra produced by the
+DataFrame API (api/dataframe.py), tagged and converted by plan/overrides.py.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from ..types import (BOOL, INT64, DataType, Schema, StructField)
+from ..exprs.base import Alias, ColumnRef, Expression
+
+__all__ = ["LogicalPlan", "LogicalScan", "ParquetScan", "Project", "Filter",
+           "Aggregate", "Sort", "SortOrder", "GlobalLimit", "LocalLimit",
+           "Join", "Union", "RangeRel", "Sample", "Expand", "Window",
+           "WindowSpec", "Repartition", "WriteFile"]
+
+
+class LogicalPlan:
+    children: List["LogicalPlan"] = []
+
+    def schema(self) -> Schema:
+        raise NotImplementedError
+
+    def node_name(self) -> str:
+        return type(self).__name__
+
+    def tree_string(self, indent: int = 0) -> str:
+        s = "  " * indent + self.describe() + "\n"
+        for c in self.children:
+            s += c.tree_string(indent + 1)
+        return s
+
+    def describe(self) -> str:
+        return self.node_name()
+
+
+class LogicalScan(LogicalPlan):
+    """In-memory source: a list of Arrow tables (one per partition)."""
+
+    def __init__(self, tables, schema: Schema):
+        self.tables = list(tables)
+        self._schema = schema
+        self.children = []
+
+    def schema(self) -> Schema:
+        return self._schema
+
+    def describe(self):
+        return f"LogicalScan[{len(self.tables)} partitions]({self._schema})"
+
+
+class ParquetScan(LogicalPlan):
+    """File source (ref GpuParquetScan.scala). Partitioning into tasks is
+    decided at physical planning (io/parquet.py)."""
+
+    def __init__(self, paths: Sequence[str], schema: Schema,
+                 columns: Optional[List[str]] = None):
+        self.paths = list(paths)
+        self._schema = schema
+        self.columns = columns
+        self.children = []
+
+    def schema(self) -> Schema:
+        if self.columns is None:
+            return self._schema
+        return Schema([self._schema[c] for c in self.columns])
+
+    def describe(self):
+        return f"ParquetScan[{len(self.paths)} files]"
+
+
+class Project(LogicalPlan):
+    def __init__(self, exprs: Sequence[Expression], child: LogicalPlan):
+        self.exprs = list(exprs)
+        self.children = [child]
+
+    def schema(self) -> Schema:
+        cs = self.children[0].schema()
+        return Schema([StructField(e.name_hint, e.data_type(cs), True)
+                       for e in self.exprs])
+
+    def describe(self):
+        return "Project[" + ", ".join(e.name_hint for e in self.exprs) + "]"
+
+
+class Filter(LogicalPlan):
+    def __init__(self, condition: Expression, child: LogicalPlan):
+        self.condition = condition
+        self.children = [child]
+
+    def schema(self) -> Schema:
+        return self.children[0].schema()
+
+    def describe(self):
+        return f"Filter[{self.condition.name_hint}]"
+
+
+class Aggregate(LogicalPlan):
+    """groupings: list of (expr, name); aggs: list of AggregateExpression
+    (exprs/aggregates.py) each with an output name."""
+
+    def __init__(self, groupings, aggs, child: LogicalPlan):
+        self.groupings = list(groupings)
+        self.aggs = list(aggs)
+        self.children = [child]
+
+    def schema(self) -> Schema:
+        cs = self.children[0].schema()
+        fields = [StructField(e.name_hint, e.data_type(cs), True)
+                  for e in self.groupings]
+        fields += [StructField(a.name_hint, a.data_type(cs), True)
+                   for a in self.aggs]
+        return Schema(fields)
+
+    def describe(self):
+        g = ", ".join(e.name_hint for e in self.groupings)
+        a = ", ".join(a.name_hint for a in self.aggs)
+        return f"Aggregate[keys=[{g}], aggs=[{a}]]"
+
+
+class SortOrder:
+    def __init__(self, expr: Expression, ascending: bool = True,
+                 nulls_first: Optional[bool] = None):
+        self.expr = expr
+        self.ascending = ascending
+        # Spark default: nulls first for asc, nulls last for desc
+        self.nulls_first = nulls_first if nulls_first is not None else ascending
+
+    def __repr__(self):
+        d = "ASC" if self.ascending else "DESC"
+        n = "NULLS FIRST" if self.nulls_first else "NULLS LAST"
+        return f"{self.expr.name_hint} {d} {n}"
+
+
+class Sort(LogicalPlan):
+    def __init__(self, orders: Sequence[SortOrder], child: LogicalPlan,
+                 global_sort: bool = True):
+        self.orders = list(orders)
+        self.global_sort = global_sort
+        self.children = [child]
+
+    def schema(self) -> Schema:
+        return self.children[0].schema()
+
+    def describe(self):
+        return f"Sort[{', '.join(map(repr, self.orders))}]"
+
+
+class GlobalLimit(LogicalPlan):
+    def __init__(self, n: int, child: LogicalPlan):
+        self.n = n
+        self.children = [child]
+
+    def schema(self):
+        return self.children[0].schema()
+
+    def describe(self):
+        return f"GlobalLimit[{self.n}]"
+
+
+class LocalLimit(GlobalLimit):
+    def describe(self):
+        return f"LocalLimit[{self.n}]"
+
+
+class Join(LogicalPlan):
+    JOIN_TYPES = ("inner", "left", "right", "full", "leftsemi", "leftanti",
+                  "cross")
+
+    def __init__(self, left: LogicalPlan, right: LogicalPlan,
+                 join_type: str, left_keys: Sequence[Expression] = (),
+                 right_keys: Sequence[Expression] = (),
+                 condition: Optional[Expression] = None):
+        jt = join_type.lower().replace("_", "")
+        if jt == "leftouter":
+            jt = "left"
+        if jt == "rightouter":
+            jt = "right"
+        if jt in ("fullouter", "outer"):
+            jt = "full"
+        if jt == "semi":
+            jt = "leftsemi"
+        if jt == "anti":
+            jt = "leftanti"
+        assert jt in self.JOIN_TYPES, join_type
+        self.join_type = jt
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        self.condition = condition
+        self.children = [left, right]
+
+    def schema(self) -> Schema:
+        l, r = self.children[0].schema(), self.children[1].schema()
+        if self.join_type in ("leftsemi", "leftanti"):
+            return l
+        # outer sides become nullable
+        return Schema(list(l.fields) + list(r.fields))
+
+    def describe(self):
+        k = ", ".join(f"{a.name_hint}={b.name_hint}"
+                      for a, b in zip(self.left_keys, self.right_keys))
+        return f"Join[{self.join_type}, keys=({k})]"
+
+
+class Union(LogicalPlan):
+    def __init__(self, children: Sequence[LogicalPlan]):
+        self.children = list(children)
+
+    def schema(self):
+        return self.children[0].schema()
+
+    def describe(self):
+        return f"Union[{len(self.children)}]"
+
+
+class RangeRel(LogicalPlan):
+    """ref GpuRangeExec (basicPhysicalOperators.scala:1137)."""
+
+    def __init__(self, start: int, end: int, step: int = 1,
+                 num_partitions: int = 1, name: str = "id"):
+        self.start, self.end, self.step = start, end, step
+        self.num_partitions = num_partitions
+        self.name = name
+        self.children = []
+
+    def schema(self):
+        return Schema([StructField(self.name, INT64, False)])
+
+    def describe(self):
+        return f"Range[{self.start},{self.end},{self.step}]"
+
+
+class Sample(LogicalPlan):
+    def __init__(self, fraction: float, seed: int, child: LogicalPlan):
+        self.fraction = fraction
+        self.seed = seed
+        self.children = [child]
+
+    def schema(self):
+        return self.children[0].schema()
+
+
+class Expand(LogicalPlan):
+    """ref GpuExpandExec: each input row emits one row per projection set."""
+
+    def __init__(self, projections: Sequence[Sequence[Expression]],
+                 names: Sequence[str], child: LogicalPlan):
+        self.projections = [list(p) for p in projections]
+        self.names = list(names)
+        self.children = [child]
+
+    def schema(self):
+        cs = self.children[0].schema()
+        return Schema([StructField(n, e.data_type(cs), True)
+                       for n, e in zip(self.names, self.projections[0])])
+
+
+class WindowSpec:
+    def __init__(self, partition_by: Sequence[Expression] = (),
+                 order_by: Sequence[SortOrder] = (),
+                 frame: Optional[Tuple] = None):
+        self.partition_by = list(partition_by)
+        self.order_by = list(order_by)
+        self.frame = frame  # (kind, lower, upper) or None
+
+
+class Window(LogicalPlan):
+    """ref window/GpuWindowExec.scala:146."""
+
+    def __init__(self, window_exprs, child: LogicalPlan):
+        # window_exprs: list of (agg_or_rank_expr, WindowSpec, out_name)
+        self.window_exprs = list(window_exprs)
+        self.children = [child]
+
+    def schema(self):
+        cs = self.children[0].schema()
+        fields = list(cs.fields)
+        for e, spec, name in self.window_exprs:
+            fields.append(StructField(name, e.data_type(cs), True))
+        return Schema(fields)
+
+
+class Repartition(LogicalPlan):
+    """Exchange request (ref GpuShuffleExchangeExecBase)."""
+
+    def __init__(self, num_partitions: int, keys: Sequence[Expression],
+                 child: LogicalPlan, mode: str = "hash"):
+        self.num_partitions = num_partitions
+        self.keys = list(keys)
+        self.mode = mode  # hash / roundrobin / range / single
+        self.children = [child]
+
+    def schema(self):
+        return self.children[0].schema()
+
+    def describe(self):
+        return f"Repartition[{self.mode}, n={self.num_partitions}]"
+
+
+class WriteFile(LogicalPlan):
+    def __init__(self, path: str, file_format: str, child: LogicalPlan,
+                 mode: str = "overwrite", partition_by: Sequence[str] = ()):
+        self.path = path
+        self.file_format = file_format
+        self.mode = mode
+        self.partition_by = list(partition_by)
+        self.children = [child]
+
+    def schema(self):
+        return self.children[0].schema()

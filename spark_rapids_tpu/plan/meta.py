@@ -1,0 +1,82 @@
+"""Plan-meta tagging tree (ref RapidsMeta.scala:83 SparkPlanMeta:598).
+
+Each logical node is wrapped in a Meta that records *why* it cannot run on
+the TPU (willNotWorkOnTpu), mirrors the reference's tag-then-convert flow
+(GpuOverrides.wrapAndTagPlan:4480 -> doConvertPlan:4486), and produces the
+explain output (`spark.rapids.tpu.sql.explain=NOT_ON_TPU`, ref
+GpuOverrides.scala:4829-4838).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..config import TpuConf
+from ..exec.base import TpuExec
+
+__all__ = ["PlanMeta"]
+
+
+class PlanMeta:
+    def __init__(self, plan, conf: TpuConf, parent: Optional["PlanMeta"]):
+        self.plan = plan
+        self.conf = conf
+        self.parent = parent
+        self.reasons: List[str] = []
+        self.expr_notes: List[str] = []   # per-expression host-fallback notes
+        self.child_metas: List[PlanMeta] = []
+
+    # ------------------------------------------------------------- tagging
+    def will_not_work_on_tpu(self, reason: str):
+        if reason not in self.reasons:
+            self.reasons.append(reason)
+
+    def note_expr_fallback(self, note: str):
+        if note not in self.expr_notes:
+            self.expr_notes.append(note)
+
+    @property
+    def can_run_on_tpu(self) -> bool:
+        return not self.reasons
+
+    def tag(self):
+        if not self.conf.sql_enabled:
+            self.will_not_work_on_tpu(
+                "spark.rapids.tpu.sql.enabled is false")
+        else:
+            self.tag_self()
+        for c in self.child_metas:
+            c.tag()
+
+    def tag_self(self):
+        """Node-specific checks (TypeSig etc.); override."""
+
+    # ------------------------------------------------------------ convert
+    def convert(self) -> TpuExec:
+        children = [c.convert() for c in self.child_metas]
+        if self.can_run_on_tpu:
+            return self.convert_to_tpu(children)
+        return self.convert_to_cpu(children)
+
+    def convert_to_tpu(self, children) -> TpuExec:
+        raise NotImplementedError
+
+    def convert_to_cpu(self, children) -> TpuExec:
+        raise NotImplementedError
+
+    # ------------------------------------------------------------- explain
+    def explain(self, indent: int = 0, only_not_on_tpu: bool = True) -> str:
+        lines = []
+        name = type(self.plan).__name__
+        if self.reasons:
+            lines.append("  " * indent +
+                         f"!Exec <{name}> cannot run on TPU because " +
+                         "; ".join(self.reasons))
+        elif not only_not_on_tpu:
+            lines.append("  " * indent + f"*Exec <{name}> will run on TPU")
+        for note in self.expr_notes:
+            lines.append("  " * (indent + 1) + "!Expression " + note)
+        for c in self.child_metas:
+            sub = c.explain(indent + 1, only_not_on_tpu)
+            if sub:
+                lines.append(sub)
+        return "\n".join(l for l in lines if l)

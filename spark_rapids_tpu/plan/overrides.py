@@ -1,0 +1,306 @@
+"""The override rule registry: logical plan -> tagged meta -> physical exec.
+
+Reference analog: GpuOverrides.scala (object :438) — wrapAndTagPlan (:4480),
+doConvertPlan (:4486), applyOverrides (:4813), and the per-node ExecRule map
+(:4121). Explain-only mode honours spark.rapids.tpu.sql.mode
+(GpuOverrides.scala:4701).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict, Type
+
+from ..config import TpuConf
+from ..exec import basic as B
+from ..exec import aggregate as A
+from ..exec import sort as S
+from ..exec.base import TpuExec
+from . import logical as L
+from .meta import PlanMeta
+
+log = logging.getLogger("spark_rapids_tpu.overrides")
+
+_RULES: Dict[Type, Type[PlanMeta]] = {}
+
+
+def rule(plan_cls):
+    def deco(meta_cls):
+        _RULES[plan_cls] = meta_cls
+        return meta_cls
+    return deco
+
+
+def wrap_plan(plan: L.LogicalPlan, conf: TpuConf,
+              parent=None) -> PlanMeta:
+    meta_cls = _RULES.get(type(plan))
+    if meta_cls is None:
+        meta_cls = _FallbackMeta
+    m = meta_cls(plan, conf, parent)
+    m.child_metas = [wrap_plan(c, conf, m) for c in plan.children]
+    return m
+
+
+def plan_query(plan: L.LogicalPlan, conf: TpuConf) -> TpuExec:
+    """tag -> (explain) -> convert (ref applyOverrides:4813)."""
+    meta = wrap_plan(plan, conf)
+    meta.tag()
+    explain = conf.explain
+    if explain in ("NOT_ON_TPU", "ALL"):
+        out = meta.explain(only_not_on_tpu=(explain == "NOT_ON_TPU"))
+        if out:
+            log.warning("\n%s", out)
+    physical = meta.convert()
+    return physical
+
+
+def explain_potential_tpu_plan(plan: L.LogicalPlan, conf: TpuConf) -> str:
+    """Public ExplainPlan API analog (ref ExplainPlan.scala:28)."""
+    meta = wrap_plan(plan, conf)
+    meta.tag()
+    return meta.explain(only_not_on_tpu=False) or "<entire plan runs on TPU>"
+
+
+class _FallbackMeta(PlanMeta):
+    def tag_self(self):
+        self.will_not_work_on_tpu(
+            f"no TPU rule registered for {type(self.plan).__name__}")
+
+    def convert_to_cpu(self, children):
+        raise NotImplementedError(
+            f"no conversion for {type(self.plan).__name__}")
+
+
+@rule(L.LogicalScan)
+class ScanMeta(PlanMeta):
+    def convert_to_tpu(self, children):
+        return B.InMemoryScanExec(self.plan.tables, self.plan.schema())
+
+    convert_to_cpu = convert_to_tpu  # scan is shared (host decode either way)
+
+
+@rule(L.ParquetScan)
+class ParquetScanMeta(PlanMeta):
+    def convert_to_tpu(self, children):
+        from ..io.parquet import ParquetScanExec
+        return ParquetScanExec(self.plan.paths, self.plan.schema(),
+                               self.plan.columns, self.conf)
+
+    convert_to_cpu = convert_to_tpu
+
+
+@rule(L.Project)
+class ProjectMeta(PlanMeta):
+    def tag_self(self):
+        schema = self.plan.children[0].schema()
+        for e in self.plan.exprs:
+            r = e.fully_device_supported(schema)
+            if r:
+                # per-expression fallback stays inside TpuProjectExec;
+                # recorded for explain parity with the reference
+                self.note_expr_fallback(f"<{e.name_hint}> runs on host: {r}")
+
+    def convert_to_tpu(self, children):
+        return B.TpuProjectExec(self.plan.exprs, children[0])
+
+    def convert_to_cpu(self, children):
+        return B.CpuProjectExec(self.plan.exprs, children[0])
+
+
+@rule(L.Filter)
+class FilterMeta(PlanMeta):
+    def tag_self(self):
+        schema = self.plan.children[0].schema()
+        r = self.plan.condition.fully_device_supported(schema)
+        if r:
+            self.will_not_work_on_tpu(f"filter condition: {r}")
+
+    def convert_to_tpu(self, children):
+        return B.TpuFilterExec(self.plan.condition, children[0])
+
+    def convert_to_cpu(self, children):
+        return B.CpuFilterExec(self.plan.condition, children[0])
+
+
+@rule(L.Aggregate)
+class AggregateMeta(PlanMeta):
+    def tag_self(self):
+        schema = self.plan.children[0].schema()
+        for g in self.plan.groupings:
+            r = g.fully_device_supported(schema)
+            if r:
+                self.will_not_work_on_tpu(f"grouping <{g.name_hint}>: {r}")
+        for a in self.plan.aggs:
+            r = a.device_unsupported_reason(schema)
+            if r:
+                self.will_not_work_on_tpu(f"aggregate <{a.name_hint}>: {r}")
+            if not hasattr(a, "update"):
+                self.will_not_work_on_tpu(
+                    f"aggregate <{a.name_hint}> has no device implementation")
+
+    def convert_to_tpu(self, children):
+        return A.TpuHashAggregateExec(self.plan.groupings, self.plan.aggs,
+                                      children[0])
+
+    def convert_to_cpu(self, children):
+        return A.CpuAggregateExec(self.plan.groupings, self.plan.aggs,
+                                  children[0])
+
+
+@rule(L.Sort)
+class SortMeta(PlanMeta):
+    def tag_self(self):
+        schema = self.plan.children[0].schema()
+        for o in self.plan.orders:
+            r = o.expr.fully_device_supported(schema)
+            if r:
+                self.will_not_work_on_tpu(f"sort key <{o.expr.name_hint}>: {r}")
+        for f in schema.fields:
+            if not f.dtype.device_backed:
+                self.will_not_work_on_tpu(
+                    f"column {f.name}: {f.dtype.name} payload is host-only")
+
+    def convert_to_tpu(self, children):
+        return S.TpuSortExec(self.plan.orders, children[0],
+                             self.plan.global_sort)
+
+    def convert_to_cpu(self, children):
+        return S.CpuSortExec(self.plan.orders, children[0],
+                             self.plan.global_sort)
+
+
+@rule(L.GlobalLimit)
+class LimitMeta(PlanMeta):
+    def convert_to_tpu(self, children):
+        return B.LimitExec(self.plan.n, children[0])
+
+    convert_to_cpu = convert_to_tpu
+
+
+@rule(L.LocalLimit)
+class LocalLimitMeta(LimitMeta):
+    pass
+
+
+@rule(L.Union)
+class UnionMeta(PlanMeta):
+    def convert_to_tpu(self, children):
+        return B.UnionExec(children)
+
+    convert_to_cpu = convert_to_tpu
+
+
+@rule(L.RangeRel)
+class RangeMeta(PlanMeta):
+    def convert_to_tpu(self, children):
+        p = self.plan
+        return B.TpuRangeExec(p.start, p.end, p.step, p.name)
+
+    convert_to_cpu = convert_to_tpu
+
+
+@rule(L.Sample)
+class SampleMeta(PlanMeta):
+    def convert_to_tpu(self, children):
+        return B.TpuSampleExec(self.plan.fraction, self.plan.seed, children[0])
+
+    convert_to_cpu = convert_to_tpu
+
+
+@rule(L.Expand)
+class ExpandMeta(PlanMeta):
+    def tag_self(self):
+        schema = self.plan.children[0].schema()
+        for p in self.plan.projections:
+            for e in p:
+                r = e.fully_device_supported(schema)
+                if r:
+                    self.will_not_work_on_tpu(f"expand <{e.name_hint}>: {r}")
+
+    def convert_to_tpu(self, children):
+        return B.TpuExpandExec(self.plan.projections, self.plan.names,
+                               children[0])
+
+    def convert_to_cpu(self, children):
+        raise NotImplementedError("CPU expand fallback not implemented")
+
+
+@rule(L.Join)
+class JoinMeta(PlanMeta):
+    def tag_self(self):
+        ls = self.plan.children[0].schema()
+        rs = self.plan.children[1].schema()
+        for k in self.plan.left_keys:
+            r = k.fully_device_supported(ls)
+            if r:
+                self.will_not_work_on_tpu(f"left key <{k.name_hint}>: {r}")
+        for k in self.plan.right_keys:
+            r = k.fully_device_supported(rs)
+            if r:
+                self.will_not_work_on_tpu(f"right key <{k.name_hint}>: {r}")
+        if self.plan.join_type == "cross" or not self.plan.left_keys:
+            if self.plan.condition is None and self.plan.join_type != "cross":
+                self.will_not_work_on_tpu("equi-join keys required")
+
+    def convert_to_tpu(self, children):
+        from ..exec.joins import TpuHashJoinExec
+        p = self.plan
+        return TpuHashJoinExec(children[0], children[1], p.join_type,
+                               p.left_keys, p.right_keys, p.condition)
+
+    def convert_to_cpu(self, children):
+        from ..exec.joins import CpuJoinExec
+        p = self.plan
+        return CpuJoinExec(children[0], children[1], p.join_type,
+                           p.left_keys, p.right_keys, p.condition)
+
+
+@rule(L.Repartition)
+class RepartitionMeta(PlanMeta):
+    def tag_self(self):
+        schema = self.plan.children[0].schema()
+        for k in self.plan.keys:
+            r = k.fully_device_supported(schema)
+            if r:
+                self.will_not_work_on_tpu(f"partition key <{k.name_hint}>: {r}")
+
+    def convert_to_tpu(self, children):
+        from ..shuffle.exchange import ShuffleExchangeExec
+        p = self.plan
+        return ShuffleExchangeExec(children[0], p.num_partitions, p.keys,
+                                   p.mode, self.conf)
+
+    def convert_to_cpu(self, children):
+        from ..shuffle.exchange import CpuShuffleExchangeExec
+        p = self.plan
+        return CpuShuffleExchangeExec(children[0], p.num_partitions, p.keys,
+                                      p.mode)
+
+
+@rule(L.WriteFile)
+class WriteMeta(PlanMeta):
+    def convert_to_tpu(self, children):
+        from ..io.writers import FileWriteExec
+        p = self.plan
+        return FileWriteExec(children[0], p.path, p.file_format, p.mode,
+                             p.partition_by)
+
+    convert_to_cpu = convert_to_tpu
+
+
+@rule(L.Window)
+class WindowMeta(PlanMeta):
+    def tag_self(self):
+        schema = self.plan.children[0].schema()
+        for e, spec, name in self.plan.window_exprs:
+            for pk in spec.partition_by:
+                r = pk.fully_device_supported(schema)
+                if r:
+                    self.will_not_work_on_tpu(f"window partition key: {r}")
+
+    def convert_to_tpu(self, children):
+        from ..exec.window import TpuWindowExec
+        return TpuWindowExec(self.plan.window_exprs, children[0])
+
+    def convert_to_cpu(self, children):
+        from ..exec.window import CpuWindowExec
+        return CpuWindowExec(self.plan.window_exprs, children[0])

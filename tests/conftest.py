@@ -1,0 +1,22 @@
+"""Test config: run on the host CPU backend with 8 virtual devices so
+multi-chip sharding tests work without TPU hardware (the driver separately
+dry-runs the multi-chip path; bench.py uses the real chip)."""
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax
+
+# the axon TPU platform cannot be deprioritized via JAX_PLATFORMS; pin the
+# default device to host CPU instead (arrays then stay on CPU end-to-end)
+jax.config.update("jax_default_device", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clear_oom_injections():
+    yield
+    from spark_rapids_tpu.mem import MemoryManager
+    for mm in MemoryManager._instances.values():
+        mm.clear_injections()

@@ -1,0 +1,117 @@
+"""Seeded random data generators (ref integration_tests data_gen.py:
+composable generators with fixed seeds and special-value injection)."""
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+
+
+class Gen:
+    def __init__(self, nullable=True, special=()):
+        self.nullable = nullable
+        self.special = list(special)
+
+    def generate(self, rng: np.random.RandomState, n: int):
+        vals = self._gen(rng, n)
+        out = pd.array(vals)
+        if self.special:
+            k = max(1, n // 20)
+            idx = rng.choice(n, size=min(k * len(self.special), n),
+                             replace=False)
+            for j, i in enumerate(idx):
+                vals[i] = self.special[j % len(self.special)]
+        mask = None
+        if self.nullable:
+            mask = rng.random_sample(n) < 0.1
+        return vals, mask
+
+    def to_arrow(self, rng, n):
+        vals, mask = self.generate(rng, n)
+        return pa.array(vals, mask=mask)
+
+
+class IntGen(Gen):
+    def __init__(self, lo=-(2**31), hi=2**31 - 1, dtype=np.int32, **kw):
+        super().__init__(**kw)
+        self.lo, self.hi, self.dtype = lo, hi, dtype
+
+    def _gen(self, rng, n):
+        return rng.randint(self.lo, self.hi, size=n).astype(self.dtype)
+
+
+class LongGen(IntGen):
+    def __init__(self, **kw):
+        super().__init__(-(2**63), 2**63 - 1, np.int64, **kw)
+
+    def _gen(self, rng, n):
+        return rng.randint(-(2**62), 2**62, size=n).astype(np.int64)
+
+
+class ByteGen(IntGen):
+    def __init__(self, **kw):
+        super().__init__(-128, 127, np.int8, **kw)
+
+
+class ShortGen(IntGen):
+    def __init__(self, **kw):
+        super().__init__(-(2**15), 2**15 - 1, np.int16, **kw)
+
+
+class DoubleGen(Gen):
+    def __init__(self, with_special=True, **kw):
+        special = [0.0, -0.0, float("inf"), float("-inf"), float("nan")] \
+            if with_special else []
+        super().__init__(special=special, **kw)
+
+    def _gen(self, rng, n):
+        return (rng.standard_normal(n) * 1e6).astype(np.float64)
+
+
+class FloatGen(DoubleGen):
+    def _gen(self, rng, n):
+        return (rng.standard_normal(n) * 1e3).astype(np.float32)
+
+
+class BoolGen(Gen):
+    def _gen(self, rng, n):
+        return rng.randint(0, 2, size=n).astype(bool)
+
+
+class StringGen(Gen):
+    def __init__(self, alphabet="abc XYZ012é中", max_len=12, **kw):
+        super().__init__(**kw)
+        self.alphabet = alphabet
+        self.max_len = max_len
+
+    def _gen(self, rng, n):
+        letters = list(self.alphabet)
+        return np.array(["".join(rng.choice(letters,
+                                            size=rng.randint(0, self.max_len)))
+                         for _ in range(n)], dtype=object)
+
+
+class DateGen(Gen):
+    def _gen(self, rng, n):
+        days = rng.randint(-25000, 25000, size=n)
+        return np.array([np.datetime64("1970-01-01") + d for d in days],
+                        dtype="datetime64[D]")
+
+
+class TimestampGen(Gen):
+    def _gen(self, rng, n):
+        us = rng.randint(-(2**52), 2**52, size=n)
+        return us.astype("datetime64[us]")
+
+
+def gen_df(gens: dict, n: int = 2048, seed: int = 0) -> pd.DataFrame:
+    rng = np.random.RandomState(seed)
+    arrays = {}
+    for name, g in gens.items():
+        arrays[name] = g.to_arrow(rng, n)
+    return pa.table(arrays).to_pandas()
+
+
+# canonical small-column mixes (ref data_gen.py numeric_gens etc.)
+numeric_gens = {"b": ByteGen(), "s": ShortGen(), "i": IntGen(),
+                "l": LongGen(), "f": FloatGen(), "d": DoubleGen()}

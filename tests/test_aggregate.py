@@ -1,0 +1,133 @@
+"""Differential tests for hash aggregation
+(ref hash_aggregate_test.py)."""
+import pytest
+
+from harness import assert_tpu_and_cpu_equal, assert_all_on_tpu
+from data_gen import BoolGen, DoubleGen, IntGen, LongGen, gen_df
+from spark_rapids_tpu.api import functions as F
+
+
+def _kv(s, key_gen=None, n=4096, seed=0):
+    kg = key_gen or IntGen(lo=0, hi=50)
+    return s.create_dataframe(gen_df({"k": kg, "k2": IntGen(lo=0, hi=4),
+                                      "v": DoubleGen(with_special=False),
+                                      "i": IntGen(lo=-1000, hi=1000)},
+                                     n=n, seed=seed))
+
+
+def test_global_agg():
+    def q(s):
+        return _kv(s).agg(F.sum(F.col("i")).with_name("s"),
+                          F.count(F.col("i")).with_name("c"),
+                          F.count_star().with_name("n"),
+                          F.min(F.col("i")).with_name("mn"),
+                          F.max(F.col("i")).with_name("mx"))
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_global_agg_empty_input():
+    def q(s):
+        df = _kv(s)
+        return df.filter(F.col("i") > 10**9).agg(
+            F.sum(F.col("i")).with_name("s"),
+            F.count_star().with_name("n"))
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_grouped_sum_count():
+    def q(s):
+        return (_kv(s).group_by("k")
+                .agg(F.sum(F.col("i")).with_name("s"),
+                     F.count(F.col("v")).with_name("c"),
+                     F.count_star().with_name("n")))
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_grouped_min_max_avg():
+    def q(s):
+        return (_kv(s).group_by("k")
+                .agg(F.min(F.col("i")).with_name("mn"),
+                     F.max(F.col("i")).with_name("mx"),
+                     F.avg(F.col("v")).with_name("a")))
+    assert_tpu_and_cpu_equal(q, approximate_float=True)
+
+
+def test_multi_key_grouping():
+    def q(s):
+        return (_kv(s).group_by("k", "k2")
+                .agg(F.sum(F.col("i")).with_name("s")))
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_group_by_expression():
+    def q(s):
+        return (_kv(s).group_by((F.col("k") % 7).alias("m"))
+                .agg(F.sum(F.col("i")).with_name("s")))
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_null_keys_form_group():
+    def q(s):
+        return (_kv(s, key_gen=IntGen(lo=0, hi=3, nullable=True))
+                .group_by("k").agg(F.count_star().with_name("n")))
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_sum_all_null_group_is_null():
+    def q(s):
+        df = _kv(s)
+        return (df.with_column("nv", F.lit(None).cast("int"))
+                .group_by("k2").agg(F.sum(F.col("nv")).with_name("s"),
+                                    F.count(F.col("nv")).with_name("c")))
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_distinct():
+    def q(s):
+        return _kv(s).select("k2").distinct()
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_first_last():
+    # first/last over non-null column with per-group deterministic values
+    def q(s):
+        df = _kv(s)
+        return (df.with_column("kv", F.col("k2") * 10)
+                  .group_by("k2")
+                  .agg(F.first(F.col("kv")).with_name("f"),
+                       F.last(F.col("kv")).with_name("l")))
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_stddev_variance():
+    def q(s):
+        return (_kv(s).group_by("k2")
+                .agg(F.stddev(F.col("v")).with_name("sd"),
+                     F.stddev_pop(F.col("v")).with_name("sdp"),
+                     F.var_samp(F.col("v")).with_name("vs"),
+                     F.var_pop(F.col("v")).with_name("vp")))
+    assert_tpu_and_cpu_equal(q, approximate_float=True)
+
+
+def test_agg_multiple_batches():
+    def q(s):
+        df = s.create_dataframe(
+            gen_df({"k": IntGen(lo=0, hi=20), "v": IntGen()}, n=8192),
+            num_partitions=4)
+        return df.group_by("k").agg(F.sum(F.col("v")).with_name("s"),
+                                    F.count_star().with_name("n"))
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_agg_on_tpu_plan():
+    def q(s):
+        return _kv(s).group_by("k").agg(F.sum(F.col("i")).with_name("s"))
+    assert_all_on_tpu(q)
+
+
+def test_count_is_never_null():
+    def q(s):
+        df = _kv(s)
+        return (df.filter(F.col("k") < 5).group_by("k")
+                .agg(F.count(F.col("v")).with_name("c")))
+    assert_tpu_and_cpu_equal(q)

@@ -1,0 +1,76 @@
+"""Differential tests for arithmetic expressions
+(ref integration_tests arithmetic_ops_test.py)."""
+import pytest
+
+from harness import assert_tpu_and_cpu_equal, assert_all_on_tpu
+from data_gen import (ByteGen, DoubleGen, FloatGen, IntGen, LongGen, gen_df,
+                      numeric_gens)
+from spark_rapids_tpu.api import functions as F
+
+
+def _two_col_df(session, gen, seed=0, n=2048):
+    df = gen_df({"a": gen, "b": gen}, n=n, seed=seed)
+    return session.create_dataframe(df)
+
+
+@pytest.mark.parametrize("gen", [IntGen(), LongGen(), ByteGen(),
+                                 DoubleGen(with_special=False)],
+                         ids=["int", "long", "byte", "double"])
+@pytest.mark.parametrize("op", ["add", "sub", "mul"])
+def test_binary_arith(gen, op):
+    def q(s):
+        df = _two_col_df(s, gen)
+        c = {"add": F.col("a") + F.col("b"),
+             "sub": F.col("a") - F.col("b"),
+             "mul": F.col("a") * F.col("b")}[op]
+        return df.select(c.alias("r"))
+    assert_tpu_and_cpu_equal(q)
+
+
+@pytest.mark.parametrize("gen", [IntGen(), DoubleGen()],
+                         ids=["int", "double"])
+def test_division_null_on_zero(gen):
+    def q(s):
+        df = _two_col_df(s, gen)
+        return df.select((F.col("a") / F.col("b")).alias("div"),
+                         (F.col("a") / F.lit(0)).alias("div0"))
+    assert_tpu_and_cpu_equal(q, approximate_float=True)
+
+
+def test_remainder_sign_semantics():
+    def q(s):
+        df = _two_col_df(s, IntGen(lo=-100, hi=100))
+        return df.select((F.col("a") % F.col("b")).alias("mod"))
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_unary_minus_abs():
+    def q(s):
+        df = _two_col_df(s, IntGen())
+        return df.select((-F.col("a")).alias("neg"),
+                         F.abs(F.col("b")).alias("abs"))
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_mixed_type_promotion():
+    def q(s):
+        df = s.create_dataframe(gen_df({"i": IntGen(), "l": LongGen(),
+                                        "d": DoubleGen(with_special=False)}))
+        return df.select((F.col("i") + F.col("l")).alias("il"),
+                         (F.col("i") * F.col("d")).alias("id"),
+                         (F.col("l") - F.lit(3)).alias("l3"))
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_arith_all_on_tpu():
+    def q(s):
+        df = _two_col_df(s, IntGen())
+        return df.select((F.col("a") + F.col("b")).alias("r"))
+    assert_all_on_tpu(q)
+
+
+def test_literal_null():
+    def q(s):
+        df = _two_col_df(s, IntGen())
+        return df.select((F.col("a") + F.lit(None).cast("int")).alias("r"))
+    assert_tpu_and_cpu_equal(q)

@@ -1,0 +1,58 @@
+"""Differential tests for predicates/comparisons (ref cmp_test.py)."""
+import pytest
+
+from harness import assert_tpu_and_cpu_equal
+from data_gen import BoolGen, DoubleGen, IntGen, LongGen, gen_df
+from spark_rapids_tpu.api import functions as F
+
+
+@pytest.mark.parametrize("gen", [IntGen(), DoubleGen()], ids=["int", "double"])
+def test_comparisons(gen):
+    def q(s):
+        df = s.create_dataframe(gen_df({"a": gen, "b": gen}))
+        a, b = F.col("a"), F.col("b")
+        return df.select((a == b).alias("eq"), (a != b).alias("ne"),
+                         (a < b).alias("lt"), (a <= b).alias("le"),
+                         (a > b).alias("gt"), (a >= b).alias("ge"),
+                         a.eqNullSafe(b).alias("ens"))
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_null_checks():
+    def q(s):
+        df = s.create_dataframe(gen_df({"a": IntGen(), "d": DoubleGen()}))
+        return df.select(F.col("a").isNull().alias("n"),
+                         F.col("a").isNotNull().alias("nn"),
+                         F.isnan(F.col("d")).alias("nan"))
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_kleene_logic():
+    def q(s):
+        df = s.create_dataframe(gen_df({"x": BoolGen(), "y": BoolGen()}))
+        return df.select((F.col("x") & F.col("y")).alias("and"),
+                         (F.col("x") | F.col("y")).alias("or"),
+                         (~F.col("x")).alias("not"))
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_isin():
+    def q(s):
+        df = s.create_dataframe(gen_df({"a": IntGen(lo=0, hi=10)}))
+        return df.select(F.col("a").isin(1, 3, 5).alias("r"))
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_filter_compaction():
+    def q(s):
+        df = s.create_dataframe(gen_df({"a": IntGen(), "b": DoubleGen()}))
+        return df.filter((F.col("a") > 0) & F.col("b").isNotNull())
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_filter_null_predicate_drops():
+    # NULL predicate rows must be dropped, not kept
+    def q(s):
+        df = s.create_dataframe(gen_df({"a": IntGen()}))
+        return df.filter(F.col("a") > F.lit(None).cast("int"))
+    assert_tpu_and_cpu_equal(q)

@@ -1,0 +1,83 @@
+"""Differential tests for conditionals + casts (ref conditionals_test.py,
+cast_test.py)."""
+import pytest
+
+from harness import assert_tpu_and_cpu_equal
+from data_gen import DoubleGen, IntGen, LongGen, gen_df
+from spark_rapids_tpu.api import functions as F
+
+
+def test_if_else():
+    def q(s):
+        df = s.create_dataframe(gen_df({"a": IntGen(), "b": IntGen()}))
+        return df.select(
+            F.when(F.col("a") > F.col("b"), F.col("a"))
+             .otherwise(F.col("b")).alias("max_ab"))
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_case_when_multi_branch():
+    def q(s):
+        df = s.create_dataframe(gen_df({"a": IntGen(lo=-50, hi=50)}))
+        return df.select(
+            F.when(F.col("a") < -10, -1)
+             .when(F.col("a") > 10, 1)
+             .otherwise(0).alias("bucket"),
+            F.when(F.col("a") > 0, "pos").col.alias("no_else"))
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_coalesce():
+    def q(s):
+        df = s.create_dataframe(gen_df({"a": IntGen(), "b": IntGen(),
+                                        "c": IntGen()}))
+        return df.select(F.coalesce(F.col("a"), F.col("b"),
+                                    F.col("c"), F.lit(-1)).alias("r"))
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_nanvl():
+    import spark_rapids_tpu.exprs as E
+
+    def q(s):
+        df = s.create_dataframe(gen_df({"a": DoubleGen(), "b": DoubleGen()}))
+        from spark_rapids_tpu.api.functions import Col
+        return df.select(Col(E.NaNvl(E.ColumnRef("a"),
+                                     E.ColumnRef("b"))).alias("r"))
+    assert_tpu_and_cpu_equal(q)
+
+
+@pytest.mark.parametrize("src,dst", [
+    ("i", "bigint"), ("i", "double"), ("i", "smallint"), ("l", "int"),
+    ("d", "int"), ("d", "float"), ("i", "boolean"),
+], ids=lambda x: str(x))
+def test_numeric_casts(src, dst):
+    def q(s):
+        df = s.create_dataframe(gen_df({
+            "i": IntGen(), "l": LongGen(),
+            "d": DoubleGen(with_special=False)}))
+        return df.select(F.col(src).cast(dst).alias("r"))
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_cast_float_special_to_int():
+    # NaN -> 0, +/-inf clamps (Java semantics)
+    def q(s):
+        df = s.create_dataframe(gen_df({"d": DoubleGen()}))
+        return df.select(F.col("d").cast("int").alias("i"),
+                         F.col("d").cast("bigint").alias("l"))
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_math_functions():
+    def q(s):
+        df = s.create_dataframe(gen_df({"d": DoubleGen(with_special=False),
+                                        "i": IntGen(lo=0, hi=1000)}))
+        return df.select(F.sqrt(F.abs(F.col("d"))).alias("sqrt"),
+                         F.floor(F.col("d")).alias("floor"),
+                         F.ceil(F.col("d")).alias("ceil"),
+                         F.round(F.col("d"), 2).alias("round"),
+                         F.exp(F.col("i") % 10).alias("exp"),
+                         F.log(F.col("i") + 1).alias("log"),
+                         F.pow(F.col("d"), 2).alias("pow"))
+    assert_tpu_and_cpu_equal(q, approximate_float=True)

@@ -1,0 +1,61 @@
+"""Differential tests for sort (ref sort_test.py). Spark ordering semantics:
+NaN greatest, nulls first/last per order, -0.0 == 0.0."""
+import pytest
+
+from harness import assert_tpu_and_cpu_equal
+from data_gen import BoolGen, DoubleGen, IntGen, LongGen, gen_df
+from spark_rapids_tpu.api import functions as F
+
+
+@pytest.mark.parametrize("gen", [IntGen(lo=-100, hi=100), LongGen(),
+                                 DoubleGen(with_special=False)],
+                         ids=["int", "long", "double"])
+@pytest.mark.parametrize("asc", [True, False], ids=["asc", "desc"])
+def test_single_key_sort(gen, asc):
+    def q(s):
+        df = s.create_dataframe(gen_df({"a": gen, "b": IntGen()}))
+        o = F.col("a").asc() if asc else F.col("a").desc()
+        return df.order_by(o, F.col("b").asc())
+    assert_tpu_and_cpu_equal(q, ignore_order=False)
+
+
+def test_multi_key_mixed_direction():
+    def q(s):
+        df = s.create_dataframe(gen_df({"a": IntGen(lo=0, hi=10),
+                                        "b": IntGen(lo=0, hi=10),
+                                        "c": IntGen()}))
+        return df.order_by(F.col("a").asc(), F.col("b").desc(),
+                           F.col("c").asc())
+    assert_tpu_and_cpu_equal(q, ignore_order=False)
+
+
+@pytest.mark.parametrize("asc,nulls_first", [(True, True), (True, False),
+                                             (False, True), (False, False)])
+def test_null_ordering(asc, nulls_first):
+    def q(s):
+        df = s.create_dataframe(gen_df({"a": IntGen(lo=0, hi=20),
+                                        "b": IntGen()}))
+        o = (F.col("a").asc(nulls_first) if asc
+             else F.col("a").desc(nulls_first))
+        return df.order_by(o, F.col("b").asc())
+    assert_tpu_and_cpu_equal(q, ignore_order=False)
+
+
+def test_sort_stability_via_tiebreak():
+    def q(s):
+        df = s.create_dataframe(gen_df({"a": IntGen(lo=0, hi=3),
+                                        "b": IntGen()}))
+        return df.order_by(F.col("a").asc(), F.col("b").asc())
+    assert_tpu_and_cpu_equal(q, ignore_order=False)
+
+
+def test_sort_int_min_desc():
+    import pandas as pd
+    import numpy as np
+
+    def q(s):
+        df = s.create_dataframe(pd.DataFrame(
+            {"a": np.array([np.iinfo(np.int64).min, -1, 0, 5,
+                            np.iinfo(np.int64).max], dtype=np.int64)}))
+        return df.order_by(F.col("a").desc())
+    assert_tpu_and_cpu_equal(q, ignore_order=False)
