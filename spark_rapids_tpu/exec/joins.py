@@ -1,0 +1,370 @@
+"""Equi-join execs (ref GpuHashJoin.scala:1033, JoinGatherer.scala,
+GpuShuffledHashJoinExec, GpuBroadcastNestedLoopJoinExecBase).
+
+TPU-first design: cudf's hash join has no XLA analog, so the join is a
+SORT-based group-match, all static shapes:
+
+  phase A (count kernel): concatenate both sides' encoded keys, one
+    lax.sort, segment boundaries -> per-group counts/starts for each side,
+    per-group output pair counts, total output size.
+  host sync: total -> output shape bucket (the reference similarly sizes
+    gather maps before gathering).
+  phase B (gather kernel, static output): for each output slot, locate its
+    group via searchsorted over the pair-count prefix sums, derive
+    (left_row, right_row) indices arithmetically, gather columns; -1 index
+    = null-extended row (outer joins).
+
+Join semantics: null keys never match (each null-key row forms a singleton
+group); NaN keys match NaN (canonicalized — ref NormalizeFloatingNumbers);
+left/right/full use countX' = max(countX, 1) so null-extension falls out of
+the same index maths. Residual (non-equi) conditions are applied as a
+post-filter for inner/cross and tagged fallback otherwise.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import ColumnarBatch, DeviceColumn, concat_batches
+from ..columnar.bucketing import bucket_for
+from ..exprs.base import DVal, EvalContext, Expression
+from ..exprs.compiler import filter_batch_device, gather_batch_device
+from ..mem import SpillableBatch, with_retry_no_split
+from ..types import Schema, StructField
+from .base import ESSENTIAL, ExecContext, TpuExec
+from .encoding import grouping_operands, operands_equal
+
+__all__ = ["TpuHashJoinExec", "CpuJoinExec"]
+
+_COUNT_CACHE: Dict[Tuple, object] = {}
+_GATHER_CACHE: Dict[Tuple, object] = {}
+
+
+def _build_count_kernel(lkey_exprs, rkey_exprs, lschema, rschema, join_type):
+    ldtypes = [f.dtype for f in lschema.fields]
+    rdtypes = [f.dtype for f in rschema.fields]
+
+    @functools.partial(jax.jit, static_argnums=(4, 5))
+    def kernel(lcols, rcols, n_l, n_r, p_l, p_r):
+        ldv = [None if c is None else DVal(c[0], c[1], dt)
+               for c, dt in zip(lcols, ldtypes)]
+        rdv = [None if c is None else DVal(c[0], c[1], dt)
+               for c, dt in zip(rcols, rdtypes)]
+        lctx = EvalContext(lschema, ldv, n_l, p_l)
+        rctx = EvalContext(rschema, rdv, n_r, p_r)
+        lkeys = [e.eval_device(lctx) for e in lkey_exprs]
+        rkeys = [e.eval_device(rctx) for e in rkey_exprs]
+        P = p_l + p_r
+        lmask = lctx.row_mask()
+        rmask = rctx.row_mask()
+        real = jnp.concatenate([lmask, rmask])
+        pad = jnp.where(real, jnp.uint8(0), jnp.uint8(1))
+        operands = [pad]
+        null_key = jnp.zeros(P, dtype=jnp.bool_)
+        for lk, rk in zip(lkeys, rkeys):
+            # promote both sides to a common dtype before encoding
+            wide = jnp.promote_types(lk.data.dtype, rk.data.dtype)
+            both = DVal(jnp.concatenate([lk.data.astype(wide),
+                                         rk.data.astype(wide)]),
+                        jnp.concatenate([lk.validity, rk.validity]),
+                        lk.dtype)
+            operands.extend(grouping_operands(both))
+            null_key = jnp.logical_or(null_key,
+                                      jnp.logical_not(both.validity))
+        null_key = jnp.logical_and(null_key, real)
+        side = jnp.concatenate([jnp.zeros(p_l, jnp.uint8),
+                                jnp.ones(p_r, jnp.uint8)])
+        orig = jnp.concatenate([jnp.arange(p_l, dtype=jnp.int32),
+                                jnp.arange(p_r, dtype=jnp.int32)])
+        n_ops = len(operands) + 1  # + side (L rows first within a group)
+        sorted_all = jax.lax.sort(
+            tuple(operands + [side] + [orig, null_key.astype(jnp.uint8)]),
+            num_keys=n_ops, is_stable=True)
+        s_ops = sorted_all[:len(operands)]
+        s_side = sorted_all[len(operands)]
+        s_orig = sorted_all[n_ops]
+        s_nullk = sorted_all[n_ops + 1].astype(jnp.bool_)
+        idx = jnp.arange(P)
+        n_total = n_l + n_r
+        s_real = idx < n_total
+        differs = jnp.zeros(P, dtype=jnp.bool_)
+        for op in s_ops[1:]:
+            prev = jnp.roll(op, 1)
+            differs = jnp.logical_or(
+                differs, jnp.logical_not(operands_equal(op, prev)))
+        # null-key rows are singleton groups: boundary at them and after them
+        flags = jnp.logical_or(idx == 0, differs)
+        flags = jnp.logical_or(flags, s_nullk)
+        flags = jnp.logical_or(flags, jnp.roll(s_nullk, 1) & (idx != 0))
+        flags = jnp.logical_and(flags, s_real)
+        gid = jnp.where(s_real, (jnp.cumsum(flags) - 1).astype(jnp.int32), P)
+        num_groups = jnp.sum(flags).astype(jnp.int32)
+        is_l = jnp.logical_and(s_side == 0, s_real)
+        is_r = jnp.logical_and(s_side == 1, s_real)
+        cnt_l = jax.ops.segment_sum(is_l.astype(jnp.int64), gid,
+                                    num_segments=P)
+        cnt_r = jax.ops.segment_sum(is_r.astype(jnp.int64), gid,
+                                    num_segments=P)
+        big = jnp.array(np.iinfo(np.int32).max, jnp.int32)
+        start_l = jax.ops.segment_min(jnp.where(is_l, idx.astype(jnp.int32),
+                                                big), gid, num_segments=P)
+        start_r = jax.ops.segment_min(jnp.where(is_r, idx.astype(jnp.int32),
+                                                big), gid, num_segments=P)
+        # per-group output pair counts by join type
+        cl1 = jnp.maximum(cnt_l, 1)
+        cr1 = jnp.maximum(cnt_r, 1)
+        if join_type == "inner":
+            pairs = cnt_l * cnt_r
+        elif join_type == "left":
+            pairs = cnt_l * cr1
+        elif join_type == "right":
+            pairs = cl1 * cnt_r
+        elif join_type == "full":
+            pairs = cl1 * cr1
+            # group with neither side is impossible
+        elif join_type == "leftsemi":
+            pairs = jnp.where(cnt_r > 0, cnt_l, 0)
+        elif join_type == "leftanti":
+            pairs = jnp.where(cnt_r == 0, cnt_l, 0)
+        else:
+            raise ValueError(join_type)
+        glive = jnp.arange(P, dtype=jnp.int32) < num_groups
+        pairs = jnp.where(glive, pairs, 0)
+        offsets = jnp.cumsum(pairs)  # inclusive
+        total = offsets[-1]
+        return (s_orig, cnt_l, cnt_r, start_l, start_r, pairs, offsets,
+                total, num_groups)
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnums=(7,))
+def _gather_index_kernel(s_orig, cnt_l, cnt_r, start_l, start_r, offsets,
+                         join_cfg, out_p):
+    """out slot k -> (left row index or -1, right row index or -1).
+    join_cfg: (left_nullable, right_nullable, semi_like) as traced bools are
+    static via closure — passed as int32 flags array instead."""
+    left_nullable, right_nullable, semi_like = (join_cfg[0], join_cfg[1],
+                                                join_cfg[2])
+    k = jnp.arange(out_p, dtype=jnp.int64)
+    g = jnp.searchsorted(offsets, k, side="right").astype(jnp.int32)
+    gc = jnp.clip(g, 0, offsets.shape[0] - 1)
+    base = jnp.where(gc > 0, jnp.take(offsets, jnp.maximum(gc - 1, 0),
+                                      mode="clip"), 0)
+    r = k - base  # position within the group's pair block
+    cl = jnp.take(cnt_l, gc, mode="clip")
+    cr = jnp.take(cnt_r, gc, mode="clip")
+    cr1 = jnp.maximum(cr, 1)
+    # semi/anti emit each left row once regardless of right multiplicity
+    cr1 = jnp.where(semi_like != 0, jnp.ones_like(cr1), cr1)
+    li = r // cr1
+    ri = r % cr1
+    sl = jnp.take(start_l, gc, mode="clip")
+    sr = jnp.take(start_r, gc, mode="clip")
+    lpos = jnp.where(jnp.logical_and(left_nullable != 0, cl == 0),
+                     -1, sl + li.astype(jnp.int32))
+    rpos = jnp.where(jnp.logical_and(right_nullable != 0, cr == 0),
+                     -1, sr + ri.astype(jnp.int32))
+    l_row = jnp.where(lpos >= 0, jnp.take(s_orig, jnp.maximum(lpos, 0),
+                                          mode="clip"), -1)
+    r_row = jnp.where(rpos >= 0, jnp.take(s_orig, jnp.maximum(rpos, 0),
+                                          mode="clip"), -1)
+    return l_row.astype(jnp.int32), r_row.astype(jnp.int32)
+
+
+class TpuHashJoinExec(TpuExec):
+    def __init__(self, left: TpuExec, right: TpuExec, join_type: str,
+                 left_keys: Sequence[Expression],
+                 right_keys: Sequence[Expression],
+                 condition: Optional[Expression] = None):
+        super().__init__([left, right])
+        self.join_type = join_type
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        self.condition = condition
+        ls, rs = left.output_schema(), right.output_schema()
+        if join_type in ("leftsemi", "leftanti"):
+            self._schema = ls
+        else:
+            self._schema = Schema(list(ls.fields) + list(rs.fields))
+        if condition is not None and join_type not in ("inner", "cross"):
+            raise NotImplementedError(
+                "residual conditions only on inner/cross joins for now")
+
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        rows_m = ctx.metric(self._exec_id, "numOutputRows", ESSENTIAL)
+        # build side: coalesce right entirely; stream left batches
+        # (ref GpuShuffledHashJoinExec build-side semantics)
+        right_batches = [SpillableBatch(b, ctx.memory)
+                         for b in self.children[1].execute(ctx)]
+        left_batches = [SpillableBatch(b, ctx.memory)
+                        for b in self.children[0].execute(ctx)]
+
+        def run():
+            with ctx.semaphore.held():
+                ls, rs = (self.children[0].output_schema(),
+                          self.children[1].output_schema())
+                lb = concat_batches([s.get() for s in left_batches]) \
+                    if left_batches else _empty_batch(ls)
+                rb = concat_batches([s.get() for s in right_batches]) \
+                    if right_batches else _empty_batch(rs)
+                return self._join(lb, rb)
+
+        out = with_retry_no_split(run, ctx.memory)
+        for s in right_batches + left_batches:
+            s.close()
+        rows_m.add(out.num_rows)
+        yield out
+
+    # ------------------------------------------------------------------
+    def _join(self, lb: ColumnarBatch, rb: ColumnarBatch) -> ColumnarBatch:
+        if self.join_type == "cross" or not self.left_keys:
+            return self._cross(lb, rb)
+        ls, rs = lb.schema, rb.schema
+        ck = (tuple(e.key() for e in self.left_keys),
+              tuple(e.key() for e in self.right_keys),
+              tuple((f.name, f.dtype.name) for f in ls.fields),
+              tuple((f.name, f.dtype.name) for f in rs.fields),
+              self.join_type)
+        kern = _COUNT_CACHE.get(ck)
+        if kern is None:
+            kern = _build_count_kernel(self.left_keys, self.right_keys,
+                                       ls, rs, self.join_type)
+            _COUNT_CACHE[ck] = kern
+        lcols = [(c.data, c.validity) for c in lb.columns]
+        rcols = [(c.data, c.validity) for c in rb.columns]
+        (s_orig, cnt_l, cnt_r, start_l, start_r, pairs, offsets, total,
+         num_groups) = kern(lcols, rcols, jnp.int32(lb.num_rows),
+                            jnp.int32(rb.num_rows), lb.padded_len,
+                            rb.padded_len)
+        n_out = int(total)
+        out_p = bucket_for(max(n_out, 1))
+        semi_like = self.join_type in ("leftsemi", "leftanti")
+        left_nullable = 1 if self.join_type in ("right", "full") else 0
+        right_nullable = 1 if self.join_type in ("left", "full") else 0
+        cfg = jnp.array([left_nullable, right_nullable,
+                         1 if semi_like else 0], dtype=jnp.int32)
+        l_row, r_row = _gather_index_kernel(
+            s_orig, cnt_l, cnt_r, start_l, start_r, offsets, cfg, out_p)
+        live = np.arange(out_p) < n_out
+        l_row = jnp.where(jnp.asarray(live), l_row, -1)
+        r_row = jnp.where(jnp.asarray(live), r_row, -1)
+        lo = gather_batch_device(lb, l_row, n_out, out_p)
+        if semi_like:
+            return ColumnarBatch(lo.columns, n_out, self._schema)
+        ro = gather_batch_device(rb, r_row, n_out, out_p)
+        out = ColumnarBatch(lo.columns + ro.columns, n_out, self._schema)
+        if self.condition is not None:
+            out = filter_batch_device(self.condition, out)
+        return out
+
+    def _cross(self, lb: ColumnarBatch, rb: ColumnarBatch) -> ColumnarBatch:
+        n_out = lb.num_rows * rb.num_rows
+        out_p = bucket_for(max(n_out, 1))
+        k = jnp.arange(out_p, dtype=jnp.int64)
+        li = (k // max(rb.num_rows, 1)).astype(jnp.int32)
+        ri = (k % max(rb.num_rows, 1)).astype(jnp.int32)
+        live = jnp.asarray(np.arange(out_p) < n_out)
+        li = jnp.where(live, li, -1)
+        ri = jnp.where(live, ri, -1)
+        lo = gather_batch_device(lb, li, n_out, out_p)
+        ro = gather_batch_device(rb, ri, n_out, out_p)
+        out = ColumnarBatch(lo.columns + ro.columns, n_out, self._schema)
+        if self.condition is not None:
+            out = filter_batch_device(self.condition, out)
+        return out
+
+    def describe(self):
+        k = ", ".join(f"{a.name_hint}={b.name_hint}"
+                      for a, b in zip(self.left_keys, self.right_keys))
+        c = f", cond={self.condition.name_hint}" if self.condition else ""
+        return f"HashJoin[{self.join_type}, keys=({k}){c}]"
+
+
+def _empty_batch(schema: Schema) -> ColumnarBatch:
+    import pyarrow as pa
+    from ..types import to_arrow
+    t = pa.table({f.name: pa.array([], type=to_arrow(f.dtype))
+                  for f in schema.fields})
+    return ColumnarBatch.from_arrow(t)
+
+
+class CpuJoinExec(TpuExec):
+    """Host fallback / oracle via Arrow's join (SQL null semantics match)."""
+    is_tpu = False
+
+    def __init__(self, left, right, join_type, left_keys, right_keys,
+                 condition=None):
+        super().__init__([left, right])
+        self.join_type = join_type
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        self.condition = condition
+        ls, rs = left.output_schema(), right.output_schema()
+        if join_type in ("leftsemi", "leftanti"):
+            self._schema = ls
+        else:
+            self._schema = Schema(list(ls.fields) + list(rs.fields))
+
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        import pyarrow as pa
+        lt = self.children[0].collect(ctx)
+        rt = self.children[1].collect(ctx)
+        if self.join_type == "cross" or not self.left_keys:
+            out = self._cross_host(lt, rt)
+        else:
+            lb = ColumnarBatch.from_arrow(lt, pad=False)
+            rb = ColumnarBatch.from_arrow(rt, pad=False)
+            lkn, rkn = [], []
+            for i, (lk, rk) in enumerate(zip(self.left_keys,
+                                             self.right_keys)):
+                lt = lt.append_column(f"__jk{i}", lk.eval_host(lb))
+                rt = rt.append_column(f"__jk{i}", rk.eval_host(rb))
+                lkn.append(f"__jk{i}")
+                rkn.append(f"__jk{i}")
+            jt = {"inner": "inner", "left": "left outer",
+                  "right": "right outer", "full": "full outer",
+                  "leftsemi": "left semi", "leftanti": "left anti"}[
+                      self.join_type]
+            # suffix every right column to avoid collisions (restored after);
+            # coalesce_keys=False keeps Spark semantics: unmatched side's
+            # key columns stay null
+            rt2 = rt.rename_columns([c + "\x00r" for c in rt.column_names])
+            out = lt.join(rt2, keys=lkn,
+                          right_keys=[c + "\x00r" for c in rkn],
+                          join_type=jt, coalesce_keys=False)
+            keep = [c for c in out.column_names
+                    if not c.startswith("__jk")]
+            out = out.select(keep)
+            out = out.rename_columns([c[:-2] if c.endswith("\x00r") else c
+                                      for c in out.column_names])
+        if self.condition is not None:
+            b = ColumnarBatch.from_arrow(out, pad=False)
+            import pyarrow.compute as pc
+            mask = self.condition.eval_host(b)
+            out = out.filter(pc.fill_null(mask, False))
+        yield ColumnarBatch.from_arrow(out)
+
+    def _cross_host(self, lt, rt):
+        import pyarrow as pa
+        import numpy as np
+        n, m = lt.num_rows, rt.num_rows
+        li = pa.array(np.repeat(np.arange(n), m))
+        ri = pa.array(np.tile(np.arange(m), n))
+        lo = lt.take(li)
+        ro = rt.take(ri)
+        arrays = list(lo.columns) + list(ro.columns)
+        return pa.Table.from_arrays(arrays, names=self._schema.names())
+
+    def describe(self):
+        return f"CpuJoin[{self.join_type}]"

@@ -1,0 +1,6 @@
+from .parquet import ParquetScanExec, expand_paths, parquet_schema
+from .writers import FileWriteExec
+from .text import csv_to_tables, json_to_tables
+
+__all__ = ["ParquetScanExec", "expand_paths", "parquet_schema",
+           "FileWriteExec", "csv_to_tables", "json_to_tables"]

@@ -1,0 +1,209 @@
+"""Parquet scan (ref GpuParquetScan.scala, 2,899 LoC).
+
+Keeps the reference's three reader strategies — they are host-side
+orchestration and port cleanly (SURVEY.md section 7 hard-part #6):
+  * PERFILE       (ParquetPartitionReader :2750): one file -> one decode
+  * COALESCING    (MultiFileParquetPartitionReader :1867): stitch row groups
+    of many small files into one host table, one H2D
+  * MULTITHREADED (MultiFileCloudParquetPartitionReader :2063): background
+    host reads on a thread pool (ref GpuMultiFileReader.scala:343) feeding
+    the device in submission order
+Decode itself is pyarrow's C++ parquet reader into Arrow host memory, then
+one padded H2D per shape bucket (the cudf-decode analog; a Pallas decode for
+fixed-width pages is future work). Row-group pruning via parquet statistics
+mirrors the reference's CPU-side filterBlocks (:670).
+"""
+from __future__ import annotations
+
+import concurrent.futures as cf
+import glob
+import os
+from typing import Iterator, List, Optional, Sequence
+
+from ..columnar import ColumnarBatch
+from ..config import (MULTITHREADED_READ_THREADS, PARQUET_READER_TYPE,
+                      TpuConf)
+from ..exec.base import ESSENTIAL, ExecContext, TpuExec
+from ..types import Schema, StructField, from_arrow
+
+__all__ = ["ParquetScanExec", "parquet_schema", "expand_paths"]
+
+
+def expand_paths(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(glob.glob(os.path.join(p, "**", "*.parquet"),
+                                        recursive=True)))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(glob.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no parquet files found in {paths}")
+    return out
+
+
+def parquet_schema(path: str) -> Schema:
+    import pyarrow.parquet as pq
+    sch = pq.read_schema(path)
+    return Schema([StructField(f.name, from_arrow(f.type), f.nullable)
+                   for f in sch])
+
+
+class ParquetScanExec(TpuExec):
+    def __init__(self, paths: List[str], schema: Schema,
+                 columns: Optional[List[str]], conf: TpuConf,
+                 predicate=None):
+        super().__init__([])
+        self.paths = paths
+        self._schema = schema
+        self.columns = columns
+        self.conf = conf
+        self.predicate = predicate  # row-group pruning expression (optional)
+        mode = str(conf.get(PARQUET_READER_TYPE)).upper()
+        if mode == "AUTO":
+            mode = "MULTITHREADED" if len(paths) > 1 else "PERFILE"
+        self.mode = mode
+
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    # ---------------------------------------------------------- reading
+    def _read_table(self, path: str):
+        import pyarrow.parquet as pq
+        f = pq.ParquetFile(path)
+        groups = self._filter_row_groups(f)
+        if groups is None:
+            t = f.read(columns=self.columns)
+        elif not groups:
+            t = f.schema_arrow.empty_table()
+            if self.columns:
+                t = t.select(self.columns)
+        else:
+            t = f.read_row_groups(groups, columns=self.columns)
+        return t
+
+    def _filter_row_groups(self, f) -> Optional[List[int]]:
+        """Row-group pruning from parquet min/max statistics
+        (ref GpuParquetScan filterBlocks:670)."""
+        if self.predicate is None:
+            return None
+        try:
+            keep = []
+            for i in range(f.metadata.num_row_groups):
+                rg = f.metadata.row_group(i)
+                stats = {}
+                for j in range(rg.num_columns):
+                    c = rg.column(j)
+                    if c.statistics is not None and c.statistics.has_min_max:
+                        name = c.path_in_schema
+                        stats[name] = (c.statistics.min, c.statistics.max)
+                if _maybe_matches(self.predicate, stats):
+                    keep.append(i)
+            return keep
+        except Exception:
+            return None  # stats unusable -> read everything
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        rows_m = ctx.metric(self._exec_id, "numOutputRows", ESSENTIAL)
+        files_m = ctx.metric(self._exec_id, "numFiles")
+        files_m.add(len(self.paths))
+        batch_rows = ctx.conf.batch_size_rows
+
+        if self.mode == "COALESCING":
+            yield from self._coalescing(ctx, rows_m, batch_rows)
+            return
+        if self.mode == "MULTITHREADED":
+            yield from self._multithreaded(ctx, rows_m, batch_rows)
+            return
+        # PERFILE
+        for path in self.paths:
+            t = self._read_table(path)
+            yield from self._emit(ctx, t, rows_m, batch_rows)
+
+    def _emit(self, ctx, table, rows_m, batch_rows):
+        off = 0
+        n = table.num_rows
+        while off < n or (n == 0 and off == 0):
+            chunk = table.slice(off, batch_rows)
+            with ctx.semaphore.held():
+                b = ColumnarBatch.from_arrow(chunk)
+            rows_m.add(b.num_rows)
+            yield b
+            off += batch_rows
+            if n == 0:
+                break
+
+    def _coalescing(self, ctx, rows_m, batch_rows):
+        """Stitch small files' tables into target-size host buffers, then one
+        H2D per coalesced table (ref MultiFileParquetPartitionReader)."""
+        import pyarrow as pa
+        pending, rows = [], 0
+        for path in self.paths:
+            t = self._read_table(path)
+            pending.append(t)
+            rows += t.num_rows
+            if rows >= batch_rows:
+                yield from self._emit(ctx, pa.concat_tables(pending),
+                                      rows_m, batch_rows)
+                pending, rows = [], 0
+        if pending:
+            yield from self._emit(ctx, pa.concat_tables(pending),
+                                  rows_m, batch_rows)
+
+    def _multithreaded(self, ctx, rows_m, batch_rows):
+        """Background host reads feeding the device in order
+        (ref MultiFileCloudParquetPartitionReader + thread pool
+        Plugin.scala:269-281)."""
+        nthreads = int(self.conf.get(MULTITHREADED_READ_THREADS))
+        with cf.ThreadPoolExecutor(max_workers=nthreads) as pool:
+            futures = [pool.submit(self._read_table, p) for p in self.paths]
+            for fut in futures:  # preserve file order; reads overlap
+                yield from self._emit(ctx, fut.result(), rows_m, batch_rows)
+
+    def describe(self):
+        return (f"ParquetScan[{len(self.paths)} files, {self.mode}"
+                + (f", pushdown={self.predicate.name_hint}" if self.predicate
+                   else "") + "]")
+
+
+def _maybe_matches(pred, stats) -> bool:
+    """Conservative interval check: False only if the predicate provably
+    excludes the row group. Understands And/Or and binary comparisons on
+    plain column refs."""
+    from ..exprs import (And, ColumnRef, EqualTo, GreaterThan,
+                         GreaterThanOrEqual, LessThan, LessThanOrEqual,
+                         Literal, Or)
+    if isinstance(pred, And):
+        return all(_maybe_matches(c, stats) for c in pred.children)
+    if isinstance(pred, Or):
+        return any(_maybe_matches(c, stats) for c in pred.children)
+    if isinstance(pred, (EqualTo, GreaterThan, GreaterThanOrEqual, LessThan,
+                         LessThanOrEqual)):
+        l, r = pred.children
+        if isinstance(l, Literal) and isinstance(r, ColumnRef):
+            flip = {GreaterThan: LessThan, LessThan: GreaterThan,
+                    GreaterThanOrEqual: LessThanOrEqual,
+                    LessThanOrEqual: GreaterThanOrEqual, EqualTo: EqualTo}
+            return _maybe_matches(flip[type(pred)](r, l), stats)
+        if not (isinstance(l, ColumnRef) and isinstance(r, Literal)):
+            return True
+        if l.name not in stats or r.value is None:
+            return True
+        mn, mx = stats[l.name]
+        v = r.value
+        try:
+            if isinstance(pred, EqualTo):
+                return mn <= v <= mx
+            if isinstance(pred, GreaterThan):
+                return mx > v
+            if isinstance(pred, GreaterThanOrEqual):
+                return mx >= v
+            if isinstance(pred, LessThan):
+                return mn < v
+            if isinstance(pred, LessThanOrEqual):
+                return mn <= v
+        except TypeError:
+            return True
+    return True

@@ -1,0 +1,46 @@
+"""CSV / JSON sources (ref GpuTextBasedPartitionReader: CPU line split ->
+device parse; here pyarrow's multithreaded C++ CSV/JSON readers produce the
+host table, then the standard padded H2D)."""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from ..types import Schema, StructField, from_arrow, to_arrow
+
+__all__ = ["csv_to_tables", "json_to_tables"]
+
+
+def _schema_to_arrow(schema) -> "object":
+    import pyarrow as pa
+    return pa.schema([pa.field(f.name, to_arrow(f.dtype), f.nullable)
+                      for f in schema])
+
+
+def csv_to_tables(paths: Sequence[str], schema: Optional[Schema],
+                  header: bool) -> Tuple[List, Schema]:
+    import pyarrow.csv as pcsv
+    tables = []
+    for p in paths:
+        read_opts = pcsv.ReadOptions(autogenerate_column_names=not header)
+        convert = pcsv.ConvertOptions(
+            column_types=dict(zip(schema.names(),
+                                  [to_arrow(t) for t in schema.types()]))
+            if schema else None)
+        tables.append(pcsv.read_csv(p, read_options=read_opts,
+                                    convert_options=convert))
+    sch = schema or Schema([StructField(f.name, from_arrow(f.type), True)
+                            for f in tables[0].schema])
+    return tables, sch
+
+
+def json_to_tables(paths: Sequence[str],
+                   schema: Optional[Schema]) -> Tuple[List, Schema]:
+    import pyarrow.json as pjson
+    tables = []
+    for p in paths:
+        opts = pjson.ParseOptions(
+            explicit_schema=_schema_to_arrow(schema) if schema else None)
+        tables.append(pjson.read_json(p, parse_options=opts))
+    sch = schema or Schema([StructField(f.name, from_arrow(f.type), True)
+                            for f in tables[0].schema])
+    return tables, sch

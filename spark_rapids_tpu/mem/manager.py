@@ -42,7 +42,14 @@ class OutOfDeviceMemory(RuntimeError):
 def _device_hbm_bytes() -> int:
     import jax
     try:
-        d = jax.local_devices()[0]
+        # honour an explicitly pinned default device (tests pin 'cpu') and
+        # NEVER initialize other backends just for bookkeeping — touching the
+        # TPU client here would block if another process holds the chip
+        dd = jax.config.jax_default_device
+        if dd is not None:
+            d = jax.devices(dd)[0] if isinstance(dd, str) else dd
+        else:
+            d = jax.local_devices()[0]
         stats = d.memory_stats()
         if stats and "bytes_limit" in stats:
             return int(stats["bytes_limit"])

@@ -7,8 +7,10 @@ os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 
 import jax
 
-# the axon TPU platform cannot be deprioritized via JAX_PLATFORMS; pin the
-# default device to host CPU instead (arrays then stay on CPU end-to-end)
+# The axon TPU plugin force-sets jax_platforms="axon,cpu" at register time
+# (env JAX_PLATFORMS is ignored); override it back so tests never initialize
+# the TPU client — a wedged/held chip would hang every test otherwise.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_device", "cpu")
 
 import pytest  # noqa: E402

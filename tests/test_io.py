@@ -1,0 +1,120 @@
+"""Parquet / CSV / JSON scan + write tests (ref parquet_test.py,
+csv_test.py, json_test.py, parquet_write_test.py)."""
+import json
+import os
+
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from harness import assert_tpu_and_cpu_equal, tpu_session
+from data_gen import DoubleGen, IntGen, LongGen, StringGen, gen_df
+from spark_rapids_tpu.api import functions as F
+
+
+@pytest.fixture
+def pq_dir(tmp_path):
+    d = tmp_path / "pq"
+    d.mkdir()
+    dfs = []
+    for i in range(4):
+        df = gen_df({"a": IntGen(), "b": DoubleGen(with_special=False),
+                     "s": StringGen()}, n=1000, seed=i)
+        pq.write_table(pa.Table.from_pandas(df), d / f"part-{i}.parquet")
+        dfs.append(df)
+    return str(d), pd.concat(dfs, ignore_index=True)
+
+
+@pytest.mark.parametrize("mode", ["PERFILE", "COALESCING", "MULTITHREADED"])
+def test_parquet_reader_modes(pq_dir, mode):
+    d, expect = pq_dir
+
+    def q(s):
+        s.set_conf("spark.rapids.tpu.sql.format.parquet.reader.type", mode)
+        return s.read_parquet(d).select("a", "b")
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_parquet_projection_and_filter(pq_dir):
+    d, _ = pq_dir
+
+    def q(s):
+        return (s.read_parquet(d)
+                .filter(F.col("a") > 0)
+                .select((F.col("a") + 1).alias("a1"), "b"))
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_parquet_string_column_host(pq_dir):
+    d, expect = pq_dir
+    s = tpu_session()
+    out = s.read_parquet(d).to_pandas()
+    assert sorted(out["s"].fillna("\0")) == sorted(expect["s"].fillna("\0"))
+
+
+def test_parquet_column_pruning(pq_dir):
+    d, _ = pq_dir
+    s = tpu_session()
+    df = s.read_parquet(d, columns=["a"])
+    assert df.columns == ["a"]
+    assert df.count() == 4000
+
+
+def test_parquet_roundtrip_write(tmp_path):
+    out_dir = str(tmp_path / "out")
+    s = tpu_session()
+    src = gen_df({"a": IntGen(), "b": DoubleGen(with_special=False)}, n=2000)
+    df = s.create_dataframe(src)
+    stats = df.write_parquet(out_dir)
+    assert stats.column("rows_written")[0].as_py() == 2000
+    back = s.read_parquet(out_dir).to_pandas()
+    pd.testing.assert_frame_equal(
+        back.sort_values(["a", "b"], na_position="first").reset_index(drop=True),
+        src.sort_values(["a", "b"], na_position="first").reset_index(drop=True),
+        check_dtype=False)
+
+
+def test_parquet_partitioned_write(tmp_path):
+    out_dir = str(tmp_path / "outp")
+    s = tpu_session()
+    src = pd.DataFrame({"k": [1, 1, 2, 2, 3], "v": [10, 20, 30, 40, 50]})
+    s.create_dataframe(src).write_parquet(out_dir, partition_by=["k"])
+    assert sorted(os.listdir(out_dir)) == ["k=1", "k=2", "k=3"]
+
+
+def test_row_group_pruning(tmp_path):
+    p = str(tmp_path / "rg.parquet")
+    t = pa.table({"x": pa.array(range(100000), pa.int64())})
+    pq.write_table(t, p, row_group_size=10000)
+    from spark_rapids_tpu.io.parquet import ParquetScanExec, parquet_schema
+    from spark_rapids_tpu.exprs import ColumnRef, GreaterThan, Literal
+    from spark_rapids_tpu.config import TpuConf
+    from spark_rapids_tpu.exec.base import ExecContext
+    pred = GreaterThan(ColumnRef("x"), Literal(95000))
+    scan = ParquetScanExec([p], parquet_schema(p), None, TpuConf(), pred)
+    out = scan.collect(ExecContext())
+    # only the last row group (90000-99999) should be read
+    assert out.num_rows == 10000
+    assert out.column("x")[0].as_py() == 90000
+
+
+def test_csv_scan(tmp_path):
+    p = str(tmp_path / "t.csv")
+    pd.DataFrame({"a": [1, 2, 3], "b": [1.5, None, 3.5]}).to_csv(
+        p, index=False)
+
+    def q(s):
+        return s.read_csv(p).select((F.col("a") * 2).alias("a2"), "b")
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_json_scan(tmp_path):
+    p = str(tmp_path / "t.jsonl")
+    with open(p, "w") as f:
+        for row in [{"a": 1, "b": "x"}, {"a": 2, "b": None}, {"a": 3}]:
+            f.write(json.dumps(row) + "\n")
+
+    def q(s):
+        return s.read_json(p).select("a")
+    assert_tpu_and_cpu_equal(q)
