@@ -1,0 +1,40 @@
+"""Columnar batch (de)serialization for shuffle and spill.
+
+Reference analog: GpuColumnarBatchSerializer.scala:127 over the
+JCudfSerialization host-buffer format + TableCompressionCodec. Here the wire
+format is Arrow IPC stream bytes (zero-copy-friendly, language-neutral) with
+optional LZ4/ZSTD frame compression — the natural host format when the
+device side is Arrow-layout HBM buffers.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .batch import ColumnarBatch
+
+__all__ = ["serialize_batch", "deserialize_batch", "serialize_table",
+           "deserialize_table"]
+
+
+def serialize_table(table, codec: Optional[str] = "lz4") -> bytes:
+    import pyarrow as pa
+    sink = pa.BufferOutputStream()
+    options = pa.ipc.IpcWriteOptions(
+        compression=codec if codec in ("lz4", "zstd") else None)
+    with pa.ipc.new_stream(sink, table.schema, options=options) as w:
+        w.write_table(table)
+    return sink.getvalue().to_pybytes()
+
+
+def deserialize_table(data: bytes):
+    import pyarrow as pa
+    return pa.ipc.open_stream(pa.BufferReader(data)).read_all()
+
+
+def serialize_batch(batch: ColumnarBatch, codec: Optional[str] = "lz4") -> bytes:
+    """D2H + encode (ref SerializedTableColumn travelling through shuffle)."""
+    return serialize_table(batch.to_arrow(), codec)
+
+
+def deserialize_batch(data: bytes) -> ColumnarBatch:
+    return ColumnarBatch.from_arrow(deserialize_table(data))

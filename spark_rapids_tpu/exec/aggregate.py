@@ -31,7 +31,7 @@ from ..exprs.base import BoundReference, DVal, EvalContext, Expression
 from ..mem import SpillableBatch, with_retry_no_split
 from ..types import Schema, StructField
 from .base import ESSENTIAL, ExecContext, TpuExec
-from .encoding import grouping_operands, operands_equal
+from .groupby_core import segmented_groupby
 
 __all__ = ["TpuHashAggregateExec", "CpuAggregateExec"]
 
@@ -65,83 +65,9 @@ def _build_groupby_kernel(key_exprs: Sequence[Expression],
         dvals = [None if c is None else DVal(c[0], c[1], dt)
                  for c, dt in zip(cols, dtypes)]
         ctx = EvalContext(schema, dvals, num_rows, padded_len)
-        row_mask = ctx.row_mask()
         keys = [e.eval_device(ctx) for e in key_exprs]
         vals = [[e.eval_device(ctx) for e in exprs] for exprs in value_exprs]
-
-        if num_keys == 0:
-            # global aggregation: one group (group 0), padding -> dropped
-            gid = jnp.where(row_mask, 0, padded_len).astype(jnp.int32)
-            num_groups = jnp.int32(1)
-            sorted_vals = vals
-            key_outs = []
-        else:
-            pad_flag = jnp.where(row_mask, jnp.uint8(0), jnp.uint8(1))
-            operands = [pad_flag]
-            for k in keys:
-                operands.extend(grouping_operands(k))
-            payload = []
-            for k in keys:
-                payload.extend([k.data, k.validity])
-            for vs in vals:
-                for v in vs:
-                    payload.extend([v.data, v.validity])
-            n_key_ops = len(operands)
-            sorted_all = jax.lax.sort(tuple(operands + payload),
-                                      num_keys=n_key_ops, is_stable=True)
-            s_ops = sorted_all[:n_key_ops]
-            s_payload = list(sorted_all[n_key_ops:])
-            # group boundaries: any key operand differs from previous row
-            idx = jnp.arange(padded_len)
-            differs = jnp.zeros(padded_len, dtype=jnp.bool_)
-            for op in s_ops[1:]:  # skip the pad flag
-                prev = jnp.roll(op, 1)
-                differs = jnp.logical_or(
-                    differs, jnp.logical_not(operands_equal(op, prev)))
-            flags = jnp.logical_or(idx == 0, differs)
-            flags = jnp.logical_and(flags, row_mask)  # sorted: real rows first
-            num_groups = jnp.sum(flags).astype(jnp.int32)
-            gid = jnp.where(row_mask,
-                            (jnp.cumsum(flags) - 1).astype(jnp.int32),
-                            padded_len)
-            # rebuild sorted key/val DVals from payload
-            pi = 0
-            s_keys = []
-            for k in keys:
-                s_keys.append(DVal(s_payload[pi], s_payload[pi + 1], k.dtype))
-                pi += 2
-            sorted_vals = []
-            for vs in vals:
-                cur = []
-                for v in vs:
-                    cur.append(DVal(s_payload[pi], s_payload[pi + 1], v.dtype))
-                    pi += 2
-                sorted_vals.append(cur)
-            # emit each group's key values (scatter first occurrence)
-            key_outs = []
-            safe_gid = jnp.where(flags, gid, padded_len)
-            for k in s_keys:
-                kd = jnp.zeros((padded_len,), dtype=k.data.dtype) \
-                    .at[safe_gid].set(k.data, mode="drop")
-                kv = jnp.zeros((padded_len,), dtype=jnp.bool_) \
-                    .at[safe_gid].set(k.validity, mode="drop")
-                key_outs.append((kd, kv))
-            row_mask = jnp.arange(padded_len) < num_rows
-
-        partial_outs = []
-        for a, vs in zip(aggs, sorted_vals):
-            step = a.update if mode == "update" else a.merge
-            if mode == "update":
-                outs = step(vs, gid, padded_len, row_mask)
-            else:
-                outs = step(vs, gid, padded_len)
-            partial_outs.extend(outs)
-
-        group_live = jnp.arange(padded_len, dtype=jnp.int32) < num_groups
-        key_outs = [(d, jnp.logical_and(v, group_live)) for d, v in key_outs]
-        partial_outs = [(d, jnp.logical_and(v, group_live))
-                        for d, v in partial_outs]
-        return key_outs, partial_outs, num_groups
+        return segmented_groupby(keys, vals, aggs, mode, num_rows, padded_len)
 
     return kernel
 
@@ -196,9 +122,14 @@ class TpuHashAggregateExec(TpuExec):
         key_outs, partial_outs, num_groups = kernel(
             cols, jnp.int32(batch.num_rows), batch.padded_len)
         n = int(num_groups)
+        # re-bucket: group count is usually orders of magnitude below the
+        # input bucket; slicing keeps the merge pass (another sort) tiny
+        target = bucket_for(n)
         out_cols = []
         for (d, v), f in zip(list(key_outs) + list(partial_outs),
                              out_schema.fields):
+            if target < d.shape[0]:
+                d, v = d[:target], v[:target]
             out_cols.append(DeviceColumn(d, v, f.dtype))
         return ColumnarBatch(out_cols, n, out_schema)
 

@@ -1,0 +1,85 @@
+"""Core traced groupby: encode keys -> one lax.sort -> segment boundaries ->
+per-aggregate segment reductions. Shared by the single-device aggregate exec
+(exec/aggregate.py) and the multi-chip SPMD path (parallel/collective.py),
+so local and distributed aggregation are the same maths by construction
+(the reference gets this by reusing cudf groupby in both its first-pass and
+merge pass, GpuAggregateExec.scala:718).
+"""
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..exprs.base import DVal
+from .encoding import grouping_operands, operands_equal
+
+__all__ = ["segmented_groupby"]
+
+
+def segmented_groupby(keys: List[DVal], vals: List[List[DVal]],
+                      aggs: Sequence, mode: str, num_rows, padded_len: int):
+    """Returns (key_outs [(data, validity)...], partial_outs, num_groups).
+
+    mode='update' runs agg.update, mode='merge' runs agg.merge. All inputs
+    are padded device values; rows >= num_rows are ignored. Output group
+    arrays have length padded_len with groups packed at the front.
+    """
+    row_mask = jnp.arange(padded_len, dtype=jnp.int32) < num_rows
+    if not keys:
+        gid = jnp.where(row_mask, 0, padded_len).astype(jnp.int32)
+        num_groups = jnp.int32(1)
+        sorted_vals = vals
+        key_outs: List[Tuple] = []
+    else:
+        pad_flag = jnp.where(row_mask, jnp.uint8(0), jnp.uint8(1))
+        operands = [pad_flag]
+        for k in keys:
+            operands.extend(grouping_operands(k))
+        # sort ONLY (key operands, row index); payloads are gathered after —
+        # far cheaper than carrying every column through the sort network
+        perm0 = jnp.arange(padded_len, dtype=jnp.int32)
+        n_key_ops = len(operands)
+        sorted_all = jax.lax.sort(tuple(operands + [perm0]),
+                                  num_keys=n_key_ops, is_stable=True)
+        s_ops = sorted_all[:n_key_ops]
+        perm = sorted_all[n_key_ops]
+        idx = jnp.arange(padded_len)
+        differs = jnp.zeros(padded_len, dtype=jnp.bool_)
+        for op in s_ops[1:]:
+            prev = jnp.roll(op, 1)
+            differs = jnp.logical_or(
+                differs, jnp.logical_not(operands_equal(op, prev)))
+        flags = jnp.logical_or(idx == 0, differs)
+        flags = jnp.logical_and(flags, row_mask)  # real rows sorted first
+        num_groups = jnp.sum(flags).astype(jnp.int32)
+        gid = jnp.where(row_mask, (jnp.cumsum(flags) - 1).astype(jnp.int32),
+                        padded_len)
+        s_keys = [DVal(jnp.take(k.data, perm), jnp.take(k.validity, perm),
+                       k.dtype) for k in keys]
+        sorted_vals = [[DVal(jnp.take(v.data, perm),
+                             jnp.take(v.validity, perm), v.dtype)
+                        for v in vs] for vs in vals]
+        key_outs = []
+        safe_gid = jnp.where(flags, gid, padded_len)
+        for k in s_keys:
+            kd = jnp.zeros((padded_len,), dtype=k.data.dtype) \
+                .at[safe_gid].set(k.data, mode="drop")
+            kv = jnp.zeros((padded_len,), dtype=jnp.bool_) \
+                .at[safe_gid].set(k.validity, mode="drop")
+            key_outs.append((kd, kv))
+
+    partial_outs = []
+    for a, vs in zip(aggs, sorted_vals):
+        if mode == "update":
+            outs = a.update(vs, gid, padded_len, row_mask)
+        else:
+            outs = a.merge(vs, gid, padded_len)
+        partial_outs.extend(outs)
+
+    group_live = jnp.arange(padded_len, dtype=jnp.int32) < num_groups
+    key_outs = [(d, jnp.logical_and(v, group_live)) for d, v in key_outs]
+    partial_outs = [(d, jnp.logical_and(v, group_live))
+                    for d, v in partial_outs]
+    return key_outs, partial_outs, num_groups

@@ -58,18 +58,14 @@ def _build_sort_kernel(orders: List[SortOrder], schema: Schema):
         for o in orders:
             v = o.expr.eval_device(ctx)
             operands.extend(order_key_operands(v, o.ascending, o.nulls_first))
-        payload = []
-        for dv in dvals:
-            payload.extend([dv.data, dv.validity])
+        # sort (keys, row-index) then gather columns — payload-free sort
+        perm0 = jnp.arange(padded_len, dtype=jnp.int32)
         n_ops = len(operands)
-        out = jax.lax.sort(tuple(operands + payload), num_keys=n_ops,
+        out = jax.lax.sort(tuple(operands + [perm0]), num_keys=n_ops,
                            is_stable=True)
-        res = []
-        pi = n_ops
-        for dv in dvals:
-            res.append((out[pi], out[pi + 1]))
-            pi += 2
-        return res
+        perm = out[n_ops]
+        return [(jnp.take(dv.data, perm), jnp.take(dv.validity, perm))
+                for dv in dvals]
 
     return kernel
 

@@ -1,0 +1,6 @@
+from .mesh import Mesh, NamedSharding, P, make_mesh, replicated, row_sharding
+from .collective import build_distributed_agg_step, distributed_groupby
+
+__all__ = ["Mesh", "NamedSharding", "P", "make_mesh", "replicated",
+           "row_sharding", "build_distributed_agg_step",
+           "distributed_groupby"]

@@ -1,0 +1,250 @@
+"""Multi-chip SPMD query execution over a device mesh.
+
+This is the ICI/DCN replacement for the reference's shuffle transport
+(SURVEY.md section 2.10 "TPU equivalent"): instead of UCX point-to-point RDMA
+between executor processes (RapidsShuffleClient.doFetch), the whole exchange
+is ONE XLA `all_to_all` collective inside a shard_map'd program — batches
+stay in HBM, XLA schedules the ICI transfers, and DCN handles cross-slice
+legs automatically for meshes spanning slices.
+
+Distributed aggregation pipeline (per device, lockstep SPMD):
+  1. local filter/project + first-pass segmented groupby  (compute, no comm)
+  2. route each local group to owner = key_hash % n_devices
+  3. all_to_all the routed group partials                 (ICI)
+  4. merge-pass groupby over received partials            (compute)
+  5. finalize -> each device owns a disjoint set of final groups
+This is the same update/merge maths as the single-chip path (shared
+exec/groupby_core.py), so distributing cannot change results.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..exprs.base import DVal, EvalContext, Expression
+from ..exec.groupby_core import segmented_groupby
+from ..shuffle.partitioning import _col_hash_u32, _mix32
+from ..types import Schema
+
+__all__ = ["build_distributed_agg_step", "distributed_groupby"]
+
+
+def _route_to_buffers(arrays, pid, padded_len: int, n_dev: int):
+    """Pack rows into (n_dev, padded_len) send buffers by destination.
+
+    Worst case (every row to one destination) still fits because the chunk
+    size equals the local padded length; slot = pid*P + rank-within-pid,
+    computed via one stable sort by pid (the contiguous-split trick)."""
+    order = jnp.argsort(pid, stable=True)
+    s_pid = jnp.take(pid, order)
+    idx = jnp.arange(padded_len, dtype=jnp.int32)
+    first_of_pid = jnp.logical_or(idx == 0, s_pid != jnp.roll(s_pid, 1))
+    seg_start = jnp.where(first_of_pid, idx, 0)
+    seg_start = jax.lax.associative_scan(jnp.maximum, seg_start)
+    intra = idx - seg_start
+    slot = jnp.where(s_pid < n_dev, s_pid * padded_len + intra,
+                     n_dev * padded_len)
+    outs = []
+    for d, v in arrays:
+        sd = jnp.take(d, order)
+        sv = jnp.take(v, order)
+        od = jnp.zeros((n_dev * padded_len,), dtype=d.dtype) \
+            .at[slot].set(sd, mode="drop")
+        ov = jnp.zeros((n_dev * padded_len,), dtype=jnp.bool_) \
+            .at[slot].set(jnp.logical_and(sv, s_pid < n_dev), mode="drop")
+        outs.append((od.reshape(n_dev, padded_len),
+                     ov.reshape(n_dev, padded_len)))
+    return outs
+
+
+def build_distributed_agg_step(mesh: Mesh, schema: Schema,
+                               key_exprs: Sequence[Expression],
+                               aggs: Sequence,
+                               local_padded: int,
+                               pre_filter: Optional[Expression] = None,
+                               axis: str = "data"):
+    """Compile the full distributed query step: returns fn(cols, num_rows)
+    where cols are GLOBAL (n_dev*local_padded,) arrays sharded on `axis` and
+    num_rows is a (n_dev,) int32 vector of per-shard row counts. Output:
+    per-device final group columns (global (n_dev*local_padded,)) and a
+    (n_dev,) group-count vector."""
+    n_dev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
+    dtypes = [f.dtype for f in schema.fields]
+    partial_counts = [len(a.partial_types(schema)) for a in aggs]
+
+    def _compact(arrays, keep, length):
+        """Move keep-rows to the front (same cumsum+scatter as the filter
+        kernel); returns compacted arrays + count."""
+        cnt = jnp.sum(keep).astype(jnp.int32)
+        pos = jnp.where(keep, jnp.cumsum(keep) - 1, length)
+        out = []
+        for d, v in arrays:
+            cd = jnp.zeros_like(d).at[pos].set(d, mode="drop")
+            cv = jnp.zeros_like(v).at[pos].set(
+                jnp.logical_and(v, keep), mode="drop")
+            out.append((cd, cv))
+        return out, cnt
+
+    def local_step(nrows, *cols):
+        P_ = local_padded
+        nloc = nrows[0]
+        dvals = [DVal(d, v, dt)
+                 for d, v, dt in zip(cols[0::2], cols[1::2], dtypes)]
+        ctx = EvalContext(schema, dvals, nloc, P_)
+        # 1. local filter: evaluate predicate, compact surviving rows
+        keys = [e.eval_device(ctx) for e in key_exprs]
+        vals = [[e.eval_device(ctx) for e in a.input_exprs()] for a in aggs]
+        flat = [(k.data, k.validity) for k in keys]
+        for vs in vals:
+            flat.extend((v.data, v.validity) for v in vs)
+        if pre_filter is not None:
+            keep = pre_filter.eval_device(ctx)
+            keepb = jnp.logical_and(jnp.logical_and(keep.data, keep.validity),
+                                    ctx.row_mask())
+            flat, nloc = _compact(flat, keepb, P_)
+        # rebuild DVals (post-compaction or as-is)
+        ai = 0
+        keys2, vals2 = [], []
+        for k in keys:
+            keys2.append(DVal(flat[ai][0], flat[ai][1], k.dtype))
+            ai += 1
+        for vs in vals:
+            cur = []
+            for v in vs:
+                cur.append(DVal(flat[ai][0], flat[ai][1], v.dtype))
+                ai += 1
+            vals2.append(cur)
+        # 2. first-pass local aggregation
+        key_outs, partial_outs, n_groups = segmented_groupby(
+            keys2, vals2, aggs, "update", nloc, P_)
+        # 3. route groups to owners by key hash
+        glive = jnp.arange(P_, dtype=jnp.int32) < n_groups
+        if key_exprs:
+            h = jnp.full(P_, jnp.uint32(42))
+            for (kd, kv), k in zip(key_outs, keys2):
+                h = _mix32(h * jnp.uint32(31)
+                           + _col_hash_u32(DVal(kd, kv, k.dtype)))
+            pid = jnp.where(glive, (h % jnp.uint32(n_dev)).astype(jnp.int32),
+                            jnp.int32(n_dev))
+        else:
+            pid = jnp.where(glive, 0, n_dev)  # global agg -> device 0
+        bufs = _route_to_buffers(key_outs + partial_outs, pid, P_, n_dev)
+        # 4. ICI all_to_all: every device receives the groups it owns
+        recv = []
+        for d, v in bufs:
+            rd = jax.lax.all_to_all(d, axis, 0, 0, tiled=False)
+            rv = jax.lax.all_to_all(v, axis, 0, 0, tiled=False)
+            recv.append((rd.reshape(n_dev * P_), rv.reshape(n_dev * P_)))
+        # compact received group rows (validity marks real rows; count is
+        # never null so every live group row has >=1 valid column)
+        live = jnp.zeros(n_dev * P_, dtype=jnp.bool_)
+        for _, v in recv:
+            live = jnp.logical_or(live, v)
+        comp, cnt = _compact(recv, live, n_dev * P_)
+        # 5. merge pass over received partials
+        rkeys = [DVal(comp[i][0], comp[i][1], k.dtype)
+                 for i, k in enumerate(keys2)]
+        rvals = []
+        ai = len(keys2)
+        for a, npart in zip(aggs, partial_counts):
+            pts = a.partial_types(schema)
+            rvals.append([DVal(comp[ai + j][0], comp[ai + j][1], pts[j])
+                          for j in range(npart)])
+            ai += npart
+        mkey_outs, mpartial_outs, m_groups = segmented_groupby(
+            rkeys, rvals, aggs, "merge", cnt, n_dev * P_)
+        if not key_exprs:
+            # the single global group lives on device 0 only
+            m_groups = jnp.where(jax.lax.axis_index(axis) == 0,
+                                 m_groups, 0)
+        # 6. finalize
+        glive2 = jnp.arange(n_dev * P_, dtype=jnp.int32) < m_groups
+        outs = []
+        for d, v in mkey_outs:
+            outs.extend([d, jnp.logical_and(v, glive2)])
+        ai = 0
+        for a, npart in zip(aggs, partial_counts):
+            pts = a.partial_types(schema)
+            parts = [DVal(mpartial_outs[ai + j][0], mpartial_outs[ai + j][1],
+                          pts[j]) for j in range(npart)]
+            ai += npart
+            f = a.finalize(parts)
+            outs.extend([f.data, jnp.logical_and(f.validity, glive2)])
+        return (m_groups.reshape(1),) + tuple(outs)
+
+    in_specs = (P(axis),) + tuple(P(axis) for _ in range(2 * len(dtypes)))
+    n_out = 1 + 2 * (len(key_exprs) + len(aggs))
+    out_specs = (P(axis),) + tuple(P(axis) for _ in range(n_out - 1))
+
+    fn = shard_map(local_step, mesh=mesh, in_specs=in_specs,
+                   out_specs=out_specs, check_vma=False)
+    return jax.jit(fn), n_dev
+
+
+def distributed_groupby(mesh: Mesh, table, key_names: List[str], aggs,
+                        pre_filter=None, axis: str = "data"):
+    """Host-friendly wrapper: Arrow table -> sharded arrays -> distributed
+    step -> Arrow result table. Used by tests and the dryrun."""
+    import pyarrow as pa
+    from ..columnar import ColumnarBatch
+    from ..columnar.bucketing import bucket_for
+    from ..exprs.base import ColumnRef
+    from ..types import to_arrow
+
+    n_dev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
+    n = table.num_rows
+    per = -(-n // n_dev)
+    local_p = bucket_for(max(per, 1))
+    schema = ColumnarBatch.from_arrow(table, pad=False).schema
+    key_exprs = [ColumnRef(k) for k in key_names]
+    step, _ = build_distributed_agg_step(mesh, schema, key_exprs, aggs,
+                                         local_p, pre_filter, axis)
+    # build per-shard padded arrays
+    shards = [table.slice(i * per, per) for i in range(n_dev)]
+    nrows = np.array([s.num_rows for s in shards], dtype=np.int32)
+    cols_flat = []
+    for f in schema.fields:
+        ds, vs = [], []
+        for s in shards:
+            b = ColumnarBatch.from_arrow(s.select([f.name]))
+            c = b.columns[0]
+            d = np.asarray(jax.device_get(c.data))
+            v = np.asarray(jax.device_get(c.validity))
+            if d.shape[0] < local_p:
+                d = np.pad(d, (0, local_p - d.shape[0]))
+                v = np.pad(v, (0, local_p - v.shape[0]))
+            ds.append(d[:local_p])
+            vs.append(v[:local_p])
+        cols_flat.append(jnp.asarray(np.concatenate(ds)))
+        cols_flat.append(jnp.asarray(np.concatenate(vs)))
+    sharding = NamedSharding(mesh, P(axis))
+    nrows_dev = jax.device_put(jnp.asarray(nrows), sharding)
+    cols_dev = [jax.device_put(c, sharding) for c in cols_flat]
+    out = step(nrows_dev, *cols_dev)
+    m_groups = np.asarray(jax.device_get(out[0]))
+    data = [np.asarray(jax.device_get(x)) for x in out[1:]]
+    # stitch per-device group slices
+    names = key_names + [a.name_hint for a in aggs]
+    dtypes = [schema[k].dtype for k in key_names] + \
+        [a.data_type(schema) for a in aggs]
+    chunk = n_dev * local_p
+    arrays = []
+    for ci in range(len(names)):
+        d_all, v_all = data[2 * ci], data[2 * ci + 1]
+        parts_d, parts_v = [], []
+        for dev in range(n_dev):
+            g = int(m_groups[dev])
+            parts_d.append(d_all[dev * chunk: dev * chunk + g])
+            parts_v.append(v_all[dev * chunk: dev * chunk + g])
+        dv = np.concatenate(parts_d)
+        vv = np.concatenate(parts_v)
+        from ..columnar.column import DeviceColumn
+        col = DeviceColumn(jnp.asarray(dv), jnp.asarray(vv), dtypes[ci])
+        arrays.append(col.to_arrow(len(dv)))
+    return pa.Table.from_arrays(arrays, names=names)

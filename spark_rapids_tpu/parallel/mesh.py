@@ -1,0 +1,41 @@
+"""Device-mesh management for multi-chip execution.
+
+The reference's distribution model is Spark's (1 GPU per executor,
+Plugin.scala:536; peers discovered via driver heartbeats, UCX point-to-point
+RDMA). TPU-native replacement: a jax.sharding.Mesh over the slice —
+exchange = XLA collectives on ICI (all_to_all / psum), cross-slice = DCN —
+executed SPMD under shard_map (SURVEY.md section 2.10 TPU-equivalent note).
+
+Mesh axes for a SQL engine:
+  "data"  — row-shard parallelism (the executor/task analog)
+Future pods: 2D ("data", "host") so intra-host ICI carries the all-to-all
+and DCN only sees the cross-host reduction.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["make_mesh", "row_sharding", "replicated", "Mesh", "P",
+           "NamedSharding"]
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = "data",
+              devices: Optional[Sequence] = None) -> Mesh:
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (axis,))
+
+
+def row_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
+    """Shard leading (row) dimension across the mesh."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,5 @@
+from .partitioning import PartitionedBatches, hash_partition_ids, partition_batch
+from .exchange import CpuShuffleExchangeExec, ShuffleCatalog, ShuffleExchangeExec
+
+__all__ = ["PartitionedBatches", "hash_partition_ids", "partition_batch",
+           "CpuShuffleExchangeExec", "ShuffleCatalog", "ShuffleExchangeExec"]

@@ -1,0 +1,184 @@
+"""Shuffle exchange execs.
+
+Reference analog: GpuShuffleExchangeExecBase.scala:167
+(prepareBatchShuffleDependency:277) + RapidsShuffleInternalManagerBase modes
+(:1264-1276): MULTITHREADED (host-staged, threaded ser/deser), UCX
+(device-resident ShuffleBufferCatalog) and CACHE_ONLY (single-process
+testing). Mapping here:
+
+  MULTITHREADED -> partition on device, serialize per-partition Arrow bytes
+    on a thread pool (BytesInFlightLimiter analog via bounded executor),
+    regroup by partition, deserialize + coalesce (GpuShuffleCoalesceExec)
+  CACHE_ONLY    -> device-resident: per-partition batches stay in HBM inside
+    a spillable ShuffleCatalog (the UCX ShuffleBufferCatalog single-process
+    analog; the multi-chip ICI path lives in parallel/collective.py where
+    the exchange is an XLA all_to_all over the mesh)
+"""
+from __future__ import annotations
+
+import concurrent.futures as cf
+from typing import Dict, Iterator, List, Sequence
+
+from ..columnar import ColumnarBatch, concat_batches
+from ..columnar.serializer import deserialize_table, serialize_table
+from ..config import SHUFFLE_THREADS, TpuConf
+from ..exprs.base import Expression
+from ..mem import SpillableBatch
+from ..types import Schema
+from .partitioning import partition_batch
+
+__all__ = ["ShuffleExchangeExec", "CpuShuffleExchangeExec", "ShuffleCatalog"]
+
+from ..exec.base import ESSENTIAL, ExecContext, TpuExec
+
+
+class ShuffleCatalog:
+    """Device-resident shuffle store: partition -> spillable batches
+    (ref ShuffleBufferCatalog.scala:51)."""
+
+    def __init__(self, ctx: ExecContext):
+        self.ctx = ctx
+        self.parts: Dict[int, List[SpillableBatch]] = {}
+
+    def put(self, part: int, batch: ColumnarBatch):
+        self.parts.setdefault(part, []).append(
+            SpillableBatch(batch, self.ctx.memory))
+
+    def fetch(self, part: int) -> List[ColumnarBatch]:
+        out = [sb.get() for sb in self.parts.get(part, [])]
+        return out
+
+    def close(self):
+        for lst in self.parts.values():
+            for sb in lst:
+                sb.close()
+        self.parts.clear()
+
+
+class ShuffleExchangeExec(TpuExec):
+    def __init__(self, child: TpuExec, num_partitions: int,
+                 keys: Sequence[Expression], mode: str, conf: TpuConf):
+        super().__init__([child])
+        self.num_partitions = num_partitions
+        self.keys = list(keys)
+        self.part_mode = mode if keys or mode != "hash" else "roundrobin"
+        self.conf = conf
+
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema()
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        shuffle_mode = ctx.conf.shuffle_mode
+        if shuffle_mode == "CACHE_ONLY":
+            yield from self._device_resident(ctx)
+        else:
+            yield from self._multithreaded(ctx)
+
+    # ------------------------------------------------------- MULTITHREADED
+    def _multithreaded(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        """Host-staged: device partition -> threaded serialize -> regroup ->
+        threaded deserialize -> per-partition coalesced batches."""
+        nthreads = int(ctx.conf.get(SHUFFLE_THREADS))
+        write_m = ctx.metric(self._exec_id, "shuffleWriteTime")
+        bytes_m = ctx.metric(self._exec_id, "shuffleBytes", ESSENTIAL)
+        blocks: Dict[int, List[bytes]] = {p: [] for p in
+                                          range(self.num_partitions)}
+        with cf.ThreadPoolExecutor(max_workers=nthreads) as pool:
+            futs = []
+            for batch in self.children[0].execute(ctx):
+                with ctx.semaphore.held():
+                    parts = partition_batch(batch, self.keys,
+                                            self.num_partitions,
+                                            self.part_mode)
+                for p in range(self.num_partitions):
+                    if parts.counts[p] == 0:
+                        continue
+                    futs.append((p, pool.submit(
+                        lambda t=parts.partition(p): serialize_table(t))))
+            for p, fut in futs:
+                data = fut.result()
+                bytes_m.add(len(data))
+                blocks[p].append(data)
+        # read side (ref RapidsShuffleThreadedReaderBase + coalesce)
+        with cf.ThreadPoolExecutor(max_workers=nthreads) as pool:
+            for p in range(self.num_partitions):
+                if not blocks[p]:
+                    continue
+                tables = list(pool.map(deserialize_table, blocks[p]))
+                import pyarrow as pa
+                with ctx.semaphore.held():
+                    yield ColumnarBatch.from_arrow(pa.concat_tables(tables))
+
+    # --------------------------------------------------------- CACHE_ONLY
+    def _device_resident(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        """Batches never leave the device (UCX-mode single-process analog)."""
+        catalog = ShuffleCatalog(ctx)
+        try:
+            for batch in self.children[0].execute(ctx):
+                with ctx.semaphore.held():
+                    parts = partition_batch(batch, self.keys,
+                                            self.num_partitions,
+                                            self.part_mode)
+                    for p in range(self.num_partitions):
+                        if parts.counts[p] == 0:
+                            continue
+                        t = parts.partition(p)
+                        catalog.put(p, ColumnarBatch.from_arrow(t))
+            for p in range(self.num_partitions):
+                got = catalog.fetch(p)
+                if got:
+                    with ctx.semaphore.held():
+                        yield concat_batches(got)
+        finally:
+            catalog.close()
+
+    def describe(self):
+        k = ", ".join(e.name_hint for e in self.keys)
+        return (f"ShuffleExchange[{self.part_mode}, n={self.num_partitions}"
+                f", keys=({k})]")
+
+
+class CpuShuffleExchangeExec(TpuExec):
+    is_tpu = False
+
+    def __init__(self, child: TpuExec, num_partitions: int,
+                 keys: Sequence[Expression], mode: str):
+        super().__init__([child])
+        self.num_partitions = num_partitions
+        self.keys = list(keys)
+        self.mode = mode
+
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema()
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        import numpy as np
+        import pyarrow as pa
+        tables = [b.to_arrow() for b in self.children[0].execute(ctx)]
+        if not tables:
+            return
+        t = pa.concat_tables(tables)
+        if self.mode == "single" or self.num_partitions == 1:
+            yield ColumnarBatch.from_arrow(t)
+            return
+        if self.mode == "roundrobin" or not self.keys:
+            pid = np.arange(t.num_rows) % self.num_partitions
+        else:
+            batch = ColumnarBatch.from_arrow(t, pad=False)
+            h = np.full(t.num_rows, 42, dtype=np.uint64)
+            for k in self.keys:
+                from ..exprs.arithmetic import arrow_to_masked_numpy
+                v, ok = arrow_to_masked_numpy(k.eval_host(batch))
+                hv = np.asarray(
+                    v, dtype=np.float64).view(np.uint64) if \
+                    np.issubdtype(np.asarray(v).dtype, np.floating) else \
+                    np.asarray(v).astype(np.int64).view(np.uint64)
+                h = h * np.uint64(31) + np.where(ok, hv, np.uint64(7))
+            pid = (h % np.uint64(self.num_partitions)).astype(np.int64)
+        for p in range(self.num_partitions):
+            sub = t.filter(pa.array(pid == p))
+            if sub.num_rows:
+                yield ColumnarBatch.from_arrow(sub)
+
+    def describe(self):
+        return f"CpuShuffleExchange[{self.mode}, n={self.num_partitions}]"

@@ -1,0 +1,147 @@
+"""Device-side batch partitioning (ref GpuPartitioning.scala:37 —
+hash / round-robin / range / single, followed by contiguous split).
+
+TPU-first: partition ids are computed with a murmur-style uint32 mixer in
+one fused kernel, rows are grouped by ONE stable lax.sort on partition id
+(the contiguousSplit analog), per-partition counts come from segment_sum, and
+a single host sync of the count vector lets the host slice out per-partition
+views with no further device work.
+
+Hash details: 32-bit mixing only (TPU has no 64-bit bitcast); floats are
+canonicalized (-0.0, NaN) then bitcast f32->u32 (f64 keys hash via their f32
+image — equal keys still hash equal, which is the only requirement); the
+exact hash differs from Spark's Murmur3 — partition placement is engine
+internal, so only determinism matters (ref GpuHashPartitioningBase uses
+cudf Murmur3 for the same internal purpose).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import ColumnarBatch, DeviceColumn
+from ..exprs.base import DVal, EvalContext, Expression
+from ..types import Schema
+
+__all__ = ["hash_partition_ids", "partition_batch", "PartitionedBatches"]
+
+_PART_CACHE: Dict[Tuple, object] = {}
+
+_M1 = jnp.uint32(0x85EBCA6B)
+_M2 = jnp.uint32(0xC2B2AE35)
+
+
+def _mix32(h):
+    h = h ^ (h >> jnp.uint32(16))
+    h = h * _M1
+    h = h ^ (h >> jnp.uint32(13))
+    h = h * _M2
+    h = h ^ (h >> jnp.uint32(16))
+    return h
+
+
+def _col_hash_u32(v: DVal):
+    d = v.data
+    if jnp.issubdtype(d.dtype, jnp.floating):
+        f = d.astype(jnp.float32)
+        f = jnp.where(f == 0.0, jnp.zeros_like(f), f)
+        f = jnp.where(jnp.isnan(f), jnp.full_like(f, jnp.nan), f)
+        h = jax.lax.bitcast_convert_type(f, jnp.uint32)
+    elif d.dtype == jnp.bool_:
+        h = d.astype(jnp.uint32)
+    else:
+        x = d.astype(jnp.int64)
+        lo = (x & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
+        hi = (x >> jnp.int64(32)).astype(jnp.uint32)
+        h = lo ^ _mix32(hi)
+    # null contributes a fixed tag so null keys land together
+    return jnp.where(v.validity, _mix32(h), jnp.uint32(42))
+
+
+def _build_pid_kernel(key_exprs: Sequence[Expression], schema: Schema,
+                      mode: str):
+    dtypes = [f.dtype for f in schema.fields]
+
+    @functools.partial(jax.jit, static_argnums=(2, 3))
+    def kernel(cols, num_rows, padded_len, num_parts):
+        dvals = [None if c is None else DVal(c[0], c[1], dt)
+                 for c, dt in zip(cols, dtypes)]
+        ctx = EvalContext(schema, dvals, num_rows, padded_len)
+        if mode == "hash":
+            h = jnp.full(padded_len, jnp.uint32(42))
+            for e in key_exprs:
+                h = _mix32(h * jnp.uint32(31) + _col_hash_u32(
+                    e.eval_device(ctx)))
+            pid = (h % jnp.uint32(num_parts)).astype(jnp.int32)
+        elif mode == "roundrobin":
+            pid = (jnp.arange(padded_len, dtype=jnp.int32)
+                   % jnp.int32(num_parts))
+        else:  # single
+            pid = jnp.zeros(padded_len, dtype=jnp.int32)
+        # padding rows go to a virtual partition so they drop out
+        pid = jnp.where(ctx.row_mask(), pid, jnp.int32(num_parts))
+        return pid
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _split_kernel(arrays, pid, padded_len, num_parts):
+    """Stable sort rows by partition id (index-only), gather all columns;
+    return sorted columns + per-partition row counts (contiguous-split)."""
+    perm0 = jnp.arange(padded_len, dtype=jnp.int32)
+    s_pid, perm = jax.lax.sort((pid, perm0), num_keys=1, is_stable=True)
+    counts = jax.ops.segment_sum(jnp.ones(padded_len, jnp.int64),
+                                 s_pid.astype(jnp.int32),
+                                 num_segments=num_parts)
+    cols = [(jnp.take(d, perm), jnp.take(v, perm)) for d, v in arrays]
+    return cols, counts
+
+
+def hash_partition_ids(batch: ColumnarBatch, keys: Sequence[Expression],
+                       num_parts: int, mode: str = "hash"):
+    key = (tuple(e.key() for e in keys),
+           tuple((f.name, f.dtype.name) for f in batch.schema.fields), mode)
+    kern = _PART_CACHE.get(key)
+    if kern is None:
+        kern = _build_pid_kernel(keys, batch.schema, mode)
+        _PART_CACHE[key] = kern
+    cols = [(c.data, c.validity) if isinstance(c, DeviceColumn) else None
+            for c in batch.columns]
+    return kern(cols, jnp.int32(batch.num_rows), batch.padded_len, num_parts)
+
+
+class PartitionedBatches:
+    """Result of partitioning one batch: per-partition slices sharing the
+    sorted buffers (zero-copy views until materialized)."""
+
+    def __init__(self, sorted_cols, counts: np.ndarray, schema: Schema):
+        self.sorted_cols = sorted_cols
+        self.counts = counts
+        self.offsets = np.concatenate([[0], np.cumsum(counts)])
+        self.schema = schema
+
+    def partition(self, p: int) -> "object":
+        """Arrow table for partition p (host materialization for shuffle)."""
+        import pyarrow as pa
+        start, n = int(self.offsets[p]), int(self.counts[p])
+        cols = []
+        for (d, v), f in zip(self.sorted_cols, self.schema.fields):
+            dc = DeviceColumn(d[start:start + n], v[start:start + n], f.dtype)
+            cols.append(dc.to_arrow(n))
+        return pa.Table.from_arrays(cols, names=self.schema.names())
+
+
+def partition_batch(batch: ColumnarBatch, keys: Sequence[Expression],
+                    num_parts: int, mode: str = "hash") -> PartitionedBatches:
+    assert batch.all_device, "partitioning requires device batch"
+    pid = hash_partition_ids(batch, keys, num_parts, mode)
+    arrays = [(c.data, c.validity) for c in batch.columns]
+    # num_parts+1: the virtual padding partition sorts last and is dropped
+    cols, counts = _split_kernel(arrays, pid, batch.padded_len, num_parts + 1)
+    counts = np.asarray(counts)[:num_parts]
+    return PartitionedBatches(cols, counts, batch.schema)

@@ -1,0 +1,125 @@
+"""Multi-chip SPMD tests on the 8-virtual-device CPU mesh.
+
+Validates the ICI exchange design (local agg -> all_to_all -> merge) against
+the single-device engine — the distributed analog of the reference's
+local-cluster tests (SURVEY.md section 4.3).
+"""
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+import jax
+
+from data_gen import DoubleGen, IntGen, gen_df
+from harness import tpu_session
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.exprs import ColumnRef, GreaterThan, Literal
+from spark_rapids_tpu.exprs.aggregates import (Average, Count, CountStar,
+                                               Max, Min, Sum)
+from spark_rapids_tpu.parallel import distributed_groupby, make_mesh
+
+
+def _mesh(n=8):
+    devs = jax.devices("cpu")[:n]
+    if len(devs) < n:
+        pytest.skip(f"need {n} cpu devices")
+    return make_mesh(devices=devs)
+
+
+def _table(n=4096, key_hi=37, seed=0):
+    df = gen_df({"k": IntGen(lo=0, hi=key_hi),
+                 "v": IntGen(lo=-1000, hi=1000, nullable=False),
+                 "d": DoubleGen(with_special=False)}, n=n, seed=seed)
+    return pa.Table.from_pandas(df)
+
+
+def _expected(table, keys, agg_map):
+    df = table.to_pandas()
+    if keys:
+        g = df.groupby(keys, dropna=False)
+        out = g.agg(**agg_map).reset_index()
+    else:
+        out = pd.DataFrame([{k: f(df) for k, f in agg_map.items()}])
+    return out
+
+
+def test_distributed_grouped_sum_count():
+    mesh = _mesh()
+    t = _table()
+    out = distributed_groupby(
+        mesh, t, ["k"],
+        [Sum(ColumnRef("v")).with_name("s"),
+         CountStar("n"), Min(ColumnRef("v")).with_name("mn"),
+         Max(ColumnRef("v")).with_name("mx")])
+    got = out.to_pandas().sort_values("k", na_position="first") \
+        .reset_index(drop=True)
+    df = t.to_pandas()
+    want = (df.groupby("k", dropna=False)
+            .agg(s=("v", "sum"), n=("v", "size"), mn=("v", "min"),
+                 mx=("v", "max"))
+            .reset_index().sort_values("k", na_position="first")
+            .reset_index(drop=True))
+    assert len(got) == len(want)
+    np.testing.assert_array_equal(got["s"].to_numpy(), want["s"].to_numpy())
+    np.testing.assert_array_equal(got["n"].to_numpy(), want["n"].to_numpy())
+    np.testing.assert_array_equal(got["mn"].to_numpy(), want["mn"].to_numpy())
+    np.testing.assert_array_equal(got["mx"].to_numpy(), want["mx"].to_numpy())
+
+
+def test_distributed_global_agg():
+    mesh = _mesh()
+    t = _table()
+    out = distributed_groupby(
+        mesh, t, [],
+        [Sum(ColumnRef("v")).with_name("s"), CountStar("n")])
+    got = out.to_pandas()
+    df = t.to_pandas()
+    assert len(got) == 1
+    assert got["s"][0] == df["v"].sum()
+    assert got["n"][0] == len(df)
+
+
+def test_distributed_filtered_agg():
+    mesh = _mesh()
+    t = _table()
+    pred = GreaterThan(ColumnRef("v"), Literal(0))
+    out = distributed_groupby(
+        mesh, t, ["k"],
+        [Sum(ColumnRef("v")).with_name("s"), CountStar("n")],
+        pre_filter=pred)
+    df = t.to_pandas()
+    df = df[df["v"] > 0]
+    want = (df.groupby("k", dropna=False)
+            .agg(s=("v", "sum"), n=("v", "size")).reset_index())
+    got = out.to_pandas()
+    gm = {(None if pd.isna(k) else k): (s, n)
+          for k, s, n in zip(got["k"], got["s"], got["n"])}
+    wm = {(None if pd.isna(k) else k): (s, n)
+          for k, s, n in zip(want["k"], want["s"], want["n"])}
+    assert gm == wm
+
+
+def test_distributed_avg_matches_local():
+    mesh = _mesh()
+    t = _table(n=2048, key_hi=5)
+    outdf = distributed_groupby(
+        mesh, t, ["k"],
+        [Average(ColumnRef("d")).with_name("a")]).to_pandas()
+    df = t.to_pandas()
+    want = df.groupby("k", dropna=False)["d"].mean().reset_index()
+    got = outdf.sort_values("k", na_position="first").reset_index(drop=True)
+    want = want.sort_values("k", na_position="first").reset_index(drop=True)
+    np.testing.assert_allclose(got["a"].to_numpy(dtype=float),
+                               want["d"].to_numpy(dtype=float),
+                               rtol=1e-9, equal_nan=True)
+
+
+def test_distributed_groups_are_disjoint():
+    """Each device must own a disjoint key set after the all_to_all."""
+    mesh = _mesh()
+    t = _table(n=1024, key_hi=50)
+    out = distributed_groupby(mesh, t, ["k"],
+                              [CountStar("n")])
+    ks = out.to_pandas()["k"]
+    assert len(ks) == len(set(ks.fillna(-999)))
