@@ -152,6 +152,25 @@ class DataFrame:
         df.plan.global_sort = False
         return df
 
+    def with_window_column(self, name: str, fn, partition_by=(),
+                           order_by=(), frame=None) -> "DataFrame":
+        """Add a window-function column (ref GpuWindowExec). `fn` is a
+        WindowFunction or AggregateExpression; frame is None (Spark default)
+        or ('rows', lo, hi) with None = unbounded."""
+        from ..plan.logical import SortOrder, Window, WindowSpec
+        pks = [_as_expr(c) for c in partition_by]
+        obs = []
+        for o in order_by:
+            if isinstance(o, SortOrder):
+                obs.append(o)
+            elif isinstance(o, str):
+                obs.append(SortOrder(ColumnRef(o), True))
+            else:
+                obs.append(SortOrder(_to_expr(o), True))
+        spec = WindowSpec(pks, obs, frame)
+        return DataFrame(self.session,
+                         Window([(fn, spec, name)], self.plan))
+
     def limit(self, n: int) -> "DataFrame":
         return DataFrame(self.session, L.GlobalLimit(n, self.plan))
 

@@ -154,6 +154,65 @@ def abs(c) -> Col: return Col(E.Abs(_to_expr(c)))
 def cast(c, dtype) -> Col: return Col(E.Cast(_to_expr(c), _dtype_of(dtype)))
 
 
+# --- datetime -------------------------------------------------------------
+def year(c) -> Col: return Col(E.Year(_to_expr(c)))
+def month(c) -> Col: return Col(E.Month(_to_expr(c)))
+def dayofmonth(c) -> Col: return Col(E.DayOfMonth(_to_expr(c)))
+def hour(c) -> Col: return Col(E.Hour(_to_expr(c)))
+def minute(c) -> Col: return Col(E.Minute(_to_expr(c)))
+def second(c) -> Col: return Col(E.Second(_to_expr(c)))
+def dayofweek(c) -> Col: return Col(E.DayOfWeek(_to_expr(c)))
+def weekday(c) -> Col: return Col(E.WeekDay(_to_expr(c)))
+def dayofyear(c) -> Col: return Col(E.DayOfYear(_to_expr(c)))
+def quarter(c) -> Col: return Col(E.Quarter(_to_expr(c)))
+def date_add(c, days) -> Col: return Col(E.DateAdd(_to_expr(c), _to_expr(days)))
+def date_sub(c, days) -> Col: return Col(E.DateSub(_to_expr(c), _to_expr(days)))
+def datediff(end, start) -> Col:
+    return Col(E.DateDiff(_to_expr(end), _to_expr(start)))
+
+
+# --- strings ----------------------------------------------------------------
+def length(c) -> Col: return Col(E.Length(_to_expr(c)))
+def upper(c) -> Col: return Col(E.Upper(_to_expr(c)))
+def lower(c) -> Col: return Col(E.Lower(_to_expr(c)))
+def substring(c, pos, ln=None) -> Col:
+    return Col(E.Substring(_to_expr(c), pos, ln))
+def concat(*cols) -> Col:
+    return Col(E.ConcatStrings(*[_to_expr(c) for c in cols]))
+def contains(c, s) -> Col: return Col(E.Contains(_to_expr(c), s))
+def startswith(c, s) -> Col: return Col(E.StartsWith(_to_expr(c), s))
+def endswith(c, s) -> Col: return Col(E.EndsWith(_to_expr(c), s))
+def like(c, pattern) -> Col: return Col(E.Like(_to_expr(c), pattern))
+def rlike(c, pattern) -> Col: return Col(E.RLike(_to_expr(c), pattern))
+def regexp_replace(c, pattern, repl) -> Col:
+    return Col(E.RegExpReplace(_to_expr(c), pattern, repl))
+def regexp_extract(c, pattern, group=1) -> Col:
+    return Col(E.RegExpExtract(_to_expr(c), pattern, group))
+def trim(c) -> Col: return Col(E.StringTrim(_to_expr(c)))
+def ltrim(c) -> Col: return Col(E.StringTrimLeft(_to_expr(c)))
+def rtrim(c) -> Col: return Col(E.StringTrimRight(_to_expr(c)))
+def lpad(c, ln, pad=" ") -> Col: return Col(E.Lpad(_to_expr(c), ln, pad))
+def rpad(c, ln, pad=" ") -> Col: return Col(E.Rpad(_to_expr(c), ln, pad))
+def reverse(c) -> Col: return Col(E.Reverse(_to_expr(c)))
+def repeat(c, n) -> Col: return Col(E.StringRepeat(_to_expr(c), n))
+def initcap(c) -> Col: return Col(E.InitCap(_to_expr(c)))
+def locate(substr, c) -> Col: return Col(E.StringLocate(substr, _to_expr(c)))
+def split(c, pattern, limit=-1) -> Col:
+    return Col(E.StringSplit(_to_expr(c), pattern, limit))
+def substring_index(c, delim, count) -> Col:
+    return Col(E.SubstringIndex(_to_expr(c), delim, count))
+
+
+# --- window -----------------------------------------------------------------
+def row_number(): return E.RowNumber()
+def rank(): return E.Rank()
+def dense_rank(): return E.DenseRank()
+def lag(c, offset=1, default=None):
+    return E.Lag(_to_expr(c), offset, default)
+def lead(c, offset=1, default=None):
+    return E.Lead(_to_expr(c), offset, default)
+
+
 def asc(name: str):
     return col(name).asc()
 

@@ -1,0 +1,419 @@
+"""Window exec (ref window/GpuWindowExec.scala:146 + specializations:
+GpuRunningWindowExec scan-based running aggs, GpuBatchedBoundedWindowExec
+bounded frames, BasicWindowCalc).
+
+TPU-first, one fused kernel: ONE index-only lax.sort by (partition keys,
+order keys), segment ids from boundaries, then every window column is
+segment arithmetic on the VPU:
+  row_number  = idx - partition_start + 1
+  rank        = order-run start - partition_start + 1 (associative max scan)
+  dense_rank  = per-partition cumsum of order-run starts
+  lag/lead    = shifted gather with partition-boundary nulling
+  unbounded aggregate frames = segment reduction broadcast via take(gid)
+  running / bounded-rows sum,count,avg frames = partition-local prefix sums
+    (prefix[i+hi] - prefix[i+lo-1])
+Results scatter back to input row order through the inverse permutation, so
+the exec preserves row order like the reference does.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import ColumnarBatch, DeviceColumn, concat_batches
+from ..exprs.aggregates import AggregateExpression, Average, Count, CountStar, \
+    Max, Min, Sum
+from ..exprs.base import DVal, EvalContext
+from ..exprs.window_fns import (DenseRank, Lag, Lead, NTile, Rank, RowNumber,
+                                WindowFunction)
+from ..mem import SpillableBatch, with_retry_no_split
+from ..plan.logical import WindowSpec
+from ..types import FLOAT64, INT32, INT64, Schema, StructField
+from .base import ExecContext, TpuExec
+from .encoding import grouping_operands, operands_equal, order_key_operands
+
+__all__ = ["TpuWindowExec", "CpuWindowExec"]
+
+_WIN_CACHE: Dict[Tuple, object] = {}
+
+
+def _seg_broadcast(per_group, gid):
+    return jnp.take(per_group, jnp.clip(gid, 0, per_group.shape[0] - 1))
+
+
+def _build_window_kernel(window_exprs, schema: Schema, padded_len_key=None):
+    dtypes = [f.dtype for f in schema.fields]
+
+    @functools.partial(jax.jit, static_argnums=(2,))
+    def kernel(cols, num_rows, padded_len):
+        P = padded_len
+        dvals = [DVal(c[0], c[1], dt) for c, dt in zip(cols, dtypes)]
+        ctx = EvalContext(schema, dvals, num_rows, P)
+        row_mask = ctx.row_mask()
+        outs = []
+        for fn, spec, _name in window_exprs:
+            # --- sort by (partition, order) --------------------------------
+            pad_flag = jnp.where(row_mask, jnp.uint8(0), jnp.uint8(1))
+            operands = [pad_flag]
+            n_part_ops = 1
+            for pk in spec.partition_by:
+                operands.extend(grouping_operands(pk.eval_device(ctx)))
+            n_part_ops = len(operands)
+            for o in spec.order_by:
+                operands.extend(order_key_operands(
+                    o.expr.eval_device(ctx), o.ascending, o.nulls_first))
+            perm0 = jnp.arange(P, dtype=jnp.int32)
+            srt = jax.lax.sort(tuple(operands + [perm0]),
+                               num_keys=len(operands), is_stable=True)
+            perm = srt[len(operands)]
+            s_ops = srt[:len(operands)]
+            idx = jnp.arange(P, dtype=jnp.int32)
+            # partition boundaries
+            pdiff = jnp.zeros(P, dtype=jnp.bool_)
+            for op in s_ops[1:n_part_ops]:
+                prev = jnp.roll(op, 1)
+                pdiff = jnp.logical_or(
+                    pdiff, jnp.logical_not(operands_equal(op, prev)))
+            pflags = jnp.logical_and(jnp.logical_or(idx == 0, pdiff), row_mask)
+            gid = jnp.where(row_mask,
+                            (jnp.cumsum(pflags) - 1).astype(jnp.int32), P)
+            part_start = jax.lax.associative_scan(
+                jnp.maximum, jnp.where(pflags, idx, 0))
+            # order-value run boundaries (for rank/dense_rank)
+            odiff = pdiff
+            for op in s_ops[n_part_ops:]:
+                prev = jnp.roll(op, 1)
+                odiff = jnp.logical_or(
+                    odiff, jnp.logical_not(operands_equal(op, prev)))
+            oflags = jnp.logical_and(jnp.logical_or(idx == 0, odiff), row_mask)
+
+            val = self_validity = None
+            if isinstance(fn, (RowNumber,)):
+                out_sorted = (idx - part_start + 1).astype(jnp.int32)
+                ov_sorted = row_mask
+            elif isinstance(fn, Rank):
+                run_start = jax.lax.associative_scan(
+                    jnp.maximum, jnp.where(oflags, idx, 0))
+                out_sorted = (run_start - part_start + 1).astype(jnp.int32)
+                ov_sorted = row_mask
+            elif isinstance(fn, DenseRank):
+                c = jnp.cumsum(oflags).astype(jnp.int32)
+                c_at_pstart = _seg_broadcast(
+                    jnp.zeros(P, jnp.int32).at[
+                        jnp.where(pflags, gid, P)].set(c, mode="drop"), gid)
+                out_sorted = (c - c_at_pstart + 1).astype(jnp.int32)
+                ov_sorted = row_mask
+            elif isinstance(fn, NTile):
+                pcount = jax.ops.segment_sum(
+                    row_mask.astype(jnp.int32), gid, num_segments=P)
+                cnt = _seg_broadcast(pcount, gid)
+                rn = idx - part_start
+                n = jnp.int32(fn.n)
+                base = cnt // n
+                rem = cnt % n
+                # Spark NTile: first `rem` buckets get base+1 rows
+                big_rows = rem * (base + 1)
+                out_sorted = jnp.where(
+                    rn < big_rows,
+                    rn // jnp.maximum(base + 1, 1),
+                    rem + (rn - big_rows) // jnp.maximum(base, 1)
+                ).astype(jnp.int32) + 1
+                ov_sorted = row_mask
+            elif isinstance(fn, (Lag, Lead)):
+                v = fn.child.eval_device(ctx)
+                sd = jnp.take(v.data, perm)
+                sv = jnp.take(v.validity, perm)
+                off = fn.offset if isinstance(fn, Lag) else -fn.offset
+                shifted_idx = idx - off
+                ok = jnp.logical_and(shifted_idx >= 0, shifted_idx < P)
+                src = jnp.clip(shifted_idx, 0, P - 1)
+                out_sorted = jnp.take(sd, src)
+                ov_sorted = jnp.logical_and(jnp.take(sv, src), ok)
+                # must stay inside the partition
+                same_part = jnp.take(gid, src) == gid
+                ov_sorted = jnp.logical_and(ov_sorted, same_part)
+                if fn.default is not None:
+                    dflt = jnp.asarray(fn.default, dtype=out_sorted.dtype)
+                    fill = jnp.logical_and(jnp.logical_not(
+                        jnp.logical_and(ok, same_part)), row_mask)
+                    out_sorted = jnp.where(fill, dflt, out_sorted)
+                    ov_sorted = jnp.logical_or(ov_sorted, fill)
+            elif isinstance(fn, AggregateExpression):
+                out_sorted, ov_sorted = _windowed_agg(
+                    fn, spec, ctx, perm, gid, part_start, idx, row_mask, P)
+            else:
+                raise NotImplementedError(type(fn).__name__)
+
+            # scatter back to original order via inverse permutation
+            inv = jnp.zeros(P, dtype=jnp.int32).at[perm].set(
+                idx, mode="drop")
+            outs.append((jnp.take(out_sorted, inv),
+                         jnp.logical_and(jnp.take(ov_sorted, inv),
+                                         row_mask)))
+        return outs
+
+    return kernel
+
+
+def _windowed_agg(fn: AggregateExpression, spec: WindowSpec, ctx, perm, gid,
+                  part_start, idx, row_mask, P):
+    """Aggregate over a window frame. Default frames follow Spark: with
+    order_by -> running (unbounded preceding..current row); without ->
+    whole partition. Explicit ('rows', lo, hi) uses prefix sums."""
+    if isinstance(fn, CountStar):
+        vd = jnp.ones(P, dtype=jnp.int64)
+        vv = row_mask
+        dt = INT64
+    else:
+        v = fn.child.eval_device(ctx)
+        vd = jnp.take(v.data, perm)
+        vv = jnp.take(v.validity, perm)
+        dt = v.dtype
+    vv = jnp.logical_and(vv, row_mask)
+
+    frame = spec.frame
+    if frame is None:
+        frame = ("rows", None, 0) if spec.order_by else ("rows", None, None)
+    kind, lo, hi = frame
+
+    whole = lo is None and hi is None
+    if whole:
+        if isinstance(fn, (Sum, Average, Count, CountStar)):
+            acc = jnp.where(vv, vd, jnp.zeros_like(vd))
+            if isinstance(fn, (Count, CountStar)):
+                acc = vv.astype(jnp.int64)
+            tot = jax.ops.segment_sum(acc.astype(
+                jnp.float64 if isinstance(fn, Average) else acc.dtype),
+                gid, num_segments=P)
+            cnt = jax.ops.segment_sum(vv.astype(jnp.int64), gid,
+                                      num_segments=P)
+            if isinstance(fn, (Count, CountStar)):
+                return _seg_broadcast(tot, gid), row_mask
+            if isinstance(fn, Average):
+                c = _seg_broadcast(cnt, gid)
+                s = _seg_broadcast(tot, gid)
+                ok = c > 0
+                return s / jnp.maximum(c, 1).astype(jnp.float64), ok
+            s = _seg_broadcast(tot, gid)
+            ok = _seg_broadcast(cnt, gid) > 0
+            return s, ok
+        if isinstance(fn, (Min, Max)):
+            from ..exprs.aggregates import _seg_max, _seg_min
+            red = _seg_min if isinstance(fn, Min) else _seg_max
+            m, cnt = red(vd, vv, gid, P)
+            return _seg_broadcast(m, gid), _seg_broadcast(cnt, gid) > 0
+        raise NotImplementedError(type(fn).__name__)
+
+    # prefix-sum frames (running / bounded rows) for sum/count/avg
+    if not isinstance(fn, (Sum, Average, Count, CountStar)):
+        raise NotImplementedError(
+            f"bounded frame for {type(fn).__name__}")
+    acc_dt = jnp.float64 if (isinstance(fn, Average)
+                             or jnp.issubdtype(vd.dtype, jnp.floating)) \
+        else jnp.int64
+    acc = jnp.where(vv, vd, jnp.zeros_like(vd)).astype(acc_dt)
+    cntv = vv.astype(jnp.int64)
+    ps = jnp.cumsum(acc)          # global prefix (inclusive)
+    pc = jnp.cumsum(cntv)
+
+    def window_sum(prefix):
+        # sum over [max(pstart, i+lo), min(pend, i+hi)] in sorted space
+        lo_i = part_start if lo is None else jnp.maximum(part_start, idx + lo)
+        pcount = jax.ops.segment_sum(row_mask.astype(jnp.int32), gid,
+                                     num_segments=P)
+        pend = part_start + _seg_broadcast(pcount, gid) - 1
+        hi_i = pend if hi is None else jnp.minimum(pend, idx + hi)
+        hi_i = jnp.clip(hi_i, 0, P - 1)
+        lo_i = jnp.clip(lo_i, 0, P)
+        upper = jnp.take(prefix, hi_i)
+        lower = jnp.where(lo_i > 0,
+                          jnp.take(prefix, jnp.maximum(lo_i - 1, 0)),
+                          jnp.zeros_like(upper))
+        empty = hi_i < lo_i
+        return jnp.where(empty, jnp.zeros_like(upper), upper - lower), empty
+
+    s, empty = window_sum(ps)
+    c, _ = window_sum(pc)
+    if isinstance(fn, (Count, CountStar)):
+        return c, row_mask
+    if isinstance(fn, Average):
+        ok = jnp.logical_and(c > 0, row_mask)
+        return s.astype(jnp.float64) / jnp.maximum(c, 1).astype(jnp.float64), ok
+    ok = jnp.logical_and(c > 0, row_mask)
+    if jnp.issubdtype(vd.dtype, jnp.integer):
+        s = s.astype(jnp.int64)
+    return s, ok
+
+
+class TpuWindowExec(TpuExec):
+    def __init__(self, window_exprs, child: TpuExec):
+        super().__init__([child])
+        self.window_exprs = list(window_exprs)
+        cs = child.output_schema()
+        fields = list(cs.fields)
+        for e, spec, name in self.window_exprs:
+            fields.append(StructField(name, e.data_type(cs), True))
+        self._schema = Schema(fields)
+
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        cs = self.children[0].output_schema()
+        key = (tuple(f"{type(e).__name__}|{n}" for e, s, n in
+                     self.window_exprs),
+               tuple((f.name, f.dtype.name) for f in cs.fields), id(self))
+        kern = _WIN_CACHE.get(key)
+        if kern is None:
+            kern = _build_window_kernel(self.window_exprs, cs)
+            _WIN_CACHE[key] = kern
+        # window needs whole partitions: single-batch goal
+        spill = [SpillableBatch(b, ctx.memory)
+                 for b in self.children[0].execute(ctx)]
+        if not spill:
+            return
+
+        def run():
+            with ctx.semaphore.held():
+                batch = concat_batches([s.get() for s in spill])
+                cols = [(c.data, c.validity) for c in batch.columns]
+                outs = kern(cols, jnp.int32(batch.num_rows),
+                            batch.padded_len)
+                new_cols = list(batch.columns)
+                for (d, v), (e, s, name) in zip(outs, self.window_exprs):
+                    new_cols.append(DeviceColumn(d, v, e.data_type(cs)))
+                return ColumnarBatch(new_cols, batch.num_rows, self._schema)
+
+        out = with_retry_no_split(run, ctx.memory)
+        for s in spill:
+            s.close()
+        yield out
+
+    def describe(self):
+        names = ", ".join(n for _, _, n in self.window_exprs)
+        return f"Window[{names}]"
+
+
+class CpuWindowExec(TpuExec):
+    is_tpu = False
+
+    def __init__(self, window_exprs, child: TpuExec):
+        super().__init__([child])
+        self.window_exprs = list(window_exprs)
+        cs = child.output_schema()
+        fields = list(cs.fields)
+        for e, spec, name in self.window_exprs:
+            fields.append(StructField(name, e.data_type(cs), True))
+        self._schema = Schema(fields)
+
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        import pandas as pd
+        import pyarrow as pa
+        tables = [b.to_arrow() for b in self.children[0].execute(ctx)]
+        if not tables:
+            return
+        t = pa.concat_tables(tables)
+        df = t.to_pandas()
+        batch = ColumnarBatch.from_arrow(t, pad=False)
+        for fn, spec, name in self.window_exprs:
+            pcols = []
+            for i, pk in enumerate(spec.partition_by):
+                pc = f"__p{i}"
+                df[pc] = pk.eval_host(batch).to_pandas()
+                pcols.append(pc)
+            ocols, asc = [], []
+            for i, o in enumerate(spec.order_by):
+                oc = f"__o{i}"
+                df[oc] = o.expr.eval_host(batch).to_pandas()
+                ocols.append(oc)
+                asc.append(o.ascending)
+            work = df.sort_values(pcols + ocols, ascending=[True] * len(pcols)
+                                  + asc, kind="mergesort",
+                                  na_position="first") if (pcols or ocols) \
+                else df
+            g = work.groupby(pcols, dropna=False, sort=False) if pcols \
+                else work.assign(__one=1).groupby("__one")
+            if isinstance(fn, RowNumber):
+                res = g.cumcount() + 1
+            elif isinstance(fn, Rank):
+                res = g[ocols[0]].rank(method="min").astype("int64") \
+                    if len(ocols) == 1 else _multi_rank(work, g, ocols, "min")
+            elif isinstance(fn, DenseRank):
+                res = g[ocols[0]].rank(method="dense").astype("int64") \
+                    if len(ocols) == 1 else _multi_rank(work, g, ocols,
+                                                        "dense")
+            elif isinstance(fn, Lag):
+                src = fn.child.eval_host(batch).to_pandas()
+                work["__v"] = src.reindex(work.index)
+                res = g["__v"].shift(fn.offset, fill_value=fn.default)
+            elif isinstance(fn, Lead):
+                src = fn.child.eval_host(batch).to_pandas()
+                work["__v"] = src.reindex(work.index)
+                res = g["__v"].shift(-fn.offset, fill_value=fn.default)
+            elif isinstance(fn, AggregateExpression):
+                res = self._host_agg(fn, spec, g, work, batch)
+            else:
+                raise NotImplementedError(type(fn).__name__)
+            df[name] = res.reindex(df.index) if hasattr(res, "reindex") \
+                else res
+            df = df.drop(columns=[c for c in df.columns
+                                  if c.startswith("__")])
+        from ..types import to_arrow
+        arrays = []
+        for f in self._schema.fields:
+            vals = [None if pd.isna(x) else x for x in df[f.name].tolist()]
+            arrays.append(pa.array(vals, type=to_arrow(f.dtype)))
+        yield ColumnarBatch.from_arrow(
+            pa.Table.from_arrays(arrays, names=self._schema.names()))
+
+    def _host_agg(self, fn, spec, g, work, batch):
+        if isinstance(fn, CountStar):
+            col = None
+        else:
+            work["__a"] = fn.child.eval_host(batch).to_pandas() \
+                .reindex(work.index)
+            col = "__a"
+        frame = spec.frame
+        if frame is None:
+            frame = ("rows", None, 0) if spec.order_by \
+                else ("rows", None, None)
+        kind, lo, hi = frame
+        if lo is None and hi is None:
+            if isinstance(fn, CountStar):
+                return g["__one" if "__one" in work.columns else
+                         work.columns[0]].transform("size")
+            m = {Sum: "sum", Min: "min", Max: "max", Average: "mean",
+                 Count: "count"}[type(fn)]
+            return g[col].transform(m)
+        # running / bounded rows
+        if isinstance(fn, CountStar):
+            work["__a"] = 1
+            col = "__a"
+        window = (hi or 0) - (lo if lo is not None else -(10**9)) + 1
+        minp = 1
+        roll = g[col].rolling(window=window if lo is not None else 10**9,
+                              min_periods=minp)
+        m = {Sum: "sum", Count: "count", Average: "mean",
+             CountStar: "count"}[type(fn)]
+        res = getattr(roll, m)()
+        if hi:
+            res = g[col].rolling(window=window, min_periods=minp).agg(m)
+        res.index = res.index.droplevel(list(range(res.index.nlevels - 1)))
+        return res
+
+    def describe(self):
+        return "CpuWindow[" + ", ".join(n for _, _, n in
+                                        self.window_exprs) + "]"
+
+
+def _multi_rank(work, g, ocols, method):
+    key = work[ocols].apply(tuple, axis=1)
+    work["__rk"] = key
+    return g["__rk"].rank(method=method).astype("int64")

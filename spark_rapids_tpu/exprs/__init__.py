@@ -12,6 +12,18 @@ from .math_fns import (Acos, Asin, Atan, Atan2, Cbrt, Ceil, Cos, Cosh, Exp,
                        ToRadians)
 from .conditional import CaseWhen, Coalesce, If, NaNvl
 from .cast import Cast
+from .datetime_fns import (DateAdd, DateDiff, DateSub, DayOfMonth, DayOfWeek,
+                           DayOfYear, Hour, Minute, Month, Quarter, Second,
+                           UnixDate, WeekDay, Year)
+from .string_fns import (ConcatStrings, Contains, EndsWith, InitCap, Length,
+                         Like, Lower, Lpad, RLike, RegExpExtract,
+                         RegExpReplace, Reverse, Rpad, StartsWith,
+                         StringLocate, StringRepeat, StringReplace,
+                         StringSplit, StringTrim, StringTrimLeft,
+                         StringTrimRight, Substring, SubstringIndex, Upper)
+from .regex_transpiler import (RegexUnsupported, sql_like_to_regex,
+                               transpile_java_regex)
+from .window_fns import DenseRank, Lag, Lead, NTile, Rank, RowNumber
 from .compiler import (DeviceProjector, compile_projection,
                        eval_predicate_device, filter_batch_device,
                        gather_batch_device)

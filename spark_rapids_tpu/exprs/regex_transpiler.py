@@ -1,0 +1,210 @@
+"""Java-regex front-end + transpiler.
+
+Reference analog: RegexParser.scala:44 / CudfRegexTranspiler:687 (2,186 LoC)
+— Spark expressions take JAVA regex semantics, the accelerator's engine
+(cudf there, Python `re` executing over Arrow here, a Pallas DFA engine
+later) has different semantics, so regexes are parsed into an AST and
+re-emitted for the target engine, REJECTING patterns whose semantics would
+silently differ (the planner then falls back, mirroring
+GpuRegExpReplaceMeta's willNotWorkOnGpu tagging).
+
+Java -> Python divergences handled:
+  * \\d \\w \\s (and negations) are ASCII in Java, Unicode in Python ->
+    rewritten to explicit ASCII classes
+  * \\Z (end before final terminator) has no Python equivalent -> reject
+  * \\G, \\R, named char classes \\p{...}, \\b inside classes -> reject
+  * octal escapes \\0nn -> \\nnn form
+  * possessive quantifiers / atomic groups pass through (Python >= 3.11)
+"""
+from __future__ import annotations
+
+import re as _re
+from typing import List, Optional, Tuple
+
+__all__ = ["RegexUnsupported", "transpile_java_regex", "RegexParser"]
+
+_D = "[0-9]"
+_ND = "[^0-9]"
+_W = "[a-zA-Z0-9_]"
+_NW = "[^a-zA-Z0-9_]"
+_S = "[ \\t\\n\\x0b\\f\\r]"
+_NS = "[^ \\t\\n\\x0b\\f\\r]"
+
+
+class RegexUnsupported(ValueError):
+    """Pattern cannot be transpiled with identical semantics."""
+
+
+class RegexParser:
+    """Minimal Java-regex tokenizer/validator. Walks the pattern once,
+    validating structure and rewriting escapes; nesting is tracked for
+    groups and classes."""
+
+    def __init__(self, pattern: str):
+        self.p = pattern
+        self.i = 0
+        self.out: List[str] = []
+        self.group_depth = 0
+
+    def error(self, msg: str):
+        raise RegexUnsupported(f"{msg} near position {self.i} in "
+                               f"{self.p!r}")
+
+    def peek(self) -> str:
+        return self.p[self.i] if self.i < len(self.p) else ""
+
+    def take(self) -> str:
+        c = self.peek()
+        self.i += 1
+        return c
+
+    # ------------------------------------------------------------------
+    def parse(self) -> str:
+        while self.i < len(self.p):
+            c = self.take()
+            if c == "\\":
+                self._escape(in_class=False)
+            elif c == "[":
+                self._char_class()
+            elif c == "(":
+                self._group_open()
+            elif c == ")":
+                self.group_depth -= 1
+                if self.group_depth < 0:
+                    self.error("unbalanced )")
+                self.out.append(c)
+            else:
+                self.out.append(c)
+        if self.group_depth != 0:
+            self.error("unbalanced (")
+        result = "".join(self.out)
+        try:
+            _re.compile(result)
+        except _re.error as e:
+            raise RegexUnsupported(f"transpiled pattern invalid: {e}")
+        return result
+
+    # ------------------------------------------------------------------
+    def _escape(self, in_class: bool):
+        c = self.take()
+        if c == "":
+            self.error("dangling backslash")
+        if c == "d":
+            self.out.append(_D if not in_class else "0-9")
+        elif c == "D":
+            if in_class:
+                self.error("\\D inside character class")
+            self.out.append(_ND)
+        elif c == "w":
+            self.out.append(_W if not in_class else "a-zA-Z0-9_")
+        elif c == "W":
+            if in_class:
+                self.error("\\W inside character class")
+            self.out.append(_NW)
+        elif c == "s":
+            self.out.append(_S if not in_class else " \\t\\n\\x0b\\f\\r")
+        elif c == "S":
+            if in_class:
+                self.error("\\S inside character class")
+            self.out.append(_NS)
+        elif c in ("Z", "G", "R", "X"):
+            self.error(f"\\{c} is not supported")
+        elif c == "p" or c == "P":
+            self.error("\\p{...} named classes are not supported")
+        elif c == "b" and in_class:
+            self.error("\\b inside character class")
+        elif c == "z":
+            self.out.append("\\Z")  # Java \z == Python \Z
+        elif c == "0":
+            # Java octal \0nn -> Python \nnn
+            digits = ""
+            while self.peek().isdigit() and len(digits) < 3:
+                digits += self.take()
+            if not digits:
+                self.error("bad octal escape")
+            self.out.append("\\" + digits.zfill(3))
+        else:
+            self.out.append("\\" + c)
+
+    # ------------------------------------------------------------------
+    def _char_class(self):
+        self.out.append("[")
+        if self.peek() == "^":
+            self.out.append(self.take())
+        if self.peek() == "]":
+            self.out.append("\\]")
+            self.take()
+        while True:
+            c = self.take()
+            if c == "":
+                self.error("unterminated character class")
+            if c == "]":
+                self.out.append("]")
+                return
+            if c == "\\":
+                self._escape(in_class=True)
+            elif c == "[":
+                # Java supports nested classes / && intersection; reject
+                self.error("nested character class")
+            elif c == "&" and self.peek() == "&":
+                self.error("character class intersection &&")
+            else:
+                self.out.append(c)
+
+    # ------------------------------------------------------------------
+    def _group_open(self):
+        self.group_depth += 1
+        self.out.append("(")
+        if self.peek() != "?":
+            return
+        self.out.append(self.take())  # '?'
+        c = self.peek()
+        if c in (":", "=", "!", ">"):
+            self.out.append(self.take())
+        elif c == "<":
+            self.out.append(self.take())
+            n = self.peek()
+            if n in ("=", "!"):
+                self.out.append(self.take())  # lookbehind
+            else:
+                # named group (?<name>...) -> Python (?P<name>...)
+                self.out.pop()
+                self.out.append("P<")
+        elif c in ("i", "m", "s", "u", "x", "d", "-"):
+            while self.peek() and self.peek() not in ":)":
+                f = self.take()
+                if f in ("u", "d"):
+                    self.error(f"inline flag ({f}) is not supported")
+                self.out.append(f)
+            if self.peek():
+                self.out.append(self.take())
+        else:
+            self.error(f"unsupported group construct (?{c}")
+
+
+def transpile_java_regex(pattern: str) -> str:
+    """Java regex -> semantically-equivalent Python regex, or raise
+    RegexUnsupported (planner turns that into a CPU... here a
+    fallback-to-row reason, mirroring the reference)."""
+    return RegexParser(pattern).parse()
+
+
+def sql_like_to_regex(pattern: str, escape: str = "\\") -> str:
+    """SQL LIKE pattern -> anchored regex (ref GpuLike)."""
+    out = ["^"]
+    i = 0
+    while i < len(pattern):
+        c = pattern[i]
+        if c == escape and i + 1 < len(pattern):
+            out.append(_re.escape(pattern[i + 1]))
+            i += 2
+            continue
+        if c == "%":
+            out.append(".*")
+        elif c == "_":
+            out.append(".")
+        else:
+            out.append(_re.escape(c))
+        i += 1
+    out.append("$")
+    return "".join(out)

@@ -1,0 +1,446 @@
+"""String expressions (ref stringFunctions.scala, 2,377 LoC).
+
+Strings are host-resident (Arrow) in round 1 — every expression here is a
+vectorized Arrow kernel, honestly tagged host-only so the planner records
+the fallback (the reference's TypeSig machinery makes exactly this per-type
+fallback cheap, SURVEY.md section 7 hard-part #2). Numeric outputs (length,
+locate, comparisons) are H2D'd by the project exec so downstream compute
+stays on the TPU. Regex expressions go through the Java->Python transpiler
+(regex_transpiler.py) and REJECT patterns with divergent semantics.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..types import (BOOL, INT32, STRING, Schema, TypeSig, TypeEnum)
+from .base import Expression, Unsupported
+
+__all__ = ["Length", "Upper", "Lower", "Substring", "ConcatStrings",
+           "Contains", "StartsWith", "EndsWith", "Like", "RLike",
+           "RegExpReplace", "RegExpExtract", "StringTrim", "StringTrimLeft",
+           "StringTrimRight", "StringReplace", "StringLocate", "Lpad",
+           "Rpad", "Reverse", "StringRepeat", "InitCap", "StringSplit",
+           "SubstringIndex"]
+
+_str_sig = TypeSig([TypeEnum.STRING])
+
+
+class _HostStringExpr(Expression):
+    """Base: runs on host Arrow; device tagging returns an explicit reason
+    so explain output mirrors the reference's NOT_ON_GPU messages."""
+
+    def device_unsupported_reason(self, schema: Schema) -> Optional[str]:
+        return f"{type(self).__name__}: string expressions run on host"
+
+    def key(self):
+        kids = ",".join(c.key() for c in self.children)
+        return f"{type(self).__name__}({kids})"
+
+
+class Length(_HostStringExpr):
+    def __init__(self, child):
+        self.children = [child]
+
+    def data_type(self, schema):
+        return INT32
+
+    def eval_host(self, batch):
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        return pc.cast(pc.utf8_length(self.children[0].eval_host(batch)),
+                       pa.int32())
+
+
+class Upper(_HostStringExpr):
+    def __init__(self, child):
+        self.children = [child]
+
+    def data_type(self, schema):
+        return STRING
+
+    def eval_host(self, batch):
+        import pyarrow.compute as pc
+        return pc.utf8_upper(self.children[0].eval_host(batch))
+
+
+class Lower(_HostStringExpr):
+    def __init__(self, child):
+        self.children = [child]
+
+    def data_type(self, schema):
+        return STRING
+
+    def eval_host(self, batch):
+        import pyarrow.compute as pc
+        return pc.utf8_lower(self.children[0].eval_host(batch))
+
+
+class Substring(_HostStringExpr):
+    """Spark substring: 1-based, pos 0 treated as 1, negative from end."""
+
+    def __init__(self, child, pos: int, length: Optional[int] = None):
+        self.children = [child]
+        self.pos = pos
+        self.length = length
+
+    def data_type(self, schema):
+        return STRING
+
+    def eval_host(self, batch):
+        import pyarrow.compute as pc
+        arr = self.children[0].eval_host(batch)
+        if self.length is not None and self.length <= 0:
+            return pc.utf8_slice_codeunits(arr, 0, 0)  # "" (nulls preserved)
+        start = self.pos - 1 if self.pos > 0 else self.pos  # 0 acts like 1
+        if self.length is None:
+            stop = None
+        elif start >= 0:
+            stop = start + self.length
+        else:  # negative start: stop only if it stays negative
+            stop = start + self.length if start + self.length < 0 else None
+        return pc.utf8_slice_codeunits(arr, start, stop)
+
+    def key(self):
+        return (f"substr({self.children[0].key()},{self.pos},"
+                f"{self.length})")
+
+
+class ConcatStrings(_HostStringExpr):
+    """concat(s1, s2, ...): null if any input null (Spark concat)."""
+
+    def __init__(self, *children):
+        self.children = list(children)
+
+    def data_type(self, schema):
+        return STRING
+
+    def eval_host(self, batch):
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        arrs = [c.eval_host(batch) for c in self.children]
+        # unify string width (pandas3 produces large_string)
+        target = pa.large_string() if any(
+            pa.types.is_large_string(a.type) for a in arrs) else pa.string()
+        arrs = [pc.cast(a, target) for a in arrs]
+        return pc.binary_join_element_wise(
+            *arrs, pa.scalar("", type=target), null_handling="emit_null")
+
+
+class _PatternPredicate(_HostStringExpr):
+    def __init__(self, child, pattern: str):
+        self.children = [child]
+        self.pattern = pattern
+
+    def data_type(self, schema):
+        return BOOL
+
+    def key(self):
+        return (f"{type(self).__name__}({self.children[0].key()},"
+                f"{self.pattern!r})")
+
+
+class Contains(_PatternPredicate):
+    def eval_host(self, batch):
+        import pyarrow.compute as pc
+        return pc.match_substring(self.children[0].eval_host(batch),
+                                  self.pattern)
+
+
+class StartsWith(_PatternPredicate):
+    def eval_host(self, batch):
+        import pyarrow.compute as pc
+        return pc.starts_with(self.children[0].eval_host(batch),
+                              self.pattern)
+
+
+class EndsWith(_PatternPredicate):
+    def eval_host(self, batch):
+        import pyarrow.compute as pc
+        return pc.ends_with(self.children[0].eval_host(batch), self.pattern)
+
+
+class Like(_PatternPredicate):
+    """SQL LIKE (ref GpuLike)."""
+
+    def __init__(self, child, pattern: str, escape: str = "\\"):
+        super().__init__(child, pattern)
+        from .regex_transpiler import sql_like_to_regex
+        self._regex = sql_like_to_regex(pattern, escape)
+
+    def eval_host(self, batch):
+        import pyarrow.compute as pc
+        return pc.match_substring_regex(self.children[0].eval_host(batch),
+                                        self._regex)
+
+
+class RLike(_PatternPredicate):
+    """Java-regex RLIKE through the transpiler (ref GpuRLike +
+    CudfRegexTranspiler)."""
+
+    def __init__(self, child, pattern: str):
+        super().__init__(child, pattern)
+        from .regex_transpiler import transpile_java_regex
+        self._regex = transpile_java_regex(pattern)  # raises if unsupported
+
+    def eval_host(self, batch):
+        import pyarrow.compute as pc
+        return pc.match_substring_regex(self.children[0].eval_host(batch),
+                                        self._regex)
+
+
+class RegExpReplace(_HostStringExpr):
+    def __init__(self, child, pattern: str, replacement: str):
+        self.children = [child]
+        self.pattern = pattern
+        self.replacement = replacement
+        from .regex_transpiler import transpile_java_regex
+        self._regex = transpile_java_regex(pattern)
+
+    def data_type(self, schema):
+        return STRING
+
+    def eval_host(self, batch):
+        import pyarrow.compute as pc
+        # Java $1 backrefs -> arrow/RE2 \1
+        import re
+        repl = re.sub(r"\$(\d)", r"\\\1", self.replacement)
+        return pc.replace_substring_regex(
+            self.children[0].eval_host(batch), self._regex, repl)
+
+    def key(self):
+        return (f"regexp_replace({self.children[0].key()},"
+                f"{self.pattern!r},{self.replacement!r})")
+
+
+class RegExpExtract(_HostStringExpr):
+    def __init__(self, child, pattern: str, group: int = 1):
+        self.children = [child]
+        self.pattern = pattern
+        self.group = group
+        from .regex_transpiler import transpile_java_regex
+        self._regex = transpile_java_regex(pattern)
+
+    def data_type(self, schema):
+        return STRING
+
+    def eval_host(self, batch):
+        import re
+        import pyarrow as pa
+        arr = self.children[0].eval_host(batch)
+        rx = re.compile(self._regex)
+        out = []
+        for v in arr.to_pylist():
+            if v is None:
+                out.append(None)
+            else:
+                m = rx.search(v)
+                out.append("" if m is None else (m.group(self.group) or ""))
+        return pa.array(out, type=pa.string())
+
+    def key(self):
+        return (f"regexp_extract({self.children[0].key()},"
+                f"{self.pattern!r},{self.group})")
+
+
+class _TrimBase(_HostStringExpr):
+    pc_fn = "utf8_trim_whitespace"
+
+    def __init__(self, child, chars: Optional[str] = None):
+        self.children = [child]
+        self.chars = chars
+
+    def data_type(self, schema):
+        return STRING
+
+    def eval_host(self, batch):
+        import pyarrow.compute as pc
+        arr = self.children[0].eval_host(batch)
+        if self.chars is None:
+            return getattr(pc, self.pc_fn)(arr)
+        fn = self.pc_fn.replace("_whitespace", "")
+        return getattr(pc, fn)(arr, characters=self.chars)
+
+
+class StringTrim(_TrimBase):
+    pc_fn = "utf8_trim_whitespace"
+
+
+class StringTrimLeft(_TrimBase):
+    pc_fn = "utf8_ltrim_whitespace"
+
+
+class StringTrimRight(_TrimBase):
+    pc_fn = "utf8_rtrim_whitespace"
+
+
+class StringReplace(_HostStringExpr):
+    def __init__(self, child, search: str, replace: str):
+        self.children = [child]
+        self.search = search
+        self.replace = replace
+
+    def data_type(self, schema):
+        return STRING
+
+    def eval_host(self, batch):
+        import pyarrow.compute as pc
+        return pc.replace_substring(self.children[0].eval_host(batch),
+                                    self.search, self.replace)
+
+    def key(self):
+        return (f"replace({self.children[0].key()},{self.search!r},"
+                f"{self.replace!r})")
+
+
+class StringLocate(_HostStringExpr):
+    """locate(substr, str): 1-based, 0 if absent (ref GpuStringLocate)."""
+
+    def __init__(self, substr: str, child):
+        self.children = [child]
+        self.substr = substr
+
+    def data_type(self, schema):
+        return INT32
+
+    def eval_host(self, batch):
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        arr = self.children[0].eval_host(batch)
+        # find_substring returns BYTE offsets; Spark wants 1-based CHARACTER
+        # position -> measure the prefix before the first occurrence
+        parts = pc.split_pattern(arr, self.substr, max_splits=1)
+        prefix_len = pc.utf8_length(pc.list_element(parts, 0))
+        found = pc.match_substring(arr, self.substr)
+        pos = pc.if_else(found, pc.add(prefix_len, 1),
+                         pc.cast(0, prefix_len.type))
+        return pc.cast(pos, pa.int32())
+
+    def key(self):
+        return f"locate({self.substr!r},{self.children[0].key()})"
+
+
+class Lpad(_HostStringExpr):
+    def __init__(self, child, length: int, pad: str = " "):
+        self.children = [child]
+        self.length = length
+        self.pad = pad
+
+    def data_type(self, schema):
+        return STRING
+
+    def eval_host(self, batch):
+        import pyarrow.compute as pc
+        arr = self.children[0].eval_host(batch)
+        padded = pc.utf8_lpad(arr, self.length, padding=self.pad)
+        # Spark truncates to length
+        return pc.utf8_slice_codeunits(padded, 0, self.length)
+
+    def key(self):
+        return f"lpad({self.children[0].key()},{self.length},{self.pad!r})"
+
+
+class Rpad(Lpad):
+    def eval_host(self, batch):
+        import pyarrow.compute as pc
+        arr = self.children[0].eval_host(batch)
+        padded = pc.utf8_rpad(arr, self.length, padding=self.pad)
+        return pc.utf8_slice_codeunits(padded, 0, self.length)
+
+    def key(self):
+        return f"rpad({self.children[0].key()},{self.length},{self.pad!r})"
+
+
+class Reverse(_HostStringExpr):
+    def __init__(self, child):
+        self.children = [child]
+
+    def data_type(self, schema):
+        return STRING
+
+    def eval_host(self, batch):
+        import pyarrow.compute as pc
+        return pc.utf8_reverse(self.children[0].eval_host(batch))
+
+
+class StringRepeat(_HostStringExpr):
+    def __init__(self, child, times: int):
+        self.children = [child]
+        self.times = times
+
+    def data_type(self, schema):
+        return STRING
+
+    def eval_host(self, batch):
+        import pyarrow.compute as pc
+        return pc.binary_repeat(self.children[0].eval_host(batch),
+                                self.times)
+
+    def key(self):
+        return f"repeat({self.children[0].key()},{self.times})"
+
+
+class InitCap(_HostStringExpr):
+    def __init__(self, child):
+        self.children = [child]
+
+    def data_type(self, schema):
+        return STRING
+
+    def eval_host(self, batch):
+        import pyarrow.compute as pc
+        return pc.utf8_capitalize(self.children[0].eval_host(batch))
+
+
+class StringSplit(_HostStringExpr):
+    """split(str, java_regex) -> array<string> (host-only nested output)."""
+
+    def __init__(self, child, pattern: str, limit: int = -1):
+        self.children = [child]
+        self.pattern = pattern
+        self.limit = limit
+        from .regex_transpiler import transpile_java_regex
+        self._regex = transpile_java_regex(pattern)
+
+    def data_type(self, schema):
+        from ..types import ArrayType
+        return ArrayType(STRING)
+
+    def eval_host(self, batch):
+        import pyarrow.compute as pc
+        kwargs = {} if self.limit < 0 else {"max_splits": self.limit - 1}
+        return pc.split_pattern_regex(self.children[0].eval_host(batch),
+                                      self._regex, **kwargs)
+
+    def key(self):
+        return f"split({self.children[0].key()},{self.pattern!r})"
+
+
+class SubstringIndex(_HostStringExpr):
+    """substring_index(str, delim, count) (ref GpuSubstringIndexUtils JNI)."""
+
+    def __init__(self, child, delim: str, count: int):
+        self.children = [child]
+        self.delim = delim
+        self.count = count
+
+    def data_type(self, schema):
+        return STRING
+
+    def eval_host(self, batch):
+        import pyarrow as pa
+        arr = self.children[0].eval_host(batch)
+        out = []
+        for v in arr.to_pylist():
+            if v is None:
+                out.append(None)
+            elif self.count > 0:
+                out.append(self.delim.join(v.split(self.delim)[:self.count]))
+            elif self.count < 0:
+                out.append(self.delim.join(v.split(self.delim)[self.count:]))
+            else:
+                out.append("")
+        return pa.array(out, type=pa.string())
+
+    def key(self):
+        return (f"substring_index({self.children[0].key()},"
+                f"{self.delim!r},{self.count})")

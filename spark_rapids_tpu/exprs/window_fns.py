@@ -1,0 +1,78 @@
+"""Window function expressions (ref GpuWindowExpression.scala, 2,133 LoC).
+
+These are markers consumed by exec/window.py's sort-based kernel; they do not
+evaluate standalone (same shape as the reference where window functions only
+exist inside GpuWindowExec).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..types import INT32, INT64, DataType, Schema
+from .base import Expression
+
+__all__ = ["WindowFunction", "RowNumber", "Rank", "DenseRank", "Lag", "Lead",
+           "NTile"]
+
+
+class WindowFunction:
+    """Base marker. data_type(schema) like aggregates."""
+
+    def data_type(self, schema: Schema) -> DataType:
+        raise NotImplementedError
+
+    @property
+    def name_hint(self) -> str:
+        return type(self).__name__.lower()
+
+    def device_unsupported_reason(self, schema) -> Optional[str]:
+        return None
+
+
+class RowNumber(WindowFunction):
+    def data_type(self, schema):
+        return INT32
+
+
+class Rank(WindowFunction):
+    def data_type(self, schema):
+        return INT32
+
+
+class DenseRank(WindowFunction):
+    def data_type(self, schema):
+        return INT32
+
+
+class NTile(WindowFunction):
+    def __init__(self, n: int):
+        self.n = n
+
+    def data_type(self, schema):
+        return INT32
+
+
+class Lag(WindowFunction):
+    def __init__(self, child: Expression, offset: int = 1, default=None):
+        self.child = child
+        self.offset = offset
+        self.default = default
+
+    def data_type(self, schema):
+        return self.child.data_type(schema)
+
+    @property
+    def name_hint(self):
+        return f"lag({self.child.name_hint},{self.offset})"
+
+    def device_unsupported_reason(self, schema):
+        return self.child.fully_device_supported(schema)
+
+
+class Lead(Lag):
+    def __init__(self, child: Expression, offset: int = 1, default=None):
+        super().__init__(child, offset, default)
+
+    @property
+    def name_hint(self):
+        return f"lead({self.child.name_hint},{self.offset})"

@@ -1,0 +1,67 @@
+"""Differential datetime tests: device civil-calendar kernels vs Arrow host
+kernels (ref date_time_test.py)."""
+import pandas as pd
+import pytest
+
+from harness import assert_tpu_and_cpu_equal
+from data_gen import DateGen, IntGen, TimestampGen, gen_df
+from spark_rapids_tpu.api import functions as F
+
+
+def _dates(s, n=2048):
+    return s.create_dataframe(gen_df({"d": DateGen(),
+                                      "n": IntGen(lo=-500, hi=500)}, n=n))
+
+
+def _ts(s, n=2048):
+    return s.create_dataframe(gen_df({"t": TimestampGen()}, n=n))
+
+
+def test_date_fields():
+    def q(s):
+        df = _dates(s)
+        return df.select(F.year(F.col("d")).alias("y"),
+                         F.month(F.col("d")).alias("m"),
+                         F.dayofmonth(F.col("d")).alias("dom"),
+                         F.quarter(F.col("d")).alias("q"),
+                         F.dayofyear(F.col("d")).alias("doy"))
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_day_of_week():
+    def q(s):
+        df = _dates(s)
+        return df.select(F.dayofweek(F.col("d")).alias("dow"),
+                         F.weekday(F.col("d")).alias("wd"))
+    assert_tpu_and_cpu_equal(q)
+
+
+@pytest.mark.parametrize("positive_ts", [True, False])
+def test_time_fields(positive_ts):
+    def q(s):
+        df = _ts(s)
+        if positive_ts:
+            df = df.filter(F.col("t").cast("bigint") > 0)
+        return df.select(F.hour(F.col("t")).alias("h"),
+                         F.minute(F.col("t")).alias("mi"),
+                         F.second(F.col("t")).alias("se"),
+                         F.year(F.col("t")).alias("y"))
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_date_add_sub_diff():
+    def q(s):
+        df = _dates(s)
+        return df.select(F.date_add(F.col("d"), F.col("n")).alias("add"),
+                         F.date_sub(F.col("d"), F.lit(30)).alias("sub"),
+                         F.datediff(F.col("d"),
+                                    F.date_add(F.col("d"),
+                                               F.col("n"))).alias("diff"))
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_timestamp_to_date_cast():
+    def q(s):
+        df = _ts(s)
+        return df.select(F.col("t").cast("date").alias("d"))
+    assert_tpu_and_cpu_equal(q)

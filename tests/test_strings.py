@@ -1,0 +1,137 @@
+"""String expression tests (ref string_test.py, regexp_test.py).
+
+Strings are host-Arrow in both engines, so these validate against explicit
+Python-computed expected values rather than differentially.
+"""
+import pandas as pd
+import pytest
+
+from harness import tpu_session
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.exprs import RegexUnsupported, transpile_java_regex
+
+
+DATA = ["hello World", "", None, "Spark RAPIDS tpu", "aaa bbb  ccc",
+        "héllo 中文", "x,y,z", "  padded  "]
+
+
+def _df(s):
+    return s.create_dataframe(pd.DataFrame({"s": DATA}))
+
+
+def _run(col):
+    s = tpu_session()
+    out = _df(s).select(col.alias("r")).to_pandas()["r"].tolist()
+    # normalize pandas NaN->None and nullable floats back to ints
+    norm = []
+    for v in out:
+        if v is None or (isinstance(v, float) and pd.isna(v)):
+            norm.append(None)
+        elif isinstance(v, float) and v.is_integer():
+            norm.append(int(v))
+        else:
+            norm.append(v)
+    return norm
+
+
+def _pyexpect(fn):
+    return [None if v is None else fn(v) for v in DATA]
+
+
+def test_length_upper_lower():
+    assert _run(F.length(F.col("s"))) == _pyexpect(len)
+    assert _run(F.upper(F.col("s"))) == _pyexpect(str.upper)
+    assert _run(F.lower(F.col("s"))) == _pyexpect(str.lower)
+
+
+def test_substring():
+    assert _run(F.substring(F.col("s"), 1, 3)) == _pyexpect(lambda v: v[:3])
+    assert _run(F.substring(F.col("s"), 2, 2)) == _pyexpect(lambda v: v[1:3])
+    assert _run(F.substring(F.col("s"), -3)) == _pyexpect(lambda v: v[-3:])
+
+
+def test_concat_null_propagates():
+    out = _run(F.concat(F.col("s"), F.lit("!")))
+    assert out == [None if v is None else v + "!" for v in DATA]
+
+
+def test_predicates():
+    assert _run(F.contains(F.col("s"), "o")) == _pyexpect(lambda v: "o" in v)
+    assert _run(F.startswith(F.col("s"), "h")) == \
+        _pyexpect(lambda v: v.startswith("h"))
+    assert _run(F.endswith(F.col("s"), "c")) == \
+        _pyexpect(lambda v: v.endswith("c"))
+
+
+def test_like():
+    out = _run(F.like(F.col("s"), "%o%d"))
+    import re
+    assert out == _pyexpect(lambda v: re.fullmatch(".*o.*d", v) is not None)
+
+
+def test_trim_pad_reverse_repeat():
+    assert _run(F.trim(F.col("s"))) == _pyexpect(str.strip)
+    assert _run(F.ltrim(F.col("s"))) == _pyexpect(str.lstrip)
+    assert _run(F.rpad(F.col("s"), 4, "*")) == \
+        _pyexpect(lambda v: v.ljust(4, "*")[:4])
+    assert _run(F.reverse(F.col("s"))) == _pyexpect(lambda v: v[::-1])
+    assert _run(F.repeat(F.col("s"), 2)) == _pyexpect(lambda v: v * 2)
+
+
+def test_regexp_replace_extract():
+    assert _run(F.regexp_replace(F.col("s"), "[aeiou]", "#")) == \
+        _pyexpect(lambda v: __import__("re").sub("[aeiou]", "#", v))
+    out = _run(F.regexp_extract(F.col("s"), "(\\w+)", 1))
+    import re
+    rx = re.compile("([a-zA-Z0-9_]+)")
+    assert out == _pyexpect(
+        lambda v: (rx.search(v).group(1) if rx.search(v) else ""))
+
+
+def test_substring_index_and_locate():
+    assert _run(F.substring_index(F.col("s"), " ", 1)) == \
+        _pyexpect(lambda v: v.split(" ")[0] if " " in v else v)
+    assert _run(F.locate("o", F.col("s"))) == \
+        _pyexpect(lambda v: v.find("o") + 1)
+
+
+def test_filter_on_string_predicate_mixed_plan():
+    """String predicate forces a CPU filter; downstream arithmetic still
+    runs on device (per-exec fallback like the reference)."""
+    s = tpu_session()
+    df = s.create_dataframe(pd.DataFrame(
+        {"s": ["aa", "ab", "ba", None], "v": [1, 2, 3, 4]}))
+    out = (df.filter(F.startswith(F.col("s"), "a"))
+           .select((F.col("v") * 10).alias("v10")))
+    tree = out._physical().tree_string()
+    assert "CpuFilter" in tree and "* Project" in tree
+    assert sorted(out.to_pandas()["v10"]) == [10, 20]
+
+
+class TestRegexTranspiler:
+    def test_ascii_classes(self):
+        assert transpile_java_regex("\\d+") == "[0-9]+"
+        assert transpile_java_regex("\\w") == "[a-zA-Z0-9_]"
+        assert transpile_java_regex("[\\d]") == "[0-9]"
+
+    def test_passthrough(self):
+        assert transpile_java_regex("a(b|c)*d") == "a(b|c)*d"
+        assert transpile_java_regex("^x{2,3}$") == "^x{2,3}$"
+
+    def test_named_group(self):
+        assert transpile_java_regex("(?<nm>a)") == "(?P<nm>a)"
+
+    def test_java_z(self):
+        assert transpile_java_regex("a\\z") == "a\\Z"
+
+    @pytest.mark.parametrize("bad", ["a\\Z", "\\p{Alpha}", "[a[b]]",
+                                     "[a&&b]", "\\G", "(?"  "u)x"])
+    def test_rejected(self, bad):
+        with pytest.raises(RegexUnsupported):
+            transpile_java_regex(bad)
+
+    def test_unbalanced(self):
+        with pytest.raises(RegexUnsupported):
+            transpile_java_regex("(a")
+        with pytest.raises(RegexUnsupported):
+            transpile_java_regex("a)")

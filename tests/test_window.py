@@ -1,0 +1,87 @@
+"""Differential window-function tests (ref window_function_test.py)."""
+import pandas as pd
+import pytest
+
+from harness import assert_tpu_and_cpu_equal, tpu_session
+from data_gen import DoubleGen, IntGen, gen_df
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.exprs import ColumnRef
+from spark_rapids_tpu.exprs.aggregates import Average, CountStar, Max, Min, Sum
+from spark_rapids_tpu.exprs.window_fns import (DenseRank, Lag, Lead, Rank,
+                                               RowNumber)
+
+
+def _df(s, n=512, seed=0):
+    return s.create_dataframe(gen_df(
+        {"p": IntGen(lo=0, hi=6, nullable=False),
+         "o": IntGen(lo=0, hi=1000, nullable=False),
+         "v": IntGen(lo=-100, hi=100, nullable=False)}, n=n, seed=seed))
+
+
+def test_row_number():
+    def q(s):
+        return _df(s).with_window_column(
+            "rn", RowNumber(), partition_by=["p"],
+            order_by=[F.col("o").asc(), F.col("v").asc()])
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_rank_dense_rank():
+    def q(s):
+        df = _df(s)
+        df = df.with_window_column("rk", Rank(), partition_by=["p"],
+                                   order_by=[F.col("o").asc()])
+        return df.with_window_column("drk", DenseRank(), partition_by=["p"],
+                                     order_by=[F.col("o").asc()])
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_lag_lead():
+    def q(s):
+        df = _df(s)
+        df = df.with_window_column(
+            "lag1", Lag(ColumnRef("v"), 1), partition_by=["p"],
+            order_by=[F.col("o").asc(), F.col("v").asc()])
+        return df.with_window_column(
+            "lead2", Lead(ColumnRef("v"), 2), partition_by=["p"],
+            order_by=[F.col("o").asc(), F.col("v").asc()])
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_unbounded_partition_aggs():
+    def q(s):
+        df = _df(s)
+        df = df.with_window_column("psum", Sum(ColumnRef("v")),
+                                   partition_by=["p"])
+        df = df.with_window_column("pmin", Min(ColumnRef("v")),
+                                   partition_by=["p"])
+        df = df.with_window_column("pmax", Max(ColumnRef("v")),
+                                   partition_by=["p"])
+        return df.with_window_column("pcnt", CountStar(),
+                                     partition_by=["p"])
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_running_sum():
+    def q(s):
+        return _df(s).with_window_column(
+            "rsum", Sum(ColumnRef("v")), partition_by=["p"],
+            order_by=[F.col("o").asc(), F.col("v").asc()])
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_bounded_preceding_sum():
+    def q(s):
+        return _df(s).with_window_column(
+            "wsum", Sum(ColumnRef("v")), partition_by=["p"],
+            order_by=[F.col("o").asc(), F.col("v").asc()],
+            frame=("rows", -2, 0))
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_window_no_partition():
+    def q(s):
+        return _df(s, n=128).with_window_column(
+            "rn", RowNumber(), order_by=[F.col("o").asc(),
+                                         F.col("v").asc()])
+    assert_tpu_and_cpu_equal(q)
