@@ -63,15 +63,22 @@ class MemoryManager:
     _global_lock = threading.Lock()
 
     def __init__(self, budget_bytes: int, host_limit_bytes: int,
-                 spill_dir: str):
+                 spill_dir: str, use_native: bool = False):
         self.budget = budget_bytes
         self.host_limit = host_limit_bytes
         self.spill_dir = spill_dir
         self._lock = threading.RLock()
-        self.device_used = 0
+        # native accounting + fault machine (mem/native.py -> oom_state.cpp);
+        # process-global, so only opted into (the singleton path uses it)
+        self._native = None
+        if use_native:
+            from .native import NativeOomState, load
+            if load() is not None:
+                self._native = NativeOomState(budget_bytes)
+        self._py_device_used = 0
         self.host_used = 0
         self.disk_used = 0
-        self.max_device_used = 0
+        self._py_max_device_used = 0
         self.spill_to_host_bytes = 0
         self.spill_to_disk_bytes = 0
         # spillables: handle -> SpillableBatch, priority-ordered on demand
@@ -90,9 +97,24 @@ class MemoryManager:
         key = limit
         with cls._global_lock:
             if key not in cls._instances:
+                # first (largest-budget) singleton owns the native machine
                 cls._instances[key] = cls(limit, conf.get(HOST_SPILL_LIMIT),
-                                          conf.get(SPILL_DIR))
+                                          conf.get(SPILL_DIR),
+                                          use_native=not cls._instances)
             return cls._instances[key]
+
+    # ------------------------------------------------------------ accounting
+    @property
+    def device_used(self) -> int:
+        if self._native is not None:
+            return self._native.used
+        return self._py_device_used
+
+    @property
+    def max_device_used(self) -> int:
+        if self._native is not None:
+            return self._native.max_used
+        return self._py_max_device_used
 
     # ----------------------------------------------------------- registration
     def register_spillable(self, spillable) -> int:
@@ -113,20 +135,36 @@ class MemoryManager:
         On budget pressure: spill registered buffers; on injected or real
         exhaustion raise RetryOOM / SplitAndRetryOOM
         (ref DeviceMemoryEventHandler.onAllocFailure -> store.spill)."""
+        if self._native is not None:
+            rc = self._native.reserve(nbytes, block_ms=0)
+            if rc == 0:
+                return
+            if rc == 2:
+                raise SplitAndRetryOOM(
+                    f"native: allocation of {nbytes} cannot ever fit "
+                    f"(budget {self.budget}) or split was injected")
+            if allow_spill:
+                self.spill_device(nbytes)
+                # brief native block/wake window lets concurrent releases in
+                rc = self._native.reserve(nbytes, block_ms=20)
+                if rc == 0:
+                    return
+            raise RetryOOM(f"native: could not reserve {nbytes} "
+                           f"(used={self.device_used}, budget={self.budget})")
         self._maybe_inject()
         with self._lock:
-            if self.device_used + nbytes <= self.budget:
-                self.device_used += nbytes
-                self.max_device_used = max(self.max_device_used,
-                                           self.device_used)
+            if self._py_device_used + nbytes <= self.budget:
+                self._py_device_used += nbytes
+                self._py_max_device_used = max(self._py_max_device_used,
+                                               self._py_device_used)
                 return
         if allow_spill:
-            freed = self.spill_device(nbytes - (self.budget - self.device_used))
+            self.spill_device(nbytes - (self.budget - self._py_device_used))
             with self._lock:
-                if self.device_used + nbytes <= self.budget:
-                    self.device_used += nbytes
-                    self.max_device_used = max(self.max_device_used,
-                                               self.device_used)
+                if self._py_device_used + nbytes <= self.budget:
+                    self._py_device_used += nbytes
+                    self._py_max_device_used = max(self._py_max_device_used,
+                                                   self._py_device_used)
                     return
         if nbytes > self.budget:
             raise SplitAndRetryOOM(
@@ -135,8 +173,11 @@ class MemoryManager:
                        f"(used={self.device_used}, budget={self.budget})")
 
     def release(self, nbytes: int):
+        if self._native is not None:
+            self._native.release(nbytes)
+            return
         with self._lock:
-            self.device_used = max(0, self.device_used - nbytes)
+            self._py_device_used = max(0, self._py_device_used - nbytes)
 
     def reserve_host(self, nbytes: int):
         with self._lock:
@@ -184,17 +225,25 @@ class MemoryManager:
                         thread_id: Optional[int] = None):
         """Next `num_ooms` reserves on the thread raise RetryOOM after
         skipping `skip` (ref RmmSpark.forceRetryOOM)."""
+        if self._native is not None:
+            self._native.force_retry_oom(num_ooms, skip, thread_id)
+            return
         tid = thread_id if thread_id is not None else threading.get_ident()
         with self._lock:
             self._inject.setdefault(tid, []).append(["retry", skip, num_ooms])
 
     def force_split_and_retry_oom(self, num_ooms: int = 1, skip: int = 0,
                                   thread_id: Optional[int] = None):
+        if self._native is not None:
+            self._native.force_split_and_retry_oom(num_ooms, skip, thread_id)
+            return
         tid = thread_id if thread_id is not None else threading.get_ident()
         with self._lock:
             self._inject.setdefault(tid, []).append(["split", skip, num_ooms])
 
     def clear_injections(self):
+        if self._native is not None:
+            self._native.clear_injections()
         with self._lock:
             self._inject.clear()
 

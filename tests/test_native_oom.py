@@ -1,0 +1,86 @@
+"""Native (C++) OOM state machine tests — the RmmSpark-analog layer
+(spark_rapids_tpu/native/oom_state.cpp via ctypes)."""
+import threading
+import time
+
+import pytest
+
+from spark_rapids_tpu.mem.native import NativeOomState, load
+
+
+pytestmark = pytest.mark.skipif(load() is None,
+                                reason="no g++ toolchain available")
+
+
+@pytest.fixture
+def st():
+    yield NativeOomState(1000)
+    # the native machine is process-global: restore the singleton manager's
+    # budget so later query tests aren't squeezed into 1000 bytes
+    from spark_rapids_tpu.mem import MemoryManager
+    for mm in MemoryManager._instances.values():
+        if mm._native is not None:
+            mm._native.lib.oom_init(mm.budget)
+
+
+class TestNativeAccounting:
+    def test_reserve_release(self, st):
+        assert st.reserve(400) == 0
+        assert st.used == 400
+        assert st.reserve(600) == 0
+        assert st.used == 1000
+        assert st.reserve(1) == 1  # full -> retry
+        st.release(500)
+        assert st.reserve(1) == 0
+        assert st.max_used == 1000
+
+    def test_oversized_is_split(self, st):
+        assert st.reserve(2000) == 2
+
+    def test_injection_with_skip(self, st):
+        st.force_retry_oom(2, skip=1)
+        assert st.reserve(1) == 0   # skipped
+        assert st.reserve(1) == 1   # injected
+        assert st.reserve(1) == 1   # injected
+        assert st.reserve(1) == 0
+        assert st.retry_count() == 2
+
+    def test_split_injection(self, st):
+        st.force_split_and_retry_oom(1)
+        assert st.reserve(1) == 2
+        assert st.reserve(1) == 0
+
+    def test_clear_injections(self, st):
+        st.force_retry_oom(5)
+        st.clear_injections()
+        assert st.reserve(1) == 0
+
+
+class TestNativeBlocking:
+    def test_blocked_thread_wakes_on_release(self, st):
+        assert st.reserve(900) == 0
+        results = {}
+
+        def blocked():
+            results["rc"] = st.reserve(500, block_ms=2000)
+
+        t = threading.Thread(target=blocked)
+        t.start()
+        time.sleep(0.1)
+        assert st.blocked_threads == 1
+        st.release(900)  # wakes the waiter
+        t.join(timeout=3)
+        assert results["rc"] == 0
+        assert st.used == 500
+
+    def test_block_timeout(self, st):
+        assert st.reserve(1000) == 0
+        t0 = time.perf_counter()
+        assert st.reserve(500, block_ms=100) == 3
+        assert 0.05 < time.perf_counter() - t0 < 1.0
+
+
+def test_singleton_manager_uses_native():
+    from spark_rapids_tpu.mem import MemoryManager
+    mm = MemoryManager.get()
+    assert mm._native is not None
