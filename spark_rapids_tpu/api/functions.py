@@ -203,6 +203,124 @@ def substring_index(c, delim, count) -> Col:
     return Col(E.SubstringIndex(_to_expr(c), delim, count))
 
 
+# --- collections / complex types (ref collectionOperations.scala) -----------
+def size(c) -> Col: return Col(E.Size(_to_expr(c)))
+def array_contains(c, value) -> Col:
+    return Col(E.ArrayContains(_to_expr(c), _to_expr(value)))
+def array_position(c, value) -> Col:
+    return Col(E.ArrayPosition(_to_expr(c), _to_expr(value)))
+def element_at(c, extraction) -> Col:
+    return Col(E.ElementAt(_to_expr(c), _to_expr(extraction)))
+def get(c, index) -> Col:
+    return Col(E.GetArrayItem(_to_expr(c), _to_expr(index)))
+def get_field(c, name: str) -> Col:
+    return Col(E.GetStructField(_to_expr(c), name))
+def sort_array(c, asc: bool = True) -> Col:
+    return Col(E.SortArray(_to_expr(c), E.Literal(asc)))
+def array_min(c) -> Col: return Col(E.ArrayMin(_to_expr(c)))
+def array_max(c) -> Col: return Col(E.ArrayMax(_to_expr(c)))
+def array_join(c, delimiter, null_replacement=None) -> Col:
+    rep = E.Literal(null_replacement) if null_replacement is not None else None
+    return Col(E.ArrayJoin(_to_expr(c), E.Literal(delimiter), rep))
+def slice(c, start, length) -> Col:
+    return Col(E.Slice(_to_expr(c), _to_expr(start), _to_expr(length)))
+def array_repeat(c, count) -> Col:
+    return Col(E.ArrayRepeat(_to_expr(c), _to_expr(count)))
+def arrays_zip(*cols) -> Col:
+    names = [c.expr.name_hint if isinstance(c, Col) else str(i)
+             for i, c in enumerate(cols)]
+    return Col(E.ArraysZip(*[_to_expr(c) for c in cols], names=names))
+def concat_arrays(*cols) -> Col:
+    return Col(E.Concat(*[_to_expr(c) for c in cols]))
+def flatten(c) -> Col: return Col(E.Flatten(_to_expr(c)))
+def sequence(start, stop, step=None) -> Col:
+    return Col(E.Sequence(_to_expr(start), _to_expr(stop),
+                          _to_expr(step) if step is not None else None))
+def array_distinct(c) -> Col: return Col(E.ArrayDistinct(_to_expr(c)))
+def array_union(a, b) -> Col:
+    return Col(E.ArrayUnion(_to_expr(a), _to_expr(b)))
+def array_intersect(a, b) -> Col:
+    return Col(E.ArrayIntersect(_to_expr(a), _to_expr(b)))
+def array_except(a, b) -> Col:
+    return Col(E.ArrayExcept(_to_expr(a), _to_expr(b)))
+def array_remove(c, element) -> Col:
+    return Col(E.ArrayRemove(_to_expr(c), _to_expr(element)))
+def arrays_overlap(a, b) -> Col:
+    return Col(E.ArraysOverlap(_to_expr(a), _to_expr(b)))
+def array_reverse(c) -> Col: return Col(E.ArrayReverse(_to_expr(c)))
+def map_keys(c) -> Col: return Col(E.MapKeys(_to_expr(c)))
+def map_values(c) -> Col: return Col(E.MapValues(_to_expr(c)))
+def map_entries(c) -> Col: return Col(E.MapEntries(_to_expr(c)))
+def map_concat(*cols) -> Col:
+    return Col(E.MapConcat(*[_to_expr(c) for c in cols]))
+def map_from_arrays(keys, values) -> Col:
+    return Col(E.MapFromArrays(_to_expr(keys), _to_expr(values)))
+def str_to_map(c, pair_delim=",", kv_delim=":") -> Col:
+    return Col(E.StringToMap(_to_expr(c), E.Literal(pair_delim),
+                             E.Literal(kv_delim)))
+def array(*cols) -> Col:
+    return Col(E.CreateArray(*[_to_expr(c) for c in cols]))
+def create_map(*cols) -> Col:
+    return Col(E.CreateMap(*[_to_expr(c) for c in cols]))
+def struct(*cols) -> Col:
+    pairs = []
+    for c in cols:
+        pairs.append(E.Literal(c.expr.name_hint if isinstance(c, Col) else str(c)))
+        pairs.append(_to_expr(c))
+    return Col(E.CreateNamedStruct(*pairs))
+def named_struct(*name_col_pairs) -> Col:
+    return Col(E.CreateNamedStruct(*[_to_expr(p) for p in name_col_pairs]))
+
+
+# --- higher-order functions (ref higherOrderFunctions.scala) ----------------
+def _make_lambda(fn, hints, min_args=1):
+    """Python callable over Col -> (arg vars, body expr). Arity is taken
+    from the callable (like pyspark); min_args is per-function (e.g.
+    zip_with and the map HOFs require exactly 2)."""
+    import inspect
+    n = len(inspect.signature(fn).parameters)
+    if not min_args <= n <= len(hints):
+        raise TypeError(
+            f"lambda must take between {min_args} and {len(hints)} "
+            f"arguments, got {n}")
+    args = [E.NamedLambdaVariable(hints[i]) for i in range(n)]
+    body = _to_expr(fn(*[Col(a) for a in args]))
+    return args, body
+
+
+def transform(c, fn) -> Col:
+    args, body = _make_lambda(fn, ["x", "i"])
+    return Col(E.ArrayTransform(_to_expr(c), args, body))
+def filter(c, fn) -> Col:
+    args, body = _make_lambda(fn, ["x", "i"])
+    return Col(E.ArrayFilter(_to_expr(c), args, body))
+def exists(c, fn) -> Col:
+    args, body = _make_lambda(fn, ["x"])
+    return Col(E.ArrayExists(_to_expr(c), args, body))
+def forall(c, fn) -> Col:
+    args, body = _make_lambda(fn, ["x"])
+    return Col(E.ArrayForAll(_to_expr(c), args, body))
+def aggregate(c, initial, merge, finish=None) -> Col:
+    margs, mbody = _make_lambda(merge, ["acc", "x"], min_args=2)
+    fargs = fbody = None
+    if finish is not None:
+        fargs, fbody = _make_lambda(finish, ["acc"])
+    return Col(E.ArrayAggregate(_to_expr(c), _to_expr(initial), margs, mbody,
+                                fargs, fbody))
+def zip_with(a, b, fn) -> Col:
+    args, body = _make_lambda(fn, ["x", "y"], min_args=2)
+    return Col(E.ZipWith(_to_expr(a), _to_expr(b), args, body))
+def transform_keys(c, fn) -> Col:
+    args, body = _make_lambda(fn, ["k", "v"], min_args=2)
+    return Col(E.TransformKeys(_to_expr(c), args, body))
+def transform_values(c, fn) -> Col:
+    args, body = _make_lambda(fn, ["k", "v"], min_args=2)
+    return Col(E.TransformValues(_to_expr(c), args, body))
+def map_filter(c, fn) -> Col:
+    args, body = _make_lambda(fn, ["k", "v"], min_args=2)
+    return Col(E.MapFilter(_to_expr(c), args, body))
+
+
 # --- window -----------------------------------------------------------------
 def row_number(): return E.RowNumber()
 def rank(): return E.Rank()

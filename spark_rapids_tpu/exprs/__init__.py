@@ -24,6 +24,20 @@ from .string_fns import (ConcatStrings, Contains, EndsWith, InitCap, Length,
 from .regex_transpiler import (RegexUnsupported, sql_like_to_regex,
                                transpile_java_regex)
 from .window_fns import DenseRank, Lag, Lead, NTile, Rank, RowNumber
+from .collection_fns import (ArrayContains, ArrayDistinct, ArrayExcept,
+                             ArrayIntersect, ArrayJoin, ArrayMax, ArrayMin,
+                             ArrayPosition, ArrayRemove, ArrayRepeat,
+                             ArrayReverse, ArraysOverlap, ArraysZip,
+                             ArrayUnion, Concat, CreateArray, CreateMap,
+                             CreateNamedStruct, ElementAt, Flatten,
+                             GetArrayItem, GetMapValue, GetStructField,
+                             MapConcat, MapEntries, MapFromArrays, MapKeys,
+                             MapValues, Sequence, Size, Slice, SortArray,
+                             StringToMap)
+from .higher_order import (ArrayAggregate, ArrayExists, ArrayFilter,
+                           ArrayForAll, ArrayTransform, MapFilter,
+                           NamedLambdaVariable, TransformKeys,
+                           TransformValues, ZipWith)
 from .compiler import (DeviceProjector, compile_projection,
                        eval_predicate_device, filter_batch_device,
                        gather_batch_device)

@@ -1,0 +1,233 @@
+"""Collection / complex-type expression tests.
+
+Reference analog: integration_tests collection_ops_test.py, array_test.py,
+map_test.py, higher_order_functions_test.py. Nested types are host-Arrow in
+both engines, so these validate Spark null semantics against explicit
+expected values (the reference's CPU-Spark oracle, precomputed).
+"""
+import pyarrow as pa
+import pytest
+
+from harness import tpu_session
+from spark_rapids_tpu.api import functions as F
+
+
+ARRS = [[1, 2, 3], [], None, [4, None, 6], [7], [None]]
+
+
+def _df(s, **cols):
+    if not cols:
+        cols = {"a": ARRS}
+    return s.create_dataframe(pa.table(cols))
+
+
+def _run(col, **cols):
+    s = tpu_session()
+    out = _df(s, **cols).select(col.alias("r")).collect_arrow()
+    return out.column("r").to_pylist()
+
+
+def test_size_legacy():
+    assert _run(F.size(F.col("a"))) == [3, 0, -1, 3, 1, 1]
+
+
+def test_array_contains_three_valued():
+    assert _run(F.array_contains(F.col("a"), 1)) == \
+        [True, False, None, None, False, None]
+    assert _run(F.array_contains(F.col("a"), 6)) == \
+        [False, False, None, True, False, None]
+
+
+def test_array_position():
+    assert _run(F.array_position(F.col("a"), 6)) == [0, 0, None, 3, 0, 0]
+
+
+def test_element_at_array():
+    assert _run(F.element_at(F.col("a"), 2)) == [2, None, None, None, None, None]
+    assert _run(F.element_at(F.col("a"), -1)) == [3, None, None, 6, 7, None]
+
+
+def test_get_array_item():
+    assert _run(F.get(F.col("a"), 0)) == [1, None, None, 4, 7, None]
+    assert _run(F.get(F.col("a"), 9)) == [None] * 6
+
+
+def test_sort_array_null_placement():
+    assert _run(F.sort_array(F.col("a"))) == \
+        [[1, 2, 3], [], None, [None, 4, 6], [7], [None]]
+    assert _run(F.sort_array(F.col("a"), asc=False)) == \
+        [[3, 2, 1], [], None, [6, 4, None], [7], [None]]
+
+
+def test_array_min_max():
+    assert _run(F.array_min(F.col("a"))) == [1, None, None, 4, 7, None]
+    assert _run(F.array_max(F.col("a"))) == [3, None, None, 6, 7, None]
+
+
+def test_array_join():
+    sa = [["1", "2", "3"], [], None, ["4", None, "6"], ["7"], [None]]
+    vals = _run(F.array_join(F.col("sa"), ","), sa=sa)
+    assert vals == ["1,2,3", "", None, "4,6", "7", ""]
+    vals = _run(F.array_join(F.col("sa"), ",", "NULL"), sa=sa)
+    assert vals == ["1,2,3", "", None, "4,NULL,6", "7", "NULL"]
+
+
+def test_slice():
+    assert _run(F.slice(F.col("a"), 2, 2)) == \
+        [[2, 3], [], None, [None, 6], [], []]
+    assert _run(F.slice(F.col("a"), -2, 2)) == \
+        [[2, 3], [], None, [None, 6], [], []]
+    with pytest.raises(ValueError, match="start at 1"):
+        _run(F.slice(F.col("a"), 0, 2))
+
+
+def test_array_repeat():
+    assert _run(F.array_repeat(F.lit(7), F.lit(3))) == [[7, 7, 7]] * 6
+    assert _run(F.array_repeat(F.lit(7), F.lit(-1))) == [[]] * 6
+
+
+def test_concat_arrays_and_flatten():
+    got = _run(F.concat_arrays(F.col("a"), F.col("a")))
+    assert got == [[1, 2, 3, 1, 2, 3], [], None, [4, None, 6, 4, None, 6],
+                   [7, 7], [None, None]]
+    nested = [[[1, 2], [3]], [[], [4]], None, [[5], None]]
+    assert _run(F.flatten(F.col("n")), n=nested) == [[1, 2, 3], [4], None, None]
+
+
+def test_sequence():
+    got = _run(F.sequence(F.lit(1), F.lit(5)))
+    assert got == [[1, 2, 3, 4, 5]] * 6
+    got = _run(F.sequence(F.lit(5), F.lit(1), F.lit(-2)))
+    assert got == [[5, 3, 1]] * 6
+
+
+def test_array_set_ops():
+    a = [[1, 2, 2, None], [1, 2], None, []]
+    b = [[2, 3], None, [1], [None]]
+    assert _run(F.array_distinct(F.col("a")), a=a) == \
+        [[1, 2, None], [1, 2], None, []]
+    assert _run(F.array_union(F.col("a"), F.col("b")), a=a, b=b) == \
+        [[1, 2, None, 3], None, None, [None]]
+    assert _run(F.array_intersect(F.col("a"), F.col("b")), a=a, b=b) == \
+        [[2], None, None, []]
+    assert _run(F.array_except(F.col("a"), F.col("b")), a=a, b=b) == \
+        [[1, None], None, None, []]
+
+
+def test_array_remove_overlap_reverse():
+    assert _run(F.array_remove(F.col("a"), F.lit(2))) == \
+        [[1, 3], [], None, [4, None, 6], [7], [None]]
+    a = [[1, 2], [1, None], [1], None]
+    b = [[2, 3], [3], [2], [1]]
+    assert _run(F.arrays_overlap(F.col("a"), F.col("b")), a=a, b=b) == \
+        [True, None, False, None]
+    assert _run(F.array_reverse(F.col("a"))) == \
+        [[3, 2, 1], [], None, [6, None, 4], [7], [None]]
+
+
+def test_arrays_zip():
+    a = [[1, 2], [3]]
+    b = [[10], [20, 30]]
+    got = _run(F.arrays_zip(F.col("a"), F.col("b")), a=a, b=b)
+    assert got == [[{"a": 1, "b": 10}, {"a": 2, "b": None}],
+                   [{"a": 3, "b": 20}, {"a": None, "b": 30}]]
+
+
+MAPS = [[("a", 1), ("b", 2)], [], None, [("c", None)]]
+
+
+def test_map_basics():
+    m = pa.array(MAPS, type=pa.map_(pa.string(), pa.int64()))
+    assert _run(F.map_keys(F.col("m")), m=m) == [["a", "b"], [], None, ["c"]]
+    assert _run(F.map_values(F.col("m")), m=m) == [[1, 2], [], None, [None]]
+    assert _run(F.map_entries(F.col("m")), m=m) == \
+        [[{"key": "a", "value": 1}, {"key": "b", "value": 2}], [], None,
+         [{"key": "c", "value": None}]]
+    assert _run(F.element_at(F.col("m"), F.lit("b")), m=m) == \
+        [2, None, None, None]
+
+
+def test_map_concat_from_arrays_str_to_map():
+    m = pa.array(MAPS, type=pa.map_(pa.string(), pa.int64()))
+    got = _run(F.map_concat(F.col("m"), F.col("m")), m=m)
+    assert got == [[("a", 1), ("b", 2)], [], None, [("c", None)]]
+    got = _run(F.map_from_arrays(F.array(F.lit("x"), F.lit("y")),
+                                 F.array(F.lit(1), F.lit(2))))
+    assert got[0] == [("x", 1), ("y", 2)]
+    got = _run(F.str_to_map(F.lit("a:1,b:2")))
+    assert got[0] == [("a", "1"), ("b", "2")]
+
+
+def test_create_array_map_struct():
+    got = _run(F.array(F.lit(1), F.lit(2), F.col("x")), x=[5, None])
+    assert got == [[1, 2, 5], [1, 2, None]]
+    got = _run(F.create_map(F.lit("k"), F.col("x")), x=[5, 6])
+    assert got == [[("k", 5)], [("k", 6)]]
+    got = _run(F.struct(F.col("x"), (F.col("x") * 2).alias("y")), x=[5, None])
+    assert got == [{"x": 5, "y": 10}, {"x": None, "y": None}]
+    got = _run(F.get_field(F.struct(F.col("x")), "x"), x=[5, None])
+    assert got == [5, None]
+
+
+# --- higher-order -----------------------------------------------------------
+
+def test_transform():
+    assert _run(F.transform(F.col("a"), lambda x: x * 2)) == \
+        [[2, 4, 6], [], None, [8, None, 12], [14], [None]]
+    # (x, i) form
+    assert _run(F.transform(F.col("a"), lambda x, i: i)) == \
+        [[0, 1, 2], [], None, [0, 1, 2], [0], [0]]
+
+
+def test_transform_with_outer_reference():
+    got = _run(F.transform(F.col("a"), lambda x: x + F.col("k")),
+               a=[[1, 2], [3]], k=[10, 20])
+    assert got == [[11, 12], [23]]
+
+
+def test_filter_exists_forall():
+    assert _run(F.filter(F.col("a"), lambda x: x > 2)) == \
+        [[3], [], None, [4, 6], [7], []]
+    assert _run(F.exists(F.col("a"), lambda x: x > 5)) == \
+        [False, False, None, True, True, None]
+    assert _run(F.forall(F.col("a"), lambda x: x > 0)) == \
+        [True, True, None, None, True, None]
+
+
+def test_aggregate():
+    assert _run(F.aggregate(F.col("a"), F.lit(0), lambda acc, x: acc + x),
+                a=[[1, 2, 3], [], None, [4, 6]]) == [6, 0, None, 10]
+    assert _run(F.aggregate(F.col("a"), F.lit(0), lambda acc, x: acc + x,
+                            lambda acc: acc * 10),
+                a=[[1, 2, 3], []]) == [60, 0]
+
+
+def test_zip_with():
+    got = _run(F.zip_with(F.col("a"), F.col("b"), lambda x, y: x + y),
+               a=[[1, 2], [3]], b=[[10, 20], [30, 40]])
+    assert got == [[11, 22], [33, None]]
+
+
+def test_map_hofs():
+    m = pa.array([[("a", 1), ("b", 2)], None],
+                 type=pa.map_(pa.string(), pa.int64()))
+    assert _run(F.transform_values(F.col("m"), lambda k, v: v * 10), m=m) == \
+        [[("a", 10), ("b", 20)], None]
+    assert _run(F.transform_keys(F.col("m"), lambda k, v: F.upper(k)), m=m) == \
+        [[("A", 1), ("B", 2)], None]
+    assert _run(F.map_filter(F.col("m"), lambda k, v: v > 1), m=m) == \
+        [[("b", 2)], None]
+
+
+def test_filter_with_index_and_bad_arity():
+    assert _run(F.filter(F.col("a"), lambda x, i: i > 0),
+                a=[[1, 2, 3], [4]]) == [[2, 3], []]
+    with pytest.raises(TypeError, match="between 2 and 2"):
+        F.zip_with(F.col("a"), F.col("a"), lambda x: x)
+    with pytest.raises(TypeError, match="between 1 and 2"):
+        F.transform(F.col("a"), lambda x, i, z: x)
+
+
+def test_sequence_illegal_boundaries():
+    with pytest.raises(ValueError, match="Illegal sequence boundaries"):
+        _run(F.sequence(F.lit(1), F.lit(5), F.lit(-1)))
