@@ -321,6 +321,30 @@ def map_filter(c, fn) -> Col:
     return Col(E.MapFilter(_to_expr(c), args, body))
 
 
+# --- hashes / digests (ref HashFunctions.scala) -----------------------------
+def hash(*cols) -> Col:
+    return Col(E.Murmur3Hash([_to_expr(c) for c in cols]))
+def xxhash64(*cols) -> Col:
+    return Col(E.XxHash64([_to_expr(c) for c in cols]))
+def hive_hash(*cols) -> Col:
+    return Col(E.HiveHash([_to_expr(c) for c in cols]))
+def md5(c) -> Col: return Col(E.Md5(_to_expr(c)))
+def sha1(c) -> Col: return Col(E.Sha1(_to_expr(c)))
+def sha2(c, num_bits: int = 256) -> Col:
+    return Col(E.Sha2(_to_expr(c), num_bits))
+def crc32(c) -> Col: return Col(E.Crc32(_to_expr(c)))
+
+
+# --- JSON (ref GpuGetJsonObject / JsonToStructs / StructsToJson) ------------
+def get_json_object(c, path: str) -> Col:
+    return Col(E.GetJsonObject(_to_expr(c), E.Literal(path)))
+def from_json(c, schema) -> Col:
+    return Col(E.JsonToStructs(_to_expr(c), schema))
+def to_json(c) -> Col: return Col(E.StructsToJson(_to_expr(c)))
+def json_tuple(c, *fields) -> Col:
+    return Col(E.JsonTuple(_to_expr(c), *fields))
+
+
 # --- window -----------------------------------------------------------------
 def row_number(): return E.RowNumber()
 def rank(): return E.Rank()

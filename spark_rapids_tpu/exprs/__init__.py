@@ -38,6 +38,10 @@ from .higher_order import (ArrayAggregate, ArrayExists, ArrayFilter,
                            ArrayForAll, ArrayTransform, MapFilter,
                            NamedLambdaVariable, TransformKeys,
                            TransformValues, ZipWith)
+from .hash_fns import (Crc32, HiveHash, Md5, Murmur3Hash, Sha1, Sha2,
+                       XxHash64)
+from .json_fns import (GetJsonObject, JsonToStructs, JsonTuple,
+                       StructsToJson)
 from .compiler import (DeviceProjector, compile_projection,
                        eval_predicate_device, filter_batch_device,
                        gather_batch_device)

@@ -1,0 +1,586 @@
+"""Hash expressions: Spark-exact Murmur3, xxHash64, HiveHash, digests.
+
+Reference analog: HashFunctions.scala + the `Hash` JNI kernels
+(murmur3/xxhash64/hive hash, SURVEY.md 2.12 item 2). TPU-first design:
+integral/float/date/timestamp columns hash ON DEVICE as fused jnp
+uint32/uint64 bitwise kernels (XLA fuses the whole multi-column fold into
+one kernel); string AND double children hash on host with the identical
+bit-exact algorithm (strings are host-resident in round 1; f64 on TPU is
+emulated double-double with no bitcast, so device f64 hashing cannot be
+bit-exact — verified on hardware).
+
+Bit-exactness with Spark matters because hash() feeds HashPartitioning:
+matching Spark's Murmur3 means rows land in the same partition a CPU Spark
+cluster would produce (differential tests of partition-dependent queries,
+and the reference's "bit for bit" bar, README Compatibility).
+
+Algorithms transcribed from the well-known public Murmur3_x86_32 / XXH64
+specs with Spark's type normalizations (catalyst HashExpression):
+  * bool -> 1/0 int; byte/short/int/date -> 4-byte path
+  * long/timestamp -> 8-byte path
+  * float -> floatToIntBits with -0.0 -> 0.0 and canonical NaN
+  * double -> doubleToLongBits, same normalization
+  * decimal(p<=18) -> unscaled long
+  * NULL -> column skipped (seed flows through)
+  * multi-column fold: seed=42, seed = hash(col_i, seed)
+Spark's bytes tail handling differs from standard murmur3: each trailing
+byte runs the FULL mix (Murmur3_x86_32.hashUnsafeBytes in spark/unsafe).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..types import (BINARY, DataType, DecimalType, INT32, INT64, STRING,
+                     Schema, TypeSig, TypeEnum)
+from .base import DVal, EvalContext, Expression, Unsupported
+from .arithmetic import masked_numpy_to_arrow
+
+__all__ = ["device_hashable", "Murmur3Hash", "XxHash64", "HiveHash", "Md5", "Sha1", "Sha2",
+           "Crc32", "spark_murmur3_bytes", "spark_xxhash64_bytes"]
+
+_M3_C1 = 0xcc9e2d51
+_M3_C2 = 0x1b873593
+
+
+# ---------------------------------------------------------------------------
+# pure-Python scalar reference (host path for strings + test oracle)
+# ---------------------------------------------------------------------------
+
+def _rotl32(x, r):
+    return ((x << r) | (x >> (32 - r))) & 0xffffffff
+
+
+def _m3_mix_k1(k1):
+    k1 = (k1 * _M3_C1) & 0xffffffff
+    k1 = _rotl32(k1, 15)
+    return (k1 * _M3_C2) & 0xffffffff
+
+
+def _m3_mix_h1(h1, k1):
+    h1 ^= k1
+    h1 = _rotl32(h1, 13)
+    return (h1 * 5 + 0xe6546b64) & 0xffffffff
+
+
+def _m3_fmix(h1, length):
+    h1 ^= length
+    h1 ^= h1 >> 16
+    h1 = (h1 * 0x85ebca6b) & 0xffffffff
+    h1 ^= h1 >> 13
+    h1 = (h1 * 0xc2b2ae35) & 0xffffffff
+    h1 ^= h1 >> 16
+    return h1
+
+
+def spark_murmur3_bytes(data: bytes, seed: int) -> int:
+    """Spark's Murmur3_x86_32.hashUnsafeBytes: word loop + PER-BYTE tail
+    (signed bytes), returns signed int32."""
+    h1 = seed & 0xffffffff
+    n = len(data)
+    aligned = n - (n % 4)
+    for i in range(0, aligned, 4):
+        k1 = int.from_bytes(data[i:i + 4], "little")
+        h1 = _m3_mix_h1(h1, _m3_mix_k1(k1))
+    for i in range(aligned, n):
+        b = data[i]
+        if b >= 128:  # signed byte
+            b -= 256
+        h1 = _m3_mix_h1(h1, _m3_mix_k1(b & 0xffffffff))
+    out = _m3_fmix(h1, n)
+    return out - (1 << 32) if out >= (1 << 31) else out
+
+
+def _m3_hash_int_py(v: int, seed: int) -> int:
+    h = _m3_mix_h1(seed & 0xffffffff, _m3_mix_k1(v & 0xffffffff))
+    out = _m3_fmix(h, 4)
+    return out - (1 << 32) if out >= (1 << 31) else out
+
+
+def _m3_hash_long_py(v: int, seed: int) -> int:
+    v &= 0xffffffffffffffff
+    h = _m3_mix_h1(seed & 0xffffffff, _m3_mix_k1(v & 0xffffffff))
+    h = _m3_mix_h1(h, _m3_mix_k1(v >> 32))
+    out = _m3_fmix(h, 8)
+    return out - (1 << 32) if out >= (1 << 31) else out
+
+
+_XX_P1 = 0x9E3779B185EBCA87
+_XX_P2 = 0xC2B2AE3D27D4EB4F
+_XX_P3 = 0x165667B19E3779F9
+_XX_P4 = 0x85EBCA77C2B2AE63
+_XX_P5 = 0x27D4EB2F165667C5
+_U64 = 0xffffffffffffffff
+
+
+def _rotl64(x, r):
+    return ((x << r) | (x >> (64 - r))) & _U64
+
+
+def _xx_fmix(h):
+    h ^= h >> 33
+    h = (h * _XX_P2) & _U64
+    h ^= h >> 29
+    h = (h * _XX_P3) & _U64
+    h ^= h >> 32
+    return h
+
+
+def spark_xxhash64_bytes(data: bytes, seed: int) -> int:
+    """Standard XXH64 (Spark's XXH64.hashUnsafeBytes), signed int64 out."""
+    seed &= _U64
+    n = len(data)
+    i = 0
+    if n >= 32:
+        v1 = (seed + _XX_P1 + _XX_P2) & _U64
+        v2 = (seed + _XX_P2) & _U64
+        v3 = seed
+        v4 = (seed - _XX_P1) & _U64
+        while i <= n - 32:
+            for j, v in enumerate((v1, v2, v3, v4)):
+                k = int.from_bytes(data[i + 8 * j:i + 8 * j + 8], "little")
+                v = (v + k * _XX_P2) & _U64
+                v = _rotl64(v, 31)
+                v = (v * _XX_P1) & _U64
+                if j == 0:
+                    v1 = v
+                elif j == 1:
+                    v2 = v
+                elif j == 2:
+                    v3 = v
+                else:
+                    v4 = v
+            i += 32
+        h = (_rotl64(v1, 1) + _rotl64(v2, 7) + _rotl64(v3, 12)
+             + _rotl64(v4, 18)) & _U64
+        for v in (v1, v2, v3, v4):
+            k = (_rotl64((v * _XX_P2) & _U64, 31) * _XX_P1) & _U64
+            h = (((h ^ k) * _XX_P1) + _XX_P4) & _U64
+    else:
+        h = (seed + _XX_P5) & _U64
+    h = (h + n) & _U64
+    while i <= n - 8:
+        k = int.from_bytes(data[i:i + 8], "little")
+        k = (_rotl64((k * _XX_P2) & _U64, 31) * _XX_P1) & _U64
+        h = ((_rotl64(h ^ k, 27) * _XX_P1) + _XX_P4) & _U64
+        i += 8
+    if i <= n - 4:
+        k = int.from_bytes(data[i:i + 4], "little")
+        h = ((_rotl64(h ^ ((k * _XX_P1) & _U64), 23) * _XX_P2) + _XX_P3) & _U64
+        i += 4
+    while i < n:
+        k = (data[i] * _XX_P5) & _U64
+        h = (_rotl64(h ^ k, 11) * _XX_P1) & _U64
+        i += 1
+    out = _xx_fmix(h)
+    return out - (1 << 64) if out >= (1 << 63) else out
+
+
+def _xx_hash_int_py(v: int, seed: int) -> int:
+    h = (seed + _XX_P5 + 4) & _U64
+    h ^= ((v & 0xffffffff) * _XX_P1) & _U64
+    h = ((_rotl64(h, 23) * _XX_P2) + _XX_P3) & _U64
+    out = _xx_fmix(h)
+    return out - (1 << 64) if out >= (1 << 63) else out
+
+
+def _xx_hash_long_py(v: int, seed: int) -> int:
+    v &= _U64
+    h = (seed + _XX_P5 + 8) & _U64
+    h ^= (_rotl64((v * _XX_P2) & _U64, 31) * _XX_P1) & _U64
+    h = ((_rotl64(h, 27) * _XX_P1) + _XX_P4) & _U64
+    out = _xx_fmix(h)
+    return out - (1 << 64) if out >= (1 << 63) else out
+
+
+# ---------------------------------------------------------------------------
+# device (jnp) vectorized kernels
+# ---------------------------------------------------------------------------
+
+def _rotl32_dev(x, r):
+    return (x << np.uint32(r)) | (x >> np.uint32(32 - r))
+
+
+def _m3_mix_k1_dev(k1):
+    k1 = k1 * np.uint32(_M3_C1)
+    k1 = _rotl32_dev(k1, 15)
+    return k1 * np.uint32(_M3_C2)
+
+
+def _m3_mix_h1_dev(h1, k1):
+    h1 = h1 ^ k1
+    h1 = _rotl32_dev(h1, 13)
+    return h1 * np.uint32(5) + np.uint32(0xe6546b64)
+
+
+def _m3_fmix_dev(h1, length):
+    h1 = h1 ^ np.uint32(length)
+    h1 = h1 ^ (h1 >> np.uint32(16))
+    h1 = h1 * np.uint32(0x85ebca6b)
+    h1 = h1 ^ (h1 >> np.uint32(13))
+    h1 = h1 * np.uint32(0xc2b2ae35)
+    return h1 ^ (h1 >> np.uint32(16))
+
+
+def _normalize_to_words(val: DVal):
+    """DVal -> ('int', u32) or ('long', u64) with Spark normalization."""
+    dt, data = val.dtype, val.data
+    name = dt.name
+    if isinstance(dt, DecimalType):
+        return "long", data.astype(jnp.uint64)
+    if name in ("boolean",):
+        return "int", data.astype(jnp.uint32)
+    if name in ("tinyint", "smallint", "int", "date"):
+        # sign-extend then reinterpret (int32 cast keeps two's complement)
+        return "int", data.astype(jnp.int32).astype(jnp.uint32)
+    if name in ("bigint", "timestamp"):
+        return "long", data.astype(jnp.int64).astype(jnp.uint64)
+    if name == "float":
+        f = data.astype(jnp.float32)
+        f = jnp.where(f == 0.0, jnp.float32(0.0), f)       # -0.0 -> 0.0
+        f = jnp.where(jnp.isnan(f), jnp.float32(np.nan), f)  # canonical NaN
+        return "int", jax.lax.bitcast_convert_type(f, jnp.uint32)
+    # DOUBLE is host-only: TPU emulates f64 as double-double, so neither
+    # f64 bitcast nor exact arithmetic reconstruction of the IEEE bits is
+    # available — hashing doubles on device cannot be bit-exact with Spark.
+    raise Unsupported(f"cannot hash {name} on device")
+
+
+def murmur3_fold_device(vals: List[DVal], seed: int) -> jnp.ndarray:
+    """Fold Spark murmur3 over device columns; returns int32 hashes."""
+    h = jnp.full(vals[0].data.shape, np.uint32(seed), dtype=jnp.uint32)
+    for v in vals:
+        kind, words = _normalize_to_words(v)
+        if kind == "int":
+            nh = _m3_fmix_dev(_m3_mix_h1_dev(h, _m3_mix_k1_dev(words)), 4)
+        else:
+            lo = words.astype(jnp.uint32)
+            hi = (words >> np.uint64(32)).astype(jnp.uint32)
+            nh = _m3_mix_h1_dev(h, _m3_mix_k1_dev(lo))
+            nh = _m3_fmix_dev(_m3_mix_h1_dev(nh, _m3_mix_k1_dev(hi)), 8)
+        h = jnp.where(v.validity, nh, h)  # NULL skips the column
+    return h.astype(jnp.int32)
+
+
+def _rotl64_dev(x, r):
+    return (x << np.uint64(r)) | (x >> np.uint64(64 - r))
+
+
+def _xx_fmix_dev(h):
+    h = h ^ (h >> np.uint64(33))
+    h = h * np.uint64(_XX_P2)
+    h = h ^ (h >> np.uint64(29))
+    h = h * np.uint64(_XX_P3)
+    return h ^ (h >> np.uint64(32))
+
+
+def xxhash64_fold_device(vals: List[DVal], seed: int) -> jnp.ndarray:
+    """Fold Spark xxhash64 over device columns; returns int64 hashes."""
+    h = jnp.full(vals[0].data.shape, np.uint64(seed), dtype=jnp.uint64)
+    for v in vals:
+        kind, words = _normalize_to_words(v)
+        if kind == "int":
+            nh = h + np.uint64(_XX_P5) + np.uint64(4)
+            nh = nh ^ (words.astype(jnp.uint64) * np.uint64(_XX_P1))
+            nh = _rotl64_dev(nh, 23) * np.uint64(_XX_P2) + np.uint64(_XX_P3)
+        else:
+            nh = h + np.uint64(_XX_P5) + np.uint64(8)
+            k = _rotl64_dev(words * np.uint64(_XX_P2), 31) * np.uint64(_XX_P1)
+            nh = _rotl64_dev(nh ^ k, 27) * np.uint64(_XX_P1) + np.uint64(_XX_P4)
+        nh = _xx_fmix_dev(nh)
+        # xxhash64's fold re-seeds with the running hash (Spark: seed = hash)
+        h = jnp.where(v.validity, nh, h)
+    return h.astype(jnp.int64)
+
+
+# ---------------------------------------------------------------------------
+# expressions
+# ---------------------------------------------------------------------------
+
+# DOUBLE excluded: no bit-exact f64 bit pattern on TPU (f64 is emulated
+# double-double; bitcast unsupported) — doubles hash on host instead.
+device_hashable = TypeSig([TypeEnum.BOOLEAN, TypeEnum.BYTE, TypeEnum.SHORT,
+                         TypeEnum.INT, TypeEnum.LONG, TypeEnum.FLOAT,
+                         TypeEnum.DATE, TypeEnum.TIMESTAMP,
+                         TypeEnum.DECIMAL])
+
+
+def _py_norm(v, dt: DataType):
+    """Python-side Spark normalization -> ('int'|'long'|'bytes', value)."""
+    name = dt.name
+    if isinstance(dt, DecimalType):
+        return "long", int(round(v * (10 ** dt.scale))) if not isinstance(v, int) else v
+    if name == "boolean":
+        return "int", 1 if v else 0
+    if name in ("tinyint", "smallint", "int", "date"):
+        if hasattr(v, "toordinal"):  # datetime.date from arrow
+            import datetime
+            v = (v - datetime.date(1970, 1, 1)).days
+        return "int", int(v)
+    if name in ("bigint", "timestamp"):
+        if hasattr(v, "timestamp"):  # datetime from arrow; exact int math
+            import datetime
+            if v.tzinfo is None:
+                v = v.replace(tzinfo=datetime.timezone.utc)
+            td = v - datetime.datetime(1970, 1, 1,
+                                       tzinfo=datetime.timezone.utc)
+            v = (td.days * 86_400_000_000 + td.seconds * 1_000_000
+                 + td.microseconds)
+        return "long", int(v)
+    if name == "float":
+        f = np.float32(0.0) if v == 0 else np.float32(v)
+        if np.isnan(f):
+            f = np.float32(np.nan)
+        return "int", int(np.frombuffer(np.float32(f).tobytes(), np.int32)[0])
+    if name == "double":
+        d = np.float64(0.0) if v == 0 else np.float64(v)
+        if np.isnan(d):
+            d = np.float64(np.nan)
+        return "long", int(np.frombuffer(np.float64(d).tobytes(), np.int64)[0])
+    if name == "string":
+        return "bytes", v.encode("utf-8")
+    if name == "binary":
+        return "bytes", bytes(v)
+    raise Unsupported(f"cannot hash type {name}")
+
+
+class _HashBase(Expression):
+    """Shared: device fold when all children are device-backed, else host."""
+
+    seed: int
+
+    def __init__(self, children, seed):
+        self.children = list(children)
+        self.seed = seed
+
+    def nullable(self, schema):
+        return False
+
+    def device_unsupported_reason(self, schema: Schema) -> Optional[str]:
+        for c in self.children:
+            dt = c.data_type(schema)
+            r = device_hashable.reason_not_supported(dt)
+            if r is not None:
+                return f"{type(self).__name__}: input {r} (hashes on host)"
+        return None
+
+    def key(self):
+        kids = ",".join(c.key() for c in self.children)
+        return f"{type(self).__name__}({kids},seed={self.seed})"
+
+    # host fold over mixed types
+    def _host_fold(self, batch, hash_int, hash_long, hash_bytes):
+        cols = []
+        for c in self.children:
+            arr = c.eval_host(batch)
+            cols.append((arr.to_pylist(), c.data_type(batch.schema)))
+        n = batch.num_rows
+        out = []
+        for i in range(n):
+            h = self.seed
+            for vals, dt in cols:
+                v = vals[i]
+                if v is None:
+                    continue
+                kind, nv = _py_norm(v, dt)
+                if kind == "int":
+                    h = hash_int(nv, h & self._seed_mask)
+                elif kind == "long":
+                    h = hash_long(nv, h & self._seed_mask)
+                else:
+                    h = hash_bytes(nv, h & self._seed_mask)
+            out.append(h)
+        return out
+
+
+class Murmur3Hash(_HashBase):
+    """hash(cols...) — Spark Murmur3 with seed 42 (HashPartitioning's hash)."""
+
+    _seed_mask = 0xffffffff
+
+    def __init__(self, children, seed: int = 42):
+        super().__init__(children, seed)
+
+    def data_type(self, schema):
+        return INT32
+
+    def eval_device(self, ctx: EvalContext) -> DVal:
+        vals = [c.eval_device(ctx) for c in self.children]
+        h = murmur3_fold_device(vals, self.seed)
+        return DVal(h, jnp.ones_like(h, dtype=jnp.bool_), INT32)
+
+    def eval_host(self, batch):
+        out = self._host_fold(batch, _m3_hash_int_py, _m3_hash_long_py,
+                              spark_murmur3_bytes)
+        return masked_numpy_to_arrow(np.asarray(out, np.int32),
+                                     np.ones(len(out), np.bool_), INT32)
+
+
+class XxHash64(_HashBase):
+    """xxhash64(cols...) — Spark XXH64 with seed 42."""
+
+    _seed_mask = _U64
+
+    def __init__(self, children, seed: int = 42):
+        super().__init__(children, seed)
+
+    def data_type(self, schema):
+        return INT64
+
+    def eval_device(self, ctx: EvalContext) -> DVal:
+        vals = [c.eval_device(ctx) for c in self.children]
+        h = xxhash64_fold_device(vals, self.seed)
+        return DVal(h, jnp.ones_like(h, dtype=jnp.bool_), INT64)
+
+    def eval_host(self, batch):
+        out = self._host_fold(batch, _xx_hash_int_py, _xx_hash_long_py,
+                              spark_xxhash64_bytes)
+        return masked_numpy_to_arrow(np.asarray(out, np.int64),
+                                     np.ones(len(out), np.bool_), INT64)
+
+
+def _hive_hash_py(v, dt: DataType) -> int:
+    name = dt.name
+    if name == "boolean":
+        return 1 if v else 0
+    if name in ("tinyint", "smallint", "int", "date"):
+        kind, nv = _py_norm(v, dt)
+        return nv & 0xffffffff if nv < 0 else nv
+    if name in ("bigint", "timestamp"):
+        _, nv = _py_norm(v, dt)
+        nv &= _U64
+        return ((nv >> 32) ^ nv) & 0xffffffff
+    if name == "float":
+        _, nv = _py_norm(v, dt)
+        return nv & 0xffffffff
+    if name == "double":
+        _, nv = _py_norm(v, dt)
+        nv &= _U64
+        return ((nv >> 32) ^ nv) & 0xffffffff
+    if name == "string":
+        # Java String.hashCode folds UTF-16 code units (surrogate pairs for
+        # non-BMP chars), not code points
+        h = 0
+        data = v.encode("utf-16-be")
+        for i in range(0, len(data), 2):
+            h = (h * 31 + int.from_bytes(data[i:i + 2], "big")) & 0xffffffff
+        return h
+    raise Unsupported(f"hive hash of {name}")
+
+
+class HiveHash(Expression):
+    """hive_hash: fold h = h*31 + hash(col), h0=0 (ref HiveHash in
+    HashFunctions.scala / jni Hash.hiveHash). Host implementation."""
+
+    def __init__(self, children):
+        self.children = list(children)
+
+    def data_type(self, schema):
+        return INT32
+
+    def nullable(self, schema):
+        return False
+
+    def device_unsupported_reason(self, schema):
+        return "HiveHash runs on host"
+
+    def eval_host(self, batch):
+        cols = [(c.eval_host(batch).to_pylist(), c.data_type(batch.schema))
+                for c in self.children]
+        out = []
+        for i in range(batch.num_rows):
+            h = 0
+            for vals, dt in cols:
+                v = vals[i]
+                ch = 0 if v is None else _hive_hash_py(v, dt)
+                h = (h * 31 + ch) & 0xffffffff
+            out.append(h - (1 << 32) if h >= (1 << 31) else h)
+        return masked_numpy_to_arrow(np.asarray(out, np.int32),
+                                     np.ones(len(out), np.bool_), INT32)
+
+
+class _Digest(Expression):
+    """Host digests over string/binary (ref Md5/Sha1/Sha2 cudf kernels)."""
+
+    def __init__(self, child):
+        self.children = [child]
+
+    def data_type(self, schema):
+        return STRING
+
+    def device_unsupported_reason(self, schema):
+        return f"{type(self).__name__}: digest runs on host"
+
+    def _digest(self, data: bytes) -> str:
+        raise NotImplementedError
+
+    def eval_host(self, batch):
+        import pyarrow as pa
+        vals = self.children[0].eval_host(batch).to_pylist()
+        out = []
+        for v in vals:
+            if v is None:
+                out.append(None)
+            else:
+                b = v.encode("utf-8") if isinstance(v, str) else bytes(v)
+                out.append(self._digest(b))
+        return pa.array(out, type=pa.string())
+
+
+class Md5(_Digest):
+    def _digest(self, data):
+        import hashlib
+        return hashlib.md5(data).hexdigest()
+
+
+class Sha1(_Digest):
+    def _digest(self, data):
+        import hashlib
+        return hashlib.sha1(data).hexdigest()
+
+
+class Sha2(_Digest):
+    def __init__(self, child, num_bits: int = 256):
+        super().__init__(child)
+        self.num_bits = num_bits
+
+    def _digest(self, data):
+        import hashlib
+        bits = 256 if self.num_bits == 0 else self.num_bits
+        fn = {224: hashlib.sha224, 256: hashlib.sha256,
+              384: hashlib.sha384, 512: hashlib.sha512}.get(bits)
+        if fn is None:
+            return None
+        return fn(data).hexdigest()
+
+    def key(self):
+        return f"Sha2({self.children[0].key()},{self.num_bits})"
+
+
+class Crc32(Expression):
+    def __init__(self, child):
+        self.children = [child]
+
+    def data_type(self, schema):
+        return INT64
+
+    def device_unsupported_reason(self, schema):
+        return "Crc32 runs on host"
+
+    def eval_host(self, batch):
+        import zlib
+        vals = self.children[0].eval_host(batch).to_pylist()
+        out, valid = [], []
+        for v in vals:
+            if v is None:
+                out.append(0)
+                valid.append(False)
+            else:
+                b = v.encode("utf-8") if isinstance(v, str) else bytes(v)
+                out.append(zlib.crc32(b) & 0xffffffff)
+                valid.append(True)
+        return masked_numpy_to_arrow(np.asarray(out, np.int64),
+                                     np.asarray(valid, np.bool_), INT64)

@@ -85,8 +85,11 @@ class _HigherOrder(_HostCollectionExpr):
         raise NotImplementedError
 
     def _outer_refs(self):
-        arg_names = {a.name for a in self.args}
-        return [r for r in self.body.references() if r not in arg_names]
+        # exclude ANY lambda variable (not just this HOF's own args): a
+        # nested HOF's inner variables resolve inside its own _flat_eval,
+        # never against the enclosing batch
+        return [r for r in self.body.references()
+                if not r.startswith("`lambda_")]
 
     def _flat_eval(self, batch, rows):
         """rows: per-input-row element lists (None rows contribute nothing).

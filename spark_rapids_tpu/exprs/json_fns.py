@@ -1,0 +1,322 @@
+"""JSON expressions: get_json_object, from_json, to_json, json_tuple.
+
+Reference analog: GpuGetJsonObject / GpuJsonToStructs / GpuStructsToJson
+backed by the `JSONUtils` JNI kernels (SURVEY.md 2.6/2.12). Host-resident in
+round 1 (strings have no dense device layout); Spark semantics:
+
+  * get_json_object: JSONPath subset `$`, `.field`, `['field']`, `[index]`,
+    `[*]`, `.*`; invalid path or missing => NULL; scalar results unquoted,
+    object/array results as compact JSON.
+  * from_json: PERMISSIVE mode — malformed row => all-NULL struct fields.
+  * to_json: compact JSON, NULL fields omitted (Spark ignoreNullFields=true).
+"""
+from __future__ import annotations
+
+import json
+import math
+from typing import List, Optional
+
+from ..types import (ArrayType, DataType, MapType, STRING, Schema,
+                     StructType, to_arrow)
+from .base import Expression, Literal, Unsupported
+
+__all__ = ["GetJsonObject", "JsonToStructs", "StructsToJson", "JsonTuple",
+           "json_path_eval"]
+
+
+class _HostJsonExpr(Expression):
+    def device_unsupported_reason(self, schema: Schema) -> Optional[str]:
+        return f"{type(self).__name__}: JSON expressions run on host"
+
+
+# --- JSONPath subset parser/evaluator ---------------------------------------
+
+def _parse_path(path: str):
+    """'$.a[0].b' -> [('key','a'), ('idx',0), ('key','b')]; None if invalid."""
+    if not path or path[0] != "$":
+        return None
+    steps = []
+    i = 1
+    n = len(path)
+    while i < n:
+        c = path[i]
+        if c == ".":
+            i += 1
+            if i < n and path[i] == "*":
+                steps.append(("wild", None))
+                i += 1
+                continue
+            j = i
+            while j < n and path[j] not in ".[":
+                j += 1
+            if j == i:
+                return None
+            steps.append(("key", path[i:j]))
+            i = j
+        elif c == "[":
+            j = path.find("]", i)
+            if j < 0:
+                return None
+            inner = path[i + 1:j].strip()
+            if inner == "*":
+                steps.append(("wild", None))
+            elif inner.startswith("'") and inner.endswith("'") and len(inner) >= 2:
+                steps.append(("key", inner[1:-1]))
+            else:
+                try:
+                    steps.append(("idx", int(inner)))
+                except ValueError:
+                    return None
+            i = j + 1
+        else:
+            return None
+    return steps
+
+
+def _walk(obj, steps):
+    """Evaluate steps; returns (found, value). Wildcards collect lists."""
+    if not steps:
+        return True, obj
+    kind, arg = steps[0]
+    rest = steps[1:]
+    if kind == "key":
+        if isinstance(obj, dict) and arg in obj:
+            return _walk(obj[arg], rest)
+        return False, None
+    if kind == "idx":
+        if isinstance(obj, list) and 0 <= arg < len(obj):
+            return _walk(obj[arg], rest)
+        return False, None
+    # wildcard: map over list elements / dict values
+    if isinstance(obj, list):
+        vals = []
+        for el in obj:
+            f, v = _walk(el, rest)
+            if f:
+                vals.append(v)
+        if not vals:
+            return False, None
+        return True, vals[0] if len(vals) == 1 else vals
+    if isinstance(obj, dict):
+        vals = []
+        for el in obj.values():
+            f, v = _walk(el, rest)
+            if f:
+                vals.append(v)
+        if not vals:
+            return False, None
+        return True, vals[0] if len(vals) == 1 else vals
+    return False, None
+
+
+def _render(v) -> str:
+    """Spark renders scalars unquoted, containers as compact JSON."""
+    if isinstance(v, str):
+        return v
+    if v is True:
+        return "true"
+    if v is False:
+        return "false"
+    if isinstance(v, float) and v.is_integer():
+        return json.dumps(v)
+    if isinstance(v, (dict, list)):
+        return json.dumps(v, separators=(",", ":"))
+    return json.dumps(v)
+
+
+_PATH_CACHE: dict = {}
+
+
+def json_path_eval(doc: Optional[str], path: str) -> Optional[str]:
+    if doc is None:
+        return None
+    # the path is almost always a plan-time literal: parse once per distinct
+    # path, not once per row (Spark compiles the path per expression)
+    if path in _PATH_CACHE:
+        steps = _PATH_CACHE[path]
+    else:
+        steps = _parse_path(path)
+        if len(_PATH_CACHE) < 1024:
+            _PATH_CACHE[path] = steps
+    if steps is None:
+        return None
+    try:
+        obj = json.loads(doc)
+    except (json.JSONDecodeError, ValueError):
+        return None
+    found, v = _walk(obj, steps)
+    if not found or v is None:
+        return None
+    return _render(v)
+
+
+class GetJsonObject(_HostJsonExpr):
+    def __init__(self, child, path):
+        self.children = [child, path]
+
+    def data_type(self, schema):
+        return STRING
+
+    def eval_host(self, batch):
+        import pyarrow as pa
+        docs = self.children[0].eval_host(batch).to_pylist()
+        paths = self.children[1].eval_host(batch).to_pylist()
+        out = [None if p is None else json_path_eval(d, p)
+               for d, p in zip(docs, paths)]
+        return pa.array(out, type=pa.string())
+
+
+def _coerce(v, dt: DataType):
+    """PERMISSIVE-mode coercion of a parsed JSON value to dt; None if the
+    value cannot be coerced (field nulled, row kept)."""
+    if v is None:
+        return None
+    try:
+        name = dt.name
+        if isinstance(dt, StructType):
+            if not isinstance(v, dict):
+                return None
+            return {f.name: _coerce(v.get(f.name), f.dtype) for f in dt.fields}
+        if isinstance(dt, ArrayType):
+            if not isinstance(v, list):
+                return None
+            return [_coerce(x, dt.element) for x in v]
+        if isinstance(dt, MapType):
+            if not isinstance(v, dict):
+                return None
+            return [(k, _coerce(x, dt.value)) for k, x in v.items()]
+        if name == "string":
+            return v if isinstance(v, str) else _render(v)
+        if name == "boolean":
+            return v if isinstance(v, bool) else None
+        if name in ("tinyint", "smallint", "int", "bigint"):
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                return None
+            if isinstance(v, float) and not v.is_integer():
+                return None
+            return int(v)
+        if name in ("float", "double"):
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                return None
+            return float(v)
+    except (TypeError, ValueError):
+        return None
+    return None
+
+
+class JsonToStructs(_HostJsonExpr):
+    """from_json(col, schema) — PERMISSIVE: malformed => null row."""
+
+    def __init__(self, child, schema: DataType):
+        self.children = [child]
+        self.target = schema
+
+    def data_type(self, schema):
+        return self.target
+
+    def eval_host(self, batch):
+        import pyarrow as pa
+        docs = self.children[0].eval_host(batch).to_pylist()
+        out = []
+        for d in docs:
+            if d is None:
+                out.append(None)
+                continue
+            try:
+                obj = json.loads(d)
+            except (json.JSONDecodeError, ValueError):
+                obj = None
+            if obj is None:
+                # malformed: all-null struct (PERMISSIVE), null otherwise
+                if isinstance(self.target, StructType):
+                    out.append({f.name: None for f in self.target.fields})
+                else:
+                    out.append(None)
+                continue
+            out.append(_coerce(obj, self.target))
+        return pa.array(out, type=to_arrow(self.target))
+
+    def key(self):
+        return f"JsonToStructs({self.children[0].key()},{self.target.name})"
+
+
+def _to_jsonable(v, dt: DataType):
+    if v is None:
+        return None
+    if isinstance(dt, StructType):
+        return {f.name: _to_jsonable(v[f.name], f.dtype)
+                for f in dt.fields if v.get(f.name) is not None}
+    if isinstance(dt, ArrayType):
+        return [_to_jsonable(x, dt.element) for x in v]
+    if isinstance(dt, MapType):
+        return {str(k): _to_jsonable(x, dt.value) for k, x in v}
+    if dt.name in ("float", "double"):
+        if isinstance(v, float) and math.isnan(v):
+            return "NaN"                      # Spark renders as string "NaN"
+        if isinstance(v, float) and math.isinf(v):
+            return "Infinity" if v > 0 else "-Infinity"
+        return v
+    if dt.name == "timestamp":
+        return v.strftime("%Y-%m-%dT%H:%M:%S.%f%z") if hasattr(v, "strftime") else v
+    if dt.name == "date":
+        return v.isoformat() if hasattr(v, "isoformat") else v
+    if isinstance(dt, type(STRING)) and hasattr(v, "decode"):
+        return v.decode("utf-8", "replace")
+    return v
+
+
+class StructsToJson(_HostJsonExpr):
+    """to_json(struct|map|array) — compact, NULL fields omitted (Spark
+    default ignoreNullFields=true)."""
+
+    def __init__(self, child):
+        self.children = [child]
+
+    def data_type(self, schema):
+        return STRING
+
+    def eval_host(self, batch):
+        import pyarrow as pa
+        dt = self.children[0].data_type(batch.schema)
+        rows = self.children[0].eval_host(batch).to_pylist()
+        out = [None if v is None else
+               json.dumps(_to_jsonable(v, dt), separators=(",", ":"))
+               for v in rows]
+        return pa.array(out, type=pa.string())
+
+
+class JsonTuple(_HostJsonExpr):
+    """json_tuple(col, f1, f2, ...) — struct of extracted top-level fields
+    (Spark's generator form is handled by Generate; the struct output keeps
+    this a scalar expression, matching GpuJsonTuple's one-kernel shape)."""
+
+    def __init__(self, child, *fields):
+        self.children = [child]
+        self.fields: List[str] = [
+            f.value if isinstance(f, Literal) else str(f) for f in fields]
+
+    def data_type(self, schema):
+        from ..types import StructField
+        return StructType([StructField(f"c{i}", STRING)
+                           for i in range(len(self.fields))])
+
+    def eval_host(self, batch):
+        import pyarrow as pa
+        docs = self.children[0].eval_host(batch).to_pylist()
+        out = []
+        for d in docs:
+            row = {}
+            obj = None
+            if d is not None:
+                try:
+                    obj = json.loads(d)
+                except (json.JSONDecodeError, ValueError):
+                    obj = None
+            for i, f in enumerate(self.fields):
+                v = obj.get(f) if isinstance(obj, dict) else None
+                row[f"c{i}"] = None if v is None else _render(v)
+            out.append(row)
+        return pa.array(out, type=to_arrow(self.data_type(batch.schema)))
+
+    def key(self):
+        return f"JsonTuple({self.children[0].key()},{','.join(self.fields)})"

@@ -29,10 +29,46 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..exprs.base import DVal, EvalContext, Expression
 from ..exec.groupby_core import segmented_groupby
-from ..shuffle.partitioning import _col_hash_u32, _mix32
 from ..types import Schema
 
 __all__ = ["build_distributed_agg_step", "distributed_groupby"]
+
+# Engine-INTERNAL routing hash for group->owner placement (placement here
+# never needs Spark parity — unlike shuffle partitioning, which uses the
+# Spark-exact Murmur3 in exprs/hash_fns.py). 32-bit mixing only, so it
+# works for every device dtype including f64 (hashed via its f32 image;
+# equal keys still hash equal, the only requirement) — TPU has no f64
+# bitcast (hash_fns.py device notes).
+
+_M1 = jnp.uint32(0x85EBCA6B)
+_M2 = jnp.uint32(0xC2B2AE35)
+
+
+def _mix32(h):
+    h = h ^ (h >> jnp.uint32(16))
+    h = h * _M1
+    h = h ^ (h >> jnp.uint32(13))
+    h = h * _M2
+    h = h ^ (h >> jnp.uint32(16))
+    return h
+
+
+def _col_hash_u32(v: DVal):
+    d = v.data
+    if jnp.issubdtype(d.dtype, jnp.floating):
+        f = d.astype(jnp.float32)
+        f = jnp.where(f == 0.0, jnp.zeros_like(f), f)
+        f = jnp.where(jnp.isnan(f), jnp.full_like(f, jnp.nan), f)
+        h = jax.lax.bitcast_convert_type(f, jnp.uint32)
+    elif d.dtype == jnp.bool_:
+        h = d.astype(jnp.uint32)
+    else:
+        x = d.astype(jnp.int64)
+        lo = (x & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
+        hi = (x >> jnp.int64(32)).astype(jnp.uint32)
+        h = lo ^ _mix32(hi)
+    # null contributes a fixed tag so null keys land together
+    return jnp.where(v.validity, _mix32(h), jnp.uint32(42))
 
 
 def _route_to_buffers(arrays, pid, padded_len: int, n_dev: int):

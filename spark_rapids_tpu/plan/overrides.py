@@ -262,6 +262,14 @@ class RepartitionMeta(PlanMeta):
             r = k.fully_device_supported(schema)
             if r:
                 self.will_not_work_on_tpu(f"partition key <{k.name_hint}>: {r}")
+            if self.plan.mode == "hash":
+                # device murmur3 covers fewer types than device storage
+                # (e.g. DOUBLE hashes on host only — hash_fns device notes)
+                from ..exprs.hash_fns import device_hashable
+                hr = device_hashable.reason_not_supported(k.data_type(schema))
+                if hr:
+                    self.will_not_work_on_tpu(
+                        f"hash partition key <{k.name_hint}>: {hr}")
 
     def convert_to_tpu(self, children):
         from ..shuffle.exchange import ShuffleExchangeExec

@@ -7,12 +7,9 @@ one fused kernel, rows are grouped by ONE stable lax.sort on partition id
 a single host sync of the count vector lets the host slice out per-partition
 views with no further device work.
 
-Hash details: 32-bit mixing only (TPU has no 64-bit bitcast); floats are
-canonicalized (-0.0, NaN) then bitcast f32->u32 (f64 keys hash via their f32
-image — equal keys still hash equal, which is the only requirement); the
-exact hash differs from Spark's Murmur3 — partition placement is engine
-internal, so only determinism matters (ref GpuHashPartitioningBase uses
-cudf Murmur3 for the same internal purpose).
+Hash details: Spark-exact Murmur3 fold (seed 42) + pmod, matching
+HashPartitioning placement bit-for-bit (ref GpuHashPartitioningBase uses cudf
+Murmur3 with the same contract) — device kernel in exprs/hash_fns.py.
 """
 from __future__ import annotations
 
@@ -31,36 +28,6 @@ __all__ = ["hash_partition_ids", "partition_batch", "PartitionedBatches"]
 
 _PART_CACHE: Dict[Tuple, object] = {}
 
-_M1 = jnp.uint32(0x85EBCA6B)
-_M2 = jnp.uint32(0xC2B2AE35)
-
-
-def _mix32(h):
-    h = h ^ (h >> jnp.uint32(16))
-    h = h * _M1
-    h = h ^ (h >> jnp.uint32(13))
-    h = h * _M2
-    h = h ^ (h >> jnp.uint32(16))
-    return h
-
-
-def _col_hash_u32(v: DVal):
-    d = v.data
-    if jnp.issubdtype(d.dtype, jnp.floating):
-        f = d.astype(jnp.float32)
-        f = jnp.where(f == 0.0, jnp.zeros_like(f), f)
-        f = jnp.where(jnp.isnan(f), jnp.full_like(f, jnp.nan), f)
-        h = jax.lax.bitcast_convert_type(f, jnp.uint32)
-    elif d.dtype == jnp.bool_:
-        h = d.astype(jnp.uint32)
-    else:
-        x = d.astype(jnp.int64)
-        lo = (x & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
-        hi = (x >> jnp.int64(32)).astype(jnp.uint32)
-        h = lo ^ _mix32(hi)
-    # null contributes a fixed tag so null keys land together
-    return jnp.where(v.validity, _mix32(h), jnp.uint32(42))
-
 
 def _build_pid_kernel(key_exprs: Sequence[Expression], schema: Schema,
                       mode: str):
@@ -72,11 +39,11 @@ def _build_pid_kernel(key_exprs: Sequence[Expression], schema: Schema,
                  for c, dt in zip(cols, dtypes)]
         ctx = EvalContext(schema, dvals, num_rows, padded_len)
         if mode == "hash":
-            h = jnp.full(padded_len, jnp.uint32(42))
-            for e in key_exprs:
-                h = _mix32(h * jnp.uint32(31) + _col_hash_u32(
-                    e.eval_device(ctx)))
-            pid = (h % jnp.uint32(num_parts)).astype(jnp.int32)
+            from ..exprs.hash_fns import murmur3_fold_device
+            h = murmur3_fold_device([e.eval_device(ctx) for e in key_exprs],
+                                    42)
+            pid = h % jnp.int32(num_parts)          # Spark pmod semantics
+            pid = jnp.where(pid < 0, pid + jnp.int32(num_parts), pid)
         elif mode == "roundrobin":
             pid = (jnp.arange(padded_len, dtype=jnp.int32)
                    % jnp.int32(num_parts))

@@ -231,3 +231,9 @@ def test_filter_with_index_and_bad_arity():
 def test_sequence_illegal_boundaries():
     with pytest.raises(ValueError, match="Illegal sequence boundaries"):
         _run(F.sequence(F.lit(1), F.lit(5), F.lit(-1)))
+
+
+def test_nested_higher_order():
+    got = _run(F.transform(F.col("n"), lambda a: F.transform(a, lambda x: x * 2)),
+               n=[[[1, 2], [3]], None])
+    assert got == [[[2, 4], [6]], None]

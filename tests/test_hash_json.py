@@ -1,0 +1,223 @@
+"""Hash + JSON expression tests.
+
+Murmur3 anchors: for inputs whose byte length is a multiple of 4, Spark's
+Murmur3_x86_32 equals the standard public algorithm, so the published
+reference vectors apply (e.g. bytes 21 43 65 87 seed 0 -> 0xF55B516B).
+xxHash64 anchor: empty input seed 0 -> 0xEF46DB3751D8E999.
+Beyond anchors, the DEVICE kernels are differentially checked against the
+independently-written pure-Python scalar implementations on random data
+(nulls, negatives, -0.0, NaN, multi-column folds).
+"""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from harness import tpu_session, cpu_session
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.exprs.hash_fns import (
+    _m3_hash_int_py, _m3_hash_long_py, _xx_hash_int_py, _xx_hash_long_py,
+    spark_murmur3_bytes, spark_xxhash64_bytes)
+from spark_rapids_tpu.types import StructType, StructField, INT32, FLOAT64, STRING
+
+
+def _signed32(x):
+    return x - (1 << 32) if x >= (1 << 31) else x
+
+
+def test_murmur3_published_vectors():
+    # standard murmur3_x86_32 vectors (4-byte aligned => Spark-identical)
+    assert spark_murmur3_bytes(b"", 0) == 0
+    assert spark_murmur3_bytes(b"", 1) == _signed32(0x514E28B7)
+    assert spark_murmur3_bytes(bytes([0x21, 0x43, 0x65, 0x87]), 0) == \
+        _signed32(0xF55B516B)
+    # hashInt(v) == hashBytes(4 LE bytes of v)
+    assert _m3_hash_int_py(0x87654321, 0) == _signed32(0xF55B516B)
+    for v in (0, 1, -1, 42, 2**31 - 1, -2**31):
+        assert _m3_hash_int_py(v, 42) == \
+            spark_murmur3_bytes(np.int32(v).tobytes(), 42)
+    for v in (0, 1, -1, 2**63 - 1, -2**63, 123456789012345):
+        assert _m3_hash_long_py(v, 42) == \
+            spark_murmur3_bytes(np.int64(v).tobytes(), 42)
+
+
+def test_xxhash64_published_vectors():
+    assert spark_xxhash64_bytes(b"", 0) == \
+        np.int64(np.uint64(0xEF46DB3751D8E999)).item()
+    for v in (0, 1, -1, 42, 2**31 - 1):
+        assert _xx_hash_int_py(v, 42) == \
+            spark_xxhash64_bytes(np.int32(v).tobytes(), 42)
+    for v in (0, 1, -1, 2**63 - 1, 9876543210):
+        assert _xx_hash_long_py(v, 42) == \
+            spark_xxhash64_bytes(np.int64(v).tobytes(), 42)
+    # >=32-byte path (4-accumulator loop)
+    long_input = bytes(range(64))
+    assert isinstance(spark_xxhash64_bytes(long_input, 0), int)
+
+
+def _device_vs_host(table, cols, fn):
+    t = tpu_session().create_dataframe(table) \
+        .select(fn(*[F.col(c) for c in cols]).alias("h")).to_pandas()
+    c = cpu_session().create_dataframe(table) \
+        .select(fn(*[F.col(c) for c in cols]).alias("h")).to_pandas()
+    np.testing.assert_array_equal(t["h"].to_numpy(), c["h"].to_numpy())
+    return t["h"].tolist()
+
+
+def test_hash_device_matches_scalar_reference():
+    rng = np.random.RandomState(7)
+    n = 257
+    i32 = rng.randint(-2**31, 2**31, n).astype(np.int32)
+    i64 = rng.randint(-2**62, 2**62, n).astype(np.int64)
+    f64 = rng.randn(n)
+    f64[0], f64[1], f64[2] = 0.0, -0.0, np.nan
+    mask = rng.rand(n) > 0.2
+    table = pa.table({
+        "a": pa.array(i32, mask=~mask),
+        "b": pa.array(i64),
+        "c": pa.array(f64),
+    })
+    got = _device_vs_host(table, ["a", "b", "c"], F.hash)
+    # scalar oracle fold
+    import numpy as _np
+    for i in (0, 1, 2, 5, 100, 256):
+        h = 42
+        if mask[i]:
+            h = _m3_hash_int_py(int(i32[i]), h & 0xffffffff)
+        h = _m3_hash_long_py(int(i64[i]), h & 0xffffffff)
+        d = 0.0 if f64[i] == 0 else f64[i]
+        bits = int(_np.frombuffer(_np.float64(d).tobytes(), _np.int64)[0])
+        if _np.isnan(d):
+            bits = int(_np.frombuffer(_np.float64(_np.nan).tobytes(),
+                                      _np.int64)[0])
+        h = _m3_hash_long_py(bits, h & 0xffffffff)
+        assert got[i] == h, i
+    # -0.0 and 0.0 hash equal (Spark normalization)
+    t2 = pa.table({"x": [0.0], "y": [-0.0]})
+    s = tpu_session()
+    r = s.create_dataframe(t2).select(F.hash(F.col("x")).alias("hx"),
+                                      F.hash(F.col("y")).alias("hy")).to_pandas()
+    assert r["hx"][0] == r["hy"][0]
+
+
+def test_xxhash64_device_matches_scalar_reference():
+    rng = np.random.RandomState(8)
+    n = 128
+    i32 = rng.randint(-2**31, 2**31, n).astype(np.int32)
+    i64 = rng.randint(-2**62, 2**62, n).astype(np.int64)
+    mask = rng.rand(n) > 0.3
+    table = pa.table({"a": pa.array(i32, mask=~mask), "b": pa.array(i64)})
+    got = _device_vs_host(table, ["a", "b"], F.xxhash64)
+    for i in (0, 3, 77, 127):
+        h = 42
+        if mask[i]:
+            h = _xx_hash_int_py(int(i32[i]), h)
+        h = _xx_hash_long_py(int(i64[i]), h & (2**64 - 1))
+        assert got[i] == h, i
+
+
+def test_hash_with_strings_falls_back_to_host():
+    table = pa.table({"s": ["ab", None, "hello world", ""],
+                      "i": pa.array([1, 2, 3, 4], type=pa.int32())})
+    got = _device_vs_host(table, ["s", "i"], F.hash)
+    # oracle: fold string bytes then int
+    h0 = _m3_hash_int_py(1, spark_murmur3_bytes(b"ab", 42) & 0xffffffff)
+    assert got[0] == h0
+    h1 = _m3_hash_int_py(2, 42)  # null string skipped
+    assert got[1] == h1
+
+
+def test_hive_hash_and_digests():
+    s = tpu_session()
+    df = s.create_dataframe(pa.table({"s": ["abc", None, ""]}))
+    out = df.select(F.hive_hash(F.col("s")).alias("h"),
+                    F.md5(F.col("s")).alias("m"),
+                    F.sha1(F.col("s")).alias("s1"),
+                    F.sha2(F.col("s")).alias("s2"),
+                    F.crc32(F.col("s")).alias("c")).collect()
+    # Java "abc".hashCode() == 96354; hive fold of one col = that value
+    assert out[0]["h"] == 96354
+    assert out[0]["m"] == "900150983cd24fb0d6963f7d28e17f72"
+    assert out[0]["s1"] == "a9993e364706816aba3e25717850c26c9cd0d89d"
+    assert out[0]["s2"] == ("ba7816bf8f01cfea414140de5dae2223"
+                            "b00361a396177a9cb410ff61f20015ad")
+    assert out[0]["c"] == 891568578
+    assert out[1]["m"] is None and out[1]["c"] is None
+    assert out[2]["h"] == 0
+
+
+def test_hash_partition_matches_spark_placement():
+    """pmod(murmur3(key, 42), n) decides placement, bit-for-bit."""
+    from spark_rapids_tpu.columnar import ColumnarBatch
+    from spark_rapids_tpu.shuffle.partitioning import partition_batch
+    from spark_rapids_tpu.exprs import ColumnRef
+    keys = np.arange(100, dtype=np.int64)
+    batch = ColumnarBatch.from_arrow(pa.table({"k": keys}))
+    parts = partition_batch(batch, [ColumnRef("k")], 8)
+    expected = [(_m3_hash_long_py(int(k), 42) % 8 + 8) % 8 for k in keys]
+    got = {}
+    for p in range(8):
+        for row in parts.partition(p).column("k").to_pylist():
+            got[row] = p
+    assert [got[int(k)] for k in keys] == expected
+
+
+# --- JSON -------------------------------------------------------------------
+
+DOCS = ['{"a": 1, "b": {"c": "x"}, "d": [1, 2, 3]}',
+        '{"a": null}', "not json", None, '{"d": [{"e": 5}, {"e": 6}]}']
+
+
+def _runj(col, **cols):
+    s = tpu_session()
+    if not cols:
+        cols = {"j": DOCS}
+    df = s.create_dataframe(pa.table(cols))
+    return df.select(col.alias("r")).collect_arrow().column("r").to_pylist()
+
+
+def test_get_json_object():
+    assert _runj(F.get_json_object(F.col("j"), "$.a")) == \
+        ["1", None, None, None, None]
+    assert _runj(F.get_json_object(F.col("j"), "$.b.c")) == \
+        ["x", None, None, None, None]
+    assert _runj(F.get_json_object(F.col("j"), "$.b")) == \
+        ['{"c":"x"}', None, None, None, None]
+    assert _runj(F.get_json_object(F.col("j"), "$.d[1]")) == \
+        ["2", None, None, None, '{"e":6}']
+    assert _runj(F.get_json_object(F.col("j"), "$.d[*].e")) == \
+        [None, None, None, None, "[5,6]"]
+    assert _runj(F.get_json_object(F.col("j"), "bad path")) == [None] * 5
+
+
+def test_from_json():
+    schema = StructType([StructField("a", INT32), StructField("x", FLOAT64)])
+    got = _runj(F.from_json(F.col("j"), schema),
+                j=['{"a": 3, "x": 1.5}', '{"a": "oops"}', "garbage", None])
+    assert got == [{"a": 3, "x": 1.5}, {"a": None, "x": None},
+                   {"a": None, "x": None}, None]
+
+
+def test_to_json_roundtrip():
+    got = _runj(F.to_json(F.struct(F.col("x"), F.col("y"))),
+                x=[1, None], y=["a", "b"])
+    assert got == ['{"x":1,"y":"a"}', '{"y":"b"}']
+
+
+def test_json_tuple():
+    got = _runj(F.json_tuple(F.col("j"), "a", "b"),
+                j=['{"a": 1, "b": 2}', '{"b": "z"}', None])
+    assert got == [{"c0": "1", "c1": "2"}, {"c0": None, "c1": "z"},
+                   {"c0": None, "c1": None}]
+
+
+def test_hive_hash_surrogate_pairs():
+    s = tpu_session()
+    df = s.create_dataframe(pa.table({"s": ["\U0001D11E"]}))
+    out = df.select(F.hive_hash(F.col("s")).alias("h")).collect()
+    assert out[0]["h"] == 0xD834 * 31 + 0xDD1E  # Java folds UTF-16 units
+
+
+def test_to_json_nan_inf():
+    import math
+    got = _runj(F.to_json(F.struct(F.col("x"))), x=[math.nan, math.inf, -math.inf])
+    assert got == ['{"x":"NaN"}', '{"x":"Infinity"}', '{"x":"-Infinity"}']
