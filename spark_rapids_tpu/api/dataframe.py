@@ -100,7 +100,49 @@ class DataFrame:
     # ------------------------------------------------------------ plan ops
     def select(self, *cols) -> "DataFrame":
         exprs = [_as_expr(c) for c in cols]
+        gen = self._extract_generator(exprs)
+        if gen is not None:
+            return gen
         return DataFrame(self.session, L.Project(exprs, self.plan))
+
+    def _extract_generator(self, exprs) -> Optional["DataFrame"]:
+        """Spark's ExtractGenerator analyzer rule: a select list containing
+        explode/posexplode/stack plans a Generate node, with the other
+        expressions evaluated on top of its pass-through columns."""
+        from ..exprs.base import Alias
+        from ..exprs.generators import Generator
+        gen_idx = [i for i, e in enumerate(exprs)
+                   if isinstance(e, Generator)
+                   or (isinstance(e, Alias) and isinstance(e.children[0],
+                                                           Generator))]
+        if not gen_idx:
+            return None
+        if len(gen_idx) > 1:
+            raise ValueError("only one generator allowed per select clause")
+        i = gen_idx[0]
+        e = exprs[i]
+        alias = e.name if isinstance(e, Alias) else None
+        generator = e.children[0] if isinstance(e, Alias) else e
+        others = [x for j, x in enumerate(exprs) if j != i]
+        child_schema = self.plan.schema()
+        needed, seen = [], set()
+        for o in others:
+            for r in o.references():
+                if r not in seen:
+                    seen.add(r)
+                    needed.append(r)
+        gen_fields = generator.generator_output(child_schema)
+        out_names = None
+        if alias is not None:
+            if len(gen_fields) != 1:
+                raise ValueError(
+                    "single alias on a multi-column generator; use the "
+                    "default names instead")
+            out_names = [alias]
+        plan = L.Generate(generator, needed, self.plan, out_names)
+        gen_names = [f.name for f in (plan.schema().fields[len(needed):])]
+        top = (others[:i] + [ColumnRef(n) for n in gen_names] + others[i:])
+        return DataFrame(self.session, L.Project(top, plan))
 
     def with_column(self, name: str, c) -> "DataFrame":
         schema = self.plan.schema()

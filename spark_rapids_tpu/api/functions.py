@@ -345,6 +345,39 @@ def json_tuple(c, *fields) -> Col:
     return Col(E.JsonTuple(_to_expr(c), *fields))
 
 
+# --- generators (ref GpuGenerateExec; planned via DataFrame.select) ---------
+def explode(c) -> Col:
+    from ..exprs.generators import Explode
+    return Col(Explode(_to_expr(c)))
+def explode_outer(c) -> Col:
+    from ..exprs.generators import Explode
+    return Col(Explode(_to_expr(c), outer=True))
+def posexplode(c) -> Col:
+    from ..exprs.generators import PosExplode
+    return Col(PosExplode(_to_expr(c)))
+def posexplode_outer(c) -> Col:
+    from ..exprs.generators import PosExplode
+    return Col(PosExplode(_to_expr(c), outer=True))
+def stack(n: int, *cols) -> Col:
+    from ..exprs.generators import Stack
+    return Col(Stack(n, *[_to_expr(c) for c in cols]))
+
+
+# --- task-context / non-deterministic ---------------------------------------
+def monotonically_increasing_id() -> Col:
+    from ..exprs.nondeterministic import MonotonicallyIncreasingID
+    return Col(MonotonicallyIncreasingID())
+def spark_partition_id() -> Col:
+    from ..exprs.nondeterministic import SparkPartitionID
+    return Col(SparkPartitionID())
+def input_file_name() -> Col:
+    from ..exprs.nondeterministic import InputFileName
+    return Col(InputFileName())
+def rand(seed: int = 0) -> Col:
+    from ..exprs.nondeterministic import Rand
+    return Col(Rand(seed))
+
+
 # --- window -----------------------------------------------------------------
 def row_number(): return E.RowNumber()
 def rank(): return E.Rank()

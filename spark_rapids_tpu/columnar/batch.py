@@ -21,10 +21,10 @@ ColumnLike = Union[DeviceColumn, HostColumn]
 
 
 class ColumnarBatch:
-    __slots__ = ("columns", "num_rows", "schema")
+    __slots__ = ("columns", "num_rows", "schema", "meta")
 
     def __init__(self, columns: Sequence[ColumnLike], num_rows: int,
-                 schema: Schema):
+                 schema: Schema, meta: Optional[dict] = None):
         assert len(columns) == len(schema), (len(columns), len(schema))
         for c in columns:
             if isinstance(c, DeviceColumn) and c.padded_len < num_rows:
@@ -32,6 +32,10 @@ class ColumnarBatch:
         self.columns = list(columns)
         self.num_rows = int(num_rows)
         self.schema = schema
+        #: task-context metadata consumed by non-deterministic expressions
+        #: (ref TaskContext.partitionId / InputFileBlockHolder):
+        #: {"partition_id": int, "input_file": str}
+        self.meta = meta or {}
 
     # -- structure ---------------------------------------------------------
     def __len__(self):
@@ -69,7 +73,7 @@ class ColumnarBatch:
     def with_columns(self, columns: Sequence[ColumnLike], schema: Schema,
                      num_rows: Optional[int] = None) -> "ColumnarBatch":
         return ColumnarBatch(columns, self.num_rows if num_rows is None else num_rows,
-                             schema)
+                             schema, meta=self.meta)
 
     # -- conversions -------------------------------------------------------
     @staticmethod
@@ -129,7 +133,9 @@ class ColumnarBatch:
         padded batch."""
         import pyarrow as pa
         t = self.to_arrow().slice(offset, length)
-        return ColumnarBatch.from_arrow(pa.table(t))
+        out = ColumnarBatch.from_arrow(pa.table(t))
+        out.meta = self.meta
+        return out
 
     def __repr__(self):
         kinds = "".join("D" if isinstance(c, DeviceColumn) else "H"

@@ -24,6 +24,19 @@ __all__ = ["InMemoryScanExec", "TpuProjectExec", "CpuProjectExec",
            "TpuExpandExec"]
 
 
+def _reset_task_state(exprs):
+    """Restart task-context counters (monotonically_increasing_id, rand)
+    at the start of each plan execution — Spark resets per-task state on
+    every task launch."""
+    stack = list(exprs)
+    while stack:
+        e = stack.pop()
+        r = getattr(e, "reset_task_state", None)
+        if r is not None:
+            r()
+        stack.extend(e.children)
+
+
 class InMemoryScanExec(TpuExec):
     """Scan over pre-partitioned Arrow tables (ref GpuInMemoryTableScanExec)."""
 
@@ -38,7 +51,7 @@ class InMemoryScanExec(TpuExec):
 
     def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
         rows_m = ctx.metric(self._exec_id, "numOutputRows", ESSENTIAL)
-        for t in self.tables:
+        for pid, t in enumerate(self.tables):
             off = 0
             while off < t.num_rows or (t.num_rows == 0 and off == 0):
                 chunk = t.slice(off, self.batch_rows)
@@ -46,6 +59,7 @@ class InMemoryScanExec(TpuExec):
                     break
                 with ctx.semaphore.held():
                     b = ColumnarBatch.from_arrow(chunk)
+                b.meta = {"partition_id": pid}
                 rows_m.add(b.num_rows)
                 yield b
                 off += self.batch_rows
@@ -85,6 +99,7 @@ class TpuProjectExec(TpuExec):
         child_schema = self.children[0].output_schema()
         dev_exprs = [self.exprs[i] for i in self.device_idx]
         rows_m = ctx.metric(self._exec_id, "numOutputRows", ESSENTIAL)
+        _reset_task_state(self.exprs)
         for batch in self.children[0].execute(ctx):
             out: List[Optional[object]] = [None] * len(self.exprs)
             if dev_exprs:
@@ -106,7 +121,8 @@ class TpuProjectExec(TpuExec):
                 else:
                     out[i] = HostColumn(arr, dt)
             rows_m.add(batch.num_rows)
-            yield ColumnarBatch(out, batch.num_rows, self._schema)
+            yield ColumnarBatch(out, batch.num_rows, self._schema,
+                                meta=batch.meta)
 
     def describe(self):
         tags = []
@@ -132,12 +148,14 @@ class CpuProjectExec(TpuExec):
         return self._schema
 
     def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        _reset_task_state(self.exprs)
         for batch in self.children[0].execute(ctx):
             cols = []
             for e, f in zip(self.exprs, self._schema.fields):
                 arr = e.eval_host(batch)
                 cols.append(HostColumn(arr, f.dtype))
-            yield ColumnarBatch(cols, batch.num_rows, self._schema)
+            yield ColumnarBatch(cols, batch.num_rows, self._schema,
+                                meta=batch.meta)
 
     def describe(self):
         return "CpuProject[" + ", ".join(e.name_hint for e in self.exprs) + "]"
@@ -184,7 +202,7 @@ class TpuFilterExec(TpuExec):
             if isinstance(c, HostColumn):
                 new_cols[i] = HostColumn(
                     c.array.slice(0, batch.num_rows).filter(mask), c.dtype)
-        return ColumnarBatch(new_cols, n, batch.schema)
+        return ColumnarBatch(new_cols, n, batch.schema, meta=batch.meta)
 
     def describe(self):
         return f"Filter[{self.condition.name_hint}]"
@@ -369,4 +387,5 @@ class TpuExpandExec(TpuExec):
             for proj in projectors:
                 with ctx.semaphore.held():
                     cols = proj.run(batch)
-                yield ColumnarBatch(cols, batch.num_rows, self._schema)
+                yield ColumnarBatch(cols, batch.num_rows, self._schema,
+                                meta=batch.meta)

@@ -1,0 +1,111 @@
+"""Generate exec: explode / posexplode / stack row generation.
+
+Reference analog: GpuGenerateExec.scala (984 LoC, explode/posexplode with
+retry+split). TPU-first split of the work:
+
+  * the generator itself (list flattening) touches host-resident nested
+    payloads and runs on the host, producing per-row repeat counts and the
+    flattened output arrays;
+  * the *repetition of the pass-through columns* — the wide, expensive part —
+    is a device gather driven by a repeat-index map (np.repeat of arange by
+    counts), the same gather-map idiom as the join (JoinGatherer.scala);
+  * output size can exceed the input batch arbitrarily (big lists), so each
+    input batch is processed under the split-and-retry framework: on
+    SplitAndRetryOOM the input batch halves and the pieces re-run, mirroring
+    GpuGenerateExec's retry handling.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List
+
+import numpy as np
+
+from ..columnar import ColumnarBatch, DeviceColumn, HostColumn
+from ..columnar.bucketing import bucket_for
+from ..exprs.compiler import gather_batch_device
+from ..exprs.generators import Generator
+from ..mem import SpillableBatch, with_retry
+from ..types import Schema, StructField
+from .base import ESSENTIAL, ExecContext, TpuExec
+
+__all__ = ["TpuGenerateExec"]
+
+
+class TpuGenerateExec(TpuExec):
+    def __init__(self, generator: Generator, required_cols: List[str],
+                 child: TpuExec, output_names: List[str] = None):
+        super().__init__([child])
+        self.generator = generator
+        self.required_cols = list(required_cols)
+        child_schema = child.output_schema()
+        gen_fields = generator.generator_output(child_schema)
+        if output_names:
+            assert len(output_names) == len(gen_fields)
+            gen_fields = [StructField(n, f.dtype, f.nullable)
+                          for n, f in zip(output_names, gen_fields)]
+        self._gen_fields = gen_fields
+        self._schema = Schema(
+            [child_schema.fields[child_schema.index_of(c)]
+             for c in self.required_cols] + gen_fields)
+
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def _generate_one(self, ctx: ExecContext, sb: SpillableBatch):
+        import pyarrow as pa
+        batch = sb.get()
+        counts, gen_arrays = self.generator.generate(batch)
+        total = int(counts.sum())
+        # repeat-index gather map: output row j comes from input row rep[j]
+        rep = np.repeat(np.arange(len(counts), dtype=np.int32), counts)
+
+        out_cols: List[object] = []
+        if self.required_cols:
+            idxs = [batch.schema.index_of(c) for c in self.required_cols]
+            dev = [i for i in idxs
+                   if isinstance(batch.columns[i], DeviceColumn)]
+            if dev:
+                p_out = bucket_for(total)
+                sub_schema = Schema([batch.schema.fields[i] for i in dev])
+                sub = ColumnarBatch([batch.columns[i] for i in dev],
+                                    batch.num_rows, sub_schema)
+                pad = np.full(p_out - total, -1, dtype=np.int32)
+                with ctx.semaphore.held():
+                    gathered = gather_batch_device(
+                        sub, np.concatenate([rep, pad]).astype(np.int32),
+                        total, p_out)
+                dev_out = dict(zip(dev, gathered.columns))
+            else:
+                dev_out = {}
+            for i in idxs:
+                c = batch.columns[i]
+                if i in dev_out:
+                    out_cols.append(dev_out[i])
+                else:
+                    arr = c.to_arrow(batch.num_rows)
+                    out_cols.append(HostColumn(
+                        arr.take(pa.array(rep, type=pa.int32())), c.dtype))
+
+        for arr, f in zip(gen_arrays, self._gen_fields):
+            if f.dtype.device_backed:
+                with ctx.semaphore.held():
+                    hb = ColumnarBatch.from_arrow(pa.table({"c": arr}))
+                out_cols.append(hb.columns[0])
+            else:
+                out_cols.append(HostColumn(arr, f.dtype))
+        out = ColumnarBatch(out_cols, total, self._schema, meta=batch.meta)
+        sb.close()
+        return out
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        rows_m = ctx.metric(self._exec_id, "numOutputRows", ESSENTIAL)
+        for batch in self.children[0].execute(ctx):
+            sb = SpillableBatch(batch, ctx.memory)
+            for out in with_retry([sb], lambda b: self._generate_one(ctx, b),
+                                  mm=ctx.memory):
+                rows_m.add(out.num_rows)
+                yield out
+
+    def describe(self):
+        return (f"Generate[{self.generator.key()}, "
+                f"required={self.required_cols}]")

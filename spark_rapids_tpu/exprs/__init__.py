@@ -42,6 +42,9 @@ from .hash_fns import (Crc32, HiveHash, Md5, Murmur3Hash, Sha1, Sha2,
                        XxHash64)
 from .json_fns import (GetJsonObject, JsonToStructs, JsonTuple,
                        StructsToJson)
+from .generators import Explode, Generator, PosExplode, Stack
+from .nondeterministic import (InputFileName, MonotonicallyIncreasingID,
+                               Rand, SparkPartitionID)
 from .compiler import (DeviceProjector, compile_projection,
                        eval_predicate_device, filter_batch_device,
                        gather_batch_device)

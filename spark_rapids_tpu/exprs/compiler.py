@@ -127,7 +127,8 @@ def filter_batch_device(pred: Expression, batch: ColumnarBatch) -> ColumnarBatch
     outs, count = _compact_kernel(arrays, keep, batch.padded_len)
     new_cols = [DeviceColumn(d, v, c.dtype)
                 for (d, v), c in zip(outs, batch.columns)]
-    return ColumnarBatch(new_cols, int(count), batch.schema)
+    return ColumnarBatch(new_cols, int(count), batch.schema,
+                         meta=batch.meta)
 
 
 @functools.partial(jax.jit, static_argnums=(2,))

@@ -1,0 +1,193 @@
+"""Generator expressions: explode / posexplode / stack.
+
+Reference analog: GpuGenerateExec (GpuGenerateExec.scala, 984 LoC) and its
+GpuExplode/GpuPosExplode/GpuStack generator classes. The reference explodes on
+the GPU via cudf list-explode kernels; here list/map payloads are host(Arrow)
+resident by design (types.py: nested types are not device-backed), so a
+generator produces (per-row repeat counts, flattened output arrays) on the
+host and the *gather of the repeated pass-through columns* — the expensive,
+wide part — runs on device (exec/generate.py), keying off the same gather-map
+idiom the reference uses (JoinGatherer.scala).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..types import (ArrayType, DataType, INT32, MapType, Schema, StructField,
+                     from_arrow, to_arrow)
+from .base import Expression, Unsupported
+
+__all__ = ["Generator", "Explode", "PosExplode", "Stack"]
+
+
+class Generator(Expression):
+    """An expression producing 0..n output rows per input row. Only valid
+    directly under a Generate plan node (ref Spark's ExtractGenerator)."""
+
+    #: True => emit one all-null output row for empty/null input
+    #: (explode_outer / posexplode_outer)
+    outer: bool = False
+
+    def generator_output(self, schema: Schema) -> List[StructField]:
+        raise NotImplementedError
+
+    def generate(self, batch) -> "tuple[np.ndarray, list]":
+        """Returns (counts, outputs): counts[i] = number of output rows for
+        input row i (already accounts for ``outer``); outputs = one pyarrow
+        array per generator_output field, each of length counts.sum()."""
+        raise NotImplementedError
+
+    def data_type(self, schema: Schema) -> DataType:
+        # only meaningful through generator_output; keep explain working
+        return self.generator_output(schema)[0].dtype
+
+    def eval_device(self, ctx):
+        raise Unsupported("generators are planned as Generate, not projected")
+
+    def eval_host(self, batch):
+        raise Unsupported("generators are planned as Generate, not projected")
+
+
+class Explode(Generator):
+    """explode(array) -> col / explode(map) -> key, value
+    (ref GpuExplode in GpuGenerateExec.scala)."""
+
+    def __init__(self, child: Expression, outer: bool = False):
+        self.children = [child]
+        self.outer = outer
+
+    def _child_type(self, schema: Schema) -> DataType:
+        return self.children[0].data_type(schema)
+
+    def generator_output(self, schema: Schema) -> List[StructField]:
+        dt = self._child_type(schema)
+        if isinstance(dt, ArrayType):
+            return [StructField("col", dt.element, True)]
+        if isinstance(dt, MapType):
+            return [StructField("key", dt.key, True),
+                    StructField("value", dt.value, True)]
+        raise Unsupported(f"explode requires array or map, got {dt}")
+
+    def _rows(self, batch):
+        """-> list of per-row python lists: [(elem,), ...] or
+        [(k, v), ...] for maps; None for null input."""
+        import pyarrow as pa
+        arr = self.children[0].eval_host(batch)
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
+        out = []
+        for v in arr.to_pylist():
+            if v is None:
+                out.append(None)
+            elif isinstance(v, dict):
+                out.append(list(v.items()))
+            elif v and isinstance(v[0], tuple) and len(v[0]) == 2 and \
+                    isinstance(self._child_type(batch.schema), MapType):
+                out.append(list(v))
+            else:
+                out.append([(e,) for e in v])
+        return out
+
+    def generate(self, batch, _rows=None):
+        import pyarrow as pa
+        fields = self.generator_output(batch.schema)
+        rows = self._rows(batch) if _rows is None else _rows
+        counts = np.zeros(len(rows), dtype=np.int64)
+        cols: List[list] = [[] for _ in fields]
+        for i, r in enumerate(rows):
+            if not r:  # null or empty
+                if self.outer:
+                    counts[i] = 1
+                    for c in cols:
+                        c.append(None)
+                continue
+            counts[i] = len(r)
+            for tup in r:
+                for c, v in zip(cols, tup):
+                    c.append(v)
+        arrays = [pa.array(c, type=to_arrow(f.dtype))
+                  for c, f in zip(cols, fields)]
+        return counts, arrays
+
+    def key(self):
+        return f"Explode({self.children[0].key()},outer={self.outer})"
+
+    @property
+    def name_hint(self):
+        return "col"
+
+
+class PosExplode(Explode):
+    """posexplode: adds a 0-based ``pos`` column
+    (ref GpuPosExplode in GpuGenerateExec.scala)."""
+
+    def generator_output(self, schema: Schema) -> List[StructField]:
+        return ([StructField("pos", INT32, True)]
+                + super().generator_output(schema))
+
+    def generate(self, batch, _rows=None):
+        import pyarrow as pa
+        rows = self._rows(batch) if _rows is None else _rows
+        counts, arrays = super().generate(batch, _rows=rows)
+        pos = []
+        for i, r in enumerate(rows):
+            if not r:
+                if self.outer:
+                    pos.append(None)
+                continue
+            pos.extend(range(len(r)))
+        return counts, [pa.array(pos, type=pa.int32())] + arrays
+
+    def key(self):
+        return f"PosExplode({self.children[0].key()},outer={self.outer})"
+
+
+class Stack(Generator):
+    """stack(n, e1, ..., ek): n rows of k//n columns per input row
+    (ref GpuStack, added to GpuOverrides expression registry)."""
+
+    def __init__(self, n: int, *exprs: Expression):
+        if n <= 0:
+            raise Unsupported("stack: n must be a positive literal")
+        self.n = int(n)
+        self.children = list(exprs)
+        if not self.children:
+            raise Unsupported("stack requires at least one value expression")
+
+    def generator_output(self, schema: Schema) -> List[StructField]:
+        width = -(-len(self.children) // self.n)
+        fields = []
+        for c in range(width):
+            # Spark: column type from the first row's expression in that slot
+            dt = self.children[c].data_type(schema)
+            fields.append(StructField(f"col{c}", dt, True))
+        return fields
+
+    def generate(self, batch):
+        import pyarrow as pa
+        fields = self.generator_output(batch.schema)
+        width = len(fields)
+        n_in = batch.num_rows
+        counts = np.full(n_in, self.n, dtype=np.int64)
+        # evaluate every value expression on the host path once
+        vals = []
+        for e in self.children:
+            arr = e.eval_host(batch)
+            if isinstance(arr, pa.ChunkedArray):
+                arr = arr.combine_chunks()
+            vals.append(arr.to_pylist())
+        cols: List[list] = [[] for _ in fields]
+        for i in range(n_in):
+            for r in range(self.n):
+                for c in range(width):
+                    k = r * width + c
+                    cols[c].append(vals[k][i] if k < len(self.children) else None)
+        arrays = [pa.array(col, type=to_arrow(f.dtype))
+                  for col, f in zip(cols, fields)]
+        return counts, arrays
+
+    def key(self):
+        kids = ",".join(c.key() for c in self.children)
+        return f"Stack({self.n},{kids})"

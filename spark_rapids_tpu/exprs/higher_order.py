@@ -85,11 +85,18 @@ class _HigherOrder(_HostCollectionExpr):
         raise NotImplementedError
 
     def _outer_refs(self):
-        # exclude ANY lambda variable (not just this HOF's own args): a
-        # nested HOF's inner variables resolve inside its own _flat_eval,
-        # never against the enclosing batch
-        return [r for r in self.body.references()
-                if not r.startswith("`lambda_")]
+        # exclude only lambda variables BOUND at or below this HOF (its own
+        # args plus any nested HOF's args). An enclosing lambda's variable
+        # used inside this body is free here and must be replicated from the
+        # enclosing (possibly synthetic) batch like any other outer column.
+        bound = {a.name for a in self.args}
+        stack = [self.body]
+        while stack:
+            e = stack.pop()
+            if isinstance(e, _HigherOrder):
+                bound.update(a.name for a in e.args)
+            stack.extend(e.children)
+        return [r for r in self.body.references() if r not in bound]
 
     def _flat_eval(self, batch, rows):
         """rows: per-input-row element lists (None rows contribute nothing).

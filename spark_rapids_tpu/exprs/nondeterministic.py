@@ -1,0 +1,156 @@
+"""Non-deterministic / task-context expressions.
+
+Reference analogs: GpuMonotonicallyIncreasingID, GpuSparkPartitionID
+(GpuMonotonicallyIncreasingID / GpuSparkPartitionID execs noted in
+SURVEY §2.7 Misc), input_file_name handling (InputFileBlockRule.scala) and
+GpuRand. They read task context (partition id, current input file) from
+ColumnarBatch.meta — the library-embedded analog of Spark's
+TaskContext/InputFileBlockHolder — plus a per-execution running row counter
+kept on the expression instance (reset via reset_task_state()).
+
+All are host-evaluated: they are O(rows) metadata materializations with no
+arithmetic to fuse, so shipping them through the XLA kernel would only add
+H2D traffic for values derivable on the host for free. rand() uses a
+counter-based generator (splitmix64 over (seed, partition, row)) — same
+design choice as the reference, whose GpuRand draws from a device RNG and
+matches CPU Spark only distributionally, not bitwise.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..types import FLOAT64, INT32, INT64, STRING, DataType, Schema
+from .base import Expression
+
+__all__ = ["MonotonicallyIncreasingID", "SparkPartitionID", "InputFileName",
+           "Rand"]
+
+
+class _TaskContextExpr(Expression):
+    children = []
+
+    def nullable(self, schema: Schema) -> bool:
+        return False
+
+    def device_unsupported_reason(self, schema):
+        return f"{type(self).__name__}: host-evaluated task-context expression"
+
+    def references(self):
+        return []
+
+    def reset_task_state(self):
+        """Called by the hosting exec at the start of each plan execution so
+        re-collecting the same DataFrame restarts counters (Spark resets
+        per-task state on every task launch)."""
+
+
+class MonotonicallyIncreasingID(_TaskContextExpr):
+    """(partition_id << 33) + running row index within the partition —
+    Spark's exact formula. The row index is a per-expression-instance running
+    counter (the reference's GpuMonotonicallyIncreasingID likewise keeps a
+    per-task count), NOT the batch's scan offset: upstream filters/generators
+    change row counts, and Spark numbers the rows this operator *sees*."""
+
+    def __init__(self):
+        self._next = {}
+
+    def reset_task_state(self):
+        self._next = {}
+
+    def data_type(self, schema: Schema) -> DataType:
+        return INT64
+
+    def eval_host(self, batch):
+        import pyarrow as pa
+        pid = batch.meta.get("partition_id", 0)
+        off = self._next.get(pid, 0)
+        self._next[pid] = off + batch.num_rows
+        base = (np.int64(pid) << np.int64(33)) + np.int64(off)
+        return pa.array(base + np.arange(batch.num_rows, dtype=np.int64))
+
+    def key(self):
+        return "MonotonicallyIncreasingID()"
+
+    @property
+    def name_hint(self):
+        return "monotonically_increasing_id()"
+
+
+class SparkPartitionID(_TaskContextExpr):
+    def data_type(self, schema: Schema) -> DataType:
+        return INT32
+
+    def eval_host(self, batch):
+        import pyarrow as pa
+        pid = np.int32(batch.meta.get("partition_id", 0))
+        return pa.array(np.full(batch.num_rows, pid, dtype=np.int32))
+
+    def key(self):
+        return "SparkPartitionID()"
+
+    @property
+    def name_hint(self):
+        return "SPARK_PARTITION_ID()"
+
+
+class InputFileName(_TaskContextExpr):
+    """Current input file path, or "" when the source is not file-based
+    (Spark semantics; ref InputFileBlockRule.scala)."""
+
+    def data_type(self, schema: Schema) -> DataType:
+        return STRING
+
+    def eval_host(self, batch):
+        import pyarrow as pa
+        fname = batch.meta.get("input_file", "") or ""
+        return pa.array([fname] * batch.num_rows, type=pa.string())
+
+    def key(self):
+        return "InputFileName()"
+
+    @property
+    def name_hint(self):
+        return "input_file_name()"
+
+
+def _splitmix64(x: np.ndarray) -> np.ndarray:
+    x = (x + np.uint64(0x9E3779B97F4A7C15))
+    x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return x ^ (x >> np.uint64(31))
+
+
+class Rand(_TaskContextExpr):
+    """Uniform [0, 1) per row via a counter-based hash of
+    (seed, partition, row index seen) — deterministic for a fixed plan run;
+    nondeterministic under re-execution, exactly like Spark's rand()."""
+
+    def __init__(self, seed: int = 0):
+        self.seed = int(seed)
+        self._next = {}
+
+    def reset_task_state(self):
+        self._next = {}
+
+    def data_type(self, schema: Schema) -> DataType:
+        return FLOAT64
+
+    def eval_host(self, batch):
+        import pyarrow as pa
+        pid = batch.meta.get("partition_id", 0)
+        off = self._next.get(pid, 0)
+        self._next[pid] = off + batch.num_rows
+        idx = np.arange(off, off + batch.num_rows, dtype=np.uint64)
+        with np.errstate(over="ignore"):
+            mixed = _splitmix64(
+                idx ^ _splitmix64(np.uint64((self.seed & 0xFFFFFFFFFFFFFFFF))
+                                  + (np.uint64(pid) << np.uint64(32))))
+        u = (mixed >> np.uint64(11)).astype(np.float64) / float(1 << 53)
+        return pa.array(u)
+
+    def key(self):
+        return f"Rand({self.seed})"
+
+    @property
+    def name_hint(self):
+        return f"rand({self.seed})"

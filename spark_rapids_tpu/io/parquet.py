@@ -118,17 +118,19 @@ class ParquetScanExec(TpuExec):
             yield from self._multithreaded(ctx, rows_m, batch_rows)
             return
         # PERFILE
-        for path in self.paths:
+        for pid, path in enumerate(self.paths):
             t = self._read_table(path)
-            yield from self._emit(ctx, t, rows_m, batch_rows)
+            yield from self._emit(ctx, t, rows_m, batch_rows,
+                                  input_file=path, pid=pid)
 
-    def _emit(self, ctx, table, rows_m, batch_rows):
+    def _emit(self, ctx, table, rows_m, batch_rows, input_file=None, pid=0):
         off = 0
         n = table.num_rows
         while off < n or (n == 0 and off == 0):
             chunk = table.slice(off, batch_rows)
             with ctx.semaphore.held():
                 b = ColumnarBatch.from_arrow(chunk)
+            b.meta = {"partition_id": pid, "input_file": input_file}
             rows_m.add(b.num_rows)
             yield b
             off += batch_rows
@@ -159,8 +161,9 @@ class ParquetScanExec(TpuExec):
         nthreads = int(self.conf.get(MULTITHREADED_READ_THREADS))
         with cf.ThreadPoolExecutor(max_workers=nthreads) as pool:
             futures = [pool.submit(self._read_table, p) for p in self.paths]
-            for fut in futures:  # preserve file order; reads overlap
-                yield from self._emit(ctx, fut.result(), rows_m, batch_rows)
+            for pid, fut in enumerate(futures):  # preserve file order; reads overlap
+                yield from self._emit(ctx, fut.result(), rows_m, batch_rows,
+                                      input_file=self.paths[pid], pid=pid)
 
     def describe(self):
         return (f"ParquetScan[{len(self.paths)} files, {self.mode}"

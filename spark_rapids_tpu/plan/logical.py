@@ -258,6 +258,29 @@ class Expand(LogicalPlan):
                        for n, e in zip(self.names, self.projections[0])])
 
 
+class Generate(LogicalPlan):
+    """Generator application: explode/posexplode/stack (ref GpuGenerateExec).
+
+    required_cols: child column names passed through alongside the generator
+    output (ref requiredChildOutput)."""
+
+    def __init__(self, generator, required_cols: Sequence[str],
+                 child: LogicalPlan, output_names: Optional[Sequence[str]] = None):
+        self.generator = generator
+        self.required_cols = list(required_cols)
+        self.output_names = list(output_names) if output_names else None
+        self.children = [child]
+
+    def schema(self):
+        cs = self.children[0].schema()
+        gen_fields = self.generator.generator_output(cs)
+        if self.output_names:
+            gen_fields = [StructField(n, f.dtype, f.nullable)
+                          for n, f in zip(self.output_names, gen_fields)]
+        return Schema([cs.fields[cs.index_of(c)] for c in self.required_cols]
+                      + gen_fields)
+
+
 class WindowSpec:
     def __init__(self, partition_by: Sequence[Expression] = (),
                  order_by: Sequence[SortOrder] = (),

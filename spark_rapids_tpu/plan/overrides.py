@@ -224,6 +224,25 @@ class ExpandMeta(PlanMeta):
         raise NotImplementedError("CPU expand fallback not implemented")
 
 
+@rule(L.Generate)
+class GenerateMeta(PlanMeta):
+    def tag_self(self):
+        from ..exprs.base import Unsupported
+        schema = self.plan.children[0].schema()
+        try:
+            self.plan.generator.generator_output(schema)
+        except Unsupported as e:
+            self.will_not_work_on_tpu(str(e))
+
+    def convert_to_tpu(self, children):
+        from ..exec.generate import TpuGenerateExec
+        p = self.plan
+        return TpuGenerateExec(p.generator, p.required_cols, children[0],
+                               p.output_names)
+
+    convert_to_cpu = convert_to_tpu
+
+
 @rule(L.Join)
 class JoinMeta(PlanMeta):
     def tag_self(self):

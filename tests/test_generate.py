@@ -1,0 +1,151 @@
+"""Generate exec (explode/posexplode/stack) + task-context expressions.
+
+Reference analog: integration_tests generate_expr_test.py (explode/posexplode
+matrices) and misc_expr_test.py (monotonically_increasing_id,
+spark_partition_id, input_file_name). Expected values are CPU-Spark
+semantics, precomputed."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from harness import tpu_session
+from spark_rapids_tpu.api import functions as F
+
+
+ARRS = [[1, 2, 3], [], None, [4, None, 6]]
+IDS = [10, 20, 30, 40]
+
+
+def _df(s, **cols):
+    if not cols:
+        cols = {"id": IDS, "a": ARRS}
+    return s.create_dataframe(pa.table(cols))
+
+
+def test_explode_array():
+    s = tpu_session()
+    out = _df(s).select("id", F.explode(F.col("a"))).collect_arrow()
+    assert out.column("id").to_pylist() == [10, 10, 10, 40, 40, 40]
+    assert out.column("col").to_pylist() == [1, 2, 3, 4, None, 6]
+
+
+def test_explode_outer_array():
+    s = tpu_session()
+    out = _df(s).select("id", F.explode_outer(F.col("a"))).collect_arrow()
+    assert out.column("id").to_pylist() == [10, 10, 10, 20, 30, 40, 40, 40]
+    assert out.column("col").to_pylist() == [1, 2, 3, None, None, 4, None, 6]
+
+
+def test_explode_alias():
+    s = tpu_session()
+    out = _df(s).select(F.explode(F.col("a")).alias("v")).collect_arrow()
+    assert out.column_names == ["v"]
+    assert out.column("v").to_pylist() == [1, 2, 3, 4, None, 6]
+
+
+def test_posexplode():
+    s = tpu_session()
+    out = _df(s).select("id", F.posexplode(F.col("a"))).collect_arrow()
+    assert out.column_names == ["id", "pos", "col"]
+    assert out.column("pos").to_pylist() == [0, 1, 2, 0, 1, 2]
+    assert out.column("col").to_pylist() == [1, 2, 3, 4, None, 6]
+
+
+def test_posexplode_outer():
+    s = tpu_session()
+    out = _df(s).select(F.posexplode_outer(F.col("a"))).collect_arrow()
+    assert out.column("pos").to_pylist() == [0, 1, 2, None, None, 0, 1, 2]
+
+
+def test_explode_map():
+    s = tpu_session()
+    m = pa.array([{"x": 1, "y": 2}, None, {"z": 3}],
+                 type=pa.map_(pa.string(), pa.int64()))
+    out = s.create_dataframe(pa.table({"id": [1, 2, 3], "m": m})) \
+        .select("id", F.explode(F.col("m"))).collect_arrow()
+    assert out.column_names == ["id", "key", "value"]
+    assert out.column("key").to_pylist() == ["x", "y", "z"]
+    assert out.column("value").to_pylist() == [1, 2, 3]
+
+
+def test_explode_projected_expression_on_top():
+    s = tpu_session()
+    out = _df(s).select((F.col("id") * 2).alias("i2"),
+                        F.explode(F.col("a"))).collect_arrow()
+    assert out.column("i2").to_pylist() == [20, 20, 20, 80, 80, 80]
+
+
+def test_stack():
+    s = tpu_session()
+    df = s.create_dataframe(pa.table({"a": [1, 2], "b": [10, 20]}))
+    out = df.select(F.stack(2, F.col("a"), F.col("b"))).collect_arrow()
+    assert out.column("col0").to_pylist() == [1, 10, 2, 20]
+
+
+def test_stack_uneven():
+    s = tpu_session()
+    df = s.create_dataframe(pa.table({"a": [1], "b": [2], "c": [3]}))
+    out = df.select(F.stack(2, F.col("a"), F.col("b"), F.col("c"))) \
+        .collect_arrow()
+    assert out.column("col0").to_pylist() == [1, 3]
+    assert out.column("col1").to_pylist() == [2, None]
+
+
+def test_explode_empty_result():
+    s = tpu_session()
+    df = s.create_dataframe(pa.table({"id": [1, 2], "a": [None, []]},
+                                     schema=pa.schema([
+                                         ("id", pa.int64()),
+                                         ("a", pa.list_(pa.int64()))])))
+    out = df.select("id", F.explode(F.col("a"))).collect_arrow()
+    assert out.num_rows == 0
+
+
+# --- task-context expressions ----------------------------------------------
+
+def test_monotonically_increasing_id_multi_partition():
+    s = tpu_session()
+    df = s.create_dataframe(pa.table({"v": list(range(10))}),
+                            num_partitions=2)
+    out = df.select("v", F.monotonically_increasing_id().alias("mid")) \
+        .collect_arrow()
+    mids = out.column("mid").to_pylist()
+    # partition 0 rows 0..4 then partition 1 rows (1<<33)..(1<<33)+4
+    assert mids[:5] == [0, 1, 2, 3, 4]
+    assert mids[5:] == [(1 << 33) + i for i in range(5)]
+
+
+def test_spark_partition_id():
+    s = tpu_session()
+    df = s.create_dataframe(pa.table({"v": list(range(6))}),
+                            num_partitions=3)
+    out = df.select(F.spark_partition_id().alias("p")).collect_arrow()
+    assert out.column("p").to_pylist() == [0, 0, 1, 1, 2, 2]
+
+
+def test_input_file_name(tmp_path):
+    import pyarrow.parquet as pq
+    s = tpu_session()
+    f1, f2 = str(tmp_path / "a.parquet"), str(tmp_path / "b.parquet")
+    pq.write_table(pa.table({"v": [1, 2]}), f1)
+    pq.write_table(pa.table({"v": [3]}), f2)
+    out = s.read_parquet(f1, f2).select(
+        "v", F.input_file_name().alias("f")).collect_arrow()
+    got = out.column("f").to_pylist()
+    assert got == [f1, f1, f2]
+    # non-file source -> empty string (Spark semantics)
+    out2 = s.create_dataframe(pa.table({"v": [1]})).select(
+        F.input_file_name().alias("f")).collect_arrow()
+    assert out2.column("f").to_pylist() == [""]
+
+
+def test_rand_deterministic_and_uniform():
+    s = tpu_session()
+    df = s.create_dataframe(pa.table({"v": list(range(1000))}))
+    a = df.select(F.rand(42).alias("r")).collect_arrow().column("r").to_pylist()
+    b = df.select(F.rand(42).alias("r")).collect_arrow().column("r").to_pylist()
+    assert a == b
+    assert all(0.0 <= x < 1.0 for x in a)
+    assert 0.4 < np.mean(a) < 0.6
+    c = df.select(F.rand(7).alias("r")).collect_arrow().column("r").to_pylist()
+    assert c != a
