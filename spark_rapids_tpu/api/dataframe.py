@@ -235,8 +235,19 @@ class DataFrame:
                     lk.append(_as_expr(k[0]))
                     rk.append(_as_expr(k[1]))
         cond = _as_expr(condition) if condition is not None else None
+        bc = "right" if getattr(other, "_broadcast_hint", False) else (
+            "left" if getattr(self, "_broadcast_hint", False) else None)
         return DataFrame(self.session,
-                         L.Join(self.plan, other.plan, how, lk, rk, cond))
+                         L.Join(self.plan, other.plan, how, lk, rk, cond,
+                                broadcast=bc))
+
+    def hint(self, name: str) -> "DataFrame":
+        """Spark-style plan hint; only "broadcast" is meaningful (ref
+        Spark's broadcast() function / GpuBroadcastHashJoinExec selection)."""
+        df = DataFrame(self.session, self.plan)
+        if name.lower() == "broadcast":
+            df._broadcast_hint = True
+        return df
 
     def sample(self, fraction: float, seed: int = 42) -> "DataFrame":
         return DataFrame(self.session, L.Sample(fraction, seed, self.plan))

@@ -410,3 +410,9 @@ def stddev(c): return StddevSamp(_to_expr(c))
 def stddev_pop(c): return StddevPop(_to_expr(c))
 def var_samp(c): return VarianceSamp(_to_expr(c))
 def var_pop(c): return VariancePop(_to_expr(c))
+
+
+def broadcast(df):
+    """Mark a DataFrame as broadcastable for its next join (Spark's
+    functions.broadcast; selects TpuBroadcastHashJoinExec in the planner)."""
+    return df.hint("broadcast")

@@ -106,6 +106,13 @@ BATCH_SIZE_ROWS = register(
     "Target max rows per columnar batch (shape-bucket ceiling; TPU-specific: "
     "bounds XLA recompilation via the bucket ladder).")
 
+JOIN_SUBPARTITION_SIZE = register(
+    "spark.rapids.tpu.sql.join.subPartitionSizeBytes", 256 * 1024 * 1024,
+    "When the combined input of an equi-join exceeds this many bytes the join "
+    "hash-partitions both sides and runs N independent sub-joins "
+    "(ref GpuSubPartitionHashJoin.scala / GpuShuffledSizedHashJoinExec.scala:1255). "
+    "<= 0 disables sub-partitioning.")
+
 ALLOC_FRACTION = register(
     "spark.rapids.tpu.memory.hbm.allocFraction", 0.85,
     "Fraction of HBM the pool manager budgets for columnar buffers "
@@ -257,6 +264,9 @@ class TpuConf:
     def batch_size_bytes(self) -> int: return self.get(BATCH_SIZE_BYTES)
     @property
     def batch_size_rows(self) -> int: return self.get(BATCH_SIZE_ROWS)
+    @property
+    def join_subpartition_size_bytes(self) -> int:
+        return self.get(JOIN_SUBPARTITION_SIZE)
     @property
     def shuffle_mode(self) -> str: return str(self.get(SHUFFLE_MODE)).upper()
     @property

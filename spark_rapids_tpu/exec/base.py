@@ -50,12 +50,34 @@ class ExecContext:
             self.conf.concurrent_tpu_tasks)
         self.memory = memory or MemoryManager.get(self.conf)
         self.metrics: Dict[str, Dict[str, Metric]] = {}
+        self._cleanups = []
 
     def metric(self, exec_id: str, name: str, level: str = MODERATE) -> Metric:
         m = self.metrics.setdefault(exec_id, {})
         if name not in m:
             m[name] = Metric(name, level)
         return m[name]
+
+    def add_cleanup(self, fn) -> None:
+        """Register a resource release to run at context close (per-query
+        caches like broadcast relations)."""
+        self._cleanups.append(fn)
+
+    def close(self) -> None:
+        fns, self._cleanups = self._cleanups, []
+        for fn in fns:
+            try:
+                fn()
+            except Exception:  # pragma: no cover - best-effort teardown
+                pass
+        if getattr(self, "_broadcast_cache", None):
+            self._broadcast_cache.clear()
+
+    def __del__(self):  # pragma: no cover - GC backstop
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 class TpuExec:

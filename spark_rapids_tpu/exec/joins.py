@@ -32,13 +32,15 @@ import numpy as np
 from ..columnar import ColumnarBatch, DeviceColumn, concat_batches
 from ..columnar.bucketing import bucket_for
 from ..exprs.base import DVal, EvalContext, Expression
-from ..exprs.compiler import filter_batch_device, gather_batch_device
+from ..exprs.compiler import (_compact_kernel, eval_predicate_device,
+                              filter_batch_device, gather_batch_device)
 from ..mem import SpillableBatch, with_retry_no_split
-from ..types import Schema, StructField
+from ..types import BOOL, Schema, StructField
 from .base import ESSENTIAL, ExecContext, TpuExec
 from .encoding import grouping_operands, operands_equal
 
-__all__ = ["TpuHashJoinExec", "CpuJoinExec"]
+__all__ = ["TpuHashJoinExec", "TpuNestedLoopJoinExec",
+           "TpuBroadcastHashJoinExec", "CpuJoinExec"]
 
 _COUNT_CACHE: Dict[Tuple, object] = {}
 _GATHER_CACHE: Dict[Tuple, object] = {}
@@ -176,6 +178,109 @@ def _gather_index_kernel(s_orig, cnt_l, cnt_r, start_l, start_r, offsets,
     return l_row.astype(jnp.int32), r_row.astype(jnp.int32)
 
 
+def _join_schema(ls: Schema, rs: Schema, join_type: str,
+                 exists_name: str = "exists") -> Schema:
+    if join_type in ("leftsemi", "leftanti"):
+        return Schema(list(ls.fields))
+    if join_type == "existence":
+        return Schema(list(ls.fields) + [StructField(exists_name, BOOL,
+                                                     nullable=False)])
+    return Schema(list(ls.fields) + list(rs.fields))
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4))
+def _matched_counts_kernel(l_row, r_row, match, p_l, p_r):
+    """Per-source-row surviving-pair counts (segment sums over the pair set).
+    Pairs with row index -1 (padding) fall into the overflow segment."""
+    m = match.astype(jnp.int32)
+    ml = jax.ops.segment_sum(m, jnp.where(l_row >= 0, l_row, p_l),
+                             num_segments=p_l + 1)[:p_l]
+    mr = jax.ops.segment_sum(m, jnp.where(r_row >= 0, r_row, p_r),
+                             num_segments=p_r + 1)[:p_r]
+    return ml, mr
+
+
+@functools.partial(jax.jit, static_argnums=(5,))
+def _assemble_index_kernel(l_row, r_row, match, ul, ur, out_p):
+    """Build the combined output gather maps: surviving pairs first, then
+    unmatched-left rows (null-extended right), then unmatched-right rows
+    (null-extended left). -1 = null row."""
+    buf_l = jnp.full(out_p, -1, jnp.int32)
+    buf_r = jnp.full(out_p, -1, jnp.int32)
+    mi = match.astype(jnp.int32)
+    pos = jnp.where(match, jnp.cumsum(mi) - 1, out_p)
+    buf_l = buf_l.at[pos].set(l_row, mode="drop")
+    buf_r = buf_r.at[pos].set(r_row, mode="drop")
+    nm = jnp.sum(mi)
+    uli = ul.astype(jnp.int32)
+    posl = jnp.where(ul, nm + jnp.cumsum(uli) - 1, out_p)
+    buf_l = buf_l.at[posl].set(
+        jnp.arange(ul.shape[0], dtype=jnp.int32), mode="drop")
+    nu = nm + jnp.sum(uli)
+    uri = ur.astype(jnp.int32)
+    posr = jnp.where(ur, nu + jnp.cumsum(uri) - 1, out_p)
+    buf_r = buf_r.at[posr].set(
+        jnp.arange(ur.shape[0], dtype=jnp.int32), mode="drop")
+    return buf_l, buf_r
+
+
+def _finish_pair_join(join_type: str, lb: ColumnarBatch, rb: ColumnarBatch,
+                      l_row, r_row, live, condition: Optional[Expression],
+                      out_schema: Schema) -> ColumnarBatch:
+    """Finish any join from a candidate pair set: evaluate the residual
+    condition on the gathered pairs, then emit per join type (ref
+    GpuBroadcastNestedLoopJoinExecBase / conditional JoinGatherer paths).
+
+    ``l_row``/``r_row``: int32 candidate pair gather maps; ``live`` gates
+    padding slots. Works for both key-derived candidates (conditional equi-
+    joins) and the full cross product (nested loop)."""
+    pair_schema = Schema(list(lb.schema.fields) + list(rb.schema.fields))
+    if condition is not None:
+        n_pairs = int(jnp.sum(live))
+        lo = gather_batch_device(lb, l_row, n_pairs, int(l_row.shape[0]))
+        ro = gather_batch_device(rb, r_row, n_pairs, int(r_row.shape[0]))
+        pairs = ColumnarBatch(lo.columns + ro.columns, n_pairs, pair_schema)
+        cond = eval_predicate_device(condition, pairs)
+        match = jnp.logical_and(cond, live)
+    else:
+        match = live
+    p_l, p_r = lb.padded_len, rb.padded_len
+    ml, mr = _matched_counts_kernel(l_row, r_row, match, p_l, p_r)
+    lmask = jnp.arange(p_l, dtype=jnp.int32) < lb.num_rows
+    rmask = jnp.arange(p_r, dtype=jnp.int32) < rb.num_rows
+
+    if join_type in ("leftsemi", "leftanti"):
+        keep = jnp.logical_and(ml > 0 if join_type == "leftsemi" else ml == 0,
+                               lmask)
+        arrays = [(c.data, c.validity) for c in lb.columns]
+        outs, count = _compact_kernel(arrays, keep, p_l)
+        cols = [DeviceColumn(d, v, c.dtype)
+                for (d, v), c in zip(outs, lb.columns)]
+        return ColumnarBatch(cols, int(count), out_schema)
+    if join_type == "existence":
+        exists = DeviceColumn(ml > 0, lmask, BOOL)
+        return ColumnarBatch(list(lb.columns) + [exists], lb.num_rows,
+                             out_schema)
+
+    zl = jnp.zeros_like(lmask)
+    ul = jnp.logical_and(ml == 0, lmask) if join_type in ("left", "full") \
+        else zl
+    ur = jnp.logical_and(mr == 0, rmask) if join_type in ("right", "full") \
+        else jnp.zeros_like(rmask)
+    n_match = int(jnp.sum(match))
+    n_ul = int(jnp.sum(ul))
+    n_ur = int(jnp.sum(ur))
+    if join_type == "inner":
+        n_ul = n_ur = 0
+        ul, ur = zl, jnp.zeros_like(rmask)
+    n_out = n_match + n_ul + n_ur
+    out_p = bucket_for(max(n_out, 1))
+    gl, gr = _assemble_index_kernel(l_row, r_row, match, ul, ur, out_p)
+    lo = gather_batch_device(lb, gl, n_out, out_p)
+    ro = gather_batch_device(rb, gr, n_out, out_p)
+    return ColumnarBatch(lo.columns + ro.columns, n_out, out_schema)
+
+
 class TpuHashJoinExec(TpuExec):
     def __init__(self, left: TpuExec, right: TpuExec, join_type: str,
                  left_keys: Sequence[Expression],
@@ -187,13 +292,7 @@ class TpuHashJoinExec(TpuExec):
         self.right_keys = list(right_keys)
         self.condition = condition
         ls, rs = left.output_schema(), right.output_schema()
-        if join_type in ("leftsemi", "leftanti"):
-            self._schema = ls
-        else:
-            self._schema = Schema(list(ls.fields) + list(rs.fields))
-        if condition is not None and join_type not in ("inner", "cross"):
-            raise NotImplementedError(
-                "residual conditions only on inner/cross joins for now")
+        self._schema = _join_schema(ls, rs, join_type)
 
     def output_schema(self) -> Schema:
         return self._schema
@@ -206,11 +305,21 @@ class TpuHashJoinExec(TpuExec):
                          for b in self.children[1].execute(ctx)]
         left_batches = [SpillableBatch(b, ctx.memory)
                         for b in self.children[0].execute(ctx)]
+        ls, rs = (self.children[0].output_schema(),
+                  self.children[1].output_schema())
+
+        total_bytes = sum(s.device_bytes() for s in right_batches +
+                          left_batches)
+        threshold = ctx.conf.join_subpartition_size_bytes
+        if (threshold > 0 and total_bytes > threshold and self.left_keys
+                and self.join_type != "cross" and self.condition is None
+                and self._subpartitionable(ls, rs)):
+            yield from self._subpartitioned(ctx, left_batches, right_batches,
+                                            ls, rs, rows_m, total_bytes)
+            return
 
         def run():
             with ctx.semaphore.held():
-                ls, rs = (self.children[0].output_schema(),
-                          self.children[1].output_schema())
                 lb = concat_batches([s.get() for s in left_batches]) \
                     if left_batches else _empty_batch(ls)
                 rb = concat_batches([s.get() for s in right_batches]) \
@@ -223,10 +332,91 @@ class TpuHashJoinExec(TpuExec):
         rows_m.add(out.num_rows)
         yield out
 
+    # -- sub-partitioned big join (ref GpuSubPartitionHashJoin.scala,
+    # JoinPartitioner at GpuShuffledSizedHashJoinExec.scala:1255-1340) ------
+    def _subpartitionable(self, ls: Schema, rs: Schema) -> bool:
+        from ..exprs.hash_fns import device_hashable
+        for lk, rk in zip(self.left_keys, self.right_keys):
+            ldt, rdt = lk.data_type(ls), rk.data_type(rs)
+            if (device_hashable.reason_not_supported(ldt)
+                    or device_hashable.reason_not_supported(rdt)):
+                return False
+            # both sides must hash identically: the join kernel promotes
+            # mixed-width keys before matching, but the partitioner hashes
+            # raw values — int32 5 and int64 5 hash to different words and
+            # would land in different sub-partitions (silent row loss)
+            if ldt.np_dtype != rdt.np_dtype:
+                return False
+        return True
+
+    #: sub-partition hash seed — deliberately NOT the shuffle seed (42):
+    #: after a repartition on the join keys every row of a task satisfies
+    #: murmur3_42(key) % P == const, so re-hashing with the same seed would
+    #: collapse all rows into one sub-partition (ref GpuSubPartitionHashJoin
+    #: uses a distinct seed for the same reason)
+    SUBPARTITION_SEED = 1610612741
+
+    def _subpartitioned(self, ctx, left_batches, right_batches, ls, rs,
+                        rows_m, total_bytes) -> Iterator[ColumnarBatch]:
+        """Hash both sides into N sub-partitions on the same key hash and run
+        N independent joins — matching keys (and null keys, which never match
+        anyway) co-locate, so every equi-join type distributes over the
+        partitioning. All device work (and the semaphore) is scoped inside
+        the retry closure; outputs are parked spillable and yielded after
+        the permit is released."""
+        from ..shuffle.partitioning import partition_batch
+        n_parts = 1 << max(1, (int(total_bytes) //
+                                ctx.conf.join_subpartition_size_bytes
+                                ).bit_length())
+        n_parts = min(n_parts, 64)
+
+        def run():
+            outs = []
+            try:
+                with ctx.semaphore.held():
+                    lb = concat_batches([s.get() for s in left_batches]) \
+                        if left_batches else _empty_batch(ls)
+                    rb = concat_batches([s.get() for s in right_batches]) \
+                        if right_batches else _empty_batch(rs)
+                    lp = partition_batch(lb, self.left_keys, n_parts,
+                                         seed=self.SUBPARTITION_SEED)
+                    rp = partition_batch(rb, self.right_keys, n_parts,
+                                         seed=self.SUBPARTITION_SEED)
+                    for p in range(n_parts):
+                        lbp = lp.partition_device(p)
+                        rbp = rp.partition_device(p)
+                        if lbp.num_rows == 0 and rbp.num_rows == 0:
+                            continue
+                        out = self._join(lbp, rbp)
+                        if out.num_rows:
+                            outs.append(SpillableBatch(out, ctx.memory))
+            except Exception:
+                for s in outs:
+                    s.close()
+                raise
+            return outs
+
+        outs = with_retry_no_split(run, ctx.memory)
+        for s in left_batches + right_batches:
+            s.close()
+        for s in outs:
+            b = s.get()
+            s.close()
+            rows_m.add(b.num_rows)
+            yield b
+
     # ------------------------------------------------------------------
     def _join(self, lb: ColumnarBatch, rb: ColumnarBatch) -> ColumnarBatch:
         if self.join_type == "cross" or not self.left_keys:
             return self._cross(lb, rb)
+        if (self.condition is not None and
+                self.join_type != "inner") or self.join_type == "existence":
+            # conditional non-inner equi-join / existence: enumerate inner
+            # candidate pairs on the keys, then finish through the shared
+            # pair machinery (ref JoinGatherer conditional gathers)
+            l_row, r_row, live = self._candidate_pairs(lb, rb)
+            return _finish_pair_join(self.join_type, lb, rb, l_row, r_row,
+                                     live, self.condition, self._schema)
         ls, rs = lb.schema, rb.schema
         ck = (tuple(e.key() for e in self.left_keys),
               tuple(e.key() for e in self.right_keys),
@@ -281,6 +471,32 @@ class TpuHashJoinExec(TpuExec):
             out = filter_batch_device(self.condition, out)
         return out
 
+    def _candidate_pairs(self, lb: ColumnarBatch, rb: ColumnarBatch):
+        """Inner-join candidate pair index arrays on the equi keys."""
+        ls, rs = lb.schema, rb.schema
+        ck = (tuple(e.key() for e in self.left_keys),
+              tuple(e.key() for e in self.right_keys),
+              tuple((f.name, f.dtype.name) for f in ls.fields),
+              tuple((f.name, f.dtype.name) for f in rs.fields), "inner")
+        kern = _COUNT_CACHE.get(ck)
+        if kern is None:
+            kern = _build_count_kernel(self.left_keys, self.right_keys,
+                                       ls, rs, "inner")
+            _COUNT_CACHE[ck] = kern
+        lcols = [(c.data, c.validity) for c in lb.columns]
+        rcols = [(c.data, c.validity) for c in rb.columns]
+        (s_orig, cnt_l, cnt_r, start_l, start_r, _pairs, offsets, total,
+         _ng) = kern(lcols, rcols, jnp.int32(lb.num_rows),
+                     jnp.int32(rb.num_rows), lb.padded_len, rb.padded_len)
+        n_out = int(total)
+        out_p = bucket_for(max(n_out, 1))
+        cfg = jnp.zeros(3, dtype=jnp.int32)
+        l_row, r_row = _gather_index_kernel(
+            s_orig, cnt_l, cnt_r, start_l, start_r, offsets, cfg, out_p)
+        live = jnp.asarray(np.arange(out_p) < n_out)
+        return (jnp.where(live, l_row, -1), jnp.where(live, r_row, -1),
+                live)
+
     def describe(self):
         k = ", ".join(f"{a.name_hint}={b.name_hint}"
                       for a, b in zip(self.left_keys, self.right_keys))
@@ -296,6 +512,130 @@ def _empty_batch(schema: Schema) -> ColumnarBatch:
     return ColumnarBatch.from_arrow(t)
 
 
+class TpuNestedLoopJoinExec(TpuExec):
+    """Nested-loop join: arbitrary (non-equi) condition, every join type
+    (ref GpuBroadcastNestedLoopJoinExecBase, GpuCartesianProductExec).
+
+    TPU-first design: the candidate pair set is the full cross product laid
+    out as one static-shaped index range (li = k / n_r, ri = k % n_r); the
+    condition is one fused XLA evaluation over the gathered pair batch and
+    the per-type finishing (outer null-extension, semi/anti/existence) is
+    the same segment-sum machinery as the conditional equi-join."""
+
+    def __init__(self, left: TpuExec, right: TpuExec, join_type: str,
+                 condition: Optional[Expression] = None):
+        super().__init__([left, right])
+        self.join_type = join_type
+        self.condition = condition
+        self._schema = _join_schema(left.output_schema(),
+                                    right.output_schema(), join_type)
+
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        rows_m = ctx.metric(self._exec_id, "numOutputRows", ESSENTIAL)
+        ls, rs = (self.children[0].output_schema(),
+                  self.children[1].output_schema())
+        right_batches = [SpillableBatch(b, ctx.memory)
+                         for b in self.children[1].execute(ctx)]
+        left_batches = [SpillableBatch(b, ctx.memory)
+                        for b in self.children[0].execute(ctx)]
+
+        def run():
+            with ctx.semaphore.held():
+                lb = concat_batches([s.get() for s in left_batches]) \
+                    if left_batches else _empty_batch(ls)
+                rb = concat_batches([s.get() for s in right_batches]) \
+                    if right_batches else _empty_batch(rs)
+                n_pairs = lb.num_rows * rb.num_rows
+                out_p = bucket_for(max(n_pairs, 1))
+                k = jnp.arange(out_p, dtype=jnp.int64)
+                nr = max(rb.num_rows, 1)
+                li = (k // nr).astype(jnp.int32)
+                ri = (k % nr).astype(jnp.int32)
+                live = jnp.asarray(np.arange(out_p) < n_pairs)
+                li = jnp.where(live, li, -1)
+                ri = jnp.where(live, ri, -1)
+                if self.join_type == "cross":
+                    lo = gather_batch_device(lb, li, n_pairs, out_p)
+                    ro = gather_batch_device(rb, ri, n_pairs, out_p)
+                    out = ColumnarBatch(lo.columns + ro.columns, n_pairs,
+                                        self._schema)
+                    if self.condition is not None:
+                        out = filter_batch_device(self.condition, out)
+                    return out
+                return _finish_pair_join(self.join_type, lb, rb, li, ri,
+                                         live, self.condition, self._schema)
+
+        out = with_retry_no_split(run, ctx.memory)
+        for s in right_batches + left_batches:
+            s.close()
+        rows_m.add(out.num_rows)
+        yield out
+
+    def describe(self):
+        c = f", cond={self.condition.name_hint}" if self.condition else ""
+        return f"NestedLoopJoin[{self.join_type}{c}]"
+
+
+class TpuBroadcastHashJoinExec(TpuHashJoinExec):
+    """Equi-join against a broadcast build side (ref
+    GpuBroadcastHashJoinExecBase): the build child is a
+    BroadcastExchangeExec whose single cached batch is reused across every
+    stream batch — the stream side is NOT coalesced, each incoming batch
+    joins independently. Only join types needing no null-extension (or
+    per-row marks) of the BUILD side across stream batches may stream; the
+    rest take the coalesced whole-sides path."""
+
+    #: join types streamable per build side
+    STREAMABLE = {
+        "right": ("inner", "left", "leftsemi", "leftanti", "existence",
+                  "cross"),
+        "left": ("inner", "right", "cross"),
+    }
+
+    def __init__(self, left, right, join_type, left_keys, right_keys,
+                 condition=None, build_side: str = "right"):
+        super().__init__(left, right, join_type, left_keys, right_keys,
+                         condition)
+        assert build_side in ("left", "right")
+        self.build_side = build_side
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        from ..shuffle.broadcast import BroadcastExchangeExec
+        bi = 1 if self.build_side == "right" else 0
+        build = self.children[bi]
+        if (self.join_type not in self.STREAMABLE[self.build_side]
+                or not isinstance(build, BroadcastExchangeExec)):
+            yield from super().do_execute(ctx)
+            return
+        rows_m = ctx.metric(self._exec_id, "numOutputRows", ESSENTIAL)
+        bb = build.broadcast(ctx)
+        produced = False
+        for sb in self.children[1 - bi].execute(ctx):
+            def run(sb=sb):
+                with ctx.semaphore.held():
+                    return (self._join(sb, bb) if bi == 1
+                            else self._join(bb, sb))
+            out = with_retry_no_split(run, ctx.memory)
+            rows_m.add(out.num_rows)
+            produced = True
+            yield out
+        if not produced:
+            empty = _empty_batch(self.children[1 - bi].output_schema())
+
+            def run_empty():
+                with ctx.semaphore.held():
+                    return (self._join(empty, bb) if bi == 1
+                            else self._join(bb, empty))
+            yield with_retry_no_split(run_empty, ctx.memory)
+
+    def describe(self):
+        return "Broadcast" + super().describe()[:-1] + \
+            f", build={self.build_side}]"
+
+
 class CpuJoinExec(TpuExec):
     """Host fallback / oracle via Arrow's join (SQL null semantics match)."""
     is_tpu = False
@@ -307,11 +647,8 @@ class CpuJoinExec(TpuExec):
         self.left_keys = list(left_keys)
         self.right_keys = list(right_keys)
         self.condition = condition
-        ls, rs = left.output_schema(), right.output_schema()
-        if join_type in ("leftsemi", "leftanti"):
-            self._schema = ls
-        else:
-            self._schema = Schema(list(ls.fields) + list(rs.fields))
+        self._schema = _join_schema(left.output_schema(),
+                                    right.output_schema(), join_type)
 
     def output_schema(self) -> Schema:
         return self._schema
@@ -320,6 +657,15 @@ class CpuJoinExec(TpuExec):
         import pyarrow as pa
         lt = self.children[0].collect(ctx)
         rt = self.children[1].collect(ctx)
+        if (self.join_type == "existence"
+                or (self.condition is not None
+                    and self.join_type not in ("inner", "cross"))
+                or (not self.left_keys
+                    and self.join_type not in ("inner", "cross"))):
+            # pair-set path: the condition (or its absence, for keyless
+            # outer/semi/anti joins) decides matched-ness per row
+            yield self._pairwise_host(lt, rt)
+            return
         if self.join_type == "cross" or not self.left_keys:
             out = self._cross_host(lt, rt)
         else:
@@ -365,6 +711,74 @@ class CpuJoinExec(TpuExec):
         ro = rt.take(ri)
         arrays = list(lo.columns) + list(ro.columns)
         return pa.Table.from_arrays(arrays, names=self._schema.names())
+
+    def _pairwise_host(self, lt, rt) -> ColumnarBatch:
+        """Generic host join over an explicit candidate pair set — the only
+        correct way to apply a residual condition to outer/semi/anti joins
+        (the condition decides matched-ness, it does not post-filter)."""
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        n_l, n_r = lt.num_rows, rt.num_rows
+        if self.left_keys:
+            lb = ColumnarBatch.from_arrow(lt, pad=False)
+            rb = ColumnarBatch.from_arrow(rt, pad=False)
+            kt_l = pa.table(
+                {f"__jk{i}": k.eval_host(lb)
+                 for i, k in enumerate(self.left_keys)} |
+                {"__l": pa.array(np.arange(n_l, dtype=np.int64))})
+            kt_r = pa.table(
+                {f"__jk{i}": k.eval_host(rb)
+                 for i, k in enumerate(self.right_keys)} |
+                {"__r": pa.array(np.arange(n_r, dtype=np.int64))})
+            keys = [f"__jk{i}" for i in range(len(self.left_keys))]
+            pairs = kt_l.join(kt_r, keys=keys, right_keys=keys,
+                              join_type="inner", coalesce_keys=True)
+            li = pairs.column("__l").to_numpy()
+            ri = pairs.column("__r").to_numpy()
+        else:
+            li = np.repeat(np.arange(n_l), n_r)
+            ri = np.tile(np.arange(n_r), n_l)
+        if self.condition is not None and len(li):
+            pair_schema = Schema(list(self.children[0].output_schema().fields)
+                                 + list(self.children[1].output_schema().fields))
+            lo = lt.take(pa.array(li))
+            ro = rt.take(pa.array(ri))
+            pair_t = pa.Table.from_arrays(
+                list(lo.columns) + list(ro.columns),
+                names=[f.name for f in pair_schema.fields])
+            pb = ColumnarBatch.from_arrow(pair_t, pad=False)
+            pb.schema = pair_schema
+            mask = pc.fill_null(self.condition.eval_host(pb), False)
+            m = mask.to_numpy(zero_copy_only=False)
+            li, ri = li[m], ri[m]
+        ml = np.bincount(li, minlength=n_l) if n_l else np.zeros(0, np.int64)
+        names = self._schema.names()
+        if self.join_type == "leftsemi":
+            return ColumnarBatch.from_arrow(
+                lt.take(pa.array(np.nonzero(ml > 0)[0])))
+        if self.join_type == "leftanti":
+            return ColumnarBatch.from_arrow(
+                lt.take(pa.array(np.nonzero(ml == 0)[0])))
+        if self.join_type == "existence":
+            out = lt.append_column(names[-1], pa.array(ml > 0))
+            return ColumnarBatch.from_arrow(out)
+        mr = np.bincount(ri, minlength=n_r) if n_r else np.zeros(0, np.int64)
+        gl, gr = [li], [ri]
+        if self.join_type in ("left", "full"):
+            u = np.nonzero(ml == 0)[0]
+            gl.append(u)
+            gr.append(np.full(len(u), -1, np.int64))
+        if self.join_type in ("right", "full"):
+            u = np.nonzero(mr == 0)[0]
+            gl.append(np.full(len(u), -1, np.int64))
+            gr.append(u)
+        gl = np.concatenate(gl) if gl else np.zeros(0, np.int64)
+        gr = np.concatenate(gr) if gr else np.zeros(0, np.int64)
+        lo = lt.take(pa.array(gl, mask=gl < 0))
+        ro = rt.take(pa.array(gr, mask=gr < 0))
+        out = pa.Table.from_arrays(list(lo.columns) + list(ro.columns),
+                                   names=names)
+        return ColumnarBatch.from_arrow(out)
 
     def describe(self):
         return f"CpuJoin[{self.join_type}]"

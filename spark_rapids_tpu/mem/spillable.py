@@ -46,6 +46,11 @@ class SpillableBatch:
         self._handle = self._mm.register_spillable(self)
         self._closed = False
 
+    def device_bytes(self) -> int:
+        """Device footprint when resident (size estimate for spill/split
+        decisions, ref SpillableColumnarBatch.sizeInBytes)."""
+        return self._device_bytes
+
     # ------------------------------------------------------------- migration
     def spill_to_host(self) -> int:
         with self._lock:

@@ -168,12 +168,13 @@ class LocalLimit(GlobalLimit):
 
 class Join(LogicalPlan):
     JOIN_TYPES = ("inner", "left", "right", "full", "leftsemi", "leftanti",
-                  "cross")
+                  "cross", "existence")
 
     def __init__(self, left: LogicalPlan, right: LogicalPlan,
                  join_type: str, left_keys: Sequence[Expression] = (),
                  right_keys: Sequence[Expression] = (),
-                 condition: Optional[Expression] = None):
+                 condition: Optional[Expression] = None,
+                 broadcast: Optional[str] = None):
         jt = join_type.lower().replace("_", "")
         if jt == "leftouter":
             jt = "left"
@@ -186,16 +187,21 @@ class Join(LogicalPlan):
         if jt == "anti":
             jt = "leftanti"
         assert jt in self.JOIN_TYPES, join_type
+        assert broadcast in (None, "left", "right"), broadcast
         self.join_type = jt
         self.left_keys = list(left_keys)
         self.right_keys = list(right_keys)
         self.condition = condition
+        self.broadcast = broadcast
         self.children = [left, right]
 
     def schema(self) -> Schema:
         l, r = self.children[0].schema(), self.children[1].schema()
         if self.join_type in ("leftsemi", "leftanti"):
             return l
+        if self.join_type == "existence":
+            return Schema(list(l.fields) +
+                          [StructField("exists", BOOL, nullable=False)])
         # outer sides become nullable
         return Schema(list(l.fields) + list(r.fields))
 

@@ -11,6 +11,7 @@ import logging
 from typing import Callable, Dict, Type
 
 from ..config import TpuConf
+from ..types import Schema
 from ..exec import basic as B
 from ..exec import aggregate as A
 from ..exec import sort as S
@@ -256,13 +257,30 @@ class JoinMeta(PlanMeta):
             r = k.fully_device_supported(rs)
             if r:
                 self.will_not_work_on_tpu(f"right key <{k.name_hint}>: {r}")
-        if self.plan.join_type == "cross" or not self.plan.left_keys:
-            if self.plan.condition is None and self.plan.join_type != "cross":
-                self.will_not_work_on_tpu("equi-join keys required")
+        if self.plan.condition is not None:
+            joined = Schema(list(ls.fields) + list(rs.fields))
+            r = self.plan.condition.fully_device_supported(joined)
+            if r:
+                self.will_not_work_on_tpu(
+                    f"join condition <{self.plan.condition.name_hint}>: {r}")
 
     def convert_to_tpu(self, children):
-        from ..exec.joins import TpuHashJoinExec
+        from ..exec.joins import (TpuBroadcastHashJoinExec, TpuHashJoinExec,
+                                  TpuNestedLoopJoinExec)
+        from ..shuffle.broadcast import BroadcastExchangeExec
         p = self.plan
+        if p.join_type == "cross" or not p.left_keys:
+            # no equi keys: nested loop (ref GpuBroadcastNestedLoopJoinExec)
+            return TpuNestedLoopJoinExec(children[0], children[1],
+                                         p.join_type, p.condition)
+        if p.broadcast == "right":
+            return TpuBroadcastHashJoinExec(
+                children[0], BroadcastExchangeExec(children[1]), p.join_type,
+                p.left_keys, p.right_keys, p.condition, build_side="right")
+        if p.broadcast == "left":
+            return TpuBroadcastHashJoinExec(
+                BroadcastExchangeExec(children[0]), children[1], p.join_type,
+                p.left_keys, p.right_keys, p.condition, build_side="left")
         return TpuHashJoinExec(children[0], children[1], p.join_type,
                                p.left_keys, p.right_keys, p.condition)
 

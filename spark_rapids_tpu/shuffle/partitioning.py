@@ -30,7 +30,7 @@ _PART_CACHE: Dict[Tuple, object] = {}
 
 
 def _build_pid_kernel(key_exprs: Sequence[Expression], schema: Schema,
-                      mode: str):
+                      mode: str, seed: int = 42):
     dtypes = [f.dtype for f in schema.fields]
 
     @functools.partial(jax.jit, static_argnums=(2, 3))
@@ -41,7 +41,7 @@ def _build_pid_kernel(key_exprs: Sequence[Expression], schema: Schema,
         if mode == "hash":
             from ..exprs.hash_fns import murmur3_fold_device
             h = murmur3_fold_device([e.eval_device(ctx) for e in key_exprs],
-                                    42)
+                                    seed)
             pid = h % jnp.int32(num_parts)          # Spark pmod semantics
             pid = jnp.where(pid < 0, pid + jnp.int32(num_parts), pid)
         elif mode == "roundrobin":
@@ -70,12 +70,13 @@ def _split_kernel(arrays, pid, padded_len, num_parts):
 
 
 def hash_partition_ids(batch: ColumnarBatch, keys: Sequence[Expression],
-                       num_parts: int, mode: str = "hash"):
+                       num_parts: int, mode: str = "hash", seed: int = 42):
     key = (tuple(e.key() for e in keys),
-           tuple((f.name, f.dtype.name) for f in batch.schema.fields), mode)
+           tuple((f.name, f.dtype.name) for f in batch.schema.fields), mode,
+           seed)
     kern = _PART_CACHE.get(key)
     if kern is None:
-        kern = _build_pid_kernel(keys, batch.schema, mode)
+        kern = _build_pid_kernel(keys, batch.schema, mode, seed)
         _PART_CACHE[key] = kern
     cols = [(c.data, c.validity) if isinstance(c, DeviceColumn) else None
             for c in batch.columns]
@@ -102,11 +103,40 @@ class PartitionedBatches:
             cols.append(dc.to_arrow(n))
         return pa.Table.from_arrays(cols, names=self.schema.names())
 
+    def partition_device(self, p: int) -> ColumnarBatch:
+        """Partition p as a device-resident bucketed batch — no host round
+        trip (the contiguous-split view stays in HBM, ref
+        GpuPartitioning contiguousSplit returning device tables). The slice
+        is re-padded to a shape bucket via an index-gather so downstream
+        kernels compile once per bucket, not once per partition size."""
+        from ..columnar.bucketing import bucket_for
+        start, n = int(self.offsets[p]), int(self.counts[p])
+        pb = bucket_for(max(n, 1))
+        cols = []
+        for (d, v), f in zip(self.sorted_cols, self.schema.fields):
+            od, ov = _slice_pad_kernel(d, v, jnp.int32(start), jnp.int32(n),
+                                       pb)
+            cols.append(DeviceColumn(od, ov, f.dtype))
+        return ColumnarBatch(cols, n, self.schema)
+
+
+@functools.partial(jax.jit, static_argnums=(4,))
+def _slice_pad_kernel(data, validity, start, n, out_p):
+    """Gather rows [start, start+n) into a bucket-padded buffer; slots past n
+    are invalid padding (data holds the dtype default from index clipping)."""
+    idx = start + jnp.arange(out_p, dtype=jnp.int32)
+    live = jnp.arange(out_p, dtype=jnp.int32) < n
+    od = jnp.take(data, idx, mode="clip")
+    ov = jnp.logical_and(jnp.take(validity, idx, mode="clip"), live)
+    od = jnp.where(live, od, jnp.zeros_like(od))
+    return od, ov
+
 
 def partition_batch(batch: ColumnarBatch, keys: Sequence[Expression],
-                    num_parts: int, mode: str = "hash") -> PartitionedBatches:
+                    num_parts: int, mode: str = "hash",
+                    seed: int = 42) -> PartitionedBatches:
     assert batch.all_device, "partitioning requires device batch"
-    pid = hash_partition_ids(batch, keys, num_parts, mode)
+    pid = hash_partition_ids(batch, keys, num_parts, mode, seed)
     arrays = [(c.data, c.validity) for c in batch.columns]
     # num_parts+1: the virtual padding partition sorts last and is dropped
     cols, counts = _split_kernel(arrays, pid, batch.padded_len, num_parts + 1)

@@ -104,3 +104,159 @@ def test_float_keys_nan_matches_nan():
     out = l.join(r, on=[("lk", "rk")], how="inner").to_pandas()
     got = sorted(zip(out["lv"], out["rv"]))
     assert got == [(2, 10), (3, 20), (4, 20)]
+
+
+# ---------------------------------------------------------------------------
+# Conditional (residual-condition) joins of every type
+# (ref GpuBroadcastNestedLoopJoinExecBase / conditional JoinGatherer)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "full",
+                                 "leftsemi", "leftanti"])
+def test_conditional_equi_join(how):
+    def q(s):
+        l, r = _sides(s, n_l=128, n_r=96, key_hi=10)
+        return l.join(r, on=[("lk", "rk")], how=how,
+                      condition=F.col("lv") > F.col("rv"))
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_conditional_left_join_hand_oracle():
+    """Condition decides matched-ness — NOT a post-filter (a left row whose
+    key matches but whose condition never passes must still appear,
+    null-extended)."""
+    import pyarrow as pa
+    from harness import tpu_session
+    s = tpu_session()
+    l = s.create_dataframe(pa.table({"lk": [1, 1, 2], "lv": [10, 1, 5]}))
+    r = s.create_dataframe(pa.table({"rk": [1, 1, 3], "rv": [5, 20, 0]}))
+    out = l.join(r, on=[("lk", "rk")], how="left",
+                 condition=F.col("lv") > F.col("rv")).to_pandas()
+    out = out.sort_values(["lk", "lv"], na_position="first")
+    # lv=10 matches rv=5 only; lv=1 matches nothing -> null-extended;
+    # lk=2 has no key match -> null-extended
+    assert len(out) == 3
+    matched = out[out["rv"].notna()]
+    assert matched[["lv", "rv"]].values.tolist() == [[10, 5]]
+    assert out["rv"].isna().sum() == 2
+
+
+@pytest.mark.parametrize("how", ["existence"])
+def test_existence_join(how):
+    def q(s):
+        l, r = _sides(s, n_l=256, n_r=64, key_hi=20)
+        return l.join(r, on=[("lk", "rk")], how=how)
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_existence_join_with_condition():
+    def q(s):
+        l, r = _sides(s, n_l=128, n_r=64, key_hi=8)
+        return l.join(r, on=[("lk", "rk")], how="existence",
+                      condition=F.col("lv") > F.col("rv"))
+    assert_tpu_and_cpu_equal(q)
+
+
+# ---------------------------------------------------------------------------
+# Nested-loop joins (no equi keys; ref GpuBroadcastNestedLoopJoinExecBase,
+# GpuCartesianProductExec)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "full",
+                                 "leftsemi", "leftanti"])
+def test_nested_loop_join(how):
+    def q(s):
+        l, r = _sides(s, n_l=64, n_r=48, key_hi=100)
+        return l.join(r, how=how, condition=F.col("lk") < F.col("rk"))
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_nested_loop_join_plan():
+    from harness import tpu_session
+    l, r = _sides(tpu_session())
+    plan = l.join(r, how="inner",
+                  condition=F.col("lk") < F.col("rk"))._physical()
+    assert "NestedLoopJoin" in plan.tree_string()
+
+
+def test_cartesian_product_with_condition():
+    def q(s):
+        l, r = _sides(s, n_l=32, n_r=32)
+        return l.join(r, how="cross",
+                      condition=F.col("lv") % 2 == F.col("rv") % 2)
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_nested_loop_empty_right():
+    def q(s):
+        l, r = _sides(s, n_l=32)
+        return l.join(r.filter(F.col("rv") > 10**10), how="left",
+                      condition=F.col("lk") < F.col("rk"))
+    assert_tpu_and_cpu_equal(q)
+
+
+# ---------------------------------------------------------------------------
+# Broadcast hash join (ref GpuBroadcastHashJoinExecBase +
+# GpuBroadcastExchangeExec)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("how", ["inner", "left", "leftsemi", "leftanti",
+                                 "right", "full", "existence"])
+def test_broadcast_hash_join(how):
+    def q(s):
+        l, r = _sides(s, n_l=512, n_r=64, key_hi=30)
+        return l.join(F.broadcast(r), on=[("lk", "rk")], how=how)
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_broadcast_join_plan_has_exchange():
+    from harness import tpu_session
+    l, r = _sides(tpu_session())
+    plan = l.join(F.broadcast(r), on=[("lk", "rk")], how="inner")._physical()
+    t = plan.tree_string()
+    assert "BroadcastExchange" in t and "BroadcastHashJoin" in t
+
+
+# ---------------------------------------------------------------------------
+# Sub-partitioned big-input join (ref GpuSubPartitionHashJoin.scala)
+# ---------------------------------------------------------------------------
+
+_SUBPART_CONF = {"spark.rapids.tpu.sql.join.subPartitionSizeBytes": 1024}
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "full",
+                                 "leftsemi", "leftanti"])
+def test_subpartitioned_join(how):
+    def q(s):
+        l, r = _sides(s, n_l=1024, n_r=512, key_hi=50)
+        return l.join(r, on=[("lk", "rk")], how=how)
+    assert_tpu_and_cpu_equal(q, conf=_SUBPART_CONF)
+
+
+def test_subpartitioned_join_matches_unpartitioned():
+    from harness import tpu_session
+    def q(s):
+        l, r = _sides(s, n_l=777, n_r=333, key_hi=25)
+        return l.join(r, on=[("lk", "rk")], how="inner")
+    a = q(tpu_session(_SUBPART_CONF)).to_pandas()
+    b = q(tpu_session()).to_pandas()
+    key = ["lk", "lv", "rk", "rv"]
+    a = a.sort_values(key, na_position="first").reset_index(drop=True)
+    b = b.sort_values(key, na_position="first").reset_index(drop=True)
+    pd.testing.assert_frame_equal(a, b)
+
+
+@pytest.mark.parametrize("how", ["inner", "right", "left", "full"])
+def test_broadcast_left_build_side(how):
+    def q(s):
+        l, r = _sides(s, n_l=64, n_r=512, key_hi=30)
+        return F.broadcast(l).join(r, on=[("lk", "rk")], how=how)
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_broadcast_join_empty_stream():
+    def q(s):
+        l, r = _sides(s, n_l=64, n_r=64, key_hi=10)
+        return l.filter(F.col("lv") > 10**10).join(
+            F.broadcast(r), on=[("lk", "rk")], how="left")
+    assert_tpu_and_cpu_equal(q)
