@@ -147,18 +147,72 @@ class TpuHashAggregateExec(TpuExec):
             # idempotent over the input batch -> retry-safe
             partials.append(with_retry_no_split(first_pass, ctx.memory))
 
+        total = sum(sb.device_bytes() for sb in partials)
+        if (self.groupings and partials
+                and total > ctx.conf.batch_size_bytes
+                and self._repartitionable()):
+            yield from self._repartitioned_merge(ctx, partials, total, rows_m)
+            return
+
         merged = self._merge(ctx, partials)
         final = self._finalize(ctx, merged)
         rows_m.add(final.num_rows)
         yield final
 
-    # ------------------------------------------------------------------
-    def _merge(self, ctx: ExecContext,
-               partials: List[SpillableBatch]) -> ColumnarBatch:
+    # -- re-partition fallback (ref GpuAggregateExec.scala:718-780: when the
+    # merge target cannot fit, hash re-partition the partial batches by key
+    # and merge each partition independently — group keys are disjoint
+    # across partitions, so per-partition merge+finalize is exact) ---------
+    #: distinct seed from shuffle partitioning (42) so a key-partitioned
+    #: shuffle stage does not collapse all rows into one sub-partition
+    REPARTITION_SEED = 1879048201
+
+    def _repartitionable(self) -> bool:
+        from ..exprs.hash_fns import device_hashable
+        return not any(
+            device_hashable.reason_not_supported(f.dtype)
+            for f in self._partial_schema.fields[:len(self.groupings)])
+
+    def _merge_kernel(self):
         merge_keys = [BoundReference(i, f.dtype) for i, f in
                       enumerate(self._partial_schema.fields[:len(self.groupings)])]
         merge_k = _get_kernel(merge_keys, self.aggs, self._partial_schema,
                               "merge", self._partial_counts)
+        return merge_keys, merge_k
+
+    def _repartitioned_merge(self, ctx: ExecContext, partials, total, rows_m
+                             ) -> Iterator[ColumnarBatch]:
+        from ..shuffle.partitioning import partition_batch, scatter_spillables
+        merge_keys, merge_k = self._merge_kernel()
+        n_parts = min(1 << max(1, (int(total) // ctx.conf.batch_size_bytes
+                                   ).bit_length()), 64)
+        ctx.metric(self._exec_id, "aggRepartitions").set(n_parts)
+        slices = scatter_spillables(
+            ctx, partials,
+            lambda b: partition_batch(b, merge_keys, n_parts,
+                                      seed=self.REPARTITION_SEED),
+            n_parts)
+        for p in range(n_parts):
+            parts = slices[p]
+            if not parts:
+                continue
+
+            def merge_part(parts=parts):
+                with ctx.semaphore.held():
+                    big = concat_batches([s.get() for s in parts])
+                    return self._run_kernel(merge_k, big,
+                                            self._partial_schema)
+            merged = with_retry_no_split(merge_part, ctx.memory)
+            for s in parts:
+                s.close()
+            final = self._finalize(ctx, merged)
+            rows_m.add(final.num_rows)
+            yield final
+
+    # ------------------------------------------------------------------
+    def _merge(self, ctx: ExecContext,
+               partials: List[SpillableBatch]) -> ColumnarBatch:
+        _, merge_k = self._merge_kernel()
         if not partials:
             # empty input: still one row for global agg, zero rows for grouped
             empty = ColumnarBatch.from_arrow(

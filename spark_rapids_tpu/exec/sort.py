@@ -2,9 +2,22 @@
 
 Device sort = encode each SortOrder into (null_rank u8, key u64) operands
 (exec/encoding.py) and run ONE stable ``lax.sort`` carrying every output
-column as payload. Global sort currently concatenates batches then sorts
-(single-batch goal) under the retry framework; the reference's out-of-core
-merge-sort with spillable pending queues is the planned widening.
+column as payload.
+
+Global sort has two regimes (selected by spark.rapids.tpu.sql.batchSizeBytes,
+the reference's targetSizeBytes role):
+  * small input — concatenate + one device sort (single-batch goal);
+  * out-of-core — the reference's GpuOutOfCoreSortIterator re-designed
+    TPU-first as a SAMPLE SORT: sort each input batch into a spillable run,
+    sample each run's encoded sort keys to pick K-1 range splitters, bucket
+    every run by splitter rank on device (one fused lexicographic-compare
+    kernel + the contiguous-split sorter), then per bucket concat the slices
+    from all runs and device-sort once more. Buckets are range-disjoint and
+    emitted in order, so the stream of output batches is globally sorted
+    while only ~|total|/K rows are ever resident. Sample sort replaces the
+    reference's priority-queue merge because a K-way streaming merge is
+    scalar-sequential (hostile to the MXU/vector units), while bucketing and
+    re-sorting are single fused XLA ops over static shapes.
 """
 from __future__ import annotations
 
@@ -13,6 +26,7 @@ from typing import Dict, Iterator, List, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..columnar import ColumnarBatch, DeviceColumn, concat_batches
 from ..exprs.base import DVal, EvalContext
@@ -44,7 +58,14 @@ def _np_total_order_key(v):
 _SORT_KERNEL_CACHE: Dict[Tuple, object] = {}
 
 
-def _build_sort_kernel(orders: List[SortOrder], schema: Schema):
+def _kernel_cache_key(orders: List[SortOrder], schema: Schema):
+    return (tuple(f"{o.expr.key()}|{o.ascending}|{o.nulls_first}"
+                  for o in orders),
+            tuple((f.name, f.dtype.name) for f in schema.fields))
+
+
+def _build_sort_kernel(orders: List[SortOrder], schema: Schema,
+                       with_keys: bool = False):
     dtypes = [f.dtype for f in schema.fields]
 
     @functools.partial(jax.jit, static_argnums=(2,))
@@ -64,28 +85,94 @@ def _build_sort_kernel(orders: List[SortOrder], schema: Schema):
         out = jax.lax.sort(tuple(operands + [perm0]), num_keys=n_ops,
                            is_stable=True)
         perm = out[n_ops]
-        return [(jnp.take(dv.data, perm), jnp.take(dv.validity, perm))
-                for dv in dvals]
+        sorted_cols = [(jnp.take(dv.data, perm), jnp.take(dv.validity, perm))
+                       for dv in dvals]
+        if with_keys:
+            # permuted encoded keys ride along so the out-of-core sampler
+            # needn't re-evaluate the sort expressions over the run
+            return sorted_cols, tuple(out[1:n_ops])
+        return sorted_cols
 
     return kernel
 
 
-def sort_batch_device(orders: List[SortOrder], batch: ColumnarBatch) -> ColumnarBatch:
-    key = (tuple(f"{o.expr.key()}|{o.ascending}|{o.nulls_first}"
-                 for o in orders),
-           tuple((f.name, f.dtype.name) for f in batch.schema.fields))
+def sort_batch_device(orders: List[SortOrder], batch: ColumnarBatch,
+                      with_keys: bool = False):
+    key = _kernel_cache_key(orders, batch.schema) + (with_keys,)
     kernel = _SORT_KERNEL_CACHE.get(key)
     if kernel is None:
-        kernel = _build_sort_kernel(orders, batch.schema)
+        kernel = _build_sort_kernel(orders, batch.schema, with_keys)
         _SORT_KERNEL_CACHE[key] = kernel
     cols = [(c.data, c.validity) for c in batch.columns]
     outs = kernel(cols, jnp.int32(batch.num_rows), batch.padded_len)
+    ops = None
+    if with_keys:
+        outs, ops = outs
     new_cols = [DeviceColumn(d, v, c.dtype)
                 for (d, v), c in zip(outs, batch.columns)]
-    return ColumnarBatch(new_cols, batch.num_rows, batch.schema)
+    out = ColumnarBatch(new_cols, batch.num_rows, batch.schema)
+    return (out, ops) if with_keys else out
+
+
+_KEYENC_CACHE: Dict[Tuple, object] = {}
+
+
+def _build_keyenc_kernel(orders: List[SortOrder], schema: Schema):
+    """Encoded sort-key operand arrays for a batch (same encoding the sort
+    kernel orders by, so host-side splitter maths agrees with device order)."""
+    dtypes = [f.dtype for f in schema.fields]
+
+    @functools.partial(jax.jit, static_argnums=(2,))
+    def kernel(cols, num_rows, padded_len):
+        dvals = [None if c is None else DVal(c[0], c[1], dt)
+                 for c, dt in zip(cols, dtypes)]
+        ctx = EvalContext(schema, dvals, num_rows, padded_len)
+        operands = []
+        for o in orders:
+            v = o.expr.eval_device(ctx)
+            operands.extend(order_key_operands(v, o.ascending, o.nulls_first))
+        return tuple(operands)
+
+    return kernel
+
+
+def _encode_keys(orders: List[SortOrder], batch: ColumnarBatch):
+    key = _kernel_cache_key(orders, batch.schema)
+    kern = _KEYENC_CACHE.get(key)
+    if kern is None:
+        kern = _build_keyenc_kernel(orders, batch.schema)
+        _KEYENC_CACHE[key] = kern
+    cols = [(c.data, c.validity) for c in batch.columns]
+    return kern(cols, jnp.int32(batch.num_rows), batch.padded_len)
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def _bucket_id_kernel(operands, splitters, num_rows, padded_len):
+    """bucket(row) = #{splitters lexicographically <= row_key}; padding rows
+    go to the virtual last bucket. Accumulates over splitters with a
+    fori_loop so peak memory is O(P), not O(P x K) — this path runs exactly
+    when HBM is tight."""
+    P = padded_len
+    S = splitters[0].shape[0]
+
+    def body(i, bucket):
+        gt = jnp.zeros(P, dtype=jnp.bool_)
+        eq = jnp.ones(P, dtype=jnp.bool_)
+        for op, sv in zip(operands, splitters):
+            s = jax.lax.dynamic_index_in_dim(sv, i, keepdims=False)
+            gt = jnp.logical_or(gt, jnp.logical_and(eq, op > s))
+            eq = jnp.logical_and(eq, op == s)
+        return bucket + jnp.logical_or(gt, eq).astype(jnp.int32)
+
+    bucket = jax.lax.fori_loop(0, S, body, jnp.zeros(P, dtype=jnp.int32))
+    live = jnp.arange(P, dtype=jnp.int32) < num_rows
+    return jnp.where(live, bucket, jnp.int32(S + 1))
 
 
 class TpuSortExec(TpuExec):
+    #: splitter-sample rows taken per sorted run per target bucket
+    OVERSAMPLE = 8
+
     def __init__(self, orders: List[SortOrder], child: TpuExec,
                  global_sort: bool = True):
         super().__init__([child])
@@ -105,6 +192,11 @@ class TpuSortExec(TpuExec):
                       for b in self.children[0].execute(ctx)]
         if not spillables:
             return
+        total = sum(s.device_bytes() for s in spillables)
+        target = ctx.conf.batch_size_bytes
+        if total > target:
+            yield from self._out_of_core(ctx, spillables, total, target)
+            return
 
         def do_sort():
             with ctx.semaphore.held():
@@ -115,6 +207,84 @@ class TpuSortExec(TpuExec):
         for sb in spillables:
             sb.close()
         yield out
+
+    # ------------------------------------------------------------------
+    def _out_of_core(self, ctx: ExecContext, spillables, total, target
+                     ) -> Iterator[ColumnarBatch]:
+        from ..shuffle.partitioning import (PartitionedBatches, _split_kernel,
+                                            scatter_spillables)
+        n_buckets = min(int(-(-total // max(target, 1))), 256)
+        splits_m = ctx.metric(self._exec_id, "sortBuckets")
+        splits_m.set(n_buckets)
+
+        # pass 1: sort every batch into a run + sample its encoded keys;
+        # sample counts are proportional to run size so a small run cannot
+        # skew the pooled quantiles (and so bucket loads stay balanced)
+        total_rows = max(sum(sb.num_rows for sb in spillables), 1)
+        budget = n_buckets * self.OVERSAMPLE * len(spillables)
+        runs = []
+        samples = []
+        for sb in spillables:
+            def sort_one(sb=sb):
+                with ctx.semaphore.held():
+                    run, ops = sort_batch_device(self.orders, sb.get(),
+                                                 with_keys=True)
+                    n = run.num_rows
+                    if n == 0:
+                        return SpillableBatch(run, ctx.memory), None
+                    k = max(min(n, -(-budget * n // total_rows)), 1)
+                    idx = jnp.asarray(
+                        np.linspace(0, n - 1, num=k, dtype=np.int64))
+                    samp = [np.asarray(jnp.take(op, idx)) for op in ops]
+                    return SpillableBatch(run, ctx.memory), samp
+            run_sb, samp = with_retry_no_split(sort_one, ctx.memory)
+            sb.close()
+            runs.append(run_sb)
+            if samp is not None:
+                samples.append(samp)
+        if not samples:
+            for r in runs:
+                r.close()
+            return
+
+        # pick K-1 splitters from the pooled samples (host; encoded keys
+        # order identically to the device sort)
+        pooled = [np.concatenate([s[j] for s in samples])
+                  for j in range(len(samples[0]))]
+        order = np.lexsort(tuple(reversed(pooled)))
+        m = len(order)
+        cut = [order[int(m * (b + 1) / n_buckets) - 1]
+               for b in range(n_buckets - 1)]
+        splitters = tuple(jnp.asarray(p[cut]) for p in pooled)
+
+        # pass 2: bucket every run by splitter rank (device)
+        def bucket_run(run: ColumnarBatch) -> PartitionedBatches:
+            ops = _encode_keys(self.orders, run)
+            pid = _bucket_id_kernel(ops, splitters, jnp.int32(run.num_rows),
+                                    run.padded_len)
+            arrays = [(c.data, c.validity) for c in run.columns]
+            cols, counts = _split_kernel(arrays, pid, run.padded_len,
+                                         n_buckets + 2)
+            return PartitionedBatches(cols, np.asarray(counts)[:n_buckets],
+                                      run.schema)
+
+        bucket_slices = scatter_spillables(ctx, runs, bucket_run, n_buckets)
+
+        # pass 3: per bucket, concat + device sort; buckets are range-
+        # disjoint and ordered, so the output stream is globally sorted
+        for b in range(n_buckets):
+            parts = bucket_slices[b]
+            if not parts:
+                continue
+
+            def merge_bucket(parts=parts):
+                with ctx.semaphore.held():
+                    big = concat_batches([p.get() for p in parts])
+                    return sort_batch_device(self.orders, big)
+            out = with_retry_no_split(merge_bucket, ctx.memory)
+            for p in parts:
+                p.close()
+            yield out
 
     def describe(self):
         return "Sort[" + ", ".join(map(repr, self.orders)) + "]"

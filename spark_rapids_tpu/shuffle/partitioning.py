@@ -132,6 +132,37 @@ def _slice_pad_kernel(data, validity, start, n, out_p):
     return od, ov
 
 
+def scatter_spillables(ctx, spillables, make_parts, n_parts: int):
+    """Partition every spillable batch with ``make_parts(batch) ->
+    PartitionedBatches`` and scatter the non-empty device slices into
+    ``n_parts`` slots, each slice spill-registered. Device work runs under
+    the semaphore inside a retry closure with cleanup of partial output;
+    inputs are closed as they are consumed. Shared skeleton of the
+    sub-partitioned join, the aggregate re-partition fallback, and the
+    out-of-core sort's bucketing pass."""
+    from ..mem import SpillableBatch, with_retry_no_split
+    slots: List[List[SpillableBatch]] = [[] for _ in range(n_parts)]
+    for sb in spillables:
+        def split_one(sb=sb):
+            out = []
+            try:
+                with ctx.semaphore.held():
+                    pb = make_parts(sb.get())
+                    for p in range(n_parts):
+                        if pb.counts[p]:
+                            out.append((p, SpillableBatch(
+                                pb.partition_device(p), ctx.memory)))
+            except Exception:
+                for _, s in out:
+                    s.close()
+                raise
+            return out
+        for p, s in with_retry_no_split(split_one, ctx.memory):
+            slots[p].append(s)
+        sb.close()
+    return slots
+
+
 def partition_batch(batch: ColumnarBatch, keys: Sequence[Expression],
                     num_parts: int, mode: str = "hash",
                     seed: int = 42) -> PartitionedBatches:

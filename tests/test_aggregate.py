@@ -131,3 +131,40 @@ def test_count_is_never_null():
         return (df.filter(F.col("k") < 5).group_by("k")
                 .agg(F.count(F.col("v")).with_name("c")))
     assert_tpu_and_cpu_equal(q)
+
+
+# ---------------------------------------------------------------------------
+# Re-partition merge fallback (ref GpuAggregateExec.scala:718-780)
+# ---------------------------------------------------------------------------
+
+_REPART_CONF = {"spark.rapids.tpu.sql.batchSizeBytes": 2048}
+
+
+def test_agg_repartition_fallback_differential():
+    def q(s):
+        df = s.create_dataframe(gen_df(
+            {"k": IntGen(lo=0, hi=500), "v": DoubleGen(),
+             "w": IntGen()}, n=8192), num_partitions=6)
+        return df.group_by("k").agg(
+            F.sum(F.col("v")).with_name("s"),
+            F.avg(F.col("w")).with_name("a"),
+            F.count_star().with_name("n"),
+            F.min(F.col("v")).with_name("mn"),
+            F.max(F.col("w")).with_name("mx"))
+    assert_tpu_and_cpu_equal(q, approximate_float=True, conf=_REPART_CONF)
+
+
+def test_agg_repartition_emits_disjoint_groups():
+    import pyarrow as pa
+    from harness import tpu_session
+    s = tpu_session(_REPART_CONF)
+    df = s.create_dataframe(gen_df(
+        {"k": IntGen(lo=0, hi=200, nullable=False), "v": IntGen()},
+        n=8192), num_partitions=4)
+    out = df.group_by("k").agg(F.count_star().with_name("n"))
+    phys = out._physical()
+    batches = list(phys.execute(s.exec_context()))
+    assert len(batches) > 1, "expected re-partitioned merge output"
+    t = pa.concat_tables([b.to_arrow() for b in batches])
+    ks = t.column("k").to_pandas()
+    assert ks.nunique(dropna=False) == len(ks), "duplicate group across parts"
