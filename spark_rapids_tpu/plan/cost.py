@@ -1,0 +1,117 @@
+"""Cost-based optimizer (ref CostBasedOptimizer.scala; defaults from
+RapidsConf.scala:2126-2156 — CPU exec 2.0e-4 s/row, GPU exec 1.0e-4 s/row,
+plus row<->columnar transition costs).
+
+After tagging, walk the meta tree bottom-up estimating per-subtree wall cost
+under two placements (device vs host). A node that is TPU-capable but whose
+device cost — including the transitions its placement would force — exceeds
+its host cost is reverted with an explicit "cost-based" reason, exactly the
+reference's "it is not worth moving this subtree to the GPU" behavior.
+
+Row estimates are deliberately crude (the reference's are too): scans count
+real rows, filters halve, aggregates collapse by ~the group-ratio guess,
+joins multiply selectivity. The model's job is to catch egregious cases
+(tiny subtree sandwiched between CPU sections), not to be a planner.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ..config import (CBO_ENABLED as OPTIMIZER_ENABLED,
+                      CPU_EXEC_COST_PER_ROW as CPU_EXEC_COST,
+                      TPU_EXEC_COST_PER_ROW as TPU_EXEC_COST,
+                      TpuConf, register)
+from . import logical as L
+from .meta import PlanMeta
+
+log = logging.getLogger(__name__)
+
+TRANSITION_COST = register(
+    "spark.rapids.tpu.sql.optimizer.transition.cost", 1.0e-4,
+    "Estimated cost per row of a host<->device transition "
+    "(row->columnar H2D or columnar->row D2H; ref "
+    "spark.rapids.sql.optimizer.cpu.exec.rowToColumnarCost).",
+    internal=True)
+
+
+def estimate_rows(plan: L.LogicalPlan) -> float:
+    """Crude cardinality estimate per logical node."""
+    kids = [estimate_rows(c) for c in plan.children]
+    if isinstance(plan, L.LogicalScan):
+        return float(sum(t.num_rows for t in plan.tables))
+    if isinstance(plan, L.ParquetScan):
+        total = 0
+        for p in plan.paths:
+            try:
+                import pyarrow.parquet as pq
+                total += pq.ParquetFile(p).metadata.num_rows
+            except Exception:
+                total += 1_000_000
+        return float(total)
+    if isinstance(plan, L.RangeRel):
+        return float(max(0, (plan.end - plan.start) // (plan.step or 1)))
+    if isinstance(plan, L.Filter):
+        return kids[0] * 0.5
+    if isinstance(plan, L.Aggregate):
+        return max(kids[0] * 0.1, 1.0) if plan.groupings else 1.0
+    if isinstance(plan, (L.GlobalLimit, L.LocalLimit)):
+        return float(min(plan.n, kids[0]))
+    if isinstance(plan, L.Join):
+        if plan.join_type in ("leftsemi", "leftanti", "existence"):
+            return kids[0]
+        if not plan.left_keys:
+            return kids[0] * kids[1] * 0.1
+        return max(kids[0], kids[1])
+    if isinstance(plan, L.Sample):
+        return kids[0] * plan.fraction
+    if isinstance(plan, L.Expand):
+        return kids[0] * len(plan.projections)
+    if isinstance(plan, L.Union):
+        return float(sum(kids))
+    return kids[0] if kids else 1000.0
+
+
+class _Cost:
+    __slots__ = ("device", "host", "device_boundary")
+
+    def __init__(self, device: float, host: float, device_boundary: bool):
+        #: cheapest cost of this subtree ending device-resident / host-resident
+        self.device = device
+        self.host = host
+        #: whether the subtree root runs on device in the device plan
+        self.device_boundary = device_boundary
+
+
+def apply_cost_optimizer(meta: PlanMeta, conf: TpuConf) -> None:
+    """Revert TPU-capable nodes whose device placement is not worth the
+    transitions. Mutates metas via will_not_work_on_tpu."""
+    cpu_c = conf.get(CPU_EXEC_COST)
+    tpu_c = conf.get(TPU_EXEC_COST)
+    trans_c = conf.get(TRANSITION_COST)
+
+    def walk(m: PlanMeta) -> _Cost:
+        rows = estimate_rows(m.plan)
+        kids = [walk(c) for c in m.child_metas]
+        if not m.can_run_on_tpu:
+            # host-only: children feeding it from device pay a D2H transition
+            host = cpu_c * rows + sum(
+                min(k.host, k.device + trans_c * estimate_rows(cm.plan))
+                for k, cm in zip(kids, m.child_metas))
+            return _Cost(float("inf"), host, False)
+        # device placement: children arriving host-side pay H2D
+        device = tpu_c * rows + sum(
+            min(k.device, k.host + trans_c * estimate_rows(cm.plan))
+            for k, cm in zip(kids, m.child_metas))
+        host = cpu_c * rows + sum(
+            min(k.host, k.device + trans_c * estimate_rows(cm.plan))
+            for k, cm in zip(kids, m.child_metas))
+        if host < device:
+            m.will_not_work_on_tpu(
+                f"cost-based: device cost {device:.4f} (incl. transitions) "
+                f"exceeds host cost {host:.4f}")
+            log.debug("cost optimizer reverted %s", type(m.plan).__name__)
+            return _Cost(float("inf"), host, False)
+        return _Cost(device, host, True)
+
+    walk(meta)

@@ -42,9 +42,13 @@ def wrap_plan(plan: L.LogicalPlan, conf: TpuConf,
 
 
 def plan_query(plan: L.LogicalPlan, conf: TpuConf) -> TpuExec:
-    """tag -> (explain) -> convert (ref applyOverrides:4813)."""
+    """tag -> cost-optimize -> (explain) -> convert (ref
+    applyOverrides:4813, getOptimizations:4827)."""
     meta = wrap_plan(plan, conf)
     meta.tag()
+    from .cost import OPTIMIZER_ENABLED, apply_cost_optimizer
+    if conf.get(OPTIMIZER_ENABLED):
+        apply_cost_optimizer(meta, conf)
     explain = conf.explain
     if explain in ("NOT_ON_TPU", "ALL"):
         out = meta.explain(only_not_on_tpu=(explain == "NOT_ON_TPU"))

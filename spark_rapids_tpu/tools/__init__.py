@@ -1,0 +1,12 @@
+"""Dev tooling: supported-ops documentation and qualification-tool CSV
+generation (ref TypeChecks.scala SupportedOpsDocs:1709 /
+SupportedOpsForTools:2163 and tools/generated_files/*/operatorsScore.csv).
+"""
+from .supported_ops import (expression_inventory, exec_inventory,
+                            generate_supported_ops_md,
+                            generate_supported_exprs_csv,
+                            generate_operators_score_csv, write_all)
+
+__all__ = ["expression_inventory", "exec_inventory",
+           "generate_supported_ops_md", "generate_supported_exprs_csv",
+           "generate_operators_score_csv", "write_all"]

@@ -1,0 +1,211 @@
+"""Supported-ops docs + qualification CSVs from the live registries.
+
+The reference generates docs/supported_ops.md and per-shim
+tools/generated_files/{operatorsScore.csv,supportedExprs.csv} from its
+TypeChecks declarations (TypeChecks.scala:1709 SupportedOpsDocs, :2163
+SupportedOpsForTools; scores at tools/generated_files/320/operatorsScore.csv).
+Here the same artifacts are derived from the Python class registries: every
+Expression subclass carries ``device_type_sig`` plus device/host eval
+methods, every TpuExec subclass is an operator. Regenerate with:
+
+    python -m spark_rapids_tpu.tools.supported_ops [out_dir]
+"""
+from __future__ import annotations
+
+import importlib
+import inspect
+from typing import Dict, List, Tuple
+
+from ..exprs.base import Expression
+from ..exec.base import TpuExec
+from ..types import TypeEnum
+
+#: documented type columns, reference column order (supported_ops.md)
+TYPE_COLUMNS = [TypeEnum.BOOLEAN, TypeEnum.BYTE, TypeEnum.SHORT, TypeEnum.INT,
+                TypeEnum.LONG, TypeEnum.FLOAT, TypeEnum.DOUBLE, TypeEnum.DATE,
+                TypeEnum.TIMESTAMP, TypeEnum.STRING, TypeEnum.BINARY,
+                TypeEnum.DECIMAL, TypeEnum.NULL, TypeEnum.ARRAY, TypeEnum.MAP,
+                TypeEnum.STRUCT]
+
+_EXPR_MODULES = ["aggregates", "arithmetic", "cast", "collection_fns",
+                 "comparison", "conditional", "datetime_fns", "generators",
+                 "hash_fns", "higher_order", "json_fns", "logical",
+                 "math_fns", "nondeterministic", "string_fns", "window_fns"]
+
+_EXEC_MODULES = ["aggregate", "basic", "generate", "joins", "sort", "window"]
+
+#: per-operator speedup priors for the qualification tool (the reference
+#: ships estimates, not measurements — operatorsScore.csv:1-8; these mirror
+#: its defaults with the same "exec speedup ~2-3x" prior)
+_DEFAULT_SCORE = 2.5
+_SCORE_OVERRIDES = {
+    "TpuFilterExec": 2.8,
+    "ParquetScanExec": 3.0,
+    "TpuHashAggregateExec": 3.0,
+    "TpuHashJoinExec": 3.0,
+    "TpuBroadcastHashJoinExec": 3.5,
+    "TpuSortExec": 2.7,
+    "TpuProjectExec": 3.0,
+    "ShuffleExchangeExec": 2.8,
+    "TpuWindowExec": 3.0,
+}
+
+
+def _all_subclasses(cls) -> List[type]:
+    out = []
+    for sub in cls.__subclasses__():
+        out.append(sub)
+        out.extend(_all_subclasses(sub))
+    return out
+
+
+def _load_registries():
+    for m in _EXPR_MODULES:
+        importlib.import_module(f"spark_rapids_tpu.exprs.{m}")
+    for m in _EXEC_MODULES:
+        importlib.import_module(f"spark_rapids_tpu.exec.{m}")
+    importlib.import_module("spark_rapids_tpu.shuffle.exchange")
+    importlib.import_module("spark_rapids_tpu.shuffle.broadcast")
+    importlib.import_module("spark_rapids_tpu.io.parquet")
+    importlib.import_module("spark_rapids_tpu.io.text")
+
+
+def expression_inventory() -> List[Dict]:
+    """One record per concrete Expression: name, module, device/host support,
+    per-type support derived from device_type_sig."""
+    _load_registries()
+    from ..exprs.aggregates import AggregateExpression
+    recs = []
+    for cls in sorted(_all_subclasses(Expression), key=lambda c: c.__name__):
+        if cls.__name__.startswith("_") or inspect.isabstract(cls):
+            continue
+        has_device = ("eval_device" in cls.__dict__
+                      or any("eval_device" in b.__dict__
+                             for b in cls.__mro__[1:-1]
+                             if b not in (Expression,)))
+        has_host = ("eval_host" in cls.__dict__
+                    or any("eval_host" in b.__dict__
+                           for b in cls.__mro__[1:-1]
+                           if b not in (Expression,)))
+        is_agg = issubclass(cls, AggregateExpression)
+        if is_agg:
+            # aggregates evaluate through update/merge/finalize, not eval_*
+            has_device = True
+        if not has_device and not has_host:
+            continue  # abstract helper (no evaluation contract)
+        sig = cls.device_type_sig
+        recs.append({
+            "name": cls.__name__,
+            "module": cls.__module__.rsplit(".", 1)[-1],
+            "context": "aggregation" if is_agg else "project",
+            "device": has_device,
+            "host": has_host,
+            "types": {t: (t in sig.types) for t in TYPE_COLUMNS},
+            "notes": dict(sig.notes),
+        })
+    return recs
+
+
+def exec_inventory() -> List[Dict]:
+    _load_registries()
+    recs = []
+    for cls in sorted(_all_subclasses(TpuExec), key=lambda c: c.__name__):
+        if cls.__name__.startswith("_"):
+            continue
+        if "do_execute" not in cls.__dict__ and not any(
+                "do_execute" in b.__dict__ for b in cls.__mro__[1:-1]):
+            continue
+        recs.append({
+            "name": cls.__name__,
+            "module": cls.__module__.rsplit(".", 1)[-1],
+            "is_tpu": bool(getattr(cls, "is_tpu", True)),
+            "score": _SCORE_OVERRIDES.get(cls.__name__, _DEFAULT_SCORE),
+        })
+    return recs
+
+
+def generate_supported_ops_md() -> str:
+    exprs = expression_inventory()
+    execs = exec_inventory()
+    out = ["# Supported operators and expressions",
+           "",
+           "Generated from the live TypeSig registry "
+           "(`python -m spark_rapids_tpu.tools.supported_ops`). "
+           "S = supported on device, NS = not supported (host fallback), "
+           "PS = partial (see note).", ""]
+    out.append("## Execs")
+    out.append("")
+    out.append("Exec | Module | Device")
+    out.append("--- | --- | ---")
+    for r in execs:
+        out.append(f"{r['name']} | {r['module']} | "
+                   f"{'yes' if r['is_tpu'] else 'CPU fallback/oracle'}")
+    out.append("")
+    out.append("## Expressions")
+    out.append("")
+    out.append("Expression | Context | Engines | " +
+               " | ".join(TYPE_COLUMNS))
+    out.append("--- | --- | --- | " + " | ".join("---" for _ in TYPE_COLUMNS))
+    for r in exprs:
+        eng = ("device+host" if r["device"] and r["host"]
+               else ("device" if r["device"] else "host"))
+        cells = []
+        for t in TYPE_COLUMNS:
+            if r["types"][t]:
+                cells.append("PS" if t in r["notes"] else "S")
+            else:
+                cells.append("NS")
+        out.append(f"{r['name']} | {r['context']} | {eng} | "
+                   + " | ".join(cells))
+    notes = [(r["name"], t, n) for r in exprs for t, n in r["notes"].items()]
+    if notes:
+        out += ["", "### Partial-support notes", ""]
+        for name, t, n in notes:
+            out.append(f"* {name} [{t}]: {n}")
+    return "\n".join(out) + "\n"
+
+
+def generate_supported_exprs_csv() -> str:
+    rows = ["Expression,Context,Supported,Types"]
+    for r in expression_inventory():
+        types = ";".join(t for t in TYPE_COLUMNS if r["types"][t])
+        sup = "S" if r["device"] else "CO"  # CO = CPU-only, ref notation
+        rows.append(f"{r['name']},{r['context']},{sup},{types}")
+    return "\n".join(rows) + "\n"
+
+
+def generate_operators_score_csv() -> str:
+    rows = ["CPUOperator,Score"]
+    for r in exec_inventory():
+        if r["is_tpu"]:
+            rows.append(f"{r['name']},{r['score']}")
+    return "\n".join(rows) + "\n"
+
+
+def write_all(repo_root: str) -> List[str]:
+    import os
+    from ..config import generate_docs as config_docs
+    docs = os.path.join(repo_root, "docs")
+    gen = os.path.join(repo_root, "tools", "generated_files")
+    os.makedirs(docs, exist_ok=True)
+    os.makedirs(gen, exist_ok=True)
+    written = []
+    for path, content in [
+            (os.path.join(docs, "supported_ops.md"),
+             generate_supported_ops_md()),
+            (os.path.join(docs, "configs.md"), config_docs()),
+            (os.path.join(gen, "supportedExprs.csv"),
+             generate_supported_exprs_csv()),
+            (os.path.join(gen, "operatorsScore.csv"),
+             generate_operators_score_csv())]:
+        with open(path, "w") as f:
+            f.write(content)
+        written.append(path)
+    return written
+
+
+if __name__ == "__main__":
+    import sys
+    root = sys.argv[1] if len(sys.argv) > 1 else "."
+    for p in write_all(root):
+        print("wrote", p)

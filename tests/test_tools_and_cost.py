@@ -1,0 +1,67 @@
+"""Cost-based optimizer (ref CostBasedOptimizer.scala) and supported-ops
+doc/CSV generation (ref TypeChecks.scala SupportedOpsDocs/SupportedOpsForTools)."""
+import pytest
+
+from harness import assert_tpu_and_cpu_equal, tpu_session
+from data_gen import IntGen, gen_df
+from spark_rapids_tpu.api import functions as F
+
+
+def _q(s):
+    df = s.create_dataframe(gen_df({"k": IntGen(lo=0, hi=9),
+                                    "v": IntGen()}, n=256))
+    return df.filter(F.col("v") > 0).group_by("k").agg(
+        F.count_star().with_name("n"))
+
+
+def test_cost_optimizer_reverts_when_device_expensive():
+    s = tpu_session({
+        "spark.rapids.tpu.sql.optimizer.enabled": True,
+        "spark.rapids.tpu.sql.optimizer.tpu.exec.defaultRowCost": 100.0,
+        "spark.rapids.tpu.sql.optimizer.transition.cost": 100.0,
+    })
+    tree = _q(s)._physical().tree_string()
+    assert "Cpu" in tree, tree
+
+
+def test_cost_optimizer_keeps_device_when_cheap():
+    s = tpu_session({
+        "spark.rapids.tpu.sql.optimizer.enabled": True,
+    })
+    tree = _q(s)._physical().tree_string()
+    assert "CpuAggregate" not in tree and "CpuFilter" not in tree, tree
+
+
+def test_cost_optimizer_results_still_correct():
+    assert_tpu_and_cpu_equal(
+        _q, conf={"spark.rapids.tpu.sql.optimizer.enabled": True,
+                  "spark.rapids.tpu.sql.optimizer.tpu.exec.defaultRowCost": 100.0})
+
+
+def test_supported_ops_doc_generation():
+    from spark_rapids_tpu.tools import (generate_supported_ops_md,
+                                        generate_operators_score_csv,
+                                        generate_supported_exprs_csv)
+    md = generate_supported_ops_md()
+    assert "TpuHashJoinExec" in md and "Cast" in md
+    assert md == generate_supported_ops_md(), "generation not deterministic"
+    score = generate_operators_score_csv()
+    assert "CPUOperator,Score" in score and "TpuSortExec" in score
+    csv = generate_supported_exprs_csv()
+    assert csv.count("\n") > 100, "expression inventory suspiciously small"
+
+
+def test_expression_inventory_marks_host_only():
+    from spark_rapids_tpu.tools import expression_inventory
+    inv = {r["name"]: r for r in expression_inventory()}
+    assert inv["Add"]["device"]
+    # string functions run on host columns (honest fallback tagging)
+    assert any(r["module"] == "string_fns" for r in inv.values())
+
+
+def test_config_docs_cover_registry():
+    from spark_rapids_tpu.config import all_entries, generate_docs
+    docs = generate_docs()
+    for e in all_entries():
+        if not e.internal:
+            assert e.key in docs, e.key
