@@ -81,6 +81,20 @@ class TpuSession:
         schema = parquet_schema(files[0])
         return DataFrame(self, L.ParquetScan(files, schema, columns))
 
+    def read_orc(self, *paths: str,
+                 columns: Optional[List[str]] = None) -> "DataFrame":
+        from ..io.orc import expand_orc_paths, orc_schema
+        files = expand_orc_paths(paths)
+        return DataFrame(self, L.OrcScan(files, orc_schema(files[0]),
+                                         columns))
+
+    def read_avro(self, *paths: str,
+                  columns: Optional[List[str]] = None) -> "DataFrame":
+        from ..io.avro import avro_schema, expand_avro_paths
+        files = expand_avro_paths(paths)
+        return DataFrame(self, L.AvroScan(files, avro_schema(files[0]),
+                                          columns))
+
     def read_csv(self, *paths: str, schema=None, header=True) -> "DataFrame":
         from ..io.text import csv_to_tables
         tables, sch = csv_to_tables(paths, schema, header)
@@ -302,6 +316,20 @@ class DataFrame:
                       partition_by: Sequence[str] = ()):
         df = DataFrame(self.session,
                        L.WriteFile(path, "parquet", self.plan, mode,
+                                   partition_by))
+        return df.collect_arrow()
+
+    def write_orc(self, path: str, mode: str = "overwrite",
+                  partition_by: Sequence[str] = ()):
+        df = DataFrame(self.session,
+                       L.WriteFile(path, "orc", self.plan, mode,
+                                   partition_by))
+        return df.collect_arrow()
+
+    def write_csv(self, path: str, mode: str = "overwrite",
+                  partition_by: Sequence[str] = ()):
+        df = DataFrame(self.session,
+                       L.WriteFile(path, "csv", self.plan, mode,
                                    partition_by))
         return df.collect_arrow()
 

@@ -1,0 +1,133 @@
+"""Shared file-scan machinery: the reference's three reader strategies
+(ref GpuParquetScan.scala — ParquetPartitionReader PERFILE :2750,
+MultiFileParquetPartitionReader COALESCING :1867,
+MultiFileCloudParquetPartitionReader MULTITHREADED :2063; the same trio is
+reused by GpuOrcScan.scala and GpuAvroScan.scala).
+
+Each format subclass supplies ``_read_table(path) -> pyarrow.Table`` (host
+decode — the CPU-side role the reference's footer/stripe/block parsing
+plays) and the base turns tables into device batches:
+  * PERFILE       — one host read + H2D per file;
+  * COALESCING    — stitch small files' tables to target size, one H2D per
+                    coalesced table;
+  * MULTITHREADED — background host reads on a thread pool feeding the
+                    device in file order.
+"""
+from __future__ import annotations
+
+import concurrent.futures as cf
+import glob as _glob
+import os
+from typing import Iterator, List, Optional
+
+from ..columnar import ColumnarBatch
+from ..config import MULTITHREADED_READ_THREADS, TpuConf
+from ..exec.base import ESSENTIAL, ExecContext, TpuExec
+from ..types import Schema
+
+__all__ = ["FileScanBase", "expand_paths"]
+
+
+def expand_paths(paths) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for f in sorted(os.listdir(p)):
+                if not f.startswith((".", "_")):
+                    out.append(os.path.join(p, f))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(_glob.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no input files for {paths}")
+    return out
+
+
+class FileScanBase(TpuExec):
+    FORMAT = "file"
+    READER_TYPE_KEY = None  # ConfEntry; None -> AUTO resolution only
+
+    def __init__(self, paths: List[str], schema: Schema,
+                 columns: Optional[List[str]], conf: TpuConf,
+                 predicate=None):
+        super().__init__([])
+        self.paths = paths
+        self._schema = schema
+        self.columns = columns
+        self.conf = conf
+        self.predicate = predicate
+        mode = "AUTO"
+        if self.READER_TYPE_KEY is not None:
+            mode = str(conf.get(self.READER_TYPE_KEY)).upper()
+        if mode == "AUTO":
+            mode = "MULTITHREADED" if len(paths) > 1 else "PERFILE"
+        self.mode = mode
+
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def _read_table(self, path: str):
+        raise NotImplementedError
+
+    # ------------------------------------------------------------- modes
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        rows_m = ctx.metric(self._exec_id, "numOutputRows", ESSENTIAL)
+        files_m = ctx.metric(self._exec_id, "numFiles")
+        files_m.add(len(self.paths))
+        batch_rows = ctx.conf.batch_size_rows
+
+        if self.mode == "COALESCING":
+            yield from self._coalescing(ctx, rows_m, batch_rows)
+            return
+        if self.mode == "MULTITHREADED":
+            yield from self._multithreaded(ctx, rows_m, batch_rows)
+            return
+        # PERFILE
+        for pid, path in enumerate(self.paths):
+            t = self._read_table(path)
+            yield from self._emit(ctx, t, rows_m, batch_rows,
+                                  input_file=path, pid=pid)
+
+    def _emit(self, ctx, table, rows_m, batch_rows, input_file=None, pid=0):
+        off = 0
+        n = table.num_rows
+        while off < n or (n == 0 and off == 0):
+            chunk = table.slice(off, batch_rows)
+            with ctx.semaphore.held():
+                b = ColumnarBatch.from_arrow(chunk)
+            b.meta = {"partition_id": pid, "input_file": input_file}
+            rows_m.add(b.num_rows)
+            yield b
+            off += batch_rows
+            if n == 0:
+                break
+
+    def _coalescing(self, ctx, rows_m, batch_rows):
+        import pyarrow as pa
+        pending, rows = [], 0
+        for path in self.paths:
+            t = self._read_table(path)
+            pending.append(t)
+            rows += t.num_rows
+            if rows >= batch_rows:
+                yield from self._emit(ctx, pa.concat_tables(pending),
+                                      rows_m, batch_rows)
+                pending, rows = [], 0
+        if pending:
+            yield from self._emit(ctx, pa.concat_tables(pending),
+                                  rows_m, batch_rows)
+
+    def _multithreaded(self, ctx, rows_m, batch_rows):
+        nthreads = int(self.conf.get(MULTITHREADED_READ_THREADS))
+        with cf.ThreadPoolExecutor(max_workers=nthreads) as pool:
+            futures = [pool.submit(self._read_table, p) for p in self.paths]
+            for pid, fut in enumerate(futures):  # file order; reads overlap
+                yield from self._emit(ctx, fut.result(), rows_m, batch_rows,
+                                      input_file=self.paths[pid], pid=pid)
+
+    def describe(self):
+        name = type(self).__name__.replace("Exec", "")
+        return (f"{name}[{len(self.paths)} files, {self.mode}"
+                + (f", pushdown={self.predicate.name_hint}" if self.predicate
+                   else "") + "]")

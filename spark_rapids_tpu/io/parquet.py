@@ -15,16 +15,13 @@ mirrors the reference's CPU-side filterBlocks (:670).
 """
 from __future__ import annotations
 
-import concurrent.futures as cf
 import glob
 import os
-from typing import Iterator, List, Optional, Sequence
+from typing import List, Optional, Sequence
 
-from ..columnar import ColumnarBatch
-from ..config import (MULTITHREADED_READ_THREADS, PARQUET_READER_TYPE,
-                      TpuConf)
-from ..exec.base import ESSENTIAL, ExecContext, TpuExec
+from ..config import PARQUET_READER_TYPE
 from ..types import Schema, StructField, from_arrow
+from .file_scan import FileScanBase
 
 __all__ = ["ParquetScanExec", "parquet_schema", "expand_paths"]
 
@@ -51,23 +48,9 @@ def parquet_schema(path: str) -> Schema:
                    for f in sch])
 
 
-class ParquetScanExec(TpuExec):
-    def __init__(self, paths: List[str], schema: Schema,
-                 columns: Optional[List[str]], conf: TpuConf,
-                 predicate=None):
-        super().__init__([])
-        self.paths = paths
-        self._schema = schema
-        self.columns = columns
-        self.conf = conf
-        self.predicate = predicate  # row-group pruning expression (optional)
-        mode = str(conf.get(PARQUET_READER_TYPE)).upper()
-        if mode == "AUTO":
-            mode = "MULTITHREADED" if len(paths) > 1 else "PERFILE"
-        self.mode = mode
-
-    def output_schema(self) -> Schema:
-        return self._schema
+class ParquetScanExec(FileScanBase):
+    FORMAT = "parquet"
+    READER_TYPE_KEY = PARQUET_READER_TYPE
 
     # ---------------------------------------------------------- reading
     def _read_table(self, path: str):
@@ -82,6 +65,8 @@ class ParquetScanExec(TpuExec):
                 t = t.select(self.columns)
         else:
             t = f.read_row_groups(groups, columns=self.columns)
+        if self.columns:
+            t = t.select(self.columns)  # requested order, not file order
         return t
 
     def _filter_row_groups(self, f) -> Optional[List[int]]:
@@ -104,71 +89,6 @@ class ParquetScanExec(TpuExec):
             return keep
         except Exception:
             return None  # stats unusable -> read everything
-
-    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
-        rows_m = ctx.metric(self._exec_id, "numOutputRows", ESSENTIAL)
-        files_m = ctx.metric(self._exec_id, "numFiles")
-        files_m.add(len(self.paths))
-        batch_rows = ctx.conf.batch_size_rows
-
-        if self.mode == "COALESCING":
-            yield from self._coalescing(ctx, rows_m, batch_rows)
-            return
-        if self.mode == "MULTITHREADED":
-            yield from self._multithreaded(ctx, rows_m, batch_rows)
-            return
-        # PERFILE
-        for pid, path in enumerate(self.paths):
-            t = self._read_table(path)
-            yield from self._emit(ctx, t, rows_m, batch_rows,
-                                  input_file=path, pid=pid)
-
-    def _emit(self, ctx, table, rows_m, batch_rows, input_file=None, pid=0):
-        off = 0
-        n = table.num_rows
-        while off < n or (n == 0 and off == 0):
-            chunk = table.slice(off, batch_rows)
-            with ctx.semaphore.held():
-                b = ColumnarBatch.from_arrow(chunk)
-            b.meta = {"partition_id": pid, "input_file": input_file}
-            rows_m.add(b.num_rows)
-            yield b
-            off += batch_rows
-            if n == 0:
-                break
-
-    def _coalescing(self, ctx, rows_m, batch_rows):
-        """Stitch small files' tables into target-size host buffers, then one
-        H2D per coalesced table (ref MultiFileParquetPartitionReader)."""
-        import pyarrow as pa
-        pending, rows = [], 0
-        for path in self.paths:
-            t = self._read_table(path)
-            pending.append(t)
-            rows += t.num_rows
-            if rows >= batch_rows:
-                yield from self._emit(ctx, pa.concat_tables(pending),
-                                      rows_m, batch_rows)
-                pending, rows = [], 0
-        if pending:
-            yield from self._emit(ctx, pa.concat_tables(pending),
-                                  rows_m, batch_rows)
-
-    def _multithreaded(self, ctx, rows_m, batch_rows):
-        """Background host reads feeding the device in order
-        (ref MultiFileCloudParquetPartitionReader + thread pool
-        Plugin.scala:269-281)."""
-        nthreads = int(self.conf.get(MULTITHREADED_READ_THREADS))
-        with cf.ThreadPoolExecutor(max_workers=nthreads) as pool:
-            futures = [pool.submit(self._read_table, p) for p in self.paths]
-            for pid, fut in enumerate(futures):  # preserve file order; reads overlap
-                yield from self._emit(ctx, fut.result(), rows_m, batch_rows,
-                                      input_file=self.paths[pid], pid=pid)
-
-    def describe(self):
-        return (f"ParquetScan[{len(self.paths)} files, {self.mode}"
-                + (f", pushdown={self.predicate.name_hint}" if self.predicate
-                   else "") + "]")
 
 
 def _maybe_matches(pred, stats) -> bool:

@@ -68,6 +68,14 @@ class ParquetScan(LogicalPlan):
             return self._schema
         return Schema([self._schema[c] for c in self.columns])
 
+
+class OrcScan(ParquetScan):
+    """ORC file source (ref GpuOrcScan.scala)."""
+
+
+class AvroScan(ParquetScan):
+    """Avro file source (ref GpuAvroScan.scala)."""
+
     def describe(self):
         return f"ParquetScan[{len(self.paths)} files]"
 

@@ -93,6 +93,26 @@ class ParquetScanMeta(PlanMeta):
     convert_to_cpu = convert_to_tpu
 
 
+@rule(L.OrcScan)
+class OrcScanMeta(PlanMeta):
+    def convert_to_tpu(self, children):
+        from ..io.orc import OrcScanExec
+        return OrcScanExec(self.plan.paths, self.plan.schema(),
+                           self.plan.columns, self.conf)
+
+    convert_to_cpu = convert_to_tpu
+
+
+@rule(L.AvroScan)
+class AvroScanMeta(PlanMeta):
+    def convert_to_tpu(self, children):
+        from ..io.avro import AvroScanExec
+        return AvroScanExec(self.plan.paths, self.plan.schema(),
+                            self.plan.columns, self.conf)
+
+    convert_to_cpu = convert_to_tpu
+
+
 @rule(L.Project)
 class ProjectMeta(PlanMeta):
     def tag_self(self):
