@@ -416,3 +416,17 @@ def broadcast(df):
     """Mark a DataFrame as broadcastable for its next join (Spark's
     functions.broadcast; selects TpuBroadcastHashJoinExec in the planner)."""
     return df.hint("broadcast")
+
+
+def udf(fn=None, return_type=None, compile: bool = True):
+    """Python UDF: bytecode-compiled into the device plan when possible
+    (ref udf-compiler), else row-based host fallback."""
+    from ..udf import udf as _udf
+    return _udf(fn, return_type, compile)
+
+
+def columnar_udf(impl, *cols):
+    """Hand-written columnar device UDF (ref RapidsUDF.java)."""
+    from ..udf import ColumnarUDFExpr
+    from .functions import _to_expr
+    return ColumnarUDFExpr(impl, [_to_expr(c) for c in cols])

@@ -1,0 +1,16 @@
+"""UDF subsystem (ref udf-compiler/ + GpuUserDefinedFunction.scala).
+
+Two paths, mirroring the reference:
+  * bytecode compiler — Python-function bytecode symbolically executed into
+    the Expression IR so the UDF fuses into the device plan (the analog of
+    udf-compiler's Scala-bytecode -> Catalyst translation,
+    CatalystExpressionBuilder.scala:66); silent fallback on anything it
+    cannot prove (LogicalPlanRules.scala keeps the original UDF the same way)
+  * hand-written columnar UDFs — ``TpuUDF`` (the RapidsUDF.java analog):
+    the user supplies a device columnar kernel directly.
+"""
+from .compiler import compile_udf, CompileError
+from .runtime import PythonUDF, TpuUDF, ColumnarUDFExpr, udf
+
+__all__ = ["compile_udf", "CompileError", "PythonUDF", "TpuUDF",
+           "ColumnarUDFExpr", "udf"]

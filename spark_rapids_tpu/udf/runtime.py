@@ -1,0 +1,130 @@
+"""UDF runtime expressions + the user-facing ``udf`` factory.
+
+* ``PythonUDF`` — row-based host evaluation, the reference's un-compiled
+  ScalaUDF path (GpuUserDefinedFunction falls back to row-by-row on CPU when
+  there is no columnar implementation). Tagged host-only so the planner
+  reports the fallback honestly.
+* ``TpuUDF`` / ``ColumnarUDFExpr`` — the RapidsUDF.java analog: the user
+  supplies a columnar device kernel (jax arrays in, jax array out) that runs
+  fused inside the projection.
+* ``udf(fn)`` — tries the bytecode compiler first
+  (``spark.rapids.tpu.sql.udfCompiler.enabled``, ref Plugin.scala:122-128),
+  silently falling back to PythonUDF like the reference's LogicalPlanRules.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from ..config import UDF_COMPILER_ENABLED  # noqa: F401 (re-export)
+from ..exprs.base import DVal, EvalContext, Expression, Literal
+from ..types import DataType, FLOAT64, Schema, TypeSig, tpuNative
+
+log = logging.getLogger(__name__)
+
+__all__ = ["PythonUDF", "TpuUDF", "ColumnarUDFExpr", "udf"]
+
+
+class PythonUDF(Expression):
+    """Row-at-a-time host UDF (None-aware: null inputs pass through as
+    Python None, a raised exception fails the query — Spark semantics)."""
+
+    #: host-only: never claims device support
+    device_type_sig = TypeSig.none()
+
+    def __init__(self, fn: Callable, children: List[Expression],
+                 return_type: Optional[DataType] = None, name: str = None):
+        self.fn = fn
+        self.children = list(children)
+        self._return_type = return_type or FLOAT64
+        self._name = name or getattr(fn, "__name__", "udf")
+
+    def data_type(self, schema: Schema) -> DataType:
+        return self._return_type
+
+    def device_unsupported_reason(self, schema: Schema) -> Optional[str]:
+        return f"PythonUDF {self._name} is row-based host-only"
+
+    def eval_host(self, batch):
+        import pyarrow as pa
+        from ..types import to_arrow
+        cols = [c.eval_host(batch) for c in self.children]
+        pys = [c.to_pylist() for c in cols]
+        out = [self.fn(*vals) for vals in zip(*pys)] if pys else \
+            [self.fn() for _ in range(batch.num_rows)]
+        return pa.array(out, type=to_arrow(self._return_type))
+
+    def key(self):
+        kids = ",".join(c.key() for c in self.children)
+        return f"PythonUDF[{self._name}@{id(self.fn):x}]({kids})"
+
+    @property
+    def name_hint(self):
+        return f"{self._name}(...)"
+
+
+class TpuUDF:
+    """Columnar device UDF contract (ref RapidsUDF.java:22): subclass and
+    implement ``evaluate_columnar`` over jax data/validity arrays."""
+
+    #: declared result type
+    return_type: DataType = FLOAT64
+
+    def evaluate_columnar(self, *cols: DVal) -> DVal:
+        raise NotImplementedError
+
+
+class ColumnarUDFExpr(Expression):
+    """Wraps a TpuUDF instance as an expression node; runs fused inside the
+    device projection (ref GpuUserDefinedFunction columnar dispatch)."""
+
+    device_type_sig = tpuNative
+
+    def __init__(self, impl: TpuUDF, children: List[Expression]):
+        self.impl = impl
+        self.children = list(children)
+
+    def data_type(self, schema: Schema) -> DataType:
+        return self.impl.return_type
+
+    def eval_device(self, ctx: EvalContext) -> DVal:
+        ins = [c.eval_device(ctx) for c in self.children]
+        return self.impl.evaluate_columnar(*ins)
+
+    def key(self):
+        kids = ",".join(c.key() for c in self.children)
+        return f"ColumnarUDF[{type(self.impl).__name__}]({kids})"
+
+
+class _UdfCallable:
+    def __init__(self, fn, return_type, enabled: bool):
+        self.fn = fn
+        self.return_type = return_type
+        self.enabled = enabled
+        self.last_compiled: Optional[bool] = None
+
+    def __call__(self, *cols) -> Expression:
+        from ..api.functions import _to_expr
+        args = [c if isinstance(c, Expression) else _to_expr(c)
+                for c in cols]
+        if self.enabled:
+            from .compiler import CompileError, compile_udf
+            try:
+                out = compile_udf(self.fn, args)
+                self.last_compiled = True
+                return out
+            except CompileError as e:
+                log.debug("udf %s not compiled (%s); host fallback",
+                          getattr(self.fn, "__name__", "?"), e)
+        self.last_compiled = False
+        return PythonUDF(self.fn, args, self.return_type)
+
+
+def udf(fn=None, return_type: Optional[DataType] = None,
+        compile: bool = True):
+    """Decorator/factory: ``F.udf(lambda x: x + 1)(F.col("a"))``."""
+    if fn is None:
+        return lambda f: udf(f, return_type, compile)
+    return _UdfCallable(fn, return_type, compile)
