@@ -95,6 +95,15 @@ class TpuSession:
         return DataFrame(self, L.AvroScan(files, avro_schema(files[0]),
                                           columns))
 
+    def read_delta(self, path: str, columns: Optional[List[str]] = None,
+                   version: Optional[int] = None) -> "DataFrame":
+        from ..delta import DeltaTable
+        return DeltaTable(self, path).to_df(columns, version)
+
+    def delta_table(self, path: str):
+        from ..delta import DeltaTable
+        return DeltaTable(self, path)
+
     def read_csv(self, *paths: str, schema=None, header=True) -> "DataFrame":
         from ..io.text import csv_to_tables
         tables, sch = csv_to_tables(paths, schema, header)
@@ -318,6 +327,11 @@ class DataFrame:
                        L.WriteFile(path, "parquet", self.plan, mode,
                                    partition_by))
         return df.collect_arrow()
+
+    def write_delta(self, path: str, mode: str = "overwrite",
+                    partition_by: Sequence[str] = ()):
+        from ..delta.table import write_delta
+        write_delta(self.session, self, path, mode, partition_by)
 
     def write_orc(self, path: str, mode: str = "overwrite",
                   partition_by: Sequence[str] = ()):

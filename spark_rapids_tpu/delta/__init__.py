@@ -1,0 +1,18 @@
+"""Delta Lake support (ref delta-lake/ module, ~35k LoC across
+delta-20x..24x: GpuDeltaLog.scala, GpuOptimisticTransactionBase.scala,
+GpuDeltaParquetFileFormat*.scala, GpuStatisticsCollection.scala,
+GpuDeleteCommand.scala, GpuUpdateCommand.scala, GpuMergeIntoCommand.scala,
+zorder/ZOrderRules.scala).
+
+TPU-native re-design: the transaction log is pure host-side bookkeeping
+(ported as idiomatic Python over the open Delta protocol), while the data
+path — scans with file skipping + deletion-vector row filtering, rewrite
+kernels for DELETE/UPDATE/MERGE, Z-order interleave — runs through the same
+device exec/expression machinery as every other query.
+"""
+from .log import DeltaLog, Snapshot, AddFile, RemoveFile, Metadata
+from .table import DeltaTable
+from .zorder import InterleaveBits
+
+__all__ = ["DeltaLog", "Snapshot", "AddFile", "RemoveFile", "Metadata",
+           "DeltaTable", "InterleaveBits"]

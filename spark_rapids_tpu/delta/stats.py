@@ -1,0 +1,69 @@
+"""Per-file statistics collection on write (ref
+GpuStatisticsCollection.scala — numRecords/minValues/maxValues/nullCount
+computed on the device batch before it is written, used later for data
+skipping in GpuDeltaParquetFileFormat scans)."""
+from __future__ import annotations
+
+import json
+import math
+from typing import Optional
+
+__all__ = ["collect_stats", "file_matches"]
+
+
+def _json_safe(v):
+    import datetime
+
+    import numpy as np
+    if v is None:
+        return None
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        f = float(v)
+        return None if math.isnan(f) else f
+    if isinstance(v, float) and math.isnan(v):
+        return None
+    if isinstance(v, (np.bool_,)):
+        return bool(v)
+    if isinstance(v, (datetime.date, datetime.datetime)):
+        return v.isoformat()
+    if isinstance(v, bytes):
+        return None  # binary min/max not collected (matches delta)
+    return v
+
+
+def collect_stats(table) -> str:
+    """Stats JSON for one written file from its Arrow table."""
+    import pyarrow.compute as pc
+    mins, maxs, nulls = {}, {}, {}
+    for name in table.column_names:
+        col = table.column(name)
+        nulls[name] = col.null_count
+        try:
+            if col.length() - col.null_count > 0:
+                mm = pc.min_max(col)
+                mins[name] = _json_safe(mm["min"].as_py())
+                maxs[name] = _json_safe(mm["max"].as_py())
+        except Exception:
+            pass  # non-orderable type: skip min/max, keep nullCount
+    return json.dumps({"numRecords": table.num_rows, "minValues": mins,
+                       "maxValues": maxs, "nullCount": nulls})
+
+
+def file_matches(stats_json: Optional[str], pred) -> bool:
+    """Conservative data skipping: False only when the predicate provably
+    excludes every row of the file (ref delta data skipping consumed by the
+    GPU scan). Reuses the parquet row-group interval logic."""
+    if not stats_json or pred is None:
+        return True
+    try:
+        st = json.loads(stats_json)
+    except Exception:
+        return True
+    mins = st.get("minValues") or {}
+    maxs = st.get("maxValues") or {}
+    stats = {k: (mins[k], maxs[k]) for k in mins if k in maxs
+             and mins[k] is not None and maxs[k] is not None}
+    from ..io.parquet import _maybe_matches
+    return _maybe_matches(pred, stats)

@@ -67,6 +67,11 @@ class FileScanBase(TpuExec):
     def output_schema(self) -> Schema:
         return self._schema
 
+    def set_predicate(self, pred) -> None:
+        """Planner pushdown hook (skipping is conservative; the filter above
+        still runs)."""
+        self.predicate = pred
+
     def _read_table(self, path: str):
         raise NotImplementedError
 
@@ -96,7 +101,8 @@ class FileScanBase(TpuExec):
             chunk = table.slice(off, batch_rows)
             with ctx.semaphore.held():
                 b = ColumnarBatch.from_arrow(chunk)
-            b.meta = {"partition_id": pid, "input_file": input_file}
+            b.meta = {"partition_id": pid, "input_file": input_file,
+                      "row_offset": off}
             rows_m.add(b.num_rows)
             yield b
             off += batch_rows

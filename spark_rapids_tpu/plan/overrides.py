@@ -140,10 +140,25 @@ class FilterMeta(PlanMeta):
             self.will_not_work_on_tpu(f"filter condition: {r}")
 
     def convert_to_tpu(self, children):
+        self._push_down_predicate(children[0])
         return B.TpuFilterExec(self.plan.condition, children[0])
 
     def convert_to_cpu(self, children):
+        self._push_down_predicate(children[0])
         return B.CpuFilterExec(self.plan.condition, children[0])
+
+    def _push_down_predicate(self, child_exec):
+        """Predicate pushdown into file scans for row-group / delta-file
+        skipping (ref GpuParquetScan filterBlocks:670 + delta data
+        skipping). The filter itself still runs — pruning is conservative,
+        so this is purely an IO reduction."""
+        from ..io.file_scan import FileScanBase
+        if (isinstance(child_exec, FileScanBase)
+                and child_exec.predicate is None):
+            cond = self.plan.condition
+            names = set(child_exec.output_schema().names())
+            if set(cond.references()) <= names:
+                child_exec.set_predicate(cond)
 
 
 @rule(L.Aggregate)

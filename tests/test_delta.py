@@ -1,0 +1,285 @@
+"""Delta Lake module tests (ref delta-lake/ + integration_tests
+delta_lake_*_test.py, delta_zorder_test.py)."""
+import json
+import os
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from harness import tpu_session
+from data_gen import DoubleGen, IntGen, gen_df
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.exprs import ColumnRef, Literal, GreaterThan
+
+
+def _make_table(s, path, n=500, files=3):
+    for i in range(files):
+        t = pa.table(gen_df({"k": IntGen(lo=0, hi=50, nullable=False),
+                             "v": IntGen(nullable=False),
+                             "w": DoubleGen(nullable=False)}, n=n,
+                            seed=20 + i))
+        s.create_dataframe(t).write_delta(
+            str(path), mode="overwrite" if i == 0 else "append")
+    return s.delta_table(str(path))
+
+
+def test_delta_write_read_roundtrip(tmp_path):
+    s = tpu_session()
+    t = pa.table(gen_df({"a": IntGen(), "b": DoubleGen()}, n=400))
+    s.create_dataframe(t).write_delta(str(tmp_path / "t"))
+    back = s.read_delta(str(tmp_path / "t")).to_pandas()
+    exp = t.to_pandas()
+    pd.testing.assert_frame_equal(
+        back.sort_values(["a", "b"]).reset_index(drop=True),
+        exp.sort_values(["a", "b"]).reset_index(drop=True))
+
+
+def test_delta_append_and_log_versions(tmp_path):
+    s = tpu_session()
+    dt = _make_table(s, tmp_path / "t", n=100, files=3)
+    assert dt.log.version() == 2
+    assert s.read_delta(str(tmp_path / "t")).count() == 300
+    hist = dt.history()
+    assert len(hist) == 3 and hist[0]["version"] == 2
+
+
+def test_delta_time_travel(tmp_path):
+    s = tpu_session()
+    _make_table(s, tmp_path / "t", n=100, files=3)
+    assert s.read_delta(str(tmp_path / "t"), version=0).count() == 100
+    assert s.read_delta(str(tmp_path / "t"), version=1).count() == 200
+
+
+def test_delta_stats_file_skipping(tmp_path):
+    s = tpu_session()
+    # two files with disjoint key ranges
+    s.create_dataframe(pa.table({"k": list(range(0, 100))})).write_delta(
+        str(tmp_path / "t"))
+    s.create_dataframe(pa.table({"k": list(range(1000, 1100))})).write_delta(
+        str(tmp_path / "t"), mode="append")
+    df = s.read_delta(str(tmp_path / "t")).filter(F.col("k") >= 1000)
+    phys = df._physical()
+    tree = phys.tree_string()
+    assert "+1 skipped" in tree, tree
+    assert df.count() == 100
+
+
+def test_delta_delete_rewrite(tmp_path):
+    s = tpu_session()
+    dt = _make_table(s, tmp_path / "t")
+    before = s.read_delta(str(tmp_path / "t")).to_pandas()
+    res = dt.delete(GreaterThan(ColumnRef("k"), Literal(25)))
+    after = s.read_delta(str(tmp_path / "t")).to_pandas()
+    assert res["num_deleted_rows"] == int((before["k"] > 25).sum())
+    assert (after["k"] <= 25).all()
+    assert len(after) == int((before["k"] <= 25).sum())
+
+
+def test_delta_delete_with_deletion_vectors(tmp_path):
+    s = tpu_session()
+    dt = _make_table(s, tmp_path / "t", files=2)
+    before = s.read_delta(str(tmp_path / "t")).to_pandas()
+    res = dt.delete(GreaterThan(ColumnRef("k"), Literal(30)),
+                    use_deletion_vectors=True)
+    snap = dt.log.snapshot()
+    assert any(a.deletion_vector for a in snap.files.values())
+    after = s.read_delta(str(tmp_path / "t")).to_pandas()
+    assert (after["k"] <= 30).all()
+    assert len(after) == len(before) - res["num_deleted_rows"]
+
+
+def test_delta_update(tmp_path):
+    s = tpu_session()
+    dt = _make_table(s, tmp_path / "t", files=2)
+    before = s.read_delta(str(tmp_path / "t")).to_pandas()
+    from spark_rapids_tpu.exprs import Add, Multiply
+    res = dt.update(GreaterThan(ColumnRef("k"), Literal(10)),
+                    {"v": Multiply(ColumnRef("v"), Literal(2))})
+    after = s.read_delta(str(tmp_path / "t")).to_pandas()
+    b = before.sort_values(["k", "w"]).reset_index(drop=True)
+    a = after.sort_values(["k", "w"]).reset_index(drop=True)
+    exp = np.where(b["k"] > 10, b["v"] * 2, b["v"])
+    np.testing.assert_array_equal(a["v"].to_numpy(), exp)
+    assert res["num_updated_rows"] == int((before["k"] > 10).sum())
+
+
+def test_delta_merge_update_insert_delete(tmp_path):
+    s = tpu_session()
+    target = pa.table({"k": [1, 2, 3, 4], "v": [10, 20, 30, 40]})
+    s.create_dataframe(target).write_delta(str(tmp_path / "t"))
+    dt = s.delta_table(str(tmp_path / "t"))
+    source = s.create_dataframe(
+        pa.table({"sk": [2, 4, 9], "sv": [200, 400, 900]}))
+    from spark_rapids_tpu.exprs import EqualTo
+    stats = (dt.merge(source, EqualTo(ColumnRef("k"), ColumnRef("sk")))
+             .when_matched_update({"v": ColumnRef("sv")})
+             .when_not_matched_insert({"k": ColumnRef("sk"),
+                                       "v": ColumnRef("sv")})
+             .execute())
+    out = s.read_delta(str(tmp_path / "t")).to_pandas().sort_values("k")
+    assert out["k"].tolist() == [1, 2, 3, 4, 9]
+    assert out["v"].tolist() == [10, 200, 30, 400, 900]
+    assert stats["num_updated"] == 2 and stats["num_inserted"] == 1
+
+
+def test_delta_merge_delete_clause(tmp_path):
+    s = tpu_session()
+    s.create_dataframe(pa.table({"k": [1, 2, 3], "v": [1, 2, 3]})
+                       ).write_delta(str(tmp_path / "t"))
+    dt = s.delta_table(str(tmp_path / "t"))
+    src = s.create_dataframe(pa.table({"sk": [2]}))
+    from spark_rapids_tpu.exprs import EqualTo
+    stats = (dt.merge(src, EqualTo(ColumnRef("k"), ColumnRef("sk")))
+             .when_matched_delete().execute())
+    out = s.read_delta(str(tmp_path / "t")).to_pandas().sort_values("k")
+    assert out["k"].tolist() == [1, 3]
+    assert stats["num_deleted"] == 1
+
+
+def test_delta_merge_multiple_match_errors(tmp_path):
+    s = tpu_session()
+    s.create_dataframe(pa.table({"k": [1], "v": [1]})).write_delta(
+        str(tmp_path / "t"))
+    dt = s.delta_table(str(tmp_path / "t"))
+    src = s.create_dataframe(pa.table({"sk": [1, 1], "sv": [7, 8]}))
+    from spark_rapids_tpu.exprs import EqualTo
+    with pytest.raises(ValueError, match="multiple source rows"):
+        (dt.merge(src, EqualTo(ColumnRef("k"), ColumnRef("sk")))
+         .when_matched_update({"v": ColumnRef("sv")}).execute())
+
+
+def test_delta_optimize_compaction(tmp_path):
+    s = tpu_session()
+    dt = _make_table(s, tmp_path / "t", n=100, files=3)
+    before = s.read_delta(str(tmp_path / "t")).to_pandas()
+    res = dt.optimize()
+    assert res["files_removed"] == 3 and res["files_added"] == 1
+    after = s.read_delta(str(tmp_path / "t")).to_pandas()
+    assert len(after) == len(before)
+
+
+def test_delta_zorder(tmp_path):
+    s = tpu_session()
+    rng = np.random.RandomState(4)
+    t = pa.table({"x": rng.randint(0, 1 << 20, 4000),
+                  "y": rng.randint(0, 1 << 20, 4000),
+                  "p": rng.standard_normal(4000)})
+    s.create_dataframe(t).write_delta(str(tmp_path / "t"))
+    dt = s.delta_table(str(tmp_path / "t"))
+    res = dt.optimize(target_file_rows=1000, zorder_by=["x", "y"])
+    assert res["files_added"] == 4
+    # z-ordering clusters: each output file's x-range should be much
+    # narrower than the global range on average
+    snap = dt.log.snapshot()
+    spans = []
+    for a in snap.files.values():
+        st = json.loads(a.stats)
+        spans.append(st["maxValues"]["x"] - st["minValues"]["x"])
+    assert np.mean(spans) < (1 << 20) * 0.9
+    out = s.read_delta(str(tmp_path / "t")).to_pandas()
+    assert len(out) == 4000 and set(out["x"]) == set(t["x"].to_pylist())
+
+
+def test_delta_vacuum(tmp_path):
+    s = tpu_session()
+    dt = _make_table(s, tmp_path / "t", n=50, files=2)
+    dt.delete(None)  # delete everything -> all files unreferenced
+    removed = dt.vacuum(retention_hours=0)
+    assert len(removed) == 2
+    assert s.read_delta(str(tmp_path / "t")).count() == 0
+
+
+def test_delta_checkpointing(tmp_path):
+    s = tpu_session()
+    path = tmp_path / "t"
+    df0 = s.create_dataframe(pa.table({"a": [0]}))
+    df0.write_delta(str(path))
+    for i in range(1, 12):
+        s.create_dataframe(pa.table({"a": [i]})).write_delta(
+            str(path), mode="append")
+    log_files = os.listdir(path / "_delta_log")
+    assert any(f.endswith(".checkpoint.parquet") for f in log_files)
+    assert "_last_checkpoint" in log_files
+    out = s.read_delta(str(path)).to_pandas()
+    assert sorted(out["a"]) == list(range(12))
+
+
+def test_delta_concurrent_commit_conflict(tmp_path):
+    s = tpu_session()
+    dt = _make_table(s, tmp_path / "t", n=10, files=1)
+    from spark_rapids_tpu.delta.log import DeltaLog
+    log = DeltaLog(str(tmp_path / "t"))
+    v = log.version() + 1
+    log.commit(v, [])
+    with pytest.raises(RuntimeError, match="conflict"):
+        log.commit(v, [])
+
+
+# roaring / z85 unit coverage
+def test_roaring_bitmap_roundtrip():
+    from spark_rapids_tpu.delta.deletion_vectors import RoaringBitmapArray
+    rng = np.random.RandomState(0)
+    for positions in [
+            np.array([], dtype=np.int64),
+            np.array([0, 1, 2, 65535, 65536, 100000]),
+            rng.choice(1 << 20, size=5000, replace=False),   # array containers
+            np.arange(200000),                               # bitmap containers
+            np.array([5, (1 << 32) + 7, (1 << 33) + 1])]:    # multi-key
+        data = RoaringBitmapArray.serialize(np.asarray(positions))
+        back = RoaringBitmapArray.deserialize(data)
+        np.testing.assert_array_equal(back,
+                                      np.unique(np.asarray(positions)))
+
+
+def test_z85_roundtrip():
+    from spark_rapids_tpu.delta.deletion_vectors import (z85_decode,
+                                                         z85_encode)
+    for data in [b"\x00\x00\x00\x00", b"helloworld!!", bytes(range(16))]:
+        assert z85_decode(z85_encode(data)) == data
+
+
+def test_delta_append_schema_mismatch_rejected(tmp_path):
+    s = tpu_session()
+    s.create_dataframe(pa.table({"a": [1], "b": [1.0]})).write_delta(
+        str(tmp_path / "t"))
+    with pytest.raises(ValueError, match="schema mismatch"):
+        s.create_dataframe(pa.table({"x": ["no"]})).write_delta(
+            str(tmp_path / "t"), mode="append")
+
+
+def test_delta_insert_only_merge_allows_duplicate_matches(tmp_path):
+    s = tpu_session()
+    s.create_dataframe(pa.table({"k": [1, 2], "v": [10, 20]})).write_delta(
+        str(tmp_path / "t"))
+    dt = s.delta_table(str(tmp_path / "t"))
+    v_before = dt.log.snapshot().files
+    src = s.create_dataframe(pa.table({"sk": [1, 1, 9], "sv": [5, 6, 90]}))
+    from spark_rapids_tpu.exprs import EqualTo
+    stats = (dt.merge(src, EqualTo(ColumnRef("k"), ColumnRef("sk")))
+             .when_not_matched_insert({"k": ColumnRef("sk"),
+                                       "v": ColumnRef("sv")}).execute())
+    assert stats["num_inserted"] == 1
+    out = s.read_delta(str(tmp_path / "t")).to_pandas().sort_values("k")
+    assert out["k"].tolist() == [1, 2, 9]
+    # matched files untouched (no rewrite churn for insert-only merges)
+    assert set(v_before) <= set(dt.log.snapshot().files)
+
+
+def test_delta_dv_with_predicate_pushdown(tmp_path):
+    """Row-group pruning must not shift DV offsets (file read whole when a
+    DV is attached)."""
+    s = tpu_session()
+    import pyarrow.parquet as pq
+    n = 5000
+    t = pa.table({"k": list(range(n))})
+    s.create_dataframe(t).write_delta(str(tmp_path / "t"))
+    dt = s.delta_table(str(tmp_path / "t"))
+    # DV-delete rows in the back half; then filter targeting the back half
+    dt.delete(GreaterThan(ColumnRef("k"), Literal(n - 100)),
+              use_deletion_vectors=True)
+    out = (s.read_delta(str(tmp_path / "t"))
+           .filter(F.col("k") > n - 200).to_pandas())
+    assert out["k"].max() == n - 100
+    assert len(out) == 100  # (n-200, n-100]
