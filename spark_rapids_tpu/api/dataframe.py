@@ -33,11 +33,17 @@ class TpuSession:
     def __init__(self, conf: Optional[TpuConf] = None):
         self.conf = conf or TpuConf()
         self._ctx: Optional[ExecContext] = None
+        from ..aux.profiler import Profiler
+        self.profiler = Profiler(self.conf)
+        #: per-query runtime summary (ref GpuTaskMetrics accumulators)
+        self.last_query_metrics = None
 
     # ------------------------------------------------------------- config
     def set_conf(self, key: str, value) -> "TpuSession":
         self.conf = self.conf.set(key, value)
         self._ctx = None
+        from ..aux.profiler import Profiler
+        self.profiler = Profiler(self.conf)
         return self
 
     def exec_context(self) -> ExecContext:
@@ -306,7 +312,20 @@ class DataFrame:
         physical = self._physical()
         if self.session.conf.is_explain_only:
             raise RuntimeError("session is in explainOnly mode")
-        return physical.collect(self.session.exec_context())
+        from ..aux.fault import DeviceDumpHandler
+        from ..aux.lore import lore_wrap
+        from ..aux.metrics import TaskMetrics
+        physical = lore_wrap(physical, self.session.conf)
+        ctx = self.session.exec_context()
+        prof = self.session.profiler
+        tm = TaskMetrics(ctx)
+        prof.maybe_start()
+        try:
+            return DeviceDumpHandler(self.session.conf).wrap(
+                lambda: physical.collect(ctx), physical)
+        finally:
+            prof.maybe_stop()
+            self.session.last_query_metrics = tm.finish()
 
     def to_pandas(self):
         return self.collect_arrow().to_pandas()

@@ -1,0 +1,10 @@
+"""Auxiliary subsystems (ref SURVEY.md §5): LORE operator dump/replay,
+profiler sessions, task-metrics aggregation, fatal-error dump handling,
+allocation debug logging."""
+from .lore import LoreDumpExec, lore_wrap, replay
+from .profiler import Profiler
+from .metrics import TaskMetrics, metrics_summary
+from .fault import DeviceDumpHandler
+
+__all__ = ["LoreDumpExec", "lore_wrap", "replay", "Profiler",
+           "TaskMetrics", "metrics_summary", "DeviceDumpHandler"]

@@ -1,0 +1,70 @@
+"""Fatal device-error handling (ref Plugin.scala:661-686 — on fatal CUDA
+errors the executor captures nvidia-smi output + a GPU core dump
+(GpuCoreDumpHandler.scala:48-138) then self-terminates with exit 20 so
+Spark replaces it).
+
+TPU analog: on an XLA runtime error escaping a query, capture a diagnostic
+dump (device list, memory-manager accounting, live-spillable census, the
+failing plan) into ``spark.rapids.tpu.coreDump.path`` before re-raising.
+Recovery itself stays with the caller (Spark's task-retry role)."""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+import traceback
+
+from ..config import register
+
+log = logging.getLogger(__name__)
+
+__all__ = ["DeviceDumpHandler"]
+
+CORE_DUMP_PATH = register(
+    "spark.rapids.tpu.coreDump.path", "",
+    "Directory for device-failure diagnostic dumps; empty disables "
+    "(ref spark.rapids.gpu.coreDump.dir, GpuCoreDumpHandler.scala).")
+
+
+def _is_device_error(e: BaseException) -> bool:
+    name = type(e).__name__
+    return "XlaRuntimeError" in name or "RuntimeError" in name and \
+        "RESOURCE_EXHAUSTED" in str(e)
+
+
+class DeviceDumpHandler:
+    def __init__(self, conf):
+        self.path = str(conf.get(CORE_DUMP_PATH))
+
+    def capture(self, exc: BaseException, plan=None) -> str:
+        """Write the diagnostic dump; returns its path ('' if disabled)."""
+        if not self.path:
+            return ""
+        os.makedirs(self.path, exist_ok=True)
+        out = os.path.join(self.path, f"tpu-dump-{int(time.time()*1000)}.json")
+        info = {"error": repr(exc),
+                "traceback": traceback.format_exc(),
+                "plan": plan.tree_string() if plan is not None else None}
+        try:
+            import jax
+            info["devices"] = [str(d) for d in jax.devices()]
+        except Exception:
+            pass
+        try:
+            from ..mem.manager import MemoryManager
+            info["memory"] = MemoryManager.get().stats()
+        except Exception:
+            pass
+        with open(out, "w") as f:
+            json.dump(info, f, indent=2)
+        log.error("device failure diagnostic dumped to %s", out)
+        return out
+
+    def wrap(self, fn, plan=None):
+        try:
+            return fn()
+        except Exception as e:
+            if _is_device_error(e):
+                self.capture(e, plan)
+            raise

@@ -1,0 +1,108 @@
+"""LORE: per-operator dump + offline replay (ref lore/GpuLore.scala:22-40,
+dump.scala, replay.scala; tagging at GpuOverrides.scala:4840 tagForLore).
+
+Every exec in a physical plan gets a stable LORE id (preorder index).
+With ``spark.rapids.tpu.lore.dumpPath`` set and ``...lore.idsToDump``
+listing ids, those operators' INPUT batches are written as parquet files
+plus a plan.json describing the operator, so a single device operator can
+be re-executed offline against its captured inputs — the reference's
+debugging workflow for "this one exec misbehaves at scale".
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterator, List, Optional
+
+from ..columnar import ColumnarBatch
+from ..config import LORE_DUMP_PATH, LORE_IDS
+from ..exec.base import ExecContext, TpuExec
+
+__all__ = ["LoreDumpExec", "lore_wrap", "replay"]
+
+
+class LoreDumpExec(TpuExec):
+    """Transparent pass-through that tees the child's batches to disk."""
+
+    def __init__(self, child: TpuExec, lore_id: int, wrapped: TpuExec,
+                 path: str, child_slot: int):
+        super().__init__([child])
+        self.lore_id = lore_id
+        self.wrapped = wrapped
+        self.path = path
+        self.child_slot = child_slot
+
+    def output_schema(self):
+        return self.children[0].output_schema()
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        import pyarrow.parquet as pq
+        d = os.path.join(self.path, f"loreId-{self.lore_id}",
+                         f"input-{self.child_slot}")
+        os.makedirs(d, exist_ok=True)
+        for i, b in enumerate(self.children[0].execute(ctx)):
+            pq.write_table(b.to_arrow(), os.path.join(d, f"batch-{i}.parquet"))
+            yield b
+
+    def describe(self):
+        return f"LoreDump[id={self.lore_id}, slot={self.child_slot}]"
+
+
+def _plan_repr(e: TpuExec) -> dict:
+    return {"exec": type(e).__name__, "describe": e.describe(),
+            "module": type(e).__module__,
+            "schema": [(f.name, f.dtype.name)
+                       for f in e.output_schema().fields]}
+
+
+def lore_wrap(root: TpuExec, conf) -> TpuExec:
+    """Assign LORE ids (preorder) and interpose dump nodes around the
+    requested operators' inputs."""
+    path = str(conf.get(LORE_DUMP_PATH))
+    ids = {int(x) for x in str(conf.get(LORE_IDS)).split(",")
+           if x.strip().isdigit()}
+    counter = [0]
+
+    def walk(e: TpuExec) -> TpuExec:
+        my_id = counter[0]
+        counter[0] += 1
+        e.lore_id = my_id
+        new_children = [walk(c) for c in e.children]
+        if path and my_id in ids:
+            d = os.path.join(path, f"loreId-{my_id}")
+            os.makedirs(d, exist_ok=True)
+            with open(os.path.join(d, "plan.json"), "w") as f:
+                json.dump(_plan_repr(e), f, indent=2)
+            new_children = [
+                LoreDumpExec(c, my_id, e, path, slot)
+                for slot, c in enumerate(new_children)]
+        e.children = new_children
+        return e
+
+    return walk(root)
+
+
+def replay(dump_path: str, lore_id: int, exec_factory) -> "object":
+    """Re-run one operator against its captured inputs
+    (ref lore/replay.scala). ``exec_factory(children) -> TpuExec`` builds
+    the operator over InMemoryScan children of the captured batches;
+    returns the collected Arrow table."""
+    import pyarrow.parquet as pq
+
+    from ..exec.basic import InMemoryScanExec
+    from ..types import Schema, StructField, from_arrow
+    d = os.path.join(dump_path, f"loreId-{lore_id}")
+    children: List[TpuExec] = []
+    slot = 0
+    while os.path.isdir(os.path.join(d, f"input-{slot}")):
+        sd = os.path.join(d, f"input-{slot}")
+        tables = [pq.read_table(os.path.join(sd, f))
+                  for f in sorted(os.listdir(sd)) if f.endswith(".parquet")]
+        schema = Schema([StructField(f.name, from_arrow(f.type), f.nullable)
+                         for f in tables[0].schema])
+        children.append(InMemoryScanExec(tables, schema))
+        slot += 1
+    if not children:
+        raise FileNotFoundError(f"no LORE capture at {d}")
+    op = exec_factory(children)
+    return op.collect()

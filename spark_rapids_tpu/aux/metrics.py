@@ -1,0 +1,48 @@
+"""Task metrics aggregation (ref GpuTaskMetrics.scala:110-195 — semaphore
+wait, spill-to-host/disk time+bytes, max device footprint — merged into
+Spark accumulators; here merged into a per-query summary dict exposed as
+``TpuSession.last_query_metrics``)."""
+from __future__ import annotations
+
+from typing import Dict
+
+__all__ = ["TaskMetrics", "metrics_summary"]
+
+
+class TaskMetrics:
+    """Point-in-time capture of runtime counters to diff across a query."""
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+        mm = ctx.memory
+        self._before = {
+            "semWaitSec": ctx.semaphore.total_wait_s,
+            "spillToHostBytes": mm.spill_to_host_bytes,
+            **{k: v for k, v in mm.stats().items()},
+        }
+
+    def finish(self) -> Dict[str, object]:
+        ctx = self.ctx
+        mm = ctx.memory
+        after = mm.stats()
+        out = {
+            "semWaitSec": round(
+                ctx.semaphore.total_wait_s - self._before["semWaitSec"], 6),
+            "spillToHostBytes":
+                mm.spill_to_host_bytes - self._before["spillToHostBytes"],
+            "spillToDiskBytes":
+                after["spill_to_disk_bytes"]
+                - self._before["spill_to_disk_bytes"],
+            "maxDeviceBytes": after["max_device_used"],
+        }
+        out["operators"] = metrics_summary(ctx)
+        return out
+
+
+def metrics_summary(ctx) -> Dict[str, Dict[str, object]]:
+    """Per-exec metric values keyed by exec id (the SQL-UI GpuMetric view,
+    GpuExec.scala:54-165; levels preserved)."""
+    out: Dict[str, Dict[str, object]] = {}
+    for exec_id, ms in ctx.metrics.items():
+        out[exec_id] = {name: m.value for name, m in ms.items()}
+    return out

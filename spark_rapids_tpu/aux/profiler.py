@@ -1,0 +1,76 @@
+"""Profiler sessions (ref profiler.scala ProfilerOnExecutor/OnDriver
+wrapping the CUPTI JNI Profiler; TPU analog = jax.profiler traces viewable
+in xprof/TensorBoard).
+
+The reference scopes captures by time/job/stage ranges coordinated over
+driver RPC (ProfileMsg, Plugin.scala:441). Here the executing process is
+the session, so captures are scoped by QUERY index ranges: with
+``spark.rapids.tpu.profile.pathPrefix`` set, queries whose ordinal falls in
+``spark.rapids.tpu.profile.queryRanges`` (e.g. "0-2,5") are traced.
+"""
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional, Set
+
+from ..config import PROFILE_PATH, register
+
+log = logging.getLogger(__name__)
+
+__all__ = ["Profiler"]
+
+PROFILE_RANGES = register(
+    "spark.rapids.tpu.profile.queryRanges", "0-999999",
+    "Query ordinals to capture, e.g. \"0-2,5\" (ref the reference's "
+    "time/job/stage range scoping, profiler.scala).")
+
+
+def _parse_ranges(s: str) -> Set[int]:
+    out: Set[int] = set()
+    for part in s.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "-" in part:
+            a, b = part.split("-")
+            out.update(range(int(a), int(b) + 1))
+        else:
+            out.add(int(part))
+    return out
+
+
+class Profiler:
+    """Per-session profiler; wraps query execution in a jax trace when the
+    query ordinal is in range."""
+
+    def __init__(self, conf):
+        self.path = str(conf.get(PROFILE_PATH))
+        self.ranges = _parse_ranges(str(conf.get(PROFILE_RANGES))) \
+            if self.path else set()
+        self.query_index = 0
+        self._active = False
+
+    def maybe_start(self) -> None:
+        idx = self.query_index
+        self.query_index += 1
+        if not self.path or idx not in self.ranges or self._active:
+            return
+        import jax
+        d = os.path.join(self.path, f"query-{idx}")
+        os.makedirs(d, exist_ok=True)
+        try:
+            jax.profiler.start_trace(d)
+            self._active = True
+            log.info("profiler capture started -> %s", d)
+        except Exception as e:  # profiler busy/unsupported backend
+            log.warning("profiler start failed: %s", e)
+
+    def maybe_stop(self) -> None:
+        if not self._active:
+            return
+        import jax
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            self._active = False

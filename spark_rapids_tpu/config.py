@@ -167,6 +167,11 @@ TPU_EXEC_COST_PER_ROW = register(
     "spark.rapids.tpu.sql.optimizer.tpu.exec.defaultRowCost", 1.0e-4,
     "CBO default TPU cost s/row (ref RapidsConf.scala:2149).", internal=True)
 
+MEMORY_DEBUG = register(
+    "spark.rapids.tpu.memory.debug", False,
+    "Log every device allocation/free with the running footprint "
+    "(ref spark.rapids.memory.gpu.debug=STDOUT, RapidsConf.scala:376).")
+
 METRICS_LEVEL = register(
     "spark.rapids.tpu.sql.metrics.level", "MODERATE",
     "DEBUG / MODERATE / ESSENTIAL metric verbosity (ref GpuExec.scala:54-165).")

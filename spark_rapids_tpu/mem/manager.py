@@ -16,6 +16,7 @@ RmmSpark.forceRetryOOM — the backbone of the reference's OOM test suites
 """
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Dict, List, Optional
 
@@ -24,6 +25,8 @@ from ..config import (ALLOC_FRACTION, HBM_LIMIT_BYTES, HOST_SPILL_LIMIT,
 
 __all__ = ["MemoryManager", "RetryOOM", "SplitAndRetryOOM", "OutOfDeviceMemory"]
 
+
+log = logging.getLogger(__name__)
 
 class RetryOOM(RuntimeError):
     """Allocation failed but retrying after spill may succeed
@@ -86,6 +89,9 @@ class MemoryManager:
         self._next_handle = 0
         # fault injection: thread-ident -> [(kind, remaining_skips, count)]
         self._inject: Dict[int, List] = {}
+        #: alloc/free logging (ref spark.rapids.memory.gpu.debug=STDOUT,
+        #: RapidsConf.scala:376)
+        self.debug_log = False
 
     # ------------------------------------------------------------------ ctor
     @classmethod
@@ -101,7 +107,10 @@ class MemoryManager:
                 cls._instances[key] = cls(limit, conf.get(HOST_SPILL_LIMIT),
                                           conf.get(SPILL_DIR),
                                           use_native=not cls._instances)
-            return cls._instances[key]
+            inst = cls._instances[key]
+            from ..config import MEMORY_DEBUG
+            inst.debug_log = bool(conf.get(MEMORY_DEBUG))
+            return inst
 
     # ------------------------------------------------------------ accounting
     @property
@@ -135,6 +144,8 @@ class MemoryManager:
         On budget pressure: spill registered buffers; on injected or real
         exhaustion raise RetryOOM / SplitAndRetryOOM
         (ref DeviceMemoryEventHandler.onAllocFailure -> store.spill)."""
+        if self.debug_log:
+            log.info("alloc %d B (used %d B)", nbytes, self.device_used)
         if self._native is not None:
             rc = self._native.reserve(nbytes, block_ms=0)
             if rc == 0:
@@ -173,6 +184,9 @@ class MemoryManager:
                        f"(used={self.device_used}, budget={self.budget})")
 
     def release(self, nbytes: int):
+        if self.debug_log:
+            log.info("free  %d B (used %d B)", nbytes,
+                     self.device_used - nbytes)
         if self._native is not None:
             self._native.release(nbytes)
             return

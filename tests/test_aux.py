@@ -1,0 +1,102 @@
+"""Aux subsystems: LORE dump/replay, profiler scoping, task metrics,
+fault dumps, alloc logging (ref SURVEY.md section 5)."""
+import json
+import os
+
+import pyarrow as pa
+import pytest
+
+from harness import tpu_session
+from data_gen import IntGen, gen_df
+from spark_rapids_tpu.api import functions as F
+
+
+def test_lore_ids_assigned():
+    s = tpu_session()
+    df = s.create_dataframe(gen_df({"a": IntGen()}, n=64)).filter(
+        F.col("a") > 0).group_by("a").agg(F.count_star().with_name("n"))
+    out = df.collect_arrow()  # collect_arrow runs lore_wrap
+    # ids assigned preorder on the executed plan
+    phys = df._physical()
+    from spark_rapids_tpu.aux.lore import lore_wrap
+    phys = lore_wrap(phys, s.conf)
+    ids = []
+    def walk(e):
+        ids.append(e.lore_id)
+        for c in e.children:
+            walk(c)
+    walk(phys)
+    assert ids == sorted(ids) and ids[0] == 0
+
+
+def test_lore_dump_and_replay(tmp_path):
+    s = tpu_session({
+        "spark.rapids.tpu.sql.lore.dumpPath": str(tmp_path),
+        "spark.rapids.tpu.sql.lore.idsToDump": "0",
+    })
+    df = s.create_dataframe(gen_df({"a": IntGen(lo=0, hi=5)}, n=128)) \
+        .group_by("a").agg(F.count_star().with_name("n"))
+    expected = df.to_pandas().sort_values("a").reset_index(drop=True)
+    d = tmp_path / "loreId-0"
+    assert (d / "plan.json").exists()
+    assert any((d / "input-0").iterdir())
+    plan = json.loads((d / "plan.json").read_text())
+    assert plan["exec"] == "TpuHashAggregateExec"
+    # offline replay of the captured operator
+    from spark_rapids_tpu.aux.lore import replay
+    from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec
+    from spark_rapids_tpu.exprs import ColumnRef
+    from spark_rapids_tpu.exprs.aggregates import CountStar
+    out = replay(str(tmp_path), 0,
+                 lambda kids: TpuHashAggregateExec(
+                     [ColumnRef("a")], [CountStar("n")], kids[0]))
+    got = out.to_pandas().sort_values("a").reset_index(drop=True)
+    import pandas as pd
+    pd.testing.assert_frame_equal(got, expected, check_names=False)
+
+
+def test_task_metrics_populated():
+    s = tpu_session()
+    df = s.create_dataframe(gen_df({"a": IntGen()}, n=256)).filter(
+        F.col("a") > 0)
+    df.collect_arrow()
+    m = s.last_query_metrics
+    assert m is not None
+    assert "semWaitSec" in m and "maxDeviceBytes" in m
+    assert any("numOutputRows" in v for v in m["operators"].values())
+
+
+def test_fault_dump_written(tmp_path):
+    from spark_rapids_tpu.aux.fault import DeviceDumpHandler
+    from spark_rapids_tpu.config import TpuConf
+
+    class FakeXlaRuntimeError(RuntimeError):
+        pass
+    FakeXlaRuntimeError.__name__ = "XlaRuntimeError"
+    h = DeviceDumpHandler(TpuConf(
+        {"spark.rapids.tpu.coreDump.path": str(tmp_path)}))
+
+    def boom():
+        raise FakeXlaRuntimeError("RESOURCE_EXHAUSTED: out of HBM")
+    with pytest.raises(RuntimeError):
+        h.wrap(boom)
+    dumps = list(tmp_path.iterdir())
+    assert len(dumps) == 1
+    info = json.loads(dumps[0].read_text())
+    assert "RESOURCE_EXHAUSTED" in info["error"]
+    assert "memory" in info
+
+
+def test_profiler_query_range_scoping():
+    from spark_rapids_tpu.aux.profiler import _parse_ranges
+    assert _parse_ranges("0-2,5") == {0, 1, 2, 5}
+    assert _parse_ranges("") == set()
+
+
+def test_alloc_debug_logging(caplog):
+    import logging
+    s = tpu_session({"spark.rapids.tpu.memory.debug": True})
+    with caplog.at_level(logging.INFO, logger="spark_rapids_tpu.mem.manager"):
+        s.create_dataframe(gen_df({"a": IntGen()}, n=64)).order_by(
+            F.col("a").asc()).collect_arrow()
+    assert any("alloc" in r.message for r in caplog.records)
