@@ -20,6 +20,20 @@ from ..plan.overrides import explain_potential_tpu_plan, plan_query
 from ..types import Schema, from_arrow
 from .functions import Col, _to_expr, col as _col
 
+
+def _as_schema(schema) -> Schema:
+    """Schema | {name: DataType} | pyarrow.Schema -> Schema."""
+    if isinstance(schema, Schema):
+        return schema
+    if isinstance(schema, dict):
+        return Schema.of(**schema)
+    import pyarrow as pa
+    if isinstance(schema, pa.Schema):
+        from ..types import StructField
+        return Schema([StructField(f.name, from_arrow(f.type), f.nullable)
+                       for f in schema])
+    raise TypeError(f"cannot interpret schema {schema!r}")
+
 __all__ = ["TpuSession", "DataFrame", "GroupedData"]
 
 
@@ -278,6 +292,21 @@ class DataFrame:
             df._broadcast_hint = True
         return df
 
+    def map_in_pandas(self, fn, schema) -> "DataFrame":
+        """Per-batch pandas transform (ref GpuMapInPandasExec)."""
+        return DataFrame(self.session,
+                         L.MapInPandas(fn, _as_schema(schema), self.plan))
+
+    def cache(self) -> "DataFrame":
+        """Materialize once into in-memory parquet-encoded batches
+        (ref ParquetCachedBatchSerializer)."""
+        from ..exec.cached import CachedRelation, encode_batches
+        physical = self._physical()
+        ctx = self.session.exec_context()
+        blobs = encode_batches(physical.execute(ctx))
+        return DataFrame(self.session,
+                         CachedRelation(blobs, self.schema))
+
     def sample(self, fraction: float, seed: int = 42) -> "DataFrame":
         return DataFrame(self.session, L.Sample(fraction, seed, self.plan))
 
@@ -390,6 +419,18 @@ class GroupedData:
             parsed.append(a)
         return DataFrame(self.df.session,
                          L.Aggregate(self.keys, parsed, self.df.plan))
+
+    def apply_in_pandas(self, fn, schema) -> DataFrame:
+        """Per-group pandas transform (ref GpuFlatMapGroupsInPandasExec)."""
+        names = []
+        for k in self.keys:
+            assert isinstance(k, ColumnRef), \
+                "apply_in_pandas requires plain column keys"
+            names.append(k.name)
+        return DataFrame(self.df.session,
+                         L.FlatMapGroupsInPandas(names, fn,
+                                                 _as_schema(schema),
+                                                 self.df.plan))
 
     # pyspark-style helpers
     def count(self) -> DataFrame:

@@ -430,3 +430,14 @@ def columnar_udf(impl, *cols):
     from ..udf import ColumnarUDFExpr
     from .functions import _to_expr
     return ColumnarUDFExpr(impl, [_to_expr(c) for c in cols])
+
+
+def pandas_udf(fn=None, return_type=None):
+    """Vectorized pandas scalar UDF (ref GpuArrowEvalPythonExec role)."""
+    if fn is None:
+        return lambda f: pandas_udf(f, return_type)
+    from ..udf.runtime import PandasUDF
+    def call(*cols):
+        return PandasUDF(fn, [_to_expr(c) for c in cols], return_type)
+    call.__name__ = getattr(fn, "__name__", "pandas_udf")
+    return call

@@ -1,0 +1,85 @@
+"""df.cache() storage (ref ParquetCachedBatchSerializer.scala, 1,407 LoC —
+`spark.sql.cache` columnar serializer storing batches PARQUET-ENCODED in
+memory: far smaller than raw buffers, decode on demand).
+
+Same design here: caching a DataFrame materializes its batches once,
+parquet-encodes each into an in-memory buffer (host RAM, compressed
+encodings), and replaces the plan with a scan that decodes per batch."""
+from __future__ import annotations
+
+from typing import Iterator, List
+
+from ..columnar import ColumnarBatch
+from ..plan.meta import PlanMeta
+from ..plan.overrides import rule
+from ..types import Schema
+from .base import ESSENTIAL, ExecContext, TpuExec
+
+__all__ = ["CachedRelation", "ParquetCachedScanExec", "encode_batches"]
+
+
+def encode_batches(batches) -> List[bytes]:
+    import io
+
+    import pyarrow.parquet as pq
+    blobs = []
+    for b in batches:
+        buf = io.BytesIO()
+        pq.write_table(b.to_arrow(), buf)
+        blobs.append(buf.getvalue())
+    return blobs
+
+
+class CachedRelation:
+    """Logical node over parquet-encoded cached batches."""
+
+    def __init__(self, blobs: List[bytes], schema: Schema):
+        self.blobs = blobs
+        self._schema = schema
+        self.children = []
+
+    def schema(self) -> Schema:
+        return self._schema
+
+    def describe(self):
+        total = sum(len(b) for b in self.blobs)
+        return f"InMemoryParquetCache[{len(self.blobs)} batches, {total}B]"
+
+    def tree_string(self, indent: int = 0) -> str:
+        return "  " * indent + self.describe() + "\n"
+
+
+class ParquetCachedScanExec(TpuExec):
+    def __init__(self, blobs: List[bytes], schema: Schema):
+        super().__init__([])
+        self.blobs = blobs
+        self._schema = schema
+
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        rows_m = ctx.metric(self._exec_id, "numOutputRows", ESSENTIAL)
+        if not self.blobs:
+            from .joins import _empty_batch
+            yield _empty_batch(self._schema)
+            return
+        for blob in self.blobs:
+            t = pq.read_table(pa.BufferReader(blob))
+            with ctx.semaphore.held():
+                b = ColumnarBatch.from_arrow(t)
+            rows_m.add(b.num_rows)
+            yield b
+
+    def describe(self):
+        return f"ParquetCachedScan[{len(self.blobs)} batches]"
+
+
+@rule(CachedRelation)
+class _CachedMeta(PlanMeta):
+    def convert_to_tpu(self, children):
+        return ParquetCachedScanExec(self.plan.blobs, self.plan.schema())
+
+    convert_to_cpu = convert_to_tpu

@@ -1,0 +1,127 @@
+"""Pandas-UDF execs (ref execution/python/: GpuArrowEvalPythonExec,
+GpuMapInPandasExec, GpuFlatMapGroupsInPandasExec, GpuAggregateInPandasExec;
+Arrow IPC bridge GpuArrowWriter.scala; PythonWorkerSemaphore.scala).
+
+The reference ships device batches to separate Python worker processes over
+Arrow IPC because its engine lives in the JVM. This engine is already
+in-process Python, so the "worker" boundary collapses to a host call — the
+Arrow hand-off (device batch -> Arrow -> pandas -> Arrow -> device) and the
+worker-concurrency semaphore are kept, the socket is not.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterator, List
+
+from ..columnar import ColumnarBatch
+from ..config import register
+from ..types import Schema
+from .base import ESSENTIAL, ExecContext, TpuExec
+
+__all__ = ["MapInPandasExec", "FlatMapGroupsInPandasExec",
+           "python_worker_semaphore"]
+
+CONCURRENT_PYTHON_WORKERS = register(
+    "spark.rapids.tpu.python.concurrentPythonWorkers", 0,
+    "Max concurrent pandas-UDF evaluations; 0 = unlimited "
+    "(ref python/PythonWorkerSemaphore.scala + PythonConfEntries).")
+
+_SEM_LOCK = threading.Lock()
+_SEMAPHORES = {}
+
+
+def python_worker_semaphore(n: int):
+    """Process-wide gate keyed by permit count (the PythonWorkerSemaphore
+    analog); returns None when unlimited."""
+    if n <= 0:
+        return None
+    with _SEM_LOCK:
+        if n not in _SEMAPHORES:
+            _SEMAPHORES[n] = threading.Semaphore(n)
+        return _SEMAPHORES[n]
+
+
+class _PandasExecBase(TpuExec):
+    is_tpu = True  # device batches in/out; the UDF body runs on host
+
+    def __init__(self, child: TpuExec, fn: Callable, schema: Schema):
+        super().__init__([child])
+        self.fn = fn
+        self._schema = schema
+
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def _gate(self, ctx: ExecContext):
+        return python_worker_semaphore(
+            int(ctx.conf.get(CONCURRENT_PYTHON_WORKERS)))
+
+    def _emit(self, pdf) -> ColumnarBatch:
+        import pyarrow as pa
+
+        from ..types import to_arrow
+        fields = [(f.name, to_arrow(f.dtype)) for f in self._schema.fields]
+        t = pa.Table.from_pandas(pdf, preserve_index=False)
+        arrays = [t.column(n).cast(at) for n, at in fields]
+        return ColumnarBatch.from_arrow(
+            pa.Table.from_arrays(arrays, names=[n for n, _ in fields]))
+
+
+class MapInPandasExec(_PandasExecBase):
+    """df.map_in_pandas(fn): fn(pandas.DataFrame) -> pandas.DataFrame per
+    batch (ref GpuMapInPandasExec)."""
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        rows_m = ctx.metric(self._exec_id, "numOutputRows", ESSENTIAL)
+        gate = self._gate(ctx)
+        for b in self.children[0].execute(ctx):
+            pdf = b.to_arrow().to_pandas()
+            if gate:
+                with gate:
+                    out = self.fn(pdf)
+            else:
+                out = self.fn(pdf)
+            ob = self._emit(out)
+            rows_m.add(ob.num_rows)
+            yield ob
+
+    def describe(self):
+        return f"MapInPandas[{getattr(self.fn, '__name__', 'fn')}]"
+
+
+class FlatMapGroupsInPandasExec(_PandasExecBase):
+    """group_by(keys).apply_in_pandas(fn): fn(pandas.DataFrame per group)
+    -> pandas.DataFrame (ref GpuFlatMapGroupsInPandasExec; grouping uses the
+    same coalesced host grouping the CPU aggregate oracle uses)."""
+
+    def __init__(self, child: TpuExec, keys: List[str], fn: Callable,
+                 schema: Schema):
+        super().__init__(child, fn, schema)
+        self.keys = keys
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        import pandas as pd
+        rows_m = ctx.metric(self._exec_id, "numOutputRows", ESSENTIAL)
+        gate = self._gate(ctx)
+        tables = [b.to_arrow() for b in self.children[0].execute(ctx)]
+        if not tables:
+            return
+        import pyarrow as pa
+        pdf = pa.concat_tables(tables).to_pandas()
+        outs = []
+        for _, g in pdf.groupby(self.keys, dropna=False, sort=False):
+            if gate:
+                with gate:
+                    outs.append(self.fn(g))
+            else:
+                outs.append(self.fn(g))
+        if not outs:
+            return
+        out = pd.concat(outs, ignore_index=True)
+        ob = self._emit(out)
+        rows_m.add(ob.num_rows)
+        yield ob
+
+    def describe(self):
+        return (f"FlatMapGroupsInPandas[keys={self.keys}, "
+                f"{getattr(self.fn, '__name__', 'fn')}]")

@@ -69,15 +69,16 @@ class ParquetScan(LogicalPlan):
         return Schema([self._schema[c] for c in self.columns])
 
 
+    def describe(self):
+        return f"{type(self).__name__}[{len(self.paths)} files]"
+
+
 class OrcScan(ParquetScan):
     """ORC file source (ref GpuOrcScan.scala)."""
 
 
 class AvroScan(ParquetScan):
     """Avro file source (ref GpuAvroScan.scala)."""
-
-    def describe(self):
-        return f"ParquetScan[{len(self.paths)} files]"
 
 
 class Project(LogicalPlan):
@@ -348,3 +349,34 @@ class WriteFile(LogicalPlan):
 
     def schema(self):
         return self.children[0].schema()
+
+
+class MapInPandas(LogicalPlan):
+    """ref GpuMapInPandasExec (execution/python/)."""
+
+    def __init__(self, fn, out_schema: Schema, child: LogicalPlan):
+        self.fn = fn
+        self._out = out_schema
+        self.children = [child]
+
+    def schema(self) -> Schema:
+        return self._out
+
+    def describe(self):
+        return f"MapInPandas[{getattr(self.fn, '__name__', 'fn')}]"
+
+
+class FlatMapGroupsInPandas(LogicalPlan):
+    """ref GpuFlatMapGroupsInPandasExec."""
+
+    def __init__(self, keys, fn, out_schema: Schema, child: LogicalPlan):
+        self.keys = list(keys)
+        self.fn = fn
+        self._out = out_schema
+        self.children = [child]
+
+    def schema(self) -> Schema:
+        return self._out
+
+    def describe(self):
+        return f"FlatMapGroupsInPandas[keys={self.keys}]"

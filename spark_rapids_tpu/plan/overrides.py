@@ -388,3 +388,22 @@ class WindowMeta(PlanMeta):
     def convert_to_cpu(self, children):
         from ..exec.window import CpuWindowExec
         return CpuWindowExec(self.plan.window_exprs, children[0])
+
+
+@rule(L.MapInPandas)
+class MapInPandasMeta(PlanMeta):
+    def convert_to_tpu(self, children):
+        from ..exec.python_execs import MapInPandasExec
+        return MapInPandasExec(children[0], self.plan.fn, self.plan.schema())
+
+    convert_to_cpu = convert_to_tpu
+
+
+@rule(L.FlatMapGroupsInPandas)
+class FlatMapGroupsInPandasMeta(PlanMeta):
+    def convert_to_tpu(self, children):
+        from ..exec.python_execs import FlatMapGroupsInPandasExec
+        return FlatMapGroupsInPandasExec(children[0], self.plan.keys,
+                                         self.plan.fn, self.plan.schema())
+
+    convert_to_cpu = convert_to_tpu

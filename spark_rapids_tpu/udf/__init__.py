@@ -10,7 +10,7 @@ Two paths, mirroring the reference:
     the user supplies a device columnar kernel directly.
 """
 from .compiler import compile_udf, CompileError
-from .runtime import PythonUDF, TpuUDF, ColumnarUDFExpr, udf
+from .runtime import PandasUDF, PythonUDF, TpuUDF, ColumnarUDFExpr, udf
 
-__all__ = ["compile_udf", "CompileError", "PythonUDF", "TpuUDF",
+__all__ = ["compile_udf", "CompileError", "PandasUDF", "PythonUDF", "TpuUDF",
            "ColumnarUDFExpr", "udf"]

@@ -65,6 +65,52 @@ class PythonUDF(Expression):
         return f"{self._name}(...)"
 
 
+class PandasUDF(Expression):
+    """Vectorized pandas scalar UDF (ref GpuArrowEvalPythonExec's role:
+    batches cross to pandas via Arrow, the function sees Series). Host-only
+    like PythonUDF but amortized per batch instead of per row."""
+
+    device_type_sig = TypeSig.none()
+
+    def __init__(self, fn: Callable, children: List[Expression],
+                 return_type: Optional[DataType] = None, name: str = None):
+        self.fn = fn
+        self.children = list(children)
+        self._return_type = return_type or FLOAT64
+        self._name = name or getattr(fn, "__name__", "pandas_udf")
+
+    def data_type(self, schema: Schema) -> DataType:
+        return self._return_type
+
+    def device_unsupported_reason(self, schema: Schema) -> Optional[str]:
+        return f"PandasUDF {self._name} runs on host via Arrow"
+
+    def eval_host(self, batch):
+        import pyarrow as pa
+
+        from ..types import to_arrow
+        series = [c.eval_host(batch).to_pandas() for c in self.children]
+        from ..config import TpuConf
+        from ..exec.python_execs import (CONCURRENT_PYTHON_WORKERS,
+                                         python_worker_semaphore)
+        gate = python_worker_semaphore(
+            int(TpuConf().get(CONCURRENT_PYTHON_WORKERS)))
+        if gate:
+            with gate:
+                out = self.fn(*series)
+        else:
+            out = self.fn(*series)
+        return pa.Array.from_pandas(out, type=to_arrow(self._return_type))
+
+    def key(self):
+        kids = ",".join(c.key() for c in self.children)
+        return f"PandasUDF[{self._name}@{id(self.fn):x}]({kids})"
+
+    @property
+    def name_hint(self):
+        return f"{self._name}(...)"
+
+
 class TpuUDF:
     """Columnar device UDF contract (ref RapidsUDF.java:22): subclass and
     implement ``evaluate_columnar`` over jax data/validity arrays."""

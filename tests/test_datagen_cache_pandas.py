@@ -1,0 +1,153 @@
+"""datagen DSL (ref datagen/bigDataGen.scala), parquet cache serializer
+(ref ParquetCachedBatchSerializer.scala), pandas-UDF execs
+(ref execution/python/)."""
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from harness import assert_tpu_and_cpu_equal, tpu_session
+from data_gen import IntGen, gen_df
+from spark_rapids_tpu.api import functions as F
+
+
+# ---------------------------------------------------------------------------
+# datagen
+# ---------------------------------------------------------------------------
+
+def test_datagen_deterministic_and_sliceable():
+    from spark_rapids_tpu.datagen import ColumnGen, TableGen, flat, zipf
+    tg = TableGen("t", 10_000, {
+        "k": ColumnGen("long", zipf(1.3), cardinality=100),
+        "v": ColumnGen("double", lo=-1, hi=1),
+        "s": ColumnGen("string", flat(), cardinality=50),
+        "n": ColumnGen("long", null_ratio=0.2, cardinality=10),
+    }, seed=7)
+    a = tg.to_table()
+    b = tg.to_table()
+    assert a.equals(b), "generation must be deterministic"
+    # row-range independence: slicing from offset reproduces the same rows
+    # as a fresh generator (slice boundaries are the chunk contract)
+    s1 = tg.slice(0, 1000)
+    assert a.slice(0, 1000).equals(s1)
+    # unaligned range must agree with the full table too
+    s2 = tg.slice(3000, 777)
+    assert a.slice(3000, 777).to_pydict() == s2.to_pydict()
+    assert a.num_rows == 10_000
+    assert a.column("n").null_count > 1000
+
+
+def test_datagen_zipf_skew():
+    from spark_rapids_tpu.datagen import ColumnGen, TableGen, zipf
+    tg = TableGen("t", 20_000, {"k": ColumnGen("long", zipf(1.5),
+                                               cardinality=1000)})
+    counts = pd.Series(tg.to_table().column("k").to_numpy()).value_counts()
+    assert counts.iloc[0] > 20 * counts.mean(), "expected heavy skew"
+
+
+def test_datagen_key_group_correlated_join():
+    from spark_rapids_tpu.datagen import ColumnGen, KeyGroup, TableGen, flat
+    kg = KeyGroup("cust", cardinality=200, mapping="hashed")
+    facts = TableGen("fact", 2000, {"ck": ColumnGen(key_group=kg)},
+                     seed=1)
+    dims = TableGen("dim", 400, {"ck": ColumnGen(key_group=kg)}, seed=2)
+    f = set(facts.to_table().column("ck").to_pylist())
+    d = set(dims.to_table().column("ck").to_pylist())
+    # same key universe -> joins hit
+    assert len(f & d) > 50
+
+
+def test_datagen_write_parquet_scan(tmp_path):
+    from spark_rapids_tpu.datagen import ColumnGen, TableGen
+    tg = TableGen("t", 5000, {"k": ColumnGen("long", cardinality=10),
+                              "v": ColumnGen("double")})
+    paths = tg.write_parquet(str(tmp_path), files=4)
+    assert len(paths) == 4
+    s = tpu_session()
+    out = s.read_parquet(*paths).group_by("k").agg(
+        F.count_star().with_name("n")).to_pandas()
+    assert out["n"].sum() == 5000
+
+
+# ---------------------------------------------------------------------------
+# cache
+# ---------------------------------------------------------------------------
+
+def test_cache_roundtrip_and_plan():
+    s = tpu_session()
+    df = s.create_dataframe(gen_df({"a": IntGen(lo=0, hi=9),
+                                    "b": IntGen()}, n=512))
+    base = df.filter(F.col("b") > 0)
+    cached = base.cache()
+    assert "ParquetCachedScan" in cached._physical().tree_string()
+    exp = base.to_pandas().sort_values(["a", "b"]).reset_index(drop=True)
+    got = cached.to_pandas().sort_values(["a", "b"]).reset_index(drop=True)
+    pd.testing.assert_frame_equal(got, exp)
+    # downstream ops compose over the cache
+    out = cached.group_by("a").agg(F.count_star().with_name("n")).to_pandas()
+    assert out["n"].sum() == len(exp)
+
+
+# ---------------------------------------------------------------------------
+# pandas execs
+# ---------------------------------------------------------------------------
+
+def test_map_in_pandas():
+    s = tpu_session()
+    df = s.create_dataframe(gen_df({"a": IntGen(nullable=False)}, n=300),
+                            num_partitions=3)
+
+    def double(pdf):
+        pdf = pdf.copy()
+        pdf["b"] = pdf["a"].astype("int64") * 2
+        return pdf
+
+    from spark_rapids_tpu.types import INT64
+    out = df.map_in_pandas(double, {"a": INT64, "b": INT64}).to_pandas()
+    assert (out["b"] == out["a"].astype("int64") * 2).all()
+    assert len(out) == 300
+
+
+def test_apply_in_pandas_groups():
+    s = tpu_session()
+    df = s.create_dataframe(gen_df({"k": IntGen(lo=0, hi=5, nullable=False),
+                                    "v": IntGen(nullable=False)}, n=400))
+
+    def summarize(g):
+        import pandas as pd
+        return pd.DataFrame({"k": [g["k"].iloc[0]],
+                             "total": [g["v"].sum()],
+                             "n": [len(g)]})
+
+    from spark_rapids_tpu.types import INT64
+    out = (df.group_by("k")
+           .apply_in_pandas(summarize, {"k": INT64, "total": INT64,
+                                        "n": INT64})
+           .to_pandas().sort_values("k").reset_index(drop=True))
+    exp = (df.to_pandas().groupby("k")["v"]
+           .agg(["sum", "size"]).reset_index())
+    np.testing.assert_array_equal(out["total"], exp["sum"])
+    np.testing.assert_array_equal(out["n"], exp["size"])
+
+
+def test_pandas_udf_vectorized():
+    s = tpu_session()
+    df = s.create_dataframe(gen_df({"a": IntGen(nullable=False)}, n=256))
+
+    @F.pandas_udf
+    def plus_one(x):
+        return x + 1.0
+
+    out = df.with_column("b", plus_one(F.col("a"))).to_pandas()
+    np.testing.assert_allclose(out["b"], out["a"] + 1.0)
+
+
+def test_pandas_udf_marked_host_fallback():
+    s = tpu_session()
+    df = s.create_dataframe(gen_df({"a": IntGen()}, n=64))
+
+    @F.pandas_udf
+    def f(x):
+        return x * 2.0
+    txt = df.with_column("b", f(F.col("a"))).explain("potential")
+    assert "host" in txt.lower() or "PandasUDF" in txt
