@@ -115,6 +115,11 @@ class TpuSession:
         return DataFrame(self, L.AvroScan(files, avro_schema(files[0]),
                                           columns))
 
+    def read_iceberg(self, path: str, columns: Optional[List[str]] = None,
+                     snapshot_id: Optional[int] = None) -> "DataFrame":
+        from ..iceberg import IcebergTable
+        return IcebergTable(path).to_df(self, columns, snapshot_id)
+
     def read_delta(self, path: str, columns: Optional[List[str]] = None,
                    version: Optional[int] = None) -> "DataFrame":
         from ..delta import DeltaTable

@@ -1,0 +1,78 @@
+"""Shuffle peer discovery via heartbeats (ref
+RapidsShuffleHeartbeatManager (driver, Plugin.scala:428-439) +
+RapidsShuffleHeartbeatEndpoint (executor, Plugin.scala:544-548): executors
+register with the driver, the driver returns all known peers, and each
+executor connects its transport to new peers (addPeer ->
+transport.connect, RapidsShuffleInternalManagerBase.scala:1233-1251)).
+
+TPU mapping: within a slice the "peers" are the mesh devices and the
+transport is XLA collectives (no discovery needed — the mesh is static);
+across processes/slices (multi-host DCN) this registry plays the driver
+role. Peer failures are tolerated at connect like the reference
+(:1239-1250): a dead peer is evicted after missing heartbeats rather than
+failing the query."""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["ShuffleHeartbeatManager", "ShuffleHeartbeatEndpoint"]
+
+
+class ShuffleHeartbeatManager:
+    """Driver-side registry of shuffle-capable executors."""
+
+    def __init__(self, stale_after_s: float = 30.0):
+        self._lock = threading.Lock()
+        self._peers: Dict[str, dict] = {}
+        self.stale_after_s = stale_after_s
+
+    def register(self, executor_id: str, address: dict) -> List[dict]:
+        """Register/heartbeat an executor; returns every LIVE peer (the
+        reference returns all known BlockManagerIds on each heartbeat)."""
+        now = time.monotonic()
+        with self._lock:
+            self._peers[executor_id] = {"id": executor_id, "addr": address,
+                                        "last": now}
+            self._evict(now)
+            return [dict(p) for p in self._peers.values()]
+
+    def _evict(self, now: float):
+        dead = [k for k, v in self._peers.items()
+                if now - v["last"] > self.stale_after_s]
+        for k in dead:
+            del self._peers[k]
+
+    def live_peers(self) -> List[str]:
+        with self._lock:
+            self._evict(time.monotonic())
+            return sorted(self._peers)
+
+
+class ShuffleHeartbeatEndpoint:
+    """Executor-side: periodic heartbeats; invokes on_new_peer for peers it
+    has not connected to yet (transport.connect analog)."""
+
+    def __init__(self, manager: ShuffleHeartbeatManager, executor_id: str,
+                 address: Optional[dict] = None,
+                 on_new_peer: Optional[Callable[[dict], None]] = None):
+        self.manager = manager
+        self.executor_id = executor_id
+        self.address = address or {}
+        self.on_new_peer = on_new_peer
+        self._known = set()
+
+    def heartbeat(self) -> List[dict]:
+        peers = self.manager.register(self.executor_id, self.address)
+        for p in peers:
+            if p["id"] != self.executor_id and p["id"] not in self._known:
+                self._known.add(p["id"])
+                if self.on_new_peer:
+                    try:
+                        self.on_new_peer(p)
+                    except Exception:
+                        # peer connect failures are tolerated (ref
+                        # RapidsShuffleInternalManagerBase.scala:1239-1250)
+                        self._known.discard(p["id"])
+        return peers
