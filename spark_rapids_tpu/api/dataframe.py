@@ -45,6 +45,8 @@ def _as_expr(c, alias_ok=True) -> Expression:
 
 class TpuSession:
     def __init__(self, conf: Optional[TpuConf] = None):
+        if isinstance(conf, dict):
+            conf = TpuConf(conf)
         self.conf = conf or TpuConf()
         self._ctx: Optional[ExecContext] = None
         from ..aux.profiler import Profiler
@@ -342,7 +344,10 @@ class DataFrame:
     def _physical(self):
         return plan_query(self.plan, self.session.conf)
 
-    def collect_arrow(self):
+    def _execute_wrapped(self, consume):
+        """Run the physical plan through the full execution pipeline
+        (explainOnly guard, LORE wrap, profiler, task metrics, fault
+        dumps) — every materializing sink goes through here."""
         physical = self._physical()
         if self.session.conf.is_explain_only:
             raise RuntimeError("session is in explainOnly mode")
@@ -356,13 +361,42 @@ class DataFrame:
         prof.maybe_start()
         try:
             return DeviceDumpHandler(self.session.conf).wrap(
-                lambda: physical.collect(ctx), physical)
+                lambda: consume(physical, ctx), physical)
         finally:
             prof.maybe_stop()
             self.session.last_query_metrics = tm.finish()
 
+    def collect_arrow(self):
+        return self._execute_wrapped(lambda p, ctx: p.collect(ctx))
+
     def to_pandas(self):
         return self.collect_arrow().to_pandas()
+
+    def to_device_columns(self):
+        """Zero-copy export of the result as device column batches for ML
+        interop (ref ColumnarRdd.scala:42 convert(df): RDD[Table] used by
+        XGBoost): a list of batches, each a dict name -> (data jax.Array,
+        validity jax.Array), plus ``num_rows``. The arrays stay in HBM —
+        no host round trip.
+
+        The arrays keep their shape-bucket padded length: rows at index
+        >= ``num_rows`` are padding whose data values are arbitrary (their
+        validity lanes are False). Mask with ``validity`` or slice to
+        ``num_rows`` before any reduction over the array."""
+        def consume(physical, ctx):
+            out = []
+            for b in physical.execute(ctx):
+                cols = {}
+                for f, c in zip(b.schema.fields, b.columns):
+                    if not hasattr(c, "data"):
+                        raise ValueError(
+                            f"column {f.name} is host-only "
+                            f"({f.dtype.name}); device export requires "
+                            "device-backed types")
+                    cols[f.name] = (c.data, c.validity)
+                out.append({"columns": cols, "num_rows": b.num_rows})
+            return out
+        return self._execute_wrapped(consume)
 
     toPandas = to_pandas
 

@@ -106,6 +106,12 @@ BATCH_SIZE_ROWS = register(
     "Target max rows per columnar batch (shape-bucket ceiling; TPU-specific: "
     "bounds XLA recompilation via the bucket ladder).")
 
+JOIN_BLOOM_FILTER = register(
+    "spark.rapids.tpu.sql.join.bloomFilter.enabled", False,
+    "Build a device bloom filter from the build side's join keys and "
+    "pre-filter the stream side before inner/semi hash joins (ref Spark's "
+    "InjectRuntimeFilter + spark-rapids-jni BloomFilter).")
+
 JOIN_SUBPARTITION_SIZE = register(
     "spark.rapids.tpu.sql.join.subPartitionSizeBytes", 256 * 1024 * 1024,
     "When the combined input of an equi-join exceeds this many bytes the join "

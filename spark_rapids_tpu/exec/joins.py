@@ -250,13 +250,10 @@ def _finish_pair_join(join_type: str, lb: ColumnarBatch, rb: ColumnarBatch,
     rmask = jnp.arange(p_r, dtype=jnp.int32) < rb.num_rows
 
     if join_type in ("leftsemi", "leftanti"):
+        from ..exprs.compiler import filter_batch_by_mask
         keep = jnp.logical_and(ml > 0 if join_type == "leftsemi" else ml == 0,
                                lmask)
-        arrays = [(c.data, c.validity) for c in lb.columns]
-        outs, count = _compact_kernel(arrays, keep, p_l)
-        cols = [DeviceColumn(d, v, c.dtype)
-                for (d, v), c in zip(outs, lb.columns)]
-        return ColumnarBatch(cols, int(count), out_schema)
+        return filter_batch_by_mask(lb, keep, schema=out_schema)
     if join_type == "existence":
         exists = DeviceColumn(ml > 0, lmask, BOOL)
         return ColumnarBatch(list(lb.columns) + [exists], lb.num_rows,
@@ -324,6 +321,7 @@ class TpuHashJoinExec(TpuExec):
                     if left_batches else _empty_batch(ls)
                 rb = concat_batches([s.get() for s in right_batches]) \
                     if right_batches else _empty_batch(rs)
+                lb = self._maybe_bloom_filter(ctx, lb, rb)
                 return self._join(lb, rb)
 
         out = with_retry_no_split(run, ctx.memory)
@@ -331,6 +329,75 @@ class TpuHashJoinExec(TpuExec):
             s.close()
         rows_m.add(out.num_rows)
         yield out
+
+    # -- runtime bloom filter (ref InjectRuntimeFilter + jni BloomFilter):
+    # inner/semi equi-joins may drop stream rows whose keys cannot be in
+    # the build side before paying for the join kernel ------------------
+    def _maybe_bloom_filter(self, ctx, lb: ColumnarBatch,
+                            rb: ColumnarBatch) -> ColumnarBatch:
+        bloom = self._build_bloom(ctx, lb.schema, rb)
+        if bloom is None or lb.num_rows == 0:
+            return lb
+        return self._apply_bloom(ctx, bloom, lb)
+
+    def _build_bloom(self, ctx, ls: Schema, rb: ColumnarBatch):
+        """Build a bloom filter over the build side's keys, or None when
+        the runtime filter does not apply (conf off, non-inner/semi join,
+        join condition present, or non-device-hashable keys)."""
+        from ..config import JOIN_BLOOM_FILTER
+        if (not ctx.conf.get(JOIN_BLOOM_FILTER)
+                or self.join_type not in ("inner", "leftsemi")
+                or not self.left_keys or self.condition is not None
+                or rb.num_rows == 0):
+            return None
+        from ..exprs.hash_fns import device_hashable
+        from ..types import from_numpy_dtype
+        rs = rb.schema
+        self._bloom_key_dtypes = []
+        for lk, rk in zip(self.left_keys, self.right_keys):
+            ldt, rdt = lk.data_type(ls), rk.data_type(rs)
+            if (device_hashable.reason_not_supported(ldt)
+                    or device_hashable.reason_not_supported(rdt)):
+                return None
+            # mixed-width keys hash differently per width; promote both
+            # sides to the common numpy dtype so probes match the build
+            if ldt.np_dtype != rdt.np_dtype:
+                try:
+                    cdt = from_numpy_dtype(
+                        np.promote_types(ldt.np_dtype, rdt.np_dtype))
+                except Exception:
+                    return None
+                if device_hashable.reason_not_supported(cdt):
+                    return None
+                self._bloom_key_dtypes.append(cdt)
+            else:
+                self._bloom_key_dtypes.append(ldt)
+        from ..exprs.bloom_filter import build_bloom
+        from ..exprs.compiler import compile_projection
+        rvals = [self._cast_key(DVal(c.data, c.validity, c.dtype), dt)
+                 for c, dt in zip(compile_projection(
+                     self.right_keys, rs).run(rb), self._bloom_key_dtypes)]
+        return build_bloom(rvals, rb.num_rows)
+
+    @staticmethod
+    def _cast_key(v: DVal, dt) -> DVal:
+        if v.dtype.np_dtype == dt.np_dtype:
+            return v
+        return DVal(v.data.astype(dt.np_dtype), v.validity, dt)
+
+    def _apply_bloom(self, ctx, bloom, lb: ColumnarBatch) -> ColumnarBatch:
+        from ..exprs.compiler import (compile_projection,
+                                      filter_batch_by_mask)
+        ls = lb.schema
+        lvals = [self._cast_key(DVal(c.data, c.validity, c.dtype), dt)
+                 for c, dt in zip(compile_projection(
+                     self.left_keys, ls).run(lb), self._bloom_key_dtypes)]
+        live = jnp.arange(lb.padded_len, dtype=jnp.int32) < lb.num_rows
+        keep = jnp.logical_and(bloom.might_contain_mask(lvals), live)
+        out = filter_batch_by_mask(lb, keep)
+        ctx.metric(self._exec_id, "bloomFilterRowsFiltered").add(
+            lb.num_rows - out.num_rows)
+        return out
 
     # -- sub-partitioned big join (ref GpuSubPartitionHashJoin.scala,
     # JoinPartitioner at GpuShuffledSizedHashJoinExec.scala:1255-1340) ------
@@ -504,6 +571,22 @@ class TpuHashJoinExec(TpuExec):
         return f"HashJoin[{self.join_type}, keys=({k}){c}]"
 
 
+def _common_arrow_type(a, b):
+    """Numeric promotion for host join keys (the device kernel promotes via
+    jnp.promote_types; arrow joins require identical key types). Returns
+    None when no promotion exists — callers keep the original types and
+    let arrow raise its type-mismatch error rather than silently casting
+    one side."""
+    if a.equals(b):
+        return a
+    import pyarrow as pa
+    try:
+        return pa.from_numpy_dtype(np.promote_types(a.to_pandas_dtype(),
+                                                    b.to_pandas_dtype()))
+    except Exception:
+        return None
+
+
 def _empty_batch(schema: Schema) -> ColumnarBatch:
     import pyarrow as pa
     from ..types import to_arrow
@@ -612,12 +695,29 @@ class TpuBroadcastHashJoinExec(TpuHashJoinExec):
             return
         rows_m = ctx.metric(self._exec_id, "numOutputRows", ESSENTIAL)
         bb = build.broadcast(ctx)
+        # runtime bloom filter: built ONCE from the broadcast build side,
+        # applied to every stream batch (build side must be right — the
+        # filter drops stream=left rows whose keys cannot match). Like
+        # every device kernel here, build and probe run under the
+        # semaphore with OOM retry.
+        if bi == 1:
+            def build_bloom_run():
+                with ctx.semaphore.held():
+                    return self._build_bloom(
+                        ctx, self.children[0].output_schema(), bb)
+            bloom = with_retry_no_split(build_bloom_run, ctx.memory)
+        else:
+            bloom = None
         produced = False
         for sb in self.children[1 - bi].execute(ctx):
             def run(sb=sb):
                 with ctx.semaphore.held():
-                    return (self._join(sb, bb) if bi == 1
-                            else self._join(bb, sb))
+                    if bloom is not None and sb.num_rows > 0:
+                        sb2 = self._apply_bloom(ctx, bloom, sb)
+                    else:
+                        sb2 = sb
+                    return (self._join(sb2, bb) if bi == 1
+                            else self._join(bb, sb2))
             out = with_retry_no_split(run, ctx.memory)
             rows_m.add(out.num_rows)
             produced = True
@@ -674,8 +774,13 @@ class CpuJoinExec(TpuExec):
             lkn, rkn = [], []
             for i, (lk, rk) in enumerate(zip(self.left_keys,
                                              self.right_keys)):
-                lt = lt.append_column(f"__jk{i}", lk.eval_host(lb))
-                rt = rt.append_column(f"__jk{i}", rk.eval_host(rb))
+                la = lk.eval_host(lb)
+                ra = rk.eval_host(rb)
+                ct = _common_arrow_type(la.type, ra.type)
+                lt = lt.append_column(
+                    f"__jk{i}", la.cast(ct) if ct is not None else la)
+                rt = rt.append_column(
+                    f"__jk{i}", ra.cast(ct) if ct is not None else ra)
                 lkn.append(f"__jk{i}")
                 rkn.append(f"__jk{i}")
             jt = {"inner": "inner", "left": "left outer",
@@ -722,13 +827,17 @@ class CpuJoinExec(TpuExec):
         if self.left_keys:
             lb = ColumnarBatch.from_arrow(lt, pad=False)
             rb = ColumnarBatch.from_arrow(rt, pad=False)
+            lks = [k.eval_host(lb) for k in self.left_keys]
+            rks = [k.eval_host(rb) for k in self.right_keys]
+            cts = [_common_arrow_type(a.type, b.type)
+                   for a, b in zip(lks, rks)]
             kt_l = pa.table(
-                {f"__jk{i}": k.eval_host(lb)
-                 for i, k in enumerate(self.left_keys)} |
+                {f"__jk{i}": a.cast(ct) if ct is not None else a
+                 for i, (a, ct) in enumerate(zip(lks, cts))} |
                 {"__l": pa.array(np.arange(n_l, dtype=np.int64))})
             kt_r = pa.table(
-                {f"__jk{i}": k.eval_host(rb)
-                 for i, k in enumerate(self.right_keys)} |
+                {f"__jk{i}": a.cast(ct) if ct is not None else a
+                 for i, (a, ct) in enumerate(zip(rks, cts))} |
                 {"__r": pa.array(np.arange(n_r, dtype=np.int64))})
             keys = [f"__jk{i}" for i in range(len(self.left_keys))]
             pairs = kt_l.join(kt_r, keys=keys, right_keys=keys,

@@ -119,16 +119,23 @@ def eval_predicate_device(pred: Expression, batch: ColumnarBatch) -> jnp.ndarray
     return jnp.logical_and(col.data, col.validity)
 
 
-def filter_batch_device(pred: Expression, batch: ColumnarBatch) -> ColumnarBatch:
-    """Device filter over an all-device batch (host columns unsupported here —
-    the planner falls back for those)."""
-    keep = eval_predicate_device(pred, batch)
+def filter_batch_by_mask(batch: ColumnarBatch, keep,
+                         schema=None) -> ColumnarBatch:
+    """Compact the batch's rows where ``keep`` (bool over padded rows) is
+    True; the single home of the mask→compact→rebatch idiom."""
     arrays = [(c.data, c.validity) for c in batch.columns]
     outs, count = _compact_kernel(arrays, keep, batch.padded_len)
     new_cols = [DeviceColumn(d, v, c.dtype)
                 for (d, v), c in zip(outs, batch.columns)]
-    return ColumnarBatch(new_cols, int(count), batch.schema,
+    return ColumnarBatch(new_cols, int(count),
+                         schema if schema is not None else batch.schema,
                          meta=batch.meta)
+
+
+def filter_batch_device(pred: Expression, batch: ColumnarBatch) -> ColumnarBatch:
+    """Device filter over an all-device batch (host columns unsupported here —
+    the planner falls back for those)."""
+    return filter_batch_by_mask(batch, eval_predicate_device(pred, batch))
 
 
 @functools.partial(jax.jit, static_argnums=(2,))

@@ -348,4 +348,4 @@ class AvroScanExec(FileScanBase):
     READER_TYPE_KEY = AVRO_READER_TYPE
 
     def _read_table(self, path: str):
-        return read_avro_table(path, self.columns)
+        return read_avro_table(self._cached_path(path), self.columns)

@@ -72,6 +72,15 @@ class FileScanBase(TpuExec):
         still runs)."""
         self.predicate = pred
 
+    def _cached_path(self, path: str) -> str:
+        """FileCache indirection (ref FileCache hook surface; metrics
+        filecacheHits/Misses mirror GpuExec.scala:78-87)."""
+        from .filecache import FileCache
+        fc = FileCache.get(self.conf)
+        if fc is None:
+            return path
+        return fc.resolve(path)
+
     def _read_table(self, path: str):
         raise NotImplementedError
 

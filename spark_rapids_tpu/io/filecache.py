@@ -1,0 +1,115 @@
+"""FileCache: local cache of (remote) input files (ref the FileCache whose
+implementation lives in the private rapids-4-spark-private artifact — only
+its hook surface is public: FileCacheLocalityManager RPC Plugin.scala:425,
+metrics GpuExec.scala:78-87, confs, and
+tests/.../filecache/FileCacheIntegrationSuite.scala. This is a from-scratch
+implementation of that surface).
+
+Files are cached under ``spark.rapids.tpu.filecache.path`` keyed by
+(absolute path, mtime, size) so source updates invalidate naturally; an LRU
+size budget evicts cold entries. Scans consult the cache transparently via
+FileScanBase when ``spark.rapids.tpu.filecache.enabled`` is on."""
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+import threading
+from typing import Dict, Optional
+
+from ..config import register
+
+__all__ = ["FileCache"]
+
+FILECACHE_ENABLED = register(
+    "spark.rapids.tpu.filecache.enabled", False,
+    "Cache input files on local disk before reading "
+    "(ref spark.rapids.filecache.enabled).")
+
+FILECACHE_PATH = register(
+    "spark.rapids.tpu.filecache.path", "/tmp/spark_rapids_tpu_filecache",
+    "Local directory for the file cache.")
+
+FILECACHE_MAX_BYTES = register(
+    "spark.rapids.tpu.filecache.maxBytes", 10 * 1024 * 1024 * 1024,
+    "File-cache size budget; least-recently-used entries evict first.")
+
+
+class FileCache:
+    _instances: Dict[str, "FileCache"] = {}
+    _lock = threading.Lock()
+
+    def __init__(self, path: str, max_bytes: int):
+        self.path = path
+        self.max_bytes = max_bytes
+        self.hits = 0
+        self.misses = 0
+        self._io_lock = threading.Lock()
+        # thread ident -> the path resolve() last handed that thread: a
+        # concurrent miss's eviction must not unlink it before the
+        # reader opens it
+        self._in_use: Dict[int, str] = {}
+        os.makedirs(path, exist_ok=True)
+
+    @classmethod
+    def get(cls, conf) -> Optional["FileCache"]:
+        if not conf.get(FILECACHE_ENABLED):
+            return None
+        p = str(conf.get(FILECACHE_PATH))
+        with cls._lock:
+            if p not in cls._instances:
+                cls._instances[p] = cls(p, int(conf.get(FILECACHE_MAX_BYTES)))
+            return cls._instances[p]
+
+    # ------------------------------------------------------------------
+    def _key(self, path: str) -> str:
+        st = os.stat(path)
+        raw = f"{os.path.abspath(path)}|{st.st_mtime_ns}|{st.st_size}"
+        return hashlib.sha256(raw.encode()).hexdigest()[:32] + \
+            os.path.splitext(path)[1]
+
+    def resolve(self, path: str) -> str:
+        """Local cached path for ``path`` (copying in on miss).
+        Thread-safe: resolve/evict hold the instance lock so a concurrent
+        miss cannot evict an entry this call just handed out; cross-process
+        sharers are safe via unique tmp names + atomic rename and the
+        eviction grace window."""
+        with self._io_lock:
+            local = os.path.join(self.path, self._key(path))
+            if os.path.exists(local):
+                self.hits += 1
+                os.utime(local)          # LRU touch
+                self._in_use[threading.get_ident()] = local
+                return local
+            self.misses += 1
+            self._evict_for(os.path.getsize(path))
+            tmp = f"{local}.{os.getpid()}.{threading.get_ident()}.tmp"
+            try:
+                shutil.copyfile(path, tmp)
+                os.replace(tmp, local)
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+            self._in_use[threading.get_ident()] = local
+            return local
+
+    def _evict_for(self, incoming: int) -> None:
+        protected = set(self._in_use.values())
+        entries = []
+        total = 0
+        for f in os.listdir(self.path):
+            full = os.path.join(self.path, f)
+            if os.path.isfile(full):
+                st = os.stat(full)
+                entries.append((st.st_atime, st.st_size, full))
+                total += st.st_size
+        entries.sort()
+        while entries and total + incoming > self.max_bytes:
+            _, sz, full = entries.pop(0)
+            if full in protected:
+                continue
+            try:
+                os.unlink(full)
+            except OSError:
+                continue                 # raced with another evictor; keep going
+            total -= sz

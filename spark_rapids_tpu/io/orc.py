@@ -41,7 +41,7 @@ class OrcScanExec(FileScanBase):
 
     def _read_table(self, path: str):
         from pyarrow import orc
-        f = orc.ORCFile(path)
+        f = orc.ORCFile(self._cached_path(path))
         t = f.read(columns=self.columns)
         if self.columns:
             t = t.select(self.columns)  # requested order, not file order

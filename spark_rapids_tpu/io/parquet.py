@@ -55,7 +55,7 @@ class ParquetScanExec(FileScanBase):
     # ---------------------------------------------------------- reading
     def _read_table(self, path: str):
         import pyarrow.parquet as pq
-        f = pq.ParquetFile(path)
+        f = pq.ParquetFile(self._cached_path(path))
         groups = self._filter_row_groups(f)
         if groups is None:
             t = f.read(columns=self.columns)
