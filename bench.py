@@ -1,9 +1,9 @@
-"""Benchmark: TPC-H-Q1-shaped aggregation pipeline on the device engine.
+"""Benchmark: real TPC-H Q1 on the device engine (BASELINE.md ladder #2).
 
-Mirrors BASELINE.md config ladder steps 1-2: 1M-row filter+project+grouped
-aggregation (sum/avg/count per key) — the hot pattern of the reference's NDS
-benchmarks. Baseline = the same query through pandas on this host's CPU
-(the role CPU Spark plays for the reference's speedup claims).
+Generated lineitem (benchmarks/tpch.py, TPC-H column domains), the full Q1
+pricing-summary query — date filter -> projections -> string-keyed grouped
+aggregation (8 aggregates). Baseline = the same query through pandas on
+this host's CPU (the role CPU Spark plays for the reference's speedups).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 Env: SRTPU_BENCH_CPU=1 forces the JAX CPU backend; SRTPU_BENCH_ROWS
@@ -32,32 +32,15 @@ def main():
 
     from spark_rapids_tpu.api import TpuSession, functions as F
 
+    from benchmarks import tpch
+
     n = int(os.environ.get("SRTPU_BENCH_ROWS", 1_000_000))
-    rng = np.random.RandomState(42)
-    data = {
-        "k": rng.randint(0, 1000, size=n).astype(np.int64),
-        "status": rng.randint(0, 4, size=n).astype(np.int32),
-        "qty": rng.randint(1, 51, size=n).astype(np.int64),
-        "price": (rng.random_sample(n) * 1000).astype(np.float64),
-        "disc": (rng.random_sample(n) * 0.1).astype(np.float64),
-    }
-    table = pa.table({k: pa.array(v) for k, v in data.items()})
-    log(f"bench: {n} rows on {jax.devices()[0].platform}")
+    table = tpch.gen_lineitem(n)
+    log(f"bench: TPC-H Q1, {n}-row lineitem on {jax.devices()[0].platform}")
 
     def run_engine():
         s = TpuSession()
-        df = s.create_dataframe(table)
-        out = (df.filter(F.col("status") < 3)
-               .with_column("gross", F.col("price") * F.col("qty"))
-               .with_column("net", F.col("price") * F.col("qty")
-                            * (1.0 - F.col("disc")))
-               .group_by("k")
-               .agg(F.sum(F.col("qty")).with_name("sum_qty"),
-                    F.sum(F.col("gross")).with_name("sum_gross"),
-                    F.sum(F.col("net")).with_name("sum_net"),
-                    F.avg(F.col("price")).with_name("avg_price"),
-                    F.count_star().with_name("cnt")))
-        return out.collect_arrow()
+        return tpch.q1(s.create_dataframe(table), F).collect_arrow()
 
     # warm-up (compilation) then timed runs
     t0 = time.perf_counter()
@@ -73,29 +56,37 @@ def main():
     log(f"bench: engine {engine_s:.3f}s/iter -> {engine_rate:,.0f} rows/s")
 
     # pandas CPU baseline (the reference's CPU-Spark role)
-    import pandas as pd
-    pdf = table.to_pandas()
+    cutoff = np.datetime64("1998-12-01") - np.timedelta64(90, "D")
+    pdf = table.to_pandas(date_as_object=False)
     t0 = time.perf_counter()
     for _ in range(iters):
-        f = pdf[pdf["status"] < 3].copy()
-        f["gross"] = f["price"] * f["qty"]
-        f["net"] = f["gross"] * (1.0 - f["disc"])
-        base = f.groupby("k").agg(
-            sum_qty=("qty", "sum"), sum_gross=("gross", "sum"),
-            sum_net=("net", "sum"), avg_price=("price", "mean"),
-            cnt=("qty", "size"))
+        f = pdf[pdf["l_shipdate"] <= cutoff.astype("datetime64[ns]")].copy()
+        f["disc_price"] = f["l_extendedprice"] * (1.0 - f["l_discount"])
+        f["charge"] = f["disc_price"] * (1.0 + f["l_tax"])
+        base = f.groupby(["l_returnflag", "l_linestatus"]).agg(
+            sum_qty=("l_quantity", "sum"),
+            sum_base_price=("l_extendedprice", "sum"),
+            sum_disc_price=("disc_price", "sum"),
+            sum_charge=("charge", "sum"),
+            avg_qty=("l_quantity", "mean"),
+            avg_price=("l_extendedprice", "mean"),
+            avg_disc=("l_discount", "mean"),
+            count_order=("l_quantity", "size")).sort_index()
     base_s = (time.perf_counter() - t0) / iters
     base_rate = n / base_s
     log(f"bench: pandas {base_s:.3f}s/iter -> {base_rate:,.0f} rows/s")
 
     # correctness spot-check against the baseline
-    got = res.to_pandas().set_index("k").sort_index()
-    np.testing.assert_allclose(got["sum_net"].to_numpy(),
-                               base.sort_index()["sum_net"].to_numpy(),
+    got = res.to_pandas().set_index(["l_returnflag", "l_linestatus"]) \
+             .sort_index()
+    np.testing.assert_allclose(got["sum_disc_price"].to_numpy(),
+                               base["sum_disc_price"].to_numpy(),
                                rtol=1e-9)
+    np.testing.assert_array_equal(got["count_order"].to_numpy(),
+                                  base["count_order"].to_numpy())
 
     print(json.dumps({
-        "metric": "q1_like_agg_rows_per_sec",
+        "metric": "tpch_q1_rows_per_sec",
         "value": round(engine_rate, 1),
         "unit": "rows/s",
         "vs_baseline": round(engine_rate / base_rate, 3),

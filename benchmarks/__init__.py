@@ -1,0 +1,4 @@
+"""TPC-derived benchmark queries and data generators (ref: the NDS/TPC-DS
+suites the reference benchmarks against live in NVIDIA/spark-rapids-benchmarks;
+BASELINE.md config ladder steps 2-3 name TPC-H SF10 q1/q6 and TPC-DS SF100
+q3/q9/q28 as this repo's targets)."""

@@ -1,0 +1,119 @@
+"""TPC-DS subset: store_sales/date_dim/item generators + q3/q9(/q28).
+
+q3  — star join (date_dim x store_sales x item) into a string-keyed grouped
+      aggregation with a descending order by aggregate.
+q9  — conditional aggregation: bucketed sums/avgs/counts over quantity
+      ranges via CASE WHEN, the engine-level execution of the reference's
+      scalar-subquery formulation.
+q28 — bucketed avg/count + count(distinct) over list-price ranges (needs
+      distinct aggregate support).
+"""
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+
+BRANDS = [f"brand#{i}" for i in range(1, 61)]
+
+
+def gen_store_sales(n_rows: int, seed: int = 7, n_items: int = 2000,
+                    n_dates: int = 1826) -> pa.Table:
+    rng = np.random.RandomState(seed)
+    return pa.table({
+        "ss_sold_date_sk": pa.array(rng.randint(0, n_dates, n_rows)),
+        "ss_item_sk": pa.array(rng.randint(0, n_items, n_rows)),
+        "ss_customer_sk": pa.array(rng.randint(0, n_rows // 8 + 2, n_rows)),
+        "ss_quantity": pa.array(rng.randint(1, 101, n_rows)),
+        "ss_ext_sales_price": pa.array(
+            np.round(rng.uniform(1.0, 20000.0, n_rows), 2)),
+        "ss_ext_discount_amt": pa.array(
+            np.round(rng.uniform(0.0, 1000.0, n_rows), 2)),
+        "ss_net_paid": pa.array(np.round(rng.uniform(1.0, 20000.0, n_rows),
+                                         2)),
+        "ss_net_profit": pa.array(
+            np.round(rng.uniform(-5000.0, 5000.0, n_rows), 2)),
+        "ss_list_price": pa.array(np.round(rng.uniform(1.0, 200.0, n_rows),
+                                           2)),
+        "ss_coupon_amt": pa.array(np.round(rng.uniform(0.0, 500.0, n_rows),
+                                           2)),
+        "ss_wholesale_cost": pa.array(
+            np.round(rng.uniform(1.0, 100.0, n_rows), 2)),
+    })
+
+
+def gen_date_dim(n_dates: int = 1826, seed: int = 8) -> pa.Table:
+    # 5 years of days starting 1998-01-01
+    days = np.arange(n_dates)
+    dates = np.datetime64("1998-01-01") + days
+    years = dates.astype("datetime64[Y]").astype(int) + 1970
+    moys = dates.astype("datetime64[M]").astype(int) % 12 + 1
+    return pa.table({
+        "d_date_sk": pa.array(days),
+        "d_date": pa.array(dates.astype("datetime64[D]")),
+        "d_year": pa.array(years.astype(np.int32)),
+        "d_moy": pa.array(moys.astype(np.int32)),
+    })
+
+
+def gen_item(n_items: int = 2000, seed: int = 9) -> pa.Table:
+    rng = np.random.RandomState(seed)
+    return pa.table({
+        "i_item_sk": pa.array(np.arange(n_items)),
+        "i_brand_id": pa.array(rng.randint(1, 61, n_items).astype(np.int32)),
+        "i_brand": pa.array([BRANDS[b - 1] for b in
+                             rng.randint(1, 61, n_items)]),
+        "i_manufact_id": pa.array(rng.randint(1, 251, n_items)
+                                  .astype(np.int32)),
+    })
+
+
+def q3(store_sales, date_dim, item, F, manufact_id: int = 128):
+    """Brand revenue by year for one manufacturer, November only."""
+    return (store_sales
+            .join(date_dim.filter(F.col("d_moy") == F.lit(11)),
+                  on=[("ss_sold_date_sk", "d_date_sk")])
+            .join(item.filter(F.col("i_manufact_id") == F.lit(manufact_id)),
+                  on=[("ss_item_sk", "i_item_sk")])
+            .group_by("d_year", "i_brand_id", "i_brand")
+            .agg(F.sum(F.col("ss_ext_sales_price")).with_name("sum_agg"))
+            .order_by("d_year", F.desc("sum_agg"), "i_brand_id"))
+
+
+def q9(store_sales, F):
+    """Bucketed quantity-range statistics via conditional aggregation."""
+    aggs = []
+    buckets = [(1, 20), (21, 40), (41, 60), (61, 80), (81, 100)]
+    for i, (lo, hi) in enumerate(buckets, 1):
+        in_b = ((F.col("ss_quantity") >= F.lit(lo))
+                & (F.col("ss_quantity") <= F.lit(hi)))
+        one_if = F.when(in_b, F.lit(1)).otherwise(F.lit(None))
+        price_if = F.when(in_b, F.col("ss_ext_sales_price")) \
+                    .otherwise(F.lit(None))
+        paid_if = F.when(in_b, F.col("ss_net_paid")).otherwise(F.lit(None))
+        aggs += [F.count(one_if).with_name(f"cnt{i}"),
+                 F.avg(price_if).with_name(f"avg_price{i}"),
+                 F.avg(paid_if).with_name(f"avg_paid{i}")]
+    return store_sales.agg(*aggs)
+
+
+def q28(store_sales, F):
+    """Bucketed list-price stats incl. distinct counts (6 buckets)."""
+    buckets = [(0, 5, 11, 460, 14930), (6, 10, 91, 1430, 32370),
+               (11, 15, 66, 1480, 3750), (16, 20, 142, 3270, 21910),
+               (21, 25, 135, 2450, 17300), (26, 30, 28, 2340, 33660)]
+    outs = []
+    for lo, hi, lp, cp, wc in buckets:
+        b = store_sales.filter(
+            (F.col("ss_quantity") >= F.lit(lo))
+            & (F.col("ss_quantity") <= F.lit(hi))
+            & ((F.col("ss_list_price") >= F.lit(float(lp)))
+               | (F.col("ss_coupon_amt") >= F.lit(float(cp)))
+               | (F.col("ss_wholesale_cost") >= F.lit(float(wc)))))
+        outs.append(b.agg(
+            F.avg(F.col("ss_list_price")).with_name("b_avg"),
+            F.count(F.col("ss_list_price")).with_name("b_cnt"),
+            F.count_distinct(F.col("ss_list_price")).with_name("b_cntd")))
+    res = outs[0]
+    for o in outs[1:]:
+        res = res.union(o)
+    return res

@@ -404,6 +404,9 @@ class DataFrame:
         return self.collect_arrow().to_pylist()
 
     def count(self) -> int:
+        # count(*) as an aggregation: column pruning trims the scan to one
+        # column and the aggregate's single-fetch path makes the whole
+        # count one device round trip
         from .functions import count_star
         t = self.agg(count_star().with_name("n")).collect_arrow()
         return t.column("n")[0].as_py()

@@ -401,6 +401,11 @@ def sum(c): return Sum(_to_expr(c))
 def count(c): return Count(_to_expr(c))
 def count_star(): return CountStar()
 def avg(c): return Average(_to_expr(c))
+def count_distinct(c): return Count(_to_expr(c)).as_distinct()
+def sum_distinct(c): return Sum(_to_expr(c)).as_distinct()
+def avg_distinct(c): return Average(_to_expr(c)).as_distinct()
+countDistinct = count_distinct
+sumDistinct = sum_distinct
 mean = avg
 def min(c): return Min(_to_expr(c))
 def max(c): return Max(_to_expr(c))

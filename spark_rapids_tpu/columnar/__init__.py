@@ -1,6 +1,6 @@
 from .bucketing import DEFAULT_BUCKETS, bucket_for, padded_len
-from .column import Column, DeviceColumn, HostColumn
+from .column import Column, DeviceColumn, DictColumn, HostColumn
 from .batch import ColumnarBatch, concat_batches
 
 __all__ = ["DEFAULT_BUCKETS", "bucket_for", "padded_len", "Column",
-           "DeviceColumn", "HostColumn", "ColumnarBatch", "concat_batches"]
+           "DeviceColumn", "DictColumn", "HostColumn", "ColumnarBatch", "concat_batches"]

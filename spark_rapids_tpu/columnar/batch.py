@@ -13,11 +13,41 @@ from typing import List, Optional, Sequence, Union
 
 import numpy as np
 
-from ..types import DataType, Schema, StructField, from_arrow
+from ..types import STRING, DataType, Schema, StructField, from_arrow
 from .bucketing import DEFAULT_BUCKETS, bucket_for
-from .column import DeviceColumn, HostColumn
+from .column import DeviceColumn, DictColumn, HostColumn
 
 ColumnLike = Union[DeviceColumn, HostColumn]
+
+#: dictionary-encode string columns into device codes when the cardinality
+#: is below this fraction of rows (and the absolute cap). Flip to 0 to
+#: force host strings (tests use this to cover both paths).
+DICT_ENCODE_MAX_FRACTION = 0.5
+DICT_ENCODE_MAX_CARD = 1 << 20
+
+
+def _try_dict_encode(col, n: int, p: int):
+    """pa string array -> (codes, valid, sorted dictionary) or None."""
+    import pyarrow as pa
+    if n == 0 or DICT_ENCODE_MAX_FRACTION <= 0:
+        return None
+    de = col.dictionary_encode()
+    card = len(de.dictionary)
+    if card > min(n * DICT_ENCODE_MAX_FRACTION + 1, DICT_ENCODE_MAX_CARD):
+        return None
+    dvals = de.dictionary.to_numpy(zero_copy_only=False)
+    order = np.argsort(dvals)          # codepoint == UTF-8 byte order
+    rank = np.empty(card, np.int32)
+    rank[order] = np.arange(card, dtype=np.int32)
+    valid = ~np.asarray(de.indices.is_null())
+    local = np.asarray(de.indices.fill_null(0).to_numpy(
+        zero_copy_only=False), dtype=np.int64)
+    codes = rank[local] if card else np.zeros(n, np.int32)
+    d = np.zeros(p, np.int32)
+    v = np.zeros(p, bool)
+    d[:n] = codes
+    v[:n] = valid
+    return d, v, dvals[order]
 
 
 class ColumnarBatch:
@@ -81,12 +111,15 @@ class ColumnarBatch:
                    pad: bool = True) -> "ColumnarBatch":
         """Arrow table -> batch; device-backed types are H2D'd padded to the
         row bucket (ref HostColumnarToGpu / GpuRowToColumnarExec device copy)."""
+        import jax
         import pyarrow as pa
         import pyarrow.compute as pc
         n = table.num_rows
         p = bucket_for(n, buckets) if pad else n
         cols: List[ColumnLike] = []
         fields: List[StructField] = []
+        staged = []    # (col index, dtype) for one batched H2D at the end
+        host_pairs = []
         for name, col in zip(table.column_names, table.columns):
             if isinstance(col, pa.ChunkedArray):
                 col = col.combine_chunks() if col.num_chunks != 1 else col.chunk(0)
@@ -106,11 +139,51 @@ class ColumnarBatch:
                 mask = np.asarray(col.is_null())
                 fill = False if pa.types.is_boolean(arr.type) else 0
                 vals = arr.fill_null(fill).to_numpy(zero_copy_only=False)
-                cols.append(DeviceColumn.from_numpy(
-                    vals, dt, mask=~mask, padded_len=p))
+                d, v = DeviceColumn.host_prepare(vals, dt, mask=~mask,
+                                                 padded_len=p)
+                staged.append((len(cols), dt, None))
+                host_pairs.extend([d, v])
+                cols.append(None)
             else:
-                cols.append(HostColumn(col, dt))
+                # only the padded (device-bound) path dict-encodes; host
+                # execs using pad=False want plain host strings
+                enc = (_try_dict_encode(col, n, p)
+                       if dt == STRING and pad else None)
+                if enc is not None:
+                    d, v, dictionary = enc
+                    staged.append((len(cols), dt, dictionary))
+                    host_pairs.extend([d, v])
+                    cols.append(None)
+                else:
+                    cols.append(HostColumn(col, dt))
+        if staged:
+            # ONE device_put for the whole table: each separate transfer
+            # pays a full round trip on a tunneled TPU backend
+            put = jax.device_put(host_pairs)
+            for k, (i, dt, dictionary) in enumerate(staged):
+                if dictionary is None:
+                    cols[i] = DeviceColumn(put[2 * k], put[2 * k + 1], dt)
+                else:
+                    cols[i] = DictColumn(put[2 * k], put[2 * k + 1], dt,
+                                         dictionary)
         return ColumnarBatch(cols, n, Schema(fields))
+
+    @staticmethod
+    def from_arrow_host(table) -> "ColumnarBatch":
+        """Arrow table -> batch of HostColumns only (no device transfer):
+        for terminal host stages (final sort feeding collect) whose output
+        would otherwise bounce host->device->host through the tunnel."""
+        import pyarrow as pa
+        cols: List[ColumnLike] = []
+        fields: List[StructField] = []
+        for name, col in zip(table.column_names, table.columns):
+            if isinstance(col, pa.ChunkedArray):
+                col = col.combine_chunks() if col.num_chunks != 1 \
+                    else col.chunk(0)
+            dt = from_arrow(col.type)
+            fields.append(StructField(name, dt, True))
+            cols.append(HostColumn(col, dt))
+        return ColumnarBatch(cols, table.num_rows, Schema(fields))
 
     @staticmethod
     def from_pandas(df, buckets: Sequence[int] = DEFAULT_BUCKETS) -> "ColumnarBatch":
@@ -119,13 +192,42 @@ class ColumnarBatch:
                                         buckets)
 
     def to_arrow(self):
+        import jax
         import pyarrow as pa
-        arrays = [c.to_arrow(self.num_rows) for c in self.columns]
-        names = self.schema.names()
-        return pa.Table.from_arrays(arrays, names=names)
+        # ONE device_get for every device column (all copies issued async,
+        # then awaited together — a tunneled TPU pays per-transfer latency)
+        dev = [(i, c) for i, c in enumerate(self.columns)
+               if isinstance(c, DeviceColumn)]
+        fetched = {}
+        if dev:
+            got = jax.device_get(
+                [x for _, c in dev for x in (c.data, c.validity)])
+            for k, (i, c) in enumerate(dev):
+                fetched[i] = (got[2 * k][:self.num_rows],
+                              got[2 * k + 1][:self.num_rows])
+        arrays = []
+        for i, c in enumerate(self.columns):
+            if i in fetched:
+                arrays.append(c.arrow_from_host(*fetched[i]))
+            else:
+                arrays.append(c.to_arrow(self.num_rows))
+        return pa.Table.from_arrays(arrays, names=self.schema.names())
 
     def to_pandas(self):
         return self.to_arrow().to_pandas()
+
+    def ensure_device(self) -> "ColumnarBatch":
+        """Re-materialize device-backed columns that are host-resident
+        (an upstream exec produced a host batch — e.g. the aggregate's
+        single-fetch path or a host sort) back into HBM. No-op when
+        every device-backed column is already on device."""
+        needs = any(isinstance(c, HostColumn) and f.dtype.device_backed
+                    for c, f in zip(self.columns, self.schema.fields))
+        if not needs:
+            return self
+        out = ColumnarBatch.from_arrow(self.to_arrow())
+        out.meta = self.meta
+        return out
 
     # -- ops used by the runtime ------------------------------------------
     def slice(self, offset: int, length: int) -> "ColumnarBatch":

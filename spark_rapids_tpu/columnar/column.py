@@ -40,9 +40,13 @@ class DeviceColumn:
 
     # -- constructors ------------------------------------------------------
     @staticmethod
-    def from_numpy(values: np.ndarray, dtype: DataType,
-                   mask: Optional[np.ndarray] = None,
-                   padded_len: Optional[int] = None) -> "DeviceColumn":
+    def host_prepare(values: np.ndarray, dtype: DataType,
+                     mask: Optional[np.ndarray] = None,
+                     padded_len: Optional[int] = None):
+        """Build the padded host (data, validity) numpy pair for a column —
+        split from the device transfer so callers can batch many columns
+        into ONE device_put (each blocking transfer pays a full round trip
+        on a tunneled TPU)."""
         n = len(values)
         p = padded_len if padded_len is not None else n
         if p < n:
@@ -59,11 +63,24 @@ class DeviceColumn:
             m = np.asarray(mask, dtype=np.bool_)
             out[:n] = np.where(m, vals, np_dt.type(0))
             valid[:n] = m
+        return out, valid
+
+    @staticmethod
+    def from_numpy(values: np.ndarray, dtype: DataType,
+                   mask: Optional[np.ndarray] = None,
+                   padded_len: Optional[int] = None) -> "DeviceColumn":
+        out, valid = DeviceColumn.host_prepare(values, dtype, mask,
+                                               padded_len)
         return DeviceColumn(jnp.asarray(out), jnp.asarray(valid), dtype)
 
     @staticmethod
     def all_valid(data, dtype: DataType) -> "DeviceColumn":
         return DeviceColumn(data, jnp.ones(data.shape, dtype=jnp.bool_), dtype)
+
+    def with_arrays(self, data, validity) -> "DeviceColumn":
+        """Rebuild this column around row-rearranged arrays (gather /
+        compact / concat) — subclasses carry their extra state across."""
+        return DeviceColumn(data, validity, self.dtype)
 
     # -- properties --------------------------------------------------------
     @property
@@ -84,24 +101,36 @@ class DeviceColumn:
         v = np.asarray(jax.device_get(self.validity))[:num_rows]
         return d, v
 
+    def arrow_from_host(self, d: np.ndarray, v: np.ndarray):
+        """Assemble the arrow array from already-fetched host (data,
+        validity) — the fetch itself is batched at the ColumnarBatch level
+        (one device_get round trip for the whole batch)."""
+        return arrow_from_numpy(d, v, self.dtype)
+
     def to_arrow(self, num_rows: int):
-        import pyarrow as pa
         d, v = self.to_numpy(num_rows)
-        at = to_arrow(self.dtype)
-        if self.dtype == TIMESTAMP:
-            return pa.Array.from_pandas(d, mask=~v).cast(pa.int64()).cast(at)
-        if self.dtype == DATE:
-            return pa.Array.from_pandas(d, mask=~v).cast(pa.int32()).cast(at)
-        if isinstance(self.dtype, DecimalType):
-            import decimal as _dec
-            scale = self.dtype.scale
-            py = [None if not ok else _dec.Decimal(int(x)).scaleb(-scale)
-                  for x, ok in zip(d.tolist(), v.tolist())]
-            return pa.array(py, type=at)
-        return pa.Array.from_pandas(d, mask=~v, type=at)
+        return self.arrow_from_host(d, v)
 
     def __repr__(self):
         return f"DeviceColumn({self.dtype.name}, padded={self.padded_len})"
+
+
+def arrow_from_numpy(d: np.ndarray, v: np.ndarray, dtype: DataType):
+    """Host (data, validity) numpy pair -> arrow array of the declared
+    logical type (shared by every D2H materialization path)."""
+    import pyarrow as pa
+    at = to_arrow(dtype)
+    if dtype == TIMESTAMP:
+        return pa.Array.from_pandas(d, mask=~v).cast(pa.int64()).cast(at)
+    if dtype == DATE:
+        return pa.Array.from_pandas(d, mask=~v).cast(pa.int32()).cast(at)
+    if isinstance(dtype, DecimalType):
+        import decimal as _dec
+        scale = dtype.scale
+        py = [None if not ok else _dec.Decimal(int(x)).scaleb(-scale)
+              for x, ok in zip(d.tolist(), v.tolist())]
+        return pa.array(py, type=at)
+    return pa.Array.from_pandas(d, mask=~v, type=at)
 
 
 def _flatten_device_column(c: DeviceColumn):
@@ -115,6 +144,70 @@ def _unflatten_device_column(dtype, children):
 
 jax.tree_util.register_pytree_node(
     DeviceColumn, _flatten_device_column, _unflatten_device_column)
+
+
+class DictColumn(DeviceColumn):
+    """A STRING column living in HBM as dictionary codes.
+
+    TPU-first design for SURVEY.md hard-part #2 (strings in HBM without
+    cudf): ``data`` holds int32 codes into a SORTED host-side dictionary,
+    so equality AND relative order of codes match the string semantics
+    (UTF-8 byte order == codepoint order). Row-rearranging device kernels
+    (filter compaction, join gathers, partition scatter) move the codes
+    like any fixed-width column — strings never round-trip through the
+    host on the hot path; only final materialization decodes.
+
+    The reference holds strings in device memory via cudf's offset+char
+    layout; codes+dictionary is the XLA-friendly equivalent (static
+    widths, MXU/VPU-amenable, no ragged buffers)."""
+
+    __slots__ = ("dictionary",)
+
+    def __init__(self, data, validity, dtype: DataType,
+                 dictionary: np.ndarray):
+        super().__init__(data, validity, dtype)
+        self.dictionary = dictionary     # np object/str array, sorted
+
+    def with_arrays(self, data, validity) -> "DictColumn":
+        return DictColumn(data, validity, self.dtype, self.dictionary)
+
+    def to_numpy(self, num_rows: int):
+        codes, v = super().to_numpy(num_rows)
+        vals = self.dictionary[np.clip(codes, 0, len(self.dictionary) - 1)] \
+            if len(self.dictionary) else np.full(len(codes), "", object)
+        return vals, v
+
+    def arrow_from_host(self, d: np.ndarray, v: np.ndarray):
+        """``d`` holds CODES here (what lives on device), not strings."""
+        import pyarrow as pa
+        if not len(self.dictionary):
+            return pa.nulls(len(d), type=pa.string())
+        idx = pa.array(np.clip(d, 0, len(self.dictionary) - 1)
+                       .astype(np.int64), mask=~v)
+        return pa.array(self.dictionary, type=pa.string()).take(idx)
+
+    def to_arrow(self, num_rows: int):
+        codes = np.asarray(jax.device_get(self.data))[:num_rows]
+        v = np.asarray(jax.device_get(self.validity))[:num_rows]
+        return self.arrow_from_host(codes, v)
+
+    def __repr__(self):
+        return (f"DictColumn(card={len(self.dictionary)}, "
+                f"padded={self.padded_len})")
+
+
+def _flatten_dict_column(c: DictColumn):
+    return (c.data, c.validity), (c.dtype, c.dictionary)
+
+
+def _unflatten_dict_column(aux, children):
+    dtype, dictionary = aux
+    data, validity = children
+    return DictColumn(data, validity, dtype, dictionary)
+
+
+jax.tree_util.register_pytree_node(
+    DictColumn, _flatten_dict_column, _unflatten_dict_column)
 
 
 class HostColumn:

@@ -4,7 +4,7 @@ GpuFilterExec:806, GpuRangeExec:1137; GpuCoalesceBatches.scala:112).
 """
 from __future__ import annotations
 
-from typing import Iterator, List, Optional, Sequence
+from typing import Dict, Iterator, List, Optional, Sequence
 
 import jax.numpy as jnp
 import numpy as np
@@ -37,13 +37,87 @@ def _reset_task_state(exprs):
         stack.extend(e.children)
 
 
-class InMemoryScanExec(TpuExec):
-    """Scan over pre-partitioned Arrow tables (ref GpuInMemoryTableScanExec)."""
+#: device-batch cache for repeated scans of the same Arrow table (the
+#: HostColumnarToGpu analog of keeping broadcast/shuffle data
+#: device-resident): weak-keyed on the table so memory frees with it,
+#: LRU-bounded so it cannot starve the spillable memory pool (the entries
+#: live OUTSIDE the retry framework's reach — eviction here is the only
+#: pressure valve)
+import weakref
 
-    def __init__(self, tables, schema: Schema, batch_rows: int = 1 << 20):
+from ..config import register as _register_conf
+
+SCAN_CACHE_MAX_BYTES = _register_conf(
+    "spark.rapids.tpu.sql.scanCache.maxBytes", 2 * 1024 * 1024 * 1024,
+    "Device-memory budget for cached in-memory-table scan batches; "
+    "least-recently-used entries evict first. 0 disables the cache.")
+
+_SCAN_CACHE: "weakref.WeakValueDictionary" = weakref.WeakValueDictionary()
+_SCAN_CACHE_BATCHES: Dict[tuple, list] = {}
+_SCAN_CACHE_LRU: Dict[tuple, int] = {}
+_SCAN_CACHE_TICK = [0]
+
+
+def _scan_cache_get(t, key):
+    if _SCAN_CACHE.get(id(t)) is t:
+        k = (id(t),) + key
+        got = _SCAN_CACHE_BATCHES.get(k)
+        if got is not None:
+            _SCAN_CACHE_TICK[0] += 1
+            _SCAN_CACHE_LRU[k] = _SCAN_CACHE_TICK[0]
+        return got
+    return None
+
+
+def _scan_cache_bytes() -> int:
+    return sum(b.device_size_bytes() for bs in _SCAN_CACHE_BATCHES.values()
+               for b in bs)
+
+
+def _scan_cache_put(t, key, batches, limit: int):
+    if limit <= 0:
+        return
+    new_bytes = sum(b.device_size_bytes() for b in batches)
+    if new_bytes > limit:
+        return
+    # LRU-evict until the new entry fits
+    while _SCAN_CACHE_BATCHES and _scan_cache_bytes() + new_bytes > limit:
+        coldest = min(_SCAN_CACHE_LRU, key=_SCAN_CACHE_LRU.get)
+        del _SCAN_CACHE_BATCHES[coldest]
+        del _SCAN_CACHE_LRU[coldest]
+    tid = id(t)
+    if _SCAN_CACHE.get(tid) is not t:
+        # new table under a reused id: drop stale entries for that id
+        _scan_cache_evict(tid)
+        try:
+            _SCAN_CACHE[tid] = t
+        except TypeError:
+            return      # not weak-referenceable: skip caching
+        weakref.finalize(t, _scan_cache_evict, tid)
+    k = (tid,) + key
+    _SCAN_CACHE_BATCHES[k] = batches
+    _SCAN_CACHE_TICK[0] += 1
+    _SCAN_CACHE_LRU[k] = _SCAN_CACHE_TICK[0]
+
+
+def _scan_cache_evict(tid):
+    for k in [k for k in _SCAN_CACHE_BATCHES if k[0] == tid]:
+        del _SCAN_CACHE_BATCHES[k]
+        _SCAN_CACHE_LRU.pop(k, None)
+
+
+class InMemoryScanExec(TpuExec):
+    """Scan over pre-partitioned Arrow tables (ref GpuInMemoryTableScanExec).
+    Device batches are cached per (table, split) so re-running a query over
+    the same in-memory data skips the H2D transfer entirely."""
+
+    def __init__(self, tables, schema: Schema, batch_rows: int = 1 << 20,
+                 columns=None):
         super().__init__([])
         self.tables = list(tables)
-        self._schema = schema
+        self._schema = schema if columns is None else Schema(
+            [schema[c] for c in columns])
+        self.columns = list(columns) if columns is not None else None
         self.batch_rows = batch_rows
 
     def output_schema(self) -> Schema:
@@ -51,20 +125,33 @@ class InMemoryScanExec(TpuExec):
 
     def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
         rows_m = ctx.metric(self._exec_id, "numOutputRows", ESSENTIAL)
+        names = tuple(self._schema.names())
+        limit = int(ctx.conf.get(SCAN_CACHE_MAX_BYTES))
         for pid, t in enumerate(self.tables):
+            key = (self.batch_rows, names)
+            cached = _scan_cache_get(t, key)
+            if cached is not None:
+                for b in cached:
+                    rows_m.add(b.num_rows)
+                    yield b
+                continue
+            built = []
+            src = t if self.columns is None else t.select(self.columns)
             off = 0
-            while off < t.num_rows or (t.num_rows == 0 and off == 0):
-                chunk = t.slice(off, self.batch_rows)
+            while off < src.num_rows or (src.num_rows == 0 and off == 0):
+                chunk = src.slice(off, self.batch_rows)
                 if chunk.num_rows == 0 and off > 0:
                     break
                 with ctx.semaphore.held():
                     b = ColumnarBatch.from_arrow(chunk)
                 b.meta = {"partition_id": pid}
                 rows_m.add(b.num_rows)
+                built.append(b)
                 yield b
                 off += self.batch_rows
-                if t.num_rows == 0:
+                if src.num_rows == 0:
                     break
+            _scan_cache_put(t, key, built, limit)
 
     def describe(self):
         return f"InMemoryScan[{len(self.tables)} partitions]"
@@ -85,8 +172,16 @@ class TpuProjectExec(TpuExec):
             for e in self.exprs])
         self.device_idx = []
         self.host_idx = []
+        self.passthrough = {}    # out ordinal -> source column name
+        from ..exprs.base import Alias, ColumnRef
         for i, e in enumerate(self.exprs):
-            if e.fully_device_supported(in_schema) is None:
+            inner = e.children[0] if isinstance(e, Alias) else e
+            if isinstance(inner, ColumnRef):
+                # identity projection: reuse the column object — zero
+                # compute AND it preserves runtime column state
+                # (DictColumn dictionaries) the planner can't see
+                self.passthrough[i] = inner.name
+            elif e.fully_device_supported(in_schema) is None:
                 self.device_idx.append(i)
             else:
                 self.host_idx.append(i)
@@ -101,7 +196,10 @@ class TpuProjectExec(TpuExec):
         rows_m = ctx.metric(self._exec_id, "numOutputRows", ESSENTIAL)
         _reset_task_state(self.exprs)
         for batch in self.children[0].execute(ctx):
+            batch = batch.ensure_device()
             out: List[Optional[object]] = [None] * len(self.exprs)
+            for i, name in self.passthrough.items():
+                out[i] = batch.column_by_name(name)
             if dev_exprs:
                 if self._projector is None:
                     self._projector = compile_projection(dev_exprs,
@@ -174,6 +272,7 @@ class TpuFilterExec(TpuExec):
     def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
         rows_m = ctx.metric(self._exec_id, "numOutputRows", ESSENTIAL)
         for batch in self.children[0].execute(ctx):
+            batch = batch.ensure_device()
             with ctx.semaphore.held():
                 if batch.all_device:
                     out = filter_batch_device(self.condition, batch)
@@ -195,7 +294,7 @@ class TpuFilterExec(TpuExec):
         keep_np = np.asarray(keep)[:batch.num_rows]
         new_cols: List[object] = list(batch.columns)
         for i, (d, v) in zip(dev_pos, outs):
-            new_cols[i] = DeviceColumn(d, v, batch.columns[i].dtype)
+            new_cols[i] = batch.columns[i].with_arrays(d, v)
         import pyarrow as pa
         mask = pa.array(keep_np)
         for i, c in enumerate(batch.columns):

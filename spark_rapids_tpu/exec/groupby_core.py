@@ -19,19 +19,23 @@ __all__ = ["segmented_groupby"]
 
 
 def segmented_groupby(keys: List[DVal], vals: List[List[DVal]],
-                      aggs: Sequence, mode: str, num_rows, padded_len: int):
+                      aggs: Sequence, mode: str, num_rows, padded_len: int,
+                      row_mask=None):
     """Returns (key_outs [(data, validity)...], partial_outs, num_groups).
 
     mode='update' runs agg.update, mode='merge' runs agg.merge. All inputs
     are padded device values; rows >= num_rows are ignored. Output group
     arrays have length padded_len with groups packed at the front.
-    """
-    row_mask = jnp.arange(padded_len, dtype=jnp.int32) < num_rows
+    ``row_mask`` (bool[P]) overrides the row-count mask so a fused
+    pre-filter can drop rows without a separate compaction kernel."""
+    if row_mask is None:
+        row_mask = jnp.arange(padded_len, dtype=jnp.int32) < num_rows
     if not keys:
         gid = jnp.where(row_mask, 0, padded_len).astype(jnp.int32)
         num_groups = jnp.int32(1)
         sorted_vals = vals
         key_outs: List[Tuple] = []
+        update_mask = row_mask        # vals stay in the unsorted domain
     else:
         pad_flag = jnp.where(row_mask, jnp.uint8(0), jnp.uint8(1))
         operands = [pad_flag]
@@ -52,9 +56,13 @@ def segmented_groupby(keys: List[DVal], vals: List[List[DVal]],
             differs = jnp.logical_or(
                 differs, jnp.logical_not(operands_equal(op, prev)))
         flags = jnp.logical_or(idx == 0, differs)
-        flags = jnp.logical_and(flags, row_mask)  # real rows sorted first
+        # live rows sort first (pad_flag), so the sorted-domain live mask
+        # is a prefix of length sum(row_mask) — row_mask itself is in the
+        # UNSORTED domain and may be arbitrary (fused pre-filter)
+        s_live = idx < jnp.sum(row_mask)
+        flags = jnp.logical_and(flags, s_live)
         num_groups = jnp.sum(flags).astype(jnp.int32)
-        gid = jnp.where(row_mask, (jnp.cumsum(flags) - 1).astype(jnp.int32),
+        gid = jnp.where(s_live, (jnp.cumsum(flags) - 1).astype(jnp.int32),
                         padded_len)
         s_keys = [DVal(jnp.take(k.data, perm), jnp.take(k.validity, perm),
                        k.dtype) for k in keys]
@@ -69,11 +77,12 @@ def segmented_groupby(keys: List[DVal], vals: List[List[DVal]],
             kv = jnp.zeros((padded_len,), dtype=jnp.bool_) \
                 .at[safe_gid].set(k.validity, mode="drop")
             key_outs.append((kd, kv))
+        update_mask = s_live          # vals were permuted live-first
 
     partial_outs = []
     for a, vs in zip(aggs, sorted_vals):
         if mode == "update":
-            outs = a.update(vs, gid, padded_len, row_mask)
+            outs = a.update(vs, gid, padded_len, update_mask)
         else:
             outs = a.merge(vs, gid, padded_len)
         partial_outs.extend(outs)

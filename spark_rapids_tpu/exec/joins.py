@@ -298,9 +298,9 @@ class TpuHashJoinExec(TpuExec):
         rows_m = ctx.metric(self._exec_id, "numOutputRows", ESSENTIAL)
         # build side: coalesce right entirely; stream left batches
         # (ref GpuShuffledHashJoinExec build-side semantics)
-        right_batches = [SpillableBatch(b, ctx.memory)
+        right_batches = [SpillableBatch(b.ensure_device(), ctx.memory)
                          for b in self.children[1].execute(ctx)]
-        left_batches = [SpillableBatch(b, ctx.memory)
+        left_batches = [SpillableBatch(b.ensure_device(), ctx.memory)
                         for b in self.children[0].execute(ctx)]
         ls, rs = (self.children[0].output_schema(),
                   self.children[1].output_schema())
@@ -495,8 +495,10 @@ class TpuHashJoinExec(TpuExec):
             kern = _build_count_kernel(self.left_keys, self.right_keys,
                                        ls, rs, self.join_type)
             _COUNT_CACHE[ck] = kern
-        lcols = [(c.data, c.validity) for c in lb.columns]
-        rcols = [(c.data, c.validity) for c in rb.columns]
+        lcols = [(c.data, c.validity) if isinstance(c, DeviceColumn)
+                 else None for c in lb.columns]
+        rcols = [(c.data, c.validity) if isinstance(c, DeviceColumn)
+                 else None for c in rb.columns]
         (s_orig, cnt_l, cnt_r, start_l, start_r, pairs, offsets, total,
          num_groups) = kern(lcols, rcols, jnp.int32(lb.num_rows),
                             jnp.int32(rb.num_rows), lb.padded_len,
@@ -550,8 +552,10 @@ class TpuHashJoinExec(TpuExec):
             kern = _build_count_kernel(self.left_keys, self.right_keys,
                                        ls, rs, "inner")
             _COUNT_CACHE[ck] = kern
-        lcols = [(c.data, c.validity) for c in lb.columns]
-        rcols = [(c.data, c.validity) for c in rb.columns]
+        lcols = [(c.data, c.validity) if isinstance(c, DeviceColumn)
+                 else None for c in lb.columns]
+        rcols = [(c.data, c.validity) if isinstance(c, DeviceColumn)
+                 else None for c in rb.columns]
         (s_orig, cnt_l, cnt_r, start_l, start_r, _pairs, offsets, total,
          _ng) = kern(lcols, rcols, jnp.int32(lb.num_rows),
                      jnp.int32(rb.num_rows), lb.padded_len, rb.padded_len)
@@ -620,9 +624,9 @@ class TpuNestedLoopJoinExec(TpuExec):
         rows_m = ctx.metric(self._exec_id, "numOutputRows", ESSENTIAL)
         ls, rs = (self.children[0].output_schema(),
                   self.children[1].output_schema())
-        right_batches = [SpillableBatch(b, ctx.memory)
+        right_batches = [SpillableBatch(b.ensure_device(), ctx.memory)
                          for b in self.children[1].execute(ctx)]
-        left_batches = [SpillableBatch(b, ctx.memory)
+        left_batches = [SpillableBatch(b.ensure_device(), ctx.memory)
                         for b in self.children[0].execute(ctx)]
 
         def run():
@@ -710,6 +714,7 @@ class TpuBroadcastHashJoinExec(TpuHashJoinExec):
             bloom = None
         produced = False
         for sb in self.children[1 - bi].execute(ctx):
+            sb = sb.ensure_device()
             def run(sb=sb):
                 with ctx.semaphore.held():
                     if bloom is not None and sb.num_rows > 0:

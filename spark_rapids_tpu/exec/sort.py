@@ -39,9 +39,12 @@ from .encoding import order_key_operands
 __all__ = ["TpuSortExec", "CpuSortExec", "sort_batch_device"]
 
 
-def _np_total_order_key(v):
+def _np_total_order_key(v, valid=None):
     """uint64 whose unsigned order == Spark ascending order (host-side twin
-    of exec/encoding.py; numpy has no 64-bit bitcast restriction)."""
+    of exec/encoding.py; numpy has no 64-bit bitcast restriction). Strings
+    and other non-numeric comparables are dense-ranked (UTF-8 byte order ==
+    codepoint order, which np sorting follows); ``valid`` masks rows whose
+    value may be None and must not poison the ranking."""
     import numpy as np
     v = np.asarray(v)
     if np.issubdtype(v.dtype, np.floating):
@@ -53,6 +56,17 @@ def _np_total_order_key(v):
                         b | np.uint64(1 << 63))
     if v.dtype == np.bool_:
         return v.astype(np.uint64)
+    if v.dtype.kind in ("U", "S", "O"):
+        vv = v
+        if valid is not None and not valid.all():
+            if not valid.any():
+                return np.zeros(len(v), np.uint64)
+            vv = v.copy()
+            # placeholder comparable with the column's own values (could
+            # be str OR Decimal); null rank decides actual order
+            vv[~valid] = vv[valid][0]
+        _, inv = np.unique(vv, return_inverse=True)
+        return inv.astype(np.uint64)
     return v.astype(np.int64).view(np.uint64) ^ np.uint64(1 << 63)
 
 _SORT_KERNEL_CACHE: Dict[Tuple, object] = {}
@@ -108,7 +122,7 @@ def sort_batch_device(orders: List[SortOrder], batch: ColumnarBatch,
     ops = None
     if with_keys:
         outs, ops = outs
-    new_cols = [DeviceColumn(d, v, c.dtype)
+    new_cols = [c.with_arrays(d, v)
                 for (d, v), c in zip(outs, batch.columns)]
     out = ColumnarBatch(new_cols, batch.num_rows, batch.schema)
     return (out, ops) if with_keys else out
@@ -186,9 +200,9 @@ class TpuSortExec(TpuExec):
         if not self.global_sort:
             for batch in self.children[0].execute(ctx):
                 with ctx.semaphore.held():
-                    yield sort_batch_device(self.orders, batch)
+                    yield sort_batch_device(self.orders, batch.ensure_device())
             return
-        spillables = [SpillableBatch(b, ctx.memory)
+        spillables = [SpillableBatch(b.ensure_device(), ctx.memory)
                       for b in self.children[0].execute(ctx)]
         if not spillables:
             return
@@ -316,14 +330,16 @@ class CpuSortExec(TpuExec):
         lex_keys = []
         for o in reversed(self.orders):  # np.lexsort: last key is primary
             v, ok = arrow_to_masked_numpy(o.expr.eval_host(batch))
-            enc = _np_total_order_key(v)
+            enc = _np_total_order_key(v, ok)
             if not o.ascending:
                 enc = ~enc
             enc = np.where(ok, enc, np.uint64(0))
             rank = np.where(ok, 1, 0) if o.nulls_first else np.where(ok, 0, 1)
             lex_keys.extend([enc, rank.astype(np.uint8)])
         idx = np.lexsort(tuple(lex_keys))
-        yield ColumnarBatch.from_arrow(t.take(pa.array(idx)))
+        # host-only output: the sorted result is usually terminal (feeds
+        # collect) — round-tripping it through HBM costs two tunnel syncs
+        yield ColumnarBatch.from_arrow_host(t.take(pa.array(idx)))
 
     def describe(self):
         return "CpuSort[" + ", ".join(map(repr, self.orders)) + "]"

@@ -91,9 +91,18 @@ class AggregateExpression:
     """Base: not an Expression (cannot appear mid-row-expression); planner
     handles it in Aggregate nodes only (ref GpuAggregateExpression:219)."""
 
+    #: DISTINCT modifier (agg(DISTINCT e)); the TPU path rewrites the plan
+    #: into a two-level aggregation (plan/rewrites.py), the host aggregate
+    #: dedups natively
+    distinct: bool = False
+
     def __init__(self, child: Optional[Expression], name: Optional[str] = None):
         self.child = child
         self._name = name
+
+    def as_distinct(self) -> "AggregateExpression":
+        self.distinct = True
+        return self
 
     # ---- naming / typing -------------------------------------------------
     @property
@@ -144,7 +153,8 @@ class AggregateExpression:
 
     def key(self) -> str:
         c = self.child.key() if self.child is not None else "*"
-        return f"{type(self).__name__}({c})"
+        d = "DISTINCT " if self.distinct else ""
+        return f"{type(self).__name__}({d}{c})"
 
 
 class Sum(AggregateExpression):

@@ -26,7 +26,15 @@ def arrow_to_masked_numpy(arr):
     import pyarrow as pa
     valid = ~np.asarray(arr.is_null())
     if arr.null_count:
-        fill = False if pa.types.is_boolean(arr.type) else 0
+        if pa.types.is_boolean(arr.type):
+            fill = False
+        elif pa.types.is_string(arr.type) or pa.types.is_large_string(
+                arr.type):
+            fill = ""
+        elif pa.types.is_binary(arr.type):
+            fill = b""
+        else:
+            fill = 0
         vals = arr.fill_null(fill).to_numpy(zero_copy_only=False)
     else:
         vals = arr.to_numpy(zero_copy_only=False)

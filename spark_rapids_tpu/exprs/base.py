@@ -18,9 +18,9 @@ from typing import List, NamedTuple, Optional, Sequence
 import jax.numpy as jnp
 import numpy as np
 
-from ..types import (BOOL, DataType, DecimalType, FLOAT32, FLOAT64, INT8,
-                     INT16, INT32, INT64, NULLTYPE, STRING, Schema, TypeSig,
-                     tpuNative, from_numpy_dtype)
+from ..types import (BOOL, DATE, DataType, DecimalType, FLOAT32, FLOAT64,
+                     INT8, INT16, INT32, INT64, NULLTYPE, STRING, Schema,
+                     TIMESTAMP, TypeSig, tpuNative, from_numpy_dtype)
 
 __all__ = ["DVal", "EvalContext", "Expression", "ColumnRef", "BoundReference",
            "Literal", "Unsupported", "promote_types", "Alias"]
@@ -176,6 +176,7 @@ class BoundReference(Expression):
 
 
 def _literal_type(value) -> DataType:
+    import datetime
     if value is None:
         return NULLTYPE
     if isinstance(value, bool):
@@ -186,15 +187,36 @@ def _literal_type(value) -> DataType:
         return FLOAT64
     if isinstance(value, str):
         return STRING
+    if isinstance(value, np.datetime64):
+        unit = np.datetime_data(value.dtype)[0]
+        return DATE if unit in ("D", "W", "M", "Y") else TIMESTAMP
+    if isinstance(value, datetime.datetime):
+        return TIMESTAMP
+    if isinstance(value, datetime.date):
+        return DATE
     if isinstance(value, np.generic):
         return from_numpy_dtype(value.dtype)
     raise TypeError(f"cannot infer literal type for {value!r}")
 
 
+def _canonical_literal(value, dtype: DataType):
+    """Store date/timestamp literals as their device representation
+    (DATE: int32 days since epoch, TIMESTAMP: int64 microseconds) so both
+    the device kernel (jnp.full) and the host path (pa.array with the
+    arrow logical type) consume the same value."""
+    if value is None:
+        return None
+    if dtype == DATE and not isinstance(value, (int, np.integer)):
+        return int(np.datetime64(value, "D").astype(np.int64))
+    if dtype == TIMESTAMP and not isinstance(value, (int, np.integer)):
+        return int(np.datetime64(value, "us").astype(np.int64))
+    return value
+
+
 class Literal(Expression):
     def __init__(self, value, dtype: Optional[DataType] = None):
-        self.value = value
         self.dtype = dtype if dtype is not None else _literal_type(value)
+        self.value = _canonical_literal(value, self.dtype)
         self.children = []
 
     def data_type(self, schema: Schema) -> DataType:

@@ -122,11 +122,25 @@ def eval_predicate_device(pred: Expression, batch: ColumnarBatch) -> jnp.ndarray
 def filter_batch_by_mask(batch: ColumnarBatch, keep,
                          schema=None) -> ColumnarBatch:
     """Compact the batch's rows where ``keep`` (bool over padded rows) is
-    True; the single home of the mask→compact→rebatch idiom."""
-    arrays = [(c.data, c.validity) for c in batch.columns]
+    True; the single home of the mask→compact→rebatch idiom. Mixed
+    batches are first-class: device columns compact on device, host
+    columns filter via Arrow with the same mask."""
+    from ..columnar import HostColumn
+    dev_pos = [i for i, c in enumerate(batch.columns)
+               if isinstance(c, DeviceColumn)]
+    arrays = [(batch.columns[i].data, batch.columns[i].validity)
+              for i in dev_pos]
     outs, count = _compact_kernel(arrays, keep, batch.padded_len)
-    new_cols = [DeviceColumn(d, v, c.dtype)
-                for (d, v), c in zip(outs, batch.columns)]
+    new_cols = list(batch.columns)
+    for i, (d, v) in zip(dev_pos, outs):
+        new_cols[i] = batch.columns[i].with_arrays(d, v)
+    if len(dev_pos) < len(new_cols):
+        import pyarrow as pa
+        mask = pa.array(np.asarray(keep)[:batch.num_rows])
+        for i, c in enumerate(batch.columns):
+            if isinstance(c, HostColumn):
+                new_cols[i] = HostColumn(
+                    c.array.slice(0, batch.num_rows).filter(mask), c.dtype)
     return ColumnarBatch(new_cols, int(count),
                          schema if schema is not None else batch.schema,
                          meta=batch.meta)
@@ -155,13 +169,26 @@ def _gather_kernel(arrays, indices, out_len):
 def gather_batch_device(batch: ColumnarBatch, indices, num_rows: int,
                         out_padded: Optional[int] = None) -> ColumnarBatch:
     """Row gather (ref JoinGatherer.scala gather-map application). ``indices``
-    may be longer than num_rows (padding); negative index = null output row."""
+    may be longer than num_rows (padding); negative index = null output row.
+    Host columns gather via Arrow take with the same index map."""
+    from ..columnar import HostColumn
     out_p = out_padded if out_padded is not None else int(indices.shape[0])
-    arrays = [(c.data, c.validity) for c in batch.columns]
+    dev_pos = [i for i, c in enumerate(batch.columns)
+               if isinstance(c, DeviceColumn)]
+    arrays = [(batch.columns[i].data, batch.columns[i].validity)
+              for i in dev_pos]
     outs = _gather_kernel(arrays, indices, out_p)
     live = np.arange(out_p) < num_rows
-    new_cols = []
-    for (d, v), c in zip(outs, batch.columns):
+    new_cols = list(batch.columns)
+    for i, (d, v) in zip(dev_pos, outs):
         v = jnp.logical_and(v, jnp.asarray(live))
-        new_cols.append(DeviceColumn(d, v, c.dtype))
+        new_cols[i] = batch.columns[i].with_arrays(d, v)
+    if len(dev_pos) < len(new_cols):
+        import pyarrow as pa
+        idx = np.asarray(indices)[:num_rows].astype(np.int64)
+        null_row = idx < 0
+        pa_idx = pa.array(np.where(null_row, 0, idx), mask=null_row)
+        for i, c in enumerate(batch.columns):
+            if isinstance(c, HostColumn):
+                new_cols[i] = HostColumn(c.array.take(pa_idx), c.dtype)
     return ColumnarBatch(new_cols, num_rows, batch.schema)

@@ -82,11 +82,21 @@ class CaseWhen(Expression):
             [self.else_value] if self.else_value is not None else [])
         return _common_type(schema, vals)
 
+    def _typed_else(self, schema):
+        """else branch, with an untyped NULL literal (`otherwise(None)`)
+        treated as absent — its value IS the all-null default."""
+        from ..types import NULLTYPE
+        ev = self.else_value
+        if ev is not None and ev.data_type(schema) == NULLTYPE:
+            return None
+        return ev
+
     def eval_device(self, ctx):
         dt = self.data_type(ctx.schema)
         np_dt = dt.np_dtype
-        if self.else_value is not None:
-            e = self.else_value.eval_device(ctx)
+        ev = self._typed_else(ctx.schema)
+        if ev is not None:
+            e = ev.eval_device(ctx)
             data, validity = e.data.astype(np_dt), e.validity
         else:
             data = jnp.zeros(ctx.padded_len, dtype=np_dt)
@@ -104,19 +114,20 @@ class CaseWhen(Expression):
         dt = self.data_type(batch.schema)
         np_dt = dt.np_dtype
         n = batch.num_rows
+        ev = self._typed_else(batch.schema)
         if np_dt is None:  # string/nested: pure-arrow path
             import pyarrow as pa
             from ..types import to_arrow
-            if self.else_value is not None:
-                acc = self.else_value.eval_host(batch)
+            if ev is not None:
+                acc = ev.eval_host(batch)
             else:
                 acc = pa.nulls(n, type=to_arrow(dt))
             for pred, val in reversed(self.branches):
                 acc = _arrow_if_else(pred.eval_host(batch),
                                      val.eval_host(batch), acc)
             return acc
-        if self.else_value is not None:
-            data, valid = arrow_to_masked_numpy(self.else_value.eval_host(batch))
+        if ev is not None:
+            data, valid = arrow_to_masked_numpy(ev.eval_host(batch))
             data = data.astype(np_dt)
         else:
             data = np.zeros(n, dtype=np_dt)

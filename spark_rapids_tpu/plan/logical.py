@@ -38,18 +38,25 @@ class LogicalPlan:
 
 
 class LogicalScan(LogicalPlan):
-    """In-memory source: a list of Arrow tables (one per partition)."""
+    """In-memory source: a list of Arrow tables (one per partition).
+    ``columns`` (set by the pruning pass) narrows the scan without
+    replacing the tables, so the exec's device cache keys on the original
+    table object."""
 
-    def __init__(self, tables, schema: Schema):
+    def __init__(self, tables, schema: Schema,
+                 columns: Optional[List[str]] = None):
         self.tables = list(tables)
         self._schema = schema
+        self.columns = columns
         self.children = []
 
     def schema(self) -> Schema:
-        return self._schema
+        if self.columns is None:
+            return self._schema
+        return Schema([self._schema[c] for c in self.columns])
 
     def describe(self):
-        return f"LogicalScan[{len(self.tables)} partitions]({self._schema})"
+        return f"LogicalScan[{len(self.tables)} partitions]({self.schema()})"
 
 
 class ParquetScan(LogicalPlan):

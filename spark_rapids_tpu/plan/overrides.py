@@ -44,6 +44,13 @@ def wrap_plan(plan: L.LogicalPlan, conf: TpuConf,
 def plan_query(plan: L.LogicalPlan, conf: TpuConf) -> TpuExec:
     """tag -> cost-optimize -> (explain) -> convert (ref
     applyOverrides:4813, getOptimizations:4827)."""
+    from .rewrites import prune_columns
+    plan = prune_columns(plan)
+    if conf.sql_enabled:
+        # TPU-targeted rewrites (distinct-agg expansion); the host oracle
+        # path keeps native semantics so differential tests check them
+        from .rewrites import rewrite_plan
+        plan = rewrite_plan(plan)
     meta = wrap_plan(plan, conf)
     meta.tag()
     from .cost import OPTIMIZER_ENABLED, apply_cost_optimizer
@@ -78,7 +85,8 @@ class _FallbackMeta(PlanMeta):
 @rule(L.LogicalScan)
 class ScanMeta(PlanMeta):
     def convert_to_tpu(self, children):
-        return B.InMemoryScanExec(self.plan.tables, self.plan.schema())
+        return B.InMemoryScanExec(self.plan.tables, self.plan._schema,
+                                  columns=self.plan.columns)
 
     convert_to_cpu = convert_to_tpu  # scan is shared (host decode either way)
 
@@ -164,10 +172,14 @@ class FilterMeta(PlanMeta):
 @rule(L.Aggregate)
 class AggregateMeta(PlanMeta):
     def tag_self(self):
+        from ..types import STRING
         schema = self.plan.children[0].schema()
         for g in self.plan.groupings:
             r = g.fully_device_supported(schema)
-            if r:
+            # string group keys stay on the TPU path: the exec
+            # dictionary-encodes them to device int32 codes (evaluated on
+            # host, grouped on device, decoded at finalize)
+            if r and g.data_type(schema) != STRING:
                 self.will_not_work_on_tpu(f"grouping <{g.name_hint}>: {r}")
         for a in self.plan.aggs:
             r = a.device_unsupported_reason(schema)
@@ -176,10 +188,54 @@ class AggregateMeta(PlanMeta):
             if not hasattr(a, "update"):
                 self.will_not_work_on_tpu(
                     f"aggregate <{a.name_hint}> has no device implementation")
+            if a.distinct:
+                # reaches here only when rewrites.py could not expand it
+                # (multiple distinct columns / non-decomposable mix)
+                self.will_not_work_on_tpu(
+                    f"aggregate <{a.name_hint}>: DISTINCT form not "
+                    "expandable to the two-level device aggregation")
 
     def convert_to_tpu(self, children):
+        child, stages, eval_schema = self._fold_stages(children[0])
+        if stages:
+            return A.TpuHashAggregateExec(self.plan.groupings,
+                                          self.plan.aggs, child,
+                                          pre_stages=stages,
+                                          eval_schema=eval_schema)
         return A.TpuHashAggregateExec(self.plan.groupings, self.plan.aggs,
                                       children[0])
+
+    def _fold_stages(self, child):
+        """Fold a chain of device-only Filter/Project execs below the
+        aggregate INTO its update kernel: scan→filter→project→groupby
+        becomes one XLA computation — no per-stage compaction kernels or
+        host syncs (the device round trip is the unit of cost on TPU)."""
+        from ..exprs.base import ColumnRef
+        from ..types import STRING
+        eval_schema = child.output_schema()
+        stages, node = [], child
+        while True:
+            if (isinstance(node, B.TpuFilterExec)
+                    and node.condition.fully_device_supported(
+                        node.children[0].output_schema()) is None):
+                stages.append(("filter", node.condition))
+                node = node.children[0]
+            elif isinstance(node, B.TpuProjectExec) and not node.host_idx:
+                stages.append(("project", node.exprs, node.output_schema()))
+                node = node.children[0]
+            else:
+                break
+        if not stages:
+            return child, None, None
+        # string group keys are dictionary-encoded OUTSIDE the kernel from
+        # the folded input batch — they must be plain refs present there
+        in_names = set(node.output_schema().names())
+        for g in self.plan.groupings:
+            if g.data_type(eval_schema) == STRING and not (
+                    isinstance(g, ColumnRef) and g.name in in_names):
+                return child, None, None
+        stages.reverse()
+        return node, stages, eval_schema
 
     def convert_to_cpu(self, children):
         return A.CpuAggregateExec(self.plan.groupings, self.plan.aggs,

@@ -1,0 +1,222 @@
+"""Logical plan rewrites applied before TPU planning.
+
+Distinct aggregates (ref Spark's RewriteDistinctAggregates, which the
+reference accelerates post-rewrite: GpuHashAggregateExec only ever sees
+the expanded two-level form): an Aggregate containing `agg(DISTINCT e)` is
+rewritten into
+
+    Project(restore names/order)
+      Aggregate(G, merge partials + distinct aggs over e)   -- outer
+        Aggregate(G + [e], partials of non-distinct aggs)   -- inner dedup
+
+which runs entirely on the device groupby pipeline. The rewrite applies
+when every distinct agg shares ONE child expression and all aggs are
+decomposable (Sum/Count/CountStar/Min/Max/Average); otherwise the plan is
+left alone and the host aggregate computes distinct natively (the planner
+tags it off-device).
+
+Only applied when planning for the TPU: the host oracle path keeps its
+native pandas distinct so differential tests check the rewrite itself.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Optional
+
+from ..exprs import aggregates as AG
+from ..exprs.arithmetic import Divide
+from ..exprs.base import Alias, ColumnRef, Literal
+from ..exprs.cast import Cast
+from ..exprs.conditional import Coalesce
+from ..types import FLOAT64, INT64
+from . import logical as L
+
+__all__ = ["rewrite_plan", "prune_columns"]
+
+
+# ---------------------------------------------------------------------------
+# column pruning (projection pushdown into scans)
+# ---------------------------------------------------------------------------
+# The reference gets pruning for free from Catalyst; standalone we push the
+# required-column set top-down and trim LogicalScan/file scans. On a
+# tunneled TPU this directly cuts H2D bytes — often the dominant cost.
+
+def _expr_refs(e, out: set):
+    if e is None:
+        return
+    if hasattr(e, "references") and not getattr(e, "children", None):
+        for n in e.references():
+            out.add(n)
+        return
+    if isinstance(e, ColumnRef):
+        out.add(e.name)
+        return
+    for c in getattr(e, "children", ()):  # Expression tree
+        _expr_refs(c, out)
+
+
+def _agg_refs(a, out: set):
+    if getattr(a, "child", None) is not None:
+        _expr_refs(a.child, out)
+
+
+def prune_columns(plan: L.LogicalPlan,
+                  required: Optional[set] = None) -> L.LogicalPlan:
+    """required = names needed from this node's output; None = all."""
+    def rebuilt(node, new_children):
+        if all(n is o for n, o in zip(new_children, node.children)):
+            return node
+        node = copy.copy(node)
+        node.children = new_children
+        return node
+
+    if isinstance(plan, L.LogicalScan):
+        names = plan.schema().names()
+        if required is None or set(names) <= required:
+            return plan
+        keep = [n for n in names if n in required]
+        if not keep:        # degenerate count(*)-style: keep one column
+            keep = names[:1]
+        return L.LogicalScan(plan.tables, plan._schema, columns=keep)
+    if isinstance(plan, L.ParquetScan):  # covers Orc/Avro subclasses
+        names = plan.schema().names()
+        if required is not None and not set(names) <= required:
+            keep = [n for n in names if n in required] or names[:1]
+            plan = copy.copy(plan)
+            plan.columns = keep
+        return plan
+    if isinstance(plan, L.Project):
+        exprs = plan.exprs
+        if required is not None:
+            kept = [e for e in exprs if e.name_hint in required]
+            exprs = kept if kept else exprs[:1]
+        child_req: set = set()
+        for e in exprs:
+            _expr_refs(e, child_req)
+        child = prune_columns(plan.children[0], child_req)
+        if exprs is not plan.exprs or child is not plan.children[0]:
+            return L.Project(exprs, child)
+        return plan
+    if isinstance(plan, L.Filter):
+        child_req = None if required is None else set(required)
+        if child_req is not None:
+            _expr_refs(plan.condition, child_req)
+        return rebuilt(plan, [prune_columns(plan.children[0], child_req)])
+    if isinstance(plan, L.Aggregate):
+        child_req: set = set()
+        for g in plan.groupings:
+            _expr_refs(g, child_req)
+        for a in plan.aggs:
+            _agg_refs(a, child_req)
+        return rebuilt(plan, [prune_columns(plan.children[0], child_req)])
+    if isinstance(plan, L.Sort):
+        child_req = None if required is None else set(required)
+        if child_req is not None:
+            for o in plan.orders:
+                _expr_refs(o.expr, child_req)
+        return rebuilt(plan, [prune_columns(plan.children[0], child_req)])
+    if isinstance(plan, (L.GlobalLimit, L.LocalLimit, L.Sample)):
+        return rebuilt(plan, [prune_columns(plan.children[0], required)])
+    if isinstance(plan, L.Repartition):
+        child_req = None if required is None else set(required)
+        if child_req is not None:
+            for k in plan.keys:
+                _expr_refs(k, child_req)
+        return rebuilt(plan, [prune_columns(plan.children[0], child_req)])
+    if isinstance(plan, L.Union):
+        # children share column names positionally only when schemas align;
+        # prune identically by name
+        return rebuilt(plan, [prune_columns(c, required)
+                              for c in plan.children])
+    if isinstance(plan, L.Join):
+        lnames = set(plan.children[0].schema().names())
+        rnames = set(plan.children[1].schema().names())
+        if required is None:
+            lreq, rreq = None, None
+        else:
+            lreq = {n for n in required if n in lnames}
+            rreq = {n for n in required if n in rnames}
+            cond_refs: set = set()
+            for k in plan.left_keys:
+                _expr_refs(k, cond_refs)
+            for k in plan.right_keys:
+                _expr_refs(k, cond_refs)
+            _expr_refs(plan.condition, cond_refs)
+            lreq |= cond_refs & lnames
+            rreq |= cond_refs & rnames
+        return rebuilt(plan, [prune_columns(plan.children[0], lreq),
+                              prune_columns(plan.children[1], rreq)])
+    # Window/Generate/Expand/WriteFile/unknown: conservative — children
+    # keep everything
+    return rebuilt(plan, [prune_columns(c, None) for c in plan.children])
+
+
+def rewrite_plan(plan: L.LogicalPlan) -> L.LogicalPlan:
+    new_children = [rewrite_plan(c) for c in plan.children]
+    if any(n is not o for n, o in zip(new_children, plan.children)):
+        plan = copy.copy(plan)
+        plan.children = new_children
+    if isinstance(plan, L.Aggregate) and any(
+            getattr(a, "distinct", False) for a in plan.aggs):
+        new = _rewrite_distinct(plan)
+        if new is not None:
+            plan = new
+    return plan
+
+
+_DECOMPOSABLE = (AG.Sum, AG.Count, AG.CountStar, AG.Min, AG.Max, AG.Average)
+_DISTINCT_OK = (AG.Count, AG.Sum, AG.Average)
+
+
+def _rewrite_distinct(agg: L.Aggregate) -> Optional[L.LogicalPlan]:
+    cs = agg.children[0].schema()
+    d_keys = {a.child.key() for a in agg.aggs if a.distinct}
+    if len(d_keys) != 1:
+        return None          # multiple distinct columns: host handles it
+    for a in agg.aggs:
+        if a.distinct and type(a) not in _DISTINCT_OK:
+            return None
+        if not a.distinct and type(a) not in _DECOMPOSABLE:
+            return None
+    d_expr = next(a.child for a in agg.aggs if a.distinct)
+    dname = "__da_d"
+
+    inner_aggs, outer_aggs, projections = [], [], []
+    for g in agg.groupings:
+        projections.append(ColumnRef(g.name_hint))
+    for i, a in enumerate(agg.aggs):
+        out = a.name_hint
+        t = f"__da_t{i}"
+        if a.distinct:
+            # the inner agg dedups (G, e); plain agg over e finishes it
+            outer_aggs.append(type(a)(ColumnRef(dname)).with_name(t))
+            projections.append(Alias(ColumnRef(t), out))
+        elif isinstance(a, AG.Average):
+            ps, pc = f"__da_p{i}_s", f"__da_p{i}_c"
+            inner_aggs.append(AG.Sum(Cast(a.child, FLOAT64)).with_name(ps))
+            inner_aggs.append(AG.Count(a.child).with_name(pc))
+            ts, tc = f"__da_t{i}_s", f"__da_t{i}_c"
+            outer_aggs.append(AG.Sum(ColumnRef(ps)).with_name(ts))
+            outer_aggs.append(AG.Sum(ColumnRef(pc)).with_name(tc))
+            projections.append(Alias(
+                Divide(ColumnRef(ts), Cast(ColumnRef(tc), FLOAT64)), out))
+        elif isinstance(a, (AG.CountStar, AG.Count)):
+            p = f"__da_p{i}"
+            inner = (AG.CountStar() if isinstance(a, AG.CountStar)
+                     else AG.Count(a.child))
+            inner_aggs.append(inner.with_name(p))
+            outer_aggs.append(AG.Sum(ColumnRef(p)).with_name(t))
+            projections.append(Alias(
+                Coalesce(ColumnRef(t), Literal(0, INT64)), out))
+        else:                  # Sum / Min / Max merge with themselves
+            p = f"__da_p{i}"
+            cls = type(a)
+            inner_aggs.append(cls(a.child).with_name(p))
+            outer_aggs.append(cls(ColumnRef(p)).with_name(t))
+            projections.append(Alias(ColumnRef(t), out))
+
+    inner_groupings = list(agg.groupings) + [Alias(d_expr, dname)]
+    inner = L.Aggregate(inner_groupings, inner_aggs, agg.children[0])
+    outer_groupings = [ColumnRef(g.name_hint) for g in agg.groupings]
+    outer = L.Aggregate(outer_groupings, outer_aggs, inner)
+    return L.Project(projections, outer)

@@ -87,19 +87,28 @@ class PartitionedBatches:
     """Result of partitioning one batch: per-partition slices sharing the
     sorted buffers (zero-copy views until materialized)."""
 
-    def __init__(self, sorted_cols, counts: np.ndarray, schema: Schema):
+    def __init__(self, sorted_cols, counts: np.ndarray, schema: Schema,
+                 source_cols=None):
         self.sorted_cols = sorted_cols
         self.counts = counts
         self.offsets = np.concatenate([[0], np.cumsum(counts)])
         self.schema = schema
+        #: originating columns — carries column state (e.g. a DictColumn's
+        #: dictionary) across the rearrangement
+        self.source_cols = source_cols
+
+    def _rebuild(self, i, d, v):
+        if self.source_cols is not None:
+            return self.source_cols[i].with_arrays(d, v)
+        return DeviceColumn(d, v, self.schema.fields[i].dtype)
 
     def partition(self, p: int) -> "object":
         """Arrow table for partition p (host materialization for shuffle)."""
         import pyarrow as pa
         start, n = int(self.offsets[p]), int(self.counts[p])
         cols = []
-        for (d, v), f in zip(self.sorted_cols, self.schema.fields):
-            dc = DeviceColumn(d[start:start + n], v[start:start + n], f.dtype)
+        for i, (d, v) in enumerate(self.sorted_cols):
+            dc = self._rebuild(i, d[start:start + n], v[start:start + n])
             cols.append(dc.to_arrow(n))
         return pa.Table.from_arrays(cols, names=self.schema.names())
 
@@ -113,10 +122,10 @@ class PartitionedBatches:
         start, n = int(self.offsets[p]), int(self.counts[p])
         pb = bucket_for(max(n, 1))
         cols = []
-        for (d, v), f in zip(self.sorted_cols, self.schema.fields):
+        for i, (d, v) in enumerate(self.sorted_cols):
             od, ov = _slice_pad_kernel(d, v, jnp.int32(start), jnp.int32(n),
                                        pb)
-            cols.append(DeviceColumn(od, ov, f.dtype))
+            cols.append(self._rebuild(i, od, ov))
         return ColumnarBatch(cols, n, self.schema)
 
 
@@ -166,10 +175,12 @@ def scatter_spillables(ctx, spillables, make_parts, n_parts: int):
 def partition_batch(batch: ColumnarBatch, keys: Sequence[Expression],
                     num_parts: int, mode: str = "hash",
                     seed: int = 42) -> PartitionedBatches:
+    batch = batch.ensure_device()
     assert batch.all_device, "partitioning requires device batch"
     pid = hash_partition_ids(batch, keys, num_parts, mode, seed)
     arrays = [(c.data, c.validity) for c in batch.columns]
     # num_parts+1: the virtual padding partition sorts last and is dropped
     cols, counts = _split_kernel(arrays, pid, batch.padded_len, num_parts + 1)
     counts = np.asarray(counts)[:num_parts]
-    return PartitionedBatches(cols, counts, batch.schema)
+    return PartitionedBatches(cols, counts, batch.schema,
+                              source_cols=batch.columns)

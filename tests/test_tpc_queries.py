@@ -1,0 +1,165 @@
+"""TPC-H q1/q6 and TPC-DS q3/q9/q28 differential tests at tiny scale
+(BASELINE.md config ladder steps 2-3; the reference's equivalents live in
+the NDS suite + integration_tests/tpch/tpcds pytest marks). Also covers
+the distinct-aggregate rewrite (ref Spark RewriteDistinctAggregates) and
+string group keys on the device aggregation path."""
+import sys
+import os
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from benchmarks import tpch, tpcds
+from harness import (assert_all_on_tpu, assert_tpu_and_cpu_equal,
+                     tpu_session)
+from spark_rapids_tpu.api import functions as F
+
+N = 20_000
+
+
+def test_tpch_q1_differential():
+    def q(s):
+        return tpch.q1(s.create_dataframe(tpch.gen_lineitem(N)), F)
+    assert_tpu_and_cpu_equal(q, approximate_float=True)
+
+
+def test_tpch_q1_agg_on_device():
+    s = tpu_session()
+    df = tpch.q1(s.create_dataframe(tpch.gen_lineitem(2048)), F)
+    tree = df._physical().tree_string()
+    assert "HashAggregate" in tree and "CpuAggregate" not in tree, tree
+
+
+def test_tpch_q6_differential():
+    def q(s):
+        return tpch.q6(s.create_dataframe(tpch.gen_lineitem(N)), F)
+    assert_tpu_and_cpu_equal(q, approximate_float=True)
+
+
+def test_tpch_q6_all_on_tpu():
+    def q(s):
+        return tpch.q6(s.create_dataframe(tpch.gen_lineitem(2048)), F)
+    assert_all_on_tpu(q)
+
+
+def _dstables(s, n=N):
+    return (s.create_dataframe(tpcds.gen_store_sales(n)),
+            s.create_dataframe(tpcds.gen_date_dim()),
+            s.create_dataframe(tpcds.gen_item()))
+
+
+def test_tpcds_q3_differential():
+    def q(s):
+        ss, dd, it = _dstables(s)
+        return tpcds.q3(ss, dd, it, F, manufact_id=128)
+    assert_tpu_and_cpu_equal(q, approximate_float=True)
+
+
+def test_tpcds_q9_differential():
+    def q(s):
+        ss, _, _ = _dstables(s)
+        return tpcds.q9(ss, F)
+    assert_tpu_and_cpu_equal(q, approximate_float=True)
+
+
+def test_tpcds_q28_differential():
+    def q(s):
+        ss, _, _ = _dstables(s)
+        return tpcds.q28(ss, F)
+    assert_tpu_and_cpu_equal(q, approximate_float=True)
+
+
+# ---------------------------------------------------------------------------
+# distinct aggregates (the rewrite itself)
+# ---------------------------------------------------------------------------
+
+def _kv(s, n=4096, nulls=True):
+    import pyarrow as pa
+    rng = np.random.RandomState(3)
+    v = rng.randint(0, 50, n).astype("float64")
+    vmask = rng.random(n) < 0.1 if nulls else np.zeros(n, bool)
+    return s.create_dataframe(pa.table({
+        "k": pa.array(rng.randint(0, 7, n)),
+        "v": pa.array(np.where(vmask, np.nan, v), mask=vmask),
+        "w": pa.array(rng.randint(0, 1000, n).astype("int64")),
+    }))
+
+
+def test_count_distinct_grouped():
+    def q(s):
+        return _kv(s).group_by("k").agg(
+            F.count_distinct(F.col("v")).with_name("cd"),
+            F.count(F.col("v")).with_name("c"),
+            F.sum(F.col("w")).with_name("sw"),
+            F.avg(F.col("v")).with_name("av"),
+            F.count_star().with_name("n"))
+    assert_tpu_and_cpu_equal(q, approximate_float=True)
+
+
+def test_distinct_agg_global():
+    def q(s):
+        return _kv(s).agg(
+            F.count_distinct(F.col("v")).with_name("cd"),
+            F.sum_distinct(F.col("v")).with_name("sd"),
+            F.avg_distinct(F.col("v")).with_name("ad"),
+            F.max(F.col("w")).with_name("mx"))
+    assert_tpu_and_cpu_equal(q, approximate_float=True)
+
+
+def test_distinct_agg_zero_rows():
+    def q(s):
+        df = _kv(s, n=64)
+        return df.filter(F.col("w") < F.lit(-1)).agg(
+            F.count_distinct(F.col("v")).with_name("cd"),
+            F.count_star().with_name("n"))
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_distinct_agg_runs_on_device():
+    s = tpu_session()
+    df = _kv(s).group_by("k").agg(
+        F.count_distinct(F.col("v")).with_name("cd"))
+    tree = df._physical().tree_string()
+    assert "CpuAggregate" not in tree, tree
+
+
+def test_multi_column_distinct_falls_back():
+    """Two different distinct columns cannot expand -> host aggregate."""
+    def q(s):
+        return _kv(s).group_by("k").agg(
+            F.count_distinct(F.col("v")).with_name("cdv"),
+            F.count_distinct(F.col("w")).with_name("cdw"))
+    t = assert_tpu_and_cpu_equal(q)
+    assert len(t) == 7
+
+
+# ---------------------------------------------------------------------------
+# host-batch consumers (the aggregate single-fetch path emits host batches;
+# every downstream device exec must re-materialize via ensure_device)
+# ---------------------------------------------------------------------------
+
+def test_agg_output_feeds_repartition_and_join():
+    import pyarrow as pa
+    s = tpu_session()
+    t = pa.table({"k": pa.array(np.arange(1000) % 7),
+                  "v": pa.array(np.ones(1000))})
+    agg = s.create_dataframe(t).group_by("k").agg(
+        F.sum(F.col("v")).with_name("sv"))
+    assert agg.repartition(4).count() == 7
+    other = s.create_dataframe(pa.table({"k2": pa.array([0, 1, 2])}))
+    j = agg.join(other, on=[("k", "k2")], how="inner")
+    assert j.count() == 3
+
+
+def test_agg_output_feeds_window():
+    import pyarrow as pa
+    s = tpu_session()
+    t = pa.table({"k": pa.array(np.arange(100) % 5),
+                  "v": pa.array(np.arange(100, dtype="float64"))})
+    agg = s.create_dataframe(t).group_by("k").agg(
+        F.sum(F.col("v")).with_name("sv"))
+    df = agg.with_window_column("r", F.sum(F.col("sv")))
+    out = df.to_pandas()
+    assert len(out) == 5 and np.allclose(out["r"], out["sv"].sum())
