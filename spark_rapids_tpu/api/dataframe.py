@@ -49,6 +49,8 @@ class TpuSession:
             conf = TpuConf(conf)
         self.conf = conf or TpuConf()
         self._ctx: Optional[ExecContext] = None
+        #: temp-view registry consumed by session.sql()
+        self._views: dict = {}
         from ..aux.profiler import Profiler
         self.profiler = Profiler(self.conf)
         #: per-query runtime summary (ref GpuTaskMetrics accumulators)
@@ -130,6 +132,15 @@ class TpuSession:
     def delta_table(self, path: str):
         from ..delta import DeltaTable
         return DeltaTable(self, path)
+
+    def sql(self, text: str) -> "DataFrame":
+        """Run a SQL query over registered temp views (ANSI analytics
+        subset — see spark_rapids_tpu.sql)."""
+        from ..sql import lower_statement
+        return lower_statement(self, text, self._views)
+
+    def create_temp_view(self, name: str, df: "DataFrame") -> None:
+        self._views[name.lower()] = df
 
     def read_csv(self, *paths: str, schema=None, header=True) -> "DataFrame":
         from ..io.text import csv_to_tables
@@ -303,6 +314,11 @@ class DataFrame:
         """Per-batch pandas transform (ref GpuMapInPandasExec)."""
         return DataFrame(self.session,
                          L.MapInPandas(fn, _as_schema(schema), self.plan))
+
+    def create_or_replace_temp_view(self, name: str) -> None:
+        self.session.create_temp_view(name, self)
+
+    createOrReplaceTempView = create_or_replace_temp_view
 
     def cache(self) -> "DataFrame":
         """Materialize once into in-memory parquet-encoded batches

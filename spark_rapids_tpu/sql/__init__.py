@@ -1,0 +1,17 @@
+"""SQL front-end: text -> logical plan over the DataFrame engine.
+
+The reference consumes SQL through Spark's parser (it is a plugin); this
+standalone framework carries its own ANSI-subset front-end so reference
+users keep their primary interface: `session.sql("SELECT ...")` over
+registered temp views. Coverage targets the analytics subset the TPC
+suites exercise: SELECT / DISTINCT / FROM / JOIN (inner, left/right/full
+outer, semi, anti, cross; ON and USING) / WHERE / GROUP BY (names,
+aliases, ordinals) / HAVING / ORDER BY / LIMIT / UNION [ALL] / WITH
+(CTEs) / subqueries in FROM / CASE WHEN / CAST / BETWEEN / IN / LIKE /
+IS [NOT] NULL / date literals and intervals / aggregate functions incl.
+DISTINCT forms.
+"""
+from .parser import parse
+from .lowering import lower_statement
+
+__all__ = ["parse", "lower_statement"]

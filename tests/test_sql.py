@@ -1,0 +1,231 @@
+"""SQL front-end tests: text -> logical plan -> differential vs the
+DataFrame formulation and vs the host oracle (the reference consumes SQL
+through Spark's parser; this framework ships its own ANSI analytics
+subset — spark_rapids_tpu/sql/)."""
+import os
+import sys
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from benchmarks import tpcds, tpch
+from harness import tpu_session
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.sql.parser import SqlError
+
+
+def _sess():
+    s = tpu_session()
+    s.create_dataframe(tpch.gen_lineitem(10_000)) \
+        .create_or_replace_temp_view("lineitem")
+    s.create_dataframe(tpcds.gen_store_sales(8_000)) \
+        .create_or_replace_temp_view("store_sales")
+    s.create_dataframe(tpcds.gen_date_dim()) \
+        .create_or_replace_temp_view("date_dim")
+    s.create_dataframe(tpcds.gen_item()) \
+        .create_or_replace_temp_view("item")
+    return s
+
+
+def test_sql_tpch_q1_matches_dataframe():
+    s = _sess()
+    got = s.sql("""
+        SELECT l_returnflag, l_linestatus,
+               sum(l_quantity) AS sum_qty,
+               sum(l_extendedprice * (1 - l_discount)) AS sum_disc_price,
+               avg(l_discount) AS avg_disc,
+               count(*) AS count_order
+        FROM lineitem
+        WHERE l_shipdate <= date '1998-12-01' - interval '90' day
+        GROUP BY l_returnflag, l_linestatus
+        ORDER BY l_returnflag, l_linestatus""").to_pandas()
+    assert len(got) == 6
+    exp = tpch.q1(s.create_dataframe(tpch.gen_lineitem(10_000)), F) \
+        .to_pandas()
+    np.testing.assert_allclose(got["sum_disc_price"],
+                               exp["sum_disc_price"], rtol=1e-12)
+
+
+def test_sql_tpcds_q3_join():
+    s = _sess()
+    got = s.sql("""
+        SELECT d_year, i_brand_id, i_brand,
+               sum(ss_ext_sales_price) AS sum_agg
+        FROM date_dim, store_sales, item
+        WHERE d_date_sk = ss_sold_date_sk AND ss_item_sk = i_item_sk
+          AND i_manufact_id = 128 AND d_moy = 11
+        GROUP BY d_year, i_brand_id, i_brand
+        ORDER BY d_year, sum_agg DESC, i_brand_id""").to_pandas()
+    exp = tpcds.q3(s.create_dataframe(tpcds.gen_store_sales(8_000)),
+                   s.create_dataframe(tpcds.gen_date_dim()),
+                   s.create_dataframe(tpcds.gen_item()), F).to_pandas()
+    assert len(got) == len(exp)
+    np.testing.assert_allclose(sorted(got["sum_agg"]),
+                               sorted(exp["sum_agg"]), rtol=1e-12)
+
+
+def test_sql_explicit_join_on_and_using():
+    s = _sess()
+    a = s.sql("""SELECT d_year, count(*) AS n
+                 FROM store_sales JOIN date_dim
+                      ON ss_sold_date_sk = d_date_sk
+                 GROUP BY d_year ORDER BY d_year""").to_pandas()
+    assert a["n"].sum() == 8000
+    s.create_dataframe(pa.table({"k": [1, 2, 3], "x": [10, 20, 30]})) \
+        .create_or_replace_temp_view("t1")
+    s.create_dataframe(pa.table({"k": [2, 3, 4], "y": [5, 6, 7]})) \
+        .create_or_replace_temp_view("t2")
+    u = s.sql("SELECT k, x, y FROM t1 JOIN t2 USING (k) ORDER BY k") \
+        .to_pandas()
+    assert list(u["k"]) == [2, 3] and list(u.columns) == ["k", "x", "y"]
+    lo = s.sql("SELECT k, x, y FROM t1 LEFT JOIN t2 USING (k) ORDER BY k") \
+        .to_pandas()
+    assert len(lo) == 3 and lo["y"].isna().sum() == 1
+
+
+def test_sql_case_when_and_conditional_agg():
+    s = _sess()
+    got = s.sql("""
+        SELECT count(CASE WHEN ss_quantity BETWEEN 1 AND 20
+                          THEN 1 ELSE NULL END) AS b1,
+               avg(CASE WHEN ss_quantity BETWEEN 1 AND 20
+                        THEN ss_ext_sales_price ELSE NULL END) AS a1
+        FROM store_sales""").to_pandas()
+    raw = tpcds.gen_store_sales(8_000).to_pandas()
+    m = (raw["ss_quantity"] >= 1) & (raw["ss_quantity"] <= 20)
+    assert int(got["b1"][0]) == int(m.sum())
+    np.testing.assert_allclose(got["a1"][0],
+                               raw.loc[m, "ss_ext_sales_price"].mean(),
+                               rtol=1e-9)
+
+
+def test_sql_cte_having_union_limit():
+    s = _sess()
+    got = s.sql("""
+        WITH big AS (
+            SELECT l_orderkey, sum(l_quantity) AS q
+            FROM lineitem GROUP BY l_orderkey HAVING sum(l_quantity) > 60
+        )
+        SELECT l_orderkey, q FROM big ORDER BY q DESC, l_orderkey
+        LIMIT 10""").to_pandas()
+    assert len(got) == 10 and (got["q"] > 60).all()
+    assert list(got["q"]) == sorted(got["q"], reverse=True)
+
+    u = s.sql("""
+        SELECT 1 AS v FROM (SELECT l_orderkey FROM lineitem LIMIT 1) x
+        UNION ALL
+        SELECT 2 AS v FROM (SELECT l_orderkey FROM lineitem LIMIT 1) y
+        ORDER BY v""").to_pandas()
+    assert list(u["v"]) == [1, 2]
+
+
+def test_sql_count_distinct_and_aliases():
+    s = _sess()
+    got = s.sql("""
+        SELECT count(DISTINCT ss_item_sk) AS items,
+               count(*) AS n, sum(ss_quantity) / count(*) AS avg_q
+        FROM store_sales""").to_pandas()
+    raw = tpcds.gen_store_sales(8_000).to_pandas()
+    assert int(got["items"][0]) == raw["ss_item_sk"].nunique()
+    assert int(got["n"][0]) == 8000
+    np.testing.assert_allclose(got["avg_q"][0], raw["ss_quantity"].mean(),
+                               rtol=1e-9)
+
+
+def test_sql_scalar_fns_in_like_strings():
+    s = _sess()
+    t = pa.table({"name": ["Alice", "bob", "CAROL", None],
+                  "v": [1.5, -2.5, 3.25, 4.0]})
+    s.create_dataframe(t).create_or_replace_temp_view("people")
+    got = s.sql("""
+        SELECT upper(name) AS u, abs(v) AS av
+        FROM people
+        WHERE name IS NOT NULL AND lower(name) LIKE '%o%'
+        ORDER BY u""").to_pandas()
+    assert list(got["u"]) == ["BOB", "CAROL"]
+    got2 = s.sql("SELECT v FROM people WHERE v IN (1.5, 4) ORDER BY v") \
+        .to_pandas()
+    assert list(got2["v"]) == [1.5, 4.0]
+    n = s.sql("SELECT count(*) AS n FROM people "
+              "WHERE name NOT LIKE '%o%' AND name IS NOT NULL").to_pandas()
+    # LIKE is case-sensitive: 'bob' matches '%o%'; 'Alice' and 'CAROL'
+    # (uppercase O) do not
+    assert int(n["n"][0]) == 2
+
+
+def test_sql_group_by_ordinal_and_alias():
+    s = _sess()
+    a = s.sql("""SELECT l_returnflag AS rf, count(*) AS n
+                 FROM lineitem GROUP BY 1 ORDER BY 1""").to_pandas()
+    b = s.sql("""SELECT l_returnflag AS rf, count(*) AS n
+                 FROM lineitem GROUP BY rf ORDER BY rf""").to_pandas()
+    pd.testing.assert_frame_equal(a, b)
+    assert list(a["rf"]) == ["A", "N", "R"]
+
+
+def test_sql_errors_are_actionable():
+    s = _sess()
+    with pytest.raises(SqlError, match="not found"):
+        s.sql("SELECT * FROM nope")
+    with pytest.raises(SqlError):
+        s.sql("SELECT FROM lineitem")
+    with pytest.raises(SqlError, match="unknown function"):
+        s.sql("SELECT frobnicate(l_quantity) FROM lineitem")
+
+
+def test_sql_order_by_agg_and_hidden_columns():
+    s = _sess()
+    got = s.sql("""SELECT l_returnflag FROM lineitem
+                   GROUP BY l_returnflag ORDER BY count(*) DESC""") \
+        .to_pandas()
+    raw = tpch.gen_lineitem(10_000).to_pandas()
+    exp = raw.groupby("l_returnflag").size().sort_values(ascending=False)
+    assert list(got["l_returnflag"]) == list(exp.index)
+    # aliased group key ordered by its source name
+    got2 = s.sql("""SELECT l_returnflag AS rf, count(*) AS n FROM lineitem
+                    GROUP BY l_returnflag ORDER BY l_returnflag""") \
+        .to_pandas()
+    assert list(got2["rf"]) == ["A", "N", "R"]
+
+
+def test_sql_self_join_with_aliases():
+    s = _sess()
+    import pyarrow as pa
+    s.create_dataframe(pa.table({"k": [1, 2, 3], "x": [10, 20, 30]})) \
+        .create_or_replace_temp_view("t1")
+    got = s.sql("""SELECT count(*) AS n FROM t1 a JOIN t1 b ON a.k = b.k""") \
+        .to_pandas()
+    assert int(got["n"][0]) == 3
+
+
+def test_sql_using_right_and_full_outer_keys():
+    s = _sess()
+    import pyarrow as pa
+    s.create_dataframe(pa.table({"k": [1, 2, 3], "x": [10, 20, 30]})) \
+        .create_or_replace_temp_view("t1")
+    s.create_dataframe(pa.table({"k": [2, 3, 4], "y": [5, 6, 7]})) \
+        .create_or_replace_temp_view("t2")
+    r = s.sql("SELECT k, y FROM t1 RIGHT JOIN t2 USING (k) ORDER BY k") \
+        .to_pandas()
+    assert list(r["k"]) == [2, 3, 4]
+    f = s.sql("SELECT k FROM t1 FULL JOIN t2 USING (k) ORDER BY k") \
+        .to_pandas()
+    assert list(f["k"]) == [1, 2, 3, 4]
+
+
+def test_sql_negative_in_semicolon_and_bad_ordinal():
+    s = _sess()
+    import pyarrow as pa
+    s.create_dataframe(pa.table({"x": [-1, 2, 5]})) \
+        .create_or_replace_temp_view("t")
+    got = s.sql("SELECT x FROM t WHERE x IN (-1, 2) ORDER BY x;") \
+        .to_pandas()
+    assert list(got["x"]) == [-1, 2]
+    with pytest.raises(SqlError, match="ordinal"):
+        s.sql("SELECT x FROM t GROUP BY 0")
+    with pytest.raises(SqlError, match="ordinal"):
+        s.sql("SELECT x FROM t ORDER BY 5")
