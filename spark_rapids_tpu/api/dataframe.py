@@ -333,11 +333,21 @@ class DataFrame:
     def sample(self, fraction: float, seed: int = 42) -> "DataFrame":
         return DataFrame(self.session, L.Sample(fraction, seed, self.plan))
 
-    def repartition(self, n: int, *cols) -> "DataFrame":
+    def repartition(self, n: Optional[int] = None, *cols) -> "DataFrame":
+        """Shuffle into n partitions (hash by cols when given). With no
+        explicit n, the count comes from
+        spark.rapids.tpu.sql.shuffle.partitions and adaptive execution
+        may coalesce small output partitions (Spark AQE semantics: an
+        explicit n is a hard contract, an implicit one is advisory)."""
+        import numpy as _np
+        if n is not None and not isinstance(n, (int, _np.integer)):
+            cols = (n,) + cols      # repartition(col, ...) form
+            n = None
         keys = [_as_expr(c) for c in cols]
         mode = "hash" if keys else "roundrobin"
-        return DataFrame(self.session,
-                         L.Repartition(n, keys, self.plan, mode))
+        plan = L.Repartition(int(n) if n is not None else None, keys,
+                             self.plan, mode, adaptive_ok=n is None)
+        return DataFrame(self.session, plan)
 
     def drop(self, *names: str) -> "DataFrame":
         keep = [f.name for f in self.plan.schema().fields

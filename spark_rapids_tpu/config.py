@@ -139,6 +139,23 @@ OOM_RETRY_ENABLED = register(
     "Enable the per-thread OOM retry/split state machine "
     "(ref RmmRapidsRetryIterator.scala:33).")
 
+ADAPTIVE_ENABLED = register(
+    "spark.rapids.tpu.sql.adaptive.enabled", True,
+    "Adaptive execution: post-shuffle partition coalescing by observed "
+    "partition sizes (ref Spark AQE + GpuCustomShuffleReaderExec).",
+    commonly_used=True)
+
+ADAPTIVE_TARGET_BYTES = register(
+    "spark.rapids.tpu.sql.adaptive.targetPostShuffleBytes",
+    64 * 1024 * 1024,
+    "Adaptive coalescing merges consecutive shuffle partitions until this "
+    "many bytes (ref spark.sql.adaptive.advisoryPartitionSizeInBytes).")
+
+DEFAULT_SHUFFLE_PARTITIONS = register(
+    "spark.rapids.tpu.sql.shuffle.partitions", 8,
+    "Partition count for repartition() without an explicit count "
+    "(ref spark.sql.shuffle.partitions).")
+
 SHUFFLE_MODE = register(
     "spark.rapids.tpu.shuffle.mode", "MULTITHREADED",
     "MULTITHREADED (host-staged) / ICI (device-resident collective exchange) / "

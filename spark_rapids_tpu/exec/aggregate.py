@@ -264,8 +264,12 @@ class TpuHashAggregateExec(TpuExec):
         for j, i in enumerate(self._dict_keys):
             d = self._dicts[j]
             g = self.groupings[i]
+            from ..exprs.base import Alias
+            if isinstance(g, Alias):
+                g = g.children[0]
             src = None
-            if isinstance(g, ColumnRef):
+            if isinstance(g, ColumnRef) \
+                    and g.name in batch.schema.names():
                 src = batch.column_by_name(g.name)
             if isinstance(src, DictColumn):
                 gmap = np.asarray(

@@ -1,6 +1,8 @@
 from .mesh import Mesh, NamedSharding, P, make_mesh, replicated, row_sharding
-from .collective import build_distributed_agg_step, distributed_groupby
+from .collective import (build_distributed_agg_step,
+                         build_distributed_join_step, distributed_groupby,
+                         distributed_join)
 
 __all__ = ["Mesh", "NamedSharding", "P", "make_mesh", "replicated",
            "row_sharding", "build_distributed_agg_step",
-           "distributed_groupby"]
+           "distributed_groupby", "distributed_join"]

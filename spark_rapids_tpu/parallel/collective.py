@@ -31,7 +31,8 @@ from ..exprs.base import DVal, EvalContext, Expression
 from ..exec.groupby_core import segmented_groupby
 from ..types import Schema
 
-__all__ = ["build_distributed_agg_step", "distributed_groupby"]
+__all__ = ["build_distributed_agg_step", "distributed_groupby",
+           "build_distributed_join_step", "distributed_join"]
 
 # Engine-INTERNAL routing hash for group->owner placement (placement here
 # never needs Spark parity — unlike shuffle partitioning, which uses the
@@ -99,6 +100,20 @@ def _route_to_buffers(arrays, pid, padded_len: int, n_dev: int):
     return outs
 
 
+def _compact_rows(arrays, keep, length):
+    """Move keep-rows to the front (cumsum+scatter); arrays are (data,
+    validity) pairs; returns compacted pairs + count."""
+    cnt = jnp.sum(keep).astype(jnp.int32)
+    pos = jnp.where(keep, jnp.cumsum(keep) - 1, length)
+    out = []
+    for d, v in arrays:
+        cd = jnp.zeros_like(d).at[pos].set(d, mode="drop")
+        cv = jnp.zeros_like(v).at[pos].set(
+            jnp.logical_and(v, keep), mode="drop")
+        out.append((cd, cv))
+    return out, cnt
+
+
 def build_distributed_agg_step(mesh: Mesh, schema: Schema,
                                key_exprs: Sequence[Expression],
                                aggs: Sequence,
@@ -114,18 +129,7 @@ def build_distributed_agg_step(mesh: Mesh, schema: Schema,
     dtypes = [f.dtype for f in schema.fields]
     partial_counts = [len(a.partial_types(schema)) for a in aggs]
 
-    def _compact(arrays, keep, length):
-        """Move keep-rows to the front (same cumsum+scatter as the filter
-        kernel); returns compacted arrays + count."""
-        cnt = jnp.sum(keep).astype(jnp.int32)
-        pos = jnp.where(keep, jnp.cumsum(keep) - 1, length)
-        out = []
-        for d, v in arrays:
-            cd = jnp.zeros_like(d).at[pos].set(d, mode="drop")
-            cv = jnp.zeros_like(v).at[pos].set(
-                jnp.logical_and(v, keep), mode="drop")
-            out.append((cd, cv))
-        return out, cnt
+    _compact = _compact_rows
 
     def local_step(nrows, *cols):
         P_ = local_padded
@@ -241,27 +245,8 @@ def distributed_groupby(mesh: Mesh, table, key_names: List[str], aggs,
     key_exprs = [ColumnRef(k) for k in key_names]
     step, _ = build_distributed_agg_step(mesh, schema, key_exprs, aggs,
                                          local_p, pre_filter, axis)
-    # build per-shard padded arrays
-    shards = [table.slice(i * per, per) for i in range(n_dev)]
-    nrows = np.array([s.num_rows for s in shards], dtype=np.int32)
-    cols_flat = []
-    for f in schema.fields:
-        ds, vs = [], []
-        for s in shards:
-            b = ColumnarBatch.from_arrow(s.select([f.name]))
-            c = b.columns[0]
-            d = np.asarray(jax.device_get(c.data))
-            v = np.asarray(jax.device_get(c.validity))
-            if d.shape[0] < local_p:
-                d = np.pad(d, (0, local_p - d.shape[0]))
-                v = np.pad(v, (0, local_p - v.shape[0]))
-            ds.append(d[:local_p])
-            vs.append(v[:local_p])
-        cols_flat.append(jnp.asarray(np.concatenate(ds)))
-        cols_flat.append(jnp.asarray(np.concatenate(vs)))
-    sharding = NamedSharding(mesh, P(axis))
-    nrows_dev = jax.device_put(jnp.asarray(nrows), sharding)
-    cols_dev = [jax.device_put(c, sharding) for c in cols_flat]
+    nrows_dev, cols_dev = _shard_table_arrays(mesh, table, schema,
+                                              local_p, axis)
     out = step(nrows_dev, *cols_dev)
     m_groups = np.asarray(jax.device_get(out[0]))
     data = [np.asarray(jax.device_get(x)) for x in out[1:]]
@@ -281,6 +266,185 @@ def distributed_groupby(mesh: Mesh, table, key_names: List[str], aggs,
         dv = np.concatenate(parts_d)
         vv = np.concatenate(parts_v)
         from ..columnar.column import DeviceColumn
+        col = DeviceColumn(jnp.asarray(dv), jnp.asarray(vv), dtypes[ci])
+        arrays.append(col.to_arrow(len(dv)))
+    return pa.Table.from_arrays(arrays, names=names)
+
+
+# ---------------------------------------------------------------------------
+# distributed equi-join (the ICI analog of the reference's UCX shuffle join:
+# both sides hash-route rows to key owners with ONE all_to_all each, then
+# every device runs the local sort-based join kernel on its co-partitioned
+# slice — the same kernel as single-chip exec/joins.py, so distribution
+# cannot change results)
+# ---------------------------------------------------------------------------
+
+def build_distributed_join_step(mesh: Mesh, lschema: Schema,
+                                rschema: Schema,
+                                lkey_exprs: Sequence[Expression],
+                                rkey_exprs: Sequence[Expression],
+                                local_padded: int, out_factor: int = 4,
+                                axis: str = "data"):
+    """Returns fn(nl, nr, *lcols, *rcols) under shard_map. Per device the
+    local join output is bounded by ``out_factor * local_padded`` rows
+    (static shapes: XLA requirement); the returned per-device `total` lets
+    the caller detect overflow and re-run with a larger factor."""
+    from ..exec.joins import _build_count_kernel, _gather_index_kernel
+    n_dev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
+    ldtypes = [f.dtype for f in lschema.fields]
+    rdtypes = [f.dtype for f in rschema.fields]
+    P_ = local_padded
+    RP = n_dev * P_                 # received rows bound per device
+    OUT = out_factor * P_           # local join output bound
+    count_k = _build_count_kernel(lkey_exprs, rkey_exprs, lschema, rschema,
+                                  "inner")
+
+    # both sides must hash each key through a COMMON dtype, or equal keys
+    # of different widths route to different owners and matches silently
+    # vanish (the local count kernel promotes before comparing; routing
+    # must promote identically)
+    l0, r0 = lschema, rschema
+    key_np = [np.promote_types(lk.data_type(l0).np_dtype,
+                               rk.data_type(r0).np_dtype)
+              for lk, rk in zip(lkey_exprs, rkey_exprs)]
+
+    def route_side(nloc, pairs, dtypes, schema, key_exprs):
+        dvals = [DVal(d, v, dt) for (d, v), dt in zip(pairs, dtypes)]
+        ctx = EvalContext(schema, dvals, nloc, P_)
+        live = ctx.row_mask()
+        keys = [e.eval_device(ctx) for e in key_exprs]
+        h = jnp.full(P_, jnp.uint32(42))
+        for k, npdt in zip(keys, key_np):
+            kk = DVal(k.data.astype(npdt), k.validity, k.dtype)
+            h = _mix32(h * jnp.uint32(31) + _col_hash_u32(kk))
+        pid = jnp.where(live, (h % jnp.uint32(n_dev)).astype(jnp.int32),
+                        jnp.int32(n_dev))
+        # explicit liveness lane: a routed row may be all-null, so column
+        # validities cannot double as the row-live flag
+        flat = list(pairs) + [(jnp.ones(P_, jnp.int8), live)]
+        bufs = _route_to_buffers(flat, pid, P_, n_dev)
+        recv = []
+        for d, v in bufs:
+            rd = jax.lax.all_to_all(d, axis, 0, 0, tiled=False)
+            rv = jax.lax.all_to_all(v, axis, 0, 0, tiled=False)
+            recv.append((rd.reshape(RP), rv.reshape(RP)))
+        live_recv = recv[-1][1]
+        comp, cnt = _compact_rows(recv[:-1], live_recv, RP)
+        return comp, cnt
+
+    def local(nl, nr, *cols):
+        nL, nR = len(ldtypes), len(rdtypes)
+        lpairs = [(cols[2 * i], cols[2 * i + 1]) for i in range(nL)]
+        rpairs = [(cols[2 * nL + 2 * i], cols[2 * nL + 2 * i + 1])
+                  for i in range(nR)]
+        lcomp, nl2 = route_side(nl[0], lpairs, ldtypes, lschema, lkey_exprs)
+        rcomp, nr2 = route_side(nr[0], rpairs, rdtypes, rschema, rkey_exprs)
+        (s_orig, cnt_l, cnt_r, start_l, start_r, _pairs, offsets, total,
+         _ng) = count_k(lcomp, rcomp, nl2, nr2, RP, RP)
+        cfg = jnp.zeros(3, dtype=jnp.int32)       # inner join
+        l_row, r_row = _gather_index_kernel(
+            s_orig, cnt_l, cnt_r, start_l, start_r, offsets, cfg, OUT)
+        out_live = jnp.arange(OUT, dtype=jnp.int64) < total
+        outs = []
+        for d, v in lcomp:
+            idx = jnp.clip(l_row, 0, None)
+            outs.append(jnp.take(d, idx, mode="clip"))
+            outs.append(jnp.logical_and(
+                jnp.take(v, idx, mode="clip"),
+                jnp.logical_and(out_live, l_row >= 0)))
+        for d, v in rcomp:
+            idx = jnp.clip(r_row, 0, None)
+            outs.append(jnp.take(d, idx, mode="clip"))
+            outs.append(jnp.logical_and(
+                jnp.take(v, idx, mode="clip"),
+                jnp.logical_and(out_live, r_row >= 0)))
+        return (total.astype(jnp.int64).reshape(1),
+                out_live.reshape(1, OUT)) + tuple(
+                    o.reshape(1, OUT) for o in outs)
+
+    n_in = 2 * (len(ldtypes) + len(rdtypes))
+    in_specs = (P(axis), P(axis)) + tuple(P(axis) for _ in range(n_in))
+    n_out = 2 + n_in
+    out_specs = tuple(P(axis) for _ in range(n_out))
+    fn = shard_map(local, mesh=mesh, in_specs=in_specs,
+                   out_specs=out_specs, check_vma=False)
+    return jax.jit(fn), n_dev, OUT
+
+
+def _shard_table_arrays(mesh, table, schema, local_p, axis):
+    """Split an Arrow table row-wise across the mesh into padded, sharded
+    global (data, validity) device arrays + per-shard row counts."""
+    from ..columnar import ColumnarBatch
+    n_dev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
+    per = -(-table.num_rows // n_dev) if table.num_rows else 1
+    shards = [table.slice(i * per, per) for i in range(n_dev)]
+    nrows = np.array([s.num_rows for s in shards], dtype=np.int32)
+    sharding = NamedSharding(mesh, P(axis))
+    cols_dev = []
+    for f in schema.fields:
+        ds, vs = [], []
+        for s in shards:
+            b = ColumnarBatch.from_arrow(s.select([f.name]))
+            c = b.columns[0]
+            d = np.asarray(jax.device_get(c.data))
+            v = np.asarray(jax.device_get(c.validity))
+            if d.shape[0] < local_p:
+                d = np.pad(d, (0, local_p - d.shape[0]))
+                v = np.pad(v, (0, local_p - v.shape[0]))
+            ds.append(d[:local_p])
+            vs.append(v[:local_p])
+        cols_dev.append(jax.device_put(jnp.asarray(np.concatenate(ds)),
+                                       sharding))
+        cols_dev.append(jax.device_put(jnp.asarray(np.concatenate(vs)),
+                                       sharding))
+    nrows_dev = jax.device_put(jnp.asarray(nrows), sharding)
+    return nrows_dev, cols_dev
+
+
+def distributed_join(mesh: Mesh, ltable, rtable, on, out_factor: int = 4,
+                     axis: str = "data"):
+    """Host-friendly wrapper: inner equi-join of two Arrow tables over the
+    mesh; returns the joined Arrow table (l columns then r columns).
+    ``on`` is a list of (left_col, right_col) name pairs."""
+    import pyarrow as pa
+    from ..columnar import ColumnarBatch
+    from ..columnar.bucketing import bucket_for
+    from ..columnar.column import DeviceColumn
+    from ..exprs.base import ColumnRef
+
+    n_dev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
+    per = max(-(-max(ltable.num_rows, rtable.num_rows) // n_dev), 1)
+    local_p = bucket_for(per)
+    lschema = ColumnarBatch.from_arrow(ltable, pad=False).schema
+    rschema = ColumnarBatch.from_arrow(rtable, pad=False).schema
+    lkeys = [ColumnRef(a) for a, _ in on]
+    rkeys = [ColumnRef(b) for _, b in on]
+    step, _, OUT = build_distributed_join_step(
+        mesh, lschema, rschema, lkeys, rkeys, local_p, out_factor, axis)
+    nl, lcols = _shard_table_arrays(mesh, ltable, lschema, local_p, axis)
+    nr, rcols = _shard_table_arrays(mesh, rtable, rschema, local_p, axis)
+    out = step(nl, nr, *(lcols + rcols))
+    totals = np.asarray(jax.device_get(out[0]))
+    if (totals > OUT).any():
+        raise RuntimeError(
+            f"distributed join output overflowed the static bound "
+            f"(max {int(totals.max())} > {OUT}); re-run with a larger "
+            f"out_factor")
+    data = [np.asarray(jax.device_get(x)) for x in out[2:]]
+    names = [f.name for f in lschema.fields] + \
+        [f.name for f in rschema.fields]
+    dtypes = [f.dtype for f in lschema.fields] + \
+        [f.dtype for f in rschema.fields]
+    arrays = []
+    for ci in range(len(names)):
+        d_all, v_all = data[2 * ci], data[2 * ci + 1]
+        parts_d, parts_v = [], []
+        for dev in range(n_dev):
+            g = int(totals[dev])
+            parts_d.append(d_all[dev][:g])
+            parts_v.append(v_all[dev][:g])
+        dv = np.concatenate(parts_d)
+        vv = np.concatenate(parts_v)
         col = DeviceColumn(jnp.asarray(dv), jnp.asarray(vv), dtypes[ci])
         arrays.append(col.to_arrow(len(dv)))
     return pa.Table.from_arrays(arrays, names=names)

@@ -329,11 +329,19 @@ class Window(LogicalPlan):
 
 
 class Repartition(LogicalPlan):
-    """Exchange request (ref GpuShuffleExchangeExecBase)."""
+    """Exchange request (ref GpuShuffleExchangeExecBase).
 
-    def __init__(self, num_partitions: int, keys: Sequence[Expression],
-                 child: LogicalPlan, mode: str = "hash"):
+    ``num_partitions`` None means "use the conf default"; only then may
+    adaptive execution coalesce the output (``adaptive_ok``)."""
+
+    def __init__(self, num_partitions: Optional[int],
+                 keys: Sequence[Expression], child: LogicalPlan,
+                 mode: str = "hash", adaptive_ok: bool = False):
+        if num_partitions is not None and num_partitions <= 0:
+            raise ValueError(
+                f"repartition count must be positive, got {num_partitions}")
         self.num_partitions = num_partitions
+        self.adaptive_ok = adaptive_ok
         self.keys = list(keys)
         self.mode = mode  # hash / roundrobin / range / single
         self.children = [child]

@@ -228,11 +228,15 @@ class AggregateMeta(PlanMeta):
         if not stages:
             return child, None, None
         # string group keys are dictionary-encoded OUTSIDE the kernel from
-        # the folded input batch — they must be plain refs present there
+        # the folded input batch — they must be plain refs (possibly
+        # aliased) present there
+        from ..exprs.base import Alias
         in_names = set(node.output_schema().names())
         for g in self.plan.groupings:
+            inner = g.children[0] if isinstance(g, Alias) else g
             if g.data_type(eval_schema) == STRING and not (
-                    isinstance(g, ColumnRef) and g.name in in_names):
+                    isinstance(inner, ColumnRef)
+                    and inner.name in in_names):
                 return child, None, None
         stages.reverse()
         return node, stages, eval_schema
@@ -403,17 +407,24 @@ class RepartitionMeta(PlanMeta):
                     self.will_not_work_on_tpu(
                         f"hash partition key <{k.name_hint}>: {hr}")
 
+    def _num_parts(self):
+        from ..config import DEFAULT_SHUFFLE_PARTITIONS
+        n = self.plan.num_partitions
+        return n if n is not None \
+            else int(self.conf.get(DEFAULT_SHUFFLE_PARTITIONS))
+
     def convert_to_tpu(self, children):
         from ..shuffle.exchange import ShuffleExchangeExec
         p = self.plan
-        return ShuffleExchangeExec(children[0], p.num_partitions, p.keys,
-                                   p.mode, self.conf)
+        return ShuffleExchangeExec(
+            children[0], self._num_parts(), p.keys, p.mode, self.conf,
+            adaptive_ok=p.adaptive_ok)
 
     def convert_to_cpu(self, children):
         from ..shuffle.exchange import CpuShuffleExchangeExec
         p = self.plan
-        return CpuShuffleExchangeExec(children[0], p.num_partitions, p.keys,
-                                      p.mode)
+        return CpuShuffleExchangeExec(children[0], self._num_parts(),
+                                      p.keys, p.mode)
 
 
 @rule(L.WriteFile)

@@ -57,12 +57,16 @@ class ShuffleCatalog:
 
 class ShuffleExchangeExec(TpuExec):
     def __init__(self, child: TpuExec, num_partitions: int,
-                 keys: Sequence[Expression], mode: str, conf: TpuConf):
+                 keys: Sequence[Expression], mode: str, conf: TpuConf,
+                 adaptive_ok: bool = False):
         super().__init__([child])
         self.num_partitions = num_partitions
         self.keys = list(keys)
         self.part_mode = mode if keys or mode != "hash" else "roundrobin"
         self.conf = conf
+        #: adaptive coalescing allowed (implicit partition count — an
+        #: explicit repartition(n) is a hard contract, Spark AQE semantics)
+        self.adaptive_ok = adaptive_ok
 
     def output_schema(self) -> Schema:
         return self.children[0].output_schema()
@@ -70,9 +74,39 @@ class ShuffleExchangeExec(TpuExec):
     def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
         shuffle_mode = ctx.conf.shuffle_mode
         if shuffle_mode == "CACHE_ONLY":
-            yield from self._device_resident(ctx)
+            gen = self._device_resident(ctx)
         else:
-            yield from self._multithreaded(ctx)
+            gen = self._multithreaded(ctx)
+        yield from self._adaptive_read(ctx, gen)
+
+    # -- AQE shuffle read (ref GpuCustomShuffleReaderExec + Spark's
+    # CoalesceShufflePartitions): merge consecutive small partitions up
+    # to the advisory size, by their OBSERVED sizes -----------------------
+    def _adaptive_read(self, ctx: ExecContext,
+                       gen: Iterator[ColumnarBatch]):
+        from ..config import ADAPTIVE_ENABLED, ADAPTIVE_TARGET_BYTES
+        if not (self.adaptive_ok and ctx.conf.get(ADAPTIVE_ENABLED)):
+            yield from gen
+            return
+        target = int(ctx.conf.get(ADAPTIVE_TARGET_BYTES))
+        coalesced_m = ctx.metric(self._exec_id, "aqeCoalescedPartitions")
+        pending: List[ColumnarBatch] = []
+        pending_bytes = 0
+        def flush():
+            if len(pending) > 1:     # metric counts actual merges only
+                coalesced_m.add(len(pending))
+            return (pending[0] if len(pending) == 1
+                    else concat_batches(pending))
+
+        for b in gen:
+            sz = b.size_bytes()
+            if pending and pending_bytes + sz > target:
+                yield flush()
+                pending, pending_bytes = [], 0
+            pending.append(b)
+            pending_bytes += sz
+        if pending:
+            yield flush()
 
     # ------------------------------------------------------- MULTITHREADED
     def _multithreaded(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:

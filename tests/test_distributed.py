@@ -123,3 +123,115 @@ def test_distributed_groups_are_disjoint():
                               [CountStar("n")])
     ks = out.to_pandas()["k"]
     assert len(ks) == len(set(ks.fillna(-999)))
+
+
+# ---------------------------------------------------------------------------
+# adaptive execution (ref Spark AQE + GpuCustomShuffleReaderExec)
+# ---------------------------------------------------------------------------
+
+def test_adaptive_coalesces_small_partitions():
+    import numpy as np
+    import pyarrow as pa
+    from harness import tpu_session
+    from spark_rapids_tpu.api import functions as F
+    t = pa.table({"k": pa.array(np.arange(2000) % 64),
+                  "v": pa.array(np.ones(2000))})
+    # implicit repartition -> adaptive may coalesce tiny partitions
+    s = tpu_session({"spark.rapids.tpu.sql.shuffle.partitions": 16})
+    df = s.create_dataframe(t).repartition(F.col("k"))
+    batches = list(df._physical().execute(s.exec_context()))
+    assert len(batches) < 16          # coalesced
+    assert sum(b.num_rows for b in batches) == 2000
+    # explicit repartition(n) is a hard contract: no coalescing
+    s2 = tpu_session()
+    df2 = s2.create_dataframe(t).repartition(16, F.col("k"))
+    batches2 = list(df2._physical().execute(s2.exec_context()))
+    assert len(batches2) == 16
+    # adaptive off -> implicit keeps the conf partition count
+    s3 = tpu_session({"spark.rapids.tpu.sql.adaptive.enabled": False,
+                      "spark.rapids.tpu.sql.shuffle.partitions": 16})
+    df3 = s3.create_dataframe(t).repartition(F.col("k"))
+    batches3 = list(df3._physical().execute(s3.exec_context()))
+    assert len(batches3) == 16
+    # data identical across all three
+    import pandas as pd
+    base = df2.to_pandas().sort_values(["k", "v"]).reset_index(drop=True)
+    got = df.to_pandas().sort_values(["k", "v"]).reset_index(drop=True)
+    pd.testing.assert_frame_equal(base, got)
+
+
+def test_distributed_join_matches_arrow():
+    import numpy as np
+    import pyarrow as pa
+    from spark_rapids_tpu.parallel import distributed_join, make_mesh
+    mesh = make_mesh()
+    rng = np.random.RandomState(11)
+    l = pa.table({"k": pa.array(rng.randint(0, 40, 500), pa.int64()),
+                  "lv": pa.array(rng.standard_normal(500))})
+    r = pa.table({"rk": pa.array(np.arange(0, 40, 2), pa.int64()),
+                  "rv": pa.array(np.arange(20).astype("int64"))})
+    got = distributed_join(mesh, l, r, on=[("k", "rk")]).to_pandas()
+    exp = l.join(r, keys=["k"], right_keys=["rk"],
+                 join_type="inner").to_pandas()
+    assert len(got) == len(exp)
+    gs = got.sort_values(["k", "lv"]).reset_index(drop=True)
+    es = exp.sort_values(["k", "lv"]).reset_index(drop=True)
+    np.testing.assert_allclose(gs["lv"], es["lv"])
+    np.testing.assert_array_equal(gs["k"], es["k"])
+
+
+def test_distributed_join_null_keys_never_match():
+    import numpy as np
+    import pyarrow as pa
+    from spark_rapids_tpu.parallel import distributed_join, make_mesh
+    mesh = make_mesh()
+    l = pa.table({"k": pa.array([1, None, 2, None], pa.int64()),
+                  "lv": pa.array([1.0, 2.0, 3.0, 4.0])})
+    r = pa.table({"rk": pa.array([1, 2, None], pa.int64()),
+                  "rv": pa.array([10, 20, 30], pa.int64())})
+    got = distributed_join(mesh, l, r, on=[("k", "rk")]).to_pandas()
+    assert len(got) == 2 and set(got["k"]) == {1, 2}
+
+
+def test_distributed_join_overflow_detection():
+    import numpy as np
+    import pyarrow as pa
+    import pytest
+    from spark_rapids_tpu.parallel import distributed_join, make_mesh
+    mesh = make_mesh()
+    # all-same-key: output is |l|*|r| on one device — must overflow loudly
+    l = pa.table({"k": pa.array(np.zeros(400, np.int64)),
+                  "lv": pa.array(np.ones(400))})
+    r = pa.table({"rk": pa.array(np.zeros(400, np.int64)),
+                  "rv": pa.array(np.ones(400))})
+    with pytest.raises(RuntimeError, match="out_factor"):
+        distributed_join(mesh, l, r, on=[("k", "rk")], out_factor=2)
+
+
+def test_distributed_join_mixed_key_dtypes():
+    """int32 vs int64 keys must co-route (promotion before hashing)."""
+    import numpy as np
+    import pyarrow as pa
+    from spark_rapids_tpu.parallel import distributed_join, make_mesh
+    mesh = make_mesh()
+    l = pa.table({"k": pa.array(np.arange(100, dtype=np.int32)),
+                  "lv": pa.array(np.ones(100))})
+    r = pa.table({"rk": pa.array(np.arange(0, 100, 5, dtype=np.int64)),
+                  "rv": pa.array(np.ones(20, dtype=np.int64))})
+    got = distributed_join(mesh, l, r, on=[("k", "rk")]).to_pandas()
+    assert len(got) == 20
+    assert sorted(got["k"]) == list(range(0, 100, 5))
+
+
+def test_repartition_accepts_numpy_int():
+    import numpy as np
+    import pyarrow as pa
+    from harness import tpu_session
+    s = tpu_session()
+    t = pa.table({"k": pa.array(np.arange(100) % 5)})
+    df = s.create_dataframe(t).repartition(np.int64(4))
+    batches = list(df._physical().execute(s.exec_context()))
+    assert len(batches) == 4
+    import pytest
+    with pytest.raises(ValueError, match="positive"):
+        s.create_dataframe(t).repartition(0)
