@@ -382,6 +382,7 @@ def rand(seed: int = 0) -> Col:
 def row_number(): return E.RowNumber()
 def rank(): return E.Rank()
 def dense_rank(): return E.DenseRank()
+def ntile(n): return E.NTile(n)
 def lag(c, offset=1, default=None):
     return E.Lag(_to_expr(c), offset, default)
 def lead(c, offset=1, default=None):

@@ -51,7 +51,8 @@ def _build_window_kernel(window_exprs, schema: Schema, padded_len_key=None):
     @functools.partial(jax.jit, static_argnums=(2,))
     def kernel(cols, num_rows, padded_len):
         P = padded_len
-        dvals = [DVal(c[0], c[1], dt) for c, dt in zip(cols, dtypes)]
+        dvals = [None if c is None else DVal(c[0], c[1], dt)
+                 for c, dt in zip(cols, dtypes)]
         ctx = EvalContext(schema, dvals, num_rows, P)
         row_mask = ctx.row_mask()
         outs = []
@@ -280,7 +281,11 @@ class TpuWindowExec(TpuExec):
         def run():
             with ctx.semaphore.held():
                 batch = concat_batches([s.get() for s in spill])
-                cols = [(c.data, c.validity) for c in batch.columns]
+                # host columns (e.g. high-cardinality strings) ride
+                # through untouched; the kernel must not dereference them
+                cols = [(c.data, c.validity)
+                        if isinstance(c, DeviceColumn) else None
+                        for c in batch.columns]
                 outs = kern(cols, jnp.int32(batch.num_rows),
                             batch.padded_len)
                 new_cols = list(batch.columns)
@@ -328,27 +333,51 @@ class CpuWindowExec(TpuExec):
                 pc = f"__p{i}"
                 df[pc] = pk.eval_host(batch).to_pandas()
                 pcols.append(pc)
-            ocols, asc = [], []
+            ocols = []
             for i, o in enumerate(spec.order_by):
                 oc = f"__o{i}"
                 df[oc] = o.expr.eval_host(batch).to_pandas()
                 ocols.append(oc)
-                asc.append(o.ascending)
-            work = df.sort_values(pcols + ocols, ascending=[True] * len(pcols)
-                                  + asc, kind="mergesort",
-                                  na_position="first") if (pcols or ocols) \
-                else df
+            if pcols or ocols:
+                # per-column direction AND null placement must match the
+                # device kernel (order_key_operands); pandas sort_values
+                # has one global na_position, so encode like CpuSortExec
+                import numpy as np
+                from ..exprs.arithmetic import arrow_to_masked_numpy
+                from .sort import _np_total_order_key
+                lex = []
+                specs = [(o.expr, o.ascending, o.nulls_first)
+                         for o in spec.order_by]
+                specs = [(pk, True, True) for pk in spec.partition_by] + specs
+                for e, asc_, nf in reversed(specs):
+                    v, ok = arrow_to_masked_numpy(e.eval_host(batch))
+                    enc = _np_total_order_key(v, ok)
+                    if not asc_:
+                        enc = ~enc
+                    enc = np.where(ok, enc, np.uint64(0))
+                    rank = np.where(ok, 1, 0) if nf else np.where(ok, 0, 1)
+                    lex.extend([enc, rank.astype(np.uint8)])
+                order = np.lexsort(tuple(lex))
+                work = df.iloc[order]
+            else:
+                work = df
             g = work.groupby(pcols, dropna=False, sort=False) if pcols \
                 else work.assign(__one=1).groupby("__one")
             if isinstance(fn, RowNumber):
                 res = g.cumcount() + 1
             elif isinstance(fn, Rank):
-                res = g[ocols[0]].rank(method="min").astype("int64") \
-                    if len(ocols) == 1 else _multi_rank(work, g, ocols, "min")
+                res = _sorted_rank(work, pcols, ocols, dense=False)
             elif isinstance(fn, DenseRank):
-                res = g[ocols[0]].rank(method="dense").astype("int64") \
-                    if len(ocols) == 1 else _multi_rank(work, g, ocols,
-                                                        "dense")
+                res = _sorted_rank(work, pcols, ocols, dense=True)
+            elif isinstance(fn, NTile):
+                rn = g.cumcount()
+                cnt = g[work.columns[0]].transform("size") \
+                    if pcols else pd.Series(len(work), index=work.index)
+                base, rem = cnt // fn.n, cnt % fn.n
+                big = rem * (base + 1)
+                res = (rn.where(rn < big, other=None).floordiv(base + 1)
+                       .fillna(rem + (rn - big) // base.clip(lower=1))
+                       .astype("int64") + 1)
             elif isinstance(fn, Lag):
                 src = fn.child.eval_host(batch).to_pandas()
                 work["__v"] = src.reindex(work.index)
@@ -363,8 +392,10 @@ class CpuWindowExec(TpuExec):
                 raise NotImplementedError(type(fn).__name__)
             df[name] = res.reindex(df.index) if hasattr(res, "reindex") \
                 else res
-            df = df.drop(columns=[c for c in df.columns
-                                  if c.startswith("__")])
+            # drop only the temporaries THIS loop created — input columns
+            # may legitimately start with "__" (e.g. SQL-hoisted windows)
+            temps = set(pcols + ocols) | {"__v", "__a", "__one"}
+            df = df.drop(columns=[c for c in df.columns if c in temps])
         from ..types import to_arrow
         arrays = []
         for f in self._schema.fields:
@@ -413,7 +444,25 @@ class CpuWindowExec(TpuExec):
                                         self.window_exprs) + "]"
 
 
-def _multi_rank(work, g, ocols, method):
-    key = work[ocols].apply(tuple, axis=1)
-    work["__rk"] = key
-    return g["__rk"].rank(method=method).astype("int64")
+def _sorted_rank(work, pcols, ocols, dense: bool):
+    """rank/dense_rank computed POSITIONALLY over the pre-sorted frame:
+    the sort already applied each order column's ASC/DESC and null
+    placement, so equal-key runs are contiguous and direction never needs
+    re-deriving (pandas' value rank() is ascending-only and was wrong for
+    DESC orders). Nulls compare EQUAL for ranking (Spark semantics), so
+    run detection uses null-safe per-column equality, never tuple !=."""
+    import pandas as pd
+    grp = [work[c] for c in pcols] if pcols else \
+        [pd.Series(0, index=work.index)]
+    anchor = work[ocols[0]] if ocols else pd.Series(0, index=work.index)
+    rn = anchor.groupby(grp, dropna=False, sort=False).cumcount() + 1
+    same = pd.Series(True, index=work.index)
+    for c in ocols:
+        col, prev = work[c], work[c].shift(1)
+        same &= (col == prev) | (col.isna() & prev.isna())
+    newrun = (rn == 1) | ~same
+    if dense:
+        return newrun.groupby(grp, dropna=False, sort=False) \
+            .cumsum().astype("int64")
+    r = rn.where(newrun)
+    return r.groupby(grp, dropna=False, sort=False).ffill().astype("int64")

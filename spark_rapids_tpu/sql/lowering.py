@@ -114,6 +114,16 @@ class _Lowerer:
 
         select_has_agg = any(_contains_agg(e) for e, _ in sel.items) \
             or bool(sel.group_by) or _contains_agg(sel.having)
+        has_window = (any(_contains_window(e) for e, _ in sel.items)
+                      or _contains_window(sel.having)
+                      or any(_contains_window(o.expr)
+                             for o in sel.order_by))
+        if has_window:
+            if select_has_agg:
+                raise SqlError(
+                    "window functions over aggregates need a subquery "
+                    "(SELECT ... OVER ... FROM (SELECT ... GROUP BY ...))")
+            df, sel = self._hoist_windows(df, sel)
 
         if select_has_agg:
             df, alias_map, order_handled = self._lower_aggregate(df, sel)
@@ -125,11 +135,136 @@ class _Lowerer:
                 return df
             return self._order_limit(df, sel.order_by, sel.limit,
                                      alias_map, df.columns)
-        df, alias_map = self._lower_projection(df, sel)
         if sel.distinct:
+            df, alias_map = self._lower_projection(df, sel)
             df = df.distinct()
-        return self._order_limit(df, sel.order_by, sel.limit, alias_map,
-                                 df.columns)
+            return self._order_limit(df, sel.order_by, sel.limit,
+                                     alias_map, df.columns)
+        # non-distinct: ORDER BY resolves against the PRE-projection frame
+        # so it can reference hoisted window columns, select aliases, and
+        # source columns the projection drops (SQL-legal)
+        if sel.order_by:
+            items = self._expand_items(df, sel.items)
+            alias_ast = {a.lower(): e for e, a in items if a}
+            orders = []
+            for o in sel.order_by:
+                e = o.expr
+                if isinstance(e, tuple) and e[0] == "lit" \
+                        and isinstance(e[1], int):
+                    e, _ = items[_ordinal(e[1], len(items))]
+                elif isinstance(e, tuple) and e[0] == "col" \
+                        and len(e[1]) == 1 \
+                        and e[1][0].lower() in alias_ast:
+                    e = alias_ast[e[1][0].lower()]
+                c = self._expr(e)
+                orders.append(c.asc(o.nulls_first) if o.ascending
+                              else c.desc(o.nulls_first))
+            df = df.order_by(*orders)
+        df, alias_map = self._lower_projection(df, sel)
+        if sel.limit is not None:
+            df = df.limit(sel.limit)
+        return df
+
+    # -- window functions -------------------------------------------------
+    def _hoist_windows(self, df, sel: Select):
+        """Replace window-call subtrees (in SELECT, HAVING, ORDER BY) with
+        refs to computed columns; all hoisted calls land in ONE Window
+        plan node (the exec handles a list natively — one spill/concat
+        pass instead of a stack of Window nodes)."""
+        import copy
+        from ..plan.logical import SortOrder, Window, WindowSpec
+
+        def int_lit(ast, what):
+            if isinstance(ast, tuple) and ast[0] == "lit" \
+                    and isinstance(ast[1], int):
+                return ast[1]
+            if isinstance(ast, tuple) and ast[0] == "unary" \
+                    and ast[1] == "-" and isinstance(ast[2], tuple) \
+                    and ast[2][0] == "lit":
+                return -ast[2][1]
+            raise SqlError(f"{what} must be an integer literal")
+
+        def scalar_lit(ast, what):
+            if ast is None:
+                return None
+            if isinstance(ast, tuple) and ast[0] == "lit":
+                return ast[1]
+            if isinstance(ast, tuple) and ast[0] == "unary" \
+                    and ast[1] == "-" and isinstance(ast[2], tuple) \
+                    and ast[2][0] == "lit":
+                return -ast[2][1]
+            raise SqlError(f"{what} must be a literal")
+
+        wins = []    # (fn, WindowSpec, name)
+
+        def lower_win(ast):
+            _, fn_node, parts, orders, frame = ast
+            fname, args, distinct = fn_node[1], fn_node[2], fn_node[3]
+            if distinct:
+                raise SqlError(
+                    f"DISTINCT is not supported in window {fname}()")
+            if fname == "count" and (not args or args[0] == ("star",)):
+                f = F.count_star()
+            elif fname in _AGG_FNS:
+                f = _AGG_FNS[fname](self._expr(args[0]))
+            elif fname == "row_number":
+                f = F.row_number()
+            elif fname == "rank":
+                f = F.rank()
+            elif fname == "dense_rank":
+                f = F.dense_rank()
+            elif fname == "ntile":
+                f = F.ntile(int_lit(args[0], "ntile bucket count"))
+            elif fname in ("lag", "lead"):
+                off = int_lit(args[1], f"{fname} offset") \
+                    if len(args) > 1 else 1
+                default = scalar_lit(args[2] if len(args) > 2 else None,
+                                     f"{fname} default")
+                mk = F.lag if fname == "lag" else F.lead
+                f = mk(self._expr(args[0]), off, default)
+            else:
+                raise SqlError(f"{fname}() is not a window function")
+            pks = [self._expr(p).expr for p in parts]
+            obs = [SortOrder(self._expr(e).expr, asc, nf)
+                   for e, asc, nf in orders]
+            lframe = None
+            if frame is not None:
+                kind, lo, hi = frame
+                if kind != "rows":
+                    raise SqlError("only ROWS frames are supported")
+                lframe = ("rows", lo, hi)
+            name = f"__win{len(wins)}"
+            fn = f.expr if hasattr(f, "expr") else f
+            wins.append((fn, WindowSpec(pks, obs, lframe), name))
+            return name
+
+        def walk(ast):
+            if ast is None or not isinstance(ast, tuple):
+                return ast
+            if ast[0] == "window":
+                return ("col", (lower_win(ast),))
+            if ast[0] == "fn":
+                return ("fn", ast[1], [walk(a) for a in ast[2]], ast[3])
+            if ast[0] == "case":
+                return ("case", [(walk(c), walk(v)) for c, v in ast[1]],
+                        walk(ast[2]) if ast[2] is not None else None)
+            if ast[0] == "in":
+                return ("in", walk(ast[1]), [walk(v) for v in ast[2]],
+                        ast[3])
+            return tuple(walk(x) if isinstance(x, tuple) else x
+                         for x in ast)
+
+        new_sel = copy.copy(sel)
+        new_sel.items = [(walk(e), a) for e, a in sel.items]
+        new_sel.having = walk(sel.having)
+        from .parser import OrderItem
+        new_sel.order_by = [OrderItem(walk(o.expr), o.ascending,
+                                      o.nulls_first)
+                            for o in sel.order_by]
+        if wins:
+            from ..api.dataframe import DataFrame
+            df = DataFrame(df.session, Window(wins, df.plan))
+        return df, new_sel
 
     # -- joins ----------------------------------------------------------
     def _side_of(self, ast, lcols, rcols, alias_cols, ralias=None):
@@ -525,9 +660,28 @@ def _and_all(conjuncts):
     return out
 
 
+def _contains_window(ast) -> bool:
+    if ast is None or not isinstance(ast, tuple):
+        return False
+    if ast[0] == "window":
+        return True
+    if ast[0] == "fn":
+        return any(_contains_window(a) for a in ast[2])
+    if ast[0] == "case":
+        return any(_contains_window(c) or _contains_window(v)
+                   for c, v in ast[1]) or _contains_window(ast[2])
+    if ast[0] == "in":
+        return _contains_window(ast[1]) or any(_contains_window(v)
+                                               for v in ast[2])
+    return any(_contains_window(x) for x in ast[1:]
+               if isinstance(x, tuple))
+
+
 def _contains_agg(ast) -> bool:
     if ast is None or not isinstance(ast, tuple):
         return False
+    if ast[0] == "window":
+        return False      # agg inside OVER() is a window fn, not a groupby
     if ast[0] == "fn":
         if ast[1] in _AGG_FNS:
             return True

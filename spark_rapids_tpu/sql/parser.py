@@ -38,6 +38,8 @@ _KEYWORDS = {
     "null", "like", "between", "case", "when", "then", "else", "end",
     "cast", "union", "all", "with", "asc", "desc", "nulls", "first", "last",
     "date", "timestamp", "interval", "true", "false", "exists",
+    "over", "partition", "rows", "range", "unbounded", "preceding",
+    "following", "current", "row",
 }
 
 
@@ -453,7 +455,12 @@ class _Parser:
         if t.kind == "op" and t.val == "*":
             self.next()
             return ("star",)
-        if t.kind == "id" or (t.kind == "kw" and t.val in ("left", "right")):
+        # soft keywords: valid column/function names in expression position
+        # (Spark keeps these non-reserved)
+        soft = ("left", "right", "rows", "row", "range", "current",
+                "partition", "unbounded", "preceding", "following", "over",
+                "first", "last", "date", "timestamp")
+        if t.kind == "id" or (t.kind == "kw" and t.val in soft):
             name = self.next().val
             if self.accept("op", "("):       # function call
                 distinct = bool(self.accept("kw", "distinct"))
@@ -466,7 +473,10 @@ class _Parser:
                     while self.accept("op", ","):
                         args.append(self.parse_expr())
                 self.expect("op", ")")
-                return ("fn", name.lower(), args, distinct)
+                fn_node = ("fn", name.lower(), args, distinct)
+                if self.accept("kw", "over"):
+                    return self._over(fn_node)
+                return fn_node
             parts = [name]
             while self.peek().kind == "op" and self.peek().val == "." \
                     and self.peek(1).kind in ("id",):
@@ -482,6 +492,56 @@ class _Parser:
                 return ("qstar", parts[0])
             return ("col", tuple(parts))
         raise SqlError(f"unexpected token {t.val!r} at {t.pos}")
+
+    def _over(self, fn_node):
+        """OVER ([PARTITION BY ...] [ORDER BY ...] [ROWS BETWEEN ...])."""
+        self.expect("op", "(")
+        parts, orders, frame = [], [], None
+        if self.accept("kw", "partition"):
+            self.expect("kw", "by")
+            parts.append(self.parse_expr())
+            while self.accept("op", ","):
+                parts.append(self.parse_expr())
+        if self.accept("kw", "order"):
+            self.expect("kw", "by")
+            while True:
+                e = self.parse_expr()
+                asc = True
+                if self.accept("kw", "desc"):
+                    asc = False
+                else:
+                    self.accept("kw", "asc")
+                nf = None
+                if self.accept("kw", "nulls"):
+                    nf = bool(self.accept("kw", "first"))
+                    if nf is False:
+                        self.expect("kw", "last")
+                orders.append((e, asc, nf))
+                if not self.accept("op", ","):
+                    break
+        if self.at_kw("rows", "range"):
+            kind = self.next().val
+            self.expect("kw", "between")
+            lo = self._frame_bound()
+            self.expect("kw", "and")
+            hi = self._frame_bound()
+            frame = (kind, lo, hi)
+        self.expect("op", ")")
+        return ("window", fn_node, parts, orders, frame)
+
+    def _frame_bound(self):
+        if self.accept("kw", "unbounded"):
+            if not self.accept("kw", "preceding"):
+                self.expect("kw", "following")
+            return None
+        if self.accept("kw", "current"):
+            self.expect("kw", "row")
+            return 0
+        n = int(self.expect("num").val)
+        if self.accept("kw", "preceding"):
+            return -n
+        self.expect("kw", "following")
+        return n
 
     def _case(self):
         self.expect("kw", "case")

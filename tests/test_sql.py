@@ -229,3 +229,83 @@ def test_sql_negative_in_semicolon_and_bad_ordinal():
         s.sql("SELECT x FROM t GROUP BY 0")
     with pytest.raises(SqlError, match="ordinal"):
         s.sql("SELECT x FROM t ORDER BY 5")
+
+
+def test_sql_window_functions():
+    s = _sess()
+    t = pa.table({"g": ["a", "a", "a", "b", "b"],
+                  "v": [3.0, 1.0, 2.0, 5.0, 4.0]})
+    s.create_dataframe(t).create_or_replace_temp_view("w")
+    got = s.sql("""
+        SELECT g, v,
+               row_number() OVER (PARTITION BY g ORDER BY v) AS rn,
+               sum(v) OVER (PARTITION BY g) AS gs,
+               lag(v, 1) OVER (PARTITION BY g ORDER BY v) AS pv
+        FROM w ORDER BY g, v""").to_pandas()
+    assert list(got["rn"]) == [1, 2, 3, 1, 2]
+    assert list(got["gs"]) == [6.0, 6.0, 6.0, 9.0, 9.0]
+    assert got["pv"].isna().sum() == 2    # first row of each partition
+    assert list(got["pv"].dropna()) == [1.0, 2.0, 4.0]
+
+
+def test_sql_window_running_sum_frame():
+    s = _sess()
+    t = pa.table({"v": [1.0, 2.0, 3.0, 4.0]})
+    s.create_dataframe(t).create_or_replace_temp_view("w2")
+    got = s.sql("""
+        SELECT v, sum(v) OVER (ORDER BY v
+            ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW) AS rs
+        FROM w2 ORDER BY v""").to_pandas()
+    assert list(got["rs"]) == [1.0, 3.0, 6.0, 10.0]
+
+
+def test_sql_window_over_aggregate_requires_subquery():
+    s = _sess()
+    with pytest.raises(SqlError, match="subquery"):
+        s.sql("""SELECT l_returnflag, rank() OVER (ORDER BY sum(l_quantity))
+                 FROM lineitem GROUP BY l_returnflag""")
+    # the subquery formulation works
+    got = s.sql("""
+        SELECT rf, rank() OVER (ORDER BY sq DESC) AS r FROM
+          (SELECT l_returnflag AS rf, sum(l_quantity) AS sq
+           FROM lineitem GROUP BY l_returnflag) t
+        ORDER BY r""").to_pandas()
+    assert list(got["r"]) == [1, 2, 3]
+
+
+def test_sql_window_extras():
+    s = _sess()
+    t = pa.table({"g": ["a", "a", "b", "b", None],
+                  "v": pa.array([3.0, None, 2.0, 5.0, 4.0])})
+    s.create_dataframe(t).create_or_replace_temp_view("wx")
+    # ntile + negative lag default + trailing ';' + soft keyword column
+    got = s.sql("""
+        SELECT g, v, ntile(2) OVER (ORDER BY v NULLS FIRST) AS nt,
+               lag(v, 1, -1) OVER (PARTITION BY g ORDER BY v) AS pv
+        FROM wx ORDER BY g NULLS FIRST, v;""").to_pandas()
+    assert set(got["nt"]) == {1, 2}
+    assert (got["pv"].dropna() >= -1).all()
+    # window in ORDER BY only
+    r = s.sql("""SELECT v FROM wx
+                 ORDER BY row_number() OVER (ORDER BY v DESC)""") \
+        .to_pandas()
+    assert list(r["v"].dropna()) == [5.0, 4.0, 3.0, 2.0]
+    # DISTINCT inside a window is rejected loudly
+    with pytest.raises(SqlError, match="DISTINCT"):
+        s.sql("SELECT sum(DISTINCT v) OVER () FROM wx")
+    # soft keywords usable as column names
+    s.create_dataframe(pa.table({"rows": [1, 2], "current": [3, 4]})) \
+        .create_or_replace_temp_view("soft")
+    assert s.sql("SELECT rows, current FROM soft ORDER BY rows") \
+        .count() == 2
+
+
+def test_sql_rank_null_order_keys_tie():
+    s = _sess()
+    t = pa.table({"v": pa.array([None, None, 1.0, 2.0])})
+    s.create_dataframe(t).create_or_replace_temp_view("nt")
+    got = s.sql("""SELECT v, rank() OVER (ORDER BY v) AS r,
+                          dense_rank() OVER (ORDER BY v) AS dr
+                   FROM nt ORDER BY r, v""").to_pandas()
+    assert list(got["r"]) == [1, 1, 3, 4]
+    assert list(got["dr"]) == [1, 1, 2, 3]

@@ -85,3 +85,27 @@ def test_window_no_partition():
             "rn", RowNumber(), order_by=[F.col("o").asc(),
                                          F.col("v").asc()])
     assert_tpu_and_cpu_equal(q)
+
+
+def test_rank_desc_multi_order_differential():
+    """rank/dense_rank with DESC and multi-column orders (the host engine
+    computed value-ascending ranks regardless of direction)."""
+    import numpy as np
+    import pyarrow as pa
+    from harness import assert_tpu_and_cpu_equal
+    from spark_rapids_tpu.api import functions as F
+
+    rng = np.random.RandomState(9)
+    t = pa.table({"g": pa.array(rng.choice(["a", "b"], 300)),
+                  "x": pa.array(rng.randint(0, 10, 300).astype("int64")),
+                  "y": pa.array(rng.randint(0, 5, 300).astype("int64"))})
+
+    def q(s):
+        df = s.create_dataframe(t)
+        df = df.with_window_column(
+            "r", F.rank(), partition_by=["g"],
+            order_by=[F.col("x").desc(), F.col("y").asc()])
+        return df.with_window_column(
+            "dr", F.dense_rank(), partition_by=["g"],
+            order_by=[F.col("x").desc()])
+    assert_tpu_and_cpu_equal(q)
