@@ -199,6 +199,12 @@ def initcap(c) -> Col: return Col(E.InitCap(_to_expr(c)))
 def locate(substr, c) -> Col: return Col(E.StringLocate(substr, _to_expr(c)))
 def split(c, pattern, limit=-1) -> Col:
     return Col(E.StringSplit(_to_expr(c), pattern, limit))
+def parse_url(c, part, key=None) -> Col:
+    return Col(E.ParseUrl(_to_expr(c), part, key))
+def from_utc_timestamp(c, tz) -> Col:
+    return Col(E.FromUtcTimestamp(_to_expr(c), tz))
+def to_utc_timestamp(c, tz) -> Col:
+    return Col(E.ToUtcTimestamp(_to_expr(c), tz))
 def substring_index(c, delim, count) -> Col:
     return Col(E.SubstringIndex(_to_expr(c), delim, count))
 

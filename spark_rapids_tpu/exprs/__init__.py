@@ -13,10 +13,11 @@ from .math_fns import (Acos, Asin, Atan, Atan2, Cbrt, Ceil, Cos, Cosh, Exp,
 from .conditional import CaseWhen, Coalesce, If, NaNvl
 from .cast import Cast
 from .datetime_fns import (DateAdd, DateDiff, DateSub, DayOfMonth, DayOfWeek,
-                           DayOfYear, Hour, Minute, Month, Quarter, Second,
-                           UnixDate, WeekDay, Year)
+                           DayOfYear, FromUtcTimestamp, Hour, Minute, Month,
+                           Quarter, Second, ToUtcTimestamp, UnixDate,
+                           WeekDay, Year)
 from .string_fns import (ConcatStrings, Contains, EndsWith, InitCap, Length,
-                         Like, Lower, Lpad, RLike, RegExpExtract,
+                         Like, Lower, Lpad, ParseUrl, RLike, RegExpExtract,
                          RegExpReplace, Reverse, Rpad, StartsWith,
                          StringLocate, StringRepeat, StringReplace,
                          StringSplit, StringTrim, StringTrimLeft,

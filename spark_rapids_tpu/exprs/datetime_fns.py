@@ -292,3 +292,65 @@ class UnixDate(Expression):
 
     def key(self):
         return f"unix_date({self.children[0].key()})"
+
+
+class _TzConvert(Expression):
+    """from/to_utc_timestamp (ref GpuTimeZoneDB JNI + TimeZoneDB.scala).
+    Named-zone DST rules come from the host's IANA database (zoneinfo) —
+    timestamps are micros-since-epoch internally, so conversion is an
+    offset add computed per row on the host."""
+
+    def __init__(self, child: Expression, tz: str, to_utc: bool):
+        import zoneinfo
+        self.children = [child]
+        self.tz = tz
+        self.to_utc = to_utc
+        try:
+            self._zone = zoneinfo.ZoneInfo(tz)
+        except (KeyError, zoneinfo.ZoneInfoNotFoundError):
+            raise ValueError(f"unknown timezone: {tz}")
+
+    def data_type(self, schema):
+        return TIMESTAMP
+
+    def device_unsupported_reason(self, schema):
+        return (f"{type(self).__name__}: named-timezone DST rules are "
+                "host-resident (ref GpuTimeZoneDB)")
+
+    def eval_host(self, batch):
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        arr = self.children[0].eval_host(batch)
+        naive = arr.cast(pa.timestamp("us"))
+        if self.to_utc:
+            # interpret the naive timestamp as wall time in tz; arrow's
+            # assume_timezone applies the zone's DST rules vectorized
+            aware = pc.assume_timezone(naive, self.tz,
+                                       ambiguous="earliest",
+                                       nonexistent="earliest")
+            return aware.cast(pa.int64()).cast(pa.timestamp("us"))
+        # UTC instant -> wall time in tz
+        aware = naive.cast(pa.int64()).cast(pa.timestamp("us", tz=self.tz))
+        return pc.local_timestamp(aware)
+
+    def key(self):
+        return (f"{type(self).__name__}({self.children[0].key()},"
+                f"{self.tz})")
+
+
+class FromUtcTimestamp(_TzConvert):
+    def __init__(self, child, tz):
+        super().__init__(child, tz, to_utc=False)
+
+    @property
+    def name_hint(self):
+        return f"from_utc_timestamp({self.children[0].name_hint},{self.tz})"
+
+
+class ToUtcTimestamp(_TzConvert):
+    def __init__(self, child, tz):
+        super().__init__(child, tz, to_utc=True)
+
+    @property
+    def name_hint(self):
+        return f"to_utc_timestamp({self.children[0].name_hint},{self.tz})"

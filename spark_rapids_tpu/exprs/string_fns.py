@@ -444,3 +444,79 @@ class SubstringIndex(_HostStringExpr):
     def key(self):
         return (f"substring_index({self.children[0].key()},"
                 f"{self.delim!r},{self.count})")
+
+
+class ParseUrl(_HostStringExpr):
+    """parse_url(url, part[, key]) (ref ParseURI JNI: GpuParseUrl).
+    Parts: PROTOCOL, HOST, PATH, QUERY, REF, AUTHORITY, FILE, USERINFO;
+    QUERY with a key extracts that query parameter."""
+
+    PARTS = ("PROTOCOL", "HOST", "PATH", "QUERY", "REF", "AUTHORITY",
+             "FILE", "USERINFO")
+
+    def __init__(self, child, part: str, query_key=None):
+        self.children = [child]
+        self.part = part.upper()
+        self.query_key = query_key
+
+    def data_type(self, schema):
+        return STRING
+
+    def eval_host(self, batch):
+        import pyarrow as pa
+        from urllib.parse import urlparse
+        arr = self.children[0].eval_host(batch)
+        out = []
+        for v in arr.to_pylist():
+            if v is None:
+                out.append(None)
+                continue
+            try:
+                u = urlparse(v)
+            except ValueError:
+                out.append(None)
+                continue
+            # Spark (java.net.URI) returns NULL for every part of an
+            # unparseable URL: require a scheme with an authority or
+            # opaque part
+            if not u.scheme or (not u.netloc and not u.path):
+                out.append(None)
+                continue
+            if self.part == "PROTOCOL":
+                r = u.scheme or None
+            elif self.part == "HOST":
+                # preserve case (u.hostname lowercases, Spark does not):
+                # strip userinfo and port from the raw netloc
+                h = u.netloc.rsplit("@", 1)[-1]
+                if h.startswith("["):            # [ipv6]:port
+                    r = h.split("]")[0] + "]" if "]" in h else h
+                else:
+                    r = h.split(":", 1)[0] or None
+            elif self.part == "PATH":
+                r = u.path or None
+            elif self.part == "QUERY":
+                r = u.query or None
+                if r is not None and self.query_key is not None:
+                    # RAW parameter value (Spark does not percent-decode)
+                    r = None
+                    for kv in u.query.split("&"):
+                        k, _, val = kv.partition("=")
+                        if k == self.query_key:
+                            r = val
+                            break
+            elif self.part == "REF":
+                r = u.fragment or None
+            elif self.part == "AUTHORITY":
+                r = u.netloc or None
+            elif self.part == "FILE":
+                r = (u.path + ("?" + u.query if u.query else "")) or None
+            elif self.part == "USERINFO":
+                r = u.netloc.rsplit("@", 1)[0] if "@" in u.netloc else None
+            else:
+                r = None
+            out.append(r)
+        return pa.array(out, type=pa.string())
+
+    def key(self):
+        return (f"parse_url({self.children[0].key()},{self.part},"
+                f"{self.query_key!r})")

@@ -37,6 +37,8 @@ class SpillableBatch:
         self._batch: Optional[ColumnarBatch] = batch
         self._host_table = None           # pyarrow.Table when tier=host
         self._disk_path: Optional[str] = None
+        self._disk_block: Optional[int] = None   # native store block id
+        self._disk_bytes = 0
         self.tier = "device"
         self.spill_priority = spill_priority
         self.num_rows = batch.num_rows
@@ -67,23 +69,39 @@ class SpillableBatch:
 
     def spill_to_disk(self) -> int:
         import pyarrow as pa
-        import pyarrow.feather  # noqa: F401
         with self._lock:
             if self.tier != "host" or self._closed:
                 return 0
-            os.makedirs(self._mm.spill_dir, exist_ok=True)
-            path = os.path.join(self._mm.spill_dir, f"spill-{uuid.uuid4().hex}.arrow")
-            with pa.OSFile(path, "wb") as f:
-                with pa.ipc.new_file(f, self._host_table.schema) as w:
-                    w.write_table(self._host_table)
             nbytes = self._host_table.nbytes
+            store = self._native_store()
+            if store is not None:
+                # native slab block store (spill_store.cpp): append into
+                # big shared files with CRC-verified read-back
+                sink = pa.BufferOutputStream()
+                with pa.ipc.new_file(sink, self._host_table.schema) as w:
+                    w.write_table(self._host_table)
+                data = sink.getvalue().to_pybytes()
+                self._disk_block = store.write(data)
+                self._mm.disk_used += len(data)
+                self._disk_bytes = len(data)
+            else:
+                os.makedirs(self._mm.spill_dir, exist_ok=True)
+                path = os.path.join(self._mm.spill_dir,
+                                    f"spill-{uuid.uuid4().hex}.arrow")
+                with pa.OSFile(path, "wb") as f:
+                    with pa.ipc.new_file(f, self._host_table.schema) as w:
+                        w.write_table(self._host_table)
+                self._mm.disk_used += os.path.getsize(path)
+                self._disk_path = path
             self._mm.release_host(nbytes)
-            self._mm.disk_used += os.path.getsize(path)
             self._mm.spill_to_disk_bytes += nbytes
             self._host_table = None
-            self._disk_path = path
             self.tier = "disk"
             return nbytes
+
+    def _native_store(self):
+        from .native_spill import get_store
+        return get_store(self._mm.spill_dir)
 
     def _unspill(self) -> ColumnarBatch:
         import pyarrow as pa
@@ -91,7 +109,13 @@ class SpillableBatch:
             table = self._host_table
             self._mm.release_host(table.nbytes)
             self._host_table = None
-        else:  # disk
+        elif self._disk_block is not None:
+            data = self._native_store().read(self._disk_block)
+            table = pa.ipc.open_file(pa.BufferReader(data)).read_all()
+            self._native_store().free(self._disk_block)
+            self._mm.disk_used -= self._disk_bytes
+            self._disk_block, self._disk_bytes = None, 0
+        else:  # per-file fallback tier
             with pa.memory_map(self._disk_path, "rb") as f:
                 table = pa.ipc.open_file(f).read_all()
             try:
@@ -130,6 +154,10 @@ class SpillableBatch:
             elif self.tier == "host" and self._host_table is not None:
                 self._mm.release_host(self._host_table.nbytes)
                 self._host_table = None
+            elif self.tier == "disk" and self._disk_block is not None:
+                self._native_store().free(self._disk_block)
+                self._mm.disk_used -= self._disk_bytes
+                self._disk_block = None
             elif self.tier == "disk" and self._disk_path:
                 try:
                     self._mm.disk_used -= os.path.getsize(self._disk_path)

@@ -621,6 +621,20 @@ class _Lowerer:
             if fn == "datediff":
                 return F.datediff(self._expr(args[0]),
                                   self._expr(args[1]))
+            if fn == "parse_url":
+                if len(args) < 2:
+                    raise SqlError("parse_url requires (url, part[, key])")
+                part = _str_lit(args[1], "parse_url part")
+                key = _str_lit(args[2], "parse_url key") \
+                    if len(args) > 2 else None
+                return F.parse_url(self._expr(args[0]), part, key)
+            if fn in ("from_utc_timestamp", "to_utc_timestamp"):
+                if len(args) != 2:
+                    raise SqlError(f"{fn} requires (timestamp, tz)")
+                mk = (F.from_utc_timestamp if fn == "from_utc_timestamp"
+                      else F.to_utc_timestamp)
+                return mk(self._expr(args[0]),
+                          _str_lit(args[1], f"{fn} timezone"))
             if fn in _SCALAR_FNS:
                 return _SCALAR_FNS[fn](self._expr(args[0]))
             raise SqlError(f"unknown function {fn}()")
@@ -636,6 +650,13 @@ class _Lowerer:
         b = self._expr(base_ast)
         return (F.date_add(b, n * days * sign) if sign > 0
                 else F.date_sub(b, n * days))
+
+
+def _str_lit(ast, what) -> str:
+    if isinstance(ast, tuple) and ast[0] == "lit" \
+            and isinstance(ast[1], str):
+        return ast[1]
+    raise SqlError(f"{what} must be a string literal")
 
 
 def _ordinal(n: int, count: int) -> int:

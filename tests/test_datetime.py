@@ -65,3 +65,107 @@ def test_timestamp_to_date_cast():
         df = _ts(s)
         return df.select(F.col("t").cast("date").alias("d"))
     assert_tpu_and_cpu_equal(q)
+
+
+# ---------------------------------------------------------------------------
+# parse_url (ref ParseURI JNI) + timezone conversions (ref GpuTimeZoneDB)
+# ---------------------------------------------------------------------------
+
+def test_parse_url_parts():
+    import pyarrow as pa
+    from harness import tpu_session
+    from spark_rapids_tpu.api import functions as F
+    s = tpu_session()
+    urls = ["https://user:pw@spark.apache.org:443/docs/latest?q=rapids&x=1#frag",
+            "http://example.com/a/b", None, "not a url at all"]
+    df = s.create_dataframe(pa.table({"u": pa.array(urls)}))
+    out = df.select(
+        F.parse_url(F.col("u"), "PROTOCOL").alias("proto"),
+        F.parse_url(F.col("u"), "HOST").alias("host"),
+        F.parse_url(F.col("u"), "PATH").alias("path"),
+        F.parse_url(F.col("u"), "QUERY", "q").alias("q"),
+        F.parse_url(F.col("u"), "REF").alias("ref"),
+        F.parse_url(F.col("u"), "USERINFO").alias("ui"),
+    ).collect()
+    assert out[0] == {"proto": "https", "host": "spark.apache.org",
+                      "path": "/docs/latest", "q": "rapids",
+                      "ref": "frag", "ui": "user:pw"}
+    assert out[1]["host"] == "example.com" and out[1]["q"] is None
+    assert out[2]["host"] is None
+    assert out[3] == {"proto": None, "host": None, "path": None, "q": None,
+                      "ref": None, "ui": None}   # invalid URL -> all NULL
+
+
+def test_utc_timestamp_conversions_dst():
+    import numpy as np
+    import pyarrow as pa
+    from harness import tpu_session
+    from spark_rapids_tpu.api import functions as F
+    s = tpu_session()
+    # 2024-01-15 (EST, UTC-5) and 2024-07-15 (EDT, UTC-4): DST must apply
+    ts = np.array(["2024-01-15T12:00:00", "2024-07-15T12:00:00"],
+                  dtype="datetime64[us]")
+    df = s.create_dataframe(pa.table({"t": pa.array(ts)}))
+    out = df.select(
+        F.from_utc_timestamp(F.col("t"), "America/New_York").alias("ny"),
+        F.to_utc_timestamp(F.col("t"), "America/New_York").alias("utc"),
+    ).to_pandas()
+    ny = out["ny"].dt.tz_localize(None) if out["ny"].dt.tz is not None \
+        else out["ny"]
+    utc = out["utc"].dt.tz_localize(None) if out["utc"].dt.tz is not None \
+        else out["utc"]
+    assert str(ny[0]) == "2024-01-15 07:00:00"   # UTC-5
+    assert str(ny[1]) == "2024-07-15 08:00:00"   # UTC-4
+    assert str(utc[0]) == "2024-01-15 17:00:00"
+    assert str(utc[1]) == "2024-07-15 16:00:00"
+    import pytest
+    with pytest.raises(ValueError, match="unknown timezone"):
+        df.select(F.from_utc_timestamp(F.col("t"), "Not/AZone"))
+
+
+def test_sql_parse_url_and_tz():
+    import pyarrow as pa
+    from harness import tpu_session
+    s = tpu_session()
+    s.create_dataframe(pa.table({
+        "u": ["https://h.example.com/p?a=1"]})) \
+        .create_or_replace_temp_view("urls")
+    got = s.sql("SELECT parse_url(u, 'HOST') AS h, "
+                "parse_url(u, 'QUERY', 'a') AS a FROM urls").collect()
+    assert got[0] == {"h": "h.example.com", "a": "1"}
+
+
+def test_parse_url_spark_fidelity():
+    import pyarrow as pa
+    from harness import tpu_session
+    from spark_rapids_tpu.api import functions as F
+    s = tpu_session()
+    df = s.create_dataframe(pa.table({"u": [
+        "http://h/p?a=b%20c&p=1+2",      # raw values, no decoding
+        "https://EXAMPLE.com/x",          # host case preserved
+    ]}))
+    out = df.select(
+        F.parse_url(F.col("u"), "QUERY", "a").alias("a"),
+        F.parse_url(F.col("u"), "QUERY", "p").alias("p"),
+        F.parse_url(F.col("u"), "HOST").alias("h")).collect()
+    assert out[0]["a"] == "b%20c" and out[0]["p"] == "1+2"
+    assert out[1]["h"] == "EXAMPLE.com"
+
+
+def test_tz_roundtrip_precision():
+    import numpy as np
+    import pyarrow as pa
+    from harness import tpu_session
+    from spark_rapids_tpu.api import functions as F
+    s = tpu_session()
+    rng = np.random.RandomState(7)
+    micros = rng.randint(0, 2_000_000_000_000_000, 5000)
+    ts = micros.astype("datetime64[us]")
+    df = s.create_dataframe(pa.table({"t": pa.array(ts)}))
+    out = df.select(F.to_utc_timestamp(
+        F.from_utc_timestamp(F.col("t"), "America/New_York"),
+        "America/New_York").alias("r")).to_pandas()
+    r = out["r"]
+    if r.dt.tz is not None:
+        r = r.dt.tz_localize(None)
+    np.testing.assert_array_equal(r.to_numpy().astype("datetime64[us]"), ts)
