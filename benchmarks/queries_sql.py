@@ -1,0 +1,65 @@
+"""TPC queries as SQL text — the interface reference users actually write.
+Run with `session.sql()` after registering lineitem / store_sales /
+date_dim / item temp views (generators in tpch.py / tpcds.py)."""
+
+TPCH_Q1 = """
+SELECT l_returnflag, l_linestatus,
+       sum(l_quantity)                                      AS sum_qty,
+       sum(l_extendedprice)                                 AS sum_base_price,
+       sum(l_extendedprice * (1 - l_discount))              AS sum_disc_price,
+       sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) AS sum_charge,
+       avg(l_quantity)                                      AS avg_qty,
+       avg(l_extendedprice)                                 AS avg_price,
+       avg(l_discount)                                      AS avg_disc,
+       count(*)                                             AS count_order
+FROM lineitem
+WHERE l_shipdate <= date '1998-12-01' - interval '90' day
+GROUP BY l_returnflag, l_linestatus
+ORDER BY l_returnflag, l_linestatus
+"""
+
+TPCH_Q6 = """
+SELECT sum(l_extendedprice * l_discount) AS revenue
+FROM lineitem
+WHERE l_shipdate >= date '1994-01-01'
+  AND l_shipdate < date '1995-01-01'
+  AND l_discount BETWEEN 0.05 AND 0.07
+  AND l_quantity < 24
+"""
+
+TPCDS_Q3 = """
+SELECT d_year, i_brand_id, i_brand, sum(ss_ext_sales_price) AS sum_agg
+FROM date_dim, store_sales, item
+WHERE d_date_sk = ss_sold_date_sk
+  AND ss_item_sk = i_item_sk
+  AND i_manufact_id = 128
+  AND d_moy = 11
+GROUP BY d_year, i_brand_id, i_brand
+ORDER BY d_year, sum_agg DESC, i_brand_id
+"""
+
+TPCDS_Q9_BUCKET = """
+SELECT count(CASE WHEN ss_quantity BETWEEN {lo} AND {hi}
+                  THEN 1 ELSE NULL END)                        AS cnt,
+       avg(CASE WHEN ss_quantity BETWEEN {lo} AND {hi}
+                THEN ss_ext_sales_price ELSE NULL END)          AS avg_price,
+       avg(CASE WHEN ss_quantity BETWEEN {lo} AND {hi}
+                THEN ss_net_paid ELSE NULL END)                 AS avg_paid
+FROM store_sales
+"""
+
+
+def register_tpch(session, n_rows: int = 100_000):
+    from . import tpch
+    session.create_dataframe(tpch.gen_lineitem(n_rows)) \
+        .create_or_replace_temp_view("lineitem")
+
+
+def register_tpcds(session, n_rows: int = 100_000):
+    from . import tpcds
+    session.create_dataframe(tpcds.gen_store_sales(n_rows)) \
+        .create_or_replace_temp_view("store_sales")
+    session.create_dataframe(tpcds.gen_date_dim()) \
+        .create_or_replace_temp_view("date_dim")
+    session.create_dataframe(tpcds.gen_item()) \
+        .create_or_replace_temp_view("item")

@@ -100,33 +100,40 @@ class TpuSession:
 
     def read_parquet(self, *paths: str,
                      columns: Optional[List[str]] = None) -> "DataFrame":
+        from ..io.file_scan import apply_path_rules
         from ..io.parquet import parquet_schema, expand_paths
-        files = expand_paths(paths)
+        files = expand_paths(apply_path_rules(self.conf, paths))
         schema = parquet_schema(files[0])
         return DataFrame(self, L.ParquetScan(files, schema, columns))
 
     def read_orc(self, *paths: str,
                  columns: Optional[List[str]] = None) -> "DataFrame":
+        from ..io.file_scan import apply_path_rules
         from ..io.orc import expand_orc_paths, orc_schema
-        files = expand_orc_paths(paths)
+        files = expand_orc_paths(apply_path_rules(self.conf, paths))
         return DataFrame(self, L.OrcScan(files, orc_schema(files[0]),
                                          columns))
 
     def read_avro(self, *paths: str,
                   columns: Optional[List[str]] = None) -> "DataFrame":
         from ..io.avro import avro_schema, expand_avro_paths
-        files = expand_avro_paths(paths)
+        from ..io.file_scan import apply_path_rules
+        files = expand_avro_paths(apply_path_rules(self.conf, paths))
         return DataFrame(self, L.AvroScan(files, avro_schema(files[0]),
                                           columns))
 
     def read_iceberg(self, path: str, columns: Optional[List[str]] = None,
                      snapshot_id: Optional[int] = None) -> "DataFrame":
         from ..iceberg import IcebergTable
+        from ..io.file_scan import apply_path_rules
+        path = apply_path_rules(self.conf, [path])[0]
         return IcebergTable(path).to_df(self, columns, snapshot_id)
 
     def read_delta(self, path: str, columns: Optional[List[str]] = None,
                    version: Optional[int] = None) -> "DataFrame":
         from ..delta import DeltaTable
+        from ..io.file_scan import apply_path_rules
+        path = apply_path_rules(self.conf, [path])[0]
         return DeltaTable(self, path).to_df(columns, version)
 
     def delta_table(self, path: str):
@@ -143,13 +150,17 @@ class TpuSession:
         self._views[name.lower()] = df
 
     def read_csv(self, *paths: str, schema=None, header=True) -> "DataFrame":
+        from ..io.file_scan import apply_path_rules
         from ..io.text import csv_to_tables
-        tables, sch = csv_to_tables(paths, schema, header)
+        tables, sch = csv_to_tables(apply_path_rules(self.conf, paths),
+                                    schema, header)
         return DataFrame(self, L.LogicalScan(tables, sch))
 
     def read_json(self, *paths: str, schema=None) -> "DataFrame":
+        from ..io.file_scan import apply_path_rules
         from ..io.text import json_to_tables
-        tables, sch = json_to_tables(paths, schema)
+        tables, sch = json_to_tables(apply_path_rules(self.conf, paths),
+                                     schema)
         return DataFrame(self, L.LogicalScan(tables, sch))
 
 

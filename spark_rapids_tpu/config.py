@@ -162,6 +162,11 @@ SHUFFLE_MODE = register(
     "CACHE_ONLY (single-process testing) "
     "(ref RapidsShuffleInternalManagerBase.scala:1264-1276).", commonly_used=True)
 
+SHUFFLE_CODEC = register(
+    "spark.rapids.tpu.shuffle.compression.codec", "lz4",
+    "Compression for serialized shuffle blocks: lz4 / zstd / none "
+    "(ref spark.rapids.shuffle.compression.codec + TableCompressionCodec).")
+
 SHUFFLE_THREADS = register(
     "spark.rapids.tpu.shuffle.multiThreaded.numThreads", 8,
     "Writer/reader threads for the multithreaded shuffle "
@@ -171,6 +176,12 @@ MULTITHREADED_READ_THREADS = register(
     "spark.rapids.tpu.sql.multiThreadedRead.numThreads", 8,
     "Host read thread-pool size for cloud/coalescing file readers "
     "(ref Plugin.scala:269-281).")
+
+IO_PATH_REPLACEMENT = register(
+    "spark.rapids.tpu.io.pathReplacementRules", "",
+    "Semicolon-separated 'prefix->replacement' rules applied to scan paths "
+    "before opening (ref AlluxioUtils.scala s3://->alluxio:// rewriting); "
+    "e.g. 's3://bucket->/mnt/alluxio/bucket'.")
 
 PARQUET_READER_TYPE = register(
     "spark.rapids.tpu.sql.format.parquet.reader.type", "AUTO",

@@ -25,6 +25,36 @@ from ..config import MULTITHREADED_READ_THREADS, TpuConf
 from ..exec.base import ESSENTIAL, ExecContext, TpuExec
 from ..types import Schema
 
+
+def apply_path_rules(conf, paths):
+    """Rewrite paths through spark.rapids.tpu.io.pathReplacementRules
+    (ref AlluxioUtils.scala's s3://->alluxio:// replacement): applied
+    once, where the session first resolves the scan. Malformed rules
+    (no '->') are rejected loudly — a silently mis-parsed rule strips
+    prefixes instead of replacing them."""
+    from ..config import IO_PATH_REPLACEMENT
+    rules = []
+    raw = str(conf.get(IO_PATH_REPLACEMENT))
+    for r in filter(None, raw.split(";")):
+        prefix, sep, repl = r.partition("->")
+        if not sep or not prefix:
+            raise ValueError(
+                f"malformed path replacement rule {r!r} "
+                "(expected 'prefix->replacement')")
+        rules.append((prefix, repl))
+    if not rules:
+        return list(paths)
+    out = []
+    for p in paths:
+        for prefix, repl in rules:
+            if p.startswith(prefix):
+                p = repl + p[len(prefix):]
+                break
+        out.append(p)
+    return out
+
+
+
 __all__ = ["FileScanBase", "expand_paths"]
 
 
@@ -74,7 +104,8 @@ class FileScanBase(TpuExec):
 
     def _cached_path(self, path: str) -> str:
         """FileCache indirection (ref FileCache hook surface; metrics
-        filecacheHits/Misses mirror GpuExec.scala:78-87)."""
+        filecacheHits/Misses mirror GpuExec.scala:78-87). Path-replacement
+        rules were already applied when the session resolved the scan."""
         from .filecache import FileCache
         fc = FileCache.get(self.conf)
         if fc is None:

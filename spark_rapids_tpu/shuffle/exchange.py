@@ -112,7 +112,14 @@ class ShuffleExchangeExec(TpuExec):
     def _multithreaded(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
         """Host-staged: device partition -> threaded serialize -> regroup ->
         threaded deserialize -> per-partition coalesced batches."""
+        from ..config import SHUFFLE_CODEC
         nthreads = int(ctx.conf.get(SHUFFLE_THREADS))
+        codec = str(ctx.conf.get(SHUFFLE_CODEC)).lower()
+        if codec not in ("lz4", "zstd", "none"):
+            raise ValueError(
+                f"unsupported shuffle codec {codec!r} "
+                "(supported: lz4, zstd, none)")
+        codec = None if codec == "none" else codec
         write_m = ctx.metric(self._exec_id, "shuffleWriteTime")
         bytes_m = ctx.metric(self._exec_id, "shuffleBytes", ESSENTIAL)
         blocks: Dict[int, List[bytes]] = {p: [] for p in
@@ -128,7 +135,8 @@ class ShuffleExchangeExec(TpuExec):
                     if parts.counts[p] == 0:
                         continue
                     futs.append((p, pool.submit(
-                        lambda t=parts.partition(p): serialize_table(t))))
+                        lambda t=parts.partition(p):
+                        serialize_table(t, codec))))
             for p, fut in futs:
                 data = fut.result()
                 bytes_m.add(len(data))

@@ -309,3 +309,61 @@ def test_sql_rank_null_order_keys_tie():
                    FROM nt ORDER BY r, v""").to_pandas()
     assert list(got["r"]) == [1, 1, 3, 4]
     assert list(got["dr"]) == [1, 1, 2, 3]
+
+
+def test_sql_tpc_query_texts_match_dataframe():
+    """The canonical SQL texts (benchmarks/queries_sql.py) agree with the
+    DataFrame formulations."""
+    from benchmarks import queries_sql as Q
+    s = tpu_session()
+    Q.register_tpch(s, 10_000)
+    Q.register_tpcds(s, 8_000)
+    q1 = s.sql(Q.TPCH_Q1).to_pandas()
+    e1 = tpch.q1(s.create_dataframe(tpch.gen_lineitem(10_000)), F) \
+        .to_pandas()
+    pd.testing.assert_frame_equal(q1, e1, check_exact=False, rtol=1e-12)
+    q6 = s.sql(Q.TPCH_Q6).to_pandas()
+    e6 = tpch.q6(s.create_dataframe(tpch.gen_lineitem(10_000)), F) \
+        .to_pandas()
+    np.testing.assert_allclose(q6["revenue"], e6["revenue"], rtol=1e-12)
+    q3 = s.sql(Q.TPCDS_Q3).to_pandas()
+    e3 = tpcds.q3(s.create_dataframe(tpcds.gen_store_sales(8_000)),
+                  s.create_dataframe(tpcds.gen_date_dim()),
+                  s.create_dataframe(tpcds.gen_item()), F).to_pandas()
+    np.testing.assert_allclose(sorted(q3["sum_agg"]),
+                               sorted(e3["sum_agg"]), rtol=1e-12)
+
+
+def test_io_path_replacement(tmp_path):
+    import pyarrow.parquet as pq
+    real = tmp_path / "data"
+    real.mkdir()
+    pq.write_table(pa.table({"a": [1, 2, 3]}), str(real / "t.parquet"))
+    s = tpu_session({"spark.rapids.tpu.io.pathReplacementRules":
+                     f"s3://fake-bucket->{real}"})
+    df = s.read_parquet("s3://fake-bucket/t.parquet")
+    assert df.count() == 3
+
+
+def test_shuffle_codec_conf():
+    from harness import tpu_session
+    import numpy as np
+    t = pa.table({"k": pa.array(np.arange(5000) % 7),
+                  "v": pa.array(np.ones(5000))})
+    for codec in ("lz4", "zstd", "none"):
+        s = tpu_session({"spark.rapids.tpu.shuffle.compression.codec": codec})
+        out = s.create_dataframe(t).repartition(4, F.col("k")).count()
+        assert out == 5000
+
+
+def test_path_rules_and_codec_validation():
+    import pytest
+    from spark_rapids_tpu.io.file_scan import apply_path_rules
+    from spark_rapids_tpu.config import TpuConf
+    conf = TpuConf({"spark.rapids.tpu.io.pathReplacementRules": "s3://b"})
+    with pytest.raises(ValueError, match="malformed"):
+        apply_path_rules(conf, ["s3://b/x"])
+    s = tpu_session({"spark.rapids.tpu.shuffle.compression.codec": "snappy"})
+    t = pa.table({"k": [1, 2, 3]})
+    with pytest.raises(ValueError, match="unsupported shuffle codec"):
+        s.create_dataframe(t).repartition(2, F.col("k")).count()
