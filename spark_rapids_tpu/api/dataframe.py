@@ -149,6 +149,13 @@ class TpuSession:
     def create_temp_view(self, name: str, df: "DataFrame") -> None:
         self._views[name.lower()] = df
 
+    def register_delta_table(self, name: str, path: str) -> None:
+        """Expose a Delta table to SQL, both as a readable view (always
+        reading the CURRENT version) and as the target of UPDATE / DELETE
+        / MERGE INTO statements. One registry: replacing the name with a
+        temp view later redirects BOTH reads and DML resolution."""
+        self._views[name.lower()] = self.delta_table(path)
+
     def read_csv(self, *paths: str, schema=None, header=True) -> "DataFrame":
         from ..io.file_scan import apply_path_rules
         from ..io.text import csv_to_tables

@@ -77,7 +77,11 @@ class _Lowerer:
         name = ref.name.lower()
         if name not in self.views:
             raise SqlError(f"table or view not found: {ref.name}")
-        return self.views[name]
+        v = self.views[name]
+        from ..delta.table import DeltaTable
+        if isinstance(v, DeltaTable):
+            return v.to_df()     # re-read the log: DML may have run
+        return v
 
     def _lower_select(self, sel: Select):
         df = self._resolve_ref(sel.from_ref)
@@ -723,6 +727,139 @@ def _canon_type(ty: str) -> str:
             "decimal": "decimal(10,0)"}.get(t, t)
 
 
+def _resolve_delta(session, ref, views, what):
+    from ..delta.table import DeltaTable
+    from .parser import TableRef
+    if not isinstance(ref, TableRef):
+        raise SqlError(f"{what} requires a registered Delta table name")
+    dt = views.get(ref.name.lower())
+    if not isinstance(dt, DeltaTable):
+        raise SqlError(
+            f"{ref.name} is not a registered Delta table (use "
+            "session.register_delta_table(name, path))")
+    return dt
+
+
+def _metrics_df(session, metrics: dict):
+    import pyarrow as pa
+    return session.create_dataframe(
+        pa.table({k: [v] for k, v in metrics.items()} or {"ok": [1]}))
+
+
+def _dup_check(pairs, what):
+    seen = set()
+    for c, _ in pairs:
+        if c.lower() in seen:
+            raise SqlError(f"duplicate SET column {c!r} in {what}")
+        seen.add(c.lower())
+
+
+def _lower_dml(session, stmt, views):
+    from .parser import DeleteStmt, MergeStmt, UpdateStmt
+    lw = _Lowerer(session, views)
+    lw._aliases = {}
+    if isinstance(stmt, DeleteStmt):
+        dt = _resolve_delta(session, stmt.table, views, "DELETE")
+        cond = lw._expr(stmt.where).expr if stmt.where is not None else None
+        return _metrics_df(session, dt.delete(cond))
+    if isinstance(stmt, UpdateStmt):
+        dt = _resolve_delta(session, stmt.table, views, "UPDATE")
+        _dup_check(stmt.assignments, "UPDATE")
+        cond = lw._expr(stmt.where).expr if stmt.where is not None else None
+        sets = {c: lw._expr(e).expr for c, e in stmt.assignments}
+        return _metrics_df(session, dt.update(cond, sets))
+    if isinstance(stmt, MergeStmt):
+        return _lower_merge(session, stmt, views, lw)
+    raise SqlError(f"unsupported statement {type(stmt).__name__}")
+
+
+def _lower_merge(session, stmt, views, lw):
+    """MERGE lowering with qualifier resolution: source columns whose
+    names collide with target columns are renamed before the merge, and
+    t.col / s.col references resolve through the alias — an unqualified
+    colliding name is an error (the engine's pair batch could otherwise
+    silently bind it to the target side)."""
+    dt = _resolve_delta(session, stmt.target, views, "MERGE INTO")
+    src = lw._resolve_ref(stmt.source)
+    talias = (stmt.target.alias or stmt.target.name).lower()
+    salias = ((stmt.source.alias
+               or getattr(stmt.source, "name", None)) or "__src").lower()
+    tcols = set(dt.to_df().columns)
+    scols = list(src.columns)
+    colliding = {c for c in scols if c in tcols}
+    rename = {c: f"__src_{c}" for c in colliding}
+    if rename:
+        src = src.select(*[
+            (F.col(c).alias(rename[c]) if c in rename else F.col(c))
+            for c in scols])
+
+    def resolve(ast):
+        """AST -> AST with qualified refs bound to a side and colliding
+        names renamed on the source side."""
+        if not isinstance(ast, tuple):
+            return ast
+        if ast[0] == "col":
+            parts = ast[1]
+            if len(parts) == 2:
+                q, n = parts[0].lower(), parts[1]
+                if q == salias:
+                    return ("col", (rename.get(n, n),))
+                if q == talias:
+                    if n not in tcols:
+                        raise SqlError(
+                            f"{parts[0]}.{n}: no such target column")
+                    return ("col", (n,))
+                raise SqlError(f"unknown qualifier {parts[0]!r} in MERGE")
+            n = parts[0]
+            if n in colliding:
+                raise SqlError(
+                    f"ambiguous column {n!r} in MERGE (qualify with "
+                    f"{talias}. or {salias}.)")
+            return ast
+        return tuple(resolve(x) if isinstance(x, tuple)
+                     else ([resolve(y) if isinstance(y, tuple) else y
+                            for y in x] if isinstance(x, list) else x)
+                     for x in ast)
+
+    mb = dt.merge(src, lw._expr(resolve(stmt.on)).expr)
+    kinds = [c[0] for c in stmt.clauses]
+    for kind in ("update", "delete"):
+        if kinds.count(kind) > 1:
+            raise SqlError(f"duplicate WHEN MATCHED THEN {kind.upper()} "
+                           "clause")
+    if "update" in kinds and "delete" in kinds:
+        raise SqlError("MERGE with both WHEN MATCHED UPDATE and DELETE "
+                       "clauses is not supported (conditional clauses "
+                       "are unimplemented)")
+    if kinds.count("insert") + kinds.count("insert_star") > 1:
+        raise SqlError("duplicate WHEN NOT MATCHED THEN INSERT clause")
+    for clause in stmt.clauses:
+        if clause[0] == "update":
+            _dup_check(clause[1], "MERGE UPDATE")
+            mb = mb.when_matched_update(
+                {c: lw._expr(resolve(e)).expr for c, e in clause[1]})
+        elif clause[0] == "delete":
+            mb = mb.when_matched_delete()
+        elif clause[0] == "insert":
+            mb = mb.when_not_matched_insert(
+                {c: lw._expr(resolve(e)).expr
+                 for c, e in zip(clause[1], clause[2])})
+        else:
+            # insert_star: map source columns onto same-named target
+            # columns (through any collision renames) with the target's
+            # dtype cast — same contract as the builder's fallback
+            from ..exprs.base import ColumnRef
+            from ..exprs.cast import Cast
+            tschema = dt.to_df().schema
+            mb = mb.when_not_matched_insert(
+                {c: Cast(ColumnRef(rename.get(c, c)), tschema[c].dtype)
+                 for c in scols if c in tcols})
+    return _metrics_df(session, mb.execute())
+
+
 def lower_statement(session, text: str, views: Dict[str, object]):
-    from .parser import parse
-    return _Lowerer(session, views).lower(parse(text))
+    from .parser import DeleteStmt, MergeStmt, Select, UpdateStmt, parse
+    stmt = parse(text)
+    if isinstance(stmt, (DeleteStmt, MergeStmt, UpdateStmt)):
+        return _lower_dml(session, stmt, views)
+    return _Lowerer(session, views).lower(stmt)

@@ -40,7 +40,19 @@ _KEYWORDS = {
     "date", "timestamp", "interval", "true", "false", "exists",
     "over", "partition", "rows", "range", "unbounded", "preceding",
     "following", "current", "row",
+    "update", "delete", "merge", "into", "set", "values", "insert",
+    "matched", "then",
 }
+
+
+#: keywords that remain legal identifiers (Spark keeps these
+#: non-reserved): accepted anywhere a plain identifier is expected
+SOFT_IDS = frozenset({
+    "left", "right", "rows", "row", "range", "current", "partition",
+    "unbounded", "preceding", "following", "over", "first", "last",
+    "date", "timestamp", "update", "delete", "insert", "merge", "into",
+    "set", "values", "matched",
+})
 
 
 class _Tok:
@@ -107,6 +119,29 @@ class OrderItem:
                                                        nulls_first)
 
 
+class UpdateStmt:
+    def __init__(self, table, assignments, where):
+        self.table = table              # TableRef
+        self.assignments = assignments  # [(col_name, expr_ast)]
+        self.where = where
+
+
+class DeleteStmt:
+    def __init__(self, table, where):
+        self.table = table
+        self.where = where
+
+
+class MergeStmt:
+    def __init__(self, target, source, on, clauses):
+        self.target = target            # TableRef
+        self.source = source            # TableRef | SubqueryRef
+        self.on = on
+        #: clauses: ("update", [(col, expr)]) | ("delete",)
+        #:        | ("insert", [cols], [exprs]) | ("insert_star",)
+        self.clauses = clauses
+
+
 class Select:
     def __init__(self):
         self.ctes: List[Tuple[str, "Select"]] = []
@@ -154,22 +189,109 @@ class _Parser:
                            f"{got.val!r} at {got.pos}")
         return t
 
+    def expect_ident(self) -> str:
+        """An identifier, allowing non-reserved (soft) keywords."""
+        t = self.peek()
+        if t.kind == "id" or (t.kind == "kw" and t.val in SOFT_IDS):
+            return self.next().val
+        raise SqlError(f"expected identifier, got {t.val!r} at {t.pos}")
+
     def at_kw(self, *vals) -> bool:
         t = self.peek()
         return t.kind == "kw" and t.val in vals
 
     # -- statements -------------------------------------------------------
-    def parse_statement(self) -> Select:
-        sel = self.parse_query()
+    def parse_statement(self):
+        if self.at_kw("update"):
+            stmt = self._parse_update()
+        elif self.at_kw("delete"):
+            stmt = self._parse_delete()
+        elif self.at_kw("merge"):
+            stmt = self._parse_merge()
+        else:
+            stmt = self.parse_query()
         self.accept("op", ";")
         self.expect("eof")
-        return sel
+        return stmt
+
+    # -- DML (Delta tables; ref GpuUpdateCommand / GpuDeleteCommand /
+    # GpuMergeIntoCommand) ------------------------------------------------
+    def _parse_update(self) -> UpdateStmt:
+        self.expect("kw", "update")
+        table = self.parse_table_ref()
+        self.expect("kw", "set")
+        assignments = []
+        while True:
+            col = self.expect_ident()
+            self.expect("op", "=")
+            assignments.append((col, self.parse_expr()))
+            if not self.accept("op", ","):
+                break
+        where = self.parse_expr() if self.accept("kw", "where") else None
+        return UpdateStmt(table, assignments, where)
+
+    def _parse_delete(self) -> DeleteStmt:
+        self.expect("kw", "delete")
+        self.expect("kw", "from")
+        table = self.parse_table_ref()
+        where = self.parse_expr() if self.accept("kw", "where") else None
+        return DeleteStmt(table, where)
+
+    def _parse_merge(self) -> MergeStmt:
+        self.expect("kw", "merge")
+        self.expect("kw", "into")
+        target = self.parse_table_ref()
+        self.expect("kw", "using")
+        source = self.parse_table_ref()
+        self.expect("kw", "on")
+        on = self.parse_expr()
+        clauses = []
+        while self.accept("kw", "when"):
+            matched = True
+            if self.accept("kw", "not"):
+                matched = False
+            self.expect("kw", "matched")
+            self.expect("kw", "then")
+            if matched and self.accept("kw", "update"):
+                self.expect("kw", "set")
+                assigns = []
+                while True:
+                    col = self.expect_ident()
+                    self.expect("op", "=")
+                    assigns.append((col, self.parse_expr()))
+                    if not self.accept("op", ","):
+                        break
+                clauses.append(("update", assigns))
+            elif matched and self.accept("kw", "delete"):
+                clauses.append(("delete",))
+            elif not matched and self.accept("kw", "insert"):
+                if self.accept("op", "*"):
+                    clauses.append(("insert_star",))
+                    continue
+                self.expect("op", "(")
+                cols = [self.expect_ident()]
+                while self.accept("op", ","):
+                    cols.append(self.expect_ident())
+                self.expect("op", ")")
+                self.expect("kw", "values")
+                self.expect("op", "(")
+                vals = [self.parse_expr()]
+                while self.accept("op", ","):
+                    vals.append(self.parse_expr())
+                self.expect("op", ")")
+                clauses.append(("insert", cols, vals))
+            else:
+                t = self.peek()
+                raise SqlError(f"bad MERGE clause at {t.pos}")
+        if not clauses:
+            raise SqlError("MERGE requires at least one WHEN clause")
+        return MergeStmt(target, source, on, clauses)
 
     def parse_query(self) -> Select:
         ctes = []
         if self.accept("kw", "with"):
             while True:
-                name = self.expect("id").val
+                name = self.expect_ident()
                 self.expect("kw", "as")
                 self.expect("op", "(")
                 sub = self.parse_query()
@@ -201,7 +323,7 @@ class _Parser:
             e = self.parse_expr()
             alias = None
             if self.accept("kw", "as"):
-                alias = self.expect("id").val
+                alias = self.expect_ident()
             elif self.peek().kind == "id":
                 alias = self.next().val
             sel.items.append((e, alias))
@@ -223,9 +345,9 @@ class _Parser:
                         on = self.parse_expr()
                     elif self.accept("kw", "using"):
                         self.expect("op", "(")
-                        using = [self.expect("id").val]
+                        using = [self.expect_ident()]
                         while self.accept("op", ","):
-                            using.append(self.expect("id").val)
+                            using.append(self.expect_ident())
                         self.expect("op", ")")
                 sel.joins.append(Join(kind, ref, on, using))
         if self.accept("kw", "where"):
@@ -288,14 +410,14 @@ class _Parser:
             self.expect("op", ")")
             alias = None
             if self.accept("kw", "as"):
-                alias = self.expect("id").val
+                alias = self.expect_ident()
             elif self.peek().kind == "id":
                 alias = self.next().val
             return SubqueryRef(sub, alias)
-        name = self.expect("id").val
+        name = self.expect_ident()
         alias = None
         if self.accept("kw", "as"):
-            alias = self.expect("id").val
+            alias = self.expect_ident()
         elif self.peek().kind == "id":
             alias = self.next().val
         return TableRef(name, alias)
@@ -433,7 +555,7 @@ class _Parser:
                     n = n.val
                 else:
                     raise SqlError(f"bad interval at {t.pos}")
-                unit = self.expect("id").val.lower().rstrip("s")
+                unit = self.expect_ident().lower().rstrip("s")
                 return ("interval", int(n), unit)
             if t.val == "case":
                 return self._case()
@@ -455,12 +577,7 @@ class _Parser:
         if t.kind == "op" and t.val == "*":
             self.next()
             return ("star",)
-        # soft keywords: valid column/function names in expression position
-        # (Spark keeps these non-reserved)
-        soft = ("left", "right", "rows", "row", "range", "current",
-                "partition", "unbounded", "preceding", "following", "over",
-                "first", "last", "date", "timestamp")
-        if t.kind == "id" or (t.kind == "kw" and t.val in soft):
+        if t.kind == "id" or (t.kind == "kw" and t.val in SOFT_IDS):
             name = self.next().val
             if self.accept("op", "("):       # function call
                 distinct = bool(self.accept("kw", "distinct"))

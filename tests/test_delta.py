@@ -283,3 +283,98 @@ def test_delta_dv_with_predicate_pushdown(tmp_path):
            .filter(F.col("k") > n - 200).to_pandas())
     assert out["k"].max() == n - 100
     assert len(out) == 100  # (n-200, n-100]
+
+
+# ---------------------------------------------------------------------------
+# SQL DML over Delta tables (ref GpuUpdateCommand / GpuDeleteCommand /
+# GpuMergeIntoCommand, driven through the SQL front-end)
+# ---------------------------------------------------------------------------
+
+def test_sql_dml_update_delete_merge(tmp_path):
+    s = tpu_session()
+    s.create_dataframe(pa.table({"k": [1, 2, 3, 4],
+                                 "v": [10, 20, 30, 40]})) \
+        .write_delta(str(tmp_path / "t"))
+    s.register_delta_table("t", str(tmp_path / "t"))
+
+    m = s.sql("UPDATE t SET v = v * 2 WHERE k >= 3").collect()[0]
+    rows = {r["k"]: r["v"] for r in s.sql("SELECT k, v FROM t").collect()}
+    assert rows == {1: 10, 2: 20, 3: 60, 4: 80}
+
+    s.sql("DELETE FROM t WHERE k = 1")
+    assert s.sql("SELECT count(*) AS n FROM t").collect()[0]["n"] == 3
+
+    s.create_dataframe(pa.table({"sk": [2, 9], "sv": [222, 999]})) \
+        .create_or_replace_temp_view("src")
+    s.sql("""MERGE INTO t USING src ON k = sk
+             WHEN MATCHED THEN UPDATE SET v = sv
+             WHEN NOT MATCHED THEN INSERT (k, v) VALUES (sk, sv)""")
+    rows = {r["k"]: r["v"] for r in s.sql("SELECT k, v FROM t").collect()}
+    assert rows == {2: 222, 3: 60, 4: 80, 9: 999}
+    # history shows the DML operations in the delta log
+    ops = [h["operation"] for h in s.delta_table(str(tmp_path / "t"))
+           .history()]
+    assert "MERGE" in ops and "DELETE" in ops and "UPDATE" in ops
+
+
+def test_sql_dml_errors(tmp_path):
+    import pytest
+    from spark_rapids_tpu.sql.parser import SqlError
+    s = tpu_session()
+    s.create_dataframe(pa.table({"k": [1]})) \
+        .create_or_replace_temp_view("plainview")
+    with pytest.raises(SqlError, match="Delta table"):
+        s.sql("DELETE FROM plainview WHERE k = 1")
+    with pytest.raises(SqlError, match="WHEN clause"):
+        s.create_dataframe(pa.table({"k": [1]})) \
+            .write_delta(str(tmp_path / "d"))
+        s.register_delta_table("d", str(tmp_path / "d"))
+        s.sql("MERGE INTO d USING plainview ON k = k")
+
+
+def test_sql_merge_same_named_columns_with_qualifiers(tmp_path):
+    """Target and source sharing column names — the common MERGE shape —
+    must resolve t.col / s.col correctly (unqualified collisions error)."""
+    import pytest
+    from spark_rapids_tpu.sql.parser import SqlError
+    s = tpu_session()
+    s.create_dataframe(pa.table({"k": [1, 2, 3], "v": [10, 20, 30]})) \
+        .write_delta(str(tmp_path / "t"))
+    s.register_delta_table("t", str(tmp_path / "t"))
+    s.create_dataframe(pa.table({"k": [2, 7], "v": [999, 777]})) \
+        .create_or_replace_temp_view("s2")
+    s.sql("""MERGE INTO t USING s2 AS s ON t.k = s.k
+             WHEN MATCHED THEN UPDATE SET v = s.v
+             WHEN NOT MATCHED THEN INSERT (k, v) VALUES (s.k, s.v)""")
+    rows = {r["k"]: r["v"] for r in s.sql("SELECT k, v FROM t").collect()}
+    assert rows == {1: 10, 2: 999, 3: 30, 7: 777}, rows
+    with pytest.raises(SqlError, match="ambiguous"):
+        s.sql("""MERGE INTO t USING s2 AS s ON t.k = s.k
+                 WHEN MATCHED THEN UPDATE SET v = v""")
+    # INSERT * maps same-named source columns
+    s.create_dataframe(pa.table({"k": [50], "v": [500]})) \
+        .create_or_replace_temp_view("s3")
+    s.sql("""MERGE INTO t USING s3 ON t.k = s3.k
+             WHEN NOT MATCHED THEN INSERT *""")
+    assert s.sql("SELECT v FROM t WHERE k = 50").collect()[0]["v"] == 500
+
+
+def test_sql_merge_clause_validation(tmp_path):
+    import pytest
+    from spark_rapids_tpu.sql.parser import SqlError
+    s = tpu_session()
+    s.create_dataframe(pa.table({"k": [1]})).write_delta(str(tmp_path/"t"))
+    s.register_delta_table("t", str(tmp_path / "t"))
+    s.create_dataframe(pa.table({"sk": [1]})) \
+        .create_or_replace_temp_view("src")
+    with pytest.raises(SqlError, match="UPDATE and DELETE"):
+        s.sql("""MERGE INTO t USING src ON k = sk
+                 WHEN MATCHED THEN UPDATE SET k = sk
+                 WHEN MATCHED THEN DELETE""")
+    with pytest.raises(SqlError, match="duplicate SET"):
+        s.sql("UPDATE t SET k = 1, k = 2")
+    # soft keywords still valid as column names after DML keywords added
+    s.create_dataframe(pa.table({"update": [1], "values": [2]})) \
+        .create_or_replace_temp_view("softcols")
+    got = s.sql('SELECT update, values FROM softcols').collect()
+    assert got[0] == {"update": 1, "values": 2}
