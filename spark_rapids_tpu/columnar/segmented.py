@@ -1,0 +1,115 @@
+"""TPU-native segmented reductions (the engine's groupby/join/window core).
+
+Measured on TPU v5e: XLA lowers `jax.ops.segment_*` to scatter, and 1M-row
+scatters serialize on the scalar core at ~15-77 ns/element — 72-155 ms per
+segment-sum (emulated-64-bit tuple combiners are worst). Row-sized gathers
+(`jnp.take` with 1M indices) cost ~15-45 ms for the same reason. Dense
+one-hot masked reductions instead run on the vector units at HBM bandwidth:
+~15 us per segment over 1M rows (0.3 ms for 12 groups, 15 ms for 1024).
+
+Strategy implemented here:
+  * ``num_segments <= DENSE_MAX``: one-hot broadcast + reduce. The
+    ``gid[None, :] == iota[:, None]`` mask fuses into the reduction loop, so
+    the [G, n] intermediate never materializes.
+  * larger: scatter fallback (cheap when the row count is small, e.g. the
+    merge pass over already-grouped partials; the 1M-row big-G case is
+    handled by the sorted-segment scan pipeline in groupby_core).
+
+Group-sized (output-sized) gathers and scatters stay: G <= 4096 elements on
+the scalar core is ~60 us, which is noise.
+
+The reference gets segmented reductions from cudf's hash-based groupby
+(CUDA hash tables + atomics); there is no XLA analog of device atomics, and
+emulating one via scatter is exactly the wrong shape for this hardware.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["DENSE_MAX", "bucket_segments", "seg_sum", "seg_min", "seg_max",
+           "seg_count", "onehot_gather"]
+
+#: largest static segment count handled by the dense one-hot strategy
+DENSE_MAX = 4096
+
+#: static bucket sizes: kernels recompile only when the group-count estimate
+#: crosses a bucket boundary (5 variants max), never per dictionary growth
+_BUCKETS = (16, 64, 256, 1024, 4096)
+
+
+def bucket_segments(n: int) -> int:
+    """Smallest static bucket >= n (for jit static num_segments args)."""
+    for b in _BUCKETS:
+        if n <= b:
+            return b
+    return n
+
+
+def _dense_mask(gid, num_segments: int):
+    """[G, n] one-hot mask; stays fused into the consuming reduction."""
+    iota = jax.lax.broadcasted_iota(jnp.int32, (num_segments, gid.shape[0]),
+                                    0)
+    return gid.astype(jnp.int32)[None, :] == iota
+
+
+def seg_sum(data, gid, num_segments: int):
+    """Sum of data per segment; rows with gid outside [0, G) are dropped."""
+    if num_segments <= DENSE_MAX:
+        m = _dense_mask(gid, num_segments)
+        return jnp.sum(jnp.where(m, data[None, :], jnp.zeros_like(data[:1])),
+                       axis=1)
+    return jax.ops.segment_sum(data, gid, num_segments=num_segments)
+
+
+def seg_count(pred, gid, num_segments: int, dtype=jnp.int64):
+    """Count of True rows per segment (pred bool)."""
+    return seg_sum(pred.astype(dtype), gid, num_segments)
+
+
+def seg_min(data, gid, num_segments: int):
+    if num_segments <= DENSE_MAX:
+        m = _dense_mask(gid, num_segments)
+        big = _neutral_max(data.dtype)
+        return jnp.min(jnp.where(m, data[None, :], big), axis=1)
+    return jax.ops.segment_min(data, gid, num_segments=num_segments)
+
+
+def seg_max(data, gid, num_segments: int):
+    if num_segments <= DENSE_MAX:
+        m = _dense_mask(gid, num_segments)
+        small = _neutral_min(data.dtype)
+        return jnp.max(jnp.where(m, data[None, :], small), axis=1)
+    return jax.ops.segment_max(data, gid, num_segments=num_segments)
+
+
+def _neutral_max(dtype):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.array(jnp.inf, dtype=dtype)
+    if dtype == jnp.bool_:
+        return jnp.array(True)
+    return jnp.array(jnp.iinfo(dtype).max, dtype=dtype)
+
+
+def _neutral_min(dtype):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.array(-jnp.inf, dtype=dtype)
+    if dtype == jnp.bool_:
+        return jnp.array(False)
+    return jnp.array(jnp.iinfo(dtype).min, dtype=dtype)
+
+
+def onehot_gather(table, codes, num_entries: int):
+    """table[codes] for a SMALL table (dictionary remap): dense one-hot
+    select instead of a row-sized gather (44 ms -> 0.3 ms at 1M rows).
+    Codes outside [0, num_entries) map to 0 of the table dtype."""
+    if num_entries == 0:
+        return jnp.zeros(codes.shape, dtype=table.dtype)
+    # crossover vs the serialized row-gather (~30 ms/1M rows) is ~2k entries
+    if num_entries > 2048:
+        return jnp.take(table, codes, mode="clip")
+    iota = jax.lax.broadcasted_iota(jnp.int32,
+                                    (num_entries, codes.shape[0]), 0)
+    m = codes.astype(jnp.int32)[None, :] == iota
+    t = table[:num_entries].astype(table.dtype)[:, None]
+    return jnp.sum(jnp.where(m, t, jnp.zeros_like(t[:1])), axis=0)

@@ -276,8 +276,9 @@ class TpuHashAggregateExec(TpuExec):
                     [d.setdefault(s, len(d)) for s in src.dictionary],
                     dtype=np.int32)
                 if len(gmap):
+                    from ..columnar.segmented import onehot_gather
                     remap = jnp.asarray(gmap)       # tiny H2D (cardinality)
-                    codes = jnp.take(remap, src.data, mode="clip")
+                    codes = onehot_gather(remap, src.data, len(gmap))
                 else:
                     codes = jnp.zeros(p, jnp.int32)
                 cols.append(DeviceColumn(codes, src.validity, INT32))
@@ -365,14 +366,17 @@ class TpuHashAggregateExec(TpuExec):
         _AGG_KERNEL_CACHE[("fast",) + kernel_key] = fast
         return fast
 
-    def _get_fast_direct_kernel(self):
+    def _get_fast_direct_kernel(self, g_bucket: int):
         """Direct-addressing groupby for ALL-dictionary-coded keys with a
         small cardinality product: gid = Σ code_i·stride_i — NO 1M-row
         sort (the sort is the dominant FLOPs of the sort-based path; the
-        reference's cudf hash groupby makes the same trade). Static
-        segment bound = OPTIMISTIC_GROUPS; cardinalities ride in as a
-        traced arg so dictionary growth never recompiles."""
-        key = ("fastdirect",) + self._kernel_key
+        reference's cudf hash groupby makes the same trade). The static
+        segment count is the smallest bucket >= the cardinality product,
+        so the dense one-hot reduction (columnar/segmented.py) only pays
+        for the groups that can exist; cardinalities themselves still ride
+        in traced, so dictionary growth recompiles only on a bucket
+        crossing (<=5 variants), never per new dictionary entry."""
+        key = ("fastdirect", g_bucket) + self._kernel_key
         cached = _AGG_KERNEL_CACHE.get(key)
         if cached is not None:
             return cached
@@ -388,8 +392,9 @@ class TpuHashAggregateExec(TpuExec):
                        if in_schema is not None else None)
         stages = self.pre_stages
         OPT = self.OPTIMISTIC_GROUPS
-        G = OPT + 1
+        G = g_bucket
         from ..types import INT32
+        from ..columnar.segmented import seg_sum
 
         @functools.partial(jax.jit, static_argnums=(2,))
         def fast_direct(cols, num_rows, padded_len, cards):
@@ -427,8 +432,7 @@ class TpuHashAggregateExec(TpuExec):
             partial_outs = []
             for a, vs in zip(aggs, vals):
                 partial_outs.extend(a.update(vs, gid, G, keep))
-            occ = jax.ops.segment_sum(keep.astype(jnp.int32), gid,
-                                      num_segments=G) > 0
+            occ = seg_sum(keep.astype(jnp.int32), gid, num_segments=G) > 0
             num_groups = jnp.sum(occ).astype(jnp.int32)
             pos = jnp.where(occ, jnp.cumsum(occ) - 1, G).astype(jnp.int32)
             slot = jnp.arange(G, dtype=jnp.int32)
@@ -479,7 +483,9 @@ class TpuHashAggregateExec(TpuExec):
         cards = np.asarray([len(d) for d in self._dicts], np.int32)
         if (nkeys > 0 and len(self._dict_keys) == nkeys
                 and int(np.prod(cards + 1)) <= self.OPTIMISTIC_GROUPS):
-            fast = self._get_fast_direct_kernel()
+            from ..columnar.segmented import bucket_segments
+            fast = self._get_fast_direct_kernel(
+                bucket_segments(int(np.prod(cards + 1))))
             num_groups, outs = fast(cols, jnp.int32(batch.num_rows),
                                     batch.padded_len, jnp.asarray(cards))
         else:

@@ -23,6 +23,7 @@ import numpy as np
 
 from ..types import (BOOL, DataType, FLOAT64, INT64, Schema, numeric)
 from .base import DVal, Expression, Literal
+from ..columnar.segmented import seg_max, seg_min, seg_sum
 
 __all__ = ["AggregateExpression", "Sum", "Count", "CountStar", "Min", "Max",
            "Average", "First", "Last", "StddevSamp", "StddevPop",
@@ -31,8 +32,8 @@ __all__ = ["AggregateExpression", "Sum", "Count", "CountStar", "Min", "Max",
 
 def _seg_sum(data, valid, gid, num_segments):
     masked = jnp.where(valid, data, jnp.zeros_like(data))
-    s = jax.ops.segment_sum(masked, gid, num_segments=num_segments)
-    cnt = jax.ops.segment_sum(valid.astype(jnp.int64), gid,
+    s = seg_sum(masked, gid, num_segments=num_segments)
+    cnt = seg_sum(valid.astype(jnp.int64), gid,
                               num_segments=num_segments)
     return s, cnt
 
@@ -41,25 +42,25 @@ def _seg_min(data, valid, gid, num_segments):
     if jnp.issubdtype(data.dtype, jnp.floating):
         big = jnp.array(jnp.inf, dtype=data.dtype)
         masked = jnp.where(valid & ~jnp.isnan(data), data, big)
-        has_nan = jax.ops.segment_max(
+        has_nan = seg_max(
             (valid & jnp.isnan(data)).astype(jnp.int32), gid,
             num_segments=num_segments) > 0
-        non_nan_cnt = jax.ops.segment_sum(
+        non_nan_cnt = seg_sum(
             (valid & ~jnp.isnan(data)).astype(jnp.int64), gid,
             num_segments=num_segments)
-        m = jax.ops.segment_min(masked, gid, num_segments=num_segments)
+        m = seg_min(masked, gid, num_segments=num_segments)
         # all-NaN group: min is NaN (NaN is greatest but it's all there is)
         m = jnp.where((non_nan_cnt == 0) & has_nan,
                       jnp.array(jnp.nan, dtype=data.dtype), m)
-        cnt = jax.ops.segment_sum(valid.astype(jnp.int64), gid,
+        cnt = seg_sum(valid.astype(jnp.int64), gid,
                                   num_segments=num_segments)
         return m, cnt
     info = jnp.iinfo(data.dtype) if jnp.issubdtype(data.dtype, jnp.integer) \
         else None
     big = jnp.array(info.max, dtype=data.dtype) if info is not None else True
     masked = jnp.where(valid, data, big)
-    m = jax.ops.segment_min(masked, gid, num_segments=num_segments)
-    cnt = jax.ops.segment_sum(valid.astype(jnp.int64), gid,
+    m = seg_min(masked, gid, num_segments=num_segments)
+    cnt = seg_sum(valid.astype(jnp.int64), gid,
                               num_segments=num_segments)
     return m, cnt
 
@@ -68,21 +69,21 @@ def _seg_max(data, valid, gid, num_segments):
     if jnp.issubdtype(data.dtype, jnp.floating):
         small = jnp.array(-jnp.inf, dtype=data.dtype)
         masked = jnp.where(valid & ~jnp.isnan(data), data, small)
-        has_nan = jax.ops.segment_max(
+        has_nan = seg_max(
             (valid & jnp.isnan(data)).astype(jnp.int32), gid,
             num_segments=num_segments) > 0
-        m = jax.ops.segment_max(masked, gid, num_segments=num_segments)
+        m = seg_max(masked, gid, num_segments=num_segments)
         # Spark: NaN is greatest, so any NaN -> max is NaN
         m = jnp.where(has_nan, jnp.array(jnp.nan, dtype=data.dtype), m)
-        cnt = jax.ops.segment_sum(valid.astype(jnp.int64), gid,
+        cnt = seg_sum(valid.astype(jnp.int64), gid,
                                   num_segments=num_segments)
         return m, cnt
     info = jnp.iinfo(data.dtype) if jnp.issubdtype(data.dtype, jnp.integer) \
         else None
     small = jnp.array(info.min, dtype=data.dtype) if info is not None else False
     masked = jnp.where(valid, data, small)
-    m = jax.ops.segment_max(masked, gid, num_segments=num_segments)
-    cnt = jax.ops.segment_sum(valid.astype(jnp.int64), gid,
+    m = seg_max(masked, gid, num_segments=num_segments)
+    cnt = seg_sum(valid.astype(jnp.int64), gid,
                               num_segments=num_segments)
     return m, cnt
 
@@ -197,7 +198,7 @@ class Count(AggregateExpression):
 
     def update(self, vals, gid, num_segments, row_mask):
         v = vals[0]
-        cnt = jax.ops.segment_sum(v.validity.astype(jnp.int64), gid,
+        cnt = seg_sum(v.validity.astype(jnp.int64), gid,
                                   num_segments=num_segments)
         return [(cnt, jnp.ones_like(cnt, dtype=jnp.bool_))]
 
@@ -226,7 +227,7 @@ class CountStar(Count):
 
     def update(self, vals, gid, num_segments, row_mask):
         ones = row_mask.astype(jnp.int64)
-        cnt = jax.ops.segment_sum(ones, gid, num_segments=num_segments)
+        cnt = seg_sum(ones, gid, num_segments=num_segments)
         return [(cnt, jnp.ones_like(cnt, dtype=jnp.bool_))]
 
 
@@ -322,7 +323,7 @@ class First(AggregateExpression):
         n = v.data.shape[0]
         idx = jnp.arange(n, dtype=jnp.int64)
         big = jnp.array(np.iinfo(np.int64).max, dtype=jnp.int64)
-        first_idx = jax.ops.segment_min(jnp.where(v.validity, idx, big), gid,
+        first_idx = seg_min(jnp.where(v.validity, idx, big), gid,
                                         num_segments=num_segments)
         ok = first_idx < big
         safe = jnp.where(ok, first_idx, 0)
@@ -334,7 +335,7 @@ class First(AggregateExpression):
         n = val.data.shape[0]
         big = jnp.array(np.iinfo(np.int64).max, dtype=jnp.int64)
         eff = jnp.where(val.validity, pos.data, big)
-        first_pos = jax.ops.segment_min(eff, gid, num_segments=num_segments)
+        first_pos = seg_min(eff, gid, num_segments=num_segments)
         ok = first_pos < big
         # gather the value whose pos equals first_pos within the segment
         is_first = jnp.logical_and(eff == jnp.take(first_pos, gid, mode="clip"),
@@ -362,7 +363,7 @@ class Last(AggregateExpression):
         n = v.data.shape[0]
         idx = jnp.arange(n, dtype=jnp.int64)
         small = jnp.array(-1, dtype=jnp.int64)
-        last_idx = jax.ops.segment_max(jnp.where(v.validity, idx, small), gid,
+        last_idx = seg_max(jnp.where(v.validity, idx, small), gid,
                                        num_segments=num_segments)
         ok = last_idx >= 0
         safe = jnp.where(ok, last_idx, 0)
@@ -373,7 +374,7 @@ class Last(AggregateExpression):
         val, pos = partials[0], partials[1]
         small = jnp.array(-1, dtype=jnp.int64)
         eff = jnp.where(val.validity, pos.data, small)
-        last_pos = jax.ops.segment_max(eff, gid, num_segments=num_segments)
+        last_pos = seg_max(eff, gid, num_segments=num_segments)
         ok = last_pos >= 0
         is_last = jnp.logical_and(eff == jnp.take(last_pos, gid, mode="clip"),
                                   val.validity)
