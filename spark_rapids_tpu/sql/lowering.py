@@ -765,12 +765,24 @@ def _lower_dml(session, stmt, views):
     if isinstance(stmt, UpdateStmt):
         dt = _resolve_delta(session, stmt.table, views, "UPDATE")
         _dup_check(stmt.assignments, "UPDATE")
+        _target_col_check((c for c, _ in stmt.assignments),
+                          dt.to_df().columns, "UPDATE SET")
         cond = lw._expr(stmt.where).expr if stmt.where is not None else None
         sets = {c: lw._expr(e).expr for c, e in stmt.assignments}
         return _metrics_df(session, dt.update(cond, sets))
     if isinstance(stmt, MergeStmt):
         return _lower_merge(session, stmt, views, lw)
     raise SqlError(f"unsupported statement {type(stmt).__name__}")
+
+
+def _target_col_check(cols, target_cols, what):
+    """Unknown SET/INSERT target columns are an analysis error (Spark
+    raises too); the DeltaTable builders silently drop unmatched names."""
+    known = set(target_cols)
+    for c in cols:
+        if c not in known:
+            raise SqlError(f"{what}: column {c!r} does not exist in the "
+                           f"target table (columns: {sorted(known)})")
 
 
 def _lower_merge(session, stmt, views, lw):
@@ -784,7 +796,8 @@ def _lower_merge(session, stmt, views, lw):
     talias = (stmt.target.alias or stmt.target.name).lower()
     salias = ((stmt.source.alias
                or getattr(stmt.source, "name", None)) or "__src").lower()
-    tcols = set(dt.to_df().columns)
+    tdf = dt.to_df()
+    tcols = set(tdf.columns)
     scols = list(src.columns)
     colliding = {c for c in scols if c in tcols}
     rename = {c: f"__src_{c}" for c in colliding}
@@ -836,11 +849,19 @@ def _lower_merge(session, stmt, views, lw):
     for clause in stmt.clauses:
         if clause[0] == "update":
             _dup_check(clause[1], "MERGE UPDATE")
+            _target_col_check((c for c, _ in clause[1]), tcols,
+                              "MERGE UPDATE SET")
             mb = mb.when_matched_update(
                 {c: lw._expr(resolve(e)).expr for c, e in clause[1]})
         elif clause[0] == "delete":
             mb = mb.when_matched_delete()
         elif clause[0] == "insert":
+            if len(clause[1]) != len(clause[2]):
+                raise SqlError(
+                    f"MERGE INSERT: {len(clause[1])} columns but "
+                    f"{len(clause[2])} values")
+            _dup_check([(c, None) for c in clause[1]], "MERGE INSERT")
+            _target_col_check(clause[1], tcols, "MERGE INSERT")
             mb = mb.when_not_matched_insert(
                 {c: lw._expr(resolve(e)).expr
                  for c, e in zip(clause[1], clause[2])})
@@ -850,7 +871,7 @@ def _lower_merge(session, stmt, views, lw):
             # dtype cast — same contract as the builder's fallback
             from ..exprs.base import ColumnRef
             from ..exprs.cast import Cast
-            tschema = dt.to_df().schema
+            tschema = tdf.schema
             mb = mb.when_not_matched_insert(
                 {c: Cast(ColumnRef(rename.get(c, c)), tschema[c].dtype)
                  for c in scols if c in tcols})

@@ -378,3 +378,16 @@ def test_sql_merge_clause_validation(tmp_path):
         .create_or_replace_temp_view("softcols")
     got = s.sql('SELECT update, values FROM softcols').collect()
     assert got[0] == {"update": 1, "values": 2}
+    # unknown target columns are analysis errors, not silent no-ops
+    with pytest.raises(SqlError, match="does not exist"):
+        s.sql("UPDATE t SET nosuch = 99")
+    with pytest.raises(SqlError, match="does not exist"):
+        s.sql("""MERGE INTO t USING src ON k = sk
+                 WHEN MATCHED THEN UPDATE SET nosuch = sk""")
+    with pytest.raises(SqlError, match="does not exist"):
+        s.sql("""MERGE INTO t USING src ON k = sk
+                 WHEN NOT MATCHED THEN INSERT (nosuch) VALUES (sk)""")
+    # INSERT column/value arity mismatch is rejected (zip would truncate)
+    with pytest.raises(SqlError, match="1 values"):
+        s.sql("""MERGE INTO t USING src ON k = sk
+                 WHEN NOT MATCHED THEN INSERT (k, k) VALUES (sk)""")
