@@ -15,6 +15,22 @@ import jax as _jax
 # the user opts into approximate float, but parity mode needs x64 on.
 _jax.config.update("jax_enable_x64", True)
 
+# Persistent XLA compilation cache: large variadic sorts compile in ~40 s
+# per signature on TPU; caching makes that a once-ever cost (the analog of
+# the reference shipping precompiled fatbins per architecture). Override
+# with SRTPU_COMPILE_CACHE=/path or disable with SRTPU_COMPILE_CACHE=0.
+import os as _os
+
+_cache_dir = _os.environ.get("SRTPU_COMPILE_CACHE",
+                             _os.path.expanduser("~/.cache/srtpu_xla"))
+if _cache_dir and _cache_dir != "0":
+    try:
+        _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        _jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:  # cache is an optimization, never a hard dependency
+        pass
+
 from .version import __version__
 from .types import Schema, StructField
 from .columnar import ColumnarBatch, DeviceColumn, HostColumn

@@ -27,8 +27,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["DENSE_MAX", "bucket_segments", "seg_sum", "seg_min", "seg_max",
-           "seg_count", "onehot_gather"]
+__all__ = ["DENSE_MAX", "SortedSegments", "bucket_segments", "seg_sum",
+           "seg_min", "seg_max", "seg_count", "onehot_gather"]
 
 #: largest static segment count handled by the dense one-hot strategy
 DENSE_MAX = 4096
@@ -46,6 +46,33 @@ def bucket_segments(n: int) -> int:
     return n
 
 
+def _steps(n: int) -> int:
+    return max(1, (n - 1).bit_length())
+
+
+def _shifted(arr, fill, d):
+    """arr shifted right by traced d, filled with `fill`: pad to [2n] and
+    dynamic-slice — keeps Hillis-Steele loops rolled (lax.fori_loop), so
+    kernels with many scans compile in seconds instead of minutes."""
+    n = arr.shape[0]
+    two = jnp.concatenate([jnp.full((n,), fill, dtype=arr.dtype), arr])
+    return jax.lax.dynamic_slice(two, (n - d,), (n,))
+
+
+def prefix_sum(x, dtype=None):
+    """Inclusive prefix sum via log2(n) shift/add passes in a rolled loop.
+    On this backend `jnp.cumsum` over 1M rows is pathological (191 s
+    compile, 10.7 ms run measured); this runs in ~0.6 ms."""
+    v = x if dtype is None else x.astype(dtype)
+    n = v.shape[0]
+
+    def body(i, v):
+        d = jax.lax.shift_left(jnp.int32(1), i.astype(jnp.int32))
+        return v + _shifted(v, jnp.zeros((), v.dtype), d)
+
+    return jax.lax.fori_loop(0, _steps(n), body, v)
+
+
 def _dense_mask(gid, num_segments: int):
     """[G, n] one-hot mask; stays fused into the consuming reduction."""
     iota = jax.lax.broadcasted_iota(jnp.int32, (num_segments, gid.shape[0]),
@@ -53,8 +80,112 @@ def _dense_mask(gid, num_segments: int):
     return gid.astype(jnp.int32)[None, :] == iota
 
 
-def seg_sum(data, gid, num_segments: int):
-    """Sum of data per segment; rows with gid outside [0, G) are dropped."""
+class SortedSegments:
+    """Segment context for rows already sorted by group key (groupby_core's
+    sort pipeline). Segment reductions become Hillis-Steele segmented scans
+    — log2(n) shift/combine passes, all vector ops — and each segment's
+    aggregate lands at the segment's LAST row. Callers pass an instance in
+    place of the ``gid`` array; every seg_* op dispatches on it and returns
+    PER-ROW arrays (value at each row = scan up to that row). groupby_core
+    extracts the per-segment results at the end positions with one shared
+    compaction sort.
+
+    ``live`` marks real rows (False = padding/filtered); dead rows
+    contribute the combine-neutral to every scan and carry no boundary
+    flags, so a trailing dead region just extends the last segment without
+    changing its total.
+    """
+
+    def __init__(self, flags, live, orig_index=None):
+        self.flags = flags            # bool[n]: True at segment starts
+        self.live = live              # bool[n]
+        #: original (pre-sort) row index per row — the rank FIRST/LAST
+        #: select by; required when those aggregates run over this context
+        self.orig_index = orig_index
+
+    def _scan(self, v, combine, neutral):
+        n = v.shape[0]
+        neutral = jnp.asarray(neutral, dtype=v.dtype)
+
+        def body(i, vf):
+            v, f = vf
+            d = jax.lax.shift_left(jnp.int32(1), i.astype(jnp.int32))
+            pv = _shifted(v, neutral, d)
+            pf = _shifted(f, jnp.array(True), d)
+            return (jnp.where(f, v, combine(pv, v)),
+                    jnp.logical_or(f, pf))
+
+        v, _ = jax.lax.fori_loop(0, _steps(n), body, (v, self.flags))
+        return v
+
+    def sum(self, data, valid):
+        ok = jnp.logical_and(valid, self.live)
+        z = jnp.zeros((), dtype=data.dtype)
+        masked = jnp.where(ok, data, z)
+        return self._scan(masked, lambda a, b: a + b, 0)
+
+    def min(self, data, valid):
+        ok = jnp.logical_and(valid, self.live)
+        big = _neutral_max(data.dtype)
+        return self._scan(jnp.where(ok, data, big), jnp.minimum, big)
+
+    def max(self, data, valid):
+        ok = jnp.logical_and(valid, self.live)
+        small = _neutral_min(data.dtype)
+        return self._scan(jnp.where(ok, data, small), jnp.maximum, small)
+
+    def count(self, pred, dtype=jnp.int64):
+        ok = jnp.logical_and(pred, self.live)
+        return self._scan(ok.astype(dtype), lambda a, b: a + b, 0)
+
+    def select_by_rank(self, values, rank, valid, mode: str):
+        """argmin/argmax scan: per row, the (values..., rank) of the valid
+        row with the smallest (mode='min') / largest ('max') rank seen so
+        far in the segment. Returns (selected_values list, sel_rank, ok).
+        Used for FIRST/LAST (rank = original row index)."""
+        ok = jnp.logical_and(valid, self.live)
+        if mode == "min":
+            neutral_r = _neutral_max(rank.dtype)
+            better = lambda a, b: a <= b
+        else:
+            neutral_r = _neutral_min(rank.dtype)
+            better = lambda a, b: a >= b
+        r = jnp.where(ok, rank, neutral_r)
+        n = r.shape[0]
+        neutral_r = jnp.asarray(neutral_r, dtype=r.dtype)
+
+        def body(i, carry):
+            r, o, f, vs = carry
+            d = jax.lax.shift_left(jnp.int32(1), i.astype(jnp.int32))
+            pr = _shifted(r, neutral_r, d)
+            po = _shifted(o, jnp.array(False), d)
+            pf = _shifted(f, jnp.array(True), d)
+            pvs = tuple(_shifted(v, jnp.zeros((), v.dtype), d) for v in vs)
+            # take the predecessor when it is valid and (we're invalid or
+            # its rank is better) — standard argmin/argmax monoid
+            take_prev = jnp.logical_and(
+                jnp.logical_not(f),
+                jnp.logical_and(po, jnp.logical_or(jnp.logical_not(o),
+                                                   better(pr, r))))
+            return (jnp.where(take_prev, pr, r),
+                    jnp.where(f, o, jnp.logical_or(o, po)),
+                    jnp.logical_or(f, pf),
+                    tuple(jnp.where(take_prev, pv, v)
+                          for pv, v in zip(pvs, vs)))
+
+        r, o, _, vs = jax.lax.fori_loop(
+            0, _steps(n), body, (r, ok, self.flags, tuple(values)))
+        return list(vs), r, o
+
+
+def seg_sum(data, gid, num_segments: int, valid=None):
+    """Sum of data per segment; rows with gid outside [0, G) are dropped.
+    With a SortedSegments context, returns the per-row segmented scan."""
+    if isinstance(gid, SortedSegments):
+        v = jnp.ones(data.shape, jnp.bool_) if valid is None else valid
+        return gid.sum(data, v)
+    if valid is not None:
+        data = jnp.where(valid, data, jnp.zeros((), dtype=data.dtype))
     if num_segments <= DENSE_MAX:
         m = _dense_mask(gid, num_segments)
         return jnp.sum(jnp.where(m, data[None, :], jnp.zeros_like(data[:1])),
@@ -64,10 +195,17 @@ def seg_sum(data, gid, num_segments: int):
 
 def seg_count(pred, gid, num_segments: int, dtype=jnp.int64):
     """Count of True rows per segment (pred bool)."""
+    if isinstance(gid, SortedSegments):
+        return gid.count(pred, dtype)
     return seg_sum(pred.astype(dtype), gid, num_segments)
 
 
-def seg_min(data, gid, num_segments: int):
+def seg_min(data, gid, num_segments: int, valid=None):
+    if isinstance(gid, SortedSegments):
+        v = jnp.ones(data.shape, jnp.bool_) if valid is None else valid
+        return gid.min(data, v)
+    if valid is not None:
+        data = jnp.where(valid, data, _neutral_max(data.dtype))
     if num_segments <= DENSE_MAX:
         m = _dense_mask(gid, num_segments)
         big = _neutral_max(data.dtype)
@@ -75,7 +213,12 @@ def seg_min(data, gid, num_segments: int):
     return jax.ops.segment_min(data, gid, num_segments=num_segments)
 
 
-def seg_max(data, gid, num_segments: int):
+def seg_max(data, gid, num_segments: int, valid=None):
+    if isinstance(gid, SortedSegments):
+        v = jnp.ones(data.shape, jnp.bool_) if valid is None else valid
+        return gid.max(data, v)
+    if valid is not None:
+        data = jnp.where(valid, data, _neutral_min(data.dtype))
     if num_segments <= DENSE_MAX:
         m = _dense_mask(gid, num_segments)
         small = _neutral_min(data.dtype)
@@ -97,6 +240,25 @@ def _neutral_min(dtype):
     if dtype == jnp.bool_:
         return jnp.array(False)
     return jnp.array(jnp.iinfo(dtype).min, dtype=dtype)
+
+
+def compact_rows(arrays, keep, padded_len: int):
+    """Move keep-rows to the front preserving order: ONE stable variadic
+    sort on (!keep) carrying every column as payload. Replaces the
+    cumsum+scatter idiom — per-column 1M-row scatters serialize on the
+    scalar core, while the sort network is bandwidth-bound (~5 ms).
+
+    arrays: [(data, validity), ...]; returns (compacted pairs, count)."""
+    count = jnp.sum(keep).astype(jnp.int32)
+    live = jnp.arange(padded_len, dtype=jnp.int32) < count
+    key = jnp.where(keep, jnp.uint8(0), jnp.uint8(1))
+    flat = []
+    for d, v in arrays:
+        flat.extend((d, v))
+    packed = jax.lax.sort(tuple([key] + flat), num_keys=1, is_stable=True)
+    it = iter(packed[1:])
+    outs = [(next(it), jnp.logical_and(next(it), live)) for _ in arrays]
+    return outs, count
 
 
 def onehot_gather(table, codes, num_entries: int):

@@ -4,11 +4,13 @@ Reference: GpuHashAggregateExec (GpuAggregateExec.scala:1776) — a 3-phase
 pipeline: per-batch first-pass aggregation, merge passes over partial results
 (GpuMergeAggregateIterator:718), finalize projection.
 
-TPU-first divergence: the per-batch groupby is SORT-BASED (encode keys ->
-one lax.sort -> segment boundaries -> jax.ops.segment_* reductions), all
-static shapes, one fused XLA kernel per phase per shape bucket. cudf's hash
-groupby has no XLA analog; sort+segments is the canonical accelerator-SQL
-formulation for SPMD hardware. Merge uses the same kernel with each
+TPU-first divergence: the per-batch groupby avoids scatter/gather entirely
+(they serialize on the TPU scalar core). Dictionary-coded keys with a small
+cardinality product take the direct-addressing kernel (dense one-hot
+broadcast+reduce over a bucketed static group count); everything else takes
+the sort pipeline in groupby_core (one variadic lax.sort carrying payloads,
+segmented scans, one compaction sort), all static shapes, one fused XLA
+kernel per phase per shape bucket. Merge uses the same kernels with each
 aggregate's merge semantics — identical maths to the reference's merge pass.
 
 Memory behaviour mirrors the reference: partial batches are Spillable, merge
@@ -394,7 +396,7 @@ class TpuHashAggregateExec(TpuExec):
         OPT = self.OPTIMISTIC_GROUPS
         G = g_bucket
         from ..types import INT32
-        from ..columnar.segmented import seg_sum
+        from ..columnar.segmented import prefix_sum, seg_sum
 
         @functools.partial(jax.jit, static_argnums=(2,))
         def fast_direct(cols, num_rows, padded_len, cards):
@@ -434,7 +436,7 @@ class TpuHashAggregateExec(TpuExec):
                 partial_outs.extend(a.update(vs, gid, G, keep))
             occ = seg_sum(keep.astype(jnp.int32), gid, num_segments=G) > 0
             num_groups = jnp.sum(occ).astype(jnp.int32)
-            pos = jnp.where(occ, jnp.cumsum(occ) - 1, G).astype(jnp.int32)
+            pos = jnp.where(occ, prefix_sum(occ, jnp.int32) - 1, G)
             slot = jnp.arange(G, dtype=jnp.int32)
             outs = []
             for i in range(nkeys):

@@ -1,9 +1,17 @@
-"""Core traced groupby: encode keys -> one lax.sort -> segment boundaries ->
-per-aggregate segment reductions. Shared by the single-device aggregate exec
-(exec/aggregate.py) and the multi-chip SPMD path (parallel/collective.py),
-so local and distributed aggregation are the same maths by construction
-(the reference gets this by reusing cudf groupby in both its first-pass and
-merge pass, GpuAggregateExec.scala:718).
+"""Core traced groupby: encode keys -> ONE variadic lax.sort (payloads ride
+the sort network) -> segmented scans -> one compaction sort. Shared by the
+single-device aggregate exec (exec/aggregate.py) and the multi-chip SPMD
+path (parallel/collective.py), so local and distributed aggregation are the
+same maths by construction (the reference gets this by reusing cudf groupby
+in both its first-pass and merge pass, GpuAggregateExec.scala:718).
+
+TPU note: this pipeline deliberately contains NO row-sized gathers or
+scatters — both serialize on the scalar core (~15-45 ms per 1M rows
+measured on v5e). Values are carried through the key sort as sort payloads,
+per-segment aggregation is a Hillis-Steele segmented scan
+(columnar/segmented.SortedSegments), and the per-group results — which land
+at each segment's last row — are packed to the front by one more variadic
+sort keyed on "segment id at end rows, +inf elsewhere".
 """
 from __future__ import annotations
 
@@ -12,6 +20,7 @@ from typing import List, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..columnar.segmented import SortedSegments, prefix_sum
 from ..exprs.base import DVal
 from .encoding import grouping_operands, operands_equal
 
@@ -30,65 +39,93 @@ def segmented_groupby(keys: List[DVal], vals: List[List[DVal]],
     pre-filter can drop rows without a separate compaction kernel."""
     if row_mask is None:
         row_mask = jnp.arange(padded_len, dtype=jnp.int32) < num_rows
+    idx = jnp.arange(padded_len, dtype=jnp.int32)
+
     if not keys:
-        gid = jnp.where(row_mask, 0, padded_len).astype(jnp.int32)
+        # single group over the unsorted rows; the scans' inclusive total
+        # lands at the last row (dead rows contribute the neutral)
+        seg = SortedSegments(idx == 0, row_mask, orig_index=idx)
         num_groups = jnp.int32(1)
-        sorted_vals = vals
+        partial_rows = _run_aggs(aggs, vals, seg, mode, row_mask)
         key_outs: List[Tuple] = []
-        update_mask = row_mask        # vals stay in the unsorted domain
+        partial_outs = [(jnp.where(idx == 0, d[-1],
+                                   jnp.zeros((), dtype=d.dtype)),
+                         jnp.where(idx == 0, v[-1], False))
+                        for d, v in partial_rows]
     else:
         pad_flag = jnp.where(row_mask, jnp.uint8(0), jnp.uint8(1))
         operands = [pad_flag]
         for k in keys:
             operands.extend(grouping_operands(k))
-        # sort ONLY (key operands, row index); payloads are gathered after —
-        # far cheaper than carrying every column through the sort network
-        perm0 = jnp.arange(padded_len, dtype=jnp.int32)
         n_key_ops = len(operands)
-        sorted_all = jax.lax.sort(tuple(operands + [perm0]),
+        # payloads (carried through the sort network — far cheaper than
+        # row-sized gathers): original index, key columns, value columns
+        payload: List = [idx]
+        for k in keys:
+            payload.extend((k.data, k.validity))
+        for vs in vals:
+            for v in vs:
+                payload.extend((v.data, v.validity))
+        sorted_all = jax.lax.sort(tuple(operands + payload),
                                   num_keys=n_key_ops, is_stable=True)
         s_ops = sorted_all[:n_key_ops]
-        perm = sorted_all[n_key_ops]
-        idx = jnp.arange(padded_len)
+        it = iter(sorted_all[n_key_ops:])
+        perm = next(it)
+        s_keys = [DVal(next(it), next(it), k.dtype) for k in keys]
+        sorted_vals = [[DVal(next(it), next(it), v.dtype) for v in vs]
+                       for vs in vals]
+
         differs = jnp.zeros(padded_len, dtype=jnp.bool_)
         for op in s_ops[1:]:
             prev = jnp.roll(op, 1)
             differs = jnp.logical_or(
                 differs, jnp.logical_not(operands_equal(op, prev)))
-        flags = jnp.logical_or(idx == 0, differs)
         # live rows sort first (pad_flag), so the sorted-domain live mask
         # is a prefix of length sum(row_mask) — row_mask itself is in the
         # UNSORTED domain and may be arbitrary (fused pre-filter)
         s_live = idx < jnp.sum(row_mask)
-        flags = jnp.logical_and(flags, s_live)
+        flags = jnp.logical_and(jnp.logical_or(idx == 0, differs), s_live)
         num_groups = jnp.sum(flags).astype(jnp.int32)
-        gid = jnp.where(s_live, (jnp.cumsum(flags) - 1).astype(jnp.int32),
-                        padded_len)
-        s_keys = [DVal(jnp.take(k.data, perm), jnp.take(k.validity, perm),
-                       k.dtype) for k in keys]
-        sorted_vals = [[DVal(jnp.take(v.data, perm),
-                             jnp.take(v.validity, perm), v.dtype)
-                        for v in vs] for vs in vals]
-        key_outs = []
-        safe_gid = jnp.where(flags, gid, padded_len)
+        # segment id without live-masking: the trailing dead region simply
+        # extends the last segment (its scans see only neutrals there)
+        gid_seg = prefix_sum(flags, jnp.int32) - 1
+
+        seg = SortedSegments(flags, s_live, orig_index=perm)
+        partial_rows = _run_aggs(aggs, sorted_vals, seg, mode, s_live)
+
+        # extraction: each segment's total sits at its last LIVE row (the
+        # scan there covers the whole segment; the raw key payload there is
+        # a real row, unlike the trailing dead region); one stable sort
+        # packs those rows — already in segment order — to the front
+        one_true = jnp.ones((1,), dtype=jnp.bool_)
+        nxt_flag = jnp.concatenate([flags[1:], one_true])
+        nxt_dead = jnp.concatenate([jnp.logical_not(s_live[1:]), one_true])
+        end_mask = jnp.logical_and(
+            s_live, jnp.logical_or(nxt_flag, nxt_dead))
+        ckey = jnp.where(end_mask, gid_seg, padded_len)
+        carry: List = []
         for k in s_keys:
-            kd = jnp.zeros((padded_len,), dtype=k.data.dtype) \
-                .at[safe_gid].set(k.data, mode="drop")
-            kv = jnp.zeros((padded_len,), dtype=jnp.bool_) \
-                .at[safe_gid].set(k.validity, mode="drop")
-            key_outs.append((kd, kv))
-        update_mask = s_live          # vals were permuted live-first
+            carry.extend((k.data, k.validity))
+        for d, v in partial_rows:
+            carry.extend((d, v))
+        packed = jax.lax.sort(tuple([ckey] + carry), num_keys=1,
+                              is_stable=True)
+        it = iter(packed[1:])
+        key_outs = [(next(it), next(it)) for _ in keys]
+        partial_outs = [(next(it), next(it)) for _ in partial_rows]
 
-    partial_outs = []
-    for a, vs in zip(aggs, sorted_vals):
-        if mode == "update":
-            outs = a.update(vs, gid, padded_len, update_mask)
-        else:
-            outs = a.merge(vs, gid, padded_len)
-        partial_outs.extend(outs)
-
-    group_live = jnp.arange(padded_len, dtype=jnp.int32) < num_groups
+    group_live = idx < num_groups
     key_outs = [(d, jnp.logical_and(v, group_live)) for d, v in key_outs]
     partial_outs = [(d, jnp.logical_and(v, group_live))
                     for d, v in partial_outs]
     return key_outs, partial_outs, num_groups
+
+
+def _run_aggs(aggs, vals, seg, mode, update_mask):
+    outs = []
+    for a, vs in zip(aggs, vals):
+        if mode == "update":
+            outs.extend(a.update(vs, seg, None, update_mask))
+        else:
+            outs.extend(a.merge(vs, seg, None))
+    return outs

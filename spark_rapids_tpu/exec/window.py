@@ -22,6 +22,8 @@ from typing import Dict, Iterator, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+
+from ..columnar.segmented import prefix_sum
 import numpy as np
 
 from ..columnar import ColumnarBatch, DeviceColumn, concat_batches
@@ -81,7 +83,7 @@ def _build_window_kernel(window_exprs, schema: Schema, padded_len_key=None):
                     pdiff, jnp.logical_not(operands_equal(op, prev)))
             pflags = jnp.logical_and(jnp.logical_or(idx == 0, pdiff), row_mask)
             gid = jnp.where(row_mask,
-                            (jnp.cumsum(pflags) - 1).astype(jnp.int32), P)
+                            prefix_sum(pflags, jnp.int32) - 1, P)
             part_start = jax.lax.associative_scan(
                 jnp.maximum, jnp.where(pflags, idx, 0))
             # order-value run boundaries (for rank/dense_rank)
@@ -102,7 +104,7 @@ def _build_window_kernel(window_exprs, schema: Schema, padded_len_key=None):
                 out_sorted = (run_start - part_start + 1).astype(jnp.int32)
                 ov_sorted = row_mask
             elif isinstance(fn, DenseRank):
-                c = jnp.cumsum(oflags).astype(jnp.int32)
+                c = prefix_sum(oflags, jnp.int32)
                 c_at_pstart = _seg_broadcast(
                     jnp.zeros(P, jnp.int32).at[
                         jnp.where(pflags, gid, P)].set(c, mode="drop"), gid)
@@ -218,8 +220,8 @@ def _windowed_agg(fn: AggregateExpression, spec: WindowSpec, ctx, perm, gid,
         else jnp.int64
     acc = jnp.where(vv, vd, jnp.zeros_like(vd)).astype(acc_dt)
     cntv = vv.astype(jnp.int64)
-    ps = jnp.cumsum(acc)          # global prefix (inclusive)
-    pc = jnp.cumsum(cntv)
+    ps = prefix_sum(acc)          # global prefix (inclusive)
+    pc = prefix_sum(cntv)
 
     def window_sum(prefix):
         # sum over [max(pstart, i+lo), min(pend, i+hi)] in sorted space

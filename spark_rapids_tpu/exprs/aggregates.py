@@ -1,10 +1,13 @@
 """Aggregate functions (ref aggregate/aggregateFunctions.scala, 2,158 LoC;
 GpuAggregateFunction trait aggregateBase.scala:79).
 
-TPU-first design: groupby is SORT-BASED segmented reduction, not hash tables —
-`lax.sort` on encoded keys then `jax.ops.segment_*` over group ids, all static
-shapes (the XLA-native pattern; cudf uses hash groupby which has no efficient
-XLA analog). Each aggregate declares:
+TPU-first design: groupby is segmented reduction, not hash tables (cudf's
+hash groupby relies on device atomics, which have no XLA analog). Two
+regimes, both scatter-free (columnar/segmented.py): dense one-hot
+broadcast+reduce when the group-id space is small (dictionary-coded keys),
+and sort + Hillis-Steele segmented scans for the general case — every
+aggregate's seg_* call dispatches on the context it is handed. Each
+aggregate declares:
   update   : per-row values  -> per-group partials      (first pass, per batch)
   merge    : per-group partials -> per-group partials   (combining batches or
              shuffle partitions — identical maths to the reference's
@@ -23,7 +26,7 @@ import numpy as np
 
 from ..types import (BOOL, DataType, FLOAT64, INT64, Schema, numeric)
 from .base import DVal, Expression, Literal
-from ..columnar.segmented import seg_max, seg_min, seg_sum
+from ..columnar.segmented import SortedSegments, seg_max, seg_min, seg_sum
 
 __all__ = ["AggregateExpression", "Sum", "Count", "CountStar", "Min", "Max",
            "Average", "First", "Last", "StddevSamp", "StddevPop",
@@ -321,8 +324,13 @@ class First(AggregateExpression):
     def update(self, vals, gid, num_segments, row_mask):
         v = vals[0]
         n = v.data.shape[0]
-        idx = jnp.arange(n, dtype=jnp.int64)
         big = jnp.array(np.iinfo(np.int64).max, dtype=jnp.int64)
+        if isinstance(gid, SortedSegments):
+            idx = gid.orig_index.astype(jnp.int64)
+            (val,), fi, ok = gid.select_by_rank([v.data], idx, v.validity,
+                                                "min")
+            return [(val, ok), (jnp.where(ok, fi, big), jnp.ones_like(ok))]
+        idx = jnp.arange(n, dtype=jnp.int64)
         first_idx = seg_min(jnp.where(v.validity, idx, big), gid,
                                         num_segments=num_segments)
         ok = first_idx < big
@@ -332,9 +340,12 @@ class First(AggregateExpression):
 
     def merge(self, partials, gid, num_segments):
         val, pos = partials[0], partials[1]
-        n = val.data.shape[0]
         big = jnp.array(np.iinfo(np.int64).max, dtype=jnp.int64)
         eff = jnp.where(val.validity, pos.data, big)
+        if isinstance(gid, SortedSegments):
+            (out,), fp, ok = gid.select_by_rank([val.data], eff,
+                                                val.validity, "min")
+            return [(out, ok), (jnp.where(ok, fp, big), jnp.ones_like(ok))]
         first_pos = seg_min(eff, gid, num_segments=num_segments)
         ok = first_pos < big
         # gather the value whose pos equals first_pos within the segment
@@ -361,8 +372,14 @@ class Last(AggregateExpression):
     def update(self, vals, gid, num_segments, row_mask):
         v = vals[0]
         n = v.data.shape[0]
-        idx = jnp.arange(n, dtype=jnp.int64)
         small = jnp.array(-1, dtype=jnp.int64)
+        if isinstance(gid, SortedSegments):
+            idx = gid.orig_index.astype(jnp.int64)
+            (val,), li, ok = gid.select_by_rank([v.data], idx, v.validity,
+                                                "max")
+            return [(val, ok), (jnp.where(ok, li, small),
+                                jnp.ones_like(ok))]
+        idx = jnp.arange(n, dtype=jnp.int64)
         last_idx = seg_max(jnp.where(v.validity, idx, small), gid,
                                        num_segments=num_segments)
         ok = last_idx >= 0
@@ -374,6 +391,11 @@ class Last(AggregateExpression):
         val, pos = partials[0], partials[1]
         small = jnp.array(-1, dtype=jnp.int64)
         eff = jnp.where(val.validity, pos.data, small)
+        if isinstance(gid, SortedSegments):
+            (out,), lp, ok = gid.select_by_rank([val.data], eff,
+                                                val.validity, "max")
+            return [(out, ok), (jnp.where(ok, lp, small),
+                                jnp.ones_like(ok))]
         last_pos = seg_max(eff, gid, num_segments=num_segments)
         ok = last_pos >= 0
         is_last = jnp.logical_and(eff == jnp.take(last_pos, gid, mode="clip"),

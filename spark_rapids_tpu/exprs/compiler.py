@@ -99,17 +99,11 @@ def _compact_kernel(arrays, keep, padded_len):
 
     arrays: list of (data, validity); keep: bool[P] (False on padding).
     Returns compacted (data, validity) list + new row count (int32 scalar).
-    O(n) cumsum + scatter-with-drop, no sort.
+    One stable variadic sort (columnar/segmented.compact_rows) — scatter
+    compaction serializes on the TPU scalar core.
     """
-    count = jnp.sum(keep).astype(jnp.int32)
-    pos = jnp.where(keep, jnp.cumsum(keep) - 1, padded_len)
-    live = jnp.arange(padded_len, dtype=jnp.int32) < count
-    outs = []
-    for data, validity in arrays:
-        od = jnp.zeros_like(data).at[pos].set(data, mode="drop")
-        ov = jnp.zeros_like(validity).at[pos].set(validity, mode="drop")
-        outs.append((od, jnp.logical_and(ov, live)))
-    return outs, count
+    from ..columnar.segmented import compact_rows
+    return compact_rows(arrays, keep, padded_len)
 
 
 def eval_predicate_device(pred: Expression, batch: ColumnarBatch) -> jnp.ndarray:

@@ -101,17 +101,12 @@ def _route_to_buffers(arrays, pid, padded_len: int, n_dev: int):
 
 
 def _compact_rows(arrays, keep, length):
-    """Move keep-rows to the front (cumsum+scatter); arrays are (data,
-    validity) pairs; returns compacted pairs + count."""
-    cnt = jnp.sum(keep).astype(jnp.int32)
-    pos = jnp.where(keep, jnp.cumsum(keep) - 1, length)
-    out = []
-    for d, v in arrays:
-        cd = jnp.zeros_like(d).at[pos].set(d, mode="drop")
-        cv = jnp.zeros_like(v).at[pos].set(
-            jnp.logical_and(v, keep), mode="drop")
-        out.append((cd, cv))
-    return out, cnt
+    """Move keep-rows to the front; arrays are (data, validity) pairs;
+    returns compacted pairs + count. Sort-based (segmented.compact_rows):
+    scatter compaction serializes on the TPU scalar core."""
+    from ..columnar.segmented import compact_rows
+    masked = [(d, jnp.logical_and(v, keep)) for d, v in arrays]
+    return compact_rows(masked, keep, length)
 
 
 def build_distributed_agg_step(mesh: Mesh, schema: Schema,

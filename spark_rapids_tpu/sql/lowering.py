@@ -746,11 +746,11 @@ def _metrics_df(session, metrics: dict):
         pa.table({k: [v] for k, v in metrics.items()} or {"ok": [1]}))
 
 
-def _dup_check(pairs, what):
+def _dup_check(pairs, what, kind="SET"):
     seen = set()
     for c, _ in pairs:
         if c.lower() in seen:
-            raise SqlError(f"duplicate SET column {c!r} in {what}")
+            raise SqlError(f"duplicate {kind} column {c!r} in {what}")
         seen.add(c.lower())
 
 
@@ -860,7 +860,8 @@ def _lower_merge(session, stmt, views, lw):
                 raise SqlError(
                     f"MERGE INSERT: {len(clause[1])} columns but "
                     f"{len(clause[2])} values")
-            _dup_check([(c, None) for c in clause[1]], "MERGE INSERT")
+            _dup_check([(c, None) for c in clause[1]], "MERGE INSERT",
+                       kind="INSERT")
             _target_col_check(clause[1], tcols, "MERGE INSERT")
             mb = mb.when_not_matched_insert(
                 {c: lw._expr(resolve(e)).expr
