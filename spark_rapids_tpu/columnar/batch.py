@@ -188,8 +188,14 @@ class ColumnarBatch:
     @staticmethod
     def from_pandas(df, buckets: Sequence[int] = DEFAULT_BUCKETS) -> "ColumnarBatch":
         import pyarrow as pa
-        return ColumnarBatch.from_arrow(pa.Table.from_pandas(df, preserve_index=False),
-                                        buckets)
+        # column-by-column: pa.Table.from_pandas rejects duplicate column
+        # names, which are legal in intermediate frames (e.g. t.k joined
+        # with r.k — Spark allows ambiguous names until they're referenced)
+        arrays = [pa.Array.from_pandas(df.iloc[:, i])
+                  for i in range(df.shape[1])]
+        table = pa.Table.from_arrays(arrays,
+                                     names=[str(c) for c in df.columns])
+        return ColumnarBatch.from_arrow(table, buckets)
 
     def to_arrow(self):
         import jax

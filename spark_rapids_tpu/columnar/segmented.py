@@ -178,14 +178,12 @@ class SortedSegments:
         return list(vs), r, o
 
 
-def seg_sum(data, gid, num_segments: int, valid=None):
+def seg_sum(data, gid, num_segments: int):
     """Sum of data per segment; rows with gid outside [0, G) are dropped.
-    With a SortedSegments context, returns the per-row segmented scan."""
+    Callers pre-mask invalid rows to the neutral. With a SortedSegments
+    context, returns the per-row segmented scan."""
     if isinstance(gid, SortedSegments):
-        v = jnp.ones(data.shape, jnp.bool_) if valid is None else valid
-        return gid.sum(data, v)
-    if valid is not None:
-        data = jnp.where(valid, data, jnp.zeros((), dtype=data.dtype))
+        return gid.sum(data, jnp.ones(data.shape, jnp.bool_))
     if num_segments <= DENSE_MAX:
         m = _dense_mask(gid, num_segments)
         return jnp.sum(jnp.where(m, data[None, :], jnp.zeros_like(data[:1])),
@@ -200,12 +198,9 @@ def seg_count(pred, gid, num_segments: int, dtype=jnp.int64):
     return seg_sum(pred.astype(dtype), gid, num_segments)
 
 
-def seg_min(data, gid, num_segments: int, valid=None):
+def seg_min(data, gid, num_segments: int):
     if isinstance(gid, SortedSegments):
-        v = jnp.ones(data.shape, jnp.bool_) if valid is None else valid
-        return gid.min(data, v)
-    if valid is not None:
-        data = jnp.where(valid, data, _neutral_max(data.dtype))
+        return gid.min(data, jnp.ones(data.shape, jnp.bool_))
     if num_segments <= DENSE_MAX:
         m = _dense_mask(gid, num_segments)
         big = _neutral_max(data.dtype)
@@ -213,12 +208,9 @@ def seg_min(data, gid, num_segments: int, valid=None):
     return jax.ops.segment_min(data, gid, num_segments=num_segments)
 
 
-def seg_max(data, gid, num_segments: int, valid=None):
+def seg_max(data, gid, num_segments: int):
     if isinstance(gid, SortedSegments):
-        v = jnp.ones(data.shape, jnp.bool_) if valid is None else valid
-        return gid.max(data, v)
-    if valid is not None:
-        data = jnp.where(valid, data, _neutral_min(data.dtype))
+        return gid.max(data, jnp.ones(data.shape, jnp.bool_))
     if num_segments <= DENSE_MAX:
         m = _dense_mask(gid, num_segments)
         small = _neutral_min(data.dtype)

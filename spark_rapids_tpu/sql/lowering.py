@@ -85,24 +85,41 @@ class _Lowerer:
 
     def _lower_select(self, sel: Select):
         df = self._resolve_ref(sel.from_ref)
+        # alias -> {original column name -> actual column name}: join
+        # inputs whose names collide with columns already in the frame are
+        # renamed before the join, and qualified references (t.k / r.k)
+        # resolve through this map — otherwise both sides' k collapse to
+        # one ambiguous name (Spark keeps attributes distinct by expr id)
         alias_cols = {}
         if isinstance(sel.from_ref, (TableRef, SubqueryRef)) \
                 and sel.from_ref.alias:
-            alias_cols[sel.from_ref.alias.lower()] = set(df.columns)
+            alias_cols[sel.from_ref.alias.lower()] = {c: c
+                                                      for c in df.columns}
         elif isinstance(sel.from_ref, TableRef):
-            alias_cols[sel.from_ref.name.lower()] = set(df.columns)
+            alias_cols[sel.from_ref.name.lower()] = {c: c
+                                                     for c in df.columns}
 
         # implicit joins (FROM a, b WHERE a.k = b.k): claim WHERE equality
         # conjuncts as join keys so the plan never materializes a true
         # cartesian product (Spark's planner does the same rewrite)
         conjuncts = _split_conjuncts(sel.where)
-        for j in sel.joins:
+        for ji, j in enumerate(sel.joins):
             right = self._resolve_ref(j.ref)
             rname = (j.ref.alias or getattr(j.ref, "name", None))
+            rmap = {c: c for c in right.columns}
+            if j.using is None:
+                taken = set(df.columns)
+                collide = [c for c in right.columns if c in taken]
+                if collide:
+                    rmap = {c: (f"__j{ji}_{c}" if c in collide else c)
+                            for c in right.columns}
+                    right = right.select(*[
+                        F.col(c).alias(rmap[c]) for c in right.columns])
             if rname:
-                alias_cols[rname.lower()] = set(right.columns)
+                alias_cols[rname.lower()] = rmap
             if j.kind == "cross" and j.on is None and j.using is None \
                     and conjuncts:
+                self._aliases = alias_cols
                 pairs, conjuncts = self._claim_eq_pairs(
                     conjuncts, set(df.columns), set(right.columns),
                     alias_cols, rname.lower() if rname else None)
@@ -279,14 +296,15 @@ class _Lowerer:
         sets on both sides) unambiguous."""
         if not (isinstance(ast, tuple) and ast[0] == "col"):
             return None, None
-        nm = self._col_name(ast)
         parts = ast[1]
         if len(parts) == 2:
             q = parts[0].lower()
+            nm = alias_cols.get(q, {}).get(parts[1], parts[1])
             if ralias is not None and q == ralias:
                 return ("r", nm) if nm in rcols else (None, None)
             if q in alias_cols:
                 return ("l", nm) if nm in lcols else (None, None)
+        nm = self._col_name(ast)
         if nm in lcols and nm not in rcols:
             return "l", nm
         if nm in rcols and nm not in lcols:
@@ -356,17 +374,24 @@ class _Lowerer:
     # -- projection / aggregation ---------------------------------------
     def _expand_items(self, df, items):
         out = []
+        rev = {}
+        for amap in getattr(self, "_aliases", {}).values():
+            for orig, actual in amap.items():
+                if actual != orig:
+                    rev[actual] = orig
         for e, alias in items:
             if isinstance(e, tuple) and e[0] == "star":
                 for c in df.columns:
-                    out.append((("col", (c,)), None))
+                    out.append((("col", (c,)), rev.get(c)))
             elif isinstance(e, tuple) and e[0] == "qstar":
-                cols = self._aliases.get(e[1].lower())
-                if cols is None:
+                amap = self._aliases.get(e[1].lower())
+                if amap is None:
                     raise SqlError(f"unknown alias {e[1]}")
+                arev = {actual: orig for orig, actual in amap.items()}
                 for c in df.columns:
-                    if c in cols:
-                        out.append((("col", (c,)), None))
+                    if c in arev:
+                        out.append((("col", (c,)),
+                                    arev[c] if arev[c] != c else None))
             else:
                 out.append((e, alias))
         return out
@@ -527,6 +552,17 @@ class _Lowerer:
     # -- scalar expressions ----------------------------------------------
     def _col_name(self, ast) -> str:
         parts = ast[1]
+        if len(parts) == 2:
+            # qualified ref: resolve through the alias map so t.k / r.k
+            # reach the right (possibly collision-renamed) column
+            amap = getattr(self, "_aliases", {}).get(parts[0].lower())
+            if amap is not None:
+                actual = amap.get(parts[1])
+                if actual is None:
+                    raise SqlError(
+                        f"{parts[0]}.{parts[1]}: no such column (columns: "
+                        f"{sorted(amap)})")
+                return actual
         return parts[-1]
 
     def _default_name(self, ast, c) -> str:

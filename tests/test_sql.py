@@ -367,3 +367,22 @@ def test_path_rules_and_codec_validation():
     t = pa.table({"k": [1, 2, 3]})
     with pytest.raises(ValueError, match="unsupported shuffle codec"):
         s.create_dataframe(t).repartition(2, F.col("k")).count()
+
+
+def test_qualified_refs_with_colliding_join_columns():
+    """t.k and r.k must stay distinct after a join (Spark keeps attributes
+    by expression id; the lowerer renames collisions internally)."""
+    t = pa.table({"k": [1, 2, 2], "v": [1.0, 2.0, 3.0]})
+    r = pa.table({"k": [2, 3], "name": ["a", "b"]})
+    for enabled in (True, False):
+        s = tpu_session({"spark.rapids.tpu.sql.enabled": enabled})
+        s.create_dataframe(t).create_or_replace_temp_view("t")
+        s.create_dataframe(r).create_or_replace_temp_view("r")
+        got = s.sql("""SELECT t.k, count(name) c FROM t LEFT JOIN r
+                       ON t.k = r.k GROUP BY t.k ORDER BY t.k""").collect()
+        assert got == [{"k": 1, "c": 0}, {"k": 2, "c": 2}]
+        both = s.sql("SELECT t.k, r.k FROM t JOIN r ON t.k = r.k") \
+            .collect_arrow()
+        assert both.column_names == ["k", "k"]
+        star = s.sql("SELECT r.* FROM t JOIN r ON t.k = r.k").collect()
+        assert star == [{"k": 2, "name": "a"}, {"k": 2, "name": "a"}]
