@@ -44,5 +44,9 @@ def metrics_summary(ctx) -> Dict[str, Dict[str, object]]:
     GpuExec.scala:54-165; levels preserved)."""
     out: Dict[str, Dict[str, object]] = {}
     for exec_id, ms in ctx.metrics.items():
-        out[exec_id] = {name: m.value for name, m in ms.items()}
+        # metric adds may accumulate lazy device scalars (row counts kept
+        # unforced to avoid tunnel syncs); force to plain ints ONCE here
+        out[exec_id] = {name: (m.value.item()
+                               if hasattr(m.value, "item") else m.value)
+                        for name, m in ms.items()}
     return out

@@ -19,6 +19,17 @@ from .column import DeviceColumn, DictColumn, HostColumn
 
 ColumnLike = Union[DeviceColumn, HostColumn]
 
+
+class SpeculativeOverflow(Exception):
+    """A speculatively-sized output (join bucket guess) was too small; the
+    sink catches this, disables speculation on the ExecContext, and
+    re-executes the plan with exact (synchronous) sizing."""
+
+    def __init__(self, needed: int, capacity: int):
+        super().__init__(f"speculative capacity {capacity} < {needed} rows")
+        self.needed = needed
+        self.capacity = capacity
+
 #: dictionary-encode string columns into device codes when the cardinality
 #: is below this fraction of rows (and the absolute cap). Flip to 0 to
 #: force host strings (tests use this to cover both paths).
@@ -51,21 +62,47 @@ def _try_dict_encode(col, n: int, p: int):
 
 
 class ColumnarBatch:
-    __slots__ = ("columns", "num_rows", "schema", "meta")
+    __slots__ = ("columns", "_num_rows", "schema", "meta")
 
-    def __init__(self, columns: Sequence[ColumnLike], num_rows: int,
+    def __init__(self, columns: Sequence[ColumnLike], num_rows,
                  schema: Schema, meta: Optional[dict] = None):
         assert len(columns) == len(schema), (len(columns), len(schema))
+        lazy = not isinstance(num_rows, (int, np.integer))
         for c in columns:
-            if isinstance(c, DeviceColumn) and c.padded_len < num_rows:
+            if not lazy and isinstance(c, DeviceColumn) \
+                    and c.padded_len < num_rows:
                 raise ValueError("device column shorter than num_rows")
         self.columns = list(columns)
-        self.num_rows = int(num_rows)
+        # num_rows may be a device scalar (e.g. a filter's surviving-row
+        # count): forcing it costs a full tunnel round trip (~40-100 ms on
+        # this backend), so it stays on device until host code actually
+        # needs the int — kernels consume num_rows_raw without syncing
+        self._num_rows = num_rows if lazy else int(num_rows)
         self.schema = schema
         #: task-context metadata consumed by non-deterministic expressions
         #: (ref TaskContext.partitionId / InputFileBlockHolder):
         #: {"partition_id": int, "input_file": str}
         self.meta = meta or {}
+
+    @property
+    def num_rows(self) -> int:
+        nr = self._num_rows
+        if not isinstance(nr, int):
+            nr = int(nr)            # device sync
+            cap = next((c.padded_len for c in self.columns
+                        if isinstance(c, DeviceColumn)), None)
+            if cap is not None and nr > cap:
+                # a speculatively-sized producer (join) guessed too small:
+                # rows beyond the padded capacity were truncated
+                raise SpeculativeOverflow(nr, cap)
+            self._num_rows = nr
+        return nr
+
+    @property
+    def num_rows_raw(self):
+        """num_rows without forcing a device sync: a host int or a device
+        scalar — both valid inputs to a traced kernel argument."""
+        return self._num_rows
 
     # -- structure ---------------------------------------------------------
     def __len__(self):
@@ -102,7 +139,8 @@ class ColumnarBatch:
 
     def with_columns(self, columns: Sequence[ColumnLike], schema: Schema,
                      num_rows: Optional[int] = None) -> "ColumnarBatch":
-        return ColumnarBatch(columns, self.num_rows if num_rows is None else num_rows,
+        return ColumnarBatch(columns,
+                             self._num_rows if num_rows is None else num_rows,
                              schema, meta=self.meta)
 
     # -- conversions -------------------------------------------------------

@@ -119,6 +119,13 @@ JOIN_SUBPARTITION_SIZE = register(
     "(ref GpuSubPartitionHashJoin.scala / GpuShuffledSizedHashJoinExec.scala:1255). "
     "<= 0 disables sub-partitioning.")
 
+JOIN_SPECULATIVE_SIZING = register(
+    "spark.rapids.tpu.sql.join.speculativeSizing", True,
+    "Size join outputs from the input shape bucket instead of syncing the "
+    "exact pair count to the host (each sync is a full tunnel round trip). "
+    "Sinks validate the real totals once per query and transparently "
+    "re-execute with exact sizing if a guess was too small.")
+
 ALLOC_FRACTION = register(
     "spark.rapids.tpu.memory.hbm.allocFraction", 0.85,
     "Fraction of HBM the pool manager budgets for columnar buffers "
@@ -303,6 +310,9 @@ class TpuConf:
     def batch_size_bytes(self) -> int: return self.get(BATCH_SIZE_BYTES)
     @property
     def batch_size_rows(self) -> int: return self.get(BATCH_SIZE_ROWS)
+    @property
+    def join_speculative_sizing(self) -> bool:
+        return bool(self.get(JOIN_SPECULATIVE_SIZING))
     @property
     def join_subpartition_size_bytes(self) -> int:
         return self.get(JOIN_SUBPARTITION_SIZE)

@@ -233,7 +233,7 @@ class TpuHashAggregateExec(TpuExec):
         for c in extra_cols:
             cols.append((c.data, c.validity))
         key_outs, partial_outs, num_groups = kernel(
-            cols, jnp.int32(batch.num_rows), batch.padded_len)
+            cols, jnp.int32(batch.num_rows_raw), batch.padded_len)
         n = int(num_groups)
         # re-bucket: group count is usually orders of magnitude below the
         # input bucket; slicing keeps the merge pass (another sort) tiny
@@ -488,14 +488,14 @@ class TpuHashAggregateExec(TpuExec):
             from ..columnar.segmented import bucket_segments
             fast = self._get_fast_direct_kernel(
                 bucket_segments(int(np.prod(cards + 1))))
-            num_groups, outs = fast(cols, jnp.int32(batch.num_rows),
+            num_groups, outs = fast(cols, jnp.int32(batch.num_rows_raw),
                                     batch.padded_len, jnp.asarray(cards))
         else:
             if self._fast_k is None:
                 self._fast_k = self._get_fast_kernel(update_k,
                                                      self._kernel_key)
-            num_groups, outs = self._fast_k(cols, jnp.int32(batch.num_rows),
-                                            batch.padded_len)
+            num_groups, outs = self._fast_k(
+                cols, jnp.int32(batch.num_rows_raw), batch.padded_len)
         flat = [num_groups] + [x for d, v in outs for x in (d, v)]
         got = jax.device_get(flat)              # the ONE round trip
         n = int(got[0])

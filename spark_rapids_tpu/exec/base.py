@@ -51,6 +51,30 @@ class ExecContext:
         self.memory = memory or MemoryManager.get(self.conf)
         self.metrics: Dict[str, Dict[str, Metric]] = {}
         self._cleanups = []
+        #: speculative output sizing (joins skip the count->host sync and
+        #: guess the bucket); the FINAL sink calls check_speculations() once
+        self.speculate = self.conf.join_speculative_sizing
+        #: [(device total, capacity, join stat key), ...]
+        self.speculations = []
+
+    def check_speculations(self) -> None:
+        """Validate every speculatively-sized output (ONE batched fetch of
+        the tiny totals); raises SpeculativeOverflow if any guess was too
+        small. Only the query's final sink may call this — a mid-plan
+        validation would consume another join's pending record."""
+        if not self.speculations:
+            return
+        import jax
+        from ..columnar.batch import SpeculativeOverflow
+        from .joins import _TOTAL_STATS
+        pending, self.speculations = self.speculations, []
+        totals = jax.device_get([t for t, _, _ in pending])
+        for n, (_, cap, stat_key) in zip(totals, pending):
+            n = int(n)
+            if stat_key is not None:
+                _TOTAL_STATS[stat_key] = n     # keep the statistic fresh
+            if n > cap:
+                raise SpeculativeOverflow(n, cap)
 
     def metric(self, exec_id: str, name: str, level: str = MODERATE) -> Metric:
         m = self.metrics.setdefault(exec_id, {})
@@ -115,13 +139,42 @@ class TpuExec:
             s += c.tree_string(indent + 1)
         return s
 
-    def collect(self, ctx: Optional[ExecContext] = None):
-        """Materialize to a single Arrow table (drives the whole pipeline)."""
+    def collect(self, ctx: Optional[ExecContext] = None,
+                validate: bool = True):
+        """Materialize to a single Arrow table (drives the whole pipeline).
+        ``validate=False`` marks a MID-PLAN materialization (e.g. a join
+        building its broadcast side): it must neither consume the context's
+        pending speculation records nor retry a subtree on its own — an
+        overflow propagates to the final sink, which re-runs the full plan.
+        """
         import pyarrow as pa
+        from ..columnar.batch import SpeculativeOverflow
         ctx = ctx or ExecContext()
+        if not validate:
+            return self._collect_tables(ctx)
+        try:
+            tables = [b.to_arrow() for b in self.execute(ctx)]
+            ctx.check_speculations()
+        except SpeculativeOverflow:
+            # a join's guessed output bucket was too small: re-run the
+            # whole plan with exact (synchronous) output sizing
+            ctx.speculate = False
+            ctx.speculations.clear()
+            ctx.metrics.clear()        # don't double-count the failed run
+            tables = [b.to_arrow() for b in self.execute(ctx)]
+        if not tables:
+            return self._empty_table()
+        return pa.concat_tables(tables)
+
+    def _collect_tables(self, ctx):
+        import pyarrow as pa
         tables = [b.to_arrow() for b in self.execute(ctx)]
         if not tables:
-            from ..types import to_arrow
-            fields = [(f.name, to_arrow(f.dtype)) for f in self.output_schema()]
-            return pa.table({n: pa.array([], type=t) for n, t in fields})
+            return self._empty_table()
         return pa.concat_tables(tables)
+
+    def _empty_table(self):
+        import pyarrow as pa
+        from ..types import to_arrow
+        fields = [(f.name, to_arrow(f.dtype)) for f in self.output_schema()]
+        return pa.table({n: pa.array([], type=t) for n, t in fields})

@@ -218,8 +218,8 @@ class TpuProjectExec(TpuExec):
                     out[i] = hb.columns[0]
                 else:
                     out[i] = HostColumn(arr, dt)
-            rows_m.add(batch.num_rows)
-            yield ColumnarBatch(out, batch.num_rows, self._schema,
+            rows_m.add(batch.num_rows_raw)
+            yield ColumnarBatch(out, batch.num_rows_raw, self._schema,
                                 meta=batch.meta)
 
     def describe(self):
@@ -252,7 +252,7 @@ class CpuProjectExec(TpuExec):
             for e, f in zip(self.exprs, self._schema.fields):
                 arr = e.eval_host(batch)
                 cols.append(HostColumn(arr, f.dtype))
-            yield ColumnarBatch(cols, batch.num_rows, self._schema,
+            yield ColumnarBatch(cols, batch.num_rows_raw, self._schema,
                                 meta=batch.meta)
 
     def describe(self):
@@ -278,7 +278,7 @@ class TpuFilterExec(TpuExec):
                     out = filter_batch_device(self.condition, batch)
                 else:
                     out = self._filter_mixed(batch)
-            rows_m.add(out.num_rows)
+            rows_m.add(out.num_rows_raw)
             yield out
 
     def _filter_mixed(self, batch: ColumnarBatch) -> ColumnarBatch:

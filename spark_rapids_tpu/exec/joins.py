@@ -46,6 +46,8 @@ __all__ = ["TpuHashJoinExec", "TpuNestedLoopJoinExec",
 
 _COUNT_CACHE: Dict[Tuple, object] = {}
 _GATHER_CACHE: Dict[Tuple, object] = {}
+#: last observed output total per join shape (feeds speculative sizing)
+_TOTAL_STATS: Dict[Tuple, int] = {}
 
 
 def _build_count_kernel(lkey_exprs, rkey_exprs, lschema, rschema, join_type):
@@ -338,12 +340,12 @@ class TpuHashJoinExec(TpuExec):
                 rb = concat_batches([s.get() for s in right_batches]) \
                     if right_batches else _empty_batch(rs)
                 lb = self._maybe_bloom_filter(ctx, lb, rb)
-                return self._join(lb, rb)
+                return self._join(lb, rb, ctx)
 
         out = with_retry_no_split(run, ctx.memory)
         for s in right_batches + left_batches:
             s.close()
-        rows_m.add(out.num_rows)
+        rows_m.add(out.num_rows_raw)
         yield out
 
     # -- runtime bloom filter (ref InjectRuntimeFilter + jni BloomFilter):
@@ -489,7 +491,8 @@ class TpuHashJoinExec(TpuExec):
             yield b
 
     # ------------------------------------------------------------------
-    def _join(self, lb: ColumnarBatch, rb: ColumnarBatch) -> ColumnarBatch:
+    def _join(self, lb: ColumnarBatch, rb: ColumnarBatch,
+              ctx: Optional[ExecContext] = None) -> ColumnarBatch:
         if self.join_type == "cross" or not self.left_keys:
             return self._cross(lb, rb)
         if (self.condition is not None and
@@ -516,21 +519,42 @@ class TpuHashJoinExec(TpuExec):
         rcols = [(c.data, c.validity) if isinstance(c, DeviceColumn)
                  else None for c in rb.columns]
         (s_orig, cnt_l, cnt_r, start_l, start_r, pairs, offsets, total,
-         num_groups) = kern(lcols, rcols, jnp.int32(lb.num_rows),
-                            jnp.int32(rb.num_rows), lb.padded_len,
+         num_groups) = kern(lcols, rcols, jnp.int32(lb.num_rows_raw),
+                            jnp.int32(rb.num_rows_raw), lb.padded_len,
                             rb.padded_len)
-        n_out = int(total)
-        out_p = bucket_for(max(n_out, 1))
         semi_like = self.join_type in ("leftsemi", "leftanti")
+        # speculative output sizing: guessing the output bucket from the
+        # input sizes skips the count->host->gather sync (a full tunnel
+        # round trip, ~40-150 ms, PER JOIN). semi/anti have the hard bound
+        # out <= n_l; inner/left/right/full register the device total with
+        # the context, and the sink validates every registered total once
+        # (one batched fetch) — on overflow the plan re-runs with exact
+        # sizing (ColumnarBatch.num_rows also guards any other force site).
+        spec = (ctx is not None and ctx.speculate)
+        stat = _TOTAL_STATS.get(ck)
+        if semi_like:
+            n_out = total
+            out_p = bucket_for(max(lb.padded_len, 1))
+        elif spec and stat is not None:
+            # adaptive guess from this join shape's last observed total
+            # (x1.5 headroom); validated at the sink, exact re-run on
+            # overflow — the AQE-statistics analog of sizing gather maps
+            n_out = total
+            out_p = bucket_for(max(int(stat * 1.5), 1))
+            ctx.speculations.append((total, out_p, ck))
+        else:
+            n_out = int(total)
+            _TOTAL_STATS[ck] = n_out
+            out_p = bucket_for(max(n_out, 1))
         left_nullable = 1 if self.join_type in ("right", "full") else 0
         right_nullable = 1 if self.join_type in ("left", "full") else 0
         cfg = jnp.array([left_nullable, right_nullable,
                          1 if semi_like else 0], dtype=jnp.int32)
         l_row, r_row = _gather_index_kernel(
             s_orig, cnt_l, cnt_r, start_l, start_r, offsets, cfg, out_p)
-        live = np.arange(out_p) < n_out
-        l_row = jnp.where(jnp.asarray(live), l_row, -1)
-        r_row = jnp.where(jnp.asarray(live), r_row, -1)
+        live = jnp.arange(out_p, dtype=jnp.int64) < jnp.asarray(n_out)
+        l_row = jnp.where(live, l_row, -1)
+        r_row = jnp.where(live, r_row, -1)
         lo = gather_batch_device(lb, l_row, n_out, out_p)
         if semi_like:
             return ColumnarBatch(lo.columns, n_out, self._schema)
@@ -674,7 +698,7 @@ class TpuNestedLoopJoinExec(TpuExec):
         out = with_retry_no_split(run, ctx.memory)
         for s in right_batches + left_batches:
             s.close()
-        rows_m.add(out.num_rows)
+        rows_m.add(out.num_rows_raw)
         yield out
 
     def describe(self):
@@ -740,7 +764,7 @@ class TpuBroadcastHashJoinExec(TpuHashJoinExec):
                     return (self._join(sb2, bb) if bi == 1
                             else self._join(bb, sb2))
             out = with_retry_no_split(run, ctx.memory)
-            rows_m.add(out.num_rows)
+            rows_m.add(out.num_rows_raw)
             produced = True
             yield out
         if not produced:
@@ -776,8 +800,8 @@ class CpuJoinExec(TpuExec):
 
     def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
         import pyarrow as pa
-        lt = self.children[0].collect(ctx)
-        rt = self.children[1].collect(ctx)
+        lt = self.children[0].collect(ctx, validate=False)
+        rt = self.children[1].collect(ctx, validate=False)
         if (self.join_type == "existence"
                 or (self.condition is not None
                     and self.join_type not in ("inner", "cross"))

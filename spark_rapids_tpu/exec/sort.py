@@ -324,7 +324,9 @@ class CpuSortExec(TpuExec):
         if not tables:
             return
         t = pa.concat_tables(tables)
-        batch = ColumnarBatch.from_arrow(t, pad=False)
+        # host columns only: from_arrow would put columns back on device,
+        # and each eval_host key fetch would then pay two tunnel syncs
+        batch = ColumnarBatch.from_arrow_host(t)
         # stable lexsort with per-key order/null-placement (Spark semantics:
         # NaN greatest, -0.0 == 0.0, null rank independent per key)
         lex_keys = []

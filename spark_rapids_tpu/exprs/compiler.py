@@ -135,7 +135,7 @@ def filter_batch_by_mask(batch: ColumnarBatch, keep,
             if isinstance(c, HostColumn):
                 new_cols[i] = HostColumn(
                     c.array.slice(0, batch.num_rows).filter(mask), c.dtype)
-    return ColumnarBatch(new_cols, int(count),
+    return ColumnarBatch(new_cols, count,
                          schema if schema is not None else batch.schema,
                          meta=batch.meta)
 
@@ -172,14 +172,15 @@ def gather_batch_device(batch: ColumnarBatch, indices, num_rows: int,
     arrays = [(batch.columns[i].data, batch.columns[i].validity)
               for i in dev_pos]
     outs = _gather_kernel(arrays, indices, out_p)
-    live = np.arange(out_p) < num_rows
+    # num_rows may be a device scalar (speculative sizing) — mask on device
+    live = jnp.arange(out_p, dtype=jnp.int64) < jnp.asarray(num_rows)
     new_cols = list(batch.columns)
     for i, (d, v) in zip(dev_pos, outs):
-        v = jnp.logical_and(v, jnp.asarray(live))
+        v = jnp.logical_and(v, live)
         new_cols[i] = batch.columns[i].with_arrays(d, v)
     if len(dev_pos) < len(new_cols):
         import pyarrow as pa
-        idx = np.asarray(indices)[:num_rows].astype(np.int64)
+        idx = np.asarray(indices)[:int(num_rows)].astype(np.int64)
         null_row = idx < 0
         pa_idx = pa.array(np.where(null_row, 0, idx), mask=null_row)
         for i, c in enumerate(batch.columns):

@@ -41,12 +41,26 @@ class SpillableBatch:
         self._disk_bytes = 0
         self.tier = "device"
         self.spill_priority = spill_priority
-        self.num_rows = batch.num_rows
+        # keep a lazy count: forcing a device-scalar row count here
+        # would cost a tunnel sync on every spillable wrap
+        self._num_rows = batch.num_rows_raw
+        self._cap = next((c.padded_len for c in batch.columns
+                          if hasattr(c, "padded_len")), None)
         self.schema = batch.schema
         self._device_bytes = batch.device_size_bytes()
         self._mm.reserve(self._device_bytes)
         self._handle = self._mm.register_spillable(self)
         self._closed = False
+
+    @property
+    def num_rows(self) -> int:
+        if not isinstance(self._num_rows, int):
+            n = int(self._num_rows)
+            if self._cap is not None and n > self._cap:
+                from ..columnar.batch import SpeculativeOverflow
+                raise SpeculativeOverflow(n, self._cap)
+            self._num_rows = n
+        return self._num_rows
 
     def device_bytes(self) -> int:
         """Device footprint when resident (size estimate for spill/split
