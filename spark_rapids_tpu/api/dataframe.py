@@ -342,9 +342,8 @@ class DataFrame:
         """Materialize once into in-memory parquet-encoded batches
         (ref ParquetCachedBatchSerializer)."""
         from ..exec.cached import CachedRelation, encode_batches
-        physical = self._physical()
-        ctx = self.session.exec_context()
-        blobs = encode_batches(physical.execute(ctx))
+        blobs = self._execute_wrapped(
+            lambda p, ctx: encode_batches(p.execute(ctx)))
         return DataFrame(self.session,
                          CachedRelation(blobs, self.schema))
 
@@ -391,21 +390,39 @@ class DataFrame:
     def _execute_wrapped(self, consume):
         """Run the physical plan through the full execution pipeline
         (explainOnly guard, LORE wrap, profiler, task metrics, fault
-        dumps) — every materializing sink goes through here."""
+        dumps) — every materializing sink goes through here. Speculative
+        join sizing is reset per query, validated after the consume, and
+        transparently retried with exact sizing on overflow; plans with
+        side effects (file writes) run with speculation OFF so a retry
+        can never duplicate output files."""
         physical = self._physical()
         if self.session.conf.is_explain_only:
             raise RuntimeError("session is in explainOnly mode")
         from ..aux.fault import DeviceDumpHandler
         from ..aux.lore import lore_wrap
         from ..aux.metrics import TaskMetrics
+        from ..columnar.batch import SpeculativeOverflow
         physical = lore_wrap(physical, self.session.conf)
         ctx = self.session.exec_context()
+        side_effects = isinstance(self.plan, L.WriteFile)
+        ctx.speculations.clear()
+        ctx.speculate = (ctx.conf.join_speculative_sizing
+                         and not side_effects)
         prof = self.session.profiler
         tm = TaskMetrics(ctx)
         prof.maybe_start()
         try:
-            return DeviceDumpHandler(self.session.conf).wrap(
-                lambda: consume(physical, ctx), physical)
+            try:
+                out = DeviceDumpHandler(self.session.conf).wrap(
+                    lambda: consume(physical, ctx), physical)
+                ctx.check_speculations()
+                return out
+            except SpeculativeOverflow:
+                ctx.speculate = False
+                ctx.speculations.clear()
+                ctx.metrics.clear()
+                return DeviceDumpHandler(self.session.conf).wrap(
+                    lambda: consume(physical, ctx), physical)
         finally:
             prof.maybe_stop()
             self.session.last_query_metrics = tm.finish()

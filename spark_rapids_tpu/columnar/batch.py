@@ -236,19 +236,28 @@ class ColumnarBatch:
         return ColumnarBatch.from_arrow(table, buckets)
 
     def to_arrow(self):
-        import jax
         import pyarrow as pa
-        # ONE device_get for every device column (all copies issued async,
-        # then awaited together — a tunneled TPU pays per-transfer latency)
+        from .packing import fetch_packed
+        # ONE packed transfer for every device column (leaf-by-leaf waits
+        # pay per-transfer latency on a tunneled TPU)
         dev = [(i, c) for i, c in enumerate(self.columns)
                if isinstance(c, DeviceColumn)]
         fetched = {}
         if dev:
-            got = jax.device_get(
-                [x for _, c in dev for x in (c.data, c.validity)])
+            flat = [x for _, c in dev for x in (c.data, c.validity)]
+            lazy = not isinstance(self._num_rows, int)
+            if lazy:
+                flat.append(self._num_rows)   # ride the same transfer
+            got = fetch_packed(flat)
+            if lazy:
+                nr = int(got[-1])
+                cap = dev[0][1].padded_len
+                if nr > cap:
+                    raise SpeculativeOverflow(nr, cap)
+                self._num_rows = nr
+            n = self.num_rows
             for k, (i, c) in enumerate(dev):
-                fetched[i] = (got[2 * k][:self.num_rows],
-                              got[2 * k + 1][:self.num_rows])
+                fetched[i] = (got[2 * k][:n], got[2 * k + 1][:n])
         arrays = []
         for i, c in enumerate(self.columns):
             if i in fetched:

@@ -497,7 +497,8 @@ class TpuHashAggregateExec(TpuExec):
             num_groups, outs = self._fast_k(
                 cols, jnp.int32(batch.num_rows_raw), batch.padded_len)
         flat = [num_groups] + [x for d, v in outs for x in (d, v)]
-        got = jax.device_get(flat)              # the ONE round trip
+        from ..columnar.packing import fetch_packed
+        got = fetch_packed(flat)                # the ONE round trip
         n = int(got[0])
         if n > self.OPTIMISTIC_GROUPS:
             return None
@@ -715,14 +716,15 @@ class CpuAggregateExec(TpuExec):
                                         StddevSamp, Sum, VariancePop,
                                         VarianceSamp)
         tables = [b.to_arrow() for b in self.children[0].execute(ctx)]
-        if tables:
-            df = pa.concat_tables(tables).to_pandas()
-        else:
-            df = _empty_arrow(self.children[0].output_schema()).to_pandas()
+        at = (pa.concat_tables(tables) if tables
+              else _empty_arrow(self.children[0].output_schema()))
+        df = at.to_pandas()
 
-        # evaluate key + input expressions into temp columns
+        # evaluate key + input expressions into temp columns; the source
+        # batch comes straight from ARROW (from_pandas would turn every
+        # NaN into a SQL NULL — Spark distinguishes them: NaN is a value)
         work = pd.DataFrame(index=df.index)
-        src = ColumnarBatch.from_pandas(df) if len(df) else None
+        src = ColumnarBatch.from_arrow_host(at) if len(df) else None
         key_names = []
         for i, g in enumerate(self.groupings):
             col = f"_k{i}"
@@ -733,39 +735,54 @@ class CpuAggregateExec(TpuExec):
             col = f"_a{i}"
             if isinstance(a, CountStar):
                 work[col] = 1
+                work[col + "__ok"] = True
             else:
-                work[col] = _host_series(a.child, df, src)
+                arr = (a.child.eval_host(src) if src is not None else None)
+                if arr is None:
+                    work[col] = pd.Series([], dtype="float64")
+                    work[col + "__ok"] = pd.Series([], dtype="bool")
+                else:
+                    # keep SQL NULL distinct from NaN: pandas conflates
+                    # them, but Spark's sum/avg/max PROPAGATE NaN while
+                    # ignoring NULL (NaN is a value, NaN > everything)
+                    work[col] = arr.to_pandas()
+                    work[col + "__ok"] = ~np.asarray(arr.is_null())
             in_names.append(col)
 
-        def agg_series(a, s: "pd.Series"):
+        def agg_series(a, s: "pd.Series", ok: "pd.Series"):
+            vals = s.to_numpy()[ok.to_numpy().astype(bool)]
             if a.distinct and not isinstance(a, CountStar):
-                s = s.dropna().drop_duplicates()
+                vals = pd.unique(pd.Series(vals))   # NaN == NaN, keep one
             if isinstance(a, CountStar):
                 return len(s)
             if isinstance(a, Count):
-                return s.count()
+                return len(vals)
+            if len(vals) == 0:
+                return None
             if isinstance(a, Sum):
-                return s.sum(min_count=1)
+                return np.sum(vals)                 # NaN propagates
             if isinstance(a, Min):
-                return s.min()
+                with np.errstate(invalid="ignore"):
+                    m = np.nanmin(vals) if _is_float(vals) else np.min(vals)
+                return m                            # all-NaN -> NaN
             if isinstance(a, Max):
-                return s.max()
+                return np.max(vals)                 # NaN is greatest
             if isinstance(a, Average):
-                return s.mean()
+                return np.sum(vals) / len(vals)
             if isinstance(a, First):
-                nn = s.dropna()
-                return nn.iloc[0] if len(nn) else None
+                return vals[0]
             if isinstance(a, Last):
-                nn = s.dropna()
-                return nn.iloc[-1] if len(nn) else None
-            if isinstance(a, StddevSamp):
-                return s.std(ddof=1)
-            if isinstance(a, StddevPop):
-                return s.std(ddof=0)
-            if isinstance(a, VarianceSamp):
-                return s.var(ddof=1)
-            if isinstance(a, VariancePop):
-                return s.var(ddof=0)
+                return vals[-1]
+            n = len(vals)
+            if isinstance(a, (StddevSamp, VarianceSamp)) and n < 2:
+                return None
+            mean = np.sum(vals) / n
+            var = np.sum((vals - mean) ** 2) / \
+                (n - 1 if isinstance(a, (StddevSamp, VarianceSamp)) else n)
+            if isinstance(a, (StddevSamp, StddevPop)):
+                return np.sqrt(var)
+            if isinstance(a, (VarianceSamp, VariancePop)):
+                return var
             raise NotImplementedError(type(a).__name__)
 
         if self.groupings:
@@ -774,18 +791,28 @@ class CpuAggregateExec(TpuExec):
             for key, sub in grouped:
                 if not isinstance(key, tuple):
                     key = (key,)
-                rows.append(list(key) + [agg_series(a, sub[c])
-                                         for a, c in zip(self.aggs, in_names)])
+                rows.append(list(key) +
+                            [agg_series(a, sub[c], sub[c + "__ok"])
+                             for a, c in zip(self.aggs, in_names)])
             out = pd.DataFrame(rows, columns=self._schema.names())
         else:
-            vals = [agg_series(a, work[c])
+            vals = [agg_series(a, work[c], work[c + "__ok"])
                     for a, c in zip(self.aggs, in_names)]
             out = pd.DataFrame([vals], columns=self._schema.names())
         # coerce to declared output types
         from ..types import to_arrow as _toa
+
+        def _cell(x, is_float: bool):
+            if x is None:
+                return None
+            if is_float and isinstance(x, float) and np.isnan(x):
+                return x               # NaN is a VALUE, not SQL NULL
+            return None if pd.isna(x) else x
+
         arrays = []
         for f in self._schema.fields:
-            vals = [None if pd.isna(x) else x for x in out[f.name].tolist()]
+            isf = f.dtype.name in ("float", "double")
+            vals = [_cell(x, isf) for x in out[f.name].tolist()]
             arrays.append(pa.array(vals, type=_toa(f.dtype)))
         table = pa.Table.from_arrays(arrays, names=self._schema.names())
         yield ColumnarBatch.from_arrow(table)
@@ -794,6 +821,11 @@ class CpuAggregateExec(TpuExec):
         g = ", ".join(e.name_hint for e in self.groupings)
         a = ", ".join(x.name_hint for x in self.aggs)
         return f"CpuAggregate[keys=[{g}], aggs=[{a}]]"
+
+
+def _is_float(vals) -> bool:
+    return getattr(vals, "dtype", None) is not None and \
+        vals.dtype.kind == "f"
 
 
 def _host_series(expr: Expression, df, src_batch):

@@ -64,11 +64,11 @@ class ExecContext:
         validation would consume another join's pending record."""
         if not self.speculations:
             return
-        import jax
         from ..columnar.batch import SpeculativeOverflow
+        from ..columnar.packing import fetch_packed
         from .joins import _TOTAL_STATS
         pending, self.speculations = self.speculations, []
-        totals = jax.device_get([t for t, _, _ in pending])
+        totals = fetch_packed([t for t, _, _ in pending])
         for n, (_, cap, stat_key) in zip(totals, pending):
             n = int(n)
             if stat_key is not None:

@@ -532,7 +532,9 @@ class TpuHashJoinExec(TpuExec):
         # sizing (ColumnarBatch.num_rows also guards any other force site).
         spec = (ctx is not None and ctx.speculate)
         stat = _TOTAL_STATS.get(ck)
-        if semi_like:
+        if semi_like and spec:
+            # hard bound: semi/anti emit at most the left input's rows, so
+            # lazy sizing needs no validation at all
             n_out = total
             out_p = bucket_for(max(lb.padded_len, 1))
         elif spec and stat is not None:
@@ -761,8 +763,8 @@ class TpuBroadcastHashJoinExec(TpuHashJoinExec):
                         sb2 = self._apply_bloom(ctx, bloom, sb)
                     else:
                         sb2 = sb
-                    return (self._join(sb2, bb) if bi == 1
-                            else self._join(bb, sb2))
+                    return (self._join(sb2, bb, ctx) if bi == 1
+                            else self._join(bb, sb2, ctx))
             out = with_retry_no_split(run, ctx.memory)
             rows_m.add(out.num_rows_raw)
             produced = True
@@ -772,8 +774,8 @@ class TpuBroadcastHashJoinExec(TpuHashJoinExec):
 
             def run_empty():
                 with ctx.semaphore.held():
-                    return (self._join(empty, bb) if bi == 1
-                            else self._join(bb, empty))
+                    return (self._join(empty, bb, ctx) if bi == 1
+                            else self._join(bb, empty, ctx))
             yield with_retry_no_split(run_empty, ctx.memory)
 
     def describe(self):

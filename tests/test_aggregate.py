@@ -168,3 +168,24 @@ def test_agg_repartition_emits_disjoint_groups():
     t = pa.concat_tables([b.to_arrow() for b in batches])
     ks = t.column("k").to_pandas()
     assert ks.nunique(dropna=False) == len(ks), "duplicate group across parts"
+
+
+def test_nan_is_a_value_not_null():
+    import pyarrow as pa
+    from harness import tpu_session
+    """Spark semantics: sum/avg/max PROPAGATE NaN, min ignores it (NaN is
+    greatest), count counts it — while SQL NULL is skipped by all. Both
+    engines must agree (the host oracle evaluates from Arrow, where null
+    and NaN stay distinct)."""
+    import math
+    t = pa.table({"k": ["a", "a", "a", "b"],
+                  "v": [1.0, float("nan"), None, 2.0]})
+    for enabled in (True, False):
+        s = tpu_session({"spark.rapids.tpu.sql.enabled": enabled})
+        s.create_dataframe(t).create_or_replace_temp_view("t")
+        got = s.sql("""SELECT k, sum(v) s, min(v) mn, max(v) mx, count(v) c
+                       FROM t GROUP BY k ORDER BY k""").collect()
+        a = got[0]
+        assert math.isnan(a["s"]) and math.isnan(a["mx"]), (enabled, a)
+        assert a["mn"] == 1.0 and a["c"] == 2, (enabled, a)
+        assert got[1] == {"k": "b", "s": 2.0, "mn": 2.0, "mx": 2.0, "c": 1}
