@@ -149,6 +149,9 @@ class TpuSession:
     def create_temp_view(self, name: str, df: "DataFrame") -> None:
         self._views[name.lower()] = df
 
+    def drop_temp_view(self, name: str) -> None:
+        self._views.pop(name.lower(), None)
+
     def register_delta_table(self, name: str, path: str) -> None:
         """Expose a Delta table to SQL, both as a readable view (always
         reading the CURRENT version) and as the target of UPDATE / DELETE
@@ -161,6 +164,18 @@ class TpuSession:
         from ..io.text import csv_to_tables
         tables, sch = csv_to_tables(apply_path_rules(self.conf, paths),
                                     schema, header)
+        return DataFrame(self, L.LogicalScan(tables, sch))
+
+    def read_hive_text(self, *paths: str, schema,
+                       field_delim: str = "\x01",
+                       null_value: str = "\\N") -> "DataFrame":
+        """Hive text tables (LazySimpleSerDe ^A-delimited, \\N nulls —
+        ref GpuHiveTextFileFormat / hive text scans)."""
+        from ..io.file_scan import apply_path_rules
+        from ..io.text import hive_text_to_tables
+        tables, sch = hive_text_to_tables(
+            apply_path_rules(self.conf, paths), schema,
+            field_delim=field_delim, null_value=null_value)
         return DataFrame(self, L.LogicalScan(tables, sch))
 
     def read_json(self, *paths: str, schema=None) -> "DataFrame":
@@ -495,6 +510,13 @@ class DataFrame:
                   partition_by: Sequence[str] = ()):
         df = DataFrame(self.session,
                        L.WriteFile(path, "csv", self.plan, mode,
+                                   partition_by))
+        return df.collect_arrow()
+
+    def write_hive_text(self, path: str, mode: str = "overwrite",
+                        partition_by: Sequence[str] = ()):
+        df = DataFrame(self.session,
+                       L.WriteFile(path, "hive_text", self.plan, mode,
                                    partition_by))
         return df.collect_arrow()
 

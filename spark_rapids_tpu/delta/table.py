@@ -57,14 +57,30 @@ def write_delta(session, plan_df, path: str, mode: str = "overwrite",
     GpuStatisticsCollection)."""
     if partition_by:
         raise NotImplementedError("partitioned delta writes not yet supported")
+    from .constraints import check_invariants, fill_identity, identity_specs
     log = DeltaLog(path)
     version = log.version()
     data = plan_df.collect_arrow()
     os.makedirs(path, exist_ok=True)
     actions: List[dict] = []
+    meta = None
+    old_meta = log.snapshot().metadata if version >= 0 else None
     if version < 0 or mode == "overwrite":
-        meta = Metadata(schema=plan_df.schema)
-        actions.append(meta.to_action())
+        old_cfg = dict(old_meta.configuration) if old_meta else {}
+        # reconcile config against the new schema: identity specs for
+        # dropped columns would otherwise re-append phantom columns
+        from .constraints import IDENTITY_PREFIX
+        new_names = set(plan_df.schema.names())
+        old_cfg = {k: v for k, v in old_cfg.items()
+                   if not (k.startswith(IDENTITY_PREFIX)
+                           and k[len(IDENTITY_PREFIX):] not in new_names)}
+        meta = Metadata(schema=plan_df.schema, configuration=old_cfg,
+                        **({"table_id": old_meta.table_id,
+                            "name": old_meta.name,
+                            "partition_columns":
+                                old_meta.partition_columns}
+                           if old_meta else {}))
+        schema, cfg = plan_df.schema, old_cfg
         if version >= 0 and mode == "overwrite":
             snap = log.snapshot()
             actions += [RemoveFile(p, _now_ms()).to_action()
@@ -72,19 +88,89 @@ def write_delta(session, plan_df, path: str, mode: str = "overwrite",
     elif mode == "append":
         # schema enforcement (delta writes validate against the committed
         # metadata — a mismatched append would corrupt every later scan)
-        existing = log.snapshot().schema
+        snap = log.snapshot()
+        existing, cfg = snap.schema, snap.metadata.configuration
         new = plan_df.schema
+        idents = set(identity_specs(cfg))
         got = [(f.name, f.dtype.name) for f in new.fields]
-        want = [(f.name, f.dtype.name) for f in existing.fields]
+        want = [(f.name, f.dtype.name) for f in existing.fields
+                if f.name not in idents or f.name in new.names()]
         if got != want:
             raise ValueError(
                 f"delta append schema mismatch: table has {want}, "
                 f"dataframe has {got}")
+        schema = existing
     else:
         raise ValueError(f"unsupported delta write mode {mode}")
-    add = _write_data_file(path, data)
-    actions.append(add.to_action())
+    data, new_cfg = fill_identity(data, schema, cfg)
+    if new_cfg is not None:
+        keep = meta if meta is not None else old_meta
+        meta = Metadata(schema=schema, configuration=new_cfg,
+                        table_id=keep.table_id, name=keep.name,
+                        partition_columns=keep.partition_columns)
+    if meta is not None:
+        actions.insert(0, meta.to_action())
+    check_invariants(session, schema, cfg, data)
+    # optimize write (ref GpuOptimizeWriteExchangeExec): bin the output
+    # into target-sized files instead of one arbitrary file per batch
+    target = _optimize_write_target(session, cfg)
+    if target and data.num_rows > target:
+        off = 0
+        while off < data.num_rows:
+            chunk = data.slice(off, target)
+            actions.append(_write_data_file(path, chunk).to_action())
+            off += target
+    else:
+        actions.append(_write_data_file(path, data).to_action())
     log.commit(version + 1, actions, op="WRITE")
+    _maybe_auto_compact(session, path, cfg)
+
+
+def _optimize_write_target(session, cfg: Dict[str, str]) -> int:
+    if cfg.get("delta.autoOptimize.optimizeWrite", "").lower() != "true":
+        return 0
+    return int(session.conf.raw.get(
+        "spark.rapids.tpu.delta.optimizeWrite.targetRows", 1 << 20))
+
+
+def _maybe_auto_compact(session, path: str, cfg: Dict[str, str]) -> None:
+    """Post-commit auto-compaction (ref delta autoCompact / the reference's
+    auto-compaction support in GpuOptimisticTransaction): when enough small
+    files accumulate, fold them into target-sized ones."""
+    if cfg.get("delta.autoOptimize.autoCompact", "").lower() != "true":
+        return
+    import pyarrow as pa
+    min_files = int(session.conf.raw.get(
+        "spark.rapids.tpu.delta.autoCompact.minNumFiles", 8))
+    target = int(session.conf.raw.get(
+        "spark.rapids.tpu.delta.optimizeWrite.targetRows", 1 << 20))
+    dt = DeltaTable(session, path)
+    snap = dt.log.snapshot()
+    small = [a for a in snap.files.values()
+             if _file_rows(a) is not None and _file_rows(a) < target]
+    if len(small) < min_files:
+        return
+    # fold ONLY the small files into target-sized ones (dataChange=false:
+    # compaction moves rows, it does not change them)
+    merged = pa.concat_tables([dt._load_file(a) for a in small])
+    actions = [RemoveFile(a.path, _now_ms(), data_change=False).to_action()
+               for a in small]
+    off = 0
+    while off < merged.num_rows:
+        add = _write_data_file(path, merged.slice(off, target))
+        add.data_change = False
+        actions.append(add.to_action())
+        off += target
+    dt.log.commit(snap.version + 1, actions, op="auto-OPTIMIZE")
+
+
+def _file_rows(add: AddFile):
+    if not add.stats:
+        return None
+    try:
+        return int(json.loads(add.stats).get("numRecords"))
+    except (ValueError, TypeError):
+        return None
 
 
 class DeltaTable:
@@ -186,6 +272,9 @@ class DeltaTable:
                 else:
                     cols[f.name] = t.column(f.name)
             out = pa.table(cols)
+            from .constraints import check_invariants
+            check_invariants(self.session, schema,
+                             snap.metadata.configuration, out)
             actions.append(RemoveFile(add.path, _now_ms()).to_action())
             actions.append(_write_data_file(self.path, out).to_action())
         if actions:
@@ -197,6 +286,87 @@ class DeltaTable:
         return MergeBuilder(self, source, condition)
 
     # ----------------------------------------------------------- OPTIMIZE
+    # -- table evolution (constraints / identity / properties) -----------
+    def _commit_metadata(self, schema, cfg, op: str) -> None:
+        snap = self.log.snapshot()
+        old = snap.metadata
+        meta = Metadata(schema=schema,
+                        partition_columns=old.partition_columns,
+                        table_id=old.table_id, name=old.name,
+                        configuration=cfg)
+        self.log.commit(snap.version + 1, [meta.to_action()], op=op)
+
+    def add_check_constraint(self, name: str, expr: str) -> None:
+        """ALTER TABLE ADD CONSTRAINT name CHECK (expr): existing rows are
+        validated first (Spark/Delta semantics), then the constraint is
+        committed and every future write enforces it
+        (ref GpuCheckDeltaInvariant)."""
+        from .constraints import CONSTRAINT_PREFIX, check_invariants
+        snap = self.log.snapshot()
+        cfg = dict(snap.metadata.configuration)
+        cfg[CONSTRAINT_PREFIX + name] = expr
+        check_invariants(self.session, snap.schema, cfg, self.to_df()
+                         .collect_arrow())
+        self._commit_metadata(snap.schema, cfg, "ADD CONSTRAINT")
+
+    def drop_check_constraint(self, name: str) -> None:
+        from .constraints import CONSTRAINT_PREFIX
+        snap = self.log.snapshot()
+        cfg = dict(snap.metadata.configuration)
+        cfg.pop(CONSTRAINT_PREFIX + name, None)
+        self._commit_metadata(snap.schema, cfg, "DROP CONSTRAINT")
+
+    def set_nullable(self, column: str, nullable: bool) -> None:
+        """ALTER COLUMN SET/DROP NOT NULL; tightening validates existing
+        rows first."""
+        from ..types import StructField
+        from .constraints import InvariantViolation
+        snap = self.log.snapshot()
+        fields = []
+        for f in snap.schema.fields:
+            if f.name == column:
+                if not nullable:
+                    at = self.to_df().collect_arrow()
+                    nulls = at.column(column).null_count
+                    if nulls:
+                        raise InvariantViolation(
+                            f"cannot SET NOT NULL on {column!r}: "
+                            f"{nulls} existing null value(s)")
+                f = StructField(f.name, f.dtype, nullable)
+            fields.append(f)
+        self._commit_metadata(Schema(fields),
+                              snap.metadata.configuration,
+                              "CHANGE COLUMN")
+
+    def add_identity_column(self, column: str, start: int = 1,
+                            step: int = 1) -> None:
+        """Declare an existing INT64 column GENERATED BY DEFAULT AS
+        IDENTITY (ref GpuIdentityColumn): appends that omit the column (or
+        leave it null) get values from the tracked high-water mark."""
+        import json as _json
+        from .constraints import IDENTITY_PREFIX
+        if step == 0:
+            raise ValueError("identity step must be non-zero")
+        snap = self.log.snapshot()
+        if column not in snap.schema.names():
+            raise ValueError(f"no such column {column!r}")
+        if snap.schema[column].dtype.name != "bigint":
+            raise ValueError(
+                f"identity column {column!r} must be BIGINT, is "
+                f"{snap.schema[column].dtype.name} (Spark identity "
+                "columns are always bigint)")
+        cfg = dict(snap.metadata.configuration)
+        cfg[IDENTITY_PREFIX + column] = _json.dumps(
+            {"start": start, "step": step, "highWaterMark": None})
+        self._commit_metadata(snap.schema, cfg, "CHANGE COLUMN")
+
+    def set_properties(self, props: Dict[str, str]) -> None:
+        """ALTER TABLE SET TBLPROPERTIES (e.g. delta.autoOptimize.*)."""
+        snap = self.log.snapshot()
+        cfg = dict(snap.metadata.configuration)
+        cfg.update({k: str(v) for k, v in props.items()})
+        self._commit_metadata(snap.schema, cfg, "SET TBLPROPERTIES")
+
     def optimize(self, target_file_rows: int = 1 << 20,
                  zorder_by: Optional[List[str]] = None) -> Dict[str, int]:
         """Compaction / Z-order rewrite (ref delta OPTIMIZE + ZOrderRules:
@@ -393,7 +563,11 @@ class MergeBuilder:
                 out_cols[f.name] = col
             if self._matched_update:
                 stats["num_updated"] += len(tm)
-            actions.append(_write_data_file(t.path, pa.table(out_cols))
+            new_content = pa.table(out_cols)
+            from .constraints import check_invariants
+            check_invariants(t.session, schema,
+                             snap.metadata.configuration, new_content)
+            actions.append(_write_data_file(t.path, new_content)
                            .to_action())
         # not-matched inserts
         if self._insert_values is not None:
@@ -412,6 +586,9 @@ class MergeBuilder:
                         cols[f.name] = pa.nulls(unmatched.num_rows,
                                                 to_arrow(f.dtype))
                 ins = pa.table(cols)
+                from .constraints import check_invariants
+                check_invariants(t.session, schema,
+                                 snap.metadata.configuration, ins)
                 actions.append(_write_data_file(t.path, ins).to_action())
                 stats["num_inserted"] = ins.num_rows
         if actions:

@@ -7,7 +7,12 @@ from typing import List, Optional, Sequence, Tuple
 
 from ..types import Schema, StructField, from_arrow, to_arrow
 
-__all__ = ["csv_to_tables", "json_to_tables"]
+__all__ = ["csv_to_tables", "json_to_tables", "hive_text_to_tables",
+           "write_hive_text"]
+
+#: Hive LazySimpleSerDe defaults: ^A field delimiter, \N for NULL
+HIVE_FIELD_DELIM = "\x01"
+HIVE_NULL = "\\N"
 
 
 def _schema_to_arrow(schema) -> "object":
@@ -31,6 +36,110 @@ def csv_to_tables(paths: Sequence[str], schema: Optional[Schema],
     sch = schema or Schema([StructField(f.name, from_arrow(f.type), True)
                             for f in tables[0].schema])
     return tables, sch
+
+
+def hive_text_to_tables(paths: Sequence[str], schema: Schema,
+                        field_delim: str = HIVE_FIELD_DELIM,
+                        null_value: str = HIVE_NULL) -> Tuple[List, Schema]:
+    """Hive text tables (LazySimpleSerDe: ^A-delimited fields, \\N nulls,
+    backslash escaping, no header — ref GpuHiveFileFormat /
+    GpuHiveTextFileFormat and the hive text path of
+    GpuTextBasedPartitionReader). A schema is required: hive text carries
+    no self-description. The parser is escape-aware (a backslash escapes
+    the delimiter, newline as ``\\n``/``\\r``, the backslash itself, and
+    distinguishes a literal backslash-N from the NULL marker), which
+    pyarrow's CSV reader cannot express — correctness over raw speed."""
+    import pyarrow as pa
+    if schema is None:
+        raise ValueError("hive text requires an explicit schema")
+    names = schema.names()
+    atypes = [to_arrow(t) for t in schema.types()]
+    tables = []
+    for p in paths:
+        with open(p, encoding="utf-8", newline="") as f:
+            text = f.read()
+        rows = _hive_parse(text, field_delim, null_value, len(names))
+        cols = []
+        for i, (nm, at) in enumerate(zip(names, atypes)):
+            raw = [r[i] if i < len(r) else None for r in rows]
+            cols.append(_hive_convert(raw, at))
+        tables.append(pa.Table.from_arrays(cols, names=names))
+    return tables, schema
+
+
+def _hive_parse(text: str, delim: str, null_value: str, ncols: int):
+    """Escape-aware split into rows of (str | None) cells. ``\\N`` filling
+    an entire cell is the NULL marker; a literal backslash-N is written
+    (and read back) as ``\\\\N``."""
+    rows, row, cell = [], [], []
+    is_null = False
+    i, n = 0, len(text)
+    while i < n:
+        ch = text[i]
+        if ch == "\\" and i + 1 < n:
+            nxt = text[i + 1]
+            if (nxt == "N" and not cell
+                    and (i + 2 >= n or text[i + 2] in (delim, "\n"))):
+                is_null = True
+            else:
+                cell.append({"n": "\n", "r": "\r", "t": "\t"}.get(nxt, nxt))
+            i += 2
+            continue
+        if ch == delim:
+            row.append(None if is_null else "".join(cell))
+            cell, is_null = [], False
+            i += 1
+            continue
+        if ch == "\n":
+            row.append(None if is_null else "".join(cell))
+            rows.append(row)
+            row, cell, is_null = [], [], False
+            i += 1
+            continue
+        cell.append(ch)
+        i += 1
+    if cell or row or is_null:
+        row.append(None if is_null else "".join(cell))
+        rows.append(row)
+    return rows
+
+
+def _hive_convert(raw, at):
+    import pyarrow as pa
+    if pa.types.is_string(at):
+        return pa.array(raw, type=at)
+    if pa.types.is_boolean(at):
+        return pa.array([None if v is None else v.lower() == "true"
+                         for v in raw], type=at)
+    if pa.types.is_integer(at):
+        return pa.array([None if v in (None, "") else int(v)
+                         for v in raw], type=at)
+    if pa.types.is_floating(at):
+        return pa.array([None if v in (None, "") else float(v)
+                         for v in raw], type=at)
+    return pa.array(raw).cast(at)
+
+
+def write_hive_text(table, path: str, field_delim: str = HIVE_FIELD_DELIM,
+                    null_value: str = HIVE_NULL) -> None:
+    """Arrow table -> one Hive text file; backslash-escapes the delimiter,
+    newlines, tabs, and backslashes inside values (LazySimpleSerDe
+    escaping) so every value round-trips."""
+    cols = [table.column(i).to_pylist() for i in range(table.num_columns)]
+    with open(path, "w", encoding="utf-8", newline="") as f:
+        for row in zip(*cols) if cols else []:
+            f.write(field_delim.join(
+                null_value if v is None else _hive_cell(v, field_delim)
+                for v in row) + "\n")
+
+
+def _hive_cell(v, delim: str) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    s = str(v)
+    s = (s.replace("\\", "\\\\").replace(delim, "\\" + delim)
+          .replace("\n", "\\n").replace("\r", "\\r").replace("\t", "\\t"))
+    return s
 
 
 def json_to_tables(paths: Sequence[str],

@@ -89,6 +89,9 @@ class FileWriteExec(TpuExec):
         elif self.file_format == "orc":
             import pyarrow.orc as porc
             porc.write_table(table, base + ".orc")
+        elif self.file_format == "hive_text":
+            from .text import write_hive_text
+            write_hive_text(table, base + ".txt")
         else:
             raise ValueError(f"unsupported format {self.file_format}")
 

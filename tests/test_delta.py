@@ -391,3 +391,82 @@ def test_sql_merge_clause_validation(tmp_path):
     with pytest.raises(SqlError, match="1 values"):
         s.sql("""MERGE INTO t USING src ON k = sk
                  WHEN NOT MATCHED THEN INSERT (k, k) VALUES (sk)""")
+
+
+def test_delta_check_constraints_and_not_null(tmp_path):
+    """ref GpuCheckDeltaInvariant: writes validate NOT NULL + CHECK."""
+    import pytest
+    from spark_rapids_tpu.delta.constraints import InvariantViolation
+    s = tpu_session()
+    p = str(tmp_path / "t")
+    s.create_dataframe(pa.table({"k": [1, 2], "v": [10.0, 20.0]})) \
+        .write_delta(p)
+    dt = s.delta_table(p)
+    dt.add_check_constraint("v_pos", "v > 0")
+    # violating append rejected
+    with pytest.raises(InvariantViolation, match="v_pos"):
+        s.create_dataframe(pa.table({"k": [3], "v": [-1.0]})) \
+            .write_delta(p, mode="append")
+    # satisfying append (and NULL satisfies CHECK)
+    s.create_dataframe(pa.table({"k": pa.array([3], pa.int64()),
+                                 "v": pa.array([None], pa.float64())})) \
+        .write_delta(p, mode="append")
+    assert dt.to_df().count() == 3
+    # adding a constraint that existing rows violate is rejected
+    with pytest.raises(InvariantViolation, match="k_small"):
+        dt.add_check_constraint("k_small", "k < 2")
+    dt.drop_check_constraint("v_pos")
+    s.create_dataframe(pa.table({"k": pa.array([4], pa.int64()),
+                                 "v": pa.array([-5.0])})) \
+        .write_delta(p, mode="append")
+    # NOT NULL tightening rejected while nulls exist
+    with pytest.raises(InvariantViolation, match="existing null"):
+        dt.set_nullable("v", False)
+    # and enforced once set on a clean column
+    dt.set_nullable("k", False)
+    with pytest.raises(InvariantViolation, match="NOT NULL"):
+        s.create_dataframe(pa.table({"k": pa.array([None], pa.int64()),
+                                     "v": pa.array([1.0])})) \
+            .write_delta(p, mode="append")
+
+
+def test_delta_identity_columns(tmp_path):
+    """ref GpuIdentityColumn: high-water-mark tracked generation."""
+    s = tpu_session()
+    p = str(tmp_path / "t")
+    s.create_dataframe(pa.table({"id": pa.array([], pa.int64()),
+                                 "v": pa.array([], pa.float64())})) \
+        .write_delta(p)
+    dt = s.delta_table(p)
+    dt.add_identity_column("id", start=100, step=10)
+    # append WITHOUT the identity column: values generated
+    s.create_dataframe(pa.table({"v": [1.0, 2.0, 3.0]})) \
+        .write_delta(p, mode="append")
+    got = {r["v"]: r["id"] for r in dt.to_df().collect()}
+    assert sorted(got.values()) == [100, 110, 120]
+    # next append continues past the high-water mark
+    s.create_dataframe(pa.table({"v": [4.0]})).write_delta(p, mode="append")
+    ids = sorted(r["id"] for r in dt.to_df().collect())
+    assert ids == [100, 110, 120, 130]
+
+
+def test_delta_optimize_write_and_auto_compact(tmp_path):
+    """ref GpuOptimizeWriteExchangeExec + auto-compaction."""
+    s = tpu_session({"spark.rapids.tpu.delta.optimizeWrite.targetRows": 100,
+                     "spark.rapids.tpu.delta.autoCompact.minNumFiles": 2})
+    p = str(tmp_path / "t")
+    s.create_dataframe(pa.table({"k": list(range(250))})).write_delta(p)
+    dt = s.delta_table(p)
+    dt.set_properties({"delta.autoOptimize.optimizeWrite": "true"})
+    # optimize-write splits a 250-row append into 100-row target files
+    s.create_dataframe(pa.table({"k": list(range(250))})) \
+        .write_delta(p, mode="append")
+    files = dt.log.snapshot().files
+    assert len(files) >= 4  # 1 initial + 3 split
+    # enable auto-compact: enough small files -> post-commit compaction
+    dt.set_properties({"delta.autoOptimize.autoCompact": "true"})
+    s.create_dataframe(pa.table({"k": [999]})).write_delta(p, mode="append")
+    after = dt.log.snapshot().files
+    # the 50-row remainder and the 1-row append folded into one file
+    assert len(after) == len(files)
+    assert dt.to_df().count() == 501
