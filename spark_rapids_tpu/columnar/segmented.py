@@ -73,6 +73,45 @@ def prefix_sum(x, dtype=None):
     return jax.lax.fori_loop(0, _steps(n), body, v)
 
 
+def last_valid_scan(values, present):
+    """Per row: the ``values`` entry at the most recent row (itself
+    included) where ``present`` is True; rows before any present row keep
+    their own value with present=False propagated. The vector-native way
+    to broadcast a per-segment value (e.g. at segment starts) to every row
+    without the group-table gather (~15-45 ms per 1M rows on TPU)."""
+    n = values.shape[0]
+
+    def body(i, vp):
+        v, p = vp
+        d = jax.lax.shift_left(jnp.int32(1), i.astype(jnp.int32))
+        pv = _shifted(v, jnp.zeros((), v.dtype), d)
+        pp = _shifted(p, jnp.array(False), d)
+        return (jnp.where(p, v, pv), jnp.logical_or(p, pp))
+
+    v, p = jax.lax.fori_loop(0, _steps(n), body, (values, present))
+    return v, p
+
+
+def reverse_last_valid_scan(values, present):
+    """last_valid_scan scanning right-to-left (broadcast from segment
+    ENDS backward)."""
+    v, p = last_valid_scan(jnp.flip(values), jnp.flip(present))
+    return jnp.flip(v), jnp.flip(p)
+
+
+def shift_static(arr, d: int, fill):
+    """arr shifted by a STATIC distance (positive = right), fill-padded —
+    a concatenate, not a gather."""
+    if d == 0:
+        return arr
+    n = arr.shape[0]
+    k = min(abs(d), n)
+    pad = jnp.full((k,), fill, dtype=arr.dtype)
+    if d > 0:
+        return jnp.concatenate([pad, arr[:n - k]])
+    return jnp.concatenate([arr[k:], pad])
+
+
 def _dense_mask(gid, num_segments: int):
     """[G, n] one-hot mask; stays fused into the consuming reduction."""
     iota = jax.lax.broadcasted_iota(jnp.int32, (num_segments, gid.shape[0]),

@@ -64,7 +64,8 @@ def write_delta(session, plan_df, path: str, mode: str = "overwrite",
     os.makedirs(path, exist_ok=True)
     actions: List[dict] = []
     meta = None
-    old_meta = log.snapshot().metadata if version >= 0 else None
+    snap0 = log.snapshot() if version >= 0 else None
+    old_meta = snap0.metadata if snap0 is not None else None
     if version < 0 or mode == "overwrite":
         old_cfg = dict(old_meta.configuration) if old_meta else {}
         # reconcile config against the new schema: identity specs for
@@ -81,15 +82,13 @@ def write_delta(session, plan_df, path: str, mode: str = "overwrite",
                                 old_meta.partition_columns}
                            if old_meta else {}))
         schema, cfg = plan_df.schema, old_cfg
-        if version >= 0 and mode == "overwrite":
-            snap = log.snapshot()
+        if snap0 is not None and mode == "overwrite":
             actions += [RemoveFile(p, _now_ms()).to_action()
-                        for p in snap.files]
+                        for p in snap0.files]
     elif mode == "append":
         # schema enforcement (delta writes validate against the committed
         # metadata — a mismatched append would corrupt every later scan)
-        snap = log.snapshot()
-        existing, cfg = snap.schema, snap.metadata.configuration
+        existing, cfg = snap0.schema, snap0.metadata.configuration
         new = plan_df.schema
         idents = set(identity_specs(cfg))
         got = [(f.name, f.dtype.name) for f in new.fields]
@@ -586,7 +585,16 @@ class MergeBuilder:
                         cols[f.name] = pa.nulls(unmatched.num_rows,
                                                 to_arrow(f.dtype))
                 ins = pa.table(cols)
-                from .constraints import check_invariants
+                from .constraints import check_invariants, fill_identity
+                ins, new_cfg = fill_identity(
+                    ins, schema, snap.metadata.configuration)
+                if new_cfg is not None:
+                    old = snap.metadata
+                    actions.append(Metadata(
+                        schema=schema, configuration=new_cfg,
+                        table_id=old.table_id, name=old.name,
+                        partition_columns=old.partition_columns)
+                        .to_action())
                 check_invariants(t.session, schema,
                                  snap.metadata.configuration, ins)
                 actions.append(_write_data_file(t.path, ins).to_action())

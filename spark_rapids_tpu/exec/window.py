@@ -23,7 +23,9 @@ from typing import Dict, Iterator, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from ..columnar.segmented import prefix_sum
+from ..columnar.segmented import (SortedSegments, last_valid_scan,
+                                  prefix_sum, reverse_last_valid_scan,
+                                  shift_static)
 import numpy as np
 
 from ..columnar import ColumnarBatch, DeviceColumn, concat_batches
@@ -43,8 +45,15 @@ __all__ = ["TpuWindowExec", "CpuWindowExec"]
 _WIN_CACHE: Dict[Tuple, object] = {}
 
 
-def _seg_broadcast(per_group, gid):
-    return jnp.take(per_group, jnp.clip(gid, 0, per_group.shape[0] - 1))
+def _start_broadcast(values, pflags):
+    """values at partition-start rows propagated forward to every row of
+    the partition (scan, not a group-table gather)."""
+    return last_valid_scan(values, pflags)[0]
+
+
+def _end_broadcast(values, end_mask):
+    """values at partition-end rows propagated backward."""
+    return reverse_last_valid_scan(values, end_mask)[0]
 
 
 def _build_window_kernel(window_exprs, schema: Schema, padded_len_key=None):
@@ -70,9 +79,20 @@ def _build_window_kernel(window_exprs, schema: Schema, padded_len_key=None):
                 operands.extend(order_key_operands(
                     o.expr.eval_device(ctx), o.ascending, o.nulls_first))
             perm0 = jnp.arange(P, dtype=jnp.int32)
-            srt = jax.lax.sort(tuple(operands + [perm0]),
+            # carry the aggregated/lagged value through the sort network
+            # instead of gathering it by perm afterwards (row gathers
+            # serialize on the TPU scalar core)
+            payload = [perm0]
+            child = getattr(fn, "child", None)
+            if child is not None:
+                cv = child.eval_device(ctx)
+                payload.extend((cv.data, cv.validity))
+            srt = jax.lax.sort(tuple(operands + payload),
                                num_keys=len(operands), is_stable=True)
             perm = srt[len(operands)]
+            sorted_child = (DVal(srt[len(operands) + 1],
+                                 srt[len(operands) + 2], cv.dtype)
+                            if child is not None else None)
             s_ops = srt[:len(operands)]
             idx = jnp.arange(P, dtype=jnp.int32)
             # partition boundaries
@@ -84,8 +104,15 @@ def _build_window_kernel(window_exprs, schema: Schema, padded_len_key=None):
             pflags = jnp.logical_and(jnp.logical_or(idx == 0, pdiff), row_mask)
             gid = jnp.where(row_mask,
                             prefix_sum(pflags, jnp.int32) - 1, P)
-            part_start = jax.lax.associative_scan(
-                jnp.maximum, jnp.where(pflags, idx, 0))
+            part_start = _start_broadcast(idx, pflags)
+            nlive = jnp.sum(row_mask.astype(jnp.int32))
+            end_mask = jnp.logical_and(
+                row_mask,
+                jnp.logical_or(
+                    jnp.concatenate([pflags[1:],
+                                     jnp.ones((1,), jnp.bool_)]),
+                    idx + 1 >= nlive))
+            pend = _end_broadcast(idx, end_mask)
             # order-value run boundaries (for rank/dense_rank)
             odiff = pdiff
             for op in s_ops[n_part_ops:]:
@@ -99,21 +126,16 @@ def _build_window_kernel(window_exprs, schema: Schema, padded_len_key=None):
                 out_sorted = (idx - part_start + 1).astype(jnp.int32)
                 ov_sorted = row_mask
             elif isinstance(fn, Rank):
-                run_start = jax.lax.associative_scan(
-                    jnp.maximum, jnp.where(oflags, idx, 0))
+                run_start = _start_broadcast(idx, oflags)
                 out_sorted = (run_start - part_start + 1).astype(jnp.int32)
                 ov_sorted = row_mask
             elif isinstance(fn, DenseRank):
                 c = prefix_sum(oflags, jnp.int32)
-                c_at_pstart = _seg_broadcast(
-                    jnp.zeros(P, jnp.int32).at[
-                        jnp.where(pflags, gid, P)].set(c, mode="drop"), gid)
+                c_at_pstart = _start_broadcast(c, pflags)
                 out_sorted = (c - c_at_pstart + 1).astype(jnp.int32)
                 ov_sorted = row_mask
             elif isinstance(fn, NTile):
-                pcount = jax.ops.segment_sum(
-                    row_mask.astype(jnp.int32), gid, num_segments=P)
-                cnt = _seg_broadcast(pcount, gid)
+                cnt = (pend - part_start + 1).astype(jnp.int32)
                 rn = idx - part_start
                 n = jnp.int32(fn.n)
                 base = cnt // n
@@ -127,17 +149,18 @@ def _build_window_kernel(window_exprs, schema: Schema, padded_len_key=None):
                 ).astype(jnp.int32) + 1
                 ov_sorted = row_mask
             elif isinstance(fn, (Lag, Lead)):
-                v = fn.child.eval_device(ctx)
-                sd = jnp.take(v.data, perm)
-                sv = jnp.take(v.validity, perm)
+                sd = sorted_child.data
+                sv = sorted_child.validity
                 off = fn.offset if isinstance(fn, Lag) else -fn.offset
-                shifted_idx = idx - off
-                ok = jnp.logical_and(shifted_idx >= 0, shifted_idx < P)
-                src = jnp.clip(shifted_idx, 0, P - 1)
-                out_sorted = jnp.take(sd, src)
-                ov_sorted = jnp.logical_and(jnp.take(sv, src), ok)
+                # STATIC shift (a concatenate), not a row gather
+                ok = jnp.logical_and(idx - off >= 0, idx - off < P)
+                out_sorted = shift_static(sd, off,
+                                          jnp.zeros((), sd.dtype))
+                ov_sorted = jnp.logical_and(
+                    shift_static(sv, off, jnp.array(False)), ok)
                 # must stay inside the partition
-                same_part = jnp.take(gid, src) == gid
+                same_part = shift_static(
+                    gid, off, jnp.full((), P, gid.dtype)) == gid
                 ov_sorted = jnp.logical_and(ov_sorted, same_part)
                 if fn.default is not None:
                     dflt = jnp.asarray(fn.default, dtype=out_sorted.dtype)
@@ -147,36 +170,38 @@ def _build_window_kernel(window_exprs, schema: Schema, padded_len_key=None):
                     ov_sorted = jnp.logical_or(ov_sorted, fill)
             elif isinstance(fn, AggregateExpression):
                 out_sorted, ov_sorted = _windowed_agg(
-                    fn, spec, ctx, perm, gid, part_start, idx, row_mask, P)
+                    fn, spec, ctx, sorted_child, part_start, idx,
+                    row_mask, P, pflags, end_mask, pend)
             else:
                 raise NotImplementedError(type(fn).__name__)
 
-            # scatter back to original order via inverse permutation
-            inv = jnp.zeros(P, dtype=jnp.int32).at[perm].set(
-                idx, mode="drop")
-            outs.append((jnp.take(out_sorted, inv),
-                         jnp.logical_and(jnp.take(ov_sorted, inv),
-                                         row_mask)))
+            # restore original order: ONE variadic sort keyed on the
+            # carried original index (scatter + inverse gathers serialize
+            # on the scalar core)
+            _, od, ov = jax.lax.sort((perm, out_sorted, ov_sorted),
+                                     num_keys=1, is_stable=True)
+            outs.append((od, jnp.logical_and(ov, row_mask)))
         return outs
 
     return kernel
 
 
-def _windowed_agg(fn: AggregateExpression, spec: WindowSpec, ctx, perm, gid,
-                  part_start, idx, row_mask, P):
+def _windowed_agg(fn: AggregateExpression, spec: WindowSpec, ctx,
+                  sorted_child, part_start, idx, row_mask, P,
+                  pflags, end_mask, pend):
     """Aggregate over a window frame. Default frames follow Spark: with
     order_by -> running (unbounded preceding..current row); without ->
-    whole partition. Explicit ('rows', lo, hi) uses prefix sums."""
+    whole partition. All segment maths are scans + STATIC shifts — no
+    row-sized gather or scatter anywhere (TPU scalar-core serialization).
+    """
     if isinstance(fn, CountStar):
         vd = jnp.ones(P, dtype=jnp.int64)
         vv = row_mask
-        dt = INT64
     else:
-        v = fn.child.eval_device(ctx)
-        vd = jnp.take(v.data, perm)
-        vv = jnp.take(v.validity, perm)
-        dt = v.dtype
+        vd = sorted_child.data
+        vv = sorted_child.validity
     vv = jnp.logical_and(vv, row_mask)
+    seg = SortedSegments(pflags, row_mask)
 
     frame = spec.frame
     if frame is None:
@@ -186,29 +211,40 @@ def _windowed_agg(fn: AggregateExpression, spec: WindowSpec, ctx, perm, gid,
     whole = lo is None and hi is None
     if whole:
         if isinstance(fn, (Sum, Average, Count, CountStar)):
-            acc = jnp.where(vv, vd, jnp.zeros_like(vd))
+            acc = vd
             if isinstance(fn, (Count, CountStar)):
                 acc = vv.astype(jnp.int64)
-            tot = jax.ops.segment_sum(acc.astype(
-                jnp.float64 if isinstance(fn, Average) else acc.dtype),
-                gid, num_segments=P)
-            cnt = jax.ops.segment_sum(vv.astype(jnp.int64), gid,
-                                      num_segments=P)
+            acc = acc.astype(jnp.float64 if isinstance(fn, Average)
+                             else acc.dtype)
+            tot = _end_broadcast(seg.sum(acc, vv), end_mask)
+            cnt = _end_broadcast(seg.count(vv), end_mask)
             if isinstance(fn, (Count, CountStar)):
-                return _seg_broadcast(tot, gid), row_mask
+                return tot, row_mask
             if isinstance(fn, Average):
-                c = _seg_broadcast(cnt, gid)
-                s = _seg_broadcast(tot, gid)
-                ok = c > 0
-                return s / jnp.maximum(c, 1).astype(jnp.float64), ok
-            s = _seg_broadcast(tot, gid)
-            ok = _seg_broadcast(cnt, gid) > 0
-            return s, ok
+                ok = cnt > 0
+                return (tot / jnp.maximum(cnt, 1).astype(jnp.float64), ok)
+            return tot, cnt > 0
         if isinstance(fn, (Min, Max)):
-            from ..exprs.aggregates import _seg_max, _seg_min
-            red = _seg_min if isinstance(fn, Min) else _seg_max
-            m, cnt = red(vd, vv, gid, P)
-            return _seg_broadcast(m, gid), _seg_broadcast(cnt, gid) > 0
+            if jnp.issubdtype(vd.dtype, jnp.floating):
+                # Spark: NaN is greatest; all-NaN group -> NaN
+                notnan = jnp.logical_and(vv, jnp.logical_not(jnp.isnan(vd)))
+                has_nan = _end_broadcast(
+                    seg.max(jnp.logical_and(vv, jnp.isnan(vd))
+                            .astype(jnp.int32), vv), end_mask) > 0
+                red = seg.min if isinstance(fn, Min) else seg.max
+                m = _end_broadcast(red(vd, notnan), end_mask)
+                n_notnan = _end_broadcast(seg.count(notnan), end_mask)
+                nanv = jnp.array(jnp.nan, dtype=vd.dtype)
+                if isinstance(fn, Max):
+                    m = jnp.where(has_nan, nanv, m)
+                else:
+                    m = jnp.where(jnp.logical_and(n_notnan == 0, has_nan),
+                                  nanv, m)
+            else:
+                red = seg.min if isinstance(fn, Min) else seg.max
+                m = _end_broadcast(red(vd, vv), end_mask)
+            cnt = _end_broadcast(seg.count(vv), end_mask)
+            return m, cnt > 0
         raise NotImplementedError(type(fn).__name__)
 
     # prefix-sum frames (running / bounded rows) for sum/count/avg
@@ -218,31 +254,48 @@ def _windowed_agg(fn: AggregateExpression, spec: WindowSpec, ctx, perm, gid,
     acc_dt = jnp.float64 if (isinstance(fn, Average)
                              or jnp.issubdtype(vd.dtype, jnp.floating)) \
         else jnp.int64
-    acc = jnp.where(vv, vd, jnp.zeros_like(vd)).astype(acc_dt)
+    is_f = jnp.issubdtype(vd.dtype, jnp.floating)
+    # NaN must poison only frames CONTAINING it, not every later prefix:
+    # sum finite values in the prefix and track NaN positions separately
+    # (a frame whose NaN-count difference is >0 yields NaN)
+    isnan = (jnp.logical_and(vv, jnp.isnan(vd)) if is_f
+             else jnp.zeros(P, jnp.bool_))
+    finite_ok = jnp.logical_and(vv, jnp.logical_not(isnan))
+    acc = jnp.where(finite_ok, vd, jnp.zeros_like(vd)).astype(acc_dt)
     cntv = vv.astype(jnp.int64)
     ps = prefix_sum(acc)          # global prefix (inclusive)
     pc = prefix_sum(cntv)
+    pn = prefix_sum(isnan.astype(jnp.int32))
+    lo_i = part_start if lo is None else jnp.maximum(part_start, idx + lo)
+    hi_i = pend if hi is None else jnp.minimum(pend, idx + hi)
+    empty = hi_i < lo_i
 
     def window_sum(prefix):
-        # sum over [max(pstart, i+lo), min(pend, i+hi)] in sorted space
-        lo_i = part_start if lo is None else jnp.maximum(part_start, idx + lo)
-        pcount = jax.ops.segment_sum(row_mask.astype(jnp.int32), gid,
-                                     num_segments=P)
-        pend = part_start + _seg_broadcast(pcount, gid) - 1
-        hi_i = pend if hi is None else jnp.minimum(pend, idx + hi)
-        hi_i = jnp.clip(hi_i, 0, P - 1)
-        lo_i = jnp.clip(lo_i, 0, P)
-        upper = jnp.take(prefix, hi_i)
-        lower = jnp.where(lo_i > 0,
-                          jnp.take(prefix, jnp.maximum(lo_i - 1, 0)),
-                          jnp.zeros_like(upper))
-        empty = hi_i < lo_i
-        return jnp.where(empty, jnp.zeros_like(upper), upper - lower), empty
+        z = jnp.zeros((), prefix.dtype)
+        # prefix value just BEFORE the partition (0 at the table start)
+        before = _start_broadcast(shift_static(prefix, 1, z), pflags)
+        at_end = _end_broadcast(prefix, end_mask)
+        # upper = prefix[min(pend, idx+hi)] via a STATIC shift + clamp fix
+        if hi is None:
+            upper = at_end
+        else:
+            upper = jnp.where(idx + hi > pend, at_end,
+                              shift_static(prefix, -hi, z))
+        # lower = prefix[max(pstart, idx+lo) - 1]
+        if lo is None:
+            lower = before
+        else:
+            lower = jnp.where(idx + lo <= part_start, before,
+                              shift_static(prefix, -(lo - 1), z))
+        return jnp.where(empty, z, upper - lower)
 
-    s, empty = window_sum(ps)
-    c, _ = window_sum(pc)
+    s = window_sum(ps)
+    c = window_sum(pc)
     if isinstance(fn, (Count, CountStar)):
         return c, row_mask
+    if is_f:
+        frame_nan = window_sum(pn) > 0
+        s = jnp.where(frame_nan, jnp.array(jnp.nan, s.dtype), s)
     if isinstance(fn, Average):
         ok = jnp.logical_and(c > 0, row_mask)
         return s.astype(jnp.float64) / jnp.maximum(c, 1).astype(jnp.float64), ok
@@ -380,14 +433,11 @@ class CpuWindowExec(TpuExec):
                 res = (rn.where(rn < big, other=None).floordiv(base + 1)
                        .fillna(rem + (rn - big) // base.clip(lower=1))
                        .astype("int64") + 1)
-            elif isinstance(fn, Lag):
-                src = fn.child.eval_host(batch).to_pandas()
-                work["__v"] = src.reindex(work.index)
-                res = g["__v"].shift(fn.offset, fill_value=fn.default)
-            elif isinstance(fn, Lead):
-                src = fn.child.eval_host(batch).to_pandas()
-                work["__v"] = src.reindex(work.index)
-                res = g["__v"].shift(-fn.offset, fill_value=fn.default)
+            elif isinstance(fn, (Lag, Lead)):
+                # validity-aware shift: out-of-partition slots are SQL
+                # NULL (or the default), never NaN — pandas shift's NaN
+                # fill is indistinguishable from a real NaN value
+                res = _host_shift(fn, g, work, batch)
             elif isinstance(fn, AggregateExpression):
                 res = self._host_agg(fn, spec, g, work, batch)
             else:
@@ -401,49 +451,154 @@ class CpuWindowExec(TpuExec):
         from ..types import to_arrow
         arrays = []
         for f in self._schema.fields:
-            vals = [None if pd.isna(x) else x for x in df[f.name].tolist()]
+            isf = f.dtype.name in ("float", "double")
+            vals = [x if (isf and isinstance(x, float) and np.isnan(x))
+                    else (None if pd.isna(x) else x)
+                    for x in df[f.name].tolist()]
             arrays.append(pa.array(vals, type=to_arrow(f.dtype)))
         yield ColumnarBatch.from_arrow(
             pa.Table.from_arrays(arrays, names=self._schema.names()))
 
     def _host_agg(self, fn, spec, g, work, batch):
+        """Frame aggregation on the host oracle with Spark semantics:
+        SQL NULL (arrow validity) is skipped, NaN is a VALUE that poisons
+        any frame containing it; FOLLOWING bounds are honored (pandas
+        rolling is trailing-only and skips NaN, so frames are computed
+        from per-partition prefix arrays instead)."""
+        import numpy as np
+        import pandas as pd
+        n = len(work)
         if isinstance(fn, CountStar):
-            col = None
+            vals = np.ones(n)
+            ok = np.ones(n, dtype=bool)
         else:
-            work["__a"] = fn.child.eval_host(batch).to_pandas() \
-                .reindex(work.index)
-            col = "__a"
+            import pyarrow as pa
+            arr = fn.child.eval_host(batch)
+            if isinstance(arr, pa.ChunkedArray):
+                arr = arr.combine_chunks()
+            ok_full = ~np.asarray(arr.is_null())
+            v_full = np.asarray(arr.to_pandas().to_numpy(), dtype=object)
+            pos = work.index.to_numpy()
+            vals = v_full[pos]
+            ok = ok_full[pos]
+        import pyarrow as pa
+        is_f = (not isinstance(fn, (Count, CountStar))
+                and pa.types.is_floating(arr.type))
+        fvals = np.asarray(
+            [float(x) if x is not None and not (isinstance(x, float)
+                                                and np.isnan(x)) else
+             (np.nan if isinstance(x, float) else 0.0) for x in vals],
+            dtype=np.float64)
+
         frame = spec.frame
         if frame is None:
             frame = ("rows", None, 0) if spec.order_by \
                 else ("rows", None, None)
         kind, lo, hi = frame
-        if lo is None and hi is None:
-            if isinstance(fn, CountStar):
-                return g["__one" if "__one" in work.columns else
-                         work.columns[0]].transform("size")
-            m = {Sum: "sum", Min: "min", Max: "max", Average: "mean",
-                 Count: "count"}[type(fn)]
-            return g[col].transform(m)
-        # running / bounded rows
-        if isinstance(fn, CountStar):
-            work["__a"] = 1
-            col = "__a"
-        window = (hi or 0) - (lo if lo is not None else -(10**9)) + 1
-        minp = 1
-        roll = g[col].rolling(window=window if lo is not None else 10**9,
-                              min_periods=minp)
-        m = {Sum: "sum", Count: "count", Average: "mean",
-             CountStar: "count"}[type(fn)]
-        res = getattr(roll, m)()
-        if hi:
-            res = g[col].rolling(window=window, min_periods=minp).agg(m)
-        res.index = res.index.droplevel(list(range(res.index.nlevels - 1)))
-        return res
+
+        out = np.empty(n, dtype=object)
+        start = 0
+        sizes = (g.size().to_numpy() if hasattr(g, "size") else [n])
+        for sz in sizes:
+            sl = slice(start, start + int(sz))
+            v = fvals[sl]
+            k = ok[sl]
+            m = int(sz)
+            isn = np.where(k, np.isnan(v), False)
+            fin = k & ~isn
+            acc = np.where(fin, v, 0.0).cumsum()
+            nc = isn.astype(np.int64).cumsum()
+            cnt = k.astype(np.int64).cumsum()
+            i = np.arange(m)
+            lo_i = np.zeros(m, np.int64) if lo is None \
+                else np.clip(i + lo, 0, m)
+            hi_i = np.full(m, m - 1) if hi is None \
+                else np.minimum(i + hi, m - 1)
+            empty = hi_i < lo_i
+            hs = np.clip(hi_i, 0, m - 1)
+
+            def dif(p):
+                upper = p[hs]
+                lower = np.where(lo_i > 0, p[np.maximum(lo_i - 1, 0)], 0)
+                return np.where(empty, 0, upper - lower)
+
+            if isinstance(fn, (Min, Max)):
+                # whole-partition only (bounded min/max unsupported on
+                # both engines); Spark: NaN is greatest, all-NaN -> NaN
+                if lo is not None or hi is not None:
+                    raise NotImplementedError(
+                        f"bounded frame for {type(fn).__name__}")
+                finite = v[fin]
+                if not k.any():
+                    res = np.full(m, None, dtype=object)
+                elif isinstance(fn, Max):
+                    val = np.nan if isn.any() else finite.max()
+                    res = np.full(m, val, dtype=object)
+                else:
+                    val = finite.min() if len(finite) else np.nan
+                    res = np.full(m, val, dtype=object)
+                if not is_f:
+                    res = np.asarray([None if x is None else int(x)
+                                      for x in res], dtype=object)
+                out[sl] = res
+                start += int(sz)
+                continue
+            s_ = dif(acc)
+            c_ = dif(cnt)
+            has_nan = dif(nc) > 0
+            if isinstance(fn, (Count, CountStar)):
+                res = c_.astype(object)
+            elif isinstance(fn, Average):
+                res = np.where(has_nan, np.nan,
+                               s_ / np.maximum(c_, 1))
+                res = np.asarray(res, dtype=object)
+                res[c_ == 0] = None
+            else:  # Sum
+                res = np.where(has_nan, np.nan, s_)
+                res = np.asarray(res, dtype=object)
+                res[c_ == 0] = None
+                if not is_f:
+                    res = np.asarray(
+                        [None if x is None else int(x) for x in res],
+                        dtype=object)
+            out[sl] = res
+            start += int(sz)
+        return pd.Series(out, index=work.index)
 
     def describe(self):
         return "CpuWindow[" + ", ".join(n for _, _, n in
                                         self.window_exprs) + "]"
+
+
+def _host_shift(fn, g, work, batch):
+    import numpy as np
+    import pandas as pd
+    import pyarrow as pa
+    arr = fn.child.eval_host(batch)
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    ok_full = ~np.asarray(arr.is_null())
+    v_full = np.asarray(arr.to_pandas().to_numpy(), dtype=object)
+    pos = work.index.to_numpy()
+    vals, ok = v_full[pos], ok_full[pos]
+    off = fn.offset if isinstance(fn, Lag) else -fn.offset
+    out = np.empty(len(work), dtype=object)
+    start = 0
+    for sz in g.size().to_numpy():
+        m = int(sz)
+        sl_v, sl_k = vals[start:start + m], ok[start:start + m]
+        res = np.empty(m, dtype=object)
+        for i in range(m):
+            j = i - off
+            if 0 <= j < m and sl_k[j]:
+                res[i] = sl_v[j]
+            elif 0 <= j < m:
+                res[i] = None            # in-window NULL value
+            else:
+                res[i] = fn.default      # outside the partition
+        out[start:start + m] = res
+        start += m
+    return pd.Series(out, index=work.index)
 
 
 def _sorted_rank(work, pcols, ocols, dense: bool):

@@ -58,7 +58,7 @@ def hive_text_to_tables(paths: Sequence[str], schema: Schema,
     for p in paths:
         with open(p, encoding="utf-8", newline="") as f:
             text = f.read()
-        rows = _hive_parse(text, field_delim, null_value, len(names))
+        rows = _hive_parse(text, field_delim, null_value)
         cols = []
         for i, (nm, at) in enumerate(zip(names, atypes)):
             raw = [r[i] if i < len(r) else None for r in rows]
@@ -67,7 +67,7 @@ def hive_text_to_tables(paths: Sequence[str], schema: Schema,
     return tables, schema
 
 
-def _hive_parse(text: str, delim: str, null_value: str, ncols: int):
+def _hive_parse(text: str, delim: str, null_value: str):
     """Escape-aware split into rows of (str | None) cells. ``\\N`` filling
     an entire cell is the NULL marker; a literal backslash-N is written
     (and read back) as ``\\\\N``."""
@@ -78,7 +78,7 @@ def _hive_parse(text: str, delim: str, null_value: str, ncols: int):
         ch = text[i]
         if ch == "\\" and i + 1 < n:
             nxt = text[i + 1]
-            if (nxt == "N" and not cell
+            if (null_value == "\\N" and nxt == "N" and not cell
                     and (i + 2 >= n or text[i + 2] in (delim, "\n"))):
                 is_null = True
             else:
@@ -86,12 +86,12 @@ def _hive_parse(text: str, delim: str, null_value: str, ncols: int):
             i += 2
             continue
         if ch == delim:
-            row.append(None if is_null else "".join(cell))
+            row.append(_hive_finish(cell, is_null, null_value))
             cell, is_null = [], False
             i += 1
             continue
         if ch == "\n":
-            row.append(None if is_null else "".join(cell))
+            row.append(_hive_finish(cell, is_null, null_value))
             rows.append(row)
             row, cell, is_null = [], [], False
             i += 1
@@ -99,9 +99,29 @@ def _hive_parse(text: str, delim: str, null_value: str, ncols: int):
         cell.append(ch)
         i += 1
     if cell or row or is_null:
-        row.append(None if is_null else "".join(cell))
+        row.append(_hive_finish(cell, is_null, null_value))
         rows.append(row)
     return rows
+
+
+def _hive_finish(cell, is_null: bool, null_value: str):
+    if is_null:
+        return None
+    s = "".join(cell)
+    # custom (non-backslash) null markers compare against the raw cell
+    if null_value != "\\N" and s == null_value:
+        return None
+    return s
+
+
+def _num(v, conv):
+    """Hive LazySimpleSerDe: a malformed numeric cell reads as NULL."""
+    if v in (None, ""):
+        return None
+    try:
+        return conv(v)
+    except ValueError:
+        return None
 
 
 def _hive_convert(raw, at):
@@ -112,11 +132,9 @@ def _hive_convert(raw, at):
         return pa.array([None if v is None else v.lower() == "true"
                          for v in raw], type=at)
     if pa.types.is_integer(at):
-        return pa.array([None if v in (None, "") else int(v)
-                         for v in raw], type=at)
+        return pa.array([_num(v, int) for v in raw], type=at)
     if pa.types.is_floating(at):
-        return pa.array([None if v in (None, "") else float(v)
-                         for v in raw], type=at)
+        return pa.array([_num(v, float) for v in raw], type=at)
     return pa.array(raw).cast(at)
 
 

@@ -109,3 +109,41 @@ def test_rank_desc_multi_order_differential():
             "dr", F.dense_rank(), partition_by=["g"],
             order_by=[F.col("x").desc()])
     assert_tpu_and_cpu_equal(q)
+
+
+def test_window_nan_vs_null_semantics():
+    """NaN is a value: it poisons frames CONTAINING it (sum/avg/max) but
+    not later disjoint frames; SQL NULLs are skipped; lag/lead produce
+    NULL (not NaN) outside the partition. Differential vs the host
+    oracle, which computes frames independently."""
+    import numpy as np
+    import pyarrow as pa
+    from harness import tpu_session
+    rng = np.random.RandomState(9)
+    n = 2000
+    v = rng.rand(n)
+    v[rng.rand(n) < 0.05] = np.nan
+    va = pa.array(v)
+    # sprinkle true NULLs too
+    mask = rng.rand(n) < 0.05
+    va = pa.array([None if m else x for m, x in zip(mask, v)])
+    t = pa.table({"g": rng.randint(0, 20, n), "v": va, "o": rng.rand(n)})
+    q = """SELECT g, sum(v) OVER (PARTITION BY g ORDER BY o
+             ROWS BETWEEN 2 PRECEDING AND 1 FOLLOWING) s,
+           max(v) OVER (PARTITION BY g) mx,
+           lag(v, 1) OVER (PARTITION BY g ORDER BY o) lg
+           FROM t ORDER BY g, o"""
+    import math
+    outs = []
+    for en in (True, False):
+        s = tpu_session({"spark.rapids.tpu.sql.enabled": en})
+        s.create_dataframe(t).create_or_replace_temp_view("t")
+        outs.append(s.sql(q).collect())
+    for rd, rc in zip(*outs):
+        for c in rd:
+            a, b = rd[c], rc[c]
+            if isinstance(a, float) and isinstance(b, float):
+                assert (math.isnan(a) and math.isnan(b)) \
+                    or abs(a - b) <= 1e-9 * (1 + abs(b)), (c, rd, rc)
+            else:
+                assert a == b, (c, rd, rc)
