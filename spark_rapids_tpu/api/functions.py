@@ -444,6 +444,18 @@ def columnar_udf(impl, *cols):
     return ColumnarUDFExpr(impl, [_to_expr(c) for c in cols])
 
 
+def df_udf(fn):
+    """Dataframe-function UDF (ref DFUDFPlugin / sql-plugin-api
+    functions.scala df_udf): the body is written in terms of Column
+    expressions, so the call site inlines straight into the device plan —
+    no bytecode compilation, no Python worker, full expression-level
+    type checking and fusion."""
+    def call(*cols):
+        return fn(*[c if isinstance(c, Col) else lit(c) for c in cols])
+    call.__name__ = getattr(fn, "__name__", "df_udf")
+    return call
+
+
 def pandas_udf(fn=None, return_type=None):
     """Vectorized pandas scalar UDF (ref GpuArrowEvalPythonExec role)."""
     if fn is None:

@@ -19,7 +19,44 @@ from .deletion_vectors import read_deletion_vector
 from .log import DeltaLog, Snapshot
 from .stats import file_matches
 
-__all__ = ["DeltaScanExec"]
+__all__ = ["DeltaScanExec", "attach_partition_columns"]
+
+
+def attach_partition_columns(t, partition_values, schema):
+    """Append the log-recorded partition values as typed constant columns
+    (delta stores them as strings; null as None /
+    __HIVE_DEFAULT_PARTITION__)."""
+    import pyarrow as pa
+    from ..types import to_arrow
+    for col, val in partition_values.items():
+        at = to_arrow(schema[col].dtype)
+        if val is None or val == "__HIVE_DEFAULT_PARTITION__":
+            arr = pa.nulls(t.num_rows, at)
+        else:
+            scalar = pa.scalar(val).cast(at)
+            arr = pa.repeat(scalar, t.num_rows)
+        t = t.append_column(col, arr)
+    return t
+
+
+def _partition_matches(partition_values, schema, predicate) -> bool:
+    """Partition pruning: evaluate the predicate over a 1-row table of the
+    file's partition values; strictly-False means skip. Predicates that
+    reference non-partition columns fail to evaluate -> keep the file."""
+    if not partition_values or predicate is None:
+        return True
+    import pyarrow as pa
+    from ..columnar import ColumnarBatch
+    try:
+        t = attach_partition_columns(
+            pa.table({"__r": pa.array([0])}), partition_values, schema
+        ).drop_columns(["__r"])
+        b = ColumnarBatch.from_arrow(t, pad=False)
+        m = predicate.eval_host(b)
+        v = m[0].as_py() if len(m) else True
+        return v is not False
+    except Exception:
+        return True
 
 
 class DeltaScanExec(ParquetScanExec):
@@ -40,11 +77,21 @@ class DeltaScanExec(ParquetScanExec):
 
     def _prune(self):
         adds = list(self.snapshot.files.values())
-        kept = [a for a in adds if file_matches(a.stats, self.predicate)]
+        kept = [a for a in adds
+                if file_matches(a.stats, self.predicate)
+                and _partition_matches(a.partition_values,
+                                       self.snapshot.schema,
+                                       self.predicate)]
         self._skipped_files = len(adds) - len(kept)
         self._dv_by_path = {
             os.path.join(self.table_path, a.path): a.deletion_vector
             for a in kept if a.deletion_vector}
+        # hive-partitioned files carry their partition VALUES in the log,
+        # not in the parquet footer; the scan re-attaches them as constant
+        # columns (ref GpuDeltaParquetFileFormat partition handling)
+        self._pv_by_path = {
+            os.path.join(self.table_path, a.path): a.partition_values
+            for a in kept if a.partition_values}
         self.paths = [os.path.join(self.table_path, a.path) for a in kept]
         self._empty = not self.paths
         # re-resolve AUTO now that the real path list is known (the base
@@ -64,6 +111,15 @@ class DeltaScanExec(ParquetScanExec):
         self._prune()
 
     def _read_table(self, path: str):
+        pv = self._pv_by_path.get(path)
+        if pv:
+            import pyarrow.parquet as pq
+            want = self.columns or self.snapshot.schema.names()
+            file_cols = [c for c in want if c not in pv]
+            t = pq.ParquetFile(self._cached_path(path)).read(
+                columns=file_cols or None)
+            t = attach_partition_columns(t, pv, self.snapshot.schema)
+            return t.select(want)
         if path in self._dv_by_path:
             # DV positions are file-absolute: row-group pruning would shift
             # every subsequent row's offset and mis-apply the vector, so

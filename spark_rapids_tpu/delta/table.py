@@ -41,22 +41,31 @@ def _eval_predicate(pred: Expression, table) -> np.ndarray:
     return np.asarray(mask.to_numpy(zero_copy_only=False), dtype=bool)
 
 
-def _write_data_file(table_path: str, table) -> AddFile:
+def _write_data_file(table_path: str, table,
+                     partition_values: Optional[Dict[str, str]] = None
+                     ) -> AddFile:
     import pyarrow.parquet as pq
     name = f"part-{uuid.uuid4().hex}.parquet"
+    if partition_values:
+        sub = "/".join(
+            f"{k}={'__HIVE_DEFAULT_PARTITION__' if v is None else v}"
+            for k, v in partition_values.items())
+        os.makedirs(os.path.join(table_path, sub), exist_ok=True)
+        name = f"{sub}/{name}"
     full = os.path.join(table_path, name)
     pq.write_table(table, full)
     return AddFile(name, size=os.path.getsize(full),
                    modification_time=_now_ms(), data_change=True,
-                   stats=collect_stats(table))
+                   stats=collect_stats(table),
+                   partition_values=dict(partition_values or {}))
 
 
 def write_delta(session, plan_df, path: str, mode: str = "overwrite",
                 partition_by=()) -> None:
     """df.write_delta backend (ref GpuOptimisticTransaction write path +
-    GpuStatisticsCollection)."""
-    if partition_by:
-        raise NotImplementedError("partitioned delta writes not yet supported")
+    GpuStatisticsCollection); ``partition_by`` lays files out hive-style
+    (col=value/ dirs) with the values recorded in each AddFile's
+    partitionValues per the delta protocol."""
     from .constraints import check_invariants, fill_identity, identity_specs
     log = DeltaLog(path)
     version = log.version()
@@ -66,6 +75,20 @@ def write_delta(session, plan_df, path: str, mode: str = "overwrite",
     meta = None
     snap0 = log.snapshot() if version >= 0 else None
     old_meta = snap0.metadata if snap0 is not None else None
+    if partition_by:
+        part_cols = list(partition_by)
+    elif old_meta is not None:
+        # delta semantics: omitting partitionBy keeps the table's layout
+        part_cols = list(old_meta.partition_columns)
+    else:
+        part_cols = []
+    if partition_by and mode == "append" and \
+            list(partition_by) != list(part_cols):
+        raise ValueError(f"append partitioning {list(partition_by)} != "
+                         f"table partitioning {part_cols}")
+    for c in part_cols:
+        if c not in plan_df.schema.names() and mode != "append":
+            raise ValueError(f"partition column {c!r} not in dataframe")
     if version < 0 or mode == "overwrite":
         old_cfg = dict(old_meta.configuration) if old_meta else {}
         # reconcile config against the new schema: identity specs for
@@ -76,11 +99,9 @@ def write_delta(session, plan_df, path: str, mode: str = "overwrite",
                    if not (k.startswith(IDENTITY_PREFIX)
                            and k[len(IDENTITY_PREFIX):] not in new_names)}
         meta = Metadata(schema=plan_df.schema, configuration=old_cfg,
+                        partition_columns=part_cols,
                         **({"table_id": old_meta.table_id,
-                            "name": old_meta.name,
-                            "partition_columns":
-                                old_meta.partition_columns}
-                           if old_meta else {}))
+                            "name": old_meta.name} if old_meta else {}))
         schema, cfg = plan_df.schema, old_cfg
         if snap0 is not None and mode == "overwrite":
             actions += [RemoveFile(p, _now_ms()).to_action()
@@ -113,16 +134,53 @@ def write_delta(session, plan_df, path: str, mode: str = "overwrite",
     # optimize write (ref GpuOptimizeWriteExchangeExec): bin the output
     # into target-sized files instead of one arbitrary file per batch
     target = _optimize_write_target(session, cfg)
-    if target and data.num_rows > target:
-        off = 0
-        while off < data.num_rows:
-            chunk = data.slice(off, target)
-            actions.append(_write_data_file(path, chunk).to_action())
-            off += target
-    else:
-        actions.append(_write_data_file(path, data).to_action())
+    for part_values, sub in _split_partitions(data, part_cols):
+        if target and sub.num_rows > target:
+            off = 0
+            while off < sub.num_rows:
+                actions.append(_write_data_file(
+                    path, sub.slice(off, target),
+                    part_values).to_action())
+                off += target
+        else:
+            actions.append(
+                _write_data_file(path, sub, part_values).to_action())
     log.commit(version + 1, actions, op="WRITE")
     _maybe_auto_compact(session, path, cfg)
+
+
+def _rewrite_file(table_path: str, table, src: AddFile,
+                  part_cols) -> AddFile:
+    """Rewrite of (part of) an existing file: keep the SOURCE file's
+    partitionValues and drop the partition columns from the physical data
+    (a compliant Delta reader derives them from partitionValues)."""
+    if src.partition_values:
+        keep = [c for c in table.column_names
+                if c not in src.partition_values]
+        table = table.select(keep)
+    return _write_data_file(table_path, table, src.partition_values)
+
+
+def _split_partitions(data, part_cols):
+    """-> [(partition_values dict[str,str|None], table sans part cols)].
+    Single empty-dict partition when the table is unpartitioned."""
+    import pyarrow as pa
+    import pyarrow.compute as pc
+    if not part_cols:
+        return [({}, data)]
+    combos = (data.select(part_cols).group_by(part_cols).aggregate([])
+              .to_pylist())
+    out = []
+    for row in combos:
+        mask = None
+        for k, v in row.items():
+            cond = pc.is_null(data.column(k)) if v is None else \
+                pc.equal(data.column(k), pa.scalar(v))
+            mask = cond if mask is None else pc.and_(mask, cond)
+        sub = data.filter(mask).drop_columns(part_cols)
+        out.append(({k: (None if v is None else str(v))
+                     for k, v in row.items()}, sub))
+    return out
 
 
 def _optimize_write_target(session, cfg: Dict[str, str]) -> int:
@@ -151,15 +209,18 @@ def _maybe_auto_compact(session, path: str, cfg: Dict[str, str]) -> None:
         return
     # fold ONLY the small files into target-sized ones (dataChange=false:
     # compaction moves rows, it does not change them)
-    merged = pa.concat_tables([dt._load_file(a) for a in small])
+    merged = pa.concat_tables([dt._load_file(a, snap.schema)
+                               for a in small])
     actions = [RemoveFile(a.path, _now_ms(), data_change=False).to_action()
                for a in small]
-    off = 0
-    while off < merged.num_rows:
-        add = _write_data_file(path, merged.slice(off, target))
-        add.data_change = False
-        actions.append(add.to_action())
-        off += target
+    for pv, sub in _split_partitions(merged,
+                                     snap.metadata.partition_columns):
+        off = 0
+        while off < sub.num_rows:
+            add = _write_data_file(path, sub.slice(off, target), pv)
+            add.data_change = False
+            actions.append(add.to_action())
+            off += target
     dt.log.commit(snap.version + 1, actions, op="auto-OPTIMIZE")
 
 
@@ -190,10 +251,19 @@ class DeltaTable:
         return self.log.history()
 
     # ------------------------------------------------------- file rewrite
-    def _load_file(self, add: AddFile):
-        """Arrow table of a live file with its DV already applied."""
+    def _load_file(self, add: AddFile, schema=None):
+        """Arrow table of a live file with its DV already applied; hive
+        partition values re-attach as typed constant columns. Pass the
+        caller's snapshot schema — re-reading it here would replay the
+        whole log once per file."""
         import pyarrow.parquet as pq
         t = pq.read_table(os.path.join(self.path, add.path))
+        if add.partition_values:
+            from .scan import attach_partition_columns
+            schema = schema if schema is not None \
+                else self.log.snapshot().schema
+            t = attach_partition_columns(t, add.partition_values, schema)
+            t = t.select(schema.names())
         if add.deletion_vector:
             deleted = read_deletion_vector(self.path, add.deletion_vector)
             keep = np.ones(t.num_rows, dtype=bool)
@@ -208,13 +278,14 @@ class DeltaTable:
         """ref GpuDeleteCommand.scala: stats-skip untouched files, drop
         fully-deleted files, rewrite (or DV) partially-deleted ones."""
         snap = self.log.snapshot()
+        schema = snap.schema
         actions: List[dict] = []
         deleted_rows = 0
         for add in snap.files.values():
             if condition is not None and not file_matches(add.stats,
                                                           condition):
                 continue
-            t = self._load_file(add)
+            t = self._load_file(add, schema)
             mask = (_eval_predicate(condition, t) if condition is not None
                     else np.ones(t.num_rows, dtype=bool))
             n_del = int(mask.sum())
@@ -234,7 +305,8 @@ class DeltaTable:
             else:
                 import pyarrow as pa
                 kept = t.filter(pa.array(~mask))
-                actions.append(_write_data_file(self.path, kept).to_action())
+                actions.append(_rewrite_file(self.path, kept, add,
+                                             None).to_action())
         if actions:
             self.log.commit(snap.version + 1, actions, op="DELETE")
         return {"num_deleted_rows": deleted_rows}
@@ -275,7 +347,8 @@ class DeltaTable:
             check_invariants(self.session, schema,
                              snap.metadata.configuration, out)
             actions.append(RemoveFile(add.path, _now_ms()).to_action())
-            actions.append(_write_data_file(self.path, out).to_action())
+            actions.append(_rewrite_file(self.path, out, add,
+                                         None).to_action())
         if actions:
             self.log.commit(snap.version + 1, actions, op="UPDATE")
         return {"num_updated_rows": updated}
@@ -375,7 +448,8 @@ class DeltaTable:
         snap = self.log.snapshot()
         if not snap.files:
             return {"files_removed": 0, "files_added": 0}
-        tables = [self._load_file(a) for a in snap.files.values()]
+        tables = [self._load_file(a, snap.schema)
+                  for a in snap.files.values()]
         big = pa.concat_tables(tables)
         if zorder_by:
             from ..api.dataframe import DataFrame
@@ -390,12 +464,14 @@ class DeltaTable:
         actions = [RemoveFile(a.path, _now_ms(), data_change=False)
                    .to_action() for a in snap.files.values()]
         added = 0
-        for off in range(0, max(big.num_rows, 1), target_file_rows):
-            chunk = big.slice(off, target_file_rows)
-            af = _write_data_file(self.path, chunk)
-            af.data_change = False
-            actions.append(af.to_action())
-            added += 1
+        pcols = snap.metadata.partition_columns
+        for pv, sub in _split_partitions(big, pcols):
+            for off in range(0, max(sub.num_rows, 1), target_file_rows):
+                chunk = sub.slice(off, target_file_rows)
+                af = _write_data_file(self.path, chunk, pv)
+                af.data_change = False
+                actions.append(af.to_action())
+                added += 1
         self.log.commit(snap.version + 1, actions,
                         op="OPTIMIZE" if not zorder_by else "ZORDER")
         return {"files_removed": len(snap.files), "files_added": added}
@@ -503,7 +579,7 @@ class MergeBuilder:
         has_matched_clause = bool(self._matched_update) or \
             self._matched_delete
         for add in snap.files.values():
-            tt = t._load_file(add)
+            tt = t._load_file(add, schema)
             n_t, n_s = tt.num_rows, src.num_rows
             if n_t == 0 or n_s == 0:
                 continue
@@ -540,7 +616,8 @@ class MergeBuilder:
                 stats["num_deleted"] += int(row_matched.sum())
                 kept = tt.filter(pa.array(~row_matched))
                 if kept.num_rows:
-                    actions.append(_write_data_file(t.path, kept).to_action())
+                    actions.append(
+                        _rewrite_file(t.path, kept, add, None).to_action())
                 continue
             # matched update: evaluate set-exprs over the matched pair rows
             out_cols = {}
@@ -566,7 +643,7 @@ class MergeBuilder:
             from .constraints import check_invariants
             check_invariants(t.session, schema,
                              snap.metadata.configuration, new_content)
-            actions.append(_write_data_file(t.path, new_content)
+            actions.append(_rewrite_file(t.path, new_content, add, None)
                            .to_action())
         # not-matched inserts
         if self._insert_values is not None:
@@ -597,7 +674,10 @@ class MergeBuilder:
                         .to_action())
                 check_invariants(t.session, schema,
                                  snap.metadata.configuration, ins)
-                actions.append(_write_data_file(t.path, ins).to_action())
+                pcols = snap.metadata.partition_columns
+                for pv, sub in _split_partitions(ins, pcols):
+                    actions.append(
+                        _write_data_file(t.path, sub, pv).to_action())
                 stats["num_inserted"] = ins.num_rows
         if actions:
             t.log.commit(snap.version + 1, actions, op="MERGE")

@@ -482,13 +482,21 @@ class CpuWindowExec(TpuExec):
             vals = v_full[pos]
             ok = ok_full[pos]
         import pyarrow as pa
-        is_f = (not isinstance(fn, (Count, CountStar))
-                and pa.types.is_floating(arr.type))
-        fvals = np.asarray(
-            [float(x) if x is not None and not (isinstance(x, float)
-                                                and np.isnan(x)) else
-             (np.nan if isinstance(x, float) else 0.0) for x in vals],
-            dtype=np.float64)
+        if isinstance(fn, (Count, CountStar)):
+            is_f, is_num = False, True
+        else:
+            is_f = pa.types.is_floating(arr.type)
+            is_num = is_f or pa.types.is_integer(arr.type)
+        if is_f:
+            fvals = np.asarray([np.nan if x is None else float(x)
+                                for x in vals], dtype=np.float64)
+        elif is_num:
+            # int64 prefix sums stay EXACT (float64 would lose precision
+            # past 2^53 and mangle decimals)
+            fvals = np.asarray([0 if x is None else int(x)
+                                for x in vals], dtype=np.int64)
+        else:
+            fvals = vals            # strings/dates: min/max only
 
         frame = spec.frame
         if frame is None:
@@ -504,9 +512,14 @@ class CpuWindowExec(TpuExec):
             v = fvals[sl]
             k = ok[sl]
             m = int(sz)
-            isn = np.where(k, np.isnan(v), False)
-            fin = k & ~isn
-            acc = np.where(fin, v, 0.0).cumsum()
+            if is_num:
+                isn = np.where(k, np.isnan(v), False) if is_f \
+                    else np.zeros(m, dtype=bool)
+                fin = k & ~isn
+                acc = np.where(fin, v, 0).cumsum()
+            else:
+                isn = fin = np.zeros(m, dtype=bool)
+                acc = np.zeros(m)
             nc = isn.astype(np.int64).cumsum()
             cnt = k.astype(np.int64).cumsum()
             i = np.arange(m)
@@ -528,19 +541,18 @@ class CpuWindowExec(TpuExec):
                 if lo is not None or hi is not None:
                     raise NotImplementedError(
                         f"bounded frame for {type(fn).__name__}")
-                finite = v[fin]
                 if not k.any():
-                    res = np.full(m, None, dtype=object)
+                    val = None
+                elif not is_num:        # strings/dates: python min/max
+                    vv = [x for x, kk in zip(v, k) if kk]
+                    val = min(vv) if isinstance(fn, Min) else max(vv)
                 elif isinstance(fn, Max):
-                    val = np.nan if isn.any() else finite.max()
-                    res = np.full(m, val, dtype=object)
+                    val = np.nan if (is_f and isn.any()) else v[fin].max()
+                elif len(v[fin]):
+                    val = v[fin].min()
                 else:
-                    val = finite.min() if len(finite) else np.nan
-                    res = np.full(m, val, dtype=object)
-                if not is_f:
-                    res = np.asarray([None if x is None else int(x)
-                                      for x in res], dtype=object)
-                out[sl] = res
+                    val = np.nan
+                out[sl] = np.full(m, val, dtype=object)
                 start += int(sz)
                 continue
             s_ = dif(acc)
@@ -587,15 +599,15 @@ def _host_shift(fn, g, work, batch):
     for sz in g.size().to_numpy():
         m = int(sz)
         sl_v, sl_k = vals[start:start + m], ok[start:start + m]
-        res = np.empty(m, dtype=object)
-        for i in range(m):
-            j = i - off
-            if 0 <= j < m and sl_k[j]:
-                res[i] = sl_v[j]
-            elif 0 <= j < m:
-                res[i] = None            # in-window NULL value
-            else:
-                res[i] = fn.default      # outside the partition
+        res = np.full(m, fn.default, dtype=object)   # outside partition
+        if off >= 0:                                  # lag: shift right
+            d = min(off, m)
+            src_v, src_k = sl_v[:m - d], sl_k[:m - d]
+            res[d:] = np.where(src_k, src_v, None)
+        else:                                         # lead: shift left
+            d = min(-off, m)
+            src_v, src_k = sl_v[d:], sl_k[d:]
+            res[:m - d] = np.where(src_k, src_v, None)
         out[start:start + m] = res
         start += m
     return pd.Series(out, index=work.index)

@@ -470,3 +470,42 @@ def test_delta_optimize_write_and_auto_compact(tmp_path):
     # the 50-row remainder and the 1-row append folded into one file
     assert len(after) == len(files)
     assert dt.to_df().count() == 501
+
+
+def test_delta_partitioned_write_read_dml(tmp_path):
+    """Hive-style partitioned layout with partitionValues in the log
+    (ref delta protocol + GpuDeltaParquetFileFormat partition columns)."""
+    s = tpu_session()
+    p = str(tmp_path / "t")
+    t = pa.table({"region": ["eu", "us", "eu", "ap", None, "us"],
+                  "v": [1, 2, 3, 4, 5, 6]})
+    s.create_dataframe(t).write_delta(p, partition_by=["region"])
+    dt = s.delta_table(p)
+    snap = dt.log.snapshot()
+    assert snap.metadata.partition_columns == ["region"]
+    assert all(a.partition_values for a in snap.files.values())
+    assert any("region=eu" in a.path for a in snap.files.values())
+    # read back with partition column re-attached
+    out = sorted(dt.to_df().collect(), key=lambda r: r["v"])
+    assert [r["region"] for r in out] == ["eu", "us", "eu", "ap", None,
+                                         "us"]
+    assert [r["v"] for r in out] == [1, 2, 3, 4, 5, 6]
+    # partition pruning: only matching files scanned
+    df = dt.to_df().filter(F.col("region") == F.lit("eu"))
+    tree = df._physical().tree_string()
+    assert "skipped" in tree
+    assert sorted(r["v"] for r in df.collect()) == [1, 3]
+    # append respects existing partitioning
+    s.create_dataframe(pa.table({"region": ["eu"], "v": [7]})) \
+        .write_delta(p, mode="append")
+    assert dt.to_df().count() == 7
+    # DML over a partitioned table (predicate on the partition column)
+    dt.delete(GreaterThan(ColumnRef("v"), Literal(5)))
+    assert dt.to_df().count() == 5
+    from spark_rapids_tpu.exprs import EqualTo
+    res = dt.update(EqualTo(ColumnRef("region"), Literal("ap")),
+                    {"v": Literal(40)})
+    assert res["num_updated_rows"] == 1
+    got = {r["region"]: r["v"] for r in dt.to_df().collect()
+           if r["region"] == "ap"}
+    assert got == {"ap": 40}

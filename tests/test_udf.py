@@ -151,3 +151,24 @@ def test_columnar_udf_runs_on_device():
     import numpy as np
     np.testing.assert_allclose(out["c"], 1 / (1 + np.exp(-out["b"])),
                                rtol=1e-12)
+
+
+def test_df_udf_inlines_into_device_plan():
+    """ref DFUDFPlugin: a UDF defined as Column expressions runs fully on
+    device with no fallback tagging."""
+    import pyarrow as pa
+    from harness import tpu_session, assert_all_on_tpu
+    from spark_rapids_tpu.api import functions as F
+
+    @F.df_udf
+    def gross(price, tax):
+        return price * (F.lit(1.0) + tax)
+
+    def q(s):
+        df = s.create_dataframe(
+            pa.table({"p": [10.0, 20.0], "t": [0.1, 0.2]}))
+        return df.select(gross(F.col("p"), F.col("t")).alias("g"))
+    assert_all_on_tpu(q)
+    s = tpu_session()
+    out = q(s)
+    assert [r["g"] for r in out.collect()] == [11.0, 24.0]
