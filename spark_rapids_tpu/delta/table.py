@@ -75,19 +75,17 @@ def write_delta(session, plan_df, path: str, mode: str = "overwrite",
     meta = None
     snap0 = log.snapshot() if version >= 0 else None
     old_meta = snap0.metadata if snap0 is not None else None
-    if partition_by:
-        part_cols = list(partition_by)
-    elif old_meta is not None:
-        # delta semantics: omitting partitionBy keeps the table's layout
-        part_cols = list(old_meta.partition_columns)
+    existing_parts = list(old_meta.partition_columns) if old_meta else []
+    if mode == "append":
+        part_cols = existing_parts
+        if partition_by and list(partition_by) != existing_parts:
+            raise ValueError(
+                f"append partitioning {list(partition_by)} != "
+                f"table partitioning {existing_parts}")
     else:
-        part_cols = []
-    if partition_by and mode == "append" and \
-            list(partition_by) != list(part_cols):
-        raise ValueError(f"append partitioning {list(partition_by)} != "
-                         f"table partitioning {part_cols}")
+        part_cols = list(partition_by) if partition_by else existing_parts
     for c in part_cols:
-        if c not in plan_df.schema.names() and mode != "append":
+        if c not in plan_df.schema.names():
             raise ValueError(f"partition column {c!r} not in dataframe")
     if version < 0 or mode == "overwrite":
         old_cfg = dict(old_meta.configuration) if old_meta else {}
@@ -324,7 +322,7 @@ class DeltaTable:
             if condition is not None and not file_matches(add.stats,
                                                           condition):
                 continue
-            t = self._load_file(add)
+            t = self._load_file(add, schema)
             mask = (_eval_predicate(condition, t) if condition is not None
                     else np.ones(t.num_rows, dtype=bool))
             n_upd = int(mask.sum())

@@ -29,7 +29,9 @@ import numpy as np
 from ..columnar import ColumnarBatch, DeviceColumn, HostColumn, concat_batches
 from ..columnar.bucketing import bucket_for
 from ..exprs.aggregates import AggregateExpression
-from ..exprs.base import BoundReference, DVal, EvalContext, Expression
+from ..exprs.base import (BoundReference, DVal, EvalContext, Expression,
+                          collect_param_literals, literal_scalars,
+                          parameterized_keys)
 from ..mem import SpillableBatch, with_retry_no_split
 from ..types import Schema, StructField
 from .base import ESSENTIAL, ExecContext, TpuExec
@@ -76,9 +78,14 @@ def _build_groupby_kernel(key_exprs: Sequence[Expression],
             ord_ += n
 
     from ..types import INT32
+    lit_exprs = _param_exprs(key_exprs, aggs, mode, stages,
+                             value_exprs=value_exprs
+                             if mode == "update" else None)
+    slots = {id(l): i
+             for i, l in enumerate(collect_param_literals(lit_exprs))}
 
     @functools.partial(jax.jit, static_argnums=(2,))
-    def kernel(cols, num_rows, padded_len):
+    def kernel(cols, num_rows, padded_len, scalars=()):
         keep = None
         if base_schema is not None:
             n_base = len(base_dtypes)
@@ -86,15 +93,18 @@ def _build_groupby_kernel(key_exprs: Sequence[Expression],
                     for c, dt in zip(cols[:n_base], base_dtypes)]
             codes = [DVal(c[0], c[1], INT32) for c in cols[n_base:]]
             sctx, keep = _apply_pre_stages(stages, base_schema, base,
-                                           num_rows, padded_len)
+                                           num_rows, padded_len,
+                                           scalars, slots)
             dvals = list(sctx.columns) + codes
             # schema = eval schema + __gk fields; pad dvals to match
             dvals = dvals[:len(dtypes)] + [None] * (len(dtypes) - len(dvals))
-            ctx = EvalContext(schema, dvals, num_rows, padded_len)
+            ctx = EvalContext(schema, dvals, num_rows, padded_len,
+                              scalars, slots)
         else:
             dvals = [None if c is None else DVal(c[0], c[1], dt)
                      for c, dt in zip(cols, dtypes)]
-            ctx = EvalContext(schema, dvals, num_rows, padded_len)
+            ctx = EvalContext(schema, dvals, num_rows, padded_len,
+                              scalars, slots)
         keys = [e.eval_device(ctx) for e in key_exprs]
         vals = [[e.eval_device(ctx) for e in exprs] for exprs in value_exprs]
         return segmented_groupby(keys, vals, aggs, mode, num_rows,
@@ -103,13 +113,15 @@ def _build_groupby_kernel(key_exprs: Sequence[Expression],
     return kernel
 
 
-def _apply_pre_stages(stages, in_schema, base_dvals, num_rows, padded_len):
+def _apply_pre_stages(stages, in_schema, base_dvals, num_rows, padded_len,
+                      scalars=None, slots=None):
     """Trace the fused ("filter", cond) / ("project", exprs, schema)
     pre-stages over the base context; returns (final EvalContext over the
     last stage's schema, keep mask). Shared by the sort-based and
     direct-addressing update kernels so the fusion semantics cannot
     diverge between them."""
-    ctx = EvalContext(in_schema, base_dvals, num_rows, padded_len)
+    ctx = EvalContext(in_schema, base_dvals, num_rows, padded_len,
+                      scalars, slots)
     keep = ctx.row_mask()
     for st in stages:
         if st[0] == "filter":
@@ -121,8 +133,33 @@ def _apply_pre_stages(stages, in_schema, base_dvals, num_rows, padded_len):
             dv = [e.eval_device(ctx)
                   if e.fully_device_supported(ctx.schema) is None
                   else None for e in exprs]
-            ctx = EvalContext(out_schema, dv, num_rows, padded_len)
+            ctx = EvalContext(out_schema, dv, num_rows, padded_len,
+                              ctx.scalars, ctx.literal_slots)
     return ctx, keep
+
+
+def _param_exprs(key_exprs, aggs, mode, stages, value_exprs=None):
+    """The expression list (deterministic order) whose parameterizable
+    literals ride into the kernel as traced scalars — the ONE definition
+    of slot order shared by kernel build and call sites. Builders pass
+    their already-materialized ``value_exprs`` (the objects the kernel
+    traces over); callers omit it and get structurally-aligned fresh
+    lists from input_exprs()."""
+    exprs = []
+    for st in (stages or ()):
+        if st[0] == "filter":
+            exprs.append(st[1])
+        else:
+            exprs.extend(st[1])
+    exprs.extend(key_exprs)
+    if mode == "update":
+        if value_exprs is not None:
+            for ve in value_exprs:
+                exprs.extend(ve)
+        else:
+            for a in aggs:
+                exprs.extend(a.input_exprs())
+    return exprs
 
 
 def _stage_key(stages):
@@ -141,12 +178,13 @@ def _stage_key(stages):
 
 def _agg_kernel_key(key_exprs, aggs, schema, mode, in_schema=None,
                     stages=None, n_codes=0):
-    return (tuple(e.key() for e in key_exprs),
-            tuple(a.key() for a in aggs),
-            tuple((f.name, f.dtype.name) for f in schema.fields), mode,
-            tuple((f.name, f.dtype.name) for f in in_schema.fields)
-            if in_schema is not None else None,
-            _stage_key(stages), n_codes)
+    with parameterized_keys():
+        return (tuple(e.key() for e in key_exprs),
+                tuple(a.key() for a in aggs),
+                tuple((f.name, f.dtype.name) for f in schema.fields), mode,
+                tuple((f.name, f.dtype.name) for f in in_schema.fields)
+                if in_schema is not None else None,
+                _stage_key(stages), n_codes)
 
 
 def _get_kernel(key_exprs, aggs, schema, mode, partial_counts=None,
@@ -223,7 +261,8 @@ class TpuHashAggregateExec(TpuExec):
 
     # ------------------------------------------------------------------
     def _run_kernel(self, kernel, batch: ColumnarBatch,
-                    out_schema: Schema, extra_cols=()) -> ColumnarBatch:
+                    out_schema: Schema, extra_cols=(),
+                    scalars=()) -> ColumnarBatch:
         cols = []
         for c in batch.columns:
             if isinstance(c, DeviceColumn):
@@ -233,7 +272,7 @@ class TpuHashAggregateExec(TpuExec):
         for c in extra_cols:
             cols.append((c.data, c.validity))
         key_outs, partial_outs, num_groups = kernel(
-            cols, jnp.int32(batch.num_rows_raw), batch.padded_len)
+            cols, jnp.int32(batch.num_rows_raw), batch.padded_len, scalars)
         n = int(num_groups)
         # re-bucket: group count is usually orders of magnitude below the
         # input bucket; slicing keeps the merge pass (another sort) tiny
@@ -351,9 +390,9 @@ class TpuHashAggregateExec(TpuExec):
         OPT = self.OPTIMISTIC_GROUPS
 
         @functools.partial(jax.jit, static_argnums=(2,))
-        def fast(cols, num_rows, padded_len):
+        def fast(cols, num_rows, padded_len, scalars=()):
             key_outs, partial_outs, num_groups = update_k(
-                cols, num_rows, padded_len)
+                cols, num_rows, padded_len, scalars)
             outs = list(key_outs)
             ord_ = 0
             for ai, a in enumerate(aggs):
@@ -397,24 +436,31 @@ class TpuHashAggregateExec(TpuExec):
         G = g_bucket
         from ..types import INT32
         from ..columnar.segmented import prefix_sum, seg_sum
+        lit_exprs = _param_exprs(self._kernel_groupings, aggs, "update",
+                                 stages, value_exprs=value_exprs)
+        slots = {id(l): i
+                 for i, l in enumerate(collect_param_literals(lit_exprs))}
 
         @functools.partial(jax.jit, static_argnums=(2,))
-        def fast_direct(cols, num_rows, padded_len, cards):
+        def fast_direct(cols, num_rows, padded_len, cards, scalars=()):
             if base_dtypes is not None:
                 n_base = len(base_dtypes)
                 base = [None if c is None else DVal(c[0], c[1], dt)
                         for c, dt in zip(cols[:n_base], base_dtypes)]
                 code_cols = cols[n_base:]
                 sctx, keep = _apply_pre_stages(stages, in_schema, base,
-                                               num_rows, padded_len)
+                                               num_rows, padded_len,
+                                               scalars, slots)
                 dvals = (list(sctx.columns)
                          + [DVal(c[0], c[1], INT32) for c in code_cols])
-                ectx = EvalContext(schema, dvals, num_rows, padded_len)
+                ectx = EvalContext(schema, dvals, num_rows, padded_len,
+                                   scalars, slots)
             else:
                 n_base = len(dtypes) - nkeys
                 dvals = [None if c is None else DVal(c[0], c[1], dt)
                          for c, dt in zip(cols, dtypes)]
-                ectx = EvalContext(schema, dvals, num_rows, padded_len)
+                ectx = EvalContext(schema, dvals, num_rows, padded_len,
+                                   scalars, slots)
                 code_cols = cols[n_base:]
                 keep = ectx.row_mask()
             # gid from packed codes; null occupies the extra slot per key
@@ -489,13 +535,15 @@ class TpuHashAggregateExec(TpuExec):
             fast = self._get_fast_direct_kernel(
                 bucket_segments(int(np.prod(cards + 1))))
             num_groups, outs = fast(cols, jnp.int32(batch.num_rows_raw),
-                                    batch.padded_len, jnp.asarray(cards))
+                                    batch.padded_len, jnp.asarray(cards),
+                                    self._upd_scalars)
         else:
             if self._fast_k is None:
                 self._fast_k = self._get_fast_kernel(update_k,
                                                      self._kernel_key)
             num_groups, outs = self._fast_k(
-                cols, jnp.int32(batch.num_rows_raw), batch.padded_len)
+                cols, jnp.int32(batch.num_rows_raw), batch.padded_len,
+                self._upd_scalars)
         flat = [num_groups] + [x for d, v in outs for x in (d, v)]
         from ..columnar.packing import fetch_packed
         got = fetch_packed(flat)                # the ONE round trip
@@ -531,6 +579,9 @@ class TpuHashAggregateExec(TpuExec):
                                in_schema=in_schema,
                                stages=self.pre_stages or None,
                                n_codes=len(self._dict_keys))
+        self._upd_scalars = literal_scalars(collect_param_literals(
+            _param_exprs(self._kernel_groupings, self.aggs, "update",
+                         self.pre_stages or None)))
         rows_m = ctx.metric(self._exec_id, "numOutputRows", ESSENTIAL)
 
         it = self.children[0].execute(ctx)
@@ -559,7 +610,8 @@ class TpuHashAggregateExec(TpuExec):
             def first_pass(b=batch, extra=codes):
                 with ctx.semaphore.held():
                     pb = self._run_kernel(update_k, b, self._partial_schema,
-                                          extra_cols=extra)
+                                          extra_cols=extra,
+                                          scalars=self._upd_scalars)
                     return SpillableBatch(pb, ctx.memory)
             # idempotent over the input batch -> retry-safe
             partials.append(with_retry_no_split(first_pass, ctx.memory))

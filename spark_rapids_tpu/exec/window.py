@@ -485,7 +485,10 @@ class CpuWindowExec(TpuExec):
         if isinstance(fn, (Count, CountStar)):
             is_f, is_num = False, True
         else:
-            is_f = pa.types.is_floating(arr.type)
+            # decimals take the float64 path (approximate, like the old
+            # pandas transform did); int64 stays exact
+            is_f = (pa.types.is_floating(arr.type)
+                    or pa.types.is_decimal(arr.type))
             is_num = is_f or pa.types.is_integer(arr.type)
         if is_f:
             fvals = np.asarray([np.nan if x is None else float(x)
@@ -566,7 +569,10 @@ class CpuWindowExec(TpuExec):
                 res = np.asarray(res, dtype=object)
                 res[c_ == 0] = None
             else:  # Sum
-                res = np.where(has_nan, np.nan, s_)
+                if is_f:
+                    res = np.where(has_nan, np.nan, s_)
+                else:
+                    res = s_        # int64: exact, no NaN possible
                 res = np.asarray(res, dtype=object)
                 res[c_ == 0] = None
                 if not is_f:

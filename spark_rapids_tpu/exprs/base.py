@@ -44,18 +44,79 @@ class EvalContext:
     columns: per-input-ordinal DVal (traced jnp arrays)
     num_rows: traced int32 scalar — the true (unpadded) row count
     padded_len: static int — the shape bucket
+    scalars/literal_slots: traced literal values (see parameterized_keys) —
+    numeric literals ride into the kernel as scalar operands so queries
+    differing only in constants share ONE compiled executable
     """
 
     def __init__(self, schema: Schema, columns: Sequence[DVal], num_rows,
-                 padded_len: int):
+                 padded_len: int, scalars=None, literal_slots=None):
         self.schema = schema
         self.columns = list(columns)
         self.num_rows = num_rows
         self.padded_len = padded_len
+        self.scalars = scalars
+        self.literal_slots = literal_slots
 
     def row_mask(self):
         """bool[P]: True for real rows, False for padding."""
         return jnp.arange(self.padded_len, dtype=jnp.int32) < self.num_rows
+
+
+import contextlib as _contextlib
+import threading as _threading
+
+_PARAM_KEYS = _threading.local()
+
+
+def _param_keys_on() -> bool:
+    return getattr(_PARAM_KEYS, "on", False)
+
+
+@_contextlib.contextmanager
+def parameterized_keys():
+    """Within this context, Literal.key() renders parameterizable values
+    as a type-only placeholder. Kernel caches compute their keys under it,
+    so queries that differ only in numeric constants (TPC parameter
+    sweeps) resolve to the SAME compiled kernel; the actual values ride in
+    as traced scalar operands collected by collect_param_literals."""
+    prev = getattr(_PARAM_KEYS, "on", False)
+    prev_map = getattr(_PARAM_KEYS, "slots", None)
+    _PARAM_KEYS.on = True
+    _PARAM_KEYS.slots = {}
+    try:
+        yield
+    finally:
+        _PARAM_KEYS.on = prev
+        _PARAM_KEYS.slots = prev_map
+
+
+def collect_param_literals(exprs) -> list:
+    """Deterministic DFS over expression trees -> parameterizable Literal
+    nodes (deduped by identity), the slot order shared by kernel build
+    and call sites."""
+    out, seen = [], set()
+
+    def walk(e):
+        if e is None:
+            return
+        if isinstance(e, Literal):
+            if e.parameterizable() and id(e) not in seen:
+                seen.add(id(e))
+                out.append(e)
+            return
+        for c in getattr(e, "children", []):
+            walk(c)
+
+    for e in exprs:
+        walk(e)
+    return out
+
+
+def literal_scalars(lits) -> tuple:
+    """Call-time traced operand tuple for the collected literals."""
+    return tuple(jnp.asarray(np.asarray(l.value, dtype=l.dtype.np_dtype))
+                 for l in lits)
 
 
 class Expression:
@@ -89,7 +150,12 @@ class Expression:
         if r is not None:
             return f"{type(self).__name__}: output {r}"
         for c in self.children:
-            cr = self.device_type_sig.reason_not_supported(c.data_type(schema))
+            cdt = c.data_type(schema)
+            if cdt == NULLTYPE:
+                # an untyped NULL literal adapts to the consumer's output
+                # type (all-invalid lanes) — e.g. CASE WHEN ... ELSE NULL
+                continue
+            cr = self.device_type_sig.reason_not_supported(cdt)
             if cr is not None:
                 return f"{type(self).__name__}: input {cr}"
         return None
@@ -238,6 +304,12 @@ class Literal(Expression):
             np_dt = self.dtype.np_dtype or np.dtype(np.int32)
             return DVal(jnp.zeros(p, dtype=np_dt),
                         jnp.zeros(p, dtype=jnp.bool_), self.dtype)
+        slots = ctx.literal_slots
+        if slots is not None and id(self) in slots \
+                and ctx.scalars is not None:
+            v = ctx.scalars[slots[id(self)]]
+            return DVal(jnp.broadcast_to(v, (p,)),
+                        jnp.ones(p, dtype=jnp.bool_), self.dtype)
         data = jnp.full((p,), self.value, dtype=self.dtype.np_dtype)
         return DVal(data, jnp.ones(p, dtype=jnp.bool_), self.dtype)
 
@@ -250,7 +322,22 @@ class Literal(Expression):
         return pa.array([self.value] * batch.num_rows, type=at)
 
     def key(self):
+        if _param_keys_on() and self.parameterizable():
+            # slot index in the key: two queries whose literal-object
+            # SHARING differs must not collide on one compiled kernel
+            slots = _PARAM_KEYS.slots
+            slot = slots.setdefault(id(self), len(slots))
+            return f"lit(?{slot}:{self.dtype.name})"
         return f"lit({self.value!r}:{self.dtype.name})"
+
+    def parameterizable(self) -> bool:
+        """True when the value can ride into a kernel as a traced scalar
+        operand (numeric/bool/date/timestamp; not strings/decimals/NULL)."""
+        from ..types import DecimalType, STRING
+        return (self.value is not None
+                and self.dtype.np_dtype is not None
+                and self.dtype != STRING
+                and not isinstance(self.dtype, DecimalType))
 
     @property
     def name_hint(self):
