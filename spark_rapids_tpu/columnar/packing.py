@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["fetch_packed"]
+__all__ = ["fetch_packed", "pack_traced", "unpack_streams"]
 
 
 def _u32_words(dt: np.dtype, shape) -> int:
@@ -31,9 +31,10 @@ def _u32_words(dt: np.dtype, shape) -> int:
     return count
 
 
-@jax.jit
-def _pack(flat):
-    """-> (u32 stream, f64 stream); f64 arrays contribute only to the
+def pack_traced(flat):
+    """Traceable packing — call INSIDE an operator kernel so results leave
+    the device as two buffers with no extra dispatch.
+    -> (u32 stream, f64 stream); f64 arrays contribute only to the
     second, everything else only to the first."""
     words = []
     f64s = []
@@ -66,12 +67,11 @@ def _pack(flat):
     return u32, f64
 
 
-def fetch_packed(arrays):
-    """Fetch a list of device arrays in at most two transfers; returns
-    numpy arrays with the original dtypes/shapes."""
-    flat = list(arrays)
-    specs = [(np.dtype(a.dtype), tuple(a.shape)) for a in flat]
-    u32, f64 = jax.device_get(_pack(tuple(flat)))
+_pack = jax.jit(pack_traced)
+
+
+def unpack_streams(u32, f64, specs):
+    """Host-side inverse of pack_traced; specs = [(np dtype, shape)]."""
     u32 = np.asarray(u32)
     f64 = np.asarray(f64)
     out = []
@@ -97,3 +97,12 @@ def fetch_packed(arrays):
                 arr = raw.astype(dt)
         out.append(arr.reshape(shape) if shape else arr[0])
     return out
+
+
+def fetch_packed(arrays):
+    """Fetch a list of device arrays in at most two transfers; returns
+    numpy arrays with the original dtypes/shapes."""
+    flat = list(arrays)
+    specs = [(np.dtype(a.dtype), tuple(a.shape)) for a in flat]
+    u32, f64 = jax.device_get(_pack(tuple(flat)))
+    return unpack_streams(u32, f64, specs)

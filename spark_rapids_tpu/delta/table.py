@@ -76,13 +76,15 @@ def write_delta(session, plan_df, path: str, mode: str = "overwrite",
     snap0 = log.snapshot() if version >= 0 else None
     old_meta = snap0.metadata if snap0 is not None else None
     existing_parts = list(old_meta.partition_columns) if old_meta else []
-    if mode == "append":
+    if mode == "append" and old_meta is not None:
         part_cols = existing_parts
         if partition_by and list(partition_by) != existing_parts:
             raise ValueError(
                 f"append partitioning {list(partition_by)} != "
                 f"table partitioning {existing_parts}")
     else:
+        # includes append-creates-table (version < 0): use the requested
+        # layout, like Delta's saveAsTable with partitionBy
         part_cols = list(partition_by) if partition_by else existing_parts
     for c in part_cols:
         if c not in plan_df.schema.names():

@@ -286,62 +286,90 @@ class TpuHashAggregateExec(TpuExec):
         return ColumnarBatch(out_cols, n, out_schema)
 
     # -- string-key dictionary encoding --------------------------------
-    def _augment(self, batch: ColumnarBatch) -> list:
-        """Build one int32 code column per string group key, encoded
-        through the exec-local dictionary (consistent across batches).
+    def _encode_key(self, j: int, i: int, batch: ColumnarBatch):
+        """ONE implementation of dictionary-encoding a string group key
+        through the exec-local dictionary (consistent global codes across
+        batches AND across the fused/classic paths — they must agree when
+        the optimistic path bails out mid-query).
 
-        Fast path: a plain column reference to a DictColumn never leaves
-        the device — only the batch's small dictionary->global-code remap
-        table is uploaded and applied with one gather. The general path
-        (computed string keys, host string columns) evaluates on host."""
-        if not self._dict_keys:
-            return []
+        Returns (data, validity, gmap, already_global):
+          * DictColumn fast path: device codes in the SOURCE dictionary's
+            space + the source->global remap table (applied later, on
+            device, fused into the kernel when possible);
+          * general path (computed keys, host strings): host-encoded codes
+            already in GLOBAL space, gmap=None.
+        """
         import pyarrow as pa
         from ..columnar import DictColumn
-        from ..exprs.base import ColumnRef
-        from ..types import INT32
+        from ..exprs.base import Alias, ColumnRef
         p, n = batch.padded_len, batch.num_rows
+        d = self._dicts[j]
+        g = self.groupings[i]
+        if isinstance(g, Alias):
+            g = g.children[0]
+        src = None
+        if isinstance(g, ColumnRef) and g.name in batch.schema.names():
+            src = batch.column_by_name(g.name)
+        if isinstance(src, DictColumn):
+            gmap = np.asarray(
+                [d.setdefault(s_, len(d)) for s_ in src.dictionary],
+                dtype=np.int32)
+            return src.data, src.validity, gmap, False
+        arr = g.eval_host(batch)
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
+        de = arr.dictionary_encode()
+        gmap = np.asarray([d.setdefault(s_, len(d))
+                           for s_ in de.dictionary.to_pylist()],
+                          dtype=np.int32)
+        valid = ~np.asarray(de.indices.is_null())
+        idx = np.asarray(de.indices.fill_null(0).to_numpy(
+            zero_copy_only=False), dtype=np.int64)
+        codes = gmap[idx] if len(gmap) else np.zeros(len(idx), np.int32)
+        data = np.zeros(p, dtype=np.int32)
+        vmask = np.zeros(p, dtype=bool)
+        data[:n] = codes[:n]
+        vmask[:n] = valid[:n]
+        return jnp.asarray(data), jnp.asarray(vmask), None, True
+
+    def _augment(self, batch: ColumnarBatch) -> list:
+        """One int32 GLOBAL-code device column per string group key (the
+        classic/sort path: the remap is applied here with one dispatch)."""
+        if not self._dict_keys:
+            return []
+        from ..columnar.segmented import onehot_gather
+        from ..types import INT32
         cols = []
         for j, i in enumerate(self._dict_keys):
-            d = self._dicts[j]
-            g = self.groupings[i]
-            from ..exprs.base import Alias
-            if isinstance(g, Alias):
-                g = g.children[0]
-            src = None
-            if isinstance(g, ColumnRef) \
-                    and g.name in batch.schema.names():
-                src = batch.column_by_name(g.name)
-            if isinstance(src, DictColumn):
-                gmap = np.asarray(
-                    [d.setdefault(s, len(d)) for s in src.dictionary],
-                    dtype=np.int32)
+            data, validity, gmap, already_global = \
+                self._encode_key(j, i, batch)
+            if not already_global:
                 if len(gmap):
-                    from ..columnar.segmented import onehot_gather
-                    remap = jnp.asarray(gmap)       # tiny H2D (cardinality)
-                    codes = onehot_gather(remap, src.data, len(gmap))
+                    data = onehot_gather(jnp.asarray(gmap), data, len(gmap))
                 else:
-                    codes = jnp.zeros(p, jnp.int32)
-                cols.append(DeviceColumn(codes, src.validity, INT32))
-                continue
-            arr = g.eval_host(batch)
-            if isinstance(arr, pa.ChunkedArray):
-                arr = arr.combine_chunks()
-            de = arr.dictionary_encode()
-            gmap = np.asarray([d.setdefault(s, len(d))
-                               for s in de.dictionary.to_pylist()],
-                              dtype=np.int32)
-            valid = ~np.asarray(de.indices.is_null())
-            idx = np.asarray(de.indices.fill_null(0).to_numpy(
-                zero_copy_only=False), dtype=np.int64)
-            codes = gmap[idx] if len(gmap) else np.zeros(len(idx), np.int32)
-            data = np.zeros(p, dtype=np.int32)
-            vmask = np.zeros(p, dtype=bool)
-            data[:n] = codes[:n]
-            vmask[:n] = valid[:n]
-            cols.append(DeviceColumn(jnp.asarray(data), jnp.asarray(vmask),
-                                     INT32))
+                    data = jnp.zeros(batch.padded_len, jnp.int32)
+            cols.append(DeviceColumn(data, validity, INT32))
         return cols
+
+    def _augment_pairs(self, batch: ColumnarBatch):
+        """Dict-key operands for the FUSED dense kernel: per key a raw
+        (codes, validity) device pair plus its dictionary->global-code
+        remap (numpy; identity when codes are already global) — the remap
+        is applied INSIDE the kernel, so no extra dispatch per key."""
+        if not self._dict_keys:
+            return [], []
+        pairs, remaps = [], []
+        for j, i in enumerate(self._dict_keys):
+            data, validity, gmap, already_global = \
+                self._encode_key(j, i, batch)
+            pairs.append((data, validity))
+            if already_global:
+                card = max(len(self._dicts[j]), 1)
+                remaps.append(np.arange(card, dtype=np.int32))
+            else:
+                remaps.append(gmap if len(gmap)
+                              else np.zeros(1, np.int32))
+        return pairs, remaps
 
     def _inverse_dict(self, j: int) -> list:
         """code -> string list for dictionary key ordinal j."""
@@ -402,8 +430,15 @@ class TpuHashAggregateExec(TpuExec):
                 ord_ += pcounts[ai]
                 fin = a.finalize(parts)
                 outs.append((fin.data, fin.validity))
-            return num_groups, [(d[:OPT], v[:OPT]) for d, v in outs]
+            from ..columnar.packing import pack_traced
+            flat = [num_groups] + [x for d, v in outs
+                                   for x in (d[:OPT], v[:OPT])]
+            spec_cell[padded_len] = [(np.dtype(x.dtype), tuple(x.shape))
+                                     for x in flat]
+            return pack_traced(flat)
 
+        spec_cell = {}
+        fast.out_specs = spec_cell
         _AGG_KERNEL_CACHE[("fast",) + kernel_key] = fast
         return fast
 
@@ -442,12 +477,18 @@ class TpuHashAggregateExec(TpuExec):
                  for i, l in enumerate(collect_param_literals(lit_exprs))}
 
         @functools.partial(jax.jit, static_argnums=(2,))
-        def fast_direct(cols, num_rows, padded_len, cards, scalars=()):
+        def fast_direct(cols, num_rows, padded_len, cards, scalars,
+                        code_pairs, remaps):
+            from ..columnar.segmented import onehot_gather
+            # dictionary remap FUSED into the kernel (each standalone
+            # remap dispatch pays full tunnel latency)
+            code_cols = []
+            for (cd, cv), rm in zip(code_pairs, remaps):
+                code_cols.append((onehot_gather(rm, cd, G), cv))
             if base_dtypes is not None:
                 n_base = len(base_dtypes)
                 base = [None if c is None else DVal(c[0], c[1], dt)
                         for c, dt in zip(cols[:n_base], base_dtypes)]
-                code_cols = cols[n_base:]
                 sctx, keep = _apply_pre_stages(stages, in_schema, base,
                                                num_rows, padded_len,
                                                scalars, slots)
@@ -456,12 +497,13 @@ class TpuHashAggregateExec(TpuExec):
                 ectx = EvalContext(schema, dvals, num_rows, padded_len,
                                    scalars, slots)
             else:
-                n_base = len(dtypes) - nkeys
                 dvals = [None if c is None else DVal(c[0], c[1], dt)
                          for c, dt in zip(cols, dtypes)]
+                dvals = dvals[:len(dtypes) - nkeys] + \
+                    [DVal(c[0], c[1], INT32) for c in code_cols]
+                dvals += [None] * (len(dtypes) - len(dvals))
                 ectx = EvalContext(schema, dvals, num_rows, padded_len,
                                    scalars, slots)
-                code_cols = cols[n_base:]
                 keep = ectx.row_mask()
             # gid from packed codes; null occupies the extra slot per key
             strides = []
@@ -507,46 +549,64 @@ class TpuHashAggregateExec(TpuExec):
                 ord_ += pcounts[ai]
                 fin = a.finalize(parts)
                 outs.append((fin.data, fin.validity))
-            return num_groups, [(d[:OPT], v[:OPT]) for d, v in outs]
+            from ..columnar.packing import pack_traced
+            flat = [num_groups] + [x for d, v in outs
+                                   for x in (d[:OPT], v[:OPT])]
+            spec_cell[padded_len] = [(np.dtype(x.dtype), tuple(x.shape))
+                                     for x in flat]
+            return pack_traced(flat)
 
+        spec_cell = {}
+        fast_direct.out_specs = spec_cell
         _AGG_KERNEL_CACHE[key] = fast_direct
         return fast_direct
 
-    def _fast_single_batch(self, ctx, batch: ColumnarBatch, codes,
+    def _fast_single_batch(self, ctx, batch: ColumnarBatch,
                            update_k) -> Optional[ColumnarBatch]:
-        """Single-input-batch aggregation: ONE kernel (fused pre-stages +
-        update + finalize) and ONE host fetch produce the final HOST
-        batch. Returns None when the group count exceeds the optimistic
-        bound (caller takes the classic path)."""
+        """Single-input-batch aggregation: ONE kernel dispatch (fused
+        pre-stages + dictionary remap + update + finalize + result
+        packing) and ONE fetch produce the final HOST batch — every extra
+        dispatch or fetch pays full tunnel latency. Returns None when the
+        group count exceeds the optimistic bound (caller takes the
+        classic path)."""
         import jax
         from ..columnar.column import arrow_from_numpy
+        from ..columnar.packing import unpack_streams
         from ..types import STRING
-        cols = []
+        base_cols = []
         for c in batch.columns:
-            cols.append((c.data, c.validity)
-                        if isinstance(c, DeviceColumn) else None)
-        for c in codes:
-            cols.append((c.data, c.validity))
+            base_cols.append((c.data, c.validity)
+                             if isinstance(c, DeviceColumn) else None)
         nkeys = len(self.groupings)
-        cards = np.asarray([len(d) for d in self._dicts], np.int32)
-        if (nkeys > 0 and len(self._dict_keys) == nkeys
-                and int(np.prod(cards + 1)) <= self.OPTIMISTIC_GROUPS):
-            from ..columnar.segmented import bucket_segments
-            fast = self._get_fast_direct_kernel(
-                bucket_segments(int(np.prod(cards + 1))))
-            num_groups, outs = fast(cols, jnp.int32(batch.num_rows_raw),
-                                    batch.padded_len, jnp.asarray(cards),
-                                    self._upd_scalars)
-        else:
+        packed = None
+        if nkeys > 0 and len(self._dict_keys) == nkeys:
+            pairs, remaps = self._augment_pairs(batch)
+            cards = np.asarray([len(d) for d in self._dicts], np.int32)
+            prod = int(np.prod(cards + 1))
+            if prod <= self.OPTIMISTIC_GROUPS:
+                from ..columnar.segmented import bucket_segments
+                Gb = bucket_segments(prod)
+                padded_remaps = tuple(
+                    jnp.asarray(np.pad(r, (0, max(Gb - len(r), 0)))[:Gb])
+                    for r in remaps)
+                fast = self._get_fast_direct_kernel(Gb)
+                packed = fast(base_cols, jnp.int32(batch.num_rows_raw),
+                              batch.padded_len, jnp.asarray(cards),
+                              self._upd_scalars, tuple(pairs),
+                              padded_remaps)
+                specs = fast.out_specs[batch.padded_len]
+        if packed is None:
+            codes = self._augment(batch)
+            cols = base_cols + [(c.data, c.validity) for c in codes]
             if self._fast_k is None:
                 self._fast_k = self._get_fast_kernel(update_k,
                                                      self._kernel_key)
-            num_groups, outs = self._fast_k(
+            packed = self._fast_k(
                 cols, jnp.int32(batch.num_rows_raw), batch.padded_len,
                 self._upd_scalars)
-        flat = [num_groups] + [x for d, v in outs for x in (d, v)]
-        from ..columnar.packing import fetch_packed
-        got = fetch_packed(flat)                # the ONE round trip
+            specs = self._fast_k.out_specs[batch.padded_len]
+        u32, f64 = jax.device_get(packed)       # the ONE round trip
+        got = unpack_streams(u32, f64, specs)
         n = int(got[0])
         if n > self.OPTIMISTIC_GROUPS:
             return None
@@ -589,12 +649,10 @@ class TpuHashAggregateExec(TpuExec):
         second = next(it, None) if first is not None else None
         if first is not None and second is None:
             first = first.ensure_device()
-            codes = self._augment(first)
 
             def run_fast():
                 with ctx.semaphore.held():
-                    return self._fast_single_batch(ctx, first, codes,
-                                                   update_k)
+                    return self._fast_single_batch(ctx, first, update_k)
             out = with_retry_no_split(run_fast, ctx.memory)
             if out is not None:
                 rows_m.add(out.num_rows)

@@ -483,12 +483,13 @@ class CpuWindowExec(TpuExec):
             ok = ok_full[pos]
         import pyarrow as pa
         if isinstance(fn, (Count, CountStar)):
-            is_f, is_num = False, True
+            is_f, is_num, is_dec = False, True, False
         else:
-            # decimals take the float64 path (approximate, like the old
-            # pandas transform did); int64 stays exact
-            is_f = (pa.types.is_floating(arr.type)
-                    or pa.types.is_decimal(arr.type))
+            # decimal SUM/AVG take the float64 path (approximate — exact
+            # decimal accumulation is future work); decimal MIN/MAX stay
+            # exact via the object path below; int64 stays exact
+            is_dec = pa.types.is_decimal(arr.type)
+            is_f = pa.types.is_floating(arr.type) or is_dec
             is_num = is_f or pa.types.is_integer(arr.type)
         if is_f:
             fvals = np.asarray([np.nan if x is None else float(x)
@@ -546,8 +547,9 @@ class CpuWindowExec(TpuExec):
                         f"bounded frame for {type(fn).__name__}")
                 if not k.any():
                     val = None
-                elif not is_num:        # strings/dates: python min/max
-                    vv = [x for x, kk in zip(v, k) if kk]
+                elif not is_num or is_dec:  # strings/dates/decimals: exact
+                    src = vals[sl] if is_dec else v
+                    vv = [x for x, kk in zip(src, k) if kk]
                     val = min(vv) if isinstance(fn, Min) else max(vv)
                 elif isinstance(fn, Max):
                     val = np.nan if (is_f and isn.any()) else v[fin].max()

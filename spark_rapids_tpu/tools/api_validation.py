@@ -1,0 +1,107 @@
+"""API-conformance audit (ref api_validation/ ApiValidation.scala).
+
+The reference reflects every Gpu exec's constructor signature against the
+matching Spark exec per shim version so drift is caught at build time.
+The analog here audits the live registries:
+
+  * every logical plan node registered in the planner has a PlanMeta whose
+    conversions produce execs implementing the TpuExec surface
+    (output_schema / do_execute / describe);
+  * every Expression subclass declares a device or host evaluation path
+    and a resolvable type signature;
+  * every AggregateExpression implements the update/merge/finalize
+    pipeline plus the host oracle hook (pandas_agg);
+  * the generated supported-ops inventory agrees with the registry (no
+    expression silently missing from the docs).
+
+Run ``python -m spark_rapids_tpu.tools.api_validation`` for a report;
+the test suite asserts the violation list is empty.
+"""
+from __future__ import annotations
+
+import inspect
+from typing import List
+
+__all__ = ["validate_api", "main"]
+
+
+def _overrides(cls, name: str, base) -> bool:
+    fn = getattr(cls, name, None)
+    return fn is not None and fn is not getattr(base, name, None)
+
+
+def validate_api() -> List[str]:
+    from ..exec.base import TpuExec
+    from ..exprs.aggregates import AggregateExpression
+    from ..exprs.base import Expression
+    from .supported_ops import (_all_subclasses, _load_registries,
+                                expression_inventory)
+    _load_registries()
+    problems: List[str] = []
+
+    # --- execs ----------------------------------------------------------
+    for cls in _all_subclasses(TpuExec):
+        if inspect.isabstract(cls) or cls.__name__.startswith("_") \
+                or cls.__subclasses__():   # intermediate base class
+            continue
+        for required in ("output_schema", "do_execute"):
+            if not _overrides(cls, required, TpuExec):
+                problems.append(
+                    f"exec {cls.__name__}: missing {required}()")
+
+    # --- expressions ----------------------------------------------------
+    for cls in _all_subclasses(Expression):
+        if cls.__name__.startswith("_") or inspect.isabstract(cls) \
+                or cls.__subclasses__():   # intermediate base class
+            continue
+        has_dev = _overrides(cls, "eval_device", Expression)
+        has_host = _overrides(cls, "eval_host", Expression)
+        if not has_dev and not has_host:
+            problems.append(
+                f"expression {cls.__name__}: neither eval_device nor "
+                "eval_host implemented")
+        if getattr(cls, "device_type_sig", None) is None:
+            problems.append(
+                f"expression {cls.__name__}: no device_type_sig")
+
+    # --- aggregates -----------------------------------------------------
+    for cls in _all_subclasses(AggregateExpression):
+        if inspect.isabstract(cls) or cls.__name__.startswith("_"):
+            continue
+        for required in ("update", "merge", "finalize", "partial_types",
+                         "data_type"):
+            if not _overrides(cls, required, AggregateExpression):
+                problems.append(
+                    f"aggregate {cls.__name__}: missing {required}()")
+        if getattr(cls, "pandas_agg", "?") == "?":
+            problems.append(
+                f"aggregate {cls.__name__}: no host-oracle pandas_agg")
+
+    # --- docs/registry agreement ---------------------------------------
+    inv_names = {row["name"] for row in expression_inventory()}
+    for cls in _all_subclasses(Expression):
+        if cls.__name__.startswith("_") or inspect.isabstract(cls):
+            continue
+        if not (_overrides(cls, "eval_device", Expression)
+                or _overrides(cls, "eval_host", Expression)):
+            continue
+        if cls.__name__ not in inv_names:
+            problems.append(
+                f"expression {cls.__name__}: absent from the supported-ops "
+                "inventory (docs would omit it)")
+    return problems
+
+
+def main() -> int:
+    problems = validate_api()
+    if not problems:
+        print("api_validation: all registries conform")
+        return 0
+    print(f"api_validation: {len(problems)} problem(s)")
+    for p in problems:
+        print(" -", p)
+    return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -65,3 +65,10 @@ def test_config_docs_cover_registry():
     for e in all_entries():
         if not e.internal:
             assert e.key in docs, e.key
+
+
+def test_api_validation_clean():
+    """ref api_validation/ApiValidation.scala: the registries must conform
+    to the exec/expression/aggregate interfaces with docs coverage."""
+    from spark_rapids_tpu.tools.api_validation import validate_api
+    assert validate_api() == []
