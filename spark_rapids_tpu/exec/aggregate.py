@@ -31,7 +31,7 @@ from ..columnar.bucketing import bucket_for
 from ..exprs.aggregates import AggregateExpression
 from ..exprs.base import (BoundReference, DVal, EvalContext, Expression,
                           collect_param_literals, literal_scalars,
-                          parameterized_keys)
+                          literal_slot_map, parameterized_keys)
 from ..mem import SpillableBatch, with_retry_no_split
 from ..types import Schema, StructField
 from .base import ESSENTIAL, ExecContext, TpuExec
@@ -78,11 +78,9 @@ def _build_groupby_kernel(key_exprs: Sequence[Expression],
             ord_ += n
 
     from ..types import INT32
-    lit_exprs = _param_exprs(key_exprs, aggs, mode, stages,
-                             value_exprs=value_exprs
-                             if mode == "update" else None)
-    slots = {id(l): i
-             for i, l in enumerate(collect_param_literals(lit_exprs))}
+    slots = literal_slot_map(_param_exprs(
+        key_exprs, aggs, mode, stages,
+        value_exprs=value_exprs if mode == "update" else None))
 
     @functools.partial(jax.jit, static_argnums=(2,))
     def kernel(cols, num_rows, padded_len, scalars=()):
@@ -471,10 +469,9 @@ class TpuHashAggregateExec(TpuExec):
         G = g_bucket
         from ..types import INT32
         from ..columnar.segmented import prefix_sum, seg_sum
-        lit_exprs = _param_exprs(self._kernel_groupings, aggs, "update",
-                                 stages, value_exprs=value_exprs)
-        slots = {id(l): i
-                 for i, l in enumerate(collect_param_literals(lit_exprs))}
+        slots = literal_slot_map(_param_exprs(
+            self._kernel_groupings, aggs, "update", stages,
+            value_exprs=value_exprs))
 
         @functools.partial(jax.jit, static_argnums=(2,))
         def fast_direct(cols, num_rows, padded_len, cards, scalars,

@@ -113,6 +113,12 @@ def collect_param_literals(exprs) -> list:
     return out
 
 
+def literal_slot_map(exprs) -> dict:
+    """id(Literal) -> slot index in the shared DFS order; kernel builders
+    derive slots and call sites derive values from the SAME traversal."""
+    return {id(l): i for i, l in enumerate(collect_param_literals(exprs))}
+
+
 def literal_scalars(lits) -> tuple:
     """Call-time traced operand tuple for the collected literals."""
     return tuple(jnp.asarray(np.asarray(l.value, dtype=l.dtype.np_dtype))

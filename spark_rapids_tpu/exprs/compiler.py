@@ -24,7 +24,8 @@ import numpy as np
 from ..columnar import ColumnarBatch, DeviceColumn, HostColumn
 from ..columnar.bucketing import bucket_for
 from ..types import Schema, StructField
-from .base import DVal, EvalContext, Expression
+from .base import (DVal, EvalContext, Expression, collect_param_literals,
+                   literal_scalars, literal_slot_map, parameterized_keys)
 
 __all__ = ["compile_projection", "DeviceProjector", "filter_batch_device",
            "gather_batch_device", "eval_predicate_device"]
@@ -45,8 +46,14 @@ class DeviceProjector:
         self.exprs = list(exprs)
         self.schema = schema
         self.out_types = [e.data_type(schema) for e in self.exprs]
-        self._key = (tuple(e.key() for e in self.exprs),
-                     tuple((f.name, f.dtype.name) for f in schema.fields))
+        with parameterized_keys():
+            self._key = (tuple(e.key() for e in self.exprs),
+                         tuple((f.name, f.dtype.name)
+                               for f in schema.fields))
+        # numeric literals ride in as traced scalars: structurally equal
+        # projections/filters with different constants share ONE kernel
+        self._lits = collect_param_literals(self.exprs)
+        self._scalars = literal_scalars(self._lits)
         self._fn = _KERNEL_CACHE.get(self._key)
         if self._fn is None:
             self._fn = self._build()
@@ -55,12 +62,14 @@ class DeviceProjector:
     def _build(self):
         exprs, schema = self.exprs, self.schema
         dtypes = [f.dtype for f in schema.fields]  # static, closed over
+        slots = {id(l): i for i, l in enumerate(self._lits)}
 
         @functools.partial(jax.jit, static_argnums=(2,))
-        def kernel(cols, num_rows, padded_len):
+        def kernel(cols, num_rows, padded_len, scalars=()):
             dvals = [None if c is None else DVal(c[0], c[1], dt)
                      for c, dt in zip(cols, dtypes)]
-            ctx = EvalContext(schema, dvals, num_rows, padded_len)
+            ctx = EvalContext(schema, dvals, num_rows, padded_len,
+                              scalars, slots)
             outs = []
             for e in exprs:
                 v = e.eval_device(ctx)
@@ -79,8 +88,8 @@ class DeviceProjector:
                 cols.append((c.data, c.validity))
             else:
                 cols.append(None)  # host column: device exprs must not touch it
-        num_rows = jnp.int32(batch.num_rows)
-        outs = self._fn(cols, num_rows, p)
+        num_rows = jnp.int32(batch.num_rows_raw)
+        outs = self._fn(cols, num_rows, p, self._scalars)
         return [DeviceColumn(d, v, dt)
                 for (d, v), dt in zip(outs, self.out_types)]
 
