@@ -4,6 +4,7 @@ Spark accumulators; here merged into a per-query summary dict exposed as
 ``TpuSession.last_query_metrics``)."""
 from __future__ import annotations
 
+from collections.abc import Mapping
 from typing import Dict
 
 __all__ = ["TaskMetrics", "metrics_summary"]
@@ -39,14 +40,59 @@ class TaskMetrics:
         return out
 
 
-def metrics_summary(ctx) -> Dict[str, Dict[str, object]]:
+class LazyMetricsView(Mapping):
+    """Per-exec metric mapping that defers forcing lazy device-scalar
+    values (row counts kept unforced to avoid tunnel syncs) until someone
+    READS the metrics — then forces them all in ONE packed fetch instead
+    of one ~100 ms round trip per metric. A query that never inspects
+    last_query_metrics pays nothing.
+
+    The VALUES are snapshotted at construction (finish time): jax scalars
+    are immutable, so later queries mutating the live Metric objects
+    cannot contaminate this view, and forcing never writes back into
+    engine state. Mapping (not dict) so every access path — [], get, in,
+    iteration, dict(view) — funnels through the forcing accessors."""
+
+    def __init__(self, values):
+        #: exec_id -> {name: raw value (host number or jax scalar)}
+        self._raw = values
+        self._data = None
+
+    def _force(self):
+        if self._data is not None:
+            return self._data
+        lazy = [(eid, name, v) for eid, ms in self._raw.items()
+                for name, v in ms.items() if hasattr(v, "item")]
+        forced = {}
+        if lazy:
+            from ..columnar.packing import fetch_packed
+            got = fetch_packed([v for _, _, v in lazy])
+            for (eid, name, _), v in zip(lazy, got):
+                forced[(eid, name)] = v.item() if hasattr(v, "item") else v
+        self._data = {
+            eid: {name: forced.get((eid, name), v)
+                  for name, v in ms.items()}
+            for eid, ms in self._raw.items()}
+        return self._data
+
+    def __getitem__(self, k):
+        return self._force()[k]
+
+    def __iter__(self):
+        return iter(self._force())
+
+    def __len__(self):
+        return len(self._force())
+
+    def __repr__(self):
+        return repr(self._force())
+
+
+def metrics_summary(ctx):
     """Per-exec metric values keyed by exec id (the SQL-UI GpuMetric view,
-    GpuExec.scala:54-165; levels preserved)."""
-    out: Dict[str, Dict[str, object]] = {}
-    for exec_id, ms in ctx.metrics.items():
-        # metric adds may accumulate lazy device scalars (row counts kept
-        # unforced to avoid tunnel syncs); force to plain ints ONCE here
-        out[exec_id] = {name: (m.value.item()
-                               if hasattr(m.value, "item") else m.value)
-                        for name, m in ms.items()}
-    return out
+    GpuExec.scala:54-165; levels preserved). Lazy: see LazyMetricsView."""
+    # snapshot the raw VALUES of THIS query now — Metric objects live on
+    # the session-cached context and later queries mutate them
+    snap = {exec_id: {name: m.value for name, m in ms.items()}
+            for exec_id, ms in ctx.metrics.items()}
+    return LazyMetricsView(snap)

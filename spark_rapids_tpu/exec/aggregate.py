@@ -300,7 +300,7 @@ class TpuHashAggregateExec(TpuExec):
         import pyarrow as pa
         from ..columnar import DictColumn
         from ..exprs.base import Alias, ColumnRef
-        p, n = batch.padded_len, batch.num_rows
+        p = batch.padded_len
         d = self._dicts[j]
         g = self.groupings[i]
         if isinstance(g, Alias):
@@ -324,6 +324,7 @@ class TpuHashAggregateExec(TpuExec):
         idx = np.asarray(de.indices.fill_null(0).to_numpy(
             zero_copy_only=False), dtype=np.int64)
         codes = gmap[idx] if len(gmap) else np.zeros(len(idx), np.int32)
+        n = batch.num_rows      # host encode needs the exact count anyway
         data = np.zeros(p, dtype=np.int32)
         vmask = np.zeros(p, dtype=bool)
         data[:n] = codes[:n]
