@@ -179,7 +179,11 @@ class ColumnarBatch:
                 vals = arr.fill_null(fill).to_numpy(zero_copy_only=False)
                 d, v = DeviceColumn.host_prepare(vals, dt, mask=~mask,
                                                  padded_len=p)
-                staged.append((len(cols), dt, None))
+                # canonical arrow type NOW so mirror-served batches have
+                # the same schema a device round trip would produce
+                from ..types import to_arrow as _toa
+                mirror = col if col.type == _toa(dt) else col.cast(_toa(dt))
+                staged.append((len(cols), dt, None, mirror))
                 host_pairs.extend([d, v])
                 cols.append(None)
             else:
@@ -189,7 +193,10 @@ class ColumnarBatch:
                        if dt == STRING and pad else None)
                 if enc is not None:
                     d, v, dictionary = enc
-                    staged.append((len(cols), dt, dictionary))
+                    from ..types import to_arrow as _toa
+                    mirror = (col if col.type == _toa(dt)
+                              else col.cast(_toa(dt)))
+                    staged.append((len(cols), dt, dictionary, mirror))
                     host_pairs.extend([d, v])
                     cols.append(None)
                 else:
@@ -198,12 +205,13 @@ class ColumnarBatch:
             # ONE device_put for the whole table: each separate transfer
             # pays a full round trip on a tunneled TPU backend
             put = jax.device_put(host_pairs)
-            for k, (i, dt, dictionary) in enumerate(staged):
+            for k, (i, dt, dictionary, mirror) in enumerate(staged):
                 if dictionary is None:
-                    cols[i] = DeviceColumn(put[2 * k], put[2 * k + 1], dt)
+                    cols[i] = DeviceColumn(put[2 * k], put[2 * k + 1], dt,
+                                           host_mirror=mirror)
                 else:
                     cols[i] = DictColumn(put[2 * k], put[2 * k + 1], dt,
-                                         dictionary)
+                                         dictionary, host_mirror=mirror)
         return ColumnarBatch(cols, n, Schema(fields))
 
     @staticmethod
@@ -228,9 +236,20 @@ class ColumnarBatch:
         import pyarrow as pa
         # column-by-column: pa.Table.from_pandas rejects duplicate column
         # names, which are legal in intermediate frames (e.g. t.k joined
-        # with r.k — Spark allows ambiguous names until they're referenced)
-        arrays = [pa.Array.from_pandas(df.iloc[:, i])
-                  for i in range(df.shape[1])]
+        # with r.k — Spark allows ambiguous names until they're referenced).
+        # Copy numeric buffers: Array.from_pandas zero-copies null-free
+        # numpy columns, and ingested arrays become host mirrors that must
+        # be snapshots (the user may mutate the DataFrame afterwards)
+        arrays = []
+        for i in range(df.shape[1]):
+            series = df.iloc[:, i]
+            vals = series.to_numpy()
+            if vals.dtype != object:
+                vals = np.array(vals, copy=True)
+                arrays.append(pa.Array.from_pandas(
+                    __import__("pandas").Series(vals, index=series.index)))
+            else:
+                arrays.append(pa.Array.from_pandas(series))
         table = pa.Table.from_arrays(arrays,
                                      names=[str(c) for c in df.columns])
         return ColumnarBatch.from_arrow(table, buckets)
@@ -241,11 +260,29 @@ class ColumnarBatch:
         # ONE packed transfer for every device column (leaf-by-leaf waits
         # pay per-transfer latency on a tunneled TPU)
         dev = [(i, c) for i, c in enumerate(self.columns)
-               if isinstance(c, DeviceColumn)]
+               if isinstance(c, DeviceColumn)
+               and getattr(c, "host_mirror", None) is None]
+        mirror_pos = {i for i, c in enumerate(self.columns)
+                      if isinstance(c, DeviceColumn)
+                      and getattr(c, "host_mirror", None) is not None}
         fetched = {}
         if dev:
-            flat = [x for _, c in dev for x in (c.data, c.validity)]
             lazy = not isinstance(self._num_rows, int)
+            # fetch only a prefix covering num_rows (64k granularity keeps
+            # the pack-kernel variant count small): at ~10 MB/s tunnel
+            # bandwidth the padded tail is pure waste
+            cut = None
+            if not lazy:
+                cut = min(self.padded_len,
+                          ((self._num_rows + 65535) // 65536) * 65536)
+                if cut == 0:
+                    cut = 1
+            flat = []
+            for _, c in dev:
+                d, v = c.data, c.validity
+                if cut is not None and cut < c.padded_len:
+                    d, v = d[:cut], v[:cut]
+                flat.extend((d, v))
             if lazy:
                 flat.append(self._num_rows)   # ride the same transfer
             got = fetch_packed(flat)
@@ -262,6 +299,8 @@ class ColumnarBatch:
         for i, c in enumerate(self.columns):
             if i in fetched:
                 arrays.append(c.arrow_from_host(*fetched[i]))
+            elif i in mirror_pos:
+                arrays.append(c.host_mirror.slice(0, self.num_rows))
             else:
                 arrays.append(c.to_arrow(self.num_rows))
         return pa.Table.from_arrays(arrays, names=self.schema.names())

@@ -31,12 +31,18 @@ class DeviceColumn:
     (padded) length. Slots where validity is False hold the dtype's default
     value so arithmetic never sees garbage (NaN-free padding)."""
 
-    __slots__ = ("data", "validity", "dtype")
+    __slots__ = ("data", "validity", "dtype", "host_mirror")
 
-    def __init__(self, data, validity, dtype: DataType):
+    def __init__(self, data, validity, dtype: DataType, host_mirror=None):
         self.data = data
         self.validity = validity
         self.dtype = dtype
+        #: the SOURCE arrow array this column was ingested from, when the
+        #: device content is a verbatim padded copy of it. Materialization
+        #: serves a prefix slice of the mirror instead of a D2H fetch
+        #: (tunnel transfers run at ~10-30 MB/s). Any transform that
+        #: rearranges rows goes through with_arrays(), which drops it.
+        self.host_mirror = host_mirror
 
     # -- constructors ------------------------------------------------------
     @staticmethod
@@ -164,8 +170,8 @@ class DictColumn(DeviceColumn):
     __slots__ = ("dictionary",)
 
     def __init__(self, data, validity, dtype: DataType,
-                 dictionary: np.ndarray):
-        super().__init__(data, validity, dtype)
+                 dictionary: np.ndarray, host_mirror=None):
+        super().__init__(data, validity, dtype, host_mirror=host_mirror)
         self.dictionary = dictionary     # np object/str array, sorted
 
     def with_arrays(self, data, validity) -> "DictColumn":
