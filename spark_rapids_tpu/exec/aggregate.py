@@ -40,6 +40,11 @@ from .groupby_core import segmented_groupby
 __all__ = ["TpuHashAggregateExec", "CpuAggregateExec"]
 
 _AGG_KERNEL_CACHE: Dict[Tuple, object] = {}
+#: last observed group count per kernel shape: the optimistic single-
+#: fetch attempt is skipped while the statistic exceeds the bound and
+#: refreshed on every execution, so it adapts back when the data changes
+#: (the aggregate analog of the joins' _TOTAL_STATS sizing)
+_FAST_GROUPS: Dict[Tuple, int] = {}
 
 
 def _build_groupby_kernel(key_exprs: Sequence[Expression],
@@ -607,6 +612,7 @@ class TpuHashAggregateExec(TpuExec):
         got = unpack_streams(u32, f64, specs)
         n = int(got[0])
         if n > self.OPTIMISTIC_GROUPS:
+            _FAST_GROUPS[self._kernel_key] = n
             return None
         out_cols = []
         dict_pos = {i: j for j, i in enumerate(self._dict_keys)}
@@ -645,7 +651,9 @@ class TpuHashAggregateExec(TpuExec):
         it = self.children[0].execute(ctx)
         first = next(it, None)
         second = next(it, None) if first is not None else None
-        if first is not None and second is None:
+        if first is not None and second is None \
+                and _FAST_GROUPS.get(self._kernel_key, 0) \
+                <= self.OPTIMISTIC_GROUPS:
             first = first.ensure_device()
 
             def run_fast():
@@ -653,6 +661,7 @@ class TpuHashAggregateExec(TpuExec):
                     return self._fast_single_batch(ctx, first, update_k)
             out = with_retry_no_split(run_fast, ctx.memory)
             if out is not None:
+                _FAST_GROUPS[self._kernel_key] = out.num_rows
                 rows_m.add(out.num_rows)
                 yield out
                 return
@@ -687,6 +696,7 @@ class TpuHashAggregateExec(TpuExec):
         else:
             merged = self._merge(ctx, partials)
         final = self._finalize(ctx, merged)
+        _FAST_GROUPS[self._kernel_key] = final.num_rows   # refresh stat
         rows_m.add(final.num_rows)
         yield final
 
