@@ -514,10 +514,15 @@ class DataFrame:
         return df.collect_arrow()
 
     def write_hive_text(self, path: str, mode: str = "overwrite",
-                        partition_by: Sequence[str] = ()):
+                        partition_by: Sequence[str] = (),
+                        field_delim: Optional[str] = None,
+                        null_value: Optional[str] = None):
+        opts = {k: v for k, v in (("field_delim", field_delim),
+                                  ("null_value", null_value))
+                if v is not None}
         df = DataFrame(self.session,
                        L.WriteFile(path, "hive_text", self.plan, mode,
-                                   partition_by))
+                                   partition_by, opts))
         return df.collect_arrow()
 
     def explain(self, mode: str = "physical") -> str:

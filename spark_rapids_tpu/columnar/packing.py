@@ -58,6 +58,11 @@ def pack_traced(flat):
             words.append(jnp.stack([lo, hi], axis=1).reshape(-1))
         elif a.dtype.itemsize == 4:
             words.append(jax.lax.bitcast_convert_type(a, jnp.uint32))
+        elif jnp.issubdtype(a.dtype, jnp.floating):
+            # f16/bf16: value-cast would drop fraction bits — carry the
+            # raw 16-bit pattern instead
+            words.append(jax.lax.bitcast_convert_type(a, jnp.uint16)
+                         .astype(jnp.uint32))
         else:                            # 1/2-byte ints: widen (rare)
             words.append(a.astype(jnp.uint32))
     u32 = (jnp.concatenate(words) if words
@@ -93,6 +98,8 @@ def unpack_streams(u32, f64, specs):
                 arr = ((pair[:, 1] << np.uint64(32)) | pair[:, 0]).view(dt)
             elif dt.itemsize == 4:
                 arr = raw.view(dt)
+            elif np.issubdtype(dt, np.floating) or dt.kind == 'V':
+                arr = raw.astype(np.uint16).view(dt)
             else:
                 arr = raw.astype(dt)
         out.append(arr.reshape(shape) if shape else arr[0])

@@ -257,6 +257,17 @@ PROFILE_PATH = register(
     "When set, capture XLA/TPU profiler traces to this path "
     "(ref profiler.scala ProfilerOnExecutor).")
 
+DELTA_OPTIMIZE_WRITE_TARGET_ROWS = register(
+    "spark.rapids.tpu.delta.optimizeWrite.targetRows", 1 << 20,
+    "Target rows per output file when delta.autoOptimize.optimizeWrite is set "
+    "on a table (ref GpuOptimizeWriteExchangeExec.scala); also the "
+    "auto-compaction target size.")
+
+DELTA_AUTO_COMPACT_MIN_FILES = register(
+    "spark.rapids.tpu.delta.autoCompact.minNumFiles", 8,
+    "Minimum number of sub-target-size files before post-commit "
+    "auto-compaction folds them (ref delta autoCompact.minNumFiles).")
+
 SHAPE_BUCKETS = register(
     "spark.rapids.tpu.sql.shapeBuckets", "1024,8192,65536,262144,1048576,4194304",
     "Row-count bucket ladder; batches pad up to the nearest bucket so each "

@@ -20,6 +20,9 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..columnar import ColumnarBatch
+from ..config import (DELTA_AUTO_COMPACT_MIN_FILES as AUTO_COMPACT_MIN_FILES,
+                      DELTA_OPTIMIZE_WRITE_TARGET_ROWS
+                      as OPTIMIZE_WRITE_TARGET_ROWS)
 from ..exprs.base import Expression
 from ..types import Schema
 from .deletion_vectors import read_deletion_vector, write_deletion_vector
@@ -186,8 +189,7 @@ def _split_partitions(data, part_cols):
 def _optimize_write_target(session, cfg: Dict[str, str]) -> int:
     if cfg.get("delta.autoOptimize.optimizeWrite", "").lower() != "true":
         return 0
-    return int(session.conf.raw.get(
-        "spark.rapids.tpu.delta.optimizeWrite.targetRows", 1 << 20))
+    return int(OPTIMIZE_WRITE_TARGET_ROWS.get(session.conf))
 
 
 def _maybe_auto_compact(session, path: str, cfg: Dict[str, str]) -> None:
@@ -197,10 +199,8 @@ def _maybe_auto_compact(session, path: str, cfg: Dict[str, str]) -> None:
     if cfg.get("delta.autoOptimize.autoCompact", "").lower() != "true":
         return
     import pyarrow as pa
-    min_files = int(session.conf.raw.get(
-        "spark.rapids.tpu.delta.autoCompact.minNumFiles", 8))
-    target = int(session.conf.raw.get(
-        "spark.rapids.tpu.delta.optimizeWrite.targetRows", 1 << 20))
+    min_files = int(AUTO_COMPACT_MIN_FILES.get(session.conf))
+    target = int(OPTIMIZE_WRITE_TARGET_ROWS.get(session.conf))
     dt = DeltaTable(session, path)
     snap = dt.log.snapshot()
     small = [a for a in snap.files.values()

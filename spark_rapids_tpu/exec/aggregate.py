@@ -113,6 +113,7 @@ def _build_groupby_kernel(key_exprs: Sequence[Expression],
         return segmented_groupby(keys, vals, aggs, mode, num_rows,
                                  padded_len, row_mask=keep)
 
+    kernel.n_param_slots = len(slots)
     return kernel
 
 
@@ -188,6 +189,18 @@ def _agg_kernel_key(key_exprs, aggs, schema, mode, in_schema=None,
                 tuple((f.name, f.dtype.name) for f in in_schema.fields)
                 if in_schema is not None else None,
                 _stage_key(stages), n_codes)
+
+
+def _check_scalar_slots(kernel, scalars):
+    """Kernel slot maps and call-site scalars come from SEPARATE
+    traversals of the parameterizable-literal set (value_exprs at build
+    vs fresh input_exprs() at call); the alignment is an invariant, not a
+    given — fail loudly instead of silently misbinding constants."""
+    n = getattr(kernel, "n_param_slots", None)
+    if n is not None and n != len(scalars):
+        raise RuntimeError(
+            f"aggregate kernel literal-slot mismatch: kernel built with "
+            f"{n} parameter slots, call site collected {len(scalars)}")
 
 
 def _get_kernel(key_exprs, aggs, schema, mode, partial_counts=None,
@@ -274,6 +287,7 @@ class TpuHashAggregateExec(TpuExec):
                 cols.append(None)
         for c in extra_cols:
             cols.append((c.data, c.validity))
+        _check_scalar_slots(kernel, scalars)
         key_outs, partial_outs, num_groups = kernel(
             cols, jnp.int32(batch.num_rows_raw), batch.padded_len, scalars)
         n = int(num_groups)
@@ -443,6 +457,7 @@ class TpuHashAggregateExec(TpuExec):
 
         spec_cell = {}
         fast.out_specs = spec_cell
+        fast.n_param_slots = getattr(update_k, "n_param_slots", None)
         _AGG_KERNEL_CACHE[("fast",) + kernel_key] = fast
         return fast
 
@@ -561,6 +576,7 @@ class TpuHashAggregateExec(TpuExec):
 
         spec_cell = {}
         fast_direct.out_specs = spec_cell
+        fast_direct.n_param_slots = len(slots)
         _AGG_KERNEL_CACHE[key] = fast_direct
         return fast_direct
 
@@ -593,6 +609,7 @@ class TpuHashAggregateExec(TpuExec):
                     jnp.asarray(np.pad(r, (0, max(Gb - len(r), 0)))[:Gb])
                     for r in remaps)
                 fast = self._get_fast_direct_kernel(Gb)
+                _check_scalar_slots(fast, self._upd_scalars)
                 packed = fast(base_cols, jnp.int32(batch.num_rows_raw),
                               batch.padded_len, jnp.asarray(cards),
                               self._upd_scalars, tuple(pairs),
@@ -604,6 +621,7 @@ class TpuHashAggregateExec(TpuExec):
             if self._fast_k is None:
                 self._fast_k = self._get_fast_kernel(update_k,
                                                      self._kernel_key)
+            _check_scalar_slots(self._fast_k, self._upd_scalars)
             packed = self._fast_k(
                 cols, jnp.int32(batch.num_rows_raw), batch.padded_len,
                 self._upd_scalars)

@@ -72,7 +72,7 @@ def _hive_parse(text: str, delim: str, null_value: str):
     an entire cell is the NULL marker; a literal backslash-N is written
     (and read back) as ``\\\\N``."""
     rows, row, cell = [], [], []
-    is_null = False
+    is_null = escaped = False
     i, n = 0, len(text)
     while i < n:
         ch = text[i]
@@ -83,33 +83,36 @@ def _hive_parse(text: str, delim: str, null_value: str):
                 is_null = True
             else:
                 cell.append({"n": "\n", "r": "\r", "t": "\t"}.get(nxt, nxt))
+                escaped = True
             i += 2
             continue
         if ch == delim:
-            row.append(_hive_finish(cell, is_null, null_value))
-            cell, is_null = [], False
+            row.append(_hive_finish(cell, is_null, null_value, escaped))
+            cell, is_null, escaped = [], False, False
             i += 1
             continue
         if ch == "\n":
-            row.append(_hive_finish(cell, is_null, null_value))
+            row.append(_hive_finish(cell, is_null, null_value, escaped))
             rows.append(row)
-            row, cell, is_null = [], [], False
+            row, cell, is_null, escaped = [], [], False, False
             i += 1
             continue
         cell.append(ch)
         i += 1
     if cell or row or is_null:
-        row.append(_hive_finish(cell, is_null, null_value))
+        row.append(_hive_finish(cell, is_null, null_value, escaped))
         rows.append(row)
     return rows
 
 
-def _hive_finish(cell, is_null: bool, null_value: str):
+def _hive_finish(cell, is_null: bool, null_value: str, escaped: bool):
     if is_null:
         return None
     s = "".join(cell)
-    # custom (non-backslash) null markers compare against the raw cell
-    if null_value != "\\N" and s == null_value:
+    # custom (non-backslash) null markers compare against the raw cell;
+    # a cell containing ANY escape is a literal value, never the marker
+    # (the writer escapes marker-colliding values — see write_hive_text)
+    if null_value != "\\N" and not escaped and s == null_value:
         return None
     return s
 
@@ -143,21 +146,65 @@ def write_hive_text(table, path: str, field_delim: str = HIVE_FIELD_DELIM,
     """Arrow table -> one Hive text file; backslash-escapes the delimiter,
     newlines, tabs, and backslashes inside values (LazySimpleSerDe
     escaping) so every value round-trips."""
+    _check_hive_options(field_delim, null_value)
     cols = [table.column(i).to_pylist() for i in range(table.num_columns)]
     with open(path, "w", encoding="utf-8", newline="") as f:
         for row in zip(*cols) if cols else []:
             f.write(field_delim.join(
-                null_value if v is None else _hive_cell(v, field_delim)
+                null_value if v is None
+                else _hive_cell(v, field_delim, null_value)
                 for v in row) + "\n")
 
 
-def _hive_cell(v, delim: str) -> str:
+def _hive_cell(v, delim: str, null_value: str = HIVE_NULL) -> str:
     if isinstance(v, bool):
         return "true" if v else "false"
-    s = str(v)
-    s = (s.replace("\\", "\\\\").replace(delim, "\\" + delim)
-          .replace("\n", "\\n").replace("\r", "\\r").replace("\t", "\\t"))
+    # single pass: with a control-char delimiter (e.g. tabs) chained
+    # replaces would re-escape the backslash-delim pair into garbage
+    out = []
+    for ch in str(v):
+        if ch == "\\":
+            out.append("\\\\")
+        elif ch == "\n":
+            out.append("\\n")
+        elif ch == "\r":
+            out.append("\\r")
+        elif ch == "\t":
+            out.append("\\t")
+        elif ch == delim:
+            out.append("\\" + ch)
+        else:
+            out.append(ch)
+    s = "".join(out)
+    if null_value != HIVE_NULL and s == null_value:
+        # a literal value colliding with a custom NULL marker: escape the
+        # first safely-escapable char so the reader sees a literal cell
+        # (backslash before n/r/t would decode to a control char instead;
+        # _check_hive_options guarantees such a char exists)
+        for i, ch in enumerate(s):
+            if ch not in "nrt":
+                return s[:i] + "\\" + s[i:]
     return s
+
+
+def _check_hive_options(field_delim: str, null_value: str) -> None:
+    """Reject delimiter/marker choices the escape grammar cannot
+    round-trip (silent-corruption holes otherwise)."""
+    if len(field_delim) != 1:
+        raise ValueError("hive text field_delim must be one character")
+    if field_delim in "nrt\\":
+        raise ValueError(
+            f"hive text field_delim {field_delim!r} collides with the "
+            "backslash escape alphabet (\\n/\\r/\\t) and cannot round-trip")
+    if null_value != HIVE_NULL:
+        if any(c in null_value for c in (field_delim, "\\", "\n", "\r")):
+            raise ValueError(
+                f"hive text null_value {null_value!r} contains the field "
+                "delimiter, a backslash, or a newline and cannot round-trip")
+        if null_value and all(c in "nrt" for c in null_value):
+            raise ValueError(
+                f"hive text null_value {null_value!r} uses only n/r/t "
+                "characters; colliding values could not be escaped")
 
 
 def json_to_tables(paths: Sequence[str],

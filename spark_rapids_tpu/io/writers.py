@@ -19,12 +19,14 @@ class FileWriteExec(TpuExec):
     (rows written) like the reference's BasicColumnarWriteStatsTracker."""
 
     def __init__(self, child: TpuExec, path: str, file_format: str,
-                 mode: str = "overwrite", partition_by: Sequence[str] = ()):
+                 mode: str = "overwrite", partition_by: Sequence[str] = (),
+                 options=None):
         super().__init__([child])
         self.path = path
         self.file_format = file_format
         self.mode = mode
         self.partition_by = list(partition_by)
+        self.options = dict(options or {})
 
     def output_schema(self) -> Schema:
         return Schema([StructField("rows_written", INT64, False),
@@ -90,8 +92,12 @@ class FileWriteExec(TpuExec):
             import pyarrow.orc as porc
             porc.write_table(table, base + ".orc")
         elif self.file_format == "hive_text":
-            from .text import write_hive_text
-            write_hive_text(table, base + ".txt")
+            from .text import HIVE_FIELD_DELIM, HIVE_NULL, write_hive_text
+            write_hive_text(
+                table, base + ".txt",
+                field_delim=self.options.get("field_delim",
+                                             HIVE_FIELD_DELIM),
+                null_value=self.options.get("null_value", HIVE_NULL))
         else:
             raise ValueError(f"unsupported format {self.file_format}")
 

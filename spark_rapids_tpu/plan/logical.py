@@ -355,11 +355,15 @@ class Repartition(LogicalPlan):
 
 class WriteFile(LogicalPlan):
     def __init__(self, path: str, file_format: str, child: LogicalPlan,
-                 mode: str = "overwrite", partition_by: Sequence[str] = ()):
+                 mode: str = "overwrite", partition_by: Sequence[str] = (),
+                 options: Optional[dict] = None):
         self.path = path
         self.file_format = file_format
         self.mode = mode
         self.partition_by = list(partition_by)
+        #: format-specific writer options (e.g. hive text field_delim /
+        #: null_value) so reads and writes can round-trip non-defaults
+        self.options = dict(options or {})
         self.children = [child]
 
     def schema(self):

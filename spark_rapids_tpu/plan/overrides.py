@@ -433,7 +433,7 @@ class WriteMeta(PlanMeta):
         from ..io.writers import FileWriteExec
         p = self.plan
         return FileWriteExec(children[0], p.path, p.file_format, p.mode,
-                             p.partition_by)
+                             p.partition_by, getattr(p, "options", None))
 
     convert_to_cpu = convert_to_tpu
 

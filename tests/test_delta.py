@@ -450,6 +450,29 @@ def test_delta_identity_columns(tmp_path):
     assert ids == [100, 110, 120, 130]
 
 
+def test_delta_identity_zero_row_append_keeps_schema(tmp_path):
+    """A zero-row append that omits the identity column must still write a
+    file carrying the full declared schema in declared order (ADVICE r1)."""
+    s = tpu_session()
+    p = str(tmp_path / "t")
+    s.create_dataframe(pa.table({"id": pa.array([], pa.int64()),
+                                 "v": pa.array([], pa.float64())})) \
+        .write_delta(p)
+    dt = s.delta_table(p)
+    dt.add_identity_column("id", start=1, step=1)
+    s.create_dataframe(pa.table({"v": pa.array([], pa.float64())})) \
+        .write_delta(p, mode="append")
+    import glob
+
+    import pyarrow.parquet as pq
+    newest = max(glob.glob(str(tmp_path / "t" / "*.parquet")),
+                 key=os.path.getmtime)
+    assert pq.read_schema(newest).names == ["id", "v"]
+    # and the table still reads + generates correctly afterwards
+    s.create_dataframe(pa.table({"v": [7.0]})).write_delta(p, mode="append")
+    assert [r["id"] for r in dt.to_df().collect()] == [1]
+
+
 def test_delta_optimize_write_and_auto_compact(tmp_path):
     """ref GpuOptimizeWriteExchangeExec + auto-compaction."""
     s = tpu_session({"spark.rapids.tpu.delta.optimizeWrite.targetRows": 100,

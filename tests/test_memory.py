@@ -215,3 +215,27 @@ def test_spillable_batch_disk_tier_uses_native_store(tmp_path):
     sb.close()
     from spark_rapids_tpu.mem.native_spill import get_store
     assert get_store(str(tmp_path / "sp")).stats()["live_blocks"] == 0
+
+
+def test_fetch_packed_roundtrip_all_dtypes():
+    """Two-stream packed fetch must round-trip every dtype bit-exactly —
+    including sub-4-byte floats, whose bit patterns must be carried, not
+    value-cast (ADVICE r1: astype would truncate f16/bf16 fractions)."""
+    import jax.numpy as jnp
+    import numpy as np
+    from spark_rapids_tpu.columnar.packing import fetch_packed
+    rng = np.random.default_rng(7)
+    arrays = [
+        np.arange(10, dtype=np.int32),
+        rng.standard_normal(7).astype(np.float32),
+        np.array([True, False, True] * 20),
+        np.arange(-5, 5, dtype=np.int64) * (1 << 40),
+        rng.standard_normal(5).astype(np.float64),
+        np.array([1.5, -2.25, 3.75, 1e-3], dtype=np.float16),
+        np.array([0, 1, 255], dtype=np.uint8),
+    ]
+    dev = [jnp.asarray(a) for a in arrays]
+    got = fetch_packed(dev)
+    for orig, back in zip(arrays, got):
+        assert back.dtype == orig.dtype, (back.dtype, orig.dtype)
+        np.testing.assert_array_equal(np.asarray(back), orig)
