@@ -1,13 +1,18 @@
-"""Benchmark: real TPC-H Q1 on the device engine (BASELINE.md ladder #2).
+"""Benchmark ladder: TPC-H q1/q6, TPC-DS q3/q9/q28, bounded window.
 
-Generated lineitem (benchmarks/tpch.py, TPC-H column domains), the full Q1
-pricing-summary query — date filter -> projections -> string-keyed grouped
-aggregation (8 aggregates). Baseline = the same query through pandas on
-this host's CPU (the role CPU Spark plays for the reference's speedups).
+Covers BASELINE.md configs #2/#3 plus the window workload so regressions in
+ANY ladder query are visible to the driver every round (VERDICT r1 #3), not
+just the winning one. Baseline = the same queries through pandas on this
+host's CPU (the role CPU Spark plays for the reference's speedups).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints one JSON line per workload (metric/value/unit/vs_baseline) and a
+final summary line whose vs_baseline is the geometric mean of the
+per-workload speedups — the driver's single-line parse lands on the
+summary; the per-workload lines ride along in the recorded tail and in the
+summary's "details".
+
 Env: SRTPU_BENCH_CPU=1 forces the JAX CPU backend; SRTPU_BENCH_ROWS
-overrides the row count.
+overrides the row count; SRTPU_BENCH_ITERS the per-workload iterations.
 """
 from __future__ import annotations
 
@@ -23,53 +28,90 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+def _time_min(fn, iters):
+    best = float("inf")
+    out = None
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = fn()
+        best = min(best, time.perf_counter() - t0)
+    return best, out
+
+
+def gen_window_table(n: int, seed: int = 11):
+    import pyarrow as pa
+    rng = np.random.RandomState(seed)
+    return pa.table({
+        "p": pa.array(rng.randint(0, 512, n)),
+        "o": pa.array(rng.randint(0, 1 << 30, n)),
+        "v": pa.array(rng.uniform(-100.0, 100.0, n)),
+    })
+
+
 def main():
     if os.environ.get("SRTPU_BENCH_CPU") == "1":
         import jax
         jax.config.update("jax_platforms", "cpu")
     import jax
-    import pyarrow as pa
 
     from spark_rapids_tpu.api import TpuSession, functions as F
 
-    from benchmarks import tpch
+    from benchmarks import tpch, tpcds
 
     n = int(os.environ.get("SRTPU_BENCH_ROWS", 1_000_000))
-    table = tpch.gen_lineitem(n)
-    log(f"bench: TPC-H Q1, {n}-row lineitem on {jax.devices()[0].platform}")
+    iters = int(os.environ.get("SRTPU_BENCH_ITERS", 3))
+    nw = min(n, 500_000)
+    lineitem = tpch.gen_lineitem(n)
+    store_sales = tpcds.gen_store_sales(n)
+    date_dim = tpcds.gen_date_dim()
+    item = tpcds.gen_item()
+    wtab = gen_window_table(nw)
+    log(f"bench: ladder on {jax.devices()[0].platform}, {n} rows, "
+        f"{iters} iters")
 
-    def run_engine():
+    # ---------------- engine side ----------------
+    def eng_q1():
         s = TpuSession()
-        return tpch.q1(s.create_dataframe(table), F).collect_arrow()
+        return tpch.q1(s.create_dataframe(lineitem), F).collect_arrow()
 
-    # warm-up (compilation) then timed runs; min-of-iters on both sides
-    # (wall-clock on a shared host is noisy — min is the stable statistic)
-    t0 = time.perf_counter()
-    res = run_engine()
-    warm = time.perf_counter() - t0
-    log(f"bench: warm-up (incl. compile) {warm:.2f}s, groups={res.num_rows}")
-    iters = 5
-    engine_s = float("inf")
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        res = run_engine()
-        engine_s = min(engine_s, time.perf_counter() - t0)
-    engine_rate = n / engine_s
-    log(f"bench: engine {engine_s:.3f}s/iter -> {engine_rate:,.0f} rows/s")
+    def eng_q6():
+        s = TpuSession()
+        return tpch.q6(s.create_dataframe(lineitem), F).collect_arrow()
 
-    # pandas CPU baseline (the reference's CPU-Spark role). Parity of
-    # starting point: each iteration begins from the SAME in-memory Arrow
-    # table the engine ingests (the engine side pays H2D per iteration;
-    # pandas pays its own arrow->numpy materialization).
-    cutoff = np.datetime64("1998-12-01") - np.timedelta64(90, "D")
-    base_s = float("inf")
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        pdf = table.to_pandas(date_as_object=False)
-        f = pdf[pdf["l_shipdate"] <= cutoff.astype("datetime64[ns]")].copy()
+    def eng_q3():
+        s = TpuSession()
+        return tpcds.q3(s.create_dataframe(store_sales),
+                        s.create_dataframe(date_dim),
+                        s.create_dataframe(item), F).collect_arrow()
+
+    def eng_q9():
+        s = TpuSession()
+        return tpcds.q9(s.create_dataframe(store_sales), F).collect_arrow()
+
+    def eng_q28():
+        s = TpuSession()
+        return tpcds.q28(s.create_dataframe(store_sales), F).collect_arrow()
+
+    def eng_window():
+        from spark_rapids_tpu.exprs import ColumnRef
+        from spark_rapids_tpu.exprs.aggregates import Sum
+        s = TpuSession()
+        return (s.create_dataframe(wtab)
+                .with_window_column("wsum", Sum(ColumnRef("v")),
+                                    partition_by=["p"],
+                                    order_by=[F.col("o").asc()],
+                                    frame=("rows", -2, 0))
+                .collect_arrow())
+
+    # ---------------- pandas baselines ----------------
+    def base_q1():
+        pdf = lineitem.to_pandas(date_as_object=False)
+        cutoff = (np.datetime64("1998-12-01")
+                  - np.timedelta64(90, "D")).astype("datetime64[ns]")
+        f = pdf[pdf["l_shipdate"] <= cutoff].copy()
         f["disc_price"] = f["l_extendedprice"] * (1.0 - f["l_discount"])
         f["charge"] = f["disc_price"] * (1.0 + f["l_tax"])
-        base = f.groupby(["l_returnflag", "l_linestatus"]).agg(
+        return f.groupby(["l_returnflag", "l_linestatus"]).agg(
             sum_qty=("l_quantity", "sum"),
             sum_base_price=("l_extendedprice", "sum"),
             sum_disc_price=("disc_price", "sum"),
@@ -78,24 +120,135 @@ def main():
             avg_price=("l_extendedprice", "mean"),
             avg_disc=("l_discount", "mean"),
             count_order=("l_quantity", "size")).sort_index()
-        base_s = min(base_s, time.perf_counter() - t0)
-    base_rate = n / base_s
-    log(f"bench: pandas {base_s:.3f}s/iter -> {base_rate:,.0f} rows/s")
 
-    # correctness spot-check against the baseline
+    def base_q6():
+        pdf = lineitem.to_pandas(date_as_object=False)
+        m = ((pdf["l_shipdate"] >= np.datetime64("1994-01-01"))
+             & (pdf["l_shipdate"] < np.datetime64("1995-01-01"))
+             & (pdf["l_discount"] >= 0.05) & (pdf["l_discount"] <= 0.07)
+             & (pdf["l_quantity"] < 24.0))
+        f = pdf[m]
+        return float((f["l_extendedprice"] * f["l_discount"]).sum())
+
+    def base_q3():
+        ss = store_sales.to_pandas()
+        dd = date_dim.to_pandas(date_as_object=False)
+        it = item.to_pandas()
+        dd = dd[dd["d_moy"] == 11]
+        it = it[it["i_manufact_id"] == 128]
+        j = ss.merge(dd, left_on="ss_sold_date_sk", right_on="d_date_sk")
+        j = j.merge(it, left_on="ss_item_sk", right_on="i_item_sk")
+        g = (j.groupby(["d_year", "i_brand_id", "i_brand"], as_index=False)
+             ["ss_ext_sales_price"].sum()
+             .rename(columns={"ss_ext_sales_price": "sum_agg"}))
+        return g.sort_values(["d_year", "sum_agg", "i_brand_id"],
+                             ascending=[True, False, True])
+
+    def base_q9():
+        ss = store_sales.to_pandas()
+        out = {}
+        for i, (lo, hi) in enumerate(
+                [(1, 20), (21, 40), (41, 60), (61, 80), (81, 100)], 1):
+            m = (ss["ss_quantity"] >= lo) & (ss["ss_quantity"] <= hi)
+            out[f"cnt{i}"] = int(m.sum())
+            out[f"avg_price{i}"] = float(ss.loc[m, "ss_ext_sales_price"].mean())
+            out[f"avg_paid{i}"] = float(ss.loc[m, "ss_net_paid"].mean())
+        return out
+
+    def base_q28():
+        ss = store_sales.to_pandas()
+        buckets = [(0, 5, 11, 460, 14930), (6, 10, 91, 1430, 32370),
+                   (11, 15, 66, 1480, 3750), (16, 20, 142, 3270, 21910),
+                   (21, 25, 135, 2450, 17300), (26, 30, 28, 2340, 33660)]
+        rows = []
+        for lo, hi, lp, cp, wc in buckets:
+            m = ((ss["ss_quantity"] >= lo) & (ss["ss_quantity"] <= hi)
+                 & ((ss["ss_list_price"] >= float(lp))
+                    | (ss["ss_coupon_amt"] >= float(cp))
+                    | (ss["ss_wholesale_cost"] >= float(wc))))
+            b = ss.loc[m, "ss_list_price"]
+            rows.append((float(b.mean()), int(b.count()), int(b.nunique())))
+        return rows
+
+    def base_window():
+        pdf = wtab.to_pandas()
+        pdf = pdf.sort_values(["p", "o"], kind="stable")
+        pdf["wsum"] = (pdf.groupby("p")["v"]
+                       .rolling(3, min_periods=1).sum()
+                       .reset_index(level=0, drop=True))
+        return pdf
+
+    workloads = [
+        ("tpch_q1", eng_q1, base_q1),
+        ("tpch_q6", eng_q6, base_q6),
+        ("tpcds_q3", eng_q3, base_q3),
+        ("tpcds_q9", eng_q9, base_q9),
+        ("tpcds_q28", eng_q28, base_q28),
+        ("window_bounded", eng_window, base_window),
+    ]
+
+    details = {}
+    checks = {}
+    for name, eng, base in workloads:
+        t0 = time.perf_counter()
+        eng_res = eng()                       # warm-up incl. compile
+        warm = time.perf_counter() - t0
+        eng_s, eng_res = _time_min(eng, iters)
+        base_s, base_res = _time_min(base, iters)
+        speedup = base_s / eng_s
+        rows = nw if name == "window_bounded" else n
+        details[name] = {
+            "engine_s": round(eng_s, 4), "baseline_s": round(base_s, 4),
+            "speedup": round(speedup, 3),
+            "rows_per_sec": round(rows / eng_s, 1),
+        }
+        checks[name] = (eng_res, base_res)
+        log(f"bench: {name:15s} engine {eng_s:7.3f}s  pandas {base_s:7.3f}s "
+            f"-> {speedup:5.2f}x  (warm-up {warm:.1f}s)")
+
+    # ---------------- correctness spot-checks ----------------
+    res, base = checks["tpch_q1"]
     got = res.to_pandas().set_index(["l_returnflag", "l_linestatus"]) \
              .sort_index()
     np.testing.assert_allclose(got["sum_disc_price"].to_numpy(),
-                               base["sum_disc_price"].to_numpy(),
-                               rtol=1e-9)
+                               base["sum_disc_price"].to_numpy(), rtol=1e-9)
     np.testing.assert_array_equal(got["count_order"].to_numpy(),
                                   base["count_order"].to_numpy())
+    res, base = checks["tpch_q6"]
+    np.testing.assert_allclose(res.column("revenue")[0].as_py(), base,
+                               rtol=1e-9)
+    res, base = checks["tpcds_q3"]
+    np.testing.assert_allclose(
+        np.sort(res.column("sum_agg").to_numpy()),
+        np.sort(base["sum_agg"].to_numpy()), rtol=1e-9)
+    assert res.num_rows == len(base)
+    res, base = checks["tpcds_q9"]
+    grow = res.to_pylist()[0]
+    for k, v in base.items():
+        np.testing.assert_allclose(grow[k], v, rtol=1e-9, err_msg=k)
+    res, base = checks["tpcds_q28"]
+    eng_rows = [(r["b_avg"], r["b_cnt"], r["b_cntd"]) for r in res.to_pylist()]
+    for (ea, ec, ed), (ba, bc, bd) in zip(eng_rows, base):
+        np.testing.assert_allclose(ea, ba, rtol=1e-9)
+        assert (ec, ed) == (bc, bd)
+    res, base = checks["window_bounded"]
+    eng_sum = float(np.nansum(res.column("wsum").to_numpy(
+        zero_copy_only=False)))
+    np.testing.assert_allclose(eng_sum, float(base["wsum"].sum()), rtol=1e-6)
+    log("bench: all correctness checks passed")
 
+    for name, d in details.items():
+        print(json.dumps({"metric": name + "_speedup", "value": d["speedup"],
+                          "unit": "x_vs_pandas",
+                          "vs_baseline": d["speedup"]}))
+    geo = float(np.exp(np.mean([np.log(d["speedup"])
+                                for d in details.values()])))
     print(json.dumps({
-        "metric": "tpch_q1_rows_per_sec",
-        "value": round(engine_rate, 1),
-        "unit": "rows/s",
-        "vs_baseline": round(engine_rate / base_rate, 3),
+        "metric": "ladder_geomean_speedup",
+        "value": round(geo, 3),
+        "unit": "x_vs_pandas",
+        "vs_baseline": round(geo, 3),
+        "details": details,
     }))
 
 
