@@ -44,7 +44,7 @@ def _as_expr(c, alias_ok=True) -> Expression:
 
 
 class TpuSession:
-    def __init__(self, conf: Optional[TpuConf] = None):
+    def __init__(self, conf: Optional[TpuConf] = None, mesh=None):
         if isinstance(conf, dict):
             conf = TpuConf(conf)
         self.conf = conf or TpuConf()
@@ -55,6 +55,17 @@ class TpuSession:
         self.profiler = Profiler(self.conf)
         #: per-query runtime summary (ref GpuTaskMetrics accumulators)
         self.last_query_metrics = None
+        #: device mesh for distributed execution: explicit, or built from
+        #: spark.rapids.tpu.distributed.* conf (the planner lowers
+        #: supported fragments onto it — parallel/planner.py)
+        self.mesh = mesh
+        if self.mesh is None:
+            from ..parallel.planner import (DISTRIBUTED_ENABLED,
+                                            DISTRIBUTED_NUM_DEVICES)
+            if self.conf.get(DISTRIBUTED_ENABLED):
+                from ..parallel.mesh import make_mesh
+                n = int(self.conf.get(DISTRIBUTED_NUM_DEVICES)) or None
+                self.mesh = make_mesh(n)
 
     # ------------------------------------------------------------- config
     def set_conf(self, key: str, value) -> "TpuSession":
@@ -400,7 +411,8 @@ class DataFrame:
         return self.plan.schema().names()
 
     def _physical(self):
-        return plan_query(self.plan, self.session.conf)
+        return plan_query(self.plan, self.session.conf,
+                          mesh=getattr(self.session, "mesh", None))
 
     def _execute_wrapped(self, consume):
         """Run the physical plan through the full execution pipeline

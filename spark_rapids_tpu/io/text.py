@@ -201,7 +201,11 @@ def _check_hive_options(field_delim: str, null_value: str) -> None:
             raise ValueError(
                 f"hive text null_value {null_value!r} contains the field "
                 "delimiter, a backslash, or a newline and cannot round-trip")
-        if null_value and all(c in "nrt" for c in null_value):
+        if not null_value:
+            raise ValueError(
+                "hive text null_value must be non-empty: an empty marker "
+                "makes empty-string cells indistinguishable from NULL")
+        if all(c in "nrt" for c in null_value):
             raise ValueError(
                 f"hive text null_value {null_value!r} uses only n/r/t "
                 "characters; colliding values could not be escaped")

@@ -41,9 +41,11 @@ def wrap_plan(plan: L.LogicalPlan, conf: TpuConf,
     return m
 
 
-def plan_query(plan: L.LogicalPlan, conf: TpuConf) -> TpuExec:
+def plan_query(plan: L.LogicalPlan, conf: TpuConf, mesh=None) -> TpuExec:
     """tag -> cost-optimize -> (explain) -> convert (ref
-    applyOverrides:4813, getOptimizations:4827)."""
+    applyOverrides:4813, getOptimizations:4827) -> distribute onto the mesh
+    when one is configured (ref GpuShuffleExchangeExecBase: the planner —
+    not the user — makes queries distributed)."""
     from .rewrites import prune_columns
     plan = prune_columns(plan)
     if conf.sql_enabled:
@@ -62,6 +64,9 @@ def plan_query(plan: L.LogicalPlan, conf: TpuConf) -> TpuExec:
         if out:
             log.warning("\n%s", out)
     physical = meta.convert()
+    if mesh is not None and conf.sql_enabled:
+        from ..parallel.planner import maybe_distribute
+        physical = maybe_distribute(physical, conf, mesh)
     return physical
 
 

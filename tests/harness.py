@@ -21,9 +21,9 @@ from spark_rapids_tpu.config import TpuConf
 DEFAULT_CONF = {}
 
 
-def tpu_session(extra_conf=None) -> TpuSession:
+def tpu_session(extra_conf=None, mesh=None) -> TpuSession:
     conf = TpuConf({**DEFAULT_CONF, **(extra_conf or {})})
-    return TpuSession(conf)
+    return TpuSession(conf, mesh=mesh)
 
 
 def cpu_session(extra_conf=None) -> TpuSession:

@@ -235,3 +235,182 @@ def test_repartition_accepts_numpy_int():
     import pytest
     with pytest.raises(ValueError, match="positive"):
         s.create_dataframe(t).repartition(0)
+
+
+# ---------------------------------------------------------------------------
+# planner-level distributed execution (VERDICT r1 #1): session.sql /
+# DataFrame queries lower onto the mesh via plan_query -> maybe_distribute;
+# differential against the single-chip engine and the host oracle
+# ---------------------------------------------------------------------------
+
+def _dist_session(conf=None):
+    mesh = _mesh()
+    c = {"spark.rapids.tpu.distributed.enabled": True}
+    c.update(conf or {})
+    return tpu_session(c, mesh=mesh)
+
+
+def _assert_plan_distributed(df):
+    s = df.explain()
+    assert "DistributedPipeline" in s, s
+
+
+def test_planned_distributed_agg_differential():
+    t = _table(n=3000)
+    sd = _dist_session()
+    q = (sd.create_dataframe(t)
+         .filter(F.col("v") > F.lit(-500))
+         .group_by("k")
+         .agg(F.sum(F.col("v")).with_name("s"),
+              F.count_star().with_name("n"),
+              F.min(F.col("d")).with_name("mn"),
+              F.avg(F.col("v")).with_name("a")))
+    _assert_plan_distributed(q)
+    got = q.collect_arrow().to_pandas().sort_values("k",
+                                                    na_position="first")
+    single = tpu_session()
+    q1 = (single.create_dataframe(t)
+          .filter(F.col("v") > F.lit(-500))
+          .group_by("k")
+          .agg(F.sum(F.col("v")).with_name("s"),
+               F.count_star().with_name("n"),
+               F.min(F.col("d")).with_name("mn"),
+               F.avg(F.col("v")).with_name("a")))
+    want = q1.collect_arrow().to_pandas().sort_values("k",
+                                                      na_position="first")
+    pd.testing.assert_frame_equal(got.reset_index(drop=True),
+                                  want.reset_index(drop=True),
+                                  check_dtype=False)
+
+
+def test_planned_distributed_string_group_key():
+    rng = np.random.RandomState(5)
+    t = pa.table({"g": pa.array(rng.choice(["aa", "bb", "cc", None], 800)),
+                  "v": pa.array(rng.standard_normal(800))})
+    sd = _dist_session()
+    q = sd.create_dataframe(t).group_by("g").agg(
+        F.sum(F.col("v")).with_name("s"), F.count_star().with_name("n"))
+    _assert_plan_distributed(q)
+    got = {r["g"]: (round(r["s"], 9), r["n"]) for r in q.collect()}
+    df = t.to_pandas()
+    want = df.groupby("g", dropna=False).agg(s=("v", "sum"),
+                                             n=("v", "size"))
+    for g, row in want.iterrows():
+        key = None if pd.isna(g) else g
+        assert got[key][1] == row["n"]
+        np.testing.assert_allclose(got[key][0], row["s"], rtol=1e-9)
+
+
+def test_planned_distributed_join_agg_differential():
+    t = _table(n=2500, key_hi=11)
+    dim = pa.table({"k2": pa.array(np.arange(11), pa.int64()),
+                    "w": pa.array(np.arange(11, dtype=np.float64) * 0.5),
+                    "nm": pa.array([f"name{i}" for i in range(11)])})
+    sd = _dist_session()
+    q = (sd.create_dataframe(t)
+         .join(sd.create_dataframe(dim), on=[("k", "k2")])
+         .group_by("nm")
+         .agg(F.sum(F.col("v") * F.col("w")).with_name("sv"),
+              F.count_star().with_name("n")))
+    _assert_plan_distributed(q)
+    got = q.collect_arrow().to_pandas().set_index("nm").sort_index()
+    df = t.to_pandas().merge(dim.to_pandas(), left_on="k", right_on="k2")
+    df["vw"] = df["v"] * df["w"]
+    want = df.groupby("nm").agg(sv=("vw", "sum"), n=("vw", "size")) \
+        .sort_index()
+    np.testing.assert_allclose(got["sv"].to_numpy(),
+                               want["sv"].to_numpy(), rtol=1e-9)
+    np.testing.assert_array_equal(got["n"].to_numpy(),
+                                  want["n"].to_numpy())
+
+
+def test_planned_distributed_broadcast_join():
+    t = _table(n=2000, key_hi=7)
+    dim = pa.table({"k2": pa.array(np.arange(7), pa.int64()),
+                    "w": pa.array(np.arange(7, dtype=np.float64))})
+    sd = _dist_session()
+    q = (sd.create_dataframe(t)
+         .join(sd.create_dataframe(dim).hint("broadcast"),
+               on=[("k", "k2")])
+         .select(F.col("k"), F.col("w")))
+    _assert_plan_distributed(q)
+    got = q.collect_arrow().to_pandas()
+    want = t.to_pandas().merge(dim.to_pandas(), left_on="k",
+                               right_on="k2")
+    assert len(got) == len(want)
+    np.testing.assert_allclose(np.sort(got["w"].to_numpy()),
+                               np.sort(want["w"].to_numpy()))
+
+
+def test_planned_distributed_q3_full_query():
+    """TPC-DS q3 planned end-to-end on the mesh: scan -> filter ->
+    distributed joins -> distributed agg, host final sort (VERDICT r1 #1
+    'done' criterion)."""
+    import sys
+    sys.path.insert(0, ".")
+    from benchmarks import tpcds
+    ss = tpcds.gen_store_sales(8000)
+    sd = _dist_session()
+    q = tpcds.q3(sd.create_dataframe(ss),
+                 sd.create_dataframe(tpcds.gen_date_dim()),
+                 sd.create_dataframe(tpcds.gen_item()), F)
+    _assert_plan_distributed(q)
+    got = q.collect_arrow().to_pandas()
+    # single-chip engine as the oracle
+    s1 = tpu_session()
+    want = tpcds.q3(s1.create_dataframe(ss),
+                    s1.create_dataframe(tpcds.gen_date_dim()),
+                    s1.create_dataframe(tpcds.gen_item()), F) \
+        .collect_arrow().to_pandas()
+    pd.testing.assert_frame_equal(got, want, check_dtype=False)
+
+
+def test_planned_distributed_overflow_retry():
+    """A skewed key that routes every row to one device must overflow the
+    speculative receive bound and transparently re-run with doubled
+    bounds (the mesh-level SpeculativeOverflow analog)."""
+    n = 2048
+    t = pa.table({"k": pa.array(np.zeros(n, np.int64)),
+                  "v": pa.array(np.ones(n, np.float64))})
+    dim = pa.table({"k2": pa.array([0], pa.int64()),
+                    "w": pa.array([2.0])})
+    sd = _dist_session({
+        "spark.rapids.tpu.distributed.joinOutFactor": 1})
+    q = (sd.create_dataframe(t)
+         .join(sd.create_dataframe(dim), on=[("k", "k2")])
+         .group_by("k").agg(F.sum(F.col("w")).with_name("sw")))
+    _assert_plan_distributed(q)
+    rows = q.collect()
+    assert rows == [{"k": 0, "sw": 2.0 * n}]
+
+
+def test_planned_global_agg_distributed():
+    t = _table(n=3000)
+    sd = _dist_session()
+    q = sd.create_dataframe(t).agg(F.sum(F.col("v")).with_name("s"),
+                                   F.count_star().with_name("n"))
+    _assert_plan_distributed(q)
+    row = q.collect()[0]
+    df = t.to_pandas()
+    assert row["n"] == len(df)
+    np.testing.assert_allclose(row["s"], df["v"].sum(), rtol=1e-12)
+
+
+def test_planned_broadcast_outer_join_not_duplicated():
+    """Join types that emit rows from the replicated side must NOT lower
+    to the broadcast-distributed form (every device would emit the
+    replicated side's unmatched rows once per shard)."""
+    t = _table(n=1000, key_hi=5)
+    dim = pa.table({"k2": pa.array([0, 1, 2, 99], pa.int64()),
+                    "w": pa.array([0.0, 1.0, 2.0, 99.0])})
+    sd = _dist_session()
+    q = (sd.create_dataframe(t)
+         .join(sd.create_dataframe(dim).hint("broadcast"),
+               on=[("k", "k2")], how="right")
+         .select(F.col("k2"), F.col("w"), F.col("v")))
+    got = q.collect_arrow().to_pandas()
+    want = t.to_pandas().merge(dim.to_pandas(), left_on="k",
+                               right_on="k2", how="right")
+    assert len(got) == len(want)
+    # the unmatched dim row (k2=99) appears exactly once
+    assert int((got["k2"] == 99).sum()) == 1
