@@ -203,15 +203,38 @@ class ColumnarBatch:
                     cols.append(HostColumn(col, dt))
         if staged:
             # ONE device_put for the whole table: each separate transfer
-            # pays a full round trip on a tunneled TPU backend
-            put = jax.device_put(host_pairs)
-            for k, (i, dt, dictionary, mirror) in enumerate(staged):
-                if dictionary is None:
-                    cols[i] = DeviceColumn(put[2 * k], put[2 * k + 1], dt,
-                                           host_mirror=mirror)
-                else:
-                    cols[i] = DictColumn(put[2 * k], put[2 * k + 1], dt,
-                                         dictionary, host_mirror=mirror)
+            # pays a full round trip on a tunneled TPU backend. Above the
+            # size threshold, columns are narrowed/bitpacked host-side and
+            # decoded by one fused kernel after the transfer — H2D bytes
+            # drop 4-16x on TPC-shaped data (columnar/transfer.py).
+            from .transfer import (decode_with_len, encode_columns,
+                                   worthwhile)
+            pairs = [(host_pairs[2 * k], host_pairs[2 * k + 1])
+                     for k in range(len(staged))]
+            flat, specs, enc_params, ratio, raw_bytes = \
+                encode_columns(pairs)
+            if worthwhile(ratio, raw_bytes):
+                put = jax.device_put(flat)
+                decoded = decode_with_len(put, specs, enc_params, p)
+                for k, (i, dt, dictionary, mirror) in enumerate(staged):
+                    d, v = decoded[k]
+                    if dictionary is None:
+                        cols[i] = DeviceColumn(d, v, dt,
+                                               host_mirror=mirror)
+                    else:
+                        cols[i] = DictColumn(d, v, dt, dictionary,
+                                             host_mirror=mirror)
+            else:
+                put = jax.device_put(host_pairs)
+                for k, (i, dt, dictionary, mirror) in enumerate(staged):
+                    if dictionary is None:
+                        cols[i] = DeviceColumn(put[2 * k],
+                                               put[2 * k + 1], dt,
+                                               host_mirror=mirror)
+                    else:
+                        cols[i] = DictColumn(put[2 * k], put[2 * k + 1],
+                                             dt, dictionary,
+                                             host_mirror=mirror)
         return ColumnarBatch(cols, n, Schema(fields))
 
     @staticmethod

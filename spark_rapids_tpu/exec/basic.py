@@ -70,8 +70,10 @@ def _scan_cache_get(t, key):
 
 
 def _scan_cache_bytes() -> int:
-    return sum(b.device_size_bytes() for bs in _SCAN_CACHE_BATCHES.values()
-               for b in bs)
+    # snapshot: weakref finalizers may evict entries mid-iteration (GC can
+    # run _scan_cache_evict during any allocation inside the sum)
+    return sum(b.device_size_bytes()
+               for bs in list(_SCAN_CACHE_BATCHES.values()) for b in bs)
 
 
 def _scan_cache_put(t, key, batches, limit: int):
@@ -401,6 +403,44 @@ class UnionExec(TpuExec):
 
     def describe(self):
         return f"Union[{len(self.children)}]"
+
+
+class BranchAlignExec(TpuExec):
+    """Host assembly of the union-of-aggregates single pass (see
+    plan/rewrites.py _rewrite_union_agg): child rows are keyed by a
+    branch-id first column; emit exactly n rows in branch order with
+    empty-aggregate defaults for missing branches. At most n (tiny) rows
+    — host by construction, zero device dispatches."""
+
+    def __init__(self, n: int, fill_zero: List[bool], child: TpuExec):
+        super().__init__([child])
+        self.n = n
+        self.fill_zero = list(fill_zero)
+        cs = child.output_schema()
+        self._schema = Schema(list(cs.fields)[1:])
+
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        import pyarrow as pa
+        from ..types import to_arrow
+        t = self.children[0].collect(ctx, validate=False)
+        bid = t.column(0).to_pylist()
+        row_of = {int(b): i for i, b in enumerate(bid) if b is not None}
+        arrays = []
+        for ci, f in enumerate(self._schema.fields):
+            col = t.column(ci + 1)
+            vals = col.to_pylist()
+            default = 0 if self.fill_zero[ci] else None
+            out = [vals[row_of[i]] if i in row_of else default
+                   for i in range(self.n)]
+            arrays.append(pa.array(out, type=to_arrow(f.dtype)))
+        yield ColumnarBatch.from_arrow_host(
+            pa.Table.from_arrays(arrays, names=self._schema.names()))
+
+    def describe(self):
+        return f"BranchAlign[n={self.n}]"
 
 
 class CoalesceBatchesExec(TpuExec):
