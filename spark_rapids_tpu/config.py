@@ -274,6 +274,16 @@ SHAPE_BUCKETS = register(
     "operator compiles once per bucket (TPU-specific, no reference analog — "
     "cudf is shape-dynamic, XLA is not).")
 
+WINDOW_HOST_SINK_ROWS = register(
+    "spark.rapids.tpu.window.hostSinkRowThreshold", 65536,
+    "A terminal window exec whose input has at least this many rows runs "
+    "its kernel on the host XLA backend instead of the device: the result "
+    "is row-sized and heading to a host collect, so the D2H fetch — not "
+    "compute — dominates on a tunneled TPU (measured 0.25-0.9 s per "
+    "MB-scale fetch; docs/performance.md). Identical kernel, identical "
+    "semantics; 0 disables (ref CostBasedOptimizer transition-cost "
+    "reverts, RapidsConf.scala:2126).")
+
 CPU_FALLBACK_ENABLED = register(
     "spark.rapids.tpu.sql.cpuFallback.enabled", True,
     "Allow per-operator CPU fallback (off = fail when a plan node is unsupported).")

@@ -28,7 +28,8 @@ from ..columnar.segmented import (SortedSegments, last_valid_scan,
                                   shift_static)
 import numpy as np
 
-from ..columnar import ColumnarBatch, DeviceColumn, concat_batches
+from ..columnar import (ColumnarBatch, DeviceColumn, DictColumn,
+                        concat_batches)
 from ..exprs.aggregates import AggregateExpression, Average, Count, CountStar, \
     Max, Min, Sum
 from ..exprs.base import DVal, EvalContext
@@ -247,25 +248,10 @@ def _windowed_agg(fn: AggregateExpression, spec: WindowSpec, ctx,
             return m, cnt > 0
         raise NotImplementedError(type(fn).__name__)
 
-    # prefix-sum frames (running / bounded rows) for sum/count/avg
-    if not isinstance(fn, (Sum, Average, Count, CountStar)):
-        raise NotImplementedError(
-            f"bounded frame for {type(fn).__name__}")
-    acc_dt = jnp.float64 if (isinstance(fn, Average)
-                             or jnp.issubdtype(vd.dtype, jnp.floating)) \
-        else jnp.int64
+    # frame geometry shared by every bounded/running aggregate
     is_f = jnp.issubdtype(vd.dtype, jnp.floating)
-    # NaN must poison only frames CONTAINING it, not every later prefix:
-    # sum finite values in the prefix and track NaN positions separately
-    # (a frame whose NaN-count difference is >0 yields NaN)
     isnan = (jnp.logical_and(vv, jnp.isnan(vd)) if is_f
              else jnp.zeros(P, jnp.bool_))
-    finite_ok = jnp.logical_and(vv, jnp.logical_not(isnan))
-    acc = jnp.where(finite_ok, vd, jnp.zeros_like(vd)).astype(acc_dt)
-    cntv = vv.astype(jnp.int64)
-    ps = prefix_sum(acc)          # global prefix (inclusive)
-    pc = prefix_sum(cntv)
-    pn = prefix_sum(isnan.astype(jnp.int32))
     lo_i = part_start if lo is None else jnp.maximum(part_start, idx + lo)
     hi_i = pend if hi is None else jnp.minimum(pend, idx + hi)
     empty = hi_i < lo_i
@@ -289,6 +275,27 @@ def _windowed_agg(fn: AggregateExpression, spec: WindowSpec, ctx,
                               shift_static(prefix, -(lo - 1), z))
         return jnp.where(empty, z, upper - lower)
 
+    if isinstance(fn, (Min, Max)):
+        return _bounded_minmax(fn, vd, vv, isnan, lo, hi, part_start,
+                               pend, idx, row_mask, P, pflags, end_mask,
+                               window_sum, empty)
+
+    if not isinstance(fn, (Sum, Average, Count, CountStar)):
+        raise NotImplementedError(
+            f"bounded frame for {type(fn).__name__}")
+    acc_dt = jnp.float64 if (isinstance(fn, Average)
+                             or jnp.issubdtype(vd.dtype, jnp.floating)) \
+        else jnp.int64
+    # NaN must poison only frames CONTAINING it, not every later prefix:
+    # sum finite values in the prefix and track NaN positions separately
+    # (a frame whose NaN-count difference is >0 yields NaN)
+    finite_ok = jnp.logical_and(vv, jnp.logical_not(isnan))
+    acc = jnp.where(finite_ok, vd, jnp.zeros_like(vd)).astype(acc_dt)
+    cntv = vv.astype(jnp.int64)
+    ps = prefix_sum(acc)          # global prefix (inclusive)
+    pc = prefix_sum(cntv)
+    pn = prefix_sum(isnan.astype(jnp.int32))
+
     s = window_sum(ps)
     c = window_sum(pc)
     if isinstance(fn, (Count, CountStar)):
@@ -305,10 +312,302 @@ def _windowed_agg(fn: AggregateExpression, spec: WindowSpec, ctx,
     return s, ok
 
 
+def _numpy_window_one(fn, spec, col_np, n: int):
+    """One window expression over host arrays; returns (data, validity)
+    in ORIGINAL row order, or None if unsupported. Mirrors the device
+    kernel's frame semantics (incl. Spark NaN/NULL rules)."""
+    from .sort import _np_total_order_key
+    keys = []
+    for pk in spec.partition_by:
+        got = col_np(pk)
+        if got is None:
+            return None
+        keys.append((got, True, True))
+    for o in spec.order_by:
+        got = col_np(o.expr)
+        if got is None:
+            return None
+        keys.append((got, o.ascending, o.nulls_first))
+    child_pair = None
+    child = getattr(fn, "child", None)
+    if child is not None:
+        child_pair = col_np(child)
+        if child_pair is None:
+            return None
+
+    # one total-order encoding per key, shared by the sort AND boundary
+    # detection (raw-value comparison would merge NULLs with the fill
+    # value and split equal NaNs — the device kernel compares encoded
+    # operands, so must we)
+    encs = []
+    for (v, ok), asc, nf in keys:
+        enc = _np_total_order_key(np.asarray(v), np.asarray(ok))
+        if not asc:
+            enc = ~enc
+        enc = np.where(ok, enc, np.uint64(0))
+        rank = (np.where(ok, 1, 0) if nf else np.where(ok, 0, 1)) \
+            .astype(np.uint8)
+        encs.append((enc, rank))
+    lex = []
+    for enc, rank in reversed(encs):
+        lex.extend([enc, rank])
+    order = (np.lexsort(tuple(lex)) if lex
+             else np.arange(n, dtype=np.int64))
+    idx = np.arange(n, dtype=np.int64)
+
+    def run_flags(pairs):
+        flags = np.zeros(n, dtype=bool)
+        if n:
+            flags[0] = True
+        for enc, rank in pairs:
+            se, sr = enc[order], rank[order]
+            diff = np.zeros(n, dtype=bool)
+            diff[1:] = (se[1:] != se[:-1]) | (sr[1:] != sr[:-1])
+            flags |= diff
+        return flags
+
+    npart = len(spec.partition_by)
+    pflags = run_flags(encs[:npart])
+    part_start = np.maximum.accumulate(np.where(pflags, idx, 0))
+    # partition end: reverse accumulate of end flags
+    endf = np.zeros(n, dtype=bool)
+    if n:
+        endf[-1] = True
+        endf[:-1] = pflags[1:]
+    pend = np.minimum.accumulate(np.where(endf, idx, n - 1)[::-1])[::-1]
+
+    oflags = pflags | run_flags(encs[npart:])
+
+    if isinstance(fn, RowNumber):
+        out, ov = (idx - part_start + 1).astype(np.int64), \
+            np.ones(n, bool)
+    elif isinstance(fn, Rank):
+        run_start = np.maximum.accumulate(np.where(oflags, idx, 0))
+        out = (run_start - part_start + 1).astype(np.int64)
+        ov = np.ones(n, bool)
+    elif isinstance(fn, DenseRank):
+        c = np.cumsum(oflags)
+        c_at = np.maximum.accumulate(np.where(pflags, c, 0))
+        out = (c - c_at + 1).astype(np.int64)
+        ov = np.ones(n, bool)
+    elif isinstance(fn, (Lag, Lead)):
+        vd = np.asarray(child_pair[0])[order]
+        vv = np.asarray(child_pair[1])[order]
+        off = fn.offset if isinstance(fn, Lag) else -fn.offset
+        src = idx - off
+        inside = (src >= part_start) & (src <= pend)
+        srcc = np.clip(src, 0, n - 1)
+        out = np.where(inside, vd[srcc], np.zeros((), vd.dtype))
+        ov = np.where(inside, vv[srcc], False)
+        if fn.default is not None:
+            fill = ~inside
+            out = np.where(fill, np.asarray(fn.default, vd.dtype), out)
+            ov = ov | fill
+    elif isinstance(fn, AggregateExpression) and isinstance(
+            fn, (Sum, Average, Count, CountStar, Min, Max)):
+        got = _numpy_frame_agg(fn, spec, child_pair, order, idx,
+                               part_start, pend, n)
+        if got is None:
+            return None
+        out, ov = got
+    else:
+        return None
+
+    inv = np.empty(n, dtype=np.int64)
+    inv[order] = idx
+    return out[inv], ov[inv]
+
+
+def _numpy_frame_agg(fn, spec, child_pair, order, idx, part_start, pend,
+                     n: int):
+    frame = spec.frame
+    if frame is None:
+        frame = ("rows", None, 0) if spec.order_by else \
+            ("rows", None, None)
+    kind, lo, hi = frame
+    if kind != "rows":
+        return None
+    if isinstance(fn, CountStar):
+        vd = np.ones(n, dtype=np.int64)
+        vv = np.ones(n, dtype=bool)
+    else:
+        vd = np.asarray(child_pair[0])[order]
+        vv = np.asarray(child_pair[1])[order]
+    is_f = np.issubdtype(vd.dtype, np.floating)
+    isnan = (vv & np.isnan(vd)) if is_f else np.zeros(n, bool)
+    ok = vv & ~isnan
+    lo_i = part_start if lo is None else np.maximum(part_start, idx + lo)
+    hi_i = pend if hi is None else np.minimum(pend, idx + hi)
+    empty = hi_i < lo_i
+    hs = np.clip(hi_i, 0, max(n - 1, 0))
+    ls = np.clip(lo_i, 0, max(n - 1, 0))
+
+    def wsum(prefix):
+        upper = prefix[hs]
+        lower = np.where(ls > 0, prefix[np.maximum(ls - 1, 0)], 0)
+        return np.where(empty, 0, upper - lower)
+
+    c_valid = wsum(np.cumsum(vv.astype(np.int64)))
+    c_nan = wsum(np.cumsum(isnan.astype(np.int64)))
+    if isinstance(fn, (Min, Max)):
+        is_min = isinstance(fn, Min)
+        from ..columnar.segmented import _neutral_max, _neutral_min
+        neutral = np.asarray(_neutral_max(vd.dtype) if is_min
+                             else _neutral_min(vd.dtype), vd.dtype)
+        masked = np.where(ok, vd, neutral)
+        combine = np.minimum if is_min else np.maximum
+        # sparse table over clamped per-row spans (log2 passes)
+        span = (hs - ls + 1).astype(np.int64)
+        span = np.where(empty, 1, span)
+        K = int(max(span.max(), 1)).bit_length() - 1 if n else 0
+        tables = [masked]
+        for k in range(K):
+            t = tables[-1]
+            shifted = np.concatenate(
+                [t[1 << k:], np.full(min(1 << k, n), neutral, vd.dtype)])
+            tables.append(combine(t, shifted))
+        k_i = np.maximum(
+            np.int64(np.log2(np.maximum(span, 1))), 0).astype(np.int64) \
+            if n else np.zeros(0, np.int64)
+        # per-row table pick via np.select over log-many tables
+        out = np.full(n, neutral, vd.dtype)
+        for k in range(K + 1):
+            sel = k_i == k
+            if not sel.any():
+                continue
+            t = tables[k]
+            a = ls[sel]
+            b = hs[sel] - (1 << k) + 1
+            out[sel] = combine(t[a], t[np.maximum(b, 0)])
+        if is_f:
+            n_ok = c_valid - c_nan
+            if is_min:
+                out = np.where((n_ok == 0) & (c_nan > 0), np.nan, out)
+            else:
+                out = np.where(c_nan > 0, np.nan, out)
+        return out, (~empty) & (c_valid > 0)
+    # sum / avg / count
+    acc_dt = np.float64 if (isinstance(fn, Average) or is_f) else np.int64
+    acc = np.where(ok, vd, 0).astype(acc_dt)
+    s = wsum(np.cumsum(acc))
+    if isinstance(fn, (Count, CountStar)):
+        return wsum(np.cumsum(vv.astype(np.int64))), np.ones(n, bool)
+    if is_f:
+        s = np.where(c_nan > 0, np.nan, s)
+    c_ok = wsum(np.cumsum(vv.astype(np.int64)))
+    if isinstance(fn, Average):
+        out = s.astype(np.float64) / np.maximum(c_ok, 1)
+        return out, (c_ok > 0)
+    if np.issubdtype(vd.dtype, np.integer):
+        s = s.astype(np.int64)
+    return s, (c_ok > 0)
+
+
+def _seg_combine_scan(vals, flags, combine, neutral):
+    """Segmented inclusive forward scan (Hillis-Steele: log2(P) static
+    shift+combine passes — no gathers)."""
+    from ..columnar.segmented import _shifted, _steps
+    n = vals.shape[0]
+    neutral = jnp.asarray(neutral, dtype=vals.dtype)
+
+    def body(i, vf):
+        v, f = vf
+        d = jax.lax.shift_left(jnp.int32(1), i.astype(jnp.int32))
+        pv = _shifted(v, neutral, d)
+        pf = _shifted(f, jnp.array(True), d)
+        return (jnp.where(f, v, combine(pv, v)), jnp.logical_or(f, pf))
+
+    v, _ = jax.lax.fori_loop(0, _steps(n), body, (vals, flags))
+    return v
+
+
+def _bounded_minmax(fn, vd, vv, isnan, lo, hi, part_start, pend, idx,
+                    row_mask, P, pflags, end_mask, window_sum, empty):
+    """Bounded-frame MIN/MAX (removes the r1 limitation; ref
+    GpuBatchedBoundedWindowExec). Sliding extrema without gathers:
+
+      * interior rows (frame fully inside the partition) query a sparse
+        table: T_k[i] = extremum over [i, i+2^k); the frame [a, a+W-1] is
+        combine(T_K[a], T_K[a+W-2^K]) with K = floor(log2(W)) — both
+        reads are STATIC shifts because a = i+lo;
+      * start-clamped rows read the partition-running scan at i+hi;
+      * end-clamped rows read the reverse (suffix) scan at i+lo;
+      * doubly-clamped rows take the whole-partition extremum.
+
+    All four candidates are elementwise selects over scans and static
+    shifts — the same no-gather discipline as the rest of the kernel.
+    Spark NaN semantics: max -> NaN if the frame contains any NaN; min ->
+    NaN only when the frame has NaNs and no other valid values."""
+    from ..columnar.segmented import _neutral_max, _neutral_min
+    is_min = isinstance(fn, Min)
+    combine = jnp.minimum if is_min else jnp.maximum
+    neutral = _neutral_max(vd.dtype) if is_min else _neutral_min(vd.dtype)
+    ok = jnp.logical_and(vv, jnp.logical_not(isnan))
+    masked = jnp.where(ok, vd, jnp.asarray(neutral, vd.dtype))
+
+    z = jnp.asarray(neutral, vd.dtype)
+    run_fwd = _seg_combine_scan(masked, pflags, combine, neutral)
+    # suffix scan = forward scan of the flipped array with flipped
+    # segment-start flags (= end flags)
+    run_rev = jnp.flip(_seg_combine_scan(
+        jnp.flip(masked), jnp.flip(end_mask), combine, neutral))
+    whole_part = _end_broadcast(run_fwd, end_mask)
+
+    cands = []
+    if lo is not None and hi is not None and hi >= lo:
+        W = hi - lo + 1
+        K = max(W.bit_length() - 1, 0)      # floor(log2(W))
+        T = masked
+        for k in range(K):
+            T = combine(T, shift_static(T, -(1 << k), z))
+        interior_val = combine(shift_static(T, -lo, z),
+                               shift_static(T, -(hi - (1 << K) + 1), z))
+        interior = jnp.logical_and(idx + lo >= part_start,
+                                   idx + hi <= pend)
+        cands.append((interior, interior_val))
+    if hi is not None:
+        start_clamped = shift_static(run_fwd, -hi, z)
+        cands.append((jnp.logical_and(
+            (idx + lo < part_start) if lo is not None
+            else jnp.ones(P, jnp.bool_),
+            idx + hi <= pend), start_clamped))
+    if lo is not None:
+        end_clamped = shift_static(run_rev, -lo, z)
+        cands.append((jnp.logical_and(
+            idx + lo >= part_start,
+            (idx + hi > pend) if hi is not None
+            else jnp.ones(P, jnp.bool_)), end_clamped))
+    out = whole_part
+    for mask, val in cands:
+        out = jnp.where(mask, val, out)
+
+    # null / NaN semantics from frame counts (prefix-sum machinery)
+    c_valid = window_sum(prefix_sum(vv.astype(jnp.int64)))
+    c_nan = window_sum(prefix_sum(isnan.astype(jnp.int32)))
+    c_ok = window_sum(prefix_sum(ok.astype(jnp.int64)))
+    has_val = jnp.logical_and(jnp.logical_not(empty), c_valid > 0)
+    if jnp.issubdtype(vd.dtype, jnp.floating):
+        nanv = jnp.array(jnp.nan, dtype=vd.dtype)
+        if is_min:
+            out = jnp.where(jnp.logical_and(c_ok == 0, c_nan > 0),
+                            nanv, out)
+        else:
+            out = jnp.where(c_nan > 0, nanv, out)
+    return out, jnp.logical_and(has_val, row_mask)
+
+
 class TpuWindowExec(TpuExec):
-    def __init__(self, window_exprs, child: TpuExec):
+    def __init__(self, window_exprs, child: TpuExec,
+                 host_sink: bool = False):
         super().__init__([child])
         self.window_exprs = list(window_exprs)
+        #: True when this window is the query's terminal stage: its
+        #: row-sized result goes straight to a host collect, so the D2H
+        #: fetch (not the compute) is the dominant cost on a tunneled
+        #: backend — the cost model may run the SAME kernel on host XLA
+        #: (ref CostBasedOptimizer's transition-cost reverts,
+        #: RapidsConf.scala:2126)
+        self.host_sink = host_sink
         cs = child.output_schema()
         fields = list(cs.fields)
         for e, spec, name in self.window_exprs:
@@ -333,9 +632,20 @@ class TpuWindowExec(TpuExec):
         if not spill:
             return
 
+        from ..config import WINDOW_HOST_SINK_ROWS
+        thr = int(ctx.conf.get(WINDOW_HOST_SINK_ROWS))
+
         def run():
             with ctx.semaphore.held():
                 batch = concat_batches([s.get() for s in spill])
+                np_cols = (self._host_inputs(batch)
+                           if self.host_sink and thr
+                           and batch.num_rows >= thr else None)
+                if np_cols is not None:
+                    out = self._run_host_numpy(batch, cs, np_cols)
+                    if out is not None:
+                        return out
+                    return self._run_host_xla(kern, batch, cs, np_cols)
                 # host columns (e.g. high-cardinality strings) ride
                 # through untouched; the kernel must not dereference them
                 cols = [(c.data, c.validity)
@@ -352,6 +662,92 @@ class TpuWindowExec(TpuExec):
         for s in spill:
             s.close()
         yield out
+
+    # -- host numpy execution (terminal, fetch-bound windows) --------------
+    def _run_host_numpy(self, batch, cs, np_cols):
+        """Vectorized numpy evaluation of the window — the same
+        prefix-sum / segment-broadcast formulas as the device kernel, on
+        host-sorted arrays (np.lexsort ~3x faster than XLA-CPU's
+        lax.sort). Returns None when an expression falls outside the
+        supported set (caller then uses the host-XLA kernel, then the
+        device). Differentially tested against BOTH other engines."""
+        from ..columnar.column import HostColumn
+        from ..exprs.arithmetic import masked_numpy_to_arrow
+        n = batch.num_rows
+        name_to = {f.name: i for i, f in enumerate(cs.fields)}
+
+        def col_np(e):
+            from ..exprs.base import Alias, ColumnRef
+            inner = e.children[0] if isinstance(e, Alias) else e
+            if not isinstance(inner, ColumnRef) \
+                    or inner.name not in name_to:
+                return None
+            pair = np_cols[name_to[inner.name]]
+            if pair is None:
+                return None
+            return pair[0][:n], pair[1][:n]
+
+        new_cols = list(batch.columns)
+        for fn, spec, name in self.window_exprs:
+            res = _numpy_window_one(fn, spec, col_np, n)
+            if res is None:
+                return None
+            d, v = res
+            dt = fn.data_type(cs)
+            new_cols.append(HostColumn(masked_numpy_to_arrow(d, v, dt),
+                                       dt))
+        return ColumnarBatch(new_cols, n, self._schema)
+
+    # -- host-XLA execution (terminal, fetch-bound windows) ----------------
+    def _host_inputs(self, batch):
+        """Padded numpy (data, validity) pairs for every device column,
+        WITHOUT a device fetch (host mirrors only); None when any needed
+        column lacks a mirror (then the device path runs)."""
+        from ..columnar.column import HostColumn
+        from ..exprs.arithmetic import arrow_to_masked_numpy
+        cols = []
+        for c in batch.columns:
+            if isinstance(c, DictColumn):
+                return None          # codes live on device only
+            if isinstance(c, DeviceColumn):
+                mirror = c.host_mirror
+                if mirror is None:
+                    return None
+                v, ok = arrow_to_masked_numpy(
+                    mirror.combine_chunks() if hasattr(mirror,
+                                                       "combine_chunks")
+                    else mirror)
+                d, val = DeviceColumn.host_prepare(
+                    v, c.dtype, mask=ok, padded_len=batch.padded_len)
+                cols.append((d, val))
+            elif isinstance(c, HostColumn):
+                cols.append(None)
+            else:
+                return None
+        return cols
+
+    def _run_host_xla(self, kern, batch, cs, np_cols):
+        """Run the SAME window kernel compiled for the host XLA backend:
+        identical semantics by construction, zero tunnel round trips.
+        Output columns are HostColumns — the terminal collect reads them
+        without any D2H."""
+        import jax
+        from ..columnar.column import HostColumn
+        from ..exprs.arithmetic import masked_numpy_to_arrow
+        cpu = jax.devices("cpu")[0]
+        dev_cols = [None if c is None else
+                    (jax.device_put(c[0], cpu), jax.device_put(c[1], cpu))
+                    for c in np_cols]
+        n = jax.device_put(jnp.int32(batch.num_rows), cpu)
+        outs = kern(dev_cols, n, batch.padded_len)
+        new_cols = list(batch.columns)
+        for (d, v), (e, s, name) in zip(outs, self.window_exprs):
+            dt = e.data_type(cs)
+            dn = np.asarray(d)[:batch.num_rows]
+            vn = np.asarray(v)[:batch.num_rows]
+            new_cols.append(HostColumn(masked_numpy_to_arrow(dn, vn, dt),
+                                       dt))
+        return ColumnarBatch(new_cols, batch.num_rows, self._schema)
 
     def describe(self):
         names = ", ".join(n for _, _, n in self.window_exprs)
@@ -450,7 +846,17 @@ class CpuWindowExec(TpuExec):
             df = df.drop(columns=[c for c in df.columns if c in temps])
         from ..types import to_arrow
         arrays = []
-        for f in self._schema.fields:
+        n_in = len(t.column_names)
+        for fi, f in enumerate(self._schema.fields):
+            if fi < n_in:
+                # passthrough columns come straight from the input table:
+                # the pandas round trip turns SQL NULL into NaN and could
+                # not restore it (NaN-vs-NULL parity)
+                col = t.column(fi).combine_chunks()
+                if col.type != to_arrow(f.dtype):
+                    col = col.cast(to_arrow(f.dtype))
+                arrays.append(col)
+                continue
             isf = f.dtype.name in ("float", "double")
             vals = [x if (isf and isinstance(x, float) and np.isnan(x))
                     else (None if pd.isna(x) else x)
@@ -539,12 +945,40 @@ class CpuWindowExec(TpuExec):
                 lower = np.where(lo_i > 0, p[np.maximum(lo_i - 1, 0)], 0)
                 return np.where(empty, 0, upper - lower)
 
+            if isinstance(fn, (Min, Max)) and (lo is not None
+                                               or hi is not None):
+                # bounded frames: direct per-row slice evaluation — the
+                # oracle optimizes for obviousness, not speed
+                res = np.empty(m, dtype=object)
+                src = vals[sl]
+                for j in range(m):
+                    a = 0 if lo is None else max(j + lo, 0)
+                    b_ = m - 1 if hi is None else min(j + hi, m - 1)
+                    if b_ < a:
+                        res[j] = None
+                        continue
+                    win_v = src[a:b_ + 1]
+                    win_k = k[a:b_ + 1]
+                    sel = [x for x, kk2 in zip(win_v, win_k) if kk2]
+                    if not sel:
+                        res[j] = None
+                        continue
+                    if is_f:
+                        fs = [float(x) for x in sel]
+                        nn = [x for x in fs if not np.isnan(x)]
+                        if isinstance(fn, Max):
+                            res[j] = np.nan if len(nn) < len(fs) \
+                                else max(nn)
+                        else:
+                            res[j] = min(nn) if nn else np.nan
+                    else:
+                        res[j] = (min(sel) if isinstance(fn, Min)
+                                  else max(sel))
+                out[sl] = res
+                start += int(sz)
+                continue
             if isinstance(fn, (Min, Max)):
-                # whole-partition only (bounded min/max unsupported on
-                # both engines); Spark: NaN is greatest, all-NaN -> NaN
-                if lo is not None or hi is not None:
-                    raise NotImplementedError(
-                        f"bounded frame for {type(fn).__name__}")
+                # whole partition; Spark: NaN is greatest, all-NaN -> NaN
                 if not k.any():
                     val = None
                 elif not is_num or is_dec:  # strings/dates/decimals: exact

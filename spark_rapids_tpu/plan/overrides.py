@@ -47,12 +47,15 @@ def plan_query(plan: L.LogicalPlan, conf: TpuConf, mesh=None) -> TpuExec:
     when one is configured (ref GpuShuffleExchangeExecBase: the planner —
     not the user — makes queries distributed)."""
     from .rewrites import prune_columns
-    plan = prune_columns(plan)
     if conf.sql_enabled:
-        # TPU-targeted rewrites (distinct-agg expansion); the host oracle
-        # path keeps native semantics so differential tests check them
+        # TPU-targeted rewrites (distinct-agg expansion, union-of-aggs
+        # single-pass) BEFORE pruning: the union rewrite keys on shared
+        # scan identity, which pruning's per-branch copies would break.
+        # The host oracle path keeps native semantics so differential
+        # tests check the rewrites themselves.
         from .rewrites import rewrite_plan
         plan = rewrite_plan(plan)
+    plan = prune_columns(plan)
     meta = wrap_plan(plan, conf)
     meta.tag()
     from .cost import OPTIMIZER_ENABLED, apply_cost_optimizer
@@ -201,14 +204,16 @@ class AggregateMeta(PlanMeta):
                     "expandable to the two-level device aggregation")
 
     def convert_to_tpu(self, children):
+        hint = getattr(self.plan, "many_groups_hint", False)
         child, stages, eval_schema = self._fold_stages(children[0])
         if stages:
             return A.TpuHashAggregateExec(self.plan.groupings,
                                           self.plan.aggs, child,
                                           pre_stages=stages,
-                                          eval_schema=eval_schema)
+                                          eval_schema=eval_schema,
+                                          many_groups_hint=hint)
         return A.TpuHashAggregateExec(self.plan.groupings, self.plan.aggs,
-                                      children[0])
+                                      children[0], many_groups_hint=hint)
 
     def _fold_stages(self, child):
         """Fold a chain of device-only Filter/Project execs below the
@@ -432,6 +437,15 @@ class RepartitionMeta(PlanMeta):
                                       p.keys, p.mode)
 
 
+@rule(L.BranchAlign)
+class BranchAlignMeta(PlanMeta):
+    def convert_to_tpu(self, children):
+        p = self.plan
+        return B.BranchAlignExec(p.n, p.fill_zero, children[0])
+
+    convert_to_cpu = convert_to_tpu
+
+
 @rule(L.WriteFile)
 class WriteMeta(PlanMeta):
     def convert_to_tpu(self, children):
@@ -455,7 +469,10 @@ class WindowMeta(PlanMeta):
 
     def convert_to_tpu(self, children):
         from ..exec.window import TpuWindowExec
-        return TpuWindowExec(self.plan.window_exprs, children[0])
+        # terminal (root) windows feed a host collect: the cost model may
+        # run their kernel on host XLA (see WINDOW_HOST_SINK_ROWS)
+        return TpuWindowExec(self.plan.window_exprs, children[0],
+                             host_sink=self.parent is None)
 
     def convert_to_cpu(self, children):
         from ..exec.window import CpuWindowExec

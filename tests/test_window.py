@@ -1,4 +1,5 @@
 """Differential window-function tests (ref window_function_test.py)."""
+import numpy as np
 import pandas as pd
 import pytest
 
@@ -147,3 +148,126 @@ def test_window_nan_vs_null_semantics():
                     or abs(a - b) <= 1e-9 * (1 + abs(b)), (c, rd, rc)
             else:
                 assert a == b, (c, rd, rc)
+
+
+def test_window_host_sink_xla_path():
+    """Terminal windows over the host-sink threshold run the same kernel
+    on host XLA (no device fetch); results must match the oracle and the
+    new columns must be host-resident."""
+    conf = {"spark.rapids.tpu.window.hostSinkRowThreshold": 64}
+
+    def q(s):
+        return _df(s, n=512).with_window_column(
+            "wsum", Sum(ColumnRef("v")), partition_by=["p"],
+            order_by=[F.col("o").asc(), F.col("v").asc()],
+            frame=("rows", -2, 0))
+    assert_tpu_and_cpu_equal(q, conf=conf)
+    # the produced window column is a HostColumn (no D2H needed)
+    from harness import tpu_session
+    from spark_rapids_tpu.columnar.column import HostColumn
+    s = tpu_session(conf)
+    df = q(s)
+    physical = df._physical()
+    batches = list(physical.execute(s.exec_context()))
+    assert isinstance(batches[0].columns[-1], HostColumn)
+
+
+def test_bounded_min_max_frames():
+    """Bounded ROWS frames for MIN/MAX (r1 limitation removed; ref
+    GpuBatchedBoundedWindowExec): interior sparse-table queries plus
+    partition-clamped scan reads."""
+    def q(s):
+        df = _df(s, n=600)
+        df = df.with_window_column(
+            "wmin", Min(ColumnRef("v")), partition_by=["p"],
+            order_by=[F.col("o").asc(), F.col("v").asc()],
+            frame=("rows", -3, 0))
+        df = df.with_window_column(
+            "wmax", Max(ColumnRef("v")), partition_by=["p"],
+            order_by=[F.col("o").asc(), F.col("v").asc()],
+            frame=("rows", -2, 2))
+        return df.with_window_column(
+            "wmax2", Max(ColumnRef("v")), partition_by=["p"],
+            order_by=[F.col("o").asc(), F.col("v").asc()],
+            frame=("rows", 1, 3))
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_bounded_min_max_half_open_frames():
+    def q(s):
+        df = _df(s, n=400)
+        df = df.with_window_column(
+            "rmin", Min(ColumnRef("v")), partition_by=["p"],
+            order_by=[F.col("o").asc(), F.col("v").asc()],
+            frame=("rows", None, -1))
+        return df.with_window_column(
+            "smax", Max(ColumnRef("v")), partition_by=["p"],
+            order_by=[F.col("o").asc(), F.col("v").asc()],
+            frame=("rows", 2, None))
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_bounded_min_max_nan_and_null():
+    """Spark semantics inside bounded frames: NULLs are skipped, NaN is
+    greatest (poisons max; min only when the frame is all-NaN)."""
+    import pyarrow as pa
+    vals = [1.0, np.nan, None, 4.0, np.nan, None, 2.0, 8.0]
+    t = pa.table({"g": pa.array([0] * len(vals), pa.int64()),
+                  "o": pa.array(range(len(vals)), pa.int64()),
+                  "v": pa.array(vals, pa.float64())})
+
+    def q(s):
+        df = s.create_dataframe(t)
+        df = df.with_window_column(
+            "bmin", Min(ColumnRef("v")), partition_by=["g"],
+            order_by=[F.col("o").asc()], frame=("rows", -1, 0))
+        return df.with_window_column(
+            "bmax", Max(ColumnRef("v")), partition_by=["g"],
+            order_by=[F.col("o").asc()], frame=("rows", -1, 1))
+    assert_tpu_and_cpu_equal(q, ignore_order=False)
+
+
+def test_window_host_numpy_path_matches_device_and_oracle():
+    """The host-sink numpy fast path is a third implementation of the
+    window math; pin it against BOTH the device kernel and the pandas
+    oracle across fn families and frames."""
+    import pyarrow as pa
+    rng = np.random.RandomState(4)
+    n = 900
+    vals = rng.uniform(-50, 50, n)
+    vmask = rng.rand(n) < 0.08
+    vals[rng.rand(n) < 0.05] = np.nan
+    t = pa.table({"p": pa.array(rng.randint(0, 9, n)),
+                  "o": pa.array(rng.randint(0, 1000, n)),
+                  "v": pa.array(np.where(vmask, 0.0, vals), mask=vmask)})
+
+    def q(s):
+        df = s.create_dataframe(t)
+        df = df.with_window_column(
+            "ws", Sum(ColumnRef("v")), partition_by=["p"],
+            order_by=[F.col("o").asc()], frame=("rows", -2, 1))
+        df = df.with_window_column(
+            "wmin", Min(ColumnRef("v")), partition_by=["p"],
+            order_by=[F.col("o").asc()], frame=("rows", -3, 0))
+        df = df.with_window_column(
+            "wmax", Max(ColumnRef("v")), partition_by=["p"],
+            order_by=[F.col("o").asc()], frame=("rows", None, 2))
+        df = df.with_window_column(
+            "rk", F.rank(), partition_by=["p"],
+            order_by=[F.col("o").desc()])
+        df = df.with_window_column(
+            "lg", Lag(ColumnRef("v"), 2), partition_by=["p"],
+            order_by=[F.col("o").asc()])
+        return df.with_window_column(
+            "av", Average(ColumnRef("v")), partition_by=["p"],
+            order_by=[F.col("o").asc()])
+
+    # device path (threshold off) vs oracle
+    dev = assert_tpu_and_cpu_equal(
+        q, conf={"spark.rapids.tpu.window.hostSinkRowThreshold": 0},
+        approximate_float=True)
+    # numpy host path (threshold 1) vs oracle
+    host = assert_tpu_and_cpu_equal(
+        q, conf={"spark.rapids.tpu.window.hostSinkRowThreshold": 1},
+        approximate_float=True)
+    assert list(dev.columns) == list(host.columns)
