@@ -228,10 +228,14 @@ class TpuHashAggregateExec(TpuExec):
     def __init__(self, groupings: Sequence[Expression],
                  aggs: Sequence[AggregateExpression], child: TpuExec,
                  pre_stages: Optional[list] = None,
-                 eval_schema: Optional[Schema] = None):
+                 eval_schema: Optional[Schema] = None,
+                 many_groups_hint: bool = False):
         super().__init__([child])
         self.groupings = list(groupings)
         self.aggs = list(aggs)
+        #: planner-known high cardinality: never try the optimistic
+        #: single-fetch path (its fused kernel compile would be wasted)
+        self.many_groups_hint = many_groups_hint
         #: fused pre-stages: ("filter", cond) / ("project", exprs, schema)
         #: applied INSIDE the update kernel, bottom-up from the child's
         #: actual output (the folded scan→filter→project→agg pipeline)
@@ -670,6 +674,7 @@ class TpuHashAggregateExec(TpuExec):
         first = next(it, None)
         second = next(it, None) if first is not None else None
         if first is not None and second is None \
+                and not self.many_groups_hint \
                 and _FAST_GROUPS.get(self._kernel_key, 0) \
                 <= self.OPTIMISTIC_GROUPS:
             first = first.ensure_device()

@@ -118,9 +118,15 @@ class Aggregate(LogicalPlan):
     """groupings: list of (expr, name); aggs: list of AggregateExpression
     (exprs/aggregates.py) each with an output name."""
 
-    def __init__(self, groupings, aggs, child: LogicalPlan):
+    def __init__(self, groupings, aggs, child: LogicalPlan,
+                 many_groups_hint: bool = False):
         self.groupings = list(groupings)
         self.aggs = list(aggs)
+        #: planner knows this aggregate is high-cardinality (e.g. the
+        #: inner dedup pass of a DISTINCT expansion groups by the distinct
+        #: value): the exec skips its optimistic single-fetch fast path,
+        #: whose kernel compile + fetch would be wasted
+        self.many_groups_hint = many_groups_hint
         self.children = [child]
 
     def schema(self) -> Schema:
@@ -278,6 +284,27 @@ class Expand(LogicalPlan):
         cs = self.children[0].schema()
         return Schema([StructField(n, e.data_type(cs), True)
                        for n, e in zip(self.names, self.projections[0])])
+
+
+class BranchAlign(LogicalPlan):
+    """Assemble the union-of-aggregates result: the child is a grouped
+    aggregate keyed by a branch-id column (first field); output has
+    exactly ``n`` rows in branch order, with empty branches filled by
+    empty-aggregate defaults (count -> 0, everything else -> NULL). Rows
+    are tiny (one per branch): a host op by construction."""
+
+    def __init__(self, n: int, fill_zero: Sequence[bool],
+                 child: LogicalPlan):
+        self.n = n
+        self.fill_zero = list(fill_zero)
+        self.children = [child]
+
+    def schema(self) -> Schema:
+        cs = self.children[0].schema()
+        return Schema(list(cs.fields)[1:])       # drop the bid key
+
+    def describe(self):
+        return f"BranchAlign[n={self.n}]"
 
 
 class Generate(LogicalPlan):

@@ -152,6 +152,12 @@ def prune_columns(plan: L.LogicalPlan,
 
 
 def rewrite_plan(plan: L.LogicalPlan) -> L.LogicalPlan:
+    if isinstance(plan, L.Union):
+        new = _rewrite_union_agg(plan)
+        if new is not None:
+            # the single-pass form contains a (possibly distinct) grouped
+            # aggregate that still needs the standard rewrites
+            return rewrite_plan(new)
     new_children = [rewrite_plan(c) for c in plan.children]
     if any(n is not o for n, o in zip(new_children, plan.children)):
         plan = copy.copy(plan)
@@ -166,6 +172,155 @@ def rewrite_plan(plan: L.LogicalPlan) -> L.LogicalPlan:
 
 _DECOMPOSABLE = (AG.Sum, AG.Count, AG.CountStar, AG.Min, AG.Max, AG.Average)
 _DISTINCT_OK = (AG.Count, AG.Sum, AG.Average)
+
+
+# ---------------------------------------------------------------------------
+# single-pass rewrite for unions of global aggregates over one shared scan
+# (the TPC-DS q28 shape: k disjoint-filter branches each computing
+# avg/count/count-distinct). Reference analog: RewriteDistinctAggregates'
+# Expand-based multi-distinct plan (GpuExpandExec + GpuAggregateExec merge
+# machinery, GpuAggregateExec.scala:718). k independent scans+sorts become
+# ONE grouped aggregation keyed by a branch id:
+#
+#   Project(outputs, bid dropped)
+#     Sort(bid)                               -- union branch order
+#       Join(left: Range(0..k), agg, on bid)  -- rows for EMPTY branches
+#         Aggregate([bid], shared aggs)
+#           Filter(bid IS NOT NULL)
+#           <tag>: Project(+CASE bid) when branch filters are provably
+#                  disjoint (1x rows), else Expand (one copy per matching
+#                  branch — correct under overlap)
+#             <shared child>
+# ---------------------------------------------------------------------------
+
+def _flatten_union(plan, out):
+    for c in plan.children:
+        if isinstance(c, L.Union):
+            _flatten_union(c, out)
+        else:
+            out.append(c)
+
+
+def _conjuncts(e, out):
+    from ..exprs.logical import And
+    if isinstance(e, And):
+        for c in e.children:
+            _conjuncts(c, out)
+    else:
+        out.append(e)
+
+
+def _branch_interval(cond):
+    """(col, lo, hi) when the condition's top-level conjuncts pin one
+    column into a closed interval; None otherwise."""
+    from ..exprs.comparison import (GreaterThan, GreaterThanOrEqual,
+                                    LessThan, LessThanOrEqual)
+    cs: list = []
+    _conjuncts(cond, cs)
+    lo = hi = col = None
+    for c in cs:
+        l, r = getattr(c, "children", (None, None))[:2] \
+            if len(getattr(c, "children", ())) == 2 else (None, None)
+        if not (isinstance(l, ColumnRef) and isinstance(r, Literal)):
+            continue
+        if isinstance(c, GreaterThanOrEqual):
+            b = r.value
+        elif isinstance(c, GreaterThan):
+            b = r.value  # open bound: treat as lo (conservative for ints)
+        elif isinstance(c, (LessThanOrEqual, LessThan)):
+            if col is None or col == l.name:
+                col = l.name
+                hi = r.value if hi is None else min(hi, r.value)
+            continue
+        else:
+            continue
+        if col is None or col == l.name:
+            col = l.name
+            lo = b if lo is None else max(lo, b)
+    if col is None or lo is None or hi is None:
+        return None
+    return (col, lo, hi)
+
+
+def _branches_disjoint(conds) -> bool:
+    ivs = [_branch_interval(c) for c in conds]
+    if any(iv is None for iv in ivs):
+        return False
+    col = ivs[0][0]
+    if any(iv[0] != col for iv in ivs):
+        return False
+    spans = sorted((iv[1], iv[2]) for iv in ivs)
+    return all(spans[i][1] < spans[i + 1][0] for i in range(len(spans) - 1))
+
+
+def _rewrite_union_agg(union: L.Union) -> Optional[L.LogicalPlan]:
+    branches: list = []
+    _flatten_union(union, branches)
+    if len(branches) < 2:
+        return None
+    conds = []
+    shared = None
+    for b in branches:
+        if not (isinstance(b, L.Aggregate) and not b.groupings
+                and len(b.children) == 1
+                and isinstance(b.children[0], L.Filter)):
+            return None
+        f = b.children[0]
+        if shared is None:
+            shared = f.children[0]
+        elif f.children[0] is not shared:
+            return None          # branches must scan the SAME relation
+        conds.append(f.condition)
+    # agg lists must be structurally identical across branches
+    a0 = branches[0].aggs
+    for b in branches[1:]:
+        if len(b.aggs) != len(a0):
+            return None
+        for x, y in zip(a0, b.aggs):
+            if type(x) is not type(y) or x.distinct != y.distinct \
+                    or x.name_hint != y.name_hint:
+                return None
+            cx = getattr(x, "child", None)
+            cy = getattr(y, "child", None)
+            if (cx is None) != (cy is None):
+                return None
+            if cx is not None and cx.key() != cy.key():
+                return None
+    for a in a0:
+        if a.distinct and type(a) not in _DISTINCT_OK:
+            return None
+        if not a.distinct and type(a) not in _DECOMPOSABLE:
+            return None
+    # a single distinct child expression at most (matches _rewrite_distinct)
+    if len({a.child.key() for a in a0 if a.distinct}) > 1:
+        return None
+
+    from ..exprs.comparison import IsNotNull
+    from ..exprs.conditional import CaseWhen
+    k = len(branches)
+    bid = "__ua_bid"
+    needed: set = set()
+    for a in a0:
+        _agg_refs(a, needed)
+    cs = shared.schema()
+    keep = [n for n in cs.names() if n in needed] or cs.names()[:1]
+    refs = [ColumnRef(n) for n in keep]
+    if _branches_disjoint(conds):
+        tag = CaseWhen([(c, Literal(i, INT64)) for i, c in enumerate(conds)])
+        tagged = L.Project(refs + [Alias(tag, bid)], shared)
+    else:
+        projections = [refs + [Alias(CaseWhen([(c, Literal(i, INT64))]),
+                                     bid)]
+                       for i, c in enumerate(conds)]
+        tagged = L.Expand(projections, keep + [bid], shared)
+    filtered = L.Filter(IsNotNull(ColumnRef(bid)), tagged)
+    agg = L.Aggregate([ColumnRef(bid)],
+                      [copy.copy(a) for a in a0], filtered)
+    # branch-ordered assembly with empty-branch defaults is a tiny host
+    # op (<= k rows) — cheaper than a join+sort tail, which would cost
+    # several device dispatches on a latency-bound backend
+    fill_zero = [isinstance(a, (AG.Count, AG.CountStar)) for a in a0]
+    return L.BranchAlign(k, fill_zero, agg)
 
 
 def _rewrite_distinct(agg: L.Aggregate) -> Optional[L.LogicalPlan]:
@@ -216,7 +371,8 @@ def _rewrite_distinct(agg: L.Aggregate) -> Optional[L.LogicalPlan]:
             projections.append(Alias(ColumnRef(t), out))
 
     inner_groupings = list(agg.groupings) + [Alias(d_expr, dname)]
-    inner = L.Aggregate(inner_groupings, inner_aggs, agg.children[0])
+    inner = L.Aggregate(inner_groupings, inner_aggs, agg.children[0],
+                        many_groups_hint=True)
     outer_groupings = [ColumnRef(g.name_hint) for g in agg.groupings]
     outer = L.Aggregate(outer_groupings, outer_aggs, inner)
     return L.Project(projections, outer)

@@ -163,3 +163,80 @@ def test_agg_output_feeds_window():
     df = agg.with_window_column("r", F.sum(F.col("sv")))
     out = df.to_pandas()
     assert len(out) == 5 and np.allclose(out["r"], out["sv"].sum())
+
+
+# ---------------------------------------------------------------------------
+# union-of-aggregates single-pass rewrite (the q28 shape; ref
+# RewriteDistinctAggregates' Expand plan + GpuAggregateExec merge)
+# ---------------------------------------------------------------------------
+
+def test_union_agg_single_pass_plan_shape():
+    """q28 must plan as ONE aggregation pipeline (no Union of 6 scans)."""
+    s = tpu_session()
+    ss, _, _ = _dstables(s)
+    tree = tpcds.q28(ss, F)._physical().tree_string()
+    assert "Union" not in tree, tree
+    assert tree.count("InMemoryScan") == 1, tree
+
+
+def test_union_agg_overlapping_branches():
+    """Non-disjoint branch filters take the Expand path: a row matching
+    two branches must count in both."""
+    import pyarrow as pa
+    t = pa.table({"q": pa.array([1, 5, 10, 15, 20], pa.int64()),
+                  "v": pa.array([1.0, 2.0, 3.0, 4.0, 5.0])})
+
+    def q(s):
+        df = s.create_dataframe(t)
+        b1 = df.filter((F.col("q") >= F.lit(0)) & (F.col("q") <= F.lit(10)))
+        b2 = df.filter((F.col("q") >= F.lit(5)) & (F.col("q") <= F.lit(20)))
+        return (b1.agg(F.count(F.col("v")).with_name("c"),
+                       F.sum(F.col("v")).with_name("s"),
+                       F.count_distinct(F.col("v")).with_name("cd"))
+                .union(b2.agg(F.count(F.col("v")).with_name("c"),
+                              F.sum(F.col("v")).with_name("s"),
+                              F.count_distinct(F.col("v")).with_name("cd"))))
+    t_got = assert_tpu_and_cpu_equal(q, ignore_order=False)
+    assert list(t_got["c"]) == [3, 4]
+
+
+def test_union_agg_empty_branch_defaults():
+    """A branch matching zero rows must still emit its row: count 0,
+    sum/avg NULL (empty-aggregate semantics through the left join)."""
+    import pyarrow as pa
+    t = pa.table({"q": pa.array([1, 2, 3], pa.int64()),
+                  "v": pa.array([1.0, 2.0, 3.0])})
+
+    def q(s):
+        df = s.create_dataframe(t)
+        b1 = df.filter((F.col("q") >= F.lit(0)) & (F.col("q") <= F.lit(10)))
+        b2 = df.filter((F.col("q") >= F.lit(100))
+                       & (F.col("q") <= F.lit(200)))
+        aggs = lambda b: b.agg(F.count(F.col("v")).with_name("c"),
+                               F.avg(F.col("v")).with_name("a"),
+                               F.count_distinct(F.col("v")).with_name("cd"))
+        return aggs(b1).union(aggs(b2))
+    t_got = assert_tpu_and_cpu_equal(q, ignore_order=False,
+                                     approximate_float=True)
+    assert list(t_got["c"]) == [3, 0]
+    assert t_got["a"].isna().tolist() == [False, True]
+
+
+def test_union_agg_branch_order_preserved():
+    """Union output rows arrive in branch order even though the single
+    pass computes them keyed by branch id."""
+    import pyarrow as pa
+    t = pa.table({"q": pa.array(list(range(30)), pa.int64())})
+
+    def q(s):
+        df = s.create_dataframe(t)
+        outs = None
+        for lo, hi in [(20, 29), (0, 9), (10, 19)]:
+            b = df.filter((F.col("q") >= F.lit(lo))
+                          & (F.col("q") <= F.lit(hi))) \
+                .agg(F.min(F.col("q")).with_name("mn"),
+                     F.max(F.col("q")).with_name("mx"))
+            outs = b if outs is None else outs.union(b)
+        return outs
+    t_got = assert_tpu_and_cpu_equal(q, ignore_order=False)
+    assert list(t_got["mn"]) == [20, 0, 10]
