@@ -938,8 +938,7 @@ class DistributedPipelineExec(TpuExec):
     def _stitch(self, env: _Env, outs, counts, dicts):
         import jax
         import pyarrow as pa
-        from ..columnar.column import DeviceColumn
-        from ..types import to_arrow
+        from ..columnar.column import arrow_from_numpy
         n_dev = env.n_dev
         root = self.root
         take_first_only = root.replicated
@@ -965,10 +964,10 @@ class DistributedPipelineExec(TpuExec):
                     arr = pa.nulls(len(dv), type=pa.string())
                 arrays.append(arr)
             else:
-                import jax.numpy as jnp
-                col = DeviceColumn(jnp.asarray(dv), jnp.asarray(vv),
-                                   lf.logical)
-                arrays.append(col.to_arrow(len(dv)))
+                # arrays are already host numpy (device_get above) —
+                # convert directly; a DeviceColumn round trip would pay
+                # one H2D + one D2H tunnel crossing per result column
+                arrays.append(arrow_from_numpy(dv, vv, lf.logical))
         names = [f.name for f in self._schema.fields]
         return pa.Table.from_arrays(arrays, names=names)
 

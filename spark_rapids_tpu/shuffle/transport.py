@@ -1,0 +1,173 @@
+"""TCP shuffle transport: block server + client.
+
+The cross-process leg of the shuffle (ref RapidsShuffleTransport's message
+protocol {MetadataRequest, TransferRequest, Buffer} —
+shuffle/RapidsShuffleTransport.scala:44-119 — and the host-staged
+MULTITHREADED mode, RapidsShuffleInternalManagerBase.scala:238,614).
+Within one process/slice the engine shuffles through HBM (ShuffleCatalog)
+or XLA collectives (parallel/); this transport is the portable
+process-to-process fallback, moving the engine's serialized Arrow blocks
+(columnar/serializer.py) over length-prefixed TCP messages.
+
+Message = 4-byte big-endian header length + JSON header + raw payload
+(length in the header). Ops:
+  put    {shuffle, part, size}+payload  -> {ok}
+  fetch  {shuffle, part}                -> {sizes: [...]}+concat(payloads)
+  call   {size}+pickled callable        -> {size}+pickled result (worker
+         task execution; the driver is trusted — same machine/user)
+"""
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import struct
+import threading
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["BlockServer", "BlockClient"]
+
+
+def _send_msg(sock: socket.socket, header: dict, payload: bytes = b""):
+    h = json.dumps(header).encode()
+    sock.sendall(struct.pack(">I", len(h)) + h + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        got = sock.recv(n - len(buf))
+        if not got:
+            raise ConnectionError("peer closed")
+        buf.extend(got)
+    return bytes(buf)
+
+
+def _recv_msg(sock: socket.socket) -> Tuple[dict, bytes]:
+    hlen = struct.unpack(">I", _recv_exact(sock, 4))[0]
+    header = json.loads(_recv_exact(sock, hlen))
+    payload = _recv_exact(sock, header.get("size", 0)) \
+        if header.get("size") else b""
+    return header, payload
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        server: "BlockServer" = self.server.owner  # type: ignore
+        try:
+            while True:
+                header, payload = _recv_msg(self.request)
+                op = header.get("op")
+                if op == "put":
+                    server._put(header["shuffle"], header["part"], payload)
+                    _send_msg(self.request, {"ok": True})
+                elif op == "fetch":
+                    blocks = server._fetch(header["shuffle"],
+                                           header["part"])
+                    body = b"".join(blocks)
+                    _send_msg(self.request,
+                              {"sizes": [len(b) for b in blocks],
+                               "size": len(body)}, body)
+                elif op == "call":
+                    import pickle
+                    fn = pickle.loads(payload)
+                    try:
+                        res = pickle.dumps((True, fn()))
+                    except Exception as e:  # shipped back, raised driver-side
+                        res = pickle.dumps((False, repr(e)))
+                    _send_msg(self.request, {"size": len(res)}, res)
+                elif op == "drop":
+                    server._drop(header["shuffle"])
+                    _send_msg(self.request, {"ok": True})
+                elif op == "close":
+                    return
+                else:
+                    raise ValueError(f"unknown op {op}")
+        except (ConnectionError, OSError):
+            return
+
+
+class _TCPServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class BlockServer:
+    """In-memory store of serialized shuffle blocks, served over TCP
+    (ref RapidsShuffleServer.doHandleTransferRequest:320 — the host-staged
+    analog: blocks already live in host memory here)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._blocks: Dict[Tuple[int, int], List[bytes]] = {}
+        self._lock = threading.Lock()
+        self._srv = _TCPServer((host, port), _Handler)
+        self._srv.owner = self
+        self.address = self._srv.server_address
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def _put(self, shuffle: int, part: int, data: bytes):
+        with self._lock:
+            self._blocks.setdefault((shuffle, part), []).append(data)
+
+    def _fetch(self, shuffle: int, part: int) -> List[bytes]:
+        with self._lock:
+            return list(self._blocks.get((shuffle, part), []))
+
+    def _drop(self, shuffle: int):
+        with self._lock:
+            for k in [k for k in self._blocks if k[0] == shuffle]:
+                del self._blocks[k]
+
+    def close(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+class BlockClient:
+    """Connection to one peer's BlockServer (ref RapidsShuffleClient
+    doFetch:174). One socket, serial request/response; callers needing
+    parallel fetches open one client per thread."""
+
+    def __init__(self, address):
+        self.address = tuple(address)
+        self._sock = socket.create_connection(self.address, timeout=120)
+
+    def put(self, shuffle: int, part: int, data: bytes):
+        _send_msg(self._sock, {"op": "put", "shuffle": shuffle,
+                               "part": part, "size": len(data)}, data)
+        _recv_msg(self._sock)
+
+    def fetch(self, shuffle: int, part: int) -> List[bytes]:
+        _send_msg(self._sock, {"op": "fetch", "shuffle": shuffle,
+                               "part": part})
+        header, body = _recv_msg(self._sock)
+        out, off = [], 0
+        for s in header["sizes"]:
+            out.append(body[off:off + s])
+            off += s
+        return out
+
+    def call(self, fn):
+        """Run a picklable callable in the peer process; raises on remote
+        failure."""
+        import pickle
+        data = pickle.dumps(fn)
+        _send_msg(self._sock, {"op": "call", "size": len(data)}, data)
+        _, body = _recv_msg(self._sock)
+        ok, res = pickle.loads(body)
+        if not ok:
+            raise RuntimeError(f"remote task failed: {res}")
+        return res
+
+    def drop(self, shuffle: int):
+        _send_msg(self._sock, {"op": "drop", "shuffle": shuffle})
+        _recv_msg(self._sock)
+
+    def close(self):
+        try:
+            _send_msg(self._sock, {"op": "close"})
+            self._sock.close()
+        except OSError:
+            pass

@@ -1,0 +1,110 @@
+"""Multi-process shuffle runtime (shuffle/cluster.py + transport.py):
+worker processes discovered via heartbeats, shuffle blocks moving over
+TCP, differential against the local engine (the reference's
+local-cluster tier, SURVEY.md section 4.3; RapidsShuffleInternalManagerBase
+threaded writer/reader analog)."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from harness import tpu_session
+from spark_rapids_tpu.api import functions as F
+
+pytestmark = pytest.mark.filterwarnings("ignore::ResourceWarning")
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    from spark_rapids_tpu.shuffle.cluster import LocalCluster
+    cl = LocalCluster(2)
+    yield cl
+    cl.shutdown()
+
+
+def _sales(n=20000, seed=3):
+    rng = np.random.RandomState(seed)
+    return pa.table({
+        "k": pa.array(rng.randint(0, 23, n)),
+        "g": pa.array(rng.choice(["x", "y", "z"], n)),
+        "v": pa.array(np.round(rng.uniform(0, 100, n), 2)),
+    })
+
+
+def test_transport_put_fetch_roundtrip():
+    from spark_rapids_tpu.shuffle.transport import BlockClient, BlockServer
+    srv = BlockServer()
+    try:
+        c = BlockClient(srv.address)
+        c.put(7, 0, b"alpha")
+        c.put(7, 0, b"beta")
+        c.put(7, 1, b"gamma")
+        assert c.fetch(7, 0) == [b"alpha", b"beta"]
+        assert c.fetch(7, 1) == [b"gamma"]
+        assert c.fetch(7, 2) == []
+        c.drop(7)
+        assert c.fetch(7, 0) == []
+        c.close()
+    finally:
+        srv.close()
+
+
+def test_heartbeat_discovers_peers(cluster):
+    # the driver registry saw both workers; each worker connected to the
+    # other through on_new_peer (heartbeat.py's production caller)
+    assert len(cluster.manager.live_peers()) == 2
+    from spark_rapids_tpu.shuffle.cluster import _worker_heartbeat
+    for c in cluster.clients.values():
+        peers = c.call(_worker_heartbeat)
+        assert len(peers) == 1          # the OTHER worker connected
+
+
+def test_distributed_grouped_agg_differential(cluster):
+    t = _sales()
+    s = tpu_session()
+    df = (s.create_dataframe(t).group_by("k", "g")
+          .agg(F.sum(F.col("v")).with_name("sv"),
+               F.count_star().with_name("n"),
+               F.avg(F.col("v")).with_name("av"),
+               F.min(F.col("v")).with_name("mn"),
+               F.max(F.col("v")).with_name("mx")))
+    got = cluster.execute(df).to_pandas() \
+        .sort_values(["k", "g"]).reset_index(drop=True)
+    want = df.collect_arrow().to_pandas() \
+        .sort_values(["k", "g"]).reset_index(drop=True)
+    assert list(got.columns) == list(want.columns)
+    np.testing.assert_array_equal(got["k"], want["k"])
+    np.testing.assert_array_equal(got["g"], want["g"])
+    np.testing.assert_array_equal(got["n"], want["n"])
+    for c in ("sv", "av", "mn", "mx"):
+        np.testing.assert_allclose(got[c], want[c], rtol=1e-9)
+
+
+def test_distributed_q3_two_processes(cluster):
+    """TPC-DS q3 across 2 worker processes: fact scan sliced, dims
+    broadcast, partial aggregates shuffled over TCP, driver finishes the
+    order-by (VERDICT r1 #7 'done' criterion)."""
+    import sys
+    sys.path.insert(0, ".")
+    from benchmarks import tpcds
+    ss = tpcds.gen_store_sales(30000)
+    s = tpu_session()
+    q = tpcds.q3(s.create_dataframe(ss),
+                 s.create_dataframe(tpcds.gen_date_dim()),
+                 s.create_dataframe(tpcds.gen_item()), F)
+    got = cluster.execute(q).to_pandas()
+    want = q.collect_arrow().to_pandas()
+    assert len(got) == len(want)
+    np.testing.assert_array_equal(got["d_year"], want["d_year"])
+    np.testing.assert_array_equal(got["i_brand"], want["i_brand"])
+    np.testing.assert_allclose(got["sum_agg"], want["sum_agg"], rtol=1e-9)
+
+
+def test_distributed_global_agg(cluster):
+    t = _sales(5000)
+    s = tpu_session()
+    df = s.create_dataframe(t).agg(F.sum(F.col("v")).with_name("s"),
+                                   F.count_star().with_name("n"))
+    got = cluster.execute(df).to_pylist()
+    want = df.collect()
+    assert got[0]["n"] == want[0]["n"]
+    np.testing.assert_allclose(got[0]["s"], want[0]["s"], rtol=1e-12)
