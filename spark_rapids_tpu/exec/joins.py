@@ -45,6 +45,7 @@ __all__ = ["TpuHashJoinExec", "TpuNestedLoopJoinExec",
            "TpuBroadcastHashJoinExec", "CpuJoinExec"]
 
 _COUNT_CACHE: Dict[Tuple, object] = {}
+_FUSED_CACHE: Dict[Tuple, object] = {}
 _GATHER_CACHE: Dict[Tuple, object] = {}
 #: last observed output total per join shape (feeds speculative sizing)
 _TOTAL_STATS: Dict[Tuple, int] = {}
@@ -194,6 +195,54 @@ def _gather_index_kernel(s_orig, cnt_l, cnt_r, start_l, start_r, offsets,
     r_row = jnp.where(rpos >= 0, jnp.take(s_orig, jnp.maximum(rpos, 0),
                                           mode="clip"), -1)
     return l_row.astype(jnp.int32), r_row.astype(jnp.int32)
+
+
+def _packed_gather(cols, idx_rows, out_p):
+    """Materialize columns by row index with ONE validity gather per 32
+    columns: validities pack into int32 bit lanes before the take, so an
+    n-column table pays n data gathers + ceil(n/32) validity gathers
+    instead of 2n (gathers serialize per element on the TPU scalar core —
+    docs/performance.md)."""
+    idx = jnp.clip(idx_rows, 0, None)
+    null_row = idx_rows < 0
+    present = [(i, c) for i, c in enumerate(cols) if c is not None]
+    outs = [None] * len(cols)
+    for base in range(0, len(present), 32):
+        chunk = present[base:base + 32]
+        vmask = None
+        for bit, (_, (d, v)) in enumerate(chunk):
+            lane = v.astype(jnp.uint32) << bit
+            vmask = lane if vmask is None else (vmask | lane)
+        gmask = jnp.take(vmask, idx, mode="clip")
+        for bit, (i, (d, v)) in enumerate(chunk):
+            od = jnp.take(d, idx, mode="clip")
+            ov = jnp.logical_and(((gmask >> bit) & 1).astype(jnp.bool_),
+                                 jnp.logical_not(null_row))
+            outs[i] = (od, ov)
+    return outs
+
+
+def _build_fused_join_kernel(count_kern, semi_like: bool):
+    """count + gather-map + materialization in ONE dispatch (speculative
+    sizing makes out_p static without reading the device total, so the
+    whole join is a single kernel launch — three tunnel round trips
+    become one)."""
+
+    @functools.partial(jax.jit, static_argnums=(4, 5, 6))
+    def fused(lcols, rcols, n_l, n_r, p_l, p_r, out_p, cfg):
+        (s_orig, cnt_l, cnt_r, start_l, start_r, _pairs, offsets, total,
+         _ng) = count_kern(lcols, rcols, n_l, n_r, p_l, p_r)
+        l_row, r_row = _gather_index_kernel(
+            s_orig, cnt_l, cnt_r, start_l, start_r, offsets, cfg, out_p)
+        live = jnp.arange(out_p, dtype=jnp.int64) < total
+        l_row = jnp.where(live, l_row, -1)
+        r_row = jnp.where(live, r_row, -1)
+        louts = _packed_gather(lcols, l_row, out_p)
+        routs = ([] if semi_like
+                 else _packed_gather(rcols, r_row, out_p))
+        return total, louts, routs
+
+    return fused
 
 
 def _join_schema(ls: Schema, rs: Schema, join_type: str,
@@ -518,11 +567,24 @@ class TpuHashJoinExec(TpuExec):
                  else None for c in lb.columns]
         rcols = [(c.data, c.validity) if isinstance(c, DeviceColumn)
                  else None for c in rb.columns]
+        semi_like = self.join_type in ("leftsemi", "leftanti")
+
+        # ONE-dispatch fused path: with speculative sizing the output
+        # bucket is known without reading the device total, so count +
+        # gather maps + packed materialization run as a single kernel
+        # (vs three launches, each a tunnel round trip)
+        spec0 = (ctx is not None and ctx.speculate)
+        stat0 = _TOTAL_STATS.get(ck)
+        all_dev = lb.all_device and rb.all_device
+        if all_dev and spec0 and self.condition is None \
+                and (semi_like or stat0 is not None):
+            return self._join_fused(ctx, lb, rb, lcols, rcols, ck,
+                                    kern, semi_like, stat0)
+
         (s_orig, cnt_l, cnt_r, start_l, start_r, pairs, offsets, total,
          num_groups) = kern(lcols, rcols, jnp.int32(lb.num_rows_raw),
                             jnp.int32(rb.num_rows_raw), lb.padded_len,
                             rb.padded_len)
-        semi_like = self.join_type in ("leftsemi", "leftanti")
         # speculative output sizing: guessing the output bucket from the
         # input sizes skips the count->host->gather sync (a full tunnel
         # round trip, ~40-150 ms, PER JOIN). semi/anti have the hard bound
@@ -565,6 +627,33 @@ class TpuHashJoinExec(TpuExec):
         if self.condition is not None:
             out = filter_batch_device(self.condition, out)
         return out
+
+    def _join_fused(self, ctx, lb: ColumnarBatch, rb: ColumnarBatch,
+                    lcols, rcols, ck, count_kern, semi_like: bool,
+                    stat) -> ColumnarBatch:
+        fk = _FUSED_CACHE.get(ck)
+        if fk is None:
+            fk = _build_fused_join_kernel(count_kern, semi_like)
+            _FUSED_CACHE[ck] = fk
+        if semi_like:
+            out_p = bucket_for(max(lb.padded_len, 1))
+        else:
+            out_p = bucket_for(max(int(stat * 1.5), 1))
+        left_nullable = 1 if self.join_type in ("right", "full") else 0
+        right_nullable = 1 if self.join_type in ("left", "full") else 0
+        cfg = jnp.array([left_nullable, right_nullable,
+                         1 if semi_like else 0], dtype=jnp.int32)
+        total, louts, routs = fk(lcols, rcols, jnp.int32(lb.num_rows_raw),
+                                 jnp.int32(rb.num_rows_raw),
+                                 lb.padded_len, rb.padded_len, out_p, cfg)
+        if not semi_like:
+            ctx.speculations.append((total, out_p, ck))
+        new_cols = [c.with_arrays(d, v)
+                    for c, (d, v) in zip(lb.columns, louts)]
+        if not semi_like:
+            new_cols += [c.with_arrays(d, v)
+                         for c, (d, v) in zip(rb.columns, routs)]
+        return ColumnarBatch(new_cols, total, self._schema)
 
     def _cross(self, lb: ColumnarBatch, rb: ColumnarBatch) -> ColumnarBatch:
         n_out = lb.num_rows * rb.num_rows

@@ -8,8 +8,11 @@ manifest avro -> data files) is parsed with the generic host Avro decoder
 
 Supported: format v1 and v2 metadata, current or explicit snapshot,
 parquet data files, live-entry filtering (status != DELETED), schema from
-the current schema id. Row-level delete files (v2 positional/equality
-deletes) are detected and rejected honestly.
+the current schema id, and v2 row-level deletes: positional delete files
+(file_path, pos) and equality delete files (keyed by equality_ids) are
+applied during scan planning with sequence-number scoping — a delete
+applies only to data files with a strictly older data sequence number
+(ref the reference's iceberg/data java bridge delete-filter chain).
 """
 from __future__ import annotations
 
@@ -102,48 +105,131 @@ class IcebergTable:
         return os.path.join(self.path, p)
 
     # ----------------------------------------------------------- planning
-    def data_files(self, snapshot_id: Optional[int] = None) -> List[dict]:
-        """Live data-file entries of the snapshot (ref the reference's
-        GpuIcebergScan planning: manifest list -> manifests -> entries)."""
+    def plan_scan(self, snapshot_id: Optional[int] = None):
+        """Live data-file entries + delete-file entries of the snapshot
+        (ref the reference's GpuIcebergScan planning: manifest list ->
+        manifests -> entries). Returns (data, deletes): data is a list of
+        (seq, data_file dict); deletes of (seq, data_file dict)."""
         from ..io.avro import read_avro_records
         snap = self.snapshot(snapshot_id)
         if snap is None:
-            return []
+            return [], []
         mlist = self._resolve(snap["manifest-list"])
-        out: List[dict] = []
+        data: List[tuple] = []
+        deletes: List[tuple] = []
         for m in read_avro_records(mlist):
-            if m.get("content", 0) == 1:
-                raise ValueError(
-                    "iceberg delete manifests (row-level deletes) are not "
-                    "yet supported")
+            mseq = m.get("sequence_number") or 0
             mpath = self._resolve(m["manifest_path"])
             for entry in read_avro_records(mpath):
                 if entry.get("status") == 2:   # DELETED
                     continue
                 df = entry["data_file"]
-                if df.get("content", 0) != 0:
-                    raise ValueError("iceberg delete files not supported")
+                seq = entry.get("sequence_number")
+                if seq is None:
+                    seq = mseq
+                content = df.get("content", 0)
                 fmt = str(df.get("file_format", "PARQUET")).upper()
                 if fmt != "PARQUET":
-                    raise ValueError(f"iceberg {fmt} data files not supported")
-                out.append(df)
-        return out
+                    raise ValueError(
+                        f"iceberg {fmt} data files not supported")
+                if content == 0:
+                    data.append((seq, df))
+                else:                          # 1 positional, 2 equality
+                    deletes.append((seq, df))
+        return data, deletes
+
+    def data_files(self, snapshot_id: Optional[int] = None) -> List[dict]:
+        return [df for _, df in self.plan_scan(snapshot_id)[0]]
 
     def file_paths(self, snapshot_id: Optional[int] = None) -> List[str]:
         return [self._resolve(d["file_path"])
                 for d in self.data_files(snapshot_id)]
 
+    def _field_names_by_id(self) -> Dict[int, str]:
+        md = self.metadata
+        if "schemas" in md:
+            sid = md.get("current-schema-id", 0)
+            js = next(s for s in md["schemas"]
+                      if s.get("schema-id") == sid)
+        else:
+            js = md["schema"]
+        return {f["id"]: f["name"] for f in js["fields"] if "id" in f}
+
+    def _apply_deletes(self, tables, data, deletes):
+        """tables: per-data-file arrow tables aligned with ``data``.
+        Positional deletes drop (file_path, pos) rows; equality deletes
+        drop rows matching the delete file's key tuples. A delete applies
+        only to data files with an OLDER data sequence number (iceberg v2
+        scoping; equal seq = same commit, not applicable)."""
+        import numpy as np
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        by_id = self._field_names_by_id()
+        # positional: target path -> [(seq, positions array)]
+        pos_by_path: Dict[str, List[tuple]] = {}
+        eq_sets: List[tuple] = []      # (seq, key names, key table)
+        for seq, df in deletes:
+            t = pq.read_table(self._resolve(df["file_path"]))
+            if df.get("content") == 1:
+                paths = t.column("file_path").to_pylist()
+                poss = np.asarray(t.column("pos").to_pylist(),
+                                  dtype=np.int64)
+                for p in set(paths):
+                    mask = np.asarray([x == p for x in paths])
+                    pos_by_path.setdefault(p, []).append(
+                        (seq, poss[mask]))
+            else:
+                ids = df.get("equality_ids") or []
+                names = [by_id[i] for i in ids] if ids \
+                    else list(t.column_names)
+                eq_sets.append((seq, names, t.select(names)))
+        out = []
+        for (dseq, df), table in zip(data, tables):
+            fpath = df["file_path"]
+            keep = np.ones(table.num_rows, dtype=bool)
+            for p, entries in pos_by_path.items():
+                if not (p == fpath or self._resolve(p)
+                        == self._resolve(fpath)):
+                    continue
+                for seq, poss in entries:
+                    if seq >= dseq:    # delete is newer (or same commit +)
+                        valid = poss[(poss >= 0)
+                                     & (poss < table.num_rows)]
+                        keep[valid] = False
+            for seq, names, kt in eq_sets:
+                if seq <= dseq:        # applies to strictly older data
+                    continue
+                import pandas as pd
+                left = table.select(names).to_pandas()
+                right = kt.to_pandas().drop_duplicates()
+                merged = left.merge(right, on=names, how="left",
+                                    indicator=True)
+                keep &= (merged["_merge"] == "left_only").to_numpy()
+            out.append(table.filter(pa.array(keep)))
+        return out
+
     def to_df(self, session, columns: Optional[List[str]] = None,
               snapshot_id: Optional[int] = None):
+        import pyarrow as pa
         from ..api.dataframe import DataFrame
         from ..plan import logical as L
-        paths = self.file_paths(snapshot_id)
+        from ..types import to_arrow
+        data, deletes = self.plan_scan(snapshot_id)
         schema = self.schema
-        if not paths:
-            import pyarrow as pa
-
-            from ..types import to_arrow
+        if not data:
             empty = pa.table({f.name: pa.array([], to_arrow(f.dtype))
                               for f in schema.fields})
             return DataFrame(session, L.LogicalScan([empty], schema))
-        return DataFrame(session, L.ParquetScan(paths, schema, columns))
+        paths = [self._resolve(d["file_path"]) for _, d in data]
+        if not deletes:
+            return DataFrame(session, L.ParquetScan(paths, schema,
+                                                    columns))
+        # row-level deletes: materialize per-file tables, apply the
+        # delete filter chain, scan the filtered tables
+        import pyarrow.parquet as pq
+        tables = [pq.read_table(p) for p in paths]
+        tables = self._apply_deletes(tables, data, deletes)
+        if columns:
+            tables = [t.select(columns) for t in tables]
+            schema = Schema([schema[c] for c in columns])
+        return DataFrame(session, L.LogicalScan(tables, schema))

@@ -2,15 +2,16 @@
 parquet, stripe stitching, schema-evolution casts).
 
 Host decode is pyarrow's C++ ORC reader (the cudf-ORC-decode analog);
-stripes play the row-group role. pyarrow exposes no per-stripe statistics,
-so predicate pruning is file-level only (tagged honestly in describe());
-the reference prunes stripes via the ORC SearchArgument on the CPU side
-(GpuOrcScan filterStripes) — the equivalent here would need a native ORC
-footer parser, tracked as future work.
+stripes play the row-group role. pyarrow exposes no per-stripe
+statistics, so stripe-level predicate pruning parses the ORC footer and
+metadata sections natively (io/orc_meta.py) and skips stripes the
+pushed-down predicate provably excludes — the CPU-side SearchArgument
+evaluation of the reference (GpuOrcScan filterStripes), sharing
+parquet's conservative interval matcher.
 """
 from __future__ import annotations
 
-from typing import List
+from typing import List, Optional
 
 from ..config import register
 from ..types import Schema, StructField, from_arrow
@@ -40,9 +41,39 @@ class OrcScanExec(FileScanBase):
     READER_TYPE_KEY = ORC_READER_TYPE
 
     def _read_table(self, path: str):
+        import pyarrow as pa
         from pyarrow import orc
-        f = orc.ORCFile(self._cached_path(path))
-        t = f.read(columns=self.columns)
+        local = self._cached_path(path)
+        f = orc.ORCFile(local)
+        keep = self._filter_stripes(local, f.nstripes)
+        if keep is None:
+            t = f.read(columns=self.columns)
+        elif not keep:
+            t = f.schema.empty_table()
+        elif len(keep) == f.nstripes:
+            t = f.read(columns=self.columns)
+        else:
+            parts = [f.read_stripe(i, columns=self.columns)
+                     for i in keep]
+            t = pa.Table.from_batches(parts)
         if self.columns:
             t = t.select(self.columns)  # requested order, not file order
         return t
+
+    def _filter_stripes(self, path: str,
+                        nstripes: int) -> Optional[List[int]]:
+        """Stripe pruning from the natively-parsed ORC metadata
+        statistics (ref GpuOrcScan filterStripes)."""
+        if self.predicate is None:
+            return None
+        from .orc_meta import read_orc_meta
+        from .parquet import _maybe_matches
+        meta = read_orc_meta(path)
+        if meta is None or meta.stripe_stats is None \
+                or len(meta.stripe_stats) != nstripes:
+            return None
+        try:
+            return [i for i, stats in enumerate(meta.stripe_stats)
+                    if _maybe_matches(self.predicate, stats)]
+        except Exception:
+            return None

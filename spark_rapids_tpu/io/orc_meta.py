@@ -1,0 +1,254 @@
+"""Native ORC footer + stripe-statistics parser.
+
+pyarrow's ORC binding exposes no per-stripe statistics, so stripe-level
+predicate pruning (ref GpuOrcScan.scala filterStripes — the ORC
+SearchArgument evaluated on the CPU before any decode) needs this
+minimal reader of the ORC file tail: PostScript -> Footer (stripes,
+types) -> Metadata (per-stripe column statistics). Only the protobuf
+fields the pruner consumes are decoded; everything else is skipped by
+wire type. Handles NONE- and ZLIB-compressed footers (what pyarrow and
+the Java writer emit by default); other codecs disable pruning
+gracefully.
+
+ORC spec: https://orc.apache.org/specification/ORCv1/ (public format).
+"""
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["OrcFileMeta", "read_orc_meta"]
+
+_VARINT = 0
+_I64 = 1
+_LEN = 2
+_I32 = 5
+
+
+def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    out = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, pos
+        shift += 7
+
+
+def _zigzag(v: int) -> int:
+    return (v >> 1) ^ -(v & 1)
+
+
+def _fields(buf: bytes):
+    """Yield (field_number, wire_type, value) over a protobuf message.
+    LEN fields yield bytes; VARINT ints; I64/I32 raw ints."""
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        tag, pos = _read_varint(buf, pos)
+        fno, wt = tag >> 3, tag & 7
+        if wt == _VARINT:
+            v, pos = _read_varint(buf, pos)
+        elif wt == _LEN:
+            ln, pos = _read_varint(buf, pos)
+            v = buf[pos:pos + ln]
+            pos += ln
+        elif wt == _I64:
+            v = struct.unpack_from("<q", buf, pos)[0]
+            pos += 8
+        elif wt == _I32:
+            v = struct.unpack_from("<i", buf, pos)[0]
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        yield fno, wt, v
+
+
+def _decompress(data: bytes, kind: int) -> bytes:
+    """ORC compressed stream: 3-byte chunk headers
+    (len << 1 | isOriginal), repeated. kind: 0=NONE 1=ZLIB."""
+    if kind == 0:
+        return data
+    out = bytearray()
+    pos = 0
+    while pos + 3 <= len(data):
+        h = data[pos] | (data[pos + 1] << 8) | (data[pos + 2] << 16)
+        pos += 3
+        ln = h >> 1
+        chunk = data[pos:pos + ln]
+        pos += ln
+        if h & 1:                      # original (uncompressed) chunk
+            out.extend(chunk)
+        elif kind == 1:                # zlib = raw deflate
+            out.extend(zlib.decompress(chunk, -15))
+        else:
+            raise ValueError(f"unsupported ORC compression kind {kind}")
+    return bytes(out)
+
+
+class _ColStats:
+    __slots__ = ("num_values", "has_null", "minimum", "maximum")
+
+    def __init__(self):
+        self.num_values: Optional[int] = None
+        self.has_null: Optional[bool] = None
+        self.minimum = None
+        self.maximum = None
+
+
+def _parse_int_stats(buf: bytes, st: _ColStats):
+    for fno, wt, v in _fields(buf):
+        if fno == 1:
+            st.minimum = _zigzag(v)
+        elif fno == 2:
+            st.maximum = _zigzag(v)
+
+
+def _parse_double_stats(buf: bytes, st: _ColStats):
+    for fno, wt, v in _fields(buf):
+        if fno == 1:
+            st.minimum = struct.unpack("<d", struct.pack("<q", v))[0]
+        elif fno == 2:
+            st.maximum = struct.unpack("<d", struct.pack("<q", v))[0]
+
+
+def _parse_string_stats(buf: bytes, st: _ColStats):
+    for fno, wt, v in _fields(buf):
+        if fno == 1:
+            st.minimum = v.decode("utf-8", "replace")
+        elif fno == 2:
+            st.maximum = v.decode("utf-8", "replace")
+
+
+def _parse_date_stats(buf: bytes, st: _ColStats):
+    import numpy as np
+    for fno, wt, v in _fields(buf):
+        if fno == 1:
+            st.minimum = np.datetime64(_zigzag(v), "D")
+        elif fno == 2:
+            st.maximum = np.datetime64(_zigzag(v), "D")
+
+
+def _parse_col_stats(buf: bytes) -> _ColStats:
+    st = _ColStats()
+    for fno, wt, v in _fields(buf):
+        if fno == 1:
+            st.num_values = v
+        elif fno == 2:
+            _parse_int_stats(v, st)
+        elif fno == 3:
+            _parse_double_stats(v, st)
+        elif fno == 4:
+            _parse_string_stats(v, st)
+        elif fno == 7:
+            _parse_date_stats(v, st)
+        elif fno == 10:
+            st.has_null = bool(v)
+    return st
+
+
+class OrcFileMeta:
+    """num_rows, stripe row counts, per-stripe column min/max."""
+
+    def __init__(self, field_names: List[str], num_rows: int,
+                 stripe_rows: List[int],
+                 stripe_stats: Optional[List[Dict[str, Tuple]]]):
+        self.field_names = field_names
+        self.num_rows = num_rows
+        self.stripe_rows = stripe_rows
+        #: per stripe: {column name: (min, max)} — None when the file
+        #: carries no usable metadata section
+        self.stripe_stats = stripe_stats
+
+
+def read_orc_meta(path: str) -> Optional[OrcFileMeta]:
+    try:
+        return _read_orc_meta(path)
+    except Exception:
+        return None                    # unreadable tail -> no pruning
+
+
+def _read_orc_meta(path: str) -> Optional[OrcFileMeta]:
+    size = os.path.getsize(path)
+    tail_len = min(size, 16 * 1024)
+    with open(path, "rb") as f:
+        f.seek(size - tail_len)
+        tail = f.read(tail_len)
+    ps_len = tail[-1]
+    ps = tail[-1 - ps_len:-1]
+    footer_len = metadata_len = 0
+    compression = 0
+    magic_ok = False
+    for fno, wt, v in _fields(ps):
+        if fno == 1:
+            footer_len = v
+        elif fno == 2:
+            compression = v
+        elif fno == 5:
+            metadata_len = v
+        elif fno == 8000:              # optional string magic = 8000
+            magic_ok = (v == b"ORC")
+    if not magic_ok:
+        return None
+    need = 1 + ps_len + footer_len + metadata_len
+    if need > tail_len:
+        with open(path, "rb") as f:
+            f.seek(size - need)
+            tail = f.read(need)
+        tail_len = need
+    footer_raw = tail[tail_len - 1 - ps_len - footer_len:
+                      tail_len - 1 - ps_len]
+    meta_raw = tail[tail_len - 1 - ps_len - footer_len - metadata_len:
+                    tail_len - 1 - ps_len - footer_len]
+    footer = _decompress(footer_raw, compression)
+
+    stripe_rows: List[int] = []
+    num_rows = 0
+    types: List[bytes] = []
+    for fno, wt, v in _fields(footer):
+        if fno == 3:                   # StripeInformation
+            rows = 0
+            for f2, _w, v2 in _fields(v):
+                if f2 == 5:
+                    rows = v2
+            stripe_rows.append(rows)
+        elif fno == 4:
+            types.append(v)
+        elif fno == 6:
+            num_rows = v
+    # flat schemas ONLY: root struct (type 0) lists child names and stats
+    # column k maps to field k-1. Nested fields occupy extra column ids
+    # and would shift the mapping — detected by the type count and
+    # answered with "no pruning" rather than a wrong mapping.
+    field_names: List[str] = []
+    if types:
+        for f2, _w, v2 in _fields(types[0]):
+            if f2 == 3:                # fieldNames
+                field_names.append(v2.decode("utf-8", "replace"))
+    if len(types) != len(field_names) + 1:
+        return OrcFileMeta(field_names, num_rows, stripe_rows, None)
+
+    stripe_stats = None
+    if metadata_len:
+        meta = _decompress(meta_raw, compression)
+        stripe_stats = []
+        for fno, wt, v in _fields(meta):
+            if fno != 1:               # StripeStatistics
+                continue
+            cols: List[_ColStats] = []
+            for f2, _w, v2 in _fields(v):
+                if f2 == 1:
+                    cols.append(_parse_col_stats(v2))
+            named: Dict[str, Tuple] = {}
+            for i, name in enumerate(field_names):
+                if i + 1 < len(cols):
+                    st = cols[i + 1]
+                    if st.minimum is not None and st.maximum is not None:
+                        named[name] = (st.minimum, st.maximum)
+            stripe_stats.append(named)
+        if len(stripe_stats) != len(stripe_rows):
+            stripe_stats = None        # inconsistent tail: no pruning
+    return OrcFileMeta(field_names, num_rows, stripe_rows, stripe_stats)

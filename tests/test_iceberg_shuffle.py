@@ -113,12 +113,14 @@ _MANIFEST_LIST_SCHEMA = {
         {"name": "manifest_length", "type": "long"},
         {"name": "partition_spec_id", "type": "int"},
         {"name": "content", "type": "int"},
+        {"name": "sequence_number", "type": ["null", "long"]},
     ]}
 
 _MANIFEST_SCHEMA = {
     "type": "record", "name": "manifest_entry", "fields": [
         {"name": "status", "type": "int"},
         {"name": "snapshot_id", "type": ["null", "long"]},
+        {"name": "sequence_number", "type": ["null", "long"]},
         {"name": "data_file", "type": {
             "type": "record", "name": "data_file", "fields": [
                 {"name": "content", "type": "int"},
@@ -128,33 +130,72 @@ _MANIFEST_SCHEMA = {
                 {"name": "file_size_in_bytes", "type": "long"},
                 {"name": "column_sizes", "type": ["null", {
                     "type": "map", "values": "long"}]},
+                {"name": "equality_ids", "type": ["null", {
+                    "type": "array", "items": "int"}]},
             ]}},
     ]}
 
 
-def _build_iceberg_table(root, tables, deleted_idx=()):
+def _build_iceberg_table(root, tables, deleted_idx=(), pos_deletes=None,
+                         eq_deletes=None, data_seq=1):
+    """pos_deletes: list of (seq, [(data_file_idx, row_pos), ...]);
+    eq_deletes: list of (seq, equality_ids, arrow key table)."""
     os.makedirs(os.path.join(root, "metadata"), exist_ok=True)
     os.makedirs(os.path.join(root, "data"), exist_ok=True)
     entries = []
+    data_paths = []
     for i, t in enumerate(tables):
         p = os.path.join(root, "data", f"f{i}.parquet")
         pq.write_table(t, p)
+        data_paths.append(p)
         entries.append({
             "status": 2 if i in deleted_idx else 1,
             "snapshot_id": 99,
+            "sequence_number": data_seq,
             "data_file": {
                 "content": 0, "file_path": p, "file_format": "PARQUET",
                 "record_count": t.num_rows,
                 "file_size_in_bytes": os.path.getsize(p),
-                "column_sizes": {"a": 100},
+                "column_sizes": {"a": 100}, "equality_ids": None,
             }})
     mpath = os.path.join(root, "metadata", "m0.avro")
     _write_avro(mpath, _MANIFEST_SCHEMA, entries)
+    manifests = [{"manifest_path": mpath,
+                  "manifest_length": os.path.getsize(mpath),
+                  "partition_spec_id": 0, "content": 0,
+                  "sequence_number": data_seq}]
+    dentries = []
+    for di, (seq, rows) in enumerate(pos_deletes or []):
+        dp = os.path.join(root, "data", f"pd{di}.parquet")
+        pq.write_table(pa.table({
+            "file_path": pa.array([data_paths[i] for i, _ in rows]),
+            "pos": pa.array([p for _, p in rows], pa.int64())}), dp)
+        dentries.append({
+            "status": 1, "snapshot_id": 99, "sequence_number": seq,
+            "data_file": {
+                "content": 1, "file_path": dp, "file_format": "PARQUET",
+                "record_count": len(rows),
+                "file_size_in_bytes": os.path.getsize(dp),
+                "column_sizes": None, "equality_ids": None}})
+    for di, (seq, ids, kt) in enumerate(eq_deletes or []):
+        dp = os.path.join(root, "data", f"ed{di}.parquet")
+        pq.write_table(kt, dp)
+        dentries.append({
+            "status": 1, "snapshot_id": 99, "sequence_number": seq,
+            "data_file": {
+                "content": 2, "file_path": dp, "file_format": "PARQUET",
+                "record_count": kt.num_rows,
+                "file_size_in_bytes": os.path.getsize(dp),
+                "column_sizes": None, "equality_ids": list(ids)}})
+    if dentries:
+        dmpath = os.path.join(root, "metadata", "dm0.avro")
+        _write_avro(dmpath, _MANIFEST_SCHEMA, dentries)
+        manifests.append({"manifest_path": dmpath,
+                          "manifest_length": os.path.getsize(dmpath),
+                          "partition_spec_id": 0, "content": 1,
+                          "sequence_number": None})
     mlist = os.path.join(root, "metadata", "snap-99.avro")
-    _write_avro(mlist, _MANIFEST_LIST_SCHEMA, [{
-        "manifest_path": mpath,
-        "manifest_length": os.path.getsize(mpath),
-        "partition_spec_id": 0, "content": 0}])
+    _write_avro(mlist, _MANIFEST_LIST_SCHEMA, manifests)
     md = {
         "format-version": 2,
         "table-uuid": "0000",
@@ -222,6 +263,58 @@ def test_iceberg_nested_schema_rejected(tmp_path):
 
 
 # -- heartbeat registry ------------------------------------------------------
+
+def test_iceberg_positional_deletes(tmp_path):
+    """v2 positional delete files drop (file_path, pos) rows during scan
+    (ref iceberg/data delete filter)."""
+    t0 = pa.table({"a": pa.array(range(10), pa.int64()),
+                   "b": pa.array([float(i) for i in range(10)])})
+    t1 = pa.table({"a": pa.array(range(100, 110), pa.int64()),
+                   "b": pa.array([float(i) for i in range(10)])})
+    _build_iceberg_table(str(tmp_path), [t0, t1],
+                         pos_deletes=[(2, [(0, 0), (0, 3), (1, 9)])],
+                         data_seq=1)
+    s = tpu_session()
+    got = sorted(r["a"] for r in s.read_iceberg(str(tmp_path)).collect())
+    want = sorted(set(range(10)) - {0, 3} | set(range(100, 109)))
+    assert got == want
+
+
+def test_iceberg_equality_deletes_with_sequencing(tmp_path):
+    """Equality deletes apply only to STRICTLY older data files."""
+    t0 = pa.table({"a": pa.array([1, 2, 3, 2], pa.int64()),
+                   "b": pa.array([0.1, 0.2, 0.3, 0.4])})
+    _build_iceberg_table(
+        str(tmp_path), [t0],
+        eq_deletes=[(5, [1], pa.table({"a": pa.array([2], pa.int64())}))],
+        data_seq=1)
+    s = tpu_session()
+    assert sorted(r["a"] for r in
+                  s.read_iceberg(str(tmp_path)).collect()) == [1, 3]
+    # same-sequence delete does NOT apply (written by the same commit's
+    # data files cannot be affected)
+    import shutil
+    shutil.rmtree(str(tmp_path / "metadata"))
+    shutil.rmtree(str(tmp_path / "data"))
+    _build_iceberg_table(
+        str(tmp_path), [t0],
+        eq_deletes=[(1, [1], pa.table({"a": pa.array([2], pa.int64())}))],
+        data_seq=1)
+    s2 = tpu_session()
+    assert sorted(r["a"] for r in
+                  s2.read_iceberg(str(tmp_path)).collect()) == [1, 2, 2, 3]
+
+
+def test_iceberg_deletes_with_column_pruning(tmp_path):
+    t0 = pa.table({"a": pa.array(range(6), pa.int64()),
+                   "b": pa.array([float(i) for i in range(6)])})
+    _build_iceberg_table(str(tmp_path), [t0],
+                         pos_deletes=[(2, [(0, 5)])], data_seq=1)
+    s = tpu_session()
+    df = s.read_iceberg(str(tmp_path), columns=["b"])
+    assert df.columns == ["b"]
+    assert df.count() == 5
+
 
 def test_shuffle_heartbeat_peer_discovery():
     from spark_rapids_tpu.shuffle.heartbeat import (
