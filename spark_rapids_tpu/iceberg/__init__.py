@@ -139,7 +139,15 @@ class IcebergTable:
         return data, deletes
 
     def data_files(self, snapshot_id: Optional[int] = None) -> List[dict]:
-        return [df for _, df in self.plan_scan(snapshot_id)[0]]
+        """Data-file entries WITHOUT delete awareness — raises when the
+        snapshot carries row-level deletes so a caller can never read
+        deleted rows silently (use plan_scan / to_df for those)."""
+        data, deletes = self.plan_scan(snapshot_id)
+        if deletes:
+            raise ValueError(
+                "snapshot has row-level delete files; use to_df() (which "
+                "applies them) or plan_scan() for the raw entries")
+        return [df for _, df in data]
 
     def file_paths(self, snapshot_id: Optional[int] = None) -> List[str]:
         return [self._resolve(d["file_path"])
