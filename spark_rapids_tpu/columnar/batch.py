@@ -37,6 +37,27 @@ DICT_ENCODE_MAX_FRACTION = 0.5
 DICT_ENCODE_MAX_CARD = 1 << 20
 
 
+def _decimal_unscaled_int64(arr, valid: np.ndarray) -> np.ndarray:
+    """decimal128 arrow array -> unscaled int64 values (invalid rows 0).
+
+    The decimal128 buffer stores the unscaled int128 little-endian; a
+    value fits the device's int64 lane iff the high word is the sign
+    extension of the low word. Out-of-range values raise — silently
+    truncating money would be the worst failure mode (ref DecimalUtils'
+    checked casts)."""
+    buf = arr.buffers()[1]
+    words = np.frombuffer(buf, dtype=np.int64)
+    off = arr.offset
+    lo = words[2 * off::2][:len(arr)]
+    hi = words[2 * off + 1::2][:len(arr)]
+    ok = (hi == np.where(lo < 0, -1, 0))
+    if not ok[valid].all():
+        raise ValueError(
+            "decimal value exceeds the device's 64-bit unscaled range "
+            "(|unscaled| >= 2^63); this magnitude needs host execution")
+    return np.where(valid, lo, 0)
+
+
 def _try_dict_encode(col, n: int, p: int):
     """pa string array -> (codes, valid, sorted dictionary) or None."""
     import pyarrow as pa
@@ -169,14 +190,19 @@ class ColumnarBatch:
                     arr = arr.cast(pa.int32())
                 elif pa.types.is_timestamp(arr.type):
                     arr = arr.cast(pa.int64())
-                elif pa.types.is_decimal(arr.type):
-                    # unscaled int64 view for precision<=18
-                    arr = pc.multiply_checked(
-                        arr.cast(pa.decimal128(38, arr.type.scale)),
-                        10 ** arr.type.scale).cast(pa.int64())
                 mask = np.asarray(col.is_null())
-                fill = False if pa.types.is_boolean(arr.type) else 0
-                vals = arr.fill_null(fill).to_numpy(zero_copy_only=False)
+                if pa.types.is_decimal(arr.type):
+                    # unscaled int64 straight from the decimal128
+                    # buffer; values beyond int64 fail LOUDLY (the
+                    # device lane is 64-bit — types.DecimalType).
+                    # Narrower decimal32/64 arrays widen first.
+                    if arr.type.bit_width != 128:
+                        arr = arr.cast(pa.decimal128(38, arr.type.scale))
+                    vals = _decimal_unscaled_int64(arr, ~mask)
+                else:
+                    fill = False if pa.types.is_boolean(arr.type) else 0
+                    vals = arr.fill_null(fill).to_numpy(
+                        zero_copy_only=False)
                 d, v = DeviceColumn.host_prepare(vals, dt, mask=~mask,
                                                  padded_len=p)
                 # canonical arrow type NOW so mirror-served batches have

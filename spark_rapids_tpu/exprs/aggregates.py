@@ -24,7 +24,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..types import (BOOL, DataType, FLOAT64, INT64, Schema, numeric)
+from ..types import (BOOL, DataType, DecimalType, FLOAT64, INT64, Schema,
+                     numeric)
 from .base import DVal, Expression, Literal
 from ..columnar.segmented import SortedSegments, seg_max, seg_min, seg_sum
 
@@ -161,6 +162,22 @@ class AggregateExpression:
         return f"{type(self).__name__}({d}{c})"
 
 
+#: decimal SUM limb base: 3 limbs of 10^12 cover 36+ digits of running
+#: total, and a <=2^20-row segment of limb values stays inside int64
+_DEC_LIMB = 10 ** 12
+_DEC_LIMB2 = _DEC_LIMB * _DEC_LIMB
+
+
+def _dec_normalize(l0, l1, l2):
+    """Carry-propagate limb sums back into canonical form
+    (l0, l1 in [0, base); sign carried by l2)."""
+    l1 = l1 + l0 // _DEC_LIMB
+    l0 = l0 % _DEC_LIMB
+    l2 = l2 + l1 // _DEC_LIMB
+    l1 = l1 % _DEC_LIMB
+    return l0, l1, l2
+
+
 class Sum(AggregateExpression):
     pandas_agg = "sum"
 
@@ -168,13 +185,35 @@ class Sum(AggregateExpression):
         dt = self.child.data_type(schema)
         if dt.name in ("tinyint", "smallint", "int", "bigint"):
             return INT64
+        if isinstance(dt, DecimalType):
+            # Spark: sum(decimal(p,s)) -> decimal(min(p+10, 38), s)
+            return DecimalType(min(dt.precision + 10, 38), dt.scale)
         return FLOAT64 if dt.name in ("float", "double") else dt
 
+    def _is_decimal(self, schema) -> bool:
+        return isinstance(self.child.data_type(schema), DecimalType)
+
     def partial_types(self, schema):
+        if self._is_decimal(schema):
+            return [INT64, INT64, INT64]
         return [self.data_type(schema)]
 
     def update(self, vals, gid, num_segments, row_mask):
         v = vals[0]
+        if isinstance(v.dtype, DecimalType):
+            # exact 128-bit-wide accumulation in 10^12-base limbs: every
+            # per-segment limb sum fits int64 (ref DecimalUtils JNI
+            # 128-bit sums; TPU has no int128, limbs are the XLA shape)
+            x = v.data.astype(jnp.int64)
+            xd = x // _DEC_LIMB
+            l0, c = _seg_sum(x % _DEC_LIMB, v.validity, gid, num_segments)
+            l1, _ = _seg_sum(xd % _DEC_LIMB, v.validity,
+                             gid, num_segments)
+            l2, _ = _seg_sum(xd // _DEC_LIMB, v.validity, gid,
+                             num_segments)
+            l0, l1, l2 = _dec_normalize(l0, l1, l2)
+            ok = c > 0
+            return [(l0, ok), (l1, ok), (l2, ok)]
         # promote to the accumulator type before summing
         acc_dt = jnp.int64 if jnp.issubdtype(v.data.dtype, jnp.integer) \
             else jnp.float64
@@ -182,11 +221,37 @@ class Sum(AggregateExpression):
         return [(s, cnt > 0)]
 
     def merge(self, partials, gid, num_segments):
+        if len(partials) == 3:         # decimal limbs
+            sums = []
+            ok = None
+            for p in partials:
+                s, cnt = _seg_sum(p.data, p.validity, gid, num_segments)
+                sums.append(s)
+                ok = cnt > 0 if ok is None else ok
+            l0, l1, l2 = _dec_normalize(*sums)
+            return [(l0, ok), (l1, ok), (l2, ok)]
         p = partials[0]
         s, cnt = _seg_sum(p.data, p.validity, gid, num_segments)
         return [(s, cnt > 0)]
 
     def finalize(self, partials):
+        if len(partials) == 3:
+            l0, l1, l2 = (p.data for p in partials)
+            ok = partials[0].validity
+            # representable on device iff the exact total fits int64;
+            # beyond that Spark's (non-ANSI) overflow answer is NULL —
+            # the f64 magnitude test is exact to ~1e3 at the boundary,
+            # erring to NULL inside the last few thousand ulps
+            est = (l2.astype(jnp.float64) * float(_DEC_LIMB2)
+                   + l1.astype(jnp.float64) * float(_DEC_LIMB)
+                   + l0.astype(jnp.float64))
+            fits = jnp.abs(est) < 9.223372e18
+            # nested form keeps every constant and (when fits) every
+            # intermediate inside int64: value = (l2*M + l1)*M + l0;
+            # non-fitting lanes wrap silently and are masked NULL
+            total = (l2 * _DEC_LIMB + l1) * _DEC_LIMB + l0
+            return DVal(jnp.where(fits, total, 0),
+                        jnp.logical_and(ok, fits), INT64)
         return partials[0]
 
 

@@ -67,7 +67,12 @@ def host_binary_numpy(expr, batch, fn, out_dtype: DataType,
 
 
 class BinaryArithmetic(Expression):
-    device_type_sig: TypeSig = numeric
+    # decimal ARITHMETIC stays capped at precision 18 on device: the
+    # int64 lanes would silently wrap beyond that (only SUM has
+    # limb-exact wide accumulation, exprs/aggregates.py). Storage /
+    # grouping / min-max of wider decimals remain device-backed.
+    device_type_sig: TypeSig = TypeSig(numeric.types,
+                                       max_decimal_precision=18)
     symbol = "?"
 
     def __init__(self, left: Expression, right: Expression):
@@ -222,7 +227,7 @@ class Pmod(BinaryArithmetic):
 
 
 class UnaryMinus(Expression):
-    device_type_sig = numeric
+    device_type_sig = TypeSig(numeric.types, max_decimal_precision=18)
 
     def __init__(self, child: Expression):
         self.children = [child]
@@ -243,7 +248,7 @@ class UnaryMinus(Expression):
 
 
 class Abs(Expression):
-    device_type_sig = numeric
+    device_type_sig = TypeSig(numeric.types, max_decimal_precision=18)
 
     def __init__(self, child: Expression):
         self.children = [child]

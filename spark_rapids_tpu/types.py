@@ -93,12 +93,18 @@ NULLTYPE = _Simple("void", None)
 
 
 class DecimalType(DataType):
-    """Decimal with precision<=18 held as scaled int64 on device.
+    """Decimal held as the SCALED UNSCALED-int64 value on device, for
+    every declared precision up to 38.
 
-    The reference supports decimal128 via cudf (DecimalUtils JNI,
-    SURVEY.md 2.12); we start with decimal64 device-backed and tag
-    precision>18 as host-only.
-    """
+    The reference's decimal128 path is cudf's 128-bit columns
+    (DecimalUtils JNI, SURVEY.md 2.12). The TPU has no native int128, so
+    the engine stores the unscaled value in int64 lanes — exact for
+    magnitudes up to ~9.2e18 unscaled (19 significant digits; every TPC
+    money column fits) and a LOUD ingest error beyond that
+    (ColumnarBatch.from_arrow's checked cast). Aggregation does NOT rely
+    on int64 intermediates: SUM accumulates in three 10^12-base limbs
+    (exprs/aggregates.py Sum), so 38-digit-wide running totals stay
+    exact and only the final value must be representable."""
 
     def __init__(self, precision: int = 10, scale: int = 0):
         if precision < 1 or precision > 38:
@@ -106,7 +112,7 @@ class DecimalType(DataType):
         self.precision = precision
         self.scale = scale
         self.name = f"decimal({precision},{scale})"
-        self.np_dtype = np.dtype(np.int64) if precision <= 18 else None
+        self.np_dtype = np.dtype(np.int64)
 
     def __eq__(self, other):
         return (isinstance(other, DecimalType) and other.precision == self.precision
@@ -231,7 +237,7 @@ class TypeSig:
 
     def __init__(self, initial: Union[Iterable[str], FrozenSet[str]] = (),
                  nested: Union[Iterable[str], FrozenSet[str]] = (),
-                 notes: Optional[dict] = None, max_decimal_precision: int = 18):
+                 notes: Optional[dict] = None, max_decimal_precision: int = 38):
         self.types: FrozenSet[str] = frozenset(initial)
         self.nested_types: FrozenSet[str] = frozenset(nested)
         self.notes = dict(notes or {})
