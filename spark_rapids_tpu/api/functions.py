@@ -69,6 +69,13 @@ class Col:
     # misc
     def isNull(self): return Col(E.IsNull(self.expr))
     def isNotNull(self): return Col(E.IsNotNull(self.expr))
+
+    # -- string predicates (PySpark Column parity) --
+    def contains(self, s): return Col(E.Contains(self.expr, s))
+    def startswith(self, s): return Col(E.StartsWith(self.expr, s))
+    def endswith(self, s): return Col(E.EndsWith(self.expr, s))
+    def like(self, pattern): return Col(E.Like(self.expr, pattern))
+    def rlike(self, pattern): return Col(E.RLike(self.expr, pattern))
     def isin(self, *vals):
         vals = vals[0] if len(vals) == 1 and isinstance(vals[0], (list, tuple)) \
             else vals

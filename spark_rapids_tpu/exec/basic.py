@@ -267,21 +267,53 @@ class TpuFilterExec(TpuExec):
     def __init__(self, condition: Expression, child: TpuExec):
         super().__init__([child])
         self.condition = condition
+        self._dict_eval = None
+        self._dict_checked = False
 
     def output_schema(self) -> Schema:
         return self.children[0].output_schema()
 
+    def _dict_evaluator(self, schema):
+        if not self._dict_checked:
+            self._dict_checked = True
+            if self.condition.fully_device_supported(schema) is not None:
+                from ..exprs.compiler import build_dict_filter
+                self._dict_eval = build_dict_filter(self.condition,
+                                                    schema)
+        return self._dict_eval
+
     def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        from ..exprs.compiler import (DictFilterFallback,
+                                      filter_batch_by_mask)
         rows_m = ctx.metric(self._exec_id, "numOutputRows", ESSENTIAL)
+        schema = self.children[0].output_schema()
         for batch in self.children[0].execute(ctx):
             batch = batch.ensure_device()
+            dict_eval = self._dict_evaluator(schema)
             with ctx.semaphore.held():
-                if batch.all_device:
+                if dict_eval is not None:
+                    out = self._filter_dict(ctx, dict_eval, batch)
+                elif batch.all_device:
                     out = filter_batch_device(self.condition, batch)
                 else:
                     out = self._filter_mixed(batch)
             rows_m.add(out.num_rows_raw)
             yield out
+
+    def _filter_dict(self, ctx, dict_eval, batch):
+        """String predicates evaluated once over the dictionary,
+        broadcast through codes on device; per-batch host fallback when a
+        string column is not dict-coded (high-cardinality bail-out)."""
+        import pyarrow.compute as pc
+        from ..exprs.compiler import (DictFilterFallback,
+                                      filter_batch_by_mask)
+        try:
+            keep = dict_eval.keep_mask(batch)
+            return filter_batch_by_mask(batch, keep)
+        except DictFilterFallback:
+            mask = pc.fill_null(self.condition.eval_host(batch), False)
+            return ColumnarBatch.from_arrow(
+                batch.to_arrow().filter(mask))
 
     def _filter_mixed(self, batch: ColumnarBatch) -> ColumnarBatch:
         """Device columns compact on device; host columns filter via Arrow

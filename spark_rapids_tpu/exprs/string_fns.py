@@ -127,6 +127,18 @@ class ConcatStrings(_HostStringExpr):
 
 
 class _PatternPredicate(_HostStringExpr):
+    """String->bool predicate. ``host_mask`` is the single definition of
+    the match, shared by row-wise host evaluation AND the dictionary
+    path: over dict-coded device columns the predicate evaluates ONCE per
+    distinct value and broadcasts through the codes on device
+    (exprs/compiler.py DictFilterEvaluator; ref stringFunctions.scala
+    device kernels — this is the O(dict) TPU equivalent)."""
+
+    #: "range": on the SORTED dictionary the matching codes are one
+    #: contiguous span -> gather-free (codes >= lo) & (codes < hi);
+    #: "mask": arbitrary match set -> one small-table lookup
+    dict_form = "mask"
+
     def __init__(self, child, pattern: str):
         self.children = [child]
         self.pattern = pattern
@@ -134,29 +146,35 @@ class _PatternPredicate(_HostStringExpr):
     def data_type(self, schema):
         return BOOL
 
+    def host_mask(self, arr):
+        raise NotImplementedError
+
+    def eval_host(self, batch):
+        return self.host_mask(self.children[0].eval_host(batch))
+
     def key(self):
         return (f"{type(self).__name__}({self.children[0].key()},"
                 f"{self.pattern!r})")
 
 
 class Contains(_PatternPredicate):
-    def eval_host(self, batch):
+    def host_mask(self, arr):
         import pyarrow.compute as pc
-        return pc.match_substring(self.children[0].eval_host(batch),
-                                  self.pattern)
+        return pc.match_substring(arr, self.pattern)
 
 
 class StartsWith(_PatternPredicate):
-    def eval_host(self, batch):
+    dict_form = "range"     # prefix match == code range on a sorted dict
+
+    def host_mask(self, arr):
         import pyarrow.compute as pc
-        return pc.starts_with(self.children[0].eval_host(batch),
-                              self.pattern)
+        return pc.starts_with(arr, self.pattern)
 
 
 class EndsWith(_PatternPredicate):
-    def eval_host(self, batch):
+    def host_mask(self, arr):
         import pyarrow.compute as pc
-        return pc.ends_with(self.children[0].eval_host(batch), self.pattern)
+        return pc.ends_with(arr, self.pattern)
 
 
 class Like(_PatternPredicate):
@@ -167,10 +185,9 @@ class Like(_PatternPredicate):
         from .regex_transpiler import sql_like_to_regex
         self._regex = sql_like_to_regex(pattern, escape)
 
-    def eval_host(self, batch):
+    def host_mask(self, arr):
         import pyarrow.compute as pc
-        return pc.match_substring_regex(self.children[0].eval_host(batch),
-                                        self._regex)
+        return pc.match_substring_regex(arr, self._regex)
 
 
 class RLike(_PatternPredicate):
@@ -182,10 +199,9 @@ class RLike(_PatternPredicate):
         from .regex_transpiler import transpile_java_regex
         self._regex = transpile_java_regex(pattern)  # raises if unsupported
 
-    def eval_host(self, batch):
+    def host_mask(self, arr):
         import pyarrow.compute as pc
-        return pc.match_substring_regex(self.children[0].eval_host(batch),
-                                        self._regex)
+        return pc.match_substring_regex(arr, self._regex)
 
 
 class RegExpReplace(_HostStringExpr):

@@ -153,6 +153,14 @@ class FilterMeta(PlanMeta):
         schema = self.plan.children[0].schema()
         r = self.plan.condition.fully_device_supported(schema)
         if r:
+            # string predicates over dict-coded columns still run on the
+            # device via dictionary evaluation (compiler.py
+            # DictFilterEvaluator; ref stringFunctions.scala families)
+            from ..exprs.compiler import build_dict_filter
+            if build_dict_filter(self.plan.condition, schema) is not None:
+                self.note_expr_fallback(
+                    "string predicate evaluated over the dictionary")
+                return
             self.will_not_work_on_tpu(f"filter condition: {r}")
 
     def convert_to_tpu(self, children):

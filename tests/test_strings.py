@@ -6,7 +6,7 @@ Python-computed expected values rather than differentially.
 import pandas as pd
 import pytest
 
-from harness import tpu_session
+from harness import assert_tpu_and_cpu_equal, tpu_session
 from spark_rapids_tpu.api import functions as F
 from spark_rapids_tpu.exprs import RegexUnsupported, transpile_java_regex
 
@@ -96,16 +96,23 @@ def test_substring_index_and_locate():
 
 
 def test_filter_on_string_predicate_mixed_plan():
-    """String predicate forces a CPU filter; downstream arithmetic still
-    runs on device (per-exec fallback like the reference)."""
+    """Plain-column string predicates now stay on the device filter
+    (dictionary evaluation); predicates over COMPUTED strings still fall
+    back to the CPU filter (per-exec fallback like the reference)."""
     s = tpu_session()
     df = s.create_dataframe(pd.DataFrame(
         {"s": ["aa", "ab", "ba", None], "v": [1, 2, 3, 4]}))
     out = (df.filter(F.startswith(F.col("s"), "a"))
            .select((F.col("v") * 10).alias("v10")))
     tree = out._physical().tree_string()
-    assert "CpuFilter" in tree and "* Project" in tree
+    assert "CpuFilter" not in tree and "* Project" in tree
     assert sorted(out.to_pandas()["v10"]) == [10, 20]
+
+    out2 = (df.filter(F.startswith(F.upper(F.col("s")), "A"))
+            .select((F.col("v") * 10).alias("v10")))
+    tree2 = out2._physical().tree_string()
+    assert "CpuFilter" in tree2, tree2
+    assert sorted(out2.to_pandas()["v10"]) == [10, 20]
 
 
 class TestRegexTranspiler:
@@ -135,3 +142,77 @@ class TestRegexTranspiler:
             transpile_java_regex("(a")
         with pytest.raises(RegexUnsupported):
             transpile_java_regex("a)")
+
+
+# ---------------------------------------------------------------------------
+# dictionary-evaluated string predicates (VERDICT r1 #5): predicates run
+# once over the sorted dictionary, broadcast through codes on device
+# ---------------------------------------------------------------------------
+
+def _str_table(n=2000, card=30, seed=3):
+    import numpy as np
+    import pyarrow as pa
+    rng = np.random.RandomState(seed)
+    words = [f"{p}_{i:03d}" for i, p in zip(
+        range(card), ["apple", "apricot", "banana", "cherry", "date"] * card)]
+    vals = [None if rng.rand() < 0.05 else words[rng.randint(card)]
+            for _ in range(n)]
+    return pa.table({"s": pa.array(vals),
+                     "v": pa.array(rng.randint(0, 100, n).astype("int64"))})
+
+
+def test_dict_filter_contains_differential():
+    t = _str_table()
+
+    def q(s):
+        return (s.create_dataframe(t)
+                .filter(F.col("s").contains("pri") & (F.col("v") > F.lit(10)))
+                .agg(F.count_star().with_name("c"),
+                     F.sum(F.col("v")).with_name("sv")))
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_dict_filter_startswith_range_form():
+    t = _str_table()
+
+    def q(s):
+        return (s.create_dataframe(t)
+                .filter(F.col("s").startswith("ap"))
+                .agg(F.count_star().with_name("c")))
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_dict_filter_like_and_or():
+    t = _str_table()
+
+    def q(s):
+        return (s.create_dataframe(t)
+                .filter(F.col("s").like("%an%a%")
+                        | (F.col("s").startswith("date")
+                           & (F.col("v") < F.lit(50))))
+                .agg(F.count_star().with_name("c"),
+                     F.min(F.col("v")).with_name("mn")))
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_dict_filter_stays_on_device_plan():
+    t = _str_table()
+    s = tpu_session()
+    df = (s.create_dataframe(t)
+          .filter(F.col("s").contains("err"))
+          .agg(F.count_star().with_name("c")))
+    tree = df._physical().tree_string()
+    assert "CpuFilter" not in tree, tree
+    assert "Filter" in tree
+
+
+def test_dict_filter_string_output_columns_survive():
+    """Filtered batches keep the string column intact (codes compacted on
+    device, decode at the sink)."""
+    t = _str_table(n=500)
+
+    def q(s):
+        return (s.create_dataframe(t)
+                .filter(F.col("s").endswith("_001")))
+    got = assert_tpu_and_cpu_equal(q)
+    assert all(x.endswith("_001") for x in got["s"])
