@@ -35,10 +35,15 @@ _WORTH_RATIO = 0.6
 #: and the raw batch at least this big (small batches: dispatch dominates)
 MIN_RAW_BYTES = 4 << 20
 
-#: inverse scales: value ~= integer / inv (division is correctly rounded,
-#: matching how 2-/4-decimal data is produced; multiplying by 0.01 is NOT
-#: bit-identical to dividing by 100)
-_F64_INV_SCALES = (1.0, 100.0, 10000.0)
+#: f64 columns NEVER narrow: the TPU backend's emulated f64 is not
+#: bit-exact for division (5/100.0 < 0.05) NOR for int->f64 conversion
+#: (measured wrong bits even in int32 range), so any value-recomputing
+#: decode would shift comparison results at band edges. The host-side
+#: exactness proof only covers ops the device computes identically —
+#: for floats that is the raw bit-copy alone. Integer ops (add, astype
+#: between int widths) are exact on device (verified), so ints, dates,
+#: bools, dict codes, and validity still narrow.
+_F64_INV_SCALES = ()
 
 
 def _narrow_int(rng: int):

@@ -62,13 +62,16 @@ def test_tpc_shaped_columns_encode_and_roundtrip():
              for c in b.columns]
     flat, specs, params, ratio, rb = transfer.encode_columns(pairs)
     kinds = [s[0][0] for s in specs]
-    assert kinds[0] == "f64_scaled"     # 2-decimal price
-    assert kinds[1] == "f64_scaled"     # integral qty
+    # floats NEVER narrow: the TPU backend's emulated f64 cannot
+    # reproduce division or int->f64 conversion bits, so any
+    # value-recomputing float decode would corrupt band-edge comparisons
+    assert kinds[0] == "raw"            # 2-decimal price stays raw
+    assert kinds[1] == "raw"            # even integral doubles stay raw
     assert kinds[3] == "raw"            # full-entropy floats stay raw
     assert kinds[4] == "int_off"        # dates narrow to uint16
     assert kinds[5] == "bool_bits"
     assert kinds[7] == "raw"            # 63-bit ints cannot narrow
-    assert ratio < 0.6
+    assert ratio < 0.8
 
 
 def test_all_null_and_empty_columns():
