@@ -367,9 +367,11 @@ class DataFrame:
     def cache(self) -> "DataFrame":
         """Materialize once into in-memory parquet-encoded batches
         (ref ParquetCachedBatchSerializer)."""
-        from ..exec.cached import CachedRelation, encode_batches
+        from ..exec.cached import CACHE_CODEC, CachedRelation, \
+            encode_batches
+        codec = str(self.session.conf.get(CACHE_CODEC))
         blobs = self._execute_wrapped(
-            lambda p, ctx: encode_batches(p.execute(ctx)))
+            lambda p, ctx: encode_batches(p.execute(ctx), codec))
         return DataFrame(self.session,
                          CachedRelation(blobs, self.schema))
 

@@ -106,6 +106,14 @@ BATCH_SIZE_ROWS = register(
     "Target max rows per columnar batch (shape-bucket ceiling; TPU-specific: "
     "bounds XLA recompilation via the bucket ladder).")
 
+AUTO_BROADCAST_THRESHOLD = register(
+    "spark.rapids.tpu.sql.autoBroadcastJoinThreshold", 10 * 1024 * 1024,
+    "Equi-joins broadcast a side whose plan-time size estimate is at or "
+    "below this many bytes (build once, probe per shard — ref Spark's "
+    "autoBroadcastJoinThreshold + the reference's AQE join-strategy "
+    "switching, GpuOverrides.scala:4681). <=0 disables auto selection.",
+    commonly_used=True)
+
 JOIN_BLOOM_FILTER = register(
     "spark.rapids.tpu.sql.join.bloomFilter.enabled", False,
     "Build a device bloom filter from the build side's join keys and "

@@ -2,13 +2,21 @@
 `spark.sql.cache` columnar serializer storing batches PARQUET-ENCODED in
 memory: far smaller than raw buffers, decode on demand).
 
-Same design here: caching a DataFrame materializes its batches once,
-parquet-encodes each into an in-memory buffer (host RAM, compressed
-encodings), and replaces the plan with a scan that decodes per batch."""
+Same design here, with the reference's main serializer capabilities:
+  * codec-aware encoding (``spark.rapids.tpu.sql.cache.codec``:
+    zstd / lz4 / snappy / gzip / none) — per-column compressed pages;
+  * column pruning at decode time (the cache holds every column, a
+    pruned read decodes only what the query needs);
+  * predicate skipping over cached batches using the parquet row-group
+    statistics already embedded in each blob (the cached analog of
+    GpuParquetScan.filterBlocks);
+  * byte accounting surfaced in explain().
+"""
 from __future__ import annotations
 
-from typing import Iterator, List
+from typing import Iterator, List, Optional
 
+from ..config import register
 from ..columnar import ColumnarBatch
 from ..plan.meta import PlanMeta
 from ..plan.overrides import rule
@@ -17,69 +25,129 @@ from .base import ESSENTIAL, ExecContext, TpuExec
 
 __all__ = ["CachedRelation", "ParquetCachedScanExec", "encode_batches"]
 
+CACHE_CODEC = register(
+    "spark.rapids.tpu.sql.cache.codec", "zstd",
+    "Compression codec for df.cache()'s parquet-encoded batches "
+    "(zstd / lz4 / snappy / gzip / none; ref "
+    "ParquetCachedBatchSerializer's compressed columnar cache format).")
 
-def encode_batches(batches) -> List[bytes]:
+
+def encode_batches(batches, codec: str = "zstd") -> List[bytes]:
     import io
 
     import pyarrow.parquet as pq
+    codec = (codec or "zstd").lower()
+    if codec == "none":
+        codec = "NONE"
     blobs = []
     for b in batches:
         buf = io.BytesIO()
-        pq.write_table(b.to_arrow(), buf)
+        pq.write_table(b.to_arrow(), buf, compression=codec)
         blobs.append(buf.getvalue())
     return blobs
 
 
 class CachedRelation:
-    """Logical node over parquet-encoded cached batches."""
+    """Logical node over parquet-encoded cached batches. ``columns``
+    (set by the pruning pass) narrows DECODE, not storage, so one cache
+    serves any projection of the cached frame."""
 
-    def __init__(self, blobs: List[bytes], schema: Schema):
+    def __init__(self, blobs: List[bytes], schema: Schema,
+                 columns: Optional[List[str]] = None):
         self.blobs = blobs
         self._schema = schema
+        self.columns = columns
         self.children = []
 
     def schema(self) -> Schema:
-        return self._schema
+        if self.columns is None:
+            return self._schema
+        return Schema([self._schema[c] for c in self.columns])
+
+    def estimated_size_bytes(self) -> int:
+        return sum(len(b) for b in self.blobs)
 
     def describe(self):
         total = sum(len(b) for b in self.blobs)
-        return f"InMemoryParquetCache[{len(self.blobs)} batches, {total}B]"
+        cols = "" if self.columns is None else f", cols={self.columns}"
+        return (f"InMemoryParquetCache[{len(self.blobs)} batches, "
+                f"{total}B{cols}]")
 
     def tree_string(self, indent: int = 0) -> str:
         return "  " * indent + self.describe() + "\n"
 
 
 class ParquetCachedScanExec(TpuExec):
-    def __init__(self, blobs: List[bytes], schema: Schema):
+    def __init__(self, blobs: List[bytes], schema: Schema,
+                 columns: Optional[List[str]] = None, predicate=None):
         super().__init__([])
         self.blobs = blobs
-        self._schema = schema
+        self._schema = (schema if columns is None
+                        else Schema([schema[c] for c in columns]))
+        self.columns = columns
+        #: pushed-down predicate for batch skipping via the parquet
+        #: row-group statistics inside each cached blob
+        self.predicate = predicate
 
     def output_schema(self) -> Schema:
         return self._schema
+
+    def set_predicate(self, pred) -> None:
+        self.predicate = pred
+
+    def _skip_blob(self, pf) -> bool:
+        """True when the predicate provably excludes every row group of
+        this cached batch (shares parquet's interval matcher)."""
+        if self.predicate is None:
+            return False
+        from ..io.parquet import _maybe_matches
+        try:
+            for i in range(pf.metadata.num_row_groups):
+                rg = pf.metadata.row_group(i)
+                stats = {}
+                for j in range(rg.num_columns):
+                    c = rg.column(j)
+                    if c.statistics is not None \
+                            and c.statistics.has_min_max:
+                        stats[c.path_in_schema] = (c.statistics.min,
+                                                   c.statistics.max)
+                if _maybe_matches(self.predicate, stats):
+                    return False
+            return True
+        except Exception:
+            return False
 
     def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
         import pyarrow as pa
         import pyarrow.parquet as pq
         rows_m = ctx.metric(self._exec_id, "numOutputRows", ESSENTIAL)
-        if not self.blobs:
-            from .joins import _empty_batch
-            yield _empty_batch(self._schema)
-            return
+        skipped_m = ctx.metric(self._exec_id, "cachedBatchesSkipped")
+        emitted = False
         for blob in self.blobs:
-            t = pq.read_table(pa.BufferReader(blob))
+            pf = pq.ParquetFile(pa.BufferReader(blob))
+            if self._skip_blob(pf):
+                skipped_m.add(1)
+                continue
+            t = pf.read(columns=self.columns)
             with ctx.semaphore.held():
                 b = ColumnarBatch.from_arrow(t)
             rows_m.add(b.num_rows)
+            emitted = True
             yield b
+        if not emitted:
+            from .joins import _empty_batch
+            yield _empty_batch(self._schema)
 
     def describe(self):
-        return f"ParquetCachedScan[{len(self.blobs)} batches]"
+        pd = (f", pushdown={self.predicate.name_hint}"
+              if self.predicate is not None else "")
+        return f"ParquetCachedScan[{len(self.blobs)} batches{pd}]"
 
 
 @rule(CachedRelation)
 class _CachedMeta(PlanMeta):
     def convert_to_tpu(self, children):
-        return ParquetCachedScanExec(self.plan.blobs, self.plan.schema())
+        return ParquetCachedScanExec(self.plan.blobs, self.plan._schema,
+                                     self.plan.columns)
 
     convert_to_cpu = convert_to_tpu

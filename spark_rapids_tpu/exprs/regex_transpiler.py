@@ -11,8 +11,16 @@ GpuRegExpReplaceMeta's willNotWorkOnGpu tagging).
 Java -> Python divergences handled:
   * \\d \\w \\s (and negations) are ASCII in Java, Unicode in Python ->
     rewritten to explicit ASCII classes
-  * \\Z (end before final terminator) has no Python equivalent -> reject
-  * \\G, \\R, named char classes \\p{...}, \\b inside classes -> reject
+  * \\b / \\B are ASCII in Java -> scoped (?a:...) ASCII-flag groups
+  * \\Z (end before the FINAL line terminator) -> an explicit
+    lookahead over Java's terminator set; \\R (any linebreak) -> its
+    defined alternation
+  * POSIX/Java ASCII named classes \\p{Alpha}/\\p{Digit}/... -> explicit
+    ASCII classes; Unicode category classes (\\p{L}, \\p{Lu}, ...) ->
+    reject (engine semantics differ)
+  * nested character-class UNIONS [a[bc]] -> flattened [abc];
+    class intersection && -> reject
+  * \\G, \\X, \\b inside classes -> reject
   * octal escapes \\0nn -> \\nnn form
   * possessive quantifiers / atomic groups pass through (Python >= 3.11)
 """
@@ -29,6 +37,21 @@ _W = "[a-zA-Z0-9_]"
 _NW = "[^a-zA-Z0-9_]"
 _S = "[ \\t\\n\\x0b\\f\\r]"
 _NS = "[^ \\t\\n\\x0b\\f\\r]"
+
+#: Java \Z: end of input but for a final line terminator
+_END_Z = "(?=(?:\\r\\n|[\\n\\r\\x85\\u2028\\u2029])?\\Z)"
+#: Java \R: any unicode linebreak sequence
+_ANY_BREAK = "(?:\\r\\n|[\\n\\x0b\\f\\r\\x85\\u2028\\u2029])"
+
+#: POSIX/Java ASCII named classes (RegexParser.scala handles the same
+#: set); values are class BODIES (composable inside [...])
+_POSIX = {
+    "Lower": "a-z", "Upper": "A-Z", "ASCII": "\\x00-\\x7f",
+    "Alpha": "a-zA-Z", "Digit": "0-9", "Alnum": "a-zA-Z0-9",
+    "Punct": "!-/:-@\\[-`{-~", "Graph": "!-~", "Print": " -~",
+    "Blank": " \\t", "Cntrl": "\\x00-\\x1f\\x7f",
+    "XDigit": "0-9a-fA-F", "Space": " \\t\\n\\x0b\\f\\r",
+}
 
 
 class RegexUnsupported(ValueError):
@@ -107,10 +130,21 @@ class RegexParser:
             if in_class:
                 self.error("\\S inside character class")
             self.out.append(_NS)
-        elif c in ("Z", "G", "R", "X"):
+        elif c == "Z":
+            if in_class:
+                self.error("\\Z inside character class")
+            self.out.append(_END_Z)
+        elif c == "R":
+            if in_class:
+                self.error("\\R inside character class")
+            self.out.append(_ANY_BREAK)
+        elif c in ("G", "X"):
             self.error(f"\\{c} is not supported")
         elif c == "p" or c == "P":
-            self.error("\\p{...} named classes are not supported")
+            self._named_class(negated=(c == "P"), in_class=in_class)
+        elif c in ("b", "B") and not in_class:
+            # Java boundaries use its ASCII \w; scope the ASCII flag
+            self.out.append(f"(?a:\\{c})")
         elif c == "b" and in_class:
             self.error("\\b inside character class")
         elif c == "z":
@@ -127,10 +161,33 @@ class RegexParser:
             self.out.append("\\" + c)
 
     # ------------------------------------------------------------------
-    def _char_class(self):
-        self.out.append("[")
-        if self.peek() == "^":
-            self.out.append(self.take())
+    def _named_class(self, negated: bool, in_class: bool):
+        if self.take() != "{":
+            self.error("malformed \\p escape")
+        name = ""
+        while self.peek() and self.peek() != "}":
+            name += self.take()
+        if self.take() != "}":
+            self.error("unterminated \\p{...}")
+        body = _POSIX.get(name)
+        if body is None:
+            # Unicode category/property classes (\p{L}, \p{IsDigit},
+            # scripts, blocks): Java resolves them over Unicode, which
+            # the ASCII expansions cannot reproduce — honest rejection
+            self.error(f"\\p{{{name}}} is not supported")
+        if in_class:
+            if negated:
+                self.error("\\P{...} inside character class")
+            self.out.append(body)
+        else:
+            self.out.append(("[^" if negated else "[") + body + "]")
+
+    # ------------------------------------------------------------------
+    def _char_class(self, nested: bool = False):
+        if not nested:
+            self.out.append("[")
+            if self.peek() == "^":
+                self.out.append(self.take())
         if self.peek() == "]":
             self.out.append("\\]")
             self.take()
@@ -139,13 +196,18 @@ class RegexParser:
             if c == "":
                 self.error("unterminated character class")
             if c == "]":
-                self.out.append("]")
+                if not nested:
+                    self.out.append("]")
                 return
             if c == "\\":
                 self._escape(in_class=True)
             elif c == "[":
-                # Java supports nested classes / && intersection; reject
-                self.error("nested character class")
+                # Java nested class UNION [a[bc]]: flatten the inner
+                # class's members into the enclosing one. A negated
+                # nested class is set arithmetic — reject.
+                if self.peek() == "^":
+                    self.error("negated nested character class")
+                self._char_class(nested=True)
             elif c == "&" and self.peek() == "&":
                 self.error("character class intersection &&")
             else:

@@ -55,6 +55,9 @@ class LogicalScan(LogicalPlan):
             return self._schema
         return Schema([self._schema[c] for c in self.columns])
 
+    def estimated_size_bytes(self) -> int:
+        return sum(t.nbytes for t in self.tables)
+
     def describe(self):
         return f"LogicalScan[{len(self.tables)} partitions]({self.schema()})"
 

@@ -7,6 +7,7 @@ doConvertPlan (:4486), applyOverrides (:4813), and the per-node ExecRule map
 """
 from __future__ import annotations
 
+import copy
 import logging
 from typing import Callable, Dict, Type
 
@@ -174,15 +175,29 @@ class FilterMeta(PlanMeta):
     def _push_down_predicate(self, child_exec):
         """Predicate pushdown into file scans for row-group / delta-file
         skipping (ref GpuParquetScan filterBlocks:670 + delta data
-        skipping). The filter itself still runs — pruning is conservative,
-        so this is purely an IO reduction."""
+        skipping) and into cached scans for batch skipping via the
+        embedded parquet statistics (ref ParquetCachedBatchSerializer).
+        The filter itself still runs — pruning is conservative, so this
+        is purely an IO reduction."""
+        from ..exec.cached import ParquetCachedScanExec
         from ..io.file_scan import FileScanBase
-        if (isinstance(child_exec, FileScanBase)
-                and child_exec.predicate is None):
-            cond = self.plan.condition
-            names = set(child_exec.output_schema().names())
-            if set(cond.references()) <= names:
-                child_exec.set_predicate(cond)
+        cond = self.plan.condition
+        refs = set(cond.references())
+        node = child_exec
+        # look through projections that pass the referenced columns
+        # through unchanged (the exec's own passthrough map, restricted
+        # to un-renamed columns)
+        while isinstance(node, B.TpuProjectExec):
+            same_name = {n for i, n in node.passthrough.items()
+                         if node.exprs[i].name_hint == n}
+            if not refs <= same_name:
+                return
+            node = node.children[0]
+        if (isinstance(node, (FileScanBase, ParquetCachedScanExec))
+                and node.predicate is None):
+            names = set(node.output_schema().names())
+            if refs <= names:
+                node.set_predicate(cond)
 
 
 @rule(L.Aggregate)
@@ -381,6 +396,28 @@ class JoinMeta(PlanMeta):
                 self.will_not_work_on_tpu(
                     f"join condition <{self.plan.condition.name_hint}>: {r}")
 
+    def _auto_broadcast(self):
+        """Pick a broadcast side from plan-time size estimates when the
+        user gave no hint (ref Spark autoBroadcastJoinThreshold + the
+        reference's AQE join-strategy switching,
+        GpuOverrides.scala:4681)."""
+        from ..config import AUTO_BROADCAST_THRESHOLD
+        from .rewrites import estimated_size_bytes
+        p = self.plan
+        thr = int(self.conf.get(AUTO_BROADCAST_THRESHOLD))
+        if thr <= 0:
+            return None
+        r_ok = p.join_type in ("inner", "left", "leftsemi", "leftanti")
+        l_ok = p.join_type in ("inner", "right")
+        rs = estimated_size_bytes(p.children[1]) if r_ok else None
+        ls = estimated_size_bytes(p.children[0]) if l_ok else None
+        cand = []
+        if rs is not None and rs <= thr:
+            cand.append((rs, "right"))
+        if ls is not None and ls <= thr:
+            cand.append((ls, "left"))
+        return min(cand)[1] if cand else None
+
     def convert_to_tpu(self, children):
         from ..exec.joins import (TpuBroadcastHashJoinExec, TpuHashJoinExec,
                                   TpuNestedLoopJoinExec)
@@ -390,6 +427,9 @@ class JoinMeta(PlanMeta):
             # no equi keys: nested loop (ref GpuBroadcastNestedLoopJoinExec)
             return TpuNestedLoopJoinExec(children[0], children[1],
                                          p.join_type, p.condition)
+        if p.broadcast is None:
+            p = copy.copy(p)
+            p.broadcast = self._auto_broadcast()
         if p.broadcast == "right":
             return TpuBroadcastHashJoinExec(
                 children[0], BroadcastExchangeExec(children[1]), p.join_type,

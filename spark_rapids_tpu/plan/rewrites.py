@@ -123,6 +123,13 @@ def prune_columns(plan: L.LogicalPlan,
             for k in plan.keys:
                 _expr_refs(k, child_req)
         return rebuilt(plan, [prune_columns(plan.children[0], child_req)])
+    from ..exec.cached import CachedRelation
+    if isinstance(plan, CachedRelation):
+        names = plan.schema().names()
+        if required is None or set(names) <= required:
+            return plan
+        keep = [n for n in names if n in required] or names[:1]
+        return CachedRelation(plan.blobs, plan._schema, columns=keep)
     if isinstance(plan, L.Union):
         # children share column names positionally only when schemas align;
         # prune identically by name
@@ -149,6 +156,27 @@ def prune_columns(plan: L.LogicalPlan,
     # Window/Generate/Expand/WriteFile/unknown: conservative — children
     # keep everything
     return rebuilt(plan, [prune_columns(c, None) for c in plan.children])
+
+
+def estimated_size_bytes(plan: L.LogicalPlan) -> Optional[int]:
+    """Plan-time size estimate (ref Spark SizeInBytesOnlyStatsPlan /
+    the reference's AQE stage statistics): known for in-memory and file
+    scans, propagated through size-preserving unary nodes, None where
+    unknowable. Filters keep the child estimate (conservative — Spark's
+    default without column stats)."""
+    own = getattr(plan, "estimated_size_bytes", None)
+    if own is not None:                # LogicalScan, CachedRelation, ...
+        return own()
+    if isinstance(plan, L.ParquetScan):
+        import os
+        try:
+            return sum(os.path.getsize(p) for p in plan.paths)
+        except OSError:
+            return None
+    if isinstance(plan, (L.Filter, L.Sort, L.Repartition, L.Sample,
+                         L.LocalLimit, L.GlobalLimit, L.Project)):
+        return estimated_size_bytes(plan.children[0])
+    return None
 
 
 def rewrite_plan(plan: L.LogicalPlan) -> L.LogicalPlan:

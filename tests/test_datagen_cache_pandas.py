@@ -151,3 +151,38 @@ def test_pandas_udf_marked_host_fallback():
         return x * 2.0
     txt = df.with_column("b", f(F.col("a"))).explain("potential")
     assert "host" in txt.lower() or "PandasUDF" in txt
+
+
+def test_cache_codec_pruning_and_predicate_skipping():
+    """r2 cache-serializer capabilities: codec choice, decode-time column
+    pruning, and predicate batch-skipping via embedded parquet stats
+    (ref ParquetCachedBatchSerializer)."""
+    import numpy as np
+    import pyarrow as pa
+    from harness import tpu_session
+    from spark_rapids_tpu.api import functions as F
+    s = tpu_session({"spark.rapids.tpu.sql.cache.codec": "zstd"})
+    t = pa.table({"a": pa.array(np.arange(50000, dtype=np.int64)),
+                  "b": pa.array(np.arange(50000) * 0.5),
+                  "big": pa.array(["x" * 50] * 50000)})
+    cached = s.create_dataframe(t, num_partitions=5).cache()
+    from spark_rapids_tpu.exec.cached import CachedRelation
+    assert isinstance(cached.plan, CachedRelation)
+    # zstd-compressed blobs are far smaller than raw
+    assert cached.plan.estimated_size_bytes() < t.nbytes / 3
+
+    # pruning: only requested columns decode
+    q = cached.select("a").filter(F.col("a") >= F.lit(49_000)) \
+        .agg(F.count_star().with_name("c"))
+    tree = q._physical().tree_string()
+    assert "ParquetCachedScan" in tree and "pushdown=" in tree, tree
+    assert q.collect() == [{"c": 1000}]
+
+    # batch skipping: the pushed predicate excludes 4 of 5 cached batches
+    physical = q._physical()
+    ctx = s.exec_context()
+    list(physical.execute(ctx))
+    skipped = [m.value for em in ctx.metrics.values()
+               for name, m in em.items()
+               if name == "cachedBatchesSkipped"]
+    assert skipped and max(skipped) == 4, skipped

@@ -260,3 +260,38 @@ def test_broadcast_join_empty_stream():
         return l.filter(F.col("lv") > 10**10).join(
             F.broadcast(r), on=[("lk", "rk")], how="left")
     assert_tpu_and_cpu_equal(q)
+
+
+def test_auto_broadcast_small_side():
+    """Plan-time size estimates pick the broadcast side without a hint
+    (ref Spark autoBroadcastJoinThreshold / reference AQE switching)."""
+    import numpy as np
+    import pyarrow as pa
+    from harness import tpu_session
+    rng = np.random.RandomState(0)
+    big = pa.table({"k": pa.array(rng.randint(0, 50, 50000)),
+                    "v": pa.array(rng.standard_normal(50000))})
+    dim = pa.table({"k2": pa.array(np.arange(50)),
+                    "w": pa.array(np.arange(50) * 2.0)})
+    s = tpu_session()
+    df = s.create_dataframe(big).join(s.create_dataframe(dim),
+                                      on=[("k", "k2")])
+    tree = df._physical().tree_string()
+    assert "BroadcastHashJoin" in tree and "build=right" in tree, tree
+    # correctness unchanged
+    out = df.agg(F.sum(F.col("w")).with_name("sw")).collect()
+    pdf = big.to_pandas().merge(dim.to_pandas(), left_on="k",
+                                right_on="k2")
+    np.testing.assert_allclose(out[0]["sw"], pdf["w"].sum(), rtol=1e-9)
+
+
+def test_auto_broadcast_disabled_by_conf():
+    import numpy as np
+    import pyarrow as pa
+    from harness import tpu_session
+    big = pa.table({"k": pa.array(np.arange(1000))})
+    dim = pa.table({"k2": pa.array(np.arange(10))})
+    s = tpu_session({"spark.rapids.tpu.sql.autoBroadcastJoinThreshold": 0})
+    df = s.create_dataframe(big).join(s.create_dataframe(dim),
+                                      on=[("k", "k2")])
+    assert "BroadcastHashJoin" not in df._physical().tree_string()

@@ -131,7 +131,7 @@ class TestRegexTranspiler:
     def test_java_z(self):
         assert transpile_java_regex("a\\z") == "a\\Z"
 
-    @pytest.mark.parametrize("bad", ["a\\Z", "\\p{Alpha}", "[a[b]]",
+    @pytest.mark.parametrize("bad", ["\\p{L}", "[a[^b]]",
                                      "[a&&b]", "\\G", "(?"  "u)x"])
     def test_rejected(self, bad):
         with pytest.raises(RegexUnsupported):
@@ -216,3 +216,60 @@ def test_dict_filter_string_output_columns_survive():
                 .filter(F.col("s").endswith("_001")))
     got = assert_tpu_and_cpu_equal(q)
     assert all(x.endswith("_001") for x in got["s"])
+
+
+class TestRegexTranspilerR2:
+    """Round-2 depth: \\Z, \\R, POSIX classes, nested unions, ASCII
+    boundaries (ref RegexParser.scala coverage)."""
+
+    def test_end_anchor_Z(self):
+        import re
+        p = transpile_java_regex("abc\\Z")
+        assert re.search(p, "abc\n")      # before final terminator
+        assert re.search(p, "abc")
+        assert not re.search(p, "abc\n\n")
+
+    def test_any_linebreak_R(self):
+        import re
+        p = transpile_java_regex("a\\Rb")
+        assert re.search(p, "a\r\nb") and re.search(p, "a\nb")
+        assert not re.search(p, "a b")
+
+    def test_posix_classes(self):
+        import re
+        p = transpile_java_regex("\\p{Alpha}+\\p{Digit}")
+        assert re.fullmatch(p, "abc7")
+        assert not re.fullmatch(p, "ab7c")
+        pn = transpile_java_regex("\\P{Digit}")
+        assert re.fullmatch(pn, "x") and not re.fullmatch(pn, "5")
+        pin = transpile_java_regex("[\\p{Upper}0-3]+")
+        assert re.fullmatch(pin, "AB2")
+
+    def test_unicode_category_rejected(self):
+        with pytest.raises(RegexUnsupported):
+            transpile_java_regex("\\p{L}+")
+
+    def test_nested_class_union(self):
+        import re
+        p = transpile_java_regex("[a[bc]]+")
+        assert re.fullmatch(p, "cab")
+        assert not re.fullmatch(p, "d")
+        with pytest.raises(RegexUnsupported):
+            transpile_java_regex("[a[^b]]")
+        with pytest.raises(RegexUnsupported):
+            transpile_java_regex("[a&&[b]]")
+
+    def test_ascii_word_boundary(self):
+        import re
+        p = transpile_java_regex("\\bword\\b")
+        assert re.search(p, "a word here")
+        # Java's ASCII \b: a unicode letter is NOT a word char
+        assert re.search(p, "éwordé")
+
+    def test_rlike_uses_extended_transpiler(self):
+        s = tpu_session()
+        df = s.create_dataframe(pd.DataFrame(
+            {"s": ["abc1", "xyz", "ABC2", None]}))
+        out = df.filter(F.rlike(F.col("s"), "\\p{Alpha}+\\p{Digit}")) \
+            .to_pandas()
+        assert sorted(out["s"]) == ["ABC2", "abc1"]
