@@ -73,6 +73,78 @@ DISTRIBUTED_OUT_FACTOR = register(
     "Initial join-output bound as a multiple of the probe-side shard size; "
     "exceeded bounds double and re-run.")
 
+FUSED_PIPELINE = register(
+    "spark.rapids.tpu.sql.fusedPipeline.enabled", False,
+    "Single-chip queries whose plan contains a join compile the WHOLE "
+    "supported fragment (scans -> filters -> joins -> aggregation) into "
+    "ONE kernel via the fragment compiler on a 1-device mesh. The right "
+    "shape for real TPU hardware (dispatch ~us, D2H ~GB/s: one launch "
+    "beats several), and OFF by default on the tunneled dev backend, "
+    "where measurement shows the fragment path's whole-array result "
+    "fetch loses to the operator pipeline's packed single-fetch "
+    "discipline (docs/performance.md). Unsupported or oversized plans "
+    "fall back to the operator pipeline either way.")
+
+#: learned speculative bounds per (fragment signature, bound key) —
+#: the cross-query statistics that let repeat queries start with tight
+#: static shapes (the fragment analog of exec/joins._TOTAL_STATS)
+_FRAGMENT_STATS: Dict[Tuple, int] = {}
+
+#: per-source device-array cache (encode + pad + H2D skipped on repeat
+#: queries over the same in-memory table). Weak pin + finalizer evict on
+#: table GC (the scan-cache pattern, exec/basic.py); byte-capped LRU.
+import weakref  # noqa: E402
+
+_SOURCE_PIN: "weakref.WeakValueDictionary" = weakref.WeakValueDictionary()
+_SOURCE_ARRAYS: Dict[Tuple, tuple] = {}
+_SOURCE_LRU: Dict[Tuple, int] = {}
+_SOURCE_TICK = [0]
+_SOURCE_CACHE_MAX_BYTES = 1 << 30
+
+
+def _source_evict(tid: int):
+    for k in [k for k in _SOURCE_ARRAYS if k[0] == tid]:
+        del _SOURCE_ARRAYS[k]
+        _SOURCE_LRU.pop(k, None)
+
+
+def _source_bytes(entry) -> int:
+    _n, pairs, _d, _p = entry
+    return sum(int(d.nbytes) + int(v.nbytes) for d, v in pairs)
+
+
+def _source_cache_put(key, entry):
+    new_bytes = _source_bytes(entry)
+    if new_bytes > _SOURCE_CACHE_MAX_BYTES:
+        return
+    total = sum(_source_bytes(e) for e in _SOURCE_ARRAYS.values())
+    while _SOURCE_ARRAYS and total + new_bytes > _SOURCE_CACHE_MAX_BYTES:
+        coldest = min(_SOURCE_LRU, key=_SOURCE_LRU.get)
+        total -= _source_bytes(_SOURCE_ARRAYS[coldest])
+        del _SOURCE_ARRAYS[coldest]
+        del _SOURCE_LRU[coldest]
+    _SOURCE_ARRAYS[key] = entry
+    _SOURCE_TICK[0] += 1
+    _SOURCE_LRU[key] = _SOURCE_TICK[0]
+
+
+def _source_cache_key(src, replicated: bool, n_dev: int, frag_fields):
+    from ..exec.basic import InMemoryScanExec
+    if not isinstance(src, InMemoryScanExec) or len(src.tables) != 1:
+        return None
+    t = src.tables[0]
+    tid = id(t)
+    if _SOURCE_PIN.get(tid) is not t:
+        try:
+            _SOURCE_PIN[tid] = t
+        except TypeError:
+            return None
+        _source_evict(tid)          # stale entries under a reused id
+        weakref.finalize(t, _source_evict, tid)
+    sig = tuple((f.name, f.phys.name, f.dict_id is not None)
+                for f in frag_fields)
+    return (tid, replicated, n_dev, sig)
+
 
 # ---------------------------------------------------------------------------
 # fragment IR
@@ -451,12 +523,16 @@ class _NotLowerable(Exception):
 
 
 class _Planner:
-    def __init__(self, conf: TpuConf):
+    def __init__(self, conf: TpuConf, fused_mode: bool = False):
         self.conf = conf
+        #: True for single-chip fused lowering (stricter gates apply:
+        #: features living only in the operator path must not be lost)
+        self.fused_mode = fused_mode
         self.sources: List[Tuple[object, bool]] = []   # (exec, replicated)
         self.n_dicts = 0
         self.n_frags = 0
         self.has_comm = False
+        self.has_join = False
 
     def new_dict(self) -> int:
         self.n_dicts += 1
@@ -568,6 +644,17 @@ class _Planner:
 
     def _make_join(self, node, left: _Frag, right: _Frag,
                    broadcast: bool) -> _Frag:
+        if node.join_type not in ("inner", "left", "right", "full",
+                                  "leftsemi", "leftanti"):
+            raise _NotLowerable(f"join type {node.join_type}")
+        from ..config import JOIN_BLOOM_FILTER
+        if self.fused_mode and self.conf.get(JOIN_BLOOM_FILTER):
+            # the runtime bloom filter is an operator-path optimization;
+            # single-chip fusion must not silently drop it (on a REAL
+            # mesh the collective exchange replaces it wholesale, so
+            # multi-device lowering proceeds regardless)
+            raise _NotLowerable("bloom-filtered joins keep the operator "
+                               "pipeline")
         for k in node.left_keys:
             if not self._expr_ok(k, left):
                 raise _NotLowerable(f"join key {k.name_hint}")
@@ -591,6 +678,7 @@ class _Planner:
         # any join benefits from the mesh: routed joins exchange, broadcast
         # joins probe in parallel across shards
         self.has_comm = True
+        self.has_join = True
         frag = _JoinFrag(self.frag_id(), left, right, node.left_keys,
                          node.right_keys, node.join_type, broadcast)
         # semi/anti joins emit probe-side fields only
@@ -664,7 +752,7 @@ class _Env:
     and the overflow-check accumulator."""
 
     def __init__(self, mesh, axis: str, conf: TpuConf,
-                 source_layout, bounds: Dict):
+                 source_layout, bounds: Dict, sig: str = ""):
         self.mesh = mesh
         self.axis = axis
         self.n_dev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
@@ -672,13 +760,19 @@ class _Env:
         self.conf_out_factor = int(conf.get(DISTRIBUTED_OUT_FACTOR))
         self._layout = source_layout    # idx -> (padded, n_fields)
         self._bounds = bounds           # key -> int (speculative bounds)
+        self.sig = sig                  # fragment signature for stats
         self._inputs = None             # set per trace
         self.checks: List[Tuple] = []   # (traced count, static bound)
 
     def bound(self, key, default: int) -> int:
         b = self._bounds.get(key)
         if b is None:
-            b = self._bounds[key] = int(default)
+            # learned cross-query statistic first (the fragment analog
+            # of the joins' _TOTAL_STATS speculative sizing)
+            b = _FRAGMENT_STATS.get((self.sig, self.n_dev, key))
+            if b is None:
+                b = int(default)
+            self._bounds[key] = b
         return b
 
     def check(self, count, bound: int):
@@ -704,7 +798,8 @@ class DistributedPipelineExec(TpuExec):
     reference would show GpuShuffleExchangeExec-separated stages."""
 
     def __init__(self, root: _Frag, sources: List[Tuple[object, bool]],
-                 mesh, conf: TpuConf, out_schema: Schema, axis: str = "data"):
+                 mesh, conf: TpuConf, out_schema: Schema,
+                 axis: str = "data", fallback=None):
         super().__init__([s for s, _ in sources])
         self.root = root
         self.sources = sources
@@ -713,6 +808,10 @@ class DistributedPipelineExec(TpuExec):
         self.axis = axis
         self._schema = out_schema
         self._bounds: Dict = {}
+        self.sig = root.signature()
+        #: original operator subtree; runs instead when a source exceeds
+        #: the shape-bucket ladder (fragments are single-batch programs)
+        self.fallback = fallback
         self.n_dev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
 
     def output_schema(self) -> Schema:
@@ -726,7 +825,26 @@ class DistributedPipelineExec(TpuExec):
     def do_execute(self, ctx):
         import pyarrow as pa
         from ..columnar import ColumnarBatch
+        from ..columnar.bucketing import DEFAULT_BUCKETS
+        from ..exec.basic import InMemoryScanExec
+        max_rows = max(DEFAULT_BUCKETS)
+        if self.fallback is not None:
+            # fragments are single-batch programs; oversized inputs take
+            # the multi-batch operator pipeline. Scan sources expose
+            # their row counts WITHOUT executing anything — check them
+            # first so fallback never double-runs the sources.
+            for s, _ in self.sources:
+                if isinstance(s, InMemoryScanExec) and \
+                        sum(t.num_rows for t in s.tables) > max_rows:
+                    yield from self.fallback.execute(ctx)
+                    return
         tables = [s._collect_tables(ctx) for s, _ in self.sources]
+        if self.fallback is not None and any(
+                t.num_rows > max_rows for t in tables):
+            # non-scan source turned out oversized: the sources ran
+            # twice on this rare path — documented cost of the late check
+            yield from self.fallback.execute(ctx)
+            return
         out = self._run(ctx, tables)
         yield ColumnarBatch.from_arrow(out)
 
@@ -734,7 +852,8 @@ class DistributedPipelineExec(TpuExec):
         import jax
         for attempt in range(4):
             layout, inputs, dicts = self._shard_inputs(tables)
-            env = _Env(self.mesh, self.axis, self.conf, layout, self._bounds)
+            env = _Env(self.mesh, self.axis, self.conf, layout,
+                       self._bounds, self.sig)
             fn, n_checks = self._build_program(env)
             outs = fn(*inputs)
             counts = np.asarray(jax.device_get(outs[0]))
@@ -747,6 +866,15 @@ class DistributedPipelineExec(TpuExec):
                           enumerate(zip(check_vals, bounds_flat))
                           if v > b]
             if not violations:
+                # record observed sizes so the NEXT query of this shape
+                # starts with tight static bounds (smaller sorts); a
+                # running max keyed by mesh size avoids thrash when the
+                # same shape alternates between small and large inputs
+                for i, (v, b) in enumerate(zip(check_vals, bounds_flat)):
+                    k = (self.sig, self.n_dev, self._check_keys[i])
+                    _FRAGMENT_STATS[k] = max(
+                        _FRAGMENT_STATS.get(k, 0),
+                        _bucket(max(int(v) * 3 // 2, 1)))
                 return self._stitch(env, outs, counts, dicts)
             # double every violated speculative bound and re-run (the
             # mesh-level SpeculativeOverflow retry)
@@ -761,57 +889,84 @@ class DistributedPipelineExec(TpuExec):
     # -----------------------------------------------------------------------
     def _shard_inputs(self, tables):
         """Arrow tables -> padded sharded/replicated device arrays.
-        Returns (layout, flat_inputs, dicts)."""
-        import jax
-        import jax.numpy as jnp
-        from jax.sharding import NamedSharding, PartitionSpec as P
-        shard = NamedSharding(self.mesh, P(self.axis))
-        repl = NamedSharding(self.mesh, P())
-        n_dev = self.n_dev
+        Returns (layout, flat_inputs, dicts). Per-source device arrays
+        are cached by underlying-table identity, so repeat queries over
+        the same in-memory data skip the encode + H2D entirely (the
+        fragment analog of the operator scan cache)."""
         layout = {}
         flat = []
         dicts = {}
         off = 0
         for (src, replicated), table, frag_fields in zip(
                 self.sources, tables, self._source_fields()):
-            n = table.num_rows
+            key = _source_cache_key(src, replicated, self.n_dev,
+                                    frag_fields)
+            cached = _SOURCE_ARRAYS.get(key) if key is not None else None
+            if cached is not None:
+                _SOURCE_TICK[0] += 1
+                _SOURCE_LRU[key] = _SOURCE_TICK[0]
+            else:
+                cached = self._put_source(table, replicated, frag_fields)
+                if key is not None:
+                    _source_cache_put(key, cached)
+            nrows, pairs_dev, pos_dicts, padded = cached
+            flat.append(nrows)
+            for d, v in pairs_dev:
+                flat.append(d)
+                flat.append(v)
+            for pos, uniq in pos_dicts.items():
+                dicts[frag_fields[pos].dict_id] = uniq
+            layout[len(layout)] = (padded, len(pairs_dev), off)
+            off += 1 + 2 * len(pairs_dev)
+        return layout, flat, dicts
+
+    def _put_source(self, table, replicated: bool, frag_fields):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        shard = NamedSharding(self.mesh, P(self.axis))
+        repl = NamedSharding(self.mesh, P())
+        n_dev = self.n_dev
+        n = table.num_rows
+        if replicated:
+            padded = _bucket(n)
+            nrows = jax.device_put(jnp.asarray(np.full(1, n, np.int32)),
+                                   repl)
+        else:
+            per = -(-n // n_dev) if n else 1
+            padded = _bucket(per)
+            counts = np.asarray(
+                [max(min(n - i * per, per), 0) for i in range(n_dev)],
+                np.int32)
+            nrows = jax.device_put(jnp.asarray(counts), shard)
+        dicts: Dict = {}
+        arrays = self._encode_columns(table, frag_fields, dicts)
+        pos_dicts = {i: dicts[f.dict_id]
+                     for i, f in enumerate(frag_fields)
+                     if f.dict_id is not None}
+        pairs_dev = []
+        for d, v in arrays:
             if replicated:
-                padded = _bucket(n)
-                nrows = jax.device_put(
-                    jnp.asarray(np.full(1, n, np.int32)), repl)
+                dp = np.zeros(padded, d.dtype)
+                vp = np.zeros(padded, bool)
+                dp[:n] = d
+                vp[:n] = v
+                pairs_dev.append((jax.device_put(jnp.asarray(dp), repl),
+                                  jax.device_put(jnp.asarray(vp), repl)))
             else:
                 per = -(-n // n_dev) if n else 1
-                padded = _bucket(per)
-                counts = np.asarray(
-                    [max(min(n - i * per, per), 0) for i in range(n_dev)],
-                    np.int32)
-                nrows = jax.device_put(jnp.asarray(counts), shard)
-            flat.append(nrows)
-            arrays = self._encode_columns(table, frag_fields, dicts)
-            for d, v in arrays:
-                if replicated:
-                    dp = np.zeros(padded, d.dtype)
-                    vp = np.zeros(padded, bool)
-                    dp[:n] = d
-                    vp[:n] = v
-                    flat.append(jax.device_put(jnp.asarray(dp), repl))
-                    flat.append(jax.device_put(jnp.asarray(vp), repl))
-                else:
-                    per = -(-n // n_dev) if n else 1
-                    dp = np.zeros(n_dev * padded, d.dtype)
-                    vp = np.zeros(n_dev * padded, bool)
-                    for i in range(n_dev):
-                        c = max(min(n - i * per, per), 0)
-                        if c:
-                            dp[i * padded:i * padded + c] = d[i * per:
-                                                              i * per + c]
-                            vp[i * padded:i * padded + c] = v[i * per:
-                                                              i * per + c]
-                    flat.append(jax.device_put(jnp.asarray(dp), shard))
-                    flat.append(jax.device_put(jnp.asarray(vp), shard))
-            layout[len(layout)] = (padded, len(arrays), off)
-            off += 1 + 2 * len(arrays)
-        return layout, flat, dicts
+                dp = np.zeros(n_dev * padded, d.dtype)
+                vp = np.zeros(n_dev * padded, bool)
+                for i in range(n_dev):
+                    c = max(min(n - i * per, per), 0)
+                    if c:
+                        dp[i * padded:i * padded + c] = \
+                            d[i * per:i * per + c]
+                        vp[i * padded:i * padded + c] = \
+                            v[i * per:i * per + c]
+                pairs_dev.append((jax.device_put(jnp.asarray(dp), shard),
+                                  jax.device_put(jnp.asarray(vp), shard)))
+        return nrows, pairs_dev, pos_dicts, padded
 
     def _source_fields(self):
         out = []
@@ -986,14 +1141,33 @@ def maybe_distribute(physical, conf: TpuConf, mesh):
     return replaced if replaced is not None else physical
 
 
-def _try_replace(node, conf: TpuConf, mesh):
-    new = _lower_node(node, conf, mesh)
+_SINGLE_MESH = [None]
+
+
+def maybe_fuse_single_chip(physical, conf: TpuConf):
+    """Single-chip fused pipelines: a plan fragment containing a JOIN
+    compiles to ONE kernel through the fragment compiler over a 1-device
+    mesh — one dispatch instead of several per operator, the dominant
+    cost on a latency-bound backend. Join-free plans keep the operator
+    pipeline (the aggregate exec's fused single-fetch path is already
+    one dispatch). Oversized inputs fall back at runtime."""
+    if _SINGLE_MESH[0] is None:
+        from .mesh import make_mesh
+        _SINGLE_MESH[0] = make_mesh(1)
+    replaced = _try_replace(physical, conf, _SINGLE_MESH[0],
+                            require_join=True, keep_fallback=True)
+    return replaced if replaced is not None else physical
+
+
+def _try_replace(node, conf: TpuConf, mesh, require_join: bool = False,
+                 keep_fallback: bool = False):
+    new = _lower_node(node, conf, mesh, require_join, keep_fallback)
     if new is not None:
         return new
     changed = False
     new_children = []
     for c in getattr(node, "children", []):
-        r = _try_replace(c, conf, mesh)
+        r = _try_replace(c, conf, mesh, require_join, keep_fallback)
         if r is not None and r is not c:
             changed = True
             new_children.append(r)
@@ -1004,8 +1178,9 @@ def _try_replace(node, conf: TpuConf, mesh):
     return node if changed else None
 
 
-def _lower_node(node, conf: TpuConf, mesh):
-    planner = _Planner(conf)
+def _lower_node(node, conf: TpuConf, mesh, require_join: bool = False,
+                keep_fallback: bool = False):
+    planner = _Planner(conf, fused_mode=require_join)
     try:
         frag = planner.lower(node)
     except _NotLowerable as e:
@@ -1013,5 +1188,9 @@ def _lower_node(node, conf: TpuConf, mesh):
         return None
     if not planner.has_comm:
         return None                 # no join/agg: the mesh gains nothing
+    if require_join and not planner.has_join:
+        return None
     return DistributedPipelineExec(frag, planner.sources, mesh, conf,
-                                   node.output_schema())
+                                   node.output_schema(),
+                                   fallback=node if keep_fallback
+                                   else None)

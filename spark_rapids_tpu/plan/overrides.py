@@ -71,6 +71,11 @@ def plan_query(plan: L.LogicalPlan, conf: TpuConf, mesh=None) -> TpuExec:
     if mesh is not None and conf.sql_enabled:
         from ..parallel.planner import maybe_distribute
         physical = maybe_distribute(physical, conf, mesh)
+    elif conf.sql_enabled:
+        from ..parallel.planner import FUSED_PIPELINE, \
+            maybe_fuse_single_chip
+        if conf.get(FUSED_PIPELINE):
+            physical = maybe_fuse_single_chip(physical, conf)
     return physical
 
 

@@ -414,3 +414,29 @@ def test_planned_broadcast_outer_join_not_duplicated():
     assert len(got) == len(want)
     # the unmatched dim row (k2=99) appears exactly once
     assert int((got["k2"] == 99).sum()) == 1
+
+
+def test_fused_single_chip_pipeline_differential():
+    """Opt-in single-chip fused pipelines: the whole join+agg fragment
+    compiles through the 1-device-mesh fragment compiler; results must
+    match the operator pipeline."""
+    t = _table(n=2500, key_hi=11)
+    dim = pa.table({"k2": pa.array(np.arange(11), pa.int64()),
+                    "w": pa.array(np.arange(11, dtype=np.float64))})
+
+    def q(s):
+        return (s.create_dataframe(t)
+                .join(s.create_dataframe(dim), on=[("k", "k2")])
+                .group_by("k")
+                .agg(F.sum(F.col("w")).with_name("sw"),
+                     F.count_star().with_name("n")))
+    fused = tpu_session(
+        {"spark.rapids.tpu.sql.fusedPipeline.enabled": True})
+    tree = q(fused)._physical().tree_string()
+    assert "DistributedPipeline[n_dev=1" in tree, tree
+    got = q(fused).collect_arrow().to_pandas() \
+        .sort_values("k").reset_index(drop=True)
+    plain = tpu_session()
+    want = q(plain).collect_arrow().to_pandas() \
+        .sort_values("k").reset_index(drop=True)
+    pd.testing.assert_frame_equal(got, want, check_dtype=False)

@@ -210,8 +210,11 @@ def test_broadcast_hash_join(how):
 
 
 def test_broadcast_join_plan_has_exchange():
+    # operator-plan shape: disable single-chip fusion, which would
+    # otherwise compile the whole fragment into one pipeline node
     from harness import tpu_session
-    l, r = _sides(tpu_session())
+    s = tpu_session({"spark.rapids.tpu.sql.fusedPipeline.enabled": False})
+    l, r = _sides(s)
     plan = l.join(F.broadcast(r), on=[("lk", "rk")], how="inner")._physical()
     t = plan.tree_string()
     assert "BroadcastExchange" in t and "BroadcastHashJoin" in t
@@ -273,7 +276,7 @@ def test_auto_broadcast_small_side():
                     "v": pa.array(rng.standard_normal(50000))})
     dim = pa.table({"k2": pa.array(np.arange(50)),
                     "w": pa.array(np.arange(50) * 2.0)})
-    s = tpu_session()
+    s = tpu_session({"spark.rapids.tpu.sql.fusedPipeline.enabled": False})
     df = s.create_dataframe(big).join(s.create_dataframe(dim),
                                       on=[("k", "k2")])
     tree = df._physical().tree_string()
