@@ -20,9 +20,20 @@ from ..types import (BINARY, BOOL, DATE, DataType, DecimalType, FLOAT32,
                      Schema, StructField)
 
 __all__ = ["DeltaLog", "Snapshot", "AddFile", "RemoveFile", "Metadata",
-           "schema_from_delta_json", "schema_to_delta_json"]
+           "schema_from_delta_json", "schema_to_delta_json",
+           "ConcurrentCommitException", "ConcurrentModificationException"]
 
 CHECKPOINT_INTERVAL = 10
+
+
+class ConcurrentCommitException(RuntimeError):
+    """A concurrent writer won the race for this log version."""
+
+
+class ConcurrentModificationException(RuntimeError):
+    """The transaction's snapshot is stale and its actions cannot be
+    safely replayed on top of the winning commits (ref delta-io
+    ConcurrentModificationException family)."""
 
 _PRIM = {
     "string": STRING, "long": INT64, "integer": INT32, "short": INT16,
@@ -210,6 +221,50 @@ class DeltaLog:
         return Snapshot(target, meta, files)
 
     # ----------------------------------------------------------- writing
+    def commit_with_retry(self, version: int, actions: List[dict],
+                          op: str = "WRITE", max_retries: int = 10) -> int:
+        """Optimistic-concurrency commit with conflict checking (ref
+        delta-io OptimisticTransaction.checkForConflicts as driven by
+        GpuOptimisticTransaction): on losing the version race, read the
+        winning commits and decide —
+
+          * our commit is a BLIND APPEND (adds only) and every winner
+            only added data -> retry at the next version;
+          * a winner changed metadata, removed files, or our commit
+            removes/rewrites files (DML/OPTIMIZE) -> raise
+            ConcurrentModificationException (the snapshot our actions
+            were computed from is stale).
+
+        Returns the version actually committed."""
+        ours_blind = not any("remove" in a or "metaData" in a
+                             for a in actions)
+        for attempt in range(max_retries + 1):
+            try:
+                self.commit(version, actions, op)
+                return version
+            except ConcurrentCommitException:
+                if not ours_blind:
+                    raise ConcurrentModificationException(
+                        f"{op} at version {version} conflicts with a "
+                        "concurrent writer (stale snapshot)")
+                winner = os.path.join(self.log_path,
+                                      f"{version:020d}.json")
+                with open(winner) as f:
+                    their = [json.loads(line) for line in f
+                             if line.strip()]
+                # only PURE APPENDS commute: anything beyond add/
+                # commitInfo (removes, metadata, protocol upgrades, ...)
+                # invalidates our snapshot (delta-io treats
+                # ProtocolChanged as a hard conflict too)
+                if not all(set(a) <= {"add", "commitInfo"}
+                           for a in their):
+                    raise ConcurrentModificationException(
+                        f"append at version {version} conflicts with a "
+                        "concurrent non-append commit")
+                version += 1          # both pure appends: commute
+        raise ConcurrentModificationException(
+            f"gave up after {max_retries} concurrent-commit retries")
+
     def commit(self, version: int, actions: List[dict],
                op: str = "WRITE") -> None:
         """Atomic create-if-absent commit (optimistic concurrency: a
@@ -228,7 +283,7 @@ class DeltaLog:
             # O_EXCL-like: link fails if the version already exists
             os.link(tmp, path)
         except FileExistsError:
-            raise RuntimeError(
+            raise ConcurrentCommitException(
                 f"concurrent delta commit conflict at version {version}")
         finally:
             os.unlink(tmp)

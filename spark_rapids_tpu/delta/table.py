@@ -148,7 +148,9 @@ def write_delta(session, plan_df, path: str, mode: str = "overwrite",
         else:
             actions.append(
                 _write_data_file(path, sub, part_values).to_action())
-    log.commit(version + 1, actions, op="WRITE")
+    # appends retry past concurrent pure-append winners; overwrites and
+    # anything carrying metadata/removes abort on conflict
+    log.commit_with_retry(version + 1, actions, op="WRITE")
     _maybe_auto_compact(session, path, cfg)
 
 
@@ -221,7 +223,8 @@ def _maybe_auto_compact(session, path: str, cfg: Dict[str, str]) -> None:
             add.data_change = False
             actions.append(add.to_action())
             off += target
-    dt.log.commit(snap.version + 1, actions, op="auto-OPTIMIZE")
+    dt.log.commit_with_retry(snap.version + 1, actions,
+                             op="auto-OPTIMIZE")
 
 
 def _file_rows(add: AddFile):
@@ -308,7 +311,8 @@ class DeltaTable:
                 actions.append(_rewrite_file(self.path, kept, add,
                                              None).to_action())
         if actions:
-            self.log.commit(snap.version + 1, actions, op="DELETE")
+            self.log.commit_with_retry(snap.version + 1, actions,
+                                       op="DELETE")
         return {"num_deleted_rows": deleted_rows}
 
     # ------------------------------------------------------------ UPDATE
@@ -350,7 +354,8 @@ class DeltaTable:
             actions.append(_rewrite_file(self.path, out, add,
                                          None).to_action())
         if actions:
-            self.log.commit(snap.version + 1, actions, op="UPDATE")
+            self.log.commit_with_retry(snap.version + 1, actions,
+                                       op="UPDATE")
         return {"num_updated_rows": updated}
 
     # ------------------------------------------------------------- MERGE
@@ -366,7 +371,8 @@ class DeltaTable:
                         partition_columns=old.partition_columns,
                         table_id=old.table_id, name=old.name,
                         configuration=cfg)
-        self.log.commit(snap.version + 1, [meta.to_action()], op=op)
+        self.log.commit_with_retry(snap.version + 1, [meta.to_action()],
+                                   op=op)
 
     def add_check_constraint(self, name: str, expr: str) -> None:
         """ALTER TABLE ADD CONSTRAINT name CHECK (expr): existing rows are
@@ -472,8 +478,9 @@ class DeltaTable:
                 af.data_change = False
                 actions.append(af.to_action())
                 added += 1
-        self.log.commit(snap.version + 1, actions,
-                        op="OPTIMIZE" if not zorder_by else "ZORDER")
+        self.log.commit_with_retry(
+            snap.version + 1, actions,
+            op="OPTIMIZE" if not zorder_by else "ZORDER")
         return {"files_removed": len(snap.files), "files_added": added}
 
     # ------------------------------------------------------------- VACUUM
@@ -680,7 +687,7 @@ class MergeBuilder:
                         _write_data_file(t.path, sub, pv).to_action())
                 stats["num_inserted"] = ins.num_rows
         if actions:
-            t.log.commit(snap.version + 1, actions, op="MERGE")
+            t.log.commit_with_retry(snap.version + 1, actions, op="MERGE")
         return stats
 
 

@@ -99,7 +99,13 @@ _SOURCE_PIN: "weakref.WeakValueDictionary" = weakref.WeakValueDictionary()
 _SOURCE_ARRAYS: Dict[Tuple, tuple] = {}
 _SOURCE_LRU: Dict[Tuple, int] = {}
 _SOURCE_TICK = [0]
-_SOURCE_CACHE_MAX_BYTES = 1 << 30
+
+
+def _source_cache_limit(conf: TpuConf) -> int:
+    # governed by the SAME conf as the operator scan cache: one budget
+    # for "device arrays pinned for repeat scans", 0 disables both
+    from ..exec.basic import SCAN_CACHE_MAX_BYTES
+    return int(conf.get(SCAN_CACHE_MAX_BYTES))
 
 
 def _source_evict(tid: int):
@@ -113,12 +119,12 @@ def _source_bytes(entry) -> int:
     return sum(int(d.nbytes) + int(v.nbytes) for d, v in pairs)
 
 
-def _source_cache_put(key, entry):
+def _source_cache_put(key, entry, limit: int):
     new_bytes = _source_bytes(entry)
-    if new_bytes > _SOURCE_CACHE_MAX_BYTES:
+    if limit <= 0 or new_bytes > limit:
         return
-    total = sum(_source_bytes(e) for e in _SOURCE_ARRAYS.values())
-    while _SOURCE_ARRAYS and total + new_bytes > _SOURCE_CACHE_MAX_BYTES:
+    total = sum(_source_bytes(e) for e in list(_SOURCE_ARRAYS.values()))
+    while _SOURCE_ARRAYS and total + new_bytes > limit:
         coldest = min(_SOURCE_LRU, key=_SOURCE_LRU.get)
         total -= _source_bytes(_SOURCE_ARRAYS[coldest])
         del _SOURCE_ARRAYS[coldest]
@@ -768,11 +774,17 @@ class _Env:
         b = self._bounds.get(key)
         if b is None:
             # learned cross-query statistic first (the fragment analog
-            # of the joins' _TOTAL_STATS speculative sizing)
-            b = _FRAGMENT_STATS.get((self.sig, self.n_dev, key))
+            # of the joins' _TOTAL_STATS speculative sizing). The stat is
+            # keyed by the bucketed DEFAULT too, so the same query shape
+            # at a different input scale keeps its input-proportional
+            # default instead of a stale too-small bound.
+            b = _FRAGMENT_STATS.get(
+                (self.sig, self.n_dev, key, _bucket(default)))
             if b is None:
                 b = int(default)
             self._bounds[key] = b
+            self._defaults = getattr(self, "_defaults", {})
+            self._defaults[key] = int(default)
         return b
 
     def check(self, count, bound: int):
@@ -850,7 +862,9 @@ class DistributedPipelineExec(TpuExec):
 
     def _run(self, ctx, tables):
         import jax
-        for attempt in range(4):
+        # deep fragments can surface undersized bounds one layer per
+        # attempt (each clamped count hides the next layer's true size)
+        for attempt in range(6):
             layout, inputs, dicts = self._shard_inputs(tables)
             env = _Env(self.mesh, self.axis, self.conf, layout,
                        self._bounds, self.sig)
@@ -867,11 +881,15 @@ class DistributedPipelineExec(TpuExec):
                           if v > b]
             if not violations:
                 # record observed sizes so the NEXT query of this shape
-                # starts with tight static bounds (smaller sorts); a
-                # running max keyed by mesh size avoids thrash when the
-                # same shape alternates between small and large inputs
+                # AND input scale starts with tight static bounds; a
+                # running max avoids thrash on varying data
+                defaults = getattr(env, "_defaults", {})
                 for i, (v, b) in enumerate(zip(check_vals, bounds_flat)):
-                    k = (self.sig, self.n_dev, self._check_keys[i])
+                    ck = self._check_keys[i]
+                    dflt = defaults.get(ck)
+                    if dflt is None:
+                        continue
+                    k = (self.sig, self.n_dev, ck, _bucket(dflt))
                     _FRAGMENT_STATS[k] = max(
                         _FRAGMENT_STATS.get(k, 0),
                         _bucket(max(int(v) * 3 // 2, 1)))
@@ -884,7 +902,7 @@ class DistributedPipelineExec(TpuExec):
             log.warning("distributed bounds overflowed (%s); retrying",
                         violations)
         raise RuntimeError("distributed pipeline failed to size its "
-                           "speculative bounds after 4 attempts")
+                           "speculative bounds after 6 attempts")
 
     # -----------------------------------------------------------------------
     def _shard_inputs(self, tables):
@@ -908,7 +926,8 @@ class DistributedPipelineExec(TpuExec):
             else:
                 cached = self._put_source(table, replicated, frag_fields)
                 if key is not None:
-                    _source_cache_put(key, cached)
+                    _source_cache_put(key, cached,
+                                      _source_cache_limit(self.conf))
             nrows, pairs_dev, pos_dicts, padded = cached
             flat.append(nrows)
             for d, v in pairs_dev:
