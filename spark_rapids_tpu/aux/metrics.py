@@ -90,9 +90,19 @@ class LazyMetricsView(Mapping):
 
 def metrics_summary(ctx):
     """Per-exec metric values keyed by exec id (the SQL-UI GpuMetric view,
-    GpuExec.scala:54-165; levels preserved). Lazy: see LazyMetricsView."""
+    GpuExec.scala:54-165). The verbosity conf plays the role of the
+    reference's DEBUG/MODERATE/ESSENTIAL metric levels: lower verbosity
+    drops the noisier counters from the summary. Lazy: LazyMetricsView."""
+    from ..config import METRICS_LEVEL
+    level = str(ctx.conf.get(METRICS_LEVEL)).upper()
+    lvl_rank = {"ESSENTIAL": 0, "MODERATE": 1, "DEBUG": 2}
+    keep = lvl_rank.get(level, 2)
     # snapshot the raw VALUES of THIS query now — Metric objects live on
     # the session-cached context and later queries mutate them
-    snap = {exec_id: {name: m.value for name, m in ms.items()}
-            for exec_id, ms in ctx.metrics.items()}
+    snap = {}
+    for exec_id, ms in ctx.metrics.items():
+        kept = {name: m.value for name, m in ms.items()
+                if lvl_rank.get(m.level, 1) <= keep}
+        if kept:
+            snap[exec_id] = kept
     return LazyMetricsView(snap)

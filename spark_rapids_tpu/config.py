@@ -282,6 +282,13 @@ SHAPE_BUCKETS = register(
     "operator compiles once per bucket (TPU-specific, no reference analog — "
     "cudf is shape-dynamic, XLA is not).")
 
+AGG_OPTIMISTIC_GROUPS = register(
+    "spark.rapids.tpu.sql.agg.optimisticGroups", 4096,
+    "Single-batch aggregations speculatively fetch final results sized "
+    "for at most this many groups in ONE device round trip; more groups "
+    "fall back to the classic multi-pass pipeline (TPU-specific: the "
+    "fetch is the unit of cost on a tunneled backend).")
+
 WINDOW_HOST_SINK_ROWS = register(
     "spark.rapids.tpu.window.hostSinkRowThreshold", 65536,
     "A terminal window exec whose input has at least this many rows runs "

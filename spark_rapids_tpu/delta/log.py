@@ -222,7 +222,8 @@ class DeltaLog:
 
     # ----------------------------------------------------------- writing
     def commit_with_retry(self, version: int, actions: List[dict],
-                          op: str = "WRITE", max_retries: int = 10) -> int:
+                          op: str = "WRITE", max_retries: int = 10,
+                          blind_append: Optional[bool] = None) -> int:
         """Optimistic-concurrency commit with conflict checking (ref
         delta-io OptimisticTransaction.checkForConflicts as driven by
         GpuOptimisticTransaction): on losing the version race, read the
@@ -235,9 +236,17 @@ class DeltaLog:
             ConcurrentModificationException (the snapshot our actions
             were computed from is stale).
 
+        ``blind_append``: callers that READ the table before writing
+        (e.g. an insert-only MERGE, whose adds-only action shape LOOKS
+        blind) must pass False — retrying would replay a decision made
+        against a stale snapshot. None infers from the action shape,
+        which is only valid for true append paths.
+
         Returns the version actually committed."""
-        ours_blind = not any("remove" in a or "metaData" in a
-                             for a in actions)
+        ours_blind = blind_append
+        if ours_blind is None:
+            ours_blind = not any("remove" in a or "metaData" in a
+                                 for a in actions)
         for attempt in range(max_retries + 1):
             try:
                 self.commit(version, actions, op)

@@ -687,7 +687,11 @@ class MergeBuilder:
                         _write_data_file(t.path, sub, pv).to_action())
                 stats["num_inserted"] = ins.num_rows
         if actions:
-            t.log.commit_with_retry(snap.version + 1, actions, op="MERGE")
+            # MERGE reads the table: even an insert-only merge (adds-only
+            # action shape) must NOT retry as a blind append — the
+            # not-matched determination is snapshot-dependent
+            t.log.commit_with_retry(snap.version + 1, actions, op="MERGE",
+                                    blind_append=False)
         return stats
 
 

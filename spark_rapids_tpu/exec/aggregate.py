@@ -428,10 +428,11 @@ class TpuHashAggregateExec(TpuExec):
     #: optimistic single-fetch group bound: the fused update+finalize
     #: kernel slices outputs to this many rows so num_groups AND the
     #: results come back in ONE device_get; more groups -> slow path
-    OPTIMISTIC_GROUPS = 4096
+    OPTIMISTIC_GROUPS = 4096     # overridden per query from conf
 
     def _get_fast_kernel(self, update_k, kernel_key):
-        cached = _AGG_KERNEL_CACHE.get(("fast",) + kernel_key)
+        cached = _AGG_KERNEL_CACHE.get(
+            ("fast", self.OPTIMISTIC_GROUPS) + kernel_key)
         if cached is not None:
             return cached
         aggs, pcounts = self.aggs, self._partial_counts
@@ -462,7 +463,8 @@ class TpuHashAggregateExec(TpuExec):
         spec_cell = {}
         fast.out_specs = spec_cell
         fast.n_param_slots = getattr(update_k, "n_param_slots", None)
-        _AGG_KERNEL_CACHE[("fast",) + kernel_key] = fast
+        _AGG_KERNEL_CACHE[("fast", self.OPTIMISTIC_GROUPS)
+                          + kernel_key] = fast
         return fast
 
     def _get_fast_direct_kernel(self, g_bucket: int):
@@ -475,7 +477,8 @@ class TpuHashAggregateExec(TpuExec):
         for the groups that can exist; cardinalities themselves still ride
         in traced, so dictionary growth recompiles only on a bucket
         crossing (<=5 variants), never per new dictionary entry."""
-        key = ("fastdirect", g_bucket) + self._kernel_key
+        key = ("fastdirect", self.OPTIMISTIC_GROUPS,
+               g_bucket) + self._kernel_key
         cached = _AGG_KERNEL_CACHE.get(key)
         if cached is not None:
             return cached
@@ -652,6 +655,8 @@ class TpuHashAggregateExec(TpuExec):
         return ColumnarBatch(out_cols, n, self._schema)
 
     def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        from ..config import AGG_OPTIMISTIC_GROUPS
+        self.OPTIMISTIC_GROUPS = int(ctx.conf.get(AGG_OPTIMISTIC_GROUPS))
         self._dicts = [dict() for _ in self._dict_keys]
         self._fast_k = None
         in_schema = (self.children[0].output_schema()

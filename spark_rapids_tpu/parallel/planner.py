@@ -783,8 +783,10 @@ class _Env:
             if b is None:
                 b = int(default)
             self._bounds[key] = b
-            self._defaults = getattr(self, "_defaults", {})
-            self._defaults[key] = int(default)
+        # record on EVERY call: retries rebuild the env with pre-filled
+        # bounds, and the success-time stats write needs the default
+        self._defaults = getattr(self, "_defaults", {})
+        self._defaults[key] = int(default)
         return b
 
     def check(self, count, bound: int):

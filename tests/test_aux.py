@@ -100,3 +100,45 @@ def test_alloc_debug_logging(caplog):
         s.create_dataframe(gen_df({"a": IntGen()}, n=64)).order_by(
             F.col("a").asc()).collect_arrow()
     assert any("alloc" in r.message for r in caplog.records)
+
+
+def test_metrics_level_filters_summary():
+    """spark.rapids.tpu.sql.metrics.level plays the reference's
+    DEBUG/MODERATE/ESSENTIAL verbosity (GpuExec.scala:54)."""
+    import pyarrow as pa
+    from harness import tpu_session
+    from spark_rapids_tpu.api import functions as F
+    t = pa.table({"k": [1, 2, 1], "v": [1.0, 2.0, 3.0]})
+
+    def run(level):
+        s = tpu_session({"spark.rapids.tpu.sql.metrics.level": level})
+        s.create_dataframe(t).group_by("k").agg(
+            F.sum(F.col("v")).with_name("s")).collect()
+        ops = s.last_query_metrics["operators"]
+        return {n for m in ops.values() for n in m}
+    essential = run("ESSENTIAL")
+    debug = run("DEBUG")
+    moderate = run("MODERATE")
+    assert "numOutputRows" in essential
+    assert essential <= moderate <= debug
+    assert "opTime" in debug and "opTime" not in essential
+
+
+def test_agg_optimistic_groups_conf():
+    """Lowering the optimistic bound pushes a small-group aggregation to
+    the classic path without changing results."""
+    import numpy as np
+    import pyarrow as pa
+    from harness import assert_tpu_and_cpu_equal
+    from spark_rapids_tpu.api import functions as F
+    rng = np.random.RandomState(0)
+    t = pa.table({"k": pa.array(rng.randint(0, 50, 3000)),
+                  "v": pa.array(rng.standard_normal(3000))})
+
+    def q(s):
+        return s.create_dataframe(t).group_by("k").agg(
+            F.sum(F.col("v")).with_name("sv"),
+            F.count_star().with_name("n"))
+    assert_tpu_and_cpu_equal(
+        q, approximate_float=True,
+        conf={"spark.rapids.tpu.sql.agg.optimisticGroups": 8})
