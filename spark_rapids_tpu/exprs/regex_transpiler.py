@@ -8,13 +8,22 @@ re-emitted for the target engine, REJECTING patterns whose semantics would
 silently differ (the planner then falls back, mirroring
 GpuRegExpReplaceMeta's willNotWorkOnGpu tagging).
 
+The transpiler is TARGET-AWARE: ``target="python"`` emits for the
+stdlib `re` engine (RegExpExtract's row loop), ``target="re2"`` emits
+for pyarrow's RE2 engine (RLike / RegExpReplace / StringSplit run
+through pc.*_regex kernels). RE2 has no lookaround, no backreferences
+and no (?a) flag, but its \\b/\\w/\\d are already ASCII like Java's —
+so the two targets need different rewrites (and different rejections).
+
 Java -> Python divergences handled:
   * \\d \\w \\s (and negations) are ASCII in Java, Unicode in Python ->
-    rewritten to explicit ASCII classes
-  * \\b / \\B are ASCII in Java -> scoped (?a:...) ASCII-flag groups
+    rewritten to explicit ASCII classes (RE2: already ASCII, but the
+    explicit classes are valid there too)
+  * \\b / \\B are ASCII in Java -> scoped (?a:...) ASCII-flag groups for
+    Python; passed through verbatim for RE2 (same ASCII semantics)
   * \\Z (end before the FINAL line terminator) -> an explicit
-    lookahead over Java's terminator set; \\R (any linebreak) -> its
-    defined alternation
+    lookahead over Java's terminator set for Python; REJECTED for RE2
+    (no lookahead); \\R (any linebreak) -> its defined alternation
   * POSIX/Java ASCII named classes \\p{Alpha}/\\p{Digit}/... -> explicit
     ASCII classes; Unicode category classes (\\p{L}, \\p{Lu}, ...) ->
     reject (engine semantics differ)
@@ -42,6 +51,14 @@ _NS = "[^ \\t\\n\\x0b\\f\\r]"
 _END_Z = "(?=(?:\\r\\n|[\\n\\r\\x85\\u2028\\u2029])?\\Z)"
 #: Java \R: any unicode linebreak sequence
 _ANY_BREAK = "(?:\\r\\n|[\\n\\x0b\\f\\r\\x85\\u2028\\u2029])"
+#: RE2 spells non-BMP-ish escapes \x{...} rather than \uXXXX
+_ANY_BREAK_RE2 = "(?:\\r\\n|[\\n\\x0b\\f\\r\\x85\\x{2028}\\x{2029}])"
+#: Java line terminators (the set `.` excludes and `$`/\Z anchor before)
+_TERM_RE2 = "(?:\\r\\n|[\\n\\r\\x85\\x{2028}\\x{2029}])"
+#: Java `.` (no DOTALL) excludes ALL line terminators; Python/RE2 dot
+#: excludes only \n -> rewrite to an explicit negated class
+_DOT = "[^\\n\\r\\x85\\u2028\\u2029]"
+_DOT_RE2 = "[^\\n\\r\\x85\\x{2028}\\x{2029}]"
 
 #: POSIX/Java ASCII named classes (RegexParser.scala handles the same
 #: set); values are class BODIES (composable inside [...])
@@ -63,11 +80,32 @@ class RegexParser:
     validating structure and rewriting escapes; nesting is tracked for
     groups and classes."""
 
-    def __init__(self, pattern: str):
+    def __init__(self, pattern: str, target: str = "python",
+                 mode: str = "find"):
+        if target not in ("python", "re2"):
+            raise ValueError(f"unknown regex target {target!r}")
+        if mode not in ("find", "replace", "split"):
+            raise ValueError(f"unknown regex mode {mode!r}")
         self.p = pattern
         self.i = 0
         self.out: List[str] = []
         self.group_depth = 0
+        self.target = target
+        self.mode = mode
+        self.dotall = False
+        # A global leading flag group (?s)/(?is)... is the one scoping we
+        # can honor exactly: strip it, remember dotall, re-emit verbatim.
+        m = _re.match(r"^\(\?([ims]+)\)", self.p)
+        if m:
+            if "m" in m.group(1):
+                # Java multiline anchors recognize \r\n/\r/\x85/u2028/29;
+                # Python's and RE2's (?m) recognize only \n
+                raise RegexUnsupported(
+                    "(?m) multiline anchors have Java-specific line "
+                    "terminators")
+            self.dotall = "s" in m.group(1)
+            self.out.append(m.group(0))
+            self.i = m.end()
 
     def error(self, msg: str):
         raise RegexUnsupported(f"{msg} near position {self.i} in "
@@ -87,6 +125,14 @@ class RegexParser:
             c = self.take()
             if c == "\\":
                 self._escape(in_class=False)
+            elif c == ".":
+                if self.dotall:
+                    self.out.append(".")
+                else:
+                    self.out.append(_DOT if self.target == "python"
+                                    else _DOT_RE2)
+            elif c == "$":
+                self._dollar()
             elif c == "[":
                 self._char_class()
             elif c == "(":
@@ -101,10 +147,25 @@ class RegexParser:
         if self.group_depth != 0:
             self.error("unbalanced (")
         result = "".join(self.out)
-        try:
-            _re.compile(result)
-        except _re.error as e:
-            raise RegexUnsupported(f"transpiled pattern invalid: {e}")
+        if self.target == "python":
+            try:
+                _re.compile(result)
+            except _re.error as e:
+                raise RegexUnsupported(f"transpiled pattern invalid: {e}")
+        else:
+            # Compile-check against the actual RE2 engine: catches
+            # everything RE2 rejects that the walk above passed through
+            # (backreferences, possessive quantifiers, \uXXXX escapes,
+            # ...), at plan time instead of mid-query. One real element —
+            # pyarrow skips kernel compilation entirely on empty input.
+            import pyarrow as _pa
+            import pyarrow.compute as _pc
+            try:
+                _pc.match_substring_regex(
+                    _pa.array([""], type=_pa.string()), result)
+            except Exception as e:
+                raise RegexUnsupported(
+                    f"pattern unsupported by RE2 engine: {e}")
         return result
 
     # ------------------------------------------------------------------
@@ -133,22 +194,29 @@ class RegexParser:
         elif c == "Z":
             if in_class:
                 self.error("\\Z inside character class")
-            self.out.append(_END_Z)
+            # Java \Z == Java non-multiline $ -> shared rewrite
+            self._dollar(spelled=r"\Z")
         elif c == "R":
             if in_class:
                 self.error("\\R inside character class")
-            self.out.append(_ANY_BREAK)
+            self.out.append(_ANY_BREAK if self.target == "python"
+                            else _ANY_BREAK_RE2)
         elif c in ("G", "X"):
             self.error(f"\\{c} is not supported")
         elif c == "p" or c == "P":
             self._named_class(negated=(c == "P"), in_class=in_class)
         elif c in ("b", "B") and not in_class:
-            # Java boundaries use its ASCII \w; scope the ASCII flag
-            self.out.append(f"(?a:\\{c})")
+            if self.target == "re2":
+                # RE2's \b/\B are ASCII already — same as Java's
+                self.out.append(f"\\{c}")
+            else:
+                # Python's use its Unicode \w; scope the ASCII flag
+                self.out.append(f"(?a:\\{c})")
         elif c == "b" and in_class:
             self.error("\\b inside character class")
         elif c == "z":
-            self.out.append("\\Z")  # Java \z == Python \Z
+            # Java \z: RE2 supports \z natively; Python spells it \Z
+            self.out.append("\\z" if self.target == "re2" else "\\Z")
         elif c == "0":
             # Java octal \0nn -> Python \nnn
             digits = ""
@@ -159,6 +227,22 @@ class RegexParser:
             self.out.append("\\" + digits.zfill(3))
         else:
             self.out.append("\\" + c)
+
+    # ------------------------------------------------------------------
+    def _dollar(self, spelled: str = "$"):
+        """Java non-multiline `$` (and its synonym \\Z): matches at end
+        of input OR just before one FINAL line terminator — wider than
+        Python's (only \\n) and RE2's (end of text only)."""
+        if self.target == "python":
+            self.out.append(_END_Z)
+        elif self.mode == "find":
+            # boolean-match contexts may CONSUME the terminator: same
+            # verdict, no lookahead needed (RE2 has none)
+            self.out.append(_TERM_RE2 + "?$")
+        else:
+            # replace/split would swallow the terminator into the match
+            self.error(f"{spelled} requires lookahead in "
+                       f"{self.mode} mode (RE2 target)")
 
     # ------------------------------------------------------------------
     def _named_class(self, negated: bool, in_class: bool):
@@ -222,11 +306,15 @@ class RegexParser:
         self.out.append(self.take())  # '?'
         c = self.peek()
         if c in (":", "=", "!", ">"):
+            if self.target == "re2" and c in ("=", "!", ">"):
+                self.error(f"(?{c} lookaround/atomic group (RE2 target)")
             self.out.append(self.take())
         elif c == "<":
             self.out.append(self.take())
             n = self.peek()
             if n in ("=", "!"):
+                if self.target == "re2":
+                    self.error("lookbehind (RE2 target)")
                 self.out.append(self.take())  # lookbehind
             else:
                 # named group (?<name>...) -> Python (?P<name>...)
@@ -237,6 +325,13 @@ class RegexParser:
                 f = self.take()
                 if f in ("u", "d"):
                     self.error(f"inline flag ({f}) is not supported")
+                if f == "m":
+                    self.error("(?m) multiline anchors have "
+                               "Java-specific line terminators")
+                if f == "s":
+                    # scoped/mid-pattern DOTALL would need per-region
+                    # dot rewrites; only the global prefix is honored
+                    self.error("non-global (?s) flag is not supported")
                 self.out.append(f)
             if self.peek():
                 self.out.append(self.take())
@@ -244,11 +339,15 @@ class RegexParser:
             self.error(f"unsupported group construct (?{c}")
 
 
-def transpile_java_regex(pattern: str) -> str:
-    """Java regex -> semantically-equivalent Python regex, or raise
-    RegexUnsupported (planner turns that into a CPU... here a
+def transpile_java_regex(pattern: str, target: str = "python",
+                         mode: str = "find") -> str:
+    """Java regex -> semantically-equivalent regex for ``target``
+    ("python" = stdlib re, "re2" = pyarrow's RE2 kernels) in ``mode``
+    ("find" boolean match / "replace" / "split" — anchors rewrite
+    differently per mode, ref CudfRegexTranspiler's RegexMode), or
+    raise RegexUnsupported (planner turns that into a CPU... here a
     fallback-to-row reason, mirroring the reference)."""
-    return RegexParser(pattern).parse()
+    return RegexParser(pattern, target=target, mode=mode).parse()
 
 
 def sql_like_to_regex(pattern: str, escape: str = "\\") -> str:

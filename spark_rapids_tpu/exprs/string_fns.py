@@ -126,6 +126,27 @@ class ConcatStrings(_HostStringExpr):
             *arrs, pa.scalar("", type=target), null_handling="emit_null")
 
 
+def _transpile_with_fallback(pattern: str, mode: str):
+    """(re2_regex, py_regex): exactly one is non-None. RE2 (pyarrow's
+    vectorized kernels) is the fast path; patterns it cannot run
+    (lookaround, backrefs, mode-dependent anchors) transpile for the
+    Python-re row loop instead — the analog of the reference's CPU
+    fallback, with Java semantics restored per target."""
+    from .regex_transpiler import RegexUnsupported, transpile_java_regex
+    try:
+        return transpile_java_regex(pattern, target="re2",
+                                    mode=mode), None
+    except RegexUnsupported:
+        return None, transpile_java_regex(pattern, target="python")
+
+
+def _py_row_map(arr, fn, out_type):
+    """Per-row Python fallback over an Arrow array; nulls pass through."""
+    import pyarrow as pa
+    return pa.array([None if v is None else fn(v) for v in arr.to_pylist()],
+                    type=out_type)
+
+
 class _PatternPredicate(_HostStringExpr):
     """String->bool predicate. ``host_mask`` is the single definition of
     the match, shared by row-wise host evaluation AND the dictionary
@@ -196,12 +217,18 @@ class RLike(_PatternPredicate):
 
     def __init__(self, child, pattern: str):
         super().__init__(child, pattern)
-        from .regex_transpiler import transpile_java_regex
-        self._regex = transpile_java_regex(pattern)  # raises if unsupported
+        self._regex, self._pyregex = _transpile_with_fallback(pattern,
+                                                              "find")
 
     def host_mask(self, arr):
         import pyarrow.compute as pc
-        return pc.match_substring_regex(arr, self._regex)
+        if self._regex is not None:
+            return pc.match_substring_regex(arr, self._regex)
+        import re
+        import pyarrow as pa
+        rx = re.compile(self._pyregex)
+        return _py_row_map(arr, lambda v: rx.search(v) is not None,
+                           pa.bool_())
 
 
 class RegExpReplace(_HostStringExpr):
@@ -209,19 +236,23 @@ class RegExpReplace(_HostStringExpr):
         self.children = [child]
         self.pattern = pattern
         self.replacement = replacement
-        from .regex_transpiler import transpile_java_regex
-        self._regex = transpile_java_regex(pattern)
+        self._regex, self._pyregex = _transpile_with_fallback(pattern,
+                                                              "replace")
 
     def data_type(self, schema):
         return STRING
 
     def eval_host(self, batch):
-        import pyarrow.compute as pc
-        # Java $1 backrefs -> arrow/RE2 \1
         import re
+        arr = self.children[0].eval_host(batch)
+        # Java $1 backrefs -> \1 (same spelling in RE2 and Python re)
         repl = re.sub(r"\$(\d)", r"\\\1", self.replacement)
-        return pc.replace_substring_regex(
-            self.children[0].eval_host(batch), self._regex, repl)
+        if self._regex is not None:
+            import pyarrow.compute as pc
+            return pc.replace_substring_regex(arr, self._regex, repl)
+        import pyarrow as pa
+        rx = re.compile(self._pyregex)
+        return _py_row_map(arr, lambda v: rx.sub(repl, v), pa.string())
 
     def key(self):
         return (f"regexp_replace({self.children[0].key()},"
@@ -414,18 +445,34 @@ class StringSplit(_HostStringExpr):
         self.children = [child]
         self.pattern = pattern
         self.limit = limit
-        from .regex_transpiler import transpile_java_regex
-        self._regex = transpile_java_regex(pattern)
+        self._regex, self._pyregex = _transpile_with_fallback(pattern,
+                                                              "split")
 
     def data_type(self, schema):
         from ..types import ArrayType
         return ArrayType(STRING)
 
     def eval_host(self, batch):
-        import pyarrow.compute as pc
-        kwargs = {} if self.limit < 0 else {"max_splits": self.limit - 1}
-        return pc.split_pattern_regex(self.children[0].eval_host(batch),
-                                      self._regex, **kwargs)
+        arr = self.children[0].eval_host(batch)
+        if self._regex is not None:
+            import pyarrow.compute as pc
+            kwargs = ({} if self.limit <= 0
+                      else {"max_splits": self.limit - 1})
+            return pc.split_pattern_regex(arr, self._regex, **kwargs)
+        import re
+        import pyarrow as pa
+        rx = re.compile(self._pyregex)
+        lim = self.limit
+
+        def split_one(v):
+            # Spark limit: >0 = at most `limit` elements; <=0 =
+            # unlimited. Python re.split's maxsplit inverts the special
+            # values (0 = unlimited, negative = no splits), so the two
+            # must never be passed through directly.
+            if lim == 1:
+                return [v]                      # no splits at all
+            return rx.split(v, 0 if lim <= 0 else lim - 1)
+        return _py_row_map(arr, split_one, pa.list_(pa.string()))
 
     def key(self):
         return f"split({self.children[0].key()},{self.pattern!r})"

@@ -74,21 +74,46 @@ DISTRIBUTED_OUT_FACTOR = register(
     "exceeded bounds double and re-run.")
 
 FUSED_PIPELINE = register(
-    "spark.rapids.tpu.sql.fusedPipeline.enabled", False,
+    "spark.rapids.tpu.sql.fusedPipeline.enabled", True,
     "Single-chip queries whose plan contains a join compile the WHOLE "
     "supported fragment (scans -> filters -> joins -> aggregation) into "
-    "ONE kernel via the fragment compiler on a 1-device mesh. The right "
-    "shape for real TPU hardware (dispatch ~us, D2H ~GB/s: one launch "
-    "beats several), and OFF by default on the tunneled dev backend, "
-    "where measurement shows the fragment path's whole-array result "
-    "fetch loses to the operator pipeline's packed single-fetch "
-    "discipline (docs/performance.md). Unsupported or oversized plans "
-    "fall back to the operator pipeline either way.")
+    "ONE kernel via the fragment compiler on a 1-device mesh — one "
+    "dispatch and a two-stream packed result fetch instead of several "
+    "launches (ref GpuShuffleExchangeExecBase.scala:167: exchanges are "
+    "not opt-in). ON by default since r3: with the packed sink + "
+    "compiled-program cache the fused path measures faster than the "
+    "operator pipeline (q3 0.21 s vs 0.38 s on the tunneled v5e, "
+    "docs/performance.md). Unsupported or oversized plans fall back "
+    "to the operator pipeline either way.", commonly_used=True)
 
 #: learned speculative bounds per (fragment signature, bound key) —
 #: the cross-query statistics that let repeat queries start with tight
 #: static shapes (the fragment analog of exec/joins._TOTAL_STATS)
 _FRAGMENT_STATS: Dict[Tuple, int] = {}
+
+#: compiled SPMD programs keyed by (signature, n_dev, source layout,
+#: resolved bounds): re-running the same query shape must NOT pay the
+#: shard_map retrace + lowering again (measured ~5 s/query on the
+#: fused q3 fragment — the whole win of one-dispatch execution was
+#: being spent re-tracing it). Programs are cached only after their
+#: bounds VALIDATE (an overflowed attempt's undersized program could
+#: never match again) and the cache is entry-capped LRU — each entry
+#: pins a compiled XLA executable.
+_PROGRAM_CACHE: Dict[Tuple, List[tuple]] = {}
+_PROGRAM_LRU: Dict[Tuple, int] = {}
+_PROGRAM_TICK = [0]
+_PROGRAM_CACHE_MAX = 64
+
+
+def _program_cache_put(base_key, variant):
+    _PROGRAM_CACHE.setdefault(base_key, []).append(variant)
+    _PROGRAM_TICK[0] += 1
+    _PROGRAM_LRU[base_key] = _PROGRAM_TICK[0]
+    while sum(len(v) for v in _PROGRAM_CACHE.values()) \
+            > _PROGRAM_CACHE_MAX:
+        coldest = min(_PROGRAM_LRU, key=_PROGRAM_LRU.get)
+        del _PROGRAM_CACHE[coldest]
+        del _PROGRAM_LRU[coldest]
 
 #: per-source device-array cache (encode + pad + H2D skipped on repeat
 #: queries over the same in-memory table). Weak pin + finalizer evict on
@@ -862,30 +887,90 @@ class DistributedPipelineExec(TpuExec):
         out = self._run(ctx, tables)
         yield ColumnarBatch.from_arrow(out)
 
+    def _mesh_key(self):
+        return (tuple(str(d) for d in np.asarray(self.mesh.devices).flat),
+                tuple(self.mesh.axis_names), self.axis)
+
+    def _resolve_bound(self, key, default: int) -> int:
+        """Host-side mirror of _Env.bound()'s resolution order, used to
+        test whether a cached program's embedded bounds still apply."""
+        b = self._bounds.get(key)
+        if b is None:
+            b = _FRAGMENT_STATS.get(
+                (self.sig, self.n_dev, key, _bucket(default)))
+        return int(default) if b is None else int(b)
+
+    def _lookup_program(self, layout):
+        layout_t = tuple(sorted((i, p, nf)
+                                for i, (p, nf, _o) in layout.items()))
+        base = (self.sig, self.n_dev, self._mesh_key(), layout_t)
+        for variant in _PROGRAM_CACHE.get(base, []):
+            (fn, out_specs, check_keys, bounds_flat, bound_items) = variant
+            if all(self._resolve_bound(k, d) == r
+                   for k, d, r in bound_items):
+                _PROGRAM_TICK[0] += 1
+                _PROGRAM_LRU[base] = _PROGRAM_TICK[0]
+                return base, variant
+        return base, None
+
     def _run(self, ctx, tables):
         import jax
+        from ..columnar.packing import unpack_streams
         # deep fragments can surface undersized bounds one layer per
         # attempt (each clamped count hides the next layer's true size)
         for attempt in range(6):
             layout, inputs, dicts = self._shard_inputs(tables)
-            env = _Env(self.mesh, self.axis, self.conf, layout,
-                       self._bounds, self.sig)
-            fn, n_checks = self._build_program(env)
+            base_key, cached = self._lookup_program(layout)
+            if cached is not None:
+                # repeat query shape: skip the shard_map retrace + XLA
+                # lowering entirely (measured ~5 s on the fused q3
+                # fragment) — the compiled executable is called directly
+                (fn, out_specs, check_keys, bounds_flat,
+                 bound_items) = cached
+                self._out_specs = out_specs
+                self._check_keys = check_keys
+                defaults = {k: d for k, d, _ in bound_items}
+                for k, _d, r in bound_items:
+                    self._bounds[k] = r
+                env = None
+            else:
+                env = _Env(self.mesh, self.axis, self.conf, layout,
+                           self._bounds, self.sig)
+                fn = self._build_program(env)
             outs = fn(*inputs)
-            counts = np.asarray(jax.device_get(outs[0]))
+            variant = None
+            if env is not None:
+                # trace happened inside the call above: snapshot the
+                # program + its embedded bounds (cached below ONLY if
+                # this attempt's bounds validate)
+                bounds_flat = [b for _, b in env.checks]
+                defaults = getattr(env, "_defaults", {})
+                bound_items = [(k, defaults.get(k, 0),
+                                self._bounds.get(k, defaults.get(k, 0)))
+                               for k in self._check_keys
+                               if k in defaults or k in self._bounds]
+                variant = (fn, self._out_specs, self._check_keys,
+                           bounds_flat, bound_items)
+            # ONE device_get over the two packed streams (the operator
+            # path's fetch_packed discipline, applied to the fragment)
+            u32_all, f64_all = jax.device_get(outs)
+            u32_all = np.asarray(u32_all)
+            f64_all = np.asarray(f64_all)
+            per_dev = [unpack_streams(u32_all[i], f64_all[i],
+                                      self._out_specs)
+                       for i in range(self.n_dev)]
+            counts = np.asarray([int(p[0][0]) for p in per_dev])
             # per-device check values -> worst (max) over devices
-            check_vals = np.asarray(jax.device_get(outs[1]))
-            if check_vals.ndim == 2:
-                check_vals = check_vals.max(axis=0)
-            bounds_flat = [b for _, b in env.checks]
+            check_vals = np.stack([p[1] for p in per_dev]).max(axis=0)
             violations = [(i, int(v), b) for i, (v, b) in
                           enumerate(zip(check_vals, bounds_flat))
                           if v > b]
             if not violations:
+                if variant is not None:
+                    _program_cache_put(base_key, variant)
                 # record observed sizes so the NEXT query of this shape
                 # AND input scale starts with tight static bounds; a
                 # running max avoids thrash on varying data
-                defaults = getattr(env, "_defaults", {})
                 for i, (v, b) in enumerate(zip(check_vals, bounds_flat)):
                     ck = self._check_keys[i]
                     dflt = defaults.get(ck)
@@ -895,7 +980,7 @@ class DistributedPipelineExec(TpuExec):
                     _FRAGMENT_STATS[k] = max(
                         _FRAGMENT_STATS.get(k, 0),
                         _bucket(max(int(v) * 3 // 2, 1)))
-                return self._stitch(env, outs, counts, dicts)
+                return self._stitch_packed(per_dev, counts, dicts)
             # double every violated speculative bound and re-run (the
             # mesh-level SpeculativeOverflow retry)
             for i, v, b in violations:
@@ -1049,23 +1134,39 @@ class DistributedPipelineExec(TpuExec):
         import jax
         from jax import shard_map
         from jax.sharding import PartitionSpec as P
+        from ..columnar.packing import pack_traced
         root = self.root
         self._check_keys = None
+        self._out_specs = None
 
         def local(*inputs):
+            import jax.numpy as jnp
             env._inputs = inputs
             env.checks = []
             rel = root.emit(env).compacted(env)
-            import jax.numpy as jnp
-            outs = [rel.count.astype(jnp.int64).reshape(1)]
-            checks = [c.astype(jnp.int64).reshape(1)
-                      for c, _ in env.checks] or \
-                [jnp.zeros(1, jnp.int64)]
-            outs.append(jnp.concatenate(checks).reshape(1, -1))
+            # Sink discipline (r2 verdict #1): the fetch is sized by the
+            # RESULT, not the padded program shapes — slice every output
+            # column to a learned speculative result bound (validated
+            # like every other bound; first run uses the padded size,
+            # the recorded stat shrinks repeats), then pack everything
+            # into the engine's two-stream format (columnar/packing.py)
+            # so the whole result leaves the device in at most two
+            # transfers instead of 2×columns×devices padded fetches.
+            rb = min(env.bound(("result",), default=rel.padded),
+                     rel.padded)
+            env.check(rel.count, rb)
+            flat = [rel.count.astype(jnp.int64).reshape(1)]
+            # env.checks is never empty: the result-bound check above
+            # is always present
+            flat.append(jnp.concatenate(
+                [c.astype(jnp.int64).reshape(1) for c, _ in env.checks]))
             for d, v in rel.pairs:
-                outs.append(d.reshape(1, rel.padded))
-                outs.append(v.reshape(1, rel.padded))
-            return tuple(outs)
+                flat.append(d[:rb])
+                flat.append(v[:rb])
+            self._out_specs = [(np.dtype(str(x.dtype)), tuple(x.shape))
+                               for x in flat]
+            u32, f64 = pack_traced(flat)
+            return u32.reshape(1, -1), f64.reshape(1, -1)
 
         # specs: replicated sources P(), sharded P(axis)
         in_specs = []
@@ -1081,7 +1182,7 @@ class DistributedPipelineExec(TpuExec):
         jit_fn = jax.jit(fn)
         # bind check keys in emit order: do a lightweight bound-key pass
         self._check_keys = self._collect_check_keys(env)
-        return jit_fn, len(self._check_keys)
+        return jit_fn
 
     def _collect_check_keys(self, env: _Env):
         """Deterministic (emit-order) keys for the overflow checks —
@@ -1108,28 +1209,28 @@ class DistributedPipelineExec(TpuExec):
                 if not (env.n_dev == 1 or frag.replicated):
                     keys.append(("agg", frag.frag_id))
         walk(self.root)
+        keys.append(("result",))    # the sink's result-bound check
         return keys
 
     # -----------------------------------------------------------------------
-    def _stitch(self, env: _Env, outs, counts, dicts):
-        import jax
+    def _stitch_packed(self, per_dev, counts, dicts):
         import pyarrow as pa
         from ..columnar.column import arrow_from_numpy
-        n_dev = env.n_dev
+        n_dev = self.n_dev
         root = self.root
         take_first_only = root.replicated
-        data = [np.asarray(jax.device_get(x)) for x in outs[2:]]
         arrays = []
         for ci, (f, lf) in enumerate(zip(self._schema.fields, root.fields)):
-            d_all, v_all = data[2 * ci], data[2 * ci + 1]
             parts_d, parts_v = [], []
             devs = [0] if take_first_only else range(n_dev)
             for dev in devs:
                 g = int(counts[dev])
-                parts_d.append(d_all[dev][:g])
-                parts_v.append(v_all[dev][:g])
-            dv = np.concatenate(parts_d) if parts_d else d_all[0][:0]
-            vv = np.concatenate(parts_v) if parts_v else v_all[0][:0]
+                parts_d.append(per_dev[dev][2 + 2 * ci][:g])
+                parts_v.append(per_dev[dev][3 + 2 * ci][:g])
+            dv = np.concatenate(parts_d) if parts_d \
+                else per_dev[0][2 + 2 * ci][:0]
+            vv = np.concatenate(parts_v) if parts_v \
+                else per_dev[0][3 + 2 * ci][:0]
             if lf.dict_id is not None:
                 uniq = dicts.get(lf.dict_id, np.asarray([], object))
                 if len(uniq):

@@ -153,7 +153,12 @@ def _hash_partition(table, exprs, n_parts: int):
             hv = np.asarray([zlib.crc32(str(x).encode()) for x in v],
                             dtype=np.uint64)
         elif np.issubdtype(v.dtype, np.floating):
-            hv = v.astype(np.float64).view(np.uint64)
+            # normalize before hashing (advisor r2): -0.0 == 0.0 must
+            # route together, and all NaN payloads are one group — raw
+            # bit patterns would split them across reduce partitions
+            f = v.astype(np.float64) + 0.0          # -0.0 -> +0.0
+            f = np.where(np.isnan(f), np.nan, f)    # canonical NaN
+            hv = f.view(np.uint64)
         else:
             hv = v.astype(np.int64).view(np.uint64)
         h = h * np.uint64(31) + np.where(ok, hv, np.uint64(7))

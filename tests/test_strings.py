@@ -123,7 +123,12 @@ class TestRegexTranspiler:
 
     def test_passthrough(self):
         assert transpile_java_regex("a(b|c)*d") == "a(b|c)*d"
-        assert transpile_java_regex("^x{2,3}$") == "^x{2,3}$"
+        # `$` is NOT passthrough: Java's matches before a final
+        # line terminator (r3 fix)
+        import re as _re
+        p = transpile_java_regex("^x{2,3}$")
+        assert _re.search(p, "xx\n") and _re.search(p, "xxx")
+        assert not _re.search(p, "xx\ny") and not _re.search(p, "x")
 
     def test_named_group(self):
         assert transpile_java_regex("(?<nm>a)") == "(?P<nm>a)"
@@ -273,3 +278,132 @@ class TestRegexTranspilerR2:
         out = df.filter(F.rlike(F.col("s"), "\\p{Alpha}+\\p{Digit}")) \
             .to_pandas()
         assert sorted(out["s"]) == ["ABC2", "abc1"]
+
+
+class TestRegexTargets:
+    """The transpiler emits per-target syntax: RLike/RegExpReplace/
+    StringSplit execute on pyarrow's RE2 engine (no lookaround, ASCII
+    \\b already), RegExpExtract on Python re. These route boundary and
+    anchor patterns END TO END through each engine (advisor r2 high)."""
+
+    def test_rlike_word_boundary_end_to_end(self):
+        s = tpu_session()
+        df = s.create_dataframe(pd.DataFrame(
+            {"s": ["a word here", "sword", "word", None]}))
+        out = df.filter(F.rlike(F.col("s"), "\\bword\\b")).to_pandas()
+        assert sorted(out["s"]) == ["a word here", "word"]
+
+    def test_regexp_replace_word_boundary_end_to_end(self):
+        assert _run(F.regexp_replace(F.col("s"), "\\bWorld\\b", "X")) == \
+            _pyexpect(lambda v: v.replace("World", "X"))
+
+    def test_rlike_end_anchor_Z_java_semantics(self):
+        # Java \Z matches before one FINAL line terminator; in boolean
+        # find mode the RE2 rewrite may consume it (same verdict)
+        s = tpu_session()
+        df = s.create_dataframe(pd.DataFrame(
+            {"s": ["x", "x\n", "x\r\n", "x\n\n", "x\ny"]}))
+        out = df.filter(F.rlike(F.col("s"), "x\\Z")).to_pandas()
+        assert sorted(out["s"]) == ["x", "x\n", "x\r\n"]
+
+    def test_rlike_dollar_java_semantics(self):
+        # Java non-multiline $ == \Z (r3 review finding: RE2 $ is
+        # end-of-text only, silently dropping the "x\n" row before)
+        s = tpu_session()
+        df = s.create_dataframe(pd.DataFrame(
+            {"s": ["x", "x\n", "x\r", "x\n\n", "xy"]}))
+        out = df.filter(F.rlike(F.col("s"), "x$")).to_pandas()
+        assert sorted(out["s"]) == ["x", "x\n", "x\r"]
+
+    def test_regexp_replace_dollar_keeps_terminator(self):
+        # replace mode must NOT consume the final \n -> falls back to
+        # the Python-re row loop where the lookahead rewrite applies
+        s = tpu_session()
+        df = s.create_dataframe(pd.DataFrame({"s": ["ax\n", "ax", "ay"]}))
+        out = df.select(
+            F.regexp_replace(F.col("s"), "x$", "Z").alias("r")
+        ).to_pandas()["r"].tolist()
+        assert out == ["aZ\n", "aZ", "ay"]
+
+    def test_dot_excludes_java_line_terminators(self):
+        # Java `.` excludes \r \x85    , not just \n
+        s = tpu_session()
+        df = s.create_dataframe(pd.DataFrame(
+            {"s": ["a\rb", "a\nb", "a\x85b", "axb"]}))
+        out = df.filter(F.rlike(F.col("s"), "a.b")).to_pandas()
+        assert sorted(out["s"]) == ["axb"]
+        # (?s) global prefix restores match-anything dot
+        out = df.filter(F.rlike(F.col("s"), "(?s)a.b")).to_pandas()
+        assert len(out) == 4
+
+    def test_multiline_flag_rejected(self):
+        with pytest.raises(RegexUnsupported):
+            transpile_java_regex("(?m)^x$")
+        with pytest.raises(RegexUnsupported):
+            transpile_java_regex("a(?m:x$)b", target="re2")
+
+    def test_rlike_lookaround_falls_back_to_python_engine(self):
+        # RE2 can't run lookarounds; RLike transparently row-loops
+        s = tpu_session()
+        df = s.create_dataframe(pd.DataFrame(
+            {"s": ["price: 10", "price: 9", None]}))
+        out = df.filter(F.rlike(F.col("s"), "price: (?=1)\\d+")) \
+            .to_pandas()
+        assert out["s"].tolist() == ["price: 10"]
+
+    def test_rlike_java_z_anchor(self):
+        s = tpu_session()
+        df = s.create_dataframe(pd.DataFrame({"s": ["x", "x\n", "ax"]}))
+        out = df.filter(F.rlike(F.col("s"), "x\\z")).to_pandas()
+        assert sorted(out["s"]) == ["ax", "x"]
+
+    def test_regexp_extract_keeps_python_target(self):
+        # extract runs on Python re, where \Z/\b rewrites still apply
+        out = _run(F.regexp_extract(F.col("s"), "(\\w+)\\Z", 1))
+        import re
+        exp = []
+        for v in DATA:
+            if v is None:
+                exp.append(None)
+            else:
+                m = re.search(r"(?a:(\w+))(?=\n?\Z)", v)
+                exp.append("" if m is None else m.group(1))
+        assert out == exp
+
+    def test_re2_rejections_are_plan_time(self):
+        for pat in ["(?=x)y", "(?<=x)y", "(?>xy)", "(x)\\1"]:
+            with pytest.raises(RegexUnsupported):
+                transpile_java_regex(pat, target="re2")
+        # ...but python target keeps lookarounds
+        assert transpile_java_regex("(?=x)y") == "(?=x)y"
+
+    def test_linebreak_R_both_targets(self):
+        s = tpu_session()
+        df = s.create_dataframe(pd.DataFrame(
+            {"s": ["a\nb", "a\r\nb", "a b", "ab"]}))
+        out = df.filter(F.rlike(F.col("s"), "a\\Rb")).to_pandas()
+        assert len(out) == 3
+
+
+def test_split_limit_semantics_both_engines():
+    """Spark limit: >0 = at most limit elements, <=0 = unlimited.
+    Python re.split inverts the special maxsplit values (r3 review
+    finding) — pin both the RE2 path and the lookahead-forced
+    Python-re fallback."""
+    s = tpu_session()
+    df = s.create_dataframe(pd.DataFrame({"s": ["a:1b:2c:3d"]}))
+
+    def run(pat, lim):
+        return _df_split(df, pat, lim)
+
+    def _df_split(df, pat, lim):
+        out = df.select(
+            F.split(F.col("s"), pat, lim).alias("r")).to_pandas()
+        return list(out["r"][0])
+
+    for pat in [":", ":(?=\\d)"]:        # RE2 path / python fallback
+        assert _df_split(df, pat, -1) == ["a", "1b", "2c", "3d"]
+        assert _df_split(df, pat, 0) == ["a", "1b", "2c", "3d"]
+        assert _df_split(df, pat, 1) == ["a:1b:2c:3d"]
+        assert _df_split(df, pat, 2) == ["a", "1b:2c:3d"]
+        assert _df_split(df, pat, 3) == ["a", "1b", "2c:3d"]
