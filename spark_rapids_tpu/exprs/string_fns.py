@@ -452,26 +452,47 @@ class StringSplit(_HostStringExpr):
         from ..types import ArrayType
         return ArrayType(STRING)
 
+    @staticmethod
+    def _strip_trailing_empties(list_arr):
+        """Spark/Java limit=0: unlimited splits, then trailing empty
+        strings removed (Pattern.split)."""
+        import pyarrow as pa
+        out = []
+        for parts in list_arr.to_pylist():
+            if parts is None:
+                out.append(None)
+                continue
+            while parts and parts[-1] == "":
+                parts.pop()
+            out.append(parts)
+        return pa.array(out, type=pa.list_(pa.string()))
+
     def eval_host(self, batch):
+        import pyarrow as pa
         arr = self.children[0].eval_host(batch)
+        lim = self.limit
         if self._regex is not None:
             import pyarrow.compute as pc
-            kwargs = ({} if self.limit <= 0
-                      else {"max_splits": self.limit - 1})
-            return pc.split_pattern_regex(arr, self._regex, **kwargs)
+            kwargs = {} if lim <= 0 else {"max_splits": lim - 1}
+            split = pc.split_pattern_regex(arr, self._regex, **kwargs)
+            return self._strip_trailing_empties(split) if lim == 0 \
+                else split
         import re
-        import pyarrow as pa
         rx = re.compile(self._pyregex)
-        lim = self.limit
 
         def split_one(v):
-            # Spark limit: >0 = at most `limit` elements; <=0 =
-            # unlimited. Python re.split's maxsplit inverts the special
-            # values (0 = unlimited, negative = no splits), so the two
-            # must never be passed through directly.
+            # Spark limit (Java Pattern.split): >0 = at most `limit`
+            # elements; 0 = unlimited + trailing empties removed; <0 =
+            # unlimited keeping them. Python re.split's maxsplit inverts
+            # the special values (0 = unlimited, negative = no splits),
+            # so neither passes through directly.
             if lim == 1:
                 return [v]                      # no splits at all
-            return rx.split(v, 0 if lim <= 0 else lim - 1)
+            parts = rx.split(v, 0 if lim <= 0 else lim - 1)
+            if lim == 0:
+                while parts and parts[-1] == "":
+                    parts.pop()
+            return parts
         return _py_row_map(arr, split_one, pa.list_(pa.string()))
 
     def key(self):

@@ -69,6 +69,56 @@ class ParquetScanExec(FileScanBase):
             t = t.select(self.columns)  # requested order, not file order
         return t
 
+    def collect_row_group_shards(self, n_shards: int):
+        """Row-group-partitioned read for the distributed planner (ref
+        GpuMultiFileReader.scala:295 / GpuParquetScan row-group task
+        assignment): (path, row-group) units greedy-pack into ``n_shards``
+        bins by row count, each bin read independently — on a multi-host
+        deployment each host reads only its bin. Returns a list of
+        ``n_shards`` Arrow tables (possibly empty) or None when the
+        format prevents per-group assignment."""
+        import pyarrow.parquet as pq
+        try:
+            units = []            # (rows, path, group_idx)
+            files = {}
+            for path in self.paths:
+                f = pq.ParquetFile(self._cached_path(path))
+                files[path] = f
+                groups = self._filter_row_groups(f)
+                if groups is None:
+                    groups = range(f.metadata.num_row_groups)
+                for g in groups:
+                    units.append((f.metadata.row_group(g).num_rows,
+                                  path, g))
+        except Exception:
+            return None
+        bins = [[] for _ in range(n_shards)]
+        fill = [0] * n_shards
+        for rows, path, g in sorted(units, reverse=True):
+            i = fill.index(min(fill))
+            bins[i].append((path, g))
+            fill[i] += rows
+        out = []
+        empty = files[self.paths[0]].schema_arrow.empty_table() \
+            if self.paths else None
+        for b in bins:
+            if not b:
+                t = empty
+            else:
+                import pyarrow as pa
+                parts = []
+                by_path: dict = {}
+                for path, g in b:
+                    by_path.setdefault(path, []).append(g)
+                for path, gs in by_path.items():
+                    parts.append(files[path].read_row_groups(
+                        sorted(gs), columns=self.columns))
+                t = pa.concat_tables(parts) if len(parts) > 1 else parts[0]
+            if self.columns and t is not None:
+                t = t.select(self.columns)
+            out.append(t)
+        return out
+
     def _filter_row_groups(self, f) -> Optional[List[int]]:
         """Row-group pruning from parquet min/max statistics
         (ref GpuParquetScan filterBlocks:670)."""

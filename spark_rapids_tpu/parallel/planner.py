@@ -306,6 +306,58 @@ class _LocalFrag(_Frag):
         return _Rel(pairs, rel.count, rel.padded, keep)
 
 
+def _key_hash_rel(env, rel: _Rel, fields, key_exprs, key_np):
+    import jax.numpy as jnp
+    from ..exprs.base import DVal, EvalContext
+    from .collective import _col_hash_u32, _mix32
+    schema = _phys_schema(fields)
+    dvals = [DVal(d, v, f.phys)
+             for (d, v), f in zip(rel.pairs, fields)]
+    ctx = EvalContext(schema, dvals, rel.count, rel.padded)
+    h = jnp.full(rel.padded, jnp.uint32(42))
+    for i, e in enumerate(key_exprs):
+        k = e.eval_device(ctx)
+        npdt = key_np[i] if key_np is not None else k.data.dtype
+        kk = DVal(k.data.astype(npdt), k.validity, k.dtype)
+        h = _mix32(h * jnp.uint32(31) + _col_hash_u32(kk))
+    return h
+
+
+def _route_rel(env, rel: _Rel, fields, key_exprs, key_np, bound_key):
+    """Hash-route live rows to their key-owner device with one
+    all_to_all (the exchange shared by routed joins, aggs, and windows —
+    ref GpuShuffleExchangeExecBase.prepareBatchShuffleDependency:277)."""
+    import jax
+    import jax.numpy as jnp
+    from .collective import _compact_rows, _route_to_buffers
+    n_dev = env.n_dev
+    rel = rel.compacted(env)
+    if n_dev == 1:
+        return rel
+    P_ = rel.padded
+    h = _key_hash_rel(env, rel, fields, key_exprs, key_np)
+    live = rel.live_mask(env)
+    pid = jnp.where(live, (h % jnp.uint32(n_dev)).astype(jnp.int32),
+                    jnp.int32(n_dev))
+    flat = list(rel.pairs) + [(jnp.ones(P_, jnp.int8), live)]
+    bufs = _route_to_buffers(flat, pid, P_, n_dev)
+    recv = []
+    for d, v in bufs:
+        rd = jax.lax.all_to_all(d, env.axis, 0, 0, tiled=False)
+        rv = jax.lax.all_to_all(v, env.axis, 0, 0, tiled=False)
+        recv.append((rd.reshape(n_dev * P_), rv.reshape(n_dev * P_)))
+    live_recv = recv[-1][1]
+    comp, cnt = _compact_rows(recv[:-1], live_recv, n_dev * P_)
+    # received rows are speculatively re-bounded (hash balance makes
+    # ~P_ the expectation; worst case n_dev*P_) — validated at the sink
+    rb = min(env.bound(bound_key,
+                       default=min(n_dev * P_, _bucket(2 * P_))),
+             n_dev * P_)
+    env.check(cnt, rb)
+    comp = [(d[:rb], v[:rb]) for d, v in comp]
+    return _Rel(comp, cnt, rb)
+
+
 class _JoinFrag(_Frag):
     """Equi-join. ``routed``: both sides hash-route rows to key owners with
     one all_to_all each, then each device joins its co-partitioned slice
@@ -314,7 +366,8 @@ class _JoinFrag(_Frag):
     (GpuBroadcastHashJoinExecBase analog)."""
 
     def __init__(self, frag_id: int, left: _Frag, right: _Frag,
-                 lkeys, rkeys, join_type: str, broadcast_build: bool):
+                 lkeys, rkeys, join_type: str, broadcast_build: bool,
+                 condition=None):
         self.frag_id = frag_id
         self.left = left
         self.right = right
@@ -322,63 +375,20 @@ class _JoinFrag(_Frag):
         self.rkeys = list(rkeys)
         self.join_type = join_type
         self.broadcast_build = broadcast_build
+        #: residual non-equi condition (inner joins only: there it is
+        #: exactly a post-join filter — ref GpuHashJoin compiled AST
+        #: conditions)
+        self.condition = condition
         self.fields = list(left.fields) + list(right.fields)
         self.replicated = left.replicated and right.replicated
 
     def signature(self) -> str:
         lk = ",".join(e.key() for e in self.lkeys)
         rk = ",".join(e.key() for e in self.rkeys)
+        cond = self.condition.key() if self.condition is not None else ""
         return (f"join{self.frag_id}[{self.join_type};{int(self.broadcast_build)};"
-                f"{lk};{rk}]({self.left.signature()},"
+                f"{lk};{rk};{cond}]({self.left.signature()},"
                 f"{self.right.signature()})")
-
-    # -- routing ------------------------------------------------------------
-    def _key_hash(self, env, rel: _Rel, frag: _Frag, key_exprs, key_np):
-        import jax.numpy as jnp
-        from ..exprs.base import DVal, EvalContext
-        from .collective import _col_hash_u32, _mix32
-        schema = _phys_schema(frag.fields)
-        dvals = [DVal(d, v, f.phys)
-                 for (d, v), f in zip(rel.pairs, frag.fields)]
-        ctx = EvalContext(schema, dvals, rel.count, rel.padded)
-        h = jnp.full(rel.padded, jnp.uint32(42))
-        for e, npdt in zip(key_exprs, key_np):
-            k = e.eval_device(ctx)
-            kk = DVal(k.data.astype(npdt), k.validity, k.dtype)
-            h = _mix32(h * jnp.uint32(31) + _col_hash_u32(kk))
-        return h
-
-    def _route(self, env, rel: _Rel, frag: _Frag, key_exprs, key_np) -> _Rel:
-        import jax
-        import jax.numpy as jnp
-        from .collective import _compact_rows, _route_to_buffers
-        n_dev = env.n_dev
-        rel = rel.compacted(env)
-        if n_dev == 1:
-            return rel
-        P_ = rel.padded
-        h = self._key_hash(env, rel, frag, key_exprs, key_np)
-        live = rel.live_mask(env)
-        pid = jnp.where(live, (h % jnp.uint32(n_dev)).astype(jnp.int32),
-                        jnp.int32(n_dev))
-        flat = list(rel.pairs) + [(jnp.ones(P_, jnp.int8), live)]
-        bufs = _route_to_buffers(flat, pid, P_, n_dev)
-        recv = []
-        for d, v in bufs:
-            rd = jax.lax.all_to_all(d, env.axis, 0, 0, tiled=False)
-            rv = jax.lax.all_to_all(v, env.axis, 0, 0, tiled=False)
-            recv.append((rd.reshape(n_dev * P_), rv.reshape(n_dev * P_)))
-        live_recv = recv[-1][1]
-        comp, cnt = _compact_rows(recv[:-1], live_recv, n_dev * P_)
-        # received rows are speculatively re-bounded (hash balance makes
-        # ~P_ the expectation; worst case n_dev*P_) — validated at the sink
-        rb = min(env.bound(("recv", self.frag_id,
-                            id(frag) == id(self.right)),
-                           default=min(n_dev * P_, _bucket(2 * P_))),
-                 n_dev * P_)
-        env.check(cnt, rb)
-        comp = [(d[:rb], v[:rb]) for d, v in comp]
-        return _Rel(comp, cnt, rb)
 
     def emit(self, env) -> _Rel:
         import jax.numpy as jnp
@@ -394,8 +404,10 @@ class _JoinFrag(_Frag):
             lrel = lrel.compacted(env)
             rrel = rrel.compacted(env)
         else:
-            lrel = self._route(env, lrel, self.left, self.lkeys, key_np)
-            rrel = self._route(env, rrel, self.right, self.rkeys, key_np)
+            lrel = _route_rel(env, lrel, self.left.fields, self.lkeys,
+                              key_np, ("recv", self.frag_id, False))
+            rrel = _route_rel(env, rrel, self.right.fields, self.rkeys,
+                              key_np, ("recv", self.frag_id, True))
         count_k = _build_count_kernel(self.lkeys, self.rkeys,
                                       lschema, rschema, self.join_type)
         (s_orig, cnt_l, cnt_r, start_l, start_r, _pairs, offsets, total,
@@ -427,7 +439,63 @@ class _JoinFrag(_Frag):
                           jnp.logical_and(
                               jnp.take(v, idx, mode="clip"),
                               jnp.logical_and(out_live, r_row >= 0))))
-        return _Rel(pairs, total, out)
+        if self.condition is None:
+            return _Rel(pairs, total, out)
+        # inner-join residual condition == post-join filter: evaluate
+        # over the gathered pair columns, pending rows carry a keep mask
+        from ..exprs.base import DVal, EvalContext
+        schema = _phys_schema(self.fields)
+        dvals = [DVal(d, v, f.phys)
+                 for (d, v), f in zip(pairs, self.fields)]
+        ctx = EvalContext(schema, dvals, total, out)
+        c = self.condition.eval_device(ctx)
+        # seed with liveness: a condition whose validity is constant-true
+        # (e.g. null-safe equality) must not resurrect padding rows
+        keep = jnp.logical_and(jnp.logical_and(c.data, c.validity),
+                               out_live)
+        return _Rel(pairs, total, out, keep)
+
+
+class _WindowFrag(_Frag):
+    """Window functions on the mesh: rows hash-route to the device owning
+    their PARTITION (one all_to_all), then each device runs the engine's
+    window kernel over its complete partitions — the distributed analog of
+    window/GpuWindowExec.scala:146 downstream of a hash exchange."""
+
+    def __init__(self, frag_id: int, child: _Frag, window_exprs,
+                 fields: List[_Field]):
+        self.frag_id = frag_id
+        self.child = child
+        self.window_exprs = list(window_exprs)
+        self.fields = fields
+        self.replicated = child.replicated
+        self._kern = None
+
+    def signature(self) -> str:
+        ws = ",".join(f"{type(e).__name__}|{n}"
+                      for e, _s, n in self.window_exprs)
+        return f"win{self.frag_id}[{ws}]({self.child.signature()})"
+
+    def emit(self, env) -> _Rel:
+        import jax.numpy as jnp
+        from ..exec.window import _build_window_kernel
+        rel = self.child.emit(env)
+        part_keys = []
+        for _fn, spec, _n in self.window_exprs:
+            part_keys = list(spec.partition_by)
+            break
+        if env.n_dev == 1 or self.replicated:
+            rel = rel.compacted(env)
+        else:
+            rel = _route_rel(env, rel, self.child.fields, part_keys,
+                             None, ("win", self.frag_id))
+        if self._kern is None:
+            self._kern = _build_window_kernel(
+                self.window_exprs, _phys_schema(self.child.fields))
+        cols = [(d, v) for d, v in rel.pairs]
+        outs = self._kern(cols, rel.count.astype(jnp.int32), rel.padded)
+        pairs = list(rel.pairs) + [(d, v) for d, v in outs]
+        return _Rel(pairs, rel.count, rel.padded)
 
 
 class _AggFrag(_Frag):
@@ -643,8 +711,6 @@ class _Planner:
                                        out_fields)], out_fields)
 
         if isinstance(node, TpuBroadcastHashJoinExec):
-            if node.condition is not None:
-                raise _NotLowerable("join condition")
             if node.join_type not in ("inner", "left", "right", "full",
                                       "leftsemi", "leftanti"):
                 raise _NotLowerable(f"join type {node.join_type}")
@@ -661,8 +727,6 @@ class _Planner:
             return self._make_join(node, left, right, broadcast=True)
 
         if isinstance(node, TpuHashJoinExec):
-            if node.condition is not None:
-                raise _NotLowerable("join condition")
             left = self.lower(node.children[0], replicated)
             right = self.lower(node.children[1], replicated)
             return self._make_join(node, left, right, broadcast=False)
@@ -670,14 +734,65 @@ class _Planner:
         if isinstance(node, TpuHashAggregateExec):
             return self._lower_agg(node, replicated)
 
+        from ..exec.window import TpuWindowExec
+        if isinstance(node, TpuWindowExec):
+            return self._lower_window(node, replicated)
+
         # anything else becomes a host-executed source (scans always do)
         return self.source(node, replicated)
+
+    def _lower_window(self, node, replicated: bool) -> _Frag:
+        from ..exprs.window_fns import (DenseRank, Lag, Lead, NTile, Rank,
+                                        RowNumber)
+        from ..exprs.aggregates import AggregateExpression
+        child = self.lower(node.children[0], replicated)
+        part_sig = None
+        for fn, spec, _name in node.window_exprs:
+            if not isinstance(fn, (RowNumber, Rank, DenseRank, NTile, Lag,
+                                   Lead, AggregateExpression)):
+                raise _NotLowerable(f"window fn {type(fn).__name__}")
+            # all exprs must share ONE partitioning: the routing
+            # co-locates partitions for exactly one key set
+            sig = tuple(k.key() for k in spec.partition_by)
+            if part_sig is None:
+                part_sig = sig
+            elif sig != part_sig:
+                raise _NotLowerable("window exprs with mixed partitioning")
+            for k in spec.partition_by:
+                pf = self._passthrough_field(k, child)
+                if pf is None and not self._expr_ok(k, child):
+                    raise _NotLowerable("window partition key")
+            for o in spec.order_by:
+                pf = self._passthrough_field(o.expr, child)
+                if pf is None and not self._expr_ok(o.expr, child):
+                    raise _NotLowerable("window order key")
+            fchild = getattr(fn, "child", None)
+            if fchild is not None and not self._expr_ok(fchild, child):
+                raise _NotLowerable("window value expression")
+        cs = node.children[0].output_schema()
+        out_fields = list(child.fields)
+        for fn, _spec, name in node.window_exprs:
+            dt = fn.data_type(cs)
+            out_fields.append(_Field(name, dt, dt))
+        self.has_comm = True
+        return _WindowFrag(self.frag_id(), child, node.window_exprs,
+                           out_fields)
 
     def _make_join(self, node, left: _Frag, right: _Frag,
                    broadcast: bool) -> _Frag:
         if node.join_type not in ("inner", "left", "right", "full",
                                   "leftsemi", "leftanti"):
             raise _NotLowerable(f"join type {node.join_type}")
+        condition = getattr(node, "condition", None)
+        if condition is not None:
+            # only for INNER joins is the ON-condition equivalent to a
+            # post-join filter; outer joins would change match semantics
+            if node.join_type != "inner":
+                raise _NotLowerable(
+                    f"join condition on {node.join_type} join")
+            if not self._expr_ok_f(condition,
+                                   list(left.fields) + list(right.fields)):
+                raise _NotLowerable("join condition not device-evaluable")
         from ..config import JOIN_BLOOM_FILTER
         if self.fused_mode and self.conf.get(JOIN_BLOOM_FILTER):
             # the runtime bloom filter is an operator-path optimization;
@@ -711,7 +826,8 @@ class _Planner:
         self.has_comm = True
         self.has_join = True
         frag = _JoinFrag(self.frag_id(), left, right, node.left_keys,
-                         node.right_keys, node.join_type, broadcast)
+                         node.right_keys, node.join_type, broadcast,
+                         condition=condition)
         # semi/anti joins emit probe-side fields only
         if node.join_type in ("leftsemi", "leftanti"):
             frag.fields = list(left.fields)
@@ -831,6 +947,52 @@ class _BoundOverflow(Exception):
         self.violations = violations
 
 
+def _one_chunk(col):
+    import pyarrow as pa
+    if isinstance(col, pa.ChunkedArray):
+        return col.combine_chunks() if col.num_chunks != 1 else col.chunk(0)
+    return col
+
+
+def _encode_plain(col, phys):
+    """Arrow column -> (data, validity) numpy pair with the same
+    arrow->device casts as ColumnarBatch.from_arrow."""
+    import pyarrow as pa
+    import pyarrow.compute as pc
+    from ..columnar.column import DeviceColumn
+    arr = col
+    if pa.types.is_date32(arr.type):
+        arr = arr.cast(pa.int32())
+    elif pa.types.is_timestamp(arr.type):
+        arr = arr.cast(pa.int64())
+    elif pa.types.is_decimal(arr.type):
+        arr = pc.multiply_checked(
+            arr.cast(pa.decimal128(38, arr.type.scale)),
+            10 ** arr.type.scale).cast(pa.int64())
+    mask = ~np.asarray(col.is_null())
+    fill = False if pa.types.is_boolean(arr.type) else 0
+    vals = arr.fill_null(fill).to_numpy(zero_copy_only=False)
+    return DeviceColumn.host_prepare(vals, phys, mask=mask)
+
+
+def _strings_of(col):
+    valid = ~np.asarray(col.is_null())
+    strs = np.asarray(col.fill_null("").to_pylist(), dtype=object)
+    return strs, valid
+
+
+class _ShardedTables:
+    """Per-device pre-sharded source tables (row-group-partitioned scan):
+    shard i's table goes to device i verbatim — no driver-side concat or
+    re-slice."""
+
+    def __init__(self, shards):
+        self.shards = list(shards)
+
+    def rows_per_shard(self):
+        return [t.num_rows for t in self.shards]
+
+
 class DistributedPipelineExec(TpuExec):
     """Physical operator executing a plan fragment as ONE SPMD program over
     the session mesh (see module docstring). Appears in explain() where the
@@ -877,9 +1039,21 @@ class DistributedPipelineExec(TpuExec):
                         sum(t.num_rows for t in s.tables) > max_rows:
                     yield from self.fallback.execute(ctx)
                     return
-        tables = [s._collect_tables(ctx) for s, _ in self.sources]
+        tables = []
+        for s, replicated in self.sources:
+            shards = None
+            if not replicated:
+                from ..io.parquet import ParquetScanExec
+                if isinstance(s, ParquetScanExec):
+                    # row-group-partitioned scan: each shard reads only
+                    # its assigned groups (VERDICT r2 #3; ref
+                    # GpuMultiFileReader.scala:295)
+                    shards = s.collect_row_group_shards(self.n_dev)
+            tables.append(_ShardedTables(shards) if shards is not None
+                          else s._collect_tables(ctx))
         if self.fallback is not None and any(
-                t.num_rows > max_rows for t in tables):
+                (max(t.rows_per_shard()) if isinstance(t, _ShardedTables)
+                 else t.num_rows) > max_rows for t in tables):
             # non-scan source turned out oversized: the sources ran
             # twice on this rare path — documented cost of the late check
             yield from self.fallback.execute(ctx)
@@ -1027,6 +1201,8 @@ class DistributedPipelineExec(TpuExec):
         return layout, flat, dicts
 
     def _put_source(self, table, replicated: bool, frag_fields):
+        if isinstance(table, _ShardedTables):
+            return self._put_source_shards(table.shards, frag_fields)
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -1083,7 +1259,7 @@ class DistributedPipelineExec(TpuExec):
             elif isinstance(frag, _JoinFrag):
                 walk(frag.left)
                 walk(frag.right)
-            elif isinstance(frag, (_LocalFrag, _AggFrag)):
+            elif isinstance(frag, (_LocalFrag, _AggFrag, _WindowFrag)):
                 walk(frag.child)
         walk(self.root)
         out.sort()
@@ -1092,18 +1268,11 @@ class DistributedPipelineExec(TpuExec):
     def _encode_columns(self, table, fields: List[_Field], dicts):
         """numpy (data, validity) per field; strings -> GLOBAL sorted
         dictionary codes (code order == string order on every device)."""
-        import pyarrow as pa
-        import pyarrow.compute as pc
-        from ..columnar.column import DeviceColumn
         arrays = []
         for f, col in zip(fields, table.columns):
-            if isinstance(col, pa.ChunkedArray):
-                col = col.combine_chunks() if col.num_chunks != 1 \
-                    else col.chunk(0)
+            col = _one_chunk(col)
             if f.dict_id is not None:
-                valid = ~np.asarray(col.is_null())
-                strs = np.asarray(col.fill_null("").to_pylist(),
-                                  dtype=object)
+                strs, valid = _strings_of(col)
                 uniq = np.unique(strs[valid]) if valid.any() \
                     else np.asarray([], dtype=object)
                 codes = np.searchsorted(uniq, strs).astype(np.int32) \
@@ -1112,22 +1281,60 @@ class DistributedPipelineExec(TpuExec):
                 dicts[f.dict_id] = uniq
                 arrays.append((codes, valid))
             else:
-                # same arrow->device casts as ColumnarBatch.from_arrow
-                arr = col
-                if pa.types.is_date32(arr.type):
-                    arr = arr.cast(pa.int32())
-                elif pa.types.is_timestamp(arr.type):
-                    arr = arr.cast(pa.int64())
-                elif pa.types.is_decimal(arr.type):
-                    arr = pc.multiply_checked(
-                        arr.cast(pa.decimal128(38, arr.type.scale)),
-                        10 ** arr.type.scale).cast(pa.int64())
-                mask = ~np.asarray(col.is_null())
-                fill = False if pa.types.is_boolean(arr.type) else 0
-                vals = arr.fill_null(fill).to_numpy(zero_copy_only=False)
-                d, v = DeviceColumn.host_prepare(vals, f.phys, mask=mask)
-                arrays.append((d, v))
+                arrays.append(_encode_plain(col, f.phys))
         return arrays
+
+    def _put_source_shards(self, shards, frag_fields):
+        """Pre-sharded (row-group-assigned) tables: shard i's rows land
+        on device i directly; string dictionaries are built GLOBALLY
+        across shards so codes stay comparable on every device."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        shard_sh = NamedSharding(self.mesh, P(self.axis))
+        n_dev = self.n_dev
+        assert len(shards) == n_dev, (len(shards), n_dev)
+        counts = np.asarray([t.num_rows for t in shards], np.int32)
+        padded = _bucket(max(int(counts.max()), 1))
+        nrows = jax.device_put(jnp.asarray(counts), shard_sh)
+        dicts: Dict = {}
+        shard_cols: Dict[int, list] = {}   # pos -> [(d, v) per shard]
+        for pos, f in enumerate(frag_fields):
+            if f.dict_id is not None:
+                per = [_strings_of(_one_chunk(t.columns[pos]))
+                       for t in shards]
+                live = [s[v] for s, v in per if v.any()]
+                uniq = np.unique(np.concatenate(live)) if live \
+                    else np.asarray([], dtype=object)
+                dicts[f.dict_id] = uniq
+                cols = []
+                for strs, valid in per:
+                    codes = np.searchsorted(uniq, strs).astype(np.int32) \
+                        if len(uniq) else np.zeros(len(strs), np.int32)
+                    codes[~valid] = 0
+                    cols.append((codes, valid))
+                shard_cols[pos] = cols
+            else:
+                shard_cols[pos] = [
+                    _encode_plain(_one_chunk(t.columns[pos]), f.phys)
+                    for t in shards]
+        pairs_dev = []
+        for pos, f in enumerate(frag_fields):
+            cols = shard_cols[pos]
+            dt = cols[0][0].dtype
+            dp = np.zeros(n_dev * padded, dt)
+            vp = np.zeros(n_dev * padded, bool)
+            for i, (d, v) in enumerate(cols):
+                c = len(d)
+                if c:
+                    dp[i * padded:i * padded + c] = d
+                    vp[i * padded:i * padded + c] = v
+            pairs_dev.append((jax.device_put(jnp.asarray(dp), shard_sh),
+                              jax.device_put(jnp.asarray(vp), shard_sh)))
+        pos_dicts = {i: dicts[f.dict_id]
+                     for i, f in enumerate(frag_fields)
+                     if f.dict_id is not None}
+        return nrows, pairs_dev, pos_dicts, padded
 
     # -----------------------------------------------------------------------
     def _build_program(self, env: _Env):
@@ -1203,6 +1410,11 @@ class DistributedPipelineExec(TpuExec):
                     keys.append(("recv", frag.frag_id, False))
                     keys.append(("recv", frag.frag_id, True))
                 keys.append(("join", frag.frag_id))
+                return
+            if isinstance(frag, _WindowFrag):
+                walk(frag.child)
+                if not (env.n_dev == 1 or frag.replicated):
+                    keys.append(("win", frag.frag_id))
                 return
             if isinstance(frag, _AggFrag):
                 walk(frag.child)

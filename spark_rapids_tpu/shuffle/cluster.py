@@ -10,8 +10,9 @@ reader, :1228 manager; heartbeat discovery Plugin.scala:428-439):
   ShuffleHeartbeatManager <--- ShuffleHeartbeatEndpoint heartbeats
   LocalCluster.execute(df)     each runs a BlockServer (transport.py)
     split plan at the agg      map task: run fragment, hash-partition
-    ship map tasks  ---------> output, PUT blocks to partition owners
-    ship reduce tasks -------> fetch owned partitions, merge-aggregate
+    (and, r3, at a shuffled    output, PUT blocks to partition owners
+    JOIN below it)             join task: fetch co-partitions of both
+    ship typed tasks --------> sides, local join + partial agg, PUT
     collect + finish plan <---- serialized Arrow results
 
 Aggregates are decomposed into update/merge pairs exactly like the
@@ -19,48 +20,48 @@ distinct rewrite (plan/rewrites.py): Sum/Min/Max merge with themselves,
 Count(+Star) merges by summing, Average splits into sum+count with a
 driver-side divide — so distributing cannot change results.
 
+Joins (r3): when BOTH sides of an equi-join are large, the driver
+hash-shuffles both sides by their join keys (one map task per worker per
+side), each worker joins its co-partitioned slice locally and runs the
+partial aggregation, then the existing agg shuffle/merge finishes — the
+host-staged analog of GpuShuffledHashJoinExec over
+RapidsShuffleInternalManagerBase exchanges (:614). Small sides keep the
+replicated (broadcast) path.
+
+All control traffic is the typed-task protocol in transport.py, signed
+with a per-cluster HMAC token — workers execute only registered task
+entry points, never shipped code objects.
+
 This is deliberately the MULTITHREADED-mode analog (host-staged blocks
 over TCP). The single-process device-resident path (ShuffleCatalog) and
 the SPMD collective path (parallel/planner.py) remain the fast paths; this
-runtime is the scale-out seam for multi-host DCN deployments.
+runtime is the scale-out seam for multi-host deployments.
 """
 from __future__ import annotations
 
 import copy
-import functools
 import os
 import pickle
+import secrets
 import time
 from typing import Dict, List, Optional, Tuple
 
 from .heartbeat import ShuffleHeartbeatEndpoint, ShuffleHeartbeatManager
-from .transport import BlockClient, BlockServer
+from .transport import BlockClient, BlockServer, ShuffleFetchFailed
 
-__all__ = ["LocalCluster"]
-
-
-# ---------------------------------------------------------------------------
-# driver-process globals (reached from workers via transport "call")
-# ---------------------------------------------------------------------------
-
-_DRIVER: Dict[str, object] = {}
-
-
-def _driver_register(executor_id: str, address: dict):
-    mgr: ShuffleHeartbeatManager = _DRIVER["manager"]  # type: ignore
-    return mgr.register(executor_id, address)
+__all__ = ["LocalCluster", "ShuffleFetchFailed"]
 
 
 class _RemoteManager:
     """Worker-side proxy giving ShuffleHeartbeatEndpoint the manager
     interface over the driver's control socket."""
 
-    def __init__(self, driver_addr):
-        self._client = BlockClient(driver_addr)
+    def __init__(self, driver_addr, token: Optional[bytes]):
+        self._client = BlockClient(driver_addr, token=token)
 
     def register(self, executor_id: str, address: dict):
-        return self._client.call(functools.partial(
-            _driver_register, executor_id, address))
+        return self._client.task("register", executor_id=executor_id,
+                                 address=address)
 
 
 # ---------------------------------------------------------------------------
@@ -70,7 +71,7 @@ class _RemoteManager:
 _WORKER: Dict[str, object] = {}
 
 
-def _worker_main(worker_id: int, driver_addr, ready_q):
+def _worker_main(worker_id: int, driver_addr, ready_q, token: bytes):
     # CPU backend only: worker processes must never grab the TPU the
     # driver session owns (one chip, many processes — the reference's
     # one-GPU-per-executor assignment, Plugin.scala:536)
@@ -87,17 +88,18 @@ def _worker_main(worker_id: int, driver_addr, ready_q):
         import faulthandler
         import sys
         faulthandler.dump_traceback_later(30, repeat=True, file=sys.stderr)
-    server = BlockServer()
+    server = BlockServer(token=token, tasks=_WORKER_TASKS)
     _WORKER["server"] = server
     _WORKER["id"] = f"worker-{worker_id}"
     _WORKER["peers"] = {}
+    _WORKER["token"] = token
 
     def on_new_peer(p):
         _WORKER["peers"][p["id"]] = BlockClient(
-            (p["addr"]["host"], p["addr"]["port"]))
+            (p["addr"]["host"], p["addr"]["port"]), token=token)
 
     ep = ShuffleHeartbeatEndpoint(
-        _RemoteManager(tuple(driver_addr)), _WORKER["id"],
+        _RemoteManager(tuple(driver_addr), token), _WORKER["id"],
         {"host": server.address[0], "port": server.address[1]},
         on_new_peer=on_new_peer)
     _WORKER["endpoint"] = ep
@@ -107,7 +109,7 @@ def _worker_main(worker_id: int, driver_addr, ready_q):
     stop = threading.Event()
     _WORKER["stop"] = stop
     while not stop.is_set():           # heartbeat loop; tasks arrive via
-        time.sleep(1.0)                # the BlockServer "call" op
+        time.sleep(1.0)                # the BlockServer "task" op
         try:
             ep.heartbeat()
         except Exception:
@@ -119,7 +121,12 @@ def _worker_stop():
     return True
 
 
-def _peer_client(owner_id: str) -> BlockClient:
+def _worker_heartbeat():
+    _WORKER["endpoint"].heartbeat()    # type: ignore
+    return sorted(_WORKER["peers"])    # type: ignore
+
+
+def _peer_client(owner_id: str) -> Optional[BlockClient]:
     if owner_id == _WORKER["id"]:
         return None                    # local put goes straight to store
     peers: Dict[str, BlockClient] = _WORKER["peers"]  # type: ignore
@@ -171,19 +178,8 @@ def _hash_partition(table, exprs, n_parts: int):
     return out
 
 
-def _run_map_task(shuffle_id: int, plan_bytes: bytes, group_bytes: bytes,
-                  owners: List[str]):
-    """Execute the map fragment, hash-partition its output, PUT blocks to
-    partition owners (ref RapidsShuffleThreadedWriterBase:238)."""
-    from ..api.dataframe import TpuSession
+def _put_partitions(shuffle_id: int, parts, owners: List[str]):
     from ..columnar.serializer import serialize_table
-    plan = pickle.loads(plan_bytes)
-    groupings = pickle.loads(group_bytes)
-    session = TpuSession()
-    from ..plan.overrides import plan_query
-    physical = plan_query(plan, session.conf)
-    table = physical.collect(session.exec_context())
-    parts = _hash_partition(table, groupings, len(owners))
     server: BlockServer = _WORKER["server"]  # type: ignore
     for p, sub in parts.items():
         data = serialize_table(sub, "lz4")
@@ -195,33 +191,116 @@ def _run_map_task(shuffle_id: int, plan_bytes: bytes, group_bytes: bytes,
     return {p: t.num_rows for p, t in parts.items()}
 
 
-def _run_reduce_task(shuffle_id: int, parts: List[int], plan_bytes: bytes):
-    """Merge-aggregate the owned partitions
-    (ref RapidsShuffleThreadedReaderBase:614)."""
+def _fetch_concat(shuffle_id: int, parts: List[int]):
+    """Fetch owned partitions from the local store (writers already
+    routed them here)."""
     import pyarrow as pa
-    from ..api.dataframe import TpuSession
-    from ..columnar.serializer import deserialize_table, serialize_table
-    from ..plan import logical as L
-    from ..plan.overrides import plan_query
-    from ..types import Schema, from_arrow, StructField
+    from ..columnar.serializer import deserialize_table
     server: BlockServer = _WORKER["server"]  # type: ignore
-    reduce_plan = pickle.loads(plan_bytes)
     tables = []
     for p in parts:
         for blk in server._fetch(shuffle_id, p):
             tables.append(deserialize_table(blk))
-    if not tables:
-        return None
-    t = pa.concat_tables(tables)
+    return pa.concat_tables(tables) if tables else None
+
+
+def _scan_of(table):
+    from ..plan import logical as L
+    from ..types import Schema, from_arrow, StructField
     schema = Schema([StructField(f.name, from_arrow(f.type), True)
-                     for f in t.schema])
-    scan = L.LogicalScan([t], schema)
+                     for f in table.schema])
+    return L.LogicalScan([table], schema)
+
+
+def _run_map_task(shuffle_id: int, plan_bytes: bytes, group_bytes: bytes,
+                  owners: List[str]):
+    """Execute the map fragment, hash-partition its output, PUT blocks to
+    partition owners (ref RapidsShuffleThreadedWriterBase:238)."""
+    from ..api.dataframe import TpuSession
+    plan = pickle.loads(plan_bytes)
+    groupings = pickle.loads(group_bytes)
+    session = TpuSession()
+    from ..plan.overrides import plan_query
+    physical = plan_query(plan, session.conf)
+    table = physical.collect(session.exec_context())
+    parts = _hash_partition(table, groupings, len(owners))
+    return _put_partitions(shuffle_id, parts, owners)
+
+
+def _run_reduce_task(shuffle_id: int, parts: List[int], plan_bytes: bytes):
+    """Merge-aggregate the owned partitions
+    (ref RapidsShuffleThreadedReaderBase:614)."""
+    from ..api.dataframe import TpuSession
+    from ..columnar.serializer import serialize_table
+    from ..plan.overrides import plan_query
+    reduce_plan = pickle.loads(plan_bytes)
+    t = _fetch_concat(shuffle_id, parts)
+    if t is None:
+        return None
     reduce_plan = copy.copy(reduce_plan)
-    reduce_plan.children = [scan]
+    reduce_plan.children = [_scan_of(t)]
     session = TpuSession()
     physical = plan_query(reduce_plan, session.conf)
     out = physical.collect(session.exec_context())
     return serialize_table(out, "lz4")
+
+
+def _run_join_side_task(shuffle_id: int, plan_bytes: bytes,
+                        key_bytes: bytes, owners: List[str]):
+    """Evaluate one side of a shuffled join and hash-partition its rows
+    by the JOIN keys (the exchange below GpuShuffledHashJoinExec)."""
+    from ..api.dataframe import TpuSession
+    from ..plan.overrides import plan_query
+    plan = pickle.loads(plan_bytes)
+    keys = pickle.loads(key_bytes)
+    session = TpuSession()
+    physical = plan_query(plan, session.conf)
+    table = physical.collect(session.exec_context())
+    parts = _hash_partition(table, keys, len(owners))
+    return _put_partitions(shuffle_id, parts, owners)
+
+
+def _run_join_local_task(shuffle_l: int, shuffle_r: int, parts: List[int],
+                         template_bytes: bytes, group_bytes: bytes,
+                         out_shuffle: int, owners: List[str],
+                         schemas_bytes: bytes):
+    """Fetch co-partitioned slices of both join sides, run the local
+    join + upper fragment + PARTIAL aggregation, hash-partition the
+    partials by grouping keys into the next shuffle."""
+    from ..api.dataframe import TpuSession
+    from ..plan import logical as L
+    from ..plan.overrides import plan_query
+    template = pickle.loads(template_bytes)
+    groupings = pickle.loads(group_bytes)
+    lschema, rschema = pickle.loads(schemas_bytes)
+    lt = _fetch_concat(shuffle_l, parts)
+    rt = _fetch_concat(shuffle_r, parts)
+    if lt is None and rt is None:
+        return {}
+    lt = lt if lt is not None else _empty_like(lschema)
+    rt = rt if rt is not None else _empty_like(rschema)
+    join = _find_join(template)
+    join.children = [L.LogicalScan([lt], lschema),
+                     L.LogicalScan([rt], rschema)]
+    session = TpuSession()
+    physical = plan_query(template, session.conf)
+    table = physical.collect(session.exec_context())
+    parts_out = _hash_partition(table, groupings, len(owners))
+    return _put_partitions(out_shuffle, parts_out, owners)
+
+
+#: the closed task table workers expose over the transport — the typed
+#: protocol's entire executable surface (ref RapidsShuffleTransport's
+#: message enum: adding a capability means adding a NAME here, not
+#: shipping code)
+_WORKER_TASKS = {
+    "map_agg": _run_map_task,
+    "reduce_agg": _run_reduce_task,
+    "join_side": _run_join_side_task,
+    "join_local": _run_join_local_task,
+    "heartbeat": _worker_heartbeat,
+    "stop": _worker_stop,
+}
 
 
 # ---------------------------------------------------------------------------
@@ -289,12 +368,30 @@ def _find_agg(plan):
         return None, None
 
 
+def _find_join(plan):
+    """Topmost equi-Join in the subtree (depth-first)."""
+    from ..plan import logical as L
+    if isinstance(plan, L.Join):
+        return plan
+    for c in plan.children:
+        j = _find_join(c)
+        if j is not None:
+            return j
+    return None
+
+
 def _scan_sizes(plan, out):
     from ..plan import logical as L
     if isinstance(plan, L.LogicalScan):
         out.append(plan)
     for c in plan.children:
         _scan_sizes(c, out)
+
+
+def _subtree_rows(plan) -> int:
+    scans: List = []
+    _scan_sizes(plan, scans)
+    return sum(sum(t.num_rows for t in s.tables) for s in scans)
 
 
 def _replace_node(plan, old, new):
@@ -310,21 +407,27 @@ def _replace_node(plan, old, new):
 # ---------------------------------------------------------------------------
 
 class LocalCluster:
-    """N worker processes on this host, shuffling over TCP. The seam for
-    multi-host: replace the process spawner with per-host launchers and
-    the loopback addresses with real ones — the protocol is already
-    remote-shaped."""
+    """N worker processes on this host, shuffling over TCP with a shared
+    HMAC token. The seam for multi-host: replace the process spawner with
+    per-host launchers and the loopback addresses with real ones — the
+    protocol is already remote-shaped and authenticated."""
 
-    def __init__(self, n_workers: int = 2, start_timeout_s: float = 60.0):
+    def __init__(self, n_workers: int = 2, start_timeout_s: float = 60.0,
+                 shuffle_join_min_rows: int = 100_000):
         import multiprocessing as mp
+        self.token = secrets.token_bytes(32)
         self.manager = ShuffleHeartbeatManager()
-        _DRIVER["manager"] = self.manager
-        self.control = BlockServer()
+        # the control server binds ITS OWN manager: two live clusters in
+        # one driver process must not cross-register workers
+        self.control = BlockServer(token=self.token,
+                                   tasks={"register": self.manager.register})
+        self.shuffle_join_min_rows = shuffle_join_min_rows
         ctx = mp.get_context("spawn")
         self._ready = ctx.Queue()
         self.procs = [ctx.Process(target=_worker_main,
                                   args=(i, self.control.address,
-                                        self._ready), daemon=True)
+                                        self._ready, self.token),
+                                  daemon=True)
                       for i in range(n_workers)]
         for p in self.procs:
             p.start()
@@ -335,19 +438,28 @@ class LocalCluster:
                 raise TimeoutError("workers failed to start")
             wid, addr = self._ready.get(timeout=start_timeout_s)
             self.workers[f"worker-{wid}"] = tuple(addr)
-        self.clients = {wid: BlockClient(addr)
+        self.clients = {wid: BlockClient(addr, token=self.token)
                         for wid, addr in sorted(self.workers.items())}
         # let every worker discover every peer before tasks ship
         for c in self.clients.values():
-            c.call(_worker_heartbeat)
+            c.task("heartbeat")
         self._next_shuffle = [0]
+
+    def _shuffle_id(self, owned: List[int]) -> int:
+        sid = self._next_shuffle[0]
+        self._next_shuffle[0] += 1
+        owned.append(sid)
+        return sid
 
     # -------------------------------------------------------------------
     def execute(self, df):
         """Distributed execution of a DataFrame whose plan is
         Sort/Project/Limit* over a decomposable Aggregate: map fragments
         run on workers, the shuffle moves partial-aggregate blocks, the
-        reduce merges, the driver finishes the plan. Returns Arrow."""
+        reduce merges, the driver finishes the plan. When the aggregate
+        sits over an equi-join whose sides are BOTH large, the join is
+        itself shuffled (both sides hash-partitioned by join key) before
+        the local join + partial agg. Returns Arrow."""
         from ..plan import logical as L
         from ..plan.rewrites import prune_columns
         from ..types import Schema, from_arrow, StructField
@@ -363,60 +475,55 @@ class LocalCluster:
             raise ValueError("aggregates are not merge-decomposable")
         map_aggs, reduce_aggs, projections = dec
 
-        scans: List = []
-        _scan_sizes(agg.children[0], scans)
-        if not scans:
-            raise ValueError("no in-memory scans to distribute")
-        fact = max(scans, key=lambda s: sum(t.num_rows for t in s.tables))
-
         worker_ids = sorted(self.clients)
         n = len(worker_ids)
-        shuffle_id = self._next_shuffle[0]
-        self._next_shuffle[0] += 1
-
-        # per-worker map plans: the fact scan sliced row-wise, dims ride
-        # replicated (broadcast analog); partial agg on top
-        fact_table = pa.concat_tables(fact.tables) if len(fact.tables) > 1 \
-            else fact.tables[0]
-        per = -(-fact_table.num_rows // n)
-        futures = []
         import concurrent.futures as cf
-        pool = cf.ThreadPoolExecutor(max_workers=n)
+        pool = cf.ThreadPoolExecutor(max_workers=2 * n)
         group_bytes = pickle.dumps([self._group_ref(g)
                                     for g in agg.groupings])
-        for wi, wid in enumerate(worker_ids):
-            slice_w = fact_table.slice(wi * per, per)
-            scan_w = L.LogicalScan([slice_w], fact._schema,
-                                   columns=fact.columns)
-            child_w = _replace_node(agg.children[0], fact, scan_w)
-            map_plan = L.Aggregate(list(agg.groupings), map_aggs, child_w)
-            futures.append(pool.submit(
-                self.clients[wid].call,
-                functools.partial(_run_map_task, shuffle_id,
-                                  pickle.dumps(map_plan), group_bytes,
-                                  worker_ids)))
-        for f in futures:
-            f.result()
 
-        # reduce: worker w owns partition w; the child is patched
-        # worker-side with a scan of the fetched blocks
-        reduce_proto = L.Aggregate(
-            [self._group_ref(g) for g in agg.groupings], reduce_aggs,
-            L.RangeRel(0, 1))
-        results = []
-        futures = [pool.submit(self.clients[wid].call,
-                               functools.partial(_run_reduce_task,
-                                                 shuffle_id, [wi],
-                                                 pickle.dumps(reduce_proto)))
-                   for wi, wid in enumerate(worker_ids)]
-        from ..columnar.serializer import deserialize_table
-        for f in futures:
-            got = f.result()
-            if got is not None:
-                results.append(deserialize_table(got))
-        pool.shutdown()
-        for c in self.clients.values():
-            c.drop(shuffle_id)
+        join = _find_join(agg.children[0])
+        shuffled_join = (
+            join is not None and join.condition is None
+            and join.join_type in ("inner", "left", "right", "full")
+            and join.left_keys and join.right_keys
+            and _subtree_rows(join.children[0]) >= self.shuffle_join_min_rows
+            and _subtree_rows(join.children[1]) >= self.shuffle_join_min_rows)
+
+        owned_sids: List[int] = []     # THIS call's shuffles only
+        try:
+            if shuffled_join:
+                agg_shuffle = self._exec_shuffled_join(
+                    pool, worker_ids, agg, join, map_aggs, group_bytes,
+                    owned_sids)
+            else:
+                agg_shuffle = self._exec_sliced_map(
+                    pool, worker_ids, agg, map_aggs, group_bytes,
+                    owned_sids)
+
+            # reduce: worker w owns partition w; the child is patched
+            # worker-side with a scan of the fetched blocks
+            reduce_proto = L.Aggregate(
+                [self._group_ref(g) for g in agg.groupings], reduce_aggs,
+                L.RangeRel(0, 1))
+            results = []
+            futures = [pool.submit(self.clients[wid].task, "reduce_agg",
+                                   shuffle_id=agg_shuffle, parts=[wi],
+                                   plan_bytes=pickle.dumps(reduce_proto))
+                       for wi, wid in enumerate(worker_ids)]
+            from ..columnar.serializer import deserialize_table
+            for f in futures:
+                got = f.result()
+                if got is not None:
+                    results.append(deserialize_table(got))
+        finally:
+            pool.shutdown(wait=False)
+            for c in self.clients.values():
+                try:
+                    for sid in owned_sids:
+                        c.drop(sid)
+                except Exception:
+                    pass
 
         merged = pa.concat_tables(results) if results else None
         # driver finish: restore names/avg divides, then the upper path
@@ -440,6 +547,101 @@ class LocalCluster:
         physical = plan_query(final, session.conf)
         return physical.collect(session.exec_context())
 
+    # -------------------------------------------------------------------
+    def _exec_sliced_map(self, pool, worker_ids, agg, map_aggs,
+                         group_bytes, owned_sids: List[int]) -> int:
+        """Original single-exchange path: the fact scan sliced row-wise,
+        dims ride replicated (broadcast analog); partial agg on top."""
+        from ..plan import logical as L
+        import pyarrow as pa
+        scans: List = []
+        _scan_sizes(agg.children[0], scans)
+        if not scans:
+            raise ValueError("no in-memory scans to distribute")
+        fact = max(scans, key=lambda s: sum(t.num_rows for t in s.tables))
+        n = len(worker_ids)
+        shuffle_id = self._shuffle_id(owned_sids)
+        fact_table = pa.concat_tables(fact.tables) if len(fact.tables) > 1 \
+            else fact.tables[0]
+        per = -(-fact_table.num_rows // n)
+        futures = []
+        for wi, wid in enumerate(worker_ids):
+            slice_w = fact_table.slice(wi * per, per)
+            scan_w = L.LogicalScan([slice_w], fact._schema,
+                                   columns=fact.columns)
+            child_w = _replace_node(agg.children[0], fact, scan_w)
+            map_plan = L.Aggregate(list(agg.groupings), map_aggs, child_w)
+            futures.append(pool.submit(
+                self.clients[wid].task, "map_agg", shuffle_id=shuffle_id,
+                plan_bytes=pickle.dumps(map_plan), group_bytes=group_bytes,
+                owners=worker_ids))
+        for f in futures:
+            f.result()
+        return shuffle_id
+
+    # -------------------------------------------------------------------
+    def _exec_shuffled_join(self, pool, worker_ids, agg, join, map_aggs,
+                            group_bytes, owned_sids: List[int]) -> int:
+        """Two-exchange path: hash-shuffle both join sides by join keys,
+        local join + partial agg per worker, then the agg exchange."""
+        from ..plan import logical as L
+        import pyarrow as pa
+        n = len(worker_ids)
+        side_shuffles = []
+        futures = []
+        for side, keys in ((0, join.left_keys), (1, join.right_keys)):
+            subtree = join.children[side]
+            scans: List = []
+            _scan_sizes(subtree, scans)
+            if not scans:
+                raise ValueError("join side has no in-memory scans")
+            fact = max(scans,
+                       key=lambda s: sum(t.num_rows for t in s.tables))
+            shuffle_id = self._shuffle_id(owned_sids)
+            side_shuffles.append(shuffle_id)
+            fact_table = pa.concat_tables(fact.tables) \
+                if len(fact.tables) > 1 else fact.tables[0]
+            per = -(-fact_table.num_rows // n)
+            key_bytes = pickle.dumps(list(keys))
+            for wi, wid in enumerate(worker_ids):
+                slice_w = fact_table.slice(wi * per, per)
+                scan_w = L.LogicalScan([slice_w], fact._schema,
+                                       columns=fact.columns)
+                plan_w = _replace_node(subtree, fact, scan_w)
+                futures.append(pool.submit(
+                    self.clients[wid].task, "join_side",
+                    shuffle_id=shuffle_id,
+                    plan_bytes=pickle.dumps(plan_w),
+                    key_bytes=key_bytes, owners=worker_ids))
+        for f in futures:
+            f.result()
+
+        # local join + partial agg per worker; output rides the agg
+        # exchange. The template is the agg child with the join's inputs
+        # to be patched worker-side (located by the same deterministic
+        # walk both sides of the wire run).
+        lschema = join.children[0].schema()
+        rschema = join.children[1].schema()
+        template_join = copy.copy(join)
+        template_join.children = [L.RangeRel(0, 1), L.RangeRel(0, 1)]
+        template_child = _replace_node(agg.children[0], join,
+                                       template_join)
+        template = L.Aggregate(list(agg.groupings), map_aggs,
+                               template_child)
+        agg_shuffle = self._shuffle_id(owned_sids)
+        schemas_bytes = pickle.dumps((lschema, rschema))
+        template_bytes = pickle.dumps(template)
+        futures = [pool.submit(
+            self.clients[wid].task, "join_local",
+            shuffle_l=side_shuffles[0], shuffle_r=side_shuffles[1],
+            parts=[wi], template_bytes=template_bytes,
+            group_bytes=group_bytes, out_shuffle=agg_shuffle,
+            owners=worker_ids, schemas_bytes=schemas_bytes)
+            for wi, wid in enumerate(worker_ids)]
+        for f in futures:
+            f.result()
+        return agg_shuffle
+
     @staticmethod
     def _group_ref(g):
         from ..exprs.base import ColumnRef
@@ -448,7 +650,7 @@ class LocalCluster:
     def shutdown(self):
         for c in self.clients.values():
             try:
-                c.call(_worker_stop)
+                c.task("stop")
             except Exception:
                 pass
             c.close()
@@ -457,11 +659,6 @@ class LocalCluster:
             if p.is_alive():
                 p.terminate()
         self.control.close()
-
-
-def _worker_heartbeat():
-    _WORKER["endpoint"].heartbeat()    # type: ignore
-    return sorted(_WORKER["peers"])    # type: ignore
 
 
 def _empty_like(schema):

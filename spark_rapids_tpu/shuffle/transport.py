@@ -1,4 +1,4 @@
-"""TCP shuffle transport: block server + client.
+"""TCP shuffle transport: block server + client, typed + authenticated.
 
 The cross-process leg of the shuffle (ref RapidsShuffleTransport's message
 protocol {MetadataRequest, TransferRequest, Buffer} —
@@ -10,25 +10,56 @@ process-to-process fallback, moving the engine's serialized Arrow blocks
 (columnar/serializer.py) over length-prefixed TCP messages.
 
 Message = 4-byte big-endian header length + JSON header + raw payload
-(length in the header). Ops:
+(length in the header). Ops — a CLOSED dispatch table, mirroring the
+reference's typed message enum (there is deliberately no "run arbitrary
+callable" op):
   put    {shuffle, part, size}+payload  -> {ok}
   fetch  {shuffle, part}                -> {sizes: [...]}+concat(payloads)
-  call   {size}+pickled callable        -> {size}+pickled result (worker
-         task execution; the driver is trusted — same machine/user)
+  task   {name, size}+pickled kwargs    -> {size}+pickled result; `name`
+         must be registered in the server's task table (cluster.py
+         registers the worker/driver task entry points)
+  drop   {shuffle}                      -> {ok}
+  close                                 -> connection ends
+
+Trust model: every message carries an HMAC-SHA256 over header+payload
+keyed by a per-cluster token minted by LocalCluster and handed to worker
+processes at spawn. A server with a token refuses unauthenticated or
+mis-signed messages, so only cluster members can store blocks or invoke
+tasks — task payloads are pickled by trusted peers only. Without a token
+(tests, single-user tooling) the server accepts loopback traffic as
+before.
 """
 from __future__ import annotations
 
+import hashlib
+import hmac as hmac_mod
 import json
 import socket
 import socketserver
 import struct
 import threading
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
-__all__ = ["BlockServer", "BlockClient"]
+__all__ = ["BlockServer", "BlockClient", "ShuffleFetchFailed"]
 
 
-def _send_msg(sock: socket.socket, header: dict, payload: bytes = b""):
+class ShuffleFetchFailed(RuntimeError):
+    """A peer's blocks are unreachable (process died / connection reset) —
+    the analog of Spark's FetchFailedException; the driver surfaces it
+    instead of hanging (ref RapidsShuffleIterator transport errors)."""
+
+
+def _sign(token: Optional[bytes], header: dict, payload: bytes) -> str:
+    msg = json.dumps(header, sort_keys=True).encode() + payload
+    return hmac_mod.new(token or b"", msg, hashlib.sha256).hexdigest()
+
+
+def _send_msg(sock: socket.socket, header: dict, payload: bytes = b"",
+              token: Optional[bytes] = None):
+    if token is not None:
+        header = dict(header)
+        header["hmac"] = _sign(token, {k: v for k, v in header.items()
+                                       if k != "hmac"}, payload)
     h = json.dumps(header).encode()
     sock.sendall(struct.pack(">I", len(h)) + h + payload)
 
@@ -54,9 +85,20 @@ def _recv_msg(sock: socket.socket) -> Tuple[dict, bytes]:
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
         server: "BlockServer" = self.server.owner  # type: ignore
+        with server._conn_lock:
+            server._conns.add(self.request)
         try:
             while True:
                 header, payload = _recv_msg(self.request)
+                if server.token is not None:
+                    sig = header.get("hmac", "")
+                    want = _sign(server.token,
+                                 {k: v for k, v in header.items()
+                                  if k != "hmac"}, payload)
+                    if not hmac_mod.compare_digest(sig, want):
+                        _send_msg(self.request,
+                                  {"error": "authentication failed"})
+                        return
                 op = header.get("op")
                 if op == "put":
                     server._put(header["shuffle"], header["part"], payload)
@@ -68,13 +110,19 @@ class _Handler(socketserver.BaseRequestHandler):
                     _send_msg(self.request,
                               {"sizes": [len(b) for b in blocks],
                                "size": len(body)}, body)
-                elif op == "call":
+                elif op == "task":
                     import pickle
-                    fn = pickle.loads(payload)
-                    try:
-                        res = pickle.dumps((True, fn()))
-                    except Exception as e:  # shipped back, raised driver-side
-                        res = pickle.dumps((False, repr(e)))
+                    fn = server.tasks.get(header.get("name", ""))
+                    if fn is None:
+                        res = pickle.dumps(
+                            (False, f"unknown task {header.get('name')!r}"))
+                    else:
+                        try:
+                            kwargs = pickle.loads(payload) if payload \
+                                else {}
+                            res = pickle.dumps((True, fn(**kwargs)))
+                        except Exception as e:  # raised driver-side
+                            res = pickle.dumps((False, repr(e)))
                     _send_msg(self.request, {"size": len(res)}, res)
                 elif op == "drop":
                     server._drop(header["shuffle"])
@@ -85,6 +133,9 @@ class _Handler(socketserver.BaseRequestHandler):
                     raise ValueError(f"unknown op {op}")
         except (ConnectionError, OSError):
             return
+        finally:
+            with server._conn_lock:
+                server._conns.discard(self.request)
 
 
 class _TCPServer(socketserver.ThreadingTCPServer):
@@ -95,11 +146,18 @@ class _TCPServer(socketserver.ThreadingTCPServer):
 class BlockServer:
     """In-memory store of serialized shuffle blocks, served over TCP
     (ref RapidsShuffleServer.doHandleTransferRequest:320 — the host-staged
-    analog: blocks already live in host memory here)."""
+    analog: blocks already live in host memory here). ``tasks`` is the
+    closed name->callable dispatch table for the `task` op."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 token: Optional[bytes] = None,
+                 tasks: Optional[Dict[str, Callable]] = None):
         self._blocks: Dict[Tuple[int, int], List[bytes]] = {}
         self._lock = threading.Lock()
+        self._conns: set = set()
+        self._conn_lock = threading.Lock()
+        self.token = token
+        self.tasks: Dict[str, Callable] = dict(tasks or {})
         self._srv = _TCPServer((host, port), _Handler)
         self._srv.owner = self
         self.address = self._srv.server_address
@@ -123,51 +181,88 @@ class BlockServer:
     def close(self):
         self._srv.shutdown()
         self._srv.server_close()
+        # sever live connections too: a "closed" server must look DEAD to
+        # peers (fetches fail fast instead of riding a half-open socket)
+        with self._conn_lock:
+            for s in list(self._conns):
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            self._conns.clear()
 
 
 class BlockClient:
     """Connection to one peer's BlockServer (ref RapidsShuffleClient
     doFetch:174). One socket, serial request/response; callers needing
-    parallel fetches open one client per thread."""
+    parallel fetches open one client per thread. Signs every message with
+    the cluster token when one is set."""
 
-    def __init__(self, address):
+    def __init__(self, address, token: Optional[bytes] = None):
         self.address = tuple(address)
+        self.token = token
         self._sock = socket.create_connection(self.address, timeout=120)
+        self._lock = threading.Lock()
 
     def put(self, shuffle: int, part: int, data: bytes):
-        _send_msg(self._sock, {"op": "put", "shuffle": shuffle,
-                               "part": part, "size": len(data)}, data)
-        _recv_msg(self._sock)
+        with self._lock:
+            _send_msg(self._sock, {"op": "put", "shuffle": shuffle,
+                                   "part": part, "size": len(data)}, data,
+                      token=self.token)
+            self._check(_recv_msg(self._sock)[0])
 
     def fetch(self, shuffle: int, part: int) -> List[bytes]:
-        _send_msg(self._sock, {"op": "fetch", "shuffle": shuffle,
-                               "part": part})
-        header, body = _recv_msg(self._sock)
+        try:
+            with self._lock:
+                _send_msg(self._sock, {"op": "fetch", "shuffle": shuffle,
+                                       "part": part}, token=self.token)
+                header, body = _recv_msg(self._sock)
+        except (ConnectionError, OSError) as e:
+            raise ShuffleFetchFailed(
+                f"fetch shuffle={shuffle} part={part} from "
+                f"{self.address}: {e}") from e
+        self._check(header)
         out, off = [], 0
         for s in header["sizes"]:
             out.append(body[off:off + s])
             off += s
         return out
 
-    def call(self, fn):
-        """Run a picklable callable in the peer process; raises on remote
-        failure."""
+    def task(self, name: str, **kwargs):
+        """Invoke a REGISTERED task in the peer process; raises on remote
+        failure. Replaces the old arbitrary-callable `call` op."""
         import pickle
-        data = pickle.dumps(fn)
-        _send_msg(self._sock, {"op": "call", "size": len(data)}, data)
-        _, body = _recv_msg(self._sock)
+        data = pickle.dumps(kwargs)
+        with self._lock:
+            _send_msg(self._sock, {"op": "task", "name": name,
+                                   "size": len(data)}, data,
+                      token=self.token)
+            header, body = _recv_msg(self._sock)
+        self._check(header)
         ok, res = pickle.loads(body)
         if not ok:
-            raise RuntimeError(f"remote task failed: {res}")
+            raise RuntimeError(f"remote task {name!r} failed: {res}")
         return res
 
     def drop(self, shuffle: int):
-        _send_msg(self._sock, {"op": "drop", "shuffle": shuffle})
-        _recv_msg(self._sock)
+        with self._lock:
+            _send_msg(self._sock, {"op": "drop", "shuffle": shuffle},
+                      token=self.token)
+            self._check(_recv_msg(self._sock)[0])
+
+    @staticmethod
+    def _check(header: dict):
+        if "error" in header:
+            raise ConnectionError(header["error"])
 
     def close(self):
         try:
-            _send_msg(self._sock, {"op": "close"})
+            with self._lock:
+                _send_msg(self._sock, {"op": "close"}, token=self.token)
             self._sock.close()
         except OSError:
             pass

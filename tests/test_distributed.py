@@ -440,3 +440,151 @@ def test_fused_single_chip_pipeline_differential():
     want = q(plain).collect_arrow().to_pandas() \
         .sort_values("k").reset_index(drop=True)
     pd.testing.assert_frame_equal(got, want, check_dtype=False)
+
+
+def test_planned_distributed_window_differential():
+    """Windowed query plans as DistributedPipeline (VERDICT r2 #3):
+    rows route to partition owners, each device runs the window kernel
+    over complete partitions."""
+    import pandas as pd
+    rng = np.random.RandomState(5)
+    n = 4000
+    t = pa.table({
+        "p": pa.array(rng.randint(0, 37, n)),
+        "o": pa.array(rng.randint(0, 1 << 20, n)),
+        "v": pa.array(np.round(rng.uniform(-50, 50, n), 2)),
+    })
+    from spark_rapids_tpu.exprs import ColumnRef
+    from spark_rapids_tpu.exprs.aggregates import Sum
+    sd = _dist_session()
+    q = (sd.create_dataframe(t)
+         .with_window_column("wsum", Sum(ColumnRef("v")),
+                             partition_by=["p"],
+                             order_by=[F.col("o").asc()],
+                             frame=("rows", -2, 0)))
+    _assert_plan_distributed(q)
+    got = q.collect_arrow().to_pandas() \
+        .sort_values(["p", "o"]).reset_index(drop=True)
+    s1 = tpu_session()
+    want = (s1.create_dataframe(t)
+            .with_window_column("wsum", Sum(ColumnRef("v")),
+                                partition_by=["p"],
+                                order_by=[F.col("o").asc()],
+                                frame=("rows", -2, 0))
+            .collect_arrow().to_pandas()
+            .sort_values(["p", "o"]).reset_index(drop=True))
+    np.testing.assert_array_equal(got["p"], want["p"])
+    np.testing.assert_allclose(got["wsum"], want["wsum"], rtol=1e-9)
+
+
+def test_planned_distributed_conditioned_join_differential():
+    """Inner equi-join with a residual non-equi condition lowers to the
+    fragment (condition == post-join filter on device)."""
+    rng = np.random.RandomState(9)
+    n = 5000
+    left = pa.table({"k": pa.array(rng.randint(0, 200, n)),
+                     "a": pa.array(rng.randint(0, 100, n))})
+    right = pa.table({"k2": pa.array(rng.randint(0, 200, 300)),
+                      "b": pa.array(rng.randint(0, 100, 300))})
+    sd = _dist_session()
+    q = (sd.create_dataframe(left)
+         .join(sd.create_dataframe(right),
+               on=[(F.col("k"), F.col("k2"))], how="inner",
+               condition=F.col("a") > F.col("b"))
+         .group_by("k")
+         .agg(F.count_star().with_name("n"),
+              F.sum(F.col("b")).with_name("sb")))
+    _assert_plan_distributed(q)
+    got = q.collect_arrow().to_pandas().sort_values("k") \
+        .reset_index(drop=True)
+    s1 = tpu_session()
+    want = (s1.create_dataframe(left)
+            .join(s1.create_dataframe(right),
+                  on=[(F.col("k"), F.col("k2"))], how="inner",
+                  condition=F.col("a") > F.col("b"))
+            .group_by("k")
+            .agg(F.count_star().with_name("n"),
+                 F.sum(F.col("b")).with_name("sb"))
+            .collect_arrow().to_pandas().sort_values("k")
+            .reset_index(drop=True))
+    np.testing.assert_array_equal(got["k"], want["k"])
+    np.testing.assert_array_equal(got["n"], want["n"])
+    np.testing.assert_allclose(got["sb"], want["sb"], rtol=1e-12)
+
+
+def test_planned_distributed_q28_distinct():
+    """q28's rewritten distinct aggregates plan as DistributedPipeline
+    (VERDICT r2 #3 'done' criterion)."""
+    import sys
+    sys.path.insert(0, ".")
+    from benchmarks import tpcds
+    ss = tpcds.gen_store_sales(20000)
+    sd = _dist_session()
+    q = tpcds.q28(sd.create_dataframe(ss), F)
+    _assert_plan_distributed(q)
+    got = q.collect_arrow()
+    s1 = tpu_session({"spark.rapids.tpu.sql.enabled": False})
+    want = tpcds.q28(s1.create_dataframe(ss), F).collect_arrow()
+    for c in ("b_avg", "b_cnt", "b_cntd"):
+        np.testing.assert_allclose(
+            np.asarray(got.column(c).to_numpy(zero_copy_only=False), float),
+            np.asarray(want.column(c).to_numpy(zero_copy_only=False), float),
+            rtol=1e-9)
+
+
+def test_planned_distributed_parquet_row_group_scan(tmp_path):
+    """Fragment sources over parquet read row-group-partitioned: each
+    device's shard is an independent read_row_groups (VERDICT r2 #3;
+    ref GpuMultiFileReader.scala:295)."""
+    import pyarrow.parquet as pq
+    rng = np.random.RandomState(11)
+    n = 6000
+    t = pa.table({
+        "k": pa.array(rng.randint(0, 50, n)),
+        "g": pa.array(rng.choice(["ant", "bee", "cat", "dog"], n)),
+        "v": pa.array(np.round(rng.uniform(0, 100, n), 2)),
+    })
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(t, path, row_group_size=500)     # 12 row groups
+    sd = _dist_session()
+    q = (sd.read_parquet(path)
+         .filter(F.col("v") > F.lit(5.0))
+         .group_by("k", "g")
+         .agg(F.sum(F.col("v")).with_name("sv"),
+              F.count_star().with_name("n")))
+    _assert_plan_distributed(q)
+    got = q.collect_arrow().to_pandas() \
+        .sort_values(["k", "g"]).reset_index(drop=True)
+    s1 = tpu_session({"spark.rapids.tpu.sql.enabled": False})
+    want = (s1.read_parquet(path)
+            .filter(F.col("v") > F.lit(5.0))
+            .group_by("k", "g")
+            .agg(F.sum(F.col("v")).with_name("sv"),
+                 F.count_star().with_name("n"))
+            .collect_arrow().to_pandas()
+            .sort_values(["k", "g"]).reset_index(drop=True))
+    np.testing.assert_array_equal(got["k"], want["k"])
+    np.testing.assert_array_equal(got["g"], want["g"])
+    np.testing.assert_array_equal(got["n"], want["n"])
+    np.testing.assert_allclose(got["sv"], want["sv"], rtol=1e-9)
+
+
+def test_conditioned_join_null_safe_condition_no_phantom_rows():
+    """A residual condition with constant-true validity (null-safe
+    equality) must not resurrect padding rows (r3 review finding)."""
+    left = pa.table({"k": pa.array([1, 2, 3]),
+                     "x": pa.array([10, None, 30])})
+    right = pa.table({"k2": pa.array([1, 2, 3, 4]),
+                      "y": pa.array([None, None, 30, 40])})
+    sd = _dist_session()
+    q = (sd.create_dataframe(left)
+         .join(sd.create_dataframe(right),
+               on=[(F.col("k"), F.col("k2"))], how="inner",
+               condition=F.col("x").eqNullSafe(F.col("y")))
+         .group_by("k")
+         .agg(F.count_star().with_name("n")))
+    got = q.collect_arrow().to_pandas().sort_values("k") \
+        .reset_index(drop=True)
+    # matches: k=2 (NULL<=>NULL true), k=3 (30<=>30)
+    assert got["k"].tolist() == [2, 3]
+    assert got["n"].tolist() == [1, 1]

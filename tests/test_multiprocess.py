@@ -52,9 +52,8 @@ def test_heartbeat_discovers_peers(cluster):
     # the driver registry saw both workers; each worker connected to the
     # other through on_new_peer (heartbeat.py's production caller)
     assert len(cluster.manager.live_peers()) == 2
-    from spark_rapids_tpu.shuffle.cluster import _worker_heartbeat
     for c in cluster.clients.values():
-        peers = c.call(_worker_heartbeat)
+        peers = c.task("heartbeat")
         assert len(peers) == 1          # the OTHER worker connected
 
 
@@ -126,3 +125,104 @@ def test_hash_partition_normalizes_float_keys():
             home[k] = p
     assert home[1] == home[2], "-0.0 and 0.0 split across partitions"
     assert home[3] == home[4], "NaN payloads split across partitions"
+
+
+def test_transport_rejects_unauthenticated_and_unknown_tasks():
+    """A tokened server refuses unsigned/mis-signed traffic, and the
+    task op only reaches REGISTERED names (advisor r2: no arbitrary
+    callable execution)."""
+    from spark_rapids_tpu.shuffle.transport import BlockClient, BlockServer
+    srv = BlockServer(token=b"s3cret", tasks={"echo": lambda x: x})
+    try:
+        good = BlockClient(srv.address, token=b"s3cret")
+        good.put(1, 0, b"data")
+        assert good.fetch(1, 0) == [b"data"]
+        assert good.task("echo", x=41) == 41
+        with pytest.raises(RuntimeError, match="unknown task"):
+            good.task("os_system", x="rm -rf /")
+        bad = BlockClient(srv.address, token=b"wrong")
+        with pytest.raises((ConnectionError, OSError)):
+            bad.put(1, 0, b"evil")
+        unsigned = BlockClient(srv.address)  # no token at all
+        with pytest.raises((ConnectionError, OSError)):
+            unsigned.fetch(1, 0)
+        good.close()
+    finally:
+        srv.close()
+
+
+@pytest.fixture(scope="module")
+def cluster4():
+    from spark_rapids_tpu.shuffle.cluster import LocalCluster
+    cl = LocalCluster(4, shuffle_join_min_rows=1000)
+    yield cl
+    cl.shutdown()
+
+
+def test_shuffled_join_agg_differential(cluster4):
+    """join+agg across LocalCluster(4) with BOTH sides hash-partitioned
+    by join key (VERDICT r2 #5 'done' criterion): results identical to
+    single-process."""
+    rng = np.random.RandomState(7)
+    n = 40000
+    left = pa.table({
+        "k": pa.array(rng.randint(0, 5000, n)),
+        "v": pa.array(np.round(rng.uniform(0, 10, n), 2)),
+    })
+    right = pa.table({
+        "k2": pa.array(rng.randint(0, 5000, n)),
+        "w": pa.array(rng.randint(0, 100, n)),
+    })
+    s = tpu_session()
+    df = (s.create_dataframe(left)
+          .join(s.create_dataframe(right),
+                on=[(F.col("k"), F.col("k2"))], how="inner")
+          .group_by("k")
+          .agg(F.sum(F.col("v")).with_name("sv"),
+               F.count_star().with_name("n"),
+               F.max(F.col("w")).with_name("mw")))
+    got = cluster4.execute(df).to_pandas() \
+        .sort_values("k").reset_index(drop=True)
+    want = df.collect_arrow().to_pandas() \
+        .sort_values("k").reset_index(drop=True)
+    assert len(got) == len(want)
+    np.testing.assert_array_equal(got["k"], want["k"])
+    np.testing.assert_array_equal(got["n"], want["n"])
+    np.testing.assert_array_equal(got["mw"], want["mw"])
+    np.testing.assert_allclose(got["sv"], want["sv"], rtol=1e-9)
+
+
+def test_shuffled_left_join_null_keys(cluster4):
+    """Left-join rows with NULL keys survive the shuffle (routed to a
+    deterministic partition, never matched)."""
+    left = pa.table({"k": pa.array([1, 2, None, 4] * 500),
+                     "v": pa.array([1.0, 2.0, 3.0, 4.0] * 500)})
+    right = pa.table({"k2": pa.array([1, 4] * 600),
+                      "w": pa.array([10, 40] * 600)})
+    s = tpu_session()
+    df = (s.create_dataframe(left)
+          .join(s.create_dataframe(right),
+                on=[(F.col("k"), F.col("k2"))], how="left")
+          .group_by("k")
+          .agg(F.count_star().with_name("n"),
+               F.sum(F.col("w")).with_name("sw")))
+    got = cluster4.execute(df).to_pandas()
+    want = df.collect_arrow().to_pandas()
+    gk = got.sort_values("k", na_position="last").reset_index(drop=True)
+    wk = want.sort_values("k", na_position="last").reset_index(drop=True)
+    np.testing.assert_array_equal(gk["n"], wk["n"])
+
+
+def test_fetch_failure_surfaces_cleanly():
+    """A dead peer mid-shuffle raises ShuffleFetchFailed, not a hang
+    (ref RapidsShuffleIterator transport-error handling)."""
+    from spark_rapids_tpu.shuffle.transport import (BlockClient,
+                                                    BlockServer,
+                                                    ShuffleFetchFailed)
+    srv = BlockServer(token=b"t")
+    c = BlockClient(srv.address, token=b"t")
+    c.put(9, 0, b"block")
+    srv.close()           # peer dies
+    with pytest.raises(ShuffleFetchFailed):
+        for _ in range(3):     # first fetch may see a half-open socket
+            c.fetch(9, 0)

@@ -407,3 +407,19 @@ def test_split_limit_semantics_both_engines():
         assert _df_split(df, pat, 1) == ["a:1b:2c:3d"]
         assert _df_split(df, pat, 2) == ["a", "1b:2c:3d"]
         assert _df_split(df, pat, 3) == ["a", "1b", "2c:3d"]
+
+
+def test_split_limit_zero_drops_trailing_empties():
+    """Java Pattern.split limit=0: unlimited splits THEN trailing empty
+    strings removed; limit=-1 keeps them (r3 review finding)."""
+    s = tpu_session()
+    df = s.create_dataframe(pd.DataFrame({"s": ["a:b::", "::", "a"]}))
+
+    def run(pat, lim):
+        out = df.select(
+            F.split(F.col("s"), pat, lim).alias("r")).to_pandas()
+        return [list(x) for x in out["r"]]
+
+    for pat in [":", ":(?=.?)"]:          # RE2 path / python fallback
+        assert run(pat, 0) == [["a", "b"], [], ["a"]]
+        assert run(pat, -1) == [["a", "b", "", ""], ["", "", ""], ["a"]]
