@@ -45,6 +45,17 @@ def _time_min(fn, iters):
     return best, out
 
 
+def gen_string_table(n: int, seed: int = 13, card: int = 1000):
+    import pyarrow as pa
+    rng = np.random.RandomState(seed)
+    pool = np.asarray([f"  Item-{i:05d}-{'x' * (i % 7)}  "
+                       for i in range(card)], dtype=object)
+    return pa.table({
+        "s": pa.array(pool[rng.randint(0, card, n)]),
+        "v": pa.array(rng.uniform(0, 10, n)),
+    })
+
+
 def gen_window_table(n: int, seed: int = 11):
     import pyarrow as pa
     rng = np.random.RandomState(seed)
@@ -75,6 +86,7 @@ def main():
     date_dim = tpcds.gen_date_dim()
     item = tpcds.gen_item()
     wtab = gen_window_table(nw)
+    stab = gen_string_table(n)
     log(f"bench: ladder on {jax.devices()[0].platform}, {n} rows, "
         f"{iters} iters")
 
@@ -118,6 +130,19 @@ def main():
                                     partition_by=["p"],
                                     order_by=[F.col("o").asc()],
                                     frame=("rows", -2, 0))
+                .collect_arrow())
+
+    def eng_strings():
+        # dict-transform path (r3): upper/trim/substring evaluate once
+        # per distinct dictionary entry; rows stay device-resident codes
+        s = TpuSession()
+        return (s.create_dataframe(stab)
+                .select(F.upper(F.trim(F.col("s"))).alias("u"),
+                        F.substring(F.col("s"), 3, 4).alias("pre"),
+                        F.col("v"))
+                .group_by("u", "pre")
+                .agg(F.sum(F.col("v")).with_name("sv"),
+                     F.count_star().with_name("n"))
                 .collect_arrow())
 
     # ---------------- pandas baselines ----------------
@@ -199,6 +224,13 @@ def main():
             rows.append((float(b.mean()), int(b.count()), int(b.nunique())))
         return rows
 
+    def base_strings():
+        pdf = stab.to_pandas()
+        pdf["u"] = pdf["s"].str.strip().str.upper()
+        pdf["pre"] = pdf["s"].str.slice(2, 6)
+        return (pdf.groupby(["u", "pre"], as_index=False)
+                .agg(sv=("v", "sum"), n=("v", "size")))
+
     def base_window():
         pdf = wtab.to_pandas()
         pdf = pdf.sort_values(["p", "o"], kind="stable")
@@ -214,6 +246,7 @@ def main():
         ("tpcds_q9", eng_q9, base_q9),
         ("tpcds_q28", eng_q28, base_q28),
         ("window_bounded", eng_window, base_window),
+        ("string_transforms", eng_strings, base_strings),
     ]
     if lineitem_big is not None:
         workloads += [
@@ -270,6 +303,13 @@ def main():
     eng_sum = float(np.nansum(res.column("wsum").to_numpy(
         zero_copy_only=False)))
     np.testing.assert_allclose(eng_sum, float(base["wsum"].sum()), rtol=1e-6)
+    res, base = checks["string_transforms"]
+    got = res.to_pandas().sort_values(["u", "pre"]).reset_index(drop=True)
+    base = base.sort_values(["u", "pre"]).reset_index(drop=True)
+    assert len(got) == len(base), (len(got), len(base))
+    np.testing.assert_array_equal(got["u"], base["u"])
+    np.testing.assert_array_equal(got["n"], base["n"])
+    np.testing.assert_allclose(got["sv"], base["sv"], rtol=1e-9)
     if "tpch_q1_10m" in checks:
         res, base = checks["tpch_q1_10m"]
         got = res.to_pandas().set_index(["l_returnflag", "l_linestatus"]) \

@@ -27,7 +27,16 @@ _str_sig = TypeSig([TypeEnum.STRING])
 
 class _HostStringExpr(Expression):
     """Base: runs on host Arrow; device tagging returns an explicit reason
-    so explain output mirrors the reference's NOT_ON_GPU messages."""
+    so explain output mirrors the reference's NOT_ON_GPU messages.
+
+    ``dict_transform = True`` marks VALUE-WISE string->string transforms:
+    over a dictionary-coded column the project exec evaluates them ONCE
+    per distinct dictionary entry and re-encodes — row data never leaves
+    the device (the O(dict) transform generalization of the r2 predicate
+    trick; ref stringFunctions.scala device kernels)."""
+
+    #: subclasses that map each string value independently set True
+    dict_transform = False
 
     def device_unsupported_reason(self, schema: Schema) -> Optional[str]:
         return f"{type(self).__name__}: string expressions run on host"
@@ -52,6 +61,7 @@ class Length(_HostStringExpr):
 
 
 class Upper(_HostStringExpr):
+    dict_transform = True
     def __init__(self, child):
         self.children = [child]
 
@@ -64,6 +74,7 @@ class Upper(_HostStringExpr):
 
 
 class Lower(_HostStringExpr):
+    dict_transform = True
     def __init__(self, child):
         self.children = [child]
 
@@ -77,6 +88,7 @@ class Lower(_HostStringExpr):
 
 class Substring(_HostStringExpr):
     """Spark substring: 1-based, pos 0 treated as 1, negative from end."""
+    dict_transform = True
 
     def __init__(self, child, pos: int, length: Optional[int] = None):
         self.children = [child]
@@ -232,6 +244,7 @@ class RLike(_PatternPredicate):
 
 
 class RegExpReplace(_HostStringExpr):
+    dict_transform = True
     def __init__(self, child, pattern: str, replacement: str):
         self.children = [child]
         self.pattern = pattern
@@ -290,6 +303,7 @@ class RegExpExtract(_HostStringExpr):
 
 
 class _TrimBase(_HostStringExpr):
+    dict_transform = True
     pc_fn = "utf8_trim_whitespace"
 
     def __init__(self, child, chars: Optional[str] = None):
@@ -321,6 +335,7 @@ class StringTrimRight(_TrimBase):
 
 
 class StringReplace(_HostStringExpr):
+    dict_transform = True
     def __init__(self, child, search: str, replace: str):
         self.children = [child]
         self.search = search
@@ -367,6 +382,7 @@ class StringLocate(_HostStringExpr):
 
 
 class Lpad(_HostStringExpr):
+    dict_transform = True
     def __init__(self, child, length: int, pad: str = " "):
         self.children = [child]
         self.length = length
@@ -398,6 +414,7 @@ class Rpad(Lpad):
 
 
 class Reverse(_HostStringExpr):
+    dict_transform = True
     def __init__(self, child):
         self.children = [child]
 
@@ -410,6 +427,7 @@ class Reverse(_HostStringExpr):
 
 
 class StringRepeat(_HostStringExpr):
+    dict_transform = True
     def __init__(self, child, times: int):
         self.children = [child]
         self.times = times
@@ -427,6 +445,7 @@ class StringRepeat(_HostStringExpr):
 
 
 class InitCap(_HostStringExpr):
+    dict_transform = True
     def __init__(self, child):
         self.children = [child]
 
@@ -500,6 +519,7 @@ class StringSplit(_HostStringExpr):
 
 
 class SubstringIndex(_HostStringExpr):
+    dict_transform = True
     """substring_index(str, delim, count) (ref GpuSubstringIndexUtils JNI)."""
 
     def __init__(self, child, delim: str, count: int):

@@ -19,7 +19,7 @@ import glob
 import os
 from typing import List, Optional, Sequence
 
-from ..config import PARQUET_READER_TYPE
+from ..config import MULTITHREADED_READ_THREADS, PARQUET_READER_TYPE
 from ..types import Schema, StructField, from_arrow
 from .file_scan import FileScanBase
 
@@ -98,25 +98,30 @@ class ParquetScanExec(FileScanBase):
             i = fill.index(min(fill))
             bins[i].append((path, g))
             fill[i] += rows
-        out = []
         empty = files[self.paths[0]].schema_arrow.empty_table() \
             if self.paths else None
-        for b in bins:
+
+        def read_bin(b):
+            import pyarrow as pa
             if not b:
-                t = empty
-            else:
-                import pyarrow as pa
-                parts = []
-                by_path: dict = {}
-                for path, g in b:
-                    by_path.setdefault(path, []).append(g)
-                for path, gs in by_path.items():
-                    parts.append(files[path].read_row_groups(
-                        sorted(gs), columns=self.columns))
-                t = pa.concat_tables(parts) if len(parts) > 1 else parts[0]
-            if self.columns and t is not None:
-                t = t.select(self.columns)
-            out.append(t)
+                return empty
+            by_path: dict = {}
+            for path, g in b:
+                by_path.setdefault(path, []).append(g)
+            parts = [files[path].read_row_groups(sorted(gs),
+                                                 columns=self.columns)
+                     for path, gs in by_path.items()]
+            return pa.concat_tables(parts) if len(parts) > 1 else parts[0]
+
+        # overlap bin reads the way the MULTITHREADED reader overlaps
+        # per-file reads (file_scan.py _multithreaded)
+        import concurrent.futures as cf
+        nthreads = int(self.conf.get(MULTITHREADED_READ_THREADS))
+        with cf.ThreadPoolExecutor(max_workers=max(nthreads, 1)) as pool:
+            out = list(pool.map(read_bin, bins))
+        if self.columns:
+            out = [t.select(self.columns) if t is not None else t
+                   for t in out]
         return out
 
     def _filter_row_groups(self, f) -> Optional[List[int]]:

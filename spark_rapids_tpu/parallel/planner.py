@@ -981,6 +981,16 @@ def _strings_of(col):
     return strs, valid
 
 
+def _codes_for(strs, valid, uniq):
+    """Strings -> int32 codes in the given sorted dictionary; invalid
+    rows code to 0 (the one code-assignment rule for every source
+    path — sharded and unsharded encodes must agree)."""
+    codes = np.searchsorted(uniq, strs).astype(np.int32) \
+        if len(uniq) else np.zeros(len(strs), np.int32)
+    codes[~valid] = 0
+    return codes
+
+
 class _ShardedTables:
     """Per-device pre-sharded source tables (row-group-partitioned scan):
     shard i's table goes to device i verbatim — no driver-side concat or
@@ -1275,11 +1285,8 @@ class DistributedPipelineExec(TpuExec):
                 strs, valid = _strings_of(col)
                 uniq = np.unique(strs[valid]) if valid.any() \
                     else np.asarray([], dtype=object)
-                codes = np.searchsorted(uniq, strs).astype(np.int32) \
-                    if len(uniq) else np.zeros(len(strs), np.int32)
-                codes[~valid] = 0
                 dicts[f.dict_id] = uniq
-                arrays.append((codes, valid))
+                arrays.append((_codes_for(strs, valid, uniq), valid))
             else:
                 arrays.append(_encode_plain(col, f.phys))
         return arrays
@@ -1307,13 +1314,8 @@ class DistributedPipelineExec(TpuExec):
                 uniq = np.unique(np.concatenate(live)) if live \
                     else np.asarray([], dtype=object)
                 dicts[f.dict_id] = uniq
-                cols = []
-                for strs, valid in per:
-                    codes = np.searchsorted(uniq, strs).astype(np.int32) \
-                        if len(uniq) else np.zeros(len(strs), np.int32)
-                    codes[~valid] = 0
-                    cols.append((codes, valid))
-                shard_cols[pos] = cols
+                shard_cols[pos] = [(_codes_for(strs, valid, uniq), valid)
+                                   for strs, valid in per]
             else:
                 shard_cols[pos] = [
                     _encode_plain(_one_chunk(t.columns[pos]), f.phys)

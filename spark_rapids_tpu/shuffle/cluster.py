@@ -517,13 +517,15 @@ class LocalCluster:
                 if got is not None:
                     results.append(deserialize_table(got))
         finally:
-            pool.shutdown(wait=False)
+            # settle in-flight tasks BEFORE dropping, or a late map PUT
+            # would recreate blocks for an already-dropped shuffle id
+            pool.shutdown(wait=True)
             for c in self.clients.values():
-                try:
-                    for sid in owned_sids:
+                for sid in owned_sids:
+                    try:
                         c.drop(sid)
-                except Exception:
-                    pass
+                    except Exception:
+                        continue
 
         merged = pa.concat_tables(results) if results else None
         # driver finish: restore names/avg divides, then the upper path
