@@ -345,6 +345,40 @@ def _finish_pair_join(join_type: str, lb: ColumnarBatch, rb: ColumnarBatch,
     return ColumnarBatch(lo.columns + ro.columns, n_out, out_schema)
 
 
+def _row_width_bytes(schema: Schema) -> int:
+    """Fixed-width logical row estimate (strings ~16 B, matching the
+    plan-time estimator's assumption)."""
+    w = 0
+    for f in schema.fields:
+        np_dt = getattr(f.dtype, "np_dtype", None)
+        w += (np_dt.itemsize if np_dt is not None else 16) + 1
+    return max(w, 1)
+
+
+def _record_sides(sides) -> None:
+    """Record each join side's LOGICAL size into the adaptive stats;
+    ``sides`` = [(sig, spillables, schema)]. Lazy device row counts from
+    BOTH sides fetch in ONE packed transfer (only the big-sides shuffled
+    join pays this round trip — the broadcast path's counts are already
+    host ints)."""
+    from ..columnar.packing import fetch_packed
+    from ..plan.cost import record_runtime_size
+    # SpillableBatch mirrors the lazy count — read it WITHOUT get(),
+    # which would unspill whole batches just for a row count
+    lazy = []
+    for _sig, spillables, _schema in sides:
+        for s in spillables:
+            if not isinstance(s._num_rows, (int, np.integer)):
+                lazy.append(s)
+    if lazy:
+        vals = fetch_packed([s._num_rows for s in lazy])
+        for s, v in zip(lazy, vals):
+            s._num_rows = int(v)
+    for sig, spillables, schema in sides:
+        rows = sum(int(s._num_rows) for s in spillables)
+        record_runtime_size(sig, rows * _row_width_bytes(schema))
+
+
 class TpuHashJoinExec(TpuExec):
     def __init__(self, left: TpuExec, right: TpuExec, join_type: str,
                  left_keys: Sequence[Expression],
@@ -371,7 +405,6 @@ class TpuHashJoinExec(TpuExec):
                         for b in self.children[0].execute(ctx)]
         ls, rs = (self.children[0].output_schema(),
                   self.children[1].output_schema())
-
         total_bytes = sum(s.device_bytes() for s in right_batches +
                           left_batches)
         threshold = ctx.conf.join_subpartition_size_bytes
@@ -392,6 +425,12 @@ class TpuHashJoinExec(TpuExec):
                 return self._join(lb, rb, ctx)
 
         out = with_retry_no_split(run, ctx.memory)
+        sigs = getattr(self, "side_sigs", None)
+        if sigs is not None:
+            # AQE stage stats (ref GpuCustomShuffleReaderExec): record
+            # LOGICAL side sizes for the next planning of this shape
+            _record_sides([(sigs[0], left_batches, ls),
+                           (sigs[1], right_batches, rs)])
         for s in right_batches + left_batches:
             s.close()
         rows_m.add(out.num_rows_raw)
@@ -830,6 +869,15 @@ class TpuBroadcastHashJoinExec(TpuHashJoinExec):
             return
         rows_m = ctx.metric(self._exec_id, "numOutputRows", ESSENTIAL)
         bb = build.broadcast(ctx)
+        sigs = getattr(self, "side_sigs", None)
+        if sigs is not None and bb is not None:
+            # record the build side's MEASURED logical bytes: an
+            # over-eager broadcast flips back to shuffled next planning
+            from ..plan.cost import record_runtime_size
+            record_runtime_size(
+                sigs[bi],
+                bb.num_rows * _row_width_bytes(
+                    self.children[bi].output_schema()))
         # runtime bloom filter: built ONCE from the broadcast build side,
         # applied to every stream batch (build side must be right — the
         # filter drops stream=left rows whose keys cannot match). Like

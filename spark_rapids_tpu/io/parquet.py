@@ -108,8 +108,11 @@ class ParquetScanExec(FileScanBase):
             by_path: dict = {}
             for path, g in b:
                 by_path.setdefault(path, []).append(g)
-            parts = [files[path].read_row_groups(sorted(gs),
-                                                 columns=self.columns)
+            # one file's groups land in several bins: each thread opens
+            # its OWN ParquetFile (parquet readers are not thread-safe
+            # for concurrent reads on a shared instance)
+            parts = [pq.ParquetFile(self._cached_path(path))
+                     .read_row_groups(sorted(gs), columns=self.columns)
                      for path, gs in by_path.items()]
             return pa.concat_tables(parts) if len(parts) > 1 else parts[0]
 

@@ -35,6 +35,81 @@ TRANSITION_COST = register(
     internal=True)
 
 
+# ---------------------------------------------------------------------------
+# adaptive runtime statistics (ref GpuCustomShuffleReaderExec / the
+# reference's AQE stage stats, GpuOverrides.scala:4681-4730): execs record
+# the MEASURED size of materialized plan subtrees keyed by a structural
+# signature; the planner prefers these over the crude estimates below, so
+# a join strategy mis-planned from estimates flips on the next planning
+# of the same shape.
+# ---------------------------------------------------------------------------
+
+_RUNTIME_SIZES: dict = {}
+_RUNTIME_SIZES_MAX = 4096
+
+# id-reuse guard (same hazard planner._source_cache_key handles): scan
+# signatures embed id(table); when a table is GC'd, evict every stat
+# whose signature mentions that id so a recycled address can never serve
+# a stale measured size for an unrelated table.
+import weakref  # noqa: E402
+
+_SIG_PIN: "weakref.WeakValueDictionary" = weakref.WeakValueDictionary()
+
+
+def _evict_sigs_for(tid: int):
+    tag = f"#{tid}#"
+    for k in [k for k in _RUNTIME_SIZES if tag in k]:
+        del _RUNTIME_SIZES[k]
+
+
+def _pin_table(t) -> str:
+    tid = id(t)
+    if _SIG_PIN.get(tid) is not t:
+        try:
+            _SIG_PIN[tid] = t
+        except TypeError:
+            return f"#{tid}#"
+        _evict_sigs_for(tid)        # stale stats under a reused id
+        weakref.finalize(t, _evict_sigs_for, tid)
+    return f"#{tid}#"
+
+
+def plan_signature(plan: L.LogicalPlan) -> str:
+    """Structural signature of a logical subtree (stable across runs of
+    the same query shape; scans key on table identity + schema)."""
+    kids = ",".join(plan_signature(c) for c in plan.children)
+    extra = ""
+    if isinstance(plan, L.LogicalScan):
+        extra = (f"{[_pin_table(t) for t in plan.tables]};"
+                 f"{plan.schema().names()}")
+    elif isinstance(plan, L.ParquetScan):
+        extra = ";".join(plan.paths)
+    elif isinstance(plan, L.Filter):
+        extra = plan.condition.key()
+    elif isinstance(plan, L.Project):
+        extra = ",".join(e.key() for e in plan.exprs)
+    elif isinstance(plan, L.Join):
+        extra = (f"{plan.join_type};"
+                 + ",".join(e.key() for e in plan.left_keys) + ";"
+                 + ",".join(e.key() for e in plan.right_keys))
+    elif isinstance(plan, L.Aggregate):
+        extra = (",".join(e.key() for e in plan.groupings) + ";"
+                 + ",".join(a.key() for a in plan.aggs))
+    return f"{type(plan).__name__}[{extra}]({kids})"
+
+
+def record_runtime_size(sig: str, nbytes: int) -> None:
+    if len(_RUNTIME_SIZES) >= _RUNTIME_SIZES_MAX \
+            and sig not in _RUNTIME_SIZES:
+        _RUNTIME_SIZES.pop(next(iter(_RUNTIME_SIZES)))
+    # running max: re-planning must stay safe under varying batch counts
+    _RUNTIME_SIZES[sig] = max(_RUNTIME_SIZES.get(sig, 0), int(nbytes))
+
+
+def runtime_size(sig: str):
+    return _RUNTIME_SIZES.get(sig)
+
+
 def estimate_rows(plan: L.LogicalPlan) -> float:
     """Crude cardinality estimate per logical node."""
     kids = [estimate_rows(c) for c in plan.children]

@@ -407,15 +407,24 @@ class JoinMeta(PlanMeta):
         reference's AQE join-strategy switching,
         GpuOverrides.scala:4681)."""
         from ..config import AUTO_BROADCAST_THRESHOLD
+        from .cost import plan_signature, runtime_size
         from .rewrites import estimated_size_bytes
         p = self.plan
         thr = int(self.conf.get(AUTO_BROADCAST_THRESHOLD))
         if thr <= 0:
             return None
+
+        def side_size(child):
+            # MEASURED size from a previous materialization of this
+            # subtree beats any estimate (the AQE stage-stats analog,
+            # ref GpuCustomShuffleReaderExec)
+            meas = runtime_size(plan_signature(child))
+            return meas if meas is not None \
+                else estimated_size_bytes(child)
         r_ok = p.join_type in ("inner", "left", "leftsemi", "leftanti")
         l_ok = p.join_type in ("inner", "right")
-        rs = estimated_size_bytes(p.children[1]) if r_ok else None
-        ls = estimated_size_bytes(p.children[0]) if l_ok else None
+        rs = side_size(p.children[1]) if r_ok else None
+        ls = side_size(p.children[0]) if l_ok else None
         cand = []
         if rs is not None and rs <= thr:
             cand.append((rs, "right"))
@@ -435,16 +444,24 @@ class JoinMeta(PlanMeta):
         if p.broadcast is None:
             p = copy.copy(p)
             p.broadcast = self._auto_broadcast()
+        from .cost import plan_signature
+        sigs = (plan_signature(p.children[0]),
+                plan_signature(p.children[1]))
         if p.broadcast == "right":
-            return TpuBroadcastHashJoinExec(
+            j = TpuBroadcastHashJoinExec(
                 children[0], BroadcastExchangeExec(children[1]), p.join_type,
                 p.left_keys, p.right_keys, p.condition, build_side="right")
-        if p.broadcast == "left":
-            return TpuBroadcastHashJoinExec(
+        elif p.broadcast == "left":
+            j = TpuBroadcastHashJoinExec(
                 BroadcastExchangeExec(children[0]), children[1], p.join_type,
                 p.left_keys, p.right_keys, p.condition, build_side="left")
-        return TpuHashJoinExec(children[0], children[1], p.join_type,
-                               p.left_keys, p.right_keys, p.condition)
+        else:
+            j = TpuHashJoinExec(children[0], children[1], p.join_type,
+                                p.left_keys, p.right_keys, p.condition)
+        # runtime-stats hookup: the exec records each side's MEASURED
+        # bytes under these signatures when it materializes them
+        j.side_sigs = sigs
+        return j
 
     def convert_to_cpu(self, children):
         from ..exec.joins import CpuJoinExec

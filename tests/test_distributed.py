@@ -588,3 +588,57 @@ def test_conditioned_join_null_safe_condition_no_phantom_rows():
     # matches: k=2 (NULL<=>NULL true), k=3 (30<=>30)
     assert got["k"].tolist() == [2, 3]
     assert got["n"].tolist() == [1, 1]
+
+
+def test_high_cardinality_string_keys_hash_encoded():
+    """Above the dictionary cap, string group keys ride as 64-bit value
+    hashes — NO driver-side global string sort (VERDICT r2 #6). Results
+    identical to the sorted-dictionary path."""
+    rng = np.random.RandomState(21)
+    n = 30000
+    keys = np.asarray([f"user-{i:07d}" for i in
+                       rng.randint(0, 20000, n)], dtype=object)
+    t = pa.table({"g": pa.array(keys),
+                  "v": pa.array(rng.uniform(0, 10, n))})
+    import spark_rapids_tpu.parallel.planner as P
+    # spy: the hash path must never reach the sorted-dictionary encode
+    # (that global STRING sort is the driver bottleneck being avoided)
+    sorted_calls = []
+    orig = P._encode_string_global
+
+    def spy(per, cap, ordered):
+        entry, codes = orig(per, cap, ordered)
+        sorted_calls.append(entry[0])
+        return entry, codes
+
+    sd = _dist_session({"spark.rapids.tpu.distributed.maxDictEntries": 500})
+    q = (sd.create_dataframe(t).group_by("g")
+         .agg(F.sum(F.col("v")).with_name("sv"),
+              F.count_star().with_name("n")))
+    _assert_plan_distributed(q)
+    P._encode_string_global = spy
+    try:
+        got = q.collect_arrow().to_pandas().sort_values("g") \
+            .reset_index(drop=True)
+    finally:
+        P._encode_string_global = orig
+    assert sorted_calls == ["hashed"], sorted_calls
+    pdf = t.to_pandas()
+    want = (pdf.groupby("g", as_index=False)
+            .agg(sv=("v", "sum"), n=("v", "size"))
+            .sort_values("g").reset_index(drop=True))
+    assert len(got) == len(want)
+    np.testing.assert_array_equal(got["g"], want["g"])
+    np.testing.assert_array_equal(got["n"], want["n"])
+    np.testing.assert_allclose(got["sv"], want["sv"], rtol=1e-9)
+
+
+def test_hash_encoded_keys_with_nulls():
+    t = pa.table({"g": pa.array(["a", None, "b", "a", None] * 2000),
+                  "v": pa.array(np.arange(10000, dtype=np.float64))})
+    sd = _dist_session({"spark.rapids.tpu.distributed.maxDictEntries": 1})
+    q = (sd.create_dataframe(t).group_by("g")
+         .agg(F.count_star().with_name("n")))
+    _assert_plan_distributed(q)
+    got = {r["g"]: r["n"] for r in q.collect()}
+    assert got == {"a": 4000, "b": 2000, None: 4000}, got

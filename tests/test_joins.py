@@ -2,7 +2,7 @@
 import pandas as pd
 import pytest
 
-from harness import assert_tpu_and_cpu_equal
+from harness import assert_tpu_and_cpu_equal, tpu_session
 from data_gen import DoubleGen, IntGen, gen_df
 from spark_rapids_tpu.api import functions as F
 
@@ -298,3 +298,45 @@ def test_auto_broadcast_disabled_by_conf():
     df = s.create_dataframe(big).join(s.create_dataframe(dim),
                                       on=[("k", "k2")])
     assert "BroadcastHashJoin" not in df._physical().tree_string()
+
+
+def test_aqe_broadcast_flips_on_measured_size():
+    """AQE analog (VERDICT r2 #7): the first run measures the filtered
+    side's TRUE size; re-planning the same query shape then broadcasts a
+    side the plan-time estimate had called too big."""
+    import numpy as np
+    import pyarrow as pa
+    rng = np.random.RandomState(4)
+    n = 60000
+    left = pa.table({"k": pa.array(rng.randint(0, 1000, n)),
+                     "v": pa.array(rng.uniform(0, 1, n))})
+    # big scan whose filter keeps almost nothing: plan-time estimate
+    # (conservative: filters keep the child size) exceeds the broadcast
+    # threshold, the MEASURED size is tiny
+    right = pa.table({"k2": pa.array(rng.randint(0, 1000, n)),
+                      "w": pa.array(rng.randint(0, 3, n))})
+    thr = 64 * 1024
+    s = tpu_session({"spark.rapids.tpu.sql.autoBroadcastJoinThreshold": thr,
+                     # operator pipeline: the fused fragment's explain
+                     # would hide the join strategy under one node
+                     "spark.rapids.tpu.sql.fusedPipeline.enabled": False})
+
+    def build():
+        r = s.create_dataframe(right).filter(F.col("w") == F.lit(0)) \
+             .filter(F.col("k2") < F.lit(20))
+        return (s.create_dataframe(left)
+                .join(r, on=[(F.col("k"), F.col("k2"))], how="inner")
+                .group_by("k").agg(F.count_star().with_name("n")))
+
+    q1 = build()
+    p1 = q1.explain()
+    assert "BroadcastHashJoin" not in p1, p1   # estimate said too big
+    r1 = q1.collect_arrow()
+    q2 = build()
+    p2 = q2.explain()
+    assert "BroadcastHashJoin" in p2, p2       # measured size flipped it
+    r2 = q2.collect_arrow()
+    g1 = r1.to_pandas().sort_values("k").reset_index(drop=True)
+    g2 = r2.to_pandas().sort_values("k").reset_index(drop=True)
+    np.testing.assert_array_equal(g1["k"], g2["k"])
+    np.testing.assert_array_equal(g1["n"], g2["n"])
