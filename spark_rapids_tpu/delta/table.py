@@ -526,22 +526,18 @@ class MergeBuilder:
         self._insert_values = values if values is not None else {}
         return self
 
-    def _candidate_pairs(self, tt, src, schema):
-        """(ti, si) candidate index pairs for the merge condition. Uses a
-        hash join on any extractable equi-keys (the low-shuffle analog —
-        ref GpuLowShuffleMergeCommand motivation) and only falls back to
-        the cross product for pure theta conditions."""
-        import pyarrow as pa
-        n_t, n_s = tt.num_rows, src.num_rows
+    def _equi_keys(self, schema, src):
+        """[(target_col, source_col)] when the merge condition is a
+        conjunction of column equalities, else None."""
         tnames = set(f.name for f in schema.fields)
         snames = set(src.column_names)
 
-        def equi_keys(e):
+        def walk(e):
             from ..exprs import And, ColumnRef, EqualTo
             if isinstance(e, And):
                 out = []
                 for c in e.children:
-                    k = equi_keys(c)
+                    k = walk(c)
                     if k is None:
                         return None
                     out.extend(k)
@@ -554,8 +550,41 @@ class MergeBuilder:
                     if r.name in tnames and l.name in snames:
                         return [(r.name, l.name)]
             return None
+        return walk(self.condition)
 
-        keys = equi_keys(self.condition)
+    def _prune_predicate(self, schema, src, keys):
+        """Per-file skip predicate from the SOURCE keys' min/max: a
+        target file whose key-column stats cannot overlap the source key
+        range can neither match nor be rewritten — it is skipped without
+        being READ (the low-shuffle property, ref
+        GpuLowShuffleMergeCommand: only touched files rewrite)."""
+        if not keys:
+            return None
+        import pyarrow.compute as pc
+        from ..exprs import (And, ColumnRef, GreaterThanOrEqual,
+                             LessThanOrEqual, Literal)
+        pred = None
+        for tk, sk in keys:
+            col = src.column(sk)
+            if col.length() == col.null_count:
+                continue
+            mm = pc.min_max(col)
+            lo, hi = mm["min"].as_py(), mm["max"].as_py()
+            if lo is None or hi is None:
+                continue
+            dt = schema[tk].dtype
+            term = And(GreaterThanOrEqual(ColumnRef(tk), Literal(lo, dt)),
+                       LessThanOrEqual(ColumnRef(tk), Literal(hi, dt)))
+            pred = term if pred is None else And(pred, term)
+        return pred
+
+    def _candidate_pairs(self, tt, src, schema, keys):
+        """(ti, si) candidate index pairs for the merge condition. Uses a
+        hash join on any extractable equi-keys (the low-shuffle analog —
+        ref GpuLowShuffleMergeCommand motivation) and only falls back to
+        the cross product for pure theta conditions."""
+        import pyarrow as pa
+        n_t, n_s = tt.num_rows, src.num_rows
         if keys:
             kt = pa.table({f"__k{i}": tt.column(tk)
                            for i, (tk, _) in enumerate(keys)} |
@@ -580,17 +609,26 @@ class MergeBuilder:
         src = self.source.collect_arrow() if hasattr(self.source,
                                                      "collect_arrow") \
             else self.source
-        stats = {"num_updated": 0, "num_deleted": 0, "num_inserted": 0}
+        stats = {"num_updated": 0, "num_deleted": 0, "num_inserted": 0,
+                 "num_files_pruned": 0}
         actions: List[dict] = []
         src_matched = np.zeros(src.num_rows, dtype=bool)
         has_matched_clause = bool(self._matched_update) or \
             self._matched_delete
+        keys = self._equi_keys(schema, src)
+        prune_pred = self._prune_predicate(schema, src, keys)
+        from .stats import file_matches
         for add in snap.files.values():
+            if prune_pred is not None and not file_matches(add.stats,
+                                                           prune_pred):
+                # key ranges provably disjoint: untouched file, not read
+                stats["num_files_pruned"] += 1
+                continue
             tt = t._load_file(add, schema)
             n_t, n_s = tt.num_rows, src.num_rows
             if n_t == 0 or n_s == 0:
                 continue
-            ti, si = self._candidate_pairs(tt, src, schema)
+            ti, si = self._candidate_pairs(tt, src, schema, keys)
             if len(ti):
                 pair = pa.Table.from_arrays(
                     list(tt.take(pa.array(ti)).columns) +

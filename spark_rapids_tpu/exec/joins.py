@@ -345,22 +345,14 @@ def _finish_pair_join(join_type: str, lb: ColumnarBatch, rb: ColumnarBatch,
     return ColumnarBatch(lo.columns + ro.columns, n_out, out_schema)
 
 
-def _row_width_bytes(schema: Schema) -> int:
-    """Fixed-width logical row estimate (strings ~16 B, matching the
-    plan-time estimator's assumption)."""
-    w = 0
-    for f in schema.fields:
-        np_dt = getattr(f.dtype, "np_dtype", None)
-        w += (np_dt.itemsize if np_dt is not None else 16) + 1
-    return max(w, 1)
-
-
 def _record_sides(sides) -> None:
     """Record each join side's LOGICAL size into the adaptive stats;
-    ``sides`` = [(sig, spillables, schema)]. Lazy device row counts from
-    BOTH sides fetch in ONE packed transfer (only the big-sides shuffled
-    join pays this round trip — the broadcast path's counts are already
-    host ints)."""
+    ``sides`` = [(sig, spillables, schema)]. Logical bytes = the batch's
+    ACTUAL device footprint scaled by its live-row fraction (the padded
+    layout carries the true per-row width, including strings' code+dict
+    representation); lazy device row counts from BOTH sides fetch in
+    ONE packed transfer (only the big-sides shuffled join pays this
+    round trip — the broadcast path's counts are already host ints)."""
     from ..columnar.packing import fetch_packed
     from ..plan.cost import record_runtime_size
     # SpillableBatch mirrors the lazy count — read it WITHOUT get(),
@@ -375,8 +367,12 @@ def _record_sides(sides) -> None:
         for s, v in zip(lazy, vals):
             s._num_rows = int(v)
     for sig, spillables, schema in sides:
-        rows = sum(int(s._num_rows) for s in spillables)
-        record_runtime_size(sig, rows * _row_width_bytes(schema))
+        total = 0.0
+        for s in spillables:
+            rows = int(s._num_rows)
+            cap = s._cap or max(rows, 1)
+            total += s.device_bytes() * (rows / max(cap, 1))
+        record_runtime_size(sig, int(total))
 
 
 class TpuHashJoinExec(TpuExec):
@@ -874,10 +870,9 @@ class TpuBroadcastHashJoinExec(TpuHashJoinExec):
             # record the build side's MEASURED logical bytes: an
             # over-eager broadcast flips back to shuffled next planning
             from ..plan.cost import record_runtime_size
-            record_runtime_size(
-                sigs[bi],
-                bb.num_rows * _row_width_bytes(
-                    self.children[bi].output_schema()))
+            frac = bb.num_rows / max(bb.padded_len or bb.num_rows, 1)
+            record_runtime_size(sigs[bi],
+                                int(bb.device_size_bytes() * frac))
         # runtime bloom filter: built ONCE from the broadcast build side,
         # applied to every stream batch (build side must be right — the
         # filter drops stream=left rows whose keys cannot match). Like

@@ -40,8 +40,25 @@ def _field_type(t) -> DataType:
             return DecimalType(int(p), int(s))
         if t in _PRIM:
             return _PRIM[t]
-    raise ValueError(f"unsupported iceberg type {t!r} "
-                     "(nested types not yet supported)")
+    if isinstance(t, dict):
+        # nested types (r3; ref iceberg/data java bridge readers):
+        # struct/list/map scan through the host columnar layer — the
+        # engine's collection expressions evaluate them there
+        from ..types import ArrayType, MapType, StructField, StructType
+        kind = t.get("type")
+        if kind == "struct":
+            return StructType([
+                StructField(f["name"], _field_type(f["type"]),
+                            not f.get("required", False))
+                for f in t["fields"]])
+        if kind == "list":
+            return ArrayType(_field_type(t["element"]),
+                             contains_null=not t.get("element-required",
+                                                     False))
+        if kind == "map":
+            return MapType(_field_type(t["key"]),
+                           _field_type(t["value"]))
+    raise ValueError(f"unsupported iceberg type {t!r}")
 
 
 def iceberg_schema_from_json(schema: dict) -> Schema:

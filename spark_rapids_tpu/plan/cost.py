@@ -83,15 +83,28 @@ def plan_signature(plan: L.LogicalPlan) -> str:
         extra = (f"{[_pin_table(t) for t in plan.tables]};"
                  f"{plan.schema().names()}")
     elif isinstance(plan, L.ParquetScan):
-        extra = ";".join(plan.paths)
+        # key on content fingerprint (mtime+size) and projected columns:
+        # an appended file or a wider projection must not inherit a
+        # stale measured size
+        import os
+        fp = []
+        for p in plan.paths:
+            try:
+                st = os.stat(p)
+                fp.append(f"{p}@{st.st_mtime_ns}:{st.st_size}")
+            except OSError:
+                fp.append(p)
+        extra = ";".join(fp) + f";{plan.columns}"
     elif isinstance(plan, L.Filter):
         extra = plan.condition.key()
     elif isinstance(plan, L.Project):
         extra = ",".join(e.key() for e in plan.exprs)
     elif isinstance(plan, L.Join):
+        cond = plan.condition.key() if plan.condition is not None else ""
         extra = (f"{plan.join_type};"
                  + ",".join(e.key() for e in plan.left_keys) + ";"
-                 + ",".join(e.key() for e in plan.right_keys))
+                 + ",".join(e.key() for e in plan.right_keys)
+                 + f";{cond};{plan.broadcast}")
     elif isinstance(plan, L.Aggregate):
         extra = (",".join(e.key() for e in plan.groupings) + ";"
                  + ",".join(a.key() for a in plan.aggs))

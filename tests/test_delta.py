@@ -603,3 +603,67 @@ def test_concurrent_append_through_write_delta(tmp_path):
         DeltaLog.commit = orig
     rows = sorted(r["a"] for r in s.delta_table(p).to_df().collect())
     assert rows == [1, 2, 99]
+
+
+def test_low_shuffle_merge_prunes_unread_files(tmp_path):
+    """Low-shuffle MERGE (VERDICT r2 #8; ref GpuLowShuffleMergeCommand):
+    files whose key-column stats are disjoint from the source keys are
+    neither REWRITTEN nor even READ."""
+    s = tpu_session()
+    # three files with disjoint key ranges
+    for lo in (0, 100, 200):
+        t = pa.table({"k": list(range(lo, lo + 10)),
+                      "v": [lo] * 10})
+        df = s.create_dataframe(t)
+        if lo == 0:
+            df.write_delta(str(tmp_path / "t"))
+        else:
+            df.write_delta(str(tmp_path / "t"), mode="append")
+    dt = s.delta_table(str(tmp_path / "t"))
+    source = s.create_dataframe(pa.table({"sk": [102, 105],
+                                          "sv": [-1, -2]}))
+    import spark_rapids_tpu.delta.table as DT
+    loads = []
+    orig = DT.DeltaTable._load_file
+
+    def spy(self, add, schema, *a, **k):
+        loads.append(add.path)
+        return orig(self, add, schema, *a, **k)
+    DT.DeltaTable._load_file = spy
+    try:
+        from spark_rapids_tpu.exprs import EqualTo
+        st = (dt.merge(source, EqualTo(ColumnRef("k"), ColumnRef("sk")))
+              .when_matched_update({"v": ColumnRef("sv")})
+              .execute())
+    finally:
+        DT.DeltaTable._load_file = orig
+    assert st["num_updated"] == 2
+    assert st["num_files_pruned"] == 2, st
+    assert len(loads) == 1, loads          # only the touched file read
+    out = s.read_delta(str(tmp_path / "t")).to_pandas().sort_values("k")
+    assert out.loc[out["k"] == 102, "v"].tolist() == [-1]
+    assert out.loc[out["k"] == 105, "v"].tolist() == [-2]
+    assert len(out) == 30
+
+
+def test_merge_prune_keeps_insert_semantics(tmp_path):
+    """Pruned files cannot hide not-matched inserts: unmatched source
+    rows still insert."""
+    s = tpu_session()
+    s.create_dataframe(pa.table({"k": [1, 2], "v": [1, 2]})
+                       ).write_delta(str(tmp_path / "t"))
+    s.create_dataframe(pa.table({"k": [50, 51], "v": [5, 5]})
+                       ).write_delta(str(tmp_path / "t"), mode="append")
+    dt = s.delta_table(str(tmp_path / "t"))
+    source = s.create_dataframe(pa.table({"sk": [50, 999],
+                                          "sv": [500, 999]}))
+    from spark_rapids_tpu.exprs import EqualTo
+    st = (dt.merge(source, EqualTo(ColumnRef("k"), ColumnRef("sk")))
+          .when_matched_update({"v": ColumnRef("sv")})
+          .when_not_matched_insert({"k": ColumnRef("sk"),
+                                    "v": ColumnRef("sv")})
+          .execute())
+    assert st["num_updated"] == 1 and st["num_inserted"] == 1
+    out = s.read_delta(str(tmp_path / "t")).to_pandas().sort_values("k")
+    assert out["k"].tolist() == [1, 2, 50, 51, 999]
+    assert out.loc[out["k"] == 50, "v"].tolist() == [500]

@@ -606,8 +606,8 @@ def test_high_cardinality_string_keys_hash_encoded():
     sorted_calls = []
     orig = P._encode_string_global
 
-    def spy(per, cap, ordered):
-        entry, codes = orig(per, cap, ordered)
+    def spy(cols, cap, ordered, code_dtype=None):
+        entry, codes = orig(cols, cap, ordered, code_dtype)
         sorted_calls.append(entry[0])
         return entry, codes
 

@@ -249,13 +249,48 @@ def test_iceberg_schema_and_query(tmp_path):
     assert out["n"].sum() == (exp["a"] < 10).sum()
 
 
-def test_iceberg_nested_schema_rejected(tmp_path):
+def test_iceberg_nested_types_scan(tmp_path):
+    """struct/list columns (r3; VERDICT r2 #8): schema converts and the
+    scan reads nested data through the host columnar layer."""
+    nested = pa.table({
+        "a": pa.array([1, 2, 3], pa.int64()),
+        "tags": pa.array([["x", "y"], [], ["z"]],
+                         pa.list_(pa.string())),
+        "info": pa.array([{"c": 1, "d": "u"}, {"c": 2, "d": "v"},
+                          {"c": None, "d": "w"}],
+                         pa.struct([("c", pa.int32()),
+                                    ("d", pa.string())])),
+    })
+    _build_iceberg_table(str(tmp_path), [nested])
+    md_path = tmp_path / "metadata" / "v1.metadata.json"
+    md = json.loads(md_path.read_text())
+    md["schemas"][0]["fields"] = [
+        {"id": 1, "name": "a", "required": True, "type": "long"},
+        {"id": 2, "name": "tags", "required": False,
+         "type": {"type": "list", "element": "string"}},
+        {"id": 3, "name": "info", "required": False,
+         "type": {"type": "struct", "fields": [
+             {"id": 4, "name": "c", "required": False, "type": "int"},
+             {"id": 5, "name": "d", "required": False,
+              "type": "string"}]}},
+    ]
+    md_path.write_text(json.dumps(md))
+    from spark_rapids_tpu.iceberg import IcebergTable
+    sch = IcebergTable(str(tmp_path)).schema
+    assert sch["tags"].dtype.name == "array<string>"
+    assert sch["info"].dtype.name.startswith("struct<")
+    s = tpu_session()
+    out = s.read_iceberg(str(tmp_path)).collect()
+    assert [r["tags"] for r in out] == [["x", "y"], [], ["z"]]
+    assert out[2]["info"]["d"] == "w"
+
+
+def test_iceberg_truly_unknown_type_rejected(tmp_path):
     _build_iceberg_table(str(tmp_path), [_tbl(0)])
     md_path = tmp_path / "metadata" / "v1.metadata.json"
     md = json.loads(md_path.read_text())
     md["schemas"][0]["fields"].append(
-        {"id": 3, "name": "nest", "required": False,
-         "type": {"type": "struct", "fields": []}})
+        {"id": 3, "name": "x", "required": False, "type": "variant"})
     md_path.write_text(json.dumps(md))
     from spark_rapids_tpu.iceberg import IcebergTable
     with pytest.raises(ValueError, match="unsupported iceberg type"):
