@@ -25,7 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..types import (BOOL, DataType, DecimalType, FLOAT64, INT64, Schema,
-                     numeric)
+                     TypeEnum, numeric, tpuNative)
 from .base import DVal, Expression, Literal
 from ..columnar.segmented import SortedSegments, seg_max, seg_min, seg_sum
 
@@ -180,13 +180,26 @@ def _dec_normalize(l0, l1, l2):
 
 class Sum(AggregateExpression):
     pandas_agg = "sum"
+    device_type_sig = tpuNative.with_psnote(
+        TypeEnum.DECIMAL,
+        "totals whose |unscaled value| >= 2^63 finalize as NULL (device "
+        "decimals are int64-scaled; Spark non-ANSI would return up to "
+        "38 digits)")
 
     def data_type(self, schema):
         dt = self.child.data_type(schema)
         if dt.name in ("tinyint", "smallint", "int", "bigint"):
             return INT64
         if isinstance(dt, DecimalType):
-            # Spark: sum(decimal(p,s)) -> decimal(min(p+10, 38), s)
+            # Spark: sum(decimal(p,s)) -> decimal(min(p+10, 38), s).
+            # ENGINE LIMITATION (documented in docs/performance.md and
+            # supported_ops): device decimals are int64-scaled, so a
+            # finalized total whose |unscaled value| >= 2^63 returns
+            # NULL even when the declared result precision could hold it
+            # (Spark non-ANSI would return the value up to min(p+10,38)
+            # digits). The limb accumulation itself is exact; only the
+            # final materialization is capped. Same cap as ingest
+            # (types.py/_decimal-to-int64).
             return DecimalType(min(dt.precision + 10, 38), dt.scale)
         return FLOAT64 if dt.name in ("float", "double") else dt
 

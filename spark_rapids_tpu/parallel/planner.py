@@ -258,11 +258,18 @@ def _probe_low_cardinality(exec_node, name: str,
     if not isinstance(exec_node, InMemoryScanExec) or not exec_node.tables:
         return False
     try:
+        import pyarrow as pa
         t = exec_node.tables[0]
-        col = t.column(name).slice(0, min(sample, t.num_rows))
-        de = _one_chunk(col).dictionary_encode()
-        n = max(col.length(), 1)
-        return len(de.dictionary) <= max(n // 2, 1)
+        n = t.num_rows
+        # head + middle + tail slices: value-clustered data (logs sorted
+        # by key) would fool a head-only sample into the int32/sorted
+        # path and reintroduce the driver string sort the cap prevents
+        k = max(sample // 3, 1)
+        parts = [_one_chunk(t.column(name).slice(off, k))
+                 for off in (0, max((n - k) // 2, 0), max(n - k, 0))]
+        col = pa.concat_arrays(parts)
+        de = col.dictionary_encode()
+        return len(de.dictionary) <= max(col.length() // 2, 1)
     except Exception:
         return False
 
@@ -1059,6 +1066,11 @@ def _encode_string_global(cols, cap: int, ordered: bool,
     def sorted_path(distincts):
         uniq = np.unique(np.concatenate(distincts)) if distincts \
             else np.asarray([], dtype=object)
+        if np.dtype(code_dtype).itemsize < 8 and len(uniq) >= (1 << 31):
+            raise ValueError(
+                "dictionary exceeds int32 code space (mis-probed "
+                "cardinality); raise distributed.maxDictEntries or "
+                "disable distribution for this query")
         ranks = [np.searchsorted(uniq, d).astype(np.int64)
                  for d in dvals]
         return ("sorted", uniq), emit(ranks, code_dtype)

@@ -85,16 +85,22 @@ def plan_signature(plan: L.LogicalPlan) -> str:
     elif isinstance(plan, L.ParquetScan):
         # key on content fingerprint (mtime+size) and projected columns:
         # an appended file or a wider projection must not inherit a
-        # stale measured size
-        import os
-        fp = []
-        for p in plan.paths:
-            try:
-                st = os.stat(p)
-                fp.append(f"{p}@{st.st_mtime_ns}:{st.st_size}")
-            except OSError:
-                fp.append(p)
-        extra = ";".join(fp) + f";{plan.columns}"
+        # stale measured size. Memoized per node — plan_signature runs
+        # several times per planning and must not re-stat thousands of
+        # files each time.
+        fp = getattr(plan, "_sig_fingerprint", None)
+        if fp is None:
+            import os
+            parts = []
+            for p in plan.paths:
+                try:
+                    st = os.stat(p)
+                    parts.append(f"{p}@{st.st_mtime_ns}:{st.st_size}")
+                except OSError:
+                    parts.append(p)
+            fp = ";".join(parts)
+            plan._sig_fingerprint = fp
+        extra = fp + f";{plan.columns}"
     elif isinstance(plan, L.Filter):
         extra = plan.condition.key()
     elif isinstance(plan, L.Project):

@@ -124,6 +124,32 @@ def exec_inventory() -> List[Dict]:
     return recs
 
 
+def fallback_histogram(exprs=None) -> List[Tuple[str, int, List[str]]]:
+    """(reason category, count, expression names): why host-only
+    expressions are host-only — the coverage-gap histogram VERDICT r2 #9
+    asks for, grouped by the stated device_unsupported reason family."""
+    import collections
+    groups: Dict[str, List[str]] = collections.defaultdict(list)
+    for r in (expression_inventory() if exprs is None else exprs):
+        if r["device"]:
+            continue
+        mod = r["module"]
+        if mod == "string_fns":
+            cat = ("string transform (dictionary-evaluated over dict "
+                   "columns; per-row host otherwise)")
+        elif mod == "collection_fns":
+            cat = "nested-type expression (host Arrow kernels)"
+        elif mod == "json_fns":
+            cat = "JSON expression (host parser)"
+        elif mod == "higher_order":
+            cat = "higher-order function (host row loop)"
+        else:
+            cat = f"other host-only ({mod})"
+        groups[cat].append(r["name"])
+    return sorted(((k, len(v), sorted(v)) for k, v in groups.items()),
+                  key=lambda x: -x[1])
+
+
 def generate_supported_ops_md() -> str:
     exprs = expression_inventory()
     execs = exec_inventory()
@@ -133,6 +159,17 @@ def generate_supported_ops_md() -> str:
            "(`python -m spark_rapids_tpu.tools.supported_ops`). "
            "S = supported on device, NS = not supported (host fallback), "
            "PS = partial (see note).", ""]
+    n_dev = sum(1 for r in exprs if r["device"])
+    n_host = sum(1 for r in exprs if not r["device"])
+    out += ["## Coverage summary", "",
+            f"* **{len(exprs)}** expressions registered "
+            f"(reference registry: ~224 rules, GpuOverrides.scala:3935)",
+            f"* **{n_dev}** evaluate on device, **{n_host}** are "
+            "host-only", f"* **{len(execs)}** operators", "",
+            "### Host-fallback reasons", ""]
+    for cat, n, names in fallback_histogram(exprs):
+        out.append(f"* {n} × {cat}: {', '.join(names)}")
+    out.append("")
     out.append("## Execs")
     out.append("")
     out.append("Exec | Module | Device")

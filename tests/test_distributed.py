@@ -606,7 +606,7 @@ def test_high_cardinality_string_keys_hash_encoded():
     sorted_calls = []
     orig = P._encode_string_global
 
-    def spy(cols, cap, ordered, code_dtype=None):
+    def spy(cols, cap, ordered, code_dtype=__import__('numpy').int64):
         entry, codes = orig(cols, cap, ordered, code_dtype)
         sorted_calls.append(entry[0])
         return entry, codes

@@ -490,3 +490,35 @@ def test_orc_string_predicate_pruning(tmp_path):
         return (s.read_orc(p).filter(F.col("s") == F.lit("g9"))
                 .agg(F.sum(F.col("v")).with_name("sv")))
     assert_tpu_and_cpu_equal(q)
+
+
+@pytest.mark.parametrize("comp", ["SNAPPY", "ZSTD"])
+def test_orc_stripe_pruning_compressed_footers(tmp_path, comp):
+    """snappy/zstd-compressed ORC footers parse and prune (VERDICT r2
+    #10 — pruning must not silently vanish on common writers)."""
+    import numpy as np
+    import pyarrow as pa
+    from pyarrow import orc
+    n = 100_000
+    t = pa.table({"a": pa.array(np.arange(n, dtype=np.int64)),
+                  "f": pa.array(np.arange(n) * 0.5)})
+    p = str(tmp_path / f"t_{comp}.orc")
+    orc.write_table(t, p, stripe_size=64 * 1024, compression=comp)
+    from spark_rapids_tpu.io.orc_meta import read_orc_meta
+    meta = read_orc_meta(p)
+    assert meta is not None and meta.stripe_stats is not None
+    assert len(meta.stripe_stats) >= 2     # compression packs stripes
+    assert sum(meta.stripe_rows) == n
+    from spark_rapids_tpu.config import TpuConf
+    from spark_rapids_tpu.exprs import (ColumnRef, GreaterThanOrEqual,
+                                        Literal)
+    from spark_rapids_tpu.io.orc import OrcScanExec, orc_schema
+    scan = OrcScanExec([p], orc_schema(p), None, TpuConf())
+    scan.set_predicate(GreaterThanOrEqual(ColumnRef("a"), Literal(99_000)))
+    keep = scan._filter_stripes(p, len(meta.stripe_rows))
+    assert keep is not None and 0 < len(keep) < len(meta.stripe_rows)
+    # and the full read still matches
+    s = tpu_session()
+    out = (s.read_orc(p).filter(F.col("a") >= F.lit(99_000))
+           .agg(F.count_star().with_name("c")).collect())
+    assert out[0]["c"] == 1000
