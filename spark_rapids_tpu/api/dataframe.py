@@ -427,6 +427,11 @@ class DataFrame:
         physical = self._physical()
         if self.session.conf.is_explain_only:
             raise RuntimeError("session is in explainOnly mode")
+        # re-install this query's per-expression disables for the runtime
+        # device/host checks: planning by another session in between must
+        # not leak its conf into this execution (thread-local set)
+        from ..plan.op_confs import install_from_conf
+        install_from_conf(self.session.conf)
         from ..aux.fault import DeviceDumpHandler
         from ..aux.lore import lore_wrap
         from ..aux.metrics import TaskMetrics

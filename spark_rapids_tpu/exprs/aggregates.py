@@ -125,6 +125,10 @@ class AggregateExpression:
         raise NotImplementedError
 
     def device_unsupported_reason(self, schema: Schema) -> Optional[str]:
+        from .base import expression_disabled_reason
+        r = expression_disabled_reason(type(self))
+        if r:
+            return r
         if self.child is None:
             return None
         r = self.child.fully_device_supported(schema)

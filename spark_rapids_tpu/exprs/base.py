@@ -31,6 +31,32 @@ class Unsupported(Exception):
     converts this into a fallback reason (ref RapidsMeta willNotWorkOnGpu)."""
 
 
+#: expression class names disabled by `spark.rapids.tpu.sql.expression.<Name>`
+#: confs (ref GpuOverrides.scala:3935 — every ExprRule gets an enable conf;
+#: disabling it forces the expression off the accelerator). Thread-local:
+#: plan/op_confs.install_from_conf installs the set from the query's conf at
+#: BOTH plan time (tagging) and execution time (the dataframe sink
+#: re-installs before running), so interleaved sessions on other threads
+#: cannot contaminate this query's fallback decisions. Consulted by the SAME
+#: fully_device_supported checks the execs use at run time, so a disabled
+#: expression falls back to host evaluation end to end.
+import threading as _thr
+
+_DISABLED = _thr.local()
+
+
+def set_disabled_expressions(names) -> None:
+    _DISABLED.sets = frozenset(names)
+
+
+def expression_disabled_reason(cls) -> Optional[str]:
+    name = cls.__name__
+    if name in getattr(_DISABLED, "sets", ()):
+        return (f"{name} disabled by "
+                f"spark.rapids.tpu.sql.expression.{name}=false")
+    return None
+
+
 class DVal(NamedTuple):
     """A traced device value: padded data + validity mask (+static dtype)."""
     data: jnp.ndarray
@@ -167,6 +193,9 @@ class Expression:
         return None
 
     def fully_device_supported(self, schema: Schema) -> Optional[str]:
+        r = expression_disabled_reason(type(self))
+        if r:
+            return r
         r = self.device_unsupported_reason(schema)
         if r:
             return r

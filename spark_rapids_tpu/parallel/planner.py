@@ -265,9 +265,15 @@ def _probe_low_cardinality(exec_node, name: str,
         # by key) would fool a head-only sample into the int32/sorted
         # path and reintroduce the driver string sort the cap prevents
         k = max(sample // 3, 1)
-        parts = [_one_chunk(t.column(name).slice(off, k))
-                 for off in (0, max((n - k) // 2, 0), max(n - k, 0))]
-        col = pa.concat_arrays(parts)
+        if n <= 3 * k:
+            # small table: probe it whole — overlapping head/middle/tail
+            # slices would triple-count rows and misclassify all-distinct
+            # columns as low-cardinality
+            col = _one_chunk(t.column(name).slice(0, n))
+        else:
+            parts = [_one_chunk(t.column(name).slice(off, k))
+                     for off in (0, (n - k) // 2, n - k)]
+            col = pa.concat_arrays(parts)
         de = col.dictionary_encode()
         return len(de.dictionary) <= max(col.length() // 2, 1)
     except Exception:

@@ -85,11 +85,16 @@ def plan_signature(plan: L.LogicalPlan) -> str:
     elif isinstance(plan, L.ParquetScan):
         # key on content fingerprint (mtime+size) and projected columns:
         # an appended file or a wider projection must not inherit a
-        # stale measured size. Memoized per node — plan_signature runs
-        # several times per planning and must not re-stat thousands of
-        # files each time.
-        fp = getattr(plan, "_sig_fingerprint", None)
-        if fp is None:
+        # stale measured size. Memo lifetime is a short freshness window,
+        # not the node's lifetime — plan_signature runs several times
+        # per planning and must not re-stat thousands of files each time,
+        # but a node re-planned after its files changed must see them.
+        import time
+        memo = getattr(plan, "_sig_fingerprint", None)
+        now = time.monotonic()
+        if memo is not None and now - memo[1] < 2.0:
+            fp = memo[0]
+        else:
             import os
             parts = []
             for p in plan.paths:
@@ -99,7 +104,7 @@ def plan_signature(plan: L.LogicalPlan) -> str:
                 except OSError:
                     parts.append(p)
             fp = ";".join(parts)
-            plan._sig_fingerprint = fp
+            plan._sig_fingerprint = (fp, now)
         extra = fp + f";{plan.columns}"
     elif isinstance(plan, L.Filter):
         extra = plan.condition.key()

@@ -1,0 +1,112 @@
+"""Per-operator enable confs, auto-registered from the live registries.
+
+Reference analog: GpuOverrides creates one ``spark.rapids.sql.expression.X``
+conf per ExprRule and one ``spark.rapids.sql.exec.X`` conf per ExecRule
+(GpuOverrides.scala:3935 expression map, :4121 exec map; the confs appear in
+docs/additional-functionality/advanced_configs.md) — setting one to false
+forces that operator off the accelerator with an explain reason.
+
+Here the registries are the Python class inventories: every concrete
+``Expression`` subclass gets ``spark.rapids.tpu.sql.expression.<Name>`` and
+every logical-plan rule gets ``spark.rapids.tpu.sql.exec.<Name>``.  The
+expression confs feed ``exprs.base.set_disabled_expressions`` (consulted by
+the same ``fully_device_supported`` check the execs use at run time, so a
+disabled expression is host-evaluated end to end); the exec confs are
+checked in ``PlanMeta.tag`` (a disabled exec converts to its CPU twin and
+shows up in explain output).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List
+
+from .. import config as C
+
+__all__ = ["ensure_op_confs", "install_from_conf", "exec_conf_key",
+           "EXPR_CONF_PREFIX", "EXEC_CONF_PREFIX"]
+
+EXPR_CONF_PREFIX = "spark.rapids.tpu.sql.expression."
+EXEC_CONF_PREFIX = "spark.rapids.tpu.sql.exec."
+
+_LOCK = threading.RLock()
+_DONE = False
+
+
+def _expression_names() -> List[str]:
+    from ..tools.supported_ops import _load_registries, _all_subclasses
+    import inspect
+    from ..exprs.base import Expression
+    from ..exprs.aggregates import AggregateExpression
+    _load_registries()
+    names = set()
+    for root in (Expression, AggregateExpression):
+        for cls in _all_subclasses(root):
+            if cls.__name__.startswith("_") or inspect.isabstract(cls):
+                continue
+            names.add(cls.__name__)
+    return sorted(names)
+
+
+def _exec_names() -> List[str]:
+    from .overrides import _RULES
+    return sorted(cls.__name__ for cls in _RULES)
+
+
+def ensure_op_confs() -> None:
+    """Idempotently register the per-op confs (called by plan_query and by
+    the docs generator so docs/configs.md lists every knob)."""
+    global _DONE
+    with _LOCK:
+        if _DONE:
+            return
+        for n in _expression_names():
+            key = EXPR_CONF_PREFIX + n
+            if key not in C._REGISTRY:
+                C.register(key, True,
+                           f"Enable expression {n} on the TPU; false forces "
+                           "host evaluation (ref GpuOverrides.scala:3935 "
+                           "per-ExprRule confs).")
+        for n in _exec_names():
+            key = EXEC_CONF_PREFIX + n
+            if key not in C._REGISTRY:
+                C.register(key, True,
+                           f"Enable the {n} operator on the TPU; false "
+                           "converts it to the CPU twin (ref "
+                           "GpuOverrides.scala:4121 per-ExecRule confs).")
+        # only a fully-registered registry marks done: a failure above is
+        # retried on the next call instead of silently skipping forever
+        _DONE = True
+
+
+def exec_conf_key(plan) -> str:
+    return EXEC_CONF_PREFIX + type(plan).__name__
+
+
+def _falsy(v) -> bool:
+    if isinstance(v, bool):
+        return not v
+    return str(v).strip().lower() in ("false", "0", "no", "off")
+
+
+def install_from_conf(conf: C.TpuConf) -> None:
+    """Install the (thread-local) disabled-expression set for this query.
+
+    Called at plan time for tagging and again by the execution sink, so the
+    runtime device/host decision always reflects THIS query's conf even when
+    other sessions plan in between. Only raw conf keys are scanned — per-op
+    confs are deliberately not resolvable from environment variables (the
+    upper-cased env name cannot be mapped back to the case-sensitive class
+    name); everything else keeps ConfEntry's env fallback.
+    """
+    ensure_op_confs()
+    disabled = set()
+    for k, v in conf.raw.items():
+        if k.startswith(EXPR_CONF_PREFIX) and _falsy(v):
+            disabled.add(k[len(EXPR_CONF_PREFIX):])
+    from ..exprs.base import set_disabled_expressions
+    set_disabled_expressions(disabled)
+
+
+def exec_disabled(conf: C.TpuConf, plan) -> bool:
+    v = conf.raw.get(exec_conf_key(plan))
+    return v is not None and _falsy(v)

@@ -48,6 +48,8 @@ def plan_query(plan: L.LogicalPlan, conf: TpuConf, mesh=None) -> TpuExec:
     when one is configured (ref GpuShuffleExchangeExecBase: the planner —
     not the user — makes queries distributed)."""
     from .rewrites import prune_columns
+    from .op_confs import install_from_conf
+    install_from_conf(conf)
     if conf.sql_enabled:
         # TPU-targeted rewrites (distinct-agg expansion, union-of-aggs
         # single-pass) BEFORE pruning: the union rewrite keys on shared
@@ -81,6 +83,8 @@ def plan_query(plan: L.LogicalPlan, conf: TpuConf, mesh=None) -> TpuExec:
 
 def explain_potential_tpu_plan(plan: L.LogicalPlan, conf: TpuConf) -> str:
     """Public ExplainPlan API analog (ref ExplainPlan.scala:28)."""
+    from .op_confs import install_from_conf
+    install_from_conf(conf)
     meta = wrap_plan(plan, conf)
     meta.tag()
     return meta.explain(only_not_on_tpu=False) or "<entire plan runs on TPU>"

@@ -221,6 +221,8 @@ def generate_operators_score_csv() -> str:
 
 def write_all(repo_root: str) -> List[str]:
     import os
+    from ..plan.op_confs import ensure_op_confs
+    ensure_op_confs()   # docs/configs.md lists the per-op enable confs too
     from ..config import generate_docs as config_docs
     docs = os.path.join(repo_root, "docs")
     gen = os.path.join(repo_root, "tools", "generated_files")
