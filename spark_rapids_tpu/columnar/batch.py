@@ -58,6 +58,11 @@ def _decimal_unscaled_int64(arr, valid: np.ndarray) -> np.ndarray:
     return np.where(valid, lo, 0)
 
 
+def _is_device_list(dt) -> bool:
+    from .nested import device_list_ok
+    return device_list_ok(dt)
+
+
 def _try_dict_encode(col, n: int, p: int):
     """pa string array -> (codes, valid, sorted dictionary) or None."""
     import pyarrow as pa
@@ -179,6 +184,7 @@ class ColumnarBatch:
         fields: List[StructField] = []
         staged = []    # (col index, dtype) for one batched H2D at the end
         host_pairs = []
+        list_staged = []   # (col index, dtype, rectangle arrays, mirror)
         for name, col in zip(table.column_names, table.columns):
             if isinstance(col, pa.ChunkedArray):
                 col = col.combine_chunks() if col.num_chunks != 1 else col.chunk(0)
@@ -212,6 +218,16 @@ class ColumnarBatch:
                 staged.append((len(cols), dt, None, mirror))
                 host_pairs.extend([d, v])
                 cols.append(None)
+            elif pad and _is_device_list(dt):
+                # list-of-primitive: dense rectangular device layout
+                # (columnar/nested.py); width-capped columns stay host
+                from .nested import encode_list_column
+                encl = encode_list_column(col, dt, p)
+                if encl is not None:
+                    list_staged.append((len(cols), dt, encl, col))
+                    cols.append(None)
+                else:
+                    cols.append(HostColumn(col, dt))
             else:
                 # only the padded (device-bound) path dict-encodes; host
                 # execs using pad=False want plain host strings
@@ -261,6 +277,16 @@ class ColumnarBatch:
                         cols[i] = DictColumn(put[2 * k], put[2 * k + 1],
                                              dt, dictionary,
                                              host_mirror=mirror)
+        if list_staged:
+            from .nested import ListColumn
+            flat = []
+            for _i, _dt, (vals, ev, lens, rv, _w), _m in list_staged:
+                flat.extend((vals, ev, lens, rv))
+            put = jax.device_put(flat)   # one transfer for all rectangles
+            for k, (i, dt, enc, mirror) in enumerate(list_staged):
+                cols[i] = ListColumn(put[4 * k], put[4 * k + 3], dt,
+                                     put[4 * k + 1], put[4 * k + 2],
+                                     host_mirror=mirror)
         return ColumnarBatch(cols, n, Schema(fields))
 
     @staticmethod
@@ -308,8 +334,10 @@ class ColumnarBatch:
         from .packing import fetch_packed
         # ONE packed transfer for every device column (leaf-by-leaf waits
         # pay per-transfer latency on a tunneled TPU)
+        from .nested import ListColumn
         dev = [(i, c) for i, c in enumerate(self.columns)
                if isinstance(c, DeviceColumn)
+               and not isinstance(c, ListColumn)
                and getattr(c, "host_mirror", None) is None]
         mirror_pos = {i for i, c in enumerate(self.columns)
                       if isinstance(c, DeviceColumn)
@@ -368,6 +396,25 @@ class ColumnarBatch:
             return self
         out = ColumnarBatch.from_arrow(self.to_arrow())
         out.meta = self.meta
+        return out
+
+    def with_lists_on_host(self) -> "ColumnarBatch":
+        """Demote device list columns (rectangles) to HostColumns.
+
+        Row-rearranging execs that own their kernels (joins, sorts, aggs,
+        windows, partitioning) move 1D (data, validity) pairs; list
+        payloads crossing them materialize host-side first — project/
+        filter pipelines keep lists on device via the lane decomposition
+        (exprs/compiler._lane_pairs). Honest fallback, mirrored in
+        supported_ops docs."""
+        from .nested import ListColumn
+        if not any(isinstance(c, ListColumn) for c in self.columns):
+            return self
+        n = self.num_rows
+        cols = [HostColumn(c.to_arrow(n), c.dtype)
+                if isinstance(c, ListColumn) else c
+                for c in self.columns]
+        out = ColumnarBatch(cols, n, self.schema, meta=self.meta)
         return out
 
     # -- ops used by the runtime ------------------------------------------

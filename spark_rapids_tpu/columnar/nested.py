@@ -1,0 +1,208 @@
+"""Device-resident list columns: dense ragged-to-rectangular layout.
+
+TPU-first design for SURVEY.md hard-part #2 (nested types in HBM without
+cudf — ref collectionOperations.scala, 1,802 LoC of cudf list kernels).
+cudf stores lists as offsets + child buffers; XLA wants static shapes, so a
+list column here is a RECTANGLE:
+
+  * ``data``        [P, W] element values, W = bucketed max list length
+  * ``elem_valid``  [P, W] element validity (False for NULL elements AND
+                    for slots at/after each row's length)
+  * ``lengths``     [P]    int32 per-row lengths (0 for NULL rows)
+  * ``validity``    [P]    row validity (inherited DeviceColumn slot)
+
+Collection expressions become plain vectorized ops over axis 1 (masked
+reductions, axis-1 sorts, gathers) that XLA fuses like any elementwise
+work — no ragged buffers, no scalar loops. Rows whose lists exceed the
+width cap stay host columns (honest per-column fallback, the same
+cost-based split the string dictionary uses for high cardinality).
+
+Row-rearranging kernels (filter compaction, joins' gathers) operate on 1D
+(data, validity) pairs; ``kernel_lanes``/``from_lanes`` decompose a list
+column into W+1 such pairs and reassemble it, so the existing variadic-sort
+compaction machinery moves list rows without learning about axis 1.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..types import ArrayType, DataType, from_arrow
+from .column import DeviceColumn
+
+__all__ = ["ListColumn", "encode_list_column", "WIDTH_BUCKETS",
+           "device_list_ok"]
+
+#: list-width buckets: each distinct W compiles its own kernel variants,
+#: so widths snap to a short ladder (the row-count bucket idea on axis 1)
+WIDTH_BUCKETS = (4, 8, 16, 32, 64, 128, 256)
+
+
+def width_bucket(w: int) -> Optional[int]:
+    for b in WIDTH_BUCKETS:
+        if w <= b:
+            return b
+    return None
+
+
+def device_list_ok(dt: DataType) -> bool:
+    """True when ``dt`` is a list type whose elements can live densely on
+    device (primitive element — nested-of-nested stays host)."""
+    return (isinstance(dt, ArrayType) and dt.element.np_dtype is not None)
+
+
+class ListColumn(DeviceColumn):
+    """Device list column in the rectangular layout (module docstring)."""
+
+    __slots__ = ("elem_valid", "lengths")
+
+    def __init__(self, data, validity, dtype: ArrayType, elem_valid,
+                 lengths, host_mirror=None):
+        super().__init__(data, validity, dtype, host_mirror=host_mirror)
+        self.elem_valid = elem_valid
+        self.lengths = lengths
+
+    # -- shape -------------------------------------------------------------
+    @property
+    def width(self) -> int:
+        return int(self.data.shape[1])
+
+    @property
+    def padded_len(self) -> int:
+        return int(self.data.shape[0])
+
+    def nbytes(self) -> int:
+        return int(self.data.size * self.data.dtype.itemsize
+                   + self.elem_valid.size + self.validity.size
+                   + self.lengths.size * 4)
+
+    # -- rearranging-kernel interop ---------------------------------------
+    def kernel_lanes(self) -> List[tuple]:
+        """Decompose into 1D (data, validity) pairs for the variadic
+        compaction/gather kernels: W value lanes + one (lengths, row
+        validity) pair, in that order."""
+        return ([(self.data[:, j], self.elem_valid[:, j])
+                 for j in range(self.width)]
+                + [(self.lengths, self.validity)])
+
+    def from_lanes(self, pairs: List[tuple]) -> "ListColumn":
+        w = self.width
+        data = jnp.stack([d for d, _ in pairs[:w]], axis=1)
+        ev = jnp.stack([v for _, v in pairs[:w]], axis=1)
+        lengths, validity = pairs[w]
+        return ListColumn(data, validity, self.dtype, ev, lengths)
+
+    def with_arrays(self, data, validity):
+        raise TypeError(
+            "ListColumn rows rearrange via kernel_lanes()/from_lanes(); "
+            "a 1D with_arrays() would silently corrupt the rectangle")
+
+    # -- host materialization ---------------------------------------------
+    def to_arrow(self, num_rows: int):
+        import pyarrow as pa
+        from .packing import fetch_packed
+        from ..types import to_arrow as _toa
+        n = int(num_rows)
+        vals, ev, lens, rv = fetch_packed([
+            self.data.reshape(-1), self.elem_valid.reshape(-1),
+            self.lengths, self.validity])
+        w = self.width
+        vals = vals.reshape(-1, w)[:n]
+        ev = ev.reshape(-1, w)[:n]
+        lens = np.clip(lens[:n], 0, w).astype(np.int32)
+        rv = rv[:n]
+        pos = np.arange(w)[None, :] < lens[:, None]
+        flat_vals = vals[pos]
+        flat_valid = ev[pos]
+        offsets = np.zeros(n + 1, dtype=np.int32)
+        np.cumsum(lens, out=offsets[1:])
+        # a null at offsets position i marks LIST ROW i as null
+        off_arr = pa.array(offsets.astype(np.int64), mask=np.concatenate(
+            [~rv, [False]]).astype(bool)).cast(pa.int32())
+        elem_pa = pa.array(flat_vals, type=_toa(self.dtype.element),
+                           from_pandas=True, mask=~flat_valid)
+        return pa.ListArray.from_arrays(off_arr, elem_pa)
+
+    def to_numpy(self, num_rows: int):
+        a = self.to_arrow(num_rows)
+        return (np.asarray(a.to_pylist(), dtype=object),
+                ~np.asarray(a.is_null()))
+
+    def __repr__(self):
+        return (f"ListColumn({self.dtype.element.name}[{self.width}], "
+                f"padded={self.padded_len})")
+
+
+def _flatten_list_column(c: ListColumn):
+    return (c.data, c.validity, c.elem_valid, c.lengths), c.dtype
+
+
+def _unflatten_list_column(dtype, children):
+    data, validity, elem_valid, lengths = children
+    return ListColumn(data, validity, dtype, elem_valid, lengths)
+
+
+jax.tree_util.register_pytree_node(
+    ListColumn, _flatten_list_column, _unflatten_list_column)
+
+
+def encode_list_column(col, dtype: ArrayType, padded_len: int,
+                       width_cap: int = WIDTH_BUCKETS[-1]):
+    """Arrow ListArray -> host-prepared rectangle arrays, or None when the
+    column cannot (or should not) live densely on device: non-primitive
+    element, or max list length beyond the cap (W*P element slots are
+    materialized — a few long lists would explode HBM).
+
+    Returns (values[P,W], elem_valid[P,W], lengths[P], row_valid[P], W).
+    """
+    import pyarrow as pa
+    if not device_list_ok(dtype):
+        return None
+    if isinstance(col, pa.ChunkedArray):
+        col = col.combine_chunks()
+    n = len(col)
+    if n == 0:
+        w = WIDTH_BUCKETS[0]
+        np_dt = dtype.element.np_dtype
+        return (np.zeros((padded_len, w), np_dt),
+                np.zeros((padded_len, w), np.bool_),
+                np.zeros(padded_len, np.int32),
+                np.zeros(padded_len, np.bool_), w)
+    offsets = np.asarray(col.offsets)
+    row_valid = ~np.asarray(col.is_null())
+    lens = (offsets[1:] - offsets[:-1]).astype(np.int64)
+    lens = np.where(row_valid, lens, 0)
+    maxw = int(lens.max()) if n else 0
+    w = width_bucket(max(maxw, 1))
+    if w is None or w * padded_len > (1 << 26):
+        return None                     # width cap or >64M element slots
+    np_dt = dtype.element.np_dtype
+    flat = col.values                   # raw child array; offsets are
+    elem_valid_flat = ~np.asarray(flat.is_null())   # absolute into it
+    if np_dt == np.bool_:
+        fv = flat.fill_null(False)
+    else:
+        fv = flat.fill_null(0)
+    at = fv.type
+    if pa.types.is_date32(at):
+        fv = fv.cast(pa.int32())
+    elif pa.types.is_timestamp(at):
+        fv = fv.cast(pa.int64())
+    flat_np = fv.to_numpy(zero_copy_only=False).astype(np_dt, copy=False)
+    base = offsets[:-1].astype(np.int64)
+    pos = base[:, None] + np.arange(w)[None, :]
+    in_list = np.arange(w)[None, :] < lens[:, None]
+    pos = np.clip(pos, 0, max(len(flat_np) - 1, 0))
+    values = np.zeros((padded_len, w), dtype=np_dt)
+    ev = np.zeros((padded_len, w), dtype=np.bool_)
+    if len(flat_np):
+        values[:n] = np.where(in_list, flat_np[pos], np_dt.type(0))
+        ev[:n] = in_list & elem_valid_flat[pos]
+    lengths = np.zeros(padded_len, dtype=np.int32)
+    lengths[:n] = lens.astype(np.int32)
+    rv = np.zeros(padded_len, dtype=np.bool_)
+    rv[:n] = row_valid
+    return values, ev, lengths, rv, w

@@ -195,7 +195,18 @@ class TpuProjectExec(TpuExec):
                 leaf = self._dict_chain_leaf(inner, in_schema)
                 if leaf is not None:
                     self.dict_chain[i] = (inner, leaf)
+        #: device exprs referencing ArrayType columns: the batch may carry
+        #: them as HostColumns (width cap, columnar/nested.py) — those
+        #: exprs drop to host PER BATCH (the dict-filter bail-out pattern)
+        from ..types import ArrayType
+        self._list_refs = {
+            i: [r for r in set(self.exprs[i].references())
+                if r in in_schema.names()
+                and isinstance(in_schema[r].dtype, ArrayType)]
+            for i in self.device_idx}
+        self._list_refs = {i: v for i, v in self._list_refs.items() if v}
         self._projector = None
+        self._sub_projectors = {}
         self._dict_xform_cache = {}
 
     @staticmethod
@@ -264,14 +275,44 @@ class TpuProjectExec(TpuExec):
             out: List[Optional[object]] = [None] * len(self.exprs)
             for i, name in self.passthrough.items():
                 out[i] = batch.column_by_name(name)
-            if dev_exprs:
-                if self._projector is None:
-                    self._projector = compile_projection(dev_exprs,
-                                                         child_schema)
+            host_now = []
+            dev_now = self.device_idx
+            if self._list_refs:
+                from ..columnar.nested import ListColumn
+                host_now = [
+                    i for i, names in self._list_refs.items()
+                    if any(not isinstance(batch.column_by_name(nm),
+                                          ListColumn) for nm in names)]
+                if host_now:
+                    dev_now = [i for i in self.device_idx
+                               if i not in host_now]
+            if dev_now:
+                if dev_now is self.device_idx:
+                    if self._projector is None:
+                        self._projector = compile_projection(dev_exprs,
+                                                             child_schema)
+                    proj = self._projector
+                else:
+                    key = tuple(dev_now)
+                    proj = self._sub_projectors.get(key)
+                    if proj is None:
+                        proj = compile_projection(
+                            [self.exprs[i] for i in dev_now],
+                            child_schema)
+                        self._sub_projectors[key] = proj
                 with ctx.semaphore.held():
-                    dcols = self._projector.run(batch)
-                for i, c in zip(self.device_idx, dcols):
+                    dcols = proj.run(batch)
+                for i, c in zip(dev_now, dcols):
                     out[i] = c
+            for i in host_now:
+                arr = self.exprs[i].eval_host(batch)
+                dt = self._schema.fields[i].dtype
+                if dt.device_backed:
+                    import pyarrow as pa
+                    hb = ColumnarBatch.from_arrow(pa.table({"c": arr}))
+                    out[i] = hb.columns[0]
+                else:
+                    out[i] = HostColumn(arr, dt)
             for i in self.host_idx:
                 chain = self.dict_chain.get(i)
                 if chain is not None:
@@ -395,25 +436,20 @@ class TpuFilterExec(TpuExec):
 
     def _filter_mixed(self, batch: ColumnarBatch) -> ColumnarBatch:
         """Device columns compact on device; host columns filter via Arrow
-        with the same mask."""
+        with the same mask. When the condition itself references a column
+        that is host-resident in THIS batch (e.g. a width-capped list,
+        columnar/nested.py), the whole batch filters on host."""
+        from ..columnar import DeviceColumn as _DC
+        from ..exprs.compiler import filter_batch_by_mask
+        refs = set(self.condition.references())
+        names = batch.schema.names()
+        if any(nm in refs and not isinstance(batch.column_by_name(nm), _DC)
+               for nm in names):
+            import pyarrow.compute as pc
+            mask = pc.fill_null(self.condition.eval_host(batch), False)
+            return ColumnarBatch.from_arrow(batch.to_arrow().filter(mask))
         keep = eval_predicate_device(self.condition, batch)
-        dev_pos = [i for i, c in enumerate(batch.columns)
-                   if isinstance(c, DeviceColumn)]
-        arrays = [(batch.columns[i].data, batch.columns[i].validity)
-                  for i in dev_pos]
-        outs, count = _compact_kernel(arrays, keep, batch.padded_len)
-        n = int(count)
-        keep_np = np.asarray(keep)[:batch.num_rows]
-        new_cols: List[object] = list(batch.columns)
-        for i, (d, v) in zip(dev_pos, outs):
-            new_cols[i] = batch.columns[i].with_arrays(d, v)
-        import pyarrow as pa
-        mask = pa.array(keep_np)
-        for i, c in enumerate(batch.columns):
-            if isinstance(c, HostColumn):
-                new_cols[i] = HostColumn(
-                    c.array.slice(0, batch.num_rows).filter(mask), c.dtype)
-        return ColumnarBatch(new_cols, n, batch.schema, meta=batch.meta)
+        return filter_batch_by_mask(batch, keep)
 
     def describe(self):
         return f"Filter[{self.condition.name_hint}]"

@@ -395,10 +395,14 @@ class TpuHashJoinExec(TpuExec):
         rows_m = ctx.metric(self._exec_id, "numOutputRows", ESSENTIAL)
         # build side: coalesce right entirely; stream left batches
         # (ref GpuShuffledHashJoinExec build-side semantics)
-        right_batches = [SpillableBatch(b.ensure_device(), ctx.memory)
-                         for b in self.children[1].execute(ctx)]
-        left_batches = [SpillableBatch(b.ensure_device(), ctx.memory)
-                        for b in self.children[0].execute(ctx)]
+        # list payloads materialize host-side: the join gather kernels move
+        # 1D lanes only (columnar/nested.py with_lists_on_host)
+        right_batches = [SpillableBatch(
+            b.ensure_device().with_lists_on_host(), ctx.memory)
+            for b in self.children[1].execute(ctx)]
+        left_batches = [SpillableBatch(
+            b.ensure_device().with_lists_on_host(), ctx.memory)
+            for b in self.children[0].execute(ctx)]
         ls, rs = (self.children[0].output_schema(),
                   self.children[1].output_schema())
         total_bytes = sum(s.device_bytes() for s in right_batches +
@@ -790,10 +794,14 @@ class TpuNestedLoopJoinExec(TpuExec):
         rows_m = ctx.metric(self._exec_id, "numOutputRows", ESSENTIAL)
         ls, rs = (self.children[0].output_schema(),
                   self.children[1].output_schema())
-        right_batches = [SpillableBatch(b.ensure_device(), ctx.memory)
-                         for b in self.children[1].execute(ctx)]
-        left_batches = [SpillableBatch(b.ensure_device(), ctx.memory)
-                        for b in self.children[0].execute(ctx)]
+        # list payloads materialize host-side: the join gather kernels move
+        # 1D lanes only (columnar/nested.py with_lists_on_host)
+        right_batches = [SpillableBatch(
+            b.ensure_device().with_lists_on_host(), ctx.memory)
+            for b in self.children[1].execute(ctx)]
+        left_batches = [SpillableBatch(
+            b.ensure_device().with_lists_on_host(), ctx.memory)
+            for b in self.children[0].execute(ctx)]
 
         def run():
             with ctx.semaphore.held():
@@ -888,7 +896,7 @@ class TpuBroadcastHashJoinExec(TpuHashJoinExec):
             bloom = None
         produced = False
         for sb in self.children[1 - bi].execute(ctx):
-            sb = sb.ensure_device()
+            sb = sb.ensure_device().with_lists_on_host()
             def run(sb=sb):
                 with ctx.semaphore.held():
                     if bloom is not None and sb.num_rows > 0:

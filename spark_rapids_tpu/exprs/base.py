@@ -57,8 +57,19 @@ def expression_disabled_reason(cls) -> Optional[str]:
     return None
 
 
+class ListVal(NamedTuple):
+    """Traced device LIST value in the rectangular layout
+    (columnar/nested.py): rides in DVal.data for ArrayType-typed values.
+    values[P, W] element data, elem_valid[P, W], lengths[P]."""
+    values: jnp.ndarray
+    elem_valid: jnp.ndarray
+    lengths: jnp.ndarray
+
+
 class DVal(NamedTuple):
-    """A traced device value: padded data + validity mask (+static dtype)."""
+    """A traced device value: padded data + validity mask (+static dtype).
+    For ArrayType values, ``data`` is a ListVal rectangle and ``validity``
+    remains the per-row mask."""
     data: jnp.ndarray
     validity: jnp.ndarray
     dtype: DataType
@@ -237,9 +248,15 @@ class ColumnRef(Expression):
         return [self.name]
 
     def device_unsupported_reason(self, schema: Schema) -> Optional[str]:
-        if not schema[self.name].dtype.device_backed:
-            return f"column {self.name}: {schema[self.name].dtype.name} is host-only"
-        return None
+        dt = schema[self.name].dtype
+        if dt.device_backed:
+            return None
+        from ..columnar.nested import device_list_ok
+        if device_list_ok(dt):
+            # list-of-primitive rides the dense rectangle (nested.py);
+            # width-capped batches demote to host per batch at run time
+            return None
+        return f"column {self.name}: {dt.name} is host-only"
 
     def eval_device(self, ctx: EvalContext) -> DVal:
         return ctx.columns[ctx.schema.index_of(self.name)]

@@ -35,11 +35,86 @@ __all__ = [
 
 
 class _HostCollectionExpr(Expression):
-    """Base for host-evaluated nested-type expressions; device tagging
-    yields the explicit reason used by explain (ref NOT_ON_GPU)."""
+    """Base for nested-type expressions. Host (Arrow) evaluation is the
+    floor; subclasses with a dense rectangular device path (lists of
+    primitives in the [P, W] layout, columnar/nested.py) override
+    ``_device_list_reason`` to return None and implement ``eval_device``
+    over ListVal rectangles — the TPU-first replacement for cudf's list
+    kernels (ref collectionOperations.scala)."""
+
+    def _device_list_reason(self, schema: Schema) -> Optional[str]:
+        return "nested-type expression runs on host"
 
     def device_unsupported_reason(self, schema: Schema) -> Optional[str]:
-        return f"{type(self).__name__}: nested-type expressions run on host"
+        from .base import expression_disabled_reason
+        r = expression_disabled_reason(type(self))
+        if r is not None:
+            return r
+        r = self._device_list_reason(schema)
+        return None if r is None else f"{type(self).__name__}: {r}"
+
+
+def _dense_list_reason(e, schema) -> Optional[str]:
+    """Shared precondition for the device path: first child is a list of
+    device-backed primitives (the rectangle layout)."""
+    from ..columnar.nested import device_list_ok
+    dt = e.children[0].data_type(schema)
+    if not device_list_ok(dt):
+        return f"{dt} has no dense device layout"
+    return None
+
+
+def _in_list_mask(lv):
+    """bool[P, W]: positions before each row's length."""
+    import jax.numpy as jnp
+    w = lv.values.shape[1]
+    return jnp.arange(w, dtype=jnp.int32)[None, :] < lv.lengths[:, None]
+
+
+def _list_arg(ctx, e):
+    from .base import ListVal
+    v = e.eval_device(ctx)
+    assert isinstance(v.data, ListVal), "planner must route host lists away"
+    return v
+
+
+def _needle_reason(e, schema) -> Optional[str]:
+    """Shared by ArrayContains/ArrayPosition: dense list + primitive needle."""
+    r = _dense_list_reason(e, schema)
+    if r is not None:
+        return r
+    vdt = e.children[1].data_type(schema)
+    if vdt.np_dtype is None:
+        return f"needle type {vdt} is host-only"
+    return None
+
+
+def _needle_eq(ctx, array_expr, value_expr):
+    """(array DVal, value DVal, eq[P, W]): element equality restricted to
+    valid in-list positions — the shared core of contains/position."""
+    import jax.numpy as jnp
+    arr = _list_arg(ctx, array_expr)
+    val = value_expr.eval_device(ctx)
+    lv = arr.data
+    in_list = _in_list_mask(lv)
+    needle = jnp.broadcast_to(jnp.asarray(val.data),
+                              (lv.values.shape[0],))
+    eq = jnp.logical_and(lv.values == needle[:, None],
+                         jnp.logical_and(lv.elem_valid, in_list))
+    return arr, val, eq
+
+
+def _gather_element(lv, idx):
+    """Gather element at 0-based ``idx`` (int32[P]) per row from a ListVal:
+    (data[P], elem_valid_at[P], in_bounds[P]) — the shared core of
+    element_at/get."""
+    import jax.numpy as jnp
+    w = lv.values.shape[1]
+    ok = jnp.logical_and(idx >= 0, idx < lv.lengths)
+    j = jnp.clip(idx, 0, w - 1)[:, None]
+    data = jnp.take_along_axis(lv.values, j, axis=1)[:, 0]
+    ev = jnp.take_along_axis(lv.elem_valid, j, axis=1)[:, 0]
+    return data, ev, ok
 
 
 def _elem_type(dt: DataType) -> DataType:
@@ -82,6 +157,19 @@ class Size(_HostCollectionExpr):
     def key(self):
         return f"Size({self.children[0].key()},legacy={self.legacy})"
 
+    def _device_list_reason(self, schema):
+        return _dense_list_reason(self, schema)
+
+    def eval_device(self, ctx):
+        import jax.numpy as jnp
+        from .base import DVal
+        v = _list_arg(ctx, self.children[0])
+        lens = v.data.lengths.astype(jnp.int32)
+        if self.legacy:
+            return DVal(jnp.where(v.validity, lens, jnp.int32(-1)),
+                        jnp.ones_like(v.validity), INT32)
+        return DVal(lens, v.validity, INT32)
+
 
 class ArrayContains(_HostCollectionExpr):
     def __init__(self, array, value):
@@ -105,6 +193,22 @@ class ArrayContains(_HostCollectionExpr):
                 out.append(False)
         return _pa(out, BOOL)
 
+    def _device_list_reason(self, schema):
+        return _needle_reason(self, schema)
+
+    def eval_device(self, ctx):
+        import jax.numpy as jnp
+        from .base import DVal
+        arr, val, eq = _needle_eq(ctx, self.children[0], self.children[1])
+        lv = arr.data
+        found = jnp.any(eq, axis=1)
+        has_null = jnp.any(jnp.logical_and(_in_list_mask(lv),
+                                           ~lv.elem_valid), axis=1)
+        valid = jnp.logical_and(
+            jnp.logical_and(arr.validity, val.validity),
+            jnp.logical_or(found, ~has_null))
+        return DVal(found, valid, BOOL)
+
 
 class ArrayPosition(_HostCollectionExpr):
     """1-based position of first match, 0 if absent, NULL on null inputs."""
@@ -125,6 +229,19 @@ class ArrayPosition(_HostCollectionExpr):
             else:
                 out.append(a.index(v) + 1 if v in a else 0)
         return _pa(out, INT64)
+
+    def _device_list_reason(self, schema):
+        return _needle_reason(self, schema)
+
+    def eval_device(self, ctx):
+        import jax.numpy as jnp
+        from .base import DVal
+        arr, val, eq = _needle_eq(ctx, self.children[0], self.children[1])
+        found = jnp.any(eq, axis=1)
+        first = jnp.argmax(eq, axis=1).astype(jnp.int64)
+        data = jnp.where(found, first + 1, jnp.int64(0))
+        return DVal(data, jnp.logical_and(arr.validity, val.validity),
+                    INT64)
 
 
 class ElementAt(_HostCollectionExpr):
@@ -161,6 +278,32 @@ class ElementAt(_HostCollectionExpr):
                 out.append(c[i] if 0 <= i < len(c) else None)
         return _pa(out, dt)
 
+    def _device_list_reason(self, schema):
+        r = _dense_list_reason(self, schema)
+        if r is not None:
+            return r
+        # index 0 raises in Spark; a kernel cannot raise mid-trace, so
+        # only statically-nonzero literal indices take the device path
+        k = self.children[1]
+        if not (isinstance(k, Literal) and isinstance(k.value, int)
+                and k.value != 0):
+            return "index must be a nonzero integer literal"
+        return None
+
+    def eval_device(self, ctx):
+        import jax.numpy as jnp
+        from .base import DVal
+        kval = self.children[1].eval_device(ctx)
+        arr = _list_arg(ctx, self.children[0])
+        k = jnp.broadcast_to(jnp.asarray(kval.data).astype(jnp.int32),
+                             (ctx.padded_len,))
+        idx = jnp.where(k > 0, k - 1, arr.data.lengths + k)
+        data, ev, ok = _gather_element(arr.data, idx)
+        valid = jnp.logical_and(
+            jnp.logical_and(arr.validity, kval.validity),
+            jnp.logical_and(ok, ev))
+        return DVal(data, valid, self.data_type(ctx.schema))
+
 
 class GetArrayItem(_HostCollectionExpr):
     """arr[i]: 0-based ordinal extraction, OOB/negative => NULL."""
@@ -182,6 +325,28 @@ class GetArrayItem(_HostCollectionExpr):
             else:
                 out.append(a[i])
         return _pa(out, dt)
+
+    def _device_list_reason(self, schema):
+        r = _dense_list_reason(self, schema)
+        if r is not None:
+            return r
+        idt = self.children[1].data_type(schema)
+        if idt.np_dtype is None or idt.np_dtype.kind not in "iu":
+            return "ordinal must be integral"
+        return None
+
+    def eval_device(self, ctx):
+        import jax.numpy as jnp
+        from .base import DVal
+        ordv = self.children[1].eval_device(ctx)
+        arr = _list_arg(ctx, self.children[0])
+        idx = jnp.broadcast_to(
+            jnp.asarray(ordv.data).astype(jnp.int32), (ctx.padded_len,))
+        data, ev, ok = _gather_element(arr.data, idx)
+        valid = jnp.logical_and(
+            jnp.logical_and(arr.validity, ordv.validity),
+            jnp.logical_and(ok, ev))
+        return DVal(data, valid, self.data_type(ctx.schema))
 
 
 class GetMapValue(_HostCollectionExpr):
@@ -246,6 +411,58 @@ class SortArray(_HostCollectionExpr):
             out.append(nulls + nn if asc else list(reversed(nn)) + nulls)
         return _pa(out, dt)
 
+    def _device_list_reason(self, schema):
+        r = _dense_list_reason(self, schema)
+        if r is not None:
+            return r
+        if not isinstance(self.children[1], Literal):
+            return "ascending flag must be a literal"
+        return None
+
+    def key(self):
+        # the ascending flag is baked into the kernel STRUCTURE (not a
+        # traced operand), so it must stay in the cache key even under
+        # literal parameterization
+        asc = (self.children[1].value
+               if isinstance(self.children[1], Literal) else "?")
+        return f"SortArray({self.children[0].key()},asc={asc})"
+
+    def eval_device(self, ctx):
+        import jax
+        import jax.numpy as jnp
+        from .base import DVal, ListVal
+        arr = _list_arg(ctx, self.children[0])
+        asc = bool(self.children[1].value)
+        lv = arr.data
+        in_list = _in_list_mask(lv)
+        live = jnp.logical_and(lv.elem_valid, in_list)
+        # rank: nulls 0, valid 1, padding 2 -> ascending variadic sort
+        # along axis 1 gives [nulls][values asc][padding] per row
+        rank = jnp.where(in_list,
+                         jnp.where(lv.elem_valid, jnp.int32(1),
+                                   jnp.int32(0)),
+                         jnp.int32(2))
+        srank, svals = jax.lax.sort((rank, lv.values), dimension=1,
+                                    num_keys=2)
+        w = lv.values.shape[1]
+        nullcnt = jnp.sum(jnp.logical_and(in_list, ~lv.elem_valid),
+                          axis=1, dtype=jnp.int32)
+        validcnt = jnp.sum(live, axis=1, dtype=jnp.int32)
+        if asc:
+            out_vals, out_rank = svals, srank
+        else:
+            # desc = reversed valid run first, then the null slots
+            j = jnp.arange(w, dtype=jnp.int32)[None, :]
+            idx = jnp.where(j < validcnt[:, None],
+                            lv.lengths[:, None] - 1 - j,
+                            j - validcnt[:, None])
+            idx = jnp.clip(idx, 0, w - 1)
+            out_vals = jnp.take_along_axis(svals, idx, axis=1)
+            out_rank = jnp.take_along_axis(srank, idx, axis=1)
+        out_ev = jnp.logical_and(out_rank == jnp.int32(1), in_list)
+        return DVal(ListVal(out_vals, out_ev, lv.lengths), arr.validity,
+                    self.data_type(ctx.schema))
+
 
 class _ArrayReduce(_HostCollectionExpr):
     """min/max over elements ignoring nulls; empty/all-null => NULL."""
@@ -266,6 +483,48 @@ class _ArrayReduce(_HostCollectionExpr):
             vs = [v for v in (a or []) if v is not None]
             out.append(type(self)._pick(vs) if vs else None)
         return _pa(out, dt)
+
+    def _device_list_reason(self, schema):
+        return _dense_list_reason(self, schema)
+
+    def eval_device(self, ctx):
+        import jax.numpy as jnp
+        from .base import DVal
+        arr = _list_arg(ctx, self.children[0])
+        lv = arr.data
+        live = jnp.logical_and(lv.elem_valid, _in_list_mask(lv))
+        vdt = lv.values.dtype
+        is_min = type(self)._pick is min
+        if jnp.issubdtype(vdt, jnp.floating):
+            hi, lo = jnp.asarray(jnp.inf, vdt), jnp.asarray(-jnp.inf, vdt)
+            nanv = jnp.isnan(lv.values)
+            # Spark orders NaN greatest: min skips NaN unless all-NaN,
+            # max returns NaN when any NaN present
+            if is_min:
+                base = jnp.where(jnp.logical_and(live, ~nanv),
+                                 lv.values, hi)
+                red = jnp.min(base, axis=1)
+                all_nan = ~jnp.any(jnp.logical_and(live, ~nanv), axis=1)
+                red = jnp.where(all_nan, jnp.asarray(jnp.nan, vdt), red)
+            else:
+                base = jnp.where(jnp.logical_and(live, ~nanv),
+                                 lv.values, lo)
+                red = jnp.max(base, axis=1)
+                any_nan = jnp.any(jnp.logical_and(live, nanv), axis=1)
+                red = jnp.where(any_nan, jnp.asarray(jnp.nan, vdt), red)
+        elif vdt == jnp.bool_:
+            sentinel = is_min               # True floors min, False maxes
+            base = jnp.where(live, lv.values, sentinel)
+            red = (jnp.min if is_min else jnp.max)(base, axis=1)
+        else:
+            info = jnp.iinfo(vdt)
+            sentinel = info.max if is_min else info.min
+            base = jnp.where(live, lv.values,
+                             jnp.asarray(sentinel, vdt))
+            red = (jnp.min if is_min else jnp.max)(base, axis=1)
+        has = jnp.any(live, axis=1)
+        return DVal(red, jnp.logical_and(arr.validity, has),
+                    self.data_type(ctx.schema))
 
 
 class ArrayMin(_ArrayReduce):
@@ -329,6 +588,58 @@ class Slice(_HostCollectionExpr):
             i = s - 1 if s > 0 else len(a) + s
             out.append([] if i < 0 else a[i:i + ln])
         return _pa(out, dt)
+
+    def _device_list_reason(self, schema):
+        r = _dense_list_reason(self, schema)
+        if r is not None:
+            return r
+        # start=0 / length<0 raise in Spark; kernels cannot raise, so the
+        # device path requires statically-checked literals
+        s, ln = self.children[1], self.children[2]
+        if not (isinstance(s, Literal) and isinstance(s.value, int)
+                and s.value != 0):
+            return "start must be a nonzero integer literal"
+        if not (isinstance(ln, Literal) and isinstance(ln.value, int)
+                and ln.value >= 0):
+            return "length must be a non-negative integer literal"
+        return None
+
+    def key(self):
+        # start/length shape the kernel statically — keep them in the
+        # cache key even under literal parameterization
+        s = (self.children[1].value
+             if isinstance(self.children[1], Literal) else "?")
+        ln = (self.children[2].value
+              if isinstance(self.children[2], Literal) else "?")
+        return f"Slice({self.children[0].key()},{s},{ln})"
+
+    def eval_device(self, ctx):
+        import jax.numpy as jnp
+        from .base import DVal, ListVal
+        arr = _list_arg(ctx, self.children[0])
+        s = int(self.children[1].value)
+        ln = int(self.children[2].value)
+        lv = arr.data
+        w = lv.values.shape[1]
+        start = (jnp.full_like(lv.lengths, s - 1) if s > 0
+                 else lv.lengths + jnp.int32(s))
+        neg = start < 0                      # before the array: []
+        j = jnp.arange(w, dtype=jnp.int32)[None, :]
+        src = start[:, None] + j
+        take = jnp.logical_and(
+            jnp.logical_and(j < ln, src < lv.lengths[:, None]),
+            jnp.logical_and(src >= 0, ~neg[:, None]))
+        srcc = jnp.clip(src, 0, w - 1)
+        vals = jnp.where(take,
+                         jnp.take_along_axis(lv.values, srcc, axis=1),
+                         jnp.zeros((), lv.values.dtype))
+        ev = jnp.logical_and(
+            jnp.take_along_axis(lv.elem_valid, srcc, axis=1), take)
+        out_len = jnp.where(
+            neg, jnp.int32(0),
+            jnp.clip(lv.lengths - start, 0, jnp.int32(ln)))
+        return DVal(ListVal(vals, ev, out_len), arr.validity,
+                    self.data_type(ctx.schema))
 
 
 class ArrayRepeat(_HostCollectionExpr):
@@ -587,6 +898,27 @@ class ArrayReverse(_HostCollectionExpr):
         dt = self.data_type(batch.schema)
         return _pa([None if a is None else list(reversed(a)) for a in rows], dt)
 
+    def _device_list_reason(self, schema):
+        return _dense_list_reason(self, schema)
+
+    def eval_device(self, ctx):
+        import jax.numpy as jnp
+        from .base import DVal, ListVal
+        arr = _list_arg(ctx, self.children[0])
+        lv = arr.data
+        w = lv.values.shape[1]
+        j = jnp.arange(w, dtype=jnp.int32)[None, :]
+        src = lv.lengths[:, None] - 1 - j
+        ok = j < lv.lengths[:, None]
+        srcc = jnp.clip(src, 0, w - 1)
+        vals = jnp.where(ok,
+                         jnp.take_along_axis(lv.values, srcc, axis=1),
+                         jnp.zeros((), lv.values.dtype))
+        ev = jnp.logical_and(
+            jnp.take_along_axis(lv.elem_valid, srcc, axis=1), ok)
+        return DVal(ListVal(vals, ev, lv.lengths), arr.validity,
+                    self.data_type(ctx.schema))
+
 
 # ---------------------------------------------------------------------------
 # Map expressions
@@ -751,6 +1083,47 @@ class CreateArray(_HostCollectionExpr):
         if not cols:
             return _pa([[]] * batch.num_rows, dt)
         return _pa([list(row) for row in zip(*cols)], dt)
+
+    def _device_list_reason(self, schema):
+        from ..columnar.nested import device_list_ok, width_bucket
+        if not self.children:
+            return "empty array literal is host-built"
+        if width_bucket(len(self.children)) is None:
+            return (f"{len(self.children)} elements exceeds the device "
+                    "width cap")
+        if not device_list_ok(self.data_type(schema)):
+            return f"{self.data_type(schema)} has no dense device layout"
+        for c in self.children:
+            if c.data_type(schema).np_dtype is None \
+                    and c.data_type(schema) != NULLTYPE:
+                return "element type is host-only"
+        return None
+
+    def eval_device(self, ctx):
+        import jax.numpy as jnp
+        from ..columnar.nested import width_bucket
+        from .base import DVal, ListVal
+        dt = self.data_type(ctx.schema)
+        np_dt = dt.element.np_dtype
+        k = len(self.children)
+        w = width_bucket(k)
+        vals, evs = [], []
+        for c in self.children:
+            v = c.eval_device(ctx)
+            data = jnp.broadcast_to(jnp.asarray(v.data),
+                                    (ctx.padded_len,)).astype(np_dt)
+            vv = jnp.broadcast_to(jnp.asarray(v.validity),
+                                  (ctx.padded_len,))
+            vals.append(data)
+            evs.append(vv)
+        pad = w - k
+        values = jnp.stack(vals + [jnp.zeros(ctx.padded_len, np_dt)] * pad,
+                           axis=1)
+        ev = jnp.stack(evs + [jnp.zeros(ctx.padded_len, jnp.bool_)] * pad,
+                       axis=1)
+        lengths = jnp.full(ctx.padded_len, jnp.int32(k))
+        return DVal(ListVal(values, ev, lengths),
+                    jnp.ones(ctx.padded_len, jnp.bool_), dt)
 
 
 class CreateMap(_HostCollectionExpr):

@@ -60,14 +60,21 @@ class DeviceProjector:
             _KERNEL_CACHE[self._key] = self._fn
 
     def _build(self):
+        from .base import ListVal
         exprs, schema = self.exprs, self.schema
         dtypes = [f.dtype for f in schema.fields]  # static, closed over
         slots = {id(l): i for i, l in enumerate(self._lits)}
 
         @functools.partial(jax.jit, static_argnums=(2,))
         def kernel(cols, num_rows, padded_len, scalars=()):
-            dvals = [None if c is None else DVal(c[0], c[1], dt)
-                     for c, dt in zip(cols, dtypes)]
+            dvals = []
+            for c, dt in zip(cols, dtypes):
+                if c is None:
+                    dvals.append(None)
+                elif len(c) == 4:       # list rectangle (nested.py)
+                    dvals.append(DVal(ListVal(c[0], c[2], c[3]), c[1], dt))
+                else:
+                    dvals.append(DVal(c[0], c[1], dt))
             ctx = EvalContext(schema, dvals, num_rows, padded_len,
                               scalars, slots)
             outs = []
@@ -81,18 +88,29 @@ class DeviceProjector:
 
     def run(self, batch: ColumnarBatch,
             extra_scalars: tuple = ()) -> List[DeviceColumn]:
+        from ..columnar.nested import ListColumn
+        from ..types import ArrayType
+        from .base import ListVal
         p = batch.padded_len
         cols = []
         for i, f in enumerate(batch.schema.fields):
             c = batch.columns[i]
-            if isinstance(c, DeviceColumn):
+            if isinstance(c, ListColumn):
+                cols.append((c.data, c.validity, c.elem_valid, c.lengths))
+            elif isinstance(c, DeviceColumn):
                 cols.append((c.data, c.validity))
             else:
                 cols.append(None)  # host column: device exprs must not touch it
         num_rows = jnp.int32(batch.num_rows_raw)
         outs = self._fn(cols, num_rows, p, self._scalars + extra_scalars)
-        return [DeviceColumn(d, v, dt)
-                for (d, v), dt in zip(outs, self.out_types)]
+        built = []
+        for (d, v), dt in zip(outs, self.out_types):
+            if isinstance(d, ListVal):
+                built.append(ListColumn(d.values, v, dt, d.elem_valid,
+                                        d.lengths))
+            else:
+                built.append(DeviceColumn(d, v, dt))
+        return built
 
 
 def compile_projection(exprs: Sequence[Expression], schema: Schema) -> DeviceProjector:
@@ -264,6 +282,33 @@ def build_dict_filter(cond: Expression,
     return DictFilterEvaluator(cond, schema, new, preds)
 
 
+def _lane_pairs(cols):
+    """(pairs, spans): flatten device columns into 1D (data, validity)
+    pairs for the variadic row kernels. Scalar columns contribute one
+    pair; ListColumns decompose into W+1 lanes (nested.kernel_lanes) and
+    reassemble after — the rearranging kernels stay 1D-only."""
+    pairs = []
+    spans = []
+    for i, c in cols:
+        start = len(pairs)
+        if hasattr(c, "kernel_lanes"):
+            pairs.extend(c.kernel_lanes())
+        else:
+            pairs.append((c.data, c.validity))
+        spans.append((i, start, len(pairs)))
+    return pairs, spans
+
+
+def _lane_rebuild(batch, spans, outs, new_cols):
+    for i, start, end in spans:
+        c = batch.columns[i]
+        if hasattr(c, "from_lanes"):
+            new_cols[i] = c.from_lanes(outs[start:end])
+        else:
+            d, v = outs[start]
+            new_cols[i] = c.with_arrays(d, v)
+
+
 def filter_batch_by_mask(batch: ColumnarBatch, keep,
                          schema=None) -> ColumnarBatch:
     """Compact the batch's rows where ``keep`` (bool over padded rows) is
@@ -273,12 +318,10 @@ def filter_batch_by_mask(batch: ColumnarBatch, keep,
     from ..columnar import HostColumn
     dev_pos = [i for i, c in enumerate(batch.columns)
                if isinstance(c, DeviceColumn)]
-    arrays = [(batch.columns[i].data, batch.columns[i].validity)
-              for i in dev_pos]
+    arrays, spans = _lane_pairs([(i, batch.columns[i]) for i in dev_pos])
     outs, count = _compact_kernel(arrays, keep, batch.padded_len)
     new_cols = list(batch.columns)
-    for i, (d, v) in zip(dev_pos, outs):
-        new_cols[i] = batch.columns[i].with_arrays(d, v)
+    _lane_rebuild(batch, spans, outs, new_cols)
     if len(dev_pos) < len(new_cols):
         import pyarrow as pa
         mask = pa.array(np.asarray(keep)[:batch.num_rows])
@@ -320,15 +363,13 @@ def gather_batch_device(batch: ColumnarBatch, indices, num_rows: int,
     out_p = out_padded if out_padded is not None else int(indices.shape[0])
     dev_pos = [i for i, c in enumerate(batch.columns)
                if isinstance(c, DeviceColumn)]
-    arrays = [(batch.columns[i].data, batch.columns[i].validity)
-              for i in dev_pos]
+    arrays, spans = _lane_pairs([(i, batch.columns[i]) for i in dev_pos])
     outs = _gather_kernel(arrays, indices, out_p)
     # num_rows may be a device scalar (speculative sizing) — mask on device
     live = jnp.arange(out_p, dtype=jnp.int64) < jnp.asarray(num_rows)
+    outs = [(d, jnp.logical_and(v, live)) for d, v in outs]
     new_cols = list(batch.columns)
-    for i, (d, v) in zip(dev_pos, outs):
-        v = jnp.logical_and(v, live)
-        new_cols[i] = batch.columns[i].with_arrays(d, v)
+    _lane_rebuild(batch, spans, outs, new_cols)
     if len(dev_pos) < len(new_cols):
         import pyarrow as pa
         idx = np.asarray(indices)[:int(num_rows)].astype(np.int64)

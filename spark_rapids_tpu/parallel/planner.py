@@ -696,6 +696,13 @@ class _Planner:
         return self.n_frags - 1
 
     def source(self, exec_node, replicated: bool) -> _SourceFrag:
+        for f in exec_node.output_schema().fields:
+            if f.dtype != STRING and not f.dtype.device_backed:
+                # nested/binary columns have no fragment encoding (list
+                # rectangles don't ride the exchange yet) — reject the
+                # fragment; the operator pipeline handles these
+                raise _NotLowerable(
+                    f"source column {f.name}: {f.dtype.name}")
         idx = len(self.sources)
         self.sources.append((exec_node, replicated))
         return _SourceFrag(exec_node, idx, replicated, self)
@@ -703,9 +710,16 @@ class _Planner:
     # -- helpers -----------------------------------------------------------
     def _expr_ok_f(self, e, fields: Sequence[_Field]) -> bool:
         """Device-supported and independent of dict-coded (string) cols."""
+        from ..types import ArrayType
         schema = Schema([StructField(f.name, f.logical, True)
                          for f in fields])
         if e.fully_device_supported(schema) is not None:
+            return False
+        # list columns (rectangular layout) don't ride fragments yet:
+        # their lanes would need the exchange/compaction to be W-aware
+        if isinstance(e.data_type(schema), ArrayType) or any(
+                isinstance(f.logical, ArrayType)
+                for f in fields if f.name in set(e.references())):
             return False
         dict_names = {f.name for f in fields if f.dict_id is not None}
         return not (set(e.references()) & dict_names)

@@ -90,6 +90,17 @@ def explain_potential_tpu_plan(plan: L.LogicalPlan, conf: TpuConf) -> str:
     return meta.explain(only_not_on_tpu=False) or "<entire plan runs on TPU>"
 
 
+def _list_key_reason(expr, schema):
+    """Keys (join/group/partition/window) cannot be list-typed: the key
+    hash/compare kernels are 1D. List-typed VALUES are fine in project/
+    filter pipelines (columnar/nested.py); Spark allows array keys, so a
+    list key converts the exec to its CPU twin."""
+    from ..types import ArrayType
+    if isinstance(expr.data_type(schema), ArrayType):
+        return "list-typed keys compare on host"
+    return None
+
+
 class _FallbackMeta(PlanMeta):
     def tag_self(self):
         self.will_not_work_on_tpu(
@@ -215,7 +226,8 @@ class AggregateMeta(PlanMeta):
         from ..types import STRING
         schema = self.plan.children[0].schema()
         for g in self.plan.groupings:
-            r = g.fully_device_supported(schema)
+            r = (g.fully_device_supported(schema)
+                 or _list_key_reason(g, schema))
             # string group keys stay on the TPU path: the exec
             # dictionary-encodes them to device int32 codes (evaluated on
             # host, grouped on device, decoded at finalize)
@@ -391,11 +403,11 @@ class JoinMeta(PlanMeta):
         ls = self.plan.children[0].schema()
         rs = self.plan.children[1].schema()
         for k in self.plan.left_keys:
-            r = k.fully_device_supported(ls)
+            r = k.fully_device_supported(ls) or _list_key_reason(k, ls)
             if r:
                 self.will_not_work_on_tpu(f"left key <{k.name_hint}>: {r}")
         for k in self.plan.right_keys:
-            r = k.fully_device_supported(rs)
+            r = k.fully_device_supported(rs) or _list_key_reason(k, rs)
             if r:
                 self.will_not_work_on_tpu(f"right key <{k.name_hint}>: {r}")
         if self.plan.condition is not None:
@@ -479,7 +491,8 @@ class RepartitionMeta(PlanMeta):
     def tag_self(self):
         schema = self.plan.children[0].schema()
         for k in self.plan.keys:
-            r = k.fully_device_supported(schema)
+            r = k.fully_device_supported(schema) \
+                or _list_key_reason(k, schema)
             if r:
                 self.will_not_work_on_tpu(f"partition key <{k.name_hint}>: {r}")
             if self.plan.mode == "hash":
@@ -535,9 +548,17 @@ class WriteMeta(PlanMeta):
 class WindowMeta(PlanMeta):
     def tag_self(self):
         schema = self.plan.children[0].schema()
+        from ..types import ArrayType
+        for f in schema.fields:
+            if isinstance(f.dtype, ArrayType):
+                # list payloads don't ride the window kernels (they own
+                # their 1D column layout); CPU window handles them
+                self.will_not_work_on_tpu(
+                    f"column {f.name}: list payload is host-only in windows")
         for e, spec, name in self.plan.window_exprs:
             for pk in spec.partition_by:
-                r = pk.fully_device_supported(schema)
+                r = pk.fully_device_supported(schema) \
+                    or _list_key_reason(pk, schema)
                 if r:
                     self.will_not_work_on_tpu(f"window partition key: {r}")
 

@@ -85,10 +85,16 @@ def hash_partition_ids(batch: ColumnarBatch, keys: Sequence[Expression],
 
 class PartitionedBatches:
     """Result of partitioning one batch: per-partition slices sharing the
-    sorted buffers (zero-copy views until materialized)."""
+    sorted buffers (zero-copy views until materialized).
+
+    Mixed batches are supported: device columns ride the stable-sorted
+    device buffers; host columns (e.g. demoted list payloads,
+    columnar/nested.py) carry (arrow array, pid per row) and mask-filter
+    per partition — the stable device sort preserves original row order
+    within a partition, so both representations stay row-aligned."""
 
     def __init__(self, sorted_cols, counts: np.ndarray, schema: Schema,
-                 source_cols=None):
+                 source_cols=None, dev_pos=None, host_parts=None):
         self.sorted_cols = sorted_cols
         self.counts = counts
         self.offsets = np.concatenate([[0], np.cumsum(counts)])
@@ -96,20 +102,33 @@ class PartitionedBatches:
         #: originating columns — carries column state (e.g. a DictColumn's
         #: dictionary) across the rearrangement
         self.source_cols = source_cols
+        #: schema ordinal per sorted_cols entry (identity when None)
+        self.dev_pos = (list(range(len(sorted_cols)))
+                        if dev_pos is None else list(dev_pos))
+        #: ordinal -> (arrow array, np pid per row) for host columns
+        self.host_parts = host_parts or {}
 
     def _rebuild(self, i, d, v):
         if self.source_cols is not None:
             return self.source_cols[i].with_arrays(d, v)
         return DeviceColumn(d, v, self.schema.fields[i].dtype)
 
+    def _host_partition(self, i, p):
+        arr, pid_np = self.host_parts[i]
+        return arr.filter(__import__("pyarrow").array(pid_np == p))
+
     def partition(self, p: int) -> "object":
         """Arrow table for partition p (host materialization for shuffle)."""
         import pyarrow as pa
         start, n = int(self.offsets[p]), int(self.counts[p])
-        cols = []
-        for i, (d, v) in enumerate(self.sorted_cols):
+        by_ordinal = {}
+        for k, (d, v) in enumerate(self.sorted_cols):
+            i = self.dev_pos[k]
             dc = self._rebuild(i, d[start:start + n], v[start:start + n])
-            cols.append(dc.to_arrow(n))
+            by_ordinal[i] = dc.to_arrow(n)
+        for i in self.host_parts:
+            by_ordinal[i] = self._host_partition(i, p)
+        cols = [by_ordinal[i] for i in range(len(self.schema.fields))]
         return pa.Table.from_arrays(cols, names=self.schema.names())
 
     def partition_device(self, p: int) -> ColumnarBatch:
@@ -117,15 +136,22 @@ class PartitionedBatches:
         trip (the contiguous-split view stays in HBM, ref
         GpuPartitioning contiguousSplit returning device tables). The slice
         is re-padded to a shape bucket via an index-gather so downstream
-        kernels compile once per bucket, not once per partition size."""
+        kernels compile once per bucket, not once per partition size.
+        Host columns (demoted lists) stay host in the output batch."""
+        from ..columnar import HostColumn
         from ..columnar.bucketing import bucket_for
         start, n = int(self.offsets[p]), int(self.counts[p])
         pb = bucket_for(max(n, 1))
-        cols = []
-        for i, (d, v) in enumerate(self.sorted_cols):
+        by_ordinal = {}
+        for k, (d, v) in enumerate(self.sorted_cols):
+            i = self.dev_pos[k]
             od, ov = _slice_pad_kernel(d, v, jnp.int32(start), jnp.int32(n),
                                        pb)
-            cols.append(self._rebuild(i, od, ov))
+            by_ordinal[i] = self._rebuild(i, od, ov)
+        for i in self.host_parts:
+            by_ordinal[i] = HostColumn(self._host_partition(i, p),
+                                       self.schema.fields[i].dtype)
+        cols = [by_ordinal[i] for i in range(len(self.schema.fields))]
         return ColumnarBatch(cols, n, self.schema)
 
 
@@ -175,12 +201,23 @@ def scatter_spillables(ctx, spillables, make_parts, n_parts: int):
 def partition_batch(batch: ColumnarBatch, keys: Sequence[Expression],
                     num_parts: int, mode: str = "hash",
                     seed: int = 42) -> PartitionedBatches:
-    batch = batch.ensure_device()
-    assert batch.all_device, "partitioning requires device batch"
+    from ..columnar import HostColumn
+    batch = batch.ensure_device().with_lists_on_host()
     pid = hash_partition_ids(batch, keys, num_parts, mode, seed)
-    arrays = [(c.data, c.validity) for c in batch.columns]
+    dev_pos = [i for i, c in enumerate(batch.columns)
+               if isinstance(c, DeviceColumn)]
+    arrays = [(batch.columns[i].data, batch.columns[i].validity)
+              for i in dev_pos]
     # num_parts+1: the virtual padding partition sorts last and is dropped
     cols, counts = _split_kernel(arrays, pid, batch.padded_len, num_parts + 1)
     counts = np.asarray(counts)[:num_parts]
+    host_parts = None
+    if len(dev_pos) < len(batch.columns):
+        pid_np = np.asarray(pid)[:batch.num_rows]
+        host_parts = {
+            i: (c.to_arrow(batch.num_rows), pid_np)
+            for i, c in enumerate(batch.columns)
+            if isinstance(c, HostColumn)}
     return PartitionedBatches(cols, counts, batch.schema,
-                              source_cols=batch.columns)
+                              source_cols=batch.columns,
+                              dev_pos=dev_pos, host_parts=host_parts)
