@@ -88,7 +88,7 @@ def _try_dict_encode(col, n: int, p: int):
 
 
 class ColumnarBatch:
-    __slots__ = ("columns", "_num_rows", "schema", "meta")
+    __slots__ = ("columns", "_num_rows", "schema", "meta", "__weakref__")
 
     def __init__(self, columns: Sequence[ColumnLike], num_rows,
                  schema: Schema, meta: Optional[dict] = None):
@@ -121,8 +121,23 @@ class ColumnarBatch:
                 # a speculatively-sized producer (join) guessed too small:
                 # rows beyond the padded capacity were truncated
                 raise SpeculativeOverflow(nr, cap)
-            self._num_rows = nr
+            self._resolve_count(nr)
         return nr
+
+    def _resolve_count(self, nr: int) -> None:
+        """Install a now-known row count; feeds the cost model's measured
+        row statistics when the producer tagged THIS batch (deferred —
+        lazy device counts resolve at the sink fetch, never via an extra
+        sync). The weakref identity check keeps derived batches that
+        copied or share this meta dict from mis-attributing their counts
+        to the producer's accumulator."""
+        self._num_rows = nr
+        tag = self.meta.get("rows_accum")
+        if tag is not None:
+            accum, ref = tag
+            if ref() is self:
+                accum.add(nr)
+                self.meta.pop("rows_accum", None)
 
     @property
     def num_rows_raw(self):
@@ -172,7 +187,8 @@ class ColumnarBatch:
     # -- conversions -------------------------------------------------------
     @staticmethod
     def from_arrow(table, buckets: Sequence[int] = DEFAULT_BUCKETS,
-                   pad: bool = True) -> "ColumnarBatch":
+                   pad: bool = True,
+                   encode_lists: bool = True) -> "ColumnarBatch":
         """Arrow table -> batch; device-backed types are H2D'd padded to the
         row bucket (ref HostColumnarToGpu / GpuRowToColumnarExec device copy)."""
         import jax
@@ -218,7 +234,7 @@ class ColumnarBatch:
                 staged.append((len(cols), dt, None, mirror))
                 host_pairs.extend([d, v])
                 cols.append(None)
-            elif pad and _is_device_list(dt):
+            elif pad and encode_lists and _is_device_list(dt):
                 # list-of-primitive: dense rectangular device layout
                 # (columnar/nested.py); width-capped columns stay host
                 from .nested import encode_list_column
@@ -368,7 +384,7 @@ class ColumnarBatch:
                 cap = dev[0][1].padded_len
                 if nr > cap:
                     raise SpeculativeOverflow(nr, cap)
-                self._num_rows = nr
+                self._resolve_count(nr)
             n = self.num_rows
             for k, (i, c) in enumerate(dev):
                 fetched[i] = (got[2 * k][:n], got[2 * k + 1][:n])
@@ -394,7 +410,11 @@ class ColumnarBatch:
                     for c, f in zip(self.columns, self.schema.fields))
         if not needs:
             return self
-        out = ColumnarBatch.from_arrow(self.to_arrow())
+        # encode_lists=False: a host-resident list column stays host here —
+        # the execs that call ensure_device either route it per batch
+        # (project/filter) or demote it anyway (joins), so re-encoding the
+        # rectangle just to fetch it back would waste an H2D+D2H
+        out = ColumnarBatch.from_arrow(self.to_arrow(), encode_lists=False)
         out.meta = self.meta
         return out
 
@@ -411,11 +431,16 @@ class ColumnarBatch:
         if not any(isinstance(c, ListColumn) for c in self.columns):
             return self
         n = self.num_rows
-        cols = [HostColumn(c.to_arrow(n), c.dtype)
-                if isinstance(c, ListColumn) else c
-                for c in self.columns]
-        out = ColumnarBatch(cols, n, self.schema, meta=self.meta)
-        return out
+
+        def demote(c):
+            if not isinstance(c, ListColumn):
+                return c
+            if c.host_mirror is not None:   # fresh ingest: zero-cost slice
+                return HostColumn(c.host_mirror.slice(0, n), c.dtype)
+            return HostColumn(c.to_arrow(n), c.dtype)
+
+        return ColumnarBatch([demote(c) for c in self.columns], n,
+                             self.schema, meta=self.meta)
 
     # -- ops used by the runtime ------------------------------------------
     def slice(self, offset: int, length: int) -> "ColumnarBatch":

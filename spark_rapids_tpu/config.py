@@ -204,9 +204,13 @@ PARQUET_READER_TYPE = register(
     "(ref GpuParquetScan.scala reader factory:1070).")
 
 CBO_ENABLED = register(
-    "spark.rapids.tpu.sql.optimizer.enabled", False,
-    "Cost-based reversion of device subtrees to CPU when transition cost "
-    "exceeds benefit (ref CostBasedOptimizer.scala).")
+    "spark.rapids.tpu.sql.optimizer.enabled", True,
+    "Cost-based reversion of device subtrees (and whole small-input "
+    "queries, which lose to the per-query dispatch+fetch floor on a "
+    "tunneled TPU) to the host engine (ref CostBasedOptimizer.scala; "
+    "floor model: plan/cost.py DEVICE_QUERY_FLOOR). ON by default since "
+    "r3: the engine picks the faster engine per query; tests pin it off "
+    "to keep device-path coverage.", commonly_used=True)
 
 CPU_EXEC_COST_PER_ROW = register(
     "spark.rapids.tpu.sql.optimizer.cpu.exec.defaultRowCost", 2.0e-4,

@@ -961,7 +961,9 @@ class CpuAggregateExec(TpuExec):
             vals = [_cell(x, isf) for x in out[f.name].tolist()]
             arrays.append(pa.array(vals, type=_toa(f.dtype)))
         table = pa.Table.from_arrays(arrays, names=self._schema.names())
-        yield ColumnarBatch.from_arrow(table)
+        # host-only output (see CpuFilterExec): no device bounce on the
+        # CPU-reverted path; downstream re-materializes if needed
+        yield ColumnarBatch.from_arrow_host(table)
 
     def describe(self):
         g = ", ".join(e.name_hint for e in self.groupings)

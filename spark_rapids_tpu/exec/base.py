@@ -68,11 +68,16 @@ class ExecContext:
         from ..columnar.packing import fetch_packed
         from .joins import _TOTAL_STATS
         pending, self.speculations = self.speculations, []
-        totals = fetch_packed([t for t, _, _ in pending])
-        for n, (_, cap, stat_key) in zip(totals, pending):
+        totals = fetch_packed([t for t, _, _, _ in pending])
+        for n, (_, cap, stat_key, plan_sig) in zip(totals, pending):
             n = int(n)
             if stat_key is not None:
                 _TOTAL_STATS[stat_key] = n     # keep the statistic fresh
+            if plan_sig is not None:
+                # measured join-output rows -> the cost model (the crudest
+                # estimate it has); rides the same batched totals fetch
+                from ..plan.cost import record_runtime_rows
+                record_runtime_rows(plan_sig, n)
             if n > cap:
                 raise SpeculativeOverflow(n, cap)
 
@@ -123,7 +128,29 @@ class TpuExec:
         t0 = time.perf_counter()
         it = self.do_execute(ctx)
         m.add(time.perf_counter() - t0)
-        return it
+        sig = getattr(self, "plan_sig", None)
+        if sig is None:
+            return it
+        return self._record_rows(it, sig)
+
+    @staticmethod
+    def _record_rows(it, sig):
+        """Measured-rows feedback for the cost model (plan/cost.py
+        _RUNTIME_ROWS): execs tagged with a plan signature record their
+        output row counts — immediately for host ints, deferred to the
+        sink fetch for lazy device counts (never an extra sync). One
+        accumulator covers all of this exec's batches (true totals);
+        the weakref tag pins each deferred count to its exact batch."""
+        import weakref
+        from ..plan.cost import RowsAccum
+        accum = RowsAccum(sig)
+        for b in it:
+            if isinstance(b.num_rows_raw, int):
+                accum.add(b.num_rows_raw)
+            else:
+                b.meta = dict(b.meta)
+                b.meta["rows_accum"] = (accum, weakref.ref(b))
+            yield b
 
     def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
         raise NotImplementedError

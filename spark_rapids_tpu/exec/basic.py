@@ -417,7 +417,7 @@ class TpuFilterExec(TpuExec):
                 else:
                     out = self._filter_mixed(batch)
             rows_m.add(out.num_rows_raw)
-            yield out
+            yield out    # measured-rows feedback: base execute() records
 
     def _filter_dict(self, ctx, dict_eval, batch):
         """String predicates evaluated once over the dictionary,
@@ -447,7 +447,9 @@ class TpuFilterExec(TpuExec):
                for nm in names):
             import pyarrow.compute as pc
             mask = pc.fill_null(self.condition.eval_host(batch), False)
-            return ColumnarBatch.from_arrow(batch.to_arrow().filter(mask))
+            out = ColumnarBatch.from_arrow(batch.to_arrow().filter(mask))
+            out.meta = dict(batch.meta)   # keep partition_id/input_file
+            return out
         keep = eval_predicate_device(self.condition, batch)
         return filter_batch_by_mask(batch, keep)
 
@@ -470,7 +472,11 @@ class CpuFilterExec(TpuExec):
         for batch in self.children[0].execute(ctx):
             mask = self.condition.eval_host(batch)
             t = batch.to_arrow().filter(pc.fill_null(mask, False))
-            yield ColumnarBatch.from_arrow(t)
+            # host-only output: a CPU-reverted chain must not bounce
+            # every batch back through HBM (downstream device execs
+            # re-materialize via ensure_device when they need to);
+            # measured-rows feedback records in base execute()
+            yield ColumnarBatch.from_arrow_host(t)
 
     def describe(self):
         return f"CpuFilter[{self.condition.name_hint}]"

@@ -644,7 +644,8 @@ class TpuHashJoinExec(TpuExec):
             # overflow — the AQE-statistics analog of sizing gather maps
             n_out = total
             out_p = bucket_for(max(int(stat * 1.5), 1))
-            ctx.speculations.append((total, out_p, ck))
+            ctx.speculations.append((total, out_p, ck,
+                                     getattr(self, 'plan_sig', None)))
         else:
             n_out = int(total)
             _TOTAL_STATS[ck] = n_out
@@ -686,7 +687,8 @@ class TpuHashJoinExec(TpuExec):
                                  jnp.int32(rb.num_rows_raw),
                                  lb.padded_len, rb.padded_len, out_p, cfg)
         if not semi_like:
-            ctx.speculations.append((total, out_p, ck))
+            ctx.speculations.append((total, out_p, ck,
+                                     getattr(self, 'plan_sig', None)))
         new_cols = [c.with_arrays(d, v)
                     for c, (d, v) in zip(lb.columns, louts)]
         if not semi_like:
@@ -873,6 +875,10 @@ class TpuBroadcastHashJoinExec(TpuHashJoinExec):
             return
         rows_m = ctx.metric(self._exec_id, "numOutputRows", ESSENTIAL)
         bb = build.broadcast(ctx)
+        if bb is not None:
+            # list payloads demote like every other join intake: the
+            # gather path moves 1D lanes only
+            bb = bb.with_lists_on_host()
         sigs = getattr(self, "side_sigs", None)
         if sigs is not None and bb is not None:
             # record the build side's MEASURED logical bytes: an
@@ -991,7 +997,9 @@ class CpuJoinExec(TpuExec):
             import pyarrow.compute as pc
             mask = self.condition.eval_host(b)
             out = out.filter(pc.fill_null(mask, False))
-        yield ColumnarBatch.from_arrow(out)
+        # host-only output (see CpuFilterExec): no device bounce on the
+        # CPU-reverted path
+        yield ColumnarBatch.from_arrow_host(out)
 
     def _cross_host(self, lt, rt):
         import pyarrow as pa

@@ -686,6 +686,11 @@ class _Planner:
         #: dict_id -> the SOURCE _Field, so order-sensitive consumers
         #: can force the sorted-dictionary encode on it
         self.dict_fields: Dict[int, _Field] = {}
+        #: bound-check key -> logical plan signature: the fragment's bound
+        #: validation fetches measured sizes anyway — feed them to the
+        #: cost model's _RUNTIME_ROWS so re-planning sees real join
+        #: outputs even when the whole query ran fused
+        self.key_sigs: Dict[Tuple, str] = {}
 
     def new_dict(self) -> int:
         self.n_dicts += 1
@@ -902,6 +907,9 @@ class _Planner:
         frag = _JoinFrag(self.frag_id(), left, right, node.left_keys,
                          node.right_keys, node.join_type, broadcast,
                          condition=condition)
+        sig = getattr(node, "plan_sig", None)
+        if sig is not None:
+            self.key_sigs[("join", frag.frag_id)] = sig
         # semi/anti joins emit probe-side fields only
         if node.join_type in ("leftsemi", "leftanti"):
             frag.fields = list(left.fields)
@@ -1289,8 +1297,15 @@ class DistributedPipelineExec(TpuExec):
                 # record observed sizes so the NEXT query of this shape
                 # AND input scale starts with tight static bounds; a
                 # running max avoids thrash on varying data
+                key_sigs = getattr(self, "key_sigs", None) or {}
                 for i, (v, b) in enumerate(zip(check_vals, bounds_flat)):
                     ck = self._check_keys[i]
+                    sig = key_sigs.get(ck)
+                    if sig is not None:
+                        # measured fragment sizes -> the cost model, so
+                        # re-planning this shape knows real join outputs
+                        from ..plan.cost import record_runtime_rows
+                        record_runtime_rows(sig, int(v))
                     dflt = defaults.get(ck)
                     if dflt is None:
                         continue
@@ -1665,7 +1680,9 @@ def _lower_node(node, conf: TpuConf, mesh, require_join: bool = False,
         return None                 # no join/agg: the mesh gains nothing
     if require_join and not planner.has_join:
         return None
-    return DistributedPipelineExec(frag, planner.sources, mesh, conf,
-                                   node.output_schema(),
-                                   fallback=node if keep_fallback
-                                   else None)
+    ex = DistributedPipelineExec(frag, planner.sources, mesh, conf,
+                                 node.output_schema(),
+                                 fallback=node if keep_fallback
+                                 else None)
+    ex.key_sigs = planner.key_sigs
+    return ex

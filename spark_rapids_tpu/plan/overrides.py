@@ -186,11 +186,17 @@ class FilterMeta(PlanMeta):
 
     def convert_to_tpu(self, children):
         self._push_down_predicate(children[0])
-        return B.TpuFilterExec(self.plan.condition, children[0])
+        ex = B.TpuFilterExec(self.plan.condition, children[0])
+        from .cost import plan_signature
+        ex.plan_sig = plan_signature(self.plan)   # measured-rows feedback
+        return ex
 
     def convert_to_cpu(self, children):
         self._push_down_predicate(children[0])
-        return B.CpuFilterExec(self.plan.condition, children[0])
+        ex = B.CpuFilterExec(self.plan.condition, children[0])
+        from .cost import plan_signature
+        ex.plan_sig = plan_signature(self.plan)
+        return ex
 
     def _push_down_predicate(self, child_exec):
         """Predicate pushdown into file scans for row-group / delta-file
@@ -475,15 +481,22 @@ class JoinMeta(PlanMeta):
             j = TpuHashJoinExec(children[0], children[1], p.join_type,
                                 p.left_keys, p.right_keys, p.condition)
         # runtime-stats hookup: the exec records each side's MEASURED
-        # bytes under these signatures when it materializes them
+        # bytes under these signatures when it materializes them, and the
+        # join's OUTPUT rows under its own (the cost model's join-output
+        # estimates are the crudest — measured feedback re-plans e.g. a
+        # dimension-filtered join at its real, tiny output size)
         j.side_sigs = sigs
+        j.plan_sig = plan_signature(self.plan)
         return j
 
     def convert_to_cpu(self, children):
         from ..exec.joins import CpuJoinExec
+        from .cost import plan_signature
         p = self.plan
-        return CpuJoinExec(children[0], children[1], p.join_type,
-                           p.left_keys, p.right_keys, p.condition)
+        ex = CpuJoinExec(children[0], children[1], p.join_type,
+                         p.left_keys, p.right_keys, p.condition)
+        ex.plan_sig = plan_signature(self.plan)
+        return ex
 
 
 @rule(L.Repartition)

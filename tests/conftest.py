@@ -5,6 +5,12 @@ import os
 
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 
+# Pin the cost optimizer OFF for tests (it is ON by default): on tiny test
+# inputs the per-query device floor would revert every plan to the host
+# engine and silently drop device-path coverage. Tests that exercise the
+# optimizer enable it explicitly via session conf (raw conf beats env).
+os.environ.setdefault("SPARK_RAPIDS_TPU_SQL_OPTIMIZER_ENABLED", "false")
+
 import jax
 
 # The axon TPU plugin force-sets jax_platforms="axon,cpu" at register time
