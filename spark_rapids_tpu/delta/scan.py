@@ -51,7 +51,7 @@ def _partition_matches(partition_values, schema, predicate) -> bool:
         t = attach_partition_columns(
             pa.table({"__r": pa.array([0])}), partition_values, schema
         ).drop_columns(["__r"])
-        b = ColumnarBatch.from_arrow(t, pad=False)
+        b = ColumnarBatch.from_arrow_host(t)
         m = predicate.eval_host(b)
         v = m[0].as_py() if len(m) else True
         return v is not False

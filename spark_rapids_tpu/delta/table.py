@@ -39,7 +39,7 @@ def _now_ms() -> int:
 def _eval_predicate(pred: Expression, table) -> np.ndarray:
     """bool mask (nulls -> False) of pred over an Arrow table."""
     import pyarrow.compute as pc
-    b = ColumnarBatch.from_arrow(table, pad=False)
+    b = ColumnarBatch.from_arrow_host(table)
     mask = pc.fill_null(pred.eval_host(b), False)
     return np.asarray(mask.to_numpy(zero_copy_only=False), dtype=bool)
 
@@ -335,7 +335,7 @@ class DeltaTable:
             if n_upd == 0:
                 continue
             updated += n_upd
-            b = ColumnarBatch.from_arrow(t, pad=False)
+            b = ColumnarBatch.from_arrow_host(t)
             cols = {}
             for f in schema.fields:
                 if f.name in assignments:
@@ -634,7 +634,7 @@ class MergeBuilder:
                     list(tt.take(pa.array(ti)).columns) +
                     list(src.take(pa.array(si)).columns),
                     names=[f.name for f in schema.fields] + src.column_names)
-                pb = ColumnarBatch.from_arrow(pair, pad=False)
+                pb = ColumnarBatch.from_arrow_host(pair)
                 m = np.asarray(pc.fill_null(self.condition.eval_host(pb),
                                             False)
                                .to_numpy(zero_copy_only=False), dtype=bool)
@@ -670,7 +670,7 @@ class MergeBuilder:
                 list(tt.take(pa.array(tm)).columns) +
                 list(src.take(pa.array(sm)).columns),
                 names=[f.name for f in schema.fields] + src.column_names)
-            mb = ColumnarBatch.from_arrow(matched_pairs, pad=False)
+            mb = ColumnarBatch.from_arrow_host(matched_pairs)
             for f in schema.fields:
                 col = tt.column(f.name).combine_chunks()
                 if self._matched_update and f.name in self._matched_update:
@@ -694,7 +694,7 @@ class MergeBuilder:
         if self._insert_values is not None:
             unmatched = src.filter(pa.array(~src_matched))
             if unmatched.num_rows:
-                ub = ColumnarBatch.from_arrow(unmatched, pad=False)
+                ub = ColumnarBatch.from_arrow_host(unmatched)
                 from ..types import to_arrow
                 cols = {}
                 for f in schema.fields:

@@ -962,8 +962,8 @@ class CpuJoinExec(TpuExec):
         if self.join_type == "cross" or not self.left_keys:
             out = self._cross_host(lt, rt)
         else:
-            lb = ColumnarBatch.from_arrow(lt, pad=False)
-            rb = ColumnarBatch.from_arrow(rt, pad=False)
+            lb = ColumnarBatch.from_arrow_host(lt)
+            rb = ColumnarBatch.from_arrow_host(rt)
             lkn, rkn = [], []
             for i, (lk, rk) in enumerate(zip(self.left_keys,
                                              self.right_keys)):
@@ -993,7 +993,7 @@ class CpuJoinExec(TpuExec):
             out = out.rename_columns([c[:-2] if c.endswith("\x00r") else c
                                       for c in out.column_names])
         if self.condition is not None:
-            b = ColumnarBatch.from_arrow(out, pad=False)
+            b = ColumnarBatch.from_arrow_host(out)
             import pyarrow.compute as pc
             mask = self.condition.eval_host(b)
             out = out.filter(pc.fill_null(mask, False))
@@ -1020,8 +1020,8 @@ class CpuJoinExec(TpuExec):
         import pyarrow.compute as pc
         n_l, n_r = lt.num_rows, rt.num_rows
         if self.left_keys:
-            lb = ColumnarBatch.from_arrow(lt, pad=False)
-            rb = ColumnarBatch.from_arrow(rt, pad=False)
+            lb = ColumnarBatch.from_arrow_host(lt)
+            rb = ColumnarBatch.from_arrow_host(rt)
             lks = [k.eval_host(lb) for k in self.left_keys]
             rks = [k.eval_host(rb) for k in self.right_keys]
             cts = [_common_arrow_type(a.type, b.type)
@@ -1050,7 +1050,7 @@ class CpuJoinExec(TpuExec):
             pair_t = pa.Table.from_arrays(
                 list(lo.columns) + list(ro.columns),
                 names=[f.name for f in pair_schema.fields])
-            pb = ColumnarBatch.from_arrow(pair_t, pad=False)
+            pb = ColumnarBatch.from_arrow_host(pair_t)
             pb.schema = pair_schema
             mask = pc.fill_null(self.condition.eval_host(pb), False)
             m = mask.to_numpy(zero_copy_only=False)

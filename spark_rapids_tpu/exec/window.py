@@ -777,7 +777,7 @@ class CpuWindowExec(TpuExec):
             return
         t = pa.concat_tables(tables)
         df = t.to_pandas()
-        batch = ColumnarBatch.from_arrow(t, pad=False)
+        batch = ColumnarBatch.from_arrow_host(t)
         for fn, spec, name in self.window_exprs:
             pcols = []
             for i, pk in enumerate(spec.partition_by):

@@ -13,12 +13,15 @@ import numpy as np
 import jax.numpy as jnp
 
 from ..types import (BOOL, DataType, FLOAT32, FLOAT64, INT64, Schema,
-                     numeric, TypeSig)
+                     integral, numeric, TypeSig)
 from .base import DVal, EvalContext, Expression, null_and, promote_types
 
 __all__ = ["Add", "Subtract", "Multiply", "Divide", "IntegralDivide",
-           "Remainder", "Pmod", "UnaryMinus", "Abs", "host_binary_numpy",
-           "arrow_to_masked_numpy", "masked_numpy_to_arrow"]
+           "Remainder", "Pmod", "UnaryMinus", "UnaryPositive", "Abs",
+           "BitwiseAnd", "BitwiseOr", "BitwiseXor", "BitwiseNot",
+           "ShiftLeft", "ShiftRight", "ShiftRightUnsigned",
+           "host_binary_numpy", "arrow_to_masked_numpy",
+           "masked_numpy_to_arrow"]
 
 
 def arrow_to_masked_numpy(arr):
@@ -266,3 +269,160 @@ class Abs(Expression):
 
     def key(self):
         return f"abs({self.children[0].key()})"
+
+
+class UnaryPositive(Expression):
+    """`+x`: identity on numerics (ref GpuOverrides UnaryPositive rule)."""
+
+    device_type_sig = TypeSig(numeric.types)
+
+    def __init__(self, child: Expression):
+        self.children = [child]
+
+    def data_type(self, schema):
+        return self.children[0].data_type(schema)
+
+    def eval_device(self, ctx):
+        return self.children[0].eval_device(ctx)
+
+    def eval_host(self, batch):
+        return self.children[0].eval_host(batch)
+
+    def key(self):
+        return f"pos({self.children[0].key()})"
+
+
+# ---------------------------------------------------------------------------
+# bitwise (ref bitwise.scala — cudf bitwise kernels; here plain VPU int ops)
+# ---------------------------------------------------------------------------
+
+class _BitwiseBinary(BinaryArithmetic):
+    device_type_sig = integral
+    jnp_fn = None
+    np_fn = None
+
+    def eval_device(self, ctx):
+        ld, rd, v, dt = self._promoted_device_operands(ctx)
+        return DVal(type(self).jnp_fn(ld, rd), v, dt)
+
+    def eval_host(self, batch):
+        return host_binary_numpy(self, batch, type(self).np_fn,
+                                 self.data_type(batch.schema))
+
+
+class BitwiseAnd(_BitwiseBinary):
+    symbol = "&"
+    jnp_fn = staticmethod(jnp.bitwise_and)
+    np_fn = staticmethod(np.bitwise_and)
+
+
+class BitwiseOr(_BitwiseBinary):
+    symbol = "|"
+    jnp_fn = staticmethod(jnp.bitwise_or)
+    np_fn = staticmethod(np.bitwise_or)
+
+
+class BitwiseXor(_BitwiseBinary):
+    symbol = "^"
+    jnp_fn = staticmethod(jnp.bitwise_xor)
+    np_fn = staticmethod(np.bitwise_xor)
+
+
+class BitwiseNot(Expression):
+    device_type_sig = integral
+
+    def __init__(self, child: Expression):
+        self.children = [child]
+
+    def data_type(self, schema):
+        return self.children[0].data_type(schema)
+
+    def eval_device(self, ctx):
+        c = self.children[0].eval_device(ctx)
+        return DVal(jnp.bitwise_not(c.data), c.validity, c.dtype)
+
+    def eval_host(self, batch):
+        v, ok = arrow_to_masked_numpy(self.children[0].eval_host(batch))
+        return masked_numpy_to_arrow(np.bitwise_not(v), ok,
+                                     self.data_type(batch.schema))
+
+    def key(self):
+        return f"~({self.children[0].key()})"
+
+
+class _Shift(Expression):
+    """shiftleft/shiftright/shiftrightunsigned(x, n): Java semantics —
+    byte/short values promote to INT (like Java's << on sub-int types)
+    and the shift amount uses only the low 5 (int) or 6 (long) bits
+    (ref GpuShiftLeft/Right in arithmetic.scala)."""
+
+    device_type_sig = integral
+
+    def __init__(self, value: Expression, amount: Expression):
+        self.children = [value, amount]
+
+    def data_type(self, schema):
+        from ..types import INT32
+        dt = self.children[0].data_type(schema)
+        return dt if dt.np_dtype.itemsize >= 4 else INT32
+
+    def _mask(self, dt) -> int:
+        return 63 if dt.np_dtype.itemsize == 8 else 31
+
+    def _shift_np(self, v, n, dt):
+        raise NotImplementedError
+
+    def eval_device(self, ctx):
+        import jax.numpy as jnp
+        c = self.children[0].eval_device(ctx)
+        a = self.children[1].eval_device(ctx)
+        dt = self.data_type(ctx.schema)
+        n = a.data.astype(jnp.int32) & self._mask(dt)
+        out = self._shift_jnp(c.data.astype(dt.np_dtype), n, dt)
+        from .base import null_and
+        return DVal(out, null_and(c.validity, a.validity), dt)
+
+    def eval_host(self, batch):
+        v, vok = arrow_to_masked_numpy(self.children[0].eval_host(batch))
+        n, nok = arrow_to_masked_numpy(self.children[1].eval_host(batch))
+        dt = self.data_type(batch.schema)
+        v = v.astype(dt.np_dtype, copy=False)
+        n = n.astype(np.int64) & self._mask(dt)
+        out = self._shift_np(v, n, dt)
+        return masked_numpy_to_arrow(out, vok & nok, dt)
+
+    def key(self):
+        return (f"{type(self).__name__}({self.children[0].key()},"
+                f"{self.children[1].key()})")
+
+
+class ShiftLeft(_Shift):
+    def _shift_jnp(self, v, n, dt):
+        return jnp.left_shift(v, n.astype(v.dtype))
+
+    def _shift_np(self, v, n, dt):
+        return np.left_shift(v, n.astype(v.dtype))
+
+
+class ShiftRight(_Shift):
+    """Arithmetic (sign-propagating) right shift, Java >>."""
+
+    def _shift_jnp(self, v, n, dt):
+        return jnp.right_shift(v, n.astype(v.dtype))
+
+    def _shift_np(self, v, n, dt):
+        return np.right_shift(v, n.astype(v.dtype))
+
+
+class ShiftRightUnsigned(_Shift):
+    """Logical right shift, Java >>>: shift the UNSIGNED bit pattern."""
+
+    def _shift_jnp(self, v, n, dt):
+        u = jnp.asarray(v).view(
+            jnp.uint64 if dt.np_dtype.itemsize == 8 else jnp.uint32)
+        return jnp.right_shift(u, n.astype(u.dtype)).view(v.dtype)
+
+    def _shift_np(self, v, n, dt):
+        udt = np.uint64 if dt.np_dtype.itemsize == 8 else np.uint32
+        u = v.astype(dt.np_dtype, copy=False).view(udt)
+        return np.right_shift(u, n.astype(udt)).view(dt.np_dtype)

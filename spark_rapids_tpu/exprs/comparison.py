@@ -57,8 +57,39 @@ class BinaryComparison(Expression):
         return l.data, r.data, null_and(l.validity, r.validity)
 
     def _host_operands(self, batch):
-        l, lv = arrow_to_masked_numpy(self.children[0].eval_host(batch))
-        r, rv = arrow_to_masked_numpy(self.children[1].eval_host(batch))
+        from .base import Literal
+
+        from ..types import DATE, TIMESTAMP, DecimalType
+
+        def is_lit(e):
+            if not (isinstance(e, Literal) and e.value is not None
+                    and e.dtype.np_dtype is not None):
+                return False
+            # DATE/TIMESTAMP/decimal columns materialize as datetime64 /
+            # object arrays on host — their literals must keep the arrow
+            # path so dtypes line up
+            other = self.children[1] if e is self.children[0] \
+                else self.children[0]
+            odt = other.data_type(batch.schema)
+            if odt in (DATE, TIMESTAMP) or isinstance(odt, DecimalType) \
+                    or e.dtype in (DATE, TIMESTAMP) \
+                    or isinstance(e.dtype, DecimalType):
+                return False
+            return True
+
+        def side(e, as_scalar):
+            # literal operands ride as numpy scalars (broadcast is free;
+            # materializing a constant column costs ~30 ms per 1M rows)
+            if as_scalar:
+                import numpy as _np
+                return (_np.asarray(e.value, dtype=e.dtype.np_dtype),
+                        True)
+            return arrow_to_masked_numpy(e.eval_host(batch))
+
+        lit0, lit1 = is_lit(self.children[0]), is_lit(self.children[1])
+        # at most one side stays scalar so the result keeps batch length
+        l, lv = side(self.children[0], lit0 and not lit1)
+        r, rv = side(self.children[1], lit1)
         ldt = self.children[0].data_type(batch.schema)
         rdt = self.children[1].data_type(batch.schema)
         if ldt != rdt and ldt.device_backed and rdt.device_backed:

@@ -9,7 +9,9 @@ from ..types import BOOL, DataType, Schema
 from .base import DVal, Expression, promote_types
 from .arithmetic import arrow_to_masked_numpy, masked_numpy_to_arrow
 
-__all__ = ["If", "CaseWhen", "Coalesce", "NaNvl"]
+__all__ = ["If", "CaseWhen", "Coalesce", "NaNvl", "Greatest",
+           "Least", "AtLeastNNonNulls", "KnownNotNull",
+           "KnownFloatingPointNormalized", "NormalizeNaNAndZero"]
 
 
 def _common_type(schema: Schema, exprs) -> DataType:
@@ -216,3 +218,208 @@ class NaNvl(Expression):
 
     def key(self):
         return f"nanvl({self.children[0].key()},{self.children[1].key()})"
+
+
+class _NarySelect(Expression):
+    """Base for greatest/least: n-ary, NULLs skipped, NULL only when every
+    operand is NULL; NaN orders greatest (Spark total order — ref
+    arithmetic.scala GpuGreatest/GpuLeast)."""
+
+    _is_max = True
+
+    def __init__(self, *children):
+        assert len(children) >= 2, "greatest/least need >= 2 args"
+        self.children = list(children)
+
+    def data_type(self, schema: Schema) -> DataType:
+        return _common_type(schema, self.children)
+
+    def _sentinels(self, np_dt):
+        if np.issubdtype(np_dt, np.floating):
+            # NaN sorts GREATEST in Spark: max starts below NaN handling
+            lo, hi = -np.inf, np.inf
+        elif np_dt == np.bool_:
+            lo, hi = False, True
+        else:
+            info = np.iinfo(np_dt)
+            lo, hi = info.min, info.max
+        return (lo, hi) if self._is_max else (hi, lo)
+
+    def eval_device(self, ctx):
+        dt = self.data_type(ctx.schema)
+        np_dt = dt.np_dtype
+        skip, _ = self._sentinels(np_dt)
+        acc = None
+        any_valid = None
+        any_nan = None
+        any_nonnan = None
+        is_float = np.issubdtype(np_dt, np.floating)
+        for c in self.children:
+            v = c.eval_device(ctx)
+            d = v.data.astype(np_dt)
+            if is_float:
+                nan_here = jnp.logical_and(jnp.isnan(d), v.validity)
+                nonnan_here = jnp.logical_and(~jnp.isnan(d), v.validity)
+                any_nan = nan_here if any_nan is None else \
+                    jnp.logical_or(any_nan, nan_here)
+                any_nonnan = nonnan_here if any_nonnan is None else \
+                    jnp.logical_or(any_nonnan, nonnan_here)
+                d = jnp.where(jnp.isnan(d), jnp.asarray(skip, np_dt), d)
+            d = jnp.where(v.validity, d, jnp.asarray(skip, np_dt))
+            acc = d if acc is None else (
+                jnp.maximum(acc, d) if self._is_max else jnp.minimum(acc, d))
+            any_valid = v.validity if any_valid is None else \
+                jnp.logical_or(any_valid, v.validity)
+        if is_float and self._is_max and any_nan is not None:
+            acc = jnp.where(any_nan, jnp.asarray(np.nan, np_dt), acc)
+        elif is_float and not self._is_max and any_nan is not None:
+            # least: NaN only wins when NO valid operand is non-NaN
+            # (a real +inf operand must not be mistaken for the sentinel)
+            acc = jnp.where(jnp.logical_and(any_nan, ~any_nonnan),
+                            jnp.asarray(np.nan, np_dt), acc)
+        return DVal(acc, any_valid, dt)
+
+    def eval_host(self, batch):
+        dt = self.data_type(batch.schema)
+        np_dt = dt.np_dtype
+        skip, _ = self._sentinels(np_dt)
+        is_float = np.issubdtype(np_dt, np.floating)
+        acc = None
+        any_valid = None
+        any_nan = None
+        any_nonnan = None
+        for c in self.children:
+            v, ok = arrow_to_masked_numpy(c.eval_host(batch))
+            d = v.astype(np_dt)
+            if is_float:
+                nan_here = np.isnan(d) & ok
+                any_nan = nan_here if any_nan is None else (any_nan | nan_here)
+                nn = ~np.isnan(d) & ok
+                any_nonnan = nn if any_nonnan is None else (any_nonnan | nn)
+                d = np.where(np.isnan(d), skip, d)
+            d = np.where(ok, d, skip)
+            acc = d if acc is None else (
+                np.maximum(acc, d) if self._is_max else np.minimum(acc, d))
+            any_valid = ok if any_valid is None else (any_valid | ok)
+        if is_float and self._is_max and any_nan is not None:
+            acc = np.where(any_nan, np.nan, acc)
+        elif is_float and not self._is_max and any_nan is not None:
+            # see eval_device: NaN wins only when no valid non-NaN exists
+            acc = np.where(any_nan & ~any_nonnan, np.nan, acc)
+        return masked_numpy_to_arrow(acc, any_valid, dt)
+
+    def key(self):
+        kids = ",".join(c.key() for c in self.children)
+        return f"{type(self).__name__}({kids})"
+
+
+class Greatest(_NarySelect):
+    _is_max = True
+
+
+class Least(_NarySelect):
+    _is_max = False
+
+
+class AtLeastNNonNulls(Expression):
+    """True when at least n children are non-null AND non-NaN (Spark's
+    df.na.drop support expression — ref GpuAtLeastNNonNulls)."""
+
+    def __init__(self, n: int, *children):
+        self.n = int(n)
+        self.children = list(children)
+
+    def data_type(self, schema: Schema) -> DataType:
+        return BOOL
+
+    def nullable(self, schema):
+        return False
+
+    def eval_device(self, ctx):
+        cnt = None
+        for c in self.children:
+            v = c.eval_device(ctx)
+            good = v.validity
+            if jnp.issubdtype(v.data.dtype, jnp.floating):
+                good = jnp.logical_and(good, ~jnp.isnan(v.data))
+            g = good.astype(jnp.int32)
+            cnt = g if cnt is None else cnt + g
+        data = cnt >= self.n if cnt is not None else \
+            jnp.full(ctx.padded_len, self.n <= 0)
+        return DVal(data, jnp.ones(ctx.padded_len, jnp.bool_), BOOL)
+
+    def eval_host(self, batch):
+        cnt = np.zeros(batch.num_rows, np.int32)
+        for c in self.children:
+            v, ok = arrow_to_masked_numpy(c.eval_host(batch))
+            good = ok.copy()
+            if np.issubdtype(np.asarray(v).dtype, np.floating):
+                good &= ~np.isnan(v)
+            cnt += good
+        return masked_numpy_to_arrow(cnt >= self.n,
+                                     np.ones(batch.num_rows, bool), BOOL)
+
+    def key(self):
+        kids = ",".join(c.key() for c in self.children)
+        return f"AtLeastNNonNulls({self.n};{kids})"
+
+
+class _IdentityHint(Expression):
+    """Catalyst optimizer-hint wrappers: evaluate to the child unchanged
+    (ref GpuKnownNotNull / GpuKnownFloatingPointNormalized)."""
+
+    def __init__(self, child):
+        self.children = [child]
+
+    def data_type(self, schema: Schema) -> DataType:
+        return self.children[0].data_type(schema)
+
+    def eval_device(self, ctx):
+        return self.children[0].eval_device(ctx)
+
+    def eval_host(self, batch):
+        return self.children[0].eval_host(batch)
+
+    def key(self):
+        return f"{type(self).__name__}({self.children[0].key()})"
+
+
+class KnownNotNull(_IdentityHint):
+    def nullable(self, schema):
+        return False
+
+
+class KnownFloatingPointNormalized(_IdentityHint):
+    pass
+
+
+class NormalizeNaNAndZero(Expression):
+    """Canonicalize -0.0 -> 0.0 and every NaN payload -> one canonical NaN
+    so grouping/join keys compare consistently (ref
+    NormalizeFloatingNumbers.scala / GpuNormalizeNaNAndZero)."""
+
+    def __init__(self, child):
+        self.children = [child]
+
+    def data_type(self, schema: Schema) -> DataType:
+        return self.children[0].data_type(schema)
+
+    def eval_device(self, ctx):
+        v = self.children[0].eval_device(ctx)
+        d = v.data
+        if jnp.issubdtype(d.dtype, jnp.floating):
+            # NOT `d + 0.0`: XLA algebraically folds that away under jit
+            # and -0.0 would survive; -0.0 == 0 is True so where() works
+            d = jnp.where(jnp.isnan(d), jnp.asarray(jnp.nan, d.dtype),
+                          jnp.where(d == 0, jnp.asarray(0.0, d.dtype), d))
+        return DVal(d, v.validity, v.dtype)
+
+    def eval_host(self, batch):
+        v, ok = arrow_to_masked_numpy(self.children[0].eval_host(batch))
+        if np.issubdtype(np.asarray(v).dtype, np.floating):
+            v = np.where(np.isnan(v), np.nan, np.where(v == 0, 0.0, v))
+        return masked_numpy_to_arrow(v, ok,
+                                     self.data_type(batch.schema))
+
+    def key(self):
+        return f"normnanzero({self.children[0].key()})"

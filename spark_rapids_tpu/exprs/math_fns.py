@@ -15,7 +15,8 @@ from .arithmetic import arrow_to_masked_numpy, masked_numpy_to_arrow
 __all__ = ["Sqrt", "Exp", "Log", "Log10", "Sin", "Cos", "Tan", "Asin",
            "Acos", "Atan", "Sinh", "Cosh", "Tanh", "Cbrt", "Floor", "Ceil",
            "Round", "Pow", "Signum", "Expm1", "Log1p", "Log2", "Atan2",
-           "ToDegrees", "ToRadians", "Rint"]
+           "ToDegrees", "ToRadians", "Rint", "Asinh", "Acosh", "Atanh",
+           "Cot", "Hypot", "Logarithm", "BRound"]
 
 
 class _UnaryDouble(Expression):
@@ -70,6 +71,10 @@ Cbrt = _mk("Cbrt", jnp.cbrt, np.cbrt)
 ToDegrees = _mk("ToDegrees", jnp.degrees, np.degrees)
 ToRadians = _mk("ToRadians", jnp.radians, np.radians)
 Rint = _mk("Rint", jnp.rint, np.rint)
+Asinh = _mk("Asinh", jnp.arcsinh, np.arcsinh)
+Acosh = _mk("Acosh", jnp.arccosh, np.arccosh)
+Atanh = _mk("Atanh", jnp.arctanh, np.arctanh)
+Cot = _mk("Cot", lambda x: 1.0 / jnp.tan(x), lambda x: 1.0 / np.tan(x))
 
 
 class Signum(_UnaryDouble):
@@ -221,3 +226,90 @@ class Atan2(Expression):
 
     def key(self):
         return f"atan2({self.children[0].key()},{self.children[1].key()})"
+
+
+class Hypot(Expression):
+    """hypot(a, b) = sqrt(a^2 + b^2) without overflow (ref GpuHypot)."""
+
+    device_type_sig = numeric
+
+    def __init__(self, left, right):
+        self.children = [left, right]
+
+    def data_type(self, schema: Schema):
+        return FLOAT64
+
+    def eval_device(self, ctx):
+        l = self.children[0].eval_device(ctx)
+        r = self.children[1].eval_device(ctx)
+        out = jnp.hypot(l.data.astype(jnp.float64),
+                        r.data.astype(jnp.float64))
+        return DVal(out, null_and(l.validity, r.validity), FLOAT64)
+
+    def eval_host(self, batch):
+        l, lv = arrow_to_masked_numpy(self.children[0].eval_host(batch))
+        r, rv = arrow_to_masked_numpy(self.children[1].eval_host(batch))
+        with np.errstate(all="ignore"):
+            out = np.hypot(l.astype(np.float64), r.astype(np.float64))
+        return masked_numpy_to_arrow(out, lv & rv, FLOAT64)
+
+    def key(self):
+        return f"hypot({self.children[0].key()},{self.children[1].key()})"
+
+
+class Logarithm(Expression):
+    """log(base, x) (ref GpuLogarithm: log(x) / log(base))."""
+
+    device_type_sig = numeric
+
+    def __init__(self, base, x):
+        self.children = [base, x]
+
+    def data_type(self, schema: Schema):
+        return FLOAT64
+
+    def eval_device(self, ctx):
+        b = self.children[0].eval_device(ctx)
+        x = self.children[1].eval_device(ctx)
+        out = (jnp.log(x.data.astype(jnp.float64))
+               / jnp.log(b.data.astype(jnp.float64)))
+        return DVal(out, null_and(b.validity, x.validity), FLOAT64)
+
+    def eval_host(self, batch):
+        b, bv = arrow_to_masked_numpy(self.children[0].eval_host(batch))
+        x, xv = arrow_to_masked_numpy(self.children[1].eval_host(batch))
+        with np.errstate(all="ignore"):
+            out = (np.log(x.astype(np.float64))
+                   / np.log(b.astype(np.float64)))
+        return masked_numpy_to_arrow(out, bv & xv, FLOAT64)
+
+    def key(self):
+        return f"log({self.children[0].key()},{self.children[1].key()})"
+
+
+class BRound(Round):
+    """bround: HALF_EVEN (banker's) rounding at the given scale
+    (ref GpuBRound; Round is HALF_UP). numpy/jnp ``rint`` IS half-even."""
+
+    def eval_device(self, ctx):
+        c = self.children[0].eval_device(ctx)
+        if jnp.issubdtype(c.data.dtype, jnp.integer) and self.decimals >= 0:
+            return c
+        scale = 10.0 ** self.decimals
+        out = jnp.rint(c.data.astype(jnp.float64) * scale) / scale
+        return DVal(out, c.validity, self.data_type(ctx.schema))
+
+    def eval_host(self, batch):
+        v, ok = arrow_to_masked_numpy(self.children[0].eval_host(batch))
+        dt = self.data_type(batch.schema)
+        if np.issubdtype(v.dtype, np.integer) and self.decimals >= 0:
+            return masked_numpy_to_arrow(v, ok, dt)
+        scale = 10.0 ** self.decimals
+        with np.errstate(all="ignore"):
+            d = v.astype(np.float64)
+            out = np.rint(d * scale) / scale
+            out = np.where(np.isfinite(d), out, d)
+        return masked_numpy_to_arrow(out, ok, dt)
+
+    def key(self):
+        return f"bround({self.children[0].key()},{self.decimals})"

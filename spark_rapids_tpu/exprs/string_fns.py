@@ -20,7 +20,8 @@ __all__ = ["Length", "Upper", "Lower", "Substring", "ConcatStrings",
            "RegExpReplace", "RegExpExtract", "StringTrim", "StringTrimLeft",
            "StringTrimRight", "StringReplace", "StringLocate", "Lpad",
            "Rpad", "Reverse", "StringRepeat", "InitCap", "StringSplit",
-           "SubstringIndex"]
+           "SubstringIndex", "Ascii", "Chr", "BitLength", "OctetLength",
+           "StringInstr", "StringTranslate", "ConcatWs", "FormatNumber"]
 
 _str_sig = TypeSig([TypeEnum.STRING])
 
@@ -624,3 +625,176 @@ class ParseUrl(_HostStringExpr):
     def key(self):
         return (f"parse_url({self.children[0].key()},{self.part},"
                 f"{self.query_key!r})")
+
+
+class Ascii(_HostStringExpr):
+    """ascii(s): code point of the first character, 0 for '' (ref
+    GpuAscii in stringFunctions.scala)."""
+
+    def __init__(self, child):
+        self.children = [child]
+
+    def data_type(self, schema):
+        return INT32
+
+    def eval_host(self, batch):
+        import pyarrow as pa
+        vals = self.children[0].eval_host(batch).to_pylist()
+        return pa.array([None if s is None else (ord(s[0]) if s else 0)
+                         for s in vals], type=pa.int32())
+
+
+class Chr(_HostStringExpr):
+    """chr(n): character for code point n % 256 like Spark (0 -> '')."""
+
+    def __init__(self, child):
+        self.children = [child]
+
+    def data_type(self, schema):
+        return STRING
+
+    def eval_host(self, batch):
+        import pyarrow as pa
+        vals = self.children[0].eval_host(batch).to_pylist()
+        out = []
+        for n in vals:
+            if n is None:
+                out.append(None)
+            else:
+                m = int(n) & 0xFF if int(n) >= 0 else 0
+                out.append("" if m == 0 else chr(m))
+        return pa.array(out, type=pa.string())
+
+
+class BitLength(_HostStringExpr):
+    def __init__(self, child):
+        self.children = [child]
+
+    def data_type(self, schema):
+        return INT32
+
+    def eval_host(self, batch):
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        b = pc.binary_length(pc.cast(self.children[0].eval_host(batch),
+                                     pa.binary()))
+        return pc.cast(pc.multiply(b, pa.scalar(8)), pa.int32())
+
+
+class OctetLength(_HostStringExpr):
+    def __init__(self, child):
+        self.children = [child]
+
+    def data_type(self, schema):
+        return INT32
+
+    def eval_host(self, batch):
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        return pc.cast(pc.binary_length(
+            pc.cast(self.children[0].eval_host(batch), pa.binary())),
+            pa.int32())
+
+
+class StringInstr(_HostStringExpr):
+    """instr(str, substr): 1-based first occurrence, 0 if absent (ref
+    GpuStringInstr — locate with fixed start=1)."""
+
+    def __init__(self, child, substr):
+        self.children = [child, substr]
+
+    def data_type(self, schema):
+        return INT32
+
+    def eval_host(self, batch):
+        import pyarrow as pa
+        s = self.children[0].eval_host(batch).to_pylist()
+        sub = self.children[1].eval_host(batch).to_pylist()
+        out = [None if a is None or b is None else a.find(b) + 1
+               for a, b in zip(s, sub)]
+        return pa.array(out, type=pa.int32())
+
+
+class StringTranslate(_HostStringExpr):
+    """translate(s, from, to): per-character mapping; chars beyond
+    len(to) are deleted (ref GpuStringTranslate)."""
+
+    dict_transform = True
+
+    def __init__(self, child, src, dst):
+        self.children = [child, src, dst]
+
+    def data_type(self, schema):
+        return STRING
+
+    def eval_host(self, batch):
+        import pyarrow as pa
+        s = self.children[0].eval_host(batch).to_pylist()
+        f = self.children[1].eval_host(batch).to_pylist()
+        t = self.children[2].eval_host(batch).to_pylist()
+        out = []
+        for a, ff, tt in zip(s, f, t):
+            if a is None or ff is None or tt is None:
+                out.append(None)
+                continue
+            table = {}
+            for i, ch in enumerate(ff):
+                if ord(ch) not in table:   # first occurrence wins (Spark)
+                    table[ord(ch)] = tt[i] if i < len(tt) else None
+            out.append(a.translate(table))
+        return pa.array(out, type=pa.string())
+
+
+class ConcatWs(_HostStringExpr):
+    """concat_ws(sep, args...): NULL args are skipped (unlike concat);
+    NULL separator -> NULL (ref GpuConcatWs)."""
+
+    def __init__(self, sep, *children):
+        self.children = [sep] + list(children)
+
+    def data_type(self, schema):
+        return STRING
+
+    def eval_host(self, batch):
+        import pyarrow as pa
+        sep = self.children[0].eval_host(batch).to_pylist()
+        cols = [c.eval_host(batch).to_pylist() for c in self.children[1:]]
+        out = []
+        for i, sp in enumerate(sep):
+            if sp is None:
+                out.append(None)
+                continue
+            parts = []
+            for col in cols:
+                v = col[i]
+                if v is None:
+                    continue
+                if isinstance(v, list):
+                    parts.extend(str(x) for x in v if x is not None)
+                else:
+                    parts.append(str(v))
+            out.append(sp.join(parts))
+        return pa.array(out, type=pa.string())
+
+
+class FormatNumber(_HostStringExpr):
+    """format_number(x, d): thousands separators + d decimal places,
+    HALF_EVEN like java.text.DecimalFormat (ref GpuFormatNumber)."""
+
+    def __init__(self, child, decimals):
+        self.children = [child, decimals]
+
+    def data_type(self, schema):
+        return STRING
+
+    def eval_host(self, batch):
+        import pyarrow as pa
+        vals = self.children[0].eval_host(batch).to_pylist()
+        decs = self.children[1].eval_host(batch).to_pylist()
+        out = []
+        for v, d in zip(vals, decs):
+            if v is None or d is None or d < 0:
+                out.append(None)
+                continue
+            out.append(f"{v:,.{int(d)}f}")
+        return pa.array(out, type=pa.string())

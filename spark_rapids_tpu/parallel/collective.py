@@ -236,7 +236,7 @@ def distributed_groupby(mesh: Mesh, table, key_names: List[str], aggs,
     n = table.num_rows
     per = -(-n // n_dev)
     local_p = bucket_for(max(per, 1))
-    schema = ColumnarBatch.from_arrow(table, pad=False).schema
+    schema = ColumnarBatch.from_arrow_host(table).schema
     key_exprs = [ColumnRef(k) for k in key_names]
     step, _ = build_distributed_agg_step(mesh, schema, key_exprs, aggs,
                                          local_p, pre_filter, axis)
@@ -410,8 +410,8 @@ def distributed_join(mesh: Mesh, ltable, rtable, on, out_factor: int = 4,
     n_dev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
     per = max(-(-max(ltable.num_rows, rtable.num_rows) // n_dev), 1)
     local_p = bucket_for(per)
-    lschema = ColumnarBatch.from_arrow(ltable, pad=False).schema
-    rschema = ColumnarBatch.from_arrow(rtable, pad=False).schema
+    lschema = ColumnarBatch.from_arrow_host(ltable).schema
+    rschema = ColumnarBatch.from_arrow_host(rtable).schema
     lkeys = [ColumnRef(a) for a, _ in on]
     rkeys = [ColumnRef(b) for _, b in on]
     step, _, OUT = build_distributed_join_step(

@@ -206,7 +206,7 @@ class CpuShuffleExchangeExec(TpuExec):
         if self.mode == "roundrobin" or not self.keys:
             pid = np.arange(t.num_rows) % self.num_partitions
         else:
-            batch = ColumnarBatch.from_arrow(t, pad=False)
+            batch = ColumnarBatch.from_arrow_host(t)
             h = np.full(t.num_rows, 42, dtype=np.uint64)
             for k in self.keys:
                 from ..exprs.arithmetic import arrow_to_masked_numpy
