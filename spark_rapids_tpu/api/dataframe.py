@@ -445,21 +445,44 @@ class DataFrame:
         prof = self.session.profiler
         tm = TaskMetrics(ctx)
         prof.maybe_start()
+        import time as _time
+        t0 = _time.perf_counter()
+        ok = False
         try:
             try:
                 out = DeviceDumpHandler(self.session.conf).wrap(
                     lambda: consume(physical, ctx), physical)
                 ctx.check_speculations()
+                ok = True
                 return out
             except SpeculativeOverflow:
                 ctx.speculate = False
                 ctx.speculations.clear()
                 ctx.metrics.clear()
-                return DeviceDumpHandler(self.session.conf).wrap(
+                out = DeviceDumpHandler(self.session.conf).wrap(
                     lambda: consume(physical, ctx), physical)
+                ok = True
+                return out
         finally:
             prof.maybe_stop()
             self.session.last_query_metrics = tm.finish()
+            if ok and not side_effects:
+                # measured whole-query wall per (shape, engine placement):
+                # the cost optimizer prefers these over its model, so a
+                # mispriced engine choice self-corrects on the next
+                # planning of the same shape (plan/cost._ENGINE_WALLS)
+                from ..plan.cost import plan_signature, record_engine_wall
+
+                def _on_device(n):
+                    # scans are engine-shared; any other device exec
+                    # means the query touched the accelerator
+                    if n.is_tpu and "Scan" not in type(n).__name__:
+                        return True
+                    return any(_on_device(c) for c in n.children)
+
+                placement = ("device" if _on_device(physical) else "host")
+                record_engine_wall(plan_signature(self.plan), placement,
+                                   _time.perf_counter() - t0)
 
     def collect_arrow(self):
         return self._execute_wrapped(lambda p, ctx: p.collect(ctx))

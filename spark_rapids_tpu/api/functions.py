@@ -472,3 +472,74 @@ def pandas_udf(fn=None, return_type=None):
         return PandasUDF(fn, [_to_expr(c) for c in cols], return_type)
     call.__name__ = getattr(fn, "__name__", "pandas_udf")
     return call
+
+
+# ---- round-3 breadth batch (ref GpuOverrides registry entries) -----------
+def greatest(*cols) -> Col:
+    return Col(E.Greatest(*[_to_expr(c) for c in cols]))
+def least(*cols) -> Col:
+    return Col(E.Least(*[_to_expr(c) for c in cols]))
+def bitwise_not(c) -> Col: return Col(E.BitwiseNot(_to_expr(c)))
+def shiftleft(c, n) -> Col:
+    return Col(E.ShiftLeft(_to_expr(c), _to_expr(n)))
+def shiftright(c, n) -> Col:
+    return Col(E.ShiftRight(_to_expr(c), _to_expr(n)))
+def shiftrightunsigned(c, n) -> Col:
+    return Col(E.ShiftRightUnsigned(_to_expr(c), _to_expr(n)))
+def hypot(a, b) -> Col: return Col(E.Hypot(_to_expr(a), _to_expr(b)))
+def bround(c, scale: int = 0) -> Col:
+    return Col(E.BRound(_to_expr(c), scale))
+def asinh(c) -> Col: return Col(E.Asinh(_to_expr(c)))
+def acosh(c) -> Col: return Col(E.Acosh(_to_expr(c)))
+def atanh(c) -> Col: return Col(E.Atanh(_to_expr(c)))
+def cot(c) -> Col: return Col(E.Cot(_to_expr(c)))
+def last_day(c) -> Col: return Col(E.LastDay(_to_expr(c)))
+def add_months(c, n) -> Col:
+    return Col(E.AddMonths(_to_expr(c), _to_expr(n)))
+def months_between(end, start, round_off: bool = True) -> Col:
+    return Col(E.MonthsBetween(_to_expr(end), _to_expr(start), round_off))
+def timestamp_seconds(c) -> Col:
+    return Col(E.SecondsToTimestamp(_to_expr(c)))
+def timestamp_millis(c) -> Col:
+    return Col(E.MillisToTimestamp(_to_expr(c)))
+def timestamp_micros(c) -> Col:
+    return Col(E.MicrosToTimestamp(_to_expr(c)))
+def to_unix_timestamp(c, fmt: str = "yyyy-MM-dd HH:mm:ss") -> Col:
+    return Col(E.ToUnixTimestamp(_to_expr(c), fmt))
+def unix_timestamp(c, fmt: str = "yyyy-MM-dd HH:mm:ss") -> Col:
+    return Col(E.UnixTimestamp(_to_expr(c), fmt))
+def from_unixtime(c, fmt: str = "yyyy-MM-dd HH:mm:ss") -> Col:
+    return Col(E.FromUnixTime(_to_expr(c), fmt))
+def date_format(c, fmt: str) -> Col:
+    return Col(E.DateFormatClass(_to_expr(c), fmt))
+def trunc(c, fmt: str) -> Col: return Col(E.TruncDate(_to_expr(c), fmt))
+def ascii(c) -> Col: return Col(E.Ascii(_to_expr(c)))
+def chr_(c) -> Col: return Col(E.Chr(_to_expr(c)))
+def bit_length(c) -> Col: return Col(E.BitLength(_to_expr(c)))
+def octet_length(c) -> Col: return Col(E.OctetLength(_to_expr(c)))
+def instr(c, substr: str) -> Col:
+    return Col(E.StringInstr(_to_expr(c), _to_expr(substr)))
+def translate(c, src: str, dst: str) -> Col:
+    return Col(E.StringTranslate(_to_expr(c), _to_expr(src),
+                                 _to_expr(dst)))
+def concat_ws(sep, *cols) -> Col:
+    return Col(E.ConcatWs(_to_expr(sep), *[_to_expr(c) for c in cols]))
+def format_number(c, d) -> Col:
+    return Col(E.FormatNumber(_to_expr(c), _to_expr(d)))
+
+
+def collect_list(c):
+    from ..exprs.aggregates import CollectList
+    return CollectList(_to_expr(c))
+def collect_set(c):
+    from ..exprs.aggregates import CollectSet
+    return CollectSet(_to_expr(c))
+def min_by(c, ordering):
+    from ..exprs.aggregates import MinBy
+    return MinBy(_to_expr(c), _to_expr(ordering))
+def max_by(c, ordering):
+    from ..exprs.aggregates import MaxBy
+    return MaxBy(_to_expr(c), _to_expr(ordering))
+def percentile(c, p: float):
+    from ..exprs.aggregates import Percentile
+    return Percentile(_to_expr(c), p)

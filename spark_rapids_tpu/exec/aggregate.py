@@ -857,10 +857,11 @@ class CpuAggregateExec(TpuExec):
     def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
         import pandas as pd
         import pyarrow as pa
-        from ..exprs.aggregates import (Average, Count, CountStar, First,
-                                        Last, Max, Min, StddevPop,
-                                        StddevSamp, Sum, VariancePop,
-                                        VarianceSamp)
+        from ..exprs.aggregates import (Average, CollectList, CollectSet,
+                                        Count, CountStar, First, Last, Max,
+                                        MaxBy, Min, MinBy, Percentile,
+                                        StddevPop, StddevSamp, Sum,
+                                        VariancePop, VarianceSamp)
         tables = [b.to_arrow() for b in self.children[0].execute(ctx)]
         at = (pa.concat_tables(tables) if tables
               else _empty_arrow(self.children[0].output_schema()))
@@ -879,6 +880,9 @@ class CpuAggregateExec(TpuExec):
         in_names = []
         for i, a in enumerate(self.aggs):
             col = f"_a{i}"
+            if isinstance(a, (MinBy, MaxBy)) and src is not None:
+                # second input: the ordering column rides alongside
+                work[col + "__ord"] = a.ordering.eval_host(src).to_pandas()
             if isinstance(a, CountStar):
                 work[col] = 1
                 work[col + "__ok"] = True
@@ -895,16 +899,42 @@ class CpuAggregateExec(TpuExec):
                     work[col + "__ok"] = ~np.asarray(arr.is_null())
             in_names.append(col)
 
-        def agg_series(a, s: "pd.Series", ok: "pd.Series"):
-            vals = s.to_numpy()[ok.to_numpy().astype(bool)]
+        def agg_series(a, s: "pd.Series", ok: "pd.Series", sub=None,
+                       col=None):
+            okm = ok.to_numpy().astype(bool)
+            vals = s.to_numpy()[okm]
             if a.distinct and not isinstance(a, CountStar):
                 vals = pd.unique(pd.Series(vals))   # NaN == NaN, keep one
             if isinstance(a, CountStar):
                 return len(s)
             if isinstance(a, Count):
                 return len(vals)
+            if isinstance(a, CollectSet):
+                return list(pd.unique(pd.Series(vals)))
+            if isinstance(a, CollectList):
+                return list(vals)
+            if isinstance(a, (MinBy, MaxBy)):
+                # Spark: pick the VALUE (possibly NULL) at the extreme
+                # ordering; only NULL-ordering rows are skipped
+                o = sub[col + "__ord"].to_numpy()
+                o_ok = ~pd.isna(o)
+                if not o_ok.any():
+                    return None
+                idx = np.nanargmin(o[o_ok]) if a._pick_min \
+                    else np.nanargmax(o[o_ok])
+                if not okm[o_ok][idx]:
+                    return None                     # value is SQL NULL
+                return s.to_numpy()[o_ok][idx]
             if len(vals) == 0:
                 return None
+            if isinstance(a, Percentile):
+                fv = vals.astype(np.float64)
+                fv = fv[~np.isnan(fv)]
+                if len(fv) == 0:
+                    return None
+                return float(np.percentile(np.sort(fv),
+                                           a.percentage * 100.0,
+                                           method="linear"))
             if isinstance(a, Sum):
                 return np.sum(vals)                 # NaN propagates
             if isinstance(a, Min):
@@ -938,11 +968,12 @@ class CpuAggregateExec(TpuExec):
                 if not isinstance(key, tuple):
                     key = (key,)
                 rows.append(list(key) +
-                            [agg_series(a, sub[c], sub[c + "__ok"])
+                            [agg_series(a, sub[c], sub[c + "__ok"],
+                                        sub, c)
                              for a, c in zip(self.aggs, in_names)])
             out = pd.DataFrame(rows, columns=self._schema.names())
         else:
-            vals = [agg_series(a, work[c], work[c + "__ok"])
+            vals = [agg_series(a, work[c], work[c + "__ok"], work, c)
                     for a, c in zip(self.aggs, in_names)]
             out = pd.DataFrame([vals], columns=self._schema.names())
         # coerce to declared output types
@@ -951,6 +982,8 @@ class CpuAggregateExec(TpuExec):
         def _cell(x, is_float: bool):
             if x is None:
                 return None
+            if isinstance(x, (list, np.ndarray)):
+                return list(x)         # collect_list/set array cells
             if is_float and isinstance(x, float) and np.isnan(x):
                 return x               # NaN is a VALUE, not SQL NULL
             return None if pd.isna(x) else x

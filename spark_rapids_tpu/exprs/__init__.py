@@ -1,26 +1,38 @@
 from .base import (Alias, BoundReference, ColumnRef, DVal, EvalContext,
                    Expression, Literal, Unsupported, promote_types)
-from .arithmetic import (Abs, Add, Divide, IntegralDivide, Multiply, Pmod,
-                         Remainder, Subtract, UnaryMinus)
+from .arithmetic import (Abs, Add, BitwiseAnd, BitwiseNot, BitwiseOr,
+                         BitwiseXor, Divide, IntegralDivide, Multiply, Pmod,
+                         Remainder, ShiftLeft, ShiftRight,
+                         ShiftRightUnsigned, Subtract, UnaryMinus,
+                         UnaryPositive)
 from .comparison import (EqualNullSafe, EqualTo, GreaterThan,
                          GreaterThanOrEqual, In, IsNaN, IsNotNull, IsNull,
                          LessThan, LessThanOrEqual, NotEqual)
 from .logical import And, Not, Or
-from .math_fns import (Acos, Asin, Atan, Atan2, Cbrt, Ceil, Cos, Cosh, Exp,
-                       Expm1, Floor, Log, Log1p, Log2, Log10, Pow, Rint,
-                       Round, Signum, Sin, Sinh, Sqrt, Tan, Tanh, ToDegrees,
-                       ToRadians)
-from .conditional import CaseWhen, Coalesce, If, NaNvl
+from .math_fns import (Acos, Acosh, Asin, Asinh, Atan, Atan2, Atanh,
+                       BRound, Cbrt, Ceil, Cos, Cosh, Cot, Exp, Expm1,
+                       Floor, Hypot, Log, Log1p, Log2, Log10, Logarithm,
+                       Pow, Rint, Round, Signum, Sin, Sinh, Sqrt, Tan,
+                       Tanh, ToDegrees, ToRadians)
+from .conditional import (AtLeastNNonNulls, CaseWhen, Coalesce, Greatest,
+                          If, KnownFloatingPointNormalized, KnownNotNull,
+                          Least, NaNvl, NormalizeNaNAndZero)
 from .cast import Cast
-from .datetime_fns import (DateAdd, DateDiff, DateSub, DayOfMonth, DayOfWeek,
-                           DayOfYear, FromUtcTimestamp, Hour, Minute, Month,
-                           Quarter, Second, ToUtcTimestamp, UnixDate,
-                           WeekDay, Year)
-from .string_fns import (ConcatStrings, Contains, EndsWith, InitCap, Length,
-                         Like, Lower, Lpad, ParseUrl, RLike, RegExpExtract,
-                         RegExpReplace, Reverse, Rpad, StartsWith,
-                         StringLocate, StringRepeat, StringReplace,
-                         StringSplit, StringTrim, StringTrimLeft,
+from .datetime_fns import (AddMonths, DateAdd, DateDiff, DateFormatClass,
+                           DateSub, DayOfMonth, DayOfWeek, DayOfYear,
+                           FromUnixTime, FromUtcTimestamp, Hour, LastDay,
+                           MicrosToTimestamp, MillisToTimestamp, Minute,
+                           Month, MonthsBetween, Quarter, Second,
+                           SecondsToTimestamp, TimeAdd, ToUnixTimestamp,
+                           ToUtcTimestamp, TruncDate, UnixDate,
+                           UnixTimestamp, WeekDay, Year)
+from .string_fns import (Ascii, BitLength, Chr, ConcatStrings, ConcatWs,
+                         Contains, EndsWith, FormatNumber, InitCap, Length,
+                         Like, Lower, Lpad, OctetLength, ParseUrl, RLike,
+                         RegExpExtract, RegExpReplace, Reverse, Rpad,
+                         StartsWith, StringInstr, StringLocate,
+                         StringRepeat, StringReplace, StringSplit,
+                         StringTranslate, StringTrim, StringTrimLeft,
                          StringTrimRight, Substring, SubstringIndex, Upper)
 from .regex_transpiler import (RegexUnsupported, sql_like_to_regex,
                                transpile_java_regex)

@@ -31,7 +31,8 @@ from ..columnar.segmented import SortedSegments, seg_max, seg_min, seg_sum
 
 __all__ = ["AggregateExpression", "Sum", "Count", "CountStar", "Min", "Max",
            "Average", "First", "Last", "StddevSamp", "StddevPop",
-           "VarianceSamp", "VariancePop"]
+           "VarianceSamp", "VariancePop", "CollectList", "CollectSet",
+           "MinBy", "MaxBy", "Percentile"]
 
 
 def _seg_sum(data, valid, gid, num_segments):
@@ -566,3 +567,71 @@ class StddevSamp(VarianceSamp):
         out = jnp.sqrt(m2 / jnp.where(ok, n - 1.0, 1.0))
         out = jnp.where(n == 1, jnp.nan, out)
         return DVal(out, n > 0, FLOAT64)
+
+
+class _HostOnlyAgg(AggregateExpression):
+    """Aggregates without a device update/merge pipeline: the planner
+    reverts the whole aggregation to the CPU twin, whose per-group
+    evaluation lives in exec/aggregate.CpuAggregateExec (honest whole-exec
+    fallback, ref the reference's TypeSig rejections)."""
+
+    def device_unsupported_reason(self, schema: Schema) -> Optional[str]:
+        from .base import expression_disabled_reason
+        return (expression_disabled_reason(type(self))
+                or f"{type(self).__name__} evaluates on host")
+
+
+class CollectList(_HostOnlyAgg):
+    """collect_list(e): non-null values per group in arrival order
+    (ref GpuCollectList in aggregateFunctions.scala)."""
+
+    def data_type(self, schema: Schema):
+        from ..types import ArrayType
+        return ArrayType(self.child.data_type(schema))
+
+    def nullable(self, schema):
+        return False
+
+
+class CollectSet(CollectList):
+    """collect_set(e): distinct non-null values (ref GpuCollectSet)."""
+
+
+class MinBy(_HostOnlyAgg):
+    """min_by(value, ordering) (ref GpuMinBy)."""
+
+    _pick_min = True
+
+    def __init__(self, child, ordering, name=None):
+        super().__init__(child, name)
+        self.ordering = ordering
+
+    def data_type(self, schema: Schema):
+        return self.child.data_type(schema)
+
+    def input_exprs(self):
+        return [self.child, self.ordering]
+
+    def key(self):
+        return (f"{type(self).__name__}({self.child.key()},"
+                f"{self.ordering.key()})")
+
+
+class MaxBy(MinBy):
+    _pick_min = False
+
+
+class Percentile(_HostOnlyAgg):
+    """percentile(e, p): exact percentile with linear interpolation
+    between closest ranks (Spark's Percentile; ref GpuPercentileDefault)."""
+
+    def __init__(self, child, percentage: float, name=None):
+        super().__init__(child, name)
+        self.percentage = float(percentage)
+
+    def data_type(self, schema: Schema):
+        from ..types import FLOAT64
+        return FLOAT64
+
+    def key(self):
+        return f"percentile({self.child.key()},{self.percentage})"

@@ -8,12 +8,17 @@ from __future__ import annotations
 import jax.numpy as jnp
 import numpy as np
 
-from ..types import DATE, INT32, INT64, TIMESTAMP, Schema, TypeSig, TypeEnum
-from .base import DVal, Expression, null_and
+from ..types import (DATE, FLOAT64, INT32, INT64, STRING, TIMESTAMP,
+                     Schema, TypeSig, TypeEnum)
+from .base import DVal, Expression, Unsupported, null_and
 
 __all__ = ["Year", "Month", "DayOfMonth", "Hour", "Minute", "Second",
            "DayOfWeek", "WeekDay", "DayOfYear", "Quarter", "DateAdd",
-           "DateSub", "DateDiff", "UnixDate", "civil_from_days"]
+           "DateSub", "DateDiff", "UnixDate", "civil_from_days",
+           "LastDay", "AddMonths", "MonthsBetween", "SecondsToTimestamp",
+           "MillisToTimestamp", "MicrosToTimestamp", "ToUnixTimestamp",
+           "UnixTimestamp", "FromUnixTime", "DateFormatClass", "TimeAdd",
+           "TruncDate"]
 
 _MICROS_PER_DAY = 86_400_000_000
 _date_sig = TypeSig([TypeEnum.DATE, TypeEnum.TIMESTAMP])
@@ -354,3 +359,435 @@ class ToUtcTimestamp(_TzConvert):
     @property
     def name_hint(self):
         return f"to_utc_timestamp({self.children[0].name_hint},{self.tz})"
+
+
+def _days_in_month(year, month):
+    import jax.numpy as jnp
+    leap = jnp.logical_and(year % 4 == 0,
+                           jnp.logical_or(year % 100 != 0, year % 400 == 0))
+    base = jnp.asarray(
+        np.array([31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31],
+                 dtype=np.int32))
+    dim = jnp.take(base, jnp.clip(month - 1, 0, 11))
+    return jnp.where(jnp.logical_and(month == 2, leap), 29, dim)
+
+
+class LastDay(Expression):
+    """last_day(date): last day of the input's month (ref GpuLastDay)."""
+    device_type_sig = TypeSig([TypeEnum.DATE])
+
+    def __init__(self, child):
+        self.children = [child]
+
+    def data_type(self, schema):
+        return DATE
+
+    def eval_device(self, ctx):
+        v = self.children[0].eval_device(ctx)
+        days = _days_of(v)
+        y, m, d = civil_from_days(days)
+        out = days + (_days_in_month(y, m) - d).astype(jnp.int32)
+        return DVal(out, v.validity, DATE)
+
+    def eval_host(self, batch):
+        import pyarrow as pa
+        vals = self.children[0].eval_host(batch).to_pylist()
+        import calendar
+        out = []
+        for d in vals:
+            if d is None:
+                out.append(None)
+            else:
+                out.append(d.replace(
+                    day=calendar.monthrange(d.year, d.month)[1]))
+        return pa.array(out, type=pa.date32())
+
+    def key(self):
+        return f"last_day({self.children[0].key()})"
+
+
+class AddMonths(Expression):
+    """add_months(date, n): calendar month arithmetic with day clamped to
+    the target month's end (ref GpuAddMonths)."""
+    device_type_sig = TypeSig([TypeEnum.DATE, TypeEnum.BYTE, TypeEnum.SHORT,
+                               TypeEnum.INT])
+
+    def __init__(self, date, months):
+        self.children = [date, months]
+
+    def data_type(self, schema):
+        return DATE
+
+    def eval_host(self, batch):
+        import pyarrow as pa
+        ds = self.children[0].eval_host(batch).to_pylist()
+        ns = self.children[1].eval_host(batch).to_pylist()
+        import calendar
+        import datetime
+        out = []
+        for d, n in zip(ds, ns):
+            if d is None or n is None:
+                out.append(None)
+                continue
+            t = d.year * 12 + (d.month - 1) + int(n)
+            y, m = divmod(t, 12)
+            m += 1
+            day = min(d.day, calendar.monthrange(y, m)[1])
+            out.append(datetime.date(y, m, day))
+        return pa.array(out, type=pa.date32())
+
+    def eval_device(self, ctx):
+        v = self.children[0].eval_device(ctx)
+        n = self.children[1].eval_device(ctx)
+        y, m, d = civil_from_days(_days_of(v))
+        t = y * 12 + (m - 1) + n.data.astype(jnp.int32)
+        ny = jnp.floor_divide(t, 12)
+        nm = t - ny * 12 + 1
+        nd = jnp.minimum(d, _days_in_month(ny, nm))
+        out = _days_from_civil(ny, nm, nd)
+        return DVal(out, null_and(v.validity, n.validity), DATE)
+
+    def key(self):
+        return f"add_months({self.children[0].key()},{self.children[1].key()})"
+
+
+def _days_from_civil(y, m, d):
+    """(year, month, day) -> days since epoch (Hinnant days_from_civil)."""
+    y = y - (m <= 2)
+    era = jnp.floor_divide(y, 400)
+    yoe = y - era * 400
+    mp = m + jnp.where(m > 2, -3, 9)
+    doy = jnp.floor_divide(153 * mp + 2, 5) + d - 1
+    doe = yoe * 365 + jnp.floor_divide(yoe, 4) \
+        - jnp.floor_divide(yoe, 100) + doy
+    return (era * 146097 + doe - 719468).astype(jnp.int32)
+
+
+class MonthsBetween(Expression):
+    """months_between(end, start[, roundOff]): fractional months, both
+    last-day-of-month => whole months (ref GpuMonthsBetween)."""
+    device_type_sig = TypeSig([TypeEnum.DATE, TypeEnum.TIMESTAMP])
+
+    def __init__(self, end, start, round_off=True):
+        self.children = [end, start]
+        self.round_off = bool(round_off)
+
+    def data_type(self, schema):
+        return FLOAT64
+
+    def eval_host(self, batch):
+        import pyarrow as pa
+        import calendar
+        import datetime
+
+        def as_dt(x):
+            if isinstance(x, datetime.datetime):
+                return x
+            return datetime.datetime(x.year, x.month, x.day)
+
+        e = self.children[0].eval_host(batch).to_pylist()
+        s = self.children[1].eval_host(batch).to_pylist()
+        out = []
+        for a, b in zip(e, s):
+            if a is None or b is None:
+                out.append(None)
+                continue
+            a, b = as_dt(a), as_dt(b)
+            a_last = a.day == calendar.monthrange(a.year, a.month)[1]
+            b_last = b.day == calendar.monthrange(b.year, b.month)[1]
+            months = (a.year - b.year) * 12 + (a.month - b.month)
+            if a.day == b.day or (a_last and b_last):
+                r = float(months)
+            else:
+                secs_a = (a.day - 1) * 86400 + a.hour * 3600 \
+                    + a.minute * 60 + a.second
+                secs_b = (b.day - 1) * 86400 + b.hour * 3600 \
+                    + b.minute * 60 + b.second
+                r = months + (secs_a - secs_b) / (31.0 * 86400)
+            out.append(round(r, 8) if self.round_off else r)
+        return pa.array(out, type=pa.float64())
+
+    def key(self):
+        return (f"months_between({self.children[0].key()},"
+                f"{self.children[1].key()},{self.round_off})")
+
+
+class _ScaledToTimestamp(Expression):
+    """timestamp_seconds/millis/micros: integral -> timestamp
+    (ref GpuSecondsToTimestamp family)."""
+    device_type_sig = TypeSig([TypeEnum.BYTE, TypeEnum.SHORT, TypeEnum.INT,
+                               TypeEnum.LONG])
+    _scale = 1
+
+    def __init__(self, child):
+        self.children = [child]
+
+    def data_type(self, schema):
+        return TIMESTAMP
+
+    def eval_device(self, ctx):
+        v = self.children[0].eval_device(ctx)
+        out = v.data.astype(jnp.int64) * jnp.int64(type(self)._scale)
+        return DVal(out, v.validity, TIMESTAMP)
+
+    def eval_host(self, batch):
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        micros = pc.multiply(
+            pc.cast(self.children[0].eval_host(batch), pa.int64()),
+            pa.scalar(type(self)._scale, pa.int64()))
+        return pc.cast(micros, pa.timestamp("us", "UTC"))
+
+    def key(self):
+        return f"{type(self).__name__}({self.children[0].key()})"
+
+
+class SecondsToTimestamp(_ScaledToTimestamp):
+    _scale = 1_000_000
+
+
+class MillisToTimestamp(_ScaledToTimestamp):
+    _scale = 1_000
+
+
+class MicrosToTimestamp(_ScaledToTimestamp):
+    _scale = 1
+
+
+class ToUnixTimestamp(Expression):
+    """to_unix_timestamp(ts) -> long seconds (timestamp/date input device;
+    string parsing on host with the given java format; ref
+    GpuToUnixTimestamp)."""
+    device_type_sig = TypeSig([TypeEnum.DATE, TypeEnum.TIMESTAMP])
+
+    def __init__(self, child, fmt: str = "yyyy-MM-dd HH:mm:ss"):
+        self.children = [child]
+        self.fmt = fmt
+
+    def data_type(self, schema):
+        return INT64
+
+    def device_unsupported_reason(self, schema):
+        from .base import expression_disabled_reason
+        r = expression_disabled_reason(type(self))
+        if r is not None:
+            return r
+        dt = self.children[0].data_type(schema)
+        if dt == STRING:
+            return "string timestamp parsing runs on host"
+        return None
+
+    def eval_device(self, ctx):
+        v = self.children[0].eval_device(ctx)
+        if v.dtype == TIMESTAMP:
+            out = jnp.floor_divide(v.data, 1_000_000)
+        else:   # DATE
+            out = v.data.astype(jnp.int64) * jnp.int64(86400)
+        return DVal(out.astype(jnp.int64), v.validity, INT64)
+
+    def eval_host(self, batch):
+        import pyarrow as pa
+        dt = self.children[0].data_type(batch.schema)
+        arr = self.children[0].eval_host(batch)
+        import pyarrow.compute as pc
+        if dt == TIMESTAMP:
+            return pc.cast(pc.floor(pc.divide(
+                pc.cast(arr, pa.int64()), pa.scalar(1_000_000.0))),
+                pa.int64())
+        if dt == DATE:
+            return pc.multiply(pc.cast(arr, pa.int64()),
+                               pa.scalar(86400, pa.int64()))
+        # string: java SimpleDateFormat subset via strptime
+        fmt = _java_to_strptime(self.fmt)
+        out = []
+        import datetime
+        for s in arr.to_pylist():
+            if s is None:
+                out.append(None)
+                continue
+            try:
+                d = datetime.datetime.strptime(s, fmt)
+                out.append(int(d.replace(
+                    tzinfo=datetime.timezone.utc).timestamp()))
+            except ValueError:
+                out.append(None)
+        return pa.array(out, type=pa.int64())
+
+    def key(self):
+        return f"{type(self).__name__}({self.children[0].key()},{self.fmt})"
+
+
+class UnixTimestamp(ToUnixTimestamp):
+    """unix_timestamp(...) — same semantics (ref GpuUnixTimestamp)."""
+
+
+def _java_to_strptime(fmt: str) -> str:
+    """Java SimpleDateFormat subset -> strptime (shared with the cast
+    machinery's date parsing; unsupported directives raise so tagging
+    can reject them honestly)."""
+    # no SSS: Java SSS is 3-digit millis, strftime %f is 6-digit micros
+    # — mapping them would silently format wrong, so SSS stays rejected
+    table = [("yyyy", "%Y"), ("yy", "%y"), ("MM", "%m"), ("dd", "%d"),
+             ("HH", "%H"), ("mm", "%M"), ("ss", "%S")]
+    out = fmt
+    for j, p in table:
+        out = out.replace(j, p)
+    import re as _re
+    residue = _re.sub(r"%[a-zA-Z]", "", out)   # strip emitted directives
+    if any(ch.isalpha() for ch in residue):
+        leftover = [c for c in residue if c.isalpha()]
+        raise Unsupported(f"unsupported datetime format chars {leftover}")
+    return out
+
+
+class FromUnixTime(Expression):
+    """from_unixtime(seconds, fmt) -> string (host strftime; ref
+    GpuFromUnixTime)."""
+
+    def __init__(self, child, fmt: str = "yyyy-MM-dd HH:mm:ss"):
+        self.children = [child]
+        self.fmt = fmt
+        _java_to_strptime(fmt)   # unsupported formats reject at BUILD time
+
+    def data_type(self, schema):
+        return STRING
+
+    def device_unsupported_reason(self, schema):
+        return f"{type(self).__name__}: string formatting runs on host"
+
+    def eval_host(self, batch):
+        import datetime
+        import pyarrow as pa
+        fmt = _java_to_strptime(self.fmt)
+        out = []
+        for v in self.children[0].eval_host(batch).to_pylist():
+            if v is None:
+                out.append(None)
+            else:
+                out.append(datetime.datetime.fromtimestamp(
+                    int(v), datetime.timezone.utc).strftime(fmt))
+        return pa.array(out, type=pa.string())
+
+    def key(self):
+        return f"from_unixtime({self.children[0].key()},{self.fmt})"
+
+
+class DateFormatClass(Expression):
+    """date_format(ts, fmt) -> string (host strftime; ref
+    GpuDateFormatClass)."""
+
+    def __init__(self, child, fmt: str):
+        self.children = [child]
+        self.fmt = fmt
+        _java_to_strptime(fmt)   # unsupported formats reject at BUILD time
+
+    def data_type(self, schema):
+        return STRING
+
+    def device_unsupported_reason(self, schema):
+        return f"{type(self).__name__}: string formatting runs on host"
+
+    def eval_host(self, batch):
+        import pyarrow as pa
+        fmt = _java_to_strptime(self.fmt)
+        out = []
+        for v in self.children[0].eval_host(batch).to_pylist():
+            out.append(None if v is None else v.strftime(fmt))
+        return pa.array(out, type=pa.string())
+
+    def key(self):
+        return f"date_format({self.children[0].key()},{self.fmt})"
+
+
+class TimeAdd(Expression):
+    """timestamp + INTERVAL microseconds (ref GpuTimeAdd); the interval
+    rides as a static literal in micros."""
+    device_type_sig = TypeSig([TypeEnum.TIMESTAMP])
+
+    def __init__(self, child, interval_micros: int):
+        self.children = [child]
+        self.micros = int(interval_micros)
+
+    def data_type(self, schema):
+        return TIMESTAMP
+
+    def eval_device(self, ctx):
+        v = self.children[0].eval_device(ctx)
+        return DVal(v.data + jnp.int64(self.micros), v.validity, TIMESTAMP)
+
+    def eval_host(self, batch):
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        arr = self.children[0].eval_host(batch)
+        out = pc.add(pc.cast(arr, pa.int64()),
+                     pa.scalar(self.micros, pa.int64()))
+        return pc.cast(out, pa.timestamp("us", "UTC"))
+
+    def key(self):
+        return f"time_add({self.children[0].key()},{self.micros})"
+
+
+class TruncDate(Expression):
+    """trunc(date, fmt): truncate to year/quarter/month/week level
+    (ref GpuTruncDate)."""
+    device_type_sig = TypeSig([TypeEnum.DATE])
+
+    _LEVELS = {"year": "year", "yyyy": "year", "yy": "year",
+               "quarter": "quarter", "month": "month", "mon": "month",
+               "mm": "month", "week": "week"}
+
+    def __init__(self, child, fmt: str):
+        self.children = [child]
+        self.fmt = str(fmt).lower()
+
+    def data_type(self, schema):
+        return DATE
+
+    def device_unsupported_reason(self, schema):
+        from .base import expression_disabled_reason
+        r = expression_disabled_reason(type(self))
+        if r is not None:
+            return r
+        if self._LEVELS.get(self.fmt) is None:
+            return f"trunc level {self.fmt!r} unsupported"
+        return None
+
+    def eval_device(self, ctx):
+        v = self.children[0].eval_device(ctx)
+        days = _days_of(v)
+        y, m, d = civil_from_days(days)
+        level = self._LEVELS[self.fmt]
+        if level == "week":
+            # Monday-start week: 1970-01-01 was a Thursday (weekday 3)
+            out = days - ((days + 3) % 7)
+        else:
+            if level == "year":
+                nm = jnp.ones_like(m)
+            elif level == "quarter":
+                nm = ((m - 1) // 3) * 3 + 1
+            else:
+                nm = m
+            out = _days_from_civil(y, nm, jnp.ones_like(d))
+        return DVal(out.astype(jnp.int32), v.validity, DATE)
+
+    def eval_host(self, batch):
+        import datetime
+        import pyarrow as pa
+        level = self._LEVELS.get(self.fmt)
+        out = []
+        for v in self.children[0].eval_host(batch).to_pylist():
+            if v is None or level is None:
+                out.append(None)
+            elif level == "year":
+                out.append(v.replace(month=1, day=1))
+            elif level == "quarter":
+                out.append(v.replace(month=((v.month - 1) // 3) * 3 + 1,
+                                     day=1))
+            elif level == "month":
+                out.append(v.replace(day=1))
+            else:   # week, Monday start
+                out.append(v - datetime.timedelta(days=v.weekday()))
+        return pa.array(out, type=pa.date32())
+
+    def key(self):
+        return f"trunc({self.children[0].key()},{self.fmt})"

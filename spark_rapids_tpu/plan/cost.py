@@ -56,7 +56,11 @@ _HOST_ROW_COST = {
     L.Project: 8.0e-9,
     L.Join: 4.0e-8,              # hash probe per stream row
     L.Sort: 1.5e-7,
-    L.Window: 1.8e-7,
+    # the CPU twin (CpuWindowExec, pandas per-window apply) measures
+    # ~1e-5 s/row — NOT the host-sink numpy path, which belongs to
+    # TpuWindowExec and prices itself (WINDOW_HOST_SINK_ROWS); a cheap
+    # estimate here would revert windows onto the slow twin
+    L.Window: 1.0e-5,
     L.Expand: 2.0e-8,
 }
 _HOST_ROW_DEFAULT = 2.0e-8
@@ -204,6 +208,33 @@ def record_runtime_rows(sig: str, rows: int) -> None:
     _RUNTIME_ROWS[sig] = max(_RUNTIME_ROWS.get(sig, 0), int(rows))
 
 
+#: measured whole-query wall seconds per (plan signature, placement):
+#: the ground truth that overrides the static floor model once an engine
+#: has actually been tried — mispriced shapes self-correct on the next
+#: planning. Values are (observations, min seconds); a placement's wall
+#: is TRUSTED only after >= 2 observations, because the first device run
+#: of a shape carries its XLA compile (minutes on a remote backend) and
+#: must not poison the choice
+_ENGINE_WALLS: dict = {}
+
+
+def record_engine_wall(sig: str, placement: str, seconds: float) -> None:
+    if len(_ENGINE_WALLS) >= _RUNTIME_SIZES_MAX \
+            and (sig, placement) not in _ENGINE_WALLS:
+        _ENGINE_WALLS.pop(next(iter(_ENGINE_WALLS)))
+    k = (sig, placement)
+    cnt, prev = _ENGINE_WALLS.get(k, (0, None))
+    _ENGINE_WALLS[k] = (cnt + 1,
+                        seconds if prev is None else min(prev, seconds))
+
+
+def trusted_engine_wall(sig: str, placement: str):
+    got = _ENGINE_WALLS.get((sig, placement))
+    if got is None or got[0] < 2:
+        return None
+    return got[1]
+
+
 class RowsAccum:
     """Per-exec output-row accumulator for measured-rows feedback.
 
@@ -280,7 +311,8 @@ class _Cost:
         self.device_boundary = device_boundary
 
 
-def apply_cost_optimizer(meta: PlanMeta, conf: TpuConf) -> None:
+def apply_cost_optimizer(meta: PlanMeta, conf: TpuConf,
+                         wall_sig: Optional[str] = None) -> None:
     """Revert TPU-capable nodes whose device placement is not worth it.
 
     Two decisions, both the reference's CostBasedOptimizer idea adapted to
@@ -346,10 +378,22 @@ def apply_cost_optimizer(meta: PlanMeta, conf: TpuConf) -> None:
 
     host_only = pure_host(meta)
     best_mixed = min(root.device, root.host)
-    if floor > 0 and host_only < best_mixed + floor:
-        reason = (f"cost-based: whole-plan host estimate {host_only:.4f}s "
-                  f"beats device {best_mixed:.4f}s + "
-                  f"{floor:.2f}s query floor")
+    host_est = host_only
+    dev_est = best_mixed + floor
+    how = "estimate"
+    if wall_sig is not None:
+        # MEASURED whole-query walls trump the model: a shape that has
+        # actually run on an engine is priced by what it cost, so
+        # marginal mispredictions self-correct on the next planning
+        hw = trusted_engine_wall(wall_sig, "host")
+        dw = trusted_engine_wall(wall_sig, "device")
+        if hw is not None:
+            host_est, how = hw, "measured"
+        if dw is not None:
+            dev_est, how = dw, "measured"
+    if floor > 0 and host_est < dev_est:
+        reason = (f"cost-based: whole-plan host {how} {host_est:.4f}s "
+                  f"beats device {dev_est:.4f}s (incl. floor)")
 
         def revert_all(m: PlanMeta):
             if m.can_run_on_tpu:

@@ -49,7 +49,12 @@ def plan_query(plan: L.LogicalPlan, conf: TpuConf, mesh=None) -> TpuExec:
     not the user — makes queries distributed)."""
     from .rewrites import prune_columns
     from .op_confs import install_from_conf
+    from .cost import plan_signature
     install_from_conf(conf)
+    # signature of the plan AS THE USER BUILT IT: the execution sink
+    # records measured walls under this same pre-rewrite signature
+    # (api/dataframe._execute_wrapped), so lookup and record must agree
+    wall_sig = plan_signature(plan)
     if conf.sql_enabled:
         # TPU-targeted rewrites (distinct-agg expansion, union-of-aggs
         # single-pass) BEFORE pruning: the union rewrite keys on shared
@@ -63,7 +68,7 @@ def plan_query(plan: L.LogicalPlan, conf: TpuConf, mesh=None) -> TpuExec:
     meta.tag()
     from .cost import OPTIMIZER_ENABLED, apply_cost_optimizer
     if conf.get(OPTIMIZER_ENABLED):
-        apply_cost_optimizer(meta, conf)
+        apply_cost_optimizer(meta, conf, wall_sig=wall_sig)
     explain = conf.explain
     if explain in ("NOT_ON_TPU", "ALL"):
         out = meta.explain(only_not_on_tpu=(explain == "NOT_ON_TPU"))

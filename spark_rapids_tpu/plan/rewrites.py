@@ -56,8 +56,9 @@ def _expr_refs(e, out: set):
 
 
 def _agg_refs(a, out: set):
-    if getattr(a, "child", None) is not None:
-        _expr_refs(a.child, out)
+    # input_exprs() covers multi-input aggregates (min_by's ordering)
+    for e in a.input_exprs():
+        _expr_refs(e, out)
 
 
 def prune_columns(plan: L.LogicalPlan,

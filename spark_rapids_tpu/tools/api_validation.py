@@ -65,8 +65,24 @@ def validate_api() -> List[str]:
                 f"expression {cls.__name__}: no device_type_sig")
 
     # --- aggregates -----------------------------------------------------
+    from ..exprs.aggregates import _HostOnlyAgg
+    import inspect as _i
+    _cpu_agg_src = _i.getsource(
+        __import__("spark_rapids_tpu.exec.aggregate",
+                   fromlist=["CpuAggregateExec"]))
     for cls in _all_subclasses(AggregateExpression):
         if inspect.isabstract(cls) or cls.__name__.startswith("_"):
+            continue
+        if issubclass(cls, _HostOnlyAgg):
+            # deliberately host-only (collect_list etc.): the contract is
+            # data_type + CpuAggregateExec dispatch, no device pipeline
+            if not _overrides(cls, "data_type", AggregateExpression):
+                problems.append(
+                    f"aggregate {cls.__name__}: missing data_type()")
+            if cls.__name__ not in _cpu_agg_src:
+                problems.append(
+                    f"host-only aggregate {cls.__name__}: not handled by "
+                    "CpuAggregateExec.agg_series")
             continue
         for required in ("update", "merge", "finalize", "partial_types",
                          "data_type"):

@@ -187,3 +187,91 @@ def test_string_translate_first_wins():
         s.create_dataframe(t).plan)).collect_arrow()
     # duplicate 'a' in from: FIRST mapping wins (Spark)
     assert out.column("tr").to_pylist() == ["xxx"]
+
+
+def test_datetime_breadth():
+    import datetime
+    from spark_rapids_tpu.exprs.datetime_fns import (
+        AddMonths, DateFormatClass, FromUnixTime, LastDay,
+        MicrosToTimestamp, MillisToTimestamp, MonthsBetween,
+        SecondsToTimestamp, TimeAdd, ToUnixTimestamp, TruncDate)
+    d = pa.array([datetime.date(2024, 2, 15), datetime.date(2023, 12, 31),
+                  None, datetime.date(2024, 1, 1)], type=pa.date32())
+    n = pa.array([1, -2, 3, 13], type=pa.int32())
+    sec = pa.array([0, 86400, None, 1700000000], type=pa.int64())
+    t = pa.table({"d": d, "n": n, "sec": sec})
+    out = _dual(t, [
+        Alias(LastDay(ColumnRef("d")), "ld"),
+        Alias(AddMonths(ColumnRef("d"), ColumnRef("n")), "am"),
+        Alias(SecondsToTimestamp(ColumnRef("sec")), "ts"),
+        Alias(ToUnixTimestamp(SecondsToTimestamp(ColumnRef("sec"))), "ux"),
+        Alias(TruncDate(ColumnRef("d"), "month"), "tm"),
+        Alias(TruncDate(ColumnRef("d"), "quarter"), "tq"),
+        Alias(TruncDate(ColumnRef("d"), "week"), "tw"),
+        Alias(TimeAdd(SecondsToTimestamp(ColumnRef("sec")),
+                      3_600_000_000), "ta"),
+    ])
+    assert out.column("ld").to_pylist() == [
+        datetime.date(2024, 2, 29), datetime.date(2023, 12, 31), None,
+        datetime.date(2024, 1, 31)]
+    # leap-year clamp: 2024-02-15 + 1 month = 2024-03-15;
+    # 2023-12-31 - 2 = 2023-10-31; 2024-01-01 + 13 = 2025-02-01
+    assert out.column("am").to_pylist() == [
+        datetime.date(2024, 3, 15), datetime.date(2023, 10, 31), None,
+        datetime.date(2025, 2, 1)]
+    assert out.column("ux").to_pylist() == [0, 86400, None, 1700000000]
+    assert out.column("tm").to_pylist()[0] == datetime.date(2024, 2, 1)
+    assert out.column("tq").to_pylist()[0] == datetime.date(2024, 1, 1)
+    # 2024-02-15 is a Thursday -> Monday 2024-02-12
+    assert out.column("tw").to_pylist()[0] == datetime.date(2024, 2, 12)
+
+    # host-only formatting fns against precomputed oracles
+    s = tpu_session()
+    out2 = DataFrame(s, L.Project([
+        Alias(FromUnixTime(ColumnRef("sec")), "fu"),
+        Alias(DateFormatClass(ColumnRef("d"), "yyyy/MM"), "df"),
+    ], s.create_dataframe(t).plan)).collect_arrow()
+    assert out2.column("fu").to_pylist()[1] == "1970-01-02 00:00:00"
+    assert out2.column("df").to_pylist()[0] == "2024/02"
+
+
+def test_months_between():
+    import datetime
+    from spark_rapids_tpu.exprs.datetime_fns import MonthsBetween
+    t = pa.table({
+        "e": pa.array([datetime.date(2024, 3, 31),
+                       datetime.date(2024, 3, 15)], type=pa.date32()),
+        "s": pa.array([datetime.date(2024, 2, 29),
+                       datetime.date(2024, 1, 15)], type=pa.date32())})
+    sess = tpu_session()
+    out = DataFrame(sess, L.Project(
+        [Alias(MonthsBetween(ColumnRef("e"), ColumnRef("s")), "mb")],
+        sess.create_dataframe(t).plan)).collect_arrow()
+    # both last days -> exactly 1.0; same day-of-month -> exactly 2.0
+    assert out.column("mb").to_pylist() == [1.0, 2.0]
+
+
+def test_collect_minby_percentile_aggs():
+    from spark_rapids_tpu.exprs.aggregates import (CollectList, CollectSet,
+                                                   MaxBy, MinBy, Percentile)
+    from spark_rapids_tpu.exprs.base import ColumnRef
+    s = tpu_session()
+    t = pa.table({"g": pa.array([1, 1, 1, 2, 2]),
+                  "v": pa.array([3, 1, 3, None, 7], type=pa.int64()),
+                  "o": pa.array([0.5, 2.0, 1.0, 9.0, 3.0])})
+    df = (s.create_dataframe(t).group_by("g")
+          .agg(CollectList(ColumnRef("v")).with_name("cl"),
+               CollectSet(ColumnRef("v")).with_name("cs"),
+               MinBy(ColumnRef("v"), ColumnRef("o")).with_name("mnb"),
+               MaxBy(ColumnRef("v"), ColumnRef("o")).with_name("mxb"),
+               Percentile(ColumnRef("v"), 0.5).with_name("p50")))
+    out = df.collect_arrow().to_pydict()
+    rows = {g: (cl, sorted(cs), mnb, mxb, p)
+            for g, cl, cs, mnb, mxb, p in zip(
+                out["g"], out["cl"], out["cs"], out["mnb"], out["mxb"],
+                out["p50"])}
+    assert rows[1] == ([3, 1, 3], [1, 3], 3, 1, 3.0)
+    # group 2: the extreme-ORDERING row (o=9.0) carries v=NULL — Spark
+    # max_by returns that NULL; min_by picks o=3.0 -> 7
+    assert rows[2][0] == [7] and rows[2][2] == 7 and rows[2][3] is None
+    assert rows[2][4] == 7.0
