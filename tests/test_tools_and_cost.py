@@ -178,3 +178,14 @@ def test_op_confs_registered_and_documented():
     assert len(_REGISTRY) > 200
     docs = generate_docs()
     assert "spark.rapids.tpu.sql.expression.Multiply" in docs
+
+
+def test_scale_test_harness():
+    """ref integration_tests scaletest: parameterized scale run with
+    host-oracle verification and a machine-readable report."""
+    from spark_rapids_tpu.tools.scale_test import run_scale_test
+    rep = run_scale_test(20_000, ["q6", "q1"], iters=1)
+    assert rep["rows"] == 20_000
+    assert rep["queries"]["q6"]["verified"]
+    assert rep["queries"]["q1"]["output_rows"] > 0
+    assert rep["queries"]["q1"]["placement"] in ("host", "device")
