@@ -138,6 +138,17 @@ class ColumnarBatch:
             if ref() is self:
                 accum.add(nr)
                 self.meta.pop("rows_accum", None)
+        tag = self.meta.get("count_cb")
+        if tag is not None:
+            # producer-installed callback (e.g. the aggregate exec's group
+            # count statistic): fires when the count resolves, so stats
+            # stay fresh without the producer paying its own device sync.
+            # Same weakref identity guard as rows_accum: derived batches
+            # sharing/copying this meta dict must not fire it.
+            cb, ref = tag
+            if ref() is self:
+                self.meta.pop("count_cb", None)
+                cb(nr)
 
     @property
     def num_rows_raw(self):
@@ -459,15 +470,93 @@ class ColumnarBatch:
                 f"cols=[{kinds}], {self.schema})")
 
 
+def _device_concat_compact(counts, cols):
+    """Traced device concat of prefix-packed batches: per batch a liveness
+    mask from its (traced) count, one stable argsort moves live rows to the
+    front, every column gathers through the same permutation. Counts ride
+    as a traced vector so varying row counts never recompile."""
+    import jax.numpy as jnp
+    live = jnp.concatenate([
+        jnp.arange(d.shape[0], dtype=jnp.int32) < counts[i]
+        for i, (d, _) in enumerate(cols[0])])
+    perm = jnp.argsort(jnp.logical_not(live), stable=True)
+    out = []
+    for per_batch in cols:
+        d = jnp.concatenate([d for d, _ in per_batch])[perm]
+        v = jnp.concatenate([v for _, v in per_batch])[perm]
+        out.append((d, v))
+    return out
+
+
+_DEVICE_CONCAT_JIT = None
+
+
+def concat_batches_device(batches: Sequence[ColumnarBatch],
+                          buckets: Sequence[int] = DEFAULT_BUCKETS):
+    """Device-resident concat: no D2H. Requires every column of every batch
+    to be a plain DeviceColumn and every row count to be a host int (the
+    aggregate merge path qualifies). Returns None when not applicable —
+    callers fall back to the host-staged concat_batches."""
+    import jax
+    import jax.numpy as jnp
+    counts = []
+    for b in batches:
+        if not isinstance(b.num_rows_raw, int):
+            return None
+        counts.append(b.num_rows_raw)
+        for c in b.columns:
+            if type(c) is not DeviceColumn:
+                return None
+    schema = batches[0].schema
+    for b in batches[1:]:
+        if [f.dtype for f in b.schema.fields] != \
+                [f.dtype for f in schema.fields]:
+            return None
+    cols = [[(b.columns[ci].data, b.columns[ci].validity)
+             for b in batches] for ci in range(len(schema))]
+    total = sum(counts)
+    if all(c == b.padded_len for c, b in
+           zip(counts[:-1], batches[:-1])):
+        # every batch but the last is full: plain concatenation is already
+        # prefix-packed — no compaction permutation needed (the common
+        # scan-fed case: N full bucket batches + one partial tail)
+        outs = [(jnp.concatenate([d for d, _ in per]),
+                 jnp.concatenate([v for _, v in per])) for per in cols]
+    else:
+        global _DEVICE_CONCAT_JIT
+        if _DEVICE_CONCAT_JIT is None:
+            _DEVICE_CONCAT_JIT = jax.jit(_device_concat_compact)
+        outs = _DEVICE_CONCAT_JIT(
+            jnp.asarray(np.asarray(counts, np.int32)), cols)
+    target = bucket_for(total, buckets)
+    out_cols = []
+    for (d, v), f in zip(outs, schema.fields):
+        if target < d.shape[0]:
+            d, v = d[:target], v[:target]
+        elif target > d.shape[0]:
+            # pad UP to the ladder bucket too: padded_len is a static jit
+            # arg downstream, so an off-ladder length (sum of input
+            # paddings) would compile a fresh kernel variant per distinct
+            # sum — exactly what the bucket ladder exists to prevent
+            pad = target - d.shape[0]
+            d = jnp.pad(d, (0, pad))
+            v = jnp.pad(v, (0, pad))
+        out_cols.append(DeviceColumn(d, v, f.dtype))
+    return ColumnarBatch(out_cols, total, schema)
+
+
 def concat_batches(batches: Sequence[ColumnarBatch],
                    buckets: Sequence[int] = DEFAULT_BUCKETS) -> ColumnarBatch:
     """Concatenate batches (ref GpuCoalesceBatches concatenation,
-    GpuCoalesceBatches.scala:112-176). Host-staged for simplicity and
-    correctness across mixed device/host columns; the hot device-only path is
-    overridden by exec/coalesce.py with an on-device concat kernel."""
+    GpuCoalesceBatches.scala:112-176). Device-resident batches concatenate
+    on device (one dispatch, no D2H round trips); mixed device/host falls
+    back to the host-staged Arrow path."""
     import pyarrow as pa
     assert batches, "empty concat"
     if len(batches) == 1:
         return batches[0]
+    dev = concat_batches_device(batches, buckets)
+    if dev is not None:
+        return dev
     tables = [b.to_arrow() for b in batches]
     return ColumnarBatch.from_arrow(pa.concat_tables(tables), buckets)

@@ -280,9 +280,14 @@ class TpuHashAggregateExec(TpuExec):
         return self._schema
 
     # ------------------------------------------------------------------
-    def _run_kernel(self, kernel, batch: ColumnarBatch,
-                    out_schema: Schema, extra_cols=(),
-                    scalars=()) -> ColumnarBatch:
+    def _run_kernel_raw(self, kernel, batch: ColumnarBatch,
+                        extra_cols=(), scalars=()):
+        """Dispatch the agg kernel; NO device sync — returns the raw
+        (outs, num_groups device scalar) pair so multi-batch first passes
+        can overlap every batch's kernel and resolve all counts in ONE
+        stacked fetch (per-batch ``int(num_groups)`` cost a full tunnel
+        round trip each, serializing the pipeline — 10 batches at 10M rows
+        spent ~2 s in fetch latency alone)."""
         cols = []
         for c in batch.columns:
             if isinstance(c, DeviceColumn):
@@ -294,17 +299,35 @@ class TpuHashAggregateExec(TpuExec):
         _check_scalar_slots(kernel, scalars)
         key_outs, partial_outs, num_groups = kernel(
             cols, jnp.int32(batch.num_rows_raw), batch.padded_len, scalars)
-        n = int(num_groups)
-        # re-bucket: group count is usually orders of magnitude below the
-        # input bucket; slicing keeps the merge pass (another sort) tiny
-        target = bucket_for(n)
+        return list(key_outs) + list(partial_outs), num_groups
+
+    @staticmethod
+    def _slice_to_count(outs, n, out_schema: Schema) -> ColumnarBatch:
+        """Re-bucket raw kernel outputs once the group count is known:
+        group counts are usually orders of magnitude below the input
+        bucket; slicing keeps the merge pass (another sort) tiny."""
+        target = bucket_for(int(n))
         out_cols = []
-        for (d, v), f in zip(list(key_outs) + list(partial_outs),
-                             out_schema.fields):
+        for (d, v), f in zip(outs, out_schema.fields):
             if target < d.shape[0]:
                 d, v = d[:target], v[:target]
             out_cols.append(DeviceColumn(d, v, f.dtype))
-        return ColumnarBatch(out_cols, n, out_schema)
+        return ColumnarBatch(out_cols, int(n), out_schema)
+
+    def _run_kernel(self, kernel, batch: ColumnarBatch,
+                    out_schema: Schema, extra_cols=(),
+                    scalars=(), lazy: bool = False) -> ColumnarBatch:
+        outs, num_groups = self._run_kernel_raw(kernel, batch, extra_cols,
+                                                scalars)
+        if lazy:
+            # keep the count on device (resolved by the sink fetch); the
+            # outputs stay at the input bucket — callers use this when the
+            # input is already group-sized (merge passes), where slicing
+            # would buy nothing but the sync would cost a round trip
+            out_cols = [DeviceColumn(d, v, f.dtype)
+                        for (d, v), f in zip(outs, out_schema.fields)]
+            return ColumnarBatch(out_cols, num_groups, out_schema)
+        return self._slice_to_count(outs, int(num_groups), out_schema)
 
     # -- string-key dictionary encoding --------------------------------
     def _encode_key(self, j: int, i: int, batch: ColumnarBatch):
@@ -485,6 +508,74 @@ class TpuHashAggregateExec(TpuExec):
         aggs, pcounts = self.aggs, self._partial_counts
         nkeys = len(self._kernel_groupings)
         ptypes = [f.dtype for f in self._partial_schema.fields]
+        OPT = self.OPTIMISTIC_GROUPS
+        G = g_bucket
+        core = self._build_direct_core(G)
+
+        @functools.partial(jax.jit, static_argnums=(2,))
+        def fast_direct(cols, num_rows, padded_len, cards, scalars,
+                        code_pairs, remaps):
+            key_outs, partial_outs, num_groups = core(
+                cols, num_rows, padded_len, cards, scalars,
+                code_pairs, remaps)
+            outs = list(key_outs)
+            live = jnp.arange(G, dtype=jnp.int32) < num_groups
+            ord_ = 0
+            for ai, a in enumerate(aggs):
+                parts = []
+                for o in range(ord_, ord_ + pcounts[ai]):
+                    cd, cv = partial_outs[o]
+                    parts.append(DVal(cd, jnp.logical_and(cv, live),
+                                      ptypes[nkeys + o]))
+                ord_ += pcounts[ai]
+                fin = a.finalize(parts)
+                outs.append((fin.data, fin.validity))
+            from ..columnar.packing import pack_traced
+            flat = [num_groups] + [x for d, v in outs
+                                   for x in (d[:OPT], v[:OPT])]
+            spec_cell[padded_len] = [(np.dtype(x.dtype), tuple(x.shape))
+                                     for x in flat]
+            return pack_traced(flat)
+
+        spec_cell = {}
+        fast_direct.out_specs = spec_cell
+        fast_direct.n_param_slots = core.n_param_slots
+        _AGG_KERNEL_CACHE[key] = fast_direct
+        return fast_direct
+
+    def _get_direct_update_kernel(self, g_bucket: int):
+        """Direct-addressing UPDATE kernel for the multi-batch first pass:
+        the dense one-hot pipeline of _get_fast_direct_kernel but emitting
+        the sort-path update contract (compacted key-code rows + update
+        partials + num_groups) so the merge/finalize phases are shared
+        with the sort path. All-dictionary keys with a small cardinality
+        product only. The point is COMPILE time as much as run time: the
+        1M-row variadic-sort update kernel takes minutes to compile on a
+        tunneled backend (bench_r3.log: q28 warm-up 2,381 s), while this
+        kernel is elementwise + one-hot reductions that compile in
+        seconds."""
+        key = ("directupd", g_bucket) + self._kernel_key
+        cached = _AGG_KERNEL_CACHE.get(key)
+        if cached is not None:
+            return cached
+        core = self._build_direct_core(g_bucket)
+        direct_update = jax.jit(core, static_argnums=(2,))
+        direct_update.n_param_slots = core.n_param_slots
+        _AGG_KERNEL_CACHE[key] = direct_update
+        return direct_update
+
+    def _build_direct_core(self, g_bucket: int):
+        """The direct-addressing groupby pipeline SHARED by the fused
+        single-batch kernel and the multi-batch update kernel (one
+        implementation — null-key handling, stride packing, and pre-stage
+        fusion cannot diverge between the two paths). Returns a traceable
+        fn (cols, num_rows, padded_len, cards, scalars, code_pairs,
+        remaps) -> (key_outs, partial_outs, num_groups) with compacted
+        G-sized outputs; partial validities are ANDed with occupancy but
+        NOT with the live prefix (callers needing fetch-stable tails mask
+        with ``slot < num_groups`` themselves)."""
+        aggs = self.aggs
+        nkeys = len(self._kernel_groupings)
         value_exprs = [a.input_exprs() for a in aggs]
         schema = self._kernel_schema
         dtypes = [f.dtype for f in schema.fields]
@@ -493,7 +584,6 @@ class TpuHashAggregateExec(TpuExec):
         base_dtypes = ([f.dtype for f in in_schema.fields]
                        if in_schema is not None else None)
         stages = self.pre_stages
-        OPT = self.OPTIMISTIC_GROUPS
         G = g_bucket
         from ..types import INT32
         from ..columnar.segmented import prefix_sum, seg_sum
@@ -501,15 +591,13 @@ class TpuHashAggregateExec(TpuExec):
             self._kernel_groupings, aggs, "update", stages,
             value_exprs=value_exprs))
 
-        @functools.partial(jax.jit, static_argnums=(2,))
-        def fast_direct(cols, num_rows, padded_len, cards, scalars,
-                        code_pairs, remaps):
+        def core(cols, num_rows, padded_len, cards, scalars,
+                 code_pairs, remaps):
             from ..columnar.segmented import onehot_gather
             # dictionary remap FUSED into the kernel (each standalone
             # remap dispatch pays full tunnel latency)
-            code_cols = []
-            for (cd, cv), rm in zip(code_pairs, remaps):
-                code_cols.append((onehot_gather(rm, cd, G), cv))
+            code_cols = [(onehot_gather(rm, cd, G), cv)
+                         for (cd, cv), rm in zip(code_pairs, remaps)]
             if base_dtypes is not None:
                 n_base = len(base_dtypes)
                 base = [None if c is None else DVal(c[0], c[1], dt)
@@ -544,14 +632,14 @@ class TpuHashAggregateExec(TpuExec):
             gid = jnp.where(keep, gid, G)        # dead rows drop out
             vals = [[e.eval_device(ectx) for e in exprs]
                     for exprs in value_exprs]
-            partial_outs = []
+            partial_dense = []
             for a, vs in zip(aggs, vals):
-                partial_outs.extend(a.update(vs, gid, G, keep))
+                partial_dense.extend(a.update(vs, gid, G, keep))
             occ = seg_sum(keep.astype(jnp.int32), gid, num_segments=G) > 0
             num_groups = jnp.sum(occ).astype(jnp.int32)
             pos = jnp.where(occ, prefix_sum(occ, jnp.int32) - 1, G)
             slot = jnp.arange(G, dtype=jnp.int32)
-            outs = []
+            key_outs = []
             for i in range(nkeys):
                 code_i = (slot // strides[i]) % (cards[i] + 1)
                 valid_i = jnp.logical_and(code_i < cards[i], occ)
@@ -559,33 +647,44 @@ class TpuHashAggregateExec(TpuExec):
                                                          mode="drop")
                 kv = jnp.zeros(G, jnp.bool_).at[pos].set(valid_i,
                                                          mode="drop")
-                outs.append((kd, kv))
-            ord_ = 0
-            live = slot < num_groups
-            for ai, a in enumerate(aggs):
-                parts = []
-                for o in range(ord_, ord_ + pcounts[ai]):
-                    d, v = partial_outs[o]
-                    cd = jnp.zeros(G, d.dtype).at[pos].set(d, mode="drop")
-                    cv = jnp.zeros(G, jnp.bool_).at[pos].set(
-                        jnp.logical_and(v, occ), mode="drop")
-                    parts.append(DVal(cd, jnp.logical_and(cv, live),
-                                      ptypes[nkeys + o]))
-                ord_ += pcounts[ai]
-                fin = a.finalize(parts)
-                outs.append((fin.data, fin.validity))
-            from ..columnar.packing import pack_traced
-            flat = [num_groups] + [x for d, v in outs
-                                   for x in (d[:OPT], v[:OPT])]
-            spec_cell[padded_len] = [(np.dtype(x.dtype), tuple(x.shape))
-                                     for x in flat]
-            return pack_traced(flat)
+                key_outs.append((kd, kv))
+            partial_outs = []
+            for d, v in partial_dense:
+                cd = jnp.zeros(G, d.dtype).at[pos].set(d, mode="drop")
+                cv = jnp.zeros(G, jnp.bool_).at[pos].set(
+                    jnp.logical_and(v, occ), mode="drop")
+                partial_outs.append((cd, cv))
+            return key_outs, partial_outs, num_groups
 
-        spec_cell = {}
-        fast_direct.out_specs = spec_cell
-        fast_direct.n_param_slots = len(slots)
-        _AGG_KERNEL_CACHE[key] = fast_direct
-        return fast_direct
+        core.n_param_slots = len(slots)
+        return core
+
+    def _direct_update_args(self, batch: ColumnarBatch):
+        """When the multi-batch first pass can use the direct-addressing
+        update kernel for this batch, return (kernel, args); else None."""
+        if not self.groupings or \
+                len(self._dict_keys) != len(self.groupings):
+            return None
+        # current dictionary sizes are a lower bound on post-encode sizes:
+        # once the product exceeds the bound it can only grow, so bail out
+        # BEFORE paying the host-side dictionary encode a second time
+        lower = 1
+        for d in self._dicts:
+            lower *= max(len(d), 1) + 1
+        if lower > self.OPTIMISTIC_GROUPS:
+            return None
+        pairs, remaps = self._augment_pairs(batch)
+        cards = np.asarray([len(d) for d in self._dicts], np.int32)
+        prod = int(np.prod(cards.astype(np.int64) + 1))
+        if prod > self.OPTIMISTIC_GROUPS:
+            return None
+        from ..columnar.segmented import bucket_segments
+        Gb = bucket_segments(prod)
+        padded_remaps = tuple(
+            jnp.asarray(np.pad(r, (0, max(Gb - len(r), 0)))[:Gb])
+            for r in remaps)
+        kern = self._get_direct_update_kernel(Gb)
+        return kern, (jnp.asarray(cards), tuple(pairs), padded_remaps)
 
     def _fast_single_batch(self, ctx, batch: ColumnarBatch,
                            update_k) -> Optional[ColumnarBatch]:
@@ -696,18 +795,87 @@ class TpuHashAggregateExec(TpuExec):
 
         import itertools
         pending = [b for b in (first, second) if b is not None]
+        # phase 1: dispatch EVERY batch's update kernel without syncing —
+        # the kernels overlap in the device queue and the tunnel pipeline
+        # (a per-batch int(num_groups) cost one round trip EACH, ~2 s of
+        # pure latency for a 10-batch input on the tunneled backend).
+        # Outputs are sliced immediately to a SPECULATIVE group bucket
+        # (stat from previous runs of this kernel) so at most one
+        # input-bucket-sized output is live at a time; the stacked count
+        # fetch in phase 2 validates every guess and re-runs the (rare,
+        # idempotent) overflowed batch at its true bucket.
+        spec = bucket_for(max(_FAST_GROUPS.get(self._kernel_key, 0),
+                              1 if not self.groupings else 1024))
+        #: bound on input batches pinned by pending dispatch closures: the
+        #: count fetch resolves per WINDOW, so a long scan never holds
+        #: every input batch in HBM at once (one fetch per 8 batches
+        #: instead of per batch — latency amortized 8x, memory bounded)
+        WINDOW = 8
         partials: List[SpillableBatch] = []
+        window = []      # (sliced outs, num_groups dev scalar, dispatch fn)
+
+        def flush_window():
+            if not window:
+                return
+            if not self.groupings:
+                counts = [1] * len(window)
+            elif len(window) == 1:
+                counts = [int(window[0][1])]
+            else:
+                def resolve_counts():
+                    import numpy as _np
+                    return [int(x) for x in
+                            _np.asarray(jnp.stack([ng for _, ng, _d
+                                                   in window]))]
+                counts = with_retry_no_split(resolve_counts, ctx.memory)
+            for (outs, _, dispatch), n in zip(window, counts):
+                if n > spec:
+                    # speculation overflow: re-run this batch's kernel
+                    # (pure function of retained inputs) and slice at the
+                    # true count
+                    def redo(d=dispatch):
+                        with ctx.semaphore.held():
+                            return d()[0]
+                    outs = with_retry_no_split(redo, ctx.memory)
+                pb = self._slice_to_count(outs, n, self._partial_schema)
+                partials.append(SpillableBatch(pb, ctx.memory))
+            window.clear()
+
         for batch in itertools.chain(pending, it):
             batch = batch.ensure_device()
-            codes = self._augment(batch)
-            def first_pass(b=batch, extra=codes):
+            direct = self._direct_update_args(batch)
+            if direct is not None:
+                kern, (cards, pairs, remaps) = direct
+                _check_scalar_slots(kern, self._upd_scalars)
+
+                def dispatch(b=batch, k=kern, c=cards, p=pairs, r=remaps):
+                    base_cols = [(cc.data, cc.validity)
+                                 if isinstance(cc, DeviceColumn) else None
+                                 for cc in b.columns]
+                    ko, po, ng = k(base_cols, jnp.int32(b.num_rows_raw),
+                                   b.padded_len, c, self._upd_scalars,
+                                   p, r)
+                    return list(ko) + list(po), ng
+            else:
+                codes = self._augment(batch)
+
+                def dispatch(b=batch, extra=codes):
+                    return self._run_kernel_raw(
+                        update_k, b, extra_cols=extra,
+                        scalars=self._upd_scalars)
+
+            def first_pass(d=dispatch):
                 with ctx.semaphore.held():
-                    pb = self._run_kernel(update_k, b, self._partial_schema,
-                                          extra_cols=extra,
-                                          scalars=self._upd_scalars)
-                    return SpillableBatch(pb, ctx.memory)
+                    outs, ng = d()
+                    outs = [(d_[:spec], v[:spec]) if spec < d_.shape[0]
+                            else (d_, v) for d_, v in outs]
+                    return outs, ng
             # idempotent over the input batch -> retry-safe
-            partials.append(with_retry_no_split(first_pass, ctx.memory))
+            outs, ng = with_retry_no_split(first_pass, ctx.memory)
+            window.append((outs, ng, dispatch))
+            if len(window) >= WINDOW:
+                flush_window()
+        flush_window()
 
         total = sum(sb.device_bytes() for sb in partials)
         if (self.groupings and partials
@@ -724,8 +892,21 @@ class TpuHashAggregateExec(TpuExec):
         else:
             merged = self._merge(ctx, partials)
         final = self._finalize(ctx, merged)
-        _FAST_GROUPS[self._kernel_key] = final.num_rows   # refresh stat
-        rows_m.add(final.num_rows)
+        nr = final.num_rows_raw
+        if isinstance(nr, int):
+            _FAST_GROUPS[self._kernel_key] = nr   # refresh stat
+            rows_m.add(nr)
+        else:
+            # lazy count: refresh the stat when the sink fetch resolves it
+            # (never an extra sync — _resolve_count runs the callback)
+            kk, fg = self._kernel_key, _FAST_GROUPS
+
+            def _on_groups(n, _kk=kk, _fg=fg, _m=rows_m):
+                _fg[_kk] = n
+                _m.add(n)
+            import weakref
+            final.meta = dict(final.meta)
+            final.meta["count_cb"] = (_on_groups, weakref.ref(final))
         yield final
 
     # -- re-partition fallback (ref GpuAggregateExec.scala:718-780: when the
@@ -793,7 +974,11 @@ class TpuHashAggregateExec(TpuExec):
             with ctx.semaphore.held():
                 batches = [sb.get() for sb in partials]
                 big = concat_batches(batches)
-                return self._run_kernel(merge_k, big, self._partial_schema)
+                # lazy: the merge input is already group-sized, so the
+                # output stays at its (small) bucket and the group count
+                # rides to the sink fetch instead of syncing here
+                return self._run_kernel(merge_k, big, self._partial_schema,
+                                        lazy=True)
 
         out = with_retry_no_split(do_merge, ctx.memory)
         for sb in partials:
@@ -804,7 +989,7 @@ class TpuHashAggregateExec(TpuExec):
     def _finalize(self, ctx: ExecContext, merged: ColumnarBatch) -> ColumnarBatch:
         nkeys = len(self.groupings)
         out_cols: List[DeviceColumn] = self._decode_keys(
-            list(merged.columns[:nkeys]), merged.num_rows)
+            list(merged.columns[:nkeys]), merged.num_rows_raw)
         ord_ = nkeys
         for ai, a in enumerate(self.aggs):
             n = self._partial_counts[ai]
@@ -815,7 +1000,7 @@ class TpuHashAggregateExec(TpuExec):
             final = a.finalize(parts)
             out_cols.append(DeviceColumn(final.data, final.validity,
                                          self._schema.fields[nkeys + ai].dtype))
-        return ColumnarBatch(out_cols, merged.num_rows, self._schema)
+        return ColumnarBatch(out_cols, merged.num_rows_raw, self._schema)
 
     def describe(self):
         g = ", ".join(e.name_hint for e in self.groupings)
