@@ -55,17 +55,65 @@ class TpuSession:
         self.profiler = Profiler(self.conf)
         #: per-query runtime summary (ref GpuTaskMetrics accumulators)
         self.last_query_metrics = None
+        #: engine that ran the last materialized query: "device"/"host"
+        self.last_placement = None
         #: device mesh for distributed execution: explicit, or built from
         #: spark.rapids.tpu.distributed.* conf (the planner lowers
         #: supported fragments onto it — parallel/planner.py)
         self.mesh = mesh
+        #: True when the mesh was built from conf defaults rather than
+        #: supplied explicitly: the planner only uses an auto mesh above
+        #: the distributed.minRows threshold (distribution_gate)
+        self.mesh_is_auto = False
         if self.mesh is None:
             from ..parallel.planner import (DISTRIBUTED_ENABLED,
                                             DISTRIBUTED_NUM_DEVICES)
             if self.conf.get(DISTRIBUTED_ENABLED):
-                from ..parallel.mesh import make_mesh
+                import jax
                 n = int(self.conf.get(DISTRIBUTED_NUM_DEVICES)) or None
-                self.mesh = make_mesh(n)
+                avail = len(jax.devices())
+                # a 1-device mesh adds shard_map overhead for nothing —
+                # distributed-by-default only engages with real devices
+                if (n or avail) > 1 and avail > 1:
+                    from ..parallel.mesh import make_mesh
+                    self.mesh = make_mesh(n)
+                    self.mesh_is_auto = True
+
+    # ------------------------------------------------------------ lifecycle
+    def close(self) -> None:
+        """Release session resources. With
+        spark.rapids.tpu.memory.leakDetection on, assert that no device
+        buffer registration outlived its query — the MemoryCleaner
+        shutdown leak check analog (ref Plugin.scala:573-588). Like the
+        reference's shutdown hook, the audit is PROCESS-wide (buffer
+        registries are per-memory-budget, not per-session): run it from
+        single-session debug harnesses, not while other sessions have
+        queries in flight."""
+        if self._ctx is not None:
+            self._ctx.close()
+            self._ctx = None
+        from ..config import LEAK_DETECTION
+        if self.conf.get(LEAK_DETECTION):
+            from ..mem.manager import MemoryManager
+            leaks = MemoryManager.audit_all_leaks()
+            if leaks:
+                raise AssertionError(
+                    f"{len(leaks)} leaked device buffer registration(s) "
+                    f"at session close: {leaks[:5]} "
+                    f"(set SRTPU_LEAK_DEBUG=1 for creation sites)")
+
+    def __enter__(self) -> "TpuSession":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is not None:
+            # never mask the in-flight exception with a leak assertion
+            # (leaks ARE likely mid-exception — batches were abandoned)
+            if self._ctx is not None:
+                self._ctx.close()
+                self._ctx = None
+            return
+        self.close()
 
     # ------------------------------------------------------------- config
     def set_conf(self, key: str, value) -> "TpuSession":
@@ -414,7 +462,9 @@ class DataFrame:
 
     def _physical(self):
         return plan_query(self.plan, self.session.conf,
-                          mesh=getattr(self.session, "mesh", None))
+                          mesh=getattr(self.session, "mesh", None),
+                          mesh_auto=getattr(self.session, "mesh_is_auto",
+                                            False))
 
     def _execute_wrapped(self, consume):
         """Run the physical plan through the full execution pipeline
@@ -481,6 +531,9 @@ class DataFrame:
                     return any(_on_device(c) for c in n.children)
 
                 placement = ("device" if _on_device(physical) else "host")
+                #: benchmark/diagnostic surface: which engine actually ran
+                #: the last materialized query on this session
+                self.session.last_placement = placement
                 record_engine_wall(plan_signature(self.plan), placement,
                                    _time.perf_counter() - t0)
 

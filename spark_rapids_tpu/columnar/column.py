@@ -114,6 +114,14 @@ class DeviceColumn:
         return arrow_from_numpy(d, v, self.dtype)
 
     def to_arrow(self, num_rows: int):
+        if self.host_mirror is not None:
+            # serve the exact source bits: besides skipping the D2H
+            # fetch, this is a CORRECTNESS requirement for f64 — the
+            # backend's emulated float64 carries ~48 mantissa bits, so a
+            # device round trip of an untouched column would hand host
+            # expressions values 1 ulp off (q6's `discount >= 0.05`
+            # silently dropped every boundary row on the host engine)
+            return self.host_mirror.slice(0, num_rows)
         d, v = self.to_numpy(num_rows)
         return self.arrow_from_host(d, v)
 
@@ -193,6 +201,8 @@ class DictColumn(DeviceColumn):
         return pa.array(self.dictionary, type=pa.string()).take(idx)
 
     def to_arrow(self, num_rows: int):
+        if self.host_mirror is not None:
+            return self.host_mirror.slice(0, num_rows)
         codes = np.asarray(jax.device_get(self.data))[:num_rows]
         v = np.asarray(jax.device_get(self.validity))[:num_rows]
         return self.arrow_from_host(codes, v)

@@ -46,31 +46,29 @@ def bucket_segments(n: int) -> int:
     return n
 
 
-def _steps(n: int) -> int:
-    return max(1, (n - 1).bit_length())
 
 
-def _shifted(arr, fill, d):
-    """arr shifted right by traced d, filled with `fill`: pad to [2n] and
-    dynamic-slice — keeps Hillis-Steele loops rolled (lax.fori_loop), so
-    kernels with many scans compile in seconds instead of minutes."""
-    n = arr.shape[0]
-    two = jnp.concatenate([jnp.full((n,), fill, dtype=arr.dtype), arr])
-    return jax.lax.dynamic_slice(two, (n - d,), (n,))
+#: Hillis-Steele scans are UNROLLED with static shift distances. The
+#: rolled form (lax.fori_loop whose body dynamic-slices by a traced
+#: 1<<i) compiles pathologically on this backend WHEN COMPOSED WITH the
+#: sort pipeline around it: sort+scan+sort measured 65-95 s to compile at
+#: 262k rows (vs 24 s for the two sorts alone), multiplying per key and
+#: per aggregate until the q28 merge kernel took >20 minutes. The same
+#: pipeline with static-shift unrolled scans compiles in 15-17 s total.
+#: (jnp.cumsum and lax.associative_scan are still worse: 191 s / 63 s at
+#: 1M rows — see docs/performance.md.)
 
 
 def prefix_sum(x, dtype=None):
-    """Inclusive prefix sum via log2(n) shift/add passes in a rolled loop.
-    On this backend `jnp.cumsum` over 1M rows is pathological (191 s
-    compile, 10.7 ms run measured); this runs in ~0.6 ms."""
+    """Inclusive prefix sum via log2(n) static-shift/add passes."""
     v = x if dtype is None else x.astype(dtype)
     n = v.shape[0]
-
-    def body(i, v):
-        d = jax.lax.shift_left(jnp.int32(1), i.astype(jnp.int32))
-        return v + _shifted(v, jnp.zeros((), v.dtype), d)
-
-    return jax.lax.fori_loop(0, _steps(n), body, v)
+    zero = jnp.zeros((), v.dtype)
+    d = 1
+    while d < n:
+        v = v + shift_static(v, d, zero)
+        d <<= 1
+    return v
 
 
 def last_valid_scan(values, present):
@@ -79,16 +77,16 @@ def last_valid_scan(values, present):
     their own value with present=False propagated. The vector-native way
     to broadcast a per-segment value (e.g. at segment starts) to every row
     without the group-table gather (~15-45 ms per 1M rows on TPU)."""
-    n = values.shape[0]
-
-    def body(i, vp):
-        v, p = vp
-        d = jax.lax.shift_left(jnp.int32(1), i.astype(jnp.int32))
-        pv = _shifted(v, jnp.zeros((), v.dtype), d)
-        pp = _shifted(p, jnp.array(False), d)
-        return (jnp.where(p, v, pv), jnp.logical_or(p, pp))
-
-    v, p = jax.lax.fori_loop(0, _steps(n), body, (values, present))
+    v, p = values, present
+    n = v.shape[0]
+    zero = jnp.zeros((), v.dtype)
+    d = 1
+    while d < n:
+        pv = shift_static(v, d, zero)
+        pp = shift_static(p, d, False)
+        v = jnp.where(p, v, pv)
+        p = jnp.logical_or(p, pp)
+        d <<= 1
     return v, p
 
 
@@ -145,16 +143,14 @@ class SortedSegments:
     def _scan(self, v, combine, neutral):
         n = v.shape[0]
         neutral = jnp.asarray(neutral, dtype=v.dtype)
-
-        def body(i, vf):
-            v, f = vf
-            d = jax.lax.shift_left(jnp.int32(1), i.astype(jnp.int32))
-            pv = _shifted(v, neutral, d)
-            pf = _shifted(f, jnp.array(True), d)
-            return (jnp.where(f, v, combine(pv, v)),
-                    jnp.logical_or(f, pf))
-
-        v, _ = jax.lax.fori_loop(0, _steps(n), body, (v, self.flags))
+        f = self.flags
+        d = 1
+        while d < n:
+            pv = shift_static(v, d, neutral)
+            pf = shift_static(f, d, True)
+            v = jnp.where(f, v, combine(pv, v))
+            f = jnp.logical_or(f, pf)
+            d <<= 1
         return v
 
     def sum(self, data, valid):
@@ -192,28 +188,27 @@ class SortedSegments:
         r = jnp.where(ok, rank, neutral_r)
         n = r.shape[0]
         neutral_r = jnp.asarray(neutral_r, dtype=r.dtype)
-
-        def body(i, carry):
-            r, o, f, vs = carry
-            d = jax.lax.shift_left(jnp.int32(1), i.astype(jnp.int32))
-            pr = _shifted(r, neutral_r, d)
-            po = _shifted(o, jnp.array(False), d)
-            pf = _shifted(f, jnp.array(True), d)
-            pvs = tuple(_shifted(v, jnp.zeros((), v.dtype), d) for v in vs)
+        o, f, vs = ok, self.flags, tuple(values)
+        d = 1
+        while d < n:
+            pr = shift_static(r, d, neutral_r)
+            po = shift_static(o, d, False)
+            pf = shift_static(f, d, True)
+            pvs = tuple(shift_static(v, d, jnp.zeros((), v.dtype))
+                        for v in vs)
             # take the predecessor when it is valid and (we're invalid or
             # its rank is better) — standard argmin/argmax monoid
             take_prev = jnp.logical_and(
                 jnp.logical_not(f),
                 jnp.logical_and(po, jnp.logical_or(jnp.logical_not(o),
                                                    better(pr, r))))
-            return (jnp.where(take_prev, pr, r),
-                    jnp.where(f, o, jnp.logical_or(o, po)),
-                    jnp.logical_or(f, pf),
-                    tuple(jnp.where(take_prev, pv, v)
-                          for pv, v in zip(pvs, vs)))
-
-        r, o, _, vs = jax.lax.fori_loop(
-            0, _steps(n), body, (r, ok, self.flags, tuple(values)))
+            r, o, f, vs = (
+                jnp.where(take_prev, pr, r),
+                jnp.where(f, o, jnp.logical_or(o, po)),
+                jnp.logical_or(f, pf),
+                tuple(jnp.where(take_prev, pv, v)
+                      for pv, v in zip(pvs, vs)))
+            d <<= 1
         return list(vs), r, o
 
 

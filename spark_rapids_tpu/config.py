@@ -225,6 +225,14 @@ MEMORY_DEBUG = register(
     "Log every device allocation/free with the running footprint "
     "(ref spark.rapids.memory.gpu.debug=STDOUT, RapidsConf.scala:376).")
 
+LEAK_DETECTION = register(
+    "spark.rapids.tpu.memory.leakDetection", False,
+    "Debug-mode allocation auditing: every SpillableBatch records its "
+    "creation site, and TpuSession.close() raises if any device buffer "
+    "registration is still live (ref cudf MemoryCleaner leak tracking at "
+    "shutdown, Plugin.scala:573-588). The test suite runs with this "
+    "effectively on via its per-test zero-leak fixture.")
+
 METRICS_LEVEL = register(
     "spark.rapids.tpu.sql.metrics.level", "MODERATE",
     "DEBUG / MODERATE / ESSENTIAL metric verbosity (ref GpuExec.scala:54-165).")

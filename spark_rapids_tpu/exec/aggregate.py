@@ -87,8 +87,8 @@ def _build_groupby_kernel(key_exprs: Sequence[Expression],
         key_exprs, aggs, mode, stages,
         value_exprs=value_exprs if mode == "update" else None))
 
-    @functools.partial(jax.jit, static_argnums=(2,))
-    def kernel(cols, num_rows, padded_len, scalars=()):
+    def prep(cols, num_rows, padded_len, scalars):
+        """Shared traced prologue: pre-stages + key/value evaluation."""
         keep = None
         if base_schema is not None:
             n_base = len(base_dtypes)
@@ -110,10 +110,146 @@ def _build_groupby_kernel(key_exprs: Sequence[Expression],
                               scalars, slots)
         keys = [e.eval_device(ctx) for e in key_exprs]
         vals = [[e.eval_device(ctx) for e in exprs] for exprs in value_exprs]
+        return keys, vals, keep
+
+    @functools.partial(jax.jit, static_argnums=(2,))
+    def kernel(cols, num_rows, padded_len, scalars=()):
+        keys, vals, keep = prep(cols, num_rows, padded_len, scalars)
         return segmented_groupby(keys, vals, aggs, mode, num_rows,
                                  padded_len, row_mask=keep)
 
     kernel.n_param_slots = len(slots)
+    kernel._prep = prep
+    kernel._value_exprs = value_exprs
+    return kernel
+
+
+def _build_groupby_kernel_split(key_exprs, aggs, schema, mode,
+                                partial_counts=None, in_schema=None,
+                                stages=None, n_codes=0):
+    """The same groupby as _build_groupby_kernel but run as THREE
+    separately-jitted dispatches (prologue+sort / scans / compaction
+    sort). Identical maths — the stages are groupby_core's own pieces —
+    but each XLA module is small: on this backend a lax.sort's compile
+    time multiplies with surrounding module complexity (the fused two-key
+    merge kernel never finished compiling in >20 min; split stages total
+    ~1 min). Used on the classic multi-batch/merge path where the extra
+    ~2 dispatch round trips are amortized per QUERY, not per batch-row;
+    the fused form remains for the single-batch fast path and shard_map
+    fragments (dispatch count dominates there)."""
+    from .groupby_core import stage_scan
+    fused = _build_groupby_kernel(key_exprs, aggs, schema, mode,
+                                  partial_counts, in_schema, stages,
+                                  n_codes)
+    if not key_exprs:
+        return fused         # global path has no sort — fused is cheap
+    prep = fused._prep
+    value_exprs = fused._value_exprs
+    key_dtypes = [e.data_type(schema) for e in key_exprs]
+    val_dtypes = [[e.data_type(schema) for e in exprs]
+                  for exprs in value_exprs]
+
+    from .encoding import grouping_operands
+
+    # Sort operand budget: every operand in the variadic sort costs
+    # compile time, so the split path carries the MINIMUM. Keys whose
+    # grouping encoding is the standard (null_rank, key) pair are NOT
+    # duplicated as payload — k_scan reconstructs (data, validity) from
+    # the sorted operands themselves (validity = rank==0; data =
+    # operand cast back, canonicalized for floats — the
+    # NormalizeFloatingNumbers semantics grouping already applies). The
+    # original-row-index payload rides only when an order-dependent
+    # aggregate (First/Last) needs it.
+    from ..exprs.aggregates import First, Last
+
+    def _key_op_shapes(dt):
+        import numpy as _np
+        return jax.eval_shape(
+            lambda d, v: tuple(grouping_operands(DVal(d, v, dt))),
+            jax.ShapeDtypeStruct((1,), dt.np_dtype),
+            jax.ShapeDtypeStruct((1,), _np.bool_))
+
+    reconstruct_keys = all(
+        dt.np_dtype is not None and len(_key_op_shapes(dt)) == 2
+        for dt in key_dtypes)
+    needs_rank = any(isinstance(a, (First, Last)) for a in aggs)
+
+    @functools.partial(jax.jit, static_argnums=(2,))
+    def k_prep(cols, num_rows, padded_len, scalars=()):
+        """Prologue + key encoding ONLY — no sort. A lax.sort's compile
+        time multiplies with everything else in its module (a fused
+        filter/CASE prologue pushed the q28 update sort past 15 minutes),
+        so the sort gets a module to itself with raw operands."""
+        keys, vals, keep = prep(cols, num_rows, padded_len, scalars)
+        if keep is None:
+            keep = jnp.arange(padded_len, dtype=jnp.int32) < num_rows
+        pad_flag = jnp.where(keep, jnp.uint8(0), jnp.uint8(1))
+        operands = [pad_flag]
+        for k in keys:
+            operands.extend(grouping_operands(k))
+        payload = []
+        if needs_rank:
+            payload.append(jnp.arange(padded_len, dtype=jnp.int32))
+        if not reconstruct_keys:
+            for k in keys:
+                payload.extend((k.data, k.validity))
+        for vs in vals:
+            for v in vs:
+                payload.extend((v.data, v.validity))
+        live = jnp.sum(keep).astype(jnp.int32)
+        return tuple(operands + payload), live
+
+    n_key_ops = 1 + 2 * len(key_exprs)   # pad_flag + (rank, key) per key
+
+    @jax.jit
+    def k_sort(flat):
+        """The bare variadic sort — nothing else in the module."""
+        return jax.lax.sort(tuple(flat), num_keys=n_key_ops,
+                            is_stable=True)
+
+    @functools.partial(jax.jit, static_argnums=(1,))
+    def k_scan(flat, padded_len, live):
+        it = iter(flat)
+        s_ops = [next(it) for _ in range(n_key_ops)]
+        perm = next(it) if needs_rank else None
+        if reconstruct_keys:
+            s_keys = []
+            for i, dt in enumerate(key_dtypes):
+                rank = s_ops[1 + 2 * i]
+                keyop = s_ops[2 + 2 * i]
+                s_keys.append(DVal(keyop.astype(dt.np_dtype), rank == 0,
+                                   dt))
+        else:
+            s_keys = [DVal(next(it), next(it), dt) for dt in key_dtypes]
+        sorted_vals = [[DVal(next(it), next(it), dt) for dt in dts]
+                       for dts in val_dtypes]
+        ckey, carry, num_groups = stage_scan(
+            aggs, mode, s_ops, perm, s_keys, sorted_vals, live,
+            padded_len)
+        return ckey, tuple(carry), num_groups
+
+    @jax.jit
+    def k_pack_sort(ckey, carry):
+        """The bare compaction sort, also alone in its module. No
+        group-liveness masking afterwards: split-path consumers slice to
+        the resolved group count and read rows by prefix, so rows past
+        num_groups are never interpreted (unlike the fused path, whose
+        packed fetch reads a fixed OPT rows and must mask)."""
+        return jax.lax.sort((ckey,) + tuple(carry), num_keys=1,
+                            is_stable=True)
+
+    def kernel(cols, num_rows, padded_len, scalars=()):
+        flat, live = k_prep(cols, num_rows, padded_len, scalars)
+        sorted_all = k_sort(flat)
+        ckey, carry, ng = k_scan(tuple(sorted_all), padded_len, live)
+        packed = k_pack_sort(ckey, carry)
+        it = iter(packed[1:])
+        key_outs = [(next(it), next(it)) for _ in range(len(key_exprs))]
+        n_partials = (len(carry) - 2 * len(key_exprs)) // 2
+        partial_outs = [(next(it), next(it)) for _ in range(n_partials)]
+        return key_outs, partial_outs, ng
+
+    kernel.n_param_slots = fused.n_param_slots
     return kernel
 
 
@@ -204,14 +340,23 @@ def _check_scalar_slots(kernel, scalars):
 
 
 def _get_kernel(key_exprs, aggs, schema, mode, partial_counts=None,
-                in_schema=None, stages=None, n_codes=0):
+                in_schema=None, stages=None, n_codes=0,
+                split: bool = False):
+    """``split=True`` returns the three-dispatch variant (cheap XLA
+    compiles, ~2 extra round trips) — the right form for direct calls
+    from the classic multi-batch/merge path. The default fused form is
+    required wherever the kernel is traced INSIDE another jit (the fast
+    single-batch kernel, shard_map fragments)."""
     key = _agg_kernel_key(key_exprs, aggs, schema, mode, in_schema,
                           stages, n_codes)
+    if split:
+        key = ("split",) + key
     k = _AGG_KERNEL_CACHE.get(key)
     if k is None:
-        k = _build_groupby_kernel(key_exprs, aggs, schema, mode,
-                                  partial_counts, in_schema, stages,
-                                  n_codes)
+        build = (_build_groupby_kernel_split if split
+                 else _build_groupby_kernel)
+        k = build(key_exprs, aggs, schema, mode, partial_counts,
+                  in_schema, stages, n_codes)
         _AGG_KERNEL_CACHE[key] = k
     return k
 
@@ -769,6 +914,15 @@ class TpuHashAggregateExec(TpuExec):
                                in_schema=in_schema,
                                stages=self.pre_stages or None,
                                n_codes=len(self._dict_keys))
+        # the multi-batch first pass calls the kernel directly (not traced
+        # inside another jit) — the split three-dispatch form compiles in
+        # ~1 min where the fused sort pipeline took >20 on this backend
+        update_k_split = _get_kernel(self._kernel_groupings, self.aggs,
+                                     self._kernel_schema, "update",
+                                     in_schema=in_schema,
+                                     stages=self.pre_stages or None,
+                                     n_codes=len(self._dict_keys),
+                                     split=True)
         self._upd_scalars = literal_scalars(collect_param_literals(
             _param_exprs(self._kernel_groupings, self.aggs, "update",
                          self.pre_stages or None)))
@@ -812,7 +966,21 @@ class TpuHashAggregateExec(TpuExec):
         #: instead of per batch — latency amortized 8x, memory bounded)
         WINDOW = 8
         partials: List[SpillableBatch] = []
-        window = []      # (sliced outs, num_groups dev scalar, dispatch fn)
+        row_base = 0     # global row offset of the next batch
+        window = []  # (sliced outs, num_groups dev scalar, dispatch, base)
+
+        #: (value ordinal, position ordinal) per First/Last aggregate:
+        #: their within-batch row positions must become GLOBAL before the
+        #: merge, or ties between different batches' firsts break
+        #: cross-batch arrival order (caught by
+        #: test_agg_multibatch_first_last_order_dependent)
+        from ..exprs.aggregates import First, Last
+        pos_partials = []
+        ord_ = len(self.groupings)
+        for ai, a in enumerate(self.aggs):
+            if isinstance(a, (First, Last)):
+                pos_partials.append((ord_, ord_ + 1))
+            ord_ += self._partial_counts[ai]
 
         def flush_window():
             if not window:
@@ -825,10 +993,10 @@ class TpuHashAggregateExec(TpuExec):
                 def resolve_counts():
                     import numpy as _np
                     return [int(x) for x in
-                            _np.asarray(jnp.stack([ng for _, ng, _d
+                            _np.asarray(jnp.stack([ng for _, ng, _d, _b
                                                    in window]))]
                 counts = with_retry_no_split(resolve_counts, ctx.memory)
-            for (outs, _, dispatch), n in zip(window, counts):
+            for (outs, _, dispatch, base), n in zip(window, counts):
                 if n > spec:
                     # speculation overflow: re-run this batch's kernel
                     # (pure function of retained inputs) and slice at the
@@ -838,6 +1006,13 @@ class TpuHashAggregateExec(TpuExec):
                             return d()[0]
                     outs = with_retry_no_split(redo, ctx.memory)
                 pb = self._slice_to_count(outs, n, self._partial_schema)
+                for val_o, pos_o in pos_partials:
+                    vcol, pcol = pb.columns[val_o], pb.columns[pos_o]
+                    pd_ = jnp.where(vcol.validity,
+                                    pcol.data + jnp.int64(base),
+                                    pcol.data)
+                    pb.columns[pos_o] = DeviceColumn(pd_, pcol.validity,
+                                                     pcol.dtype)
                 partials.append(SpillableBatch(pb, ctx.memory))
             window.clear()
 
@@ -861,7 +1036,7 @@ class TpuHashAggregateExec(TpuExec):
 
                 def dispatch(b=batch, extra=codes):
                     return self._run_kernel_raw(
-                        update_k, b, extra_cols=extra,
+                        update_k_split, b, extra_cols=extra,
                         scalars=self._upd_scalars)
 
             def first_pass(d=dispatch):
@@ -872,7 +1047,8 @@ class TpuHashAggregateExec(TpuExec):
                     return outs, ng
             # idempotent over the input batch -> retry-safe
             outs, ng = with_retry_no_split(first_pass, ctx.memory)
-            window.append((outs, ng, dispatch))
+            window.append((outs, ng, dispatch, row_base))
+            row_base += batch.padded_len
             if len(window) >= WINDOW:
                 flush_window()
         flush_window()
@@ -927,7 +1103,7 @@ class TpuHashAggregateExec(TpuExec):
         merge_keys = [BoundReference(i, f.dtype) for i, f in
                       enumerate(self._partial_schema.fields[:len(self.groupings)])]
         merge_k = _get_kernel(merge_keys, self.aggs, self._partial_schema,
-                              "merge", self._partial_counts)
+                              "merge", self._partial_counts, split=True)
         return merge_keys, merge_k
 
     def _repartitioned_merge(self, ctx: ExecContext, partials, total, rows_m
@@ -962,6 +1138,15 @@ class TpuHashAggregateExec(TpuExec):
     # ------------------------------------------------------------------
     def _merge(self, ctx: ExecContext,
                partials: List[SpillableBatch]) -> ColumnarBatch:
+        """Merge partial batches. Small totals concat once and run ONE
+        lazy merge kernel. Totals whose concat would exceed batchSizeRows
+        merge as a bounded-fan-in TREE instead: chunks of partials whose
+        padded sum fits the cap merge in parallel (counts resolved in one
+        stacked fetch per level), so no merge kernel is ever compiled
+        above the bucket the cap implies. Before this, 10 high-cardinality
+        partials at the 262144 bucket concatenated to a 4.19M-row shape
+        whose variadic-sort merge kernel took >12 minutes to compile on
+        the tunneled backend (TPC-DS q28 at 10M rows)."""
         _, merge_k = self._merge_kernel()
         if not partials:
             # empty input: still one row for global agg, zero rows for grouped
@@ -970,20 +1155,84 @@ class TpuHashAggregateExec(TpuExec):
             with ctx.semaphore.held():
                 return self._run_kernel(merge_k, empty, self._partial_schema)
 
+        # the tree operates on SPILLABLES end to end: every level's inputs
+        # materialize via sb.get() INSIDE the retried closure, so a
+        # RetryOOM spill actually frees HBM and the retry re-materializes
+        # from host (holding raw jax arrays across the retry would pin
+        # the memory the spill claims to have released).
+        # the cap never sits below the largest single partial (a chunk of
+        # one merges nothing and would loop forever)
+        cap = max(ctx.conf.batch_size_rows,
+                  max(sb.padded_len for sb in partials))
+        level: List[SpillableBatch] = list(partials)
+
+        while len(level) > 1 and \
+                sum(sb.padded_len for sb in level) > cap:
+            # greedy chunking by padded length
+            chunks, cur, acc = [], [], 0
+            for sb in level:
+                if cur and acc + sb.padded_len > cap:
+                    chunks.append(cur)
+                    cur, acc = [], 0
+                cur.append(sb)
+                acc += sb.padded_len
+            chunks.append(cur)
+            raws = []
+            for chunk in chunks:
+                if len(chunk) == 1:
+                    raws.append(chunk[0])    # spillable passthrough
+                    continue
+
+                def level_merge(c=chunk):
+                    with ctx.semaphore.held():
+                        big = concat_batches([s.get() for s in c])
+                        return self._run_kernel_raw(merge_k, big)
+                raws.append(with_retry_no_split(level_merge, ctx.memory))
+            ngs = [r[1] for r in raws if isinstance(r, tuple)]
+            if len(ngs) > 1:
+                def resolve():
+                    import numpy as _np
+                    return [int(x) for x in _np.asarray(jnp.stack(ngs))]
+                counts = iter(with_retry_no_split(resolve, ctx.memory))
+            else:
+                counts = iter([int(ngs[0])] if ngs else [])
+            merged_level = []
+            for r in raws:
+                if not isinstance(r, tuple):
+                    merged_level.append(r)
+                    continue
+                pb = self._slice_to_count(r[0], next(counts),
+                                          self._partial_schema)
+                merged_level.append(SpillableBatch(pb, ctx.memory))
+            # consumed chunk inputs can release now (their content lives
+            # on in the level outputs)
+            for sb in level:
+                if sb not in merged_level:
+                    sb.close()
+            if len(merged_level) >= len(level):
+                # no progress (every chunk was a singleton — all partials
+                # at cap size): fall through to one oversized merge rather
+                # than loop forever
+                level = merged_level
+                break
+            level = merged_level
+
         def do_merge() -> ColumnarBatch:
             with ctx.semaphore.held():
-                batches = [sb.get() for sb in partials]
-                big = concat_batches(batches)
+                big = concat_batches([s.get() for s in level])
                 # lazy: the merge input is already group-sized, so the
                 # output stays at its (small) bucket and the group count
                 # rides to the sink fetch instead of syncing here
                 return self._run_kernel(merge_k, big, self._partial_schema,
                                         lazy=True)
 
-        out = with_retry_no_split(do_merge, ctx.memory)
-        for sb in partials:
-            sb.close()
-        return out
+        try:
+            if len(level) == 1:
+                return level[0].get()
+            return with_retry_no_split(do_merge, ctx.memory)
+        finally:
+            for sb in level:
+                sb.close()
 
     # ------------------------------------------------------------------
     def _finalize(self, ctx: ExecContext, merged: ColumnarBatch) -> ColumnarBatch:

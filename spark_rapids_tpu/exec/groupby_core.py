@@ -1,17 +1,25 @@
 """Core traced groupby: encode keys -> ONE variadic lax.sort (payloads ride
 the sort network) -> segmented scans -> one compaction sort. Shared by the
-single-device aggregate exec (exec/aggregate.py) and the multi-chip SPMD
-path (parallel/collective.py), so local and distributed aggregation are the
-same maths by construction (the reference gets this by reusing cudf groupby
-in both its first-pass and merge pass, GpuAggregateExec.scala:718).
+single-chip aggregate exec and the SPMD fragment compiler.
 
-TPU note: this pipeline deliberately contains NO row-sized gathers or
-scatters — both serialize on the scalar core (~15-45 ms per 1M rows
-measured on v5e). Values are carried through the key sort as sort payloads,
-per-segment aggregation is a Hillis-Steele segmented scan
-(columnar/segmented.SortedSegments), and the per-group results — which land
-at each segment's last row — are packed to the front by one more variadic
+Reference analog: cudf's hash groupby behind GpuHashAggregateExec
+(GpuAggregateExec.scala). A hash table is the wrong shape for a TPU (random
+scatter/gather serialize on the scalar core); sorting is native (variadic
+bitonic sort on the VPU, 4-8 ms per 1M rows measured on v5e). Values are
+carried through the key sort as sort payloads, aggregates become segmented
+scans over the sorted domain, and results pack to the front with one more
 sort keyed on "segment id at end rows, +inf elsewhere".
+
+The pipeline is exposed BOTH as one traceable composition
+(``segmented_groupby`` — required inside shard_map SPMD fragments and the
+fused single-batch kernels) AND as three separately-traceable stages
+(``stage_sort`` / ``stage_scan`` / ``stage_pack``). The split form exists
+for COMPILE time: on the tunneled v5e backend, a lax.sort's compile cost
+multiplies with the complexity of the surrounding module (a bare 7-operand
+sort compiles in ~6 s, the same sort fed by two jnp.where's in ~22 s, and
+the full fused two-key merge kernel never finished in >20 minutes), while
+the three stages jitted separately compile in ~30-100 s total and add only
+dispatch latency — the right trade everywhere except inside shard_map.
 """
 from __future__ import annotations
 
@@ -24,7 +32,118 @@ from ..columnar.segmented import SortedSegments, prefix_sum
 from ..exprs.base import DVal
 from .encoding import grouping_operands, operands_equal
 
-__all__ = ["segmented_groupby"]
+__all__ = ["segmented_groupby", "stage_sort", "stage_scan", "stage_pack",
+           "global_groupby"]
+
+
+def global_groupby(vals: List[List[DVal]], aggs: Sequence, mode: str,
+                   num_rows, padded_len: int, row_mask=None):
+    """Key-less (global) aggregation: a single segment over the unsorted
+    rows — no sort at all; each scan's inclusive total lands at the last
+    row."""
+    if row_mask is None:
+        row_mask = jnp.arange(padded_len, dtype=jnp.int32) < num_rows
+    idx = jnp.arange(padded_len, dtype=jnp.int32)
+    seg = SortedSegments(idx == 0, row_mask, orig_index=idx)
+    num_groups = jnp.int32(1)
+    partial_rows = _run_aggs(aggs, vals, seg, mode, row_mask)
+    partial_outs = [(jnp.where(idx == 0, d[-1],
+                               jnp.zeros((), dtype=d.dtype)),
+                     jnp.where(idx == 0, v[-1], False))
+                    for d, v in partial_rows]
+    return [], partial_outs, num_groups
+
+
+def stage_sort(keys: List[DVal], vals: List[List[DVal]], num_rows,
+               padded_len: int, row_mask=None):
+    """Stage 1: encode key operands and run THE sort, values riding as
+    payloads. Returns (s_ops, perm, s_keys, sorted_vals, live_count)."""
+    if row_mask is None:
+        row_mask = jnp.arange(padded_len, dtype=jnp.int32) < num_rows
+    idx = jnp.arange(padded_len, dtype=jnp.int32)
+    pad_flag = jnp.where(row_mask, jnp.uint8(0), jnp.uint8(1))
+    operands = [pad_flag]
+    for k in keys:
+        operands.extend(grouping_operands(k))
+    n_key_ops = len(operands)
+    # payloads (carried through the sort network — far cheaper than
+    # row-sized gathers): original index, key columns, value columns
+    payload: List = [idx]
+    for k in keys:
+        payload.extend((k.data, k.validity))
+    for vs in vals:
+        for v in vs:
+            payload.extend((v.data, v.validity))
+    sorted_all = jax.lax.sort(tuple(operands + payload),
+                              num_keys=n_key_ops, is_stable=True)
+    s_ops = sorted_all[:n_key_ops]
+    it = iter(sorted_all[n_key_ops:])
+    perm = next(it)
+    s_keys = [DVal(next(it), next(it), k.dtype) for k in keys]
+    sorted_vals = [[DVal(next(it), next(it), v.dtype) for v in vs]
+                   for vs in vals]
+    live_count = jnp.sum(row_mask).astype(jnp.int32)
+    return s_ops, perm, s_keys, sorted_vals, live_count
+
+
+def stage_scan(aggs: Sequence, mode: str, s_ops, perm, s_keys,
+               sorted_vals, live_count, padded_len: int):
+    """Stage 2: segment boundaries from adjacent-key comparison, then the
+    segmented scans. Returns (ckey, carry, num_groups) where ``carry`` is
+    the flat [key data/validity..., partial data/validity...] list the
+    compaction sort will move."""
+    idx = jnp.arange(padded_len, dtype=jnp.int32)
+    differs = jnp.zeros(padded_len, dtype=jnp.bool_)
+    for op in s_ops[1:]:
+        prev = jnp.roll(op, 1)
+        differs = jnp.logical_or(
+            differs, jnp.logical_not(operands_equal(op, prev)))
+    # live rows sort first (pad_flag), so the sorted-domain live mask
+    # is a prefix of length live_count — row_mask itself is in the
+    # UNSORTED domain and may be arbitrary (fused pre-filter)
+    s_live = idx < live_count
+    flags = jnp.logical_and(jnp.logical_or(idx == 0, differs), s_live)
+    num_groups = jnp.sum(flags).astype(jnp.int32)
+    # segment id without live-masking: the trailing dead region simply
+    # extends the last segment (its scans see only neutrals there)
+    gid_seg = prefix_sum(flags, jnp.int32) - 1
+
+    seg = SortedSegments(flags, s_live, orig_index=perm)
+    partial_rows = _run_aggs(aggs, sorted_vals, seg, mode, s_live)
+
+    # extraction: each segment's total sits at its last LIVE row (the
+    # scan there covers the whole segment; the raw key payload there is
+    # a real row, unlike the trailing dead region); one stable sort
+    # packs those rows — already in segment order — to the front
+    one_true = jnp.ones((1,), dtype=jnp.bool_)
+    nxt_flag = jnp.concatenate([flags[1:], one_true])
+    nxt_dead = jnp.concatenate([jnp.logical_not(s_live[1:]), one_true])
+    end_mask = jnp.logical_and(
+        s_live, jnp.logical_or(nxt_flag, nxt_dead))
+    ckey = jnp.where(end_mask, gid_seg, padded_len)
+    carry: List = []
+    for k in s_keys:
+        carry.extend((k.data, k.validity))
+    for d, v in partial_rows:
+        carry.extend((d, v))
+    return ckey, carry, num_groups
+
+
+def stage_pack(ckey, carry, num_groups, n_keys: int, padded_len: int):
+    """Stage 3: the compaction sort. Returns (key_outs, partial_outs,
+    num_groups) with group validities masked to the live prefix."""
+    idx = jnp.arange(padded_len, dtype=jnp.int32)
+    packed = jax.lax.sort(tuple([ckey] + list(carry)), num_keys=1,
+                          is_stable=True)
+    it = iter(packed[1:])
+    key_outs = [(next(it), next(it)) for _ in range(n_keys)]
+    n_partials = (len(carry) - 2 * n_keys) // 2
+    partial_outs = [(next(it), next(it)) for _ in range(n_partials)]
+    group_live = idx < num_groups
+    key_outs = [(d, jnp.logical_and(v, group_live)) for d, v in key_outs]
+    partial_outs = [(d, jnp.logical_and(v, group_live))
+                    for d, v in partial_outs]
+    return key_outs, partial_outs, num_groups
 
 
 def segmented_groupby(keys: List[DVal], vals: List[List[DVal]],
@@ -36,89 +155,21 @@ def segmented_groupby(keys: List[DVal], vals: List[List[DVal]],
     are padded device values; rows >= num_rows are ignored. Output group
     arrays have length padded_len with groups packed at the front.
     ``row_mask`` (bool[P]) overrides the row-count mask so a fused
-    pre-filter can drop rows without a separate compaction kernel."""
-    if row_mask is None:
-        row_mask = jnp.arange(padded_len, dtype=jnp.int32) < num_rows
-    idx = jnp.arange(padded_len, dtype=jnp.int32)
+    pre-filter can drop rows without a separate compaction kernel.
 
+    One traceable composition of the three stages — required inside
+    shard_map fragments and the fused single-batch kernels; the aggregate
+    exec's classic path jits the stages separately instead (see module
+    docstring for why)."""
     if not keys:
-        # single group over the unsorted rows; the scans' inclusive total
-        # lands at the last row (dead rows contribute the neutral)
-        seg = SortedSegments(idx == 0, row_mask, orig_index=idx)
-        num_groups = jnp.int32(1)
-        partial_rows = _run_aggs(aggs, vals, seg, mode, row_mask)
-        key_outs: List[Tuple] = []
-        partial_outs = [(jnp.where(idx == 0, d[-1],
-                                   jnp.zeros((), dtype=d.dtype)),
-                         jnp.where(idx == 0, v[-1], False))
-                        for d, v in partial_rows]
-    else:
-        pad_flag = jnp.where(row_mask, jnp.uint8(0), jnp.uint8(1))
-        operands = [pad_flag]
-        for k in keys:
-            operands.extend(grouping_operands(k))
-        n_key_ops = len(operands)
-        # payloads (carried through the sort network — far cheaper than
-        # row-sized gathers): original index, key columns, value columns
-        payload: List = [idx]
-        for k in keys:
-            payload.extend((k.data, k.validity))
-        for vs in vals:
-            for v in vs:
-                payload.extend((v.data, v.validity))
-        sorted_all = jax.lax.sort(tuple(operands + payload),
-                                  num_keys=n_key_ops, is_stable=True)
-        s_ops = sorted_all[:n_key_ops]
-        it = iter(sorted_all[n_key_ops:])
-        perm = next(it)
-        s_keys = [DVal(next(it), next(it), k.dtype) for k in keys]
-        sorted_vals = [[DVal(next(it), next(it), v.dtype) for v in vs]
-                       for vs in vals]
-
-        differs = jnp.zeros(padded_len, dtype=jnp.bool_)
-        for op in s_ops[1:]:
-            prev = jnp.roll(op, 1)
-            differs = jnp.logical_or(
-                differs, jnp.logical_not(operands_equal(op, prev)))
-        # live rows sort first (pad_flag), so the sorted-domain live mask
-        # is a prefix of length sum(row_mask) — row_mask itself is in the
-        # UNSORTED domain and may be arbitrary (fused pre-filter)
-        s_live = idx < jnp.sum(row_mask)
-        flags = jnp.logical_and(jnp.logical_or(idx == 0, differs), s_live)
-        num_groups = jnp.sum(flags).astype(jnp.int32)
-        # segment id without live-masking: the trailing dead region simply
-        # extends the last segment (its scans see only neutrals there)
-        gid_seg = prefix_sum(flags, jnp.int32) - 1
-
-        seg = SortedSegments(flags, s_live, orig_index=perm)
-        partial_rows = _run_aggs(aggs, sorted_vals, seg, mode, s_live)
-
-        # extraction: each segment's total sits at its last LIVE row (the
-        # scan there covers the whole segment; the raw key payload there is
-        # a real row, unlike the trailing dead region); one stable sort
-        # packs those rows — already in segment order — to the front
-        one_true = jnp.ones((1,), dtype=jnp.bool_)
-        nxt_flag = jnp.concatenate([flags[1:], one_true])
-        nxt_dead = jnp.concatenate([jnp.logical_not(s_live[1:]), one_true])
-        end_mask = jnp.logical_and(
-            s_live, jnp.logical_or(nxt_flag, nxt_dead))
-        ckey = jnp.where(end_mask, gid_seg, padded_len)
-        carry: List = []
-        for k in s_keys:
-            carry.extend((k.data, k.validity))
-        for d, v in partial_rows:
-            carry.extend((d, v))
-        packed = jax.lax.sort(tuple([ckey] + carry), num_keys=1,
-                              is_stable=True)
-        it = iter(packed[1:])
-        key_outs = [(next(it), next(it)) for _ in keys]
-        partial_outs = [(next(it), next(it)) for _ in partial_rows]
-
-    group_live = idx < num_groups
-    key_outs = [(d, jnp.logical_and(v, group_live)) for d, v in key_outs]
-    partial_outs = [(d, jnp.logical_and(v, group_live))
-                    for d, v in partial_outs]
-    return key_outs, partial_outs, num_groups
+        return global_groupby(vals, aggs, mode, num_rows, padded_len,
+                              row_mask)
+    s_ops, perm, s_keys, sorted_vals, live_count = stage_sort(
+        keys, vals, num_rows, padded_len, row_mask)
+    ckey, carry, num_groups = stage_scan(
+        aggs, mode, s_ops, perm, s_keys, sorted_vals, live_count,
+        padded_len)
+    return stage_pack(ckey, carry, num_groups, len(keys), padded_len)
 
 
 def _run_aggs(aggs, vals, seg, mode, update_mask):

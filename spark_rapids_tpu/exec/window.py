@@ -504,20 +504,21 @@ def _numpy_frame_agg(fn, spec, child_pair, order, idx, part_start, pend,
 
 
 def _seg_combine_scan(vals, flags, combine, neutral):
-    """Segmented inclusive forward scan (Hillis-Steele: log2(P) static
-    shift+combine passes — no gathers)."""
-    from ..columnar.segmented import _shifted, _steps
-    n = vals.shape[0]
-    neutral = jnp.asarray(neutral, dtype=vals.dtype)
-
-    def body(i, vf):
-        v, f = vf
-        d = jax.lax.shift_left(jnp.int32(1), i.astype(jnp.int32))
-        pv = _shifted(v, neutral, d)
-        pf = _shifted(f, jnp.array(True), d)
-        return (jnp.where(f, v, combine(pv, v)), jnp.logical_or(f, pf))
-
-    v, _ = jax.lax.fori_loop(0, _steps(n), body, (vals, flags))
+    """Segmented inclusive forward scan (Hillis-Steele: log2(P) STATIC
+    shift+combine passes, unrolled — the rolled traced-shift form
+    composes pathologically with surrounding sorts at compile time; see
+    columnar/segmented.py)."""
+    from ..columnar.segmented import shift_static
+    v, f = vals, flags
+    n = v.shape[0]
+    neutral = jnp.asarray(neutral, dtype=v.dtype)
+    d = 1
+    while d < n:
+        pv = shift_static(v, d, neutral)
+        pf = shift_static(f, d, True)
+        v = jnp.where(f, v, combine(pv, v))
+        f = jnp.logical_or(f, pf)
+        d <<= 1
     return v
 
 

@@ -281,6 +281,30 @@ class MemoryManager:
             raise RetryOOM("injected RetryOOM")
         raise SplitAndRetryOOM("injected SplitAndRetryOOM")
 
+    # ----------------------------------------------------------- leak audit
+    def audit_leaks(self) -> List[dict]:
+        """Live (unclosed) spillable registrations — the MemoryCleaner
+        leak tracker analog (ref Plugin.scala:573-588: cudf MemoryCleaner
+        asserts no leaked device buffers at shutdown). Every
+        SpillableBatch a query creates must be close()d by the time its
+        sink finishes; anything still registered here afterwards is a
+        leak. Entries carry the creation site when leak-detection debug
+        is on (SpillableBatch records it)."""
+        with self._lock:
+            return [{"handle": h, "tier": s.tier,
+                     "bytes": s.device_bytes(),
+                     "created_at": getattr(s, "created_at", None)}
+                    for h, s in self._spillables.items()]
+
+    @classmethod
+    def audit_all_leaks(cls) -> List[dict]:
+        with cls._global_lock:
+            insts = list(cls._instances.values())
+        out = []
+        for mm in insts:
+            out.extend(mm.audit_leaks())
+        return out
+
     # ------------------------------------------------------------------ stats
     def stats(self) -> Dict[str, int]:
         with self._lock:

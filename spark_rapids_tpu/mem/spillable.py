@@ -51,6 +51,14 @@ class SpillableBatch:
         self._mm.reserve(self._device_bytes)
         self._handle = self._mm.register_spillable(self)
         self._closed = False
+        #: creation site for the leak auditor (MemoryCleaner analog) —
+        #: only captured in debug mode, a traceback walk per wrap is not
+        #: free on the hot path
+        self.created_at = None
+        import os
+        if os.environ.get("SRTPU_LEAK_DEBUG"):
+            import traceback
+            self.created_at = "".join(traceback.format_stack(limit=6)[:-1])
 
     @property
     def num_rows(self) -> int:
@@ -66,6 +74,12 @@ class SpillableBatch:
         """Device footprint when resident (size estimate for spill/split
         decisions, ref SpillableColumnarBatch.sizeInBytes)."""
         return self._device_bytes
+
+    @property
+    def padded_len(self) -> int:
+        """Shape-bucket length of the wrapped batch (static — known
+        without materializing any tier)."""
+        return self._cap if self._cap is not None else self.num_rows
 
     # ------------------------------------------------------------- migration
     def spill_to_host(self) -> int:

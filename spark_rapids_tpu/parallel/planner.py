@@ -48,15 +48,28 @@ from ..types import INT32, INT64, STRING, DataType, Schema, StructField
 
 log = logging.getLogger("spark_rapids_tpu.distributed")
 
-__all__ = ["maybe_distribute", "DistributedPipelineExec",
+__all__ = ["maybe_distribute", "try_distribute", "distribution_gate",
+           "DistributedPipelineExec",
            "DISTRIBUTED_ENABLED", "DISTRIBUTED_NUM_DEVICES"]
 
 DISTRIBUTED_ENABLED = register(
-    "spark.rapids.tpu.distributed.enabled", False,
+    "spark.rapids.tpu.distributed.enabled", True,
     "Lower planned queries onto the session's device mesh: the supported "
     "plan fragment compiles to one SPMD program with all_to_all exchanges "
     "(ref GpuShuffleExchangeExecBase.scala:167 — the planner, not the user, "
-    "makes queries distributed).", commonly_used=True)
+    "makes queries distributed). ON by default since r4: a mesh is built "
+    "automatically when >1 device is visible, and the planner skips the "
+    "mesh for inputs below distributed.minRows (a per-collective dispatch "
+    "floor no small input can pay back). An explicitly-supplied session "
+    "mesh always distributes.", commonly_used=True)
+
+DISTRIBUTED_MIN_ROWS = register(
+    "spark.rapids.tpu.distributed.minRows", 262144,
+    "Auto-mesh threshold: a conf-built (non-explicit) mesh is only used "
+    "for queries whose in-memory scan inputs reach this many rows — below "
+    "it the exchange/dispatch overhead outweighs the parallelism (the "
+    "reference's CBO transition-cost revert applied to distribution). "
+    "File scans are always considered large enough.")
 
 DISTRIBUTED_NUM_DEVICES = register(
     "spark.rapids.tpu.distributed.numDevices", 0,
@@ -592,6 +605,25 @@ class _AggFrag(_Frag):
         ptypes = []
         for a in self.aggs:
             ptypes.extend(a.partial_types(schema))
+        if n_dev > 1 and not self.replicated:
+            # First/Last carry within-SHARD row positions; the merge after
+            # the exchange breaks ties by position, so positions must be
+            # GLOBAL (shard index * padded — sources shard row-contiguous,
+            # so shard order IS row order). Without this, 88% of groups
+            # returned another shard's first (caught by the r4 drive).
+            from ..exprs.aggregates import First, Last
+            base = (jax.lax.axis_index(env.axis).astype(jnp.int64)
+                    * jnp.int64(rel.padded))
+            ord_ = 0
+            adj = list(partial_outs)
+            for a in self.aggs:
+                n_p = len(a.partial_types(schema))
+                if isinstance(a, (First, Last)):
+                    _vd, vv = adj[ord_]
+                    pd_, pv = adj[ord_ + 1]
+                    adj[ord_ + 1] = (jnp.where(vv, pd_ + base, pd_), pv)
+                ord_ += n_p
+            partial_outs = adj
         if n_dev == 1 or self.replicated:
             m_key_outs, m_partial_outs, m_groups = key_outs, partial_outs, \
                 n_groups
@@ -1621,13 +1653,49 @@ class DistributedPipelineExec(TpuExec):
 # entry point
 # ---------------------------------------------------------------------------
 
-def maybe_distribute(physical, conf: TpuConf, mesh):
+def _scan_input_rows(node):
+    """Total in-memory scan rows under a physical node; file scans count
+    as 'large' (None = unbounded)."""
+    from ..exec.basic import InMemoryScanExec
+    from ..io.file_scan import FileScanBase
+    if isinstance(node, FileScanBase):
+        return None
+    total = 0
+    if isinstance(node, InMemoryScanExec):
+        total += sum(t.num_rows for t in node.tables)
+    for c in getattr(node, "children", []):
+        sub = _scan_input_rows(c)
+        if sub is None:
+            return None
+        total += sub
+    return total
+
+
+def distribution_gate(physical, conf: TpuConf, auto: bool = False) -> bool:
+    """Whether a mesh should be used for this plan. An explicitly-supplied
+    mesh implies distribution is wanted; an AUTO mesh (built because
+    distributed.enabled defaulted on with >1 device) only engages above
+    the minRows threshold — the cost-model gate that lets the conf
+    default ON without hurting small queries."""
+    if not auto:
+        return True
+    rows = _scan_input_rows(physical)
+    return rows is None or rows >= int(conf.get(DISTRIBUTED_MIN_ROWS))
+
+
+def try_distribute(physical, conf: TpuConf, mesh):
     """Replace the largest lowerable subtree containing communication with
-    a DistributedPipelineExec; leave the rest of the plan untouched.
-    An explicitly-supplied mesh implies distribution is wanted."""
+    a DistributedPipelineExec. Returns None when NOTHING lowered, so the
+    caller can fall back to the single-chip fused pipeline instead of
+    silently losing it."""
     if mesh is None:
-        return physical
-    replaced = _try_replace(physical, conf, mesh)
+        return None
+    return _try_replace(physical, conf, mesh)
+
+
+def maybe_distribute(physical, conf: TpuConf, mesh):
+    """try_distribute, keeping the original plan when nothing lowered."""
+    replaced = try_distribute(physical, conf, mesh)
     return replaced if replaced is not None else physical
 
 

@@ -106,31 +106,80 @@ def _host_node_cost(plan, rows_in: float, cpu_scale: float) -> float:
 _RUNTIME_SIZES: dict = {}
 _RUNTIME_SIZES_MAX = 4096
 
-# id-reuse guard (same hazard planner._source_cache_key handles): scan
-# signatures embed id(table); when a table is GC'd, evict every stat
-# whose signature mentions that id so a recycled address can never serve
-# a stale measured size for an unrelated table.
+# In-memory tables are tagged with a CONTENT fingerprint (schema + row
+# count + hashed head/tail slices), memoized per object id. Content tags
+# are stable across processes — measured walls and row counts persist to
+# the on-disk stats store (stats_store.py) and a fresh process plans a
+# previously-seen query correctly on its FIRST execution (the cross-
+# process analog of the reference's AQE stage statistics,
+# GpuOverrides.scala:4691-4730). The id-memo is only a cache: a recycled
+# object id can at worst recompute the fingerprint, never serve a stale
+# one, because the memo pins the table object itself.
 import weakref  # noqa: E402
 
 _SIG_PIN: "weakref.WeakValueDictionary" = weakref.WeakValueDictionary()
+_SIG_MEMO: dict = {}
 
 
-def _evict_sigs_for(tid: int):
-    tag = f"#{tid}#"
-    for k in [k for k in _RUNTIME_SIZES if tag in k]:
-        del _RUNTIME_SIZES[k]
+def _drop_memo(tid: int):
+    _SIG_MEMO.pop(tid, None)
+
+
+def _fingerprint_table(t) -> str:
+    import hashlib
+    h = hashlib.blake2b(digest_size=10)
+    h.update(str(t.schema).encode())
+    h.update(str(t.num_rows).encode())
+    n = t.num_rows
+    for sl in (t.slice(0, 128), t.slice(max(n - 128, 0), 128),
+               t.slice(n // 2, 64)):
+        try:
+            # hash VALUES of the sampled rows, never buffers: pyarrow
+            # slices are zero-copy views whose .buffers() return the
+            # UNTRIMMED parent buffers (hashing the whole table three
+            # times, ~1.3 s at 20M rows, measured)
+            import pickle
+            h.update(pickle.dumps(sl.to_pydict(), protocol=4))
+        except Exception:       # unpicklable cell types: length-only tag
+            h.update(b"?")
+    return h.hexdigest()
+
+
+def _evict_local_sigs(tag: str):
+    """Drop every stat whose signature embeds a process-local '#<id>#'
+    tag when that object dies — a recycled id must never serve another
+    table's measurements (the content-fingerprint path needs no eviction;
+    this guards only the non-Arrow fallback)."""
+    for store in (_RUNTIME_SIZES, _RUNTIME_ROWS):
+        for k in [k for k in store if tag in k]:
+            del store[k]
+    for k in [k for k in _ENGINE_WALLS if tag in k[0]]:
+        del _ENGINE_WALLS[k]
 
 
 def _pin_table(t) -> str:
     tid = id(t)
-    if _SIG_PIN.get(tid) is not t:
+    if _SIG_PIN.get(tid) is t and tid in _SIG_MEMO:
+        return _SIG_MEMO[tid]
+    try:
+        fp = f"#{_fingerprint_table(t)}#"
+    except Exception:
+        fp = f"#{tid}#"              # non-arrow source: process-local tag
         try:
             _SIG_PIN[tid] = t
+            _SIG_MEMO[tid] = fp
+            weakref.finalize(t, _drop_memo, tid)
+            weakref.finalize(t, _evict_local_sigs, fp)
         except TypeError:
-            return f"#{tid}#"
-        _evict_sigs_for(tid)        # stale stats under a reused id
-        weakref.finalize(t, _evict_sigs_for, tid)
-    return f"#{tid}#"
+            pass
+        return fp
+    try:
+        _SIG_PIN[tid] = t
+        _SIG_MEMO[tid] = fp
+        weakref.finalize(t, _drop_memo, tid)
+    except TypeError:
+        pass
+    return fp
 
 
 def plan_signature(plan: L.LogicalPlan) -> str:
@@ -206,6 +255,9 @@ def record_runtime_rows(sig: str, rows: int) -> None:
             and sig not in _RUNTIME_ROWS:
         _RUNTIME_ROWS.pop(next(iter(_RUNTIME_ROWS)))
     _RUNTIME_ROWS[sig] = max(_RUNTIME_ROWS.get(sig, 0), int(rows))
+    if _persist_enabled():
+        from . import stats_store
+        stats_store.mark_dirty()
 
 
 #: measured whole-query wall seconds per (plan signature, placement):
@@ -218,6 +270,19 @@ def record_runtime_rows(sig: str, rows: int) -> None:
 _ENGINE_WALLS: dict = {}
 
 
+def _persist_enabled() -> bool:
+    import os
+    return os.environ.get("SRTPU_STATS_PERSIST", "1") != "0"
+
+
+def load_persisted_stats() -> None:
+    """Merge the on-disk adaptive stats (stats_store.py) into the live
+    dicts — idempotent, called lazily before the first read."""
+    if _persist_enabled():
+        from . import stats_store
+        stats_store.load_into(_ENGINE_WALLS, _RUNTIME_ROWS)
+
+
 def record_engine_wall(sig: str, placement: str, seconds: float) -> None:
     if len(_ENGINE_WALLS) >= _RUNTIME_SIZES_MAX \
             and (sig, placement) not in _ENGINE_WALLS:
@@ -226,6 +291,9 @@ def record_engine_wall(sig: str, placement: str, seconds: float) -> None:
     cnt, prev = _ENGINE_WALLS.get(k, (0, None))
     _ENGINE_WALLS[k] = (cnt + 1,
                         seconds if prev is None else min(prev, seconds))
+    if _persist_enabled():
+        from . import stats_store
+        stats_store.mark_dirty()
 
 
 def trusted_engine_wall(sig: str, placement: str):
@@ -327,6 +395,7 @@ def apply_cost_optimizer(meta: PlanMeta, conf: TpuConf,
         (_RUNTIME_ROWS) makes the second planning of a shape exact.
 
     Mutates metas via will_not_work_on_tpu."""
+    load_persisted_stats()
     # the registered defaults are per-row costs for the reference's
     # row-interpreter; this engine's host twin is vectorized — treat the
     # conf values as SCALES relative to the registered defaults so

@@ -42,7 +42,8 @@ def wrap_plan(plan: L.LogicalPlan, conf: TpuConf,
     return m
 
 
-def plan_query(plan: L.LogicalPlan, conf: TpuConf, mesh=None) -> TpuExec:
+def plan_query(plan: L.LogicalPlan, conf: TpuConf, mesh=None,
+               mesh_auto: bool = False) -> TpuExec:
     """tag -> cost-optimize -> (explain) -> convert (ref
     applyOverrides:4813, getOptimizations:4827) -> distribute onto the mesh
     when one is configured (ref GpuShuffleExchangeExecBase: the planner —
@@ -75,13 +76,20 @@ def plan_query(plan: L.LogicalPlan, conf: TpuConf, mesh=None) -> TpuExec:
         if out:
             log.warning("\n%s", out)
     physical = meta.convert()
-    if mesh is not None and conf.sql_enabled:
-        from ..parallel.planner import maybe_distribute
-        physical = maybe_distribute(physical, conf, mesh)
-    elif conf.sql_enabled:
-        from ..parallel.planner import FUSED_PIPELINE, \
-            maybe_fuse_single_chip
-        if conf.get(FUSED_PIPELINE):
+    if conf.sql_enabled:
+        from ..parallel.planner import (FUSED_PIPELINE, distribution_gate,
+                                        maybe_fuse_single_chip,
+                                        try_distribute)
+        distributed = None
+        if mesh is not None and distribution_gate(physical, conf,
+                                                  auto=mesh_auto):
+            distributed = try_distribute(physical, conf, mesh)
+        if distributed is not None:
+            physical = distributed
+        elif conf.get(FUSED_PIPELINE):
+            # no mesh, auto-mesh below the row threshold, OR nothing in
+            # the plan lowered onto the mesh: single-chip fused pipelines
+            # still apply (losing them regressed latency-bound joins)
             physical = maybe_fuse_single_chip(physical, conf)
     return physical
 

@@ -71,7 +71,8 @@ class _RemoteManager:
 _WORKER: Dict[str, object] = {}
 
 
-def _worker_main(worker_id: int, driver_addr, ready_q, token: bytes):
+def _worker_main(worker_id: int, driver_addr, ready_q, token: bytes,
+                 bind_host: str = "127.0.0.1"):
     # CPU backend only: worker processes must never grab the TPU the
     # driver session owns (one chip, many processes — the reference's
     # one-GPU-per-executor assignment, Plugin.scala:536)
@@ -88,7 +89,7 @@ def _worker_main(worker_id: int, driver_addr, ready_q, token: bytes):
         import faulthandler
         import sys
         faulthandler.dump_traceback_later(30, repeat=True, file=sys.stderr)
-    server = BlockServer(token=token, tasks=_WORKER_TASKS)
+    server = BlockServer(host=bind_host, token=token, tasks=_WORKER_TASKS)
     _WORKER["server"] = server
     _WORKER["id"] = f"worker-{worker_id}"
     _WORKER["peers"] = {}
@@ -104,7 +105,8 @@ def _worker_main(worker_id: int, driver_addr, ready_q, token: bytes):
         on_new_peer=on_new_peer)
     _WORKER["endpoint"] = ep
     ep.heartbeat()
-    ready_q.put((worker_id, server.address))
+    if ready_q is not None:           # standalone (multi-host) workers
+        ready_q.put((worker_id, server.address))
     import threading
     stop = threading.Event()
     _WORKER["stop"] = stop
@@ -176,6 +178,61 @@ def _hash_partition(table, exprs, n_parts: int):
         if sub.num_rows:
             out[p] = sub
     return out
+
+
+def _range_partition(table, key_name: str, ascending: bool,
+                     nulls_first: bool, boundaries, n_parts: int):
+    """Range partitioning by the FIRST sort key (ref GpuRangePartitioner):
+    boundaries arrive ASC-sorted; equal key values always route to one
+    partition, so a local sort per partition + ordered concatenation is a
+    global sort (ties broken by the remaining keys locally, which all
+    live in the same partition). Nulls route to the first/last partition
+    per the null ordering."""
+    import numpy as np
+    import pyarrow as pa
+    from ..columnar import ColumnarBatch
+    from ..exprs.arithmetic import arrow_to_masked_numpy
+    from ..exprs.base import ColumnRef
+    if n_parts == 1 or not len(boundaries):
+        return {0: table}
+    batch = ColumnarBatch.from_arrow_host(table)
+    arr = ColumnRef(key_name).eval_host(batch)
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    v, ok = arrow_to_masked_numpy(arr)
+    v = np.asarray(v)
+    b = np.asarray(boundaries)
+    if ascending:
+        pid = np.searchsorted(b, v, side="right").astype(np.int64)
+    else:
+        pid = (len(b) - np.searchsorted(b, v, side="left")).astype(np.int64)
+    pid = np.clip(pid, 0, n_parts - 1)
+    pid = np.where(ok, pid, 0 if nulls_first else n_parts - 1)
+    out = {}
+    for p in range(n_parts):
+        sub = table.filter(pa.array(pid == p))
+        if sub.num_rows:
+            out[p] = sub
+    return out
+
+
+def _run_range_map_task(shuffle_id: int, plan_bytes: bytes,
+                        key_bytes: bytes, boundaries_bytes: bytes,
+                        owners: List[str]):
+    """Evaluate the map fragment and RANGE-partition its output by the
+    first sort key (the exchange below a distributed global sort; ref
+    GpuShuffleExchangeExecBase with GpuRangePartitioner)."""
+    from ..api.dataframe import TpuSession
+    from ..plan.overrides import plan_query
+    plan = pickle.loads(plan_bytes)
+    key_name, ascending, nulls_first = pickle.loads(key_bytes)
+    boundaries = pickle.loads(boundaries_bytes)
+    session = TpuSession()
+    physical = plan_query(plan, session.conf)
+    table = physical.collect(session.exec_context())
+    parts = _range_partition(table, key_name, ascending, nulls_first,
+                             boundaries, len(owners))
+    return _put_partitions(shuffle_id, parts, owners)
 
 
 def _put_partitions(shuffle_id: int, parts, owners: List[str]):
@@ -295,6 +352,10 @@ def _run_join_local_task(shuffle_l: int, shuffle_r: int, parts: List[int],
 #: shipping code)
 _WORKER_TASKS = {
     "map_agg": _run_map_task,
+    "map_range": _run_range_map_task,
+    # fetch owned partitions, apply an arbitrary unary plan over them,
+    # return Arrow: the merge-agg reducer, the per-range local sorter,
+    # and the per-hash-partition window runner are all this one task
     "reduce_agg": _run_reduce_task,
     "join_side": _run_join_side_task,
     "join_local": _run_join_local_task,
@@ -351,21 +412,91 @@ def _decompose_aggs(groupings, aggs, child_schema):
     return map_aggs, reduce_aggs, projections
 
 
-def _find_agg(plan):
-    """Topmost Aggregate reachable through unary driver-finishable nodes;
-    returns (path, agg) where path re-applies the upper fragment."""
-    from ..plan import logical as L
+def _find_root(plan, pred, through):
+    """Topmost node matching ``pred`` reachable through unary
+    driver-finishable nodes of the given types; returns (path, node)
+    where path re-applies the upper fragment on the driver."""
     path = []
     node = plan
     while True:
-        if isinstance(node, L.Aggregate):
+        if pred(node):
             return path, node
-        if isinstance(node, (L.Sort, L.Project, L.GlobalLimit,
-                             L.LocalLimit)) and len(node.children) == 1:
+        if isinstance(node, through) and len(node.children) == 1:
             path.append(node)
             node = node.children[0]
             continue
         return None, None
+
+
+def _find_agg(plan):
+    from ..plan import logical as L
+    return _find_root(plan, lambda n: isinstance(n, L.Aggregate),
+                      (L.Sort, L.Project, L.GlobalLimit, L.LocalLimit))
+
+
+def _find_sort(plan):
+    from ..plan import logical as L
+    return _find_root(
+        plan, lambda n: isinstance(n, L.Sort) and n.global_sort,
+        (L.Project, L.GlobalLimit, L.LocalLimit))
+
+
+def _find_window(plan):
+    from ..plan import logical as L
+    return _find_root(plan, lambda n: isinstance(n, L.Window),
+                      (L.Sort, L.Project, L.GlobalLimit, L.LocalLimit))
+
+
+def _largest_scan(child):
+    scans: List = []
+    _scan_sizes(child, scans)
+    if not scans:
+        return None
+    return max(scans, key=lambda s: sum(t.num_rows for t in s.tables))
+
+
+def _check_row_decomposable(child, stop_at=None, sliced=None) -> None:
+    """The map fragment below a distributed agg/sort/window is executed
+    on row SLICES of its largest scan, so it must be row-local: slicing
+    the input and unioning the outputs has to equal running it whole.
+    Project/Filter/Sample/inner-Join qualify; a nested Aggregate, Sort,
+    Limit, Window, or an outer/semi/anti join (whose null-extended or
+    filtered rows are per-slice artifacts — a dim row unmatched in one
+    slice but matched in another would be emitted null-extended anyway)
+    would silently compute per-slice results — refuse instead.
+    ``stop_at`` marks a join the caller shuffles by key instead of
+    slicing (its own subtrees are validated separately)."""
+    from ..plan import logical as L
+    ok = (L.Project, L.Filter, L.Join, L.LogicalScan, L.Sample,
+          L.Union, L.Expand, L.Generate)
+
+    def contains(n, target):
+        return n is target or any(contains(c, target) for c in n.children)
+
+    def walk(n):
+        if n is stop_at:
+            return
+        if not isinstance(n, ok):
+            raise ValueError(
+                f"fragment below the distributed root is not "
+                f"row-decomposable: {type(n).__name__} computes a "
+                f"cross-row result and would be wrong on row slices")
+        if isinstance(n, L.Join) and n.join_type != "inner":
+            # a non-inner join slices safely ONLY when the sliced scan
+            # feeds its row-preserving side (each output row then derives
+            # from exactly one sliced row); a sliced null-producing or
+            # filtering side emits per-slice artifacts
+            preserving = {"left": 0, "leftsemi": 0, "leftanti": 0,
+                          "existence": 0, "right": 1}.get(n.join_type)
+            if preserving is None or sliced is None \
+                    or not contains(n.children[preserving], sliced):
+                raise ValueError(
+                    f"{n.join_type} join is not row-decomposable with "
+                    f"the sliced input on its non-preserving side")
+        for c in n.children:
+            walk(c)
+
+    walk(child)
 
 
 def _find_join(plan):
@@ -407,26 +538,46 @@ def _replace_node(plan, old, new):
 # ---------------------------------------------------------------------------
 
 class LocalCluster:
-    """N worker processes on this host, shuffling over TCP with a shared
-    HMAC token. The seam for multi-host: replace the process spawner with
-    per-host launchers and the loopback addresses with real ones — the
-    protocol is already remote-shaped and authenticated."""
+    """N worker processes shuffling over TCP with a shared HMAC token.
+
+    Multi-host (VERDICT r3 #9): pass ``bind_host`` (a non-loopback
+    address) and workers on OTHER hosts join via the standalone entry
+    point — no code shipping, only the typed-task protocol:
+
+        # on the driver host
+        cl = LocalCluster(n_workers=0, bind_host="10.0.0.1")
+        open("/shared/token", "wb").write(cl.token)
+        print(cl.control.address)               # e.g. ('10.0.0.1', 41234)
+        # on each worker host
+        python -m spark_rapids_tpu.shuffle.worker \
+            --driver 10.0.0.1:41234 --token-file /shared/token \
+            --id 0 --bind 10.0.0.2
+        # back on the driver
+        cl.wait_for_workers(2)
+        cl.execute(df)
+
+    Local workers (``n_workers`` > 0) spawn as processes on this host and
+    bind the same ``bind_host`` (ref Plugin.scala:428-439 heartbeat
+    discovery; the transport is the RapidsShuffleTransport analog)."""
 
     def __init__(self, n_workers: int = 2, start_timeout_s: float = 60.0,
-                 shuffle_join_min_rows: int = 100_000):
+                 shuffle_join_min_rows: int = 100_000,
+                 bind_host: str = "127.0.0.1"):
         import multiprocessing as mp
         self.token = secrets.token_bytes(32)
+        self.bind_host = bind_host
         self.manager = ShuffleHeartbeatManager()
         # the control server binds ITS OWN manager: two live clusters in
         # one driver process must not cross-register workers
-        self.control = BlockServer(token=self.token,
+        self.control = BlockServer(host=bind_host, token=self.token,
                                    tasks={"register": self.manager.register})
         self.shuffle_join_min_rows = shuffle_join_min_rows
         ctx = mp.get_context("spawn")
         self._ready = ctx.Queue()
         self.procs = [ctx.Process(target=_worker_main,
                                   args=(i, self.control.address,
-                                        self._ready, self.token),
+                                        self._ready, self.token,
+                                        bind_host),
                                   daemon=True)
                       for i in range(n_workers)]
         for p in self.procs:
@@ -444,6 +595,25 @@ class LocalCluster:
         for c in self.clients.values():
             c.task("heartbeat")
         self._next_shuffle = [0]
+
+    def wait_for_workers(self, n: int, timeout_s: float = 120.0) -> None:
+        """Block until ``n`` workers (incl. externally-launched ones) have
+        registered via heartbeat, then connect task clients to them."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            peers = {p["id"]: (p["addr"]["host"], p["addr"]["port"])
+                     for p in self.manager.peer_details()}
+            if len(peers) >= n:
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"{len(peers)}/{n} workers registered")
+            time.sleep(0.2)
+        self.workers = dict(sorted(peers.items()))
+        self.clients = {wid: BlockClient(addr, token=self.token)
+                        for wid, addr in self.workers.items()}
+        for c in self.clients.values():
+            c.task("heartbeat")
 
     def _shuffle_id(self, owned: List[int]) -> int:
         sid = self._next_shuffle[0]
@@ -468,7 +638,14 @@ class LocalCluster:
         plan = prune_columns(df.plan)
         path, agg = _find_agg(plan)
         if agg is None:
-            raise ValueError("plan has no distributable aggregate root")
+            wpath, win = _find_window(plan)
+            if win is not None:
+                return self._execute_window(df, plan, wpath, win)
+            spath, sort = _find_sort(plan)
+            if sort is not None:
+                return self._execute_sort(df, plan, spath, sort)
+            raise ValueError(
+                "plan has no distributable aggregate/sort/window root")
         dec = _decompose_aggs(agg.groupings, agg.aggs,
                               agg.children[0].schema())
         if dec is None:
@@ -489,6 +666,16 @@ class LocalCluster:
             and join.left_keys and join.right_keys
             and _subtree_rows(join.children[0]) >= self.shuffle_join_min_rows
             and _subtree_rows(join.children[1]) >= self.shuffle_join_min_rows)
+        if shuffled_join:
+            # the join itself is key-shuffled (exact for outer types);
+            # each SIDE is row-sliced and must be row-local on its own
+            _check_row_decomposable(agg.children[0], stop_at=join)
+            for side in join.children:
+                _check_row_decomposable(side,
+                                        sliced=_largest_scan(side))
+        else:
+            _check_row_decomposable(agg.children[0],
+                                    sliced=_largest_scan(agg.children[0]))
 
         owned_sids: List[int] = []     # THIS call's shuffles only
         try:
@@ -550,6 +737,210 @@ class LocalCluster:
         return physical.collect(session.exec_context())
 
     # -------------------------------------------------------------------
+    def _shuffle_scope(self):
+        """Task pool + shuffle-id ownership with guaranteed cleanup: the
+        one lifecycle every distributed round (agg/sort/window) shares."""
+        import concurrent.futures as cf
+        from contextlib import contextmanager
+
+        @contextmanager
+        def scope():
+            pool = cf.ThreadPoolExecutor(max_workers=2 * len(self.clients))
+            owned: List[int] = []
+            try:
+                yield pool, owned
+            finally:
+                # settle in-flight tasks BEFORE dropping, or a late map
+                # PUT would recreate blocks for a dropped shuffle id
+                pool.shutdown(wait=True)
+                for c in self.clients.values():
+                    for sid in owned:
+                        try:
+                            c.drop(sid)
+                        except Exception:
+                            continue
+        return scope()
+
+    def _driver_finish(self, df, results, out_schema, path):
+        """Concatenate worker results (in task order) and re-apply the
+        driver-finishable upper path."""
+        import pyarrow as pa
+        from ..api.dataframe import TpuSession
+        from ..plan import logical as L
+        from ..plan.overrides import plan_query
+        session = getattr(df, "session", None) or TpuSession()
+        merged = (pa.concat_tables(results) if results
+                  else _empty_like(out_schema))
+        final = L.LogicalScan([merged], out_schema)
+        for node in reversed(path):
+            clone = copy.copy(node)
+            clone.children = [final]
+            final = clone
+        physical = plan_query(final, session.conf)
+        return physical.collect(session.exec_context())
+
+    def _sliced_fragments(self, child):
+        """Slice the largest in-memory scan of a fragment row-wise across
+        workers; returns the per-worker fragment plans."""
+        from ..plan import logical as L
+        import pyarrow as pa
+        scans: List = []
+        _scan_sizes(child, scans)
+        if not scans:
+            raise ValueError("no in-memory scans to distribute")
+        fact = max(scans, key=lambda s: sum(t.num_rows for t in s.tables))
+        fact_table = pa.concat_tables(fact.tables) \
+            if len(fact.tables) > 1 else fact.tables[0]
+        n = len(self.clients)
+        per = -(-fact_table.num_rows // n)
+        plans = []
+        for wi in range(n):
+            slice_w = fact_table.slice(wi * per, per)
+            scan_w = L.LogicalScan([slice_w], fact._schema,
+                                   columns=fact.columns)
+            plans.append(_replace_node(child, fact, scan_w))
+        return plans, fact, fact_table
+
+    def _collect_local(self, worker_ids, pool, shuffle_id, proto):
+        """One reduce_agg-style task per worker over its owned partition;
+        results come back in worker (partition) order."""
+        from ..columnar.serializer import deserialize_table
+        futures = [pool.submit(self.clients[wid].task, "reduce_agg",
+                               shuffle_id=shuffle_id, parts=[wi],
+                               plan_bytes=pickle.dumps(proto))
+                   for wi, wid in enumerate(worker_ids)]
+        results = []
+        for f in futures:
+            got = f.result()
+            if got is not None:
+                results.append(deserialize_table(got))
+        return results
+
+    def _execute_sort(self, df, plan, path, sort):
+        """Distributed global sort (VERDICT r3 #6): sample the first sort
+        key for range boundaries, range-shuffle the fragment output, sort
+        each range locally, concatenate in range order (ref
+        GpuRangePartitioner + GpuSortExec over the shuffle manager,
+        RapidsShuffleInternalManagerBase.scala:238-614)."""
+        import copy as _copy
+        from ..plan import logical as L
+        child = sort.children[0]
+        _check_row_decomposable(child, sliced=_largest_scan(child))
+        order0 = sort.orders[0]
+        key_name = order0.expr.name_hint
+        if key_name not in child.schema().names():
+            raise ValueError("distributed sort keys must be child columns")
+        worker_ids = sorted(self.clients)
+        n = len(worker_ids)
+        plans, fact, fact_table = self._sliced_fragments(child)
+        boundaries = self._sample_boundaries(df, child, order0, n,
+                                             fact=fact,
+                                             fact_table=fact_table)
+        with self._shuffle_scope() as (pool, owned_sids):
+            sid = self._shuffle_id(owned_sids)
+            key_bytes = pickle.dumps((key_name, order0.ascending,
+                                      order0.nulls_first))
+            boundaries_bytes = pickle.dumps(boundaries)
+            futures = [pool.submit(
+                self.clients[wid].task, "map_range", shuffle_id=sid,
+                plan_bytes=pickle.dumps(p), key_bytes=key_bytes,
+                boundaries_bytes=boundaries_bytes, owners=worker_ids)
+                for wid, p in zip(worker_ids, plans)]
+            for f in futures:
+                f.result()
+            proto = _copy.copy(sort)
+            proto.children = [L.RangeRel(0, 1)]
+            # partition w holds range w: descending orders put the
+            # LARGEST range in partition 0, so worker order IS sort order
+            results = self._collect_local(worker_ids, pool, sid, proto)
+        return self._driver_finish(df, results, sort.schema(), path)
+
+    def _sample_boundaries(self, df, child, order0, n_parts: int,
+                           sample_rows: int = 20000, fact=None,
+                           fact_table=None):
+        """Range boundaries from a driver-local sample of the fragment
+        output (the RangePartitioner sampling pass, run through the same
+        fragment plan the workers will run). ``fact``/``fact_table`` come
+        from the caller's _sliced_fragments pass — re-concatenating a
+        multi-chunk fact table here would double the driver copy cost."""
+        import numpy as np
+        import pyarrow as pa
+        from ..api.dataframe import TpuSession
+        from ..columnar import ColumnarBatch
+        from ..exprs.arithmetic import arrow_to_masked_numpy
+        from ..exprs.base import ColumnRef
+        from ..plan import logical as L
+        from ..plan.overrides import plan_query
+        if fact is None or fact_table is None:
+            scans: List = []
+            _scan_sizes(child, scans)
+            fact = max(scans,
+                       key=lambda s: sum(t.num_rows for t in s.tables))
+            fact_table = pa.concat_tables(fact.tables) \
+                if len(fact.tables) > 1 else fact.tables[0]
+        total = fact_table.num_rows
+        if total > sample_rows:
+            rng = np.random.RandomState(77)
+            idx = np.sort(rng.choice(total, sample_rows, replace=False))
+            sample = fact_table.take(pa.array(idx))
+        else:
+            sample = fact_table
+        scan_s = L.LogicalScan([sample], fact._schema, columns=fact.columns)
+        plan_s = _replace_node(child, fact, scan_s)
+        session = getattr(df, "session", None) or TpuSession()
+        out = plan_query(plan_s, session.conf).collect(
+            session.exec_context())
+        batch = ColumnarBatch.from_arrow_host(out)
+        arr = ColumnRef(order0.expr.name_hint).eval_host(batch)
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
+        v, ok = arrow_to_masked_numpy(arr)
+        v = np.asarray(v)[np.asarray(ok, bool)]
+        if not len(v):
+            return []
+        v = np.sort(v)          # ASC always; routing handles direction
+        cuts = [v[int(len(v) * i / n_parts)] for i in range(1, n_parts)]
+        return list(cuts)
+
+    def _execute_window(self, df, plan, path, win):
+        """Distributed windows (VERDICT r3 #6): hash-shuffle the fragment
+        output by the window partition keys (co-locating every window
+        partition on one worker), run the full Window node per worker,
+        concatenate (ref hash-partitioned GpuWindowExec over the shuffle
+        manager)."""
+        import copy as _copy
+        from ..exprs.base import ColumnRef
+        from ..plan import logical as L
+        specs = [spec for _e, spec, _n in win.window_exprs]
+        keysets = {tuple(e.name_hint for e in s.partition_by)
+                   for s in specs}
+        if len(keysets) != 1 or not next(iter(keysets)):
+            raise ValueError("distributed windows need one shared, "
+                             "non-empty partition_by")
+        keys = [ColumnRef(k) for k in next(iter(keysets))]
+        child = win.children[0]
+        _check_row_decomposable(child, sliced=_largest_scan(child))
+        cnames = child.schema().names()
+        if any(k.name not in cnames for k in keys):
+            raise ValueError("window partition keys must be child columns")
+        worker_ids = sorted(self.clients)
+        n = len(worker_ids)
+        plans, _fact, _ft = self._sliced_fragments(child)
+        with self._shuffle_scope() as (pool, owned_sids):
+            sid = self._shuffle_id(owned_sids)
+            group_bytes = pickle.dumps(keys)
+            futures = [pool.submit(
+                self.clients[wid].task, "map_agg", shuffle_id=sid,
+                plan_bytes=pickle.dumps(p), group_bytes=group_bytes,
+                owners=worker_ids)
+                for wid, p in zip(worker_ids, plans)]
+            for f in futures:
+                f.result()
+            proto = _copy.copy(win)
+            proto.children = [L.RangeRel(0, 1)]
+            results = self._collect_local(worker_ids, pool, sid, proto)
+        return self._driver_finish(df, results, win.schema(), path)
+
     def _exec_sliced_map(self, pool, worker_ids, agg, map_aggs,
                          group_bytes, owned_sids: List[int]) -> int:
         """Original single-exchange path: the fact scan sliced row-wise,

@@ -49,6 +49,13 @@ class ShuffleHeartbeatManager:
             self._evict(time.monotonic())
             return sorted(self._peers)
 
+    def peer_details(self) -> List[dict]:
+        """Live peers with their addresses (driver-side attach of
+        externally-launched multi-host workers)."""
+        with self._lock:
+            self._evict(time.monotonic())
+            return [dict(p) for _, p in sorted(self._peers.items())]
+
 
 class ShuffleHeartbeatEndpoint:
     """Executor-side: periodic heartbeats; invokes on_new_peer for peers it

@@ -11,6 +11,12 @@ os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 # optimizer enable it explicitly via session conf (raw conf beats env).
 os.environ.setdefault("SPARK_RAPIDS_TPU_SQL_OPTIMIZER_ENABLED", "false")
 
+# Keep the on-disk adaptive-stats store out of tests: persisted measured
+# walls/rows from earlier runs would make planning depend on history and
+# tests non-deterministic. Tests that exercise persistence point
+# SRTPU_STATS_PATH at a tmp file and re-enable this explicitly.
+os.environ.setdefault("SRTPU_STATS_PERSIST", "0")
+
 import jax
 
 # The axon TPU plugin force-sets jax_platforms="axon,cpu" at register time
@@ -28,3 +34,17 @@ def _clear_oom_injections():
     from spark_rapids_tpu.mem import MemoryManager
     for mm in MemoryManager._instances.values():
         mm.clear_injections()
+
+
+@pytest.fixture(autouse=True)
+def _assert_no_leaked_spillables():
+    """Suite-wide zero-leak check (ref cudf MemoryCleaner at shutdown,
+    Plugin.scala:573-588): every SpillableBatch must be closed by the
+    time its query's sink finishes — a live registration after a test is
+    a leak in an exec's cleanup path."""
+    yield
+    from spark_rapids_tpu.mem import MemoryManager
+    leaks = MemoryManager.audit_all_leaks()
+    assert not leaks, (
+        f"{len(leaks)} leaked device buffer registration(s): {leaks[:5]} "
+        f"(run with SRTPU_LEAK_DEBUG=1 for creation sites)")

@@ -264,3 +264,58 @@ def test_agg_multibatch_string_keys_high_cardinality_sort_path():
         return df.group_by("k").agg(F.sum(F.col("v")).with_name("s"),
                                     F.count_star().with_name("n"))
     assert_tpu_and_cpu_equal(q)
+
+
+def test_agg_tree_merge_bounded_fanin():
+    """Force the bounded-fan-in tree merge (r4): partials at the 1024
+    bucket with batchSizeRows=2048 make every level chunk at fan-in 2;
+    results must match the host oracle exactly."""
+    def q(s):
+        df = s.create_dataframe(gen_df(
+            {"k": IntGen(lo=0, hi=400, nullable=False),
+             "v": IntGen(), "w": DoubleGen(with_special=False)}, n=24000),
+            num_partitions=6)
+        return df.group_by("k").agg(
+            F.sum(F.col("v")).with_name("s"),
+            F.count_star().with_name("n"),
+            F.min(F.col("w")).with_name("mn"),
+            F.max(F.col("w")).with_name("mx"))
+    assert_tpu_and_cpu_equal(
+        q, approximate_float=True,
+        conf={"spark.rapids.tpu.sql.batchSizeRows": 2048,
+              # keep the byte-trigger repartition path out of the way
+              "spark.rapids.tpu.sql.batchSizeBytes": 1 << 30})
+
+
+def test_agg_multibatch_first_last_order_dependent():
+    """First/Last through the multi-batch SPLIT kernel: the original-row-
+    index payload must ride the sort (needs_rank) and per-batch firsts
+    must merge by position correctly."""
+    def q(s):
+        df = s.create_dataframe(gen_df(
+            {"k": IntGen(lo=0, hi=6, nullable=False),
+             "v": IntGen(nullable=False)}, n=9000), num_partitions=3)
+        # per-group deterministic target: first/last of a value equal to
+        # the row's position makes order bugs visible
+        return df.group_by("k").agg(F.first(F.col("v")).with_name("f"),
+                                    F.last(F.col("v")).with_name("l"),
+                                    F.count_star().with_name("n"))
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_agg_multibatch_decimal_key_payload_fallback():
+    """Decimal group keys don't fit the reconstruct-from-operands fast
+    path — the split kernel must fall back to carrying key payloads."""
+    import pyarrow as pa
+    from harness import assert_tpu_and_cpu_equal as chk
+    import decimal
+    rows = [decimal.Decimal(f"{i % 5}.25") for i in range(6000)]
+    vals = list(range(6000))
+    t = pa.table({"d": pa.array(rows, type=pa.decimal128(9, 2)),
+                  "v": pa.array(vals, type=pa.int64())})
+
+    def q(s):
+        return (s.create_dataframe(t, num_partitions=3)
+                .group_by("d").agg(F.sum(F.col("v")).with_name("s"),
+                                   F.count_star().with_name("n")))
+    chk(q)

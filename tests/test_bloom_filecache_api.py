@@ -127,6 +127,33 @@ def test_to_device_columns_export():
     assert total == exp
 
 
+
+
+def test_to_device_columns_jit_consumer_roundtrip():
+    """VERDICT r3 #8 'done' criterion: exported HBM batches feed a jit'd
+    ML-style consumer directly (ref ColumnarRdd -> XGBoost handoff) and
+    the masked reduction matches the host engine exactly — zero host
+    round trip between query sink and consumer."""
+    import jax
+    import jax.numpy as jnp
+    s = tpu_session()
+    df = s.create_dataframe(gen_df(
+        {"a": IntGen(nullable=False), "b": IntGen(nullable=True)},
+        n=4096)).filter(F.col("a") % 3 == 0)
+
+    @jax.jit
+    def consume(data, valid):
+        # padding + NULL rows are masked by validity, the export contract
+        return jnp.sum(jnp.where(valid, data, 0))
+
+    total = 0
+    for b in df.to_device_columns():
+        d, v = b["columns"]["b"]
+        total += int(consume(d, v))
+    host = df.to_pandas()
+    assert total == int(host["b"].dropna().sum())
+
+
 # ---------------------------------------------------------------------------
 # api_validation (ref api_validation/ApiValidation.scala: reflection audit)
 # ---------------------------------------------------------------------------

@@ -642,3 +642,29 @@ def test_hash_encoded_keys_with_nulls():
     _assert_plan_distributed(q)
     got = {r["g"]: r["n"] for r in q.collect()}
     assert got == {"a": 4000, "b": 2000, None: 4000}, got
+
+
+def test_planned_distributed_first_last_positions_global():
+    """r4 regression: First/Last through the SPMD fragment carried
+    within-SHARD positions, so the post-exchange merge returned another
+    shard's first for ~88% of groups. Positions must be globalized by
+    shard index before the exchange."""
+    mesh = _mesh()
+    import numpy as np
+    rng = np.random.RandomState(11)
+    n = 32768
+    t = pa.table({"k": pa.array(rng.randint(0, 500, n)),
+                  "v": pa.array(rng.uniform(-5, 5, n))})
+    s = tpu_session({"spark.rapids.tpu.distributed.enabled": True,
+                     "spark.rapids.tpu.sql.optimizer.enabled": False},
+                    mesh=mesh)
+    q = (s.create_dataframe(t).group_by("k")
+         .agg(F.first(F.col("v")).with_name("f"),
+              F.last(F.col("v")).with_name("l")))
+    assert "DistributedPipeline" in q.explain()
+    got = q.to_pandas().sort_values("k").reset_index(drop=True)
+    pdf = t.to_pandas()
+    want = (pdf.groupby("k")["v"].agg(["first", "last"])
+            .reset_index())
+    np.testing.assert_allclose(got["f"], want["first"], rtol=1e-12)
+    np.testing.assert_allclose(got["l"], want["last"], rtol=1e-12)

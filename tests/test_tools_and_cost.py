@@ -189,3 +189,45 @@ def test_scale_test_harness():
     assert rep["queries"]["q6"]["verified"]
     assert rep["queries"]["q1"]["output_rows"] > 0
     assert rep["queries"]["q1"]["placement"] in ("host", "device")
+
+
+# ---------------------------------------------------------------------------
+# Adaptive-stats persistence (r4: VERDICT #7 — measured walls/rows survive
+# process exit so a cold process plans a seen shape correctly first try)
+# ---------------------------------------------------------------------------
+
+def test_stats_store_roundtrip(tmp_path, monkeypatch):
+    import importlib
+    monkeypatch.setenv("SRTPU_STATS_PATH", str(tmp_path / "stats.json"))
+    monkeypatch.setenv("SRTPU_STATS_PERSIST", "1")
+    from spark_rapids_tpu.plan import cost, stats_store
+    importlib.reload(stats_store)
+    cost.record_engine_wall("Agg[x](Scan[#abc#])", "device", 1.25)
+    cost.record_engine_wall("Agg[x](Scan[#abc#])", "device", 0.75)
+    cost.record_engine_wall("Agg[x](Scan[#123456#])", "host", 0.5)  # local
+    cost.record_runtime_rows("Filter[c](Scan[#abc#])", 42)
+    stats_store.mark_dirty()
+    stats_store.save()
+    walls, rows = {}, {}
+    stats_store._loaded = False
+    stats_store.load_into(walls, rows)
+    assert walls[("Agg[x](Scan[#abc#])", "device")] == (2, 0.75)
+    # process-local "#<id>#" signatures must never persist
+    assert ("Agg[x](Scan[#123456#])", "host") not in walls
+    assert rows["Filter[c](Scan[#abc#])"] == 42
+    # live entries win over persisted ones on merge
+    walls2 = {("Agg[x](Scan[#abc#])", "device"): (5, 0.1)}
+    stats_store._loaded = False
+    stats_store.load_into(walls2, {})
+    assert walls2[("Agg[x](Scan[#abc#])", "device")] == (5, 0.1)
+
+
+def test_content_fingerprint_stable_and_distinct():
+    import pyarrow as pa
+    from spark_rapids_tpu.plan.cost import _pin_table
+    t1 = pa.table({"a": [1, 2, 3], "b": ["x", "y", "z"]})
+    t2 = pa.table({"a": [1, 2, 3], "b": ["x", "y", "z"]})
+    t3 = pa.table({"a": [1, 2, 4], "b": ["x", "y", "z"]})
+    assert _pin_table(t1) == _pin_table(t1)          # memo stable
+    assert _pin_table(t1) == _pin_table(t2)          # content-addressed
+    assert _pin_table(t1) != _pin_table(t3)          # data-sensitive

@@ -106,3 +106,42 @@ def test_encoded_batch_feeds_device_kernels():
             F.sum(F.col("v")).with_name("s"),
             F.count_star().with_name("c"))
     assert_tpu_and_cpu_equal(q, approximate_float=True)
+
+
+def test_f64_passthrough_serves_exact_source_bits():
+    """r4 regression (TPC-H q6 wrong by 28%): the backend's emulated f64
+    carries ~48 mantissa bits, so ANY materialization of an untouched
+    ingested column must serve the host-mirror source bits — both at the
+    batch level and through column-level to_arrow (the host engine's
+    ColumnRef.eval_host path, where `discount >= 0.05` silently dropped
+    every boundary row)."""
+    import pyarrow as pa
+    from spark_rapids_tpu.columnar import ColumnarBatch
+    from spark_rapids_tpu.exprs.base import ColumnRef
+    vals = [0.05000000000000000277, 0.25, 1.0 / 3.0, 1e-300, None]
+    t = pa.table({"d": pa.array(vals, type=pa.float64())})
+    b = ColumnarBatch.from_arrow(t)
+    got_col = ColumnRef("d").eval_host(b)
+    got_batch = b.to_arrow().column("d")
+    for got in (got_col, got_batch):
+        for g, w in zip(got, t.column("d")):
+            assert g.as_py() == w.as_py(), (g, w)
+            if g.as_py() is not None:
+                assert g.as_py().hex() == w.as_py().hex()
+
+
+def test_f64_host_engine_boundary_comparison_exact():
+    """End-to-end: host-engine filter on an exact decimal boundary keeps
+    boundary rows (differential vs pandas)."""
+    import numpy as np
+    import pyarrow as pa
+    from harness import cpu_session, tpu_session
+    from spark_rapids_tpu.api import functions as F
+    rng = np.random.RandomState(3)
+    d = np.round(rng.randint(0, 11, 20000) * 0.01, 2)
+    t = pa.table({"d": pa.array(d), "v": pa.array(rng.rand(20000))})
+    want = int((d >= 0.05).sum())
+    for s in (tpu_session(), cpu_session()):
+        got = s.create_dataframe(t).filter(
+            F.col("d") >= F.lit(0.05)).count()
+        assert got == want, (got, want)
