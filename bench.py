@@ -201,6 +201,7 @@ def main():
     item = tpcds.gen_item()
     wtab = gen_window_table(nw)
     stab = gen_string_table(n)
+    stab_hc = gen_string_table(n, card=100_000)   # byte-rectangle regime
     # big tables generate LAZILY right before their rung: eager generation
     # would burn minutes of budget (and >1 GB resident) even when the
     # budget ends up skipping every big rung
@@ -255,15 +256,18 @@ def main():
                                     frame=("rows", -2, 0)))
     eng_window = eng(_window_q)
 
-    def _strings_q(s):
-        return (s.create_dataframe(stab)
-                .select(F.upper(F.trim(F.col("s"))).alias("u"),
-                        F.substring(F.col("s"), 3, 4).alias("pre"),
-                        F.col("v"))
-                .group_by("u", "pre")
-                .agg(F.sum(F.col("v")).with_name("sv"),
-                     F.count_star().with_name("n")))
-    eng_strings = eng(_strings_q)
+    def _strings_q_of(table):
+        def q(s):
+            return (s.create_dataframe(table)
+                    .select(F.upper(F.trim(F.col("s"))).alias("u"),
+                            F.substring(F.col("s"), 3, 4).alias("pre"),
+                            F.col("v"))
+                    .group_by("u", "pre")
+                    .agg(F.sum(F.col("v")).with_name("sv"),
+                         F.count_star().with_name("n")))
+        return q
+    eng_strings = eng(_strings_q_of(stab))
+    eng_strings_hc = eng(_strings_q_of(stab_hc))
 
     # ---------------- pandas baselines ----------------
     def base_q1_of(tab):
@@ -342,12 +346,16 @@ def main():
             return rows
         return run
 
-    def base_strings():
-        pdf = stab.to_pandas()
-        pdf["u"] = pdf["s"].str.strip().str.upper()
-        pdf["pre"] = pdf["s"].str.slice(2, 6)
-        return (pdf.groupby(["u", "pre"], as_index=False)
-                .agg(sv=("v", "sum"), n=("v", "size")))
+    def base_strings_of(table):
+        def run():
+            pdf = table.to_pandas()
+            pdf["u"] = pdf["s"].str.strip().str.upper()
+            pdf["pre"] = pdf["s"].str.slice(2, 6)
+            return (pdf.groupby(["u", "pre"], as_index=False)
+                    .agg(sv=("v", "sum"), n=("v", "size")))
+        return run
+    base_strings = base_strings_of(stab)
+    base_strings_hc = base_strings_of(stab_hc)
 
     def base_window():
         pdf = wtab.to_pandas()
@@ -367,6 +375,8 @@ def main():
         ("tpcds_q28", n, q28_of(ss_), base_q28_of(ss_), check_q28),
         ("window_bounded", nw, eng_window, base_window, check_window),
         ("string_transforms", n, eng_strings, base_strings, check_strings),
+        ("string_transforms_100k", n, eng_strings_hc, base_strings_hc,
+         check_strings),
     ]
     if nbig:
         workloads += [

@@ -32,9 +32,12 @@ class SpeculativeOverflow(Exception):
 
 #: dictionary-encode string columns into device codes when the cardinality
 #: is below this fraction of rows (and the absolute cap). Flip to 0 to
-#: force host strings (tests use this to cover both paths).
+#: force host strings (tests use this to cover both paths). Above the cap
+#: the BYTE-RECTANGLE layout takes over (strrect.py): per-distinct-value
+#: dictionary work loses to per-row vectorized rectangles once the
+#: dictionary stops being small relative to the rows.
 DICT_ENCODE_MAX_FRACTION = 0.5
-DICT_ENCODE_MAX_CARD = 1 << 20
+DICT_ENCODE_MAX_CARD = 1 << 16
 
 
 def _decimal_unscaled_int64(arr, valid: np.ndarray) -> np.ndarray:
@@ -198,8 +201,8 @@ class ColumnarBatch:
     # -- conversions -------------------------------------------------------
     @staticmethod
     def from_arrow(table, buckets: Sequence[int] = DEFAULT_BUCKETS,
-                   pad: bool = True,
-                   encode_lists: bool = True) -> "ColumnarBatch":
+                   pad: bool = True, encode_lists: bool = True,
+                   rect_cap: Optional[int] = None) -> "ColumnarBatch":
         """Arrow table -> batch; device-backed types are H2D'd padded to the
         row bucket (ref HostColumnarToGpu / GpuRowToColumnarExec device copy)."""
         import jax
@@ -212,6 +215,7 @@ class ColumnarBatch:
         staged = []    # (col index, dtype) for one batched H2D at the end
         host_pairs = []
         list_staged = []   # (col index, dtype, rectangle arrays, mirror)
+        rect_staged = []   # (col index, (rect, lens, valid, ascii), mirror)
         for name, col in zip(table.column_names, table.columns):
             if isinstance(col, pa.ChunkedArray):
                 col = col.combine_chunks() if col.num_chunks != 1 else col.chunk(0)
@@ -268,8 +272,29 @@ class ColumnarBatch:
                     staged.append((len(cols), dt, dictionary, mirror))
                     host_pairs.extend([d, v])
                     cols.append(None)
-                else:
-                    cols.append(HostColumn(col, dt))
+                    continue
+                if dt == STRING and pad:
+                    # high cardinality: the byte-rectangle device layout
+                    # (VERDICT r3 #4) — transforms/grouping stay in HBM.
+                    # Callers with a session conf pass rect_cap (the scan
+                    # exec does); the registered default covers the rest.
+                    from .strrect import RECT_MAX_BYTES, encode_string_rect
+                    cap = rect_cap
+                    if cap is None:
+                        from ..config import TpuConf as _TC
+                        cap = int(_TC().get(RECT_MAX_BYTES))
+                    renc = encode_string_rect(col, n, p, cap)
+                    if renc is not None:
+                        rectd, lens, rv, asc = renc
+                        from ..types import to_arrow as _toa
+                        mirror = (col if col.type == _toa(dt)
+                                  else col.cast(_toa(dt)))
+                        rect_staged.append((len(cols),
+                                            (rectd, lens, rv, asc),
+                                            mirror))
+                        cols.append(None)
+                        continue
+                cols.append(HostColumn(col, dt))
         if staged:
             # ONE device_put for the whole table: each separate transfer
             # pays a full round trip on a tunneled TPU backend. Above the
@@ -314,6 +339,17 @@ class ColumnarBatch:
                 cols[i] = ListColumn(put[4 * k], put[4 * k + 3], dt,
                                      put[4 * k + 1], put[4 * k + 2],
                                      host_mirror=mirror)
+        if rect_staged:
+            from .strrect import ByteRectColumn
+            flat = []
+            for _i, (rectd, lens, rv, _a), _m in rect_staged:
+                flat.extend((rectd, lens, rv))
+            put = jax.device_put(flat)   # one transfer for all rectangles
+            for k, (i, enc, mirror) in enumerate(rect_staged):
+                cols[i] = ByteRectColumn(put[3 * k], put[3 * k + 2],
+                                         put[3 * k + 1],
+                                         ascii_only=enc[3],
+                                         host_mirror=mirror)
         return ColumnarBatch(cols, n, Schema(fields))
 
     @staticmethod
@@ -362,9 +398,10 @@ class ColumnarBatch:
         # ONE packed transfer for every device column (leaf-by-leaf waits
         # pay per-transfer latency on a tunneled TPU)
         from .nested import ListColumn
+        from .strrect import ByteRectColumn
         dev = [(i, c) for i, c in enumerate(self.columns)
                if isinstance(c, DeviceColumn)
-               and not isinstance(c, ListColumn)
+               and not isinstance(c, (ListColumn, ByteRectColumn))
                and getattr(c, "host_mirror", None) is None]
         mirror_pos = {i for i, c in enumerate(self.columns)
                       if isinstance(c, DeviceColumn)
@@ -430,21 +467,24 @@ class ColumnarBatch:
         return out
 
     def with_lists_on_host(self) -> "ColumnarBatch":
-        """Demote device list columns (rectangles) to HostColumns.
+        """Demote 2-D device layouts (list rectangles AND string byte
+        rectangles) to HostColumns.
 
         Row-rearranging execs that own their kernels (joins, sorts, aggs,
-        windows, partitioning) move 1D (data, validity) pairs; list
+        windows, partitioning) move 1D (data, validity) pairs; rectangle
         payloads crossing them materialize host-side first — project/
-        filter pipelines keep lists on device via the lane decomposition
-        (exprs/compiler._lane_pairs). Honest fallback, mirrored in
-        supported_ops docs."""
+        filter pipelines keep rectangles on device via the lane
+        decomposition (exprs/compiler._lane_pairs). Honest fallback,
+        mirrored in supported_ops docs."""
         from .nested import ListColumn
-        if not any(isinstance(c, ListColumn) for c in self.columns):
+        from .strrect import ByteRectColumn
+        rect_types = (ListColumn, ByteRectColumn)
+        if not any(isinstance(c, rect_types) for c in self.columns):
             return self
         n = self.num_rows
 
         def demote(c):
-            if not isinstance(c, ListColumn):
+            if not isinstance(c, rect_types):
                 return c
             if c.host_mirror is not None:   # fresh ingest: zero-cost slice
                 return HostColumn(c.host_mirror.slice(0, n), c.dtype)
@@ -499,21 +539,58 @@ def concat_batches_device(batches: Sequence[ColumnarBatch],
     callers fall back to the host-staged concat_batches."""
     import jax
     import jax.numpy as jnp
+    from .strrect import ByteRectColumn
     counts = []
     for b in batches:
         if not isinstance(b.num_rows_raw, int):
             return None
         counts.append(b.num_rows_raw)
         for c in b.columns:
-            if type(c) is not DeviceColumn:
+            if type(c) is not DeviceColumn \
+                    and type(c) is not ByteRectColumn:
                 return None
     schema = batches[0].schema
     for b in batches[1:]:
         if [f.dtype for f in b.schema.fields] != \
                 [f.dtype for f in schema.fields]:
             return None
-    cols = [[(b.columns[ci].data, b.columns[ci].validity)
-             for b in batches] for ci in range(len(schema))]
+    # decompose into 1-D lanes: byte-rectangle strings ride as packed
+    # word + length lanes (width-normalized across batches) so both
+    # concat paths below stay 1-D-only
+    lane_cols = []     # per LANE: [per-batch (d, v)]
+    rebuilds = []      # (n_lanes, rebuild fn from lane (d, v) list)
+    for ci, f in enumerate(schema.fields):
+        per_batch = [b.columns[ci] for b in batches]
+        if any(isinstance(c, ByteRectColumn) for c in per_batch):
+            if not all(type(c) is ByteRectColumn for c in per_batch):
+                return None      # mixed rect/dict (spill round trip):
+                                 # host-staged concat handles it
+            max_w = max(c.width for c in per_batch)
+            normed = []
+            for c in per_batch:
+                if c.width < max_w:
+                    c = ByteRectColumn(
+                        jnp.pad(c.data, ((0, 0), (0, max_w - c.width))),
+                        c.validity, c.lengths, ascii_only=c.ascii_only)
+                normed.append(c)
+            lane_lists = [c.kernel_lanes() for c in normed]
+            n_lanes = len(lane_lists[0])
+            for li in range(n_lanes):
+                lane_cols.append([ll[li] for ll in lane_lists])
+            template = normed[0]
+            asc = all(c.ascii_only for c in per_batch)
+
+            def rebuild(outs, template=template, asc=asc):
+                col = template.from_lanes(outs)
+                col.ascii_only = asc
+                return col
+            rebuilds.append((n_lanes, rebuild))
+        else:
+            lane_cols.append([(c.data, c.validity) for c in per_batch])
+
+            def rebuild(outs, dt=f.dtype):
+                return DeviceColumn(outs[0][0], outs[0][1], dt)
+            rebuilds.append((1, rebuild))
     total = sum(counts)
     if all(c == b.padded_len for c, b in
            zip(counts[:-1], batches[:-1])):
@@ -521,16 +598,17 @@ def concat_batches_device(batches: Sequence[ColumnarBatch],
         # prefix-packed — no compaction permutation needed (the common
         # scan-fed case: N full bucket batches + one partial tail)
         outs = [(jnp.concatenate([d for d, _ in per]),
-                 jnp.concatenate([v for _, v in per])) for per in cols]
+                 jnp.concatenate([v for _, v in per]))
+                for per in lane_cols]
     else:
         global _DEVICE_CONCAT_JIT
         if _DEVICE_CONCAT_JIT is None:
             _DEVICE_CONCAT_JIT = jax.jit(_device_concat_compact)
         outs = _DEVICE_CONCAT_JIT(
-            jnp.asarray(np.asarray(counts, np.int32)), cols)
+            jnp.asarray(np.asarray(counts, np.int32)), lane_cols)
     target = bucket_for(total, buckets)
-    out_cols = []
-    for (d, v), f in zip(outs, schema.fields):
+    sized = []
+    for d, v in outs:
         if target < d.shape[0]:
             d, v = d[:target], v[:target]
         elif target > d.shape[0]:
@@ -541,7 +619,12 @@ def concat_batches_device(batches: Sequence[ColumnarBatch],
             pad = target - d.shape[0]
             d = jnp.pad(d, (0, pad))
             v = jnp.pad(v, (0, pad))
-        out_cols.append(DeviceColumn(d, v, f.dtype))
+        sized.append((d, v))
+    out_cols = []
+    pos = 0
+    for n_lanes, rebuild in rebuilds:
+        out_cols.append(rebuild(sized[pos:pos + n_lanes]))
+        pos += n_lanes
     return ColumnarBatch(out_cols, total, schema)
 
 

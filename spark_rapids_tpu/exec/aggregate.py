@@ -33,7 +33,7 @@ from ..exprs.base import (BoundReference, DVal, EvalContext, Expression,
                           collect_param_literals, literal_scalars,
                           literal_slot_map, parameterized_keys)
 from ..mem import SpillableBatch, with_retry_no_split
-from ..types import Schema, StructField
+from ..types import STRING, Schema, StructField
 from .base import ESSENTIAL, ExecContext, TpuExec
 from .groupby_core import segmented_groupby
 
@@ -90,9 +90,12 @@ def _build_groupby_kernel(key_exprs: Sequence[Expression],
     def prep(cols, num_rows, padded_len, scalars):
         """Shared traced prologue: pre-stages + key/value evaluation."""
         keep = None
+        from ..exprs.base import StrVal
         if base_schema is not None:
             n_base = len(base_dtypes)
-            base = [None if c is None else DVal(c[0], c[1], dt)
+            base = [None if c is None
+                    else (DVal(StrVal(c[0], c[2]), c[1], dt)
+                          if len(c) == 3 else DVal(c[0], c[1], dt))
                     for c, dt in zip(cols[:n_base], base_dtypes)]
             codes = [DVal(c[0], c[1], INT32) for c in cols[n_base:]]
             sctx, keep = _apply_pre_stages(stages, base_schema, base,
@@ -104,7 +107,9 @@ def _build_groupby_kernel(key_exprs: Sequence[Expression],
             ctx = EvalContext(schema, dvals, num_rows, padded_len,
                               scalars, slots)
         else:
-            dvals = [None if c is None else DVal(c[0], c[1], dt)
+            dvals = [None if c is None
+                     else (DVal(StrVal(c[0], c[2]), c[1], dt)
+                           if len(c) == 3 else DVal(c[0], c[1], dt))
                      for c, dt in zip(cols, dtypes)]
             ctx = EvalContext(schema, dvals, num_rows, padded_len,
                               scalars, slots)
@@ -161,17 +166,21 @@ def _build_groupby_kernel_split(key_exprs, aggs, schema, mode,
     # original-row-index payload rides only when an order-dependent
     # aggregate (First/Last) needs it.
     from ..exprs.aggregates import First, Last
+    from ..exprs.base import StrVal
 
-    def _key_op_shapes(dt):
+    def _reconstructible(dt):
+        if dt == STRING:
+            return True          # rect: words + length operands suffice
+        if dt.np_dtype is None:
+            return False         # decimal etc.: carried as payload lanes
         import numpy as _np
-        return jax.eval_shape(
+        shapes = jax.eval_shape(
             lambda d, v: tuple(grouping_operands(DVal(d, v, dt))),
             jax.ShapeDtypeStruct((1,), dt.np_dtype),
             jax.ShapeDtypeStruct((1,), _np.bool_))
+        return len(shapes) == 2
 
-    reconstruct_keys = all(
-        dt.np_dtype is not None and len(_key_op_shapes(dt)) == 2
-        for dt in key_dtypes)
+    recon = [_reconstructible(dt) for dt in key_dtypes]
     needs_rank = any(isinstance(a, (First, Last)) for a in aggs)
 
     @functools.partial(jax.jit, static_argnums=(2,))
@@ -179,75 +188,89 @@ def _build_groupby_kernel_split(key_exprs, aggs, schema, mode,
         """Prologue + key encoding ONLY — no sort. A lax.sort's compile
         time multiplies with everything else in its module (a fused
         filter/CASE prologue pushed the q28 update sort past 15 minutes),
-        so the sort gets a module to itself with raw operands."""
+        so the sort gets a module to itself with raw operands. Key ops
+        come back as a NESTED per-key tuple (arities vary: scalar keys
+        two operands, byte-rectangle strings 2 + W/8)."""
         keys, vals, keep = prep(cols, num_rows, padded_len, scalars)
         if keep is None:
             keep = jnp.arange(padded_len, dtype=jnp.int32) < num_rows
         pad_flag = jnp.where(keep, jnp.uint8(0), jnp.uint8(1))
-        operands = [pad_flag]
-        for k in keys:
-            operands.extend(grouping_operands(k))
+        key_ops = tuple(tuple(grouping_operands(k)) for k in keys)
         payload = []
         if needs_rank:
             payload.append(jnp.arange(padded_len, dtype=jnp.int32))
-        if not reconstruct_keys:
-            for k in keys:
+        for k, r in zip(keys, recon):
+            if not r:
                 payload.extend((k.data, k.validity))
         for vs in vals:
             for v in vs:
                 payload.extend((v.data, v.validity))
         live = jnp.sum(keep).astype(jnp.int32)
-        return tuple(operands + payload), live
+        return (pad_flag, key_ops, tuple(payload)), live
 
-    n_key_ops = 1 + 2 * len(key_exprs)   # pad_flag + (rank, key) per key
+    _sort_jits = {}
 
-    @jax.jit
-    def k_sort(flat):
+    def k_sort(flat, nk):
         """The bare variadic sort — nothing else in the module."""
-        return jax.lax.sort(tuple(flat), num_keys=n_key_ops,
-                            is_stable=True)
+        fn = _sort_jits.get(nk)
+        if fn is None:
+            def mk(flat, nk=nk):
+                return jax.lax.sort(tuple(flat), num_keys=nk,
+                                    is_stable=True)
+            fn = _sort_jits[nk] = jax.jit(mk)
+        return fn(flat)
 
-    @functools.partial(jax.jit, static_argnums=(1,))
-    def k_scan(flat, padded_len, live):
+    @functools.partial(jax.jit, static_argnums=(1, 2))
+    def k_scan(flat, arities, padded_len, live):
         it = iter(flat)
-        s_ops = [next(it) for _ in range(n_key_ops)]
+        s_ops = [next(it) for _ in range(1 + sum(arities))]
         perm = next(it) if needs_rank else None
-        if reconstruct_keys:
-            s_keys = []
-            for i, dt in enumerate(key_dtypes):
-                rank = s_ops[1 + 2 * i]
-                keyop = s_ops[2 + 2 * i]
+        s_keys = []
+        pos = 1
+        for ar, dt, r in zip(arities, key_dtypes, recon):
+            ops = s_ops[pos:pos + ar]
+            pos += ar
+            if not r:
+                s_keys.append(DVal(next(it), next(it), dt))
+            elif dt == STRING:
+                from ..columnar.strrect import unpack_words
+                rank, words, ln = ops[0], ops[1:-1], ops[-1]
+                s_keys.append(DVal(
+                    StrVal(unpack_words(list(words), 8 * len(words)),
+                           ln.astype(jnp.int32)),
+                    rank == 0, dt))
+            else:
+                rank, keyop = ops
                 s_keys.append(DVal(keyop.astype(dt.np_dtype), rank == 0,
                                    dt))
-        else:
-            s_keys = [DVal(next(it), next(it), dt) for dt in key_dtypes]
         sorted_vals = [[DVal(next(it), next(it), dt) for dt in dts]
                        for dts in val_dtypes]
         ckey, carry, num_groups = stage_scan(
             aggs, mode, s_ops, perm, s_keys, sorted_vals, live,
             padded_len)
-        return ckey, tuple(carry), num_groups
+        return ckey, carry, num_groups
 
-    @jax.jit
-    def k_pack_sort(ckey, carry):
-        """The bare compaction sort, also alone in its module. No
-        group-liveness masking afterwards: split-path consumers slice to
-        the resolved group count and read rows by prefix, so rows past
-        num_groups are never interpreted (unlike the fused path, whose
-        packed fetch reads a fixed OPT rows and must mask)."""
-        return jax.lax.sort((ckey,) + tuple(carry), num_keys=1,
-                            is_stable=True)
+    @functools.partial(jax.jit, static_argnums=(2,))
+    def k_pack(ckey, carry, padded_len, num_groups):
+        """The compaction sort + nested rebuild (stage_pack), its own
+        module."""
+        from .groupby_core import stage_pack
+        return stage_pack(ckey, carry, num_groups, key_dtypes,
+                          padded_len)
 
     def kernel(cols, num_rows, padded_len, scalars=()):
-        flat, live = k_prep(cols, num_rows, padded_len, scalars)
-        sorted_all = k_sort(flat)
-        ckey, carry, ng = k_scan(tuple(sorted_all), padded_len, live)
-        packed = k_pack_sort(ckey, carry)
-        it = iter(packed[1:])
-        key_outs = [(next(it), next(it)) for _ in range(len(key_exprs))]
-        n_partials = (len(carry) - 2 * len(key_exprs)) // 2
-        partial_outs = [(next(it), next(it)) for _ in range(n_partials)]
-        return key_outs, partial_outs, ng
+        (pad_flag, key_ops, payload), live = k_prep(
+            cols, num_rows, padded_len, scalars)
+        arities = tuple(len(g) for g in key_ops)
+        flat = [pad_flag]
+        for g in key_ops:
+            flat.extend(g)
+        flat.extend(payload)
+        sorted_all = k_sort(tuple(flat), 1 + sum(arities))
+        ckey, carry, ng = k_scan(tuple(sorted_all), arities, padded_len,
+                                 live)
+        key_outs, partial_outs, _ = k_pack(ckey, carry, padded_len, ng)
+        return list(key_outs), list(partial_outs), ng
 
     kernel.n_param_slots = fused.n_param_slots
     return kernel
@@ -414,12 +437,20 @@ class TpuHashAggregateExec(TpuExec):
                                e.data_type(self._kernel_schema), True)
                    for i, e in enumerate(self._kernel_groupings)]
         self._partial_counts = []
+        afields = []
         for ai, a in enumerate(self.aggs):
             pts = a.partial_types(cs)
             self._partial_counts.append(len(pts))
             for pi, pt in enumerate(pts):
-                pfields.append(StructField(f"_a{ai}_{pi}", pt, True))
-        self._partial_schema = Schema(pfields)
+                afields.append(StructField(f"_a{ai}_{pi}", pt, True))
+        self._partial_schema_dict = Schema(pfields + afields)
+        self._partial_schema = self._partial_schema_dict
+        # rect-key variant: string keys keep their STRING type (byte
+        # rectangles ride the kernels directly, no int32 code columns)
+        self._partial_schema_rect = Schema(
+            [StructField(f"_k{i}", e.data_type(cs), True)
+             for i, e in enumerate(self.groupings)] + afields)
+        self._rect_mode = False
 
     def output_schema(self) -> Schema:
         return self._schema
@@ -433,9 +464,12 @@ class TpuHashAggregateExec(TpuExec):
         stacked fetch (per-batch ``int(num_groups)`` cost a full tunnel
         round trip each, serializing the pipeline — 10 batches at 10M rows
         spent ~2 s in fetch latency alone)."""
+        from ..columnar.strrect import ByteRectColumn
         cols = []
         for c in batch.columns:
-            if isinstance(c, DeviceColumn):
+            if isinstance(c, ByteRectColumn):
+                cols.append((c.data, c.validity, c.lengths))
+            elif isinstance(c, DeviceColumn):
                 cols.append((c.data, c.validity))
             else:
                 cols.append(None)
@@ -446,14 +480,23 @@ class TpuHashAggregateExec(TpuExec):
             cols, jnp.int32(batch.num_rows_raw), batch.padded_len, scalars)
         return list(key_outs) + list(partial_outs), num_groups
 
-    @staticmethod
-    def _slice_to_count(outs, n, out_schema: Schema) -> ColumnarBatch:
+    def _slice_to_count(self, outs, n, out_schema: Schema) -> ColumnarBatch:
         """Re-bucket raw kernel outputs once the group count is known:
         group counts are usually orders of magnitude below the input
         bucket; slicing keeps the merge pass (another sort) tiny."""
+        from ..columnar.strrect import ByteRectColumn
+        from ..exprs.base import StrVal
         target = bucket_for(int(n))
         out_cols = []
         for (d, v), f in zip(outs, out_schema.fields):
+            if isinstance(d, StrVal):
+                b, ln = d.bytes_, d.lengths
+                if target < b.shape[0]:
+                    b, ln, v = b[:target], ln[:target], v[:target]
+                out_cols.append(ByteRectColumn(
+                    b, v, ln,
+                    ascii_only=getattr(self, "_rect_ascii", True)))
+                continue
             if target < d.shape[0]:
                 d, v = d[:target], v[:target]
             out_cols.append(DeviceColumn(d, v, f.dtype))
@@ -469,8 +512,14 @@ class TpuHashAggregateExec(TpuExec):
             # outputs stay at the input bucket — callers use this when the
             # input is already group-sized (merge passes), where slicing
             # would buy nothing but the sync would cost a round trip
-            out_cols = [DeviceColumn(d, v, f.dtype)
-                        for (d, v), f in zip(outs, out_schema.fields)]
+            from ..columnar.strrect import ByteRectColumn
+            from ..exprs.base import StrVal
+            out_cols = [
+                (ByteRectColumn(d.bytes_, v, d.lengths,
+                                ascii_only=getattr(self, "_rect_ascii",
+                                                   True))
+                 if isinstance(d, StrVal) else DeviceColumn(d, v, f.dtype))
+                for (d, v), f in zip(outs, out_schema.fields)]
             return ColumnarBatch(out_cols, num_groups, out_schema)
         return self._slice_to_count(outs, int(num_groups), out_schema)
 
@@ -573,7 +622,9 @@ class TpuHashAggregateExec(TpuExec):
         dictionaries are sorted — only a tiny remap table touches the
         wire; the strings materialize lazily at the final sink (one
         batched fetch there instead of one per key here)."""
-        if not self._dict_keys:
+        if not self._dict_keys or self._rect_mode:
+            # rect keys pass through as ByteRectColumns: the sink decodes
+            # the (group-sized) rectangles directly
             return out_cols
         from ..columnar import DictColumn
         from ..types import STRING
@@ -804,9 +855,81 @@ class TpuHashAggregateExec(TpuExec):
         core.n_param_slots = len(slots)
         return core
 
+    def _rect_key_mode(self, batch) -> bool:
+        """True when every string group key is a direct reference to a
+        byte-rectangle ASCII column of this batch — keys then group on
+        device via packed-word operands (exprs/string_rect design)."""
+        if not self._dict_keys or batch is None:
+            return False
+        from ..columnar.strrect import ByteRectColumn
+        from ..exprs.base import Alias, ColumnRef
+        for i in self._dict_keys:
+            g = self.groupings[i]
+            if isinstance(g, Alias):
+                g = g.children[0]
+            if not isinstance(g, ColumnRef):
+                return False
+            try:
+                col = batch.column_by_name(g.name)
+            except (KeyError, ValueError):
+                return False
+            if not (isinstance(col, ByteRectColumn) and col.ascii_only):
+                return False
+        return True
+
+    def _ensure_rect_cols(self, batch: ColumnarBatch, ordinals) -> ColumnarBatch:
+        """Rect-mode invariant: the given STRING columns must be byte
+        rectangles. A spill round trip or host-staged concat can re-ingest
+        them as dictionary codes (whose code spaces differ per batch —
+        grouping on them across batches would be wrong); re-encode those
+        back to rectangles (grouping on bytes is exact for ANY UTF-8)."""
+        from ..columnar.strrect import ByteRectColumn, encode_string_rect
+        import jax
+        cols = list(batch.columns)
+        changed = False
+        for i in ordinals:
+            c = cols[i]
+            if isinstance(c, ByteRectColumn):
+                if not c.ascii_only:
+                    self._rect_ascii = False
+                continue
+            arr = c.to_arrow(batch.num_rows)
+            enc = encode_string_rect(arr, len(arr), batch.padded_len,
+                                     1 << 30)     # correctness: no cap
+            if enc is None:       # cannot happen below the 1<<30 cap,
+                raise ValueError(  # but never unpack None silently
+                    "string too wide for the rectangle re-encode")
+            rect, lens, v, asc = enc
+            if not asc:
+                # grouping stays byte-exact for any UTF-8; only the
+                # downstream case-transform eligibility flag must flip
+                self._rect_ascii = False
+            cols[i] = ByteRectColumn(jax.device_put(rect),
+                                     jax.device_put(v),
+                                     jax.device_put(lens),
+                                     ascii_only=asc)
+            changed = True
+        if not changed:
+            return batch
+        return ColumnarBatch(cols, batch.num_rows_raw, batch.schema,
+                             meta=batch.meta)
+
+    def _rect_key_ordinals_for(self, batch: ColumnarBatch):
+        """Ordinals of the key-leaf columns in an UPDATE input batch."""
+        from ..exprs.base import Alias, ColumnRef
+        out = []
+        for i in self._dict_keys:
+            g = self.groupings[i]
+            if isinstance(g, Alias):
+                g = g.children[0]
+            out.append(batch.schema.index_of(g.name))
+        return out
+
     def _direct_update_args(self, batch: ColumnarBatch):
         """When the multi-batch first pass can use the direct-addressing
         update kernel for this batch, return (kernel, args); else None."""
+        if self._rect_mode:
+            return None
         if not self.groupings or \
                 len(self._dict_keys) != len(self.groupings):
             return None
@@ -931,8 +1054,29 @@ class TpuHashAggregateExec(TpuExec):
         it = self.children[0].execute(ctx)
         first = next(it, None)
         second = next(it, None) if first is not None else None
+        # byte-rectangle key mode (VERDICT r3 #4): when every string
+        # group key is a rectangle-backed ASCII column, the keys group
+        # ON DEVICE through packed-word sort operands — no exec-local
+        # dictionary, no host encode, no per-distinct-value work
+        self._rect_mode = self._rect_key_mode(first)
+        self._rect_ascii = True
+        self._partial_schema = self._partial_schema_dict
+        if self._rect_mode:
+            self._kernel_key = ("rect",) + _agg_kernel_key(
+                self.groupings, self.aggs, self._eval_schema, "update",
+                in_schema, self.pre_stages or None, 0)
+            update_k_split = _get_kernel(self.groupings, self.aggs,
+                                         self._eval_schema, "update",
+                                         in_schema=in_schema,
+                                         stages=self.pre_stages or None,
+                                         split=True)
+            self._upd_scalars = literal_scalars(collect_param_literals(
+                _param_exprs(self.groupings, self.aggs, "update",
+                             self.pre_stages or None)))
+            self._partial_schema = self._partial_schema_rect
         if first is not None and second is None \
                 and not self.many_groups_hint \
+                and not self._rect_mode \
                 and _FAST_GROUPS.get(self._kernel_key, 0) \
                 <= self.OPTIMISTIC_GROUPS:
             first = first.ensure_device()
@@ -1018,6 +1162,9 @@ class TpuHashAggregateExec(TpuExec):
 
         for batch in itertools.chain(pending, it):
             batch = batch.ensure_device()
+            if self._rect_mode:
+                batch = self._ensure_rect_cols(
+                    batch, self._rect_key_ordinals_for(batch))
             direct = self._direct_update_args(batch)
             if direct is not None:
                 kern, (cards, pairs, remaps) = direct
@@ -1032,19 +1179,28 @@ class TpuHashAggregateExec(TpuExec):
                                    p, r)
                     return list(ko) + list(po), ng
             else:
-                codes = self._augment(batch)
+                codes = [] if self._rect_mode else self._augment(batch)
 
                 def dispatch(b=batch, extra=codes):
                     return self._run_kernel_raw(
                         update_k_split, b, extra_cols=extra,
                         scalars=self._upd_scalars)
 
+            def _spec_slice(d_, v):
+                from ..exprs.base import StrVal
+                if isinstance(d_, StrVal):
+                    if spec < d_.bytes_.shape[0]:
+                        return (StrVal(d_.bytes_[:spec],
+                                       d_.lengths[:spec]), v[:spec])
+                    return (d_, v)
+                if spec < d_.shape[0]:
+                    return (d_[:spec], v[:spec])
+                return (d_, v)
+
             def first_pass(d=dispatch):
                 with ctx.semaphore.held():
                     outs, ng = d()
-                    outs = [(d_[:spec], v[:spec]) if spec < d_.shape[0]
-                            else (d_, v) for d_, v in outs]
-                    return outs, ng
+                    return [_spec_slice(d_, v) for d_, v in outs], ng
             # idempotent over the input batch -> retry-safe
             outs, ng = with_retry_no_split(first_pass, ctx.memory)
             window.append((outs, ng, dispatch, row_base))
@@ -1186,6 +1342,9 @@ class TpuHashAggregateExec(TpuExec):
                 def level_merge(c=chunk):
                     with ctx.semaphore.held():
                         big = concat_batches([s.get() for s in c])
+                        if self._rect_mode:
+                            big = self._ensure_rect_cols(
+                                big, range(len(self.groupings)))
                         return self._run_kernel_raw(merge_k, big)
                 raws.append(with_retry_no_split(level_merge, ctx.memory))
             ngs = [r[1] for r in raws if isinstance(r, tuple)]
@@ -1220,6 +1379,9 @@ class TpuHashAggregateExec(TpuExec):
         def do_merge() -> ColumnarBatch:
             with ctx.semaphore.held():
                 big = concat_batches([s.get() for s in level])
+                if self._rect_mode:
+                    big = self._ensure_rect_cols(
+                        big, range(len(self.groupings)))
                 # lazy: the merge input is already group-sized, so the
                 # output stays at its (small) bucket and the group count
                 # rides to the sink fetch instead of syncing here

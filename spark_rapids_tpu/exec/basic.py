@@ -145,7 +145,9 @@ class InMemoryScanExec(TpuExec):
                 if chunk.num_rows == 0 and off > 0:
                     break
                 with ctx.semaphore.held():
-                    b = ColumnarBatch.from_arrow(chunk)
+                    from ..columnar.strrect import RECT_MAX_BYTES
+                    b = ColumnarBatch.from_arrow(
+                        chunk, rect_cap=int(ctx.conf.get(RECT_MAX_BYTES)))
                 b.meta = {"partition_id": pid}
                 rows_m.add(b.num_rows)
                 built.append(b)
@@ -180,6 +182,10 @@ class TpuProjectExec(TpuExec):
         #: once per distinct dictionary entry and re-encode (VERDICT r2
         #: #4 — row data stays on device; ref stringFunctions.scala)
         self.dict_chain = {}
+        #: out ordinal -> (chain root, leaf name): device byte-rectangle
+        #: string chains (high cardinality — exprs/string_rect.py)
+        self.rect_chain = {}
+        self._rect_kernels = {}
         from ..exprs.base import Alias, ColumnRef
         for i, e in enumerate(self.exprs):
             inner = e.children[0] if isinstance(e, Alias) else e
@@ -195,6 +201,14 @@ class TpuProjectExec(TpuExec):
                 leaf = self._dict_chain_leaf(inner, in_schema)
                 if leaf is not None:
                     self.dict_chain[i] = (inner, leaf)
+                from ..exprs.string_rect import rect_chain_leaf
+                rleaf = rect_chain_leaf(inner, in_schema)
+                if rleaf is not None:
+                    # high-cardinality path: when the source column is a
+                    # byte rectangle (ASCII), the chain compiles to ONE
+                    # device kernel over [rows, width] (VERDICT r3 #4;
+                    # ref stringFunctions.scala device kernels)
+                    self.rect_chain[i] = (inner, rleaf)
         #: device exprs referencing ArrayType columns: the batch may carry
         #: them as HostColumns (width cap, columnar/nested.py) — those
         #: exprs drop to host PER BATCH (the dict-filter bail-out pattern)
@@ -262,6 +276,31 @@ class TpuProjectExec(TpuExec):
         return DictColumn(codes, col.validity, col.dtype,
                           np.asarray(uniq, dtype=object))
 
+    def _rect_eval(self, expr, col, ordinal: int):
+        """One jitted kernel for a whole rect string chain (upper/trim/
+        substring/... fused), cached per (expr, width, padded)."""
+        import jax
+        from ..columnar.strrect import ByteRectColumn
+        from ..exprs.base import DVal, StrVal
+        from ..exprs.string_rect import eval_rect_chain
+        from ..types import STRING
+        key = (expr.key(), col.width, col.padded_len)
+        fn = self._rect_kernels.get(key)
+        if fn is None:
+            @jax.jit
+            def fn(bytes_, lengths, validity, e=expr):
+                outv = eval_rect_chain(
+                    e, DVal(StrVal(bytes_, lengths), validity, STRING))
+                return outv.data, outv.validity
+            self._rect_kernels[key] = fn
+        data, valid = fn(col.data, col.lengths, col.validity)
+        if isinstance(data, StrVal):
+            return ByteRectColumn(data.bytes_, valid, data.lengths,
+                                  ascii_only=True)
+        from ..columnar import DeviceColumn
+        return DeviceColumn(data, valid,
+                            self._schema.fields[ordinal].dtype)
+
     def output_schema(self) -> Schema:
         return self._schema
 
@@ -325,6 +364,15 @@ class TpuProjectExec(TpuExec):
                         if xf is not None:
                             out[i] = xf
                             continue
+                rchain = self.rect_chain.get(i)
+                if rchain is not None:
+                    from ..columnar.strrect import ByteRectColumn
+                    expr, leaf = rchain
+                    src = batch.column_by_name(leaf)
+                    if isinstance(src, ByteRectColumn) and src.ascii_only:
+                        with ctx.semaphore.held():
+                            out[i] = self._rect_eval(expr, src, i)
+                        continue
                 arr = self.exprs[i].eval_host(batch)
                 dt = self._schema.fields[i].dtype
                 if dt.device_backed:

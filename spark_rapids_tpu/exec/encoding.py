@@ -34,7 +34,27 @@ def canonicalize_floats(d):
 
 
 def order_key_operands(v: DVal, ascending: bool, nulls_first: bool):
-    """One SortOrder -> two sort operands (null_rank uint8, key)."""
+    """One SortOrder -> sort operands ([null_rank uint8, key] for scalar
+    lanes; [null_rank, length, words...] for byte-rectangle strings —
+    packed big-endian int64 words order like the bytes, and the length
+    operand keeps strings with trailing NULs distinct)."""
+    from ..exprs.base import StrVal
+    if isinstance(v.data, StrVal):
+        from ..columnar.strrect import pack_words
+        sv: StrVal = v.data
+        if nulls_first:
+            null_rank = jnp.where(v.validity, jnp.uint8(1), jnp.uint8(0))
+        else:
+            null_rank = jnp.where(v.validity, jnp.uint8(0), jnp.uint8(1))
+        ln = jnp.where(v.validity, sv.lengths, 0)
+        words = pack_words(sv.bytes_, sv.lengths)
+        if not ascending:
+            ln = -ln
+            words = [~w for w in words]
+        # words FIRST (byte order decides), length only breaks the
+        # prefix-tie ("a" vs "a\x00") — zero padding already sorts short
+        # strings before their extensions
+        return [null_rank] + words + [ln]
     d = v.data
     if jnp.issubdtype(d.dtype, jnp.floating):
         d = canonicalize_floats(d)

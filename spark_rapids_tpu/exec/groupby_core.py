@@ -54,6 +54,31 @@ def global_groupby(vals: List[List[DVal]], aggs: Sequence, mode: str,
     return [], partial_outs, num_groups
 
 
+def _flatten_key(k: DVal):
+    """Key payload lanes: (lanes, rebuild). Byte-rectangle strings ride
+    as W/8 packed words + length (the same lanes their sort operands
+    use); scalar keys as (data, validity)."""
+    from ..exprs.base import StrVal
+    if isinstance(k.data, StrVal):
+        from ..columnar.strrect import pack_words, unpack_words
+        sv: StrVal = k.data
+        w = sv.bytes_.shape[1]
+        lanes = list(pack_words(sv.bytes_, sv.lengths)) \
+            + [sv.lengths, k.validity]
+
+        def rebuild(ls, dtype=k.dtype, w=w):
+            words, lengths, validity = ls[:-2], ls[-2], ls[-1]
+            return DVal(StrVal(unpack_words(list(words), w),
+                               lengths.astype(jnp.int32)),
+                        validity, dtype)
+        return lanes, rebuild
+    lanes = [k.data, k.validity]
+
+    def rebuild(ls, dtype=k.dtype):
+        return DVal(ls[0], ls[1], dtype)
+    return lanes, rebuild
+
+
 def stage_sort(keys: List[DVal], vals: List[List[DVal]], num_rows,
                padded_len: int, row_mask=None):
     """Stage 1: encode key operands and run THE sort, values riding as
@@ -69,17 +94,24 @@ def stage_sort(keys: List[DVal], vals: List[List[DVal]], num_rows,
     # payloads (carried through the sort network — far cheaper than
     # row-sized gathers): original index, key columns, value columns
     payload: List = [idx]
+    rebuilds = []
+    spans = []
     for k in keys:
-        payload.extend((k.data, k.validity))
+        lanes, rebuild = _flatten_key(k)
+        spans.append((len(payload), len(payload) + len(lanes)))
+        payload.extend(lanes)
+        rebuilds.append(rebuild)
+    v_start = len(payload)
     for vs in vals:
         for v in vs:
             payload.extend((v.data, v.validity))
     sorted_all = jax.lax.sort(tuple(operands + payload),
                               num_keys=n_key_ops, is_stable=True)
     s_ops = sorted_all[:n_key_ops]
-    it = iter(sorted_all[n_key_ops:])
-    perm = next(it)
-    s_keys = [DVal(next(it), next(it), k.dtype) for k in keys]
+    rest = sorted_all[n_key_ops:]
+    perm = rest[0]
+    s_keys = [rb(rest[a:b]) for (a, b), rb in zip(spans, rebuilds)]
+    it = iter(rest[v_start:])
     sorted_vals = [[DVal(next(it), next(it), v.dtype) for v in vs]
                    for vs in vals]
     live_count = jnp.sum(row_mask).astype(jnp.int32)
@@ -90,8 +122,9 @@ def stage_scan(aggs: Sequence, mode: str, s_ops, perm, s_keys,
                sorted_vals, live_count, padded_len: int):
     """Stage 2: segment boundaries from adjacent-key comparison, then the
     segmented scans. Returns (ckey, carry, num_groups) where ``carry`` is
-    the flat [key data/validity..., partial data/validity...] list the
-    compaction sort will move."""
+    a NESTED (key_lane_groups, partial_pairs) structure the compaction
+    sort moves — byte-rectangle string keys contribute a lane group of
+    packed words + length + validity, scalar keys (data, validity)."""
     idx = jnp.arange(padded_len, dtype=jnp.int32)
     differs = jnp.zeros(padded_len, dtype=jnp.bool_)
     for op in s_ops[1:]:
@@ -121,28 +154,47 @@ def stage_scan(aggs: Sequence, mode: str, s_ops, perm, s_keys,
     end_mask = jnp.logical_and(
         s_live, jnp.logical_or(nxt_flag, nxt_dead))
     ckey = jnp.where(end_mask, gid_seg, padded_len)
-    carry: List = []
+    key_groups = []
     for k in s_keys:
-        carry.extend((k.data, k.validity))
-    for d, v in partial_rows:
-        carry.extend((d, v))
+        lanes, _rb = _flatten_key(k)
+        key_groups.append(tuple(lanes))
+    carry = (tuple(key_groups),
+             tuple((d, v) for d, v in partial_rows))
     return ckey, carry, num_groups
 
 
-def stage_pack(ckey, carry, num_groups, n_keys: int, padded_len: int):
-    """Stage 3: the compaction sort. Returns (key_outs, partial_outs,
-    num_groups) with group validities masked to the live prefix."""
+def stage_pack(ckey, carry, num_groups, key_dtypes, padded_len: int):
+    """Stage 3: the compaction sort over the nested carry. Returns
+    (key_outs, partial_outs, num_groups) with group validities masked to
+    the live prefix; a byte-rectangle key comes back as
+    (StrVal, validity)."""
+    from ..exprs.base import StrVal
+    key_groups, partial_pairs = carry
+    flat: List = []
+    for g in key_groups:
+        flat.extend(g)
+    for d, v in partial_pairs:
+        flat.extend((d, v))
     idx = jnp.arange(padded_len, dtype=jnp.int32)
-    packed = jax.lax.sort(tuple([ckey] + list(carry)), num_keys=1,
+    packed = jax.lax.sort(tuple([ckey] + flat), num_keys=1,
                           is_stable=True)
     it = iter(packed[1:])
-    key_outs = [(next(it), next(it)) for _ in range(n_keys)]
-    n_partials = (len(carry) - 2 * n_keys) // 2
-    partial_outs = [(next(it), next(it)) for _ in range(n_partials)]
     group_live = idx < num_groups
-    key_outs = [(d, jnp.logical_and(v, group_live)) for d, v in key_outs]
-    partial_outs = [(d, jnp.logical_and(v, group_live))
-                    for d, v in partial_outs]
+    key_outs = []
+    for g, dt in zip(key_groups, key_dtypes):
+        lanes = [next(it) for _ in g]
+        if len(lanes) == 2:
+            key_outs.append((lanes[0],
+                             jnp.logical_and(lanes[1], group_live)))
+        else:                      # rect string: words... + length + valid
+            from ..columnar.strrect import unpack_words
+            words, lengths, valid = lanes[:-2], lanes[-2], lanes[-1]
+            w = 8 * len(words)
+            key_outs.append((StrVal(unpack_words(list(words), w),
+                                    lengths.astype(jnp.int32)),
+                             jnp.logical_and(valid, group_live)))
+    partial_outs = [(next(it), jnp.logical_and(next(it), group_live))
+                    for _ in partial_pairs]
     return key_outs, partial_outs, num_groups
 
 
@@ -169,7 +221,8 @@ def segmented_groupby(keys: List[DVal], vals: List[List[DVal]],
     ckey, carry, num_groups = stage_scan(
         aggs, mode, s_ops, perm, s_keys, sorted_vals, live_count,
         padded_len)
-    return stage_pack(ckey, carry, num_groups, len(keys), padded_len)
+    return stage_pack(ckey, carry, num_groups,
+                      [k.dtype for k in keys], padded_len)
 
 
 def _run_aggs(aggs, vals, seg, mode, update_mask):

@@ -200,10 +200,13 @@ class TpuSortExec(TpuExec):
         if not self.global_sort:
             for batch in self.children[0].execute(ctx):
                 with ctx.semaphore.held():
-                    yield sort_batch_device(self.orders, batch.ensure_device())
+                    yield sort_batch_device(
+                        self.orders,
+                        batch.ensure_device().with_lists_on_host())
             return
-        spillables = [SpillableBatch(b.ensure_device(), ctx.memory)
-                      for b in self.children[0].execute(ctx)]
+        spillables = [SpillableBatch(
+            b.ensure_device().with_lists_on_host(), ctx.memory)
+            for b in self.children[0].execute(ctx)]
         if not spillables:
             return
         total = sum(s.device_bytes() for s in spillables)

@@ -628,8 +628,9 @@ class TpuWindowExec(TpuExec):
             kern = _build_window_kernel(self.window_exprs, cs)
             _WIN_CACHE[key] = kern
         # window needs whole partitions: single-batch goal
-        spill = [SpillableBatch(b.ensure_device(), ctx.memory)
-                 for b in self.children[0].execute(ctx)]
+        spill = [SpillableBatch(
+            b.ensure_device().with_lists_on_host(), ctx.memory)
+            for b in self.children[0].execute(ctx)]
         if not spill:
             return
 

@@ -66,6 +66,17 @@ class ListVal(NamedTuple):
     lengths: jnp.ndarray
 
 
+class StrVal(NamedTuple):
+    """Traced device STRING value as a dense byte rectangle
+    (columnar/strrect.py): rides in DVal.data for STRING-typed values
+    when the column is rectangle-backed (high cardinality — dictionary
+    codes stay the low-cardinality representation).
+    bytes_[P, W] uint8 (zero-padded past each row's length),
+    lengths[P] int32 (byte == char: the device path is ASCII-gated)."""
+    bytes_: jnp.ndarray
+    lengths: jnp.ndarray
+
+
 class DVal(NamedTuple):
     """A traced device value: padded data + validity mask (+static dtype).
     For ArrayType values, ``data`` is a ListVal rectangle and ``validity``

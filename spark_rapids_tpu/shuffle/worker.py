@@ -24,15 +24,27 @@ def main(argv=None) -> None:
                     help="file holding the cluster's shared HMAC token")
     ap.add_argument("--id", type=int, default=0,
                     help="worker index (unique per cluster)")
-    ap.add_argument("--bind", default="0.0.0.0",
-                    help="address this worker's block server binds")
+    ap.add_argument("--bind", default=None,
+                    help="address this worker's block server binds AND "
+                         "advertises to peers (default: the local "
+                         "interface that routes to the driver)")
     args = ap.parse_args(argv)
     host, port = args.driver.rsplit(":", 1)
+    bind = args.bind
+    if bind is None:
+        # the advertised address must be routable by peers — 0.0.0.0
+        # would make everyone connect to THEMSELVES; derive the local
+        # interface facing the driver instead
+        import socket
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect((host, int(port)))
+        bind = s.getsockname()[0]
+        s.close()
     with open(args.token_file, "rb") as f:
         token = f.read()
     from .cluster import _worker_main
     _worker_main(args.id, (host, int(port)), None, token,
-                 bind_host=args.bind)
+                 bind_host=bind)
 
 
 if __name__ == "__main__":
