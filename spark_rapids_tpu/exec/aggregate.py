@@ -990,6 +990,16 @@ class TpuHashAggregateExec(TpuExec):
                               padded_remaps)
                 specs = fast.out_specs[batch.padded_len]
         if packed is None:
+            if nkeys > 0:
+                # SORT-based keyed aggregation must not compile the fused
+                # update+finalize kernel: a lax.sort's compile time
+                # multiplies with everything else in its module, and this
+                # exact kernel stalled compiles for HOURS on the tunneled
+                # backend (r3's 2,381 s q28 warm-up; an outer-agg variant
+                # wedged a bench run for 90+ minutes in r4). The classic
+                # path runs the SPLIT kernels instead — a couple more
+                # dispatches on a single batch, compile in minutes.
+                return None
             codes = self._augment(batch)
             cols = base_cols + [(c.data, c.validity) for c in codes]
             if self._fast_k is None:
@@ -1032,11 +1042,17 @@ class TpuHashAggregateExec(TpuExec):
             self._kernel_groupings, self.aggs, self._kernel_schema,
             "update", in_schema, self.pre_stages or None,
             len(self._dict_keys))
-        update_k = _get_kernel(self._kernel_groupings, self.aggs,
-                               self._kernel_schema, "update",
-                               in_schema=in_schema,
-                               stages=self.pre_stages or None,
-                               n_codes=len(self._dict_keys))
+        # the fused (single-module) update kernel is only ever invoked for
+        # GLOBAL aggregations (_fast_single_batch's nkeys==0 branch);
+        # keyed aggregations always run the split kernels — the fused
+        # sort-based form compiles pathologically on this backend
+        update_k = None
+        if not self.groupings:
+            update_k = _get_kernel(self._kernel_groupings, self.aggs,
+                                   self._kernel_schema, "update",
+                                   in_schema=in_schema,
+                                   stages=self.pre_stages or None,
+                                   n_codes=len(self._dict_keys))
         # the multi-batch first pass calls the kernel directly (not traced
         # inside another jit) — the split three-dispatch form compiles in
         # ~1 min where the fused sort pipeline took >20 on this backend
@@ -1077,6 +1093,8 @@ class TpuHashAggregateExec(TpuExec):
         if first is not None and second is None \
                 and not self.many_groups_hint \
                 and not self._rect_mode \
+                and (not self.groupings
+                     or len(self._dict_keys) == len(self.groupings)) \
                 and _FAST_GROUPS.get(self._kernel_key, 0) \
                 <= self.OPTIMISTIC_GROUPS:
             first = first.ensure_device()
