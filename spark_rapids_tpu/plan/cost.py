@@ -405,6 +405,9 @@ def apply_cost_optimizer(meta: PlanMeta, conf: TpuConf,
     trans_c = conf.get(TRANSITION_COST)
     floor = float(conf.get(DEVICE_QUERY_FLOOR))
 
+    pending_reverts = []     # (meta, reason): applied only if the
+    # measured-wall arbitration below doesn't choose the device wholesale
+
     def walk(m: PlanMeta) -> _Cost:
         # costs scale with the rows a node PROCESSES (its input); a
         # groupby collapsing 2M rows to 7 groups still hashes 2M rows
@@ -430,10 +433,9 @@ def apply_cost_optimizer(meta: PlanMeta, conf: TpuConf,
             min(k.host, k.device + trans_c * estimate_rows(cm.plan))
             for k, cm in zip(kids, m.child_metas))
         if host < device:
-            m.will_not_work_on_tpu(
-                f"cost-based: device cost {device:.4f} (incl. transitions) "
-                f"exceeds host cost {host:.4f}")
-            log.debug("cost optimizer reverted %s", type(m.plan).__name__)
+            pending_reverts.append((m, (
+                f"cost-based: device cost {device:.4f} (incl. "
+                f"transitions) exceeds host cost {host:.4f}")))
             return _Cost(float("inf"), host, False)
         return _Cost(device, host, True)
 
@@ -448,8 +450,12 @@ def apply_cost_optimizer(meta: PlanMeta, conf: TpuConf,
     host_only = pure_host(meta)
     best_mixed = min(root.device, root.host)
     host_est = host_only
+    # model device estimate WITHOUT the per-node reverts applied: the
+    # cost every node would pay if the whole plan ran device-side
+    dev_model = root.device if root.device != float("inf") else best_mixed
     dev_est = best_mixed + floor
     how = "estimate"
+    hw = dw = None
     if wall_sig is not None:
         # MEASURED whole-query walls trump the model: a shape that has
         # actually run on an engine is priced by what it cost, so
@@ -460,15 +466,39 @@ def apply_cost_optimizer(meta: PlanMeta, conf: TpuConf,
             host_est, how = hw, "measured"
         if dw is not None:
             dev_est, how = dw, "measured"
+
+    def revert_all(m: PlanMeta, reason: str):
+        if m.can_run_on_tpu:
+            m.will_not_work_on_tpu(reason)
+        for c in m.child_metas:
+            revert_all(c, reason)
+
+    # Bidirectional measured-wall arbitration (the per-node model alone
+    # could only flip device->host; a slow host twin would then be chosen
+    # forever with the measured walls ignored — caught when the r4 bench
+    # kept q9 on a 1.4 s host plan while the device ran it in 0.2 s):
+    #   * both walls trusted -> the faster engine wins wholesale;
+    #   * only the host wall trusted, and the MODEL thinks the device
+    #     could beat it -> run device once to learn its wall;
+    #   * otherwise the model decides (per-node reverts + floor check).
+    if hw is not None and dw is not None:
+        if dw <= hw:
+            log.debug("cost optimizer: measured device wall %.4fs beats "
+                      "host %.4fs — device wholesale", dw, hw)
+            return
+        revert_all(meta, (f"cost-based: measured host wall {hw:.4f}s "
+                          f"beats device {dw:.4f}s"))
+        return
+    if hw is not None and dw is None \
+            and dev_model + floor < hw:
+        log.debug("cost optimizer: exploring device (model %.4fs + floor "
+                  "< measured host %.4fs)", dev_model, hw)
+        return
+    for m, reason in pending_reverts:
+        m.will_not_work_on_tpu(reason)
+        log.debug("cost optimizer reverted %s", type(m.plan).__name__)
     if floor > 0 and host_est < dev_est:
         reason = (f"cost-based: whole-plan host {how} {host_est:.4f}s "
                   f"beats device {dev_est:.4f}s (incl. floor)")
-
-        def revert_all(m: PlanMeta):
-            if m.can_run_on_tpu:
-                m.will_not_work_on_tpu(reason)
-            for c in m.child_metas:
-                revert_all(c)
-
-        revert_all(meta)
+        revert_all(meta, reason)
         log.debug("cost optimizer reverted whole plan to host (%s)", reason)

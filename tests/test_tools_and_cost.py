@@ -231,3 +231,43 @@ def test_content_fingerprint_stable_and_distinct():
     assert _pin_table(t1) == _pin_table(t1)          # memo stable
     assert _pin_table(t1) == _pin_table(t2)          # content-addressed
     assert _pin_table(t1) != _pin_table(t3)          # data-sensitive
+
+
+def test_measured_walls_flip_host_to_device():
+    """r4: arbitration is BIDIRECTIONAL — when the measured device wall
+    beats the measured host wall, the per-node model reverts must not
+    fire (before this, a shape the model mispriced onto a slow host twin
+    stayed there forever, walls ignored)."""
+    import pyarrow as pa
+    from harness import tpu_session
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.plan import cost
+
+    t = pa.table({"k": list(range(100)) * 10, "v": [1.0] * 1000})
+    conf = {"spark.rapids.tpu.sql.optimizer.enabled": True}
+
+    def physical():
+        s = tpu_session(conf)
+        df = (s.create_dataframe(t).group_by("k")
+              .agg(F.sum(F.col("v")).with_name("s")))
+        return df, df._physical().tree_string()
+
+    df, _tree = physical()
+    sig = cost.plan_signature(df.plan)
+    # poison: host measured fast twice -> host wholesale
+    cost._ENGINE_WALLS.clear()
+    cost.record_engine_wall(sig, "host", 0.001)
+    cost.record_engine_wall(sig, "host", 0.001)
+    cost.record_engine_wall(sig, "device", 5.0)
+    cost.record_engine_wall(sig, "device", 5.0)
+    _df, tree_host = physical()
+    assert "!" in tree_host, tree_host          # host chosen
+    # now the device wall measures faster -> device wholesale
+    cost._ENGINE_WALLS.clear()
+    cost.record_engine_wall(sig, "host", 5.0)
+    cost.record_engine_wall(sig, "host", 5.0)
+    cost.record_engine_wall(sig, "device", 0.001)
+    cost.record_engine_wall(sig, "device", 0.001)
+    _df, tree_dev = physical()
+    assert "CpuAggregate" not in tree_dev, tree_dev
+    cost._ENGINE_WALLS.clear()
