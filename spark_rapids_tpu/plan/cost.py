@@ -494,6 +494,15 @@ def apply_cost_optimizer(meta: PlanMeta, conf: TpuConf,
         log.debug("cost optimizer: exploring device (model %.4fs + floor "
                   "< measured host %.4fs)", dev_model, hw)
         return
+    if dw is not None and hw is None and host_only < dw:
+        # symmetric: a device-first shape measuring slow must TRY the
+        # host twin once, or it stays on the slow engine forever
+        revert_all(meta, (f"cost-based: exploring host (model "
+                          f"{host_only:.4f}s < measured device "
+                          f"{dw:.4f}s)"))
+        log.debug("cost optimizer: exploring host (model %.4fs < "
+                  "measured device %.4fs)", host_only, dw)
+        return
     for m, reason in pending_reverts:
         m.will_not_work_on_tpu(reason)
         log.debug("cost optimizer reverted %s", type(m.plan).__name__)
