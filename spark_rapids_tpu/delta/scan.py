@@ -92,6 +92,12 @@ class DeltaScanExec(ParquetScanExec):
         self._pv_by_path = {
             os.path.join(self.table_path, a.path): a.partition_values
             for a in kept if a.partition_values}
+        # log-recorded numRecords per file (None when stats absent): lets
+        # the sharded scan bin-pack without opening parquet footers
+        from .table import _file_rows
+        self._rows_by_path = {
+            os.path.join(self.table_path, a.path): _file_rows(a)
+            for a in kept}
         self.paths = [os.path.join(self.table_path, a.path) for a in kept]
         self._empty = not self.paths
         # re-resolve AUTO now that the real path list is known (the base
@@ -109,6 +115,62 @@ class DeltaScanExec(ParquetScanExec):
     def set_predicate(self, pred) -> None:
         super().set_predicate(pred)
         self._prune()
+
+    def collect_row_group_shards(self, n_shards: int):
+        """Distributed sharded read with Delta semantics preserved: the
+        reference applies the deletion-vector scatter inside the scan
+        itself (GpuDeltaParquetFileFormatUtils.scala) so no execution
+        path can skip it — this override is that guarantee for the
+        row-group-sharded path. DV positions are file-absolute and
+        partition values are per-file, so when either is present the
+        shard unit is a whole FILE: each shard reads its files via
+        ``_read_table`` (which attaches partition columns and reads
+        DV-carrying files unpruned), then drops DV-deleted rows
+        host-side before the shard table is encoded to devices."""
+        if self._empty:
+            return None
+        if not self._dv_by_path and not self._pv_by_path:
+            # plain parquet semantics: row-group sharding is safe
+            return super().collect_row_group_shards(n_shards)
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        from ..config import MULTITHREADED_READ_THREADS
+        from ..io.parquet import _greedy_pack
+        try:
+            units = []                          # (rows, path)
+            for path, rows in self._rows_by_path.items():
+                if rows is None:   # no numRecords stat: footer fallback
+                    rows = pq.ParquetFile(
+                        self._cached_path(path)).metadata.num_rows
+                units.append((rows, path))
+        except Exception:
+            return None
+        bins = _greedy_pack(units, n_shards)
+        want = self.columns or self.snapshot.schema.names()
+
+        def read_bin(paths):
+            if not paths:
+                return None
+            parts = []
+            for path in paths:
+                t = self._read_table(path).select(want)
+                dv = self._dv_by_path.get(path)
+                if dv is not None:
+                    deleted = read_deletion_vector(self.table_path, dv)
+                    deleted = deleted[deleted < t.num_rows]
+                    if len(deleted):
+                        keep = np.ones(t.num_rows, dtype=bool)
+                        keep[deleted.astype(np.int64)] = False
+                        t = t.filter(pa.array(keep))
+                parts.append(t)
+            return pa.concat_tables(parts) if len(parts) > 1 else parts[0]
+
+        import concurrent.futures as cf
+        nthreads = int(self.conf.get(MULTITHREADED_READ_THREADS))
+        with cf.ThreadPoolExecutor(max_workers=max(nthreads, 1)) as pool:
+            out = list(pool.map(read_bin, bins))
+        empty = next(t for t in out if t is not None).schema.empty_table()
+        return [t if t is not None else empty for t in out]
 
     def _read_table(self, path: str):
         pv = self._pv_by_path.get(path)

@@ -41,6 +41,18 @@ def expand_paths(paths: Sequence[str]) -> List[str]:
     return out
 
 
+def _greedy_pack(units, n_shards: int):
+    """Greedy longest-first bin packing: ``units`` are ``(rows, *key)``
+    tuples; returns ``n_shards`` lists of keys balanced by row count."""
+    bins = [[] for _ in range(n_shards)]
+    fill = [0] * n_shards
+    for rows, *key in sorted(units, reverse=True):
+        i = fill.index(min(fill))
+        bins[i].append(tuple(key) if len(key) > 1 else key[0])
+        fill[i] += rows
+    return bins
+
+
 def parquet_schema(path: str) -> Schema:
     import pyarrow.parquet as pq
     sch = pq.read_schema(path)
@@ -77,6 +89,11 @@ class ParquetScanExec(FileScanBase):
         deployment each host reads only its bin. Returns a list of
         ``n_shards`` Arrow tables (possibly empty) or None when the
         format prevents per-group assignment."""
+        if not self.paths:
+            # zero-file scan (e.g. a fully-vacuumed snapshot): no schema
+            # to build empty shard tables from — take the non-sharded
+            # path, which knows how to emit a typed empty batch
+            return None
         import pyarrow.parquet as pq
         try:
             units = []            # (rows, path, group_idx)
@@ -92,14 +109,8 @@ class ParquetScanExec(FileScanBase):
                                   path, g))
         except Exception:
             return None
-        bins = [[] for _ in range(n_shards)]
-        fill = [0] * n_shards
-        for rows, path, g in sorted(units, reverse=True):
-            i = fill.index(min(fill))
-            bins[i].append((path, g))
-            fill[i] += rows
-        empty = files[self.paths[0]].schema_arrow.empty_table() \
-            if self.paths else None
+        bins = _greedy_pack(units, n_shards)
+        empty = files[self.paths[0]].schema_arrow.empty_table()
 
         def read_bin(b):
             import pyarrow as pa
