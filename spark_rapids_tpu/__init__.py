@@ -21,10 +21,37 @@ _jax.config.update("jax_enable_x64", True)
 # with SRTPU_COMPILE_CACHE=/path or disable with SRTPU_COMPILE_CACHE=0.
 import os as _os
 
+def _machine_fingerprint() -> str:
+    """CPU-feature fingerprint partitioning the cache per machine type.
+
+    XLA:CPU persists AOT executables specialized to the compiling host's
+    ISA features; jax loads them on a DIFFERENT host with only a warning
+    ("could lead to execution errors such as SIGILL") — measured here as
+    a segfault ~92% into the test suite when the cache was written by an
+    avx512-richer machine. TPU executables are target-serialized and
+    machine-independent, but they ride the same cache dir, so the whole
+    dir is keyed: same machine -> warm cache across rounds (critical:
+    first-ever sort-kernel compiles take minutes); new machine -> cold
+    but correct."""
+    import hashlib
+    import platform
+    raw = platform.machine() + ";" + platform.processor()
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    raw += ";" + " ".join(sorted(line.split()))
+                    break
+    except OSError:
+        pass
+    return "m-" + hashlib.sha1(raw.encode()).hexdigest()[:10]
+
+
 _cache_dir = _os.environ.get("SRTPU_COMPILE_CACHE",
                              _os.path.expanduser("~/.cache/srtpu_xla"))
 if _cache_dir and _cache_dir != "0":
     try:
+        _cache_dir = _os.path.join(_cache_dir, _machine_fingerprint())
         _jax.config.update("jax_compilation_cache_dir", _cache_dir)
         _jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
         _jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)

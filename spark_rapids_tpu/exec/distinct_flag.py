@@ -1,0 +1,348 @@
+"""Hash-based distinct flagging — the sort-free count-distinct path.
+
+``count(DISTINCT e) GROUP BY g`` is rewritten (plan/rewrites.py
+``_rewrite_distinct_hash``) to ``count(CASE WHEN __hd THEN e END)`` over
+this operator, which appends a boolean column marking the stream-global
+FIRST occurrence of each ``(g, e)`` pair. Reference analog: cudf's
+hash-based distinct aggregation that spark-rapids lowers count-distinct
+onto (aggregateFunctions count-distinct path; the sort-based two-level
+expansion in GpuAggregateExec.scala:718 is what this replaces).
+
+TPU-first design notes:
+  * The flag is computed with a PERSISTENT device hash table (open
+    addressing, a fresh hash salt per probe round) driven by scatter-min
+    claims inside one ``lax.while_loop``. No ``lax.sort`` anywhere — a
+    sort's compile time multiplies with everything else in its XLA
+    module on this backend (docs/performance.md, r4), while this module
+    is elementwise + scatter/gather and compiles in seconds.
+  * Zero per-batch host syncs: the while_loop's condition runs on
+    device, table growth is triggered by host-known row-count upper
+    bounds, and the (practically impossible) probe-exhaustion leftover
+    count rides the existing speculation-validation fetch at the sink
+    (ExecContext.check_speculations), which re-runs the plan if it ever
+    fires.
+  * Exactness: full ``(group, value)`` keys are stored and compared —
+    no reliance on hash uniqueness. NaNs are canonicalized to one bit
+    pattern and ``-0.0`` to ``+0.0`` (SQL distinct semantics: NaN==NaN,
+    0.0 == -0.0). NULL values produce no flag (count/sum/avg DISTINCT
+    ignore NULLs); a NULL group is a real group, tracked via a stored
+    null bit.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Iterator, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import ColumnarBatch, DeviceColumn
+from ..types import BOOL, Schema, StructField
+from .base import ESSENTIAL, ExecContext, TpuExec
+
+__all__ = ["HashDistinctFlagExec", "CpuDistinctFlagExec"]
+
+_C1 = np.uint64(0xBF58476D1CE4E5B9)
+_C2 = np.uint64(0x94D049BB133111EB)
+_GOLD = np.uint64(0x9E3779B97F4A7C15)
+_BIG = np.int32(2**31 - 1)
+#: probe-round safety cap; with load kept <= 1/2 the expected round
+#: count is O(log n) and the cap is unreachable, but an unbounded
+#: while_loop must not exist in a production kernel
+_MAX_ROUNDS = 4096
+
+
+def _mix64(x, salt):
+    """splitmix64 finalizer (public-domain constant set)."""
+    x = x + salt
+    x = x ^ (x >> np.uint64(30))
+    x = x * _C1
+    x = x ^ (x >> np.uint64(27))
+    x = x * _C2
+    x = x ^ (x >> np.uint64(31))
+    return x
+
+
+def _norm_bits(data, dtype):
+    """Canonical int64 bit representation of a device-backed column:
+    equal SQL values map to equal bits (NaN -> one pattern, -0.0 -> +0.0,
+    narrow ints sign-extend)."""
+    np_dt = dtype.np_dtype
+    if np.issubdtype(np_dt, np.floating):
+        data = jnp.where(data == 0, jnp.zeros((), data.dtype), data)
+        width = jnp.int32 if np_dt == np.float32 else jnp.int64
+        bits = jax.lax.bitcast_convert_type(data, width)
+        canonical_nan = jax.lax.bitcast_convert_type(
+            jnp.full((), np.nan, data.dtype), width)
+        return jnp.where(jnp.isnan(data), canonical_nan,
+                         bits).astype(jnp.int64)
+    return data.astype(jnp.int64)
+
+
+def _probe_insert(tables, grp, gnull, val, active, rowid, padded_len):
+    """Shared while_loop core: insert/lookup ``(grp, gnull, val)`` keys
+    for ``active`` rows into the open-addressed tables. Returns
+    (first_flags, leftover_active_count, tables)."""
+    Tv, Tg, Tgn, Tocc = tables
+    M = Tv.shape[0]
+    mask_m = np.uint64(M - 1)
+    key_u = (val.astype(jnp.uint64)
+             ^ (grp.astype(jnp.uint64) * _GOLD)
+             ^ (gnull.astype(jnp.uint64) << np.uint64(1)))
+    flags0 = jnp.zeros(padded_len, jnp.bool_)
+
+    def cond(st):
+        r, active, _, _, _, _, _ = st
+        return jnp.logical_and(jnp.any(active), r < _MAX_ROUNDS)
+
+    def body(st):
+        r, active, flags, Tv, Tg, Tgn, Tocc = st
+        salt = _GOLD * (r.astype(jnp.uint64) + np.uint64(1))
+        h = (_mix64(key_u, salt) & mask_m).astype(jnp.int32)
+        occ = Tocc[h]
+        hit = (occ & (Tv[h] == val) & (Tg[h] == grp) & (Tgn[h] == gnull))
+        cand = active & ~occ
+        idx = jnp.where(cand, h, M)
+        claim = jnp.full(M + 1, _BIG, jnp.int32).at[idx].min(rowid)
+        claimed = claim[h]
+        winner = cand & (claimed == rowid)
+        wi = jnp.where(winner, h, M)
+        Tv = Tv.at[wi].set(val, mode="drop")
+        Tg = Tg.at[wi].set(grp, mode="drop")
+        Tgn = Tgn.at[wi].set(gnull, mode="drop")
+        Tocc = Tocc.at[wi].set(True, mode="drop")
+        # rows whose slot was claimed by a SAME-key winner this round are
+        # duplicates of a now-counted value; different-key losers retry
+        # under the next round's salt
+        wrow = jnp.clip(claimed, 0, padded_len - 1)
+        wsame = (cand & (claimed < _BIG)
+                 & (val[wrow] == val) & (grp[wrow] == grp)
+                 & (gnull[wrow] == gnull))
+        flags = flags | winner
+        active = active & ~(hit | winner | wsame)
+        return r + 1, active, flags, Tv, Tg, Tgn, Tocc
+
+    r, active, flags, Tv, Tg, Tgn, Tocc = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), active, flags0, Tv, Tg, Tgn, Tocc))
+    leftover = jnp.sum(active).astype(jnp.int32)
+    return flags, leftover, (Tv, Tg, Tgn, Tocc)
+
+
+@functools.lru_cache(maxsize=None)
+def _flag_kernel(has_grp: bool):
+    """Batch-kernel factory: normalize key columns, probe/insert, emit
+    flags. The returned builder is cached per dtype pair; jit itself
+    re-specializes per (M, padded_len). Tables are donated — they are
+    this exec's private state, replaced every batch."""
+
+    def build(gdtype, vdtype):
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3),
+                           static_argnums=(7,))
+        def run(Tv, Tg, Tgn, Tocc, gpair, vpair, num_rows, padded_len):
+            rowid = jnp.arange(padded_len, dtype=jnp.int32)
+            real = rowid < num_rows
+            vd, vv = vpair
+            val = _norm_bits(vd, vdtype)
+            if has_grp:
+                gd, gv = gpair
+                grp = jnp.where(gv, _norm_bits(gd, gdtype),
+                                jnp.zeros((), jnp.int64))
+                gnull = ~gv & real
+            else:
+                grp = jnp.zeros(padded_len, jnp.int64)
+                gnull = jnp.zeros(padded_len, jnp.bool_)
+            active = real & vv
+            flags, leftover, tables = _probe_insert(
+                (Tv, Tg, Tgn, Tocc), grp, gnull, val, active, rowid,
+                padded_len)
+            return flags, real, leftover, *tables
+        return run
+
+    return functools.lru_cache(maxsize=None)(build)
+
+
+@functools.partial(jax.jit, static_argnums=(4,))
+def _rebuild_kernel(Tv, Tg, Tgn, Tocc, new_m: int):
+    """Grow the table: reinsert every occupied slot into fresh tables of
+    ``new_m`` slots (the stored keys are all distinct, so this is pure
+    re-placement)."""
+    old_m = Tv.shape[0]
+    nTv = jnp.zeros(new_m, jnp.int64)
+    nTg = jnp.zeros(new_m, jnp.int64)
+    nTgn = jnp.zeros(new_m, jnp.bool_)
+    nTocc = jnp.zeros(new_m, jnp.bool_)
+    rowid = jnp.arange(old_m, dtype=jnp.int32)
+    _, leftover, tables = _probe_insert(
+        (nTv, nTg, nTgn, nTocc), Tg, Tgn, Tv, Tocc, rowid, old_m)
+    return leftover, *tables
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(int(n) - 1, 1).bit_length()
+
+
+class HashDistinctFlagExec(TpuExec):
+    """Appends ``flag_name``: True on the first stream occurrence of each
+    (key_exprs, value_expr) combination, False elsewhere (incl. NULL
+    values). See module docstring."""
+
+    #: table load is kept at or below 1/2: rows_seen*2 <= M
+    _LOAD_NUM, _LOAD_DEN = 2, 1
+    _MIN_SLOTS = 1 << 16
+
+    def __init__(self, key_exprs, value_expr, flag_name: str, child):
+        super().__init__([child])
+        self.key_exprs = list(key_exprs)
+        # the table stores ONE group word; multi-key grouping would need
+        # key packing the kernel doesn't do (the rewrite never emits it)
+        assert len(self.key_exprs) <= 1, "at most one distinct group key"
+        self.value_expr = value_expr
+        self.flag_name = flag_name
+        cs = child.output_schema()
+        self._schema = Schema(list(cs.fields)
+                              + [StructField(flag_name, BOOL, True)])
+        self._key_dtypes = [e.data_type(cs) for e in self.key_exprs]
+        self._val_dtype = value_expr.data_type(cs)
+
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def describe(self):
+        k = ", ".join(e.name_hint for e in self.key_exprs)
+        return (f"HashDistinctFlag[keys=[{k}], "
+                f"value={self.value_expr.name_hint}]")
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        from ..exprs.compiler import compile_projection
+        cs = self.children[0].output_schema()
+        proj = compile_projection(self.key_exprs + [self.value_expr], cs)
+        has_grp = bool(self.key_exprs)
+        kern_for = _flag_kernel(has_grp)
+        tables = None
+        m = 0
+        seen_ub = 0          # host-known upper bound on rows inserted
+        flags_m = ctx.metric(self._exec_id, "distinctFlagRows", ESSENTIAL)
+        for batch in self.children[0].execute(ctx):
+            batch = batch.ensure_device()
+            rows_ub = batch.padded_len
+            seen_ub += rows_ub
+            need = _next_pow2(self._LOAD_NUM * seen_ub)
+            with ctx.semaphore.held():
+                if tables is None:
+                    m = max(self._MIN_SLOTS, need)
+                    tables = (jnp.zeros(m, jnp.int64),
+                              jnp.zeros(m, jnp.int64),
+                              jnp.zeros(m, jnp.bool_),
+                              jnp.zeros(m, jnp.bool_))
+                elif m < need:
+                    # grow past the target so growth stays logarithmic
+                    m = need * 2
+                    leftover, *tables = _rebuild_kernel(*tables, m)
+                    tables = tuple(tables)
+                    ctx.speculations.append((leftover, 0, None, None))
+                cols = proj.run(batch)
+                vcol = cols[-1]
+                gpair = ((cols[0].data, cols[0].validity) if has_grp
+                         else None)
+                kern = kern_for(self._key_dtypes[0] if has_grp else None,
+                                self._val_dtype)
+                flags, valid, leftover, *tables = kern(
+                    *tables, gpair, (vcol.data, vcol.validity),
+                    jnp.int32(batch.num_rows_raw), batch.padded_len)
+                tables = tuple(tables)
+                if not ctx.speculate:
+                    # exact-sizing re-run (or speculation disabled):
+                    # check synchronously and SELF-HEAL — grow the table
+                    # and replay this batch; already-inserted keys hit
+                    # and cannot double-flag, so OR-ing the flags is
+                    # exact. Loud failure if growth doesn't resolve it.
+                    for _ in range(3):
+                        if int(leftover) == 0:
+                            break
+                        m *= 4
+                        lo, *tables = _rebuild_kernel(*tables, m)
+                        if int(lo):
+                            raise RuntimeError(
+                                "distinct-flag rebuild exhausted probes")
+                        f2, _, leftover, *tables = kern(
+                            *tables, gpair, (vcol.data, vcol.validity),
+                            jnp.int32(batch.num_rows_raw),
+                            batch.padded_len)
+                        tables = tuple(tables)
+                        flags = flags | f2
+                    if int(leftover) != 0:
+                        raise RuntimeError(
+                            "distinct-flag probe exhaustion persists "
+                            "after 3 table growths")
+            if ctx.speculate:
+                # probe exhaustion is ~impossible (load <= 1/2); if it
+                # ever fires, the sink's speculation check triggers the
+                # plan re-run, which takes the synchronous self-healing
+                # path above (ctx.speculate is False on the re-run)
+                ctx.speculations.append((leftover, 0, None, None))
+            flags_m.add(batch.padded_len)
+            out_cols = list(batch.columns) + [
+                DeviceColumn(flags, valid, BOOL)]
+            yield ColumnarBatch(out_cols, batch.num_rows_raw,
+                                self._schema, meta=batch.meta)
+        tables = None     # release table HBM promptly at stream end
+
+
+class CpuDistinctFlagExec(TpuExec):
+    """Host twin (cost-reverted path): same flag semantics via a python
+    set over normalized (keys, value) tuples."""
+
+    is_tpu = False
+
+    def __init__(self, key_exprs, value_expr, flag_name: str, child):
+        super().__init__([child])
+        self.key_exprs = list(key_exprs)
+        self.value_expr = value_expr
+        self.flag_name = flag_name
+        cs = child.output_schema()
+        self._schema = Schema(list(cs.fields)
+                              + [StructField(flag_name, BOOL, True)])
+
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def describe(self):
+        k = ", ".join(e.name_hint for e in self.key_exprs)
+        return (f"CpuDistinctFlag[keys=[{k}], "
+                f"value={self.value_expr.name_hint}]")
+
+    @staticmethod
+    def _norm(v):
+        if v is None:
+            return None
+        if isinstance(v, float):
+            if v != v:
+                return "__nan__"
+            if v == 0.0:
+                return 0.0
+        return v
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        import pyarrow as pa
+        seen = set()
+        for batch in self.children[0].execute(ctx):
+            t = batch.to_arrow()
+            n = t.num_rows
+            keys = [e.eval_host(batch).to_pylist()
+                    for e in self.key_exprs]
+            vals = self.value_expr.eval_host(batch).to_pylist()
+            flags = np.zeros(n, np.bool_)
+            for i in range(n):
+                v = vals[i]
+                if v is None:
+                    continue
+                key = tuple(self._norm(k[i]) for k in keys) \
+                    + (self._norm(v),)
+                if key not in seen:
+                    seen.add(key)
+                    flags[i] = True
+            t = t.append_column(self.flag_name, pa.array(flags))
+            out = ColumnarBatch.from_arrow_host(t)
+            out.meta = batch.meta   # keep partition_id/input_file
+            yield out

@@ -310,6 +310,35 @@ class BranchAlign(LogicalPlan):
         return f"BranchAlign[n={self.n}]"
 
 
+class DistinctFlag(LogicalPlan):
+    """Appends a boolean column that is True on the stream-global FIRST
+    occurrence of each (key_exprs, value_expr) combination and False
+    elsewhere (NULL values never flag). Produced by the hash-distinct
+    rewrite (rewrites.py _rewrite_distinct_hash); executed by the
+    sort-free persistent-hash-table operator (exec/distinct_flag.py).
+    Reference analog: cudf's hash-based distinct aggregation that the
+    reference lowers count-distinct onto."""
+
+    def __init__(self, key_exprs: Sequence[Expression],
+                 value_expr: Expression, flag_name: str,
+                 child: LogicalPlan):
+        self.key_exprs = list(key_exprs)
+        self.value_expr = value_expr
+        self.flag_name = flag_name
+        self.children = [child]
+
+    def schema(self) -> Schema:
+        from ..types import BOOL
+        cs = self.children[0].schema()
+        return Schema(list(cs.fields)
+                      + [StructField(self.flag_name, BOOL, True)])
+
+    def describe(self):
+        k = ", ".join(e.name_hint for e in self.key_exprs)
+        return (f"DistinctFlag[keys=[{k}], "
+                f"value={self.value_expr.name_hint}]")
+
+
 class Generate(LogicalPlan):
     """Generator application: explode/posexplode/stack (ref GpuGenerateExec).
 

@@ -61,9 +61,14 @@ def plan_query(plan: L.LogicalPlan, conf: TpuConf, mesh=None,
         # single-pass) BEFORE pruning: the union rewrite keys on shared
         # scan identity, which pruning's per-branch copies would break.
         # The host oracle path keeps native semantics so differential
-        # tests check the rewrites themselves.
-        from .rewrites import rewrite_plan
-        plan = rewrite_plan(plan)
+        # tests check the rewrites themselves. The sort-free hash
+        # distinct applies only off-mesh: the distributed fragment
+        # compiler lowers the two-level Aggregate form, not the
+        # stateful DistinctFlag operator.
+        from .rewrites import HASH_DISTINCT_ENABLED, rewrite_plan
+        plan = rewrite_plan(
+            plan, hash_distinct=(mesh is None
+                                 and conf.get(HASH_DISTINCT_ENABLED)))
     plan = prune_columns(plan)
     meta = wrap_plan(plan, conf)
     meta.tag()
@@ -395,6 +400,28 @@ class ExpandMeta(PlanMeta):
 
     def convert_to_cpu(self, children):
         raise NotImplementedError("CPU expand fallback not implemented")
+
+
+@rule(L.DistinctFlag)
+class DistinctFlagMeta(PlanMeta):
+    def tag_self(self):
+        schema = self.plan.children[0].schema()
+        for e in self.plan.key_exprs + [self.plan.value_expr]:
+            r = e.fully_device_supported(schema)
+            if r:
+                self.will_not_work_on_tpu(f"distinct-flag <{e.name_hint}>: {r}")
+
+    def convert_to_tpu(self, children):
+        from ..exec.distinct_flag import HashDistinctFlagExec
+        p = self.plan
+        return HashDistinctFlagExec(p.key_exprs, p.value_expr,
+                                    p.flag_name, children[0])
+
+    def convert_to_cpu(self, children):
+        from ..exec.distinct_flag import CpuDistinctFlagExec
+        p = self.plan
+        return CpuDistinctFlagExec(p.key_exprs, p.value_expr,
+                                   p.flag_name, children[0])
 
 
 @rule(L.Generate)

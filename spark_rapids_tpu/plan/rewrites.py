@@ -31,7 +31,19 @@ from ..exprs.conditional import Coalesce
 from ..types import FLOAT64, INT64
 from . import logical as L
 
-__all__ = ["rewrite_plan", "prune_columns"]
+__all__ = ["rewrite_plan", "prune_columns", "HASH_DISTINCT_ENABLED"]
+
+from ..config import register
+
+HASH_DISTINCT_ENABLED = register(
+    "spark.rapids.tpu.sql.hashDistinct.enabled", True,
+    "Rewrite count/sum/avg(DISTINCT e) over fixed-width types into a "
+    "single-level aggregate guarded by a hash-table first-occurrence "
+    "flag (exec/distinct_flag.py) instead of the two-level sort "
+    "expansion — no lax.sort in any resulting kernel, so modules "
+    "compile in seconds and the whole pipeline dispatches without "
+    "per-batch syncs (ref: cudf hash-based distinct aggregation). "
+    "Applies only when the plan is not lowered onto a device mesh.")
 
 
 # ---------------------------------------------------------------------------
@@ -116,6 +128,14 @@ def prune_columns(plan: L.LogicalPlan,
             for o in plan.orders:
                 _expr_refs(o.expr, child_req)
         return rebuilt(plan, [prune_columns(plan.children[0], child_req)])
+    if isinstance(plan, L.DistinctFlag):
+        child_req = None if required is None \
+            else set(required) - {plan.flag_name}
+        if child_req is not None:
+            for e in plan.key_exprs:
+                _expr_refs(e, child_req)
+            _expr_refs(plan.value_expr, child_req)
+        return rebuilt(plan, [prune_columns(plan.children[0], child_req)])
     if isinstance(plan, (L.GlobalLimit, L.LocalLimit, L.Sample)):
         return rebuilt(plan, [prune_columns(plan.children[0], required)])
     if isinstance(plan, L.Repartition):
@@ -180,20 +200,29 @@ def estimated_size_bytes(plan: L.LogicalPlan) -> Optional[int]:
     return None
 
 
-def rewrite_plan(plan: L.LogicalPlan) -> L.LogicalPlan:
+def rewrite_plan(plan: L.LogicalPlan,
+                 hash_distinct: bool = False) -> L.LogicalPlan:
+    """``hash_distinct``: prefer the sort-free hash-table distinct flag
+    over the two-level sort expansion. The caller enables it only when
+    the plan will NOT lower onto a device mesh (the distributed fragment
+    compiler understands the two-level Aggregate form, not the stateful
+    DistinctFlag operator)."""
     if isinstance(plan, L.Union):
         new = _rewrite_union_agg(plan)
         if new is not None:
             # the single-pass form contains a (possibly distinct) grouped
             # aggregate that still needs the standard rewrites
-            return rewrite_plan(new)
-    new_children = [rewrite_plan(c) for c in plan.children]
+            return rewrite_plan(new, hash_distinct)
+    new_children = [rewrite_plan(c, hash_distinct)
+                    for c in plan.children]
     if any(n is not o for n, o in zip(new_children, plan.children)):
         plan = copy.copy(plan)
         plan.children = new_children
     if isinstance(plan, L.Aggregate) and any(
             getattr(a, "distinct", False) for a in plan.aggs):
-        new = _rewrite_distinct(plan)
+        new = _rewrite_distinct_hash(plan) if hash_distinct else None
+        if new is None:
+            new = _rewrite_distinct(plan)
         if new is not None:
             plan = new
     return plan
@@ -350,6 +379,53 @@ def _rewrite_union_agg(union: L.Union) -> Optional[L.LogicalPlan]:
     # several device dispatches on a latency-bound backend
     fill_zero = [isinstance(a, (AG.Count, AG.CountStar)) for a in a0]
     return L.BranchAlign(k, fill_zero, agg)
+
+
+#: fixed-width device-backed types whose bit patterns the hash-distinct
+#: table stores exactly (strings/decimals/arrays stay on the sort path)
+_HASHABLE_TYPE_NAMES = frozenset(
+    ["boolean", "tinyint", "smallint", "int", "bigint", "float",
+     "double", "date", "timestamp"])
+
+
+def _rewrite_distinct_hash(agg: L.Aggregate) -> Optional[L.LogicalPlan]:
+    """Sort-free distinct: ``count(DISTINCT e) GROUP BY g`` becomes
+    ``count(CASE WHEN __hd THEN e END) GROUP BY g`` over a DistinctFlag
+    operator marking first (g, e) occurrences via a persistent device
+    hash table (exec/distinct_flag.py). One level — non-distinct aggs
+    pass through untouched — and no lax.sort in any resulting module
+    (a sort's compile time multiplies with everything fused around it,
+    docs/performance.md r4). Applies to at most one grouping key and one
+    distinct child, both fixed-width numeric."""
+    cs = agg.children[0].schema()
+    d_keys = {a.child.key() for a in agg.aggs if a.distinct}
+    if len(d_keys) != 1 or len(agg.groupings) > 1:
+        return None
+    for a in agg.aggs:
+        if a.distinct and type(a) not in _DISTINCT_OK:
+            return None
+    d_expr = next(a.child for a in agg.aggs if a.distinct)
+    try:
+        if d_expr.data_type(cs).name not in _HASHABLE_TYPE_NAMES:
+            return None
+        for g in agg.groupings:
+            if g.data_type(cs).name not in _HASHABLE_TYPE_NAMES:
+                return None
+    except Exception:
+        return None
+    from ..exprs.conditional import CaseWhen
+    flag = "__hd_flag"
+    new_aggs = []
+    for a in agg.aggs:
+        if not a.distinct:
+            new_aggs.append(a)
+            continue
+        guarded = CaseWhen([(ColumnRef(flag), a.child)])
+        new_aggs.append(type(a)(guarded).with_name(a.name_hint))
+    flagged = L.DistinctFlag(list(agg.groupings), d_expr, flag,
+                             agg.children[0])
+    return L.Aggregate(agg.groupings, new_aggs, flagged,
+                       many_groups_hint=agg.many_groups_hint)
 
 
 def _rewrite_distinct(agg: L.Aggregate) -> Optional[L.LogicalPlan]:

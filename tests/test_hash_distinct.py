@@ -1,0 +1,142 @@
+"""Hash-distinct (sort-free count-distinct) tests: the DistinctFlag
+rewrite + persistent-hash-table operator (exec/distinct_flag.py) against
+the independent host engine (ref integration_tests hash_aggregate_test
+count-distinct cases)."""
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+import pytest
+
+from data_gen import DoubleGen, IntGen, gen_df
+from harness import assert_tpu_and_cpu_equal, tpu_session
+from spark_rapids_tpu.api import functions as F
+
+#: hash distinct only applies off-mesh (the distributed fragment
+#: compiler lowers the two-level sort form instead)
+_CONF = {"spark.rapids.tpu.distributed.enabled": False}
+
+
+def _flagged_tree(q):
+    tree = q._physical().tree_string()
+    assert "DistinctFlag" in tree, tree
+    return tree
+
+
+def test_hash_distinct_grouped_differential():
+    def q(s):
+        df = s.create_dataframe(
+            gen_df({"k": IntGen(lo=0, hi=7),
+                    "v": IntGen(lo=0, hi=200),
+                    "w": IntGen(nullable=False)}, n=6000, seed=5))
+        return (df.group_by("k")
+                .agg(F.count_distinct(F.col("v")).with_name("cd"),
+                     F.sum(F.col("w")).with_name("sw"),
+                     F.count_star().with_name("n")))
+    _flagged_tree(q(tpu_session(_CONF)))
+    assert_tpu_and_cpu_equal(q, conf=_CONF)
+
+
+def test_hash_distinct_global_and_sum_avg():
+    def q(s):
+        df = s.create_dataframe(
+            gen_df({"v": IntGen(lo=0, hi=50)}, n=3000, seed=6))
+        return df.agg(F.count_distinct(F.col("v")).with_name("cd"),
+                      F.sum_distinct(F.col("v")).with_name("sd"),
+                      F.avg_distinct(F.col("v")).with_name("ad"))
+    _flagged_tree(q(tpu_session(_CONF)))
+    assert_tpu_and_cpu_equal(q, conf=_CONF, approximate_float=True)
+
+
+def test_hash_distinct_float_nan_negzero_null():
+    """SQL distinct semantics: NULL ignored, NaN is ONE value,
+    -0.0 == 0.0 (the kernel canonicalizes bit patterns)."""
+    t = pa.table({
+        "k": pa.array([0, 0, 0, 0, 0, 1, 1, 1] * 64, pa.int64()),
+        "v": pa.array([1.5, float("nan"), float("nan"), -0.0, 0.0,
+                       None, float("nan"), 2.0] * 64),
+    })
+
+    def q(s):
+        return (s.create_dataframe(t).group_by("k")
+                .agg(F.count_distinct(F.col("v")).with_name("cd")))
+    got = {r["k"]: r["cd"] for r in q(tpu_session(_CONF)).collect()}
+    assert got == {0: 3, 1: 2}, got     # {1.5, nan, 0.0} / {nan, 2.0}
+    assert_tpu_and_cpu_equal(q, conf=_CONF)
+
+
+def test_hash_distinct_null_group_is_a_group():
+    def q(s):
+        df = s.create_dataframe(
+            gen_df({"k": IntGen(lo=0, hi=3, nullable=True),
+                    "v": IntGen(lo=0, hi=40)}, n=4000, seed=7))
+        return (df.group_by("k")
+                .agg(F.count_distinct(F.col("v")).with_name("cd")))
+    assert_tpu_and_cpu_equal(q, conf=_CONF)
+
+
+def test_hash_distinct_multi_batch_and_growth(monkeypatch):
+    """Cross-batch dedup through the persistent table, including the
+    grow/rebuild path (tiny initial table forces doubling)."""
+    from spark_rapids_tpu.exec.distinct_flag import HashDistinctFlagExec
+    monkeypatch.setattr(HashDistinctFlagExec, "_MIN_SLOTS", 1 << 10)
+
+    def q(s):
+        df = s.create_dataframe(
+            gen_df({"k": IntGen(lo=0, hi=5),
+                    "v": IntGen(lo=0, hi=100000)}, n=20000, seed=8),
+            num_partitions=8)
+        return (df.group_by("k")
+                .agg(F.count_distinct(F.col("v")).with_name("cd"),
+                     F.count(F.col("v")).with_name("c")))
+    assert_tpu_and_cpu_equal(
+        q, conf={**_CONF, "spark.rapids.tpu.sql.batchSizeRows": 4096})
+
+
+def test_hash_distinct_matches_sort_path():
+    """The hash rewrite and the two-level sort expansion must agree."""
+    df_t = gen_df({"k": IntGen(lo=0, hi=9),
+                   "v": DoubleGen(),
+                   "w": IntGen(nullable=False)}, n=8000, seed=9)
+    t = pa.Table.from_pandas(df_t)
+
+    def run(extra):
+        s = tpu_session({**_CONF, **extra})
+        return (s.create_dataframe(t).group_by("k")
+                .agg(F.count_distinct(F.col("v")).with_name("cd"),
+                     F.avg(F.col("w")).with_name("aw"))
+                .to_pandas().sort_values("k").reset_index(drop=True))
+    import pandas as pd
+    h = run({})
+    s_ = run({"spark.rapids.tpu.sql.hashDistinct.enabled": False})
+    pd.testing.assert_frame_equal(h, s_)
+
+
+def test_hash_distinct_string_value_stays_on_sort_path():
+    """Variable-width values can't live in the fixed-width hash table:
+    the rewrite must leave string distinct on the two-level path."""
+    t = pa.table({"k": pa.array([1, 1, 2] * 100, pa.int64()),
+                  "v": pa.array(["a", "b", "a"] * 100)})
+
+    def q(s):
+        return (s.create_dataframe(t).group_by("k")
+                .agg(F.count_distinct(F.col("v")).with_name("cd")))
+    tree = q(tpu_session(_CONF))._physical().tree_string()
+    assert "DistinctFlag" not in tree, tree
+    assert_tpu_and_cpu_equal(q, conf=_CONF)
+
+
+def test_hash_distinct_q28_shape():
+    """The union-of-aggregates + hash-distinct composition (TPC-DS q28):
+    six disjoint branches, one flag pass, no sort anywhere."""
+    import sys, os
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from benchmarks import tpcds
+    tab = tpcds.gen_store_sales(30000, seed=11)
+
+    def q(s):
+        return tpcds.q28(s.create_dataframe(tab), F)
+    tree = q(tpu_session(_CONF))._physical().tree_string()
+    assert "DistinctFlag" in tree, tree
+    assert "BranchAlign" in tree, tree
+    assert_tpu_and_cpu_equal(q, conf=_CONF, approximate_float=True,
+                             ignore_order=False)
