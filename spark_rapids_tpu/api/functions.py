@@ -191,6 +191,8 @@ def startswith(c, s) -> Col: return Col(E.StartsWith(_to_expr(c), s))
 def endswith(c, s) -> Col: return Col(E.EndsWith(_to_expr(c), s))
 def like(c, pattern) -> Col: return Col(E.Like(_to_expr(c), pattern))
 def rlike(c, pattern) -> Col: return Col(E.RLike(_to_expr(c), pattern))
+def replace(c, search: str, replacement: str = "") -> Col:
+    return Col(E.StringReplace(_to_expr(c), search, replacement))
 def regexp_replace(c, pattern, repl) -> Col:
     return Col(E.RegExpReplace(_to_expr(c), pattern, repl))
 def regexp_extract(c, pattern, group=1) -> Col:

@@ -106,6 +106,17 @@ BATCH_SIZE_ROWS = register(
     "Target max rows per columnar batch (shape-bucket ceiling; TPU-specific: "
     "bounds XLA recompilation via the bucket ladder).")
 
+AGG_WIDE_BATCH_ROWS = register(
+    "spark.rapids.tpu.sql.agg.wideBatchRows", 0,
+    "Batch-width ceiling for in-memory scans feeding a GLOBAL (no group "
+    "key) aggregation: such pipelines have no per-batch group-bucket "
+    "risk, and their steady-state cost is per-dispatch latency, so the "
+    "scan feeds the widest batches possible — one batch means the whole "
+    "query runs as ONE fused kernel dispatch + one fetch (ref "
+    "GpuAggregateExec.scala:718 first-pass concatenation). 0 = "
+    "unlimited (whole partition; the OOM retry-split machinery still "
+    "bounds memory); set a row count to cap batch width instead.")
+
 AUTO_BROADCAST_THRESHOLD = register(
     "spark.rapids.tpu.sql.autoBroadcastJoinThreshold", 10 * 1024 * 1024,
     "Equi-joins broadcast a side whose plan-time size estimate is at or "

@@ -367,12 +367,16 @@ class TpuProjectExec(TpuExec):
                 rchain = self.rect_chain.get(i)
                 if rchain is not None:
                     from ..columnar.strrect import ByteRectColumn
+                    from ..exprs.string_rect import RectUnsupported
                     expr, leaf = rchain
                     src = batch.column_by_name(leaf)
                     if isinstance(src, ByteRectColumn) and src.ascii_only:
-                        with ctx.semaphore.held():
-                            out[i] = self._rect_eval(expr, src, i)
-                        continue
+                        try:
+                            with ctx.semaphore.held():
+                                out[i] = self._rect_eval(expr, src, i)
+                            continue
+                        except RectUnsupported:
+                            pass    # this batch's widths: host fallback
                 arr = self.exprs[i].eval_host(batch)
                 dt = self._schema.fields[i].dtype
                 if dt.device_backed:
@@ -388,13 +392,19 @@ class TpuProjectExec(TpuExec):
 
     def describe(self):
         tags = []
-        plain_host = [i for i in self.host_idx if i not in self.dict_chain]
+        plain_host = [i for i in self.host_idx
+                      if i not in self.dict_chain
+                      and i not in self.rect_chain]
         if plain_host:
             tags.append("host_fallback="
                         f"{[self.exprs[i].name_hint for i in plain_host]}")
         if self.dict_chain:
             tags.append("dict_transform="
                         f"{[self.exprs[i].name_hint for i in self.dict_chain]}")
+        rect_only = [i for i in self.rect_chain if i not in self.dict_chain]
+        if rect_only:
+            tags.append("rect_device="
+                        f"{[self.exprs[i].name_hint for i in rect_only]}")
         return ("Project[" + ", ".join(e.name_hint for e in self.exprs) + "]"
                 + (" " + " ".join(tags) if tags else ""))
 

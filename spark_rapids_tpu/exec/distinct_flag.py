@@ -240,7 +240,14 @@ class HashDistinctFlagExec(TpuExec):
                     m = need * 2
                     leftover, *tables = _rebuild_kernel(*tables, m)
                     tables = tuple(tables)
-                    ctx.speculations.append((leftover, 0, None, None))
+                    if ctx.speculate:
+                        ctx.speculations.append((leftover, 0, None,
+                                                 None))
+                    elif int(leftover):
+                        # non-speculative path has no deferred check:
+                        # validate the rebuild synchronously
+                        raise RuntimeError(
+                            "distinct-flag rebuild exhausted probes")
                 cols = proj.run(batch)
                 vcol = cols[-1]
                 gpair = ((cols[0].data, cols[0].validity) if has_grp

@@ -48,6 +48,9 @@ class _HostStringExpr(Expression):
 
 
 class Length(_HostStringExpr):
+    #: device byte-rectangle kernel available (exprs/string_rect.py;
+    #: ASCII-gated, see rect_supported_op for per-instance conditions)
+    rect_device = True
     def __init__(self, child):
         self.children = [child]
 
@@ -62,6 +65,9 @@ class Length(_HostStringExpr):
 
 
 class Upper(_HostStringExpr):
+    #: device byte-rectangle kernel available (exprs/string_rect.py;
+    #: ASCII-gated, see rect_supported_op for per-instance conditions)
+    rect_device = True
     dict_transform = True
     def __init__(self, child):
         self.children = [child]
@@ -75,6 +81,9 @@ class Upper(_HostStringExpr):
 
 
 class Lower(_HostStringExpr):
+    #: device byte-rectangle kernel available (exprs/string_rect.py;
+    #: ASCII-gated, see rect_supported_op for per-instance conditions)
+    rect_device = True
     dict_transform = True
     def __init__(self, child):
         self.children = [child]
@@ -89,6 +98,9 @@ class Lower(_HostStringExpr):
 
 class Substring(_HostStringExpr):
     """Spark substring: 1-based, pos 0 treated as 1, negative from end."""
+    #: device byte-rectangle kernel available (exprs/string_rect.py;
+    #: ASCII-gated, see rect_supported_op for per-instance conditions)
+    rect_device = True
     dict_transform = True
 
     def __init__(self, child, pos: int, length: Optional[int] = None):
@@ -192,12 +204,18 @@ class _PatternPredicate(_HostStringExpr):
 
 
 class Contains(_PatternPredicate):
+    #: device byte-rectangle kernel available (exprs/string_rect.py;
+    #: ASCII-gated, see rect_supported_op for per-instance conditions)
+    rect_device = True
     def host_mask(self, arr):
         import pyarrow.compute as pc
         return pc.match_substring(arr, self.pattern)
 
 
 class StartsWith(_PatternPredicate):
+    #: device byte-rectangle kernel available (exprs/string_rect.py;
+    #: ASCII-gated, see rect_supported_op for per-instance conditions)
+    rect_device = True
     dict_form = "range"     # prefix match == code range on a sorted dict
 
     def host_mask(self, arr):
@@ -206,6 +224,9 @@ class StartsWith(_PatternPredicate):
 
 
 class EndsWith(_PatternPredicate):
+    #: device byte-rectangle kernel available (exprs/string_rect.py;
+    #: ASCII-gated, see rect_supported_op for per-instance conditions)
+    rect_device = True
     def host_mask(self, arr):
         import pyarrow.compute as pc
         return pc.ends_with(arr, self.pattern)
@@ -213,11 +234,19 @@ class EndsWith(_PatternPredicate):
 
 class Like(_PatternPredicate):
     """SQL LIKE (ref GpuLike)."""
+    #: device byte-rectangle kernel available (exprs/string_rect.py;
+    #: ASCII-gated, see rect_supported_op for per-instance conditions)
+    rect_device = True
 
     def __init__(self, child, pattern: str, escape: str = "\\"):
         super().__init__(child, pattern)
+        self.escape = escape
         from .regex_transpiler import sql_like_to_regex
         self._regex = sql_like_to_regex(pattern, escape)
+
+    def key(self):
+        return (f"Like({self.children[0].key()},{self.pattern!r},"
+                f"{self.escape!r})")
 
     def host_mask(self, arr):
         import pyarrow.compute as pc
@@ -324,18 +353,30 @@ class _TrimBase(_HostStringExpr):
 
 
 class StringTrim(_TrimBase):
+    #: device byte-rectangle kernel available (exprs/string_rect.py;
+    #: ASCII-gated, see rect_supported_op for per-instance conditions)
+    rect_device = True
     pc_fn = "utf8_trim_whitespace"
 
 
 class StringTrimLeft(_TrimBase):
+    #: device byte-rectangle kernel available (exprs/string_rect.py;
+    #: ASCII-gated, see rect_supported_op for per-instance conditions)
+    rect_device = True
     pc_fn = "utf8_ltrim_whitespace"
 
 
 class StringTrimRight(_TrimBase):
+    #: device byte-rectangle kernel available (exprs/string_rect.py;
+    #: ASCII-gated, see rect_supported_op for per-instance conditions)
+    rect_device = True
     pc_fn = "utf8_rtrim_whitespace"
 
 
 class StringReplace(_HostStringExpr):
+    #: device byte-rectangle kernel available (exprs/string_rect.py;
+    #: ASCII-gated, see rect_supported_op for per-instance conditions)
+    rect_device = True
     dict_transform = True
     def __init__(self, child, search: str, replace: str):
         self.children = [child]
@@ -357,6 +398,9 @@ class StringReplace(_HostStringExpr):
 
 class StringLocate(_HostStringExpr):
     """locate(substr, str): 1-based, 0 if absent (ref GpuStringLocate)."""
+    #: device byte-rectangle kernel available (exprs/string_rect.py;
+    #: ASCII-gated, see rect_supported_op for per-instance conditions)
+    rect_device = True
 
     def __init__(self, substr: str, child):
         self.children = [child]
@@ -383,6 +427,9 @@ class StringLocate(_HostStringExpr):
 
 
 class Lpad(_HostStringExpr):
+    #: device byte-rectangle kernel available (exprs/string_rect.py;
+    #: ASCII-gated, see rect_supported_op for per-instance conditions)
+    rect_device = True
     dict_transform = True
     def __init__(self, child, length: int, pad: str = " "):
         self.children = [child]
@@ -395,7 +442,15 @@ class Lpad(_HostStringExpr):
     def eval_host(self, batch):
         import pyarrow.compute as pc
         arr = self.children[0].eval_host(batch)
-        padded = pc.utf8_lpad(arr, self.length, padding=self.pad)
+        if len(self.pad) == 1:
+            padded = pc.utf8_lpad(arr, self.length, padding=self.pad)
+        else:
+            # Arrow pads single codepoints only; Spark pads cyclically
+            import pyarrow as pa
+            L, p = self.length, self.pad
+            padded = _py_row_map(
+                arr, lambda v: ((p * L)[:max(L - len(v), 0)] + v),
+                pa.string())
         # Spark truncates to length
         return pc.utf8_slice_codeunits(padded, 0, self.length)
 
@@ -407,7 +462,14 @@ class Rpad(Lpad):
     def eval_host(self, batch):
         import pyarrow.compute as pc
         arr = self.children[0].eval_host(batch)
-        padded = pc.utf8_rpad(arr, self.length, padding=self.pad)
+        if len(self.pad) == 1:
+            padded = pc.utf8_rpad(arr, self.length, padding=self.pad)
+        else:
+            import pyarrow as pa
+            L, p = self.length, self.pad
+            padded = _py_row_map(
+                arr, lambda v: v + (p * L)[:max(L - len(v), 0)],
+                pa.string())
         return pc.utf8_slice_codeunits(padded, 0, self.length)
 
     def key(self):
@@ -415,6 +477,9 @@ class Rpad(Lpad):
 
 
 class Reverse(_HostStringExpr):
+    #: device byte-rectangle kernel available (exprs/string_rect.py;
+    #: ASCII-gated, see rect_supported_op for per-instance conditions)
+    rect_device = True
     dict_transform = True
     def __init__(self, child):
         self.children = [child]
@@ -520,6 +585,9 @@ class StringSplit(_HostStringExpr):
 
 
 class SubstringIndex(_HostStringExpr):
+    #: device byte-rectangle kernel available (exprs/string_rect.py;
+    #: ASCII-gated, see rect_supported_op for per-instance conditions)
+    rect_device = True
     dict_transform = True
     """substring_index(str, delim, count) (ref GpuSubstringIndexUtils JNI)."""
 
@@ -699,6 +767,9 @@ class OctetLength(_HostStringExpr):
 class StringInstr(_HostStringExpr):
     """instr(str, substr): 1-based first occurrence, 0 if absent (ref
     GpuStringInstr — locate with fixed start=1)."""
+    #: device byte-rectangle kernel available (exprs/string_rect.py;
+    #: ASCII-gated, see rect_supported_op for per-instance conditions)
+    rect_device = True
 
     def __init__(self, child, substr):
         self.children = [child, substr]

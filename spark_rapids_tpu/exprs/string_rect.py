@@ -13,8 +13,14 @@ semantics beyond ASCII fall back to the host path honestly rather than
 being silently wrong.
 
 Supported chain ops (STRING -> STRING): Upper, Lower, StringTrim(L/R)
-(whitespace only), Substring (pos >= 0, fixed length); terminals:
-Length (STRING -> INT), Contains/StartsWith/EndsWith (STRING -> BOOL).
+(whitespace only), Substring (pos >= 0, fixed length), StringReplace,
+Lpad/Rpad, SubstringIndex, Reverse; terminals: Length (STRING -> INT),
+StringLocate/StringInstr (STRING -> INT),
+Contains/StartsWith/EndsWith/Like (STRING -> BOOL).
+
+All kernels are scatter/gather + unrolled static shifts — no lax.sort
+(a sort's compile time multiplies with its module on this backend,
+docs/performance.md r4) and no per-row host work.
 """
 from __future__ import annotations
 
@@ -26,7 +32,14 @@ import numpy as np
 from ..types import BOOL, INT32, STRING, Schema
 from .base import ColumnRef, DVal, Expression, StrVal
 
-__all__ = ["rect_chain_leaf", "eval_rect_expr", "rect_supported_op"]
+__all__ = ["rect_chain_leaf", "eval_rect_expr", "rect_supported_op",
+           "RectUnsupported"]
+
+
+class RectUnsupported(Exception):
+    """Raised at kernel-trace time when a rect op cannot run for THIS
+    batch's concrete widths (e.g. a growing replace past the width
+    cap): the caller falls back to host evaluation for the batch."""
 
 
 def _live(sv: StrVal):
@@ -161,37 +174,253 @@ def _contains(sv: StrVal, pat: bytes):
     return out
 
 
+def _take_shift(b, start):
+    """Gather-based left shift by a per-row (traced) start offset; reads
+    past the width land on a zero column."""
+    w = b.shape[1]
+    j = jnp.arange(w, dtype=jnp.int32)[None, :]
+    src = j + start[:, None]
+    bx = jnp.pad(b, ((0, 0), (0, 1)))
+    return jnp.take_along_axis(bx, jnp.clip(src, 0, w), axis=1)
+
+
+def _select_nonoverlap(b, ln, pat: np.ndarray):
+    """Greedy left-to-right NON-OVERLAPPING occurrences of ``pat``
+    (java String semantics shared by replace/split): sel[p, j] marks
+    occurrence starts, cum[p, j] counts occurrences at positions <= j.
+    Sequential in j but unrolled over the static width — vector ops
+    only, no per-row code."""
+    w = b.shape[1]
+    rows = b.shape[0]
+    L = len(pat)
+    match = []
+    for j in range(w):
+        if j + L <= w:
+            match.append(jnp.logical_and(_match_at(b, None, pat, j),
+                                         ln >= j + L))
+        else:
+            match.append(jnp.zeros(rows, bool))
+    next_free = jnp.zeros(rows, jnp.int32)
+    sels = []
+    for j in range(w):
+        s = jnp.logical_and(match[j], next_free <= j)
+        next_free = jnp.where(s, j + L, next_free)
+        sels.append(s)
+    sel = jnp.stack(sels, axis=1)
+    cum = jnp.cumsum(sel.astype(jnp.int32), axis=1)
+    return sel, cum
+
+
+#: replacement-literal length cap: each replacement byte is one scatter
+#: in the fused kernel
+_REPLACE_MAX = 32
+#: static pad-target cap (HBM is rows*width)
+_PAD_MAX = 256
+
+
+def _replace(sv: StrVal, search: bytes, replace: bytes) -> StrVal:
+    """replace(str, search, replace): non-overlapping left-to-right, may
+    grow the rectangle (bounded by W//len(search) occurrences)."""
+    b, ln = sv.bytes_, sv.lengths
+    rows, w = b.shape
+    s = np.frombuffer(search, np.uint8)
+    r = np.frombuffer(replace, np.uint8)
+    l1, l2 = len(s), len(r)
+    if l1 == 0:
+        return sv                       # Spark: empty search is identity
+    sel, _ = _select_nonoverlap(b, ln, s)
+    # covered: inside a selected occurrence, not at its start
+    cov = jnp.zeros_like(sel)
+    for k in range(1, min(l1, w)):
+        cov = jnp.logical_or(cov, jnp.pad(sel[:, :-k], ((0, 0), (k, 0))))
+    live = _live(sv)
+    emit = jnp.where(sel, l2,
+                     jnp.where(jnp.logical_or(cov, ~live), 0, 1)) \
+        .astype(jnp.int32)
+    outpos = jnp.cumsum(emit, axis=1) - emit        # exclusive
+    new_len = outpos[:, -1] + emit[:, -1]
+    w_need = w + max(0, l2 - l1) * (w // l1)
+    from ..columnar.strrect import rect_width_bucket
+    wo = rect_width_bucket(max(w_need, 1), 1 << 20)
+    if wo is None:      # grown width past the cap: host handles it
+        raise RectUnsupported(f"replace output width {w_need}")
+    rowix = jnp.arange(rows, dtype=jnp.int32)[:, None]
+    out = jnp.zeros((rows, wo + 1), jnp.uint8)      # col wo = dump slot
+    copy_idx = jnp.where(
+        jnp.logical_or(sel, jnp.logical_or(cov, ~live)), wo, outpos)
+    out = out.at[rowix, copy_idx].set(b, mode="drop")
+    for k in range(l2):
+        rep_idx = jnp.where(sel, outpos + k, wo)
+        out = out.at[rowix, rep_idx].set(jnp.uint8(r[k]), mode="drop")
+    return StrVal(_zero_tail(out[:, :wo], new_len), new_len)
+
+
+def _pad(sv: StrVal, valid, length: int, pad: bytes, left: bool) -> StrVal:
+    """lpad/rpad to a STATIC length with a cyclic pad pattern; longer
+    inputs keep their prefix (Spark semantics). Invalid rows stay
+    all-zero (the rectangle convention grouping relies on)."""
+    b, ln = sv.bytes_, sv.lengths
+    rows, w = b.shape
+    p = np.frombuffer(pad, np.uint8)
+    lp = len(p)
+    from ..columnar.strrect import rect_width_bucket
+    wo = rect_width_bucket(max(length, 1), 1 << 20)
+    bx = b if wo <= w else jnp.pad(b, ((0, 0), (0, wo - w)))
+    bx = bx[:, :wo]
+    j = jnp.arange(wo, dtype=jnp.int32)[None, :]
+    pad_full = jnp.asarray(np.resize(p, wo))        # pad[j % lp] table
+    if left:
+        shift = jnp.maximum(length - ln, 0)[:, None]
+        src = j - shift
+        bpad = jnp.pad(bx, ((0, 0), (0, 1)))
+        orig = jnp.take_along_axis(bpad, jnp.clip(src, 0, wo), axis=1)
+        out = jnp.where(src >= 0, orig, pad_full[None, :])
+    else:
+        out = jnp.where(j < ln[:, None], bx,
+                        pad_full[jnp.clip(j - ln[:, None], 0, wo - 1)])
+    new_len = jnp.where(valid, jnp.int32(length), 0)
+    return StrVal(_zero_tail(out, new_len), new_len)
+
+
+def _locate(sv: StrVal, sub: bytes):
+    """1-based first occurrence, 0 when absent (byte == char: ASCII)."""
+    b, ln = sv.bytes_, sv.lengths
+    rows, w = b.shape
+    p = np.frombuffer(sub, np.uint8)
+    L = len(p)
+    if L == 0:
+        return jnp.ones(rows, jnp.int32)   # Spark: locate('', s) == 1
+    pos = jnp.zeros(rows, jnp.int32)
+    found = jnp.zeros(rows, bool)
+    for s in range(0, max(w - L + 1, 0)):
+        m = jnp.logical_and(_match_at(b, None, p, s), ln >= s + L)
+        pos = jnp.where(jnp.logical_and(~found, m), s + 1, pos)
+        found = jnp.logical_or(found, m)
+    return pos
+
+
+def _like_parts(pattern: str):
+    """(form, literal) for rectangle-supported LIKE patterns: leading/
+    trailing %% around one literal (prefix/suffix/contains/exact).
+    None for '_', escapes, interior %%, or non-ASCII."""
+    if "_" in pattern or "\\" in pattern:
+        return None
+    try:
+        pattern.encode("ascii")
+    except UnicodeEncodeError:
+        return None
+    lead = pattern.startswith("%")
+    trail = pattern.endswith("%")
+    mid = pattern.strip("%")
+    if "%" in mid:
+        return None
+    if lead and trail:
+        return ("contains", mid)
+    if lead:
+        return ("endswith", mid)
+    if trail:
+        return ("startswith", mid)
+    return ("equals", mid)
+
+
+def _equals(sv: StrVal, pat: bytes):
+    p = np.frombuffer(pat, np.uint8)
+    return jnp.logical_and(sv.lengths == len(p),
+                           _match_at(sv.bytes_, None, p, 0))
+
+
+def _substring_index(sv: StrVal, delim: bytes, count: int) -> StrVal:
+    """substring_index: prefix before the count-th delimiter (count>0)
+    or suffix after the |count|-th-from-last (count<0); whole string
+    when there are fewer delimiters."""
+    b, ln = sv.bytes_, sv.lengths
+    rows, w = b.shape
+    d = np.frombuffer(delim, np.uint8)
+    L = len(d)
+    if count == 0:
+        z = jnp.zeros_like(ln)
+        return StrVal(jnp.zeros_like(b), z)
+    sel, cum = _select_nonoverlap(b, ln, d)
+    j = jnp.arange(w, dtype=jnp.int32)[None, :]
+    if count > 0:
+        mask = jnp.logical_and(sel, cum == count)
+        cut = jnp.where(mask, j, w).min(axis=1)
+        new_len = jnp.minimum(ln, cut)
+        return StrVal(_zero_tail(b, new_len), new_len)
+    target = cum[:, -1] + count + 1     # 1-based boundary occurrence
+    mask = jnp.logical_and(sel, cum == target[:, None])
+    start = jnp.where(mask, j, 0).max(axis=1) + L
+    start = jnp.where(target >= 1, start, 0)
+    new_len = jnp.maximum(ln - start, 0)
+    return StrVal(_zero_tail(_take_shift(b, start), new_len), new_len)
+
+
+def _reverse(sv: StrVal) -> StrVal:
+    b, ln = sv.bytes_, sv.lengths
+    w = b.shape[1]
+    j = jnp.arange(w, dtype=jnp.int32)[None, :]
+    src = ln[:, None] - 1 - j
+    bx = jnp.pad(b, ((0, 0), (0, 1)))
+    out = jnp.take_along_axis(bx, jnp.clip(src, 0, w), axis=1)
+    return StrVal(_zero_tail(out, ln), ln)
+
+
 # ---------------------------------------------------------------------------
 # expression bridge
 # ---------------------------------------------------------------------------
 
+def _ascii(s: str) -> Optional[bytes]:
+    try:
+        return s.encode("ascii")
+    except UnicodeEncodeError:
+        return None
+
+
 def rect_supported_op(e: Expression) -> bool:
-    from .string_fns import (Contains, EndsWith, Length, Lower, StartsWith,
-                             StringTrim, StringTrimLeft, StringTrimRight,
-                             Substring, Upper)
-    if isinstance(e, (Upper, Lower)):
+    from .base import Literal
+    from .string_fns import (Contains, EndsWith, Length, Like, Lower, Lpad,
+                             Reverse, StartsWith, StringInstr, StringLocate,
+                             StringReplace, StringTrim, StringTrimLeft,
+                             StringTrimRight, SubstringIndex, Substring,
+                             Upper)
+    if isinstance(e, (Upper, Lower, Length, Reverse)):
         return True
     if isinstance(e, (StringTrim, StringTrimLeft, StringTrimRight)):
         return e.chars is None           # whitespace-only trim
     if isinstance(e, Substring):
         return e.pos >= 0                # negative pos: from-end (host)
-    if isinstance(e, Length):
-        return True
+    if isinstance(e, Like):
+        # _like_parts rejects any '\\' in the pattern, so the default
+        # escape can never fire on an accepted pattern; a CUSTOM escape
+        # char would change the parse -> host
+        return e.escape == "\\" and _like_parts(e.pattern) is not None
     if isinstance(e, (Contains, StartsWith, EndsWith)):
-        try:
-            e.pattern.encode("ascii")
-        except UnicodeEncodeError:
-            return False
-        return True
+        return _ascii(e.pattern) is not None
+    if isinstance(e, StringReplace):
+        return (_ascii(e.search) is not None and len(e.search) >= 1
+                and _ascii(e.replace) is not None
+                and len(e.replace) <= _REPLACE_MAX)
+    if isinstance(e, (Lpad,)):           # covers Rpad subclass
+        return (0 < e.length <= _PAD_MAX and len(e.pad) >= 1
+                and _ascii(e.pad) is not None)
+    if isinstance(e, StringLocate):
+        return _ascii(e.substr) is not None
+    if isinstance(e, StringInstr):
+        sub = e.children[1]
+        return (isinstance(sub, Literal) and isinstance(sub.value, str)
+                and _ascii(sub.value) is not None)
+    if isinstance(e, SubstringIndex):
+        return len(e.delim) >= 1 and _ascii(e.delim) is not None
     return False
 
 
 def rect_chain_leaf(e: Expression, schema: Schema) -> Optional[str]:
     """Leaf column name when ``e`` is a chain of rect-supported ops over
-    one STRING ColumnRef, else None."""
+    one STRING ColumnRef, else None. StringInstr carries its substring
+    as a Literal second child — the chain continues through child 0."""
     cur = e
     hops = 0
-    while rect_supported_op(cur) and len(cur.children) == 1:
+    while rect_supported_op(cur) and len(cur.children) >= 1:
         cur = cur.children[0]
         hops += 1
     if hops and isinstance(cur, ColumnRef) \
@@ -203,9 +432,11 @@ def rect_chain_leaf(e: Expression, schema: Schema) -> Optional[str]:
 
 def eval_rect_expr(e: Expression, child: DVal) -> DVal:
     """Evaluate one rect-supported op over a StrVal-typed DVal (traced)."""
-    from .string_fns import (Contains, EndsWith, Length, Lower, StartsWith,
-                             StringTrim, StringTrimLeft, StringTrimRight,
-                             Substring, Upper)
+    from .string_fns import (Contains, EndsWith, Length, Like, Lower, Lpad,
+                             Reverse, Rpad, StartsWith, StringInstr,
+                             StringLocate, StringReplace, StringTrim,
+                             StringTrimLeft, StringTrimRight,
+                             SubstringIndex, Substring, Upper)
     sv: StrVal = child.data
     v = child.validity
     if isinstance(e, Upper):
@@ -229,6 +460,30 @@ def eval_rect_expr(e: Expression, child: DVal) -> DVal:
         return DVal(_endswith(sv, e.pattern.encode()), v, BOOL)
     if isinstance(e, Contains):
         return DVal(_contains(sv, e.pattern.encode()), v, BOOL)
+    if isinstance(e, Like):
+        form, lit = _like_parts(e.pattern)
+        p = lit.encode()
+        fn = {"contains": _contains, "startswith": _startswith,
+              "endswith": _endswith, "equals": _equals}[form]
+        return DVal(fn(sv, p), v, BOOL)
+    if isinstance(e, StringReplace):
+        return DVal(_replace(sv, e.search.encode(), e.replace.encode()),
+                    v, STRING)
+    if isinstance(e, Rpad):
+        return DVal(_pad(sv, v, e.length, e.pad.encode(), False), v,
+                    STRING)
+    if isinstance(e, Lpad):
+        return DVal(_pad(sv, v, e.length, e.pad.encode(), True), v,
+                    STRING)
+    if isinstance(e, StringLocate):
+        return DVal(_locate(sv, e.substr.encode()), v, INT32)
+    if isinstance(e, StringInstr):
+        return DVal(_locate(sv, e.children[1].value.encode()), v, INT32)
+    if isinstance(e, SubstringIndex):
+        return DVal(_substring_index(sv, e.delim.encode(), e.count), v,
+                    STRING)
+    if isinstance(e, Reverse):
+        return DVal(_reverse(sv), v, STRING)
     raise NotImplementedError(type(e).__name__)
 
 

@@ -274,6 +274,8 @@ class AggregateMeta(PlanMeta):
     def convert_to_tpu(self, children):
         hint = getattr(self.plan, "many_groups_hint", False)
         child, stages, eval_schema = self._fold_stages(children[0])
+        if not self.plan.groupings:
+            self._widen_scan_batches(child if stages else children[0])
         if stages:
             return A.TpuHashAggregateExec(self.plan.groupings,
                                           self.plan.aggs, child,
@@ -282,6 +284,25 @@ class AggregateMeta(PlanMeta):
                                           many_groups_hint=hint)
         return A.TpuHashAggregateExec(self.plan.groupings, self.plan.aggs,
                                       children[0], many_groups_hint=hint)
+
+    def _widen_scan_batches(self, node):
+        """A GLOBAL aggregation's steady-state cost is per-dispatch
+        latency (the update kernel is elementwise + reductions): feed it
+        the widest batches the memory runtime allows. A single input
+        batch upgrades the whole query to the fused one-dispatch
+        one-fetch path (_fast_single_batch). Group-keyed aggregations
+        keep the default width — wider batches would inflate their
+        per-batch group buckets."""
+        from ..config import AGG_WIDE_BATCH_ROWS
+        from ..exec.distinct_flag import HashDistinctFlagExec
+        wide = int(self.conf.get(AGG_WIDE_BATCH_ROWS))
+        while isinstance(node, (B.TpuFilterExec, B.TpuProjectExec,
+                                HashDistinctFlagExec)):
+            node = node.children[0]
+        if isinstance(node, B.InMemoryScanExec):
+            if wide <= 0:
+                wide = max((t.num_rows for t in node.tables), default=0)
+            node.batch_rows = max(node.batch_rows, wide, 1)
 
     def _fold_stages(self, child):
         """Fold a chain of device-only Filter/Project execs below the

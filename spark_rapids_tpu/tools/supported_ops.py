@@ -64,10 +64,34 @@ def _load_registries():
         importlib.import_module(f"spark_rapids_tpu.exprs.{m}")
     for m in _EXEC_MODULES:
         importlib.import_module(f"spark_rapids_tpu.exec.{m}")
-    importlib.import_module("spark_rapids_tpu.shuffle.exchange")
-    importlib.import_module("spark_rapids_tpu.shuffle.broadcast")
-    importlib.import_module("spark_rapids_tpu.io.parquet")
-    importlib.import_module("spark_rapids_tpu.io.text")
+    # modules whose register() calls run at import: EVERY one must be
+    # loaded or docs/configs.md silently drops live confs (the generated
+    # doc is only honest if this list is complete)
+    for m in ["spark_rapids_tpu.shuffle.exchange",
+              "spark_rapids_tpu.shuffle.broadcast",
+              "spark_rapids_tpu.shuffle.cluster",
+              "spark_rapids_tpu.io.parquet",
+              "spark_rapids_tpu.io.text",
+              "spark_rapids_tpu.io.filecache",
+              "spark_rapids_tpu.columnar.strrect",
+              "spark_rapids_tpu.columnar.transfer",
+              "spark_rapids_tpu.exec.distinct_flag",
+              "spark_rapids_tpu.plan.rewrites",
+              "spark_rapids_tpu.plan.cost",
+              "spark_rapids_tpu.plan.stats_store",
+              "spark_rapids_tpu.parallel.planner",
+              "spark_rapids_tpu.mem.manager",
+              "spark_rapids_tpu.mem.semaphore",
+              "spark_rapids_tpu.aux.profiler",
+              "spark_rapids_tpu.aux.lore",
+              "spark_rapids_tpu.aux.fault",
+              "spark_rapids_tpu.udf.compiler",
+              "spark_rapids_tpu.delta.table",
+              "spark_rapids_tpu.api.session"]:
+        try:
+            importlib.import_module(m)
+        except ImportError:  # optional subsystem absent: skip its confs
+            pass
 
 
 def expression_inventory() -> List[Dict]:
@@ -100,6 +124,12 @@ def expression_inventory() -> List[Dict]:
             "context": "aggregation" if is_agg else "project",
             "device": has_device,
             "host": has_host,
+            # device byte-rectangle kernel (exprs/string_rect.py,
+            # ASCII-gated): a REAL device path, reported so the doc
+            # stays the single honest source of truth (the reference's
+            # TypeChecks discipline, TypeChecks.scala:757)
+            "rect": bool(getattr(cls, "rect_device", False)),
+            "dict": bool(getattr(cls, "dict_transform", False)),
             "types": {t: (t in sig.types) for t in TYPE_COLUMNS},
             "notes": dict(sig.notes),
         })
@@ -131,7 +161,9 @@ def fallback_histogram(exprs=None) -> List[Tuple[str, int, List[str]]]:
     import collections
     groups: Dict[str, List[str]] = collections.defaultdict(list)
     for r in (expression_inventory() if exprs is None else exprs):
-        if r["device"]:
+        if r["device"] or r["rect"]:
+            # rect-capable string ops run device-side on ASCII
+            # rectangle columns — not host-only
             continue
         mod = r["module"]
         if mod == "string_fns":
@@ -160,13 +192,17 @@ def generate_supported_ops_md() -> str:
            "S = supported on device, NS = not supported (host fallback), "
            "PS = partial (see note).", ""]
     n_dev = sum(1 for r in exprs if r["device"])
-    n_host = sum(1 for r in exprs if not r["device"])
+    n_rect = sum(1 for r in exprs if not r["device"] and r["rect"])
+    n_host = sum(1 for r in exprs
+                 if not r["device"] and not r["rect"])
     out += ["## Coverage summary", "",
             f"* **{len(exprs)}** expressions registered "
             f"(reference registry: ~224 rules, GpuOverrides.scala:3935)",
-            f"* **{n_dev}** evaluate on device, **{n_host}** are "
-            "host-only", f"* **{len(execs)}** operators", "",
-            "### Host-fallback reasons", ""]
+            f"* **{n_dev}** evaluate on device, **{n_rect}** more run "
+            "device-side over byte rectangles (ASCII columns; "
+            "dictionary/host fallback otherwise), **"
+            f"{n_host}** are host-only", f"* **{len(execs)}** operators",
+            "", "### Host-fallback reasons", ""]
     for cat, n, names in fallback_histogram(exprs):
         out.append(f"* {n} × {cat}: {', '.join(names)}")
     out.append("")
@@ -186,6 +222,8 @@ def generate_supported_ops_md() -> str:
     for r in exprs:
         eng = ("device+host" if r["device"] and r["host"]
                else ("device" if r["device"] else "host"))
+        if not r["device"] and r["rect"]:
+            eng = "device(rect,ascii)+host"
         cells = []
         for t in TYPE_COLUMNS:
             if r["types"][t]:
