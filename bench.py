@@ -231,6 +231,27 @@ def main():
         if "s" not in _big:
             _big["s"] = tpcds.gen_store_sales(nbig)
         return _big["s"]
+
+    nhuge = int(os.environ.get("SRTPU_BENCH_HUGE_ROWS", 100_000_000))
+
+    def store_sales_huge():
+        # SF100-class rung (BASELINE.md config #3 ladder): generated
+        # COLUMN-PRUNED (q9 touches 3 of the 12 columns; the full table
+        # would be ~10 GB host RAM for nothing) and only if the budget
+        # survives to the last rung
+        if "h" not in _big:
+            _big.pop("s", None)       # reclaim the 10M table first
+            import pyarrow as pa
+            rng = np.random.RandomState(7)
+            _big["h"] = pa.table({
+                "ss_quantity": pa.array(
+                    rng.randint(1, 101, nhuge)),
+                "ss_ext_sales_price": pa.array(
+                    np.round(rng.uniform(1.0, 20000.0, nhuge), 2)),
+                "ss_net_paid": pa.array(
+                    np.round(rng.uniform(1.0, 20000.0, nhuge), 2)),
+            })
+        return _big["h"]
     log(f"bench: ladder on {jax.devices()[0].platform}, {n} rows "
         f"(+{nbig} big rungs), {iters} iters, budget {budget:.0f}s")
 
@@ -403,6 +424,13 @@ def main():
              base_q9_of(store_sales_big), check_q9),
             ("tpcds_q28_10m", nbig, q28_of(store_sales_big),
              base_q28_of(store_sales_big), check_q28),
+        ]
+    if nhuge:
+        # SF100-class global-agg rung: the wide-batch path runs the
+        # whole 100M-row query as a handful of fused dispatches
+        workloads += [
+            ("tpcds_q9_100m", nhuge, q9_of(store_sales_huge),
+             base_q9_of(store_sales_huge), check_q9),
         ]
 
     details = {}

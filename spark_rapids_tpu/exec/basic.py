@@ -276,21 +276,22 @@ class TpuProjectExec(TpuExec):
         return DictColumn(codes, col.validity, col.dtype,
                           np.asarray(uniq, dtype=object))
 
-    def _rect_eval(self, expr, col, ordinal: int):
+    def _rect_eval(self, expr, col, ordinal: int, width_cap: int):
         """One jitted kernel for a whole rect string chain (upper/trim/
-        substring/... fused), cached per (expr, width, padded)."""
+        substring/... fused), cached per (expr, width, padded, cap)."""
         import jax
         from ..columnar.strrect import ByteRectColumn
         from ..exprs.base import DVal, StrVal
         from ..exprs.string_rect import eval_rect_chain
         from ..types import STRING
-        key = (expr.key(), col.width, col.padded_len)
+        key = (expr.key(), col.width, col.padded_len, width_cap)
         fn = self._rect_kernels.get(key)
         if fn is None:
             @jax.jit
             def fn(bytes_, lengths, validity, e=expr):
                 outv = eval_rect_chain(
-                    e, DVal(StrVal(bytes_, lengths), validity, STRING))
+                    e, DVal(StrVal(bytes_, lengths), validity, STRING),
+                    width_cap=width_cap)
                 return outv.data, outv.validity
             self._rect_kernels[key] = fn
         data, valid = fn(col.data, col.lengths, col.validity)
@@ -371,12 +372,18 @@ class TpuProjectExec(TpuExec):
                     expr, leaf = rchain
                     src = batch.column_by_name(leaf)
                     if isinstance(src, ByteRectColumn) and src.ascii_only:
+                        from ..columnar.strrect import RECT_MAX_BYTES
+                        cap = int(ctx.conf.get(RECT_MAX_BYTES))
                         try:
                             with ctx.semaphore.held():
-                                out[i] = self._rect_eval(expr, src, i)
+                                out[i] = self._rect_eval(expr, src, i,
+                                                         cap)
                             continue
                         except RectUnsupported:
-                            pass    # this batch's widths: host fallback
+                            # the chain outgrows the width cap: host for
+                            # this and (dropping the chain) later batches
+                            # — no per-batch re-trace just to re-raise
+                            self.rect_chain.pop(i, None)
                 arr = self.exprs[i].eval_host(batch)
                 dt = self._schema.fields[i].dtype
                 if dt.device_backed:

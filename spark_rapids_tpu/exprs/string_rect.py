@@ -218,9 +218,11 @@ _REPLACE_MAX = 32
 _PAD_MAX = 256
 
 
-def _replace(sv: StrVal, search: bytes, replace: bytes) -> StrVal:
+def _replace(sv: StrVal, search: bytes, replace: bytes,
+             width_cap: int = 1 << 20) -> StrVal:
     """replace(str, search, replace): non-overlapping left-to-right, may
-    grow the rectangle (bounded by W//len(search) occurrences)."""
+    grow the rectangle (bounded by W//len(search) occurrences) up to the
+    configured width cap — past it the batch falls back to host."""
     b, ln = sv.bytes_, sv.lengths
     rows, w = b.shape
     s = np.frombuffer(search, np.uint8)
@@ -241,7 +243,9 @@ def _replace(sv: StrVal, search: bytes, replace: bytes) -> StrVal:
     new_len = outpos[:, -1] + emit[:, -1]
     w_need = w + max(0, l2 - l1) * (w // l1)
     from ..columnar.strrect import rect_width_bucket
-    wo = rect_width_bucket(max(w_need, 1), 1 << 20)
+    # growth allowance: the conf cap governs ingest width; an op may
+    # grow to the cap (or the input width when already above it)
+    wo = rect_width_bucket(max(w_need, 1), max(width_cap, w))
     if wo is None:      # grown width past the cap: host handles it
         raise RectUnsupported(f"replace output width {w_need}")
     rowix = jnp.arange(rows, dtype=jnp.int32)[:, None]
@@ -430,7 +434,8 @@ def rect_chain_leaf(e: Expression, schema: Schema) -> Optional[str]:
     return None
 
 
-def eval_rect_expr(e: Expression, child: DVal) -> DVal:
+def eval_rect_expr(e: Expression, child: DVal,
+                   width_cap: int = 1 << 20) -> DVal:
     """Evaluate one rect-supported op over a StrVal-typed DVal (traced)."""
     from .string_fns import (Contains, EndsWith, Length, Like, Lower, Lpad,
                              Reverse, Rpad, StartsWith, StringInstr,
@@ -467,8 +472,8 @@ def eval_rect_expr(e: Expression, child: DVal) -> DVal:
               "endswith": _endswith, "equals": _equals}[form]
         return DVal(fn(sv, p), v, BOOL)
     if isinstance(e, StringReplace):
-        return DVal(_replace(sv, e.search.encode(), e.replace.encode()),
-                    v, STRING)
+        return DVal(_replace(sv, e.search.encode(), e.replace.encode(),
+                             width_cap), v, STRING)
     if isinstance(e, Rpad):
         return DVal(_pad(sv, v, e.length, e.pad.encode(), False), v,
                     STRING)
@@ -487,9 +492,10 @@ def eval_rect_expr(e: Expression, child: DVal) -> DVal:
     raise NotImplementedError(type(e).__name__)
 
 
-def eval_rect_chain(e: Expression, leaf_val: DVal) -> DVal:
+def eval_rect_chain(e: Expression, leaf_val: DVal,
+                    width_cap: int = 1 << 20) -> DVal:
     """Evaluate a rect_chain (validated by rect_chain_leaf) bottom-up."""
     if isinstance(e, ColumnRef):
         return leaf_val
-    child = eval_rect_chain(e.children[0], leaf_val)
-    return eval_rect_expr(e, child)
+    child = eval_rect_chain(e.children[0], leaf_val, width_cap)
+    return eval_rect_expr(e, child, width_cap)

@@ -90,8 +90,12 @@ def _load_registries():
               "spark_rapids_tpu.api.session"]:
         try:
             importlib.import_module(m)
-        except ImportError:  # optional subsystem absent: skip its confs
-            pass
+        except ModuleNotFoundError as ex:
+            # only a genuinely ABSENT optional subsystem may be skipped;
+            # a broken transitive import must fail loudly or the docs
+            # silently drop live confs
+            if ex.name != m:
+                raise
 
 
 def expression_inventory() -> List[Dict]:

@@ -193,3 +193,39 @@ def test_api_validation_expressions_have_an_engine():
     bad = [r["name"] for r in expression_inventory()
            if not r["device"] and not r["host"]]
     assert not bad, f"expressions with no implementation: {bad}"
+
+
+def test_to_device_columns_no_host_roundtrip(monkeypatch):
+    """VERDICT r4 #8 'done' criterion: the export path must move NO
+    column data device->host. Arrow materialization is forbidden
+    outright during the export; device fetches are limited to scalar
+    row counts (<= 1 element) — the bulk arrays stay live in HBM."""
+    import jax
+    from spark_rapids_tpu.columnar import batch as batch_mod
+
+    s = tpu_session()
+    df = s.create_dataframe(gen_df(
+        {"a": IntGen(nullable=False), "b": IntGen(nullable=True)},
+        n=5000)).filter(F.col("a") > 0)
+
+    fetched = []
+    real_get = jax.device_get
+
+    def spy_get(x):
+        for leaf in jax.tree_util.tree_leaves(x):
+            if getattr(leaf, "size", 1) > 1:
+                fetched.append(leaf.shape)
+        return real_get(x)
+
+    def no_arrow(self, *a, **k):
+        raise AssertionError("to_arrow called inside device export")
+
+    monkeypatch.setattr(jax, "device_get", spy_get)
+    monkeypatch.setattr(batch_mod.ColumnarBatch, "to_arrow", no_arrow)
+    batches = df.to_device_columns()
+    assert batches
+    assert sum(b["num_rows"] for b in batches) > 0
+    assert fetched == [], f"bulk D2H in export path: {fetched}"
+    # the arrays are live jax Arrays usable by a consumer afterwards
+    d, v = batches[0]["columns"]["a"]
+    assert isinstance(d, jax.Array) and isinstance(v, jax.Array)
