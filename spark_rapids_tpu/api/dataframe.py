@@ -218,6 +218,22 @@ class TpuSession:
         temp view later redirects BOTH reads and DML resolution."""
         self._views[name.lower()] = self.delta_table(path)
 
+    @property
+    def catalog(self):
+        """Named-table catalog over the conf'd warehouse directory (ref
+        GpuDeltaCatalogBase / IcebergProviderImpl — see sql/catalog.py)."""
+        from ..sql.catalog import Catalog
+        return Catalog(self)
+
+    def table(self, name: str) -> "DataFrame":
+        """Resolve a table by name: temp views first, then the catalog
+        ([db.]table). The SQL FROM clause resolves identically."""
+        v = self._views.get(name.lower())
+        if v is not None:
+            from ..delta.table import DeltaTable
+            return v.to_df() if isinstance(v, DeltaTable) else v
+        return self.catalog.table(name)
+
     def read_csv(self, *paths: str, schema=None, header=True) -> "DataFrame":
         from ..io.file_scan import apply_path_rules
         from ..io.text import csv_to_tables

@@ -1,0 +1,226 @@
+"""Session catalog: named tables over a warehouse directory.
+
+Reference analog: the accelerator's catalog integrations —
+GpuDeltaCatalogBase.scala (StagedTable create/commit for Delta),
+IcebergProviderImpl.scala (catalog-resolved Iceberg scans) — which let
+users address tables by NAME instead of path. Standalone design: a
+JSON metastore per database directory under a warehouse root
+(``spark.rapids.tpu.sql.catalog.warehouse``), holding
+``{table: {format, path, partition_by}}``. No Hive metastore protocol —
+the metastore file is the single source of truth, written atomically
+(tmp + os.replace) so concurrent sessions on one host never read a
+torn file.
+
+Name resolution order everywhere (session.table, SQL FROM, DML
+targets): temp views first, then ``db.table`` / ``default.table`` in
+the catalog.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+from ..config import register
+
+__all__ = ["Catalog", "CatalogError", "TableExistsError",
+           "CATALOG_WAREHOUSE"]
+
+CATALOG_WAREHOUSE = register(
+    "spark.rapids.tpu.sql.catalog.warehouse",
+    os.path.expanduser("~/.spark_rapids_tpu/warehouse"),
+    "Warehouse root for catalog-managed tables: each database is a "
+    "directory holding a _catalog.json metastore plus its managed "
+    "tables' data directories (ref GpuDeltaCatalogBase / "
+    "IcebergProviderImpl — tables addressed by name, not path).")
+
+#: formats the catalog can read back into a DataFrame
+_READABLE = ("delta", "iceberg", "parquet", "orc", "avro", "csv", "json")
+
+
+class CatalogError(ValueError):
+    pass
+
+
+class TableExistsError(CatalogError):
+    """Raised only for name collisions, so IF NOT EXISTS can suppress
+    exactly this and nothing else."""
+
+
+def _split(name: str):
+    parts = name.split(".")
+    if len(parts) == 1:
+        return "default", parts[0].lower()
+    if len(parts) == 2:
+        return parts[0].lower(), parts[1].lower()
+    raise CatalogError(f"invalid table name {name!r} (use [db.]table)")
+
+
+class Catalog:
+    def __init__(self, session):
+        self._session = session
+
+    # ------------------------------------------------------------ paths
+    @property
+    def warehouse(self) -> str:
+        return str(self._session.conf.get(CATALOG_WAREHOUSE))
+
+    def _db_dir(self, db: str) -> str:
+        return os.path.join(self.warehouse, db)
+
+    def _meta_path(self, db: str) -> str:
+        return os.path.join(self._db_dir(db), "_catalog.json")
+
+    def _load(self, db: str) -> Dict:
+        try:
+            with open(self._meta_path(db)) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return {"tables": {}}
+
+    def _store(self, db: str, meta: Dict) -> None:
+        os.makedirs(self._db_dir(db), exist_ok=True)
+        tmp = self._meta_path(db) + f".tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(meta, f, indent=1, sort_keys=True)
+        os.replace(tmp, self._meta_path(db))
+
+    def _mutate(self, db: str):
+        """Read-modify-write under an exclusive flock: atomic replace
+        alone cannot stop two sessions' concurrent updates losing one
+        side's table entry (lost update, not torn read)."""
+        import contextlib
+        import fcntl
+
+        @contextlib.contextmanager
+        def guard():
+            os.makedirs(self._db_dir(db), exist_ok=True)
+            with open(os.path.join(self._db_dir(db), ".lock"), "w") as lk:
+                fcntl.flock(lk, fcntl.LOCK_EX)
+                meta = self._load(db)
+                yield meta
+                self._store(db, meta)
+        return guard()
+
+    # -------------------------------------------------------- databases
+    def create_database(self, db: str, exist_ok: bool = True) -> None:
+        db = db.lower()
+        if os.path.isdir(self._db_dir(db)):
+            if not exist_ok:
+                raise CatalogError(f"database {db} already exists")
+            return
+        self._store(db, {"tables": {}})
+
+    def list_databases(self) -> List[str]:
+        root = self.warehouse
+        if not os.path.isdir(root):
+            return []
+        return sorted(d for d in os.listdir(root)
+                      if os.path.isfile(self._meta_path(d)))
+
+    # ----------------------------------------------------------- tables
+    def register_table(self, name: str, path: str, format: str = "delta",
+                       partition_by: Optional[List[str]] = None,
+                       replace: bool = False) -> None:
+        """Point a catalog name at EXISTING data (external table)."""
+        fmt = format.lower()
+        if fmt not in _READABLE:
+            raise CatalogError(f"unsupported format {format!r}")
+        db, tbl = _split(name)
+        with self._mutate(db) as meta:
+            if tbl in meta["tables"] and not replace:
+                raise TableExistsError(
+                    f"table {db}.{tbl} already exists")
+            meta["tables"][tbl] = {
+                "format": fmt, "path": os.path.abspath(path),
+                "partition_by": list(partition_by or []),
+                "created_at": time.strftime("%Y-%m-%d %H:%M:%S"),
+                "external": True}
+
+    def create_table(self, name: str, df=None, format: str = "delta",
+                     partition_by: Optional[List[str]] = None,
+                     path: Optional[str] = None,
+                     if_not_exists: bool = False):
+        """Create a MANAGED table (data under the warehouse unless an
+        explicit ``path`` makes it external), optionally populated from
+        ``df`` (CTAS). Ref: GpuDeltaCatalogBase StagedTable commit."""
+        fmt = format.lower()
+        if fmt not in ("delta", "parquet"):
+            raise CatalogError(
+                f"create_table supports delta/parquet, not {format!r} "
+                "(register_table points at existing data of any format)")
+        if fmt == "parquet" and partition_by:
+            raise CatalogError(
+                "parquet create_table does not support PARTITIONED BY; "
+                "use delta (hive-partitioned layout)")
+        if df is None:
+            raise CatalogError(
+                "create_table requires a DataFrame (CTAS) — the table "
+                "needs data/schema; use register_table for existing data")
+        db, tbl = _split(name)
+        with self._mutate(db) as meta:
+            if tbl in meta["tables"]:
+                if if_not_exists:
+                    return self.table(name)
+                raise TableExistsError(
+                    f"table {db}.{tbl} already exists")
+            external = path is not None
+            path = os.path.abspath(
+                path or os.path.join(self._db_dir(db), tbl))
+            if fmt == "delta":
+                df.write_delta(path, partition_by=partition_by)
+            else:
+                df.write_parquet(path)
+            meta["tables"][tbl] = {
+                "format": fmt, "path": path,
+                "partition_by": list(partition_by or []),
+                "created_at": time.strftime("%Y-%m-%d %H:%M:%S"),
+                "external": external}
+        return self.table(name)
+
+    def drop_table(self, name: str, if_exists: bool = False,
+                   purge: bool = True) -> None:
+        """Spark semantics: dropping a MANAGED table deletes its data;
+        EXTERNAL data is never touched regardless of ``purge``."""
+        db, tbl = _split(name)
+        with self._mutate(db) as meta:
+            ent = meta["tables"].pop(tbl, None)
+            if ent is None:
+                if if_exists:
+                    return
+                raise CatalogError(f"table {db}.{tbl} not found")
+        if purge and not ent.get("external"):
+            import shutil
+            shutil.rmtree(ent["path"], ignore_errors=True)
+
+    def list_tables(self, db: str = "default") -> List[Dict]:
+        meta = self._load(db.lower())
+        return [{"database": db.lower(), "table": t, **e}
+                for t, e in sorted(meta["tables"].items())]
+
+    def describe_table(self, name: str) -> Dict:
+        db, tbl = _split(name)
+        ent = self._load(db)["tables"].get(tbl)
+        if ent is None:
+            raise CatalogError(f"table {db}.{tbl} not found")
+        return {"database": db, "table": tbl, **ent}
+
+    # -------------------------------------------------------- resolution
+    def table(self, name: str):
+        """Resolve to a DataFrame reading the CURRENT table state."""
+        ent = self.describe_table(name)
+        s = self._session
+        readers = {"delta": s.read_delta, "iceberg": s.read_iceberg,
+                   "parquet": s.read_parquet, "orc": s.read_orc,
+                   "avro": s.read_avro, "csv": s.read_csv,
+                   "json": s.read_json}
+        return readers[ent["format"]](ent["path"])
+
+    def delta(self, name: str):
+        """DeltaTable handle for DML (UPDATE/DELETE/MERGE targets)."""
+        ent = self.describe_table(name)
+        if ent["format"] != "delta":
+            raise CatalogError(
+                f"{name} is {ent['format']}, not a Delta table")
+        return self._session.delta_table(ent["path"])

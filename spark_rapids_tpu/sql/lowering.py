@@ -76,7 +76,13 @@ class _Lowerer:
             return self.lower(ref.select)
         name = ref.name.lower()
         if name not in self.views:
-            raise SqlError(f"table or view not found: {ref.name}")
+            # catalog fallback: [db.]table names resolve through the
+            # session catalog (sql/catalog.py; ref GpuDeltaCatalogBase)
+            from .catalog import CatalogError
+            try:
+                return self.session.catalog.table(name)
+            except CatalogError:
+                raise SqlError(f"table or view not found: {ref.name}")
         v = self.views[name]
         from ..delta.table import DeltaTable
         if isinstance(v, DeltaTable):
@@ -769,6 +775,12 @@ def _resolve_delta(session, ref, views, what):
     if not isinstance(ref, TableRef):
         raise SqlError(f"{what} requires a registered Delta table name")
     dt = views.get(ref.name.lower())
+    if dt is None:
+        from .catalog import CatalogError
+        try:
+            return session.catalog.delta(ref.name)
+        except CatalogError as e:
+            raise SqlError(str(e))
     if not isinstance(dt, DeltaTable):
         raise SqlError(
             f"{ref.name} is not a registered Delta table (use "
@@ -916,8 +928,58 @@ def _lower_merge(session, stmt, views, lw):
 
 
 def lower_statement(session, text: str, views: Dict[str, object]):
-    from .parser import DeleteStmt, MergeStmt, Select, UpdateStmt, parse
+    from .parser import (CreateTableStmt, DeleteStmt, DropTableStmt,
+                         MergeStmt, Select, ShowTablesStmt, UpdateStmt,
+                         parse)
     stmt = parse(text)
     if isinstance(stmt, (DeleteStmt, MergeStmt, UpdateStmt)):
         return _lower_dml(session, stmt, views)
+    if isinstance(stmt, (CreateTableStmt, DropTableStmt, ShowTablesStmt)):
+        return _lower_catalog(session, stmt, views)
     return _Lowerer(session, views).lower(stmt)
+
+
+def _lower_catalog(session, stmt, views):
+    """Catalog DDL (ref GpuDeltaCatalogBase StagedTable /
+    GpuDropTable): CREATE/DROP/SHOW over the session catalog."""
+    import pyarrow as pa
+    from .parser import CreateTableStmt, DropTableStmt
+    from .catalog import CatalogError, TableExistsError
+    cat = session.catalog
+    if isinstance(stmt, CreateTableStmt):
+        df = (_Lowerer(session, views).lower(stmt.select)
+              if stmt.select is not None else None)
+        try:
+            if df is None and stmt.location is not None:
+                try:
+                    cat.register_table(stmt.name, stmt.location,
+                                       stmt.format,
+                                       partition_by=stmt.partition_by)
+                except TableExistsError:
+                    # IF NOT EXISTS suppresses ONLY the name collision
+                    if not stmt.if_not_exists:
+                        raise
+            else:
+                cat.create_table(stmt.name, df, format=stmt.format,
+                                 partition_by=stmt.partition_by,
+                                 path=stmt.location,
+                                 if_not_exists=stmt.if_not_exists)
+        except CatalogError as e:
+            raise SqlError(str(e))
+        return _metrics_df(session, {"created": 1})
+    if isinstance(stmt, DropTableStmt):
+        try:
+            cat.drop_table(stmt.name, if_exists=stmt.if_exists)
+        except CatalogError as e:
+            raise SqlError(str(e))
+        return _metrics_df(session, {"dropped": 1})
+    rows = cat.list_tables(stmt.db)
+    return session.create_dataframe(pa.table({
+        "database": [r["database"] for r in rows],
+        "tableName": [r["table"] for r in rows],
+        "format": [r["format"] for r in rows],
+        "path": [r["path"] for r in rows],
+    }) if rows else pa.table({"database": pa.array([], pa.string()),
+                              "tableName": pa.array([], pa.string()),
+                              "format": pa.array([], pa.string()),
+                              "path": pa.array([], pa.string())}))

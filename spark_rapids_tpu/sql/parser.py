@@ -42,6 +42,8 @@ _KEYWORDS = {
     "following", "current", "row",
     "update", "delete", "merge", "into", "set", "values", "insert",
     "matched", "then",
+    "create", "table", "drop", "show", "tables", "location",
+    "if", "partitioned",
 }
 
 
@@ -52,6 +54,8 @@ SOFT_IDS = frozenset({
     "unbounded", "preceding", "following", "over", "first", "last",
     "date", "timestamp", "update", "delete", "insert", "merge", "into",
     "set", "values", "matched",
+    "create", "table", "drop", "show", "tables", "location", "if",
+    "partitioned",
 })
 
 
@@ -142,6 +146,32 @@ class MergeStmt:
         self.clauses = clauses
 
 
+class CreateTableStmt:
+    """CREATE TABLE [IF NOT EXISTS] name [USING fmt]
+    [PARTITIONED BY (c, ...)] [LOCATION 'path'] [AS select]
+    (ref GpuDeltaCatalogBase StagedTable / GpuCreateDataSourceTableAsSelectCommand)."""
+
+    def __init__(self, name, format, location, partition_by, select,
+                 if_not_exists):
+        self.name = name
+        self.format = format
+        self.location = location
+        self.partition_by = partition_by
+        self.select = select
+        self.if_not_exists = if_not_exists
+
+
+class DropTableStmt:
+    def __init__(self, name, if_exists):
+        self.name = name
+        self.if_exists = if_exists
+
+
+class ShowTablesStmt:
+    def __init__(self, db):
+        self.db = db
+
+
 class Select:
     def __init__(self):
         self.ctes: List[Tuple[str, "Select"]] = []
@@ -208,11 +238,71 @@ class _Parser:
             stmt = self._parse_delete()
         elif self.at_kw("merge"):
             stmt = self._parse_merge()
+        elif self.at_kw("create"):
+            stmt = self._parse_create_table()
+        elif self.at_kw("drop"):
+            stmt = self._parse_drop_table()
+        elif self.at_kw("show"):
+            stmt = self._parse_show_tables()
         else:
             stmt = self.parse_query()
         self.accept("op", ";")
         self.expect("eof")
         return stmt
+
+    # -- catalog DDL (ref GpuDeltaCatalogBase / catalog.py) ---------------
+    def _dotted_name(self) -> str:
+        name = self.expect_ident()
+        while self.peek().kind == "op" and self.peek().val == ".":
+            self.next()
+            name += "." + self.expect_ident()
+        return name
+
+    def _parse_create_table(self) -> "CreateTableStmt":
+        self.expect("kw", "create")
+        self.expect("kw", "table")
+        if_not_exists = False
+        if self.accept("kw", "if"):
+            self.expect("kw", "not")
+            self.expect("kw", "exists")
+            if_not_exists = True
+        name = self._dotted_name()
+        fmt = "delta"
+        if self.accept("kw", "using"):
+            fmt = self.expect_ident()
+        partition_by = None
+        if self.accept("kw", "partitioned"):
+            self.expect("kw", "by")
+            self.expect("op", "(")
+            partition_by = [self.expect_ident()]
+            while self.accept("op", ","):
+                partition_by.append(self.expect_ident())
+            self.expect("op", ")")
+        location = None
+        if self.accept("kw", "location"):
+            location = self.expect("str").val
+        select = None
+        if self.accept("kw", "as"):
+            select = self.parse_query()
+        return CreateTableStmt(name, fmt, location, partition_by, select,
+                               if_not_exists)
+
+    def _parse_drop_table(self) -> "DropTableStmt":
+        self.expect("kw", "drop")
+        self.expect("kw", "table")
+        if_exists = False
+        if self.accept("kw", "if"):
+            self.expect("kw", "exists")
+            if_exists = True
+        return DropTableStmt(self._dotted_name(), if_exists)
+
+    def _parse_show_tables(self) -> "ShowTablesStmt":
+        self.expect("kw", "show")
+        self.expect("kw", "tables")
+        db = "default"
+        if self.accept("kw", "in") or self.accept("kw", "from"):
+            db = self.expect_ident()
+        return ShowTablesStmt(db)
 
     # -- DML (Delta tables; ref GpuUpdateCommand / GpuDeleteCommand /
     # GpuMergeIntoCommand) ------------------------------------------------
@@ -414,7 +504,7 @@ class _Parser:
             elif self.peek().kind == "id":
                 alias = self.next().val
             return SubqueryRef(sub, alias)
-        name = self.expect_ident()
+        name = self._dotted_name()
         alias = None
         if self.accept("kw", "as"):
             alias = self.expect_ident()
@@ -596,7 +686,9 @@ class _Parser:
                 return fn_node
             parts = [name]
             while self.peek().kind == "op" and self.peek().val == "." \
-                    and self.peek(1).kind in ("id",):
+                    and (self.peek(1).kind == "id"
+                         or (self.peek(1).kind == "kw"
+                             and self.peek(1).val in SOFT_IDS)):
                 self.next()
                 nxt = self.next()
                 if nxt.val == "*":

@@ -1,0 +1,141 @@
+"""Catalog tests: named tables over a warehouse (ref
+GpuDeltaCatalogBase.scala StagedTable create/commit;
+IcebergProviderImpl.scala catalog-resolved scans; delta_lake
+catalog integration tests)."""
+import os
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from data_gen import DoubleGen, IntGen, gen_df
+from harness import tpu_session
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.exprs import ColumnRef, GreaterThan, Literal
+from spark_rapids_tpu.sql.catalog import CatalogError
+
+
+def _sess(tmp_path):
+    return tpu_session({
+        "spark.rapids.tpu.sql.catalog.warehouse": str(tmp_path / "wh")})
+
+
+def test_catalog_create_list_drop(tmp_path):
+    s = _sess(tmp_path)
+    cat = s.catalog
+    t = pa.table(gen_df({"a": IntGen(), "b": DoubleGen()}, n=300))
+    cat.create_table("t1", s.create_dataframe(t))
+    cat.create_database("sales")
+    cat.create_table("sales.orders", s.create_dataframe(t),
+                     format="parquet")
+    assert sorted(cat.list_databases()) == ["default", "sales"]
+    assert [r["table"] for r in cat.list_tables()] == ["t1"]
+    assert [r["table"] for r in cat.list_tables("sales")] == ["orders"]
+    # managed data lives under the warehouse
+    assert cat.describe_table("t1")["path"].startswith(str(tmp_path))
+    # read back by name through both APIs
+    assert s.table("t1").count() == 300
+    assert s.table("sales.orders").count() == 300
+    cat.drop_table("sales.orders", purge=True)
+    with pytest.raises(CatalogError):
+        cat.describe_table("sales.orders")
+    assert cat.list_tables("sales") == []
+
+
+def test_catalog_register_external(tmp_path):
+    s = _sess(tmp_path)
+    t = pa.table({"k": list(range(50))})
+    p = str(tmp_path / "ext")
+    s.create_dataframe(t).write_delta(p)
+    s.catalog.register_table("ext_t", p)
+    assert s.table("ext_t").count() == 50
+    # drop with purge must NOT delete external data
+    s.catalog.drop_table("ext_t", purge=True)
+    assert os.path.isdir(p)
+    s.catalog.register_table("ext_t", p)
+    assert s.table("ext_t").count() == 50
+
+
+def test_catalog_sql_ddl_and_query(tmp_path):
+    s = _sess(tmp_path)
+    t = pa.table(gen_df({"k": IntGen(lo=0, hi=5, nullable=False),
+                         "v": IntGen(nullable=False)}, n=400))
+    s.create_temp_view("src", s.create_dataframe(t))
+    s.sql("CREATE TABLE facts USING delta AS SELECT k, v FROM src")
+    out = s.sql("SELECT k, SUM(v) AS sv FROM facts GROUP BY k") \
+        .to_pandas().sort_values("k").reset_index(drop=True)
+    want = (t.to_pandas().groupby("k")["v"].sum().reset_index()
+            .rename(columns={"v": "sv"}))
+    np.testing.assert_array_equal(out["k"], want["k"])
+    np.testing.assert_array_equal(out["sv"], want["sv"])
+    shown = s.sql("SHOW TABLES").to_pandas()
+    assert list(shown["tableName"]) == ["facts"]
+    # idempotent create via IF NOT EXISTS
+    s.sql("CREATE TABLE IF NOT EXISTS facts USING delta "
+          "AS SELECT k, v FROM src")
+    s.sql("DROP TABLE facts")
+    assert s.sql("SHOW TABLES").to_pandas().empty
+    s.sql("DROP TABLE IF EXISTS facts")   # no error when absent
+
+
+def test_catalog_sql_dml_on_named_delta(tmp_path):
+    """UPDATE/DELETE resolve catalog names, not just temp views."""
+    s = _sess(tmp_path)
+    t = pa.table({"k": list(range(100)),
+                  "v": [float(i) for i in range(100)]})
+    s.catalog.create_table("d.t", s.create_dataframe(t))
+    s.sql("DELETE FROM d.t WHERE k >= 50")
+    assert s.table("d.t").count() == 50
+    s.sql("UPDATE d.t SET v = v * 2 WHERE k < 10")
+    out = s.sql("SELECT SUM(v) AS sv FROM d.t").collect()[0]["sv"]
+    want = sum(v * 2 if k < 10 else v
+               for k, v in zip(range(50), map(float, range(50))))
+    assert out == want
+
+
+def test_catalog_partitioned_create(tmp_path):
+    s = _sess(tmp_path)
+    t = pa.table({"region": ["eu", "us", "eu", "ap"] * 50,
+                  "v": list(range(200))})
+    s.create_temp_view("src", s.create_dataframe(t))
+    s.sql("CREATE TABLE part_t USING delta PARTITIONED BY (region) "
+          "AS SELECT region, v FROM src")
+    ent = s.catalog.describe_table("part_t")
+    assert ent["partition_by"] == ["region"]
+    snap = s.delta_table(ent["path"]).log.snapshot()
+    assert snap.metadata.partition_columns == ["region"]
+    got = (s.sql("SELECT region, SUM(v) AS sv FROM part_t "
+                 "GROUP BY region").to_pandas()
+           .sort_values("region").reset_index(drop=True))
+    want = (t.to_pandas().groupby("region")["v"].sum().reset_index()
+            .sort_values("region").reset_index(drop=True))
+    np.testing.assert_array_equal(got["sv"], want["v"])
+
+
+def test_catalog_errors(tmp_path):
+    s = _sess(tmp_path)
+    with pytest.raises(CatalogError):
+        s.catalog.table("nope")
+    with pytest.raises(CatalogError):
+        s.catalog.register_table("x", "/tmp/x", format="sqlite")
+    t = pa.table({"a": [1]})
+    s.catalog.create_table("dup", s.create_dataframe(t))
+    with pytest.raises(CatalogError):
+        s.catalog.create_table("dup", s.create_dataframe(t))
+    with pytest.raises(CatalogError):
+        s.catalog.delta("missing.tbl")
+
+
+def test_new_keywords_stay_valid_identifiers(tmp_path):
+    """r5 regression guard: adding DDL keywords must not break columns
+    or aliases named create/table/location/... in queries."""
+    s = _sess(tmp_path)
+    t = pa.table({"location": ["a", "b", "a"], "v": [1, 2, 3]})
+    s.create_temp_view("sites", s.create_dataframe(t))
+    out = s.sql("SELECT x.location, SUM(x.v) AS sv FROM sites x "
+                "GROUP BY x.location").to_pandas() \
+        .sort_values("location").reset_index(drop=True)
+    assert list(out["location"]) == ["a", "b"]
+    assert list(out["sv"]) == [4, 2]
+    out2 = s.sql("SELECT location FROM sites WHERE v > 1").to_pandas()
+    assert sorted(out2["location"]) == ["a", "b"]
