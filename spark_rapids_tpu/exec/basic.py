@@ -276,7 +276,8 @@ class TpuProjectExec(TpuExec):
         return DictColumn(codes, col.validity, col.dtype,
                           np.asarray(uniq, dtype=object))
 
-    def _rect_eval(self, expr, col, ordinal: int, width_cap: int):
+    def _rect_eval(self, expr, col, ordinal: int, width_cap: int,
+                   use_pallas: bool = False):
         """One jitted kernel for a whole rect string chain (upper/trim/
         substring/... fused), cached per (expr, width, padded, cap)."""
         import jax
@@ -284,14 +285,15 @@ class TpuProjectExec(TpuExec):
         from ..exprs.base import DVal, StrVal
         from ..exprs.string_rect import eval_rect_chain
         from ..types import STRING
-        key = (expr.key(), col.width, col.padded_len, width_cap)
+        key = (expr.key(), col.width, col.padded_len, width_cap,
+               use_pallas)
         fn = self._rect_kernels.get(key)
         if fn is None:
             @jax.jit
             def fn(bytes_, lengths, validity, e=expr):
                 outv = eval_rect_chain(
                     e, DVal(StrVal(bytes_, lengths), validity, STRING),
-                    width_cap=width_cap)
+                    width_cap=width_cap, use_pallas=use_pallas)
                 return outv.data, outv.validity
             self._rect_kernels[key] = fn
         data, valid = fn(col.data, col.lengths, col.validity)
@@ -373,11 +375,13 @@ class TpuProjectExec(TpuExec):
                     src = batch.column_by_name(leaf)
                     if isinstance(src, ByteRectColumn) and src.ascii_only:
                         from ..columnar.strrect import RECT_MAX_BYTES
+                        from ..exprs.pallas_rect import PALLAS_ENABLED
                         cap = int(ctx.conf.get(RECT_MAX_BYTES))
+                        pls = bool(ctx.conf.get(PALLAS_ENABLED))
                         try:
                             with ctx.semaphore.held():
                                 out[i] = self._rect_eval(expr, src, i,
-                                                         cap)
+                                                         cap, pls)
                             continue
                         except RectUnsupported:
                             # the chain outgrows the width cap: host for

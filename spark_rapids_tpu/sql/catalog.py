@@ -159,24 +159,36 @@ class Catalog:
                 "create_table requires a DataFrame (CTAS) — the table "
                 "needs data/schema; use register_table for existing data")
         db, tbl = _split(name)
+        external = path is not None
+        entry = {
+            "format": fmt,
+            "path": os.path.abspath(
+                path or os.path.join(self._db_dir(db), tbl)),
+            "partition_by": list(partition_by or []),
+            "created_at": time.strftime("%Y-%m-%d %H:%M:%S"),
+            "external": external}
+        # reserve the name under the lock, write the data OUTSIDE it (a
+        # big CTAS must not serialize every other mutation on the db),
+        # finalize under the lock again (the reference's StagedTable
+        # create -> write -> commit shape, GpuDeltaCatalogBase.scala)
         with self._mutate(db) as meta:
             if tbl in meta["tables"]:
                 if if_not_exists:
                     return self.table(name)
                 raise TableExistsError(
                     f"table {db}.{tbl} already exists")
-            external = path is not None
-            path = os.path.abspath(
-                path or os.path.join(self._db_dir(db), tbl))
+            meta["tables"][tbl] = {**entry, "staging": True}
+        try:
             if fmt == "delta":
-                df.write_delta(path, partition_by=partition_by)
+                df.write_delta(entry["path"], partition_by=partition_by)
             else:
-                df.write_parquet(path)
-            meta["tables"][tbl] = {
-                "format": fmt, "path": path,
-                "partition_by": list(partition_by or []),
-                "created_at": time.strftime("%Y-%m-%d %H:%M:%S"),
-                "external": external}
+                df.write_parquet(entry["path"])
+        except BaseException:
+            with self._mutate(db) as meta:
+                meta["tables"].pop(tbl, None)
+            raise
+        with self._mutate(db) as meta:
+            meta["tables"][tbl] = entry
         return self.table(name)
 
     def drop_table(self, name: str, if_exists: bool = False,

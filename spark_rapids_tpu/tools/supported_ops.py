@@ -78,6 +78,7 @@ def _load_registries():
               "spark_rapids_tpu.exec.distinct_flag",
               "spark_rapids_tpu.plan.rewrites",
               "spark_rapids_tpu.sql.catalog",
+              "spark_rapids_tpu.exprs.pallas_rect",
               "spark_rapids_tpu.plan.cost",
               "spark_rapids_tpu.plan.stats_store",
               "spark_rapids_tpu.parallel.planner",

@@ -701,3 +701,29 @@ def test_rect_edgecases_empty_and_all_space():
             F.locate("-", F.col("s")).alias("lc"),
             F.reverse(F.col("s")).alias("rv"))
     assert_tpu_and_cpu_equal(q)
+
+
+def test_pallas_rect_predicates_differential():
+    """r5: the Pallas sliding-match kernels (interpret mode on CPU) must
+    agree with both the XLA rect ops and the host engine."""
+    from spark_rapids_tpu.exprs.pallas_rect import pallas_available
+    if not pallas_available():
+        import pytest
+        pytest.skip("pallas not available")
+    t = _high_card_table(30000, 20000)
+    conf = {"spark.rapids.tpu.sql.pallas.enabled": True}
+
+    def q(s):
+        df = s.create_dataframe(t)
+        return df.select(F.col("s").contains("0123").alias("c"),
+                         F.startswith(F.col("s"), "  Item-0").alias("sw"),
+                         F.endswith(F.col("s"), "x  ").alias("ew"),
+                         F.locate("-00", F.col("s")).alias("lc"),
+                         F.col("s").like("%Item-1%").alias("lk"),
+                         F.col("v"))
+    assert_tpu_and_cpu_equal(q, conf=conf)
+    # and identical to the XLA rect path
+    import pandas as pd
+    a = q(tpu_session(conf)).to_pandas()
+    b = q(tpu_session()).to_pandas()
+    pd.testing.assert_frame_equal(a, b)
