@@ -256,6 +256,10 @@ class Like(_PatternPredicate):
 class RLike(_PatternPredicate):
     """Java-regex RLIKE through the transpiler (ref GpuRLike +
     CudfRegexTranspiler)."""
+    #: device byte-rectangle kernel available (exprs/string_rect.py;
+    #: literal / anchored-literal patterns only — see
+    #: _rlike_literal_parts)
+    rect_device = True
 
     def __init__(self, child, pattern: str):
         super().__init__(child, pattern)

@@ -303,6 +303,32 @@ def _locate(sv: StrVal, sub: bytes):
     return pos
 
 
+_REGEX_META = set(".^$*+?{}[]\\|()")
+
+
+def _rlike_literal_parts(pattern: str):
+    """(mode, literal) when a Java-regex RLIKE pattern is really an
+    (optionally anchored) LITERAL — the common grep-style case cudf also
+    fast-paths (ref RegexParser literal detection): no metacharacters
+    besides the ^/$ anchors at the edges. None otherwise."""
+    if not pattern:
+        return None
+    lead = pattern.startswith("^")
+    trail = pattern.endswith("$")
+    body = pattern[1 if lead else 0: len(pattern) - (1 if trail else 0)]
+    if any(c in _REGEX_META for c in body):
+        return None
+    if _ascii(body) is None:
+        return None
+    if lead and trail:
+        return ("equals", body)
+    if lead:
+        return ("startswith", body)
+    if trail:
+        return ("endswith", body)
+    return ("contains", body)    # RLIKE is an unanchored search
+
+
 def _like_parts(pattern: str):
     """(form, literal) for rectangle-supported LIKE patterns: leading/
     trailing %% around one literal (prefix/suffix/contains/exact).
@@ -383,10 +409,10 @@ def _ascii(s: str) -> Optional[bytes]:
 def rect_supported_op(e: Expression) -> bool:
     from .base import Literal
     from .string_fns import (Contains, EndsWith, Length, Like, Lower, Lpad,
-                             Reverse, StartsWith, StringInstr, StringLocate,
-                             StringReplace, StringTrim, StringTrimLeft,
-                             StringTrimRight, SubstringIndex, Substring,
-                             Upper)
+                             Reverse, RLike, StartsWith, StringInstr,
+                             StringLocate, StringReplace, StringTrim,
+                             StringTrimLeft, StringTrimRight,
+                             SubstringIndex, Substring, Upper)
     if isinstance(e, (Upper, Lower, Length, Reverse)):
         return True
     if isinstance(e, (StringTrim, StringTrimLeft, StringTrimRight)):
@@ -398,6 +424,8 @@ def rect_supported_op(e: Expression) -> bool:
         # escape can never fire on an accepted pattern; a CUSTOM escape
         # char would change the parse -> host
         return e.escape == "\\" and _like_parts(e.pattern) is not None
+    if isinstance(e, RLike):
+        return _rlike_literal_parts(e.pattern) is not None
     if isinstance(e, (Contains, StartsWith, EndsWith)):
         return _ascii(e.pattern) is not None
     if isinstance(e, StringReplace):
@@ -441,7 +469,7 @@ def eval_rect_expr(e: Expression, child: DVal,
     ``use_pallas`` routes the sliding-pattern match family through the
     hand-written Pallas kernels (exprs/pallas_rect.py)."""
     from .string_fns import (Contains, EndsWith, Length, Like, Lower, Lpad,
-                             Reverse, Rpad, StartsWith, StringInstr,
+                             Reverse, RLike, Rpad, StartsWith, StringInstr,
                              StringLocate, StringReplace, StringTrim,
                              StringTrimLeft, StringTrimRight,
                              SubstringIndex, Substring, Upper)
@@ -461,12 +489,11 @@ def eval_rect_expr(e: Expression, child: DVal,
             return DVal(pallas_match(sv.bytes_, sv.lengths,
                                      e.pattern.encode(), "contains"),
                         v, BOOL)
-        if isinstance(e, Like):
-            form, lit = _like_parts(e.pattern)
-            mode = {"contains": "contains", "startswith": "startswith",
-                    "endswith": "endswith", "equals": "equals"}[form]
+        if isinstance(e, (Like, RLike)):
+            form, lit = (_like_parts(e.pattern) if isinstance(e, Like)
+                         else _rlike_literal_parts(e.pattern))
             return DVal(pallas_match(sv.bytes_, sv.lengths,
-                                     lit.encode(), mode), v, BOOL)
+                                     lit.encode(), form), v, BOOL)
         if isinstance(e, StringLocate):
             return DVal(pallas_match(sv.bytes_, sv.lengths,
                                      e.substr.encode(), "locate"),
@@ -496,12 +523,12 @@ def eval_rect_expr(e: Expression, child: DVal,
         return DVal(_endswith(sv, e.pattern.encode()), v, BOOL)
     if isinstance(e, Contains):
         return DVal(_contains(sv, e.pattern.encode()), v, BOOL)
-    if isinstance(e, Like):
-        form, lit = _like_parts(e.pattern)
-        p = lit.encode()
+    if isinstance(e, (Like, RLike)):
+        form, lit = (_like_parts(e.pattern) if isinstance(e, Like)
+                     else _rlike_literal_parts(e.pattern))
         fn = {"contains": _contains, "startswith": _startswith,
               "endswith": _endswith, "equals": _equals}[form]
-        return DVal(fn(sv, p), v, BOOL)
+        return DVal(fn(sv, lit.encode()), v, BOOL)
     if isinstance(e, StringReplace):
         return DVal(_replace(sv, e.search.encode(), e.replace.encode(),
                              width_cap), v, STRING)

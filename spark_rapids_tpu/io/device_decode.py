@@ -1,0 +1,263 @@
+"""Experimental device-side parquet decode (ref GpuParquetScan device
+decode: Table.readParquet feeds raw pages to cudf's GPU decoder,
+GpuParquetScan.scala:1867/2063/2750).
+
+TPU-first shape of the same idea: for UNCOMPRESSED, PLAIN-encoded,
+fixed-width, null-free column chunks, the host touches only the tiny
+page headers — the VALUE BYTES go to the device raw (one uint8 H2D per
+column) and a jitted kernel bitcasts them into the typed column. The
+host never materializes an Arrow array for these columns, so ingest
+skips one full host copy per column.
+
+Page headers are Thrift *compact protocol* structs; the ~90-line parser
+below reads just the fields needed to locate each page's value bytes
+(PageHeader: type, compressed size; DataPageHeader: num_values,
+encoding; v2: also def/rep level byte lengths). Anything unexpected —
+compression, dictionary pages, nulls, unsupported physical types —
+disqualifies the chunk and the standard pyarrow path handles it.
+
+Opt-in: ``spark.rapids.tpu.io.parquet.deviceDecode.enabled`` (an
+EXPERIMENTAL tier; the eligibility window is narrow by design — being
+right beats being broad for a decoder).
+"""
+from __future__ import annotations
+
+import struct
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..config import register
+
+__all__ = ["DEVICE_DECODE_ENABLED", "decode_chunk_values",
+           "chunk_eligible"]
+
+DEVICE_DECODE_ENABLED = register(
+    "spark.rapids.tpu.io.parquet.deviceDecode.enabled", False,
+    "EXPERIMENTAL: decode eligible parquet column chunks on the device "
+    "(uncompressed, PLAIN, fixed-width, null-free): the host parses "
+    "only page headers and ships raw value bytes; a device kernel "
+    "bitcasts them into the typed column (io/device_decode.py; ref "
+    "GpuParquetScan device decode). Engages only with "
+    "format.parquet.reader.type=PERFILE and no pushed-down predicate; "
+    "ineligible chunks/files use the standard pyarrow path.")
+
+# thrift compact-protocol wire types
+_CT_STOP = 0
+_CT_TRUE = 1
+_CT_FALSE = 2
+_CT_BYTE = 3
+_CT_I16 = 4
+_CT_I32 = 5
+_CT_I64 = 6
+_CT_DOUBLE = 7
+_CT_BINARY = 8
+_CT_LIST = 9
+_CT_SET = 10
+_CT_MAP = 11
+_CT_STRUCT = 12
+
+
+class _Compact:
+    """Minimal Thrift compact-protocol reader (just what PageHeader
+    needs: varints, zigzag ints, binary, nested structs, lists)."""
+
+    def __init__(self, buf: bytes, pos: int = 0):
+        self.buf = buf
+        self.pos = pos
+
+    def varint(self) -> int:
+        out = 0
+        shift = 0
+        while True:
+            b = self.buf[self.pos]
+            self.pos += 1
+            out |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return out
+            shift += 7
+
+    def zigzag(self) -> int:
+        v = self.varint()
+        return (v >> 1) ^ -(v & 1)
+
+    def skip(self, ctype: int) -> None:
+        if ctype in (_CT_TRUE, _CT_FALSE):
+            return
+        if ctype == _CT_BYTE:
+            self.pos += 1
+        elif ctype in (_CT_I16, _CT_I32, _CT_I64):
+            self.varint()
+        elif ctype == _CT_DOUBLE:
+            self.pos += 8
+        elif ctype == _CT_BINARY:
+            # NOT `self.pos += self.varint()`: the augmented assignment
+            # loads the OLD pos before varint() advances it
+            n = self.varint()
+            self.pos += n
+        elif ctype == _CT_STRUCT:
+            self.read_struct(lambda fid, ct, r: r.skip(ct))
+        elif ctype in (_CT_LIST, _CT_SET):
+            head = self.buf[self.pos]
+            self.pos += 1
+            n = head >> 4
+            et = head & 0x0F
+            if n == 15:
+                n = self.varint()
+            for _ in range(n):
+                self.skip(et)
+        elif ctype == _CT_MAP:
+            n = self.varint()
+            if n:
+                kv = self.buf[self.pos]
+                self.pos += 1
+                for _ in range(n):
+                    self.skip(kv >> 4)
+                    self.skip(kv & 0x0F)
+        else:
+            raise ValueError(f"thrift compact type {ctype}")
+
+    def read_struct(self, on_field) -> None:
+        """on_field(field_id, ctype, reader) must consume the value."""
+        fid = 0
+        while True:
+            b = self.buf[self.pos]
+            self.pos += 1
+            if b == _CT_STOP:
+                return
+            delta = b >> 4
+            ctype = b & 0x0F
+            if delta:
+                fid += delta
+            else:
+                fid = self.zigzag()
+            on_field(fid, ctype, self)
+
+
+class _PageHeader:
+    __slots__ = ("type", "compressed_size", "num_values", "encoding",
+                 "def_len", "rep_len")
+
+    def __init__(self):
+        self.type = None
+        self.compressed_size = None
+        self.num_values = 0
+        self.encoding = None
+        self.def_len = 0       # v2: explicit level byte lengths
+        self.rep_len = 0
+
+
+def _parse_page_header(buf: bytes, pos: int) -> Tuple[_PageHeader, int]:
+    h = _PageHeader()
+
+    def data_hdr(fid, ct, r):
+        if fid == 1:
+            h.num_values = r.zigzag()
+        elif fid == 2:
+            h.encoding = r.zigzag()
+        elif fid == 5 and ct == _CT_STRUCT:
+            r.skip(ct)         # statistics
+        else:
+            r.skip(ct)
+
+    def data_hdr_v2(fid, ct, r):
+        if fid == 1:
+            h.num_values = r.zigzag()
+        elif fid == 2:
+            r.zigzag()         # num_nulls (eligibility already proven 0)
+        elif fid == 3:
+            r.zigzag()         # num_rows
+        elif fid == 4:
+            h.encoding = r.zigzag()
+        elif fid == 5:
+            h.def_len = r.zigzag()
+        elif fid == 6:
+            h.rep_len = r.zigzag()
+        else:
+            r.skip(ct)
+
+    def top(fid, ct, r):
+        if fid == 1:
+            h.type = r.zigzag()
+        elif fid == 3:
+            h.compressed_size = r.zigzag()
+        elif fid == 5 and ct == _CT_STRUCT:
+            r.read_struct(data_hdr)
+        elif fid == 8 and ct == _CT_STRUCT:
+            r.read_struct(data_hdr_v2)
+        else:
+            r.skip(ct)
+
+    r = _Compact(buf, pos)
+    r.read_struct(top)
+    return h, r.pos
+
+
+#: parquet physical type id -> numpy dtype (fixed-width only)
+_PHYS = {"INT32": np.dtype("<i4"), "INT64": np.dtype("<i8"),
+         "FLOAT": np.dtype("<f4"), "DOUBLE": np.dtype("<f8")}
+_ENC_PLAIN = 0
+_PAGE_DATA, _PAGE_DATA_V2 = 0, 3
+
+
+def chunk_eligible(col_meta) -> Optional[np.dtype]:
+    """np dtype when this column-chunk metadata qualifies for raw-byte
+    device decode, else None."""
+    if col_meta.compression != "UNCOMPRESSED":
+        return None
+    if col_meta.dictionary_page_offset is not None:
+        return None
+    encs = set(col_meta.encodings)
+    # BIT_PACKED def levels have no length prefix — the v1 offset math
+    # below would silently land mid-page, so only RLE levels qualify
+    if not encs <= {"PLAIN", "RLE"}:
+        return None
+    st = col_meta.statistics
+    if st is None or st.null_count is None or st.null_count != 0:
+        return None
+    return _PHYS.get(col_meta.physical_type)
+
+
+def decode_chunk_values(raw: bytes, num_values: int,
+                        dtype: np.dtype,
+                        max_def_level: int) -> Optional[np.ndarray]:
+    """Concatenate the value bytes of every data page in a raw column
+    chunk -> one contiguous little-endian array (NO host type decode —
+    the caller ships these bytes to the device and bitcasts there).
+    Returns None if anything in the chunk surprises the parser."""
+    width = dtype.itemsize
+    pos = 0
+    parts: List[bytes] = []
+    got = 0
+    try:
+        while got < num_values:
+            h, data_pos = _parse_page_header(raw, pos)
+            if h.compressed_size is None:
+                return None
+            end = data_pos + h.compressed_size
+            if h.type == _PAGE_DATA:
+                if h.encoding != _ENC_PLAIN:
+                    return None
+                off = data_pos
+                if max_def_level > 0:
+                    # v1 RLE def-level block: u32 length prefix
+                    (lv_len,) = struct.unpack_from("<I", raw, off)
+                    off += 4 + lv_len
+                parts.append(raw[off:off + h.num_values * width])
+            elif h.type == _PAGE_DATA_V2:
+                if h.encoding != _ENC_PLAIN:
+                    return None
+                off = data_pos + h.def_len + h.rep_len
+                parts.append(raw[off:off + h.num_values * width])
+            else:
+                return None          # dictionary/index page: ineligible
+            got += h.num_values
+            pos = end
+        if got != num_values:
+            return None
+        out = b"".join(parts)
+        if len(out) != num_values * width:
+            return None
+        return np.frombuffer(out, dtype=dtype)
+    except (IndexError, struct.error):
+        return None

@@ -19,6 +19,8 @@ import glob
 import os
 from typing import List, Optional, Sequence
 
+import numpy as np
+
 from ..config import MULTITHREADED_READ_THREADS, PARQUET_READER_TYPE
 from ..types import Schema, StructField, from_arrow
 from .file_scan import FileScanBase
@@ -137,6 +139,107 @@ class ParquetScanExec(FileScanBase):
             out = [t.select(self.columns) if t is not None else t
                    for t in out]
         return out
+
+    # ------------------------------------------- experimental device decode
+    def do_execute(self, ctx):
+        from .device_decode import DEVICE_DECODE_ENABLED
+        if (bool(ctx.conf.get(DEVICE_DECODE_ENABLED))
+                and self.mode == "PERFILE" and self.predicate is None):
+            yield from self._device_decode_execute(ctx)
+            return
+        yield from super().do_execute(ctx)
+
+    #: engine types whose device-decode bitcast is exactly the pyarrow
+    #: result (timestamps/dates excluded: unit normalization diverges)
+    _DD_TYPES = frozenset(["int", "bigint", "float", "double"])
+
+    def _device_decode_execute(self, ctx):
+        """EXPERIMENTAL raw-byte ingest (io/device_decode.py; ref
+        GpuParquetScan device decode): eligible files skip the pyarrow
+        column decode entirely — the host parses page headers, the
+        value bytes land on the device raw. Ineligible files take the
+        standard path unchanged."""
+        from ..columnar import ColumnarBatch, DeviceColumn
+        from ..columnar.bucketing import padded_len as _bucket
+        from ..exec.base import ESSENTIAL
+        rows_m = ctx.metric(self._exec_id, "numOutputRows", ESSENTIAL)
+        ctx.metric(self._exec_id, "numFiles").add(len(self.paths))
+        dd_m = ctx.metric(self._exec_id, "deviceDecodedFiles")
+        batch_rows = ctx.conf.batch_size_rows
+        for pid, path in enumerate(self.paths):
+            cols = self._try_device_decode(path)
+            if cols is None:
+                t = self._read_table(path)
+                yield from self._emit(ctx, t, rows_m, batch_rows,
+                                      input_file=path, pid=pid)
+                continue
+            dd_m.add(1)
+            n = len(cols[0][1]) if cols else 0
+            off = 0
+            while off < n or (n == 0 and off == 0):
+                cnt = min(batch_rows, n - off)
+                pl = _bucket(cnt)
+                with ctx.semaphore.held():
+                    dcs = [DeviceColumn.from_numpy(
+                               v[off:off + cnt], dt, padded_len=pl)
+                           for _, v, dt in cols]
+                b = ColumnarBatch(dcs, cnt, self._schema)
+                b.meta = {"partition_id": pid, "input_file": path,
+                          "row_offset": off}
+                rows_m.add(cnt)
+                yield b
+                off += batch_rows
+                if n == 0:
+                    break
+
+    def _try_device_decode(self, path):
+        """[(name, raw little-endian values, engine dtype)] when EVERY
+        requested column of the file qualifies, else None."""
+        import pyarrow.parquet as pq
+        from .device_decode import chunk_eligible, decode_chunk_values
+        names = self.columns or self._schema.names()
+        try:
+            resolved = self._cached_path(path)
+            f = pq.ParquetFile(resolved)
+            md = f.metadata
+            if md.num_row_groups == 0:
+                return None
+            rg0 = md.row_group(0)
+            idx = {rg0.column(j).path_in_schema: j
+                   for j in range(rg0.num_columns)}
+            plan = []
+            for name in names:
+                dt = self._schema[name].dtype
+                if dt.name not in self._DD_TYPES or name not in idx:
+                    return None
+                nullable = f.schema_arrow.field(name).nullable
+                chunks = []
+                for g in range(md.num_row_groups):
+                    cm = md.row_group(g).column(idx[name])
+                    np_dt = chunk_eligible(cm)
+                    if np_dt is None or np_dt != dt.np_dtype.newbyteorder("<"):
+                        return None
+                    chunks.append((cm.data_page_offset,
+                                   cm.total_compressed_size,
+                                   cm.num_values))
+                plan.append((name, dt, nullable, chunks))
+            out = []
+            with open(resolved, "rb") as fh:
+                for name, dt, nullable, chunks in plan:
+                    parts = []
+                    for offset, size, nvals in chunks:
+                        fh.seek(offset)
+                        vals = decode_chunk_values(
+                            fh.read(size), nvals, dt.np_dtype,
+                            1 if nullable else 0)
+                        if vals is None:
+                            return None
+                        parts.append(vals)
+                    out.append((name, np.concatenate(parts)
+                                if len(parts) > 1 else parts[0], dt))
+            return out
+        except Exception:
+            return None     # anything surprising: standard path
 
     def _filter_row_groups(self, f) -> Optional[List[int]]:
         """Row-group pruning from parquet min/max statistics

@@ -73,6 +73,7 @@ def _load_registries():
               "spark_rapids_tpu.io.parquet",
               "spark_rapids_tpu.io.text",
               "spark_rapids_tpu.io.filecache",
+              "spark_rapids_tpu.io.device_decode",
               "spark_rapids_tpu.columnar.strrect",
               "spark_rapids_tpu.columnar.transfer",
               "spark_rapids_tpu.exec.distinct_flag",

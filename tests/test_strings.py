@@ -727,3 +727,30 @@ def test_pallas_rect_predicates_differential():
     a = q(tpu_session(conf)).to_pandas()
     b = q(tpu_session()).to_pandas()
     pd.testing.assert_frame_equal(a, b)
+
+
+def test_rect_rlike_literal_routing_differential():
+    """r5: RLIKE patterns that are plain (optionally anchored) literals
+    run on the rectangle device path; real regexes stay host."""
+    from spark_rapids_tpu.exprs.string_rect import (_rlike_literal_parts,
+                                                    rect_supported_op)
+    from spark_rapids_tpu.exprs import string_fns as SF
+    assert _rlike_literal_parts("Item-00") == ("contains", "Item-00")
+    assert _rlike_literal_parts("^Item") == ("startswith", "Item")
+    assert _rlike_literal_parts("xx$") == ("endswith", "xx")
+    assert _rlike_literal_parts("^ab$") == ("equals", "ab")
+    assert _rlike_literal_parts("It.m") is None
+    assert _rlike_literal_parts("a+") is None
+    assert not rect_supported_op(SF.RLike(None, "a|b"))
+
+    t = _high_card_table(25000, 18000)
+
+    def q(s):
+        df = s.create_dataframe(t)
+        return df.select(F.rlike(F.col("s"), "Item-00").alias("r1"),
+                         F.rlike(F.col("s"), "^  Item").alias("r2"),
+                         F.rlike(F.col("s"), "xx  $").alias("r3"),
+                         F.col("v"))
+    assert_tpu_and_cpu_equal(q)
+    assert_tpu_and_cpu_equal(
+        q, conf={"spark.rapids.tpu.sql.pallas.enabled": True})
