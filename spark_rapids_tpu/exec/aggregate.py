@@ -397,7 +397,8 @@ class TpuHashAggregateExec(TpuExec):
                  aggs: Sequence[AggregateExpression], child: TpuExec,
                  pre_stages: Optional[list] = None,
                  eval_schema: Optional[Schema] = None,
-                 many_groups_hint: bool = False):
+                 many_groups_hint: bool = False,
+                 int_key_cards: Optional[Sequence] = None):
         super().__init__([child])
         self.groupings = list(groupings)
         self.aggs = list(aggs)
@@ -410,15 +411,31 @@ class TpuHashAggregateExec(TpuExec):
         self.pre_stages = pre_stages or []
         cs = eval_schema if eval_schema is not None else child.output_schema()
         self._eval_schema = cs
-        from ..types import INT32, STRING
+        from ..types import INT32, STRING, IntegerType
         #: grouping ordinals that go through the string dictionary
         self._dict_keys = [i for i, g in enumerate(self.groupings)
                            if g.data_type(cs) == STRING]
+        #: ordinal -> PROVEN cardinality for planner-constructed small
+        #: int keys (values in [0, card), e.g. the union-rewrite branch
+        #: id): these group by DIRECT one-hot addressing with no sort
+        #: (the cudf hash-groupby trade). The key travels as an int32
+        #: CODE in partials on BOTH the direct and split paths, so
+        #: per-batch path choices merge consistently.
+        cards_in = list(int_key_cards or [])
+        self._int_cards = {
+            i: int(c) for i, c in enumerate(cards_in)
+            if c and isinstance(self.groupings[i].data_type(cs),
+                                IntegerType)}
         # the kernel sees an augmented input schema: child columns plus one
         # appended int32 code column per string key; string groupings are
         # rewritten to BoundReferences onto those columns
         self._kernel_schema = cs
         self._kernel_groupings = list(self.groupings)
+        if self._int_cards:
+            from ..exprs.cast import Cast
+            for i in self._int_cards:
+                self._kernel_groupings[i] = Cast(self.groupings[i],
+                                                 INT32)
         if self._dict_keys:
             extra = [StructField(f"__gk{i}", INT32, True)
                      for i in self._dict_keys]
@@ -621,7 +638,14 @@ class TpuHashAggregateExec(TpuExec):
         """Replace int32 code key columns with device DictColumns whose
         dictionaries are sorted — only a tiny remap table touches the
         wire; the strings materialize lazily at the final sink (one
-        batched fetch there instead of one per key here)."""
+        batched fetch there instead of one per key here). Int-carded
+        keys' codes ARE their values — just widen to the declared
+        type."""
+        for i in self._int_cards:
+            col = out_cols[i]
+            dt = self._schema.fields[i].dtype
+            out_cols[i] = DeviceColumn(
+                col.data.astype(dt.np_dtype), col.validity, dt)
         if not self._dict_keys or self._rect_mode:
             # rect keys pass through as ByteRectColumns: the sink decodes
             # the (group-sized) rectangles directly
@@ -787,6 +811,13 @@ class TpuHashAggregateExec(TpuExec):
             self._kernel_groupings, aggs, "update", stages,
             value_exprs=value_exprs))
 
+        # only DICTIONARY keys occupy appended kernel-schema slots;
+        # int-carded keys' codes feed gid directly and must NOT displace
+        # real columns in the eval context (r5: the old tail-replace
+        # clobbered the column after the last real one — e.g. the
+        # distinct flag — whenever a non-appended key was present)
+        dict_ords = tuple(self._dict_keys)
+
         def core(cols, num_rows, padded_len, cards, scalars,
                  code_pairs, remaps):
             from ..columnar.segmented import onehot_gather
@@ -794,6 +825,8 @@ class TpuHashAggregateExec(TpuExec):
             # remap dispatch pays full tunnel latency)
             code_cols = [(onehot_gather(rm, cd, G), cv)
                          for (cd, cv), rm in zip(code_pairs, remaps)]
+            dict_codes = [DVal(code_cols[i][0], code_cols[i][1], INT32)
+                          for i in dict_ords]
             if base_dtypes is not None:
                 n_base = len(base_dtypes)
                 base = [None if c is None else DVal(c[0], c[1], dt)
@@ -801,16 +834,16 @@ class TpuHashAggregateExec(TpuExec):
                 sctx, keep = _apply_pre_stages(stages, in_schema, base,
                                                num_rows, padded_len,
                                                scalars, slots)
-                dvals = (list(sctx.columns)
-                         + [DVal(c[0], c[1], INT32) for c in code_cols])
+                dvals = list(sctx.columns) + dict_codes
                 ectx = EvalContext(schema, dvals, num_rows, padded_len,
                                    scalars, slots)
             else:
+                n_base = len(dtypes) - len(dict_ords)
                 dvals = [None if c is None else DVal(c[0], c[1], dt)
-                         for c, dt in zip(cols, dtypes)]
-                dvals = dvals[:len(dtypes) - nkeys] + \
-                    [DVal(c[0], c[1], INT32) for c in code_cols]
-                dvals += [None] * (len(dtypes) - len(dvals))
+                         for c, dt in zip(cols[:n_base],
+                                          dtypes[:n_base])]
+                dvals += [None] * (n_base - len(dvals))
+                dvals += dict_codes
                 ectx = EvalContext(schema, dvals, num_rows, padded_len,
                                    scalars, slots)
                 keep = ectx.row_mask()
@@ -925,13 +958,52 @@ class TpuHashAggregateExec(TpuExec):
             out.append(batch.schema.index_of(g.name))
         return out
 
-    def _direct_update_args(self, batch: ColumnarBatch):
-        """When the multi-batch first pass can use the direct-addressing
-        update kernel for this batch, return (kernel, args); else None."""
-        if self._rect_mode:
-            return None
-        if not self.groupings or \
-                len(self._dict_keys) != len(self.groupings):
+    def _direct_keys_ok(self) -> bool:
+        """Every grouping is either a dictionary string key or a
+        proven-cardinality int key — the direct core's requirement."""
+        if not self.groupings or self._rect_mode:
+            return False
+        covered = set(self._dict_keys) | set(self._int_cards)
+        return len(covered) == len(self.groupings)
+
+    def _mixed_pairs(self, batch: ColumnarBatch):
+        """(pairs, remaps, cards) for ALL groupings in grouping order:
+        string keys dictionary-encode (global codes), int-carded keys
+        pass their device values straight through as codes with an
+        identity remap."""
+        from ..exprs.base import Alias, ColumnRef
+        s_pairs, s_remaps = self._augment_pairs(batch)
+        by_dict = {i: j for j, i in enumerate(self._dict_keys)}
+        pairs, remaps, cards = [], [], []
+        for i in range(len(self.groupings)):
+            if i in by_dict:
+                j = by_dict[i]
+                pairs.append(s_pairs[j])
+                remaps.append(s_remaps[j])
+                cards.append(max(len(self._dicts[j]), 1))
+                continue
+            card = self._int_cards[i]
+            g = self.groupings[i]
+            if isinstance(g, Alias):
+                g = g.children[0]
+            if not isinstance(g, ColumnRef):
+                return None
+            try:
+                col = batch.column_by_name(g.name)
+            except (KeyError, ValueError):
+                return None
+            if not isinstance(col, DeviceColumn):
+                return None
+            pairs.append((col.data, col.validity))
+            remaps.append(np.arange(card, dtype=np.int32))
+            cards.append(card)
+        return pairs, remaps, np.asarray(cards, np.int32)
+
+    def _direct_operands(self, batch: ColumnarBatch):
+        """(cards_dev, pairs, padded_remaps, Gb) when direct addressing
+        applies to this batch, else None — the shared operand builder of
+        the fused single-batch and multi-batch update call sites."""
+        if not self._direct_keys_ok():
             return None
         # current dictionary sizes are a lower bound on post-encode sizes:
         # once the product exceeds the bound it can only grow, so bail out
@@ -939,10 +1011,14 @@ class TpuHashAggregateExec(TpuExec):
         lower = 1
         for d in self._dicts:
             lower *= max(len(d), 1) + 1
+        for c in self._int_cards.values():
+            lower *= c + 1
         if lower > self.OPTIMISTIC_GROUPS:
             return None
-        pairs, remaps = self._augment_pairs(batch)
-        cards = np.asarray([len(d) for d in self._dicts], np.int32)
+        mixed = self._mixed_pairs(batch)
+        if mixed is None:
+            return None
+        pairs, remaps, cards = mixed
         prod = int(np.prod(cards.astype(np.int64) + 1))
         if prod > self.OPTIMISTIC_GROUPS:
             return None
@@ -951,8 +1027,17 @@ class TpuHashAggregateExec(TpuExec):
         padded_remaps = tuple(
             jnp.asarray(np.pad(r, (0, max(Gb - len(r), 0)))[:Gb])
             for r in remaps)
+        return jnp.asarray(cards), tuple(pairs), padded_remaps, Gb
+
+    def _direct_update_args(self, batch: ColumnarBatch):
+        """When the multi-batch first pass can use the direct-addressing
+        update kernel for this batch, return (kernel, args); else None."""
+        ops = self._direct_operands(batch)
+        if ops is None:
+            return None
+        cards, pairs, padded_remaps, Gb = ops
         kern = self._get_direct_update_kernel(Gb)
-        return kern, (jnp.asarray(cards), tuple(pairs), padded_remaps)
+        return kern, (cards, pairs, padded_remaps)
 
     def _fast_single_batch(self, ctx, batch: ColumnarBatch,
                            update_k) -> Optional[ColumnarBatch]:
@@ -972,22 +1057,15 @@ class TpuHashAggregateExec(TpuExec):
                              if isinstance(c, DeviceColumn) else None)
         nkeys = len(self.groupings)
         packed = None
-        if nkeys > 0 and len(self._dict_keys) == nkeys:
-            pairs, remaps = self._augment_pairs(batch)
-            cards = np.asarray([len(d) for d in self._dicts], np.int32)
-            prod = int(np.prod(cards + 1))
-            if prod <= self.OPTIMISTIC_GROUPS:
-                from ..columnar.segmented import bucket_segments
-                Gb = bucket_segments(prod)
-                padded_remaps = tuple(
-                    jnp.asarray(np.pad(r, (0, max(Gb - len(r), 0)))[:Gb])
-                    for r in remaps)
+        if nkeys > 0:
+            ops = self._direct_operands(batch)
+            if ops is not None:
+                cards, pairs, padded_remaps, Gb = ops
                 fast = self._get_fast_direct_kernel(Gb)
                 _check_scalar_slots(fast, self._upd_scalars)
                 packed = fast(base_cols, jnp.int32(batch.num_rows_raw),
-                              batch.padded_len, jnp.asarray(cards),
-                              self._upd_scalars, tuple(pairs),
-                              padded_remaps)
+                              batch.padded_len, cards,
+                              self._upd_scalars, pairs, padded_remaps)
                 specs = fast.out_specs[batch.padded_len]
         if packed is None:
             if nkeys > 0:
@@ -1093,8 +1171,7 @@ class TpuHashAggregateExec(TpuExec):
         if first is not None and second is None \
                 and not self.many_groups_hint \
                 and not self._rect_mode \
-                and (not self.groupings
-                     or len(self._dict_keys) == len(self.groupings)) \
+                and (not self.groupings or self._direct_keys_ok()) \
                 and _FAST_GROUPS.get(self._kernel_key, 0) \
                 <= self.OPTIMISTIC_GROUPS:
             first = first.ensure_device()

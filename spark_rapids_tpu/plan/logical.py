@@ -122,7 +122,8 @@ class Aggregate(LogicalPlan):
     (exprs/aggregates.py) each with an output name."""
 
     def __init__(self, groupings, aggs, child: LogicalPlan,
-                 many_groups_hint: bool = False):
+                 many_groups_hint: bool = False,
+                 int_key_cards=None):
         self.groupings = list(groupings)
         self.aggs = list(aggs)
         #: planner knows this aggregate is high-cardinality (e.g. the
@@ -130,6 +131,14 @@ class Aggregate(LogicalPlan):
         #: value): the exec skips its optimistic single-fetch fast path,
         #: whose kernel compile + fetch would be wasted
         self.many_groups_hint = many_groups_hint
+        #: per-grouping PROVEN cardinality: entry k (an int) promises the
+        #: key's values lie in [0, k) — set only by rewrites that
+        #: construct the key themselves (the union-of-aggregates branch
+        #: id). Lets the exec use direct one-hot addressing with NO sort
+        #: (the cudf hash-groupby trade; exec/aggregate.py direct core).
+        self.int_key_cards = (list(int_key_cards)
+                              if int_key_cards is not None
+                              else [None] * len(self.groupings))
         self.children = [child]
 
     def schema(self) -> Schema:

@@ -273,6 +273,7 @@ class AggregateMeta(PlanMeta):
 
     def convert_to_tpu(self, children):
         hint = getattr(self.plan, "many_groups_hint", False)
+        cards = getattr(self.plan, "int_key_cards", None)
         child, stages, eval_schema = self._fold_stages(children[0])
         if not self.plan.groupings:
             self._widen_scan_batches(child if stages else children[0])
@@ -281,9 +282,11 @@ class AggregateMeta(PlanMeta):
                                           self.plan.aggs, child,
                                           pre_stages=stages,
                                           eval_schema=eval_schema,
-                                          many_groups_hint=hint)
+                                          many_groups_hint=hint,
+                                          int_key_cards=cards)
         return A.TpuHashAggregateExec(self.plan.groupings, self.plan.aggs,
-                                      children[0], many_groups_hint=hint)
+                                      children[0], many_groups_hint=hint,
+                                      int_key_cards=cards)
 
     def _widen_scan_batches(self, node):
         """A GLOBAL aggregation's steady-state cost is per-dispatch
@@ -328,14 +331,20 @@ class AggregateMeta(PlanMeta):
             return child, None, None
         # string group keys are dictionary-encoded OUTSIDE the kernel from
         # the folded input batch — they must be plain refs (possibly
-        # aliased) present there
+        # aliased) present there. Int-carded keys (int_key_cards) need
+        # the same: their direct-addressing operands read the key COLUMN
+        # from the batch, so folding away the projection that produces it
+        # would silently demote the plan to the sort path.
         from ..exprs.base import Alias
         in_names = set(node.output_schema().names())
-        for g in self.plan.groupings:
+        cards = getattr(self.plan, "int_key_cards",
+                        [None] * len(self.plan.groupings))
+        for gi, g in enumerate(self.plan.groupings):
             inner = g.children[0] if isinstance(g, Alias) else g
-            if g.data_type(eval_schema) == STRING and not (
-                    isinstance(inner, ColumnRef)
-                    and inner.name in in_names):
+            needs_column = (g.data_type(eval_schema) == STRING
+                            or (gi < len(cards) and cards[gi]))
+            if needs_column and not (isinstance(inner, ColumnRef)
+                                     and inner.name in in_names):
                 return child, None, None
         stages.reverse()
         return node, stages, eval_schema

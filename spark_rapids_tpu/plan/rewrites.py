@@ -372,8 +372,11 @@ def _rewrite_union_agg(union: L.Union) -> Optional[L.LogicalPlan]:
                        for i, c in enumerate(conds)]
         tagged = L.Expand(projections, keep + [bid], shared)
     filtered = L.Filter(IsNotNull(ColumnRef(bid)), tagged)
+    # the branch id is OUR construction: literals 0..k-1 (or null) — the
+    # exec may group it by direct addressing, no sort
     agg = L.Aggregate([ColumnRef(bid)],
-                      [copy.copy(a) for a in a0], filtered)
+                      [copy.copy(a) for a in a0], filtered,
+                      int_key_cards=[k])
     # branch-ordered assembly with empty-branch defaults is a tiny host
     # op (<= k rows) — cheaper than a join+sort tail, which would cost
     # several device dispatches on a latency-bound backend
@@ -425,7 +428,8 @@ def _rewrite_distinct_hash(agg: L.Aggregate) -> Optional[L.LogicalPlan]:
     flagged = L.DistinctFlag(list(agg.groupings), d_expr, flag,
                              agg.children[0])
     return L.Aggregate(agg.groupings, new_aggs, flagged,
-                       many_groups_hint=agg.many_groups_hint)
+                       many_groups_hint=agg.many_groups_hint,
+                       int_key_cards=agg.int_key_cards)
 
 
 def _rewrite_distinct(agg: L.Aggregate) -> Optional[L.LogicalPlan]:
@@ -477,7 +481,9 @@ def _rewrite_distinct(agg: L.Aggregate) -> Optional[L.LogicalPlan]:
 
     inner_groupings = list(agg.groupings) + [Alias(d_expr, dname)]
     inner = L.Aggregate(inner_groupings, inner_aggs, agg.children[0],
-                        many_groups_hint=True)
+                        many_groups_hint=True,
+                        int_key_cards=agg.int_key_cards + [None])
     outer_groupings = [ColumnRef(g.name_hint) for g in agg.groupings]
-    outer = L.Aggregate(outer_groupings, outer_aggs, inner)
+    outer = L.Aggregate(outer_groupings, outer_aggs, inner,
+                        int_key_cards=agg.int_key_cards)
     return L.Project(projections, outer)

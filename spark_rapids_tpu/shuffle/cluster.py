@@ -692,7 +692,8 @@ class LocalCluster:
             # worker-side with a scan of the fetched blocks
             reduce_proto = L.Aggregate(
                 [self._group_ref(g) for g in agg.groupings], reduce_aggs,
-                L.RangeRel(0, 1))
+                L.RangeRel(0, 1),
+                int_key_cards=getattr(agg, "int_key_cards", None))
             results = []
             futures = [pool.submit(self.clients[wid].task, "reduce_agg",
                                    shuffle_id=agg_shuffle, parts=[wi],
@@ -963,7 +964,9 @@ class LocalCluster:
             scan_w = L.LogicalScan([slice_w], fact._schema,
                                    columns=fact.columns)
             child_w = _replace_node(agg.children[0], fact, scan_w)
-            map_plan = L.Aggregate(list(agg.groupings), map_aggs, child_w)
+            map_plan = L.Aggregate(
+                list(agg.groupings), map_aggs, child_w,
+                int_key_cards=getattr(agg, "int_key_cards", None))
             futures.append(pool.submit(
                 self.clients[wid].task, "map_agg", shuffle_id=shuffle_id,
                 plan_bytes=pickle.dumps(map_plan), group_bytes=group_bytes,
@@ -1019,8 +1022,9 @@ class LocalCluster:
         template_join.children = [L.RangeRel(0, 1), L.RangeRel(0, 1)]
         template_child = _replace_node(agg.children[0], join,
                                        template_join)
-        template = L.Aggregate(list(agg.groupings), map_aggs,
-                               template_child)
+        template = L.Aggregate(
+            list(agg.groupings), map_aggs, template_child,
+            int_key_cards=getattr(agg, "int_key_cards", None))
         agg_shuffle = self._shuffle_id(owned_sids)
         schemas_bytes = pickle.dumps((lschema, rschema))
         template_bytes = pickle.dumps(template)

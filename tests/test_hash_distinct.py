@@ -140,3 +140,42 @@ def test_hash_distinct_q28_shape():
     assert "BranchAlign" in tree, tree
     assert_tpu_and_cpu_equal(q, conf=_CONF, approximate_float=True,
                              ignore_order=False)
+
+
+def test_union_agg_int_key_direct_addressing():
+    """r5: the union-rewrite branch id carries a proven cardinality, so
+    the aggregate groups it by direct one-hot addressing — no sort
+    kernel — on both the single-batch and multi-batch paths."""
+    import sys, os
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from benchmarks import tpcds
+    from spark_rapids_tpu.exec import aggregate as AG
+    tab = tpcds.gen_store_sales(40000, seed=13)
+
+    def q(s):
+        return tpcds.q28(s.create_dataframe(tab), F)
+
+    def q_parts(s):
+        # multiple in-memory partitions -> multiple batches into the agg
+        return tpcds.q28(s.create_dataframe(tab, num_partitions=5), F)
+
+    def drop_direct():
+        for k in [k for k in AG._AGG_KERNEL_CACHE
+                  if k[0] in ("fastdirect", "directupd")]:
+            AG._AGG_KERNEL_CACHE.pop(k)
+
+    def direct_kinds():
+        return {k[0] for k in AG._AGG_KERNEL_CACHE
+                if k[0] in ("fastdirect", "directupd")}
+
+    drop_direct()
+    assert_tpu_and_cpu_equal(q, conf=_CONF, approximate_float=True,
+                             ignore_order=False)
+    assert "fastdirect" in direct_kinds(), \
+        "single-batch int-key query missed the fused direct path"
+    # multi-batch: direct UPDATE partials (codes) merge across batches
+    drop_direct()
+    assert_tpu_and_cpu_equal(q_parts, conf=_CONF,
+                             approximate_float=True, ignore_order=False)
+    assert "directupd" in direct_kinds(), \
+        "multi-batch int-key query missed the direct update path"
