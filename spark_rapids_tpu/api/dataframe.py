@@ -540,9 +540,12 @@ class DataFrame:
                 from ..plan.cost import plan_signature, record_engine_wall
 
                 def _on_device(n):
-                    # scans are engine-shared; any other device exec
-                    # means the query touched the accelerator
-                    if n.is_tpu and "Scan" not in type(n).__name__:
+                    # scans and engine-neutral pass-throughs (union,
+                    # limit, branch-align) are shared by both engines;
+                    # any OTHER device exec means the query actually
+                    # touched the accelerator
+                    if n.is_tpu and not n.engine_neutral \
+                            and "Scan" not in type(n).__name__:
                         return True
                     return any(_on_device(c) for c in n.children)
 

@@ -114,6 +114,10 @@ class TpuExec:
 
     #: True if this exec runs its compute on the device
     is_tpu: bool = True
+    #: True for pass-through operators shared by BOTH engines (union,
+    #: branch-align, limit): they must not make a host-reverted query
+    #: look device-placed to the measured-wall arbitration
+    engine_neutral: bool = False
 
     def __init__(self, children: List["TpuExec"]):
         self.children = children

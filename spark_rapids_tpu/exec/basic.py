@@ -588,6 +588,7 @@ class TpuRangeExec(TpuExec):
 
 
 class LimitExec(TpuExec):
+    engine_neutral = True
     def __init__(self, n: int, child: TpuExec):
         super().__init__([child])
         self.n = n
@@ -612,6 +613,7 @@ class LimitExec(TpuExec):
 
 
 class UnionExec(TpuExec):
+    engine_neutral = True
     def __init__(self, children: List[TpuExec]):
         super().__init__(children)
 
@@ -627,6 +629,7 @@ class UnionExec(TpuExec):
 
 
 class BranchAlignExec(TpuExec):
+    engine_neutral = True
     """Host assembly of the union-of-aggregates single pass (see
     plan/rewrites.py _rewrite_union_agg): child rows are keyed by a
     branch-id first column; emit exactly n rows in branch order with

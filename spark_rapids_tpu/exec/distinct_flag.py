@@ -319,36 +319,64 @@ class CpuDistinctFlagExec(TpuExec):
         return (f"CpuDistinctFlag[keys=[{k}], "
                 f"value={self.value_expr.name_hint}]")
 
-    @staticmethod
-    def _norm(v):
-        if v is None:
-            return None
-        if isinstance(v, float):
-            if v != v:
-                return "__nan__"
-            if v == 0.0:
-                return 0.0
-        return v
-
     def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        """Vectorized: in-batch first occurrences via pandas
+        duplicated() (NaN == NaN there, matching SQL distinct), then
+        O(distinct) set work against the cross-batch seen set — the
+        host twin must stay within pandas speed or the engine
+        arbitration mis-prices the host route."""
+        import pandas as pd
         import pyarrow as pa
         seen = set()
         for batch in self.children[0].execute(ctx):
             t = batch.to_arrow()
             n = t.num_rows
-            keys = [e.eval_host(batch).to_pylist()
-                    for e in self.key_exprs]
-            vals = self.value_expr.eval_host(batch).to_pylist()
+            arrs = []
+            for e in self.key_exprs + [self.value_expr]:
+                a = e.eval_host(batch)
+                if isinstance(a, pa.ChunkedArray):
+                    a = a.combine_chunks()
+                arrs.append(a)
+            cols = {}
+            for i, a in enumerate(arrs):
+                # EXACT normalized representation (to_pandas would turn
+                # int64-with-nulls into lossy float64, and raw NaN
+                # tuples break cross-batch set membership — nan != nan):
+                # floats become canonical int64 BIT patterns (-0.0 ->
+                # +0.0, one NaN), ints stay ints, anything else keeps
+                # its exact python objects
+                from ..exprs.arithmetic import arrow_to_masked_numpy
+                try:
+                    v, _ok = arrow_to_masked_numpy(a)
+                    v = np.asarray(v)
+                except Exception:
+                    v = np.asarray(a.to_pylist(), dtype=object)
+                if v.dtype.kind == "f":
+                    f = v.astype(np.float64) + 0.0
+                    f = np.where(np.isnan(f), np.nan, f)
+                    cols[f"c{i}"] = f.view(np.int64)
+                elif v.dtype.kind in "biu":
+                    cols[f"c{i}"] = v.astype(np.int64)
+                elif v.dtype.kind in "mM":
+                    cols[f"c{i}"] = v.view(np.int64)
+                else:
+                    cols[f"c{i}"] = pd.Series(a.to_pylist(),
+                                              dtype=object)
+                # pandas conflates None/NaN for floats; SQL must not
+                # (NULL ignored, NaN counts) — key the null mask in
+                cols[f"n{i}"] = np.asarray(a.is_null())
+            df = pd.DataFrame(cols)
+            valid = ~np.asarray(arrs[-1].is_null())
             flags = np.zeros(n, np.bool_)
-            for i in range(n):
-                v = vals[i]
-                if v is None:
-                    continue
-                key = tuple(self._norm(k[i]) for k in keys) \
-                    + (self._norm(v),)
-                if key not in seen:
-                    seen.add(key)
-                    flags[i] = True
+            first = (~df.duplicated()).to_numpy() & valid
+            idx = np.nonzero(first)[0]
+            if len(idx):
+                tuples = list(map(tuple, df.iloc[idx]
+                                  .itertuples(index=False)))
+                fresh = [j for j, tup in zip(idx, tuples)
+                         if tup not in seen]
+                seen.update(tuples)
+                flags[np.asarray(fresh, np.int64)] = True
             t = t.append_column(self.flag_name, pa.array(flags))
             out = ColumnarBatch.from_arrow_host(t)
             out.meta = batch.meta   # keep partition_id/input_file

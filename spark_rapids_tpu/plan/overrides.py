@@ -66,15 +66,31 @@ def plan_query(plan: L.LogicalPlan, conf: TpuConf, mesh=None,
         # compiler lowers the two-level Aggregate form, not the
         # stateful DistinctFlag operator.
         from .rewrites import HASH_DISTINCT_ENABLED, rewrite_plan
+        plan0 = plan               # the user's shape, pre-rewrite
         plan = rewrite_plan(
             plan, hash_distinct=(mesh is None
                                  and conf.get(HASH_DISTINCT_ENABLED)))
+    else:
+        plan0 = plan
+    rewritten = plan is not plan0
     plan = prune_columns(plan)
     meta = wrap_plan(plan, conf)
     meta.tag()
     from .cost import OPTIMIZER_ENABLED, apply_cost_optimizer
     if conf.get(OPTIMIZER_ENABLED):
         apply_cost_optimizer(meta, conf, wall_sig=wall_sig)
+        if rewritten and not _any_device_meta(meta):
+            # whole-plan host reversion: the TPU-targeted rewrites
+            # (distinct expansion/flag, union single-pass) only help
+            # the DEVICE engine — their CPU twins are slower than the
+            # native host shapes (e.g. a per-row flag pass vs pandas
+            # nunique). Re-plan the user's ORIGINAL plan for the host
+            # twins; the measured wall still records under wall_sig,
+            # so arbitration stays consistent.
+            meta = wrap_plan(prune_columns(plan0), conf)
+            meta.tag()
+            _revert_all(meta, "cost-based: whole-plan host placement "
+                              "(native shape, no device rewrites)")
     explain = conf.explain
     if explain in ("NOT_ON_TPU", "ALL"):
         out = meta.explain(only_not_on_tpu=(explain == "NOT_ON_TPU"))
@@ -97,6 +113,28 @@ def plan_query(plan: L.LogicalPlan, conf: TpuConf, mesh=None,
             # still apply (losing them regressed latency-bound joins)
             physical = maybe_fuse_single_chip(physical, conf)
     return physical
+
+
+#: logical nodes whose execs are engine-shared pass-throughs: their
+#: placement says nothing about which engine runs the real compute
+_NEUTRAL_PLANS = (L.LogicalScan, L.ParquetScan, L.Union, L.GlobalLimit,
+                  L.BranchAlign, L.Sample)
+
+
+def _any_device_meta(meta: PlanMeta) -> bool:
+    """True when some non-neutral node still plans onto the device
+    (scans/unions/limits are engine-shared — they don't count; must
+    stay consistent with dataframe._on_device's placement check)."""
+    if meta.can_run_on_tpu and not isinstance(meta.plan, _NEUTRAL_PLANS):
+        return True
+    return any(_any_device_meta(c) for c in meta.child_metas)
+
+
+def _revert_all(meta: PlanMeta, reason: str) -> None:
+    if meta.can_run_on_tpu:
+        meta.will_not_work_on_tpu(reason)
+    for c in meta.child_metas:
+        _revert_all(c, reason)
 
 
 def explain_potential_tpu_plan(plan: L.LogicalPlan, conf: TpuConf) -> str:

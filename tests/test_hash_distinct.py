@@ -179,3 +179,22 @@ def test_union_agg_int_key_direct_addressing():
                              approximate_float=True, ignore_order=False)
     assert "directupd" in direct_kinds(), \
         "multi-batch int-key query missed the direct update path"
+
+
+def test_cpu_twin_nan_cross_batch_and_big_ints():
+    """r5 review scenarios: the vectorized CPU twin must not overcount
+    NaN across batches (nan != nan in python tuples) nor lose int64
+    precision above 2**53 when a null forces a float conversion."""
+    conf = {**_CONF, "spark.rapids.tpu.sql.exec.HashAggregateExec": False}
+    t = pa.table({"v": pa.array([1.0, float("nan")] * 100
+                                + [float("nan")] * 100)})
+    s = tpu_session(conf)
+    out = (s.create_dataframe(t, num_partitions=4)
+           .agg(F.count_distinct(F.col("v")).with_name("cd")).collect())
+    assert out[0]["cd"] == 2, out
+    big = 2 ** 53
+    t2 = pa.table({"v": pa.array([big, big + 1, None, big, big + 1],
+                                 pa.int64())})
+    out2 = (s.create_dataframe(t2, num_partitions=2)
+            .agg(F.count_distinct(F.col("v")).with_name("cd")).collect())
+    assert out2[0]["cd"] == 2, out2
