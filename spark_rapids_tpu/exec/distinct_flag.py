@@ -360,8 +360,7 @@ class CpuDistinctFlagExec(TpuExec):
                 elif v.dtype.kind in "mM":
                     cols[f"c{i}"] = v.view(np.int64)
                 else:
-                    cols[f"c{i}"] = pd.Series(a.to_pylist(),
-                                              dtype=object)
+                    cols[f"c{i}"] = pd.Series(v, dtype=object)
                 # pandas conflates None/NaN for floats; SQL must not
                 # (NULL ignored, NaN counts) — key the null mask in
                 cols[f"n{i}"] = np.asarray(a.is_null())

@@ -118,7 +118,7 @@ def plan_query(plan: L.LogicalPlan, conf: TpuConf, mesh=None,
 #: logical nodes whose execs are engine-shared pass-throughs: their
 #: placement says nothing about which engine runs the real compute
 _NEUTRAL_PLANS = (L.LogicalScan, L.ParquetScan, L.Union, L.GlobalLimit,
-                  L.BranchAlign, L.Sample)
+                  L.BranchAlign)
 
 
 def _any_device_meta(meta: PlanMeta) -> bool:
