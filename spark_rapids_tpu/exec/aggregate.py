@@ -1619,6 +1619,7 @@ class CpuAggregateExec(TpuExec):
             if len(vals) == 0:
                 return None
             if isinstance(a, Percentile):
+                # incl. ApproximatePercentile: computed EXACTLY here
                 fv = vals.astype(np.float64)
                 fv = fv[~np.isnan(fv)]
                 if len(fv) == 0:

@@ -33,7 +33,8 @@ from ..columnar import (ColumnarBatch, DeviceColumn, DictColumn,
 from ..exprs.aggregates import AggregateExpression, Average, Count, CountStar, \
     Max, Min, Sum
 from ..exprs.base import DVal, EvalContext
-from ..exprs.window_fns import (DenseRank, Lag, Lead, NTile, Rank, RowNumber,
+from ..exprs.window_fns import (DenseRank, Lag, Lead, NTile, PercentRank,
+                                Rank, RowNumber,
                                 WindowFunction)
 from ..mem import SpillableBatch, with_retry_no_split
 from ..plan.logical import WindowSpec
@@ -129,6 +130,14 @@ def _build_window_kernel(window_exprs, schema: Schema, padded_len_key=None):
             elif isinstance(fn, Rank):
                 run_start = _start_broadcast(idx, oflags)
                 out_sorted = (run_start - part_start + 1).astype(jnp.int32)
+                ov_sorted = row_mask
+            elif isinstance(fn, PercentRank):
+                run_start = _start_broadcast(idx, oflags)
+                rank = (run_start - part_start + 1).astype(jnp.float64)
+                cnt = (pend - part_start + 1).astype(jnp.float64)
+                out_sorted = jnp.where(cnt > 1, (rank - 1.0)
+                                       / jnp.maximum(cnt - 1.0, 1.0),
+                                       0.0)
                 ov_sorted = row_mask
             elif isinstance(fn, DenseRank):
                 c = prefix_sum(oflags, jnp.int32)
@@ -384,6 +393,13 @@ def _numpy_window_one(fn, spec, col_np, n: int):
     elif isinstance(fn, Rank):
         run_start = np.maximum.accumulate(np.where(oflags, idx, 0))
         out = (run_start - part_start + 1).astype(np.int64)
+        ov = np.ones(n, bool)
+    elif isinstance(fn, PercentRank):
+        run_start = np.maximum.accumulate(np.where(oflags, idx, 0))
+        rank = (run_start - part_start + 1).astype(np.float64)
+        cnt = (pend - part_start + 1).astype(np.float64)
+        out = np.where(cnt > 1, (rank - 1.0) / np.maximum(cnt - 1.0, 1.0),
+                       0.0)
         ov = np.ones(n, bool)
     elif isinstance(fn, DenseRank):
         c = np.cumsum(oflags)
@@ -822,6 +838,12 @@ class CpuWindowExec(TpuExec):
                 res = _sorted_rank(work, pcols, ocols, dense=False)
             elif isinstance(fn, DenseRank):
                 res = _sorted_rank(work, pcols, ocols, dense=True)
+            elif isinstance(fn, PercentRank):
+                rk = _sorted_rank(work, pcols, ocols, dense=False)
+                cnt = (g[work.columns[0]].transform("size") if pcols
+                       else pd.Series(len(work), index=work.index))
+                res = ((rk - 1) / (cnt - 1).clip(lower=1)) \
+                    .where(cnt > 1, other=0.0)
             elif isinstance(fn, NTile):
                 rn = g.cumcount()
                 cnt = g[work.columns[0]].transform("size") \

@@ -32,7 +32,7 @@ from ..columnar.segmented import SortedSegments, seg_max, seg_min, seg_sum
 __all__ = ["AggregateExpression", "Sum", "Count", "CountStar", "Min", "Max",
            "Average", "First", "Last", "StddevSamp", "StddevPop",
            "VarianceSamp", "VariancePop", "CollectList", "CollectSet",
-           "MinBy", "MaxBy", "Percentile"]
+           "MinBy", "MaxBy", "Percentile", "ApproximatePercentile"]
 
 
 def _seg_sum(data, valid, gid, num_segments):
@@ -635,3 +635,16 @@ class Percentile(_HostOnlyAgg):
 
     def key(self):
         return f"percentile({self.child.key()},{self.percentage})"
+
+class ApproximatePercentile(Percentile):
+    """approx_percentile(e, p[, accuracy]): Spark's t-digest sketch is
+    an ACCURACY/memory trade; this engine computes the EXACT percentile
+    instead (a strictly tighter answer — the accuracy argument is
+    accepted and ignored). Ref GpuApproximatePercentile /
+    ApproxPercentileFromTDigestExpr."""
+
+    def __init__(self, child, percentage: float, accuracy: int = 10000,
+                 name=None):
+        super().__init__(child, percentage, name)
+        self.accuracy = int(accuracy)
+

@@ -15,7 +15,7 @@ from .arithmetic import arrow_to_masked_numpy, masked_numpy_to_arrow
 
 __all__ = ["EqualTo", "EqualNullSafe", "NotEqual", "LessThan",
            "LessThanOrEqual", "GreaterThan", "GreaterThanOrEqual",
-           "IsNull", "IsNotNull", "IsNaN", "In"]
+           "IsNull", "IsNotNull", "IsNaN", "In", "InSet"]
 
 
 def _nan_eq(l, r):
@@ -330,3 +330,9 @@ class In(Expression):
 
     def key(self):
         return f"in({self.children[0].key()},{self.values!r})"
+
+
+class InSet(In):
+    """Optimizer-produced literal-set IN (ref GpuInSet): identical
+    evaluation to In — Spark splits them only because InSet carries a
+    pre-built set; here the literal tuple already is one."""

@@ -12,7 +12,7 @@ from ..types import (DATE, FLOAT64, INT32, INT64, STRING, TIMESTAMP,
                      Schema, TypeSig, TypeEnum)
 from .base import DVal, Expression, Unsupported, null_and
 
-__all__ = ["Year", "Month", "DayOfMonth", "Hour", "Minute", "Second",
+__all__ = ["DateAddInterval", "Year", "Month", "DayOfMonth", "Hour", "Minute", "Second",
            "DayOfWeek", "WeekDay", "DayOfYear", "Quarter", "DateAdd",
            "DateSub", "DateDiff", "UnixDate", "civil_from_days",
            "LastDay", "AddMonths", "MonthsBetween", "SecondsToTimestamp",
@@ -725,6 +725,34 @@ class TimeAdd(Expression):
 
     def key(self):
         return f"time_add({self.children[0].key()},{self.micros})"
+
+
+class DateAddInterval(Expression):
+    """date + INTERVAL (days component only — a date plus sub-day
+    intervals is a type error in ANSI Spark; ref GpuDateAddInterval)."""
+    device_type_sig = TypeSig([TypeEnum.DATE])
+
+    def __init__(self, child, interval_days: int):
+        self.children = [child]
+        self.days = int(interval_days)
+
+    def data_type(self, schema):
+        return DATE
+
+    def eval_device(self, ctx):
+        v = self.children[0].eval_device(ctx)
+        return DVal(v.data + jnp.int32(self.days), v.validity, DATE)
+
+    def eval_host(self, batch):
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        arr = self.children[0].eval_host(batch)
+        out = pc.add(pc.cast(arr, pa.int32()),
+                     pa.scalar(self.days, pa.int32()))
+        return pc.cast(out, pa.date32())
+
+    def key(self):
+        return f"date_add_interval({self.children[0].key()},{self.days})"
 
 
 class TruncDate(Expression):

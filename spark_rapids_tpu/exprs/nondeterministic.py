@@ -113,6 +113,52 @@ class InputFileName(_TaskContextExpr):
         return "input_file_name()"
 
 
+class InputFileBlockStart(_TaskContextExpr):
+    """Byte offset of the current input block; this engine reads whole
+    files per task, so the block starts at 0 (-1 when the source is not
+    file-based — Spark semantics; ref InputFileBlockRule.scala)."""
+
+    def data_type(self, schema: Schema) -> DataType:
+        return INT64
+
+    def eval_host(self, batch):
+        import pyarrow as pa
+        v = 0 if batch.meta.get("input_file") else -1
+        return pa.array([v] * batch.num_rows, type=pa.int64())
+
+    def key(self):
+        return "InputFileBlockStart()"
+
+    @property
+    def name_hint(self):
+        return "input_file_block_start()"
+
+
+class InputFileBlockLength(_TaskContextExpr):
+    """Length of the current input block = the whole file here (-1 when
+    not file-based; ref InputFileBlockRule.scala)."""
+
+    def data_type(self, schema: Schema) -> DataType:
+        return INT64
+
+    def eval_host(self, batch):
+        import os
+        import pyarrow as pa
+        f = batch.meta.get("input_file")
+        try:
+            v = os.path.getsize(f) if f else -1
+        except OSError:
+            v = -1
+        return pa.array([v] * batch.num_rows, type=pa.int64())
+
+    def key(self):
+        return "InputFileBlockLength()"
+
+    @property
+    def name_hint(self):
+        return "input_file_block_length()"
+
+
 def _splitmix64(x: np.ndarray) -> np.ndarray:
     x = (x + np.uint64(0x9E3779B97F4A7C15))
     x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)

@@ -21,6 +21,7 @@ __all__ = ["Length", "Upper", "Lower", "Substring", "ConcatStrings",
            "StringTrimRight", "StringReplace", "StringLocate", "Lpad",
            "Rpad", "Reverse", "StringRepeat", "InitCap", "StringSplit",
            "SubstringIndex", "Ascii", "Chr", "BitLength", "OctetLength",
+           "RegExpExtractAll", "Conv",
            "StringInstr", "StringTranslate", "ConcatWs", "FormatNumber"]
 
 _str_sig = TypeSig([TypeEnum.STRING])
@@ -354,6 +355,103 @@ class _TrimBase(_HostStringExpr):
             return getattr(pc, self.pc_fn)(arr)
         fn = self.pc_fn.replace("_whitespace", "")
         return getattr(pc, fn)(arr, characters=self.chars)
+
+
+class RegExpExtractAll(_HostStringExpr):
+    """regexp_extract_all(str, regex, group) -> array<string> (ref
+    GpuRegExpExtractAll via the transpiler; host-only nested output)."""
+
+    def __init__(self, child, pattern: str, group: int = 1):
+        self.children = [child]
+        self.pattern = pattern
+        self.group = int(group)
+        # the eval is a python row loop: always transpile for python-re
+        # (the re2 dialect is only valid inside pyarrow pc.* kernels)
+        from .regex_transpiler import transpile_java_regex
+        self._pyregex = transpile_java_regex(pattern, target="python")
+
+    def data_type(self, schema):
+        from ..types import ArrayType
+        return ArrayType(STRING)
+
+    def eval_host(self, batch):
+        import re as _re
+        import pyarrow as pa
+        rx = _re.compile(self._pyregex)
+        arr = self.children[0].eval_host(batch)
+        out = []
+        for v in arr.to_pylist():
+            if v is None:
+                out.append(None)
+                continue
+            vals = []
+            for m in rx.finditer(v):
+                g = m.group(self.group) if self.group else m.group(0)
+                vals.append("" if g is None else g)
+            out.append(vals)
+        return pa.array(out, type=pa.list_(pa.string()))
+
+    def key(self):
+        return (f"regexp_extract_all({self.children[0].key()},"
+                f"{self.pattern!r},{self.group})")
+
+
+class Conv(_HostStringExpr):
+    """conv(num_str, from_base, to_base): base conversion with Java
+    semantics — invalid digits truncate the parse, empty parse -> NULL,
+    negative to_base keeps the sign, uppercase output (ref GpuConv)."""
+
+    def __init__(self, child, from_base: int, to_base: int):
+        self.children = [child]
+        self.from_base = int(from_base)
+        self.to_base = int(to_base)
+
+    def data_type(self, schema):
+        return STRING
+
+    def _convert(self, v: str):
+        fb, tb = self.from_base, abs(self.to_base)
+        if not (2 <= fb <= 36 and 2 <= tb <= 36):
+            return None
+        v = v.strip()
+        neg = v.startswith("-")
+        if neg:
+            v = v[1:]
+        digits = "0123456789abcdefghijklmnopqrstuvwxyz"[:fb]
+        acc = 0
+        seen = False
+        for ch in v.lower():
+            d = digits.find(ch)
+            if d < 0:
+                break
+            acc = acc * fb + d
+            seen = True
+        if not seen:
+            return None
+        acc = min(acc, (1 << 64) - 1)     # Java clamps at unsigned max
+        if neg and self.to_base > 0:
+            # Java: negative input with positive to_base wraps unsigned
+            # (modulo keeps '-0' at 0 and the result inside 64 bits)
+            acc = ((1 << 64) - acc) % (1 << 64)
+        out_digits = "0123456789ABCDEFGHIJKLMNOPQRSTUVWXYZ"
+        if acc == 0:
+            return "0"
+        out = []
+        n = acc
+        while n:
+            out.append(out_digits[n % tb])
+            n //= tb
+        body = "".join(reversed(out))
+        return ("-" + body) if (neg and self.to_base < 0) else body
+
+    def eval_host(self, batch):
+        import pyarrow as pa
+        arr = self.children[0].eval_host(batch)
+        return _py_row_map(arr, self._convert, pa.string())
+
+    def key(self):
+        return (f"conv({self.children[0].key()},{self.from_base},"
+                f"{self.to_base})")
 
 
 class StringTrim(_TrimBase):

@@ -44,6 +44,15 @@ class DenseRank(WindowFunction):
         return INT32
 
 
+class PercentRank(WindowFunction):
+    """(rank - 1) / (partition rows - 1); 0.0 for 1-row partitions
+    (ref GpuPercentRank)."""
+
+    def data_type(self, schema):
+        from ..types import FLOAT64
+        return FLOAT64
+
+
 class NTile(WindowFunction):
     def __init__(self, n: int):
         self.n = n

@@ -102,12 +102,24 @@ def _load_registries():
 
 
 def expression_inventory() -> List[Dict]:
-    """One record per concrete Expression: name, module, device/host support,
-    per-type support derived from device_type_sig."""
+    """One record per concrete Expression, AggregateExpression, or
+    WindowFunction: name, module, device/host support, per-type support
+    derived from device_type_sig. Aggregate and window families are
+    separate class hierarchies here but ARE expression rules in the
+    reference's registry (GpuOverrides.scala exprs map), so the honest
+    count includes them."""
     _load_registries()
     from ..exprs.aggregates import AggregateExpression
+    from ..exprs.window_fns import WindowFunction
+    seen = set()
+    classes = []
+    for root in (Expression, AggregateExpression, WindowFunction):
+        for cls in _all_subclasses(root):
+            if cls.__name__ not in seen:
+                seen.add(cls.__name__)
+                classes.append(cls)
     recs = []
-    for cls in sorted(_all_subclasses(Expression), key=lambda c: c.__name__):
+    for cls in sorted(classes, key=lambda c: c.__name__):
         if cls.__name__.startswith("_") or inspect.isabstract(cls):
             continue
         has_device = ("eval_device" in cls.__dict__
@@ -120,15 +132,36 @@ def expression_inventory() -> List[Dict]:
                            if b not in (Expression,)))
         is_agg = issubclass(cls, AggregateExpression)
         if is_agg:
-            # aggregates evaluate through update/merge/finalize, not eval_*
+            # aggregates evaluate through update/merge/finalize, not
+            # eval_*; _HostOnlyAgg subclasses run via the CPU twin only
+            from ..exprs.aggregates import _HostOnlyAgg
+            if issubclass(cls, _HostOnlyAgg):
+                has_host = True
+            else:
+                has_device = True
+        is_win = issubclass(cls, WindowFunction)
+        if is_win:
+            # window functions evaluate inside the window kernels
             has_device = True
         if not has_device and not has_host:
             continue  # abstract helper (no evaluation contract)
-        sig = cls.device_type_sig
+        from ..types import TypeSig
+        sig = getattr(cls, "device_type_sig", None)
+        if sig is None:
+            # aggregate/window hierarchies don't carry TypeSig (their
+            # input typing is enforced by the kernels): report the
+            # CONSERVATIVE numeric core every member accepts — claiming
+            # less than min/max/count actually support beats claiming
+            # string averages that the engine rejects
+            sig = TypeSig([TypeEnum.BOOLEAN, TypeEnum.BYTE,
+                           TypeEnum.SHORT, TypeEnum.INT, TypeEnum.LONG,
+                           TypeEnum.FLOAT, TypeEnum.DOUBLE,
+                           TypeEnum.DATE, TypeEnum.TIMESTAMP])
         recs.append({
             "name": cls.__name__,
             "module": cls.__module__.rsplit(".", 1)[-1],
-            "context": "aggregation" if is_agg else "project",
+            "context": ("aggregation" if is_agg
+                        else "window" if is_win else "project"),
             "device": has_device,
             "host": has_host,
             # device byte-rectangle kernel (exprs/string_rect.py,

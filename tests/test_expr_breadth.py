@@ -6,7 +6,7 @@ registry entries for each)."""
 import numpy as np
 import pyarrow as pa
 
-from harness import tpu_session
+from harness import assert_tpu_and_cpu_equal, tpu_session
 from spark_rapids_tpu.api import TpuSession
 from spark_rapids_tpu.api.dataframe import DataFrame
 import spark_rapids_tpu.plan.logical as L
@@ -275,3 +275,122 @@ def test_collect_minby_percentile_aggs():
     # max_by returns that NULL; min_by picks o=3.0 -> 7
     assert rows[2][0] == [7] and rows[2][2] == 7 and rows[2][3] is None
     assert rows[2][4] == 7.0
+
+
+# ---------------------------------------------------------------------------
+# r5 expression-inventory additions (ref GpuOverrides rules not previously
+# registered: InSet, RegExpExtractAll, Conv, ApproximatePercentile,
+# DateAddInterval, InputFileBlockStart/Length, PercentRank)
+# ---------------------------------------------------------------------------
+
+def test_inset_matches_in():
+    from spark_rapids_tpu.exprs.comparison import In, InSet
+    t = pa.table({"a": pa.array([1, 2, 3, None, 5], pa.int64())})
+
+    def q(s):
+        df = s.create_dataframe(t)
+        from spark_rapids_tpu.api.functions import Col
+        return df.select(Col(InSet(__import__(
+            "spark_rapids_tpu.exprs", fromlist=["ColumnRef"]
+        ).ColumnRef("a"), (1, 3, 7))).alias("m"))
+    got = q(tpu_session()).to_pandas()
+    assert list(got["m"].fillna("NULL")) == [True, False, True, "NULL",
+                                             False]
+
+
+def test_regexp_extract_all():
+    from spark_rapids_tpu.exprs.string_fns import RegExpExtractAll
+    from spark_rapids_tpu.exprs import ColumnRef
+    from spark_rapids_tpu.api.functions import Col
+    t = pa.table({"s": pa.array(["a1b22c333", "xyz", None, "9z9"])})
+    s = tpu_session()
+    out = (s.create_dataframe(t)
+           .select(Col(RegExpExtractAll(ColumnRef("s"), r"\d+", 0))
+                   .alias("m")).collect())
+    assert [r["m"] for r in out] == [["1", "22", "333"], [], None,
+                                     ["9", "9"]]
+
+
+def test_conv_base_conversion():
+    from spark_rapids_tpu.exprs.string_fns import Conv
+    from spark_rapids_tpu.exprs import ColumnRef
+    from spark_rapids_tpu.api.functions import Col
+    t = pa.table({"s": pa.array(["100", "ff", "", None, "7"])})
+    s = tpu_session()
+    out = (s.create_dataframe(t)
+           .select(Col(Conv(ColumnRef("s"), 16, 10)).alias("d"),
+                   Col(Conv(ColumnRef("s"), 10, 2)).alias("b"))
+           .collect())
+    assert [r["d"] for r in out] == ["256", "255", None, None, "7"]
+    assert [r["b"] for r in out] == ["1100100", None, None, None, "111"]
+
+
+def test_approx_percentile_exact():
+    from spark_rapids_tpu.exprs.aggregates import ApproximatePercentile
+    from spark_rapids_tpu.exprs import ColumnRef
+    t = pa.table({"v": pa.array([float(i) for i in range(101)])})
+    s = tpu_session()
+    out = (s.create_dataframe(t)
+           .agg(ApproximatePercentile(ColumnRef("v"), 0.5)
+                .with_name("p50")).collect())
+    assert out[0]["p50"] == 50.0
+
+
+def test_date_add_interval():
+    import datetime
+    from spark_rapids_tpu.exprs.datetime_fns import DateAddInterval
+    from spark_rapids_tpu.exprs import ColumnRef
+    from spark_rapids_tpu.api.functions import Col
+    t = pa.table({"d": pa.array([datetime.date(2024, 1, 30),
+                                 datetime.date(2024, 2, 28), None])})
+
+    def q(s):
+        return s.create_dataframe(t).select(
+            Col(DateAddInterval(ColumnRef("d"), 3)).alias("o"))
+    assert_tpu_and_cpu_equal(q)
+    got = [r["o"] for r in q(tpu_session()).collect()]
+    assert got == [datetime.date(2024, 2, 2), datetime.date(2024, 3, 2),
+                   None]
+
+
+def test_input_file_block_exprs(tmp_path):
+    import os as _os
+    import pyarrow.parquet as pq
+    from spark_rapids_tpu.exprs.nondeterministic import (
+        InputFileBlockLength, InputFileBlockStart)
+    from spark_rapids_tpu.api.functions import Col
+    t = pa.table({"a": list(range(100))})
+    p = str(tmp_path / "f.parquet")
+    pq.write_table(t, p)
+    s = tpu_session()
+    out = (s.read_parquet(p)
+           .select(Col(InputFileBlockStart()).alias("st"),
+                   Col(InputFileBlockLength()).alias("ln")).collect())
+    assert all(r["st"] == 0 for r in out)
+    assert all(r["ln"] == _os.path.getsize(p) for r in out)
+    # non-file source: -1 (Spark semantics)
+    out2 = (s.create_dataframe(t)
+            .select(Col(InputFileBlockStart()).alias("st")).collect())
+    assert all(r["st"] == -1 for r in out2)
+
+
+def test_percent_rank_differential():
+    from spark_rapids_tpu.api import functions as F
+    rng = np.random.RandomState(4)
+    n = 4000
+    t = pa.table({"p": pa.array(rng.randint(0, 40, n)),
+                  "o": pa.array(rng.randint(0, 1000, n)),
+                  "v": pa.array(rng.uniform(0, 1, n))})
+
+    def q(s):
+        return s.create_dataframe(t).with_window_column(
+            "pr", F.percent_rank(), partition_by=["p"],
+            order_by=[F.col("o").asc()])
+    got = q(tpu_session()).to_pandas().sort_values(["p", "o"]) \
+        .reset_index(drop=True)
+    pdf = t.to_pandas()
+    want = (pdf.assign(pr=pdf.groupby("p")["o"].rank(method="min"))
+            .sort_values(["p", "o"]).reset_index(drop=True))
+    cnt = want.groupby("p")["o"].transform("size")
+    exp = np.where(cnt > 1, (want["pr"] - 1) / np.maximum(cnt - 1, 1), 0.0)
+    np.testing.assert_allclose(got["pr"].to_numpy(), exp, rtol=1e-12)
