@@ -398,6 +398,7 @@ def row_number(): return E.RowNumber()
 def rank(): return E.Rank()
 def dense_rank(): return E.DenseRank()
 def ntile(n): return E.NTile(n)
+def nth_value(c, n): return E.NthValue(_to_expr(c), n)
 def percent_rank(): return E.PercentRank()
 def lag(c, offset=1, default=None):
     return E.Lag(_to_expr(c), offset, default)

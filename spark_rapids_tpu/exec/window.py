@@ -33,8 +33,8 @@ from ..columnar import (ColumnarBatch, DeviceColumn, DictColumn,
 from ..exprs.aggregates import AggregateExpression, Average, Count, CountStar, \
     Max, Min, Sum
 from ..exprs.base import DVal, EvalContext
-from ..exprs.window_fns import (DenseRank, Lag, Lead, NTile, PercentRank,
-                                Rank, RowNumber,
+from ..exprs.window_fns import (DenseRank, Lag, Lead, NthValue, NTile,
+                                PercentRank, Rank, RowNumber,
                                 WindowFunction)
 from ..mem import SpillableBatch, with_retry_no_split
 from ..plan.logical import WindowSpec
@@ -178,6 +178,16 @@ def _build_window_kernel(window_exprs, schema: Schema, padded_len_key=None):
                         jnp.logical_and(ok, same_part)), row_mask)
                     out_sorted = jnp.where(fill, dflt, out_sorted)
                     ov_sorted = jnp.logical_or(ov_sorted, fill)
+            elif isinstance(fn, NthValue):
+                sd = sorted_child.data
+                sv = sorted_child.validity
+                rel = idx - part_start
+                src_flags = jnp.logical_and(rel == fn.n - 1, row_mask)
+                out_sorted = last_valid_scan(sd, src_flags)[0]
+                nth_valid = last_valid_scan(sv, src_flags)[0]
+                ov_sorted = jnp.logical_and(
+                    jnp.logical_and(rel >= fn.n - 1, nth_valid),
+                    row_mask)
             elif isinstance(fn, AggregateExpression):
                 out_sorted, ov_sorted = _windowed_agg(
                     fn, spec, ctx, sorted_child, part_start, idx,
@@ -406,6 +416,14 @@ def _numpy_window_one(fn, spec, col_np, n: int):
         c_at = np.maximum.accumulate(np.where(pflags, c, 0))
         out = (c - c_at + 1).astype(np.int64)
         ov = np.ones(n, bool)
+    elif isinstance(fn, NthValue):
+        vd = np.asarray(child_pair[0])[order]
+        vv = np.asarray(child_pair[1])[order]
+        rel = idx - part_start
+        src = np.clip(part_start + fn.n - 1, 0, n - 1)
+        ok = rel >= fn.n - 1
+        out = np.where(ok, vd[src], np.zeros((), vd.dtype))
+        ov = ok & vv[src]
     elif isinstance(fn, (Lag, Lead)):
         vd = np.asarray(child_pair[0])[order]
         vv = np.asarray(child_pair[1])[order]
@@ -415,7 +433,7 @@ def _numpy_window_one(fn, spec, col_np, n: int):
         srcc = np.clip(src, 0, n - 1)
         out = np.where(inside, vd[srcc], np.zeros((), vd.dtype))
         ov = np.where(inside, vv[srcc], False)
-        if fn.default is not None:
+        if getattr(fn, "default", None) is not None:
             fill = ~inside
             out = np.where(fill, np.asarray(fn.default, vd.dtype), out)
             ov = ov | fill
@@ -853,6 +871,8 @@ class CpuWindowExec(TpuExec):
                 res = (rn.where(rn < big, other=None).floordiv(base + 1)
                        .fillna(rem + (rn - big) // base.clip(lower=1))
                        .astype("int64") + 1)
+            elif isinstance(fn, NthValue):
+                res = _host_nth_value(fn, g, work, batch)
             elif isinstance(fn, (Lag, Lead)):
                 # validity-aware shift: out-of-partition slots are SQL
                 # NULL (or the default), never NaN — pandas shift's NaN
@@ -1046,6 +1066,32 @@ class CpuWindowExec(TpuExec):
     def describe(self):
         return "CpuWindow[" + ", ".join(n for _, _, n in
                                         self.window_exprs) + "]"
+
+
+def _host_nth_value(fn, g, work, batch):
+    """Running-frame nth value: the partition's n-th row's value for
+    rows at position >= n-1, else NULL."""
+    import numpy as np
+    import pyarrow as pa
+    arr = fn.child.eval_host(batch)
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    ok_full = ~np.asarray(arr.is_null())
+    v_full = np.asarray(arr.to_pandas().to_numpy(), dtype=object)
+    pos = work.index.to_numpy()
+    vals, ok = v_full[pos], ok_full[pos]
+    out = np.empty(len(work), dtype=object)
+    start = 0
+    for sz in g.size().to_numpy():
+        m = int(sz)
+        res = np.full(m, None, dtype=object)
+        if m >= fn.n:
+            v = vals[start + fn.n - 1] if ok[start + fn.n - 1] else None
+            res[fn.n - 1:] = v
+        out[start:start + m] = res
+        start += m
+    import pandas as pd
+    return pd.Series(out, index=work.index)
 
 
 def _host_shift(fn, g, work, batch):

@@ -36,7 +36,8 @@ from .string_fns import (Ascii, BitLength, Chr, ConcatStrings, ConcatWs,
                          StringTrimRight, Substring, SubstringIndex, Upper)
 from .regex_transpiler import (RegexUnsupported, sql_like_to_regex,
                                transpile_java_regex)
-from .window_fns import (DenseRank, Lag, Lead, NTile, PercentRank, Rank,
+from .window_fns import (DenseRank, Lag, Lead, NthValue, NTile,
+                         PercentRank, Rank,
                          RowNumber)
 from .collection_fns import (ArrayContains, ArrayDistinct, ArrayExcept,
                              ArrayIntersect, ArrayJoin, ArrayMax, ArrayMin,

@@ -429,20 +429,24 @@ class Conv(_HostStringExpr):
         if not seen:
             return None
         acc = min(acc, (1 << 64) - 1)     # Java clamps at unsigned max
-        if neg and self.to_base > 0:
-            # Java: negative input with positive to_base wraps unsigned
-            # (modulo keeps '-0' at 0 and the result inside 64 bits)
-            acc = ((1 << 64) - acc) % (1 << 64)
+        # two's-complement 64-bit value (modulo keeps '-0' at 0)
+        v = ((1 << 64) - acc) % (1 << 64) if neg else acc
+        if self.to_base > 0:
+            neg_out, mag = False, v       # printed UNSIGNED
+        else:
+            # negative to_base prints the value as a SIGNED long
+            sval = v - (1 << 64) if v >= (1 << 63) else v
+            neg_out, mag = sval < 0, abs(sval)
         out_digits = "0123456789ABCDEFGHIJKLMNOPQRSTUVWXYZ"
-        if acc == 0:
+        if mag == 0:
             return "0"
         out = []
-        n = acc
+        n = mag
         while n:
             out.append(out_digits[n % tb])
             n //= tb
         body = "".join(reversed(out))
-        return ("-" + body) if (neg and self.to_base < 0) else body
+        return ("-" + body) if neg_out else body
 
     def eval_host(self, batch):
         import pyarrow as pa

@@ -53,6 +53,25 @@ class PercentRank(WindowFunction):
         return FLOAT64
 
 
+class NthValue(WindowFunction):
+    """nth_value(e, n): the n-th row's value within the RUNNING frame
+    (unbounded preceding .. current row — Spark's default frame); NULL
+    while the frame holds fewer than n rows (ref GpuNthValue)."""
+
+    def __init__(self, child: Expression, n: int):
+        self.child = child
+        self.n = int(n)
+        if self.n < 1:
+            raise ValueError("nth_value offset must be >= 1")
+
+    def data_type(self, schema):
+        return self.child.data_type(schema)
+
+    @property
+    def name_hint(self):
+        return f"nth_value({self.child.name_hint},{self.n})"
+
+
 class NTile(WindowFunction):
     def __init__(self, n: int):
         self.n = n

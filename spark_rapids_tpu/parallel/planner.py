@@ -847,14 +847,15 @@ class _Planner:
         return self.source(node, replicated)
 
     def _lower_window(self, node, replicated: bool) -> _Frag:
-        from ..exprs.window_fns import (DenseRank, Lag, Lead, NTile,
-                                        PercentRank, Rank, RowNumber)
+        from ..exprs.window_fns import (DenseRank, Lag, Lead, NthValue,
+                                        NTile, PercentRank, Rank,
+                                        RowNumber)
         from ..exprs.aggregates import AggregateExpression
         child = self.lower(node.children[0], replicated)
         part_sig = None
         for fn, spec, _name in node.window_exprs:
             if not isinstance(fn, (RowNumber, Rank, DenseRank, NTile,
-                                   PercentRank, Lag, Lead,
+                                   PercentRank, NthValue, Lag, Lead,
                                    AggregateExpression)):
                 raise _NotLowerable(f"window fn {type(fn).__name__}")
             # all exprs must share ONE partitioning: the routing

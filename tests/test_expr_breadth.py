@@ -394,3 +394,35 @@ def test_percent_rank_differential():
     cnt = want.groupby("p")["o"].transform("size")
     exp = np.where(cnt > 1, (want["pr"] - 1) / np.maximum(cnt - 1, 1), 0.0)
     np.testing.assert_allclose(got["pr"].to_numpy(), exp, rtol=1e-12)
+
+
+def test_nth_value_differential():
+    from spark_rapids_tpu.api import functions as F
+    rng = np.random.RandomState(9)
+    n = 3000
+    t = pa.table({"p": pa.array(rng.randint(0, 30, n)),
+                  "o": pa.array(rng.permutation(n)),
+                  "v": pa.array([None if x < 0.08 else float(x)
+                                 for x in rng.uniform(0, 1, n)])})
+
+    def q(s):
+        return s.create_dataframe(t).with_window_column(
+            "nv", F.nth_value(F.col("v"), 3), partition_by=["p"],
+            order_by=[F.col("o").asc()])
+    got = q(tpu_session()).to_pandas().sort_values(["p", "o"]) \
+        .reset_index(drop=True)
+    pdf = t.to_pandas().sort_values(["p", "o"]).reset_index(drop=True)
+    exp = []
+    for _, grp in pdf.groupby("p", sort=False):
+        v3 = grp["v"].iloc[2] if len(grp) >= 3 else None
+        for i in range(len(grp)):
+            exp.append(v3 if i >= 2 else None)
+    exp_s = pdf.assign(nv=np.asarray(exp, dtype=object)) \
+        .sort_values(["p", "o"])["nv"]
+    a = got["nv"].to_numpy(dtype=object)
+    b = exp_s.to_numpy(dtype=object)
+    for x, y in zip(a, b):
+        if y is None or (isinstance(y, float) and y != y):
+            assert x is None or (isinstance(x, float) and x != x), (x, y)
+        else:
+            assert abs(x - y) < 1e-12, (x, y)

@@ -271,3 +271,24 @@ def test_window_host_numpy_path_matches_device_and_oracle():
         q, conf={"spark.rapids.tpu.window.hostSinkRowThreshold": 1},
         approximate_float=True)
     assert list(dev.columns) == list(host.columns)
+
+
+def test_lag_explicit_default_on_device():
+    """r5 review regression: the Lag/Lead default-fill must stay in the
+    Lag/Lead device branch (it was briefly swallowed by a neighboring
+    branch, turning lag(v, 1, default) partition heads into NULL)."""
+    from spark_rapids_tpu.exprs.window_fns import Lag, Lead
+    from spark_rapids_tpu.exprs import ColumnRef
+    t = pa.table({"p": [1, 1, 2], "o": [1, 2, 1],
+                  "v": [10.0, 20.0, 30.0]})
+    s = tpu_session()
+    out = (s.create_dataframe(t)
+           .with_window_column("lg", Lag(ColumnRef("v"), 1, -1.0),
+                               partition_by=["p"],
+                               order_by=[F.col("o").asc()])
+           .with_window_column("ld", Lead(ColumnRef("v"), 1, -2.0),
+                               partition_by=["p"],
+                               order_by=[F.col("o").asc()])
+           .to_pandas().sort_values(["p", "o"]))
+    assert list(out["lg"]) == [-1.0, 10.0, -1.0]
+    assert list(out["ld"]) == [20.0, -2.0, -2.0]
