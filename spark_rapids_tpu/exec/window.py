@@ -161,7 +161,7 @@ def _build_window_kernel(window_exprs, schema: Schema, padded_len_key=None):
             elif isinstance(fn, (Lag, Lead)):
                 sd = sorted_child.data
                 sv = sorted_child.validity
-                off = fn.offset if isinstance(fn, Lag) else -fn.offset
+                off = fn.signed_offset
                 # STATIC shift (a concatenate), not a row gather
                 ok = jnp.logical_and(idx - off >= 0, idx - off < P)
                 out_sorted = shift_static(sd, off,
@@ -427,7 +427,7 @@ def _numpy_window_one(fn, spec, col_np, n: int):
     elif isinstance(fn, (Lag, Lead)):
         vd = np.asarray(child_pair[0])[order]
         vv = np.asarray(child_pair[1])[order]
-        off = fn.offset if isinstance(fn, Lag) else -fn.offset
+        off = fn.signed_offset
         src = idx - off
         inside = (src >= part_start) & (src <= pend)
         srcc = np.clip(src, 0, n - 1)
@@ -1105,7 +1105,7 @@ def _host_shift(fn, g, work, batch):
     v_full = np.asarray(arr.to_pandas().to_numpy(), dtype=object)
     pos = work.index.to_numpy()
     vals, ok = v_full[pos], ok_full[pos]
-    off = fn.offset if isinstance(fn, Lag) else -fn.offset
+    off = fn.signed_offset
     out = np.empty(len(work), dtype=object)
     start = 0
     for sz in g.size().to_numpy():

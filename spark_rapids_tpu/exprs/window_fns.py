@@ -86,6 +86,14 @@ class Lag(WindowFunction):
         self.offset = offset
         self.default = default
 
+    @property
+    def signed_offset(self) -> int:
+        """Shift distance with direction baked in (+N looks back).
+        Lead overrides — call sites must use THIS, not an isinstance
+        ternary: Lead subclasses Lag, so a Lag-first check silently
+        gives lead() lag semantics (the r5 bug)."""
+        return self.offset
+
     def data_type(self, schema):
         return self.child.data_type(schema)
 
@@ -100,6 +108,10 @@ class Lag(WindowFunction):
 class Lead(Lag):
     def __init__(self, child: Expression, offset: int = 1, default=None):
         super().__init__(child, offset, default)
+
+    @property
+    def signed_offset(self) -> int:
+        return -self.offset
 
     @property
     def name_hint(self):

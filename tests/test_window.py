@@ -277,6 +277,7 @@ def test_lag_explicit_default_on_device():
     """r5 review regression: the Lag/Lead default-fill must stay in the
     Lag/Lead device branch (it was briefly swallowed by a neighboring
     branch, turning lag(v, 1, default) partition heads into NULL)."""
+    import pyarrow as pa
     from spark_rapids_tpu.exprs.window_fns import Lag, Lead
     from spark_rapids_tpu.exprs import ColumnRef
     t = pa.table({"p": [1, 1, 2], "o": [1, 2, 1],
