@@ -37,6 +37,21 @@ def _as_schema(schema) -> Schema:
 __all__ = ["TpuSession", "DataFrame", "GroupedData"]
 
 
+def _rename_refs(e: Expression, mapping: dict) -> Expression:
+    """Deep-copied expression with ColumnRef names remapped (set-op
+    right-side rename)."""
+    import copy as _copy
+    e = _copy.deepcopy(e)
+
+    def walk(x):
+        if isinstance(x, ColumnRef) and x.name in mapping:
+            x.name = mapping[x.name]
+        for c in getattr(x, "children", ()):
+            walk(c)
+    walk(e)
+    return e
+
+
 def _as_expr(c, alias_ok=True) -> Expression:
     if isinstance(c, str):
         return ColumnRef(c)
@@ -389,6 +404,99 @@ class DataFrame:
         return DataFrame(self.session, L.Union([self.plan, other.plan]))
 
     unionAll = union
+
+    # ------------------------------------------------------- set operations
+    def _nullsafe_key_pairs(self, other):
+        """Per-column join-key expression pairs implementing SQL set-op
+        equality over standard equi-joins: each column contributes an
+        is-null flag plus a default-filled value, so NULLs match NULLs
+        and never a real default. NaN == NaN and -0.0 == 0.0 come from
+        the join key encoding itself (columnar/encoding.py float
+        canonicalization). Columns pair POSITIONALLY (SQL set-op
+        semantics — names may differ between the sides); the output
+        keeps the left side's names. Ref: Spark plans set ops as joins
+        with EqualNullSafe keys (ReplaceOperators)."""
+        from ..exprs import Coalesce, IsNull, Literal
+        sch = self.plan.schema()
+        osch = other.plan.schema()
+        if len(sch.fields) != len(osch.fields):
+            raise ValueError(
+                "set operations require the same number of columns "
+                f"({len(sch.fields)} vs {len(osch.fields)})")
+        defaults = {"string": "", "boolean": False, "float": 0.0,
+                    "double": 0.0}
+        pairs = []
+        for lf_, rf_ in zip(sch.fields, osch.fields):
+            d = defaults.get(lf_.dtype.name, 0)
+            l, r = ColumnRef(lf_.name), ColumnRef(rf_.name)
+            pairs.append((IsNull(l), IsNull(r)))
+            pairs.append((Coalesce(l, Literal(d, lf_.dtype)),
+                          Coalesce(r, Literal(d, rf_.dtype))))
+        return pairs
+
+    def intersect(self, other: "DataFrame") -> "DataFrame":
+        """Distinct rows present in BOTH frames (SQL INTERSECT; ref
+        Spark ReplaceIntersectWithSemiJoin -> GpuShuffledHashJoin)."""
+        return self.distinct().join(other,
+                                    on=self._nullsafe_key_pairs(other),
+                                    how="leftsemi")
+
+    def subtract(self, other: "DataFrame") -> "DataFrame":
+        """Distinct rows of this frame absent from ``other`` (SQL
+        EXCEPT; ref ReplaceExceptWithAntiJoin)."""
+        return self.distinct().join(other,
+                                    on=self._nullsafe_key_pairs(other),
+                                    how="leftanti")
+
+    def _counted_setop(self, other, all_kind: str) -> "DataFrame":
+        from . import functions as F
+        from ..exprs import Coalesce, Literal
+        from ..exprs.aggregates import CountStar
+        from ..exprs.conditional import Least
+        from ..exprs.arithmetic import Subtract
+        names = [f.name for f in self.plan.schema().fields]
+        rnames = [f.name for f in other.plan.schema().fields]
+        lc = GroupedData(self, [ColumnRef(n) for n in names]).agg(
+            CountStar().with_name("__so_l"))
+        rc = GroupedData(other, [ColumnRef(n) for n in rnames]).agg(
+            CountStar().with_name("__so_r"))
+        # rename the right side wholesale: positional pairing, and the
+        # joined frame must not carry duplicate names
+        rmap = {rn: f"__so_r_{i}" for i, rn in enumerate(rnames)}
+        rc = rc.select(*([F.col(rn).alias(rmap[rn]) for rn in rnames]
+                         + [F.col("__so_r")]))
+        lk = self._nullsafe_key_pairs(other)
+        pairs = [(le, _rename_refs(re, rmap)) for le, re in lk]
+        if all_kind == "intersect":
+            j = lc.join(rc, on=pairs, how="inner")
+            m = Least(ColumnRef("__so_l"), ColumnRef("__so_r"))
+        else:                           # exceptAll
+            j = lc.join(rc, on=pairs, how="left")
+            m = Subtract(ColumnRef("__so_l"),
+                         Coalesce(ColumnRef("__so_r"), Literal(0)))
+        j = j.with_column("__so_m", Col(m)) \
+             .filter(F.col("__so_m") > F.lit(0))
+        # multiset semantics: replicate each row m times via an exploded
+        # 1..m sequence (the ReplicateRows analog)
+        j = j.select(*(names
+                       + [F.explode(F.sequence(F.lit(1),
+                                               F.col("__so_m")))
+                          .alias("__so_i")]))
+        return j.select(*names)
+
+    def intersect_all(self, other: "DataFrame") -> "DataFrame":
+        """Multiset INTERSECT ALL (ref ReplaceIntersectAll +
+        GpuReplicateRowsExec)."""
+        return self._counted_setop(other, "intersect")
+
+    intersectAll = intersect_all
+
+    def except_all(self, other: "DataFrame") -> "DataFrame":
+        """Multiset EXCEPT ALL (ref ReplaceExceptAll +
+        GpuReplicateRowsExec)."""
+        return self._counted_setop(other, "except")
+
+    exceptAll = except_all
 
     def join(self, other: "DataFrame", on=None, how: str = "inner",
              condition=None) -> "DataFrame":

@@ -60,10 +60,18 @@ class _Lowerer:
             self.views[name.lower()] = self.lower(cte)
         if sel.union_with is not None:
             left = self._resolve_ref(sel.from_ref)
-            mode, rhs = sel.union_with
-            df = left.union(self.lower(rhs))
-            if mode == "distinct":
-                df = df.distinct()
+            op, mode, rhs = sel.union_with
+            right = self.lower(rhs)
+            if op == "union":
+                df = left.union(right)
+                if mode == "distinct":
+                    df = df.distinct()
+            elif op == "intersect":
+                df = (left.intersect_all(right) if mode == "all"
+                      else left.intersect(right))
+            else:                       # EXCEPT / MINUS
+                df = (left.except_all(right) if mode == "all"
+                      else left.subtract(right))
             return self._order_limit(df, sel.order_by, sel.limit, {},
                                      df.columns)
         return self._lower_select(sel)

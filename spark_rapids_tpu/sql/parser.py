@@ -43,7 +43,7 @@ _KEYWORDS = {
     "update", "delete", "merge", "into", "set", "values", "insert",
     "matched", "then",
     "create", "table", "drop", "show", "tables", "location",
-    "if", "partitioned",
+    "if", "partitioned", "intersect", "except", "minus",
 }
 
 
@@ -55,7 +55,7 @@ SOFT_IDS = frozenset({
     "date", "timestamp", "update", "delete", "insert", "merge", "into",
     "set", "values", "matched",
     "create", "table", "drop", "show", "tables", "location", "if",
-    "partitioned",
+    "partitioned", "intersect", "except", "minus",
 })
 
 
@@ -184,7 +184,7 @@ class Select:
         self.having = None
         self.order_by: List[OrderItem] = []
         self.limit = None
-        self.union_with: Optional[Tuple[str, "Select"]] = None  # (all?, sel)
+        self.union_with = None  # (op, "all"/"distinct", Select)
 
 
 # ---------------------------------------------------------------------------
@@ -389,15 +389,36 @@ class _Parser:
                 ctes.append((name, sub))
                 if not self.accept("op", ","):
                     break
-        sel = self.parse_select()
-        sel.ctes = ctes
-        while self.accept("kw", "union"):
-            all_ = bool(self.accept("kw", "all"))
-            rhs = self.parse_select()
+        def setop_node(op, mode, left, right):
             node = Select()
-            node.union_with = ("all" if all_ else "distinct", rhs)
-            node.from_ref = SubqueryRef(sel, None)
-            sel = node
+            node.union_with = (op, mode, right)
+            node.from_ref = SubqueryRef(left, None)
+            return node
+
+        def parse_term():
+            # INTERSECT binds tighter than UNION/EXCEPT (SQL standard)
+            t = self.parse_select()
+            while self.at_kw("intersect"):
+                self.next()
+                all_ = bool(self.accept("kw", "all"))
+                if not all_:
+                    self.accept("kw", "distinct")   # optional explicit
+                t = setop_node("intersect",
+                               "all" if all_ else "distinct",
+                               t, self.parse_select())
+            return t
+
+        sel = parse_term()
+        sel.ctes = ctes
+        while self.at_kw("union", "except", "minus"):
+            op = self.next().val
+            if op == "minus":
+                op = "except"           # Spark alias
+            all_ = bool(self.accept("kw", "all"))
+            if not all_:
+                self.accept("kw", "distinct")       # optional explicit
+            sel = setop_node(op, "all" if all_ else "distinct",
+                             sel, parse_term())
         # ORDER BY / LIMIT may follow a union chain
         if self.at_kw("order"):
             self._parse_order_by(sel)

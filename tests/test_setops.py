@@ -1,0 +1,142 @@
+"""DataFrame set operations (r5; ref Spark's ReplaceOperators planning:
+intersect/except as null-safe semi/anti joins, the ALL variants via
+count join + row replication — GpuShuffledHashJoin + ReplicateRows)."""
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+
+from harness import tpu_session
+from spark_rapids_tpu.api import functions as F
+
+
+def _frames(s):
+    l = s.create_dataframe(pa.table({
+        "k": pa.array([1, 1, 2, 2, 3, None, None, 4], pa.int64()),
+        "v": pa.array(["a", "a", "b", "b", "c", None, None, "d"])}))
+    r = s.create_dataframe(pa.table({
+        "k": pa.array([1, 2, 2, None, 5], pa.int64()),
+        "v": pa.array(["a", "b", "b", None, "e"])}))
+    return l, r
+
+
+def _rows(df):
+    return sorted(((r["k"], r["v"]) for r in df.collect()),
+                  key=lambda x: (x[0] is None, x))
+
+
+def test_intersect_distinct_nullsafe():
+    s = tpu_session()
+    l, r = _frames(s)
+    got = _rows(l.intersect(r))
+    # distinct left rows present in right; (None, None) MATCHES
+    assert got == [(1, "a"), (2, "b"), (None, None)], got
+
+
+def test_subtract_distinct_nullsafe():
+    s = tpu_session()
+    l, r = _frames(s)
+    got = _rows(l.subtract(r))
+    assert got == [(3, "c"), (4, "d")], got
+
+
+def test_intersect_all_multiset():
+    s = tpu_session()
+    l, r = _frames(s)
+    got = _rows(l.intersect_all(r))
+    # counts: (1,a): min(2,1)=1; (2,b): min(2,2)=2; (None,None): min(2,1)=1
+    assert got == [(1, "a"), (2, "b"), (2, "b"), (None, None)], got
+
+
+def test_except_all_multiset():
+    s = tpu_session()
+    l, r = _frames(s)
+    got = _rows(l.except_all(r))
+    # (1,a): 2-1=1; (3,c): 1; (None,None): 2-1=1; (4,d): 1
+    assert got == [(1, "a"), (3, "c"), (4, "d"), (None, None)], got
+
+
+def test_setops_nan_semantics():
+    """NaN == NaN and -0.0 == 0.0 in set operations (Spark)."""
+    s = tpu_session()
+    l = s.create_dataframe(pa.table({
+        "x": pa.array([1.0, float("nan"), float("nan"), -0.0, 2.0])}))
+    r = s.create_dataframe(pa.table({
+        "x": pa.array([float("nan"), 0.0, 3.0])}))
+    got = [r_["x"] for r_ in l.intersect(r).collect()]
+    def norm(x):
+        return "nan" if x != x else x
+    assert sorted(map(norm, got), key=str) == [0.0, "nan"], got
+    sub = [r_["x"] for r_ in l.subtract(r).collect()]
+    assert sorted(map(norm, sub), key=str) == [1.0, 2.0], sub
+
+
+def test_setops_larger_differential():
+    """Random multiset differential vs a pandas oracle."""
+    rng = np.random.RandomState(8)
+    n = 5000
+    mk = lambda seed: pa.table({
+        "a": pa.array(np.random.RandomState(seed).randint(0, 40, n)),
+        "b": pa.array(np.random.RandomState(seed + 1)
+                      .choice(["x", "y", "z"], n))})
+    s = tpu_session()
+    lt, rt = mk(1), mk(2)
+    l, r = s.create_dataframe(lt), s.create_dataframe(rt)
+
+    def multiset(t):
+        from collections import Counter
+        return Counter(zip(t["a"].to_pylist(), t["b"].to_pylist()))
+
+    lm, rm = multiset(lt), multiset(rt)
+    got_ia = l.intersect_all(r).collect()
+    from collections import Counter
+    got_ia_c = Counter((r_["a"], r_["b"]) for r_ in got_ia)
+    exp_ia = Counter({k: min(c, rm[k]) for k, c in lm.items()
+                      if k in rm and min(c, rm[k]) > 0})
+    assert got_ia_c == exp_ia
+    got_ea_c = Counter((r_["a"], r_["b"])
+                       for r_ in l.except_all(r).collect())
+    exp_ea = Counter({k: c - rm.get(k, 0) for k, c in lm.items()
+                      if c - rm.get(k, 0) > 0})
+    assert got_ea_c == exp_ea
+
+
+def test_sql_intersect_except():
+    s = tpu_session()
+    l, r = _frames(s)
+    s.create_temp_view("l", l)
+    s.create_temp_view("r", r)
+    got = _rows(s.sql("SELECT k, v FROM l INTERSECT SELECT k, v FROM r"))
+    assert got == [(1, "a"), (2, "b"), (None, None)], got
+    got = _rows(s.sql("SELECT k, v FROM l EXCEPT ALL SELECT k, v FROM r"))
+    assert got == [(1, "a"), (3, "c"), (4, "d"), (None, None)], got
+    got = _rows(s.sql("SELECT k, v FROM l MINUS SELECT k, v FROM r"))
+    assert got == [(3, "c"), (4, "d")], got
+    n = s.sql("SELECT k, v FROM l UNION SELECT k, v FROM r").count()
+    assert n == 6   # distinct union
+
+
+def test_sql_setop_precedence_and_aliases():
+    """INTERSECT binds tighter than UNION (SQL standard); positional
+    column pairing; explicit DISTINCT keyword accepted; date columns."""
+    import datetime
+    s = tpu_session()
+    s.create_temp_view("t1", s.create_dataframe(pa.table({"x": [1]})))
+    s.create_temp_view("t2", s.create_dataframe(pa.table({"x": [2]})))
+    s.create_temp_view("t3", s.create_dataframe(pa.table({"x": [2]})))
+    got = sorted(r["x"] for r in s.sql(
+        "SELECT x FROM t1 UNION SELECT x FROM t2 "
+        "INTERSECT SELECT x FROM t3").collect())
+    assert got == [1, 2], got       # t1 UNION (t2 INTERSECT t3)
+    # positional pairing with different output names
+    s.create_temp_view("u", s.create_dataframe(pa.table({"y": [1, 9]})))
+    got = sorted(r["x"] for r in s.sql(
+        "SELECT x FROM t1 INTERSECT DISTINCT SELECT y FROM u").collect())
+    assert got == [1], got
+    # DATE columns through set ops
+    d = s.create_dataframe(pa.table(
+        {"d": pa.array([datetime.date(2024, 1, 1),
+                        datetime.date(2024, 1, 2), None])}))
+    e = s.create_dataframe(pa.table(
+        {"d": pa.array([datetime.date(2024, 1, 1), None])}))
+    got = sorted(str(r["d"]) for r in d.intersect(e).collect())
+    assert got == ["2024-01-01", "None"], got
