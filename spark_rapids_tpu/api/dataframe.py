@@ -411,7 +411,7 @@ class DataFrame:
         equality over standard equi-joins: each column contributes an
         is-null flag plus a default-filled value, so NULLs match NULLs
         and never a real default. NaN == NaN and -0.0 == 0.0 come from
-        the join key encoding itself (columnar/encoding.py float
+        the join key encoding itself (exec/encoding.py float
         canonicalization). Columns pair POSITIONALLY (SQL set-op
         semantics — names may differ between the sides); the output
         keeps the left side's names. Ref: Spark plans set ops as joins

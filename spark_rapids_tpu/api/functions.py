@@ -145,6 +145,11 @@ def coalesce(*cols) -> Col:
     return Col(E.Coalesce(*[_to_expr(c) for c in cols]))
 
 
+def nullif(a, b) -> Col:
+    """nullif(a, b): NULL when a == b else a (Spark semantics)."""
+    return Col(E.NullIf(_to_expr(a), _to_expr(b)))
+
+
 def isnan(c) -> Col: return Col(E.IsNaN(_to_expr(c)))
 def isnull(c) -> Col: return Col(E.IsNull(_to_expr(c)))
 def sqrt(c) -> Col: return Col(E.Sqrt(_to_expr(c)))

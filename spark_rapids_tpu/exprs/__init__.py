@@ -15,6 +15,7 @@ from .math_fns import (Acos, Acosh, Asin, Asinh, Atan, Atan2, Atanh,
                        Pow, Rint, Round, Signum, Sin, Sinh, Sqrt, Tan,
                        Tanh, ToDegrees, ToRadians)
 from .conditional import (AtLeastNNonNulls, CaseWhen, Coalesce, Greatest,
+                          NullIf,
                           If, KnownFloatingPointNormalized, KnownNotNull,
                           Least, NaNvl, NormalizeNaNAndZero)
 from .cast import Cast

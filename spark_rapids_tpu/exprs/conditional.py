@@ -9,7 +9,7 @@ from ..types import BOOL, DataType, Schema
 from .base import DVal, Expression, promote_types
 from .arithmetic import arrow_to_masked_numpy, masked_numpy_to_arrow
 
-__all__ = ["If", "CaseWhen", "Coalesce", "NaNvl", "Greatest",
+__all__ = ["NullIf", "If", "CaseWhen", "Coalesce", "NaNvl", "Greatest",
            "Least", "AtLeastNNonNulls", "KnownNotNull",
            "KnownFloatingPointNormalized", "NormalizeNaNAndZero"]
 
@@ -146,6 +146,43 @@ class CaseWhen(Expression):
         b = ";".join(f"{p.key()}->{v.key()}" for p, v in self.branches)
         e = self.else_value.key() if self.else_value is not None else ""
         return f"case({b}|{e})"
+
+
+class NullIf(Expression):
+    """nullif(a, b): NULL when a == b (both non-null), else a (ref
+    GpuNullIf / Spark's NullIf runtime replacement)."""
+
+    def __init__(self, a, b):
+        self.children = [a, b]
+
+    def data_type(self, schema):
+        return self.children[0].data_type(schema)
+
+    def _eq(self):
+        # Spark's `=` semantics verbatim — type promotion and NaN == NaN
+        # (comparison.py _nan_eq); hand-rolled ==/pc.equal diverges on
+        # both (r5 review findings)
+        from .comparison import EqualTo
+        return EqualTo(self.children[0], self.children[1])
+
+    def eval_device(self, ctx):
+        a = self.children[0].eval_device(ctx)
+        e = self._eq().eval_device(ctx)
+        eq = jnp.logical_and(e.data, e.validity)
+        return DVal(a.data, jnp.logical_and(a.validity,
+                                            jnp.logical_not(eq)),
+                    self.data_type(ctx.schema))
+
+    def eval_host(self, batch):
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        a = self.children[0].eval_host(batch)
+        eq = pc.fill_null(self._eq().eval_host(batch), False)
+        return pc.if_else(eq, pa.nulls(len(a), type=a.type), a)
+
+    def key(self):
+        return (f"nullif({self.children[0].key()},"
+                f"{self.children[1].key()})")
 
 
 class Coalesce(Expression):

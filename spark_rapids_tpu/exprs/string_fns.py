@@ -617,16 +617,27 @@ class StringRepeat(_HostStringExpr):
 
 
 class InitCap(_HostStringExpr):
+    """initcap: Spark capitalizes the first letter of EVERY
+    space-separated word and lowercases the rest ('hELLO wORLD' ->
+    'Hello World'); arrow's utf8_capitalize only title-cases the first
+    character of the whole string (r5 ground-truth finding)."""
     dict_transform = True
+
     def __init__(self, child):
         self.children = [child]
 
     def data_type(self, schema):
         return STRING
 
+    @staticmethod
+    def _initcap(v: str) -> str:
+        return " ".join(w[:1].upper() + w[1:].lower()
+                        for w in v.split(" "))
+
     def eval_host(self, batch):
-        import pyarrow.compute as pc
-        return pc.utf8_capitalize(self.children[0].eval_host(batch))
+        import pyarrow as pa
+        return _py_row_map(self.children[0].eval_host(batch),
+                           self._initcap, pa.string())
 
 
 class StringSplit(_HostStringExpr):

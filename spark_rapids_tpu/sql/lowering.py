@@ -675,6 +675,11 @@ class _Lowerer:
             if fn == "datediff":
                 return F.datediff(self._expr(args[0]),
                                   self._expr(args[1]))
+            if fn == "nullif":
+                if len(args) != 2:
+                    raise SqlError("nullif requires (a, b)")
+                return F.nullif(self._expr(args[0]),
+                                self._expr(args[1]))
             if fn == "parse_url":
                 if len(args) < 2:
                     raise SqlError("parse_url requires (url, part[, key])")

@@ -1,0 +1,116 @@
+"""Ground-truth Spark-semantics battery (r5).
+
+Hand-computed expected values from Spark's documented behavior, checked
+on BOTH engines. Exists because twin-symmetric differential tests
+cannot catch bugs the engines share (the lead()-as-lag() class): the
+oracle here is Spark itself, not the sibling engine. Ref:
+integration_tests' hand-written expected values in arithmetic_ops_test
+/ string_test / hash_aggregate_test."""
+import math
+
+import pyarrow as pa
+import pytest
+
+from harness import tpu_session
+from spark_rapids_tpu.api import functions as F
+
+CASES = []
+
+
+def case(name, build, expected):
+    CASES.append(pytest.param(build, expected, id=name))
+
+
+
+# --- arithmetic / math
+case("int_div_by_zero_null",
+     lambda s: s.create_dataframe(pa.table({"a": [6, 7]})).select(
+         (F.col("a") / F.lit(0)).alias("o")),
+     [None, None])                       # Spark: x / 0 -> NULL (non-ANSI)
+case("remainder_by_zero_null",
+     lambda s: s.create_dataframe(pa.table({"a": [6]})).select(
+         (F.col("a") % F.lit(0)).alias("o")), [None])
+case("round_half_up",
+     lambda s: s.create_dataframe(pa.table({"a": [2.5, 3.5, -2.5]})).select(
+         F.round(F.col("a")).alias("o")),
+     [3.0, 4.0, -3.0])                   # Spark ROUND is HALF_UP
+case("neg_mod_sign",
+     lambda s: s.create_dataframe(pa.table({"a": [-7]})).select(
+         (F.col("a") % F.lit(3)).alias("o")), [-1])  # Java %, not python
+# --- strings
+case("substring_negative_pos",
+     lambda s: s.create_dataframe(pa.table({"x": ["hello"]})).select(
+         F.substring(F.col("x"), -3, 2).alias("o")), ["ll"])
+case("substring_pos_zero",
+     lambda s: s.create_dataframe(pa.table({"x": ["hello"]})).select(
+         F.substring(F.col("x"), 0, 3).alias("o")), ["hel"])
+case("initcap_words",
+     lambda s: s.create_dataframe(pa.table({"x": ["hELLO wORLD x2"]})).select(
+         F.initcap(F.col("x")).alias("o")), ["Hello World X2"])
+case("lpad_truncates",
+     lambda s: s.create_dataframe(pa.table({"x": ["abcdef"]})).select(
+         F.lpad(F.col("x"), 3).alias("o")), ["abc"])
+case("split_default_keeps_trailing_empties",
+     lambda s: s.create_dataframe(pa.table({"x": ["a,b,,"]})).select(
+         F.split(F.col("x"), ",").alias("o")), [["a", "b", "", ""]])
+case("concat_null_propagates",
+     lambda s: s.create_dataframe(pa.table({"x": ["a", None]})).select(
+         F.concat(F.col("x"), F.lit("b")).alias("o")), ["ab", None])
+case("translate_map",
+     lambda s: s.create_dataframe(pa.table({"x": ["ababab"]})).select(
+         F.translate(F.col("x"), "ab", "b").alias("o")), ["bbb"])
+# --- conditional / null
+case("greatest_ignores_null",
+     lambda s: s.create_dataframe(pa.table({"a": pa.array([1], pa.int64()),
+                                            "b": pa.array([None], pa.int64())})).select(
+         F.greatest(F.col("a"), F.col("b")).alias("o")), [1])
+case("nullif_equal",
+     lambda s: s.create_dataframe(pa.table({"a": [3, 4]})).select(
+         F.nullif(F.col("a"), F.lit(3)).alias("o")), [None, 4])
+# --- datetime
+case("date_add_negative",
+     lambda s: s.create_dataframe(pa.table({"d": pa.array([__import__("datetime").date(2024, 1, 1)])})).select(
+         F.date_add(F.col("d"), F.lit(-1)).alias("o")),
+     [__import__("datetime").date(2023, 12, 31)])
+case("datediff_order",
+     lambda s: s.create_dataframe(pa.table({
+         "a": pa.array([__import__("datetime").date(2024, 1, 3)]),
+         "b": pa.array([__import__("datetime").date(2024, 1, 1)])})).select(
+         F.datediff(F.col("a"), F.col("b")).alias("o")), [2])
+# --- aggregates
+case("avg_ignores_null_counts_nan",
+     lambda s: s.create_dataframe(pa.table({"v": [1.0, None, float("nan")]})).agg(
+         F.avg(F.col("v")).with_name("o")), ["nan"])  # NaN propagates
+case("min_nan_is_greatest",
+     lambda s: s.create_dataframe(pa.table({"v": [float("nan"), 2.0]})).agg(
+         F.min(F.col("v")).with_name("o")), [2.0])
+case("max_picks_nan",
+     lambda s: s.create_dataframe(pa.table({"v": [float("nan"), 2.0]})).agg(
+         F.max(F.col("v")).with_name("o")), ["nan"])
+case("count_star_counts_nulls",
+     lambda s: s.create_dataframe(pa.table({"v": [None, None, 1]})).agg(
+         F.count_star().with_name("o")), [3])
+case("sum_empty_is_null",
+     lambda s: s.create_dataframe(pa.table({"v": pa.array([], pa.int64())})).agg(
+         F.sum(F.col("v")).with_name("o")), [None])
+
+
+
+def _norm(x):
+    if x is None:
+        return None
+    if isinstance(x, float) and math.isnan(x):
+        return "nan"
+    return x
+
+
+@pytest.mark.parametrize("build,expected", CASES)
+@pytest.mark.parametrize("conf", [
+    pytest.param({"spark.rapids.tpu.distributed.enabled": False},
+                 id="device"),
+    pytest.param({"spark.rapids.tpu.sql.enabled": False}, id="host"),
+])
+def test_spark_semantics(build, expected, conf):
+    s = tpu_session(conf)
+    got = [_norm(r["o"]) for r in build(s).collect()]
+    assert got == [_norm(x) for x in expected]
