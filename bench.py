@@ -442,7 +442,7 @@ def main():
     def base_strings_of(table):
         def run():
             pdf = table.to_pandas()
-            pdf["u"] = pdf["s"].str.strip().str.upper()
+            pdf["u"] = pdf["s"].str.strip(" ").str.upper()
             pdf["pre"] = pdf["s"].str.slice(2, 6)
             return (pdf.groupby(["u", "pre"], as_index=False)
                     .agg(sv=("v", "sum"), n=("v", "size")))

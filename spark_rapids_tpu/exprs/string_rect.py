@@ -13,7 +13,7 @@ semantics beyond ASCII fall back to the host path honestly rather than
 being silently wrong.
 
 Supported chain ops (STRING -> STRING): Upper, Lower, StringTrim(L/R)
-(whitespace only), Substring (pos >= 0, fixed length), StringReplace,
+(space-only, Spark semantics), Substring (pos >= 0, fixed length), StringReplace,
 Lpad/Rpad, SubstringIndex, Reverse; terminals: Length (STRING -> INT),
 StringLocate/StringInstr (STRING -> INT),
 Contains/StartsWith/EndsWith/Like (STRING -> BOOL).
@@ -86,7 +86,8 @@ def _lower(sv: StrVal) -> StrVal:
 def _trim(sv: StrVal, left: bool, right: bool) -> StrVal:
     b, ln = sv.bytes_, sv.lengths
     live = _live(sv)
-    sp = jnp.logical_and(_is_space(b), live)
+    # Spark TRIM removes ONLY the space character 0x20 (SPARK-17299)
+    sp = jnp.logical_and(b == 32, live)
     lead = jnp.zeros_like(ln)
     if left:
         # leading-space count: cumprod zeroes after the first non-space
@@ -416,7 +417,7 @@ def rect_supported_op(e: Expression) -> bool:
     if isinstance(e, (Upper, Lower, Length, Reverse)):
         return True
     if isinstance(e, (StringTrim, StringTrimLeft, StringTrimRight)):
-        return e.chars is None           # whitespace-only trim
+        return e.chars is None           # default (space-only) trim
     if isinstance(e, Substring):
         return e.pos >= 0                # negative pos: from-end (host)
     if isinstance(e, Like):

@@ -6,6 +6,7 @@ cannot catch bugs the engines share (the lead()-as-lag() class): the
 oracle here is Spark itself, not the sibling engine. Ref:
 integration_tests' hand-written expected values in arithmetic_ops_test
 / string_test / hash_aggregate_test."""
+import datetime
 import math
 
 import pyarrow as pa
@@ -69,13 +70,13 @@ case("nullif_equal",
          F.nullif(F.col("a"), F.lit(3)).alias("o")), [None, 4])
 # --- datetime
 case("date_add_negative",
-     lambda s: s.create_dataframe(pa.table({"d": pa.array([__import__("datetime").date(2024, 1, 1)])})).select(
+     lambda s: s.create_dataframe(pa.table({"d": pa.array([datetime.date(2024, 1, 1)])})).select(
          F.date_add(F.col("d"), F.lit(-1)).alias("o")),
-     [__import__("datetime").date(2023, 12, 31)])
+     [datetime.date(2023, 12, 31)])
 case("datediff_order",
      lambda s: s.create_dataframe(pa.table({
-         "a": pa.array([__import__("datetime").date(2024, 1, 3)]),
-         "b": pa.array([__import__("datetime").date(2024, 1, 1)])})).select(
+         "a": pa.array([datetime.date(2024, 1, 3)]),
+         "b": pa.array([datetime.date(2024, 1, 1)])})).select(
          F.datediff(F.col("a"), F.col("b")).alias("o")), [2])
 # --- aggregates
 case("avg_ignores_null_counts_nan",
@@ -93,6 +94,58 @@ case("count_star_counts_nulls",
 case("sum_empty_is_null",
      lambda s: s.create_dataframe(pa.table({"v": pa.array([], pa.int64())})).agg(
          F.sum(F.col("v")).with_name("o")), [None])
+
+
+import datetime
+
+
+case("cast_invalid_string_to_int_null",
+     lambda s: s.create_dataframe(pa.table({"x": ["12abc", "7"]})).select(
+         F.col("x").cast("int").alias("o")), [None, 7])
+case("cast_string_trims_whitespace",
+     lambda s: s.create_dataframe(pa.table({"x": [" 42 "]})).select(
+         F.col("x").cast("int").alias("o")), [42])
+case("cast_float_truncates_toward_zero",
+     lambda s: s.create_dataframe(pa.table({"x": [3.99, -3.99]})).select(
+         F.col("x").cast("int").alias("o")), [3, -3])
+case("cast_bool_to_int",
+     lambda s: s.create_dataframe(pa.table({"x": [True, False]})).select(
+         F.col("x").cast("int").alias("o")), [1, 0])
+case("concat_ws_skips_nulls",
+     lambda s: s.create_dataframe(pa.table({"a": ["a"], "b": pa.array([None], pa.string()), "c": ["c"]})).select(
+         F.concat_ws(",", F.col("a"), F.col("b"), F.col("c")).alias("o")),
+     ["a,c"])
+case("trim_is_space_only",
+     lambda s: s.create_dataframe(pa.table({"x": ["  \ta b\t  "]})).select(
+         F.trim(F.col("x")).alias("o")), ["\ta b\t"])
+case("repeat_zero_empty",
+     lambda s: s.create_dataframe(pa.table({"x": ["ab"]})).select(
+         F.repeat(F.col("x"), 0).alias("o")), [""])
+case("repeat_negative_empty",
+     lambda s: s.create_dataframe(pa.table({"x": ["ab"]})).select(
+         F.repeat(F.col("x"), -1).alias("o")), [""])
+case("ascii_empty_zero",
+     lambda s: s.create_dataframe(pa.table({"x": ["", "A"]})).select(
+         F.ascii(F.col("x")).alias("o")), [0, 65])
+case("pow_zero_zero",
+     lambda s: s.create_dataframe(pa.table({"x": [0.0]})).select(
+         F.pow(F.col("x"), F.lit(0.0)).alias("o")), [1.0])
+case("substring_index_negative",
+     lambda s: s.create_dataframe(pa.table({"x": ["a.b.c"]})).select(
+         F.substring_index(F.col("x"), ".", -2).alias("o")), ["b.c"])
+case("element_at_negative_one_last",
+     lambda s: s.create_dataframe(pa.table({"x": [[1, 2, 3]]})).select(
+         F.element_at(F.col("x"), -1).alias("o")), [3])
+case("sort_array_nulls_first_asc",
+     lambda s: s.create_dataframe(pa.table({"x": [[3, None, 1]]})).select(
+         F.sort_array(F.col("x")).alias("o")), [[None, 1, 3]])
+case("add_months_clamps_month_end",
+     lambda s: s.create_dataframe(pa.table({"d": pa.array([datetime.date(2024, 1, 31)])})).select(
+         F.add_months(F.col("d"), 1).alias("o")), [datetime.date(2024, 2, 29)])
+case("least_all_null_is_null",
+     lambda s: s.create_dataframe(pa.table({"a": pa.array([None], pa.int64()),
+                                            "b": pa.array([None], pa.int64())})).select(
+         F.least(F.col("a"), F.col("b")).alias("o")), [None])
 
 
 
