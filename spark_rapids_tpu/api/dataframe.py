@@ -501,22 +501,79 @@ class DataFrame:
     def join(self, other: "DataFrame", on=None, how: str = "inner",
              condition=None) -> "DataFrame":
         lk, rk = [], []
-        if on is not None:
-            if isinstance(on, str):
-                on = [on]
-            for k in on:
+        on_list = [on] if isinstance(on, str) else (on or [])
+        all_named = bool(on_list) and all(isinstance(k, str)
+                                          for k in on_list)
+        bc = "right" if getattr(other, "_broadcast_hint", False) else (
+            "left" if getattr(self, "_broadcast_hint", False) else None)
+        cond = _as_expr(condition) if condition is not None else None
+        if not all_named:
+            for k in on_list:
                 if isinstance(k, str):
                     lk.append(ColumnRef(k))
                     rk.append(ColumnRef(k))
                 else:  # (left_col, right_col) pair
                     lk.append(_as_expr(k[0]))
                     rk.append(_as_expr(k[1]))
-        cond = _as_expr(condition) if condition is not None else None
-        bc = "right" if getattr(other, "_broadcast_hint", False) else (
-            "left" if getattr(self, "_broadcast_hint", False) else None)
-        return DataFrame(self.session,
-                         L.Join(self.plan, other.plan, how, lk, rk, cond,
-                                broadcast=bc))
+            return DataFrame(self.session,
+                             L.Join(self.plan, other.plan, how, lk, rk,
+                                    cond, broadcast=bc))
+        # USING-style join (shared key NAMES): PySpark emits ONE key
+        # column, not both sides' duplicates — otherwise a later
+        # col("k") can silently resolve to the right side's null-filled
+        # copy, and the device/host twins disagree on duplicate-name
+        # layouts (r5 ground-truth finding). Rename the right side's
+        # columns before the join so both execs see distinct names,
+        # then project: keys FIRST (PySpark order), one column per key
+        # (left's values; right's for RIGHT joins; coalesced for FULL).
+        # Colliding NON-key names keep both sides' data, the right one
+        # under a "<name>_r" suffix (this engine's schemas are
+        # name-addressed, so true duplicate names cannot be kept).
+        named_keys = list(on_list)
+        keyset = set(named_keys)
+        lnames = [f.name for f in self.plan.schema().fields]
+        rcols = [f.name for f in other.plan.schema().fields]
+        taken = set(lnames) | set(rcols)
+        rmap = {}
+        for i, k in enumerate(named_keys):
+            rmap[k] = f"__ju_{i}"
+        for c in rcols:
+            if c in keyset or c not in lnames:
+                continue
+            alt = f"{c}_r"
+            while alt in taken:
+                alt += "_"
+            taken.add(alt)
+            rmap[c] = alt
+        right2 = other.select(*[_col(c).alias(rmap.get(c, c))
+                                for c in rcols])
+        lk = [ColumnRef(k) for k in named_keys]
+        rk = [ColumnRef(rmap[k]) for k in named_keys]
+        joined = DataFrame(self.session,
+                           L.Join(self.plan, right2.plan, how, lk, rk,
+                                  cond, broadcast=bc))
+        jt = joined.plan.join_type
+        if jt in ("leftsemi", "leftanti", "existence"):
+            return joined          # left-only output: nothing to drop
+        from ..exprs import Coalesce
+        exprs = []
+        for k in named_keys:       # keys first, PySpark column order
+            if jt == "right":
+                exprs.append(Alias(ColumnRef(rmap[k]), k))
+            elif jt == "full":
+                exprs.append(Alias(Coalesce(ColumnRef(k),
+                                            ColumnRef(rmap[k])), k))
+            else:
+                exprs.append(ColumnRef(k))
+        for c in lnames:
+            if c not in keyset:
+                exprs.append(ColumnRef(c))
+        for c in rcols:
+            if c in keyset:
+                continue
+            out_name = rmap.get(c, c)
+            exprs.append(ColumnRef(out_name))
+        return DataFrame(self.session, L.Project(exprs, joined.plan))
 
     def hint(self, name: str) -> "DataFrame":
         """Spark-style plan hint; only "broadcast" is meaningful (ref

@@ -545,9 +545,9 @@ class VarianceSamp(_MomentAgg):
         n, m2 = self._moments(partials)
         ok = n > 1
         out = m2 / jnp.where(ok, n - 1.0, 1.0)
-        # n==1 -> NaN (Spark), n==0 -> null
-        out = jnp.where(n == 1, jnp.nan, jnp.maximum(out, 0.0))
-        return DVal(out, n > 0, FLOAT64)
+        # n <= 1 -> NULL (Spark 3.1+ divide-by-zero semantics,
+        # SPARK-33726; the legacy NaN behavior is gone)
+        return DVal(jnp.maximum(out, 0.0), ok, FLOAT64)
 
 
 class StddevPop(VariancePop):
@@ -562,11 +562,10 @@ class StddevSamp(VarianceSamp):
     pandas_agg = "std"
 
     def finalize(self, partials):
-        n, m2 = self._moments(partials)
-        ok = n > 1
-        out = jnp.sqrt(m2 / jnp.where(ok, n - 1.0, 1.0))
-        out = jnp.where(n == 1, jnp.nan, out)
-        return DVal(out, n > 0, FLOAT64)
+        # reuse the sample-variance finalize (incl. its FP-cancellation
+        # clamp to >= 0 — sqrt of a tiny negative m2 would be NaN)
+        v = VarianceSamp.finalize(self, partials)
+        return DVal(jnp.sqrt(v.data), v.validity, FLOAT64)
 
 
 class _HostOnlyAgg(AggregateExpression):

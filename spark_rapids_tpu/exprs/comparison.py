@@ -315,7 +315,12 @@ class In(Expression):
             if v is None:
                 continue
             out = jnp.logical_or(out, c.data == v)
-        return DVal(out, c.validity, BOOL)
+        valid = c.validity
+        if any(v is None for v in self.values):
+            # SQL three-valued IN: x IN (..., NULL) is NULL unless a
+            # listed value matches (x = NULL is unknown, not false)
+            valid = jnp.logical_and(valid, out)
+        return DVal(out, valid, BOOL)
 
     def eval_host(self, batch):
         import pyarrow as pa
@@ -325,8 +330,13 @@ class In(Expression):
                         type=arr.type)
         res = pc.is_in(arr, value_set=vals)
         # Spark: null IN (...) -> NULL (pc.is_in yields false for nulls)
-        return pc.if_else(pc.is_valid(arr), res,
-                          pa.nulls(len(arr), pa.bool_()))
+        out = pc.if_else(pc.is_valid(arr), res,
+                         pa.nulls(len(arr), pa.bool_()))
+        if any(v is None for v in self.values):
+            # non-match against a list containing NULL is NULL too
+            out = pc.if_else(pc.fill_null(out, False), out,
+                             pa.nulls(len(arr), pa.bool_()))
+        return out
 
     def key(self):
         return f"in({self.children[0].key()},{self.values!r})"

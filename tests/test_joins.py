@@ -340,3 +340,30 @@ def test_aqe_broadcast_flips_on_measured_size():
     g2 = r2.to_pandas().sort_values("k").reset_index(drop=True)
     np.testing.assert_array_equal(g1["k"], g2["k"])
     np.testing.assert_array_equal(g1["n"], g2["n"])
+
+
+def test_using_join_single_key_column():
+    """r5 ground-truth finding: join(on='k') must emit ONE k column
+    (PySpark USING semantics) — previously both sides' k survived and
+    col('k') could resolve to the right side's null-filled copy."""
+    import pyarrow as pa
+    s = tpu_session()
+    l = s.create_dataframe(pa.table({"k": pa.array([1, 2], pa.int64()),
+                                     "v": pa.array([10, 20], pa.int64())}))
+    r = s.create_dataframe(pa.table({"k": pa.array([1], pa.int64()),
+                                     "w": pa.array([5], pa.int64())}))
+    j = l.join(r, on="k", how="left")
+    assert j.columns == ["k", "v", "w"], j.columns
+    out = j.order_by(F.col("k").asc()).to_pandas()
+    assert list(out["k"]) == [1, 2]
+    assert list(out["v"]) == [10, 20]
+    assert out["w"][0] == 5 and pd.isna(out["w"][1])
+    # right join: key values come from the right side
+    jr = l.join(r, on="k", how="right").to_pandas()
+    assert list(jr["k"]) == [1] and list(jr["w"]) == [5]
+    # full outer: key coalesces across sides
+    r2 = s.create_dataframe(pa.table({"k": pa.array([3], pa.int64()),
+                                      "w": pa.array([7], pa.int64())}))
+    jf = (l.join(r2, on="k", how="full")
+          .order_by(F.col("k").asc()).to_pandas())
+    assert list(jf["k"]) == [1, 2, 3], jf

@@ -14,6 +14,7 @@ import pytest
 
 from harness import tpu_session
 from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.exprs import window_fns as WF
 
 CASES = []
 
@@ -149,6 +150,55 @@ case("least_all_null_is_null",
 
 
 
+
+case("in_list_with_null_is_null_when_absent",
+     lambda s: s.create_dataframe(pa.table({"x": pa.array([3, 1], pa.int64())})).select(
+         F.col("x").isin(1, None).alias("o")), [None, True])
+case("rank_ties",
+     lambda s: s.create_dataframe(pa.table({"v": [10, 10, 20]})).with_window_column(
+         "o", WF.Rank(),
+         order_by=[F.col("v").asc()]).select(F.col("o")).order_by(F.col("o").asc()),
+     [1, 1, 3])
+case("ntile_two_over_five",
+     lambda s: s.create_dataframe(pa.table({"v": [1, 2, 3, 4, 5]})).with_window_column(
+         "o", WF.NTile(2),
+         order_by=[F.col("v").asc()]).select(F.col("o")).order_by(F.col("o").asc()),
+     [1, 1, 1, 2, 2])
+case("stddev_single_row_null",
+     lambda s: s.create_dataframe(pa.table({"v": [5.0]})).agg(
+         F.stddev(F.col("v")).with_name("o")), [None])
+case("var_pop_single_row_zero",
+     lambda s: s.create_dataframe(pa.table({"v": [5.0]})).agg(
+         F.var_pop(F.col("v")).with_name("o")), [0.0])
+case("like_escaped_percent",
+     lambda s: s.create_dataframe(pa.table({"x": ["50%", "50x"]})).select(
+         F.col("x").like("50\\%").alias("o")), [True, False])
+case("cast_string_to_date",
+     lambda s: s.create_dataframe(pa.table({"x": ["2024-02-29"]})).select(
+         F.col("x").cast("date").alias("o")), [datetime.date(2024, 2, 29)])
+case("cast_bool_strings",
+     lambda s: s.create_dataframe(pa.table({"x": ["true", "false", "nope"]})).select(
+         F.col("x").cast("boolean").alias("o")), [True, False, None])
+case("array_contains_null_semantics",
+     lambda s: s.create_dataframe(pa.table({"x": [[1, None], [1, 2]]})).select(
+         F.array_contains(F.col("x"), 3).alias("o")), [None, False])
+case("join_null_keys_never_match",
+     lambda s: (lambda l, r: l.join(r, on="k").select(F.col("v")))(
+         s.create_dataframe(pa.table({"k": pa.array([1, None], pa.int64()),
+                                      "v": pa.array([10, 20], pa.int64())})),
+         s.create_dataframe(pa.table({"k": pa.array([1, None], pa.int64()),
+                                      "w": pa.array([5, 6], pa.int64())}))),
+     [10])
+case("left_join_unmatched_null",
+     lambda s: (lambda l, r: l.join(r, on="k", how="left")
+                .order_by(F.col("k").asc()).select(F.col("w")))(
+         s.create_dataframe(pa.table({"k": pa.array([1, 2], pa.int64())})),
+         s.create_dataframe(pa.table({"k": pa.array([1], pa.int64()),
+                                      "w": pa.array([5], pa.int64())}))),
+     [5, None])
+
+
+
 def _norm(x):
     if x is None:
         return None
@@ -165,5 +215,6 @@ def _norm(x):
 ])
 def test_spark_semantics(build, expected, conf):
     s = tpu_session(conf)
-    got = [_norm(r["o"]) for r in build(s).collect()]
+    got = [_norm(list(r.values())[0])
+           for r in build(s).collect()]
     assert got == [_norm(x) for x in expected]
