@@ -80,6 +80,21 @@ class TpuSession:
         #: supplied explicitly: the planner only uses an auto mesh above
         #: the distributed.minRows threshold (distribution_gate)
         self.mesh_is_auto = False
+        from ..bootstrap import STARTUP_CHECK
+        if self.conf.get(STARTUP_CHECK):
+            # BEFORE the auto-mesh device query: in the broken-backend
+            # environments this diagnoses, jax.devices() below would
+            # raise first and eat the diagnostic
+            import logging
+            from ..bootstrap import check_environment, engine_banner
+            lg = logging.getLogger("spark_rapids_tpu.bootstrap")
+            lg.info("%s", engine_banner())
+            for r in check_environment(self.conf):
+                lvl = (lg.info if r["level"] == "ok"
+                       else lg.error if r["level"] == "fatal"
+                       else lg.warning)
+                lvl("startup check %s [%s]: %s", r["check"], r["level"],
+                    r["detail"])
         if self.mesh is None:
             from ..parallel.planner import (DISTRIBUTED_ENABLED,
                                             DISTRIBUTED_NUM_DEVICES)

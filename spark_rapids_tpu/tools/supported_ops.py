@@ -79,6 +79,7 @@ def _load_registries():
               "spark_rapids_tpu.exec.distinct_flag",
               "spark_rapids_tpu.plan.rewrites",
               "spark_rapids_tpu.sql.catalog",
+              "spark_rapids_tpu.bootstrap",
               "spark_rapids_tpu.exprs.pallas_rect",
               "spark_rapids_tpu.plan.cost",
               "spark_rapids_tpu.plan.stats_store",

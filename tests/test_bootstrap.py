@@ -1,0 +1,51 @@
+"""Bootstrap / lifecycle diagnostics (ref Plugin.scala driver+executor
+startup checks:418-568, shutdown leak audit:573-588)."""
+import pytest
+
+from harness import tpu_session
+from spark_rapids_tpu.bootstrap import (EnvironmentProblem,
+                                        check_environment, engine_banner)
+from spark_rapids_tpu.config import TpuConf
+
+
+def test_banner_and_checks_ok():
+    b = engine_banner()
+    assert "spark-rapids-tpu" in b and "jax" in b
+    recs = check_environment()
+    by = {r["check"]: r for r in recs}
+    assert by["backend"]["level"] == "ok"
+    assert by["x64"]["level"] == "ok"
+    assert by["memory_pool"]["level"] == "ok"
+    assert "compile_cache" in by
+
+
+def test_strict_raises_on_fatal():
+    bad = TpuConf({"spark.rapids.tpu.memory.hbm.allocFraction": 0.0})
+    with pytest.raises(EnvironmentProblem):
+        check_environment(bad, strict=True)
+    # non-strict returns the record instead
+    recs = check_environment(bad)
+    assert any(r["level"] == "fatal" for r in recs)
+
+
+def test_conf_lint_device_decode_reader_type():
+    recs = check_environment(TpuConf({
+        "spark.rapids.tpu.io.parquet.deviceDecode.enabled": True,
+        "spark.rapids.tpu.sql.format.parquet.reader.type":
+            "MULTITHREADED"}))
+    assert any(r["check"] == "conf" and r["level"] == "warn"
+               for r in recs)
+    for rt in ("PERFILE", "AUTO"):
+        ok = check_environment(TpuConf({
+            "spark.rapids.tpu.io.parquet.deviceDecode.enabled": True,
+            "spark.rapids.tpu.sql.format.parquet.reader.type": rt}))
+        assert not any(r["check"] == "conf" for r in ok), rt
+
+
+def test_session_startup_check_logs(caplog):
+    import logging
+    with caplog.at_level(logging.INFO,
+                         logger="spark_rapids_tpu.bootstrap"):
+        tpu_session({"spark.rapids.tpu.startupCheck.enabled": True})
+    assert any("startup check" in m for m in caplog.messages)
+    assert any("spark-rapids-tpu" in m for m in caplog.messages)
