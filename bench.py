@@ -8,8 +8,14 @@ honesty"):
     starts, so a timeout can never discard finished results;
   * each workload records which engine actually ran ("device"/"host" from
     session.last_placement) — host-numpy wins are labeled as such;
-  * a wall budget (SRTPU_BENCH_BUDGET, default 1500 s) gracefully skips
-    remaining rungs instead of dying with rc=124;
+  * a LADDER budget (SRTPU_BENCH_BUDGET, default 1500 s) gracefully
+    skips remaining rungs; the budget clock starts AFTER backend init —
+    a held/unavailable chip costs up to SRTPU_BENCH_BACKEND_WAIT extra
+    wall (r5: hours-long outages made the wait eat the whole budget and
+    produce an empty artifact). Total wall is therefore bounded by
+    backend wait + table generation + budget; every finished rung's
+    metric line is flushed IMMEDIATELY, so even an external timeout
+    mid-ladder preserves all completed results;
   * the summary carries an overall geomean, a DEVICE-ONLY geomean, and a
     regression check against the previous round's BENCH_r*.json.
 
@@ -311,6 +317,9 @@ def main():
         return _big["h"]
     log(f"bench: ladder on {jax.devices()[0].platform}, {n} rows "
         f"(+{nbig} big rungs), {iters} iters, budget {budget:.0f}s")
+    # the budget buys LADDER time: a long backend wait (r5: hours of
+    # chip unavailability) must not exhaust it before the first rung
+    ladder_t0 = time.perf_counter()
 
     last_session = [None]
 
@@ -494,7 +503,7 @@ def main():
     skipped = []
     failed = []
     for name, rows, eng_fn, base_fn, check_fn in workloads:
-        elapsed = time.perf_counter() - START
+        elapsed = time.perf_counter() - ladder_t0
         if elapsed > budget:
             skipped.append(name)
             log(f"bench: {name:18s} SKIPPED (budget {budget:.0f}s "
@@ -537,7 +546,7 @@ def main():
     # ---------------- distributed rung (subprocess) ----------------
     dist = None
     if os.environ.get("SRTPU_BENCH_DIST", "1") != "0" \
-            and time.perf_counter() - START < budget:
+            and time.perf_counter() - ladder_t0 < budget:
         try:
             dist = run_distributed_rung(iters)
         except Exception as e:                       # noqa: BLE001
