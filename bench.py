@@ -502,6 +502,7 @@ def main():
     details = {}
     skipped = []
     failed = []
+    wrong = []
     for name, rows, eng_fn, base_fn, check_fn in workloads:
         elapsed = time.perf_counter() - ladder_t0
         if elapsed > budget:
@@ -519,12 +520,20 @@ def main():
             placement = getattr(last_session[0], "last_placement",
                                 None) or "?"
             base_s, base_res = _time_min(base_fn, iters)
-            check_fn(eng_res, base_res)       # per-workload, immediately
         except Exception as e:                # noqa: BLE001
-            # a failing workload must not discard the finished ones: the
-            # run continues, the summary marks the failure, rc goes 1
+            # INFRA failure (OOM, backend error): must not discard the
+            # finished rungs; listed in the summary, rc stays 0 as long
+            # as some rung completed
             failed.append(name)
             log(f"bench: {name:18s} FAILED: {type(e).__name__}: {e}")
+            continue
+        try:
+            check_fn(eng_res, base_res)       # per-workload, immediately
+        except AssertionError as e:
+            # WRONG ANSWER: a correctness regression always fails the
+            # run (rc=1), unlike infra flakes above
+            wrong.append(name)
+            log(f"bench: {name:18s} WRONG RESULT: {e}")
             continue
         speedup = base_s / eng_s
         details[name] = {
@@ -584,12 +593,16 @@ def main():
         "device_workloads": len(dev),
         "skipped": skipped,
         "failed": failed,
+        "wrong": wrong,
         "distributed": dist,
         "regressions": regressions,
         "wall_s": round(time.perf_counter() - START, 1),
         "details": details,
     }))
-    if failed:
+    if wrong or (failed and not details):
+        # correctness regressions ALWAYS fail the run; infra failures
+        # only when nothing completed (a partial ladder with real
+        # numbers beats rc=1 discarding them)
         sys.exit(1)
 
 

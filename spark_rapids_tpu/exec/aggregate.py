@@ -1024,6 +1024,14 @@ class TpuHashAggregateExec(TpuExec):
             return None
         from ..columnar.segmented import bucket_segments
         Gb = bucket_segments(prod)
+        if jax.default_backend() == "cpu" \
+                and Gb * batch.padded_len > (1 << 28):
+            # XLA:CPU MATERIALIZES the dense one-hot (G x P) the TPU
+            # backend fuses into its reduction — a 4096-segment bucket
+            # over a 1M-row batch would allocate >100 GB on the CPU
+            # fallback path (r5 rehearsal OOM). The split sort path
+            # handles these shapes there.
+            return None
         padded_remaps = tuple(
             jnp.asarray(np.pad(r, (0, max(Gb - len(r), 0)))[:Gb])
             for r in remaps)
