@@ -199,6 +199,31 @@ case("left_join_unmatched_null",
 
 
 
+ts_ = datetime.datetime(2024, 3, 15, 13, 45, 59,
+                        tzinfo=datetime.timezone.utc)
+case("hour_of_timestamp",
+     lambda s: s.create_dataframe(pa.table({"t": pa.array([ts_])})).select(
+         F.hour(F.col("t")).alias("o")), [13])
+case("dayofweek_sunday_is_1",
+     lambda s: s.create_dataframe(pa.table(
+         {"d": pa.array([datetime.date(2024, 3, 17)])})).select(
+         F.dayofweek(F.col("d")).alias("o")), [1])
+case("cast_timestamp_to_date",
+     lambda s: s.create_dataframe(pa.table({"t": pa.array([ts_])})).select(
+         F.col("t").cast("date").alias("o")),
+     [datetime.date(2024, 3, 15)])
+case("floor_negative_half",
+     lambda s: s.create_dataframe(pa.table({"x": [-2.5]})).select(
+         F.floor(F.col("x")).alias("o")), [-3])
+case("sequence_descending",
+     lambda s: s.create_dataframe(pa.table({"a": [5]})).select(
+         F.sequence(F.col("a"), F.lit(1)).alias("o")), [[5, 4, 3, 2, 1]])
+case("string_compare_lexicographic",
+     lambda s: s.create_dataframe(pa.table(
+         {"x": ["apple", "Banana"]})).select(
+         (F.col("x") > F.lit("Z")).alias("o")), [True, False])
+
+
 def _norm(x):
     if x is None:
         return None
