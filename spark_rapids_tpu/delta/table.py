@@ -260,7 +260,10 @@ class DeltaTable:
         caller's snapshot schema — re-reading it here would replay the
         whole log once per file."""
         import pyarrow.parquet as pq
-        t = pq.read_table(os.path.join(self.path, add.path))
+        # ParquetFile.read(), NOT read_table(): the dataset API would
+        # infer hive partition columns from the col=value/ path segments
+        # and duplicate the ones re-attached from partitionValues below
+        t = pq.ParquetFile(os.path.join(self.path, add.path)).read()
         if add.partition_values:
             from .scan import attach_partition_columns
             schema = schema if schema is not None \

@@ -1377,22 +1377,32 @@ class TpuHashAggregateExec(TpuExec):
             lambda b: partition_batch(b, merge_keys, n_parts,
                                       seed=self.REPARTITION_SEED),
             n_parts)
-        for p in range(n_parts):
-            parts = slices[p]
-            if not parts:
-                continue
+        try:
+            for p in range(n_parts):
+                parts = slices[p]
+                if not parts:
+                    continue
 
-            def merge_part(parts=parts):
-                with ctx.semaphore.held():
-                    big = concat_batches([s.get() for s in parts])
-                    return self._run_kernel(merge_k, big,
-                                            self._partial_schema)
-            merged = with_retry_no_split(merge_part, ctx.memory)
-            for s in parts:
-                s.close()
-            final = self._finalize(ctx, merged)
-            rows_m.add(final.num_rows)
-            yield final
+                def merge_part(parts=parts):
+                    with ctx.semaphore.held():
+                        big = concat_batches([s.get() for s in parts])
+                        return self._run_kernel(merge_k, big,
+                                                self._partial_schema)
+                try:
+                    merged = with_retry_no_split(merge_part, ctx.memory)
+                finally:
+                    for s in parts:
+                        s.close()
+                final = self._finalize(ctx, merged)
+                rows_m.add(final.num_rows)
+                yield final
+        except BaseException:
+            # fatal merge or abandoned consumer: LATER partitions' slices
+            # still pin pool budget (close() is idempotent)
+            for slot in slices:
+                for s in slot:
+                    s.close()
+            raise
 
     # ------------------------------------------------------------------
     def _merge(self, ctx: ExecContext,

@@ -424,15 +424,17 @@ class TpuHashJoinExec(TpuExec):
                 lb = self._maybe_bloom_filter(ctx, lb, rb)
                 return self._join(lb, rb, ctx)
 
-        out = with_retry_no_split(run, ctx.memory)
-        sigs = getattr(self, "side_sigs", None)
-        if sigs is not None:
-            # AQE stage stats (ref GpuCustomShuffleReaderExec): record
-            # LOGICAL side sizes for the next planning of this shape
-            _record_sides([(sigs[0], left_batches, ls),
-                           (sigs[1], right_batches, rs)])
-        for s in right_batches + left_batches:
-            s.close()
+        try:
+            out = with_retry_no_split(run, ctx.memory)
+            sigs = getattr(self, "side_sigs", None)
+            if sigs is not None:
+                # AQE stage stats (ref GpuCustomShuffleReaderExec): record
+                # LOGICAL side sizes for the next planning of this shape
+                _record_sides([(sigs[0], left_batches, ls),
+                               (sigs[1], right_batches, rs)])
+        finally:
+            for s in right_batches + left_batches:
+                s.close()
         rows_m.add(out.num_rows_raw)
         yield out
 
@@ -569,14 +571,23 @@ class TpuHashJoinExec(TpuExec):
                 raise
             return outs
 
-        outs = with_retry_no_split(run, ctx.memory)
-        for s in left_batches + right_batches:
-            s.close()
-        for s in outs:
-            b = s.get()
-            s.close()
-            rows_m.add(b.num_rows)
-            yield b
+        try:
+            outs = with_retry_no_split(run, ctx.memory)
+        finally:
+            for s in left_batches + right_batches:
+                s.close()
+        try:
+            for s in outs:
+                b = s.get()
+                s.close()
+                rows_m.add(b.num_rows)
+                yield b
+        except BaseException:
+            # a failed unspill or an abandoned consumer would leak the
+            # partitions still parked (close() is idempotent)
+            for s in outs:
+                s.close()
+            raise
 
     # ------------------------------------------------------------------
     def _join(self, lb: ColumnarBatch, rb: ColumnarBatch,
@@ -831,9 +842,11 @@ class TpuNestedLoopJoinExec(TpuExec):
                 return _finish_pair_join(self.join_type, lb, rb, li, ri,
                                          live, self.condition, self._schema)
 
-        out = with_retry_no_split(run, ctx.memory)
-        for s in right_batches + left_batches:
-            s.close()
+        try:
+            out = with_retry_no_split(run, ctx.memory)
+        finally:
+            for s in right_batches + left_batches:
+                s.close()
         rows_m.add(out.num_rows_raw)
         yield out
 

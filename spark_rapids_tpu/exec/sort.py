@@ -220,9 +220,11 @@ class TpuSortExec(TpuExec):
                 big = concat_batches([sb.get() for sb in spillables])
                 return sort_batch_device(self.orders, big)
 
-        out = with_retry_no_split(do_sort, ctx.memory)
-        for sb in spillables:
-            sb.close()
+        try:
+            out = with_retry_no_split(do_sort, ctx.memory)
+        finally:
+            for sb in spillables:
+                sb.close()
         yield out
 
     # ------------------------------------------------------------------
@@ -241,24 +243,30 @@ class TpuSortExec(TpuExec):
         budget = n_buckets * self.OVERSAMPLE * len(spillables)
         runs = []
         samples = []
-        for sb in spillables:
-            def sort_one(sb=sb):
-                with ctx.semaphore.held():
-                    run, ops = sort_batch_device(self.orders, sb.get(),
-                                                 with_keys=True)
-                    n = run.num_rows
-                    if n == 0:
-                        return SpillableBatch(run, ctx.memory), None
-                    k = max(min(n, -(-budget * n // total_rows)), 1)
-                    idx = jnp.asarray(
-                        np.linspace(0, n - 1, num=k, dtype=np.int64))
-                    samp = [np.asarray(jnp.take(op, idx)) for op in ops]
-                    return SpillableBatch(run, ctx.memory), samp
-            run_sb, samp = with_retry_no_split(sort_one, ctx.memory)
-            sb.close()
-            runs.append(run_sb)
-            if samp is not None:
-                samples.append(samp)
+        try:
+            for sb in spillables:
+                def sort_one(sb=sb):
+                    with ctx.semaphore.held():
+                        run, ops = sort_batch_device(self.orders, sb.get(),
+                                                     with_keys=True)
+                        n = run.num_rows
+                        if n == 0:
+                            return SpillableBatch(run, ctx.memory), None
+                        k = max(min(n, -(-budget * n // total_rows)), 1)
+                        idx = jnp.asarray(
+                            np.linspace(0, n - 1, num=k, dtype=np.int64))
+                        samp = [np.asarray(jnp.take(op, idx)) for op in ops]
+                        return SpillableBatch(run, ctx.memory), samp
+                run_sb, samp = with_retry_no_split(sort_one, ctx.memory)
+                sb.close()
+                runs.append(run_sb)
+                if samp is not None:
+                    samples.append(samp)
+        except Exception:
+            # close() is idempotent: already-consumed inputs are no-ops
+            for x in runs + spillables:
+                x.close()
+            raise
         if not samples:
             for r in runs:
                 r.close()
@@ -289,19 +297,30 @@ class TpuSortExec(TpuExec):
 
         # pass 3: per bucket, concat + device sort; buckets are range-
         # disjoint and ordered, so the output stream is globally sorted
-        for b in range(n_buckets):
-            parts = bucket_slices[b]
-            if not parts:
-                continue
+        try:
+            for b in range(n_buckets):
+                parts = bucket_slices[b]
+                if not parts:
+                    continue
 
-            def merge_bucket(parts=parts):
-                with ctx.semaphore.held():
-                    big = concat_batches([p.get() for p in parts])
-                    return sort_batch_device(self.orders, big)
-            out = with_retry_no_split(merge_bucket, ctx.memory)
-            for p in parts:
-                p.close()
-            yield out
+                def merge_bucket(parts=parts):
+                    with ctx.semaphore.held():
+                        big = concat_batches([p.get() for p in parts])
+                        return sort_batch_device(self.orders, big)
+                try:
+                    out = with_retry_no_split(merge_bucket, ctx.memory)
+                finally:
+                    for p in parts:
+                        p.close()
+                yield out
+        except BaseException:
+            # fatal merge or abandoned consumer: LATER buckets' slices
+            # still pin pool budget (close() is idempotent, so the
+            # current bucket's already-closed parts are no-ops)
+            for slot in bucket_slices:
+                for p in slot:
+                    p.close()
+            raise
 
     def describe(self):
         return "Sort[" + ", ".join(map(repr, self.orders)) + "]"

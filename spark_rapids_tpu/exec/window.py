@@ -694,9 +694,11 @@ class TpuWindowExec(TpuExec):
                     new_cols.append(DeviceColumn(d, v, e.data_type(cs)))
                 return ColumnarBatch(new_cols, batch.num_rows, self._schema)
 
-        out = with_retry_no_split(run, ctx.memory)
-        for s in spill:
-            s.close()
+        try:
+            out = with_retry_no_split(run, ctx.memory)
+        finally:
+            for s in spill:
+                s.close()
         yield out
 
     # -- host numpy execution (terminal, fetch-bound windows) --------------

@@ -76,6 +76,39 @@ def _snappy_block(chunk: bytes) -> bytes:
     return out.to_pybytes() if hasattr(out, "to_pybytes") else bytes(out)
 
 
+def _zstd_block(chunk: bytes) -> bytes:
+    """One zstd frame. Prefer the zstandard module (size-less streaming
+    API); without it, parse the frame header's Frame_Content_Size so
+    pyarrow's codec (which demands the exact size) can decode. ORC
+    writers use the simple API, which always records the content size."""
+    try:
+        import zstandard
+        return zstandard.ZstdDecompressor().decompress(
+            chunk, max_output_size=1 << 26)
+    except ImportError:
+        pass
+    import pyarrow as pa
+    if chunk[:4] != b"\x28\xb5\x2f\xfd":
+        raise ValueError("not a zstd frame")
+    fhd = chunk[4]
+    fcs_flag = fhd >> 6
+    single_segment = (fhd >> 5) & 1
+    pos = 5 + (0 if single_segment else 1)   # skip window descriptor
+    pos += (0, 1, 2, 4)[fhd & 3]             # skip dictionary id
+    if fcs_flag == 0:
+        if not single_segment:
+            raise ValueError("zstd frame without content size")
+        size = chunk[pos]
+    elif fcs_flag == 1:
+        size = struct.unpack_from("<H", chunk, pos)[0] + 256
+    elif fcs_flag == 2:
+        size = struct.unpack_from("<I", chunk, pos)[0]
+    else:
+        size = struct.unpack_from("<Q", chunk, pos)[0]
+    out = pa.Codec("zstd").decompress(chunk, decompressed_size=size)
+    return out.to_pybytes() if hasattr(out, "to_pybytes") else bytes(out)
+
+
 def _decompress(data: bytes, kind: int) -> bytes:
     """ORC compressed stream: 3-byte chunk headers
     (len << 1 | isOriginal), repeated. kind: 0=NONE 1=ZLIB 2=SNAPPY
@@ -98,9 +131,7 @@ def _decompress(data: bytes, kind: int) -> bytes:
         elif kind == 2:                # snappy raw block
             out.extend(_snappy_block(bytes(chunk)))
         elif kind == 5:                # zstd frame
-            import zstandard
-            out.extend(zstandard.ZstdDecompressor().decompress(
-                bytes(chunk), max_output_size=1 << 26))
+            out.extend(_zstd_block(bytes(chunk)))
         else:
             raise ValueError(f"unsupported ORC compression kind {kind}")
     return bytes(out)

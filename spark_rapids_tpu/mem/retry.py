@@ -78,25 +78,37 @@ def with_retry(inputs: List[SpillableBatch],
     input piece, in order."""
     mm = mm or MemoryManager.get()
     queue: List[SpillableBatch] = list(inputs)
-    while queue:
-        item = queue.pop(0)
-        attempts = 0
-        while True:
-            try:
-                yield fn(item)
-                break
-            except RetryOOM:
-                attempts += 1
-                stats and setattr(stats, "retries", stats.retries + 1)
-                if attempts > MAX_RETRIES:
-                    raise OutOfDeviceMemory("retry limit exceeded")
-                mm.spill_device(0)
-            except SplitAndRetryOOM:
-                stats and setattr(stats, "splits", stats.splits + 1)
-                pieces = splitter(item)
-                # process pieces in order before the rest of the queue
-                queue = pieces + queue
-                item = None
-                break
-        if item is None:
-            continue
+    item: Optional[SpillableBatch] = None
+    try:
+        while queue:
+            item = queue.pop(0)
+            attempts = 0
+            while True:
+                try:
+                    yield fn(item)
+                    break
+                except RetryOOM:
+                    attempts += 1
+                    stats and setattr(stats, "retries", stats.retries + 1)
+                    if attempts > MAX_RETRIES:
+                        raise OutOfDeviceMemory("retry limit exceeded")
+                    mm.spill_device(0)
+                except SplitAndRetryOOM:
+                    stats and setattr(stats, "splits", stats.splits + 1)
+                    pieces = splitter(item)
+                    # process pieces in order before the rest of the queue
+                    queue = pieces + queue
+                    item = None
+                    break
+            if item is None:
+                continue
+    except BaseException:
+        # fatal error or abandoned consumer: the iterator owns every input
+        # still queued — release them or they pin pool budget forever
+        # (close() is idempotent, so an input fn already consumed is a
+        # no-op; ref RmmRapidsRetryIterator closes its attempt on throw)
+        if item is not None:
+            item.close()
+        for sb in queue:
+            sb.close()
+        raise

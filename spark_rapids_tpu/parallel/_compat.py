@@ -1,0 +1,35 @@
+"""jax version compatibility for the SPMD layer.
+
+``shard_map`` moved from ``jax.experimental.shard_map`` to a top-level
+export (jax >= 0.4.31 keeps both, newer releases only the latter), and
+its replication-check kwarg was renamed ``check_rep`` -> ``check_vma``.
+This shim presents the NEW surface (top-level name, ``check_vma``) on
+either jax, so call sites never branch on version.
+"""
+from __future__ import annotations
+
+import inspect
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:                     # older jax: experimental only
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_PARAMS = None
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None):
+    global _PARAMS
+    kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    if check_vma is not None:
+        if _PARAMS is None:
+            try:
+                _PARAMS = frozenset(
+                    inspect.signature(_shard_map).parameters)
+            except (TypeError, ValueError):
+                _PARAMS = frozenset()
+        if "check_vma" in _PARAMS:
+            kwargs["check_vma"] = check_vma
+        elif "check_rep" in _PARAMS:    # pre-rename spelling
+            kwargs["check_rep"] = check_vma
+    return _shard_map(f, **kwargs)

@@ -24,8 +24,9 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ._compat import shard_map
 
 from ..exprs.base import DVal, EvalContext, Expression
 from ..exec.groupby_core import segmented_groupby

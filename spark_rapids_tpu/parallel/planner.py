@@ -1522,8 +1522,9 @@ class DistributedPipelineExec(TpuExec):
     # -----------------------------------------------------------------------
     def _build_program(self, env: _Env):
         import jax
-        from jax import shard_map
         from jax.sharding import PartitionSpec as P
+
+        from ._compat import shard_map
         from ..columnar.packing import pack_traced
         root = self.root
         self._check_keys = None

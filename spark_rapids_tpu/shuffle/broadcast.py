@@ -52,14 +52,16 @@ class BroadcastExchangeExec(TpuExec):
             size_m = ctx.metric(self._exec_id, "dataSize", ESSENTIAL)
             spill = [SpillableBatch(b, ctx.memory)
                      for b in self.children[0].execute(ctx)]
-            with ctx.semaphore.held():
-                if spill:
-                    out = concat_batches([s.get() for s in spill])
-                else:
-                    from ..exec.joins import _empty_batch
-                    out = _empty_batch(self._schema)
-            for s in spill:
-                s.close()
+            try:
+                with ctx.semaphore.held():
+                    if spill:
+                        out = concat_batches([s.get() for s in spill])
+                    else:
+                        from ..exec.joins import _empty_batch
+                        out = _empty_batch(self._schema)
+            finally:
+                for s in spill:
+                    s.close()
             size_m.add(out.device_size_bytes())
             sb = SpillableBatch(
                 out, ctx.memory,

@@ -177,24 +177,34 @@ def scatter_spillables(ctx, spillables, make_parts, n_parts: int):
     out-of-core sort's bucketing pass."""
     from ..mem import SpillableBatch, with_retry_no_split
     slots: List[List[SpillableBatch]] = [[] for _ in range(n_parts)]
-    for sb in spillables:
-        def split_one(sb=sb):
-            out = []
-            try:
-                with ctx.semaphore.held():
-                    pb = make_parts(sb.get())
-                    for p in range(n_parts):
-                        if pb.counts[p]:
-                            out.append((p, SpillableBatch(
-                                pb.partition_device(p), ctx.memory)))
-            except Exception:
-                for _, s in out:
-                    s.close()
-                raise
-            return out
-        for p, s in with_retry_no_split(split_one, ctx.memory):
-            slots[p].append(s)
-        sb.close()
+    try:
+        for sb in spillables:
+            def split_one(sb=sb):
+                out = []
+                try:
+                    with ctx.semaphore.held():
+                        pb = make_parts(sb.get())
+                        for p in range(n_parts):
+                            if pb.counts[p]:
+                                out.append((p, SpillableBatch(
+                                    pb.partition_device(p), ctx.memory)))
+                except Exception:
+                    for _, s in out:
+                        s.close()
+                    raise
+                return out
+            for p, s in with_retry_no_split(split_one, ctx.memory):
+                slots[p].append(s)
+            sb.close()
+    except Exception:
+        # a fatal error mid-scatter: release every slice already parked
+        # and every input not yet consumed (close() is idempotent)
+        for slot in slots:
+            for s in slot:
+                s.close()
+        for sb in spillables:
+            sb.close()
+        raise
     return slots
 
 

@@ -1,0 +1,36 @@
+"""tpulint: AST-based static analysis enforcing the accelerator contracts.
+
+The repo's core invariants are documented but were historically unenforced:
+
+* ``mem/retry.py`` — "the attempted function must be idempotent over its
+  (spillable) input" (the RmmRapidsRetryIterator.scala:33 contract);
+* ``mem/spillable.py`` — every ``SpillableBatch`` must be closed (the
+  reference tracks this with RefCount leak detection / MemoryCleaner);
+* device hot paths must not sync to the host (each sync is a full tunnel
+  round trip — the silent perf killer of accelerator pipelines);
+* the config / ops registries must stay in sync with ``docs/configs.md``
+  and ``docs/supported_ops.md`` (the reference enforces the analog with
+  TypeChecks-driven doc generation and custom scalastyle rules).
+
+This package is a self-contained stdlib-``ast`` framework: a rule
+registry, per-line / per-file suppression comments
+(``# tpulint: disable=<rule>``), a checked-in baseline for grandfathered
+findings, and a CLI (``python -m spark_rapids_tpu.tools.lint``) that
+exits non-zero on new violations. See docs/static_analysis.md.
+"""
+from .framework import (FileContext, FileRule, Finding, LintResult,
+                        ProjectRule, Rule, lint_source, load_baseline,
+                        run_lint, write_baseline)
+from .rules_retry import RetryIdempotenceRule
+from .rules_lifetime import BatchLifetimeRule
+from .rules_hostsync import HostSyncRule
+from .rules_drift import ConfigKeyDriftRule, OpsDocDriftRule
+
+#: every shipped rule, in reporting order
+ALL_RULES = [RetryIdempotenceRule(), BatchLifetimeRule(), HostSyncRule(),
+             ConfigKeyDriftRule(), OpsDocDriftRule()]
+
+__all__ = ["ALL_RULES", "FileContext", "FileRule", "Finding", "LintResult",
+           "ProjectRule", "Rule", "lint_source", "load_baseline", "run_lint",
+           "write_baseline", "RetryIdempotenceRule", "BatchLifetimeRule",
+           "HostSyncRule", "ConfigKeyDriftRule", "OpsDocDriftRule"]

@@ -1,0 +1,83 @@
+"""tpulint CLI.
+
+    python -m spark_rapids_tpu.tools.lint [paths...]
+        [--baseline PATH] [--update-baseline] [--no-baseline]
+        [--list-rules] [-v]
+
+Exit status: 0 when every finding is suppressed or baselined, 1 when new
+violations exist, 2 on usage/tool errors. Default target is the
+``spark_rapids_tpu`` package; default baseline is the checked-in
+``tools/lint/baseline.json``. See docs/static_analysis.md.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from . import ALL_RULES
+from .framework import (default_baseline_path, load_baseline, run_lint,
+                        write_baseline)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m spark_rapids_tpu.tools.lint",
+        description="AST-based static analysis enforcing the accelerator "
+                    "contracts (see docs/static_analysis.md)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/directories to lint (default: the "
+                         "spark_rapids_tpu package)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline JSON path (default: the checked-in "
+                         "tools/lint/baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, grandfathered or not")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline to the current finding set "
+                         "and exit 0")
+    ap.add_argument("--root", default=None,
+                    help="repo root anchoring relative paths and the "
+                         "docs/ lookups of the drift rules (default: the "
+                         "root this package is installed in)")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="also print suppressed and baselined findings")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in ALL_RULES:
+            print(f"{rule.name}: {rule.contract}")
+        return 0
+
+    pkg_root = os.path.abspath(
+        os.path.join(os.path.dirname(__file__), "..", ".."))
+    repo_root = os.path.abspath(args.root) if args.root \
+        else os.path.dirname(pkg_root)
+    paths = args.paths or [pkg_root]
+    baseline_path = args.baseline or default_baseline_path()
+    baseline = {} if args.no_baseline else load_baseline(baseline_path)
+
+    result = run_lint(paths, rules=ALL_RULES, baseline=baseline,
+                      root=repo_root)
+
+    if args.update_baseline:
+        out = write_baseline(result.findings, baseline_path)
+        print(f"tpulint: wrote {len(result.findings)} finding(s) to {out}")
+        return 0
+
+    for f in sorted(result.new, key=lambda f: (f.path, f.line)):
+        print(f"{f.path}:{f.line}: [{f.rule}] {f.message}")
+    if args.verbose:
+        for tag, fs in (("suppressed", result.suppressed),
+                        ("baselined", result.baselined)):
+            for f in sorted(fs, key=lambda f: (f.path, f.line)):
+                print(f"{f.path}:{f.line}: [{f.rule}] ({tag}) {f.message}")
+    print(f"tpulint: {len(result.new)} new finding(s), "
+          f"{len(result.baselined)} baselined, "
+          f"{len(result.suppressed)} suppressed")
+    return 1 if result.new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

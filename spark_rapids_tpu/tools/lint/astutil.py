@@ -1,0 +1,139 @@
+"""Small AST helpers shared by the tpulint rules (stdlib-only)."""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set, Union
+
+FuncNode = Union[ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda]
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'np.asarray' for Attribute chains, 'foo' for Names, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    return dotted_name(call.func)
+
+
+def base_name(node: ast.AST) -> Optional[str]:
+    """Leftmost Name of an Attribute/Subscript chain ('a' in a.b[c].d)."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def walk_scope(fn: FuncNode) -> Iterator[ast.AST]:
+    """Walk fn's body WITHOUT descending into nested function scopes
+    (lambdas and defs start their own scope)."""
+    body = fn.body if not isinstance(fn, ast.Lambda) else [fn.body]
+    stack: List[ast.AST] = list(body) if isinstance(body, list) else [body]
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def local_names(fn: FuncNode) -> Set[str]:
+    """Names bound in fn's own scope: params, assignment/for/with/except
+    targets, walrus targets, imports, nested def names. Python scoping
+    makes any plainly-assigned name local, so anything NOT here that is
+    read or mutated inside fn is captured from an outer scope."""
+    out: Set[str] = set()
+    args = fn.args
+    for a in (list(args.posonlyargs) + list(args.args)
+              + list(args.kwonlyargs)):
+        out.add(a.arg)
+    if args.vararg:
+        out.add(args.vararg.arg)
+    if args.kwarg:
+        out.add(args.kwarg.arg)
+    for node in walk_scope(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            out.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.add(node.name)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                out.add((alias.asname or alias.name).split(".")[0])
+        elif isinstance(node, (ast.Global, ast.Nonlocal)):
+            # declared names are explicitly NOT local
+            out.difference_update(node.names)
+    return out
+
+
+def enclosing_functions(tree: ast.Module) -> Iterator[FuncNode]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            yield node
+
+
+def find_local_funcdef(scope: FuncNode, name: str) -> Optional[FuncNode]:
+    """The def bound to `name` directly inside `scope` (not nested)."""
+    for node in walk_scope(scope):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == name:
+            return node
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Lambda):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == name:
+                    return node.value
+    return None
+
+
+def statements_between(scope: FuncNode, lo: int, hi: int) -> List[ast.stmt]:
+    """Statements of `scope` whose first line falls strictly inside
+    (lo, hi) — used for 'risky work between create and close' checks."""
+    out = []
+    for node in walk_scope(scope):
+        if isinstance(node, ast.stmt) and lo < node.lineno < hi:
+            out.append(node)
+    return out
+
+
+def contains_call(nodes) -> bool:
+    for n in nodes:
+        for sub in ast.walk(n):
+            if isinstance(sub, ast.Call):
+                return True
+    return False
+
+
+def in_cleanup_block(scope: FuncNode, target: ast.AST) -> bool:
+    """True when ``target`` sits inside an except/finally block of ``scope``
+    (without crossing into a nested function) — cleanup/undo code that the
+    retry and lifetime rules both exempt by design."""
+    found: List[bool] = []
+
+    def visit(cur, inside):
+        if cur is target:
+            found.append(inside)
+            return True
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)) and cur is not scope:
+            return False
+        for child in ast.iter_child_nodes(cur):
+            nested = inside
+            if isinstance(cur, ast.Try) and (
+                    child in cur.finalbody
+                    or isinstance(child, ast.ExceptHandler)):
+                nested = True
+            if visit(child, nested):
+                return True
+        return False
+
+    visit(scope, False)
+    return bool(found) and found[0]

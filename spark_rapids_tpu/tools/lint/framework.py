@@ -1,0 +1,255 @@
+"""tpulint core: rule protocol, suppression comments, baseline, runner.
+
+Deliberately self-contained on the stdlib (``ast`` + ``tokenize``-free
+line scanning) so the linter can run in any environment the repo runs
+in — including ones where jax itself is broken (only the two drift
+rules import the live registries, and they degrade to a tool-error
+finding instead of crashing the whole run).
+
+Reference analog: the upstream repo enforces its invariants with custom
+scalastyle rules (scalastyle-config.xml) gated in CI; the baseline file
+plays the role of its grandfathered-suppression lists.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = ["Finding", "Rule", "FileRule", "ProjectRule", "FileContext",
+           "LintResult", "lint_source", "run_lint", "load_baseline",
+           "write_baseline", "default_baseline_path", "iter_python_files"]
+
+#: ``# tpulint: disable=rule-a,rule-b`` — suppresses on its own line (the
+#: next code line) or at end of a code line (that line)
+_DISABLE_RE = re.compile(r"#\s*tpulint:\s*disable=([\w,-]+)")
+#: ``# tpulint: disable-file=rule-a`` — suppresses for the whole file
+_DISABLE_FILE_RE = re.compile(r"#\s*tpulint:\s*disable-file=([\w,-]+)")
+
+
+class Finding:
+    """One rule violation.
+
+    ``key`` is the stable fingerprint component: it must not contain line
+    numbers, so baselined findings survive unrelated edits to the file.
+    """
+
+    def __init__(self, rule: str, path: str, line: int, message: str,
+                 key: Optional[str] = None, col: int = 0):
+        self.rule = rule
+        self.path = path
+        self.line = line
+        self.col = col
+        self.message = message
+        self.key = key if key is not None else message
+
+    def fingerprint(self) -> str:
+        return f"{self.rule}::{self.path}::{self.key}"
+
+    def __repr__(self):
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+class Rule:
+    """Base: subclasses set ``name`` and ``contract`` (one-line doc)."""
+    name = "abstract"
+    contract = ""
+
+
+class FileRule(Rule):
+    """A rule evaluated per Python file: ``check(ctx) -> findings``."""
+
+    def check(self, ctx: "FileContext") -> Iterable[Finding]:
+        raise NotImplementedError
+
+
+class ProjectRule(Rule):
+    """A rule evaluated once over the whole tree (cross-file / registry
+    checks): ``check_project(ctxs, root) -> findings``."""
+
+    def check_project(self, ctxs: Sequence["FileContext"],
+                      root: str) -> Iterable[Finding]:
+        raise NotImplementedError
+
+
+class FileContext:
+    """Parsed file handed to rules: source, AST, and suppression tables."""
+
+    def __init__(self, path: str, source: str, rel: Optional[str] = None):
+        self.path = path
+        self.rel = rel or path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree: Optional[ast.Module] = None
+        self.parse_error: Optional[SyntaxError] = None
+        try:
+            self.tree = ast.parse(source, filename=path)
+        except SyntaxError as e:           # surfaced as a finding by run()
+            self.parse_error = e
+        # line -> set of rule names disabled on that line
+        self.line_disables: Dict[int, set] = {}
+        self.file_disables: set = set()
+        self._scan_suppressions()
+
+    def _scan_suppressions(self):
+        for i, text in enumerate(self.lines, start=1):
+            m = _DISABLE_FILE_RE.search(text)
+            if m:
+                self.file_disables.update(m.group(1).split(","))
+                continue
+            m = _DISABLE_RE.search(text)
+            if not m:
+                continue
+            rules = set(m.group(1).split(","))
+            if text.lstrip().startswith("#"):
+                # standalone comment: applies to the next code line —
+                # skip over any further comment-only or blank lines
+                j = i + 1
+                while j <= len(self.lines) and \
+                        (not self.lines[j - 1].strip()
+                         or self.lines[j - 1].lstrip().startswith("#")):
+                    j += 1
+                self.line_disables.setdefault(j, set()).update(rules)
+            else:
+                self.line_disables.setdefault(i, set()).update(rules)
+
+    def suppressed(self, f: Finding) -> bool:
+        if f.rule in self.file_disables or "all" in self.file_disables:
+            return True
+        at = self.line_disables.get(f.line, ())
+        return f.rule in at or "all" in at
+
+
+class LintResult:
+    def __init__(self):
+        self.findings: List[Finding] = []      # emitted and NOT suppressed
+        self.suppressed: List[Finding] = []    # silenced by comments
+        self.baselined: List[Finding] = []     # grandfathered
+        self.new: List[Finding] = []           # what the CLI fails on
+
+    @property
+    def ok(self) -> bool:
+        return not self.new
+
+
+def iter_python_files(paths: Sequence[str]) -> List[str]:
+    out = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in ("__pycache__", ".git"))
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    return out
+
+
+# --------------------------------------------------------------- baseline
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def load_baseline(path: Optional[str] = None) -> Dict[str, int]:
+    """fingerprint -> grandfathered occurrence count."""
+    path = path or default_baseline_path()
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        data = json.load(f)
+    return {str(k): int(v) for k, v in data.get("findings", {}).items()}
+
+def write_baseline(findings: Sequence[Finding],
+                   path: Optional[str] = None) -> str:
+    path = path or default_baseline_path()
+    counts: Dict[str, int] = {}
+    for f in findings:
+        counts[f.fingerprint()] = counts.get(f.fingerprint(), 0) + 1
+    with open(path, "w") as fh:
+        json.dump({"comment": "tpulint grandfathered findings; regenerate "
+                              "with python -m spark_rapids_tpu.tools.lint "
+                              "--update-baseline (docs/static_analysis.md)",
+                   "findings": dict(sorted(counts.items()))}, fh, indent=1)
+        fh.write("\n")
+    return path
+
+
+def _apply_baseline(result: LintResult, baseline: Dict[str, int]):
+    budget = dict(baseline)
+    for f in result.findings:
+        fp = f.fingerprint()
+        if budget.get(fp, 0) > 0:
+            budget[fp] -= 1
+            result.baselined.append(f)
+        else:
+            result.new.append(f)
+
+
+# ----------------------------------------------------------------- runner
+def run_lint(paths: Sequence[str], rules: Optional[Sequence[Rule]] = None,
+             baseline: Optional[Dict[str, int]] = None,
+             root: Optional[str] = None) -> LintResult:
+    """Lint ``paths`` (files or directories). ``root`` anchors relative
+    finding paths and the docs/ lookups of the project rules; defaults to
+    the repo root inferred from this package's location."""
+    if rules is None:
+        from . import ALL_RULES
+        rules = ALL_RULES
+    if root is None:
+        # .../spark_rapids_tpu/tools/lint/framework.py -> repo root
+        root = os.path.abspath(
+            os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+    result = LintResult()
+    ctxs: List[FileContext] = []
+    for fpath in iter_python_files(paths):
+        try:
+            with open(fpath, encoding="utf-8") as fh:
+                src = fh.read()
+        except OSError as e:
+            result.findings.append(Finding(
+                "tool-error", fpath, 0, f"cannot read file: {e}"))
+            continue
+        rel = os.path.relpath(os.path.abspath(fpath), root)
+        ctxs.append(FileContext(fpath, src, rel=rel))
+
+    for ctx in ctxs:
+        if ctx.parse_error is not None:
+            result.findings.append(Finding(
+                "tool-error", ctx.rel, ctx.parse_error.lineno or 0,
+                f"syntax error: {ctx.parse_error.msg}"))
+            continue
+        for rule in rules:
+            if isinstance(rule, FileRule):
+                for f in rule.check(ctx):
+                    f.path = ctx.rel
+                    (result.suppressed if ctx.suppressed(f)
+                     else result.findings).append(f)
+    by_rel = {c.rel: c for c in ctxs}
+    for rule in rules:
+        if isinstance(rule, ProjectRule):
+            for f in rule.check_project(ctxs, root):
+                ctx = by_rel.get(f.path)
+                if ctx is not None and ctx.suppressed(f):
+                    result.suppressed.append(f)
+                else:
+                    result.findings.append(f)
+    _apply_baseline(result, baseline or {})
+    return result
+
+
+def lint_source(source: str, rules: Sequence[Rule],
+                path: str = "<test>") -> List[Finding]:
+    """Test/fixture helper: run file rules over a source snippet, with
+    suppression comments honored but no baseline."""
+    ctx = FileContext(path, source)
+    if ctx.parse_error is not None:
+        raise ctx.parse_error
+    out: List[Finding] = []
+    for rule in rules:
+        if isinstance(rule, FileRule):
+            out.extend(f for f in rule.check(ctx) if not ctx.suppressed(f))
+    return out

@@ -1,0 +1,164 @@
+"""Drift rules: the docs and the string literals must match the LIVE
+registries.
+
+* ``config-key-drift`` — every ``spark.rapids.tpu.*`` string literal in
+  the tree must name a registered ConfEntry (config.py ``_REGISTRY``,
+  plus the dynamically-registered per-op enable confs), and
+  ``docs/configs.md`` must be byte-identical to ``generate_docs()``
+  output. Ref: RapidsConf.help() regenerates docs/configs.md and CI
+  fails on diff.
+* ``ops-doc-drift`` — ``docs/supported_ops.md`` must be byte-identical
+  to the live ``tools/supported_ops.generate_supported_ops_md()``. Ref:
+  TypeChecks.scala:1709 SupportedOpsDocs generation.
+
+Both rules import the live registries; when that import itself fails
+(broken interpreter environment) they degrade to a single ``tool-error``
+finding instead of crashing the lint run.
+"""
+from __future__ import annotations
+
+import ast
+import difflib
+import os
+from typing import Callable, Iterable, List, Optional, Sequence, Set
+
+from .framework import FileContext, Finding, ProjectRule
+
+CONF_PREFIX = "spark.rapids.tpu."
+
+
+def _load_registry_keys() -> Set[str]:
+    """All registered conf keys, with every register()-at-import module
+    loaded (the same completeness contract as tools/supported_ops)."""
+    from ..supported_ops import _load_registries
+    _load_registries()
+    from ...plan.op_confs import ensure_op_confs
+    ensure_op_confs()
+    from ... import config
+    return set(config._REGISTRY)
+
+
+def _expected_configs_md() -> str:
+    from ..supported_ops import _load_registries
+    _load_registries()
+    from ...plan.op_confs import ensure_op_confs
+    ensure_op_confs()
+    from ... import config
+    return config.generate_docs()
+
+
+def _expected_supported_ops_md() -> str:
+    from ..supported_ops import generate_supported_ops_md
+    return generate_supported_ops_md()
+
+
+def _doc_drift_findings(rule: str, root: str, doc_rel: str,
+                        expected: str, regen_cmd: str) -> List[Finding]:
+    path = os.path.join(root, doc_rel)
+    if not os.path.exists(path):
+        return [Finding(rule, doc_rel, 1,
+                        f"{doc_rel} is missing; regenerate with "
+                        f"`{regen_cmd}`", key="missing")]
+    with open(path, encoding="utf-8") as f:
+        actual = f.read()
+    if actual == expected:
+        return []
+    diff = list(difflib.unified_diff(
+        actual.splitlines(), expected.splitlines(),
+        fromfile=doc_rel, tofile="generated", lineterm="", n=0))
+    # first differing checked-in line anchors the finding
+    line = 1
+    for d in diff:
+        if d.startswith("@@"):
+            try:
+                line = abs(int(d.split()[1].split(",")[0]))
+            except (ValueError, IndexError):
+                pass
+            break
+    changed = sum(1 for d in diff if d.startswith(("+", "-"))
+                  and not d.startswith(("+++", "---")))
+    return [Finding(
+        rule, doc_rel, line,
+        f"{doc_rel} is stale: {changed} line(s) differ from the live "
+        f"registry output; regenerate with `{regen_cmd}`",
+        key="stale")]
+
+
+class ConfigKeyDriftRule(ProjectRule):
+    name = "config-key-drift"
+    contract = ("every conf-key literal must exist in the config.py "
+                "registry and docs/configs.md must match generate_docs() "
+                "— ref RapidsConf.help() doc generation")
+
+    def __init__(self, registry_loader: Optional[Callable[[], Set[str]]]
+                 = None,
+                 docs_loader: Optional[Callable[[], str]] = None):
+        self._registry_loader = registry_loader or _load_registry_keys
+        self._docs_loader = docs_loader or _expected_configs_md
+
+    def check_project(self, ctxs: Sequence[FileContext],
+                      root: str) -> Iterable[Finding]:
+        try:
+            keys = self._registry_loader()
+        except Exception as e:                    # degraded environment
+            return [Finding("tool-error", "spark_rapids_tpu/config.py", 1,
+                            f"{self.name}: cannot load conf registry: "
+                            f"{type(e).__name__}: {e}", key="registry-load")]
+        findings: List[Finding] = []
+        for ctx in ctxs:
+            if ctx.tree is None:
+                continue
+            for node in ast.walk(ctx.tree):
+                if not (isinstance(node, ast.Constant)
+                        and isinstance(node.value, str)):
+                    continue
+                lit = node.value
+                if not lit.startswith(CONF_PREFIX):
+                    continue
+                if any(c in lit for c in " \n*"):
+                    continue   # prose mentioning a key, not a key
+                if lit in keys:
+                    continue
+                if lit.endswith(".") and any(k.startswith(lit)
+                                             for k in keys):
+                    continue   # prefix literal (startswith checks,
+                               # f-string key stems)
+                findings.append(Finding(
+                    self.name, ctx.rel, node.lineno,
+                    f"conf key literal '{lit}' is not in the config.py "
+                    "registry — typo, or a register() call was removed "
+                    "without updating this use", key=f"unknown:{lit}"))
+        try:
+            findings.extend(_doc_drift_findings(
+                self.name, root, os.path.join("docs", "configs.md"),
+                self._docs_loader(),
+                "python -m spark_rapids_tpu.tools.supported_ops ."))
+        except Exception as e:
+            findings.append(Finding(
+                "tool-error", os.path.join("docs", "configs.md"), 1,
+                f"{self.name}: cannot generate expected docs: "
+                f"{type(e).__name__}: {e}", key="docgen"))
+        return findings
+
+
+class OpsDocDriftRule(ProjectRule):
+    name = "ops-doc-drift"
+    contract = ("docs/supported_ops.md must match the live "
+                "tools/supported_ops registries — ref TypeChecks.scala:"
+                "1709 SupportedOpsDocs")
+
+    def __init__(self, docs_loader: Optional[Callable[[], str]] = None):
+        self._docs_loader = docs_loader or _expected_supported_ops_md
+
+    def check_project(self, ctxs: Sequence[FileContext],
+                      root: str) -> Iterable[Finding]:
+        try:
+            expected = self._docs_loader()
+        except Exception as e:
+            return [Finding(
+                "tool-error", os.path.join("docs", "supported_ops.md"), 1,
+                f"{self.name}: cannot generate expected docs: "
+                f"{type(e).__name__}: {e}", key="docgen")]
+        return _doc_drift_findings(
+            self.name, root, os.path.join("docs", "supported_ops.md"),
+            expected, "python -m spark_rapids_tpu.tools.supported_ops .")

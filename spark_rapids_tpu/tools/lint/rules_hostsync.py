@@ -1,0 +1,116 @@
+"""host-sync: no device→host synchronization inside device hot paths.
+
+Contract: ``eval_device`` bodies run at TRACE time inside a jitted XLA
+computation (exprs/base.py — "an operator's whole expression list is
+traced into ONE jitted XLA computation"), and jit-decorated kernels are
+the per-batch dispatch unit. A host sync there — ``np.asarray`` /
+``np.array`` on a traced value, ``jax.device_get``, ``.item()``,
+``.block_until_ready()``, ``float()``/``int()`` of device data — either
+breaks tracing outright or, worse, silently forces a full tunnel round
+trip per batch, the dominant silent perf killer on a tunneled TPU
+(docs/performance.md: 0.25-0.9 s per MB-scale fetch; PAPERS.md "Operator
+Fusion in XLA" measures the same cliff). Intentional sync points (the
+per-window count fetch, sink materialization) live OUTSIDE these scopes
+or carry an inline suppression with their justification.
+
+Scopes checked: functions named ``eval_device``, and functions decorated
+with ``jax.jit`` / ``functools.partial(jax.jit, ...)``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from .astutil import FuncNode, call_name, dotted_name, walk_scope
+from .framework import FileContext, FileRule, Finding
+
+#: call names that ARE a host sync on a device value, no argument
+#: analysis needed
+_SYNC_CALLS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+               "jax.device_get", "device_get", "_np.asarray", "_np.array",
+               "onp.asarray", "onp.array"}
+#: method names that force a sync on any jax array receiver
+_SYNC_METHODS = {"item", "block_until_ready", "tolist", "to_py"}
+#: names whose conversion to a python scalar inside a traced scope is a
+#: sync (int()/float() on anything derived from these)
+_DEVICE_HINTS = {"ctx", "data", "validity", "num_rows", "lengths", "bytes_"}
+
+
+def _is_jit_decorated(fn: ast.AST) -> bool:
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return False
+    for dec in fn.decorator_list:
+        name = dotted_name(dec) or ""
+        if name.endswith("jax.jit") or name == "jit":
+            return True
+        if isinstance(dec, ast.Call):
+            cn = call_name(dec) or ""
+            if cn.endswith("jax.jit") or cn == "jit":
+                return True
+            if cn.endswith("partial") and dec.args:
+                inner = dotted_name(dec.args[0]) or ""
+                if inner.endswith("jax.jit") or inner == "jit":
+                    return True
+    return False
+
+
+def _mentions_device_value(expr: ast.AST) -> bool:
+    """Heuristic: the expression dereferences something that is a traced
+    device value in these scopes (ctx.*, .data/.validity attributes,
+    DVal fields)."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) and node.attr in _DEVICE_HINTS:
+            return True
+        if isinstance(node, ast.Name) and node.id in _DEVICE_HINTS:
+            return True
+    return False
+
+
+class HostSyncRule(FileRule):
+    name = "host-sync"
+    contract = ("no device->host sync (np.asarray/device_get/.item()/"
+                "float()) inside eval_device or jit-compiled kernels — "
+                "each sync is a full tunnel round trip")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if node.name == "eval_device":
+                findings.extend(self._check_scope(ctx, node, "eval_device"))
+            elif _is_jit_decorated(node):
+                findings.extend(self._check_scope(
+                    ctx, node, f"jit kernel {node.name}"))
+        return findings
+
+    def _check_scope(self, ctx: FileContext, fn: FuncNode,
+                     where: str) -> List[Finding]:
+        out: List[Finding] = []
+        fname = getattr(fn, "name", "<lambda>")
+
+        def emit(node, what, key):
+            out.append(Finding(
+                self.name, ctx.rel, node.lineno,
+                f"{what} inside {where} — this synchronizes the device "
+                "to the host (a full tunnel round trip per batch) or "
+                "breaks XLA tracing", key=f"{fname}:{key}"))
+
+        # nested defs inside eval_device are still trace-time code, so
+        # walk everything (ast.walk), not just the top scope
+        for node in ast.walk(fn) if fn.body else []:
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name in _SYNC_CALLS:
+                emit(node, f"{name}() on a traced value", f"{name}")
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _SYNC_METHODS \
+                    and not node.args:
+                emit(node, f".{node.func.attr}()",
+                     f"method:{node.func.attr}")
+            elif name in ("float", "int", "bool") and node.args \
+                    and _mentions_device_value(node.args[0]):
+                emit(node, f"{name}() of device data",
+                     f"scalar:{name}")
+        return out

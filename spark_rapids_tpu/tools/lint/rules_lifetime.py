@@ -1,0 +1,206 @@
+"""batch-lifetime: every locally-constructed SpillableBatch must be
+released on every path.
+
+Contract (mem/spillable.py, ref SpillableColumnarBatch + the RefCount /
+MemoryCleaner leak tracking): a SpillableBatch reserves device-pool bytes
+and registers with the MemoryManager at construction; until ``close()``
+it pins pool budget and stays in the spill registry. A batch that never
+reaches a close is a guaranteed leak; a batch whose only close sits AFTER
+intervening fallible work — outside any ``try/finally`` or ``with`` — is
+a leak on the exception path (exactly what the per-test zero-leak fixture
+trips on under OOM injection).
+
+Recognized discharge events for a local binding ``x = SpillableBatch(...)``
+(or a list of them built by a comprehension):
+
+* ``x.close()`` — direct close (also via ``for s in x: s.close()`` and
+  closes of loop vars drawn from expressions mentioning ``x``);
+* ``with x`` / ``with SpillableBatch(...) as x`` — scoped ownership;
+* ``return x`` / ``yield x`` — ownership moves to the caller;
+* ``f(..., x, ...)`` / ``lst.append(x)`` / ``obj.attr = x`` /
+  ``d[k] = x`` — ownership transfers to another holder (tracked there).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set
+
+from .astutil import (FuncNode, base_name, call_name, contains_call,
+                      in_cleanup_block, statements_between, walk_scope)
+from .framework import FileContext, FileRule, Finding
+
+#: constructors whose result owns device-pool budget until closed
+_OWNING_CONSTRUCTORS = {"SpillableBatch"}
+
+
+def _walk_no_comprehensions(node: ast.AST):
+    """ast.walk that does not descend into comprehensions or lambdas —
+    names there are reads, not ownership moves."""
+    stack = [node]
+    while stack:
+        cur = stack.pop()
+        yield cur
+        if isinstance(cur, (ast.ListComp, ast.SetComp, ast.DictComp,
+                            ast.GeneratorExp, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(cur))
+
+
+def _constructs_owner(expr: ast.AST) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name and name.rsplit(".", 1)[-1] in _OWNING_CONSTRUCTORS:
+                return True
+    return False
+
+
+class _Binding:
+    def __init__(self, name: str, stmt: ast.stmt):
+        self.name = name
+        self.stmt = stmt
+        self.line = stmt.lineno
+        self.closed_at: List[int] = []      # lines of direct closes
+        self.safe = False                   # with/finally-scoped close
+        self.transferred = False            # return/yield/call/store
+
+
+class BatchLifetimeRule(FileRule):
+    name = "batch-lifetime"
+    contract = ("every locally-constructed SpillableBatch must reach "
+                "close()/with/return/ownership transfer on every path — "
+                "mem/spillable.py, ref SpillableColumnarBatch RefCount")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                findings.extend(self._check_function(ctx, node))
+        return findings
+
+    # ------------------------------------------------------------------
+    def _check_function(self, ctx: FileContext,
+                        fn: FuncNode) -> List[Finding]:
+        bindings: Dict[str, _Binding] = {}
+        for stmt in walk_scope(fn):
+            if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1:
+                continue
+            t = stmt.targets[0]
+            if isinstance(t, ast.Name) and _constructs_owner(stmt.value):
+                # rebinding the same name: analyze the LAST construction
+                # (earlier generations are beyond a line-based pass)
+                bindings[t.id] = _Binding(t.id, stmt)
+        if not bindings:
+            return []
+
+        with_scoped: Set[str] = set()
+        # loop var -> every tracked binding its loop may draw from
+        # (``for s in right + left`` closes BOTH source lists)
+        loop_aliases: Dict[str, Set[str]] = {}
+        for node in walk_scope(fn):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    cm = item.context_expr
+                    if isinstance(cm, ast.Name) and cm.id in bindings:
+                        with_scoped.add(cm.id)
+                    elif _constructs_owner(cm):
+                        ov = item.optional_vars
+                        if isinstance(ov, ast.Name):
+                            with_scoped.add(ov.id)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                if isinstance(node.target, ast.Name):
+                    for sub in ast.walk(node.iter):
+                        if isinstance(sub, ast.Name) and sub.id in bindings:
+                            loop_aliases.setdefault(
+                                node.target.id, set()).add(sub.id)
+
+        for node in walk_scope(fn):
+            self._observe(node, fn, bindings, loop_aliases)
+
+        out: List[Finding] = []
+        for b in bindings.values():
+            if b.name in with_scoped or b.transferred:
+                continue
+            if not b.closed_at:
+                out.append(Finding(
+                    self.name, ctx.rel, b.line,
+                    f"SpillableBatch bound to '{b.name}' in "
+                    f"{getattr(fn, 'name', '<lambda>')}() is never closed, "
+                    "returned, or handed off — it pins device-pool budget "
+                    "forever (mem/spillable.py contract)",
+                    key=f"{getattr(fn, 'name', '<lambda>')}:"
+                        f"leak:{b.name}"))
+                continue
+            if b.safe:
+                continue
+            first_close = min(b.closed_at)
+            between = statements_between(fn, b.line, first_close)
+            risky = [s for s in between
+                     if not isinstance(s, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef,
+                                           ast.ClassDef))
+                     and contains_call([s])
+                     and not self._is_discharge_stmt(s, b.name)]
+            if risky:
+                out.append(Finding(
+                    self.name, ctx.rel, b.line,
+                    f"'{b.name}' ({getattr(fn, 'name', '<lambda>')}()) is "
+                    f"closed at line {first_close}, but the work in "
+                    "between can raise and no try/finally or with-block "
+                    "covers it — the batch leaks on the exception path",
+                    key=f"{getattr(fn, 'name', '<lambda>')}:"
+                        f"exc-leak:{b.name}"))
+        return out
+
+    @staticmethod
+    def _is_discharge_stmt(stmt: ast.stmt, name: str) -> bool:
+        """The close/cleanup statement itself (or a loop doing it)."""
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "close":
+                return True
+        return False
+
+    # ------------------------------------------------------------------
+    def _observe(self, node: ast.AST, fn: FuncNode,
+                 bindings: Dict[str, _Binding],
+                 loop_aliases: Dict[str, Set[str]]):
+        def resolve(name: Optional[str]) -> List[_Binding]:
+            if name is None:
+                return []
+            if name in bindings:
+                return [bindings[name]]
+            return [bindings[s] for s in loop_aliases.get(name, ())
+                    if s in bindings]
+
+        if isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "close" \
+                    and isinstance(node.func.value, ast.Name):
+                for b in resolve(node.func.value.id):
+                    b.closed_at.append(node.lineno)
+                    if in_cleanup_block(fn, node):
+                        b.safe = True
+                return
+            # ownership transfer: the binding rides INTO another call
+            # (with_retry consumes it, scatter_spillables registers it) —
+            # but a read-only mention inside a comprehension/lambda
+            # (``sum(s.bytes() for s in xs)``) transfers nothing
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                for sub in _walk_no_comprehensions(arg):
+                    if isinstance(sub, ast.Name) and sub.id in bindings:
+                        bindings[sub.id].transferred = True
+        elif isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+            val = node.value
+            if val is not None:
+                for sub in _walk_no_comprehensions(val):
+                    if isinstance(sub, ast.Name) and sub.id in bindings:
+                        bindings[sub.id].transferred = True
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, (ast.Attribute, ast.Subscript)):
+                    for sub in ast.walk(node.value):
+                        if isinstance(sub, ast.Name) and sub.id in bindings:
+                            bindings[sub.id].transferred = True
+

@@ -1,0 +1,144 @@
+"""retry-idempotence: closures handed to the OOM retry machinery must be
+re-runnable.
+
+Contract (mem/retry.py, ref RmmRapidsRetryIterator.scala:33): "the
+attempted function must be idempotent over its (spillable) input" — a
+RetryOOM aborts the attempt mid-flight and runs the closure AGAIN, so any
+externally-visible state change made by a partial attempt happens twice
+(or is half-done). The classic failure modes this rule catches:
+
+* mutating captured/outer state (``nonlocal``/``global`` rebinding,
+  ``captured.append(...)``, ``captured[k] = v``, ``obj.attr = v`` on a
+  captured object) — the retry re-appends / re-applies;
+* ``next()`` on a captured iterator — the retry consumes a SECOND
+  element, silently dropping a batch;
+* ``.close()`` on a captured batch — the retry calls ``get()`` on a
+  closed SpillableBatch and dies (or worse, double-frees accounting).
+
+Cleanup inside ``except``/``finally`` handlers is exempt: undoing a
+failed attempt's own partial output (the joins/_subpartitioned idiom)
+is exactly how a closure STAYS idempotent.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from .astutil import (FuncNode, base_name, call_name, find_local_funcdef,
+                      in_cleanup_block, local_names, walk_scope)
+from .framework import FileContext, FileRule, Finding
+
+#: entry points whose fn argument must be idempotent; value = positional
+#: index of the closure argument
+RETRY_ENTRY_POINTS = {"with_retry_no_split": 0, "with_retry": 1}
+
+#: mutating methods — calling one on a CAPTURED name inside the closure
+#: is outer-state mutation
+_MUTATORS = {"append", "extend", "insert", "add", "update", "pop",
+             "popitem", "remove", "discard", "clear", "setdefault",
+             "appendleft", "extendleft", "write"}
+
+
+def _closure_arg(call: ast.Call) -> Optional[ast.AST]:
+    name = call_name(call)
+    if name is None:
+        return None
+    short = name.rsplit(".", 1)[-1]
+    idx = RETRY_ENTRY_POINTS.get(short)
+    if idx is None or len(call.args) <= idx:
+        return None
+    return call.args[idx]
+
+
+class RetryIdempotenceRule(FileRule):
+    name = "retry-idempotence"
+    contract = ("closures passed to with_retry/with_retry_no_split must be "
+                "idempotent over their (spillable) input — mem/retry.py, "
+                "ref RmmRapidsRetryIterator.scala:33")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        tree = ctx.tree
+        findings: List[Finding] = []
+        # map every retry call site to its enclosing function scope so a
+        # Name closure argument can be resolved to its local def
+        scopes: List[FuncNode] = [n for n in ast.walk(tree)
+                                  if isinstance(n, (ast.FunctionDef,
+                                                    ast.AsyncFunctionDef,
+                                                    ast.Lambda))]
+        for scope in scopes:
+            for node in walk_scope(scope):
+                if not isinstance(node, ast.Call):
+                    continue
+                arg = _closure_arg(node)
+                if arg is None:
+                    continue
+                closure: Optional[FuncNode] = None
+                if isinstance(arg, ast.Lambda):
+                    closure = arg
+                elif isinstance(arg, ast.Name):
+                    closure = find_local_funcdef(scope, arg.id)
+                if closure is None:
+                    continue   # non-local callable: out of reach for AST
+                findings.extend(self._check_closure(ctx, closure))
+        return findings
+
+    def _check_closure(self, ctx: FileContext,
+                       closure: FuncNode) -> List[Finding]:
+        locals_: Set[str] = local_names(closure)
+        declared_outer: Set[str] = set()
+        for node in walk_scope(closure):
+            if isinstance(node, (ast.Global, ast.Nonlocal)):
+                declared_outer.update(node.names)
+        out: List[Finding] = []
+
+        def captured(name: Optional[str]) -> bool:
+            return name is not None and (name not in locals_
+                                         or name in declared_outer)
+
+        def emit(node, what, key):
+            if in_cleanup_block(closure, node):
+                return
+            cname = getattr(closure, "name", "<lambda>")
+            out.append(Finding(
+                self.name, ctx.rel, node.lineno,
+                f"retry closure '{cname}' {what} — a RetryOOM replays the "
+                "attempt, so this side effect is not idempotent "
+                "(mem/retry.py contract)", key=f"{cname}:{key}"))
+
+        for node in walk_scope(closure):
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if isinstance(t, ast.Name) and t.id in declared_outer:
+                        emit(node, f"rebinds outer name '{t.id}'",
+                             f"rebind:{t.id}")
+                    elif isinstance(t, (ast.Subscript, ast.Attribute)):
+                        base = base_name(t)
+                        if captured(base):
+                            kind = ("element" if isinstance(t, ast.Subscript)
+                                    else "attribute")
+                            emit(node, f"writes an {kind} of captured "
+                                       f"'{base}'", f"store:{base}")
+            elif isinstance(node, ast.Call):
+                name = call_name(node)
+                if name == "next" and node.args:
+                    it = node.args[0]
+                    if isinstance(it, ast.Name) and captured(it.id):
+                        emit(node, f"calls next() on captured iterator "
+                                   f"'{it.id}' (each retry consumes "
+                                   "another element)", f"next:{it.id}")
+                elif isinstance(node.func, ast.Attribute):
+                    base = base_name(node.func.value)
+                    meth = node.func.attr
+                    if meth == "close" and captured(base) \
+                            and isinstance(node.func.value, ast.Name):
+                        emit(node, f"closes captured batch '{base}' "
+                                   "(a retry would reuse a closed input)",
+                             f"close:{base}")
+                    elif meth in _MUTATORS and captured(base) \
+                            and isinstance(node.func.value, ast.Name):
+                        emit(node, f"mutates captured '{base}' via "
+                                   f".{meth}() (replayed on retry)",
+                             f"mutate:{base}.{meth}")
+        return out

@@ -32,7 +32,8 @@ _EXPR_MODULES = ["aggregates", "arithmetic", "cast", "collection_fns",
                  "hash_fns", "higher_order", "json_fns", "logical",
                  "math_fns", "nondeterministic", "string_fns", "window_fns"]
 
-_EXEC_MODULES = ["aggregate", "basic", "generate", "joins", "sort", "window"]
+_EXEC_MODULES = ["aggregate", "basic", "cached", "generate", "joins",
+                 "python_execs", "sort", "window"]
 
 #: per-operator speedup priors for the qualification tool (the reference
 #: ships estimates, not measurements — operatorsScore.csv:1-8; these mirror
@@ -71,6 +72,8 @@ def _load_registries():
               "spark_rapids_tpu.shuffle.broadcast",
               "spark_rapids_tpu.shuffle.cluster",
               "spark_rapids_tpu.io.parquet",
+              "spark_rapids_tpu.io.avro",
+              "spark_rapids_tpu.io.orc",
               "spark_rapids_tpu.io.text",
               "spark_rapids_tpu.io.filecache",
               "spark_rapids_tpu.io.device_decode",
@@ -91,6 +94,7 @@ def _load_registries():
               "spark_rapids_tpu.aux.fault",
               "spark_rapids_tpu.udf.compiler",
               "spark_rapids_tpu.delta.table",
+              "spark_rapids_tpu.delta.scan",
               "spark_rapids_tpu.api.session"]:
         try:
             importlib.import_module(m)
@@ -116,6 +120,10 @@ def expression_inventory() -> List[Dict]:
     classes = []
     for root in (Expression, AggregateExpression, WindowFunction):
         for cls in _all_subclasses(root):
+            # subclass scans see the whole interpreter: ad-hoc subclasses
+            # defined by tests/benchmarks must not leak into the docs
+            if not cls.__module__.startswith("spark_rapids_tpu."):
+                continue
             if cls.__name__ not in seen:
                 seen.add(cls.__name__)
                 classes.append(cls)
@@ -183,6 +191,8 @@ def exec_inventory() -> List[Dict]:
     for cls in sorted(_all_subclasses(TpuExec), key=lambda c: c.__name__):
         if cls.__name__.startswith("_"):
             continue
+        if not cls.__module__.startswith("spark_rapids_tpu."):
+            continue   # test/benchmark-local subclasses are not operators
         if "do_execute" not in cls.__dict__ and not any(
                 "do_execute" in b.__dict__ for b in cls.__mro__[1:-1]):
             continue

@@ -46,6 +46,17 @@ _BINOPS = {
     "//": IntegralDivide, "%": Remainder, "**": Pow,
 }
 
+#: python <= 3.10 spells each binary operator as its own opcode (3.11
+#: folded them all into BINARY_OP); map back to the shared symbol table
+_LEGACY_BINOPS = {
+    "BINARY_ADD": "+", "BINARY_SUBTRACT": "-", "BINARY_MULTIPLY": "*",
+    "BINARY_TRUE_DIVIDE": "/", "BINARY_FLOOR_DIVIDE": "//",
+    "BINARY_MODULO": "%", "BINARY_POWER": "**",
+    "INPLACE_ADD": "+", "INPLACE_SUBTRACT": "-", "INPLACE_MULTIPLY": "*",
+    "INPLACE_TRUE_DIVIDE": "/", "INPLACE_FLOOR_DIVIDE": "//",
+    "INPLACE_MODULO": "%", "INPLACE_POWER": "**",
+}
+
 _CMPS = {
     "<": LessThan, "<=": LessThanOrEqual, ">": GreaterThan,
     ">=": GreaterThanOrEqual, "==": EqualTo, "!=": NotEqual,
@@ -141,8 +152,16 @@ def compile_udf(fn, args: List[Expression]) -> Expression:
                 stack.append(stack[-ins.arg])
                 i += 1
                 continue
+            if op == "DUP_TOP":           # python <= 3.10 COPY 1
+                stack.append(stack[-1])
+                i += 1
+                continue
             if op == "SWAP":
                 stack[-1], stack[-ins.arg] = stack[-ins.arg], stack[-1]
+                i += 1
+                continue
+            if op == "ROT_TWO":           # python <= 3.10 SWAP 2
+                stack[-1], stack[-2] = stack[-2], stack[-1]
                 i += 1
                 continue
             if op in ("LOAD_FAST", "LOAD_FAST_CHECK"):
@@ -192,10 +211,11 @@ def compile_udf(fn, args: List[Expression]) -> Expression:
                     stack.append(_Method(name, as_expr(tgt)))
                 i += 1
                 continue
-            if op == "BINARY_OP":
+            if op == "BINARY_OP" or op in _LEGACY_BINOPS:
                 r = as_expr(stack.pop())
                 l = as_expr(stack.pop())
-                sym = ins.argrepr.rstrip("=")  # no aug-assign targets here
+                sym = (_LEGACY_BINOPS[op] if op in _LEGACY_BINOPS
+                       else ins.argrepr.rstrip("="))  # no aug targets here
                 cls = _BINOPS.get(sym)
                 if cls is None:
                     raise CompileError(f"operator {ins.argrepr}")
@@ -233,7 +253,7 @@ def compile_udf(fn, args: List[Expression]) -> Expression:
                 stack.append(Not(isnull) if ins.arg == 1 else isnull)
                 i += 1
                 continue
-            if op == "CALL":
+            if op in ("CALL", "CALL_FUNCTION", "CALL_METHOD"):
                 argc = ins.arg
                 call_args = [stack.pop() for _ in range(argc)][::-1]
                 callee = stack.pop()
@@ -270,7 +290,10 @@ def compile_udf(fn, args: List[Expression]) -> Expression:
                 taken = run(by_off[ins.argval], stack, local, depth + 1)
                 fall = run(i + 1, stack, local, depth + 1)
                 return If(cond_expr, fall, taken)
-            if op in ("JUMP_FORWARD",):
+            if op in ("JUMP_FORWARD", "JUMP_ABSOLUTE"):
+                if ins.argval <= ins.offset:
+                    # py3.10 loop back-edge compiles to JUMP_ABSOLUTE
+                    raise CompileError("loops not supported")
                 i = by_off[ins.argval]
                 continue
             if op == "JUMP_BACKWARD":

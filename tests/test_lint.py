@@ -1,0 +1,476 @@
+"""tpulint rule tests: per-rule source-snippet fixtures (one violating and
+one clean each), suppression comments, and the baseline mechanism.
+
+Reference analog: the upstream repo's custom scalastyle rules are covered
+by violating/clean snippets in their own build; the baseline plays the
+role of its grandfathered-suppression lists (docs/static_analysis.md).
+"""
+import os
+import textwrap
+
+import pytest
+
+from spark_rapids_tpu.tools.lint import (ALL_RULES, BatchLifetimeRule,
+                                         ConfigKeyDriftRule, HostSyncRule,
+                                         OpsDocDriftRule,
+                                         RetryIdempotenceRule, lint_source)
+from spark_rapids_tpu.tools.lint.framework import (FileContext, Finding,
+                                                   load_baseline, run_lint,
+                                                   write_baseline)
+
+
+def _lint(src, rule):
+    return lint_source(textwrap.dedent(src), [rule])
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ===================================================================== retry
+class TestRetryIdempotence:
+    RULE = RetryIdempotenceRule()
+
+    def test_mutates_captured_list(self):
+        fs = _lint("""
+            def outer(mm, results):
+                def attempt():
+                    b = make_batch()
+                    results.append(b)
+                    return b
+                return with_retry_no_split(attempt, mm)
+            """, self.RULE)
+        assert _rules(fs) == ["retry-idempotence"]
+        assert "results" in fs[0].message
+
+    def test_rebinds_nonlocal(self):
+        fs = _lint("""
+            def outer(mm):
+                total = 0
+                def attempt():
+                    nonlocal total
+                    total += 1
+                    return total
+                return with_retry_no_split(attempt, mm)
+            """, self.RULE)
+        assert any("rebinds outer name 'total'" in f.message for f in fs)
+
+    def test_next_on_captured_iterator(self):
+        fs = _lint("""
+            def outer(mm, batches):
+                it = iter(batches)
+                def attempt():
+                    return transform(next(it))
+                return with_retry_no_split(attempt, mm)
+            """, self.RULE)
+        assert any("next() on captured iterator 'it'" in f.message
+                   for f in fs)
+
+    def test_closes_captured_batch(self):
+        fs = _lint("""
+            def outer(mm, sb):
+                def attempt():
+                    out = transform(sb.get())
+                    sb.close()
+                    return out
+                return with_retry_no_split(attempt, mm)
+            """, self.RULE)
+        assert any("closes captured batch 'sb'" in f.message for f in fs)
+
+    def test_lambda_closure_checked(self):
+        fs = _lint("""
+            def outer(mm, acc):
+                return with_retry_no_split(lambda: acc.append(1), mm)
+            """, self.RULE)
+        assert _rules(fs) == ["retry-idempotence"]
+
+    def test_with_retry_positional_closure(self):
+        # with_retry takes the closure at positional index 1
+        fs = _lint("""
+            def outer(mm, inputs, seen):
+                def attempt(b):
+                    seen.append(b)
+                    return b
+                yield from with_retry(inputs, attempt, mm=mm)
+            """, self.RULE)
+        assert _rules(fs) == ["retry-idempotence"]
+
+    def test_clean_pure_closure(self):
+        fs = _lint("""
+            def outer(mm, sb, sem):
+                def attempt():
+                    local = []
+                    with sem.held():
+                        local.append(sb.get())
+                    return concat(local)
+                return with_retry_no_split(attempt, mm)
+            """, self.RULE)
+        assert fs == []
+
+    def test_clean_cleanup_in_except_is_exempt(self):
+        # undoing a failed attempt's own partial output is exactly how a
+        # closure STAYS idempotent (the scatter_spillables idiom)
+        fs = _lint("""
+            def outer(mm, ctx, parts):
+                def attempt():
+                    out = []
+                    try:
+                        for p in range(3):
+                            out.append(make_spillable(p))
+                            parts.probe(p)
+                    except Exception:
+                        for s in out:
+                            s.close()
+                        parts.clear()
+                        raise
+                    return out
+                return with_retry_no_split(attempt, mm)
+            """, self.RULE)
+        assert fs == []
+
+
+# ================================================================== lifetime
+class TestBatchLifetime:
+    RULE = BatchLifetimeRule()
+
+    def test_never_closed_leaks(self):
+        fs = _lint("""
+            def f(ctx, batch):
+                sb = SpillableBatch(batch, ctx.memory)
+                return transform(batch)
+            """, self.RULE)
+        assert _rules(fs) == ["batch-lifetime"]
+        assert "never closed" in fs[0].message
+
+    def test_close_after_fallible_work_flags_exception_path(self):
+        fs = _lint("""
+            def f(ctx, batch, other):
+                sb = SpillableBatch(batch, ctx.memory)
+                out = risky_work(other)
+                sb.close()
+                return out
+            """, self.RULE)
+        assert any("leaks on the exception path" in f.message for f in fs)
+
+    def test_clean_try_finally(self):
+        fs = _lint("""
+            def f(ctx, batch, other):
+                sb = SpillableBatch(batch, ctx.memory)
+                try:
+                    out = risky_work(other)
+                finally:
+                    sb.close()
+                return out
+            """, self.RULE)
+        assert fs == []
+
+    def test_clean_with_block(self):
+        fs = _lint("""
+            def f(ctx, batch, other):
+                sb = SpillableBatch(batch, ctx.memory)
+                with sb:
+                    return risky_work(other)
+            """, self.RULE)
+        assert fs == []
+
+    def test_clean_return_transfers_ownership(self):
+        fs = _lint("""
+            def f(ctx, batch):
+                sb = SpillableBatch(batch, ctx.memory)
+                return sb
+            """, self.RULE)
+        assert fs == []
+
+    def test_clean_call_transfers_ownership(self):
+        fs = _lint("""
+            def f(ctx, batch, registry):
+                sb = SpillableBatch(batch, ctx.memory)
+                registry.register(sb)
+            """, self.RULE)
+        assert fs == []
+
+    def test_clean_list_closed_through_loop(self):
+        # ``for s in xs: s.close()`` discharges the source list
+        fs = _lint("""
+            def f(ctx, batches):
+                xs = [SpillableBatch(b, ctx.memory) for b in batches]
+                for s in xs:
+                    s.close()
+            """, self.RULE)
+        assert fs == []
+
+    def test_readonly_comprehension_is_not_a_transfer(self):
+        # sum(s.bytes() for s in xs) reads xs but transfers nothing —
+        # the leak must still be reported
+        fs = _lint("""
+            def f(ctx, batches, metric):
+                xs = [SpillableBatch(b, ctx.memory) for b in batches]
+                metric.add(sum(s.bytes() for s in xs))
+            """, self.RULE)
+        assert _rules(fs) == ["batch-lifetime"]
+
+
+# ================================================================= host-sync
+class TestHostSync:
+    RULE = HostSyncRule()
+
+    def test_np_asarray_in_eval_device(self):
+        fs = _lint("""
+            class Op:
+                def eval_device(self, ctx):
+                    x = ctx.column(0)
+                    return np.asarray(x.data)
+            """, self.RULE)
+        assert _rules(fs) == ["host-sync"]
+
+    def test_item_in_jit_kernel(self):
+        fs = _lint("""
+            @jax.jit
+            def kernel(data):
+                n = data.sum().item()
+                return data[:n]
+            """, self.RULE)
+        assert any(".item()" in f.message for f in fs)
+
+    def test_float_of_device_data_in_eval_device(self):
+        fs = _lint("""
+            class Op:
+                def eval_device(self, ctx):
+                    lo = float(ctx.scalar(0))
+                    return jnp.clip(ctx.column(1).data, lo, None)
+            """, self.RULE)
+        assert any("float() of device data" in f.message for f in fs)
+
+    def test_clean_pure_jnp_eval_device(self):
+        fs = _lint("""
+            class Op:
+                def eval_device(self, ctx):
+                    a, b = ctx.column(0), ctx.column(1)
+                    return jnp.where(a.validity, a.data + b.data, 0)
+            """, self.RULE)
+        assert fs == []
+
+    def test_np_asarray_outside_device_scope_is_fine(self):
+        # host-side materialization (sink fetch) is the INTENDED sync point
+        fs = _lint("""
+            def to_pandas(batch):
+                return np.asarray(batch.data)
+            """, self.RULE)
+        assert fs == []
+
+
+# ===================================================================== drift
+def _ctx(tmp_path, rel, src):
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(src))
+    return FileContext(str(p), p.read_text(), rel=rel)
+
+
+class TestConfigKeyDrift:
+    KEYS = {"spark.rapids.tpu.enabled", "spark.rapids.tpu.sql.batchSizeRows"}
+
+    def _rule(self, docs="# configs\n"):
+        return ConfigKeyDriftRule(registry_loader=lambda: set(self.KEYS),
+                                  docs_loader=lambda: docs)
+
+    def test_unknown_key_literal_flagged(self, tmp_path):
+        (tmp_path / "docs").mkdir()
+        (tmp_path / "docs" / "configs.md").write_text("# configs\n")
+        ctx = _ctx(tmp_path, "mod.py",
+                   'KEY = "spark.rapids.tpu.sql.batchSizeRowz"\n')
+        fs = list(self._rule().check_project([ctx], str(tmp_path)))
+        assert any("batchSizeRowz" in f.message
+                   and f.rule == "config-key-drift" for f in fs)
+
+    def test_registered_key_and_prefix_literal_clean(self, tmp_path):
+        (tmp_path / "docs").mkdir()
+        (tmp_path / "docs" / "configs.md").write_text("# configs\n")
+        ctx = _ctx(tmp_path, "mod.py", '''
+            KEY = "spark.rapids.tpu.enabled"
+            PREFIX = "spark.rapids.tpu."
+            ''')
+        fs = list(self._rule().check_project([ctx], str(tmp_path)))
+        assert fs == []
+
+    def test_stale_docs_flagged(self, tmp_path):
+        (tmp_path / "docs").mkdir()
+        (tmp_path / "docs" / "configs.md").write_text("old contents\n")
+        fs = list(self._rule(docs="new contents\n")
+                  .check_project([], str(tmp_path)))
+        assert any("stale" in f.message for f in fs)
+
+    def test_broken_registry_degrades_to_tool_error(self, tmp_path):
+        def boom():
+            raise ImportError("no jax here")
+        rule = ConfigKeyDriftRule(registry_loader=boom,
+                                  docs_loader=lambda: "")
+        fs = list(rule.check_project([], str(tmp_path)))
+        assert [f.rule for f in fs] == ["tool-error"]
+
+
+class TestOpsDocDrift:
+    def test_matching_docs_clean(self, tmp_path):
+        (tmp_path / "docs").mkdir()
+        (tmp_path / "docs" / "supported_ops.md").write_text("ops table\n")
+        rule = OpsDocDriftRule(docs_loader=lambda: "ops table\n")
+        assert list(rule.check_project([], str(tmp_path))) == []
+
+    def test_stale_docs_flagged(self, tmp_path):
+        (tmp_path / "docs").mkdir()
+        (tmp_path / "docs" / "supported_ops.md").write_text("ops table\n")
+        rule = OpsDocDriftRule(docs_loader=lambda: "ops table v2\n")
+        fs = list(rule.check_project([], str(tmp_path)))
+        assert any(f.rule == "ops-doc-drift" and "stale" in f.message
+                   for f in fs)
+
+    def test_missing_docs_flagged(self, tmp_path):
+        (tmp_path / "docs").mkdir()
+        rule = OpsDocDriftRule(docs_loader=lambda: "ops table\n")
+        fs = list(rule.check_project([], str(tmp_path)))
+        assert any("missing" in f.message for f in fs)
+
+
+# ============================================================== suppressions
+VIOLATING = """
+def f(ctx, batch):
+    sb = SpillableBatch(batch, ctx.memory)
+    return transform(batch)
+"""
+
+
+class TestSuppression:
+    def test_end_of_line_disable(self):
+        src = VIOLATING.replace(
+            "sb = SpillableBatch(batch, ctx.memory)",
+            "sb = SpillableBatch(batch, ctx.memory)"
+            "  # tpulint: disable=batch-lifetime")
+        assert lint_source(src, [BatchLifetimeRule()]) == []
+
+    def test_standalone_comment_disables_next_code_line(self):
+        src = VIOLATING.replace(
+            "    sb = SpillableBatch",
+            "    # tpulint: disable=batch-lifetime\n    sb = SpillableBatch")
+        assert lint_source(src, [BatchLifetimeRule()]) == []
+
+    def test_standalone_comment_skips_blank_lines(self):
+        src = VIOLATING.replace(
+            "    sb = SpillableBatch",
+            "    # tpulint: disable=batch-lifetime\n\n    sb = SpillableBatch")
+        assert lint_source(src, [BatchLifetimeRule()]) == []
+
+    def test_file_level_disable(self):
+        src = "# tpulint: disable-file=batch-lifetime\n" + VIOLATING
+        assert lint_source(src, [BatchLifetimeRule()]) == []
+
+    def test_other_rule_disable_does_not_suppress(self):
+        src = VIOLATING.replace(
+            "sb = SpillableBatch(batch, ctx.memory)",
+            "sb = SpillableBatch(batch, ctx.memory)"
+            "  # tpulint: disable=host-sync")
+        assert len(lint_source(src, [BatchLifetimeRule()])) == 1
+
+
+# ================================================================== baseline
+class TestBaseline:
+    def _write_violation(self, tmp_path, name="mod.py"):
+        p = tmp_path / name
+        p.write_text(textwrap.dedent(VIOLATING))
+        return p
+
+    def test_baselined_finding_does_not_fail(self, tmp_path):
+        p = self._write_violation(tmp_path)
+        rules = [BatchLifetimeRule()]
+        first = run_lint([str(p)], rules=rules, root=str(tmp_path))
+        assert len(first.new) == 1
+        bl_path = str(tmp_path / "baseline.json")
+        write_baseline(first.new, bl_path)
+        second = run_lint([str(p)], rules=rules,
+                          baseline=load_baseline(bl_path),
+                          root=str(tmp_path))
+        assert second.ok
+        assert len(second.baselined) == 1
+
+    def test_baseline_survives_unrelated_edits(self, tmp_path):
+        # fingerprints carry no line numbers: shifting the finding down
+        # by adding code above it must not resurface it
+        p = self._write_violation(tmp_path)
+        rules = [BatchLifetimeRule()]
+        bl_path = str(tmp_path / "baseline.json")
+        write_baseline(run_lint([str(p)], rules=rules,
+                                root=str(tmp_path)).new, bl_path)
+        p.write_text("import os\n\n\n" + p.read_text())
+        res = run_lint([str(p)], rules=rules,
+                       baseline=load_baseline(bl_path), root=str(tmp_path))
+        assert res.ok
+
+    def test_new_finding_beyond_baseline_fails(self, tmp_path):
+        p = self._write_violation(tmp_path)
+        rules = [BatchLifetimeRule()]
+        bl_path = str(tmp_path / "baseline.json")
+        write_baseline(run_lint([str(p)], rules=rules,
+                                root=str(tmp_path)).new, bl_path)
+        # a SECOND leak in a new function is not grandfathered
+        p.write_text(p.read_text() + textwrap.dedent("""
+            def g(ctx, batch):
+                sb2 = SpillableBatch(batch, ctx.memory)
+                return transform(batch)
+            """))
+        res = run_lint([str(p)], rules=rules,
+                       baseline=load_baseline(bl_path), root=str(tmp_path))
+        assert not res.ok
+        assert len(res.new) == 1
+        assert "sb2" in res.new[0].message
+
+    def test_missing_baseline_file_is_empty(self, tmp_path):
+        assert load_baseline(str(tmp_path / "nope.json")) == {}
+
+
+# ======================================================================= CLI
+class TestCli:
+    def test_exit_nonzero_on_each_rule_fixture(self, tmp_path):
+        from spark_rapids_tpu.tools.lint.__main__ import main
+        fixtures = {
+            "retry-idempotence": """
+                def outer(mm, results):
+                    def attempt():
+                        results.append(make_batch())
+                    return with_retry_no_split(attempt, mm)
+                """,
+            "batch-lifetime": VIOLATING,
+            "host-sync": """
+                class Op:
+                    def eval_device(self, ctx):
+                        return np.asarray(ctx.column(0).data)
+                """,
+        }
+        for rule, src in fixtures.items():
+            p = tmp_path / f"{rule.replace('-', '_')}.py"
+            p.write_text(textwrap.dedent(src))
+            rc = main([str(p), "--no-baseline"])
+            assert rc != 0, f"CLI should fail on {rule} fixture"
+
+    def test_exit_nonzero_on_stale_docs_root(self, tmp_path):
+        # drift-rule violating fixtures: a repo root whose checked-in
+        # docs do not match the live registries must fail the CLI
+        from spark_rapids_tpu.tools.lint.__main__ import main
+        (tmp_path / "docs").mkdir()
+        (tmp_path / "docs" / "configs.md").write_text("stale\n")
+        (tmp_path / "docs" / "supported_ops.md").write_text("stale\n")
+        empty = tmp_path / "src"
+        empty.mkdir()
+        rc = main([str(empty), "--root", str(tmp_path), "--no-baseline"])
+        assert rc != 0
+
+    def test_exit_zero_on_clean_file(self, tmp_path):
+        from spark_rapids_tpu.tools.lint.__main__ import main
+        p = tmp_path / "clean.py"
+        p.write_text("def f(x):\n    return x + 1\n")
+        assert main([str(p)]) == 0
+
+    def test_list_rules_names_every_shipped_rule(self, capsys):
+        from spark_rapids_tpu.tools.lint.__main__ import main
+        assert main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rule in ALL_RULES:
+            assert rule.name in out

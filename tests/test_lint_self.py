@@ -1,0 +1,47 @@
+"""Tier-1 gate: tpulint over the live ``spark_rapids_tpu`` tree must be
+clean (zero unbaselined findings).
+
+This is the CI hook for the whole static-analysis suite: it runs under
+the existing ROADMAP tier-1 pytest command with no extra plumbing, the
+same way the reference gates its custom scalastyle rules in every build.
+It also transitively enforces the two drift contracts — a config key
+registered without regenerating docs/configs.md, or an op registered
+without regenerating docs/supported_ops.md, fails this test.
+
+To reproduce a failure locally / see the findings:
+
+    python -m spark_rapids_tpu.tools.lint
+
+Fix the finding, or suppress it inline with a justification
+(``# tpulint: disable=<rule>``); see docs/static_analysis.md. Baseline
+regeneration (``--update-baseline``) is a last resort for bulk
+grandfathering, not for new code.
+"""
+import os
+
+import spark_rapids_tpu
+from spark_rapids_tpu.tools.lint import ALL_RULES
+from spark_rapids_tpu.tools.lint.framework import load_baseline, run_lint
+
+PKG_ROOT = os.path.dirname(os.path.abspath(spark_rapids_tpu.__file__))
+REPO_ROOT = os.path.dirname(PKG_ROOT)
+
+
+def test_repo_is_lint_clean():
+    result = run_lint([PKG_ROOT], rules=ALL_RULES,
+                      baseline=load_baseline(), root=REPO_ROOT)
+    listing = "\n".join(
+        f"  {f.path}:{f.line}: [{f.rule}] {f.message}"
+        for f in sorted(result.new, key=lambda f: (f.path, f.line)))
+    assert result.ok, (
+        f"{len(result.new)} new tpulint finding(s) — fix or suppress with "
+        f"a justification (docs/static_analysis.md):\n{listing}")
+
+
+def test_no_tool_errors():
+    # a rule crashing (or the registries failing to import) degrades to
+    # tool-error findings; those must never be baselined away silently
+    result = run_lint([PKG_ROOT], rules=ALL_RULES,
+                      baseline={}, root=REPO_ROOT)
+    errors = [f for f in result.findings if f.rule == "tool-error"]
+    assert errors == [], [repr(f) for f in errors]

@@ -98,6 +98,19 @@ def test_udf_closure_constant():
 # fallback path
 # ---------------------------------------------------------------------------
 
+def test_udf_while_loop_falls_back_row_based():
+    # py3.10 compiles the back-edge to JUMP_ABSOLUTE — must reject,
+    # not follow it (the tracer would spin forever)
+    def f(x):
+        t = 0
+        while t < 3 * x:
+            t += x
+        return t
+    u = F.udf(f)
+    u(F.col("a"))
+    assert u.last_compiled is False
+
+
 def test_udf_loop_falls_back_row_based():
     def f(x):
         t = 0
