@@ -1,36 +1,81 @@
-"""Fatal device-error handling (ref Plugin.scala:661-686 — on fatal CUDA
-errors the executor captures nvidia-smi output + a GPU core dump
-(GpuCoreDumpHandler.scala:48-138) then self-terminates with exit 20 so
-Spark replaces it).
+"""Fault handling and fault injection.
 
-TPU analog: on an XLA runtime error escaping a query, capture a diagnostic
-dump (device list, memory-manager accounting, live-spillable census, the
-failing plan) into ``spark.rapids.tpu.coreDump.path`` before re-raising.
-Recovery itself stays with the caller (Spark's task-retry role)."""
+Two halves, mirroring the reference plugin's split:
+
+* ``DeviceDumpHandler`` — fatal device-error diagnostics (ref
+  Plugin.scala:661-686: on fatal CUDA errors the executor captures
+  nvidia-smi output + a GPU core dump (GpuCoreDumpHandler.scala:48-138)
+  then self-terminates with exit 20 so Spark replaces it). TPU analog:
+  on an XLA runtime error escaping a query, capture a diagnostic dump
+  (device list, memory-manager accounting, live-spillable census, the
+  failing plan) into ``spark.rapids.tpu.coreDump.path`` before
+  re-raising. Recovery itself stays with the caller (Spark's task-retry
+  role — here shuffle/cluster.py's fault-tolerant dispatch).
+
+* ``ChaosController`` — deterministic, seeded fault injection for the
+  distributed runtime: the cross-process analog of the memory layer's
+  ``MemoryManager.force_retry_oom`` (ref RmmSpark.forceRetryOOM test
+  hooks). Config-driven (``spark.rapids.tpu.chaos.*``): injects worker
+  kills, dropped/corrupted/delayed blocks, and RPC delays at NAMED sites
+  in the shuffle transport and the cluster dispatch loop, so the chaos
+  suite can assert byte-identical results with chaos on vs. off.
+"""
 from __future__ import annotations
 
 import json
 import logging
 import os
+import threading
 import time
 import traceback
+import zlib
+from typing import Dict, List, Optional, Tuple
 
 from ..config import register
 
 log = logging.getLogger(__name__)
 
-__all__ = ["DeviceDumpHandler"]
+__all__ = ["DeviceDumpHandler", "ChaosController", "install_chaos",
+           "active_chaos", "CHAOS_SITES"]
 
 CORE_DUMP_PATH = register(
     "spark.rapids.tpu.coreDump.path", "",
     "Directory for device-failure diagnostic dumps; empty disables "
     "(ref spark.rapids.gpu.coreDump.dir, GpuCoreDumpHandler.scala).")
 
+CHAOS_SPEC = register(
+    "spark.rapids.tpu.chaos.spec", "",
+    "Fault-injection spec for the distributed runtime; empty disables. "
+    "Semicolon-separated `site=when` entries where `when` is an integer "
+    "N (fire exactly on the Nth hit of that site), `pX` (fire with "
+    "probability X per hit, seeded), or `*` (every hit). Sites: "
+    "put.corrupt, put.drop, put.delay, fetch.corrupt, fetch.delay, "
+    "task.delay, worker.kill. The distributed analog of the OOM "
+    "injection hooks (ref RmmSpark.forceRetryOOM).")
+
+CHAOS_SEED = register(
+    "spark.rapids.tpu.chaos.seed", 0,
+    "Seed for probabilistic chaos rules — a fixed seed makes an "
+    "injection schedule reproducible across runs.")
+
+CHAOS_DELAY_MS = register(
+    "spark.rapids.tpu.chaos.delayMs", 100,
+    "Sleep injected by the *.delay chaos sites, in milliseconds.")
+
+CHAOS_KILL_TARGET = register(
+    "spark.rapids.tpu.chaos.killTarget", "",
+    "Worker id (e.g. worker-1) the worker.kill chaos site terminates; "
+    "empty means the first worker a task is dispatched to when the site "
+    "fires.")
+
 
 def _is_device_error(e: BaseException) -> bool:
     name = type(e).__name__
-    return "XlaRuntimeError" in name or "RuntimeError" in name and \
-        "RESOURCE_EXHAUSTED" in str(e)
+    # XlaRuntimeError is always a device failure; a bare RuntimeError
+    # qualifies only when the runtime's RESOURCE_EXHAUSTED marker is in
+    # the message (explicit grouping — `A or B and C` read ambiguously)
+    return "XlaRuntimeError" in name or (
+        "RuntimeError" in name and "RESOURCE_EXHAUSTED" in str(e))
 
 
 class DeviceDumpHandler:
@@ -43,8 +88,12 @@ class DeviceDumpHandler:
             return ""
         os.makedirs(self.path, exist_ok=True)
         out = os.path.join(self.path, f"tpu-dump-{int(time.time()*1000)}.json")
+        # format the PASSED exception's traceback — format_exc() reads
+        # sys.exc_info() and is empty outside an active except block
+        tb = "".join(traceback.format_exception(
+            type(exc), exc, exc.__traceback__))
         info = {"error": repr(exc),
-                "traceback": traceback.format_exc(),
+                "traceback": tb,
                 "plan": plan.tree_string() if plan is not None else None}
         try:
             import jax
@@ -68,3 +117,126 @@ class DeviceDumpHandler:
             if _is_device_error(e):
                 self.capture(e, plan)
             raise
+
+
+# ---------------------------------------------------------------------------
+# chaos injection
+# ---------------------------------------------------------------------------
+
+#: the closed set of injection sites (a site name outside this set is a
+#: spec error — named sites are the contract between the controller and
+#: the transport/cluster hooks, like the reference's typed message enum)
+CHAOS_SITES = ("put.corrupt", "put.drop", "put.delay", "fetch.corrupt",
+               "fetch.delay", "task.delay", "worker.kill")
+
+
+class ChaosController:
+    """Deterministic fault injector.
+
+    Each named site calls ``fires(site)`` (or a convenience wrapper) once
+    per potential injection point; the spec decides whether that hit
+    injects. Counting is per-site and the probabilistic rules use a
+    per-site RNG seeded from (seed, site), so a given (spec, seed) yields
+    the SAME injection schedule on every run — the property the chaos
+    suite's byte-identical assertion rests on."""
+
+    def __init__(self, spec: str = "", seed: int = 0,
+                 delay_ms: int = 100, kill_target: str = ""):
+        self.seed = int(seed)
+        self.delay_ms = int(delay_ms)
+        self.kill_target = kill_target
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+        self._fired: List[Tuple[str, int]] = []
+        self._rules: Dict[str, Tuple[str, float]] = {}
+        self._rngs: Dict[str, "object"] = {}
+        for entry in str(spec).split(";"):
+            entry = entry.strip()
+            if not entry:
+                continue
+            site, _, when = entry.partition("=")
+            site, when = site.strip(), when.strip()
+            if site not in CHAOS_SITES:
+                raise ValueError(
+                    f"unknown chaos site {site!r}; sites: {CHAOS_SITES}")
+            if when == "*":
+                self._rules[site] = ("always", 0.0)
+            elif when.startswith("p"):
+                self._rules[site] = ("prob", float(when[1:]))
+            else:
+                self._rules[site] = ("nth", float(int(when)))
+
+    @classmethod
+    def from_conf(cls, conf) -> Optional["ChaosController"]:
+        spec = str(conf.get(CHAOS_SPEC))
+        if not spec.strip():
+            return None
+        return cls(spec, seed=int(conf.get(CHAOS_SEED)),
+                   delay_ms=int(conf.get(CHAOS_DELAY_MS)),
+                   kill_target=str(conf.get(CHAOS_KILL_TARGET)))
+
+    def _rng(self, site: str):
+        import numpy as np
+        if site not in self._rngs:
+            self._rngs[site] = np.random.RandomState(
+                (self.seed * 1_000_003 + zlib.crc32(site.encode()))
+                % (2 ** 31))
+        return self._rngs[site]
+
+    def wants(self, site: str) -> bool:
+        """Does the spec name this site at all? (Callers with expensive
+        hooks — e.g. the driver's worker-kill — can skip the counter.)"""
+        return site in self._rules
+
+    def fires(self, site: str) -> bool:
+        """One potential injection point was hit; inject?"""
+        rule = self._rules.get(site)
+        with self._lock:
+            n = self._counts[site] = self._counts.get(site, 0) + 1
+            if rule is None:
+                return False
+            mode, arg = rule
+            hit = (mode == "always"
+                   or (mode == "nth" and n == int(arg))
+                   or (mode == "prob"
+                       and self._rng(site).uniform() < arg))
+            if hit:
+                self._fired.append((site, n))
+                log.warning("chaos: injecting %s (hit #%d)", site, n)
+            return hit
+
+    # convenience wrappers for the transport hooks -----------------------
+    def corrupt(self, site: str, data: bytes) -> bytes:
+        """Flip a byte of ``data`` when the site fires (CRC-detectable,
+        never a silent truncation)."""
+        if data and self.fires(site):
+            buf = bytearray(data)
+            buf[len(buf) // 2] ^= 0xFF
+            return bytes(buf)
+        return data
+
+    def maybe_delay(self, site: str) -> None:
+        if self.fires(site):
+            time.sleep(self.delay_ms / 1000.0)
+
+    def fired(self) -> List[Tuple[str, int]]:
+        with self._lock:
+            return list(self._fired)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+
+_ACTIVE: List[Optional[ChaosController]] = [None]
+
+
+def install_chaos(ctl: Optional[ChaosController]) -> None:
+    """Install (or with None, remove) the process-global controller —
+    the driver arms workers through the `chaos` task RPC, which lands
+    here in each worker process."""
+    _ACTIVE[0] = ctl
+
+
+def active_chaos() -> Optional[ChaosController]:
+    return _ACTIVE[0]

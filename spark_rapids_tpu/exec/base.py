@@ -6,6 +6,7 @@ the device semaphore gates concurrent device work (GpuSemaphore.scala:51).
 """
 from __future__ import annotations
 
+import itertools
 import time
 from typing import Dict, Iterator, List, Optional
 
@@ -109,6 +110,10 @@ class ExecContext:
             pass
 
 
+#: process-wide exec-id source (itertools.count is atomic under the GIL)
+_EXEC_ID_COUNTER = itertools.count()
+
+
 class TpuExec:
     """Base physical operator."""
 
@@ -121,7 +126,10 @@ class TpuExec:
 
     def __init__(self, children: List["TpuExec"]):
         self.children = children
-        self._exec_id = f"{type(self).__name__}@{id(self):x}"
+        # monotonic, never-reused id: keying metrics on id(self) lets a
+        # freed plan tree's address be reused by a later exec, silently
+        # MERGING two operators' metric entries in a shared ExecContext
+        self._exec_id = f"{type(self).__name__}@{next(_EXEC_ID_COUNTER)}"
 
     # -- interface ---------------------------------------------------------
     def output_schema(self) -> Schema:

@@ -55,17 +55,29 @@ def with_retry_no_split(fn: Callable[[], T], mm: Optional[MemoryManager] = None,
 
 
 def split_batch_in_half(sb: SpillableBatch) -> List[SpillableBatch]:
-    """Default splitter (ref RmmRapidsRetryIterator splitSpillableInHalfByRows)."""
-    batch = sb.get()
-    n = batch.num_rows
-    if n < 2:
-        raise OutOfDeviceMemory("cannot split a batch with < 2 rows")
-    mid = n // 2
-    left = batch.slice(0, mid)
-    right = batch.slice(mid, n - mid)
-    mm = sb._mm
-    sb.close()
-    return [SpillableBatch(left, mm), SpillableBatch(right, mm)]
+    """Default splitter (ref RmmRapidsRetryIterator splitSpillableInHalfByRows).
+
+    Exception-safe: the input is closed whether or not the split
+    succeeds, and a piece already wrapped when the second slice or
+    wrap raises is closed too — a half-built split must not pin pool
+    budget (the caller's retry loop closes only what it was handed)."""
+    pieces: List[SpillableBatch] = []
+    try:
+        batch = sb.get()
+        n = batch.num_rows
+        if n < 2:
+            raise OutOfDeviceMemory("cannot split a batch with < 2 rows")
+        mid = n // 2
+        mm = sb.memory_manager
+        pieces.append(SpillableBatch(batch.slice(0, mid), mm))
+        pieces.append(SpillableBatch(batch.slice(mid, n - mid), mm))
+        return pieces
+    except BaseException:
+        for p in pieces:
+            p.close()
+        raise
+    finally:
+        sb.close()
 
 
 def with_retry(inputs: List[SpillableBatch],

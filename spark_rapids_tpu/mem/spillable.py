@@ -61,6 +61,12 @@ class SpillableBatch:
             self.created_at = "".join(traceback.format_stack(limit=6)[:-1])
 
     @property
+    def memory_manager(self) -> MemoryManager:
+        """The manager accounting for this batch (public accessor —
+        splitters re-wrap pieces under the SAME manager)."""
+        return self._mm
+
+    @property
     def num_rows(self) -> int:
         if not isinstance(self._num_rows, int):
             n = int(self._num_rows)

@@ -1,4 +1,5 @@
-"""TCP shuffle transport: block server + client, typed + authenticated.
+"""TCP shuffle transport: block server + client, typed + authenticated +
+integrity-checked.
 
 The cross-process leg of the shuffle (ref RapidsShuffleTransport's message
 protocol {MetadataRequest, TransferRequest, Buffer} —
@@ -13,13 +14,30 @@ Message = 4-byte big-endian header length + JSON header + raw payload
 (length in the header). Ops — a CLOSED dispatch table, mirroring the
 reference's typed message enum (there is deliberately no "run arbitrary
 callable" op):
-  put    {shuffle, part, size}+payload  -> {ok}
-  fetch  {shuffle, part}                -> {sizes: [...]}+concat(payloads)
+  put    {shuffle, part, size, crc, bid?}+payload -> {ok}
+  fetch  {shuffle, part}          -> {sizes: [...], crcs: [...]}+concat
   task   {name, size}+pickled kwargs    -> {size}+pickled result; `name`
          must be registered in the server's task table (cluster.py
          registers the worker/driver task entry points)
   drop   {shuffle}                      -> {ok}
   close                                 -> connection ends
+
+Fault tolerance (the runtime's own FetchFailedException analog, since
+there is no Spark underneath to re-run stages):
+
+* every block payload carries a CRC32C (checksum.py) computed by the
+  sender and verified by the receiver — a corrupt block is REJECTED and
+  retried, never silently stored or returned;
+* `put`/`fetch` retry transient failures (connection resets, timeouts,
+  checksum rejects) against the same peer with exponential backoff +
+  jitter, up to `spark.rapids.tpu.shuffle.fetch.maxRetries`, before
+  escalating to ShuffleFetchFailed (ref RapidsShuffleIterator transport
+  errors -> FetchFailedException);
+* a put may carry a block id (`bid`); the server DEDUPES on it, which
+  makes put retries and whole-map-task re-execution idempotent (the
+  store-side half of the driver's lineage-based recovery), and fetch
+  returns bid-carrying blocks in bid order so re-executed shuffles
+  concatenate deterministically.
 
 Trust model: every message carries an HMAC-SHA256 over header+payload
 keyed by a per-cluster token minted by LocalCluster and handed to worker
@@ -34,19 +52,54 @@ from __future__ import annotations
 import hashlib
 import hmac as hmac_mod
 import json
+import random
 import socket
 import socketserver
 import struct
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Tuple
 
-__all__ = ["BlockServer", "BlockClient", "ShuffleFetchFailed"]
+from .checksum import ChecksumError, crc32c
+
+__all__ = ["BlockServer", "BlockClient", "ShuffleFetchFailed",
+           "ChecksumError", "RemoteTaskError"]
 
 
-class ShuffleFetchFailed(RuntimeError):
-    """A peer's blocks are unreachable (process died / connection reset) —
-    the analog of Spark's FetchFailedException; the driver surfaces it
-    instead of hanging (ref RapidsShuffleIterator transport errors)."""
+class RemoteTaskError(RuntimeError):
+    """A task raised inside the WORKER process. Wrapping (rather than
+    re-raising the remote exception verbatim) keeps a remote OSError/
+    ConnectionError from masquerading as a local transport failure —
+    the driver's death classifier must only ever see genuine socket
+    errors, or a deterministic worker-side IO error would get every
+    healthy worker declared dead in turn. The original exception rides
+    along as __cause__ when it survived pickling."""
+
+
+class ShuffleFetchFailed(ConnectionError):
+    """A peer's blocks are unreachable (process died / connection reset /
+    persistent corruption) after retries — the analog of Spark's
+    FetchFailedException; the driver catches it and regenerates the lost
+    partitions from lineage instead of hanging or silently continuing
+    (ref RapidsShuffleIterator transport errors). Subclasses
+    ConnectionError so transport-level handlers treat it as the
+    connection failure it escalates from."""
+
+    def __init__(self, msg: str, peer: Optional[str] = None,
+                 shuffle: Optional[int] = None, part: Optional[int] = None):
+        super().__init__(msg)
+        self.peer = peer
+        self.shuffle = shuffle
+        self.part = part
+
+    def __reduce__(self):  # keep peer/shuffle/part across pickling
+        return (type(self), (self.args[0], self.peer, self.shuffle,
+                             self.part))
+
+
+def _chaos():
+    from ..aux.fault import active_chaos
+    return active_chaos()
 
 
 def _sign(token: Optional[bytes], header: dict, payload: bytes) -> str:
@@ -101,29 +154,12 @@ class _Handler(socketserver.BaseRequestHandler):
                         return
                 op = header.get("op")
                 if op == "put":
-                    server._put(header["shuffle"], header["part"], payload)
-                    _send_msg(self.request, {"ok": True})
+                    if not self._handle_put(server, header, payload):
+                        return
                 elif op == "fetch":
-                    blocks = server._fetch(header["shuffle"],
-                                           header["part"])
-                    body = b"".join(blocks)
-                    _send_msg(self.request,
-                              {"sizes": [len(b) for b in blocks],
-                               "size": len(body)}, body)
+                    self._handle_fetch(server, header)
                 elif op == "task":
-                    import pickle
-                    fn = server.tasks.get(header.get("name", ""))
-                    if fn is None:
-                        res = pickle.dumps(
-                            (False, f"unknown task {header.get('name')!r}"))
-                    else:
-                        try:
-                            kwargs = pickle.loads(payload) if payload \
-                                else {}
-                            res = pickle.dumps((True, fn(**kwargs)))
-                        except Exception as e:  # raised driver-side
-                            res = pickle.dumps((False, repr(e)))
-                    _send_msg(self.request, {"size": len(res)}, res)
+                    self._handle_task(server, header, payload)
                 elif op == "drop":
                     server._drop(header["shuffle"])
                     _send_msg(self.request, {"ok": True})
@@ -137,6 +173,74 @@ class _Handler(socketserver.BaseRequestHandler):
             with server._conn_lock:
                 server._conns.discard(self.request)
 
+    def _handle_put(self, server: "BlockServer", header: dict,
+                    payload: bytes) -> bool:
+        """Returns False when the connection should be torn down (the
+        put.drop chaos site simulates a peer dying mid-transfer)."""
+        chaos = _chaos()
+        if chaos is not None:
+            chaos.maybe_delay("put.delay")
+            if chaos.fires("put.drop"):
+                return False       # block lost AND connection reset
+        want = header.get("crc")
+        if want is not None and crc32c(payload) != want:
+            # reject, don't store: the sender retries (bid-deduped);
+            # retryable tells the client this is NOT a dead peer
+            server.crc_rejects += 1
+            _send_msg(self.request,
+                      {"error": "checksum mismatch on put "
+                                f"shuffle={header['shuffle']} "
+                                f"part={header['part']}",
+                       "retryable": True})
+            return True
+        server._put(header["shuffle"], header["part"], payload,
+                    bid=header.get("bid"), crc=want)
+        _send_msg(self.request, {"ok": True})
+        return True
+
+    def _handle_fetch(self, server: "BlockServer", header: dict) -> None:
+        chaos = _chaos()
+        if chaos is not None:
+            chaos.maybe_delay("fetch.delay")
+        entries = server._fetch_entries(header["shuffle"], header["part"])
+        body = b"".join(data for _bid, _crc, data in entries)
+        if chaos is not None:
+            # corrupt AFTER the crc header is built: the client's
+            # verification must catch it
+            body = chaos.corrupt("fetch.corrupt", body)
+        _send_msg(self.request,
+                  {"sizes": [len(data) for _b, _c, data in entries],
+                   "crcs": [crc for _b, crc, _d in entries],
+                   "size": len(body)}, body)
+
+    def _handle_task(self, server: "BlockServer", header: dict,
+                     payload: bytes) -> None:
+        import pickle
+        chaos = _chaos()
+        if chaos is not None:
+            chaos.maybe_delay("task.delay")
+        fn = server.tasks.get(header.get("name", ""))
+        if fn is None:
+            res = pickle.dumps(
+                (False, f"unknown task {header.get('name')!r}"))
+        else:
+            try:
+                kwargs = pickle.loads(payload) if payload else {}
+                res = pickle.dumps((True, fn(**kwargs)))
+            except Exception as e:  # raised driver-side
+                try:
+                    # ship the exception itself so the driver can
+                    # classify it (ShuffleFetchFailed -> lineage
+                    # recovery); fall back to repr for exceptions that
+                    # will not round-trip — dumps alone is not enough,
+                    # some exceptions pickle fine but fail to REBUILD
+                    # (custom __init__ signatures)
+                    res = pickle.dumps((False, e))
+                    pickle.loads(res)
+                except Exception:
+                    res = pickle.dumps((False, repr(e)))
+        _send_msg(self.request, {"size": len(res)}, res)
+
 
 class _TCPServer(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
@@ -147,17 +251,23 @@ class BlockServer:
     """In-memory store of serialized shuffle blocks, served over TCP
     (ref RapidsShuffleServer.doHandleTransferRequest:320 — the host-staged
     analog: blocks already live in host memory here). ``tasks`` is the
-    closed name->callable dispatch table for the `task` op."""
+    closed name->callable dispatch table for the `task` op.
+
+    Blocks are held as (bid, crc32c, payload) triples; bid-carrying puts
+    are deduplicated (idempotent map-task re-execution) and served in bid
+    order (deterministic concatenation across re-runs)."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  token: Optional[bytes] = None,
                  tasks: Optional[Dict[str, Callable]] = None):
-        self._blocks: Dict[Tuple[int, int], List[bytes]] = {}
+        self._blocks: Dict[Tuple[int, int],
+                           List[Tuple[Optional[str], int, bytes]]] = {}
         self._lock = threading.Lock()
         self._conns: set = set()
         self._conn_lock = threading.Lock()
         self.token = token
         self.tasks: Dict[str, Callable] = dict(tasks or {})
+        self.crc_rejects = 0       # corrupt puts refused (never stored)
         self._srv = _TCPServer((host, port), _Handler)
         self._srv.owner = self
         self.address = self._srv.server_address
@@ -165,13 +275,39 @@ class BlockServer:
                                         daemon=True)
         self._thread.start()
 
-    def _put(self, shuffle: int, part: int, data: bytes):
+    def _put(self, shuffle: int, part: int, data: bytes,
+             bid: Optional[str] = None, crc: Optional[int] = None):
+        if crc is None:
+            crc = crc32c(data)
         with self._lock:
-            self._blocks.setdefault((shuffle, part), []).append(data)
+            entries = self._blocks.setdefault((shuffle, part), [])
+            if bid is not None and any(b == bid for b, _c, _d in entries):
+                return             # idempotent re-put (task re-execution)
+            entries.append((bid, crc, data))
 
-    def _fetch(self, shuffle: int, part: int) -> List[bytes]:
+    def _fetch_entries(self, shuffle: int,
+                       part: int) -> List[Tuple[Optional[str], int, bytes]]:
         with self._lock:
-            return list(self._blocks.get((shuffle, part), []))
+            entries = list(self._blocks.get((shuffle, part), []))
+        # bid-carrying blocks in bid order (stable across re-execution),
+        # legacy bid-less blocks after them in arrival order
+        keyed = sorted((e for e in entries if e[0] is not None),
+                       key=lambda e: e[0])
+        return keyed + [e for e in entries if e[0] is None]
+
+    def _fetch(self, shuffle: int, part: int,
+               verify: bool = False) -> List[bytes]:
+        """Block payloads; with verify=True each is checked against its
+        stored CRC32C (a local-store read is a fetch too — corruption
+        must never silently reach a reducer)."""
+        out = []
+        for bid, crc, data in self._fetch_entries(shuffle, part):
+            if verify and crc32c(data) != crc:
+                raise ChecksumError(
+                    f"stored block corrupt: shuffle={shuffle} "
+                    f"part={part} bid={bid}")
+            out.append(data)
+        return out
 
     def _drop(self, shuffle: int):
         with self._lock:
@@ -200,69 +336,194 @@ class BlockClient:
     """Connection to one peer's BlockServer (ref RapidsShuffleClient
     doFetch:174). One socket, serial request/response; callers needing
     parallel fetches open one client per thread. Signs every message with
-    the cluster token when one is set."""
+    the cluster token when one is set.
 
-    def __init__(self, address, token: Optional[bytes] = None):
+    ``max_retries``/``backoff_ms`` govern the transient-failure retry
+    loop on put/fetch (exponential backoff + jitter, reconnecting the
+    socket on connection errors); ``timeout`` bounds every socket
+    operation, so a wedged peer surfaces as socket.timeout instead of a
+    hang (the driver's task-timeout knob rides this)."""
+
+    def __init__(self, address, token: Optional[bytes] = None,
+                 timeout: float = 120.0, max_retries: int = 3,
+                 backoff_ms: float = 50.0):
         self.address = tuple(address)
         self.token = token
-        self._sock = socket.create_connection(self.address, timeout=120)
+        self.timeout = timeout
+        self.max_retries = max(0, int(max_retries))
+        self.backoff_ms = backoff_ms
+        self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
+        self.stats = {"put_retries": 0, "fetch_retries": 0,
+                      "crc_failures": 0, "reconnects": 0}
+        self._connect()
 
-    def put(self, shuffle: int, part: int, data: bytes):
-        with self._lock:
-            _send_msg(self._sock, {"op": "put", "shuffle": shuffle,
-                                   "part": part, "size": len(data)}, data,
-                      token=self.token)
-            self._check(_recv_msg(self._sock)[0])
+    # ------------------------------------------------------ socket mgmt
+    def _connect(self):
+        self._sock = socket.create_connection(self.address,
+                                              timeout=self.timeout)
+
+    def _invalidate(self):
+        """Drop a socket whose request/response stream can no longer be
+        trusted (error or timeout mid-exchange)."""
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _ensure(self) -> socket.socket:
+        if self._sock is None:
+            self._connect()
+            self.stats["reconnects"] += 1
+        return self._sock
+
+    def set_timeout(self, timeout: float) -> None:
+        """Rebound the per-operation socket timeout (shutdown paths drop
+        it so a wedged peer cannot stall teardown)."""
+        self.timeout = timeout
+        if self._sock is not None:
+            self._sock.settimeout(timeout)
+
+    def _backoff(self, attempt: int):
+        base = self.backoff_ms / 1000.0
+        time.sleep(base * (2 ** attempt) * (0.5 + random.random()))
+
+    # ------------------------------------------------------------- ops
+    def put(self, shuffle: int, part: int, data: bytes,
+            bid: Optional[str] = None):
+        """Store a block on the peer; CRC-verified on receipt. Retries
+        checksum rejects always; connection failures are retried only
+        for bid-carrying puts (the server dedupes those, so an
+        ack-was-lost replay cannot double-store)."""
+        crc = crc32c(data)
+        header = {"op": "put", "shuffle": shuffle, "part": part,
+                  "size": len(data), "crc": crc}
+        if bid is not None:
+            header["bid"] = bid
+        last: Optional[BaseException] = None
+        for attempt in range(self.max_retries + 1):
+            if attempt:
+                self.stats["put_retries"] += 1
+                self._backoff(attempt - 1)
+            body = data
+            chaos = _chaos()
+            if chaos is not None:    # corrupt AFTER the crc was computed
+                body = chaos.corrupt("put.corrupt", body)
+            try:
+                with self._lock:
+                    sock = self._ensure()
+                    _send_msg(sock, header, body, token=self.token)
+                    self._check(_recv_msg(sock)[0])
+                return
+            except ChecksumError as e:
+                self.stats["crc_failures"] += 1
+                last = e
+            except (ConnectionError, OSError) as e:
+                self._invalidate()
+                last = e
+                if bid is None:
+                    break          # replay without dedup could double-store
+        raise ShuffleFetchFailed(
+            f"put shuffle={shuffle} part={part} to {self.address} failed "
+            f"after {self.max_retries + 1} attempt(s): {last}",
+            shuffle=shuffle, part=part) from last
 
     def fetch(self, shuffle: int, part: int) -> List[bytes]:
-        try:
-            with self._lock:
-                _send_msg(self._sock, {"op": "fetch", "shuffle": shuffle,
-                                       "part": part}, token=self.token)
-                header, body = _recv_msg(self._sock)
-        except (ConnectionError, OSError) as e:
-            raise ShuffleFetchFailed(
-                f"fetch shuffle={shuffle} part={part} from "
-                f"{self.address}: {e}") from e
-        self._check(header)
-        out, off = [], 0
-        for s in header["sizes"]:
-            out.append(body[off:off + s])
-            off += s
-        return out
+        last: Optional[BaseException] = None
+        for attempt in range(self.max_retries + 1):
+            if attempt:
+                self.stats["fetch_retries"] += 1
+                self._backoff(attempt - 1)
+            try:
+                with self._lock:
+                    sock = self._ensure()
+                    _send_msg(sock, {"op": "fetch", "shuffle": shuffle,
+                                     "part": part}, token=self.token)
+                    header, body = _recv_msg(sock)
+                self._check(header)
+                out, off = [], 0
+                for size, crc in zip(header["sizes"],
+                                     header.get("crcs",
+                                                [None] * len(
+                                                    header["sizes"]))):
+                    block = body[off:off + size]
+                    off += size
+                    if crc is not None and crc32c(block) != crc:
+                        raise ChecksumError(
+                            f"fetched block corrupt: shuffle={shuffle} "
+                            f"part={part} from {self.address}")
+                    out.append(block)
+                return out
+            except ChecksumError as e:
+                self.stats["crc_failures"] += 1
+                last = e
+            except (ConnectionError, OSError) as e:
+                self._invalidate()
+                last = e
+        raise ShuffleFetchFailed(
+            f"fetch shuffle={shuffle} part={part} from {self.address} "
+            f"failed after {self.max_retries + 1} attempt(s): {last}",
+            shuffle=shuffle, part=part) from last
 
     def task(self, name: str, **kwargs):
-        """Invoke a REGISTERED task in the peer process; raises on remote
-        failure. Replaces the old arbitrary-callable `call` op."""
+        """Invoke a REGISTERED task in the peer process; raises the
+        remote exception (when picklable) on failure. No transport-level
+        retry: task idempotence and re-dispatch are the scheduler's
+        responsibility (cluster.py)."""
         import pickle
         data = pickle.dumps(kwargs)
-        with self._lock:
-            _send_msg(self._sock, {"op": "task", "name": name,
-                                   "size": len(data)}, data,
-                      token=self.token)
-            header, body = _recv_msg(self._sock)
+        try:
+            with self._lock:
+                sock = self._ensure()
+                _send_msg(sock, {"op": "task", "name": name,
+                                 "size": len(data)}, data,
+                          token=self.token)
+                header, body = _recv_msg(sock)
+        except (ConnectionError, OSError):
+            # timeout or reset mid-exchange: the stream is desynced, a
+            # later reply must never be read as some OTHER call's result
+            self._invalidate()
+            raise
         self._check(header)
         ok, res = pickle.loads(body)
         if not ok:
+            if isinstance(res, ShuffleFetchFailed):
+                raise res          # the lineage-recovery signal, typed
+            if isinstance(res, BaseException):
+                raise RemoteTaskError(
+                    f"remote task {name!r} failed: {res!r}") from res
             raise RuntimeError(f"remote task {name!r} failed: {res}")
         return res
 
     def drop(self, shuffle: int):
-        with self._lock:
-            _send_msg(self._sock, {"op": "drop", "shuffle": shuffle},
-                      token=self.token)
-            self._check(_recv_msg(self._sock)[0])
+        try:
+            with self._lock:
+                sock = self._ensure()
+                _send_msg(sock, {"op": "drop", "shuffle": shuffle},
+                          token=self.token)
+                self._check(_recv_msg(sock)[0])
+        except (ConnectionError, OSError):
+            # same desync rule as task(): a late drop reply must never
+            # be read as the NEXT call's response
+            self._invalidate()
+            raise
 
     @staticmethod
     def _check(header: dict):
         if "error" in header:
+            if header.get("retryable"):
+                raise ChecksumError(header["error"])
             raise ConnectionError(header["error"])
 
     def close(self):
         try:
             with self._lock:
-                _send_msg(self._sock, {"op": "close"}, token=self.token)
-            self._sock.close()
+                if self._sock is not None:
+                    _send_msg(self._sock, {"op": "close"},
+                              token=self.token)
+                    self._sock.close()
+                    self._sock = None
         except OSError:
             pass

@@ -28,12 +28,30 @@ jax.config.update("jax_default_device", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "chaos: deterministic fault-injection tests (seeded "
+        "ChaosController; part of tier-1 — they are NOT slow)")
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 `-m 'not slow'` run")
+
+
 @pytest.fixture(autouse=True)
 def _clear_oom_injections():
     yield
     from spark_rapids_tpu.mem import MemoryManager
     for mm in MemoryManager._instances.values():
         mm.clear_injections()
+
+
+@pytest.fixture(autouse=True)
+def _clear_chaos():
+    """Chaos controllers are process-global (worker arming mirrors the
+    driver); never leak one into the next test."""
+    yield
+    from spark_rapids_tpu.aux.fault import install_chaos
+    install_chaos(None)
 
 
 @pytest.fixture(autouse=True)

@@ -87,6 +87,82 @@ def test_fault_dump_written(tmp_path):
     assert "memory" in info
 
 
+def test_is_device_error_grouping():
+    """XlaRuntimeError is ALWAYS a device error; a bare RuntimeError only
+    with the RESOURCE_EXHAUSTED marker (the `A or B and C` precedence
+    trap — the intended grouping is explicit now)."""
+    from spark_rapids_tpu.aux.fault import _is_device_error
+
+    class FakeXlaRuntimeError(RuntimeError):
+        pass
+    FakeXlaRuntimeError.__name__ = "XlaRuntimeError"
+    assert _is_device_error(FakeXlaRuntimeError("anything at all"))
+    assert _is_device_error(RuntimeError("RESOURCE_EXHAUSTED: oom"))
+    assert not _is_device_error(RuntimeError("some other failure"))
+    assert not _is_device_error(ValueError("RESOURCE_EXHAUSTED"))
+
+
+def test_capture_formats_passed_exception_traceback(tmp_path):
+    """capture() must format the traceback of the exception it was
+    HANDED — format_exc() is empty outside an active except block, which
+    is exactly how the cluster's failure paths call capture."""
+    from spark_rapids_tpu.aux.fault import DeviceDumpHandler
+    from spark_rapids_tpu.config import TpuConf
+    h = DeviceDumpHandler(TpuConf(
+        {"spark.rapids.tpu.coreDump.path": str(tmp_path)}))
+
+    def _raise_with_distinctive_frame():
+        raise RuntimeError("RESOURCE_EXHAUSTED: boom")
+
+    try:
+        _raise_with_distinctive_frame()
+    except RuntimeError as e:
+        captured = e
+    # call OUTSIDE any except block: sys.exc_info() is clear here
+    out = h.capture(captured)
+    info = json.loads(open(out).read())
+    assert "_raise_with_distinctive_frame" in info["traceback"]
+    assert "RESOURCE_EXHAUSTED" in info["error"]
+
+
+def test_chaos_controller_nth_and_always():
+    from spark_rapids_tpu.aux.fault import ChaosController
+    c = ChaosController("fetch.corrupt=2;put.drop=*")
+    assert [c.fires("fetch.corrupt") for _ in range(4)] == \
+        [False, True, False, False]
+    assert [c.fires("put.drop") for _ in range(3)] == [True] * 3
+    assert ("fetch.corrupt", 2) in c.fired()
+
+
+def test_chaos_controller_seeded_prob_is_deterministic():
+    from spark_rapids_tpu.aux.fault import ChaosController
+    runs = []
+    for _ in range(2):
+        c = ChaosController("fetch.delay=p0.5", seed=7)
+        runs.append([c.fires("fetch.delay") for _ in range(32)])
+    assert runs[0] == runs[1]
+    assert any(runs[0]) and not all(runs[0])
+    with_other_seed = ChaosController("fetch.delay=p0.5", seed=8)
+    assert [with_other_seed.fires("fetch.delay") for _ in range(32)] \
+        != runs[0]
+
+
+def test_chaos_controller_rejects_unknown_site():
+    from spark_rapids_tpu.aux.fault import ChaosController
+    with pytest.raises(ValueError, match="unknown chaos site"):
+        ChaosController("rm.rf=1")
+
+
+def test_chaos_corrupt_flips_exactly_when_armed():
+    from spark_rapids_tpu.aux.fault import ChaosController
+    c = ChaosController("put.corrupt=1")
+    data = b"abcdef"
+    first = c.corrupt("put.corrupt", data)
+    second = c.corrupt("put.corrupt", data)
+    assert first != data and len(first) == len(data)
+    assert second == data
+
+
 def test_profiler_query_range_scoping():
     from spark_rapids_tpu.aux.profiler import _parse_ranges
     assert _parse_ranges("0-2,5") == {0, 1, 2, 5}

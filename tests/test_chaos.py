@@ -1,0 +1,259 @@
+"""Deterministic chaos suite for the distributed runtime (ISSUE 3
+tentpole): seeded fault injection (aux/fault.py ChaosController) against
+the 3-worker LocalCluster must leave every query in the battery
+BYTE-IDENTICAL to its fault-free run — corruption is CRC-detected and
+retried, delays ride the backoff machinery, a worker killed mid-map is
+evicted and its partitions recomputed from lineage. The distributed
+analog of the OOM-injection suites (HashAggregateRetrySuite /
+RmmSpark.forceRetryOOM).
+
+Everything here is seeded and `not slow`, so the suite runs in tier-1
+(the `chaos` marker selects it: ``pytest -m chaos``)."""
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from harness import tpu_session
+from spark_rapids_tpu.api import functions as F
+
+pytestmark = [pytest.mark.chaos,
+              pytest.mark.filterwarnings("ignore::ResourceWarning")]
+
+
+def _conf(**extra):
+    from spark_rapids_tpu.config import TpuConf
+    raw = {"spark.rapids.tpu.shuffle.fetch.retryBackoffMs": 20}
+    raw.update(extra)
+    return TpuConf(raw)
+
+
+@pytest.fixture(scope="module")
+def cluster3():
+    from spark_rapids_tpu.shuffle.cluster import LocalCluster
+    cl = LocalCluster(3, shuffle_join_min_rows=1000, conf=_conf())
+    yield cl
+    cl.shutdown()
+
+
+_RNG = np.random.RandomState(42)
+_N = 6000
+_SALES = pa.table({
+    "k": pa.array(_RNG.randint(0, 23, _N)),
+    "g": pa.array(_RNG.choice(["x", "y", "z"], _N)),
+    "v": pa.array(np.round(_RNG.uniform(0, 100, _N), 2)),
+})
+_RIGHT = pa.table({
+    "k2": pa.array(_RNG.randint(0, 23, _N)),
+    "w": pa.array(_RNG.randint(0, 1000, _N)),
+})
+# integer-valued aggregates are partition-count invariant, so results
+# stay exact even after the cluster degrades to fewer workers
+_INT_SALES = pa.table({
+    "k": pa.array(_RNG.randint(0, 23, _N)),
+    "v": pa.array(_RNG.randint(0, 1000, _N)),
+})
+
+
+def _battery(s):
+    """TPC-style coverage of every worker task type: grouped agg
+    (map_agg), shuffled join + agg (join_side + join_local), global sort
+    (map_range + boundary sampling) — all through reduce_agg. The
+    distributed-window path rides the same map_agg/reduce machinery as
+    the grouped agg and is differentially covered in
+    test_multiprocess.py; repeating it here would only re-pay its
+    compile cost against the tier-1 wall budget."""
+    agg = (s.create_dataframe(_SALES).group_by("k", "g")
+           .agg(F.sum(F.col("v")).with_name("sv"),
+                F.count_star().with_name("n"),
+                F.avg(F.col("v")).with_name("av"),
+                F.min(F.col("v")).with_name("mn"),
+                F.max(F.col("v")).with_name("mx")))
+    join = (s.create_dataframe(_SALES)
+            .join(s.create_dataframe(_RIGHT),
+                  on=[(F.col("k"), F.col("k2"))], how="inner")
+            .group_by("k")
+            .agg(F.sum(F.col("v")).with_name("sv"),
+                 F.count_star().with_name("n"),
+                 F.max(F.col("w")).with_name("mw")))
+    sort = (s.create_dataframe(_SALES)
+            .filter(F.col("v") > 5.0)
+            .order_by(F.col("v").asc(), F.col("k").asc()))
+    return [agg, join, sort]
+
+
+def _run_battery(cl):
+    s = tpu_session()
+    return [cl.execute(df) for df in _battery(s)]
+
+
+def _int_agg(s):
+    return (s.create_dataframe(_INT_SALES).group_by("k")
+            .agg(F.sum(F.col("v")).with_name("sv"),
+                 F.count_star().with_name("n"),
+                 F.min(F.col("v")).with_name("mn"),
+                 F.max(F.col("v")).with_name("mx")))
+
+
+# ---------------------------------------------------------------------------
+# the acceptance battery: chaos on == chaos off, byte for byte
+# ---------------------------------------------------------------------------
+
+def test_battery_byte_identical_under_corruption_and_delay(cluster3):
+    """One corrupted block + one delayed block transfer injected at
+    worker-0: every query's result must be byte-identical to the
+    fault-free run — the CRC reject + retry path is invisible to
+    results."""
+    want = _run_battery(cluster3)
+    cluster3.set_chaos("put.corrupt=2;put.delay=1", seed=11,
+                       delay_ms=150, workers=["worker-0"])
+    try:
+        got = _run_battery(cluster3)
+        fired = cluster3.clients["worker-0"].task("chaos_stats")
+    finally:
+        cluster3.set_chaos("")
+    assert ("put.corrupt", 2) in fired, fired
+    assert ("put.delay", 1) in fired, fired
+    # byte-identity IS the acceptance bar: the chaos-on run equals the
+    # fault-free run of the same cluster bit for bit (bid-ordered block
+    # concatenation makes repeat runs deterministic to begin with)
+    for g, w in zip(got, want):
+        assert g.equals(w), "chaos changed query results"
+
+
+# ---------------------------------------------------------------------------
+# worker kill: heartbeat eviction + lineage recomputation
+# ---------------------------------------------------------------------------
+
+def test_worker_killed_mid_map_recovers_from_lineage():
+    from spark_rapids_tpu.shuffle.cluster import LocalCluster
+    cl = LocalCluster(3, shuffle_join_min_rows=1000, conf=_conf(),
+                      stale_after_s=3.0)
+    try:
+        s = tpu_session()
+        want = cl.execute(_int_agg(s))
+        cl.set_chaos("worker.kill=1", kill_target="worker-1")
+        got = cl.execute(_int_agg(s))
+        # identical despite losing a worker mid-map: the dead worker's
+        # partition was remapped and recomputed from recorded lineage
+        assert got.equals(want)
+        assert cl.fault_stats["workers_lost"] == 1
+        assert cl.fault_stats["maps_rerun"] > 0
+        assert "worker-1" in cl._dead
+        assert not cl.procs[1].is_alive()
+        # the killed worker stops heartbeating and is EVICTED from the
+        # live registry (stale_after_s) — dispatch integration reads this
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline \
+                and "worker-1" in cl.manager.live_peers():
+            time.sleep(0.2)
+        assert "worker-1" not in cl.manager.live_peers()
+    finally:
+        cl.shutdown(join_timeout_s=5.0)
+
+
+def test_task_timeout_redispatches_to_live_worker():
+    """A task RPC exceeding spark.rapids.tpu.task.timeout is treated as
+    a lost worker: the task re-dispatches elsewhere and the query
+    completes (ref spark.network.timeout -> executor loss)."""
+    from spark_rapids_tpu.shuffle.cluster import LocalCluster
+    cl = LocalCluster(2, conf=_conf())
+    try:
+        s = tpu_session()
+        want = cl.execute(_int_agg(s))      # warm-up at default timeout
+        # worker-1's next task sleeps 8s > the (post-warm-up) 3s timeout
+        cl.set_task_timeout(3.0)
+        cl.set_chaos("task.delay=1", delay_ms=8000,
+                     workers=["worker-1"])
+        got = cl.execute(_int_agg(s))
+        assert got.equals(want)
+        assert cl.fault_stats["tasks_redispatched"] >= 1
+        assert "worker-1" in cl._dead
+    finally:
+        cl.shutdown(join_timeout_s=5.0)
+
+
+# ---------------------------------------------------------------------------
+# transport-level: corruption is never silent
+# ---------------------------------------------------------------------------
+
+def _tokened_pair(backoff_ms=5):
+    from spark_rapids_tpu.shuffle.transport import BlockClient, BlockServer
+    srv = BlockServer(token=b"t")
+    cli = BlockClient(srv.address, token=b"t", backoff_ms=backoff_ms,
+                      timeout=10)
+    return srv, cli
+
+
+def test_corrupt_fetch_detected_and_retried():
+    from spark_rapids_tpu.aux.fault import ChaosController, install_chaos
+    srv, c = _tokened_pair()
+    try:
+        c.put(1, 0, b"payload-abc", bid="m0")
+        install_chaos(ChaosController("fetch.corrupt=1"))
+        assert c.fetch(1, 0) == [b"payload-abc"]
+        assert c.stats["crc_failures"] == 1
+        assert c.stats["fetch_retries"] >= 1
+    finally:
+        install_chaos(None)
+        c.close()
+        srv.close()
+
+
+def test_persistent_corruption_escalates_not_silently_returned():
+    from spark_rapids_tpu.aux.fault import ChaosController, install_chaos
+    from spark_rapids_tpu.shuffle.transport import ShuffleFetchFailed
+    srv, c = _tokened_pair(backoff_ms=1)
+    try:
+        c.put(2, 0, b"block", bid="m0")
+        install_chaos(ChaosController("fetch.corrupt=*"))
+        with pytest.raises(ShuffleFetchFailed):
+            c.fetch(2, 0)
+    finally:
+        install_chaos(None)
+        c.close()
+        srv.close()
+
+
+def test_corrupt_put_rejected_by_server_then_retried_clean():
+    from spark_rapids_tpu.aux.fault import ChaosController, install_chaos
+    srv, c = _tokened_pair()
+    try:
+        install_chaos(ChaosController("put.corrupt=1"))
+        c.put(3, 0, b"clean-data", bid="m0")
+        assert srv.crc_rejects == 1          # never stored corrupt
+        assert c.fetch(3, 0) == [b"clean-data"]
+    finally:
+        install_chaos(None)
+        c.close()
+        srv.close()
+
+
+def test_dropped_put_retried_and_deduped():
+    from spark_rapids_tpu.aux.fault import ChaosController, install_chaos
+    srv, c = _tokened_pair()
+    try:
+        install_chaos(ChaosController("put.drop=1"))
+        c.put(4, 0, b"x", bid="m0")          # 1st attempt dropped + reset
+        install_chaos(None)
+        assert c.fetch(4, 0) == [b"x"]
+        c.put(4, 0, b"x", bid="m0")          # replay: deduped, not doubled
+        assert c.fetch(4, 0) == [b"x"]
+    finally:
+        install_chaos(None)
+        c.close()
+        srv.close()
+
+
+def test_fetch_returns_bid_blocks_in_bid_order():
+    """Deterministic concatenation is what makes re-executed shuffles
+    byte-identical: arrival order must not leak into fetch order."""
+    srv, c = _tokened_pair()
+    try:
+        c.put(5, 0, b"second", bid="m5.1")
+        c.put(5, 0, b"first", bid="m5.0")
+        assert c.fetch(5, 0) == [b"first", b"second"]
+    finally:
+        c.close()
+        srv.close()

@@ -67,6 +67,34 @@ class TestRetryFramework:
         assert len(seen) == 2  # split in half
         assert sorted(seen) == [50, 50]
 
+    def test_split_batch_closes_everything_on_failure(self):
+        """If the SECOND piece's wrap blows up mid-split, the input and
+        the already-wrapped first piece must both be closed — a
+        half-built split pinning pool budget is a leak the suite-wide
+        zero-leak fixture would flag."""
+        from spark_rapids_tpu.mem.retry import split_batch_in_half
+        mm = _mm()
+        sb = SpillableBatch(_batch(100), mm)
+        # skip piece 1's reserve, fail piece 2's
+        mm.force_retry_oom(1, skip=1)
+        with pytest.raises(RetryOOM):
+            split_batch_in_half(sb)
+        mm.clear_injections()
+        assert sb._closed
+        assert mm.audit_leaks() == []
+
+    def test_split_batch_uses_public_manager_accessor(self):
+        from spark_rapids_tpu.mem.retry import split_batch_in_half
+        mm = _mm()
+        sb = SpillableBatch(_batch(10), mm)
+        assert sb.memory_manager is mm
+        pieces = split_batch_in_half(sb)
+        assert sb._closed
+        assert [p.memory_manager for p in pieces] == [mm, mm]
+        for p in pieces:
+            p.close()
+        assert mm.audit_leaks() == []
+
     def test_injection_skip(self):
         mm = _mm()
         mm.force_retry_oom(1, skip=2)

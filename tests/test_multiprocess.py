@@ -213,6 +213,105 @@ def test_shuffled_left_join_null_keys(cluster4):
     np.testing.assert_array_equal(gk["n"], wk["n"])
 
 
+def test_remote_task_errors_wrapped_not_mistaken_for_dead_worker():
+    """A worker-side OSError (disk full, file IO) must surface as
+    RemoteTaskError, NOT as a raw OSError/ConnectionError — the
+    scheduler's death classifier only trusts genuine socket failures,
+    or a deterministic worker error would get healthy workers declared
+    dead one by one. ShuffleFetchFailed stays verbatim (it IS the
+    recovery signal), and non-round-trippable exceptions degrade to
+    their repr instead of crashing the driver's unpickler."""
+    from spark_rapids_tpu.shuffle.transport import (BlockClient,
+                                                    BlockServer,
+                                                    RemoteTaskError,
+                                                    ShuffleFetchFailed)
+
+    class NoRoundTrip(Exception):
+        def __init__(self, a, b):   # pickles, but cannot rebuild from
+            super().__init__(a)     # its (single-arg) args tuple
+
+    def boom_os():
+        raise OSError(28, "No space left on device")
+
+    def boom_fetch():
+        raise ShuffleFetchFailed("blocks gone", peer="worker-9")
+
+    def boom_weird():
+        raise NoRoundTrip(1, 2)
+
+    srv = BlockServer(token=b"t", tasks={"os": boom_os,
+                                         "fetch": boom_fetch,
+                                         "weird": boom_weird,
+                                         "echo": lambda x: x})
+    c = BlockClient(srv.address, token=b"t", timeout=10)
+    try:
+        with pytest.raises(RemoteTaskError, match="No space left"):
+            c.task("os")
+        with pytest.raises(ShuffleFetchFailed) as ei:
+            c.task("fetch")
+        assert ei.value.peer == "worker-9"
+        with pytest.raises(RuntimeError, match="NoRoundTrip"):
+            c.task("weird")
+        assert c.task("echo", x=7) == 7    # connection survives errors
+    finally:
+        c.close()
+        srv.close()
+
+
+def test_heartbeat_staleness_evicts_and_rereg_recovers():
+    """An executor that stops heartbeating leaves live_peers() after
+    stale_after_s; a fresh heartbeat re-registers it cleanly (ref
+    RapidsShuffleHeartbeatManager eviction, Plugin.scala:428-439)."""
+    import time
+    from spark_rapids_tpu.shuffle.heartbeat import ShuffleHeartbeatManager
+    mgr = ShuffleHeartbeatManager(stale_after_s=0.15)
+    mgr.register("ex-0", {"host": "h0", "port": 1})
+    mgr.register("ex-1", {"host": "h1", "port": 2})
+    assert mgr.live_peers() == ["ex-0", "ex-1"]
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        time.sleep(0.05)
+        mgr.register("ex-0", {"host": "h0", "port": 1})  # ex-0 keeps beating
+        if mgr.live_peers() == ["ex-0"]:
+            break
+    assert mgr.live_peers() == ["ex-0"], "stale ex-1 never evicted"
+    # eviction also reflected in peer_details (dispatch reads this)
+    assert [p["id"] for p in mgr.peer_details()] == ["ex-0"]
+    # a re-registering executor comes back with its new address
+    peers = mgr.register("ex-1", {"host": "h1b", "port": 3})
+    assert {p["id"] for p in peers} == {"ex-0", "ex-1"}
+    assert mgr.live_peers() == ["ex-0", "ex-1"]
+    details = {p["id"]: p["addr"] for p in mgr.peer_details()}
+    assert details["ex-1"] == {"host": "h1b", "port": 3}
+
+
+def test_heartbeat_eviction_of_all_peers_when_silent():
+    from spark_rapids_tpu.shuffle.heartbeat import ShuffleHeartbeatManager
+    mgr = ShuffleHeartbeatManager(stale_after_s=0.05)
+    mgr.register("ex-0", {"host": "h", "port": 1})
+    import time
+    time.sleep(0.2)
+    assert mgr.live_peers() == []
+
+
+def test_shutdown_escalates_to_sigkill_for_stopped_worker():
+    """A SIGSTOPped (wedged) worker must not hang shutdown: join times
+    out, SIGTERM stays pending on a stopped process, and the final
+    SIGKILL is delivered regardless — teardown always completes."""
+    import os
+    import signal
+    import time
+    from spark_rapids_tpu.shuffle.cluster import LocalCluster
+    cl = LocalCluster(1)
+    proc = cl.procs[0]
+    os.kill(proc.pid, signal.SIGSTOP)
+    t0 = time.monotonic()
+    cl.shutdown(join_timeout_s=1.0)
+    elapsed = time.monotonic() - t0
+    assert not proc.is_alive(), "stopped worker survived shutdown"
+    assert elapsed < 30, f"shutdown escalation took {elapsed:.1f}s"
+
+
 def test_fetch_failure_surfaces_cleanly():
     """A dead peer mid-shuffle raises ShuffleFetchFailed, not a hang
     (ref RapidsShuffleIterator transport-error handling)."""
