@@ -499,6 +499,39 @@ def main():
              base_q9_of(store_sales_huge), check_q9),
         ]
 
+    # per-rung trace artifacts (ISSUE 4): one extra TRACED engine run per
+    # finished rung, exported as Chrome-trace JSON so BENCH rounds ship
+    # attribution (where the time went), not just wall clocks. The traced
+    # run is never the timed run — tracing forces transfer boundaries.
+    trace_dir = os.environ.get("SRTPU_BENCH_TRACE_DIR",
+                               os.path.join(os.getcwd(), "bench_traces"))
+    trace_on = os.environ.get("SRTPU_BENCH_TRACE", "1") != "0"
+
+    def capture_trace(name, eng_fn):
+        if not trace_on:
+            return None
+        tpath = os.path.join(trace_dir, f"trace_{name}.json")
+        saved = {k: os.environ.get(k)
+                 for k in ("SPARK_RAPIDS_TPU_TRACE_ENABLED",
+                           "SPARK_RAPIDS_TPU_TRACE_OUTPUT")}
+        try:
+            os.makedirs(trace_dir, exist_ok=True)
+            os.environ["SPARK_RAPIDS_TPU_TRACE_ENABLED"] = "true"
+            os.environ["SPARK_RAPIDS_TPU_TRACE_OUTPUT"] = tpath
+            eng_fn()
+            return tpath
+        except Exception as e:               # noqa: BLE001 - best effort
+            log(f"bench: {name} trace capture failed: {e}")
+            return None
+        finally:
+            for k, v in saved.items():       # restore, don't clobber
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+            from spark_rapids_tpu.trace import install_tracer
+            install_tracer(None)   # drop the buffer between rungs
+
     details = {}
     skipped = []
     failed = []
@@ -542,8 +575,9 @@ def main():
             "rows_per_sec": round(rows / eng_s, 1),
             "warm_s": round(warm, 1), "checked": True,
         }
-        # emit the metric line NOW — a later failure or timeout must
-        # never discard a finished workload's result
+        # emit the metric line NOW — a later failure or timeout (even a
+        # wedged best-effort trace run below) must never discard a
+        # finished workload's result
         print(json.dumps({"metric": name + "_speedup", "value": speedup,
                           "unit": "x_vs_pandas", "vs_baseline": speedup,
                           "platform": jax.devices()[0].platform}),
@@ -551,6 +585,7 @@ def main():
         log(f"bench: {name:18s} engine {eng_s:7.3f}s [{placement:6s}] "
             f"pandas {base_s:7.3f}s -> {speedup:5.2f}x "
             f"(warm-up {warm:.1f}s, checked)")
+        details[name]["trace"] = capture_trace(name, eng_fn)
 
     # ---------------- distributed rung (subprocess) ----------------
     dist = None

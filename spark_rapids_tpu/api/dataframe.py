@@ -682,8 +682,11 @@ class DataFrame:
         from ..aux.lore import lore_wrap
         from ..aux.metrics import TaskMetrics
         from ..columnar.batch import SpeculativeOverflow
+        from ..trace import core as trace_core
         physical = lore_wrap(physical, self.session.conf)
         ctx = self.session.exec_context()
+        tracer = trace_core.ensure_tracer_from_conf(ctx.conf)
+        t0q = tracer.now() if tracer is not None else 0
         side_effects = isinstance(self.plan, L.WriteFile)
         ctx.speculations.clear()
         ctx.speculate = (ctx.conf.join_speculative_sizing
@@ -712,6 +715,24 @@ class DataFrame:
         finally:
             prof.maybe_stop()
             self.session.last_query_metrics = tm.finish()
+            if tracer is not None:
+                # the whole-query span wraps the existing TaskMetrics
+                # capture: one umbrella every operator span nests under
+                tracer.complete("query", t0q, cat="query",
+                                args={"ok": ok})
+                out_path = str(ctx.conf.get(trace_core.TRACE_OUTPUT))
+                if out_path:
+                    from ..trace.export import write_chrome_trace
+                    try:
+                        write_chrome_trace(out_path, tracer)
+                    except Exception as e:  # noqa: BLE001
+                        # tracing must never fail a query — but a
+                        # silently missing artifact after paying the
+                        # recording overhead must at least be loud
+                        import logging
+                        logging.getLogger(__name__).warning(
+                            "could not write trace to %s: %s",
+                            out_path, e)
             if ok and not side_effects:
                 # measured whole-query wall per (shape, engine placement):
                 # the cost optimizer prefers these over its model, so a

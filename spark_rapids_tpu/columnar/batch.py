@@ -302,13 +302,13 @@ class ColumnarBatch:
             # decoded by one fused kernel after the transfer — H2D bytes
             # drop 4-16x on TPC-shaped data (columnar/transfer.py).
             from .transfer import (decode_with_len, encode_columns,
-                                   worthwhile)
+                                   traced_device_put, worthwhile)
             pairs = [(host_pairs[2 * k], host_pairs[2 * k + 1])
                      for k in range(len(staged))]
             flat, specs, enc_params, ratio, raw_bytes = \
                 encode_columns(pairs)
             if worthwhile(ratio, raw_bytes):
-                put = jax.device_put(flat)
+                put = traced_device_put(flat, label="h2d.encoded")
                 decoded = decode_with_len(put, specs, enc_params, p)
                 for k, (i, dt, dictionary, mirror) in enumerate(staged):
                     d, v = decoded[k]
@@ -319,7 +319,7 @@ class ColumnarBatch:
                         cols[i] = DictColumn(d, v, dt, dictionary,
                                              host_mirror=mirror)
             else:
-                put = jax.device_put(host_pairs)
+                put = traced_device_put(host_pairs, label="h2d.raw")
                 for k, (i, dt, dictionary, mirror) in enumerate(staged):
                     if dictionary is None:
                         cols[i] = DeviceColumn(put[2 * k],
@@ -334,7 +334,9 @@ class ColumnarBatch:
             flat = []
             for _i, _dt, (vals, ev, lens, rv, _w), _m in list_staged:
                 flat.extend((vals, ev, lens, rv))
-            put = jax.device_put(flat)   # one transfer for all rectangles
+            from .transfer import traced_device_put
+            # one transfer for all rectangles
+            put = traced_device_put(flat, label="h2d.list")
             for k, (i, dt, enc, mirror) in enumerate(list_staged):
                 cols[i] = ListColumn(put[4 * k], put[4 * k + 3], dt,
                                      put[4 * k + 1], put[4 * k + 2],
@@ -344,7 +346,9 @@ class ColumnarBatch:
             flat = []
             for _i, (rectd, lens, rv, _a), _m in rect_staged:
                 flat.extend((rectd, lens, rv))
-            put = jax.device_put(flat)   # one transfer for all rectangles
+            from .transfer import traced_device_put
+            # one transfer for all rectangles
+            put = traced_device_put(flat, label="h2d.strrect")
             for k, (i, enc, mirror) in enumerate(rect_staged):
                 cols[i] = ByteRectColumn(put[3 * k], put[3 * k + 2],
                                          put[3 * k + 1],

@@ -109,7 +109,17 @@ def unpack_streams(u32, f64, specs):
 def fetch_packed(arrays):
     """Fetch a list of device arrays in at most two transfers; returns
     numpy arrays with the original dtypes/shapes."""
+    from ..trace import core as trace_core
     flat = list(arrays)
     specs = [(np.dtype(a.dtype), tuple(a.shape)) for a in flat]
-    u32, f64 = jax.device_get(_pack(tuple(flat)))
+    tr = trace_core.TRACER           # single branch when tracing is off
+    if tr is None:
+        u32, f64 = jax.device_get(_pack(tuple(flat)))
+        return unpack_streams(u32, f64, specs)
+    from .transfer import trace_fetch
+    t0 = tr.now()
+    packed = _pack(tuple(flat))      # pack-kernel dispatch (async)
+    t1 = tr.now()
+    u32, f64 = jax.device_get(packed)
+    trace_fetch(t0, t1, int(u32.nbytes + f64.nbytes))
     return unpack_streams(u32, f64, specs)

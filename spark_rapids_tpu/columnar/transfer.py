@@ -25,7 +25,10 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
-__all__ = ["encode_columns", "decode_with_len", "worthwhile", "RAW"]
+from ..trace import core as trace_core
+
+__all__ = ["encode_columns", "decode_with_len", "worthwhile", "RAW",
+           "traced_device_put"]
 
 RAW = ("raw",)
 
@@ -200,3 +203,50 @@ def decode_with_len(dev_arrays, specs, params, padded_len: int):
     import jax.numpy as jnp
     return _decode_kernel(specs, padded_len)(
         tuple(dev_arrays), tuple(jnp.asarray(p) for p in params))
+
+
+# ---------------------------------------------------------------------------
+# traced transfers (trace/core.py): H2D/D2H time + bytes attribution
+# ---------------------------------------------------------------------------
+
+def traced_device_put(host_arrays, label: str = "h2d"):
+    """``jax.device_put`` with H2D attribution when tracing is on: the
+    DISPATCH span (host-side enqueue, what the query thread pays even
+    asynchronously) is recorded separately from the DEVICE span (the
+    block_until_ready wait covering the actual tunnel transfer), so the
+    profile can split host time from device/transfer time. When tracing
+    is off this is exactly one branch around a plain device_put."""
+    import jax
+    tr = trace_core.TRACER
+    if tr is None:
+        return jax.device_put(host_arrays)
+    nbytes = sum(getattr(a, "nbytes", 0) for a in host_arrays)
+    t0 = tr.now()
+    out = jax.device_put(host_arrays)
+    t1 = tr.now()
+    tr.complete(f"{label}.dispatch", t0, t1, cat="transfer",
+                args={"bytes": nbytes, "arrays": len(host_arrays)})
+    # the wait is only forced while TRACING: attribution requires the
+    # transfer boundary, and an async put would bill it to whichever
+    # kernel happens to touch the arrays first
+    jax.block_until_ready(out)
+    tr.complete(f"{label}.device", t1, cat="transfer",
+                args={"bytes": nbytes})
+    tr.counter("h2d.bytes", {"bytes": nbytes}, cat="transfer")
+    return out
+
+
+def trace_fetch(t0_ns: int, t1_ns: int, nbytes: int,
+                label: str = "d2h") -> None:
+    """Record a device->host fetch that already happened: dispatch span
+    ``t0..t1`` (building/enqueueing the pack kernel) and transfer span
+    ``t1..now`` (the blocking device_get). Callers guard on the tracer
+    themselves so the disabled path stays a single branch."""
+    tr = trace_core.TRACER
+    if tr is None:
+        return
+    tr.complete(f"{label}.dispatch", t0_ns, t1_ns, cat="transfer",
+                args={"bytes": nbytes})
+    tr.complete(f"{label}.transfer", t1_ns, cat="transfer",
+                args={"bytes": nbytes})
+    tr.counter("d2h.bytes", {"bytes": nbytes}, cat="transfer")

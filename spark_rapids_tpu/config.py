@@ -113,9 +113,10 @@ AGG_WIDE_BATCH_ROWS = register(
     "risk, and their steady-state cost is per-dispatch latency, so the "
     "scan feeds the widest batches possible — one batch means the whole "
     "query runs as ONE fused kernel dispatch + one fetch (ref "
-    "GpuAggregateExec.scala:718 first-pass concatenation). 0 = "
-    "unlimited (whole partition; the OOM retry-split machinery still "
-    "bounds memory); set a row count to cap batch width instead.")
+    "GpuAggregateExec.scala:718 first-pass concatenation). 0 = auto: "
+    "widen up to the whole partition ONLY while the estimated batch "
+    "bytes fit half the HBM budget (the OOM retry-split machinery "
+    "remains the backstop); set a row count to pin the ceiling instead.")
 
 AUTO_BROADCAST_THRESHOLD = register(
     "spark.rapids.tpu.sql.autoBroadcastJoinThreshold", 10 * 1024 * 1024,

@@ -12,6 +12,7 @@ from typing import Dict, Iterator, List, Optional
 
 from ..columnar import ColumnarBatch
 from ..config import TpuConf
+from ..trace import core as trace_core
 from ..types import Schema
 
 __all__ = ["ExecContext", "TpuExec", "Metric", "ESSENTIAL", "MODERATE",
@@ -47,6 +48,9 @@ class ExecContext:
         from ..mem.semaphore import DeviceSemaphore
         from ..mem.manager import MemoryManager
         self.conf = conf or TpuConf()
+        # one conf lookup per query context, never per event: installs
+        # the process tracer iff spark.rapids.tpu.trace.enabled
+        trace_core.ensure_tracer_from_conf(self.conf)
         self.semaphore = semaphore or DeviceSemaphore(
             self.conf.concurrent_tpu_tasks)
         self.memory = memory or MemoryManager.get(self.conf)
@@ -141,9 +145,28 @@ class TpuExec:
         it = self.do_execute(ctx)
         m.add(time.perf_counter() - t0)
         sig = getattr(self, "plan_sig", None)
-        if sig is None:
-            return it
-        return self._record_rows(it, sig)
+        if sig is not None:
+            it = self._record_rows(it, sig)
+        tr = trace_core.TRACER       # single branch when tracing is off
+        if tr is not None:
+            it = self._traced_iter(it, tr)
+        return it
+
+    def _traced_iter(self, it, tr):
+        """One span per produced batch, named after the operator. Child
+        operators' spans nest inside (the contextvar parent chain), so
+        the profile analyzer can compute SELF time — where a query's
+        wall actually goes, not just cumulative subtree time."""
+        name = type(self).__name__
+        args = {"exec": self._exec_id}
+        it = iter(it)
+        while True:
+            with tr.span(name, cat="exec", args=args):
+                try:
+                    b = next(it)
+                except StopIteration:
+                    return
+            yield b
 
     @staticmethod
     def _record_rows(it, sig):

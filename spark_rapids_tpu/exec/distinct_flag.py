@@ -320,14 +320,22 @@ class CpuDistinctFlagExec(TpuExec):
                 f"value={self.value_expr.name_hint}]")
 
     def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
-        """Vectorized: in-batch first occurrences via pandas
-        duplicated() (NaN == NaN there, matching SQL distinct), then
-        O(distinct) set work against the cross-batch seen set — the
-        host twin must stay within pandas speed or the engine
-        arbitration mis-prices the host route."""
+        """Vectorized: every column is normalized to an int64 lane
+        (floats -> canonical bit patterns with -0.0 -> +0.0 and one NaN,
+        matching SQL distinct; objects -> first-seen dictionary codes;
+        null masks ride as extra lanes), in-batch first occurrences come
+        from pandas duplicated() over the int64 frame, and the
+        cross-batch seen set stores PACKED BYTES of each normalized row
+        (ADVICE r5) — one compact ~8*lanes-byte key per distinct row
+        instead of a per-row python tuple of boxed objects. The host
+        twin must stay within pandas speed or the engine arbitration
+        mis-prices the host route."""
         import pandas as pd
         import pyarrow as pa
         seen = set()
+        #: object value -> stable int64 code, assigned at first sight;
+        #: persists across batches so packed keys stay comparable
+        obj_codes: dict = {}
         for batch in self.children[0].execute(ctx):
             t = batch.to_arrow()
             n = t.num_rows
@@ -337,14 +345,11 @@ class CpuDistinctFlagExec(TpuExec):
                 if isinstance(a, pa.ChunkedArray):
                     a = a.combine_chunks()
                 arrs.append(a)
-            cols = {}
-            for i, a in enumerate(arrs):
+            lanes = []
+            for a in arrs:
                 # EXACT normalized representation (to_pandas would turn
-                # int64-with-nulls into lossy float64, and raw NaN
-                # tuples break cross-batch set membership — nan != nan):
-                # floats become canonical int64 BIT patterns (-0.0 ->
-                # +0.0, one NaN), ints stay ints, anything else keeps
-                # its exact python objects
+                # int64-with-nulls into lossy float64, and raw NaN keys
+                # break cross-batch set membership — nan != nan)
                 from ..exprs.arithmetic import arrow_to_masked_numpy
                 try:
                     v, _ok = arrow_to_masked_numpy(a)
@@ -354,27 +359,34 @@ class CpuDistinctFlagExec(TpuExec):
                 if v.dtype.kind == "f":
                     f = v.astype(np.float64) + 0.0
                     f = np.where(np.isnan(f), np.nan, f)
-                    cols[f"c{i}"] = f.view(np.int64)
+                    lanes.append(f.view(np.int64))
                 elif v.dtype.kind in "biu":
-                    cols[f"c{i}"] = v.astype(np.int64)
+                    lanes.append(v.astype(np.int64))
                 elif v.dtype.kind in "mM":
-                    cols[f"c{i}"] = v.view(np.int64)
+                    lanes.append(v.view(np.int64))
                 else:
-                    cols[f"c{i}"] = pd.Series(v, dtype=object)
+                    lanes.append(np.fromiter(
+                        (obj_codes.setdefault(x, len(obj_codes))
+                         for x in v),
+                        dtype=np.int64, count=len(v)))
                 # pandas conflates None/NaN for floats; SQL must not
                 # (NULL ignored, NaN counts) — key the null mask in
-                cols[f"n{i}"] = np.asarray(a.is_null())
-            df = pd.DataFrame(cols)
+                lanes.append(np.asarray(a.is_null()).astype(np.int64))
+            # C-contiguous (n, lanes) matrix: row j's packed key is its
+            # raw bytes — fixed width, hashable, no boxing
+            M = (np.column_stack(lanes) if lanes
+                 else np.zeros((n, 0), np.int64))
             valid = ~np.asarray(arrs[-1].is_null())
             flags = np.zeros(n, np.bool_)
-            first = (~df.duplicated()).to_numpy() & valid
+            first = (~pd.DataFrame(M).duplicated()).to_numpy() & valid
             idx = np.nonzero(first)[0]
             if len(idx):
-                tuples = list(map(tuple, df.iloc[idx]
-                                  .itertuples(index=False)))
-                fresh = [j for j, tup in zip(idx, tuples)
-                         if tup not in seen]
-                seen.update(tuples)
+                fresh = []
+                for j in idx:
+                    key = M[j].tobytes()
+                    if key not in seen:
+                        seen.add(key)
+                        fresh.append(j)
                 flags[np.asarray(fresh, np.int64)] = True
             t = t.append_column(self.flag_name, pa.array(flags))
             out = ColumnarBatch.from_arrow_host(t)

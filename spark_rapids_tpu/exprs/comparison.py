@@ -311,10 +311,17 @@ class In(Expression):
     def eval_device(self, ctx):
         c = self.children[0].eval_device(ctx)
         out = jnp.zeros(ctx.padded_len, dtype=jnp.bool_)
+        fl = jnp.issubdtype(c.data.dtype, jnp.floating)
         for v in self.values:
             if v is None:
                 continue
-            out = jnp.logical_or(out, c.data == v)
+            if fl and isinstance(v, (int, float, np.floating, np.integer)):
+                # same NaN-eq semantics as EqualTo (ADVICE r5): Spark's
+                # double('NaN') IN (NaN) is true — a bare == would miss it
+                out = jnp.logical_or(
+                    out, _nan_eq(c.data, jnp.asarray(v, c.data.dtype)))
+            else:
+                out = jnp.logical_or(out, c.data == v)
         valid = c.validity
         if any(v is None for v in self.values):
             # SQL three-valued IN: x IN (..., NULL) is NULL unless a
@@ -326,9 +333,16 @@ class In(Expression):
         import pyarrow as pa
         import pyarrow.compute as pc
         arr = self.children[0].eval_host(batch)
-        vals = pa.array([v for v in self.values if v is not None],
+        nan_listed = any(isinstance(v, float) and np.isnan(v)
+                        for v in self.values)
+        vals = pa.array([v for v in self.values if v is not None
+                         and not (isinstance(v, float) and np.isnan(v))],
                         type=arr.type)
         res = pc.is_in(arr, value_set=vals)
+        if nan_listed and pa.types.is_floating(arr.type):
+            # Spark NaN semantics (as EqualTo/_nan_eq): NaN IN (NaN) is
+            # true; arrow's is_in must not decide NaN membership
+            res = pc.or_(res, pc.is_nan(arr))
         # Spark: null IN (...) -> NULL (pc.is_in yields false for nulls)
         out = pc.if_else(pc.is_valid(arr), res,
                          pa.nulls(len(arr), pa.bool_()))

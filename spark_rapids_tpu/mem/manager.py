@@ -22,6 +22,7 @@ from typing import Dict, List, Optional
 
 from ..config import (ALLOC_FRACTION, HBM_LIMIT_BYTES, HOST_SPILL_LIMIT,
                       SPILL_DIR, TpuConf)
+from ..trace import core as trace_core
 
 __all__ = ["MemoryManager", "RetryOOM", "SplitAndRetryOOM", "OutOfDeviceMemory"]
 
@@ -149,6 +150,7 @@ class MemoryManager:
         if self._native is not None:
             rc = self._native.reserve(nbytes, block_ms=0)
             if rc == 0:
+                self._trace_alloc(nbytes)
                 return
             if rc == 2:
                 raise SplitAndRetryOOM(
@@ -159,6 +161,7 @@ class MemoryManager:
                 # brief native block/wake window lets concurrent releases in
                 rc = self._native.reserve(nbytes, block_ms=20)
                 if rc == 0:
+                    self._trace_alloc(nbytes)
                     return
             raise RetryOOM(f"native: could not reserve {nbytes} "
                            f"(used={self.device_used}, budget={self.budget})")
@@ -168,6 +171,7 @@ class MemoryManager:
                 self._py_device_used += nbytes
                 self._py_max_device_used = max(self._py_max_device_used,
                                                self._py_device_used)
+                self._trace_alloc(nbytes)
                 return
         if allow_spill:
             self.spill_device(nbytes - (self.budget - self._py_device_used))
@@ -176,12 +180,19 @@ class MemoryManager:
                     self._py_device_used += nbytes
                     self._py_max_device_used = max(self._py_max_device_used,
                                                    self._py_device_used)
+                    self._trace_alloc(nbytes)
                     return
         if nbytes > self.budget:
             raise SplitAndRetryOOM(
                 f"allocation of {nbytes} exceeds whole budget {self.budget}")
         raise RetryOOM(f"could not reserve {nbytes} "
                        f"(used={self.device_used}, budget={self.budget})")
+
+    def _trace_alloc(self, nbytes: int) -> None:
+        tr = trace_core.TRACER       # single branch when tracing is off
+        if tr is not None:
+            tr.counter("mem.device_used", {"bytes": self.device_used,
+                                           "alloc": nbytes}, cat="mem")
 
     def release(self, nbytes: int):
         if self.debug_log:
@@ -205,6 +216,8 @@ class MemoryManager:
     def spill_device(self, need_bytes: int) -> int:
         """Synchronously spill device-tier spillables in priority order until
         need_bytes freed (ref RapidsBufferStore.synchronousSpill)."""
+        tr = trace_core.TRACER
+        t0 = tr.now() if tr is not None else 0
         with self._lock:
             candidates = sorted(
                 (s for s in self._spillables.values()
@@ -215,6 +228,13 @@ class MemoryManager:
             if freed >= need_bytes:
                 break
             freed += s.spill_to_host()
+        if tr is not None and (need_bytes > 0 or freed > 0):
+            # the retry loop's spill_device(0) nudge is a no-op here
+            # (freed >= 0 breaks immediately) — a span for it would
+            # count phantom spills in the profiler
+            tr.complete("spill.device", t0, cat="mem",
+                        args={"need_bytes": need_bytes,
+                              "freed_bytes": freed})
         # host pressure cascades to disk
         with self._lock:
             over = self.host_used - self.host_limit
@@ -223,6 +243,8 @@ class MemoryManager:
         return freed
 
     def spill_host(self, need_bytes: int) -> int:
+        tr = trace_core.TRACER
+        t0 = tr.now() if tr is not None else 0
         with self._lock:
             candidates = sorted(
                 (s for s in self._spillables.values() if s.tier == "host"),
@@ -232,6 +254,10 @@ class MemoryManager:
             if freed >= need_bytes:
                 break
             freed += s.spill_to_disk()
+        if tr is not None and (need_bytes > 0 or freed > 0):
+            tr.complete("spill.host", t0, cat="mem",
+                        args={"need_bytes": need_bytes,
+                              "freed_bytes": freed})
         return freed
 
     # -------------------------------------------------------- fault injection

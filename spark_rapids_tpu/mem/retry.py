@@ -17,6 +17,7 @@ from __future__ import annotations
 import time
 from typing import Callable, Iterator, List, Optional, TypeVar
 
+from ..trace import core as trace_core
 from .manager import (MemoryManager, OutOfDeviceMemory, RetryOOM,
                       SplitAndRetryOOM)
 from .spillable import SpillableBatch
@@ -34,6 +35,12 @@ class RetryStats:
         self.splits = 0
 
 
+def _trace_oom(kind: str, attempt: int) -> None:
+    tr = trace_core.TRACER           # single branch when tracing is off
+    if tr is not None:
+        tr.instant(kind, cat="mem", args={"attempt": attempt})
+
+
 def with_retry_no_split(fn: Callable[[], T], mm: Optional[MemoryManager] = None,
                         stats: Optional[RetryStats] = None) -> T:
     """Run fn; on RetryOOM spill+retry; SplitAndRetryOOM is fatal here
@@ -46,6 +53,7 @@ def with_retry_no_split(fn: Callable[[], T], mm: Optional[MemoryManager] = None,
         except RetryOOM as e:
             last = e
             stats and setattr(stats, "retries", stats.retries + 1)
+            _trace_oom("oom.retry", attempt)
             mm.spill_device(0)
             time.sleep(0)  # yield so other tasks can release
         except SplitAndRetryOOM as e:
@@ -102,11 +110,13 @@ def with_retry(inputs: List[SpillableBatch],
                 except RetryOOM:
                     attempts += 1
                     stats and setattr(stats, "retries", stats.retries + 1)
+                    _trace_oom("oom.retry", attempts)
                     if attempts > MAX_RETRIES:
                         raise OutOfDeviceMemory("retry limit exceeded")
                     mm.spill_device(0)
                 except SplitAndRetryOOM:
                     stats and setattr(stats, "splits", stats.splits + 1)
+                    _trace_oom("oom.split", attempts)
                     pieces = splitter(item)
                     # process pieces in order before the rest of the queue
                     queue = pieces + queue

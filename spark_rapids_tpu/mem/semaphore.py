@@ -10,6 +10,8 @@ import threading
 import time
 from contextlib import contextmanager
 
+from ..trace import core as trace_core
+
 __all__ = ["DeviceSemaphore"]
 
 
@@ -31,14 +33,25 @@ class DeviceSemaphore:
         if getattr(self._held, "count", 0) > 0:
             self._held.count += 1  # reentrant per task thread
             return
+        tr = trace_core.TRACER
+        t0n = tr.now() if tr is not None else 0
         t0 = time.perf_counter()
         if not self._sem.acquire(timeout=self._timeout):
+            if tr is not None:
+                # the timed-out wait is the WORST contention case — the
+                # profiler must see it, not just successful acquires
+                tr.complete("semaphore.wait", t0n, cat="sem",
+                            args={"permits": self._permits,
+                                  "timeout": True})
             raise TimeoutError(
                 f"device semaphore not acquired within {self._timeout}s")
         wait = time.perf_counter() - t0
         with self._lock:
             self.total_wait_s += wait
             self.acquires += 1
+        if tr is not None:
+            tr.complete("semaphore.wait", t0n, cat="sem",
+                        args={"permits": self._permits})
         self._held.count = 1
 
     def release(self):

@@ -342,8 +342,38 @@ class AggregateMeta(PlanMeta):
             node = node.children[0]
         if isinstance(node, B.InMemoryScanExec):
             if wide <= 0:
-                wide = max((t.num_rows for t in node.tables), default=0)
+                # auto ceiling (ADVICE r5): "whole partition" is only
+                # safe while the batch plausibly fits device memory —
+                # gate the widening on estimated bytes against half the
+                # HBM budget instead of widening unconditionally and
+                # leaning on OOM retry/split churn to survive it
+                total = max((t.num_rows for t in node.tables), default=0)
+                wide = min(total, self._wide_batch_row_cap(node))
             node.batch_rows = max(node.batch_rows, wide, 1)
+
+    def _wide_batch_row_cap(self, scan) -> int:
+        """Estimated-byte gate for scan widening: rows such that one
+        batch of this scan's schema stays within HALF the device budget.
+        Per-row bytes are the LARGER of the schema estimate (fixed-width
+        lanes + validity) and the scan's actual Arrow bytes per row, so
+        variable-width columns (strings: dict codes or byte rectangles
+        on device) are costed from their real data, not a flat guess."""
+        import numpy as np
+        from ..mem.manager import MemoryManager
+        row_bytes = 0
+        for f in scan.output_schema():
+            np_dt = getattr(f.dtype, "np_dtype", None)
+            row_bytes += (np.dtype(np_dt).itemsize if np_dt is not None
+                          else 16) + 1     # +1: validity lane
+        total_rows = sum(t.num_rows for t in scan.tables)
+        if total_rows:
+            cols = scan.columns
+            data_bytes = sum(
+                (t.select(cols) if cols is not None else t).nbytes
+                for t in scan.tables)
+            row_bytes = max(row_bytes, -(-data_bytes // total_rows))
+        budget = MemoryManager.get(self.conf).budget
+        return max(1, (budget // 2) // max(1, row_bytes))
 
     def _fold_stages(self, child):
         """Fold a chain of device-only Filter/Project execs below the

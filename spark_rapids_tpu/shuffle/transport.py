@@ -60,6 +60,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..trace import core as trace_core
 from .checksum import ChecksumError, crc32c
 
 __all__ = ["BlockServer", "BlockClient", "ShuffleFetchFailed",
@@ -402,6 +403,8 @@ class BlockClient:
                   "size": len(data), "crc": crc}
         if bid is not None:
             header["bid"] = bid
+        tr = trace_core.TRACER       # single branch when tracing is off
+        t0 = tr.now() if tr is not None else 0
         last: Optional[BaseException] = None
         for attempt in range(self.max_retries + 1):
             if attempt:
@@ -416,9 +419,21 @@ class BlockClient:
                     sock = self._ensure()
                     _send_msg(sock, header, body, token=self.token)
                     self._check(_recv_msg(sock)[0])
+                if tr is not None:
+                    # bid rides along so the profiler can dedupe re-puts
+                    # of the same block (re-executed map tasks) exactly
+                    # like the receiving store does
+                    tr.complete("shuffle.put", t0, cat="shuffle",
+                                args={"shuffle": shuffle, "part": part,
+                                      "bytes": len(data),
+                                      "retries": attempt, "bid": bid})
                 return
             except ChecksumError as e:
                 self.stats["crc_failures"] += 1
+                if tr is not None:
+                    tr.instant("shuffle.crc_reject", cat="shuffle",
+                               args={"shuffle": shuffle, "part": part,
+                                     "op": "put"})
                 last = e
             except (ConnectionError, OSError) as e:
                 self._invalidate()
@@ -431,6 +446,8 @@ class BlockClient:
             shuffle=shuffle, part=part) from last
 
     def fetch(self, shuffle: int, part: int) -> List[bytes]:
+        tr = trace_core.TRACER       # single branch when tracing is off
+        t0 = tr.now() if tr is not None else 0
         last: Optional[BaseException] = None
         for attempt in range(self.max_retries + 1):
             if attempt:
@@ -455,9 +472,19 @@ class BlockClient:
                             f"fetched block corrupt: shuffle={shuffle} "
                             f"part={part} from {self.address}")
                     out.append(block)
+                if tr is not None:
+                    tr.complete("shuffle.fetch", t0, cat="shuffle",
+                                args={"shuffle": shuffle, "part": part,
+                                      "bytes": len(body),
+                                      "blocks": len(out),
+                                      "retries": attempt})
                 return out
             except ChecksumError as e:
                 self.stats["crc_failures"] += 1
+                if tr is not None:
+                    tr.instant("shuffle.crc_reject", cat="shuffle",
+                               args={"shuffle": shuffle, "part": part,
+                                     "op": "fetch"})
                 last = e
             except (ConnectionError, OSError) as e:
                 self._invalidate()

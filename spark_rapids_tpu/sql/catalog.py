@@ -103,6 +103,49 @@ class Catalog:
                 self._store(db, meta)
         return guard()
 
+    # ---------------------------------------------------- staging GC
+    @staticmethod
+    def _proc_start(pid: int):
+        """Kernel start time of a pid (/proc/<pid>/stat field 22, clock
+        ticks since boot; parsed after the last ')' because comm may
+        contain anything), or None when unreadable. Recorded alongside
+        the writer pid so an UNRELATED process that recycled the pid is
+        never mistaken for the live CTAS writer."""
+        try:
+            with open(f"/proc/{pid}/stat") as f:
+                return int(f.read().rsplit(")", 1)[1].split()[19])
+        except (OSError, ValueError, IndexError):
+            return None
+
+    @classmethod
+    def _staging_stale(cls, ent: Dict) -> bool:
+        """A ``staging: true`` entry is the reserve->write->commit window
+        of a CTAS (create_table). If the writing process died (SIGKILL
+        between reserve and finalize) the entry is an orphan that would
+        block its table name FOREVER — detect that by pid liveness plus
+        the recorded process start time (pid-reuse guard; the metastore
+        is same-host by design, module docstring) and treat the entry as
+        absent/reclaimable (ADVICE r5)."""
+        if not ent.get("staging"):
+            return False
+        pid = ent.get("staging_pid")
+        try:
+            pid = int(pid)
+        except (TypeError, ValueError):
+            return True      # writer unknown: nothing to wait for
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            return True      # writer died mid-CTAS: orphan
+        except PermissionError:
+            pass             # exists (different user): check start time
+        want = ent.get("staging_pid_start")
+        if want is not None:
+            got = cls._proc_start(pid)
+            if got is not None and got != want:
+                return True  # pid recycled by an unrelated process
+        return False         # writer alive: CTAS in flight
+
     # -------------------------------------------------------- databases
     def create_database(self, db: str, exist_ok: bool = True) -> None:
         db = db.lower()
@@ -172,12 +215,22 @@ class Catalog:
         # finalize under the lock again (the reference's StagedTable
         # create -> write -> commit shape, GpuDeltaCatalogBase.scala)
         with self._mutate(db) as meta:
-            if tbl in meta["tables"]:
+            existing = meta["tables"].get(tbl)
+            if existing is not None and not self._staging_stale(existing):
+                if existing.get("staging"):
+                    # a LIVE writer holds the name; there is no data to
+                    # read yet, so IF NOT EXISTS cannot return a table
+                    raise TableExistsError(
+                        f"table {db}.{tbl} is being created by pid "
+                        f"{existing.get('staging_pid')}")
                 if if_not_exists:
                     return self.table(name)
                 raise TableExistsError(
                     f"table {db}.{tbl} already exists")
-            meta["tables"][tbl] = {**entry, "staging": True}
+            # absent, or a stale orphaned staging entry — reclaim it
+            meta["tables"][tbl] = {
+                **entry, "staging": True, "staging_pid": os.getpid(),
+                "staging_pid_start": self._proc_start(os.getpid())}
         try:
             if fmt == "delta":
                 df.write_delta(entry["path"], partition_by=partition_by)
@@ -209,12 +262,13 @@ class Catalog:
     def list_tables(self, db: str = "default") -> List[Dict]:
         meta = self._load(db.lower())
         return [{"database": db.lower(), "table": t, **e}
-                for t, e in sorted(meta["tables"].items())]
+                for t, e in sorted(meta["tables"].items())
+                if not self._staging_stale(e)]
 
     def describe_table(self, name: str) -> Dict:
         db, tbl = _split(name)
         ent = self._load(db)["tables"].get(tbl)
-        if ent is None:
+        if ent is None or self._staging_stale(ent):
             raise CatalogError(f"table {db}.{tbl} not found")
         return {"database": db, "table": tbl, **ent}
 

@@ -1,0 +1,6 @@
+"""CLI entry: ``python -m spark_rapids_tpu.tools.profile trace.json``."""
+import sys
+
+from . import main
+
+sys.exit(main())

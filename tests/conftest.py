@@ -55,6 +55,16 @@ def _clear_chaos():
 
 
 @pytest.fixture(autouse=True)
+def _clear_tracer():
+    """The query tracer is process-global (trace/core.py, like the chaos
+    controller); a test that enables tracing must not leave the rest of
+    the suite paying per-event recording costs."""
+    yield
+    from spark_rapids_tpu.trace import install_tracer
+    install_tracer(None)
+
+
+@pytest.fixture(autouse=True)
 def _assert_no_leaked_spillables():
     """Suite-wide zero-leak check (ref cudf MemoryCleaner at shutdown,
     Plugin.scala:573-588): every SpillableBatch must be closed by the

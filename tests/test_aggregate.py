@@ -319,3 +319,40 @@ def test_agg_multibatch_decimal_key_payload_fallback():
                 .group_by("d").agg(F.sum(F.col("v")).with_name("s"),
                                    F.count_star().with_name("n")))
     chk(q)
+
+
+def test_wide_batch_auto_ceiling_byte_gated():
+    """ADVICE r5: AGG_WIDE_BATCH_ROWS=0 (auto) widens a GLOBAL agg's
+    scan only while the estimated batch bytes fit half the HBM budget —
+    a tiny pinned budget must keep the scan at its default width, an
+    ample one still fuses the whole partition into one batch."""
+    import numpy as np
+    import pyarrow as pa
+    from harness import tpu_session
+    from spark_rapids_tpu.exec.basic import InMemoryScanExec
+
+    n = 1 << 21                      # 2M rows x (8 B f64 + 1 B validity)
+    t = pa.table({"v": pa.array(np.zeros(n))})
+
+    def scans_of(session):
+        df = session.create_dataframe(t).agg(
+            F.sum(F.col("v")).with_name("sv"))
+        out = []
+
+        def walk(node):
+            if isinstance(node, InMemoryScanExec):
+                out.append(node)
+            for c in node.children:
+                walk(c)
+        walk(df._physical())
+        return out
+
+    tiny = 9 * (1 << 19) * 2         # row cap (budget/2)/9 = 2**19 < n
+    capped = scans_of(tpu_session(
+        {"spark.rapids.tpu.memory.hbm.limitBytes": tiny}))
+    assert capped and all(s.batch_rows < n for s in capped), \
+        [s.batch_rows for s in capped]
+
+    wide = scans_of(tpu_session())   # derived budget: plenty for 18 MB
+    assert any(s.batch_rows >= n for s in wide), \
+        [s.batch_rows for s in wide]

@@ -139,3 +139,61 @@ def test_new_keywords_stay_valid_identifiers(tmp_path):
     assert list(out["sv"]) == [4, 2]
     out2 = s.sql("SELECT location FROM sites WHERE v > 1").to_pandas()
     assert sorted(out2["location"]) == ["a", "b"]
+
+
+def test_stale_staging_entry_gc(tmp_path):
+    """ADVICE r5: a SIGKILL between CTAS reserve and finalize must not
+    block the table name forever. A staging entry whose writer pid is
+    dead is treated as absent everywhere and reclaimed by create_table;
+    a LIVE writer's reservation still blocks."""
+    import subprocess
+    s = _sess(tmp_path)
+    cat = s.catalog
+    t = pa.table({"a": [1, 2, 3]})
+    # a pid that provably existed and is now dead (reaped by wait)
+    proc = subprocess.Popen(["true"])
+    proc.wait()
+    cat.create_database("default")
+    meta = cat._load("default")
+    meta["tables"]["tbl"] = {
+        "format": "parquet", "path": str(tmp_path / "wh" / "default" / "tbl"),
+        "partition_by": [], "external": False,
+        "staging": True, "staging_pid": proc.pid}
+    cat._store("default", meta)
+    # stale staging == absent in every read path
+    with pytest.raises(CatalogError):
+        cat.describe_table("tbl")
+    with pytest.raises(CatalogError):
+        cat.table("tbl")
+    assert [r["table"] for r in cat.list_tables()] == []
+    # ... and create_table reclaims the name
+    cat.create_table("tbl", s.create_dataframe(t))
+    ent = cat.describe_table("tbl")
+    assert not ent.get("staging")
+    assert s.table("tbl").count() == 3
+    # legacy staging entries (no recorded pid) are reclaimable too
+    meta = cat._load("default")
+    meta["tables"]["old"] = {"format": "parquet", "path": "/nowhere",
+                             "partition_by": [], "external": False,
+                             "staging": True}
+    cat._store("default", meta)
+    assert [r["table"] for r in cat.list_tables()] == ["tbl"]
+    cat.create_table("old", s.create_dataframe(t))
+    assert s.table("old").count() == 3
+
+
+def test_live_staging_entry_still_blocks(tmp_path):
+    """The GC must not break in-flight CTAS: a staging entry whose
+    writer is ALIVE keeps its reservation."""
+    from spark_rapids_tpu.sql.catalog import TableExistsError
+    s = _sess(tmp_path)
+    cat = s.catalog
+    t = pa.table({"a": [1]})
+    cat.create_database("default")
+    meta = cat._load("default")
+    meta["tables"]["busy"] = {
+        "format": "parquet", "path": "/inflight", "partition_by": [],
+        "external": False, "staging": True, "staging_pid": os.getpid()}
+    cat._store("default", meta)
+    with pytest.raises(TableExistsError):
+        cat.create_table("busy", s.create_dataframe(t))

@@ -198,3 +198,48 @@ def test_cpu_twin_nan_cross_batch_and_big_ints():
     out2 = (s.create_dataframe(t2, num_partitions=2)
             .agg(F.count_distinct(F.col("v")).with_name("cd")).collect())
     assert out2[0]["cd"] == 2, out2
+
+
+def test_cpu_twin_packed_byte_keys_strings_and_specials():
+    """ADVICE r5: the CPU twin's cross-batch seen-set stores packed
+    bytes of the normalized int64 lanes (incl. first-seen string codes
+    and null-mask lanes), not python tuples. Drive the exec directly
+    over a multi-batch scan and check SQL distinct semantics survive:
+    NaN is ONE value, -0.0 == 0.0, NULL values never flag, NULL group
+    is a real group, and string codes stay stable across batches."""
+    from spark_rapids_tpu.exec.base import ExecContext
+    from spark_rapids_tpu.exec.basic import InMemoryScanExec
+    from spark_rapids_tpu.exec.distinct_flag import CpuDistinctFlagExec
+    from spark_rapids_tpu.exprs.base import ColumnRef
+    from spark_rapids_tpu.types import Schema, StructField, from_arrow
+
+    g = (["a", "b", None] * 40)[:100]
+    v = ([1.0, float("nan"), -0.0, 0.0, None] * 20)[:100]
+    t = pa.table({"g": pa.array(g), "v": pa.array(v, pa.float64())})
+    schema = Schema([StructField(f.name, from_arrow(f.type), True)
+                     for f in t.schema])
+    scan = InMemoryScanExec([t], schema, batch_rows=17)  # many batches
+    ex = CpuDistinctFlagExec([ColumnRef("g")], ColumnRef("v"), "__hd",
+                             scan)
+    out = pa.concat_tables(b.to_arrow()
+                           for b in ex.execute(ExecContext()))
+    df = out.to_pandas()
+    counts = {}
+    for gg, sub in df.groupby("g", dropna=False):
+        counts[None if gg is None or (isinstance(gg, float)
+                                      and np.isnan(gg)) else gg] = \
+            int(sub["__hd"].sum())
+    want = {}
+    for gg, vv in zip(g, v):
+        if vv is None:
+            continue
+        key = vv
+        if isinstance(vv, float):
+            if np.isnan(vv):
+                key = "nan"
+            elif vv == 0.0:
+                key = 0.0          # -0.0 == 0.0 for SQL distinct
+        want.setdefault(gg, set()).add(key)
+    assert counts == {k: len(s) for k, s in want.items()}, counts
+    # the flags across ALL batches count each distinct pair ONCE
+    assert int(df["__hd"].sum()) == sum(len(s) for s in want.values())

@@ -222,6 +222,18 @@ case("string_compare_lexicographic",
      lambda s: s.create_dataframe(pa.table(
          {"x": ["apple", "Banana"]})).select(
          (F.col("x") > F.lit("Z")).alias("o")), [True, False])
+# Spark NaN equality: double('NaN') IN (NaN) is TRUE (same _nan_eq
+# semantics as EqualTo; ADVICE r5) — and NaN never matches non-NaN
+case("nan_in_list",
+     lambda s: s.create_dataframe(pa.table(
+         {"a": [float("nan"), 1.0, 2.0]})).select(
+         F.col("a").isin(float("nan"), 5.0).alias("o")),
+     [True, False, False])
+case("nan_in_list_with_match",
+     lambda s: s.create_dataframe(pa.table(
+         {"a": [float("nan"), 1.0, None]})).select(
+         F.col("a").isin(float("nan"), 1.0).alias("o")),
+     [True, True, None])
 
 
 def _norm(x):

@@ -1,0 +1,269 @@
+"""Tracing + profiling subsystem (trace/ + tools/profile, ISSUE 4).
+
+Covers the tracer core (nesting, truncation, the disabled path being a
+no-op), single-process query traces, the 3-worker distributed
+trace-merge (driver + every worker on one timeline), and golden output
+of the profile analyzer over a checked-in fixture trace."""
+import json
+import os
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from harness import tpu_session
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.trace import (Tracer, active_tracer, chrome_trace,
+                                    install_tracer, load_chrome_trace,
+                                    write_chrome_trace)
+
+pytestmark = pytest.mark.filterwarnings("ignore::ResourceWarning")
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+# ---------------------------------------------------------------------------
+# core
+# ---------------------------------------------------------------------------
+
+def test_span_nesting_parent_ids():
+    tr = Tracer()
+    with tr.span("outer"):
+        with tr.span("inner"):
+            pass
+        with tr.span("inner2"):
+            pass
+    evs = tr.snapshot()
+    by_name = {e["name"]: e for e in evs}
+    assert by_name["outer"]["parent"] == 0
+    assert by_name["inner"]["parent"] == by_name["outer"]["id"]
+    assert by_name["inner2"]["parent"] == by_name["outer"]["id"]
+    # children's intervals are contained in the parent's
+    o = by_name["outer"]
+    for c in ("inner", "inner2"):
+        assert by_name[c]["ts"] >= o["ts"]
+        assert (by_name[c]["ts"] + by_name[c]["dur"]
+                <= o["ts"] + o["dur"])
+
+
+def test_span_records_on_exception():
+    tr = Tracer()
+    with pytest.raises(ValueError):
+        with tr.span("boom"):
+            raise ValueError("x")
+    assert [e["name"] for e in tr.snapshot()] == ["boom"]
+
+
+def test_ring_buffer_truncation_counts_drops():
+    tr = Tracer(max_events=16)
+    for i in range(40):
+        tr.instant(f"e{i}")
+    evs = tr.snapshot()
+    assert len(evs) == 16
+    assert tr.dropped == 24
+    # OLDEST events were dropped
+    assert evs[0]["name"] == "e24" and evs[-1]["name"] == "e39"
+    doc = chrome_trace(tr)
+    assert doc["otherData"]["dropped_events"] == 24
+
+
+def test_disabled_path_records_nothing():
+    """With tracing off (the default) no tracer exists, instrumented
+    sites see None and skip, and a full query leaves no global state."""
+    assert active_tracer() is None
+    t = pa.table({"k": pa.array(np.arange(500) % 5),
+                  "v": pa.array(np.arange(500, dtype=np.float64))})
+    s = tpu_session()
+    out = (s.create_dataframe(t).group_by("k")
+           .agg(F.sum(F.col("v")).with_name("sv"))).collect_arrow()
+    assert out.num_rows == 5
+    assert active_tracer() is None     # conf off -> never installed
+
+
+def test_disabled_overhead_is_one_branch():
+    """The record path when disabled is a module-global load + branch:
+    time a tight loop over the exact site pattern and assert it stays
+    within an order of magnitude of a bare loop (a generous bound —
+    this guards against accidentally adding allocation/conf lookups to
+    the disabled path, not against scheduler noise)."""
+    import time
+    from spark_rapids_tpu.trace import core as trace_core
+    assert trace_core.TRACER is None
+    n = 200_000
+
+    def site_loop():
+        acc = 0
+        for _ in range(n):
+            tr = trace_core.TRACER          # the instrumented pattern
+            if tr is not None:
+                tr.instant("x")             # pragma: no cover
+            acc += 1
+        return acc
+
+    def bare_loop():
+        acc = 0
+        for _ in range(n):
+            acc += 1
+        return acc
+
+    t0 = time.perf_counter(); site_loop(); site = time.perf_counter() - t0
+    t0 = time.perf_counter(); bare_loop(); bare = time.perf_counter() - t0
+    assert site < max(10 * bare, bare + 0.5), (site, bare)
+
+
+def test_ingest_aligns_remote_clock_and_lanes():
+    a, b = Tracer(), Tracer()
+    b.proc_name = "worker-7"
+    b.proc_names[b.pid] = "worker-7"
+    b.epoch_ns = a.epoch_ns + 5_000_000_000   # worker clock 5s ahead
+    t0 = b.now()
+    b.complete("remote", t0, t0 + 1000)
+    a.ingest(b.serialize())
+    evs = a.snapshot()
+    assert len(evs) == 1
+    # the remote span was shifted onto A's monotonic timeline
+    assert evs[0]["ts"] == t0 + 5_000_000_000
+    assert a.proc_names[b.pid] == "worker-7"
+    assert len(b.snapshot()) == 0              # serialize() drains
+
+
+# ---------------------------------------------------------------------------
+# single-process query trace
+# ---------------------------------------------------------------------------
+
+def test_query_trace_written_and_loadable(tmp_path):
+    out_path = str(tmp_path / "q.json")
+    t = pa.table({"k": pa.array(np.arange(2000) % 7),
+                  "v": pa.array(np.arange(2000, dtype=np.float64))})
+    s = tpu_session({"spark.rapids.tpu.trace.enabled": True,
+                     "spark.rapids.tpu.trace.output": out_path})
+    df = (s.create_dataframe(t).group_by("k")
+          .agg(F.sum(F.col("v")).with_name("sv")))
+    assert df.collect_arrow().num_rows == 7
+    events = load_chrome_trace(out_path)
+    phases = {e.get("ph") for e in events}
+    assert "X" in phases and "M" in phases
+    names = {e["name"] for e in events if e.get("ph") == "X"}
+    assert "query" in names
+    assert any(n.endswith("Exec") for n in names), names
+    assert any(n.startswith("h2d.") for n in names), names
+    # valid chrome trace: every X event has the required keys
+    for e in events:
+        if e.get("ph") == "X":
+            assert {"name", "ts", "dur", "pid", "tid"} <= set(e)
+    install_tracer(None)
+
+
+# ---------------------------------------------------------------------------
+# distributed: 3 workers, one merged timeline
+# ---------------------------------------------------------------------------
+
+def test_three_worker_trace_merge(tmp_path):
+    from spark_rapids_tpu.config import TpuConf
+    from spark_rapids_tpu.shuffle.cluster import LocalCluster
+    out_path = str(tmp_path / "dist.json")
+    conf = TpuConf({"spark.rapids.tpu.trace.enabled": True,
+                    "spark.rapids.tpu.trace.output": out_path})
+    cl = LocalCluster(3, conf=conf)
+    try:
+        rng = np.random.RandomState(5)
+        t = pa.table({"k": pa.array(rng.randint(0, 13, 9000)),
+                      "v": pa.array(rng.uniform(0, 100, 9000))})
+        s = tpu_session()
+        df = (s.create_dataframe(t).group_by("k")
+              .agg(F.sum(F.col("v")).with_name("sv"),
+                   F.count_star().with_name("n")))
+        got = cl.execute(df).to_pandas().sort_values("k") \
+                .reset_index(drop=True)
+        want = df.collect_arrow().to_pandas().sort_values("k") \
+                 .reset_index(drop=True)
+        np.testing.assert_allclose(got["sv"], want["sv"], rtol=1e-9)
+    finally:
+        cl.shutdown()
+        install_tracer(None)
+    events = load_chrome_trace(out_path)
+    # one coherent timeline: the driver AND every worker have a lane
+    lane_names = {e["args"]["name"] for e in events
+                  if e.get("ph") == "M"
+                  and e.get("name") == "process_name"}
+    assert {"worker-0", "worker-1", "worker-2"} <= lane_names, lane_names
+    assert "driver" in lane_names
+    pids = {e["pid"] for e in events if e.get("ph") == "X"}
+    assert len(pids) >= 4          # driver + 3 worker processes
+    names = {e["name"] for e in events if e.get("ph") == "X"}
+    assert "cluster.execute" in names
+    assert any(n.startswith("task:") for n in names), names
+    assert any(n.startswith("rpc:") for n in names), names
+    assert "shuffle.put" in names
+    # worker spans were shifted onto the driver timeline: everything
+    # falls inside the cluster.execute umbrella (loose 10s slack for
+    # clock-alignment jitter)
+    umb = next(e for e in events if e["name"] == "cluster.execute")
+    lo, hi = umb["ts"] - 10e6, umb["ts"] + umb["dur"] + 10e6
+    for e in events:
+        if e.get("ph") == "X":
+            assert lo <= e["ts"] <= hi, (e["name"], e["ts"], (lo, hi))
+    # the analyzer runs over the merged artifact without error and
+    # reports every required section
+    from spark_rapids_tpu.tools.profile import analyze_file
+    analysis, report = analyze_file(out_path)
+    assert "== Top operators by self time ==" in report
+    assert "== Memory pressure ==" in report
+    assert "== Shuffle partitions ==" in report
+    assert analysis["shuffle"]["shuffles"], "no shuffle sizes collected"
+    assert {"worker-0", "worker-1", "worker-2"} <= set(analysis["workers"])
+
+
+# ---------------------------------------------------------------------------
+# analyzer golden output
+# ---------------------------------------------------------------------------
+
+def test_profile_analyzer_golden():
+    fixture = os.path.join(FIXTURES, "trace_fixture.json")
+    golden = os.path.join(FIXTURES, "profile_golden.txt")
+    from spark_rapids_tpu.tools.profile import analyze, format_report
+    events = load_chrome_trace(fixture)
+    report = format_report(analyze(events), source="trace_fixture.json")
+    with open(golden) as f:
+        assert report == f.read()
+
+
+def test_profile_analyzer_self_time_math():
+    from spark_rapids_tpu.tools.profile import self_times
+    events = [
+        {"ph": "X", "name": "parent", "cat": "exec", "ts": 0,
+         "dur": 100, "pid": 1, "tid": 1},
+        {"ph": "X", "name": "child", "cat": "exec", "ts": 10,
+         "dur": 30, "pid": 1, "tid": 1},
+        {"ph": "X", "name": "child", "cat": "exec", "ts": 50,
+         "dur": 20, "pid": 1, "tid": 1},
+        # different lane: no nesting against pid 1
+        {"ph": "X", "name": "parent", "cat": "exec", "ts": 20,
+         "dur": 40, "pid": 2, "tid": 1},
+    ]
+    st = self_times(events)
+    assert st["parent"]["count"] == 2
+    assert st["parent"]["total_us"] == 140
+    assert st["parent"]["self_us"] == 90     # 100 - 30 - 20, + 40
+    assert st["child"]["self_us"] == 50
+
+
+def test_profile_cli_main(tmp_path, capsys):
+    from spark_rapids_tpu.tools.profile import main
+    fixture = os.path.join(FIXTURES, "trace_fixture.json")
+    assert main([fixture]) == 0
+    out = capsys.readouterr().out
+    assert "Recommendations" in out
+    assert main([fixture, "--json"]) == 0
+    json.loads(capsys.readouterr().out)      # valid JSON mode
+
+
+def test_write_and_reload_roundtrip(tmp_path):
+    tr = Tracer()
+    with tr.span("a", cat="exec", args={"k": 1}):
+        tr.counter("c", {"v": 2.0})
+    p = write_chrome_trace(str(tmp_path / "t.json"), tr)
+    evs = load_chrome_trace(p)
+    assert {e["ph"] for e in evs} == {"M", "X", "C"}
+    x = next(e for e in evs if e["ph"] == "X")
+    assert x["args"] == {"k": 1}
