@@ -499,30 +499,56 @@ def main():
              base_q9_of(store_sales_huge), check_q9),
         ]
 
-    # per-rung trace artifacts (ISSUE 4): one extra TRACED engine run per
-    # finished rung, exported as Chrome-trace JSON so BENCH rounds ship
-    # attribution (where the time went), not just wall clocks. The traced
-    # run is never the timed run — tracing forces transfer boundaries.
+    # per-rung trace + metrics artifacts (ISSUE 4 / ISSUE 5): one extra
+    # INSTRUMENTED engine run per finished rung — trace AND metric
+    # registry enabled together so the rung ships both a Chrome-trace
+    # JSON (where the time went) and a final metrics snapshot (HBM /
+    # spill / semaphore / shuffle / OOM totals, renderable with
+    # python -m spark_rapids_tpu.tools.history --metrics-file). The
+    # instrumented run is never the timed run.
     trace_dir = os.environ.get("SRTPU_BENCH_TRACE_DIR",
                                os.path.join(os.getcwd(), "bench_traces"))
+    metrics_dir = os.environ.get("SRTPU_BENCH_METRICS_DIR",
+                                 os.path.join(os.getcwd(),
+                                              "bench_metrics"))
     trace_on = os.environ.get("SRTPU_BENCH_TRACE", "1") != "0"
 
-    def capture_trace(name, eng_fn):
+    def capture_artifacts(name, eng_fn):
+        """(trace_path, metrics_path) for one instrumented run; either
+        may be None — best effort, a wedged capture never fails the
+        rung."""
         if not trace_on:
-            return None
+            return None, None
         tpath = os.path.join(trace_dir, f"trace_{name}.json")
+        mpath = os.path.join(metrics_dir, f"metrics_{name}.json")
         saved = {k: os.environ.get(k)
                  for k in ("SPARK_RAPIDS_TPU_TRACE_ENABLED",
-                           "SPARK_RAPIDS_TPU_TRACE_OUTPUT")}
+                           "SPARK_RAPIDS_TPU_TRACE_OUTPUT",
+                           "SPARK_RAPIDS_TPU_METRICS_ENABLED")}
+        got_metrics = None
         try:
             os.makedirs(trace_dir, exist_ok=True)
+            os.makedirs(metrics_dir, exist_ok=True)
             os.environ["SPARK_RAPIDS_TPU_TRACE_ENABLED"] = "true"
             os.environ["SPARK_RAPIDS_TPU_TRACE_OUTPUT"] = tpath
+            os.environ["SPARK_RAPIDS_TPU_METRICS_ENABLED"] = "true"
             eng_fn()
-            return tpath
+            try:
+                from spark_rapids_tpu.metrics import (registry_snapshot,
+                                                      active_registry)
+                reg = active_registry()
+                if reg is not None:
+                    with open(mpath, "w") as f:
+                        json.dump({"rung": name,
+                                   "snapshot": registry_snapshot(reg)},
+                                  f, sort_keys=True, default=float)
+                    got_metrics = mpath
+            except Exception as e:           # noqa: BLE001 - best effort
+                log(f"bench: {name} metrics snapshot failed: {e}")
+            return tpath, got_metrics
         except Exception as e:               # noqa: BLE001 - best effort
             log(f"bench: {name} trace capture failed: {e}")
-            return None
+            return None, got_metrics
         finally:
             for k, v in saved.items():       # restore, don't clobber
                 if v is None:
@@ -531,6 +557,8 @@ def main():
                     os.environ[k] = v
             from spark_rapids_tpu.trace import install_tracer
             install_tracer(None)   # drop the buffer between rungs
+            from spark_rapids_tpu.metrics import shutdown_metrics
+            shutdown_metrics()     # stop the sampler between rungs
 
     details = {}
     skipped = []
@@ -585,7 +613,9 @@ def main():
         log(f"bench: {name:18s} engine {eng_s:7.3f}s [{placement:6s}] "
             f"pandas {base_s:7.3f}s -> {speedup:5.2f}x "
             f"(warm-up {warm:.1f}s, checked)")
-        details[name]["trace"] = capture_trace(name, eng_fn)
+        tr_path, m_path = capture_artifacts(name, eng_fn)
+        details[name]["trace"] = tr_path
+        details[name]["metrics"] = m_path
 
     # ---------------- distributed rung (subprocess) ----------------
     dist = None

@@ -70,6 +70,15 @@ class TpuSession:
         self.profiler = Profiler(self.conf)
         #: per-query runtime summary (ref GpuTaskMetrics accumulators)
         self.last_query_metrics = None
+        #: rotating query-history log (ref spark.eventLog.*), None when
+        #: spark.rapids.tpu.eventLog.enabled is off
+        from ..metrics.events import EventLogWriter
+        self.event_log = EventLogWriter.from_conf(self.conf)
+        import itertools as _it
+        self._query_seq = _it.count(1)
+        #: fault_stats of the last LocalCluster.execute on this session
+        #: (the event log's queryEnd picks it up)
+        self.last_fault_stats = None
         #: engine that ran the last materialized query: "device"/"host"
         self.last_placement = None
         #: device mesh for distributed execution: explicit, or built from
@@ -151,6 +160,8 @@ class TpuSession:
         self._ctx = None
         from ..aux.profiler import Profiler
         self.profiler = Profiler(self.conf)
+        from ..metrics.events import EventLogWriter
+        self.event_log = EventLogWriter.from_conf(self.conf)
         return self
 
     def exec_context(self) -> ExecContext:
@@ -670,6 +681,13 @@ class DataFrame:
         transparently retried with exact sizing on overflow; plans with
         side effects (file writes) run with speculation OFF so a retry
         can never duplicate output files."""
+        # stale-telemetry guard: a query that RAISES must not leave the
+        # prior run's summary behind for callers to misattribute — and a
+        # non-distributed query must not inherit the last cluster run's
+        # fault stats. Cleared before anything (planning included) can
+        # fail.
+        self.session.last_query_metrics = None
+        self.session.last_fault_stats = None
         physical = self._physical()
         if self.session.conf.is_explain_only:
             raise RuntimeError("session is in explainOnly mode")
@@ -694,6 +712,18 @@ class DataFrame:
         prof = self.session.profiler
         tm = TaskMetrics(ctx)
         prof.maybe_start()
+        elog = self.session.event_log
+        qid = digest = None
+        if elog is not None:
+            from ..metrics.events import plan_digest
+            qid = next(self.session._query_seq)
+            digest = plan_digest(self.plan)
+            elog.write({"event": "queryStart", "queryId": qid,
+                        "planDigest": digest,
+                        "root": type(self.plan).__name__,
+                        "conf": {k: str(v) for k, v
+                                 in sorted(self.session.conf.raw.items())}})
+        trace_path = None
         import time as _time
         t0 = _time.perf_counter()
         ok = False
@@ -725,6 +755,7 @@ class DataFrame:
                     from ..trace.export import write_chrome_trace
                     try:
                         write_chrome_trace(out_path, tracer)
+                        trace_path = out_path
                     except Exception as e:  # noqa: BLE001
                         # tracing must never fail a query — but a
                         # silently missing artifact after paying the
@@ -733,6 +764,22 @@ class DataFrame:
                         logging.getLogger(__name__).warning(
                             "could not write trace to %s: %s",
                             out_path, e)
+            from ..metrics import registry as metrics_registry
+            mreg = metrics_registry.REGISTRY
+            wall_s = _time.perf_counter() - t0
+            if mreg is not None:
+                mreg.counter("srtpu_queries_total",
+                             status="ok" if ok else "failed").inc()
+                mreg.histogram("srtpu_query_seconds").observe(wall_s)
+            if elog is not None:
+                from ..aux.metrics import metrics_to_json
+                elog.write({"event": "queryEnd", "queryId": qid,
+                            "planDigest": digest, "ok": ok,
+                            "durationMs": round(wall_s * 1000.0, 3),
+                            "metrics": metrics_to_json(
+                                self.session.last_query_metrics),
+                            "faultStats": self.session.last_fault_stats,
+                            "trace": trace_path})
             if ok and not side_effects:
                 # measured whole-query wall per (shape, engine placement):
                 # the cost optimizer prefers these over its model, so a
@@ -845,10 +892,29 @@ class DataFrame:
             s = self.plan.tree_string()
         elif mode == "potential":
             s = explain_potential_tpu_plan(self.plan, self.session.conf)
+        elif mode == "analyze":
+            s = self._explain_analyze()
         else:
             s = self._physical().tree_string()
         print(s)
         return s
+
+    def _explain_analyze(self) -> str:
+        """EXPLAIN ANALYZE (the SQL-UI analog): EXECUTE the query
+        through the full pipeline, then render the physical plan
+        annotated with each operator's output rows, batches, cumulative
+        and self time from ``ExecContext.metrics``
+        (metrics/analyze.py)."""
+        from ..metrics.analyze import render_analyzed_plan
+        holder = {}
+
+        def consume(physical, ctx):
+            holder["physical"] = physical
+            holder["ctx"] = ctx
+            return physical.collect(ctx)
+
+        self._execute_wrapped(consume)
+        return render_analyzed_plan(holder["physical"], holder["ctx"])
 
 
 class GroupedData:

@@ -5,9 +5,9 @@ Spark accumulators; here merged into a per-query summary dict exposed as
 from __future__ import annotations
 
 from collections.abc import Mapping
-from typing import Dict
+from typing import Dict, Optional
 
-__all__ = ["TaskMetrics", "metrics_summary"]
+__all__ = ["TaskMetrics", "metrics_summary", "metrics_to_json"]
 
 
 class TaskMetrics:
@@ -86,6 +86,31 @@ class LazyMetricsView(Mapping):
 
     def __repr__(self):
         return repr(self._force())
+
+
+def metrics_to_json(summary: Optional[dict]) -> Optional[dict]:
+    """TaskMetrics.finish() output -> plain JSON-able dict (forces the
+    lazy operator view — one packed fetch). Used by the event log's
+    queryEnd record; NEVER raises: forcing device scalars after a failed
+    query can itself fail, and the event-log path must not mask the
+    query's real exception — it degrades to operators=None instead."""
+    if summary is None:
+        return None
+    out = {}
+    for k, v in summary.items():
+        if k != "operators":
+            out[k] = v.item() if hasattr(v, "item") else v
+            continue
+        try:
+            ops = {}
+            for eid, ms in dict(v).items():
+                ops[eid] = {
+                    n: (val.item() if hasattr(val, "item") else val)
+                    for n, val in ms.items()}
+            out[k] = ops
+        except Exception:  # noqa: BLE001 - degrade, never mask
+            out[k] = None
+    return out
 
 
 def metrics_summary(ctx):

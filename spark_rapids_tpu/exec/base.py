@@ -49,8 +49,11 @@ class ExecContext:
         from ..mem.manager import MemoryManager
         self.conf = conf or TpuConf()
         # one conf lookup per query context, never per event: installs
-        # the process tracer iff spark.rapids.tpu.trace.enabled
+        # the process tracer iff spark.rapids.tpu.trace.enabled, and the
+        # metric registry (+ sampler) iff spark.rapids.tpu.metrics.enabled
         trace_core.ensure_tracer_from_conf(self.conf)
+        from ..metrics import registry as metrics_registry
+        metrics_registry.ensure_metrics_from_conf(self.conf)
         self.semaphore = semaphore or DeviceSemaphore(
             self.conf.concurrent_tpu_tasks)
         self.memory = memory or MemoryManager.get(self.conf)
@@ -144,6 +147,11 @@ class TpuExec:
         t0 = time.perf_counter()
         it = self.do_execute(ctx)
         m.add(time.perf_counter() - t0)
+        # per-batch metering: cumulative operator time (includes pulls
+        # from children — EXPLAIN ANALYZE derives SELF time by
+        # subtracting the children's cumulative) + produced batches
+        it = self._metered_iter(
+            it, m, ctx.metric(self._exec_id, "numOutputBatches"))
         sig = getattr(self, "plan_sig", None)
         if sig is not None:
             it = self._record_rows(it, sig)
@@ -151,6 +159,24 @@ class TpuExec:
         if tr is not None:
             it = self._traced_iter(it, tr)
         return it
+
+    @staticmethod
+    def _metered_iter(it, m_time: Metric, m_batches: Metric):
+        """Time every next() into the operator's cumulative opTime and
+        count produced batches (two perf_counter reads per BATCH — noise
+        next to batch-scale work, and the price of an always-on SQL-UI
+        view; ref GpuMetric.ns around every GPU op)."""
+        it = iter(it)
+        while True:
+            t0 = time.perf_counter()
+            try:
+                b = next(it)
+            except StopIteration:
+                m_time.add(time.perf_counter() - t0)
+                return
+            m_time.add(time.perf_counter() - t0)
+            m_batches.add(1)
+            yield b
 
     def _traced_iter(self, it, tr):
         """One span per produced batch, named after the operator. Child

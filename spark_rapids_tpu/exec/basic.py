@@ -597,14 +597,17 @@ class LimitExec(TpuExec):
         return self.children[0].output_schema()
 
     def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        rows_m = ctx.metric(self._exec_id, "numOutputRows", ESSENTIAL)
         remaining = self.n
         for batch in self.children[0].execute(ctx):
             if remaining <= 0:
                 break
             if batch.num_rows <= remaining:
                 remaining -= batch.num_rows
+                rows_m.add(batch.num_rows)
                 yield batch
             else:
+                rows_m.add(remaining)
                 yield batch.slice(0, remaining)
                 remaining = 0
 

@@ -331,6 +331,26 @@ class MemoryManager:
             out.extend(mm.audit_leaks())
         return out
 
+    @classmethod
+    def stats_all(cls) -> Dict[str, int]:
+        """Aggregate accounting across every live budget singleton — the
+        metrics sampler's view (one process may hold several budgets in
+        tests; fleet gauges sum them)."""
+        with cls._global_lock:
+            insts = list(cls._instances.values())
+        out = {"device_used": 0, "host_used": 0, "disk_used": 0,
+               "max_device_used": 0, "budget": 0,
+               "spill_to_host_bytes": 0, "spill_to_disk_bytes": 0}
+        for mm in insts:
+            out["device_used"] += mm.device_used
+            out["host_used"] += mm.host_used
+            out["disk_used"] += mm.disk_used
+            out["max_device_used"] += mm.max_device_used
+            out["budget"] += mm.budget
+            out["spill_to_host_bytes"] += mm.spill_to_host_bytes
+            out["spill_to_disk_bytes"] += mm.spill_to_disk_bytes
+        return out
+
     # ------------------------------------------------------------------ stats
     def stats(self) -> Dict[str, int]:
         with self._lock:

@@ -17,6 +17,7 @@ from __future__ import annotations
 import time
 from typing import Callable, Iterator, List, Optional, TypeVar
 
+from ..metrics import registry as metrics_registry
 from ..trace import core as trace_core
 from .manager import (MemoryManager, OutOfDeviceMemory, RetryOOM,
                       SplitAndRetryOOM)
@@ -39,6 +40,10 @@ def _trace_oom(kind: str, attempt: int) -> None:
     tr = trace_core.TRACER           # single branch when tracing is off
     if tr is not None:
         tr.instant(kind, cat="mem", args={"attempt": attempt})
+    mr = metrics_registry.REGISTRY   # same contract for the registry
+    if mr is not None:
+        mr.counter("srtpu_oom_retries_total" if kind == "oom.retry"
+                   else "srtpu_oom_splits_total").inc()
 
 
 def with_retry_no_split(fn: Callable[[], T], mm: Optional[MemoryManager] = None,

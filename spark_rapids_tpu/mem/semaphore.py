@@ -8,11 +8,17 @@ from __future__ import annotations
 
 import threading
 import time
+import weakref
 from contextlib import contextmanager
 
 from ..trace import core as trace_core
 
 __all__ = ["DeviceSemaphore"]
+
+#: live semaphores, observed by the metrics sampler (queue depth / wait
+#: totals across every in-flight query context); weak so a finished
+#: query's semaphore just drops out of the sums
+_SEMAPHORES: "weakref.WeakSet" = weakref.WeakSet()
 
 
 class DeviceSemaphore:
@@ -23,7 +29,10 @@ class DeviceSemaphore:
         self._lock = threading.Lock()
         self.total_wait_s = 0.0
         self.acquires = 0
+        #: tasks currently blocked in acquire() (metrics queue depth)
+        self.waiting = 0
         self._held = threading.local()
+        _SEMAPHORES.add(self)
 
     @property
     def permits(self) -> int:
@@ -36,7 +45,14 @@ class DeviceSemaphore:
         tr = trace_core.TRACER
         t0n = tr.now() if tr is not None else 0
         t0 = time.perf_counter()
-        if not self._sem.acquire(timeout=self._timeout):
+        with self._lock:
+            self.waiting += 1
+        try:
+            acquired = self._sem.acquire(timeout=self._timeout)
+        finally:
+            with self._lock:
+                self.waiting -= 1
+        if not acquired:
             if tr is not None:
                 # the timed-out wait is the WORST contention case — the
                 # profiler must see it, not just successful acquires

@@ -1,0 +1,34 @@
+"""Continuous-telemetry subsystem (ISSUE 5): the always-on metric
+registry + background sampler (registry.py / sampler.py), Prometheus and
+JSON exporters (export.py), the rotating query event log (events.py)
+and the EXPLAIN ANALYZE renderer (analyze.py).
+
+The process-global registry follows the tracer's one-branch-when-off
+contract: instrumented sites read ``registry.REGISTRY`` and skip when it
+is ``None``; ``ensure_metrics_from_conf`` installs it (and starts the
+sampler) iff ``spark.rapids.tpu.metrics.enabled``. See
+docs/monitoring.md for the metric catalog and event-log schema.
+"""
+from .registry import (Counter, Gauge, Histogram, MetricRegistry,
+                       METRICS_ENABLED, METRICS_SAMPLE_INTERVAL_MS,
+                       active_registry, declare_metric,
+                       ensure_metrics_from_conf, install_metrics,
+                       metric_inventory, shutdown_metrics)
+from .sampler import (SAMPLER_THREAD_NAME, sample_now, sampler_thread,
+                      start_sampler, stop_sampler)
+from .export import (json_text, merge_snapshots, prometheus_text,
+                     registry_snapshot)
+from .events import (ACTIVE_NAME, EVENT_LOG_DIR, EVENT_LOG_ENABLED,
+                     EVENT_LOG_MAX_BYTES, EventLogWriter, plan_digest)
+from .analyze import render_analyzed_plan
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricRegistry",
+           "METRICS_ENABLED", "METRICS_SAMPLE_INTERVAL_MS",
+           "active_registry", "declare_metric", "ensure_metrics_from_conf",
+           "install_metrics", "metric_inventory", "shutdown_metrics",
+           "SAMPLER_THREAD_NAME", "sample_now", "sampler_thread",
+           "start_sampler", "stop_sampler", "json_text",
+           "merge_snapshots", "prometheus_text", "registry_snapshot",
+           "ACTIVE_NAME", "EVENT_LOG_DIR", "EVENT_LOG_ENABLED",
+           "EVENT_LOG_MAX_BYTES", "EventLogWriter", "plan_digest",
+           "render_analyzed_plan"]

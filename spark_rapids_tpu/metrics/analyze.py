@@ -1,0 +1,63 @@
+"""EXPLAIN ANALYZE rendering — the SQL-UI per-operator view.
+
+``df.explain("analyze")`` executes the query, then renders the physical
+plan annotated with per-operator output rows, batches, cumulative and
+SELF time pulled from ``ExecContext.metrics`` (the GpuMetric registry
+analog, GpuExec.scala:54-165). Cumulative time for a pipelined operator
+includes the time spent pulling from its children (the iterator chain),
+so self time is cumulative minus the children's cumulative, clamped at
+zero — the same interval math the trace profiler uses on spans.
+
+Lazy device row counts are forced through the metrics summary view's
+single packed fetch, so rendering costs one tunnel round trip total,
+not one per operator.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+__all__ = ["render_analyzed_plan"]
+
+
+def _fmt_count(v) -> str:
+    if v is None:
+        return "-"
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return str(v)
+    if f == int(f):
+        return str(int(f))
+    return f"{f:.2f}"
+
+
+def _fmt_ms(seconds: float) -> str:
+    return f"{seconds * 1000.0:.1f}ms"
+
+
+def render_analyzed_plan(physical, ctx) -> str:
+    """Physical tree string with per-operator metric annotations."""
+    from ..aux.metrics import metrics_summary
+    summary: Dict[str, dict] = dict(metrics_summary(ctx))
+
+    def node_time(node) -> float:
+        ms = summary.get(node._exec_id) or {}
+        try:
+            return float(ms.get("opTime", 0.0) or 0.0)
+        except (TypeError, ValueError):
+            return 0.0
+
+    def walk(node, indent: int) -> str:
+        ms = summary.get(node._exec_id) or {}
+        cum = node_time(node)
+        child_cum = sum(node_time(c) for c in node.children)
+        self_s = max(0.0, cum - child_cum)
+        ann = (f"rows={_fmt_count(ms.get('numOutputRows'))} "
+               f"batches={_fmt_count(ms.get('numOutputBatches'))} "
+               f"time={_fmt_ms(cum)} self={_fmt_ms(self_s)}")
+        marker = "*" if node.is_tpu else "!"
+        line = "  " * indent + f"{marker} {node.describe()} [{ann}]\n"
+        return line + "".join(walk(c, indent + 1)
+                              for c in node.children)
+
+    return walk(physical, 0)

@@ -1,0 +1,118 @@
+"""Exporters over registry snapshots: Prometheus text format + JSON.
+
+Both exporters consume the plain-dict snapshot interchange format
+(:meth:`MetricRegistry.snapshot`) rather than live metric objects, so
+the driver can render snapshots shipped from worker processes without
+reconstructing registries — the merged cluster view is just the same
+snapshots with a ``worker`` label stamped on (:func:`merge_snapshots`).
+
+Prometheus exposition follows the text format spec: ``# HELP`` /
+``# TYPE`` headers, label values escaped (backslash, double quote,
+newline), histograms as cumulative ``_bucket{le=...}`` series plus
+``_sum`` / ``_count`` with the implicit ``+Inf`` bucket equal to
+``_count``.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+from .registry import MetricRegistry, metric_inventory
+
+__all__ = ["prometheus_text", "json_text", "merge_snapshots",
+           "registry_snapshot"]
+
+
+def registry_snapshot(reg: MetricRegistry,
+                      sample: bool = True) -> dict:
+    """Snapshot with an optional synchronous sample pass first — gauges
+    are current at read time even when the sampler thread is off."""
+    if sample:
+        from .sampler import sample_now
+        sample_now(reg)
+    return reg.snapshot()
+
+
+def _escape_label(v: str) -> str:
+    return (str(v).replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def _fmt_labels(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_escape_label(v)}"'
+                     for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def _fmt_value(v) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    return repr(float(v))
+
+
+def prometheus_text(snapshot: dict,
+                    extra_labels: Optional[Dict[str, str]] = None) -> str:
+    """Snapshot -> Prometheus exposition text. ``extra_labels`` are
+    stamped on every series (the merged cluster view adds
+    ``worker="worker-N"``)."""
+    inv = metric_inventory()
+    extra = dict(extra_labels or {})
+    out: List[str] = []
+    for name in sorted(k for k in snapshot if not k.startswith("__")):
+        ent = snapshot[name]
+        help_text = inv.get(name, {}).get("help", "")
+        out.append(f"# HELP {name} {help_text}")
+        out.append(f"# TYPE {name} {ent['kind']}")
+        for s in ent["series"]:
+            labels = dict(s.get("labels") or {})
+            labels.update(extra)
+            if ent["kind"] == "histogram":
+                for le, c in s["buckets"]:
+                    bl = dict(labels)
+                    bl["le"] = (f"{le:g}" if isinstance(le, float)
+                                else str(le))
+                    out.append(f"{name}_bucket{_fmt_labels(bl)} {c}")
+                bl = dict(labels)
+                bl["le"] = "+Inf"
+                out.append(
+                    f"{name}_bucket{_fmt_labels(bl)} {s['count']}")
+                out.append(f"{name}_sum{_fmt_labels(labels)} "
+                           f"{_fmt_value(s['sum'])}")
+                out.append(f"{name}_count{_fmt_labels(labels)} "
+                           f"{s['count']}")
+            else:
+                out.append(f"{name}{_fmt_labels(labels)} "
+                           f"{_fmt_value(s['value'])}")
+    return "\n".join(out) + ("\n" if out else "")
+
+
+def json_text(snapshot: dict, indent: Optional[int] = 2) -> str:
+    return json.dumps(snapshot, indent=indent, sort_keys=True,
+                      default=float)
+
+
+def merge_snapshots(snapshots: Dict[str, dict]) -> dict:
+    """{lane_name: snapshot} -> one snapshot whose every series carries
+    a ``worker`` label naming its source lane. Series are sorted, so
+    the merged view is deterministic regardless of arrival order."""
+    out: Dict[str, dict] = {}
+    for lane in sorted(snapshots):
+        snap = snapshots[lane] or {}
+        for name, ent in snap.items():
+            if name.startswith("__"):
+                continue
+            dst = out.setdefault(name, {"kind": ent["kind"],
+                                        "series": []})
+            for s in ent["series"]:
+                s2 = {k: v for k, v in s.items() if k != "labels"}
+                labels = dict(s.get("labels") or {})
+                labels["worker"] = lane
+                s2["labels"] = labels
+                dst["series"].append(s2)
+    for ent in out.values():
+        ent["series"].sort(key=lambda s: sorted(s["labels"].items()))
+    return out

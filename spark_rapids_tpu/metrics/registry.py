@@ -1,0 +1,335 @@
+"""Process-global metric registry: counters, gauges, histograms.
+
+Reference analog: the Spark metrics system (ExecutorMetrics + the
+DropwizardReporter sinks) the reference plugin feeds its GPU memory /
+spill / semaphore telemetry into — the long-lived, *between*-queries
+view the SQL UI and qualification tools consume. Here a single
+process-global :class:`MetricRegistry` plays that role, with
+Prometheus-text and JSON exporters (export.py) and a background sampler
+(sampler.py) snapshotting the runtime singletons.
+
+Design contract (ISSUE 5, same shape as trace/core.py):
+
+* **one branch when off** — instrumentation sites read the module
+  global ``REGISTRY`` and skip entirely when it is ``None``; no conf
+  lookup, no allocation, no lock on the disabled path;
+* **declared inventory** — every shipped metric name is declared at
+  import time in ``_INVENTORY`` with its kind and help text; creating
+  an undeclared metric raises, so docs/monitoring.md and the
+  ``metric-name-drift`` lint rule always check against a closed,
+  honest catalog (the RapidsConf-registry pattern applied to metrics);
+* **cheap when unread** — counters and gauges are a slot store plus a
+  lock'd add; histograms bisect a short bucket ladder. Nothing is
+  formatted, aggregated, or exported until somebody asks.
+"""
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..config import register
+
+__all__ = ["MetricRegistry", "Counter", "Gauge", "Histogram",
+           "declare_metric", "metric_inventory", "active_registry",
+           "install_metrics", "shutdown_metrics",
+           "ensure_metrics_from_conf", "METRICS_ENABLED",
+           "METRICS_SAMPLE_INTERVAL_MS"]
+
+METRICS_ENABLED = register(
+    "spark.rapids.tpu.metrics.enabled", False,
+    "Maintain the process-global MetricRegistry (metrics/registry.py): "
+    "always-on counters/gauges/histograms for HBM pressure, spill "
+    "totals, semaphore contention, shuffle health and query outcomes, "
+    "sampled by a background thread and exported as Prometheus text or "
+    "JSON (docs/monitoring.md). Off by default: every instrumentation "
+    "site is a single branch when disabled.", commonly_used=True)
+
+METRICS_SAMPLE_INTERVAL_MS = register(
+    "spark.rapids.tpu.metrics.sample.intervalMs", 1000,
+    "Background sampler period for gauge snapshots (HBM used/budget, "
+    "spill-store bytes, semaphore queue depth, shuffle block-store "
+    "size). <= 0 disables the sampler thread; instrumented counters "
+    "still record, and exporters run one synchronous sample pass so "
+    "snapshots are never stale.")
+
+#: Prometheus-style default latency buckets (seconds)
+DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                   1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+#: the process-global registry; ``None`` means metrics are OFF and every
+#: instrumentation site costs exactly one attribute load + branch
+REGISTRY: Optional["MetricRegistry"] = None
+
+#: name -> {"kind", "help"}; the closed catalog every registry enforces
+_INVENTORY: Dict[str, Dict[str, str]] = {}
+
+
+def declare_metric(name: str, kind: str, help_text: str) -> str:
+    """Declare a metric name in the process-wide inventory (import
+    time). Idempotent for identical declarations; a kind conflict is a
+    programming error and raises."""
+    prev = _INVENTORY.get(name)
+    if prev is not None and prev["kind"] != kind:
+        raise ValueError(f"metric {name} redeclared as {kind}, "
+                         f"was {prev['kind']}")
+    _INVENTORY[name] = {"kind": kind, "help": help_text}
+    return name
+
+
+def metric_inventory() -> Dict[str, Dict[str, str]]:
+    """The declared catalog (docs/monitoring.md + metric-name-drift)."""
+    return dict(_INVENTORY)
+
+
+class Counter:
+    """Monotone counter. ``set_total`` exists for mirror counters whose
+    source of truth is an external cumulative total (e.g. the memory
+    manager's spill_to_host_bytes) — the sampler overwrites rather than
+    re-adding."""
+
+    __slots__ = ("name", "labels", "value", "_lock")
+    kind = "counter"
+
+    def __init__(self, name: str, labels: Tuple[Tuple[str, str], ...]):
+        self.name = name
+        self.labels = labels
+        self.value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n=1) -> None:
+        with self._lock:
+            self.value += n
+
+    def set_total(self, v) -> None:
+        with self._lock:
+            self.value = v
+
+    def set_max(self, v) -> None:
+        """Monotone mirror for totals summed over WEAKLY-held sources
+        (semaphores, block servers): a GC'd source drops out of the
+        sum, and a decreasing counter would read as a reset to
+        Prometheus rate()/increase() — hold the high-water mark
+        instead."""
+        with self._lock:
+            if v > self.value:
+                self.value = v
+
+
+class Gauge:
+    __slots__ = ("name", "labels", "value", "_lock")
+    kind = "gauge"
+
+    def __init__(self, name: str, labels: Tuple[Tuple[str, str], ...]):
+        self.name = name
+        self.labels = labels
+        self.value = 0
+        self._lock = threading.Lock()
+
+    def set(self, v) -> None:
+        with self._lock:
+            self.value = v
+
+    def inc(self, n=1) -> None:
+        with self._lock:
+            self.value += n
+
+    def dec(self, n=1) -> None:
+        with self._lock:
+            self.value -= n
+
+
+class Histogram:
+    """Cumulative-bucket histogram, Prometheus exposition semantics:
+    ``bucket_counts[i]`` counts observations <= ``buckets[i]``; the
+    implicit +Inf bucket is ``count``."""
+
+    __slots__ = ("name", "labels", "buckets", "bucket_counts", "sum",
+                 "count", "_lock")
+    kind = "histogram"
+
+    def __init__(self, name: str, labels: Tuple[Tuple[str, str], ...],
+                 buckets=DEFAULT_BUCKETS):
+        self.name = name
+        self.labels = labels
+        self.buckets = tuple(sorted(buckets))
+        self.bucket_counts = [0] * len(self.buckets)
+        self.sum = 0.0
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        i = bisect.bisect_left(self.buckets, v)
+        with self._lock:
+            for j in range(i, len(self.bucket_counts)):
+                self.bucket_counts[j] += 1
+            self.sum += v
+            self.count += 1
+
+
+class MetricRegistry:
+    """Thread-safe store of live metric instances, keyed on
+    (name, sorted labels). Snapshots are plain dicts — the interchange
+    format task-completion RPCs ship and the exporters consume."""
+
+    def __init__(self):
+        self._metrics: Dict[Tuple[str, Tuple[Tuple[str, str], ...]],
+                            object] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, cls, name: str, labels: dict, **kw):
+        if name not in _INVENTORY:
+            raise KeyError(
+                f"metric {name!r} is not declared in the inventory — "
+                "declare_metric() it (and document it in "
+                "docs/monitoring.md) before use")
+        if _INVENTORY[name]["kind"] != cls.kind:
+            raise TypeError(f"metric {name} is declared as "
+                            f"{_INVENTORY[name]['kind']}, not {cls.kind}")
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is None:
+                m = cls(name, key[1], **kw)
+                self._metrics[key] = m
+            return m
+
+    def counter(self, name: str, **labels) -> Counter:
+        return self._get(Counter, name, labels)
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        return self._get(Gauge, name, labels)
+
+    def histogram(self, name: str, buckets=DEFAULT_BUCKETS,
+                  **labels) -> Histogram:
+        return self._get(Histogram, name, labels, buckets=buckets)
+
+    # ------------------------------------------------------------- read
+    def snapshot(self) -> dict:
+        """JSON-able {name: {kind, series: [...]}} snapshot plus a
+        wall-clock stamp (the driver keeps the freshest of
+        task-completion vs heartbeat snapshots per worker)."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        out: Dict[str, dict] = {"__ts__": time.time()}
+        for m in metrics:
+            ent = out.setdefault(m.name, {"kind": m.kind, "series": []})
+            s = {"labels": dict(m.labels)}
+            if m.kind == "histogram":
+                with m._lock:
+                    s["buckets"] = [[b, c] for b, c in
+                                    zip(m.buckets, m.bucket_counts)]
+                    s["sum"] = m.sum
+                    s["count"] = m.count
+            else:
+                s["value"] = m.value
+            ent["series"].append(s)
+        for ent in out.values():
+            if isinstance(ent, dict) and "series" in ent:
+                ent["series"].sort(
+                    key=lambda s: sorted(s["labels"].items()))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# installation (the trace/core.py pattern)
+# ---------------------------------------------------------------------------
+
+_INSTALL_LOCK = threading.Lock()
+
+
+def active_registry() -> Optional[MetricRegistry]:
+    return REGISTRY
+
+
+def install_metrics(reg: Optional[MetricRegistry]) -> \
+        Optional[MetricRegistry]:
+    """Install (or with ``None`` remove) the process-global registry."""
+    global REGISTRY
+    with _INSTALL_LOCK:
+        REGISTRY = reg
+    return reg
+
+
+def shutdown_metrics() -> None:
+    """Stop the sampler thread (if any) and uninstall the registry —
+    the per-test reset (conftest) and the bench artifact teardown."""
+    from .sampler import stop_sampler
+    stop_sampler()
+    install_metrics(None)
+
+
+def ensure_metrics_from_conf(conf) -> Optional[MetricRegistry]:
+    """Install a registry (and start the sampler) iff
+    ``spark.rapids.tpu.metrics.enabled`` — the one conf lookup, paid per
+    ExecContext construction, never per metric event."""
+    global REGISTRY
+    if not conf.get(METRICS_ENABLED):
+        return REGISTRY
+    with _INSTALL_LOCK:
+        if REGISTRY is None:
+            REGISTRY = MetricRegistry()
+        reg = REGISTRY
+    interval_ms = int(conf.get(METRICS_SAMPLE_INTERVAL_MS))
+    if interval_ms > 0:
+        from .sampler import start_sampler
+        start_sampler(reg, interval_ms)
+    return reg
+
+
+# ---------------------------------------------------------------------------
+# the shipped metric catalog (docs/monitoring.md mirrors this; the
+# metric-name-drift lint rule enforces the mirror)
+# ---------------------------------------------------------------------------
+
+declare_metric("srtpu_hbm_used_bytes", "gauge",
+               "Logical HBM bytes currently accounted by the memory "
+               "manager(s), summed across budgets.")
+declare_metric("srtpu_hbm_budget_bytes", "gauge",
+               "Total HBM budget across memory manager instances.")
+declare_metric("srtpu_hbm_max_used_bytes", "gauge",
+               "High-water mark of accounted HBM bytes.")
+declare_metric("srtpu_spill_store_host_bytes", "gauge",
+               "Bytes currently held in the host spill tier.")
+declare_metric("srtpu_spill_store_disk_bytes", "gauge",
+               "Bytes currently held in the disk spill tier.")
+declare_metric("srtpu_spill_to_host_bytes_total", "counter",
+               "Cumulative bytes spilled device -> host.")
+declare_metric("srtpu_spill_to_disk_bytes_total", "counter",
+               "Cumulative bytes spilled host -> disk.")
+declare_metric("srtpu_semaphore_queue_depth", "gauge",
+               "Tasks currently blocked waiting on the device "
+               "semaphore, summed across live semaphores.")
+declare_metric("srtpu_semaphore_wait_seconds_total", "counter",
+               "Cumulative seconds tasks spent waiting on the device "
+               "semaphore.")
+declare_metric("srtpu_semaphore_acquires_total", "counter",
+               "Cumulative successful device-semaphore acquisitions.")
+declare_metric("srtpu_shuffle_block_store_bytes", "gauge",
+               "Serialized shuffle block bytes currently resident in "
+               "this process's block store(s).")
+declare_metric("srtpu_shuffle_block_store_blocks", "gauge",
+               "Shuffle blocks currently resident in this process's "
+               "block store(s).")
+declare_metric("srtpu_shuffle_put_bytes_total", "counter",
+               "Cumulative serialized bytes accepted by block-store "
+               "puts.")
+declare_metric("srtpu_shuffle_fetch_bytes_total", "counter",
+               "Cumulative serialized bytes served by block-store "
+               "fetches.")
+declare_metric("srtpu_shuffle_crc_rejects_total", "counter",
+               "Corrupt shuffle blocks rejected by CRC32C verification "
+               "(never stored/served).")
+declare_metric("srtpu_oom_retries_total", "counter",
+               "RetryOOM events absorbed by the retry framework.")
+declare_metric("srtpu_oom_splits_total", "counter",
+               "SplitAndRetryOOM events (input halved and retried).")
+declare_metric("srtpu_queries_total", "counter",
+               "Materialized queries, labeled status=ok|failed.")
+declare_metric("srtpu_query_seconds", "histogram",
+               "Whole-query wall time distribution (seconds).")
+declare_metric("srtpu_sampler_ticks_total", "counter",
+               "Background sampler passes completed.")
+declare_metric("srtpu_event_log_records_total", "counter",
+               "Records appended to the session event log.")

@@ -1,0 +1,113 @@
+"""Background gauge sampler for the metric registry.
+
+One daemon thread (``srtpu-metrics-sampler``) snapshots the runtime
+singletons — memory managers, device semaphores, shuffle block stores —
+into registry gauges every ``spark.rapids.tpu.metrics.sample.intervalMs``.
+Exporters also call :func:`sample_now` synchronously, so a snapshot is
+never staler than the moment it was asked for even with the thread
+disabled (interval <= 0).
+
+The sources are observed non-invasively: ``MemoryManager._instances``
+is the existing singleton table, semaphores and block servers register
+into weak sets at construction — a dead query's semaphore or a closed
+server just drops out of the sums.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from .registry import MetricRegistry
+
+__all__ = ["start_sampler", "stop_sampler", "sample_now",
+           "sampler_thread", "SAMPLER_THREAD_NAME"]
+
+SAMPLER_THREAD_NAME = "srtpu-metrics-sampler"
+
+_LOCK = threading.Lock()
+_THREAD: Optional[threading.Thread] = None
+_STOP = threading.Event()
+
+
+def sample_now(reg: MetricRegistry) -> None:
+    """One synchronous sample pass: set every sampled gauge (and mirror
+    the cumulative spill totals) from the live runtime singletons.
+    Gauges are always set — a worker that never spilled still exports a
+    zero series, so fleet dashboards have a lane per process."""
+    from ..mem.manager import MemoryManager
+    from ..mem import semaphore as sem_mod
+    from ..shuffle import transport as transport_mod
+
+    mm = MemoryManager.stats_all()
+    reg.gauge("srtpu_hbm_used_bytes").set(mm["device_used"])
+    reg.gauge("srtpu_hbm_budget_bytes").set(mm["budget"])
+    reg.gauge("srtpu_hbm_max_used_bytes").set(mm["max_device_used"])
+    reg.gauge("srtpu_spill_store_host_bytes").set(mm["host_used"])
+    reg.gauge("srtpu_spill_store_disk_bytes").set(mm["disk_used"])
+    reg.counter("srtpu_spill_to_host_bytes_total").set_total(
+        mm["spill_to_host_bytes"])
+    reg.counter("srtpu_spill_to_disk_bytes_total").set_total(
+        mm["spill_to_disk_bytes"])
+
+    sems = list(sem_mod._SEMAPHORES)
+    reg.gauge("srtpu_semaphore_queue_depth").set(
+        sum(s.waiting for s in sems))
+    # set_max, not set_total: semaphores/servers are weakly held, so a
+    # GC'd one falling out of the sum must not make the counter drop
+    reg.counter("srtpu_semaphore_wait_seconds_total").set_max(
+        round(sum(s.total_wait_s for s in sems), 6))
+    reg.counter("srtpu_semaphore_acquires_total").set_max(
+        sum(s.acquires for s in sems))
+
+    servers = list(transport_mod._SERVERS)
+    blocks = 0
+    nbytes = 0
+    rejects = 0
+    for srv in servers:
+        b, n = srv.store_stats()
+        blocks += b
+        nbytes += n
+        rejects += srv.crc_rejects
+    reg.gauge("srtpu_shuffle_block_store_blocks").set(blocks)
+    reg.gauge("srtpu_shuffle_block_store_bytes").set(nbytes)
+    reg.counter("srtpu_shuffle_crc_rejects_total").set_max(rejects)
+
+
+def _run(reg: MetricRegistry, interval_s: float) -> None:
+    ticks = reg.counter("srtpu_sampler_ticks_total")
+    while not _STOP.wait(interval_s):
+        try:
+            sample_now(reg)
+            ticks.inc()
+        except Exception:  # pragma: no cover - must never kill the thread
+            pass
+
+
+def start_sampler(reg: MetricRegistry, interval_ms: int) -> None:
+    """Start the daemon sampler thread (idempotent)."""
+    global _THREAD
+    with _LOCK:
+        if _THREAD is not None and _THREAD.is_alive():
+            return
+        _STOP.clear()
+        _THREAD = threading.Thread(
+            target=_run, args=(reg, max(0.01, interval_ms / 1000.0)),
+            name=SAMPLER_THREAD_NAME, daemon=True)
+        _THREAD.start()
+
+
+def stop_sampler() -> None:
+    """Stop and join the sampler thread (per-test reset)."""
+    global _THREAD
+    with _LOCK:
+        t, _THREAD = _THREAD, None
+        _STOP.set()
+    if t is not None and t.is_alive():
+        t.join(timeout=5.0)
+
+
+def sampler_thread() -> Optional[threading.Thread]:
+    """The live sampler thread, or None (test assertions that the
+    disabled path never starts one)."""
+    t = _THREAD
+    return t if (t is not None and t.is_alive()) else None

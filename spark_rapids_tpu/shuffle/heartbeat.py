@@ -27,22 +27,41 @@ class ShuffleHeartbeatManager:
         self._lock = threading.Lock()
         self._peers: Dict[str, dict] = {}
         self.stale_after_s = stale_after_s
+        #: latest metric-registry snapshot shipped per executor (ISSUE 5
+        #: distributed collection: heartbeats carry telemetry so idle
+        #: workers still report; task completions ship fresher ones)
+        self.metrics: Dict[str, dict] = {}
 
-    def register(self, executor_id: str, address: dict) -> List[dict]:
+    def register(self, executor_id: str, address: dict,
+                 metrics: Optional[dict] = None) -> List[dict]:
         """Register/heartbeat an executor; returns every LIVE peer (the
-        reference returns all known BlockManagerIds on each heartbeat)."""
+        reference returns all known BlockManagerIds on each heartbeat).
+        ``metrics`` optionally piggybacks the worker's registry
+        snapshot."""
         now = time.monotonic()
         with self._lock:
             self._peers[executor_id] = {"id": executor_id, "addr": address,
                                         "last": now}
+            if metrics is not None:
+                prev = self.metrics.get(executor_id)
+                if (prev is None or prev.get("__ts__", 0)
+                        <= metrics.get("__ts__", 0)):
+                    self.metrics[executor_id] = metrics
             self._evict(now)
             return [dict(p) for p in self._peers.values()]
+
+    def metrics_by_worker(self) -> Dict[str, dict]:
+        with self._lock:
+            return {k: dict(v) for k, v in self.metrics.items()}
 
     def _evict(self, now: float):
         dead = [k for k, v in self._peers.items()
                 if now - v["last"] > self.stale_after_s]
         for k in dead:
             del self._peers[k]
+            # a dead worker's frozen gauges must not be exported as a
+            # live lane (or inflate aggregate sums) forever
+            self.metrics.pop(k, None)
 
     def live_peers(self) -> List[str]:
         with self._lock:
@@ -63,15 +82,27 @@ class ShuffleHeartbeatEndpoint:
 
     def __init__(self, manager: ShuffleHeartbeatManager, executor_id: str,
                  address: Optional[dict] = None,
-                 on_new_peer: Optional[Callable[[dict], None]] = None):
+                 on_new_peer: Optional[Callable[[dict], None]] = None,
+                 metrics_provider: Optional[Callable[[], Optional[dict]]]
+                 = None):
         self.manager = manager
         self.executor_id = executor_id
         self.address = address or {}
         self.on_new_peer = on_new_peer
+        #: returns this process's registry snapshot (or None when
+        #: metrics are off) to piggyback on each heartbeat
+        self.metrics_provider = metrics_provider
         self._known = set()
 
     def heartbeat(self) -> List[dict]:
-        peers = self.manager.register(self.executor_id, self.address)
+        metrics = None
+        if self.metrics_provider is not None:
+            try:
+                metrics = self.metrics_provider()
+            except Exception:
+                metrics = None     # telemetry must never break discovery
+        peers = self.manager.register(self.executor_id, self.address,
+                                      metrics=metrics)
         for p in peers:
             if p["id"] != self.executor_id and p["id"] not in self._known:
                 self._known.add(p["id"])

@@ -58,13 +58,19 @@ import socketserver
 import struct
 import threading
 import time
+import weakref
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..metrics import registry as metrics_registry
 from ..trace import core as trace_core
 from .checksum import ChecksumError, crc32c
 
 __all__ = ["BlockServer", "BlockClient", "ShuffleFetchFailed",
            "ChecksumError", "RemoteTaskError"]
+
+#: live block servers, observed by the metrics sampler (block-store
+#: size per process); weak so a closed server drops out of the sums
+_SERVERS: "weakref.WeakSet" = weakref.WeakSet()
 
 
 class RemoteTaskError(RuntimeError):
@@ -275,6 +281,16 @@ class BlockServer:
         self._thread = threading.Thread(target=self._srv.serve_forever,
                                         daemon=True)
         self._thread.start()
+        _SERVERS.add(self)
+
+    def store_stats(self) -> Tuple[int, int]:
+        """(blocks, payload bytes) currently resident — the metrics
+        sampler's shuffle block-store gauges."""
+        with self._lock:
+            blocks = sum(len(v) for v in self._blocks.values())
+            nbytes = sum(len(d) for v in self._blocks.values()
+                         for _b, _c, d in v)
+        return blocks, nbytes
 
     def _put(self, shuffle: int, part: int, data: bytes,
              bid: Optional[str] = None, crc: Optional[int] = None):
@@ -285,6 +301,9 @@ class BlockServer:
             if bid is not None and any(b == bid for b, _c, _d in entries):
                 return             # idempotent re-put (task re-execution)
             entries.append((bid, crc, data))
+        mr = metrics_registry.REGISTRY   # one branch when metrics off
+        if mr is not None:
+            mr.counter("srtpu_shuffle_put_bytes_total").inc(len(data))
 
     def _fetch_entries(self, shuffle: int,
                        part: int) -> List[Tuple[Optional[str], int, bytes]]:
@@ -308,6 +327,10 @@ class BlockServer:
                     f"stored block corrupt: shuffle={shuffle} "
                     f"part={part} bid={bid}")
             out.append(data)
+        mr = metrics_registry.REGISTRY   # one branch when metrics off
+        if mr is not None:
+            mr.counter("srtpu_shuffle_fetch_bytes_total").inc(
+                sum(len(d) for d in out))
         return out
 
     def _drop(self, shuffle: int):

@@ -1,0 +1,292 @@
+"""Query-history tool over the rotating event log (metrics/events.py).
+
+The offline half of the observability subsystem — the role the Spark
+History Server + the spark-rapids qualification/profiling tools play
+over Spark event logs. Reads a log directory (rotated
+``events-<seq>.jsonl`` files oldest-first, then the active
+``events.jsonl``), pairs queryStart/queryEnd records, and renders:
+
+* the query history (``python -m spark_rapids_tpu.tools.history DIR``),
+* the slowest queries (``--slowest N``),
+* a deterministic run-over-run regression diff between two logs
+  (``--diff OTHER_DIR``), matching queries by plan digest,
+* a metrics-snapshot summary (``--metrics-file snap.json``) over the
+  JSON artifacts bench.py emits per rung.
+
+Crash tolerance: a crash-truncated (or otherwise undecodable) line is
+skipped and counted, never fatal — the log is written line-at-a-time
+precisely so everything before the crash stays readable. Stdlib-only
+and deterministic: identical logs render identical reports.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["load_events", "build_history", "format_history",
+           "format_slowest", "diff_histories", "format_diff",
+           "summarize_metrics_file", "main"]
+
+#: registry series the metrics-snapshot summary surfaces (must exist in
+#: the MetricRegistry inventory — enforced by the metric-name-drift
+#: lint rule)
+KEY_METRICS = [
+    "srtpu_hbm_used_bytes",
+    "srtpu_hbm_budget_bytes",
+    "srtpu_spill_to_host_bytes_total",
+    "srtpu_spill_to_disk_bytes_total",
+    "srtpu_semaphore_wait_seconds_total",
+    "srtpu_shuffle_block_store_bytes",
+    "srtpu_oom_retries_total",
+    "srtpu_oom_splits_total",
+    "srtpu_queries_total",
+]
+
+
+def _log_files(path: str) -> List[str]:
+    """Event-log files oldest-first for a directory (rotation order) or
+    a single file path."""
+    if os.path.isfile(path):
+        return [path]
+    try:
+        names = os.listdir(path)
+    except OSError:
+        return []
+    rotated = []
+    for n in names:
+        if n.startswith("events-") and n.endswith(".jsonl"):
+            try:
+                rotated.append((int(n[len("events-"):-len(".jsonl")]), n))
+            except ValueError:
+                continue
+    out = [os.path.join(path, n) for _, n in sorted(rotated)]
+    active = os.path.join(path, "events.jsonl")
+    if os.path.exists(active):
+        out.append(active)
+    return out
+
+
+def load_events(path: str) -> Tuple[List[dict], int]:
+    """All decodable records oldest-first plus the count of skipped
+    (truncated/corrupt) lines."""
+    events: List[dict] = []
+    skipped = 0
+    for f in _log_files(path):
+        with open(f, encoding="utf-8", errors="replace") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except (json.JSONDecodeError, ValueError):
+                    skipped += 1       # crash-truncated tail, etc.
+                    continue
+                if isinstance(rec, dict):
+                    events.append(rec)
+                else:
+                    skipped += 1
+    return events, skipped
+
+
+def build_history(events: List[dict]) -> List[dict]:
+    """Pair queryStart/queryEnd into one record per query, in start
+    order. A start without an end (crash mid-query) renders with
+    status ``lost``."""
+    starts: Dict[object, dict] = {}
+    out: List[dict] = []
+    for rec in events:
+        kind = rec.get("event")
+        if kind == "queryStart":
+            q = {"queryId": rec.get("queryId"),
+                 "planDigest": rec.get("planDigest"),
+                 "root": rec.get("root"),
+                 "startTs": rec.get("ts"),
+                 "status": "lost", "durationMs": None,
+                 "trace": None, "faultStats": None, "metrics": None}
+            starts[rec.get("queryId")] = q
+            out.append(q)
+        elif kind == "queryEnd":
+            q = starts.pop(rec.get("queryId"), None)
+            if q is None:             # end without a start (rotated away)
+                q = {"queryId": rec.get("queryId"),
+                     "planDigest": rec.get("planDigest"),
+                     "root": None, "startTs": None}
+                out.append(q)
+            q["status"] = "ok" if rec.get("ok") else "failed"
+            q["durationMs"] = rec.get("durationMs")
+            q["trace"] = rec.get("trace")
+            q["faultStats"] = rec.get("faultStats")
+            q["metrics"] = rec.get("metrics")
+    return out
+
+
+def _fmt_ms(v) -> str:
+    return "-" if v is None else f"{float(v):10.1f}"
+
+
+def format_history(history: List[dict], skipped: int = 0,
+                   source: str = "") -> str:
+    lines = [f"== Query history ({source or 'event log'}) ==",
+             f"{'id':>4}  {'status':<7} {'ms':>10}  "
+             f"{'digest':<16}  root"]
+    for q in history:
+        lines.append(
+            f"{str(q.get('queryId') or '?'):>4}  "
+            f"{q.get('status') or '?':<7} "
+            f"{_fmt_ms(q.get('durationMs'))}  "
+            f"{str(q.get('planDigest') or '?'):<16}  "
+            f"{q.get('root') or '?'}")
+    ok = sum(1 for q in history if q.get("status") == "ok")
+    failed = sum(1 for q in history if q.get("status") == "failed")
+    lost = sum(1 for q in history if q.get("status") == "lost")
+    lines.append(f"{len(history)} queries: {ok} ok, {failed} failed, "
+                 f"{lost} lost; {skipped} undecodable line(s) skipped")
+    return "\n".join(lines) + "\n"
+
+
+def format_slowest(history: List[dict], n: int) -> str:
+    timed = [q for q in history if q.get("durationMs") is not None]
+    timed.sort(key=lambda q: (-float(q["durationMs"]),
+                              str(q.get("queryId"))))
+    lines = [f"== Slowest {min(n, len(timed))} queries =="]
+    for q in timed[:n]:
+        lines.append(f"{_fmt_ms(q['durationMs'])} ms  "
+                     f"id={q.get('queryId')}  "
+                     f"digest={q.get('planDigest')}  "
+                     f"{q.get('root') or '?'}")
+    return "\n".join(lines) + "\n"
+
+
+def diff_histories(a: List[dict], b: List[dict]) -> List[dict]:
+    """Regression diff: queries matched by plan digest; per digest the
+    MIN ok duration of each side is compared (min is the stable
+    estimator the bench harness uses). Deterministic: sorted by ratio
+    descending then digest."""
+    def by_digest(h):
+        out: Dict[str, List[float]] = {}
+        for q in h:
+            if q.get("status") == "ok" and q.get("durationMs") is not None:
+                out.setdefault(str(q.get("planDigest")), []).append(
+                    float(q["durationMs"]))
+        return out
+
+    da, db = by_digest(a), by_digest(b)
+    rows = []
+    for digest in sorted(set(da) & set(db)):
+        base, new = min(da[digest]), min(db[digest])
+        rows.append({"digest": digest, "baseMs": round(base, 3),
+                     "newMs": round(new, 3),
+                     "ratio": round(new / base, 4) if base > 0 else None,
+                     "nBase": len(da[digest]), "nNew": len(db[digest])})
+    rows.sort(key=lambda r: (-(r["ratio"] or 0.0), r["digest"]))
+    only_a = sorted(set(da) - set(db))
+    only_b = sorted(set(db) - set(da))
+    if only_a:
+        rows.append({"digest": None, "onlyBase": only_a})
+    if only_b:
+        rows.append({"digest": None, "onlyNew": only_b})
+    return rows
+
+
+def format_diff(rows: List[dict], a: str, b: str) -> str:
+    lines = [f"== Regression diff: {a} -> {b} ==",
+             f"{'digest':<16}  {'base ms':>10}  {'new ms':>10}  "
+             f"{'ratio':>7}  n"]
+    for r in rows:
+        if r.get("digest") is None:
+            for k, label in (("onlyBase", "only in base"),
+                             ("onlyNew", "only in new")):
+                if r.get(k):
+                    lines.append(f"{label}: {', '.join(r[k])}")
+            continue
+        flag = ""
+        if r["ratio"] is not None and r["ratio"] >= 1.2:
+            flag = "  REGRESSED"
+        elif r["ratio"] is not None and r["ratio"] <= 0.8:
+            flag = "  improved"
+        lines.append(f"{r['digest']:<16}  {r['baseMs']:>10.1f}  "
+                     f"{r['newMs']:>10.1f}  "
+                     f"{r['ratio'] if r['ratio'] is not None else '-':>7}"
+                     f"  {r['nBase']}/{r['nNew']}{flag}")
+    return "\n".join(lines) + "\n"
+
+
+def summarize_metrics_file(path: str) -> str:
+    """Render the KEY_METRICS series of a JSON snapshot artifact (the
+    ``details[rung]["metrics"]`` file bench.py emits)."""
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    snap = doc.get("snapshot", doc)
+    lines = [f"== Metrics snapshot ({os.path.basename(path)}) =="]
+    for name in KEY_METRICS:
+        ent = snap.get(name)
+        if not ent:
+            continue
+        for s in ent.get("series", []):
+            labels = s.get("labels") or {}
+            ltxt = ("{" + ",".join(f"{k}={v}" for k, v
+                                   in sorted(labels.items())) + "}"
+                    if labels else "")
+            if ent.get("kind") == "histogram":
+                lines.append(f"{name}{ltxt} count={s.get('count')} "
+                             f"sum={s.get('sum')}")
+            else:
+                lines.append(f"{name}{ltxt} {s.get('value')}")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m spark_rapids_tpu.tools.history",
+        description="Render / diff spark-rapids-tpu query event logs "
+                    "(docs/monitoring.md).")
+    ap.add_argument("log", nargs="?", help="event-log directory or file")
+    ap.add_argument("--slowest", type=int, metavar="N",
+                    help="top-N slowest queries")
+    ap.add_argument("--diff", metavar="OTHER",
+                    help="regression diff against OTHER log (this log "
+                         "is the baseline)")
+    ap.add_argument("--metrics-file", metavar="SNAP",
+                    help="summarize a JSON metrics-snapshot artifact")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+    if args.metrics_file:
+        if args.json:
+            with open(args.metrics_file, encoding="utf-8") as f:
+                print(json.dumps(json.load(f), sort_keys=True))
+        else:
+            print(summarize_metrics_file(args.metrics_file), end="")
+        return 0
+    if not args.log:
+        ap.error("an event-log path is required (or --metrics-file)")
+    events, skipped = load_events(args.log)
+    history = build_history(events)
+    if args.diff:
+        other_events, _ = load_events(args.diff)
+        other = build_history(other_events)
+        rows = diff_histories(history, other)
+        if args.json:
+            print(json.dumps(rows, sort_keys=True))
+        else:
+            print(format_diff(rows, args.log, args.diff), end="")
+        return 0
+    if args.slowest:
+        if args.json:
+            timed = [q for q in history
+                     if q.get("durationMs") is not None]
+            timed.sort(key=lambda q: (-float(q["durationMs"]),
+                                      str(q.get("queryId"))))
+            print(json.dumps(timed[:args.slowest], sort_keys=True))
+        else:
+            print(format_slowest(history, args.slowest), end="")
+        return 0
+    if args.json:
+        print(json.dumps({"history": history, "skipped": skipped},
+                         sort_keys=True))
+    else:
+        print(format_history(history, skipped, source=args.log), end="")
+    return 0

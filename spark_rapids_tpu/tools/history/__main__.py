@@ -1,0 +1,6 @@
+"""CLI entry: ``python -m spark_rapids_tpu.tools.history <log-dir>``."""
+import sys
+
+from . import main
+
+sys.exit(main())

@@ -24,13 +24,16 @@ from .framework import (FileContext, FileRule, Finding, LintResult,
 from .rules_retry import RetryIdempotenceRule
 from .rules_lifetime import BatchLifetimeRule
 from .rules_hostsync import HostSyncRule
-from .rules_drift import ConfigKeyDriftRule, OpsDocDriftRule
+from .rules_drift import (ConfigKeyDriftRule, MetricNameDriftRule,
+                          OpsDocDriftRule)
 
 #: every shipped rule, in reporting order
 ALL_RULES = [RetryIdempotenceRule(), BatchLifetimeRule(), HostSyncRule(),
-             ConfigKeyDriftRule(), OpsDocDriftRule()]
+             ConfigKeyDriftRule(), OpsDocDriftRule(),
+             MetricNameDriftRule()]
 
 __all__ = ["ALL_RULES", "FileContext", "FileRule", "Finding", "LintResult",
            "ProjectRule", "Rule", "lint_source", "load_baseline", "run_lint",
            "write_baseline", "RetryIdempotenceRule", "BatchLifetimeRule",
-           "HostSyncRule", "ConfigKeyDriftRule", "OpsDocDriftRule"]
+           "HostSyncRule", "ConfigKeyDriftRule", "OpsDocDriftRule",
+           "MetricNameDriftRule"]

@@ -10,8 +10,13 @@ registries.
 * ``ops-doc-drift`` — ``docs/supported_ops.md`` must be byte-identical
   to the live ``tools/supported_ops.generate_supported_ops_md()``. Ref:
   TypeChecks.scala:1709 SupportedOpsDocs generation.
+* ``metric-name-drift`` — every ``srtpu_*`` metric name referenced in
+  ``docs/monitoring.md`` or in the ``tools/history`` sources must exist
+  in the ``MetricRegistry`` inventory (metrics/registry.py
+  ``_INVENTORY``) — the config-key-drift contract applied to the
+  metric catalog.
 
-Both rules import the live registries; when that import itself fails
+All rules import the live registries; when that import itself fails
 (broken interpreter environment) they degrade to a single ``tool-error``
 finding instead of crashing the lint run.
 """
@@ -20,6 +25,7 @@ from __future__ import annotations
 import ast
 import difflib
 import os
+import re
 from typing import Callable, Iterable, List, Optional, Sequence, Set
 
 from .framework import FileContext, Finding, ProjectRule
@@ -138,6 +144,81 @@ class ConfigKeyDriftRule(ProjectRule):
                 "tool-error", os.path.join("docs", "configs.md"), 1,
                 f"{self.name}: cannot generate expected docs: "
                 f"{type(e).__name__}: {e}", key="docgen"))
+        return findings
+
+
+#: token shape of registry metric names (metrics/registry.py catalog)
+METRIC_TOKEN = re.compile(r"\bsrtpu_[a-z][a-z0-9_]*\b")
+
+#: Prometheus histogram exposition suffixes: ``<name>_bucket`` /
+#: ``_sum`` / ``_count`` are derived series of a declared histogram,
+#: not separately-declared names
+_HISTOGRAM_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def _load_metric_inventory() -> Set[str]:
+    from ...metrics.registry import metric_inventory
+    return set(metric_inventory())
+
+
+class MetricNameDriftRule(ProjectRule):
+    name = "metric-name-drift"
+    contract = ("every srtpu_* metric name referenced in "
+                "docs/monitoring.md or tools/history must exist in the "
+                "metrics/registry.py inventory — the config-key-drift "
+                "contract applied to the metric catalog")
+
+    #: sources scanned for metric-name references, relative to root
+    DOC_RELS = (os.path.join("docs", "monitoring.md"),)
+    SOURCE_PREFIX = os.path.join("spark_rapids_tpu", "tools", "history")
+
+    def __init__(self, inventory_loader: Optional[Callable[[], Set[str]]]
+                 = None):
+        self._inventory_loader = (inventory_loader
+                                  or _load_metric_inventory)
+
+    def _known(self, token: str, inv: Set[str]) -> bool:
+        if token in inv:
+            return True
+        for suf in _HISTOGRAM_SUFFIXES:
+            if token.endswith(suf) and token[:-len(suf)] in inv:
+                return True
+        return False
+
+    def _scan_text(self, rel: str, text: str,
+                   inv: Set[str]) -> Iterable[Finding]:
+        for lineno, line in enumerate(text.splitlines(), start=1):
+            for m in METRIC_TOKEN.finditer(line):
+                token = m.group(0)
+                if not self._known(token, inv):
+                    yield Finding(
+                        self.name, rel, lineno,
+                        f"metric name '{token}' is not in the "
+                        "MetricRegistry inventory — typo, or a "
+                        "declare_metric() was removed without updating "
+                        "this reference", key=f"unknown:{token}")
+
+    def check_project(self, ctxs: Sequence[FileContext],
+                      root: str) -> Iterable[Finding]:
+        try:
+            inv = self._inventory_loader()
+        except Exception as e:                    # degraded environment
+            return [Finding(
+                "tool-error", "spark_rapids_tpu/metrics/registry.py", 1,
+                f"{self.name}: cannot load metric inventory: "
+                f"{type(e).__name__}: {e}", key="inventory-load")]
+        findings: List[Finding] = []
+        for rel in self.DOC_RELS:
+            path = os.path.join(root, rel)
+            if not os.path.exists(path):
+                continue            # the docs rule owns missing-doc noise
+            with open(path, encoding="utf-8") as f:
+                findings.extend(self._scan_text(rel, f.read(), inv))
+        for ctx in ctxs:
+            if not ctx.rel.replace(os.sep, "/").startswith(
+                    self.SOURCE_PREFIX.replace(os.sep, "/")):
+                continue
+            findings.extend(self._scan_text(ctx.rel, ctx.source, inv))
         return findings
 
 

@@ -93,6 +93,8 @@ def _load_registries():
               "spark_rapids_tpu.aux.lore",
               "spark_rapids_tpu.aux.fault",
               "spark_rapids_tpu.trace.core",
+              "spark_rapids_tpu.metrics.registry",
+              "spark_rapids_tpu.metrics.events",
               "spark_rapids_tpu.udf.compiler",
               "spark_rapids_tpu.delta.table",
               "spark_rapids_tpu.delta.scan",

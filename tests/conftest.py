@@ -65,6 +65,17 @@ def _clear_tracer():
 
 
 @pytest.fixture(autouse=True)
+def _clear_metrics():
+    """The metric registry and its sampler thread are process-global
+    (metrics/registry.py, like the tracer); a test that enables metrics
+    must not leave the rest of the suite recording — or a sampler
+    thread running — behind its back."""
+    yield
+    from spark_rapids_tpu.metrics import shutdown_metrics
+    shutdown_metrics()
+
+
+@pytest.fixture(autouse=True)
 def _assert_no_leaked_spillables():
     """Suite-wide zero-leak check (ref cudf MemoryCleaner at shutdown,
     Plugin.scala:573-588): every SpillableBatch must be closed by the

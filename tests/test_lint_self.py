@@ -45,3 +45,49 @@ def test_no_tool_errors():
                       baseline={}, root=REPO_ROOT)
     errors = [f for f in result.findings if f.rule == "tool-error"]
     assert errors == [], [repr(f) for f in errors]
+
+
+def test_metric_name_drift_detects_unknown_names(tmp_path):
+    """Self-test of the metric-name-drift rule: an undeclared srtpu_*
+    name in docs/monitoring.md or a tools/history source is flagged;
+    declared names — including histogram _bucket/_sum/_count exposition
+    suffixes — are not."""
+    import os as _os
+    from spark_rapids_tpu.tools.lint.framework import FileContext
+    from spark_rapids_tpu.tools.lint.rules_drift import MetricNameDriftRule
+    rule = MetricNameDriftRule(
+        inventory_loader=lambda: {"srtpu_good_total",
+                                  "srtpu_query_seconds"})
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "monitoring.md").write_text(
+        "| srtpu_good_total | counter |\n"
+        "| srtpu_query_seconds_bucket | series |\n"
+        "| srtpu_bogus_total | counter |\n")
+    hist_rel = _os.path.join("spark_rapids_tpu", "tools", "history",
+                             "__init__.py")
+    ctxs = [
+        FileContext(hist_rel, 'KEY = ["srtpu_good_total",\n'
+                              '       "srtpu_typo_bytes"]\n',
+                    rel=hist_rel),
+        # files outside tools/history are NOT scanned by this rule
+        FileContext("spark_rapids_tpu/other.py",
+                    'X = "srtpu_not_scanned_here"\n',
+                    rel="spark_rapids_tpu/other.py"),
+    ]
+    findings = list(rule.check_project(ctxs, str(tmp_path)))
+    keys = sorted(f.key for f in findings)
+    assert keys == ["unknown:srtpu_bogus_total",
+                    "unknown:srtpu_typo_bytes"], findings
+    paths = {f.key: f.path for f in findings}
+    assert paths["unknown:srtpu_bogus_total"].endswith("monitoring.md")
+    assert paths["unknown:srtpu_typo_bytes"] == hist_rel
+
+
+def test_metric_name_drift_clean_on_shipped_catalog():
+    # the live inventory covers every name the shipped docs + history
+    # tool reference (the drift contract this rule enforces)
+    from spark_rapids_tpu.tools.lint.rules_drift import MetricNameDriftRule
+    result = run_lint([PKG_ROOT], rules=[MetricNameDriftRule()],
+                      baseline={}, root=REPO_ROOT)
+    assert [f for f in result.findings] == [], result.findings
