@@ -895,7 +895,13 @@ class DataFrame:
         elif mode == "analyze":
             s = self._explain_analyze()
         else:
-            s = self._physical().tree_string()
+            physical = self._physical()
+            s = physical.tree_string()
+            decision = getattr(physical, "placement_decision", None)
+            if decision:
+                # the cost optimizer's recorded WHY: a plan staying on
+                # host explains itself from the EXPLAIN output alone
+                s = f"placement: {decision}\n" + s
         print(s)
         return s
 
@@ -914,7 +920,11 @@ class DataFrame:
             return physical.collect(ctx)
 
         self._execute_wrapped(consume)
-        return render_analyzed_plan(holder["physical"], holder["ctx"])
+        out = render_analyzed_plan(holder["physical"], holder["ctx"])
+        decision = getattr(holder["physical"], "placement_decision", None)
+        if decision:
+            out = f"placement: {decision}\n" + out
+        return out
 
 
 class GroupedData:

@@ -54,6 +54,11 @@ class ExecContext:
         trace_core.ensure_tracer_from_conf(self.conf)
         from ..metrics import registry as metrics_registry
         metrics_registry.ensure_metrics_from_conf(self.conf)
+        # persistent executable tier: point jax's compilation cache at
+        # the conf'd dir + trim to budget (one lookup per query context,
+        # never per kernel — plan/exec_cache.py)
+        from ..plan import exec_cache
+        exec_cache.configure_from_conf(self.conf)
         self.semaphore = semaphore or DeviceSemaphore(
             self.conf.concurrent_tpu_tasks)
         self.memory = memory or MemoryManager.get(self.conf)
@@ -184,7 +189,10 @@ class TpuExec:
         the profile analyzer can compute SELF time — where a query's
         wall actually goes, not just cumulative subtree time."""
         name = type(self).__name__
-        args = {"exec": self._exec_id}
+        # fused regions annotate their span with the operators they
+        # swallowed (exec/wholestage.py trace_args = {"fused": [...]})
+        args = {"exec": self._exec_id,
+                **getattr(self, "trace_args", {})}
         it = iter(it)
         while True:
             with tr.span(name, cat="exec", args=args):

@@ -13,8 +13,7 @@ from ..columnar import (ColumnarBatch, DeviceColumn, HostColumn,
                         concat_batches)
 from ..columnar.bucketing import bucket_for
 from ..exprs.base import Expression
-from ..exprs.compiler import (compile_projection, eval_predicate_device,
-                              filter_batch_device, _compact_kernel)
+from ..exprs.compiler import compile_projection, filter_batch_device
 from ..types import INT64, Schema, StructField
 from .base import DEBUG, ESSENTIAL, ExecContext, TpuExec
 
@@ -185,7 +184,6 @@ class TpuProjectExec(TpuExec):
         #: out ordinal -> (chain root, leaf name): device byte-rectangle
         #: string chains (high cardinality — exprs/string_rect.py)
         self.rect_chain = {}
-        self._rect_kernels = {}
         from ..exprs.base import Alias, ColumnRef
         for i, e in enumerate(self.exprs):
             inner = e.children[0] if isinstance(e, Alias) else e
@@ -279,23 +277,15 @@ class TpuProjectExec(TpuExec):
     def _rect_eval(self, expr, col, ordinal: int, width_cap: int,
                    use_pallas: bool = False):
         """One jitted kernel for a whole rect string chain (upper/trim/
-        substring/... fused), cached per (expr, width, padded, cap)."""
-        import jax
+        substring/... fused), resolved through the PROCESS-wide
+        executable cache keyed on (expr, width, padded, cap): a
+        per-exec kernel dict re-traced the chain on every query — the
+        string_transforms_100k 17.3 s warm cliff (ISSUE 6)."""
         from ..columnar.strrect import ByteRectColumn
-        from ..exprs.base import DVal, StrVal
-        from ..exprs.string_rect import eval_rect_chain
-        from ..types import STRING
-        key = (expr.key(), col.width, col.padded_len, width_cap,
-               use_pallas)
-        fn = self._rect_kernels.get(key)
-        if fn is None:
-            @jax.jit
-            def fn(bytes_, lengths, validity, e=expr):
-                outv = eval_rect_chain(
-                    e, DVal(StrVal(bytes_, lengths), validity, STRING),
-                    width_cap=width_cap, use_pallas=use_pallas)
-                return outv.data, outv.validity
-            self._rect_kernels[key] = fn
+        from ..exprs.base import StrVal
+        from ..exprs.compiler import compile_rect_chain
+        fn = compile_rect_chain(expr, col.width, col.padded_len,
+                                width_cap, use_pallas)
         data, valid = fn(col.data, col.lengths, col.validity)
         if isinstance(data, StrVal):
             return ByteRectColumn(data.bytes_, valid, data.lengths,
@@ -504,23 +494,8 @@ class TpuFilterExec(TpuExec):
                 batch.to_arrow().filter(mask))
 
     def _filter_mixed(self, batch: ColumnarBatch) -> ColumnarBatch:
-        """Device columns compact on device; host columns filter via Arrow
-        with the same mask. When the condition itself references a column
-        that is host-resident in THIS batch (e.g. a width-capped list,
-        columnar/nested.py), the whole batch filters on host."""
-        from ..columnar import DeviceColumn as _DC
-        from ..exprs.compiler import filter_batch_by_mask
-        refs = set(self.condition.references())
-        names = batch.schema.names()
-        if any(nm in refs and not isinstance(batch.column_by_name(nm), _DC)
-               for nm in names):
-            import pyarrow.compute as pc
-            mask = pc.fill_null(self.condition.eval_host(batch), False)
-            out = ColumnarBatch.from_arrow(batch.to_arrow().filter(mask))
-            out.meta = dict(batch.meta)   # keep partition_id/input_file
-            return out
-        keep = eval_predicate_device(self.condition, batch)
-        return filter_batch_by_mask(batch, keep)
+        from ..exprs.compiler import filter_mixed_batch
+        return filter_mixed_batch(self.condition, batch)
 
     def describe(self):
         return f"Filter[{self.condition.name_hint}]"

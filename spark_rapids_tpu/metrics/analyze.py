@@ -47,6 +47,23 @@ def render_analyzed_plan(physical, ctx) -> str:
         except (TypeError, ValueError):
             return 0.0
 
+    def fused_lines(node, indent: int) -> str:
+        """Per-operator breakdown INSIDE a fused region
+        (exec/wholestage.py): the fused ops are not children in the
+        iterator chain, but the region records each one's output rows
+        (exact, from the kernel's per-stage survivor counts) and its
+        apportioned share of the fused dispatch wall — so EXPLAIN
+        ANALYZE keeps per-op rows and self time through fusion."""
+        out = ""
+        for op in getattr(node, "fused_ops", ()):
+            ms = summary.get(op._exec_id) or {}
+            t = node_time(op)
+            ann = (f"rows={_fmt_count(ms.get('numOutputRows'))} "
+                   f"batches={_fmt_count(ms.get('numOutputBatches'))} "
+                   f"self={_fmt_ms(t)}")
+            out += "  " * (indent + 1) + f"+ {op.describe()} [{ann}]\n"
+        return out
+
     def walk(node, indent: int) -> str:
         ms = summary.get(node._exec_id) or {}
         cum = node_time(node)
@@ -57,6 +74,7 @@ def render_analyzed_plan(physical, ctx) -> str:
                f"time={_fmt_ms(cum)} self={_fmt_ms(self_s)}")
         marker = "*" if node.is_tpu else "!"
         line = "  " * indent + f"{marker} {node.describe()} [{ann}]\n"
+        line += fused_lines(node, indent)
         return line + "".join(walk(c, indent + 1)
                               for c in node.children)
 

@@ -331,5 +331,20 @@ declare_metric("srtpu_query_seconds", "histogram",
                "Whole-query wall time distribution (seconds).")
 declare_metric("srtpu_sampler_ticks_total", "counter",
                "Background sampler passes completed.")
+declare_metric("srtpu_compile_cache_hits_total", "counter",
+               "In-process executable-cache hits: a kernel request "
+               "served by an already-built jitted callable "
+               "(plan/exec_cache.py) — zero retrace, zero compile.")
+declare_metric("srtpu_compile_cache_misses_total", "counter",
+               "In-process executable-cache misses (a new kernel was "
+               "built; XLA compile may still be served by the "
+               "persistent tier).")
+declare_metric("srtpu_compile_persistent_hits_total", "counter",
+               "Compiles served by the persistent on-disk executable "
+               "tier (JAX compilation-cache deserialization) instead "
+               "of a fresh XLA compile.")
+declare_metric("srtpu_compile_seconds_total", "counter",
+               "Cumulative XLA backend-compile seconds this process "
+               "actually paid (persistent-tier hits pay none).")
 declare_metric("srtpu_event_log_records_total", "counter",
                "Records appended to the session event log.")

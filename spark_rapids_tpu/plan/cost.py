@@ -280,7 +280,7 @@ def load_persisted_stats() -> None:
     dicts — idempotent, called lazily before the first read."""
     if _persist_enabled():
         from . import stats_store
-        stats_store.load_into(_ENGINE_WALLS, _RUNTIME_ROWS)
+        stats_store.load_into(_ENGINE_WALLS, _RUNTIME_ROWS, _OP_COSTS)
 
 
 def record_engine_wall(sig: str, placement: str, seconds: float) -> None:
@@ -301,6 +301,40 @@ def trusted_engine_wall(sig: str, placement: str):
     if got is None or got[0] < 2:
         return None
     return got[1]
+
+
+#: learned per-row operator costs from LIVE self-times, keyed
+#: (operator kind, placement) -> (rows processed, seconds): the metrics
+#: registry already measures every operator — feeding those walls back
+#: here replaces the static per-row guesses with what this machine
+#: actually measured (e.g. fused device stages are priced from their
+#: real dispatch walls, exec/wholestage.py). Persisted with the other
+#: adaptive stats (stats_store.py).
+_OP_COSTS: dict = {}
+#: rows an operator kind must have processed before its learned cost is
+#: trusted (tiny samples are all dispatch floor, not per-row cost)
+_OP_COST_MIN_ROWS = 65536
+
+
+def record_op_wall(kind: str, placement: str, rows: int,
+                   seconds: float) -> None:
+    if rows <= 0 or seconds <= 0.0:
+        return
+    k = (kind, placement)
+    r, s = _OP_COSTS.get(k, (0, 0.0))
+    _OP_COSTS[k] = (r + int(rows), s + float(seconds))
+    if _persist_enabled():
+        from . import stats_store
+        stats_store.mark_dirty()
+
+
+def learned_row_cost(kind: str, placement: str):
+    """Measured seconds/row for an operator kind, or None before the
+    sample is trustworthy."""
+    got = _OP_COSTS.get((kind, placement))
+    if got is None or got[0] < _OP_COST_MIN_ROWS:
+        return None
+    return got[1] / got[0]
 
 
 class RowsAccum:
@@ -380,7 +414,7 @@ class _Cost:
 
 
 def apply_cost_optimizer(meta: PlanMeta, conf: TpuConf,
-                         wall_sig: Optional[str] = None) -> None:
+                         wall_sig: Optional[str] = None) -> str:
     """Revert TPU-capable nodes whose device placement is not worth it.
 
     Two decisions, both the reference's CostBasedOptimizer idea adapted to
@@ -394,7 +428,10 @@ def apply_cost_optimizer(meta: PlanMeta, conf: TpuConf,
         floor no matter how fast the kernels are; measured row feedback
         (_RUNTIME_ROWS) makes the second planning of a shape exact.
 
-    Mutates metas via will_not_work_on_tpu."""
+    Mutates metas via will_not_work_on_tpu. Returns a one-line placement
+    decision ("device (...)" / "host (...)") recording WHY, which
+    EXPLAIN prints — a stage staying on host is explained by the plan
+    output itself."""
     load_persisted_stats()
     # the registered defaults are per-row costs for the reference's
     # row-interpreter; this engine's host twin is vectorized — treat the
@@ -402,6 +439,12 @@ def apply_cost_optimizer(meta: PlanMeta, conf: TpuConf,
     # existing knobs still steer the model
     cpu_scale = conf.get(CPU_EXEC_COST) / 2.0e-4
     tpu_c = conf.get(TPU_EXEC_COST) / 1.0e-4 * 2.0e-9
+    # live per-operator self-times trump the static device guess — but
+    # ONLY for the node kinds the measurement covers: fused regions
+    # measure filter/project rows (record_op_wall from
+    # exec/wholestage.py), so a cheap fused wall must not also discount
+    # joins/sorts/aggregates it never timed
+    fused_c = learned_row_cost("WholeStageExec", "device")
     trans_c = conf.get(TRANSITION_COST)
     floor = float(conf.get(DEVICE_QUERY_FLOOR))
 
@@ -417,8 +460,14 @@ def apply_cost_optimizer(meta: PlanMeta, conf: TpuConf,
         host_node = _host_node_cost(m.plan, rows_in, cpu_scale)
         # scans decode on host for BOTH engines (the H2D is the floor's /
         # transition's job) — placement-neutral, never worth reverting
-        node_tpu_c = (0.0 if isinstance(
-            m.plan, (L.LogicalScan, L.ParquetScan)) else tpu_c)
+        if isinstance(m.plan, (L.LogicalScan, L.ParquetScan)):
+            node_tpu_c = 0.0
+        elif fused_c is not None and isinstance(m.plan,
+                                                (L.Filter, L.Project)):
+            # fusible node kinds price from the measured fused walls
+            node_tpu_c = min(tpu_c, fused_c)
+        else:
+            node_tpu_c = tpu_c
         if not m.can_run_on_tpu:
             # host-only: children feeding it from device pay a D2H transition
             host = host_node + sum(
@@ -485,15 +534,18 @@ def apply_cost_optimizer(meta: PlanMeta, conf: TpuConf,
         if dw <= hw:
             log.debug("cost optimizer: measured device wall %.4fs beats "
                       "host %.4fs — device wholesale", dw, hw)
-            return
+            return (f"device (measured device wall {dw:.4f}s beats host "
+                    f"{hw:.4f}s)")
         revert_all(meta, (f"cost-based: measured host wall {hw:.4f}s "
                           f"beats device {dw:.4f}s"))
-        return
+        return (f"host (measured host wall {hw:.4f}s beats device "
+                f"{dw:.4f}s)")
     if hw is not None and dw is None \
             and dev_model + floor < hw:
         log.debug("cost optimizer: exploring device (model %.4fs + floor "
                   "< measured host %.4fs)", dev_model, hw)
-        return
+        return (f"device (exploring: model {dev_model:.4f}s + floor < "
+                f"measured host {hw:.4f}s)")
     if dw is not None and hw is None and host_only < dw:
         # symmetric: a device-first shape measuring slow must TRY the
         # host twin once, or it stays on the slow engine forever
@@ -502,7 +554,8 @@ def apply_cost_optimizer(meta: PlanMeta, conf: TpuConf,
                           f"{dw:.4f}s)"))
         log.debug("cost optimizer: exploring host (model %.4fs < "
                   "measured device %.4fs)", host_only, dw)
-        return
+        return (f"host (exploring: model {host_only:.4f}s < measured "
+                f"device {dw:.4f}s)")
     for m, reason in pending_reverts:
         m.will_not_work_on_tpu(reason)
         log.debug("cost optimizer reverted %s", type(m.plan).__name__)
@@ -511,3 +564,9 @@ def apply_cost_optimizer(meta: PlanMeta, conf: TpuConf,
                   f"beats device {dev_est:.4f}s (incl. floor)")
         revert_all(meta, reason)
         log.debug("cost optimizer reverted whole plan to host (%s)", reason)
+        return (f"host ({how} {host_est:.4f}s beats device "
+                f"{dev_est:.4f}s incl. floor)")
+    return (f"device ({how}: device {dev_est:.4f}s incl. floor vs host "
+            f"{host_est:.4f}s"
+            + (f"; {len(pending_reverts)} subtree(s) reverted"
+               if pending_reverts else "") + ")")

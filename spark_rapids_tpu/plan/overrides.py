@@ -77,8 +77,9 @@ def plan_query(plan: L.LogicalPlan, conf: TpuConf, mesh=None,
     meta = wrap_plan(plan, conf)
     meta.tag()
     from .cost import OPTIMIZER_ENABLED, apply_cost_optimizer
+    decision = None
     if conf.get(OPTIMIZER_ENABLED):
-        apply_cost_optimizer(meta, conf, wall_sig=wall_sig)
+        decision = apply_cost_optimizer(meta, conf, wall_sig=wall_sig)
         if rewritten and not _any_device_meta(meta):
             # whole-plan host reversion: the TPU-targeted rewrites
             # (distinct expansion/flag, union single-pass) only help
@@ -91,6 +92,8 @@ def plan_query(plan: L.LogicalPlan, conf: TpuConf, mesh=None,
             meta.tag()
             _revert_all(meta, "cost-based: whole-plan host placement "
                               "(native shape, no device rewrites)")
+            decision = ("host (whole-plan host placement: native "
+                        "shape, no device rewrites)")
     explain = conf.explain
     if explain in ("NOT_ON_TPU", "ALL"):
         out = meta.explain(only_not_on_tpu=(explain == "NOT_ON_TPU"))
@@ -112,6 +115,15 @@ def plan_query(plan: L.LogicalPlan, conf: TpuConf, mesh=None,
             # the plan lowered onto the mesh: single-chip fused pipelines
             # still apply (losing them regressed latency-bound joins)
             physical = maybe_fuse_single_chip(physical, conf)
+    # whole-stage fusion LAST, over whatever the mesh/fragment lowering
+    # left as an operator pipeline: maximal device filter/project chains
+    # become one compiled program each (exec/wholestage.py)
+    from ..exec.wholestage import fuse_whole_stages
+    physical = fuse_whole_stages(physical, conf)
+    #: why the cost optimizer placed this plan where it did — EXPLAIN
+    #: prints it, so "why is this stage on host" is answerable from the
+    #: plan output alone (satellite of ISSUE 6)
+    physical.placement_decision = decision
     return physical
 
 

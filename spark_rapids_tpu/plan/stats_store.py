@@ -12,7 +12,10 @@ same lifetime the XLA compile cache gives kernels.
 
 Format: one JSON file next to the XLA cache —
   {"version": 1, "walls": [[sig, placement, count, min_s], ...],
-   "rows": [[sig, rows], ...]}
+   "rows": [[sig, rows], ...],
+   "ops": [[op_kind, placement, rows, seconds], ...]}
+("ops" are the learned per-operator row costs, cost.record_op_wall;
+older files without the key load fine.)
 Writes are atomic (tmp + rename) and debounced; entries are capped with
 insertion order as the recency proxy. Process-local signatures (the
 "#<id>#" fallback for non-Arrow sources) are never persisted.
@@ -49,7 +52,7 @@ def _persistable(sig: str) -> bool:
     return not _LOCAL_TAG.search(sig)
 
 
-def load_into(walls: dict, rows: dict) -> None:
+def load_into(walls: dict, rows: dict, ops: dict = None) -> None:
     """Merge persisted stats into the live dicts (live entries win)."""
     global _loaded
     with _lock:
@@ -70,6 +73,13 @@ def load_into(walls: dict, rows: dict) -> None:
     for sig, n in j.get("rows", []):
         if sig not in rows:
             rows[sig] = int(n)
+    if ops is not None:
+        # learned per-operator row costs (cost.record_op_wall): a fresh
+        # process prices device stages from previously-measured walls
+        for kind, placement, r, s in j.get("ops", []):
+            k = (kind, placement)
+            if k not in ops:
+                ops[k] = (int(r), float(s))
 
 
 def mark_dirty() -> None:
@@ -97,12 +107,15 @@ def save() -> None:
                  if _persistable(sig)][-_CAP:]
         rows = [[sig, n] for sig, n in list(cost._RUNTIME_ROWS.items())
                 if _persistable(sig)][-_CAP:]
+        ops = [[kind, pl, r, s]
+               for (kind, pl), (r, s) in list(cost._OP_COSTS.items())]
     path = _path()
     tmp = path + f".tmp{os.getpid()}"
     try:
         os.makedirs(os.path.dirname(path), exist_ok=True)
         with open(tmp, "w") as f:
-            json.dump({"version": 1, "walls": walls, "rows": rows}, f)
+            json.dump({"version": 1, "walls": walls, "rows": rows,
+                       "ops": ops}, f)
         os.replace(tmp, path)
         _dirty = False
         _last_save = time.monotonic()

@@ -24,16 +24,17 @@ from .framework import (FileContext, FileRule, Finding, LintResult,
 from .rules_retry import RetryIdempotenceRule
 from .rules_lifetime import BatchLifetimeRule
 from .rules_hostsync import HostSyncRule
+from .rules_jit import AdHocJitRule
 from .rules_drift import (ConfigKeyDriftRule, MetricNameDriftRule,
                           OpsDocDriftRule)
 
 #: every shipped rule, in reporting order
 ALL_RULES = [RetryIdempotenceRule(), BatchLifetimeRule(), HostSyncRule(),
-             ConfigKeyDriftRule(), OpsDocDriftRule(),
+             AdHocJitRule(), ConfigKeyDriftRule(), OpsDocDriftRule(),
              MetricNameDriftRule()]
 
 __all__ = ["ALL_RULES", "FileContext", "FileRule", "Finding", "LintResult",
            "ProjectRule", "Rule", "lint_source", "load_baseline", "run_lint",
            "write_baseline", "RetryIdempotenceRule", "BatchLifetimeRule",
-           "HostSyncRule", "ConfigKeyDriftRule", "OpsDocDriftRule",
-           "MetricNameDriftRule"]
+           "HostSyncRule", "AdHocJitRule", "ConfigKeyDriftRule",
+           "OpsDocDriftRule", "MetricNameDriftRule"]

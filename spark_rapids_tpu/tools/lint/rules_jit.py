@@ -1,0 +1,93 @@
+"""adhoc-jit: ``jax.jit`` only inside the blessed compiler/cache modules.
+
+Contract (ISSUE 6): every compiled executable must resolve through the
+two-tier executable cache (``plan/exec_cache.py``) so that (a) a repeat
+query reuses the live callable instead of re-tracing, (b) the
+persistent tier serves the XLA compile across processes, and (c) the
+``srtpu_compile_*`` metrics see every compile. A ``jax.jit`` call site
+anywhere else builds a private callable whose lifetime is whatever
+object holds it — the exact bug class behind the r5 warm-query cliffs
+(per-exec kernel dicts dying with their query, 17.3 s "warm"
+string_transforms_100k). New kernels belong in ``exprs/compiler.py``
+(or route their build through ``exec_cache.get_or_build``); existing
+sites are grandfathered in the baseline and should migrate as they are
+touched.
+
+Detected shapes: ``@jax.jit`` / ``@jit`` decorators,
+``functools.partial(jax.jit, ...)`` (decorator or call), and direct
+``jax.jit(fn)`` calls.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from .astutil import call_name, dotted_name
+from .framework import FileContext, FileRule, Finding
+
+#: modules allowed to call jax.jit: the expression/kernels compiler and
+#: the executable cache itself (relative to the repo root)
+BLESSED = frozenset({
+    "spark_rapids_tpu/exprs/compiler.py",
+    "spark_rapids_tpu/plan/exec_cache.py",
+})
+
+
+def _is_jit_name(name) -> bool:
+    return bool(name) and (name == "jit" or name.endswith("jax.jit")
+                           or name.endswith("_jax.jit"))
+
+
+class AdHocJitRule(FileRule):
+    name = "adhoc-jit"
+    contract = ("jax.jit only in the blessed compiler/cache modules "
+                "(exprs/compiler.py, plan/exec_cache.py) — ad-hoc jits "
+                "bypass the executable cache and re-introduce silent "
+                "recompiles")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        rel = ctx.rel.replace("\\", "/")
+        if rel in BLESSED or not rel.startswith("spark_rapids_tpu/"):
+            return []
+        findings: List[Finding] = []
+        #: per-scope occurrence counter -> stable, line-free keys
+        seen: dict = {}
+
+        def emit(node, scope: str):
+            n = seen.get(scope, 0)
+            seen[scope] = n + 1
+            findings.append(Finding(
+                self.name, ctx.rel, node.lineno,
+                "jax.jit outside the blessed compiler/cache modules — "
+                "route the kernel through plan/exec_cache.get_or_build "
+                "(or exprs/compiler.py) so warm queries reuse it and "
+                "srtpu_compile_* metrics see the compile",
+                key=f"{scope}:{n}"))
+
+        decorator_calls = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if isinstance(dec, ast.Call):
+                        decorator_calls.add(id(dec))
+                    if _is_jit_name(dotted_name(dec)):
+                        emit(dec, f"dec:{node.name}")
+                    elif isinstance(dec, ast.Call):
+                        cn = call_name(dec) or ""
+                        if _is_jit_name(cn) or (
+                                cn.endswith("partial") and dec.args
+                                and _is_jit_name(dotted_name(dec.args[0]))):
+                            emit(dec, f"dec:{node.name}")
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) \
+                    or id(node) in decorator_calls:
+                continue
+            cn = call_name(node)
+            if cn and _is_jit_name(cn) and cn != "jit":
+                # bare jit() call-names collide with user helpers; only
+                # dotted jax.jit counts as a direct call site
+                emit(node, "call")
+            elif cn and cn.endswith("partial") and node.args \
+                    and _is_jit_name(dotted_name(node.args[0])):
+                emit(node, "call")
+        return findings

@@ -130,6 +130,13 @@ def analyze(events: List[dict]) -> dict:
                 d2h_n += 1
                 d2h_b += nbytes
 
+    # compile attribution (ISSUE 6): cat="compile" spans come from the
+    # executable cache — backend-compile walls via jax.monitoring plus
+    # fused-kernel build spans. Cold queries are compile-bound; a warm
+    # repeat should show ~0 here (srtpu_compile_* metrics agree).
+    compile_n, compile_us, _ = _sum_spans(events, "compile.",
+                                          cat="compile")
+
     retries = _count_instants(events, "oom.retry")
     splits = _count_instants(events, "oom.split")
     spill_n, spill_us, _ = _sum_spans(events, "spill.", cat="mem")
@@ -185,7 +192,9 @@ def analyze(events: List[dict]) -> dict:
             "transfer": {"h2d": {"n": h2d_n, "us": h2d_us, "bytes": h2d_b},
                          "d2h": {"n": d2h_n, "us": d2h_us, "bytes": d2h_b},
                          "dispatch_us": dispatch_us,
-                         "device_us": device_us},
+                         "device_us": device_us,
+                         "compile_n": compile_n,
+                         "compile_us": compile_us},
             "memory": {"oom_retries": retries, "oom_splits": splits,
                        "spills": spill_n, "spill_us": spill_us,
                        "spill_freed_bytes": spill_freed,
@@ -201,7 +210,8 @@ def analyze(events: List[dict]) -> dict:
             "workers": workers, "lanes": lanes,
             "recommendations": _recommend(
                 shuffles, retries, splits, spill_n, sem_us,
-                total_exec_us, h2d_n, h2d_b, h2d_us, d2h_us)}
+                total_exec_us, h2d_n, h2d_b, h2d_us, d2h_us,
+                compile_us)}
 
 
 #: thresholds for the recommendation rules (module-level so tests and
@@ -213,8 +223,16 @@ SMALL_H2D_BYTES = 4 << 20
 
 
 def _recommend(shuffles, retries, splits, spills, sem_us,
-               total_exec_us, h2d_n, h2d_b, h2d_us, d2h_us) -> List[str]:
+               total_exec_us, h2d_n, h2d_b, h2d_us, d2h_us,
+               compile_us: float = 0.0) -> List[str]:
     recs: List[str] = []
+    if total_exec_us > 0 and compile_us > 0.5 * total_exec_us:
+        recs.append(
+            f"compile time ({_ms(compile_us)}) rivals exec self time: "
+            f"this is a COLD run — warm repeats should pay zero "
+            f"(persistent executable tier, "
+            f"spark.rapids.tpu.compile.cache.dir); if srtpu_compile_* "
+            f"metrics show misses on repeats, a kernel key is unstable")
     for sid, s in sorted(shuffles.items()):
         if 0 < s["bytes"] <= BROADCAST_THRESHOLD_BYTES:
             recs.append(
@@ -308,7 +326,9 @@ def format_report(a: dict, source: str = "") -> str:
     L.append(f"D2H: {t['d2h']['n']} transfer(s), "
              f"{_fmt_bytes(t['d2h']['bytes'])}, {_ms(t['d2h']['us'])}")
     L.append(f"host dispatch {_ms(t['dispatch_us'])} vs device/transfer "
-             f"{_ms(t['device_us'])}")
+             f"{_ms(t['device_us'])} vs compile "
+             f"{_ms(t.get('compile_us', 0.0))} "
+             f"({t.get('compile_n', 0)} compile span(s))")
     L.append("")
     m = a["memory"]
     L.append("== Memory pressure ==")

@@ -33,7 +33,7 @@ _EXPR_MODULES = ["aggregates", "arithmetic", "cast", "collection_fns",
                  "math_fns", "nondeterministic", "string_fns", "window_fns"]
 
 _EXEC_MODULES = ["aggregate", "basic", "cached", "generate", "joins",
-                 "python_execs", "sort", "window"]
+                 "python_execs", "sort", "wholestage", "window"]
 
 #: per-operator speedup priors for the qualification tool (the reference
 #: ships estimates, not measurements — operatorsScore.csv:1-8; these mirror
@@ -85,6 +85,7 @@ def _load_registries():
               "spark_rapids_tpu.bootstrap",
               "spark_rapids_tpu.exprs.pallas_rect",
               "spark_rapids_tpu.plan.cost",
+              "spark_rapids_tpu.plan.exec_cache",
               "spark_rapids_tpu.plan.stats_store",
               "spark_rapids_tpu.parallel.planner",
               "spark_rapids_tpu.mem.manager",
