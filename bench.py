@@ -584,6 +584,11 @@ def main():
             cache_warm = exec_cache.stats()
             placement = getattr(last_session[0], "last_placement",
                                 None) or "?"
+            # coded not-on-device summary (ISSUE 7; schema in
+            # docs/tuning.md): the artifact itself says WHY a rung
+            # stayed on host — {} for all-device rungs
+            pl_report = getattr(last_session[0], "last_placement_report",
+                                None) or {}
             base_s, base_res = _time_min(base_fn, iters)
         except Exception as e:                # noqa: BLE001
             # INFRA failure (OOM, backend error): must not discard the
@@ -613,6 +618,7 @@ def main():
             "speedup": round(speedup, 3), "placement": placement,
             "rows_per_sec": round(rows / eng_s, 1),
             "warm_s": round(warm, 1), "checked": True,
+            "placement_reasons": pl_report.get("codes") or {},
             "compile": {
                 "cold": {k: round(cache_cold[k] - cache0[k], 3)
                          for k in cache_cold},

@@ -81,6 +81,10 @@ class TpuSession:
         self.last_fault_stats = None
         #: engine that ran the last materialized query: "device"/"host"
         self.last_placement = None
+        #: coded PlacementReport summary of the last planned query
+        #: ({"verdict", "codes", "ops", "estRows"} — plan/tags.py);
+        #: bench.py records it per rung as details[rung]["placement_reasons"]
+        self.last_placement_report = None
         #: device mesh for distributed execution: explicit, or built from
         #: spark.rapids.tpu.distributed.* conf (the planner lowers
         #: supported fragments onto it — parallel/planner.py)
@@ -688,7 +692,14 @@ class DataFrame:
         # fail.
         self.session.last_query_metrics = None
         self.session.last_fault_stats = None
+        self.session.last_placement_report = None
         physical = self._physical()
+        report = getattr(physical, "placement_report", None)
+        # one summary, three consumers (session attribute, queryStart
+        # record, metric increments) — computed once
+        placement_summary = (report.summary() if report is not None
+                             else None)
+        self.session.last_placement_report = placement_summary
         if self.session.conf.is_explain_only:
             raise RuntimeError("session is in explainOnly mode")
         # re-install this query's per-expression disables for the runtime
@@ -703,6 +714,15 @@ class DataFrame:
         from ..trace import core as trace_core
         physical = lore_wrap(physical, self.session.conf)
         ctx = self.session.exec_context()
+        from ..metrics import registry as metrics_registry
+        mreg0 = metrics_registry.REGISTRY   # installed by the ctx above
+        if mreg0 is not None and placement_summary is not None:
+            # per-query fallback accounting (the qualification feed):
+            # one increment per (reason code, operator) tag occurrence
+            for op, codes in sorted(placement_summary["ops"].items()):
+                for code, n in sorted(codes.items()):
+                    mreg0.counter("srtpu_placement_fallback_total",
+                                  code=code, op=op).inc(n)
         tracer = trace_core.ensure_tracer_from_conf(ctx.conf)
         t0q = tracer.now() if tracer is not None else 0
         side_effects = isinstance(self.plan, L.WriteFile)
@@ -721,6 +741,9 @@ class DataFrame:
             elog.write({"event": "queryStart", "queryId": qid,
                         "planDigest": digest,
                         "root": type(self.plan).__name__,
+                        # coded placement summary: what tools/qualify
+                        # mines across the history (docs/placement.md)
+                        "placement": placement_summary,
                         "conf": {k: str(v) for k, v
                                  in sorted(self.session.conf.raw.items())}})
         trace_path = None
@@ -747,9 +770,13 @@ class DataFrame:
             self.session.last_query_metrics = tm.finish()
             if tracer is not None:
                 # the whole-query span wraps the existing TaskMetrics
-                # capture: one umbrella every operator span nests under
-                tracer.complete("query", t0q, cat="query",
-                                args={"ok": ok})
+                # capture: one umbrella every operator span nests under;
+                # it carries the placement verdict so the trace alone
+                # answers "did this query even touch the device"
+                qargs = {"ok": ok}
+                if report is not None:
+                    qargs["placement"] = report.verdict
+                tracer.complete("query", t0q, cat="query", args=qargs)
                 out_path = str(ctx.conf.get(trace_core.TRACE_OUTPUT))
                 if out_path:
                     from ..trace.export import write_chrome_trace
@@ -894,6 +921,17 @@ class DataFrame:
             s = explain_potential_tpu_plan(self.plan, self.session.conf)
         elif mode == "analyze":
             s = self._explain_analyze()
+        elif mode == "placement":
+            # the coded placement report (plan/tags.py): per-operator
+            # device/host verdicts with reason codes — plans only,
+            # never executes (docs/placement.md)
+            physical = self._physical()
+            rep = getattr(physical, "placement_report", None)
+            s = (rep.render() if rep is not None
+                 else "<no placement report>")
+            decision = getattr(physical, "placement_decision", None)
+            if decision:
+                s = f"placement: {decision}\n" + s
         else:
             physical = self._physical()
             s = physical.tree_string()
@@ -921,6 +959,12 @@ class DataFrame:
 
         self._execute_wrapped(consume)
         out = render_analyzed_plan(holder["physical"], holder["ctx"])
+        rep = getattr(holder["physical"], "placement_report", None)
+        if rep is not None and rep.counts():
+            # the report's top-level verdict: ANALYZE output alone says
+            # why (and how much of) the plan stayed on host
+            out = (f"placement fallbacks [{rep.verdict}]: "
+                   f"{rep.format_counts()}\n" + out)
         decision = getattr(holder["physical"], "placement_decision", None)
         if decision:
             out = f"placement: {decision}\n" + out

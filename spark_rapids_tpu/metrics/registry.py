@@ -348,3 +348,9 @@ declare_metric("srtpu_compile_seconds_total", "counter",
                "actually paid (persistent-tier hits pay none).")
 declare_metric("srtpu_event_log_records_total", "counter",
                "Records appended to the session event log.")
+declare_metric("srtpu_placement_fallback_total", "counter",
+               "Operators/expressions kept off the device at plan time, "
+               "labeled code=<reason code from the plan/tags.py closed "
+               "registry> and op=<logical operator>; incremented once "
+               "per executed query with that query's PlacementReport "
+               "tag counts (docs/placement.md).")

@@ -516,11 +516,13 @@ def apply_cost_optimizer(meta: PlanMeta, conf: TpuConf,
         if dw is not None:
             dev_est, how = dw, "measured"
 
+    # whole-plan reversions record a coded wrapping tag on the root AND
+    # flip each still-capable node — nodes carrying their own reasons
+    # keep them (tags.revert_to_host; the explain("placement") contract)
+    from .tags import WHOLE_PLAN_HOST_REVERT, revert_to_host
+
     def revert_all(m: PlanMeta, reason: str):
-        if m.can_run_on_tpu:
-            m.will_not_work_on_tpu(reason)
-        for c in m.child_metas:
-            revert_all(c, reason)
+        revert_to_host(m, reason, code=WHOLE_PLAN_HOST_REVERT)
 
     # Bidirectional measured-wall arbitration (the per-node model alone
     # could only flip device->host; a slow host twin would then be chosen
@@ -556,8 +558,9 @@ def apply_cost_optimizer(meta: PlanMeta, conf: TpuConf,
                   "measured device %.4fs)", host_only, dw)
         return (f"host (exploring: model {host_only:.4f}s < measured "
                 f"device {dw:.4f}s)")
+    from .tags import COST_MODEL_HOST
     for m, reason in pending_reverts:
-        m.will_not_work_on_tpu(reason)
+        m.will_not_work_on_tpu(reason, code=COST_MODEL_HOST)
         log.debug("cost optimizer reverted %s", type(m.plan).__name__)
     if floor > 0 and host_est < dev_est:
         reason = (f"cost-based: whole-plan host {how} {host_est:.4f}s "

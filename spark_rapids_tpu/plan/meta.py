@@ -56,18 +56,46 @@ class PlanMeta:
         self.parent = parent
         self.reasons: List[str] = []
         self.expr_notes: List[str] = []   # per-expression host-fallback notes
+        #: coded PlacementTags parallel to reasons/expr_notes (plan/tags.py);
+        #: plan_tags hold whole-plan wrapping reversions (tags.revert_to_host)
+        self.tags: List = []
+        self.expr_tags: List = []
+        self.plan_tags: List = []
+        #: tag dedup keys: (text, code, expr) — the free text alone is
+        #: NOT enough (two sites may emit identical text under different
+        #: codes, and the second tag must still reach the report)
+        self._tag_keys: set = set()
+        self._note_keys: set = set()
         self.child_metas: List[PlanMeta] = []
 
     # ------------------------------------------------------------- tagging
-    def will_not_work_on_tpu(self, reason: str):
-        if reason not in self.reasons:
-            self.reasons.append(reason)
-            _record_fallback(f"{type(self.plan).__name__}: {reason}")
+    def will_not_work_on_tpu(self, reason: str, code: str,
+                             expr: Optional[str] = None):
+        from .tags import make_tag
+        key = (reason, code, expr)
+        if key not in self._tag_keys:
+            # tag FIRST: an unregistered code must raise without leaving
+            # a half-recorded (reason without tag) meta behind
+            self.tags.append(make_tag(code, reason,
+                                      node=type(self.plan).__name__,
+                                      expr=expr))
+            self._tag_keys.add(key)
+            if reason not in self.reasons:
+                self.reasons.append(reason)
+                _record_fallback(f"{type(self.plan).__name__}: {reason}")
 
-    def note_expr_fallback(self, note: str):
-        if note not in self.expr_notes:
-            self.expr_notes.append(note)
-            _record_fallback(f"expr: {note}")
+    def note_expr_fallback(self, note: str, code: str,
+                           expr: Optional[str] = None):
+        from .tags import make_tag
+        key = (note, code, expr)
+        if key not in self._note_keys:
+            self.expr_tags.append(make_tag(code, note,
+                                           node=type(self.plan).__name__,
+                                           expr=expr))
+            self._note_keys.add(key)
+            if note not in self.expr_notes:
+                self.expr_notes.append(note)
+                _record_fallback(f"expr: {note}")
 
     @property
     def can_run_on_tpu(self) -> bool:
@@ -75,12 +103,15 @@ class PlanMeta:
 
     def tag(self):
         from .op_confs import exec_disabled, exec_conf_key
+        from .tags import CONF_DISABLED
         if not self.conf.sql_enabled:
             self.will_not_work_on_tpu(
-                "spark.rapids.tpu.sql.enabled is false")
+                "spark.rapids.tpu.sql.enabled is false",
+                code=CONF_DISABLED)
         elif exec_disabled(self.conf, self.plan):
             self.will_not_work_on_tpu(
-                f"{exec_conf_key(self.plan)} is false")
+                f"{exec_conf_key(self.plan)} is false",
+                code=CONF_DISABLED)
         else:
             self.tag_self()
         for c in self.child_metas:

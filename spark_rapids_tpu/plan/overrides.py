@@ -18,6 +18,7 @@ from ..exec import aggregate as A
 from ..exec import sort as S
 from ..exec.base import TpuExec
 from . import logical as L
+from . import tags as T
 from .meta import PlanMeta
 
 log = logging.getLogger("spark_rapids_tpu.overrides")
@@ -90,15 +91,42 @@ def plan_query(plan: L.LogicalPlan, conf: TpuConf, mesh=None,
             # so arbitration stays consistent.
             meta = wrap_plan(prune_columns(plan0), conf)
             meta.tag()
-            _revert_all(meta, "cost-based: whole-plan host placement "
-                              "(native shape, no device rewrites)")
+            T.revert_to_host(
+                meta, "cost-based: whole-plan host placement "
+                      "(native shape, no device rewrites)",
+                code=T.WHOLE_PLAN_HOST_REVERT)
             decision = ("host (whole-plan host placement: native "
                         "shape, no device rewrites)")
+    # coded placement report (plan/tags.py): assembled AFTER tagging and
+    # cost optimization so it records the final verdicts; the plan-time
+    # INPUT row estimate (summed over scan leaves — the work scale, not
+    # the often-tiny aggregate output) rides along for the qualify
+    # tool's learned-cost join
+    try:
+        from .cost import estimate_rows
+
+        def _leaf_rows(p):
+            if not p.children:
+                return estimate_rows(p)
+            return sum(_leaf_rows(c) for c in p.children)
+
+        est_rows = int(_leaf_rows(plan))
+    except Exception:  # noqa: BLE001 - diagnostics never fail planning
+        est_rows = None
+    report = T.build_report(meta, decision=decision, est_rows=est_rows)
     explain = conf.explain
     if explain in ("NOT_ON_TPU", "ALL"):
         out = meta.explain(only_not_on_tpu=(explain == "NOT_ON_TPU"))
         if out:
             log.warning("\n%s", out)
+    pexplain = str(conf.get(T.PLACEMENT_EXPLAIN)).upper()
+    # NOT_ON_DEVICE is silent for all-device plans (render() always
+    # emits at least the verdict line, so gate on recorded tags — the
+    # legacy mode's "nothing on host, nothing to say" contract)
+    if pexplain == "ALL" or (pexplain == "NOT_ON_DEVICE"
+                             and report.counts()):
+        log.warning("\n%s", report.render(
+            only_not_on_device=(pexplain == "NOT_ON_DEVICE")))
     physical = meta.convert()
     if conf.sql_enabled:
         from ..parallel.planner import (FUSED_PIPELINE, distribution_gate,
@@ -124,6 +152,10 @@ def plan_query(plan: L.LogicalPlan, conf: TpuConf, mesh=None,
     #: prints it, so "why is this stage on host" is answerable from the
     #: plan output alone (satellite of ISSUE 6)
     physical.placement_decision = decision
+    #: the coded per-operator report (ISSUE 7): explain("placement"),
+    #: the fallback metric family, and queryStart event records all
+    #: read it off the physical plan
+    physical.placement_report = report
     return physical
 
 
@@ -140,13 +172,6 @@ def _any_device_meta(meta: PlanMeta) -> bool:
     if meta.can_run_on_tpu and not isinstance(meta.plan, _NEUTRAL_PLANS):
         return True
     return any(_any_device_meta(c) for c in meta.child_metas)
-
-
-def _revert_all(meta: PlanMeta, reason: str) -> None:
-    if meta.can_run_on_tpu:
-        meta.will_not_work_on_tpu(reason)
-    for c in meta.child_metas:
-        _revert_all(c, reason)
 
 
 def explain_potential_tpu_plan(plan: L.LogicalPlan, conf: TpuConf) -> str:
@@ -172,7 +197,8 @@ def _list_key_reason(expr, schema):
 class _FallbackMeta(PlanMeta):
     def tag_self(self):
         self.will_not_work_on_tpu(
-            f"no TPU rule registered for {type(self.plan).__name__}")
+            f"no TPU rule registered for {type(self.plan).__name__}",
+            code=T.OP_UNSUPPORTED)
 
     def convert_to_cpu(self, children):
         raise NotImplementedError(
@@ -227,7 +253,9 @@ class ProjectMeta(PlanMeta):
             if r:
                 # per-expression fallback stays inside TpuProjectExec;
                 # recorded for explain parity with the reference
-                self.note_expr_fallback(f"<{e.name_hint}> runs on host: {r}")
+                self.note_expr_fallback(f"<{e.name_hint}> runs on host: {r}",
+                                        code=T.EXPR_UNSUPPORTED,
+                                        expr=e.name_hint)
 
     def convert_to_tpu(self, children):
         return B.TpuProjectExec(self.plan.exprs, children[0])
@@ -248,9 +276,13 @@ class FilterMeta(PlanMeta):
             from ..exprs.compiler import build_dict_filter
             if build_dict_filter(self.plan.condition, schema) is not None:
                 self.note_expr_fallback(
-                    "string predicate evaluated over the dictionary")
+                    "string predicate evaluated over the dictionary",
+                    code=T.EXPR_DICT_EVAL,
+                    expr=self.plan.condition.name_hint)
                 return
-            self.will_not_work_on_tpu(f"filter condition: {r}")
+            self.will_not_work_on_tpu(f"filter condition: {r}",
+                                      code=T.EXPR_UNSUPPORTED,
+                                      expr=self.plan.condition.name_hint)
 
     def convert_to_tpu(self, children):
         self._push_down_predicate(children[0])
@@ -300,26 +332,33 @@ class AggregateMeta(PlanMeta):
         from ..types import STRING
         schema = self.plan.children[0].schema()
         for g in self.plan.groupings:
-            r = (g.fully_device_supported(schema)
-                 or _list_key_reason(g, schema))
+            r = g.fully_device_supported(schema)
+            lk = None if r else _list_key_reason(g, schema)
             # string group keys stay on the TPU path: the exec
             # dictionary-encodes them to device int32 codes (evaluated on
             # host, grouped on device, decoded at finalize)
-            if r and g.data_type(schema) != STRING:
-                self.will_not_work_on_tpu(f"grouping <{g.name_hint}>: {r}")
+            if (r or lk) and g.data_type(schema) != STRING:
+                self.will_not_work_on_tpu(
+                    f"grouping <{g.name_hint}>: {r or lk}",
+                    code=(T.EXPR_UNSUPPORTED if r else T.LIST_KEY_HOST),
+                    expr=g.name_hint)
         for a in self.plan.aggs:
             r = a.device_unsupported_reason(schema)
             if r:
-                self.will_not_work_on_tpu(f"aggregate <{a.name_hint}>: {r}")
+                self.will_not_work_on_tpu(f"aggregate <{a.name_hint}>: {r}",
+                                          code=T.EXPR_UNSUPPORTED,
+                                          expr=a.name_hint)
             if not hasattr(a, "update"):
                 self.will_not_work_on_tpu(
-                    f"aggregate <{a.name_hint}> has no device implementation")
+                    f"aggregate <{a.name_hint}> has no device implementation",
+                    code=T.EXPR_UNSUPPORTED, expr=a.name_hint)
             if a.distinct:
                 # reaches here only when rewrites.py could not expand it
                 # (multiple distinct columns / non-decomposable mix)
                 self.will_not_work_on_tpu(
                     f"aggregate <{a.name_hint}>: DISTINCT form not "
-                    "expandable to the two-level device aggregation")
+                    "expandable to the two-level device aggregation",
+                    code=T.AGG_DISTINCT_HOST, expr=a.name_hint)
 
     def convert_to_tpu(self, children):
         hint = getattr(self.plan, "many_groups_hint", False)
@@ -441,11 +480,14 @@ class SortMeta(PlanMeta):
         for o in self.plan.orders:
             r = o.expr.fully_device_supported(schema)
             if r:
-                self.will_not_work_on_tpu(f"sort key <{o.expr.name_hint}>: {r}")
+                self.will_not_work_on_tpu(
+                    f"sort key <{o.expr.name_hint}>: {r}",
+                    code=T.EXPR_UNSUPPORTED, expr=o.expr.name_hint)
         for f in schema.fields:
             if not f.dtype.device_backed:
                 self.will_not_work_on_tpu(
-                    f"column {f.name}: {f.dtype.name} payload is host-only")
+                    f"column {f.name}: {f.dtype.name} payload is host-only",
+                    code=T.DTYPE_HOST_ONLY)
 
     def convert_to_tpu(self, children):
         return S.TpuSortExec(self.plan.orders, children[0],
@@ -502,7 +544,9 @@ class ExpandMeta(PlanMeta):
             for e in p:
                 r = e.fully_device_supported(schema)
                 if r:
-                    self.will_not_work_on_tpu(f"expand <{e.name_hint}>: {r}")
+                    self.will_not_work_on_tpu(f"expand <{e.name_hint}>: {r}",
+                                              code=T.EXPR_UNSUPPORTED,
+                                              expr=e.name_hint)
 
     def convert_to_tpu(self, children):
         return B.TpuExpandExec(self.plan.projections, self.plan.names,
@@ -519,7 +563,9 @@ class DistinctFlagMeta(PlanMeta):
         for e in self.plan.key_exprs + [self.plan.value_expr]:
             r = e.fully_device_supported(schema)
             if r:
-                self.will_not_work_on_tpu(f"distinct-flag <{e.name_hint}>: {r}")
+                self.will_not_work_on_tpu(
+                    f"distinct-flag <{e.name_hint}>: {r}",
+                    code=T.EXPR_UNSUPPORTED, expr=e.name_hint)
 
     def convert_to_tpu(self, children):
         from ..exec.distinct_flag import HashDistinctFlagExec
@@ -542,7 +588,7 @@ class GenerateMeta(PlanMeta):
         try:
             self.plan.generator.generator_output(schema)
         except Unsupported as e:
-            self.will_not_work_on_tpu(str(e))
+            self.will_not_work_on_tpu(str(e), code=T.EXPR_UNSUPPORTED)
 
     def convert_to_tpu(self, children):
         from ..exec.generate import TpuGenerateExec
@@ -558,20 +604,24 @@ class JoinMeta(PlanMeta):
     def tag_self(self):
         ls = self.plan.children[0].schema()
         rs = self.plan.children[1].schema()
-        for k in self.plan.left_keys:
-            r = k.fully_device_supported(ls) or _list_key_reason(k, ls)
-            if r:
-                self.will_not_work_on_tpu(f"left key <{k.name_hint}>: {r}")
-        for k in self.plan.right_keys:
-            r = k.fully_device_supported(rs) or _list_key_reason(k, rs)
-            if r:
-                self.will_not_work_on_tpu(f"right key <{k.name_hint}>: {r}")
+        for side, keys, schema in (("left", self.plan.left_keys, ls),
+                                   ("right", self.plan.right_keys, rs)):
+            for k in keys:
+                r = k.fully_device_supported(schema)
+                lk = None if r else _list_key_reason(k, schema)
+                if r or lk:
+                    self.will_not_work_on_tpu(
+                        f"{side} key <{k.name_hint}>: {r or lk}",
+                        code=(T.EXPR_UNSUPPORTED if r else T.LIST_KEY_HOST),
+                        expr=k.name_hint)
         if self.plan.condition is not None:
             joined = Schema(list(ls.fields) + list(rs.fields))
             r = self.plan.condition.fully_device_supported(joined)
             if r:
                 self.will_not_work_on_tpu(
-                    f"join condition <{self.plan.condition.name_hint}>: {r}")
+                    f"join condition <{self.plan.condition.name_hint}>: {r}",
+                    code=T.EXPR_UNSUPPORTED,
+                    expr=self.plan.condition.name_hint)
 
     def _auto_broadcast(self):
         """Pick a broadcast side from plan-time size estimates when the
@@ -654,10 +704,13 @@ class RepartitionMeta(PlanMeta):
     def tag_self(self):
         schema = self.plan.children[0].schema()
         for k in self.plan.keys:
-            r = k.fully_device_supported(schema) \
-                or _list_key_reason(k, schema)
-            if r:
-                self.will_not_work_on_tpu(f"partition key <{k.name_hint}>: {r}")
+            r = k.fully_device_supported(schema)
+            lk = None if r else _list_key_reason(k, schema)
+            if r or lk:
+                self.will_not_work_on_tpu(
+                    f"partition key <{k.name_hint}>: {r or lk}",
+                    code=(T.EXPR_UNSUPPORTED if r else T.LIST_KEY_HOST),
+                    expr=k.name_hint)
             if self.plan.mode == "hash":
                 # device murmur3 covers fewer types than device storage
                 # (e.g. DOUBLE hashes on host only — hash_fns device notes)
@@ -665,7 +718,8 @@ class RepartitionMeta(PlanMeta):
                 hr = device_hashable.reason_not_supported(k.data_type(schema))
                 if hr:
                     self.will_not_work_on_tpu(
-                        f"hash partition key <{k.name_hint}>: {hr}")
+                        f"hash partition key <{k.name_hint}>: {hr}",
+                        code=T.HASH_KEY_HOST, expr=k.name_hint)
 
     def _num_parts(self):
         from ..config import DEFAULT_SHUFFLE_PARTITIONS
@@ -717,13 +771,17 @@ class WindowMeta(PlanMeta):
                 # list payloads don't ride the window kernels (they own
                 # their 1D column layout); CPU window handles them
                 self.will_not_work_on_tpu(
-                    f"column {f.name}: list payload is host-only in windows")
+                    f"column {f.name}: list payload is host-only in windows",
+                    code=T.DTYPE_HOST_ONLY)
         for e, spec, name in self.plan.window_exprs:
             for pk in spec.partition_by:
-                r = pk.fully_device_supported(schema) \
-                    or _list_key_reason(pk, schema)
-                if r:
-                    self.will_not_work_on_tpu(f"window partition key: {r}")
+                r = pk.fully_device_supported(schema)
+                lk = None if r else _list_key_reason(pk, schema)
+                if r or lk:
+                    self.will_not_work_on_tpu(
+                        f"window partition key: {r or lk}",
+                        code=(T.EXPR_UNSUPPORTED if r else T.LIST_KEY_HOST),
+                        expr=pk.name_hint)
 
     def convert_to_tpu(self, children):
         from ..exec.window import TpuWindowExec

@@ -1,0 +1,310 @@
+"""Coded placement diagnostics — the NOT_ON_GPU explain subsystem.
+
+Reference analog: GpuOverrides tags every operator it cannot replace
+with a per-operator reason surfaced by
+``spark.rapids.sql.explain=NOT_ON_GPU`` (GpuOverrides.scala:4829-4838,
+``ExplainPlan``), and the Qualification tool mines event logs to rank
+what to fix next. Until ISSUE 7 those reasons existed here only as
+free-text strings dropped before anything could aggregate them — so a
+bench round with 9 of 12 rungs on ``placement: "host"`` could not say
+*why* from its artifacts alone.
+
+This module is the structured half of that diagnostic:
+
+* a **closed registry of reason codes** (``REASON_CODES``) — every
+  ``will_not_work_on_tpu`` / ``note_expr_fallback`` / cost-optimizer
+  reversion site records a :class:`PlacementTag` carrying a registered
+  code next to its free-text detail (creating a tag with an unknown
+  code raises, the metric-inventory / conf-registry pattern; the
+  ``reason-code-drift`` tpulint rule enforces the call sites);
+* a per-query :class:`PlacementReport` built from the tagged meta tree
+  (``plan/overrides.plan_query``) and attached to the physical plan
+  next to ``placement_decision``. Surfaced by ``df.explain("placement")``,
+  printed at planning time by ``spark.rapids.tpu.explain``
+  (NOT_ON_DEVICE / ALL — the reference's NOT_ON_GPU mode), counted into
+  the ``srtpu_placement_fallback_total{code,op}`` metric family,
+  summarized onto ``queryStart`` event-log records, and mined offline by
+  ``python -m spark_rapids_tpu.tools.qualify`` (docs/placement.md).
+"""
+from __future__ import annotations
+
+import collections
+from typing import Dict, List, Optional
+
+from ..config import register
+
+__all__ = ["PLACEMENT_EXPLAIN", "REASON_CODES", "PlacementTag",
+           "PlacementReport", "make_tag", "build_report", "revert_to_host",
+           "EXPR_UNSUPPORTED", "DTYPE_HOST_ONLY", "LIST_KEY_HOST",
+           "HASH_KEY_HOST", "AGG_DISTINCT_HOST", "EXPR_DICT_EVAL",
+           "OP_UNSUPPORTED", "CONF_DISABLED", "COST_MODEL_HOST",
+           "WHOLE_PLAN_HOST_REVERT"]
+
+PLACEMENT_EXPLAIN = register(
+    "spark.rapids.tpu.explain", "NONE",
+    "NONE / NOT_ON_DEVICE / ALL: print the coded placement report "
+    "(plan/tags.py) at query planning time — the reference's "
+    "spark.rapids.sql.explain=NOT_ON_GPU mode with machine-readable "
+    "reason codes. NOT_ON_DEVICE prints only host-placed operators and "
+    "their reason codes; ALL prints every operator's verdict. "
+    "df.explain(\"placement\") renders the same report on demand; "
+    "python -m spark_rapids_tpu.tools.qualify mines the codes from the "
+    "query-history event log (docs/placement.md).", commonly_used=True)
+
+# --------------------------------------------------------------------------
+# the closed reason-code registry (docs/placement.md mirrors this table)
+# --------------------------------------------------------------------------
+
+EXPR_UNSUPPORTED = "EXPR_UNSUPPORTED"
+DTYPE_HOST_ONLY = "DTYPE_HOST_ONLY"
+LIST_KEY_HOST = "LIST_KEY_HOST"
+HASH_KEY_HOST = "HASH_KEY_HOST"
+AGG_DISTINCT_HOST = "AGG_DISTINCT_HOST"
+EXPR_DICT_EVAL = "EXPR_DICT_EVAL"
+OP_UNSUPPORTED = "OP_UNSUPPORTED"
+CONF_DISABLED = "CONF_DISABLED"
+COST_MODEL_HOST = "COST_MODEL_HOST"
+WHOLE_PLAN_HOST_REVERT = "WHOLE_PLAN_HOST_REVERT"
+
+#: code -> one-line meaning; the single source the explain renderers,
+#: the qualify CLI and docs/placement.md share. CLOSED: make_tag raises
+#: on anything not listed here, so "zero UNKNOWN codes" is structural.
+REASON_CODES: Dict[str, str] = {
+    EXPR_UNSUPPORTED:
+        "an expression has no device implementation for its input "
+        "types (filter condition, projection, grouping, aggregate, "
+        "sort key, join key/condition, generator, ...)",
+    DTYPE_HOST_ONLY:
+        "a column's dtype payload is host-only for this operator "
+        "(e.g. list payloads in windows, non-device-backed sort "
+        "payloads)",
+    LIST_KEY_HOST:
+        "a join/group/partition/window KEY is list-typed; the key "
+        "hash/compare kernels are 1D, so the operator runs its CPU "
+        "twin (list VALUES in project/filter pipelines are fine)",
+    HASH_KEY_HOST:
+        "a hash-partition key's type is outside the device murmur3 "
+        "coverage (narrower than device storage, e.g. DOUBLE keys)",
+    AGG_DISTINCT_HOST:
+        "a DISTINCT aggregate form was not expandable to the "
+        "two-level device aggregation (multiple distinct columns or a "
+        "non-decomposable mix)",
+    EXPR_DICT_EVAL:
+        "a string predicate is evaluated over the column dictionary "
+        "(the batch stays device-resident; only the tiny dictionary "
+        "pass runs on host)",
+    OP_UNSUPPORTED:
+        "no TPU rule is registered for the logical operator",
+    CONF_DISABLED:
+        "device placement was disabled by configuration "
+        "(spark.rapids.tpu.sql.enabled or a per-operator "
+        "spark.rapids.tpu.sql.exec.* conf)",
+    COST_MODEL_HOST:
+        "the cost optimizer reverted the subtree: estimated device "
+        "cost including transitions exceeds the host cost",
+    WHOLE_PLAN_HOST_REVERT:
+        "the cost optimizer reverted the WHOLE plan to the host "
+        "engine (per-query device floor, measured-wall arbitration, "
+        "or the native-shape re-plan after TPU-targeted rewrites)",
+}
+
+
+class PlacementTag:
+    """One coded not-on-device reason: ``code`` is a REASON_CODES key,
+    ``detail`` the human free-text, ``node``/``expr`` the logical
+    operator class name and expression name hint (strings only — tags
+    ride pickled plans to shuffle workers and JSON event records)."""
+
+    __slots__ = ("code", "detail", "node", "expr")
+
+    def __init__(self, code: str, detail: str,
+                 node: Optional[str] = None, expr: Optional[str] = None):
+        self.code = code
+        self.detail = detail
+        self.node = node
+        self.expr = expr
+
+    def __repr__(self):
+        return f"PlacementTag({self.code}, {self.detail!r})"
+
+    def __getstate__(self):
+        return (self.code, self.detail, self.node, self.expr)
+
+    def __setstate__(self, st):
+        self.code, self.detail, self.node, self.expr = st
+
+
+def make_tag(code: str, detail: str, node: Optional[str] = None,
+             expr: Optional[str] = None) -> PlacementTag:
+    """The only constructor call sites should use: enforces the closed
+    registry, so an UNKNOWN code can never reach a report."""
+    if code not in REASON_CODES:
+        raise ValueError(
+            f"placement reason code {code!r} is not registered in "
+            "plan/tags.py REASON_CODES — add it to the closed registry "
+            "(and docs/placement.md) before use")
+    return PlacementTag(code, detail, node=node, expr=expr)
+
+
+def revert_to_host(meta, reason: str, code: str) -> None:
+    """Whole-subtree host reversion that PRESERVES per-node tags
+    (ISSUE 7 satellite): the reversion is recorded once as a plan-level
+    *wrapping* tag on the subtree root, and per node only
+    still-device-capable nodes receive it — a node already carrying its
+    own recorded reasons keeps them untouched, so
+    ``explain("placement")`` shows BOTH the wrapping reversion and the
+    original per-node causes instead of the reversion text clobbering
+    everything."""
+    meta.plan_tags.append(
+        make_tag(code, reason, node=type(meta.plan).__name__))
+
+    def walk(m):
+        if m.can_run_on_tpu:
+            m.will_not_work_on_tpu(reason, code=code)
+        for c in m.child_metas:
+            walk(c)
+
+    walk(meta)
+
+
+class _Entry:
+    """One plan node's verdict in a report (strings + tags only)."""
+
+    __slots__ = ("node", "depth", "device", "neutral", "tags", "expr_tags")
+
+    def __init__(self, node, depth, device, neutral, tags, expr_tags):
+        self.node = node
+        self.depth = depth
+        self.device = device
+        self.neutral = neutral
+        self.tags = tags
+        self.expr_tags = expr_tags
+
+    def __getstate__(self):
+        return (self.node, self.depth, self.device, self.neutral,
+                self.tags, self.expr_tags)
+
+    def __setstate__(self, st):
+        (self.node, self.depth, self.device, self.neutral,
+         self.tags, self.expr_tags) = st
+
+
+class PlacementReport:
+    """Per-query roll-up of placement tags, in plan-tree preorder.
+
+    ``plan_tags`` are the wrapping whole-plan reversions
+    (:func:`revert_to_host`); ``entries`` one record per logical node
+    with its own blocking tags and per-expression fallback notes.
+    ``verdict`` is "device" when any non-neutral node still plans onto
+    the device (the ``dataframe._on_device`` placement check applied at
+    plan time), else "host".
+    """
+
+    __slots__ = ("entries", "plan_tags", "decision", "verdict", "est_rows")
+
+    def __init__(self, entries: List[_Entry], plan_tags: List[PlacementTag],
+                 decision: Optional[str], verdict: str,
+                 est_rows: Optional[int] = None):
+        self.entries = entries
+        self.plan_tags = plan_tags
+        self.decision = decision
+        self.verdict = verdict
+        self.est_rows = est_rows
+
+    def __getstate__(self):
+        return (self.entries, self.plan_tags, self.decision, self.verdict,
+                self.est_rows)
+
+    def __setstate__(self, st):
+        (self.entries, self.plan_tags, self.decision, self.verdict,
+         self.est_rows) = st
+
+    # ------------------------------------------------------------ roll-ups
+    def all_tags(self) -> List[PlacementTag]:
+        out = list(self.plan_tags)
+        for e in self.entries:
+            out.extend(e.tags)
+            out.extend(e.expr_tags)
+        return out
+
+    def counts(self) -> Dict[str, int]:
+        """code -> occurrences, across node, expression and plan-level
+        tags."""
+        c: collections.Counter = collections.Counter()
+        for t in self.all_tags():
+            c[t.code] += 1
+        return dict(c)
+
+    def op_code_counts(self) -> Dict[tuple, int]:
+        """(operator, code) -> occurrences — the metric family's label
+        set (plan-level tags count under the root operator)."""
+        c: collections.Counter = collections.Counter()
+        for t in self.all_tags():
+            c[(t.node or "?", t.code)] += 1
+        return dict(c)
+
+    def format_counts(self) -> str:
+        items = sorted(self.counts().items(), key=lambda kv: (-kv[1], kv[0]))
+        return ", ".join(f"{code} x{n}" for code, n in items)
+
+    def summary(self) -> dict:
+        """JSON-able summary for event-log queryStart records (what
+        tools/qualify mines): verdict, code->count, per-op code->count,
+        and the plan-time row estimate the qualify tool joins against
+        learned per-row costs."""
+        ops: Dict[str, Dict[str, int]] = {}
+        for (op, code), n in sorted(self.op_code_counts().items()):
+            ops.setdefault(op, {})[code] = n
+        return {"verdict": self.verdict,
+                "codes": dict(sorted(self.counts().items())),
+                "ops": ops,
+                "estRows": self.est_rows}
+
+    # ------------------------------------------------------------- render
+    def render(self, only_not_on_device: bool = False) -> str:
+        """The ``explain("placement")`` tree: per-operator device/host
+        verdicts with their reason codes, wrapping plan-level tags
+        first. ``only_not_on_device`` mirrors the reference's
+        NOT_ON_GPU mode (host rows and plan tags only)."""
+        lines = [f"placement verdict: {self.verdict}"]
+        counts = self.format_counts()
+        if counts:
+            lines.append(f"fallbacks: {counts}")
+        for t in self.plan_tags:
+            lines.append(f"[{t.code}] {t.detail} (wraps the whole plan)")
+        for e in self.entries:
+            pad = "  " * e.depth
+            # NOT_ON_DEVICE keeps device rows only when they carry
+            # per-expression fallback notes (partial host work)
+            if e.device and only_not_on_device and not e.expr_tags:
+                continue
+            marker, where = ("*", "device") if e.device else ("!", "host")
+            lines.append(f"{pad}{marker}Exec <{e.node}> on {where}")
+            for t in list(e.tags) + list(e.expr_tags):
+                lines.append(f"{pad}    [{t.code}] {t.detail}")
+        return "\n".join(lines)
+
+
+def build_report(meta, decision: Optional[str] = None,
+                 est_rows: Optional[int] = None) -> PlacementReport:
+    """Assemble a PlacementReport from a tagged (and cost-optimized)
+    PlanMeta tree — called by ``plan_query`` right before conversion."""
+    from .overrides import _NEUTRAL_PLANS  # function-level: no cycle
+    entries: List[_Entry] = []
+    device_seen = False
+
+    def walk(m, depth):
+        nonlocal device_seen
+        neutral = isinstance(m.plan, _NEUTRAL_PLANS)
+        if m.can_run_on_tpu and not neutral:
+            device_seen = True
+        entries.append(_Entry(type(m.plan).__name__, depth,
+                              m.can_run_on_tpu, neutral,
+                              list(m.tags), list(m.expr_tags)))
+        for c in m.child_metas:
+            walk(c, depth + 1)
+
+    walk(meta, 0)
+    return PlacementReport(entries, list(meta.plan_tags), decision,
+                           "device" if device_seen else "host",
+                           est_rows=est_rows)

@@ -26,15 +26,15 @@ from .rules_lifetime import BatchLifetimeRule
 from .rules_hostsync import HostSyncRule
 from .rules_jit import AdHocJitRule
 from .rules_drift import (ConfigKeyDriftRule, MetricNameDriftRule,
-                          OpsDocDriftRule)
+                          OpsDocDriftRule, ReasonCodeDriftRule)
 
 #: every shipped rule, in reporting order
 ALL_RULES = [RetryIdempotenceRule(), BatchLifetimeRule(), HostSyncRule(),
              AdHocJitRule(), ConfigKeyDriftRule(), OpsDocDriftRule(),
-             MetricNameDriftRule()]
+             MetricNameDriftRule(), ReasonCodeDriftRule()]
 
 __all__ = ["ALL_RULES", "FileContext", "FileRule", "Finding", "LintResult",
            "ProjectRule", "Rule", "lint_source", "load_baseline", "run_lint",
            "write_baseline", "RetryIdempotenceRule", "BatchLifetimeRule",
            "HostSyncRule", "AdHocJitRule", "ConfigKeyDriftRule",
-           "OpsDocDriftRule", "MetricNameDriftRule"]
+           "OpsDocDriftRule", "MetricNameDriftRule", "ReasonCodeDriftRule"]

@@ -15,6 +15,11 @@ registries.
   in the ``MetricRegistry`` inventory (metrics/registry.py
   ``_INVENTORY``) — the config-key-drift contract applied to the
   metric catalog.
+* ``reason-code-drift`` — every ``will_not_work_on_tpu`` /
+  ``note_expr_fallback`` call site must pass a reason code registered
+  in the ``plan/tags.py`` closed registry (``REASON_CODES``), so the
+  placement reports, the fallback metric family and the qualify tool
+  can never see an unregistered (or missing) code.
 
 All rules import the live registries; when that import itself fails
 (broken interpreter environment) they degrade to a single ``tool-error``
@@ -219,6 +224,93 @@ class MetricNameDriftRule(ProjectRule):
                     self.SOURCE_PREFIX.replace(os.sep, "/")):
                 continue
             findings.extend(self._scan_text(ctx.rel, ctx.source, inv))
+        return findings
+
+
+def _load_reason_codes() -> Set[str]:
+    from ...plan.tags import REASON_CODES
+    return set(REASON_CODES)
+
+
+class ReasonCodeDriftRule(ProjectRule):
+    name = "reason-code-drift"
+    contract = ("every will_not_work_on_tpu / note_expr_fallback call "
+                "site must pass a reason code registered in plan/tags.py "
+                "REASON_CODES — the closed-registry contract applied to "
+                "placement diagnostics (ISSUE 7)")
+
+    #: methods whose call sites must carry a code
+    METHODS = ("will_not_work_on_tpu", "note_expr_fallback")
+
+    def __init__(self, codes_loader: Optional[Callable[[], Set[str]]]
+                 = None):
+        self._codes_loader = codes_loader or _load_reason_codes
+
+    @staticmethod
+    def _terminal_names(val) -> List[Optional[str]]:
+        """Resolvable terminal symbol name(s) of a code argument:
+        string constants, Names, Attributes (``T.EXPR_UNSUPPORTED``),
+        and both branches of a conditional expression. ``None`` marks
+        an unresolvable value."""
+        if isinstance(val, ast.IfExp):
+            return (ReasonCodeDriftRule._terminal_names(val.body)
+                    + ReasonCodeDriftRule._terminal_names(val.orelse))
+        if isinstance(val, ast.Constant) and isinstance(val.value, str):
+            return [val.value]
+        if isinstance(val, ast.Attribute):
+            return [val.attr]
+        if isinstance(val, ast.Name):
+            return [val.id]
+        return [None]
+
+    def check_project(self, ctxs: Sequence[FileContext],
+                      root: str) -> Iterable[Finding]:
+        try:
+            codes = self._codes_loader()
+        except Exception as e:                    # degraded environment
+            return [Finding(
+                "tool-error", os.path.join("spark_rapids_tpu", "plan",
+                                           "tags.py"), 1,
+                f"{self.name}: cannot load the reason-code registry: "
+                f"{type(e).__name__}: {e}", key="codes-load")]
+        findings: List[Finding] = []
+        for ctx in ctxs:
+            if ctx.tree is None:
+                continue
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                name = (fn.attr if isinstance(fn, ast.Attribute)
+                        else getattr(fn, "id", None))
+                if name not in self.METHODS:
+                    continue
+                val = None
+                for kw in node.keywords:
+                    if kw.arg == "code":
+                        val = kw.value
+                if val is None and len(node.args) >= 2:
+                    val = node.args[1]      # (reason, code) positional
+                if val is None:
+                    findings.append(Finding(
+                        self.name, ctx.rel, node.lineno,
+                        f"{name}() call passes no reason code — every "
+                        "placement fallback must carry a plan/tags.py "
+                        "code", key=f"nocode:{name}"))
+                    continue
+                for term in self._terminal_names(val):
+                    # `code` is the forwarding-parameter idiom
+                    # (tags.revert_to_host passes its own argument on)
+                    if term == "code":
+                        continue
+                    if term is None or term not in codes:
+                        findings.append(Finding(
+                            self.name, ctx.rel, node.lineno,
+                            f"{name}() passes "
+                            f"{term or 'a non-constant expression'!r} as "
+                            "its reason code, which is not registered in "
+                            "plan/tags.py REASON_CODES",
+                            key=f"badcode:{name}:{term}"))
         return findings
 
 

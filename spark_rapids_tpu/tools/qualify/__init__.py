@@ -1,0 +1,225 @@
+"""Qualification tool: rank what keeps work on the host, from history.
+
+Reference analog: the spark-rapids Qualification tool, which mines
+Spark event logs for operators that fell back to the CPU and ranks the
+fixes by estimated GPU-time saved (tools/generated_files
+operatorsScore.csv provides the per-operator speedup priors). Here the
+input is the rotating query-history event log (metrics/events.py):
+since ISSUE 7 every ``queryStart`` record carries the query's coded
+``PlacementReport`` summary (``plan/tags.py``), so the history is
+minable for *why* queries ran on host — not just that they did.
+
+    python -m spark_rapids_tpu.tools.qualify EVENTLOG_DIR [--json]
+
+For every plan digest the tool pairs the latest placement summary with
+the MIN ok wall of its ``queryEnd`` records (the same stable estimator
+``tools/history --diff`` uses), then aggregates per reason code:
+
+* ``queries`` / ``digests`` — how many queries (and distinct shapes)
+  carry the code;
+* ``host_ms`` — host wall attributed to the code: each host-placed
+  digest's wall split across its codes proportionally to tag counts;
+* ``est_saved_ms`` — estimated device time saved by fixing the code.
+  When the cost model has a TRUSTED learned device row cost
+  (``plan/cost.learned_row_cost``, persisted by the stats store) and
+  the record carries a plan-time row estimate, the device wall is
+  priced from measurement: ``estRows * learned_cost``; otherwise the
+  per-operator speedup priors from ``tools/supported_ops`` apply
+  (``saved = wall * (1 - 1/score)``, the reference's
+  operatorsScore.csv method).
+
+Output is deterministic (identical logs render identical reports);
+crash-truncated event-log lines are skipped and counted, never fatal.
+Stdlib + in-repo imports only.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Dict, List, Optional
+
+__all__ = ["analyze", "format_report", "main"]
+
+#: decimal places of the rendered/JSON millisecond figures
+_ROUND = 3
+
+
+def _op_score(op: str) -> float:
+    """Per-operator speedup prior — tools/supported_ops scores, the
+    reference's operatorsScore.csv "exec speedup ~2-3x" defaults."""
+    from ..supported_ops import _DEFAULT_SCORE, _SCORE_OVERRIDES
+    # logical-plan names map onto their device exec scores where the
+    # mapping is unambiguous; everything else takes the default prior
+    alias = {"Filter": "TpuFilterExec", "Project": "TpuProjectExec",
+             "Aggregate": "TpuHashAggregateExec", "Join": "TpuHashJoinExec",
+             "Sort": "TpuSortExec", "Window": "TpuWindowExec",
+             "Repartition": "ShuffleExchangeExec",
+             "ParquetScan": "ParquetScanExec"}
+    return float(_SCORE_OVERRIDES.get(alias.get(op, op), _DEFAULT_SCORE))
+
+
+def _learned_device_cost() -> Optional[float]:
+    """Trusted measured seconds/row for fused device stages, merged
+    from the persisted stats store — None until enough rows were
+    actually measured (plan/cost._OP_COST_MIN_ROWS)."""
+    try:
+        from ...plan import cost
+        cost.load_persisted_stats()
+        return cost.learned_row_cost("WholeStageExec", "device")
+    except Exception:  # noqa: BLE001 - offline tool, degrade to priors
+        return None
+
+
+def analyze(path: str) -> dict:
+    """Aggregate fallback codes across an event log into the ranked
+    report structure (see module doc for the estimate semantics)."""
+    from ..history import load_events
+    events, skipped = load_events(path)
+    starts: Dict[object, dict] = {}
+    # digest -> {"placement": latest summary, "walls": [ok ms], "n": runs}
+    digests: Dict[str, dict] = {}
+    for rec in events:
+        ev = rec.get("event")
+        # starts key on (queryId, digest): queryId is a PER-SESSION
+        # sequence, and two sessions sharing one log dir (a supported
+        # multi-writer setup since PR 5) would collide on it alone,
+        # attaching one session's placement to the other's wall
+        if ev == "queryStart":
+            starts[(rec.get("queryId"),
+                    str(rec.get("planDigest")))] = rec
+        elif ev == "queryEnd":
+            dig = str(rec.get("planDigest"))
+            st = starts.pop((rec.get("queryId"), dig), None)
+            d = digests.setdefault(dig, {"placement": None, "walls": [],
+                                         "n": 0})
+            d["n"] += 1
+            if rec.get("ok") and rec.get("durationMs") is not None:
+                d["walls"].append(float(rec["durationMs"]))
+            pl = (st or {}).get("placement")
+            if pl:
+                d["placement"] = pl
+                d["completed_pl"] = True
+    # starts without an end (crash mid-query) still contribute their
+    # placement summary — but never over a COMPLETED run's: a stale
+    # crashed start must not clobber the summary of a later, finished
+    # (possibly re-configured) run of the same shape. Among crash-only
+    # records the LATEST start wins (dict preserves event order), the
+    # same freshest-summary rule the completed path uses.
+    for st in starts.values():
+        dig = str(st.get("planDigest"))
+        d = digests.setdefault(dig, {"placement": None, "walls": [],
+                                     "n": 0})
+        if st.get("placement") and not d.get("completed_pl"):
+            d["placement"] = st["placement"]
+
+    dev_cost = _learned_device_cost()
+    per_code: Dict[str, dict] = {}
+    n_with_placement = 0
+    n_host = 0
+    for dig in sorted(digests):
+        d = digests[dig]
+        pl = d["placement"]
+        if not pl:
+            continue
+        n_with_placement += 1
+        host_placed = pl.get("verdict") == "host"
+        if host_placed:
+            # counted BEFORE the codes gate: an all-neutral plan can be
+            # host-placed with zero codes, and the header must not
+            # understate host placement
+            n_host += 1
+        codes = {str(k): int(v) for k, v in (pl.get("codes") or {}).items()}
+        if not codes:
+            continue
+        ops = pl.get("ops") or {}
+        wall = min(d["walls"]) if d["walls"] else None
+        total_tags = sum(codes.values()) or 1
+        saved = 0.0
+        if wall is not None and host_placed:
+            est_rows = pl.get("estRows")
+            if dev_cost is not None and est_rows:
+                est_dev_ms = float(est_rows) * dev_cost * 1000.0
+                saved = max(0.0, wall - est_dev_ms)
+            else:
+                scores = sorted(_op_score(op) for op in ops) or [2.5]
+                prior = sum(scores) / len(scores)
+                saved = wall * (1.0 - 1.0 / prior)
+        for code in sorted(codes):
+            cnt = codes[code]
+            ent = per_code.setdefault(code, {
+                "code": code, "queries": 0, "digests": 0,
+                "host_ms": 0.0, "est_saved_ms": 0.0, "ops": {}})
+            ent["queries"] += d["n"] or 1
+            ent["digests"] += 1
+            share = cnt / total_tags
+            if wall is not None and host_placed:
+                ent["host_ms"] += wall * share
+                ent["est_saved_ms"] += saved * share
+            for op in sorted(ops):
+                if code in ops[op]:
+                    ent["ops"][op] = (ent["ops"].get(op, 0)
+                                      + int(ops[op][code]))
+    ranked: List[dict] = sorted(
+        per_code.values(),
+        key=lambda e: (-e["est_saved_ms"], -e["host_ms"], -e["queries"],
+                       e["code"]))
+    for e in ranked:
+        e["host_ms"] = round(e["host_ms"], _ROUND)
+        e["est_saved_ms"] = round(e["est_saved_ms"], _ROUND)
+        e["ops"] = dict(sorted(e["ops"].items(),
+                               key=lambda kv: (-kv[1], kv[0])))
+    return {"source": os.path.basename(os.path.abspath(path)),
+            "queries_with_placement": n_with_placement,
+            "host_placed": n_host,
+            "skipped_lines": skipped,
+            "learned_device_cost": dev_cost,
+            "codes": ranked}
+
+
+def format_report(rep: dict) -> str:
+    """Human rendering of analyze() — deterministic, golden-tested."""
+    from ...plan.tags import REASON_CODES
+    lines = ["== Qualification: top reasons keeping work on host ==",
+             f"source: {rep['source']}; "
+             f"{rep['queries_with_placement']} plan shape(s) with "
+             f"placement records, {rep['host_placed']} host-placed; "
+             f"{rep['skipped_lines']} undecodable line(s) skipped",
+             f"cost basis: "
+             + ("learned device row cost "
+                f"{rep['learned_device_cost']:.3e} s/row"
+                if rep.get("learned_device_cost")
+                else "operator speedup priors (no trusted learned costs)"),
+             "",
+             f"{'rank':>4}  {'code':<24} {'queries':>7}  {'host ms':>10}  "
+             f"{'est saved ms':>12}  top ops"]
+    for i, e in enumerate(rep["codes"], start=1):
+        ops = ", ".join(list(e["ops"])[:3]) or "-"
+        lines.append(f"{i:>4}  {e['code']:<24} {e['queries']:>7}  "
+                     f"{e['host_ms']:>10.1f}  {e['est_saved_ms']:>12.1f}  "
+                     f"{ops}")
+    if not rep["codes"]:
+        lines.append("(no fallback codes recorded — everything planned "
+                     "onto the device, or the log predates ISSUE 7)")
+    lines.append("")
+    for e in rep["codes"]:
+        lines.append(f"{e['code']}: "
+                     f"{REASON_CODES.get(e['code'], '(unknown code)')}")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m spark_rapids_tpu.tools.qualify",
+        description="Rank the reasons keeping query work on the host "
+                    "from a query-history event log (docs/placement.md).")
+    ap.add_argument("log", help="event-log directory or file")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+    rep = analyze(args.log)
+    if args.json:
+        print(json.dumps(rep, sort_keys=True))
+    else:
+        print(format_report(rep), end="")
+    return 0

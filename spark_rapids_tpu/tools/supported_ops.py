@@ -87,6 +87,8 @@ def _load_registries():
               "spark_rapids_tpu.plan.cost",
               "spark_rapids_tpu.plan.exec_cache",
               "spark_rapids_tpu.plan.stats_store",
+              "spark_rapids_tpu.plan.tags",
+              "spark_rapids_tpu.tools.qualify",
               "spark_rapids_tpu.parallel.planner",
               "spark_rapids_tpu.mem.manager",
               "spark_rapids_tpu.mem.semaphore",

@@ -84,6 +84,45 @@ def test_metric_name_drift_detects_unknown_names(tmp_path):
     assert paths["unknown:srtpu_typo_bytes"] == hist_rel
 
 
+def test_reason_code_drift_detects_bad_call_sites():
+    """Self-test of the reason-code-drift rule: call sites missing a
+    code, or passing an unregistered one, are flagged; registered codes
+    (constants, attributes, conditional expressions over registered
+    codes) and the `code` forwarding-parameter idiom are not."""
+    from spark_rapids_tpu.tools.lint.framework import FileContext
+    from spark_rapids_tpu.tools.lint.rules_drift import ReasonCodeDriftRule
+    rule = ReasonCodeDriftRule(
+        codes_loader=lambda: {"GOOD_CODE", "OTHER_CODE"})
+    src = (
+        "def f(m, T, code, flag):\n"
+        "    m.will_not_work_on_tpu('r', code=T.GOOD_CODE)\n"      # ok
+        "    m.will_not_work_on_tpu('r', 'GOOD_CODE')\n"           # ok
+        "    m.note_expr_fallback('n', code='OTHER_CODE')\n"       # ok
+        "    m.will_not_work_on_tpu('r', code=(T.GOOD_CODE if flag"
+        " else T.OTHER_CODE))\n"                                   # ok
+        "    m.will_not_work_on_tpu('r', code=code)\n"             # fwd ok
+        "    m.will_not_work_on_tpu('r')\n"                        # missing
+        "    m.note_expr_fallback('n', code=T.TYPO_CODE)\n"        # unknown
+        "    m.will_not_work_on_tpu('r', code=(T.GOOD_CODE if flag"
+        " else T.BAD_BRANCH))\n"                                   # branch
+    )
+    rel = "spark_rapids_tpu/plan/overrides.py"
+    findings = list(rule.check_project(
+        [FileContext(rel, src, rel=rel)], "/nonexistent"))
+    keys = sorted(f.key for f in findings)
+    assert keys == ["badcode:note_expr_fallback:TYPO_CODE",
+                    "badcode:will_not_work_on_tpu:BAD_BRANCH",
+                    "nocode:will_not_work_on_tpu"], findings
+
+
+def test_reason_code_drift_clean_on_shipped_tree():
+    # every live call site passes a registered plan/tags.py code
+    from spark_rapids_tpu.tools.lint.rules_drift import ReasonCodeDriftRule
+    result = run_lint([PKG_ROOT], rules=[ReasonCodeDriftRule()],
+                      baseline={}, root=REPO_ROOT)
+    assert [f for f in result.findings] == [], result.findings
+
+
 def test_metric_name_drift_clean_on_shipped_catalog():
     # the live inventory covers every name the shipped docs + history
     # tool reference (the drift contract this rule enforces)
