@@ -673,11 +673,20 @@ def main():
     dev = [d["speedup"] for d in details.values()
            if d["placement"] == "device"]
     geo_dev = (float(np.exp(np.mean(np.log(dev)))) if dev else None)
+    # one-line-diffable regression surface (schema note in
+    # docs/tuning.md): top-level geomean + device/host rung tally, so
+    # BENCH_rXX rounds compare on two keys instead of a details crawl
+    placement_counts = {"device": 0, "host": 0}
+    for d in details.values():
+        placement_counts[d["placement"]] = \
+            placement_counts.get(d["placement"], 0) + 1
     print(json.dumps({
         "metric": "ladder_geomean_speedup",
         "value": round(geo, 3),
         "unit": "x_vs_pandas",
         "vs_baseline": round(geo, 3),
+        "geomean": round(geo, 3),
+        "placement_counts": placement_counts,
         "platform": jax.devices()[0].platform,
         "device_only_geomean": (round(geo_dev, 3)
                                 if geo_dev is not None else None),

@@ -734,10 +734,22 @@ class DataFrame:
         prof.maybe_start()
         elog = self.session.event_log
         qid = digest = None
+
+        def _resolve_digest():
+            # the planner already hashed the pre-rewrite tree when the
+            # optimizer ran (overrides.plan_query attaches it) — re-hash
+            # only when it didn't. ONE resolution chain for both
+            # consumers (queryStart record, record_plan_compiled):
+            # lookup and record must agree on the digest.
+            d = getattr(physical, "plan_digest", None)
+            if d is None:
+                from ..metrics.events import plan_digest
+                d = plan_digest(self.plan)
+            return d
+
         if elog is not None:
-            from ..metrics.events import plan_digest
             qid = next(self.session._query_seq)
-            digest = plan_digest(self.plan)
+            digest = _resolve_digest()
             elog.write({"event": "queryStart", "queryId": qid,
                         "planDigest": digest,
                         "root": type(self.plan).__name__,
@@ -748,6 +760,12 @@ class DataFrame:
                                  in sorted(self.session.conf.raw.items())}})
         trace_path = None
         import time as _time
+        # executable-cache counters around the run: zero in-process
+        # misses AND zero backend-compile seconds = a COMPILE-FREE run,
+        # the only kind the cost model learns walls from (plan/cost.py
+        # record_engine_wall / record_op_wall exec-cache-hit keying)
+        from ..plan import exec_cache
+        cache_before = exec_cache.stats()
         t0 = _time.perf_counter()
         ok = False
         try:
@@ -828,8 +846,29 @@ class DataFrame:
                 #: benchmark/diagnostic surface: which engine actually ran
                 #: the last materialized query on this session
                 self.session.last_placement = placement
+                compile_free = exec_cache.compile_free_since(cache_before)
+                # wall_s, not a fresh perf_counter diff: the elog write
+                # and metrics export above are observability overhead,
+                # not engine time — and a >=1-observation-trusted wall
+                # inflated by them could flip a close arbitration
                 record_engine_wall(plan_signature(self.plan), placement,
-                                   _time.perf_counter() - t0)
+                                   wall_s, compile_free=compile_free)
+                # per-operator self-times -> the learned cost table
+                # (device AND host row costs; metrics/analyze.py)
+                from ..metrics.analyze import record_learned_op_costs
+                record_learned_op_costs(physical, ctx, compile_free)
+                if placement == "device":
+                    # this plan's kernels now live in the executable
+                    # cache tiers: the planner's cache-aware floor
+                    # charges warm repeats dispatch-only (plan/cost.py).
+                    # Only the optimizer reads the digest set, and the
+                    # planner hashes the tree exactly when the optimizer
+                    # runs — with it off (and no event log) don't pay a
+                    # full-tree hash to record a digest nothing reads.
+                    if digest is None:
+                        digest = getattr(physical, "plan_digest", None)
+                    if digest is not None:
+                        exec_cache.record_plan_compiled(digest)
 
     def collect_arrow(self):
         return self._execute_wrapped(lambda p, ctx: p.collect(ctx))

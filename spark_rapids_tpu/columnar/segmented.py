@@ -27,8 +27,9 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["DENSE_MAX", "SortedSegments", "bucket_segments", "seg_sum",
-           "seg_min", "seg_max", "seg_count", "onehot_gather"]
+__all__ = ["DENSE_MAX", "SortedSegments", "GlobalSegments",
+           "bucket_segments", "seg_sum", "seg_min", "seg_max",
+           "seg_count", "onehot_gather"]
 
 #: largest static segment count handled by the dense one-hot strategy
 DENSE_MAX = 4096
@@ -210,6 +211,60 @@ class SortedSegments:
                       for pv, v in zip(pvs, vs)))
             d <<= 1
         return list(vs), r, o
+
+
+class GlobalSegments(SortedSegments):
+    """Single-segment (key-less aggregation) context: every reduction is
+    ONE masked vector reduce instead of a log2(n) Hillis-Steele scan.
+    The q9 shape — N conditional aggregates over the whole batch — drops
+    from ~2N scans x log2(P) full-array shift/combine passes to N single
+    reduces that XLA fuses into a handful of HBM sweeps, all still ONE
+    kernel dispatch per batch.
+
+    Results come back as shape-(1,) totals; callers (global_groupby)
+    read element [-1] exactly as they do the scan path's last row, so
+    every AggregateExpression.update/merge works over either context
+    unchanged. Reduction ORDER differs from the scan path for floats
+    (both differ from a sequential sum; neither is more exact)."""
+
+    def __init__(self, live, orig_index=None):
+        flags = jnp.zeros(live.shape, jnp.bool_).at[0].set(True)
+        super().__init__(flags, live, orig_index=orig_index)
+
+    def sum(self, data, valid):
+        ok = jnp.logical_and(valid, self.live)
+        z = jnp.zeros((), dtype=data.dtype)
+        return jnp.sum(jnp.where(ok, data, z), dtype=data.dtype)[None]
+
+    def min(self, data, valid):
+        ok = jnp.logical_and(valid, self.live)
+        big = _neutral_max(data.dtype)
+        return jnp.min(jnp.where(ok, data, big))[None]
+
+    def max(self, data, valid):
+        ok = jnp.logical_and(valid, self.live)
+        small = _neutral_min(data.dtype)
+        return jnp.max(jnp.where(ok, data, small))[None]
+
+    def count(self, pred, dtype=jnp.int64):
+        ok = jnp.logical_and(pred, self.live)
+        return jnp.sum(ok.astype(dtype), dtype=dtype)[None]
+
+    def select_by_rank(self, values, rank, valid, mode: str):
+        """Global argmin/argmax over rank — one reduce + one row gather
+        (group-sized, i.e. a single element) instead of the scan."""
+        ok = jnp.logical_and(valid, self.live)
+        if mode == "min":
+            neutral_r = _neutral_max(rank.dtype)
+            r = jnp.where(ok, rank, neutral_r)
+            i = jnp.argmin(r)
+        else:
+            neutral_r = _neutral_min(rank.dtype)
+            r = jnp.where(ok, rank, neutral_r)
+            i = jnp.argmax(r)
+        any_ok = jnp.any(ok)[None]
+        sel = [v[i][None] for v in values]
+        return sel, r[i][None], any_ok
 
 
 def seg_sum(data, gid, num_segments: int):

@@ -126,6 +126,7 @@ def _build_groupby_kernel(key_exprs: Sequence[Expression],
     kernel.n_param_slots = len(slots)
     kernel._prep = prep
     kernel._value_exprs = value_exprs
+    kernel.n_dispatches = 1      # one fused module per batch
     return kernel
 
 
@@ -273,6 +274,7 @@ def _build_groupby_kernel_split(key_exprs, aggs, schema, mode,
         return list(key_outs), list(partial_outs), ng
 
     kernel.n_param_slots = fused.n_param_slots
+    kernel.n_dispatches = 4      # prep + sort + scan + pack modules
     return kernel
 
 
@@ -448,6 +450,14 @@ class TpuHashAggregateExec(TpuExec):
         fields += [StructField(a.name_hint, a.data_type(cs), True)
                    for a in self.aggs]
         self._schema = Schema(fields)
+        if self.pre_stages:
+            # the trace contract for fused regions (exec/base._traced_iter
+            # reads trace_args): one span per batch showing what the
+            # update kernel swallowed — the partial-agg analog of
+            # WholeStageExec's fused=[...] annotation
+            self.trace_args = {"fused": [
+                ("filter" if s[0] == "filter" else "project")
+                for s in self.pre_stages] + ["partial-agg"]}
         # partial (intermediate) schema: keys then each agg's partials
         # (string keys travel as their int32 codes)
         pfields = [StructField(f"_k{i}",
@@ -1152,6 +1162,12 @@ class TpuHashAggregateExec(TpuExec):
             _param_exprs(self._kernel_groupings, self.aggs, "update",
                          self.pre_stages or None)))
         rows_m = ctx.metric(self._exec_id, "numOutputRows", ESSENTIAL)
+        #: compiled-module launches of the UPDATE phase, per query: the
+        #: fused-partial-agg acceptance metric — a q9-shaped
+        #: scan→filter→partial-agg region must cost exactly ONE dispatch
+        #: per input batch (fused/direct kernels), vs 4 on the split
+        #: sort pipeline and one per operator when fusion is off
+        disp_m = ctx.metric(self._exec_id, "updateDispatches")
 
         it = self.children[0].execute(ctx)
         first = next(it, None)
@@ -1189,6 +1205,7 @@ class TpuHashAggregateExec(TpuExec):
                     return self._fast_single_batch(ctx, first, update_k)
             out = with_retry_no_split(run_fast, ctx.memory)
             if out is not None:
+                disp_m.add(1)    # fused update+finalize: one module
                 _FAST_GROUPS[self._kernel_key] = out.num_rows
                 rows_m.add(out.num_rows)
                 yield out
@@ -1214,7 +1231,8 @@ class TpuHashAggregateExec(TpuExec):
         WINDOW = 8
         partials: List[SpillableBatch] = []
         row_base = 0     # global row offset of the next batch
-        window = []  # (sliced outs, num_groups dev scalar, dispatch, base)
+        # (sliced outs, num_groups dev scalar, dispatch, base, n_disp)
+        window = []
 
         #: (value ordinal, position ordinal) per First/Last aggregate:
         #: their within-batch row positions must become GLOBAL before the
@@ -1240,14 +1258,17 @@ class TpuHashAggregateExec(TpuExec):
                 def resolve_counts():
                     import numpy as _np
                     return [int(x) for x in
-                            _np.asarray(jnp.stack([ng for _, ng, _d, _b
-                                                   in window]))]
+                            _np.asarray(jnp.stack([w[1] for w in window]))]
                 counts = with_retry_no_split(resolve_counts, ctx.memory)
-            for (outs, _, dispatch, base), n in zip(window, counts):
+            for (outs, _, dispatch, base, n_disp), n in zip(window,
+                                                            counts):
                 if n > spec:
                     # speculation overflow: re-run this batch's kernel
                     # (pure function of retained inputs) and slice at the
-                    # true count
+                    # true count — a second real launch, so the dispatch
+                    # metric counts it again
+                    disp_m.add(n_disp)
+
                     def redo(d=dispatch):
                         with ctx.semaphore.held():
                             return d()[0]
@@ -1272,6 +1293,8 @@ class TpuHashAggregateExec(TpuExec):
             if direct is not None:
                 kern, (cards, pairs, remaps) = direct
                 _check_scalar_slots(kern, self._upd_scalars)
+                n_disp = 1
+                disp_m.add(n_disp)
 
                 def dispatch(b=batch, k=kern, c=cards, p=pairs, r=remaps):
                     base_cols = [(cc.data, cc.validity)
@@ -1283,6 +1306,8 @@ class TpuHashAggregateExec(TpuExec):
                     return list(ko) + list(po), ng
             else:
                 codes = [] if self._rect_mode else self._augment(batch)
+                n_disp = getattr(update_k_split, "n_dispatches", 1)
+                disp_m.add(n_disp)
 
                 def dispatch(b=batch, extra=codes):
                     return self._run_kernel_raw(
@@ -1306,7 +1331,7 @@ class TpuHashAggregateExec(TpuExec):
                     return [_spec_slice(d_, v) for d_, v in outs], ng
             # idempotent over the input batch -> retry-safe
             outs, ng = with_retry_no_split(first_pass, ctx.memory)
-            window.append((outs, ng, dispatch, row_base))
+            window.append((outs, ng, dispatch, row_base, n_disp))
             row_base += batch.padded_len
             if len(window) >= WINDOW:
                 flush_window()

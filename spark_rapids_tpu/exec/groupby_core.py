@@ -28,7 +28,8 @@ from typing import List, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
-from ..columnar.segmented import SortedSegments, prefix_sum
+from ..columnar.segmented import (GlobalSegments, SortedSegments,
+                                  prefix_sum)
 from ..exprs.base import DVal
 from .encoding import grouping_operands, operands_equal
 
@@ -38,13 +39,15 @@ __all__ = ["segmented_groupby", "stage_sort", "stage_scan", "stage_pack",
 
 def global_groupby(vals: List[List[DVal]], aggs: Sequence, mode: str,
                    num_rows, padded_len: int, row_mask=None):
-    """Key-less (global) aggregation: a single segment over the unsorted
-    rows — no sort at all; each scan's inclusive total lands at the last
-    row."""
+    """Key-less (global) aggregation: ONE segment, evaluated as plain
+    masked reductions (GlobalSegments) — every aggregate's update is a
+    single vector pass instead of a log2(n) segmented scan, and ALL N
+    aggregates trace into the one kernel: the q9 multi-aggregate shape
+    costs one dispatch and ~N fused HBM sweeps per batch."""
     if row_mask is None:
         row_mask = jnp.arange(padded_len, dtype=jnp.int32) < num_rows
     idx = jnp.arange(padded_len, dtype=jnp.int32)
-    seg = SortedSegments(idx == 0, row_mask, orig_index=idx)
+    seg = GlobalSegments(row_mask, orig_index=idx)
     num_groups = jnp.int32(1)
     partial_rows = _run_aggs(aggs, vals, seg, mode, row_mask)
     partial_outs = [(jnp.where(idx == 0, d[-1],

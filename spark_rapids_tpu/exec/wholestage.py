@@ -41,7 +41,8 @@ from ..types import Schema
 from . import basic as B
 from .base import ESSENTIAL, ExecContext, TpuExec
 
-__all__ = ["WholeStageExec", "fuse_whole_stages", "FUSION_ENABLED"]
+__all__ = ["WholeStageExec", "fuse_whole_stages", "FUSION_ENABLED",
+           "AGG_FUSION_ENABLED"]
 
 FUSION_ENABLED = register(
     "spark.rapids.tpu.fusion.enabled", True,
@@ -59,6 +60,19 @@ FUSION_MIN_OPS = register(
     "spark.rapids.tpu.fusion.minOperators", 2,
     "Minimum chain length worth fusing: a single operator already is "
     "one dispatch, so wrapping it only adds indirection.", internal=True)
+
+AGG_FUSION_ENABLED = register(
+    "spark.rapids.tpu.fusion.aggregate.enabled", True,
+    "Fold the chain of device filter/project operators feeding an "
+    "aggregation INTO its update kernel (plan/overrides.py "
+    "_fold_stages): scan->filter->project->partial-agg runs as ONE "
+    "compiled dispatch per batch — the whole-stage fusion extended "
+    "through partial aggregation, the tpcds q9/q28 multi-aggregate "
+    "shape. EXPLAIN shows the folded region as "
+    "HashAggregate[...] fused=[...]; the exec's updateDispatches "
+    "metric counts the actual kernel launches per batch. Off = the "
+    "per-operator pipeline (byte-identical results, one dispatch and "
+    "one compaction per stage).", commonly_used=True)
 
 
 def _nondeterministic(exprs) -> bool:
@@ -188,6 +202,8 @@ class WholeStageExec(TpuExec):
                    ctx.metric(op._exec_id, "numOutputRows", ESSENTIAL),
                    ctx.metric(op._exec_id, "numOutputBatches"))
                   for op in self.fused_ops]
+        from ..plan import exec_cache
+        cache0 = exec_cache.stats()
         in_rows = 0
         stage_wall = 0.0
         for batch in self.children[0].execute(ctx):
@@ -217,10 +233,23 @@ class WholeStageExec(TpuExec):
         if in_rows and stage_wall > 0.0:
             # measured fused-stage device wall -> the cost model: the
             # optimizer learns that fused device regions are cheap
-            # instead of pricing them from static per-row guesses
-            from ..plan.cost import record_op_wall
-            record_op_wall("WholeStageExec", "device", in_rows,
-                           stage_wall)
+            # instead of pricing them from static per-row guesses.
+            # Keyed on exec-cache hit status: a first run whose wall
+            # includes jit trace / XLA compile measures the cold start,
+            # not the region — only compile-free walls are learned
+            # (that keying is what let trusted_engine_wall drop its
+            # old >=2-observation workaround to >=1-with-cache-hit)
+            compile_free = exec_cache.compile_free_since(cache0)
+            from ..plan import cost as plan_cost
+            plan_cost.record_op_wall(
+                "WholeStageExec", "device", in_rows, stage_wall,
+                compile_free=compile_free,
+                # under-scale regions measure dispatch floor, not per-row
+                # cost — the same sample gate as the analyze.py feed
+                # (without it, warm small repeats would accumulate
+                # dispatch-dominated quotients past _OP_COST_MIN_ROWS
+                # and poison the trusted per-row price)
+                min_rows=plan_cost._OP_COST_SAMPLE_MIN_ROWS)
 
     def _run_fused(self, batch: ColumnarBatch):
         from ..columnar.column import DictColumn

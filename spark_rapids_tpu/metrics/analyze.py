@@ -16,7 +16,7 @@ from __future__ import annotations
 
 from typing import Dict
 
-__all__ = ["render_analyzed_plan"]
+__all__ = ["render_analyzed_plan", "record_learned_op_costs"]
 
 
 def _fmt_count(v) -> str:
@@ -33,6 +33,104 @@ def _fmt_count(v) -> str:
 
 def _fmt_ms(seconds: float) -> str:
     return f"{seconds * 1000.0:.1f}ms"
+
+
+#: physical exec class name -> learned-cost kind (plan/cost.node_kind's
+#: key space): device execs and their CPU twins land on the SAME kind so
+#: the cost model holds a device AND a host per-row price per operator
+#: family. WholeStageExec keeps its own kind (it prices fused regions).
+_EXEC_KIND = {
+    "TpuFilterExec": "Filter", "CpuFilterExec": "Filter",
+    "TpuProjectExec": "Project", "CpuProjectExec": "Project",
+    "TpuHashAggregateExec": "Aggregate", "CpuAggregateExec": "Aggregate",
+    "TpuHashJoinExec": "Join", "TpuBroadcastHashJoinExec": "Join",
+    "TpuNestedLoopJoinExec": "Join", "CpuJoinExec": "Join",
+    "TpuSortExec": "Sort", "CpuSortExec": "Sort",
+    "TpuWindowExec": "Window", "CpuWindowExec": "Window",
+    "TpuExpandExec": "Expand",
+    "WholeStageExec": "WholeStageExec",
+}
+
+
+def record_learned_op_costs(physical, ctx, compile_free: bool) -> None:
+    """Feed the per-operator SELF times this query measured into the
+    cost model's learned per-operator row cost table (plan/cost.py
+    _OP_COSTS) — the live feedback loop that replaces the static
+    host/device per-row guesses with what this machine measured.
+
+    Self time = cumulative opTime minus the children's cumulative (the
+    EXPLAIN ANALYZE interval math); rows = the operator's INPUT rows
+    (children's numOutputRows — the rows it processed, matching how the
+    cost model charges nodes). Lazy device row counts (jax scalars) are
+    SKIPPED rather than forced: this runs on every query and must never
+    add a tunnel sync. record_op_wall's per-query sample gate
+    (_OP_COST_SAMPLE_MIN_ROWS) drops dispatch-floor-dominated small
+    runs; compile-laden runs are dropped wholesale (the exec-cache-hit
+    keying).
+
+    What a DEVICE self-time measures — deliberately: device kernels
+    dispatch asynchronously (the host-sync lint rule bans mid-pipeline
+    forces), so a device operator's metered wall is its dispatch + any
+    host-side prep, while the device wait drains in the sink's single
+    packed fetch, which the per-query floor already prices. That makes
+    the learned device s/row the operator's MARGINAL contribution to
+    the query wall — the quantity the per-subtree host-vs-device
+    comparison needs on a tunneled backend — not device occupancy.
+    Device-BOUND shapes (where occupancy is the wall) are caught by the
+    whole-query engine-wall arbitration and its symmetric exploration
+    (plan/cost.py), never by per-node pricing. The distortion left:
+    an operator that does sync per batch (the aggregate's speculation
+    windows) absorbs its upstream chain's lazy work into its own self
+    time — an overestimate, i.e. conservative for device placement."""
+    from ..plan.cost import _OP_COST_SAMPLE_MIN_ROWS, record_op_wall
+
+    def raw(node, name):
+        m = (ctx.metrics.get(node._exec_id) or {}).get(name)
+        v = m.value if m is not None else None
+        return v if isinstance(v, (int, float)) else None
+
+    # iterative traversal, deliberately: a recursive closure here would
+    # be a function->cell reference cycle pinning ctx (and through it
+    # every cached broadcast relation) until the next gc pass — the
+    # suite's zero-leak audit relies on refcount-driven cleanup
+    try:
+        stack = [physical]
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children)
+            kind = _EXEC_KIND.get(type(node).__name__)
+            # WholeStageExec feeds its own measured dispatch wall from
+            # inside execution (exec/wholestage.py) — never double-count
+            if kind is None or kind == "WholeStageExec":
+                continue
+            if kind == "Aggregate" and getattr(node, "pre_stages", None):
+                # folded filter/project stages run INSIDE this exec's
+                # update kernel, so its self time covers THEIR work too
+                # — but the planner still charges the logical Filter /
+                # Project nodes their own learned costs on the same
+                # rows. Learning "Aggregate" from a folded sample would
+                # double-count the folded work in every device estimate
+                # for exactly the q9 shapes this feed exists to flip.
+                continue
+            cum = raw(node, "opTime") or 0.0
+            child_cum = sum(raw(c, "opTime") or 0.0
+                            for c in node.children)
+            self_s = max(0.0, float(cum) - float(child_cum))
+            if node.children:
+                rows = [raw(c, "numOutputRows") for c in node.children]
+                rows_in = (sum(int(r) for r in rows)
+                           if all(r is not None for r in rows) else None)
+            else:
+                r = raw(node, "numOutputRows")
+                rows_in = int(r) if r is not None else None
+            if rows_in and self_s > 0.0:
+                record_op_wall(kind,
+                               "device" if node.is_tpu else "host",
+                               rows_in, self_s,
+                               compile_free=compile_free,
+                               min_rows=_OP_COST_SAMPLE_MIN_ROWS)
+    except Exception:  # noqa: BLE001 - telemetry must never fail a query
+        pass
 
 
 def render_analyzed_plan(physical, ctx) -> str:

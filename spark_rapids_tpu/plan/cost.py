@@ -36,14 +36,28 @@ TRANSITION_COST = register(
 
 DEVICE_QUERY_FLOOR = register(
     "spark.rapids.tpu.sql.optimizer.device.queryFloorSeconds", 0.12,
-    "Fixed wall cost any device placement pays once per query: kernel "
-    "dispatch + the D2H result fetch (and H2D when the input is not "
-    "already resident). Measured ~0.1-0.25 s on this tunneled backend "
+    "Fixed wall cost a COLD device placement pays once per query: jit "
+    "trace + (persistent-tier-miss) XLA compile + kernel dispatch + the "
+    "D2H result fetch. Measured ~0.1-0.25 s on this tunneled backend "
     "(docs/performance.md); set near 0.002 on a directly-attached TPU. "
+    "Split against dispatchFloorSeconds: a plan digest whose compiled "
+    "executables are already warm in the two-tier executable cache "
+    "(plan/exec_cache.py) pays only the dispatch component, so warm "
+    "repeats — the serving case — are costed without the compile floor. "
     "Queries whose whole-plan host estimate beats device+floor revert to "
     "the host engine — the reference's CostBasedOptimizer transition "
     "revert generalized to the per-query floor that dominates small "
     "inputs on a tunnel.", commonly_used=True)
+
+DEVICE_DISPATCH_FLOOR = register(
+    "spark.rapids.tpu.sql.optimizer.device.dispatchFloorSeconds", 0.02,
+    "The dispatch-only component of the per-query device floor: kernel "
+    "launch + D2H result fetch with every executable already resolved "
+    "from the live or persistent compile cache (plan/exec_cache.py). "
+    "Charged instead of queryFloorSeconds when the plan digest is known "
+    "compiled — the cache-aware re-costing that flips warm repeats of "
+    "small queries onto the device. Never charged above "
+    "queryFloorSeconds.", commonly_used=True)
 
 #: vectorized per-row host cost by node kind (numpy/pyarrow kernels, NOT
 #: the reference's per-row-interpreter 2e-4 — this engine's host twin is
@@ -66,6 +80,27 @@ _HOST_ROW_COST = {
 _HOST_ROW_DEFAULT = 2.0e-8
 
 
+#: logical node type -> learned-cost-table kind name (the key space of
+#: record_op_wall / learned_row_cost). One kind per operator family —
+#: coarse on purpose: the learned table prices "what a Filter costs per
+#: row on this machine", not one entry per query shape (shapes are the
+#: engine walls' job).
+_KIND_OF = {
+    L.Filter: "Filter",
+    L.Project: "Project",
+    L.Aggregate: "Aggregate",
+    L.Join: "Join",
+    L.Sort: "Sort",
+    L.Window: "Window",
+    L.Expand: "Expand",
+}
+
+
+def node_kind(plan) -> Optional[str]:
+    """Learned-cost kind for a logical node (None = not learned)."""
+    return _KIND_OF.get(type(plan))
+
+
 def _expr_weight(e) -> int:
     """Expression-tree node count: one vectorized host kernel pass per
     node is the cost unit (a 5-comparison filter costs ~5x one compare)."""
@@ -73,7 +108,15 @@ def _expr_weight(e) -> int:
 
 
 def _host_node_cost(plan, rows_in: float, cpu_scale: float) -> float:
-    """Vectorized host cost of one node over its INPUT rows."""
+    """Vectorized host cost of one node over its INPUT rows. A TRUSTED
+    learned host row cost for the node's kind (fed back from the host
+    twin's measured per-operator self-times) replaces the static table —
+    what this machine measured beats any calibration constant."""
+    kind = node_kind(plan)
+    if kind is not None:
+        lc = learned_row_cost(kind, "host")
+        if lc is not None:
+            return lc * rows_in
     per_pass = 3.0e-9       # one numpy/arrow elementwise pass per row
     if isinstance(plan, L.Aggregate):
         if plan.groupings:
@@ -263,10 +306,13 @@ def record_runtime_rows(sig: str, rows: int) -> None:
 #: measured whole-query wall seconds per (plan signature, placement):
 #: the ground truth that overrides the static floor model once an engine
 #: has actually been tried — mispriced shapes self-correct on the next
-#: planning. Values are (observations, min seconds); a placement's wall
-#: is TRUSTED only after >= 2 observations, because the first device run
-#: of a shape carries its XLA compile (minutes on a remote backend) and
-#: must not poison the choice
+#: planning. Values are (compile-free observations, min seconds).
+#: Walls are keyed on executable-cache hit status at record time: only
+#: COMPILE-FREE runs (zero in-process cache misses, zero backend-compile
+#: seconds during the query) are ingested, so one observation suffices
+#: for trust — the old >=2-observation workaround existed solely because
+#: first-run walls smuggled their XLA compile (minutes on a remote
+#: backend) into the measurement
 _ENGINE_WALLS: dict = {}
 
 
@@ -279,11 +325,19 @@ def load_persisted_stats() -> None:
     """Merge the on-disk adaptive stats (stats_store.py) into the live
     dicts — idempotent, called lazily before the first read."""
     if _persist_enabled():
-        from . import stats_store
-        stats_store.load_into(_ENGINE_WALLS, _RUNTIME_ROWS, _OP_COSTS)
+        from . import exec_cache, stats_store
+        stats_store.load_into(_ENGINE_WALLS, _RUNTIME_ROWS, _OP_COSTS,
+                              exec_cache._PLAN_DIGESTS)
 
 
-def record_engine_wall(sig: str, placement: str, seconds: float) -> None:
+def record_engine_wall(sig: str, placement: str, seconds: float,
+                       compile_free: bool = True) -> None:
+    """Record a measured whole-query wall. ``compile_free=False`` (the
+    caller saw executable-cache misses or backend-compile time during
+    the run) drops the sample: a compile-laden wall measures the cold
+    start, not the engine, and must never gate the placement choice."""
+    if not compile_free:
+        return
     if len(_ENGINE_WALLS) >= _RUNTIME_SIZES_MAX \
             and (sig, placement) not in _ENGINE_WALLS:
         _ENGINE_WALLS.pop(next(iter(_ENGINE_WALLS)))
@@ -297,28 +351,46 @@ def record_engine_wall(sig: str, placement: str, seconds: float) -> None:
 
 
 def trusted_engine_wall(sig: str, placement: str):
+    # >=1 observation: every recorded wall is already compile-free
+    # (record_engine_wall keys on exec-cache hit status), so the first
+    # sample is representative — the >=2 rule this replaces only guarded
+    # against compile-poisoned first runs
     got = _ENGINE_WALLS.get((sig, placement))
-    if got is None or got[0] < 2:
+    if got is None or got[0] < 1:
         return None
     return got[1]
 
 
 #: learned per-row operator costs from LIVE self-times, keyed
 #: (operator kind, placement) -> (rows processed, seconds): the metrics
-#: registry already measures every operator — feeding those walls back
-#: here replaces the static per-row guesses with what this machine
-#: actually measured (e.g. fused device stages are priced from their
-#: real dispatch walls, exec/wholestage.py). Persisted with the other
-#: adaptive stats (stats_store.py).
+#: registry already measures every operator's self time — feeding those
+#: walls back here (metrics/analyze.record_learned_op_costs, plus the
+#: fused-region wall from exec/wholestage.py) replaces the static
+#: per-row guesses with what this machine actually measured, for device
+#: AND host placements. Persisted with the other adaptive stats
+#: (stats_store.py).
 _OP_COSTS: dict = {}
 #: rows an operator kind must have processed before its learned cost is
 #: trusted (tiny samples are all dispatch floor, not per-row cost)
 _OP_COST_MIN_ROWS = 65536
+#: per-QUERY input-row minimum for the generic self-time feed
+#: (record_op_wall min_rows): a query below this is dispatch-floor- and
+#: iterator-overhead-dominated, so its per-row quotient would poison the
+#: table no matter how many such samples accumulate
+_OP_COST_SAMPLE_MIN_ROWS = 262144
 
 
 def record_op_wall(kind: str, placement: str, rows: int,
-                   seconds: float) -> None:
-    if rows <= 0 or seconds <= 0.0:
+                   seconds: float, compile_free: bool = True,
+                   min_rows: int = 0) -> None:
+    """Accumulate (rows, seconds) into the learned per-operator cost
+    table. ``compile_free=False`` drops the sample — a wall that paid
+    jit trace or XLA compile measures the cold start, not the operator
+    (the executable-cache-hit keying that replaced the old trust-later
+    workaround). ``min_rows`` drops under-scale samples (see
+    _OP_COST_SAMPLE_MIN_ROWS)."""
+    if rows <= 0 or seconds <= 0.0 or not compile_free \
+            or rows < min_rows:
         return
     k = (kind, placement)
     r, s = _OP_COSTS.get(k, (0, 0.0))
@@ -414,24 +486,34 @@ class _Cost:
 
 
 def apply_cost_optimizer(meta: PlanMeta, conf: TpuConf,
-                         wall_sig: Optional[str] = None) -> str:
+                         wall_sig: Optional[str] = None,
+                         plan_digest: Optional[str] = None) -> str:
     """Revert TPU-capable nodes whose device placement is not worth it.
 
     Two decisions, both the reference's CostBasedOptimizer idea adapted to
     a tunneled accelerator (RapidsConf.scala:2126-2156):
       * per-subtree: a node whose host cost (incl. transitions) beats its
         device cost reverts (the reference's behavior verbatim);
-      * whole-plan: ANY device placement pays the per-query floor
-        (dispatch + D2H fetch ~0.1-0.25 s here) ONCE — when the entire
-        plan's host estimate beats best-device + floor, the whole query
-        runs on the host engine. Small inputs on a tunnel lose to the
-        floor no matter how fast the kernels are; measured row feedback
-        (_RUNTIME_ROWS) makes the second planning of a shape exact.
+      * whole-plan: ANY device placement pays the per-query floor ONCE —
+        when the entire plan's host estimate beats best-device + floor,
+        the whole query runs on the host engine. The floor is
+        CACHE-AWARE: a ``plan_digest`` whose executables are already
+        warm in the two-tier compile cache (plan/exec_cache.py) pays
+        only the dispatch component (DEVICE_DISPATCH_FLOOR), not the
+        cold trace+compile floor — warm repeats (the serving case) are
+        re-costed without the compile they will not pay. Small inputs on
+        a tunnel still lose to the dispatch floor no matter how fast the
+        kernels are; measured row feedback (_RUNTIME_ROWS) makes the
+        second planning of a shape exact.
+
+    Per-node costs prefer the LEARNED per-operator row costs (device and
+    host, record_op_wall) over the static tables once trusted.
 
     Mutates metas via will_not_work_on_tpu. Returns a one-line placement
     decision ("device (...)" / "host (...)") recording WHY, which
     EXPLAIN prints — a stage staying on host is explained by the plan
-    output itself."""
+    output itself. Every COST_MODEL_HOST tag detail carries the device
+    and host cost estimates behind the decision."""
     load_persisted_stats()
     # the registered defaults are per-row costs for the reference's
     # row-interpreter; this engine's host twin is vectorized — treat the
@@ -446,7 +528,17 @@ def apply_cost_optimizer(meta: PlanMeta, conf: TpuConf,
     # joins/sorts/aggregates it never timed
     fused_c = learned_row_cost("WholeStageExec", "device")
     trans_c = conf.get(TRANSITION_COST)
-    floor = float(conf.get(DEVICE_QUERY_FLOOR))
+    cold_floor = float(conf.get(DEVICE_QUERY_FLOOR))
+    # cache-aware floor: plan digest warm in the executable cache (live
+    # tier or a previous process via the persistent tier) -> the compile
+    # component is already paid, only dispatch+fetch remains
+    warm_digest = False
+    if plan_digest is not None:
+        from . import exec_cache
+        warm_digest = exec_cache.plan_digest_cached(plan_digest)
+    dispatch_floor = min(float(conf.get(DEVICE_DISPATCH_FLOOR)),
+                         cold_floor)
+    floor = dispatch_floor if warm_digest else cold_floor
 
     pending_reverts = []     # (meta, reason): applied only if the
     # measured-wall arbitration below doesn't choose the device wholesale
@@ -460,8 +552,24 @@ def apply_cost_optimizer(meta: PlanMeta, conf: TpuConf,
         host_node = _host_node_cost(m.plan, rows_in, cpu_scale)
         # scans decode on host for BOTH engines (the H2D is the floor's /
         # transition's job) — placement-neutral, never worth reverting
+        kind = node_kind(m.plan)
+        learned_dev = (learned_row_cost(kind, "device")
+                       if kind is not None else None)
         if isinstance(m.plan, (L.LogicalScan, L.ParquetScan)):
             node_tpu_c = 0.0
+        elif learned_dev is not None:
+            # trusted measured device cost for this operator KIND
+            # replaces the static guess outright (the learned cost
+            # already includes the kernel's real dispatch wall)
+            node_tpu_c = learned_dev
+            if fused_c is not None and isinstance(m.plan,
+                                                  (L.Filter, L.Project)):
+                # fusible chains collapse into ONE dispatch + ONE
+                # compaction (exec/wholestage.py): a per-kind cost
+                # learned from STANDALONE operators (each paying its
+                # own dispatch) overprices the fused execution, so the
+                # region's measured per-row wall caps it
+                node_tpu_c = min(node_tpu_c, fused_c)
         elif fused_c is not None and isinstance(m.plan,
                                                 (L.Filter, L.Project)):
             # fusible node kinds price from the measured fused walls
@@ -482,9 +590,12 @@ def apply_cost_optimizer(meta: PlanMeta, conf: TpuConf,
             min(k.host, k.device + trans_c * estimate_rows(cm.plan))
             for k, cm in zip(kids, m.child_metas))
         if host < device:
+            # the COST_MODEL_HOST contract: the detail always carries
+            # both estimates, so explain("placement") shows the numbers
+            # behind the decision
             pending_reverts.append((m, (
-                f"cost-based: device cost {device:.4f} (incl. "
-                f"transitions) exceeds host cost {host:.4f}")))
+                f"cost-based: device≈{device:.4f}s (incl. transitions) "
+                f"exceeds host≈{host:.4f}s")))
             return _Cost(float("inf"), host, False)
         return _Cost(device, host, True)
 
@@ -538,22 +649,28 @@ def apply_cost_optimizer(meta: PlanMeta, conf: TpuConf,
                       "host %.4fs — device wholesale", dw, hw)
             return (f"device (measured device wall {dw:.4f}s beats host "
                     f"{hw:.4f}s)")
-        revert_all(meta, (f"cost-based: measured host wall {hw:.4f}s "
-                          f"beats device {dw:.4f}s"))
+        revert_all(meta, (f"cost-based: measured host≈{hw:.4f}s beats "
+                          f"device≈{dw:.4f}s"))
         return (f"host (measured host wall {hw:.4f}s beats device "
                 f"{dw:.4f}s)")
     if hw is not None and dw is None \
-            and dev_model + floor < hw:
-        log.debug("cost optimizer: exploring device (model %.4fs + floor "
-                  "< measured host %.4fs)", dev_model, hw)
-        return (f"device (exploring: model {dev_model:.4f}s + floor < "
-                f"measured host {hw:.4f}s)")
+            and dev_model + dispatch_floor < hw:
+        # exploration prices the device at its WARM floor even when the
+        # digest is cold: the compile is a one-time investment a serving
+        # workload amortizes over every repeat, so a shape whose warm
+        # repeats would beat the measured host wall is worth one
+        # compile-paying run to learn its device wall
+        log.debug("cost optimizer: exploring device (model %.4fs + "
+                  "dispatch floor < measured host %.4fs)", dev_model, hw)
+        return (f"device (exploring: model {dev_model:.4f}s + dispatch "
+                f"floor {dispatch_floor:.4f}s < measured host "
+                f"{hw:.4f}s)")
     if dw is not None and hw is None and host_only < dw:
         # symmetric: a device-first shape measuring slow must TRY the
         # host twin once, or it stays on the slow engine forever
-        revert_all(meta, (f"cost-based: exploring host (model "
-                          f"{host_only:.4f}s < measured device "
-                          f"{dw:.4f}s)"))
+        revert_all(meta, (f"cost-based: exploring host — model "
+                          f"host≈{host_only:.4f}s < measured "
+                          f"device≈{dw:.4f}s"))
         log.debug("cost optimizer: exploring host (model %.4fs < "
                   "measured device %.4fs)", host_only, dw)
         return (f"host (exploring: model {host_only:.4f}s < measured "
@@ -562,14 +679,16 @@ def apply_cost_optimizer(meta: PlanMeta, conf: TpuConf,
     for m, reason in pending_reverts:
         m.will_not_work_on_tpu(reason, code=COST_MODEL_HOST)
         log.debug("cost optimizer reverted %s", type(m.plan).__name__)
+    floor_word = "warm dispatch floor" if warm_digest else "cold floor"
     if floor > 0 and host_est < dev_est:
-        reason = (f"cost-based: whole-plan host {how} {host_est:.4f}s "
-                  f"beats device {dev_est:.4f}s (incl. floor)")
+        reason = (f"cost-based: whole-plan host {how} host≈{host_est:.4f}s "
+                  f"beats device≈{dev_est:.4f}s (incl. {floor_word} "
+                  f"{floor:.4f}s)")
         revert_all(meta, reason)
         log.debug("cost optimizer reverted whole plan to host (%s)", reason)
         return (f"host ({how} {host_est:.4f}s beats device "
-                f"{dev_est:.4f}s incl. floor)")
-    return (f"device ({how}: device {dev_est:.4f}s incl. floor vs host "
-            f"{host_est:.4f}s"
+                f"{dev_est:.4f}s incl. {floor_word})")
+    return (f"device ({how}: device {dev_est:.4f}s incl. {floor_word} vs "
+            f"host {host_est:.4f}s"
             + (f"; {len(pending_reverts)} subtree(s) reverted"
                if pending_reverts else "") + ")")

@@ -45,7 +45,8 @@ from ..config import register
 __all__ = ["COMPILE_CACHE_DIR", "COMPILE_CACHE_MAX_BYTES",
            "get_or_build", "fused_key", "stats", "reset_stats",
            "clear", "configure_from_conf", "trim_persistent",
-           "device_kind"]
+           "device_kind", "record_plan_compiled", "plan_digest_cached",
+           "compile_free_since"]
 
 COMPILE_CACHE_DIR = register(
     "spark.rapids.tpu.compile.cache.dir", "",
@@ -88,6 +89,91 @@ _CLEAR_HOOKS = []
 #: a session with an EMPTY compile.cache.dir conf must get this default
 #: back, not whichever directory the previous session pointed jax at
 _PROC_DEFAULT_DIR = [None]
+
+#: plan digests (metrics/events.plan_digest) whose device execution
+#: completed — every kernel the plan builds now lives in the in-process
+#: tier and (serialized) in jax's persistent tier, so a repeat of the
+#: digest pays the dispatch floor only, never the compile floor. The
+#: set persists with the adaptive stats (plan/stats_store.py "plans"),
+#: giving a fresh process the same warm-floor costing the persistent
+#: executable tier gives it warm kernels. Keyed per device kind: an
+#: executable compiled for one backend says nothing about another.
+#: A dict-as-ordered-set (values unused): insertion order is the
+#: recency proxy, so the cap evicts the OLDEST digest, never an
+#: arbitrary hot one (the _ENGINE_WALLS idiom).
+_PLAN_DIGESTS: dict = {}
+_PLAN_DIGESTS_MAX = 4096
+
+
+def record_plan_compiled(digest: str) -> None:
+    """Mark a plan digest's executables as resident in the cache tiers
+    (called after a successful device execution of the plan)."""
+    if not digest:
+        return
+    key = (str(digest), device_kind())
+    with _LOCK:
+        if key in _PLAN_DIGESTS:
+            # refresh recency (move to end): a hot serving plan that
+            # re-runs every second must not age into the "oldest" slot
+            # just because it was registered first. No mark_dirty — the
+            # SET is unchanged, only its order, not worth a save per
+            # repeat query.
+            _PLAN_DIGESTS.pop(key)
+            _PLAN_DIGESTS[key] = None
+            return
+        # while, not if: a persisted-stats merge (load_into) can leave
+        # the set over the cap, and delete-one-insert-one would keep it
+        # there forever
+        while len(_PLAN_DIGESTS) >= _PLAN_DIGESTS_MAX:
+            del _PLAN_DIGESTS[next(iter(_PLAN_DIGESTS))]
+        _PLAN_DIGESTS[key] = None
+    from .cost import _persist_enabled
+    if _persist_enabled():
+        from . import stats_store
+        stats_store.mark_dirty()
+
+
+def plan_digest_cached(digest: str) -> bool:
+    """True when a previous device run of this plan digest (this process
+    or, via the persisted stats, an earlier one sharing the cache dirs)
+    left its executables warm — the planner's cache-aware floor check."""
+    if not digest:
+        return False
+    from .cost import load_persisted_stats
+    load_persisted_stats()
+    with _LOCK:
+        return (str(digest), device_kind()) in _PLAN_DIGESTS
+
+
+def _invalidate_plan_digests() -> None:
+    """Drop the warm-digest set because the persistent tier changed
+    under it (trim eviction, cache-dir re-point): a digest must never
+    vouch for executables that are no longer there — the planner would
+    charge the dispatch floor to a plan about to pay a full cold
+    compile. Conservative by design (clear()'s contract): the cold
+    floor re-applies until a device run proves the kernels warm again."""
+    with _LOCK:
+        if not _PLAN_DIGESTS:
+            return
+        _PLAN_DIGESTS.clear()
+    try:
+        from .cost import _persist_enabled
+        if _persist_enabled():
+            from . import stats_store
+            stats_store.mark_dirty()
+    except Exception:  # pragma: no cover - persistence is best-effort
+        pass
+
+
+def compile_free_since(snapshot: dict) -> bool:
+    """True when zero in-process cache misses AND zero backend-compile
+    seconds accrued since ``snapshot`` (an earlier ``stats()`` result) —
+    THE definition of a compile-free run, the only kind the learned
+    cost model ingests (cost.record_engine_wall / record_op_wall). One
+    helper so every feed site keys on the same counters."""
+    now = stats()
+    return (now["compile_s"] == snapshot["compile_s"]
+            and now["misses"] == snapshot["misses"])
 
 
 def device_kind() -> str:
@@ -174,10 +260,13 @@ def register_clear_hook(fn: Callable[[], None]) -> None:
 
 
 def clear() -> None:
-    """Drop the in-process tier and every registered front memo (tests;
-    the persistent tier survives)."""
+    """Drop the in-process tier, every registered front memo, and the
+    warm-plan-digest set (tests; the persistent tier survives — dropping
+    the digests is conservative: the planner re-applies the cold floor
+    until a run proves the kernels warm again)."""
     with _LOCK:
         _LRU.clear()
+        _PLAN_DIGESTS.clear()
         hooks = list(_CLEAR_HOOKS)
     for fn in hooks:
         fn()
@@ -208,6 +297,8 @@ def configure_from_conf(conf) -> Optional[str]:
         try:
             jax.config.update("jax_compilation_cache_dir", want or None)
             cur = want
+            # the persistent tier the warm digests vouch for just moved
+            _invalidate_plan_digests()
         except Exception:  # pragma: no cover - cache is an optimization
             pass
     if cur:
@@ -255,6 +346,11 @@ def trim_persistent(cache_dir: str, max_bytes: int) -> int:
                 break
     except OSError:  # pragma: no cover - directory races
         pass
+    if removed:
+        # which plans lost executables is unknowable at file level —
+        # drop every warm digest rather than let one vouch for a
+        # compile the evicted entries no longer cover
+        _invalidate_plan_digests()
     return removed
 
 

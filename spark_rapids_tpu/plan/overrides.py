@@ -51,12 +51,23 @@ def plan_query(plan: L.LogicalPlan, conf: TpuConf, mesh=None,
     not the user — makes queries distributed)."""
     from .rewrites import prune_columns
     from .op_confs import install_from_conf
-    from .cost import plan_signature
+    from .cost import OPTIMIZER_ENABLED, plan_signature
     install_from_conf(conf)
     # signature of the plan AS THE USER BUILT IT: the execution sink
     # records measured walls under this same pre-rewrite signature
     # (api/dataframe._execute_wrapped), so lookup and record must agree
     wall_sig = plan_signature(plan)
+    digest = None
+    if conf.get(OPTIMIZER_ENABLED):
+        # structural plan digest (the PR-5 event-log key): the cost
+        # model's cache-aware floor asks the executable cache whether
+        # this digest's kernels are already compiled — recorded by the
+        # sink under the same pre-rewrite digest, so lookup and record
+        # must agree. Computed only when the optimizer will consume it
+        # (a full-tree hash per planning otherwise buys nothing); the
+        # sink reuses it via physical.plan_digest.
+        from ..metrics.events import plan_digest
+        digest = plan_digest(plan)
     if conf.sql_enabled:
         # TPU-targeted rewrites (distinct-agg expansion, union-of-aggs
         # single-pass) BEFORE pruning: the union rewrite keys on shared
@@ -77,10 +88,11 @@ def plan_query(plan: L.LogicalPlan, conf: TpuConf, mesh=None,
     plan = prune_columns(plan)
     meta = wrap_plan(plan, conf)
     meta.tag()
-    from .cost import OPTIMIZER_ENABLED, apply_cost_optimizer
+    from .cost import apply_cost_optimizer
     decision = None
     if conf.get(OPTIMIZER_ENABLED):
-        decision = apply_cost_optimizer(meta, conf, wall_sig=wall_sig)
+        decision = apply_cost_optimizer(meta, conf, wall_sig=wall_sig,
+                                        plan_digest=digest)
         if rewritten and not _any_device_meta(meta):
             # whole-plan host reversion: the TPU-targeted rewrites
             # (distinct expansion/flag, union single-pass) only help
@@ -156,6 +168,10 @@ def plan_query(plan: L.LogicalPlan, conf: TpuConf, mesh=None,
     #: the fallback metric family, and queryStart event records all
     #: read it off the physical plan
     physical.placement_report = report
+    #: pre-rewrite structural digest (None when the optimizer is off):
+    #: the sink reuses it to mark the digest warm after a device run
+    #: (exec_cache.record_plan_compiled) instead of re-hashing the tree
+    physical.plan_digest = digest
     return physical
 
 
@@ -363,7 +379,14 @@ class AggregateMeta(PlanMeta):
     def convert_to_tpu(self, children):
         hint = getattr(self.plan, "many_groups_hint", False)
         cards = getattr(self.plan, "int_key_cards", None)
-        child, stages, eval_schema = self._fold_stages(children[0])
+        from ..exec.wholestage import AGG_FUSION_ENABLED
+        if self.conf.get(AGG_FUSION_ENABLED):
+            child, stages, eval_schema = self._fold_stages(children[0])
+        else:
+            # unfused reference path (byte-identical results, one
+            # dispatch + one compaction per stage) — the differential
+            # oracle for the fused partial-agg kernel
+            child, stages, eval_schema = children[0], None, None
         if not self.plan.groupings:
             self._widen_scan_batches(child if stages else children[0])
         if stages:

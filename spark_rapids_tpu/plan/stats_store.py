@@ -11,11 +11,19 @@ they mean the same thing in the next process; this module gives them the
 same lifetime the XLA compile cache gives kernels.
 
 Format: one JSON file next to the XLA cache —
-  {"version": 1, "walls": [[sig, placement, count, min_s], ...],
+  {"version": 2, "walls": [[sig, placement, count, min_s], ...],
    "rows": [[sig, rows], ...],
-   "ops": [[op_kind, placement, rows, seconds], ...]}
+   "ops": [[op_kind, placement, rows, seconds], ...],
+   "plans": [[plan_digest, device_kind], ...]}
 ("ops" are the learned per-operator row costs, cost.record_op_wall;
-older files without the key load fine.)
+"plans" the compiled-plan-digest set behind the cache-aware device
+floor, exec_cache.record_plan_compiled; older files without either key
+load fine.) Version 2 records COMPILE-FREE observation counts (trusted
+at >=1); version-1 files recorded raw counts whose first observation
+could embed a full XLA compile, so their counts load as count-1 — a v1
+single-observation wall stays untrusted (the old >=2 rule preserved),
+a v1 multi-observation wall stays trusted. v1 "ops" quotients (no
+compile-free keying, not subtractable) are dropped entirely.
 Writes are atomic (tmp + rename) and debounced; entries are capped with
 insertion order as the recency proxy. Process-local signatures (the
 "#<id>#" fallback for non-Arrow sources) are never persisted.
@@ -52,8 +60,11 @@ def _persistable(sig: str) -> bool:
     return not _LOCAL_TAG.search(sig)
 
 
-def load_into(walls: dict, rows: dict, ops: dict = None) -> None:
-    """Merge persisted stats into the live dicts (live entries win)."""
+def load_into(walls: dict, rows: dict, ops: dict = None,
+              plans: dict = None) -> None:
+    """Merge persisted stats into the live dicts (live entries win).
+    Corrupt or truncated files are tolerated — the caller starts with a
+    fresh table, never a crash (adaptive stats are an optimization)."""
     global _loaded
     with _lock:
         if _loaded:
@@ -64,22 +75,45 @@ def load_into(walls: dict, rows: dict, ops: dict = None) -> None:
             j = json.load(f)
     except (OSError, ValueError):
         return
-    if j.get("version") != 1:
+    version = j.get("version") if isinstance(j, dict) else None
+    if version not in (1, 2):
         return
-    for sig, placement, cnt, s in j.get("walls", []):
-        k = (sig, placement)
-        if k not in walls:
-            walls[k] = (int(cnt), float(s))
-    for sig, n in j.get("rows", []):
-        if sig not in rows:
-            rows[sig] = int(n)
-    if ops is not None:
-        # learned per-operator row costs (cost.record_op_wall): a fresh
-        # process prices device stages from previously-measured walls
-        for kind, placement, r, s in j.get("ops", []):
-            k = (kind, placement)
-            if k not in ops:
-                ops[k] = (int(r), float(s))
+    # v1 wall counts include the (possibly compile-poisoned) first
+    # observation — discount it so the lowered >=1 trust threshold can
+    # never retroactively trust a stale single-compile-run wall
+    discount = 1 if version == 1 else 0
+    try:
+        for sig, placement, cnt, s in j.get("walls", []):
+            k = (sig, placement)
+            if k not in walls:
+                walls[k] = (max(int(cnt) - discount, 0), float(s))
+        for sig, n in j.get("rows", []):
+            if sig not in rows:
+                rows[sig] = int(n)
+        if ops is not None and version >= 2:
+            # learned per-operator row costs (cost.record_op_wall): a
+            # fresh process prices operators from previously-measured
+            # walls, device AND host. v1 "ops" entries are DROPPED, not
+            # discounted: unlike walls (count-keyed, so one poisoned
+            # observation can be subtracted) they are accumulated
+            # (rows, seconds) quotients recorded with no compile-free
+            # keying — a cold 17s-compile fused run baked into a v1
+            # quotient would load straight into trusted territory
+            for kind, placement, r, s in j.get("ops", []):
+                k = (kind, placement)
+                if k not in ops:
+                    ops[k] = (int(r), float(s))
+        if plans is not None:
+            # compiled plan digests (exec_cache.record_plan_compiled):
+            # a fresh process applies the warm dispatch-only floor to
+            # every shape whose executables the persistent compile
+            # cache already holds
+            for ent in j.get("plans", []):
+                if isinstance(ent, (list, tuple)) and len(ent) == 2:
+                    plans.setdefault((str(ent[0]), str(ent[1])))
+    except (TypeError, ValueError):
+        # malformed entries mid-file: keep whatever merged cleanly
+        return
 
 
 def mark_dirty() -> None:
@@ -94,7 +128,7 @@ def save() -> None:
     global _dirty, _last_save
     if not _dirty:
         return
-    from . import cost
+    from . import cost, exec_cache
     # merge the on-disk state first: a process that never planned (e.g.
     # optimizer disabled) would otherwise TRUNCATE the accumulated store
     # to just its own entries on the first debounced save
@@ -109,13 +143,20 @@ def save() -> None:
                 if _persistable(sig)][-_CAP:]
         ops = [[kind, pl, r, s]
                for (kind, pl), (r, s) in list(cost._OP_COSTS.items())]
+        # insertion order IS the recency order (record_plan_compiled
+        # refreshes repeats to the end), so persist it — sorting would
+        # replace recency with lexicographic order on reload — and keep
+        # the NEWEST entries when over the cap (the walls idiom)
+        plans = [[dig, dk] for dig, dk in
+                 list(exec_cache._PLAN_DIGESTS)
+                 ][-exec_cache._PLAN_DIGESTS_MAX:]
     path = _path()
     tmp = path + f".tmp{os.getpid()}"
     try:
         os.makedirs(os.path.dirname(path), exist_ok=True)
         with open(tmp, "w") as f:
-            json.dump({"version": 1, "walls": walls, "rows": rows,
-                       "ops": ops}, f)
+            json.dump({"version": 2, "walls": walls, "rows": rows,
+                       "ops": ops, "plans": plans}, f)
         os.replace(tmp, path)
         _dirty = False
         _last_save = time.monotonic()

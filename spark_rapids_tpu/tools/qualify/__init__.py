@@ -20,13 +20,16 @@ the MIN ok wall of its ``queryEnd`` records (the same stable estimator
 * ``host_ms`` — host wall attributed to the code: each host-placed
   digest's wall split across its codes proportionally to tag counts;
 * ``est_saved_ms`` — estimated device time saved by fixing the code.
-  When the cost model has a TRUSTED learned device row cost
-  (``plan/cost.learned_row_cost``, persisted by the stats store) and
-  the record carries a plan-time row estimate, the device wall is
-  priced from measurement: ``estRows * learned_cost``; otherwise the
-  per-operator speedup priors from ``tools/supported_ops`` apply
+  When the cost model has TRUSTED learned device row costs for the
+  record's operator kinds (the per-operator learned cost table,
+  ``plan/cost.learned_row_cost``, persisted by the stats store) and the
+  record carries a plan-time row estimate, the device wall is priced
+  from measurement: ``estRows * sum(learned_cost per operator)`` —
+  falling back to the fused-region (``WholeStageExec``) learned cost
+  when none of the record's operators has a kind-specific entry, and to
+  the per-operator speedup priors from ``tools/supported_ops``
   (``saved = wall * (1 - 1/score)``, the reference's
-  operatorsScore.csv method).
+  operatorsScore.csv method) when nothing learned is trusted.
 
 Output is deterministic (identical logs render identical reports);
 crash-truncated event-log lines are skipped and counted, never fatal.
@@ -59,14 +62,22 @@ def _op_score(op: str) -> float:
     return float(_SCORE_OVERRIDES.get(alias.get(op, op), _DEFAULT_SCORE))
 
 
-def _learned_device_cost() -> Optional[float]:
-    """Trusted measured seconds/row for fused device stages, merged
-    from the persisted stats store — None until enough rows were
-    actually measured (plan/cost._OP_COST_MIN_ROWS)."""
+def _learned_device_cost() -> Optional[Dict[str, float]]:
+    """Trusted measured device seconds/row PER OPERATOR KIND, merged
+    from the persisted stats store — ``{"Filter": 2.1e-9, ...}`` plus
+    the legacy fused-region ``"WholeStageExec"`` entry; None until some
+    kind has enough measured rows (plan/cost._OP_COST_MIN_ROWS)."""
     try:
         from ...plan import cost
         cost.load_persisted_stats()
-        return cost.learned_row_cost("WholeStageExec", "device")
+        kinds = sorted({k for k, _pl in cost._OP_COSTS}
+                       | {"WholeStageExec"})
+        out = {}
+        for kind in kinds:
+            lc = cost.learned_row_cost(kind, "device")
+            if lc is not None:
+                out[kind] = lc
+        return out or None
     except Exception:  # noqa: BLE001 - offline tool, degrade to priors
         return None
 
@@ -138,8 +149,28 @@ def analyze(path: str) -> dict:
         saved = 0.0
         if wall is not None and host_placed:
             est_rows = pl.get("estRows")
+            per_row = None
             if dev_cost is not None and est_rows:
-                est_dev_ms = float(est_rows) * dev_cost * 1000.0
+                # per-operator learned device pricing: each operator in
+                # the record processes ~estRows rows, so the device wall
+                # is the sum of the kinds' learned per-row costs. An
+                # operator with no kind-specific entry prices at the
+                # fused-region (WholeStageExec) cost; if even that is
+                # untrusted the record is only PARTIALLY priceable and
+                # falls through to the priors — summing just the matched
+                # kinds would understate the device wall and overstate
+                # est_saved_ms relative to fully-covered records
+                fallback = dev_cost.get("WholeStageExec")
+                if ops:
+                    costs = [dev_cost.get(op, fallback)
+                             for op in sorted(ops)]
+                    per_row = (sum(costs)
+                               if all(c is not None for c in costs)
+                               else None)
+                else:
+                    per_row = fallback
+            if per_row:
+                est_dev_ms = float(est_rows) * per_row * 1000.0
                 saved = max(0.0, wall - est_dev_ms)
             else:
                 scores = sorted(_op_score(op) for op in ops) or [2.5]
@@ -186,8 +217,10 @@ def format_report(rep: dict) -> str:
              f"placement records, {rep['host_placed']} host-placed; "
              f"{rep['skipped_lines']} undecodable line(s) skipped",
              f"cost basis: "
-             + ("learned device row cost "
-                f"{rep['learned_device_cost']:.3e} s/row"
+             + (("learned device row costs ("
+                 + ", ".join(f"{k} {v:.3e}" for k, v in
+                             sorted(rep["learned_device_cost"].items()))
+                 + " s/row)")
                 if rep.get("learned_device_cost")
                 else "operator speedup priors (no trusted learned costs)"),
              "",

@@ -1,0 +1,392 @@
+"""Cache-aware learned-cost placement + fused multi-aggregate path
+(ISSUE 9).
+
+Four batteries:
+  * stats-store persistence of the learned per-operator cost table and
+    the compiled-plan-digest set (cross-session roundtrip, corrupt file
+    tolerated, trust-threshold boundaries);
+  * the cache-aware device floor: a warm plan digest is re-costed with
+    the dispatch floor only and flips onto the device, and every
+    COST_MODEL_HOST tag detail carries the device-vs-host estimates;
+  * the fused partial-agg path: a q9-shaped query (filter + many
+    sum/avg(case when ...) aggregates) runs its scan→filter→partial-agg
+    region as ONE compiled dispatch per batch, byte-identical to the
+    unfused per-operator pipeline;
+  * the bench-rung regression: with a warm exec cache and trusted
+    learned costs, the tpch q1/q6 and tpcds q9/q28 rung plans get a
+    DEVICE placement decision from apply_cost_optimizer.
+"""
+import json
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from harness import tpu_session
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.plan import cost, exec_cache
+
+
+OPT_ON = {"spark.rapids.tpu.sql.optimizer.enabled": True}
+
+#: learned per-row costs mirroring what the 1M-row bench rungs measure:
+#: device kernels run at HBM bandwidth (~2 ns/row), the vectorized host
+#: twin is 25-250x that (BENCH_r05: q9 host engine 0.62 s over 1M rows)
+LEARNED = {
+    ("Filter", "device"): (1 << 21, 0.004),      # ~2e-9 s/row
+    ("Project", "device"): (1 << 21, 0.004),
+    ("Aggregate", "device"): (1 << 21, 0.008),   # ~4e-9 s/row
+    ("Filter", "host"): (1 << 21, 0.2),          # ~1e-7 s/row
+    ("Project", "host"): (1 << 21, 0.2),
+    ("Aggregate", "host"): (1 << 21, 1.0),       # ~5e-7 s/row
+}
+
+
+@pytest.fixture
+def fresh_cost_state(monkeypatch):
+    """Isolated learned-cost + warm-digest state for placement tests."""
+    monkeypatch.setattr(cost, "_OP_COSTS", dict(LEARNED))
+    monkeypatch.setattr(exec_cache, "_PLAN_DIGESTS", {})
+    monkeypatch.setattr(cost, "_ENGINE_WALLS", {})
+
+
+def _digest(df):
+    from spark_rapids_tpu.metrics.events import plan_digest
+    return plan_digest(df.plan)
+
+
+# ---------------------------------------------------------------------------
+# stats-store persistence (satellite: learned-cost persistence)
+# ---------------------------------------------------------------------------
+
+def _reload_store(monkeypatch, tmp_path):
+    from spark_rapids_tpu.plan import stats_store
+    monkeypatch.setenv("SRTPU_STATS_PERSIST", "1")
+    monkeypatch.setenv("SRTPU_STATS_PATH", str(tmp_path / "stats.json"))
+    monkeypatch.setattr(stats_store, "_loaded", False)
+    monkeypatch.setattr(stats_store, "_dirty", True)
+    return stats_store
+
+
+def test_learned_costs_and_plan_digests_roundtrip(tmp_path, monkeypatch):
+    """Cross-session roundtrip: ops table AND the compiled-plan-digest
+    set survive a simulated process restart."""
+    stats_store = _reload_store(monkeypatch, tmp_path)
+    monkeypatch.setattr(cost, "_OP_COSTS",
+                        {("Aggregate", "host"): (1 << 20, 0.5)})
+    monkeypatch.setattr(exec_cache, "_PLAN_DIGESTS",
+                        {("deadbeef00000000", "cpu"): None})
+    stats_store.save()
+    walls, rows, ops, plans = {}, {}, {}, {}
+    monkeypatch.setattr(stats_store, "_loaded", False)
+    stats_store.load_into(walls, rows, ops, plans)
+    assert ops[("Aggregate", "host")] == (1 << 20, 0.5)
+    assert ("deadbeef00000000", "cpu") in plans
+
+
+def test_v1_stats_file_migrates_conservatively(tmp_path, monkeypatch):
+    """Pre-upgrade (version 1) files carry compile-poisoned samples:
+    wall counts load discounted by one (a v1 single-observation wall —
+    possibly a cold compile run — stays untrusted under the new >=1
+    rule), and v1 "ops" quotients are dropped outright (accumulated
+    rows/seconds can't be discounted; a 17s-compile fused run baked in
+    would load straight into trusted territory)."""
+    stats_store = _reload_store(monkeypatch, tmp_path)
+    with open(tmp_path / "stats.json", "w") as f:
+        json.dump({"version": 1,
+                   "walls": [["sig-a", "device", 1, 17.0],
+                             ["sig-b", "device", 3, 0.02]],
+                   "rows": [["sig-a", 1000]],
+                   "ops": [["WholeStageExec", "device",
+                            1 << 21, 34.0]]}, f)
+    walls, rows, ops, plans = {}, {}, {}, {}
+    stats_store.load_into(walls, rows, ops, plans)
+    assert walls[("sig-a", "device")] == (0, 17.0)    # untrusted
+    assert walls[("sig-b", "device")] == (2, 0.02)    # still trusted
+    assert rows["sig-a"] == 1000
+    assert ops == {} and plans == {}
+    monkeypatch.setattr(cost, "_ENGINE_WALLS", walls)
+    assert cost.trusted_engine_wall("sig-a", "device") is None
+    assert cost.trusted_engine_wall("sig-b", "device") == 0.02
+
+
+def test_corrupt_stats_file_tolerated(tmp_path, monkeypatch):
+    """A truncated/garbage stats file yields a fresh table — no crash,
+    planning proceeds on the static model."""
+    stats_store = _reload_store(monkeypatch, tmp_path)
+    for payload in ("{truncated", '{"version": 9}', '[]',
+                    '{"version": 1, "ops": [["only-two", "x"]], '
+                    '"plans": [42, ["a"]], "walls": "nope"}'):
+        with open(tmp_path / "stats.json", "w") as f:
+            f.write(payload)
+        walls, rows, ops, plans = {}, {}, {}, {}
+        monkeypatch.setattr(stats_store, "_loaded", False)
+        stats_store.load_into(walls, rows, ops, plans)   # must not raise
+        assert walls == {} and ops == {}
+    # and a session using the corrupt store still plans + executes
+    monkeypatch.setattr(stats_store, "_loaded", False)
+    s = tpu_session(OPT_ON)
+    t = pa.table({"v": pa.array(np.arange(100, dtype=np.int64))})
+    got = s.create_dataframe(t).agg(
+        F.sum(F.col("v")).with_name("s")).collect_arrow()
+    assert got.column("s")[0].as_py() == 4950
+
+
+def test_trust_threshold_boundaries(monkeypatch):
+    """learned_row_cost trusts at exactly _OP_COST_MIN_ROWS; engine
+    walls trust at one COMPILE-FREE observation, and compile-laden
+    samples are dropped outright."""
+    monkeypatch.setattr(cost, "_OP_COSTS", {})
+    monkeypatch.setattr(cost, "_ENGINE_WALLS", {})
+    lim = cost._OP_COST_MIN_ROWS
+    monkeypatch.setitem(cost._OP_COSTS, ("K", "device"), (lim - 1, 1.0))
+    assert cost.learned_row_cost("K", "device") is None
+    monkeypatch.setitem(cost._OP_COSTS, ("K", "device"), (lim, 1.0))
+    assert cost.learned_row_cost("K", "device") == pytest.approx(1.0 / lim)
+    # engine walls: one compile-free observation is trusted...
+    cost.record_engine_wall("sig#x#", "device", 0.5)
+    assert cost.trusted_engine_wall("sig#x#", "device") == 0.5
+    # ...while compile-laden walls never even record
+    cost.record_engine_wall("sig#y#", "device", 9.0, compile_free=False)
+    assert cost.trusted_engine_wall("sig#y#", "device") is None
+    # op-wall gates: compile-laden and under-scale samples are dropped
+    cost.record_op_wall("G", "device", 1 << 20, 1.0, compile_free=False)
+    assert ("G", "device") not in cost._OP_COSTS
+    cost.record_op_wall("G", "device", 1024, 1.0,
+                        min_rows=cost._OP_COST_SAMPLE_MIN_ROWS)
+    assert ("G", "device") not in cost._OP_COSTS
+    cost.record_op_wall("G", "device", cost._OP_COST_SAMPLE_MIN_ROWS,
+                        1.0, min_rows=cost._OP_COST_SAMPLE_MIN_ROWS)
+    assert ("G", "device") in cost._OP_COSTS
+
+
+# ---------------------------------------------------------------------------
+# cache-aware floor (acceptance: warm repeat flips onto device)
+# ---------------------------------------------------------------------------
+
+def _mid_query(s, t):
+    return (s.create_dataframe(t)
+            .filter(F.col("v") > 0.0)
+            .group_by("k").agg(F.sum(F.col("v")).with_name("sv")))
+
+
+def _mid_table(n=100_000, seed=3):
+    rng = np.random.RandomState(seed)
+    return pa.table({"k": pa.array(rng.randint(0, 50, n)),
+                     "v": pa.array(rng.uniform(-1.0, 1.0, n))})
+
+
+def test_warm_digest_drops_compile_floor_and_flips_device(
+        fresh_cost_state):
+    """The acceptance scenario: a plan the COLD floor reverts to host is
+    re-costed WITHOUT the compile floor once its digest is warm in the
+    executable cache, and places on device — asserted on
+    placement_decision."""
+    t = _mid_table()
+    s = tpu_session(OPT_ON)
+    df = _mid_query(s, t)
+    cold = df._physical()
+    assert cold.placement_decision.startswith("host ("), \
+        cold.placement_decision
+    assert "cold floor" in cold.placement_decision
+    # warm repeat: the digest's executables are cached (live tier or a
+    # previous process via the persistent tier)
+    exec_cache.record_plan_compiled(_digest(df))
+    warm = _mid_query(tpu_session(OPT_ON), t)._physical()
+    assert warm.placement_decision.startswith("device ("), \
+        warm.placement_decision
+    assert "warm dispatch floor" in warm.placement_decision
+
+
+def test_cost_model_host_tags_carry_cost_estimates(fresh_cost_state,
+                                                   monkeypatch):
+    """Every COST_MODEL_HOST / whole-plan cost tag detail embeds the
+    device and host estimates behind the decision (the
+    explain(\"placement\") contract)."""
+    t = _mid_table(4096)
+    s = tpu_session(OPT_ON)
+    df = _mid_query(s, t)
+    physical = df._physical()
+    report = physical.placement_report
+    tags = [tag for tag in report.all_tags()
+            if tag.code in ("COST_MODEL_HOST", "WHOLE_PLAN_HOST_REVERT")
+            and tag.detail.startswith("cost-based")]
+    assert tags, report.render()
+    for tag in tags:
+        assert "device≈" in tag.detail and "host≈" in tag.detail, \
+            (tag.code, tag.detail)
+    out = df.explain("placement")
+    assert "device≈" in out and "host≈" in out
+
+
+def test_plan_digest_cap_evicts_oldest_not_hottest(monkeypatch):
+    """The digest cap evicts by RECENCY: a hot plan that re-registers
+    every run is refreshed to the back of the eviction order, so the
+    4096-entry cap drops stale ad-hoc digests, never the serving plan."""
+    monkeypatch.setattr(exec_cache, "_PLAN_DIGESTS", {})
+    monkeypatch.setattr(exec_cache, "_PLAN_DIGESTS_MAX", 3)
+    for d in ("hot", "b", "c"):
+        exec_cache.record_plan_compiled(d)
+    exec_cache.record_plan_compiled("hot")    # repeat refreshes recency
+    exec_cache.record_plan_compiled("d")      # cap: evicts oldest = "b"
+    assert exec_cache.plan_digest_cached("hot")
+    assert not exec_cache.plan_digest_cached("b")
+    assert exec_cache.plan_digest_cached("c")
+    assert exec_cache.plan_digest_cached("d")
+
+
+def test_learned_standalone_cost_capped_by_fused_region_wall(monkeypatch):
+    """A per-kind device cost learned from STANDALONE operators (each
+    paying its own dispatch + compaction) must not overprice a fusible
+    Filter/Project chain that executes as ONE fused region: the
+    measured WholeStageExec per-row wall caps it, else a chain-heavy
+    plan reverts to host despite its fused device run being faster."""
+    monkeypatch.setattr(cost, "_ENGINE_WALLS", {})
+    monkeypatch.setattr(exec_cache, "_PLAN_DIGESTS", {})
+    monkeypatch.setattr(cost, "_OP_COSTS", {
+        # standalone-learned Filter: ~1e-6 s/row (dispatch-inflated)
+        ("Filter", "device"): (1 << 21, 2.0),
+        # measured fused region: ~2e-9 s/row
+        ("WholeStageExec", "device"): (1 << 21, 0.004),
+        ("Filter", "host"): (1 << 21, 0.2),      # ~1e-7 s/row
+    })
+    n = 1 << 20
+    t = pa.table({"v": pa.array(np.arange(n, dtype=np.int64))})
+    s = tpu_session(OPT_ON)
+    df = s.create_dataframe(t).filter(F.col("v") >= 0)
+    exec_cache.record_plan_compiled(_digest(df))      # warm floor
+    dec = df._physical().placement_decision
+    # host ≈ 0.1s; capped device ≈ 0.002 + 0.02 warm floor — device
+    # wins. With the uncapped 1e-6 learned cost the device estimate
+    # would be ≈1.0s and the plan would revert.
+    assert dec.startswith("device ("), dec
+
+
+def test_exploration_uses_dispatch_floor(fresh_cost_state):
+    """A shape whose measured host wall loses to model + DISPATCH floor
+    explores the device even though the digest is cold: the compile is
+    a one-time investment the serving repeats amortize."""
+    t = _mid_table()
+    s = tpu_session(OPT_ON)
+    df = _mid_query(s, t)
+    sig = cost.plan_signature(df.plan)
+    # measured host wall between the dispatch floor (0.02) and the cold
+    # floor (0.12): only dispatch-floor pricing makes device attractive
+    cost.record_engine_wall(sig, "host", 0.08)
+    dec = _mid_query(tpu_session(OPT_ON), t)._physical() \
+        .placement_decision
+    assert dec.startswith("device (exploring"), dec
+    assert "dispatch floor" in dec
+
+
+# ---------------------------------------------------------------------------
+# fused partial-agg (acceptance: ONE dispatch per batch, byte-identical)
+# ---------------------------------------------------------------------------
+
+def _q9_shaped(s, t):
+    """scan→filter→partial-agg with >=4 sum(case when ...) aggregates —
+    the tpcds q9 multi-aggregate shape."""
+    df = s.create_dataframe(t).filter(F.col("q") <= 90)
+    aggs = []
+    for i, (lo, hi) in enumerate([(1, 20), (21, 40), (41, 60), (61, 80)],
+                                 1):
+        in_b = (F.col("q") >= F.lit(lo)) & (F.col("q") <= F.lit(hi))
+        aggs.append(F.sum(F.when(in_b, F.col("p"))
+                          .otherwise(F.lit(None))).with_name(f"s{i}"))
+        aggs.append(F.count(F.when(in_b, F.lit(1))
+                            .otherwise(F.lit(None))).with_name(f"c{i}"))
+    return df.agg(*aggs)
+
+
+def _q9_table(n=50_000, seed=11):
+    rng = np.random.RandomState(seed)
+    # eighths of integers: float64 sums are EXACT in any reduction
+    # order, so fused and unfused paths must agree bit for bit
+    return pa.table({
+        "q": pa.array(rng.randint(1, 101, n)),
+        "p": pa.array(rng.randint(0, 1 << 20, n) / 8.0),
+    })
+
+
+def test_fused_partial_agg_single_dispatch_and_identical():
+    t = _q9_table()
+    s = tpu_session()
+    df = _q9_shaped(s, t)
+    physical = df._physical()
+    tree = physical.tree_string()
+    assert "fused=[filter]" in tree, tree       # filter folded into agg
+    fused = df.collect_arrow()
+    # updateDispatches: the scan→filter→partial-agg region cost exactly
+    # ONE compiled kernel launch for the single input batch
+    ops = dict(s.last_query_metrics["operators"])
+    agg_ms = [m for eid, m in ops.items()
+              if eid.startswith("TpuHashAggregateExec@")]
+    assert len(agg_ms) == 1
+    assert agg_ms[0]["updateDispatches"] == 1, agg_ms[0]
+    assert agg_ms[0]["numOutputBatches"] == 1
+    # byte-identical to the unfused per-operator pipeline
+    s2 = tpu_session({"spark.rapids.tpu.fusion.aggregate.enabled": False})
+    df2 = _q9_shaped(s2, t)
+    tree2 = df2._physical().tree_string()
+    assert "fused=" not in tree2, tree2
+    unfused = df2.collect_arrow()
+    assert fused.to_pydict() == unfused.to_pydict()
+
+
+def test_fused_partial_agg_trace_shows_fused_region(tmp_path):
+    from spark_rapids_tpu.trace import core as trace_core
+    t = _q9_table(8192)
+    s = tpu_session({"spark.rapids.tpu.trace.enabled": True})
+    _q9_shaped(s, t).collect_arrow()
+    tr = trace_core.TRACER
+    try:
+        spans = [e for e in tr.snapshot()
+                 if e.get("name") == "TpuHashAggregateExec"
+                 and "fused" in (e.get("args") or {})]
+        assert spans, "no fused agg span recorded"
+        assert spans[0]["args"]["fused"] == ["filter", "partial-agg"]
+    finally:
+        trace_core.install_tracer(None)
+
+
+# ---------------------------------------------------------------------------
+# bench-rung regression (satellite: q1/q6/q9/q28 place on device when warm)
+# ---------------------------------------------------------------------------
+
+def _rungs(n=100_000):
+    from benchmarks import tpcds, tpch
+    lineitem = tpch.gen_lineitem(n)
+    store_sales = tpcds.gen_store_sales(n)
+
+    def q1(s):
+        return tpch.q1(s.create_dataframe(lineitem), F)
+
+    def q6(s):
+        return tpch.q6(s.create_dataframe(lineitem), F)
+
+    def q9(s):
+        return tpcds.q9(s.create_dataframe(store_sales), F)
+
+    def q28(s):
+        return tpcds.q28(s.create_dataframe(store_sales), F)
+    return {"tpch_q1": q1, "tpch_q6": q6, "tpcds_q9": q9,
+            "tpcds_q28": q28}
+
+
+@pytest.mark.parametrize("rung", ["tpch_q1", "tpch_q6", "tpcds_q9",
+                                  "tpcds_q28"])
+def test_bench_rungs_place_on_device_when_warm(rung, fresh_cost_state):
+    """Regression for BENCH_r05's 10-of-12-host ladder: with a warm
+    (pre-populated) exec cache and trusted learned costs, the aggregate
+    rungs must get a DEVICE placement decision from
+    apply_cost_optimizer — window_bounded and string_transforms_100k
+    already ran device-side while every aggregate rung reverted."""
+    q = _rungs()[rung]
+    df = q(tpu_session(OPT_ON))
+    exec_cache.record_plan_compiled(_digest(df))
+    physical = q(tpu_session(OPT_ON))._physical()
+    assert physical.placement_decision.startswith("device ("), \
+        (rung, physical.placement_decision)
+    tree = physical.tree_string()
+    assert "CpuAggregate" not in tree and "CpuFilter" not in tree, \
+        (rung, physical.placement_decision, tree)

@@ -325,13 +325,43 @@ def test_qualify_crashed_start_never_clobbers_completed_run(tmp_path):
 
 def test_qualify_uses_learned_device_cost(monkeypatch):
     """With a trusted learned device row cost the estimate switches
-    from the speedup priors to measurement-based pricing."""
+    from the speedup priors to measurement-based pricing (the fused
+    WholeStageExec cost covers records with no kind-specific entry)."""
     from spark_rapids_tpu.plan import cost
     from spark_rapids_tpu.tools.qualify import analyze
-    monkeypatch.setitem(cost._OP_COSTS, ("WholeStageExec", "device"),
-                        (10_000_000, 1.0))      # 1e-7 s/row, trusted
+    monkeypatch.setattr(cost, "_OP_COSTS",
+                        {("WholeStageExec", "device"): (10_000_000, 1.0)})
     rep = analyze(QUALIFY_FIXTURE)
-    assert rep["learned_device_cost"] == pytest.approx(1e-7)
+    assert rep["learned_device_cost"]["WholeStageExec"] \
+        == pytest.approx(1e-7)
     top = rep["codes"][0]
     assert top["code"] == "WHOLE_PLAN_HOST_REVERT"
     assert top["est_saved_ms"] > 0
+
+
+def test_qualify_prefers_per_operator_learned_costs(monkeypatch):
+    """Records whose operators have kind-specific learned costs price
+    the device wall from the SUM of those costs, not the fused-region
+    fallback: a deliberately huge Filter cost must shrink the estimated
+    saving of Filter-tagged records versus the fused-only basis."""
+    from spark_rapids_tpu.plan import cost
+    from spark_rapids_tpu.tools.qualify import analyze
+    monkeypatch.setattr(cost, "_OP_COSTS",
+                        {("WholeStageExec", "device"): (10_000_000, 1.0)})
+    cheap = analyze(QUALIFY_FIXTURE)
+    monkeypatch.setattr(cost, "_OP_COSTS", {
+        ("WholeStageExec", "device"): (10_000_000, 1.0),
+        ("Filter", "device"): (10_000_000, 10_000.0),  # 1e-3 s/row: huge
+        ("Aggregate", "device"): (10_000_000, 10_000.0),
+    })
+    pricey = analyze(QUALIFY_FIXTURE)
+    assert set(pricey["learned_device_cost"]) == {
+        "Aggregate", "Filter", "WholeStageExec"}
+
+    def saved(rep, code):
+        return {e["code"]: e["est_saved_ms"]
+                for e in rep["codes"]}.get(code, 0.0)
+    # every fixture record carries Filter/Aggregate ops: the per-op
+    # pricing makes the device look expensive -> savings collapse
+    assert saved(pricey, "WHOLE_PLAN_HOST_REVERT") \
+        < saved(cheap, "WHOLE_PLAN_HOST_REVERT")
