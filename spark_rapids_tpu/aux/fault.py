@@ -146,8 +146,8 @@ class ChaosController:
         self.delay_ms = int(delay_ms)
         self.kill_target = kill_target
         self._lock = threading.Lock()
-        self._counts: Dict[str, int] = {}
-        self._fired: List[Tuple[str, int]] = []
+        self._counts: Dict[str, int] = {}  # tpulint: guarded-by _lock
+        self._fired: List[Tuple[str, int]] = []  # tpulint: guarded-by _lock
         self._rules: Dict[str, Tuple[str, float]] = {}
         self._rngs: Dict[str, "object"] = {}
         for entry in str(spec).split(";"):

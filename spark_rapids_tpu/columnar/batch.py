@@ -535,6 +535,11 @@ def _device_concat_compact(counts, cols):
 _DEVICE_CONCAT_JIT = None
 
 
+def _clear_device_concat() -> None:
+    global _DEVICE_CONCAT_JIT
+    _DEVICE_CONCAT_JIT = None
+
+
 def concat_batches_device(batches: Sequence[ColumnarBatch],
                           buckets: Sequence[int] = DEFAULT_BUCKETS):
     """Device-resident concat: no D2H. Requires every column of every batch
@@ -606,9 +611,19 @@ def concat_batches_device(batches: Sequence[ColumnarBatch],
                 for per in lane_cols]
     else:
         global _DEVICE_CONCAT_JIT
-        if _DEVICE_CONCAT_JIT is None:
-            _DEVICE_CONCAT_JIT = jax.jit(_device_concat_compact)
-        outs = _DEVICE_CONCAT_JIT(
+        # bind to a local: a concurrent exec_cache.clear() may null the
+        # memo between the check and the call
+        concat_fn = _DEVICE_CONCAT_JIT
+        if concat_fn is None:
+            # resolved through the executable cache (not an ad-hoc
+            # jit): one process-wide callable, compiles visible to the
+            # srtpu_compile_* metrics; the front memo registers a
+            # clear hook so exec_cache.clear() releases it too
+            from ..plan import exec_cache
+            exec_cache.register_clear_hook(_clear_device_concat)
+            concat_fn = _DEVICE_CONCAT_JIT = exec_cache.get_or_build_jit(
+                "columnar.device_concat", _device_concat_compact)
+        outs = concat_fn(
             jnp.asarray(np.asarray(counts, np.int32)), lane_cols)
     target = bucket_for(total, buckets)
     sized = []

@@ -72,7 +72,30 @@ def pack_traced(flat):
     return u32, f64
 
 
-_pack = jax.jit(pack_traced)
+#: lazily resolved through the executable cache (exec_cache is the
+#: blessed jit owner); the module-global memo keeps the per-fetch hit
+#: path one attribute read — the compiler-front-memo idiom
+_PACK = None
+
+
+def _clear_pack() -> None:
+    global _PACK
+    _PACK = None
+
+
+def _pack(flat):
+    global _PACK
+    # bind to a local: a concurrent exec_cache.clear() may null the
+    # memo between the check and the call
+    fn = _PACK
+    if fn is None:
+        from ..plan import exec_cache
+        # front-memo contract: exec_cache.clear() must release THIS
+        # strong reference too, or the dropped tier keeps serving
+        exec_cache.register_clear_hook(_clear_pack)
+        fn = _PACK = exec_cache.get_or_build_jit("columnar.pack_traced",
+                                                 pack_traced)
+    return fn(flat)
 
 
 def unpack_streams(u32, f64, specs):

@@ -13,8 +13,8 @@ from typing import Any, Callable, Dict, List, Optional
 
 __all__ = ["ConfEntry", "TpuConf", "register", "all_entries", "generate_docs"]
 
-_REGISTRY: Dict[str, "ConfEntry"] = {}
 _LOCK = threading.Lock()
+_REGISTRY: Dict[str, "ConfEntry"] = {}  # tpulint: guarded-by _LOCK
 
 
 class ConfEntry:
@@ -68,7 +68,11 @@ def register(key: str, default, doc: str, **kw) -> ConfEntry:
 
 
 def all_entries() -> List[ConfEntry]:
-    return sorted(_REGISTRY.values(), key=lambda e: e.key)
+    # snapshot under the lock: the docs generator or qualify tool may
+    # enumerate while ensure_op_confs() is still registering per-op keys
+    with _LOCK:
+        entries = list(_REGISTRY.values())
+    return sorted(entries, key=lambda e: e.key)
 
 
 # ---------------------------------------------------------------------------

@@ -27,7 +27,7 @@ CONCURRENT_PYTHON_WORKERS = register(
     "(ref python/PythonWorkerSemaphore.scala + PythonConfEntries).")
 
 _SEM_LOCK = threading.Lock()
-_SEMAPHORES = {}
+_SEMAPHORES = {}     # tpulint: guarded-by _SEM_LOCK
 
 
 def python_worker_semaphore(n: int):

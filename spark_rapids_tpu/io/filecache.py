@@ -36,19 +36,20 @@ FILECACHE_MAX_BYTES = register(
 
 
 class FileCache:
-    _instances: Dict[str, "FileCache"] = {}
     _lock = threading.Lock()
+    # tpulint: guarded-by _lock
+    _instances: Dict[str, "FileCache"] = {}
 
     def __init__(self, path: str, max_bytes: int):
         self.path = path
         self.max_bytes = max_bytes
-        self.hits = 0
-        self.misses = 0
         self._io_lock = threading.Lock()
+        self.hits = 0                # tpulint: guarded-by _io_lock
+        self.misses = 0              # tpulint: guarded-by _io_lock
         # thread ident -> the path resolve() last handed that thread: a
         # concurrent miss's eviction must not unlink it before the
         # reader opens it
-        self._in_use: Dict[int, str] = {}
+        self._in_use: Dict[int, str] = {}  # tpulint: guarded-by _io_lock
         os.makedirs(path, exist_ok=True)
 
     @classmethod

@@ -63,8 +63,9 @@ def _device_hbm_bytes() -> int:
 
 
 class MemoryManager:
-    _instances: Dict[int, "MemoryManager"] = {}
     _global_lock = threading.Lock()
+    # tpulint: guarded-by _global_lock
+    _instances: Dict[int, "MemoryManager"] = {}
 
     def __init__(self, budget_bytes: int, host_limit_bytes: int,
                  spill_dir: str, use_native: bool = False):
@@ -79,17 +80,17 @@ class MemoryManager:
             from .native import NativeOomState, load
             if load() is not None:
                 self._native = NativeOomState(budget_bytes)
-        self._py_device_used = 0
-        self.host_used = 0
-        self.disk_used = 0
-        self._py_max_device_used = 0
-        self.spill_to_host_bytes = 0
-        self.spill_to_disk_bytes = 0
+        self._py_device_used = 0     # tpulint: guarded-by _lock
+        self.host_used = 0           # tpulint: guarded-by _lock
+        self.disk_used = 0           # tpulint: guarded-by _lock
+        self._py_max_device_used = 0  # tpulint: guarded-by _lock
+        self.spill_to_host_bytes = 0  # tpulint: guarded-by _lock
+        self.spill_to_disk_bytes = 0  # tpulint: guarded-by _lock
         # spillables: handle -> SpillableBatch, priority-ordered on demand
-        self._spillables: Dict[int, "object"] = {}
-        self._next_handle = 0
+        self._spillables: Dict[int, "object"] = {}  # tpulint: guarded-by _lock
+        self._next_handle = 0        # tpulint: guarded-by _lock
         # fault injection: thread-ident -> [(kind, remaining_skips, count)]
-        self._inject: Dict[int, List] = {}
+        self._inject: Dict[int, List] = {}  # tpulint: guarded-by _lock
         #: alloc/free logging (ref spark.rapids.memory.gpu.debug=STDOUT,
         #: RapidsConf.scala:376)
         self.debug_log = False
@@ -118,12 +119,16 @@ class MemoryManager:
     def device_used(self) -> int:
         if self._native is not None:
             return self._native.used
+        # tpulint: disable=lock-discipline — lock-free by design: a
+        # single int read for logging/telemetry; stats() takes the lock
         return self._py_device_used
 
     @property
     def max_device_used(self) -> int:
         if self._native is not None:
             return self._native.max_used
+        # tpulint: disable=lock-discipline — lock-free by design: a
+        # single int read for logging/telemetry; stats() takes the lock
         return self._py_max_device_used
 
     # ----------------------------------------------------------- registration
@@ -174,7 +179,12 @@ class MemoryManager:
                 self._trace_alloc(nbytes)
                 return
         if allow_spill:
-            self.spill_device(nbytes - (self.budget - self._py_device_used))
+            with self._lock:
+                # read the shortfall under the lock: a stale used-count
+                # here under-spills and turns a satisfiable reserve
+                # into a spurious RetryOOM
+                shortfall = nbytes - (self.budget - self._py_device_used)
+            self.spill_device(shortfall)
             with self._lock:
                 if self._py_device_used + nbytes <= self.budget:
                     self._py_device_used += nbytes
@@ -335,20 +345,18 @@ class MemoryManager:
     def stats_all(cls) -> Dict[str, int]:
         """Aggregate accounting across every live budget singleton — the
         metrics sampler's view (one process may hold several budgets in
-        tests; fleet gauges sum them)."""
+        tests; fleet gauges sum them). Each instance is read through
+        its own lock'd stats() so a manager mid-spill contributes a
+        consistent row, not a torn one."""
         with cls._global_lock:
             insts = list(cls._instances.values())
         out = {"device_used": 0, "host_used": 0, "disk_used": 0,
                "max_device_used": 0, "budget": 0,
                "spill_to_host_bytes": 0, "spill_to_disk_bytes": 0}
         for mm in insts:
-            out["device_used"] += mm.device_used
-            out["host_used"] += mm.host_used
-            out["disk_used"] += mm.disk_used
-            out["max_device_used"] += mm.max_device_used
-            out["budget"] += mm.budget
-            out["spill_to_host_bytes"] += mm.spill_to_host_bytes
-            out["spill_to_disk_bytes"] += mm.spill_to_disk_bytes
+            st = mm.stats()
+            for k in out:
+                out[k] += st[k]
         return out
 
     # ------------------------------------------------------------------ stats

@@ -20,8 +20,8 @@ _SRC = os.path.join(os.path.dirname(__file__), "..", "native",
 _SO = os.path.join(os.path.dirname(__file__), "..", "native",
                    "liboom_state.so")
 _LOCK = threading.Lock()
-_lib = None
-_tried = False
+_lib = None          # tpulint: guarded-by _LOCK
+_tried = False       # tpulint: guarded-by _LOCK
 
 
 def _build() -> Optional[str]:

@@ -22,9 +22,9 @@ _SRC = os.path.join(os.path.dirname(__file__), "..", "native",
 _SO = os.path.join(os.path.dirname(__file__), "..", "native",
                    "libspill_store.so")
 _LOCK = threading.Lock()
-_lib = None
-_tried = False
-_stores: Dict[str, "NativeSpillStore"] = {}
+_lib = None          # tpulint: guarded-by _LOCK
+_tried = False       # tpulint: guarded-by _LOCK
+_stores: Dict[str, "NativeSpillStore"] = {}  # tpulint: guarded-by _LOCK
 
 
 def _load_lib():

@@ -27,10 +27,10 @@ class DeviceSemaphore:
         self._sem = threading.BoundedSemaphore(self._permits)
         self._timeout = timeout_s
         self._lock = threading.Lock()
-        self.total_wait_s = 0.0
-        self.acquires = 0
+        self.total_wait_s = 0.0      # tpulint: guarded-by _lock
+        self.acquires = 0            # tpulint: guarded-by _lock
         #: tasks currently blocked in acquire() (metrics queue depth)
-        self.waiting = 0
+        self.waiting = 0             # tpulint: guarded-by _lock
         self._held = threading.local()
         _SEMAPHORES.add(self)
 

@@ -79,6 +79,8 @@ class SpillableBatch:
     def device_bytes(self) -> int:
         """Device footprint when resident (size estimate for spill/split
         decisions, ref SpillableColumnarBatch.sizeInBytes)."""
+        # tpulint: disable=lock-discipline — lock-free by design: a
+        # single immutable-int read used as a sizing estimate
         return self._device_bytes
 
     @property
@@ -175,6 +177,8 @@ class SpillableBatch:
             return self._batch
 
     def size_bytes(self) -> int:
+        # tpulint: disable=lock-discipline — lock-free by design: a
+        # single immutable-int read used as a sizing estimate
         return self._device_bytes
 
     def close(self):

@@ -74,7 +74,7 @@ class EventLogWriter:
         self.dir = directory
         self.max_bytes = int(max_bytes)
         self._lock = threading.Lock()
-        self._seq = self._next_seq()
+        self._seq = self._next_seq()  # tpulint: guarded-by _lock
 
     @classmethod
     def from_conf(cls, conf) -> Optional["EventLogWriter"]:

@@ -95,7 +95,7 @@ class Counter:
     def __init__(self, name: str, labels: Tuple[Tuple[str, str], ...]):
         self.name = name
         self.labels = labels
-        self.value = 0
+        self.value = 0               # tpulint: guarded-by _lock
         self._lock = threading.Lock()
 
     def inc(self, n=1) -> None:
@@ -124,7 +124,7 @@ class Gauge:
     def __init__(self, name: str, labels: Tuple[Tuple[str, str], ...]):
         self.name = name
         self.labels = labels
-        self.value = 0
+        self.value = 0               # tpulint: guarded-by _lock
         self._lock = threading.Lock()
 
     def set(self, v) -> None:
@@ -154,9 +154,9 @@ class Histogram:
         self.name = name
         self.labels = labels
         self.buckets = tuple(sorted(buckets))
-        self.bucket_counts = [0] * len(self.buckets)
-        self.sum = 0.0
-        self.count = 0
+        self.bucket_counts = [0] * len(self.buckets)  # tpulint: guarded-by _lock
+        self.sum = 0.0               # tpulint: guarded-by _lock
+        self.count = 0               # tpulint: guarded-by _lock
         self._lock = threading.Lock()
 
     def observe(self, v: float) -> None:
@@ -174,6 +174,7 @@ class MetricRegistry:
     format task-completion RPCs ship and the exporters consume."""
 
     def __init__(self):
+        # tpulint: guarded-by _lock
         self._metrics: Dict[Tuple[str, Tuple[Tuple[str, str], ...]],
                             object] = {}
         self._lock = threading.Lock()
@@ -223,7 +224,11 @@ class MetricRegistry:
                     s["sum"] = m.sum
                     s["count"] = m.count
             else:
-                s["value"] = m.value
+                with m._lock:
+                    # a torn scalar read is survivable, but exporting a
+                    # value mid-update while histograms are snapshotted
+                    # consistently made the two families disagree
+                    s["value"] = m.value
             ent["series"].append(s)
         for ent in out.values():
             if isinstance(ent, dict) and "series" in ent:
@@ -240,6 +245,8 @@ _INSTALL_LOCK = threading.Lock()
 
 
 def active_registry() -> Optional[MetricRegistry]:
+    # tpulint: disable=lock-discipline — lock-free by design: the
+    # disabled-path contract is one unlocked reference read per site
     return REGISTRY
 
 
@@ -266,6 +273,8 @@ def ensure_metrics_from_conf(conf) -> Optional[MetricRegistry]:
     ExecContext construction, never per metric event."""
     global REGISTRY
     if not conf.get(METRICS_ENABLED):
+        # tpulint: disable=lock-discipline — lock-free by design:
+        # metrics-off fast path; installation itself locks below
         return REGISTRY
     with _INSTALL_LOCK:
         if REGISTRY is None:

@@ -69,26 +69,27 @@ COMPILE_CACHE_MAX_BYTES = register(
 #: jax's caches, which the test harness clears per module).
 _LRU_MAX = 512
 
-_LRU: "OrderedDict[Tuple, Callable]" = OrderedDict()
 _LOCK = threading.Lock()
+_LRU: "OrderedDict[Tuple, Callable]" = OrderedDict()  # tpulint: guarded-by _LOCK
+# tpulint: guarded-by _LOCK
 _STATS: Dict[str, float] = {"hits": 0, "misses": 0,
                             "persistent_hits": 0, "compile_s": 0.0}
 
 #: last persistent-tier trim PER DIRECTORY, debounced (an eviction walk
 #: per compile burst, not per kernel; two sessions on different dirs
 #: must not consume each other's debounce window)
-_LAST_TRIM: Dict[str, float] = {}
+_LAST_TRIM: Dict[str, float] = {}    # tpulint: guarded-by _LOCK
 _TRIM_DEBOUNCE_S = 30.0
 
 #: callbacks invoked by clear(): front memos layered over this cache
 #: (exprs/compiler._FRONT) register here so dropping the tier actually
 #: releases every strong reference
-_CLEAR_HOOKS = []
+_CLEAR_HOOKS = []                    # tpulint: guarded-by _LOCK
 
 #: the process-default cache dir, captured before any session override:
 #: a session with an EMPTY compile.cache.dir conf must get this default
 #: back, not whichever directory the previous session pointed jax at
-_PROC_DEFAULT_DIR = [None]
+_PROC_DEFAULT_DIR = [None]           # tpulint: guarded-by _LOCK
 
 #: plan digests (metrics/events.plan_digest) whose device execution
 #: completed — every kernel the plan builds now lives in the in-process
@@ -101,7 +102,7 @@ _PROC_DEFAULT_DIR = [None]
 #: A dict-as-ordered-set (values unused): insertion order is the
 #: recency proxy, so the cap evicts the OLDEST digest, never an
 #: arbitrary hot one (the _ENGINE_WALLS idiom).
-_PLAN_DIGESTS: dict = {}
+_PLAN_DIGESTS: dict = {}             # tpulint: guarded-by _LOCK
 _PLAN_DIGESTS_MAX = 4096
 
 
@@ -131,6 +132,14 @@ def record_plan_compiled(digest: str) -> None:
     if _persist_enabled():
         from . import stats_store
         stats_store.mark_dirty()
+
+
+def warm_digests() -> list:
+    """Snapshot of the warm (digest, device-kind) pairs, taken under
+    the lock — the stats_store persist path must not iterate the live
+    dict while record_plan_compiled mutates it."""
+    with _LOCK:
+        return list(_PLAN_DIGESTS)
 
 
 def plan_digest_cached(digest: str) -> bool:
@@ -237,6 +246,25 @@ def get_or_build(key: Tuple, build: Callable[[], Callable],
     return fn
 
 
+def get_or_build_jit(name: str, fn: Callable, **jit_kwargs) -> Callable:
+    """Blessed ``jax.jit`` wrapper for NAMED module-level kernels: the
+    compiled callable resolves through the in-process tier keyed on
+    (name, jit options, device kind), so every holder shares one
+    callable and the ``srtpu_compile_*`` metrics see the compile.  This
+    is the migration target for the grandfathered ad-hoc
+    ``jax.jit(module_fn)`` sites the ``adhoc-jit`` rule tracks
+    (docs/static_analysis.md)."""
+    import jax
+
+    def build():
+        return jax.jit(fn, **jit_kwargs)
+
+    # jit options are part of the identity: two sites sharing a name
+    # but differing in e.g. donate_argnums must not share a callable
+    opts = tuple(sorted((k, repr(v)) for k, v in jit_kwargs.items()))
+    return get_or_build(fused_key(name, opts), build, label=name)
+
+
 def stats() -> Dict[str, float]:
     """Copy of the process-lifetime cache counters (bench.py diffs
     these around each rung for the cold/warm compile split)."""
@@ -289,10 +317,15 @@ def configure_from_conf(conf) -> Optional[str]:
     Returns the active cache dir (or None when persistence is off)."""
     import jax
     cur = jax.config.jax_compilation_cache_dir
-    if _PROC_DEFAULT_DIR[0] is None:
-        _PROC_DEFAULT_DIR[0] = cur or ""
+    # check-then-set under the lock: two ExecContexts constructed
+    # concurrently must agree on ONE process default, not race to
+    # capture each other's override as "the default"
+    with _LOCK:
+        if _PROC_DEFAULT_DIR[0] is None:
+            _PROC_DEFAULT_DIR[0] = cur or ""
+        default_dir = _PROC_DEFAULT_DIR[0]
     want = (str(conf.get(COMPILE_CACHE_DIR) or "").strip()
-            or _PROC_DEFAULT_DIR[0])
+            or default_dir)
     if want != (cur or ""):
         try:
             jax.config.update("jax_compilation_cache_dir", want or None)
@@ -304,9 +337,15 @@ def configure_from_conf(conf) -> Optional[str]:
     if cur:
         max_bytes = int(conf.get(COMPILE_CACHE_MAX_BYTES))
         now = time.monotonic()
-        if max_bytes > 0 \
-                and now - _LAST_TRIM.get(cur, 0.0) >= _TRIM_DEBOUNCE_S:
-            _LAST_TRIM[cur] = now
+        # the debounce check-then-set is atomic, or two concurrent
+        # sessions both pass the window test and stat-walk the (shared,
+        # possibly NFS) cache dir twice
+        with _LOCK:
+            due = max_bytes > 0 and \
+                now - _LAST_TRIM.get(cur, 0.0) >= _TRIM_DEBOUNCE_S
+            if due:
+                _LAST_TRIM[cur] = now
+        if due:
             # background thread: the stat walk of a large shared cache
             # dir (possibly NFS) must not block query start — this is
             # called from ExecContext construction

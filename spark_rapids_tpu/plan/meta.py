@@ -22,8 +22,8 @@ __all__ = ["PlanMeta", "fallback_counts", "reset_fallback_counts"]
 #: static registry coverage). Keyed by "<PlanClass>: <reason>" for execs and
 #: "expr: <note>" for expression host-fallbacks (VERDICT r2 #9: report a
 #: fallback-reason histogram from real workloads).
-_FALLBACKS: collections.Counter = collections.Counter()
 _FB_LOCK = threading.Lock()
+_FALLBACKS: collections.Counter = collections.Counter()  # tpulint: guarded-by _FB_LOCK
 
 
 def fallback_counts() -> dict:

@@ -29,7 +29,7 @@ EXPR_CONF_PREFIX = "spark.rapids.tpu.sql.expression."
 EXEC_CONF_PREFIX = "spark.rapids.tpu.sql.exec."
 
 _LOCK = threading.RLock()
-_DONE = False
+_DONE = False        # tpulint: guarded-by _LOCK
 
 
 def _expression_names() -> List[str]:

@@ -42,9 +42,14 @@ _DEBOUNCE_S = 5.0
 _LOCAL_TAG = re.compile(r"#\d+#")
 
 _lock = threading.Lock()
-_loaded = False
-_dirty = False
-_last_save = 0.0
+#: serializes whole-file writes: two concurrent save()s could otherwise
+#: os.replace in snapshot-age order reversed, persisting the STALER one
+#: while both clear _dirty (the fresher data then never lands). Always
+#: taken BEFORE _lock, never while holding it.
+_save_lock = threading.Lock()
+_loaded = False      # tpulint: guarded-by _lock
+_dirty = False       # tpulint: guarded-by _lock
+_last_save = 0.0     # tpulint: guarded-by _lock
 
 
 def _path() -> str:
@@ -118,38 +123,74 @@ def load_into(walls: dict, rows: dict, ops: dict = None,
 
 def mark_dirty() -> None:
     global _dirty
-    _dirty = True
     now = time.monotonic()
-    if now - _last_save >= _DEBOUNCE_S:
+    # flag-set and debounce check are atomic: two writers racing here
+    # could both read a stale _last_save and double-save (harmless) or
+    # interleave with save()'s flag reset and LOSE the dirty mark (a
+    # dropped persist)
+    with _lock:
+        _dirty = True
+        due = now - _last_save >= _DEBOUNCE_S
+    if due:
         save()
 
 
 def save() -> None:
     global _dirty, _last_save
+    # tpulint: disable=lock-discipline — lock-free by design: racy
+    # early-out double-check; re-checked under _save_lock below
     if not _dirty:
         return
+    with _save_lock:
+        _save_serialized()
+
+
+def _save_serialized() -> None:
+    """The body of save(), holding _save_lock: snapshot, write,
+    flag-reset happen as one unit so a staler snapshot can never
+    overwrite a fresher file."""
+    global _dirty, _last_save
+    with _lock:
+        if not _dirty:
+            return
+        # claim the flag BEFORE snapshotting: a record_* that dirties
+        # the stats mid-write re-marks and the NEXT save persists it,
+        # instead of this save clearing a mark its snapshot missed
+        _dirty = False
     from . import cost, exec_cache
     # merge the on-disk state first: a process that never planned (e.g.
     # optimizer disabled) would otherwise TRUNCATE the accumulated store
     # to just its own entries on the first debounced save
     cost.load_persisted_stats()
-    with _lock:
-        # snapshot under the lock; list(...) guards against concurrent
-        # record_* inserts mutating the dicts mid-iteration
-        walls = [[sig, pl, c, s]
-                 for (sig, pl), (c, s) in list(cost._ENGINE_WALLS.items())
-                 if _persistable(sig)][-_CAP:]
-        rows = [[sig, n] for sig, n in list(cost._RUNTIME_ROWS.items())
-                if _persistable(sig)][-_CAP:]
-        ops = [[kind, pl, r, s]
-               for (kind, pl), (r, s) in list(cost._OP_COSTS.items())]
-        # insertion order IS the recency order (record_plan_compiled
-        # refreshes repeats to the end), so persist it — sorting would
-        # replace recency with lexicographic order on reload — and keep
-        # the NEWEST entries when over the cap (the walls idiom)
-        plans = [[dig, dk] for dig, dk in
-                 list(exec_cache._PLAN_DIGESTS)
-                 ][-exec_cache._PLAN_DIGESTS_MAX:]
+    # cost's dicts have no lock of their own (their writers are the
+    # query threads) and _PLAN_DIGESTS is guarded by exec_cache._LOCK,
+    # not ours — so snapshot each under the right regime: the digests
+    # through exec_cache's locked accessor, the cost dicts with a
+    # bounded retry on the resize-mid-iteration race
+    for _attempt in range(4):
+        try:
+            walls = [[sig, pl, c, s]
+                     for (sig, pl), (c, s) in
+                     list(cost._ENGINE_WALLS.items())
+                     if _persistable(sig)][-_CAP:]
+            rows = [[sig, n] for sig, n in
+                    list(cost._RUNTIME_ROWS.items())
+                    if _persistable(sig)][-_CAP:]
+            ops = [[kind, pl, r, s]
+                   for (kind, pl), (r, s) in list(cost._OP_COSTS.items())]
+            break
+        except RuntimeError:     # dict changed size during iteration
+            continue
+    else:
+        with _lock:
+            _dirty = True        # keep the claim; try again next time
+        return
+    # insertion order IS the recency order (record_plan_compiled
+    # refreshes repeats to the end), so persist it — sorting would
+    # replace recency with lexicographic order on reload — and keep
+    # the NEWEST entries when over the cap (the walls idiom)
+    plans = [[dig, dk] for dig, dk in
+             exec_cache.warm_digests()][-exec_cache._PLAN_DIGESTS_MAX:]
     path = _path()
     tmp = path + f".tmp{os.getpid()}"
     try:
@@ -158,9 +199,11 @@ def save() -> None:
             json.dump({"version": 2, "walls": walls, "rows": rows,
                        "ops": ops, "plans": plans}, f)
         os.replace(tmp, path)
-        _dirty = False
-        _last_save = time.monotonic()
+        with _lock:
+            _last_save = time.monotonic()
     except OSError:
+        with _lock:
+            _dirty = True        # nothing landed; keep the data claimed
         try:
             os.unlink(tmp)
         except OSError:

@@ -25,12 +25,12 @@ class ShuffleHeartbeatManager:
 
     def __init__(self, stale_after_s: float = 30.0):
         self._lock = threading.Lock()
-        self._peers: Dict[str, dict] = {}
+        self._peers: Dict[str, dict] = {}  # tpulint: guarded-by _lock
         self.stale_after_s = stale_after_s
         #: latest metric-registry snapshot shipped per executor (ISSUE 5
         #: distributed collection: heartbeats carry telemetry so idle
         #: workers still report; task completions ship fresher ones)
-        self.metrics: Dict[str, dict] = {}
+        self.metrics: Dict[str, dict] = {}  # tpulint: guarded-by _lock
 
     def register(self, executor_id: str, address: dict,
                  metrics: Optional[dict] = None) -> List[dict]:

@@ -267,11 +267,12 @@ class BlockServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  token: Optional[bytes] = None,
                  tasks: Optional[Dict[str, Callable]] = None):
+        self._lock = threading.Lock()
+        # tpulint: guarded-by _lock
         self._blocks: Dict[Tuple[int, int],
                            List[Tuple[Optional[str], int, bytes]]] = {}
-        self._lock = threading.Lock()
-        self._conns: set = set()
         self._conn_lock = threading.Lock()
+        self._conns: set = set()     # tpulint: guarded-by _conn_lock
         self.token = token
         self.tasks: Dict[str, Callable] = dict(tasks or {})
         self.crc_rejects = 0       # corrupt puts refused (never stored)
@@ -389,13 +390,17 @@ class BlockClient:
 
     def _invalidate(self):
         """Drop a socket whose request/response stream can no longer be
-        trusted (error or timeout mid-exchange)."""
-        if self._sock is not None:
-            try:
-                self._sock.close()
-            except OSError:
-                pass
-            self._sock = None
+        trusted (error or timeout mid-exchange). Takes the lock itself:
+        every caller is an except-path that has already LEFT its locked
+        region, and closing under a concurrent _ensure() would hand
+        that request a half-dead socket."""
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
 
     def _ensure(self) -> socket.socket:
         if self._sock is None:
@@ -406,9 +411,18 @@ class BlockClient:
     def set_timeout(self, timeout: float) -> None:
         """Rebound the per-operation socket timeout (shutdown paths drop
         it so a wedged peer cannot stall teardown)."""
+        # tpulint: disable=lock-discipline — lock-free by design: taking
+        # _lock here would block behind the very wedged request this
+        # call exists to un-stick; a racy settimeout is benign
         self.timeout = timeout
-        if self._sock is not None:
-            self._sock.settimeout(timeout)
+        sock = self._sock  # tpulint: disable=lock-discipline — see above
+        if sock is not None:
+            try:
+                sock.settimeout(timeout)
+            except OSError:
+                # a racing _invalidate() may close the snapshot'd
+                # socket; the un-stick path itself must never raise
+                pass
 
     def _backoff(self, attempt: int):
         base = self.backoff_ms / 1000.0

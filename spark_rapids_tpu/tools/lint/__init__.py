@@ -20,21 +20,27 @@ exits non-zero on new violations. See docs/static_analysis.md.
 """
 from .framework import (FileContext, FileRule, Finding, LintResult,
                         ProjectRule, Rule, lint_source, load_baseline,
-                        run_lint, write_baseline)
+                        prune_baseline, run_lint, write_baseline)
 from .rules_retry import RetryIdempotenceRule
 from .rules_lifetime import BatchLifetimeRule
 from .rules_hostsync import HostSyncRule
+from .rules_hostsyncflow import HostSyncFlowRule
 from .rules_jit import AdHocJitRule
+from .rules_lockdiscipline import LockDisciplineRule
+from .rules_retrace import RetraceRiskRule
 from .rules_drift import (ConfigKeyDriftRule, MetricNameDriftRule,
                           OpsDocDriftRule, ReasonCodeDriftRule)
 
 #: every shipped rule, in reporting order
 ALL_RULES = [RetryIdempotenceRule(), BatchLifetimeRule(), HostSyncRule(),
-             AdHocJitRule(), ConfigKeyDriftRule(), OpsDocDriftRule(),
+             HostSyncFlowRule(), AdHocJitRule(), RetraceRiskRule(),
+             LockDisciplineRule(), ConfigKeyDriftRule(), OpsDocDriftRule(),
              MetricNameDriftRule(), ReasonCodeDriftRule()]
 
 __all__ = ["ALL_RULES", "FileContext", "FileRule", "Finding", "LintResult",
-           "ProjectRule", "Rule", "lint_source", "load_baseline", "run_lint",
-           "write_baseline", "RetryIdempotenceRule", "BatchLifetimeRule",
-           "HostSyncRule", "AdHocJitRule", "ConfigKeyDriftRule",
-           "OpsDocDriftRule", "MetricNameDriftRule", "ReasonCodeDriftRule"]
+           "ProjectRule", "Rule", "lint_source", "load_baseline",
+           "prune_baseline", "run_lint", "write_baseline",
+           "RetryIdempotenceRule", "BatchLifetimeRule", "HostSyncRule",
+           "HostSyncFlowRule", "AdHocJitRule", "RetraceRiskRule",
+           "LockDisciplineRule", "ConfigKeyDriftRule", "OpsDocDriftRule",
+           "MetricNameDriftRule", "ReasonCodeDriftRule"]

@@ -1,13 +1,22 @@
 """tpulint CLI.
 
     python -m spark_rapids_tpu.tools.lint [paths...]
-        [--baseline PATH] [--update-baseline] [--no-baseline]
-        [--list-rules] [-v]
+        [--baseline PATH] [--update-baseline] [--prune-baseline]
+        [--no-baseline] [--format=human|json|sarif]
+        [--changed [BASE]] [--list-rules] [-v]
 
 Exit status: 0 when every finding is suppressed or baselined, 1 when new
 violations exist, 2 on usage/tool errors. Default target is the
 ``spark_rapids_tpu`` package; default baseline is the checked-in
-``tools/lint/baseline.json``. See docs/static_analysis.md.
+``tools/lint/baseline.json``.
+
+``--format=json``/``--format=sarif`` emit byte-deterministic
+machine-readable findings (formats.py documents the schemas) so CI can
+render annotations; the human format stays the default.  ``--changed``
+lints only files touched vs a git base (default HEAD) for a fast
+pre-commit loop, falling back to the full tree when git is unavailable.
+``--prune-baseline`` drops grandfathered entries the tree no longer
+produces.  See docs/static_analysis.md.
 """
 from __future__ import annotations
 
@@ -16,15 +25,17 @@ import os
 import sys
 
 from . import ALL_RULES
-from .framework import (default_baseline_path, load_baseline, run_lint,
+from .formats import FORMATS, render_json, render_sarif
+from .framework import (changed_python_files, default_baseline_path,
+                        load_baseline, prune_baseline, run_lint,
                         write_baseline)
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m spark_rapids_tpu.tools.lint",
-        description="AST-based static analysis enforcing the accelerator "
-                    "contracts (see docs/static_analysis.md)")
+        description="AST+dataflow static analysis enforcing the "
+                    "accelerator contracts (see docs/static_analysis.md)")
     ap.add_argument("paths", nargs="*",
                     help="files/directories to lint (default: the "
                          "spark_rapids_tpu package)")
@@ -36,13 +47,27 @@ def main(argv=None) -> int:
     ap.add_argument("--update-baseline", action="store_true",
                     help="rewrite the baseline to the current finding set "
                          "and exit 0")
+    ap.add_argument("--prune-baseline", action="store_true",
+                    help="drop baseline entries the tree no longer "
+                         "produces, report how many were pruned, exit 0")
+    ap.add_argument("--format", choices=FORMATS, default="human",
+                    help="output format: human (default), json, or "
+                         "sarif (SARIF 2.1.0; both byte-deterministic "
+                         "with stable ordering)")
+    ap.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                    metavar="BASE",
+                    help="lint only files changed vs the git base "
+                         "(default HEAD) plus untracked files — the "
+                         "pre-commit fast path; falls back to the full "
+                         "tree when git is unavailable")
     ap.add_argument("--root", default=None,
                     help="repo root anchoring relative paths and the "
                          "docs/ lookups of the drift rules (default: the "
                          "root this package is installed in)")
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("-v", "--verbose", action="store_true",
-                    help="also print suppressed and baselined findings")
+                    help="also print suppressed and baselined findings "
+                         "(human format; json/sarif always carry them)")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -54,9 +79,43 @@ def main(argv=None) -> int:
         os.path.join(os.path.dirname(__file__), "..", ".."))
     repo_root = os.path.abspath(args.root) if args.root \
         else os.path.dirname(pkg_root)
+    if (args.prune_baseline or args.update_baseline) and \
+            (args.changed is not None or args.paths):
+        # the baseline describes the FULL tree: rewriting it from a
+        # subset would truncate every entry the subset didn't produce
+        print("tpulint: --prune-baseline/--update-baseline require a "
+              "full-tree run (no --changed, no explicit paths)",
+              file=sys.stderr)
+        return 2
     paths = args.paths or [pkg_root]
+    if args.changed is not None:
+        changed = changed_python_files(args.changed, repo_root)
+        if changed is None:
+            print("tpulint: git unavailable for --changed; "
+                  "linting the full tree", file=sys.stderr)
+        else:
+            roots = [os.path.abspath(p) for p in paths]
+            paths = [f for f in changed
+                     if any(f == r or f.startswith(r + os.sep)
+                            for r in roots)]
+            if not paths:
+                # machine formats must still emit a parseable (empty)
+                # document — CI pipes this straight into jq/uploaders
+                if args.format == "json":
+                    from .framework import LintResult
+                    sys.stdout.write(render_json(LintResult()))
+                elif args.format == "sarif":
+                    from .framework import LintResult
+                    sys.stdout.write(render_sarif(LintResult(),
+                                                  ALL_RULES))
+                else:
+                    print("tpulint: no changed Python files under "
+                          "the lint roots")
+                return 0
     baseline_path = args.baseline or default_baseline_path()
-    baseline = {} if args.no_baseline else load_baseline(baseline_path)
+    use_baseline = not (args.no_baseline or args.prune_baseline
+                        or args.update_baseline)
+    baseline = load_baseline(baseline_path) if use_baseline else {}
 
     result = run_lint(paths, rules=ALL_RULES, baseline=baseline,
                       root=repo_root)
@@ -65,6 +124,18 @@ def main(argv=None) -> int:
         out = write_baseline(result.findings, baseline_path)
         print(f"tpulint: wrote {len(result.findings)} finding(s) to {out}")
         return 0
+    if args.prune_baseline:
+        kept, pruned = prune_baseline(result.findings, baseline_path)
+        print(f"tpulint: baseline now {kept} entr"
+              f"{'y' if kept == 1 else 'ies'}, pruned {pruned}")
+        return 0
+
+    if args.format == "json":
+        sys.stdout.write(render_json(result))
+        return 1 if result.new else 0
+    if args.format == "sarif":
+        sys.stdout.write(render_sarif(result, ALL_RULES))
+        return 1 if result.new else 0
 
     for f in sorted(result.new, key=lambda f: (f.path, f.line)):
         print(f"{f.path}:{f.line}: [{f.rule}] {f.message}")

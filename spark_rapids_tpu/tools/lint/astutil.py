@@ -74,6 +74,53 @@ def local_names(fn: FuncNode) -> Set[str]:
     return out
 
 
+def is_jit_decorated(fn: ast.AST) -> bool:
+    """True for ``@jax.jit`` / ``@jit`` / ``@functools.partial(jax.jit,
+    ...)`` decorated functions — the per-batch dispatch units."""
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return False
+    for dec in fn.decorator_list:
+        name = dotted_name(dec) or ""
+        if name.endswith("jax.jit") or name == "jit":
+            return True
+        if isinstance(dec, ast.Call):
+            cn = dotted_name(dec.func) or ""
+            if cn.endswith("jax.jit") or cn == "jit":
+                return True
+            if cn.endswith("partial") and dec.args:
+                inner = dotted_name(dec.args[0]) or ""
+                if inner.endswith("jax.jit") or inner == "jit":
+                    return True
+    return False
+
+
+def jit_static_params(fn) -> Set[str]:
+    """Parameter names a jit decorator marks static (static_argnums /
+    static_argnames) — host values, not traced."""
+    out: Set[str] = set()
+    pos = [a.arg for a in (list(fn.args.posonlyargs) + list(fn.args.args))]
+    for dec in fn.decorator_list:
+        if not isinstance(dec, ast.Call):
+            continue
+        for kw in dec.keywords:
+            if kw.arg == "static_argnums":
+                vals = kw.value.elts if isinstance(
+                    kw.value, (ast.Tuple, ast.List)) else [kw.value]
+                for v in vals:
+                    if isinstance(v, ast.Constant) and \
+                            isinstance(v.value, int) and \
+                            0 <= v.value < len(pos):
+                        out.add(pos[v.value])
+            elif kw.arg == "static_argnames":
+                vals = kw.value.elts if isinstance(
+                    kw.value, (ast.Tuple, ast.List)) else [kw.value]
+                for v in vals:
+                    if isinstance(v, ast.Constant) and \
+                            isinstance(v.value, str):
+                        out.add(v.value)
+    return out
+
+
 def enclosing_functions(tree: ast.Module) -> Iterator[FuncNode]:
     for node in ast.walk(tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,

@@ -1,0 +1,278 @@
+"""Per-function control-flow graphs over stdlib ``ast`` (tpulint v2).
+
+The dataflow rules (rules_hostsyncflow.py, rules_retrace.py) need
+flow-sensitive facts — "is this name still device-derived HERE", "which
+definition of this capture reaches the kernel" — that a plain
+``ast.walk`` cannot answer.  This module lowers one function body into a
+graph of basic blocks whose elements are either simple statements or
+small marker objects for the control points that bind/evaluate values:
+
+* :class:`Branch` — the test expression of an ``if``/``while`` (the body
+  lives in successor blocks);
+* :class:`LoopBind` — a ``for`` header: target bound from the iterable
+  once per entry edge;
+* :class:`WithBind` — ``with`` item expressions and their ``as`` names;
+* :class:`ExceptBind` — an except handler's ``as`` name.
+
+Precision is lint-grade by design: ``try`` bodies conservatively edge
+into every handler, ``finally`` runs on the fall-through path, and
+nested ``def``/``lambda`` bodies are opaque single statements (each
+function gets its own CFG).  That is exactly enough for the
+reaching-definitions and taint passes in dataflow.py to terminate on a
+finite lattice and stay honest about joins.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Union
+
+FuncNode = Union[ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda]
+
+__all__ = ["Block", "CFG", "Branch", "LoopBind", "WithBind", "ExceptBind",
+           "build_cfg"]
+
+
+class Branch:
+    """Evaluation of a branch condition (if/while test). The bodies are
+    in successor blocks; only ``test`` is evaluated in this element."""
+
+    __slots__ = ("test", "node")
+
+    def __init__(self, test: ast.expr, node: ast.stmt):
+        self.test = test
+        self.node = node
+
+
+class LoopBind:
+    """A ``for`` header: one evaluation of ``iter`` and a binding of
+    ``target`` per loop entry."""
+
+    __slots__ = ("target", "iter", "node")
+
+    def __init__(self, target: ast.expr, it: ast.expr, node: ast.stmt):
+        self.target = target
+        self.iter = it
+        self.node = node
+
+
+class WithBind:
+    """``with`` item expressions plus their optional ``as`` bindings."""
+
+    __slots__ = ("items", "node")
+
+    def __init__(self, items, node: ast.stmt):
+        self.items = items
+        self.node = node
+
+
+class ExceptBind:
+    """An except handler entry: binds the ``as`` name (opaque value)."""
+
+    __slots__ = ("name", "node")
+
+    def __init__(self, name: Optional[str], node: ast.AST):
+        self.name = name
+        self.node = node
+
+
+Element = Union[ast.stmt, Branch, LoopBind, WithBind, ExceptBind]
+
+
+class Block:
+    __slots__ = ("id", "elems", "succs", "preds")
+
+    def __init__(self, bid: int):
+        self.id = bid
+        self.elems: List[Element] = []
+        self.succs: List["Block"] = []
+        self.preds: List["Block"] = []
+
+    def __repr__(self):
+        return (f"<Block {self.id} elems={len(self.elems)} "
+                f"succs={[b.id for b in self.succs]}>")
+
+
+class CFG:
+    """Control-flow graph of one function. ``entry`` has no elements;
+    ``exit`` collects every return/raise/fall-through path."""
+
+    def __init__(self, fn: FuncNode):
+        self.fn = fn
+        self.blocks: List[Block] = []
+        self.entry = self.new_block()
+        self.exit = self.new_block()
+
+    def new_block(self) -> Block:
+        b = Block(len(self.blocks))
+        self.blocks.append(b)
+        return b
+
+    @staticmethod
+    def add_edge(src: Block, dst: Block) -> None:
+        if dst not in src.succs:
+            src.succs.append(dst)
+            dst.preds.append(src)
+
+
+class _Builder:
+    def __init__(self, fn: FuncNode):
+        self.cfg = CFG(fn)
+        #: (continue_target, break_target) per enclosing loop
+        self.loops: List[tuple] = []
+        #: handler-entry blocks of enclosing try statements: any block
+        #: built inside a try body conservatively edges into each
+        self.handlers: List[List[Block]] = []
+
+    def build(self) -> CFG:
+        fn = self.cfg.fn
+        body = fn.body if not isinstance(fn, ast.Lambda) else [
+            ast.Expr(value=fn.body)]
+        cur = self._stmts(body, self.cfg.entry)
+        if cur is not None:
+            CFG.add_edge(cur, self.cfg.exit)
+        return self.cfg
+
+    # ------------------------------------------------------------ helpers
+    def _emit(self, block: Block, elem: Element) -> None:
+        block.elems.append(elem)
+        # conservative exception edges: anything inside a try body may
+        # transfer to any of its handlers
+        for hs in self.handlers:
+            for h in hs:
+                CFG.add_edge(block, h)
+
+    def _stmts(self, stmts, cur: Optional[Block]) -> Optional[Block]:
+        for s in stmts:
+            if cur is None:       # dead code after return/raise/break
+                cur = self.cfg.new_block()
+            cur = self._stmt(s, cur)
+        return cur
+
+    # ---------------------------------------------------------- dispatch
+    def _stmt(self, s: ast.stmt, cur: Block) -> Optional[Block]:
+        c = self.cfg
+        if isinstance(s, ast.If):
+            self._emit(cur, Branch(s.test, s))
+            join = c.new_block()
+            then = c.new_block()
+            CFG.add_edge(cur, then)
+            end = self._stmts(s.body, then)
+            if end is not None:
+                CFG.add_edge(end, join)
+            if s.orelse:
+                els = c.new_block()
+                CFG.add_edge(cur, els)
+                end = self._stmts(s.orelse, els)
+                if end is not None:
+                    CFG.add_edge(end, join)
+            else:
+                CFG.add_edge(cur, join)
+            return join
+        if isinstance(s, (ast.While,)):
+            header = c.new_block()
+            CFG.add_edge(cur, header)
+            self._emit(header, Branch(s.test, s))
+            body = c.new_block()
+            after = c.new_block()
+            CFG.add_edge(header, body)
+            self.loops.append((header, after))
+            end = self._stmts(s.body, body)
+            self.loops.pop()
+            if end is not None:
+                CFG.add_edge(end, header)
+            if s.orelse:
+                els = c.new_block()
+                CFG.add_edge(header, els)
+                end = self._stmts(s.orelse, els)
+                if end is not None:
+                    CFG.add_edge(end, after)
+            else:
+                CFG.add_edge(header, after)
+            return after
+        if isinstance(s, (ast.For, ast.AsyncFor)):
+            header = c.new_block()
+            CFG.add_edge(cur, header)
+            self._emit(header, LoopBind(s.target, s.iter, s))
+            body = c.new_block()
+            after = c.new_block()
+            CFG.add_edge(header, body)
+            self.loops.append((header, after))
+            end = self._stmts(s.body, body)
+            self.loops.pop()
+            if end is not None:
+                CFG.add_edge(end, header)
+            if s.orelse:
+                els = c.new_block()
+                CFG.add_edge(header, els)
+                end = self._stmts(s.orelse, els)
+                if end is not None:
+                    CFG.add_edge(end, after)
+            else:
+                CFG.add_edge(header, after)
+            return after
+        if isinstance(s, (ast.With, ast.AsyncWith)):
+            self._emit(cur, WithBind(s.items, s))
+            return self._stmts(s.body, cur)
+        if isinstance(s, ast.Try):
+            hentries = [c.new_block() for _ in s.handlers]
+            self.handlers.append(hentries)
+            end = self._stmts(s.body, cur)
+            self.handlers.pop()
+            join = c.new_block()
+            if s.orelse:
+                if end is not None:
+                    end = self._stmts(s.orelse, end)
+            if end is not None:
+                CFG.add_edge(end, join)
+            for h, entry in zip(s.handlers, hentries):
+                self._emit(entry, ExceptBind(h.name, h))
+                hend = self._stmts(h.body, entry)
+                if hend is not None:
+                    CFG.add_edge(hend, join)
+            if s.finalbody:
+                return self._stmts(s.finalbody, join)
+            return join
+        if isinstance(s, ast.Match):
+            # each case: test the subject, bind capture names opaquely
+            self._emit(cur, Branch(s.subject, s))
+            join = c.new_block()
+            for case in s.cases:
+                cb = c.new_block()
+                CFG.add_edge(cur, cb)
+                for nm in _match_names(case.pattern):
+                    cb.elems.append(ExceptBind(nm, case))
+                end = self._stmts(case.body, cb)
+                if end is not None:
+                    CFG.add_edge(end, join)
+            CFG.add_edge(cur, join)      # no case may match
+            return join
+        if isinstance(s, (ast.Return, ast.Raise)):
+            self._emit(cur, s)
+            CFG.add_edge(cur, self.cfg.exit)
+            return None
+        if isinstance(s, ast.Break):
+            if self.loops:
+                CFG.add_edge(cur, self.loops[-1][1])
+            return None
+        if isinstance(s, ast.Continue):
+            if self.loops:
+                CFG.add_edge(cur, self.loops[-1][0])
+            return None
+        # simple statement (incl. nested def/class — opaque bindings)
+        self._emit(cur, s)
+        return cur
+
+
+def _match_names(pat) -> List[str]:
+    out = []
+    for node in ast.walk(pat):
+        name = getattr(node, "name", None)
+        if isinstance(name, str):
+            out.append(name)
+    return out
+
+
+def build_cfg(fn: FuncNode) -> CFG:
+    """Build the statement-level CFG of one function body (nested
+    functions are opaque; build their CFGs separately)."""
+    return _Builder(fn).build()

@@ -1,0 +1,572 @@
+"""Forward dataflow over the tpulint CFG (cfg.py).
+
+Two analyses, both lint-grade but honestly flow-sensitive:
+
+* :func:`ReachingDefs` — classic reaching definitions: which bindings of
+  a name can reach a program point.  Used by ``retrace-risk`` to
+  classify what a jitted kernel's closure actually captures.
+* :class:`TaintAnalysis` — a generic abstract-value/taint propagation
+  pass over a join-semilattice of label sets.  A :class:`TaintSpec`
+  names the sources and the attribute/call forms that launder taint
+  away; everything else propagates through assignments, tuple
+  unpacking, loops, conditionals and f-strings.  Used by
+  ``host-sync-flow`` with labels = {"@src"} (device-derived) and by the
+  call-summary machinery with labels = parameter indices.
+
+* :class:`Summaries` — memoized per-helper summaries for same-module
+  ``def``s: which parameters flow to the return value, and which sinks
+  inside the helper a parameter can reach.  This is what lets a rule
+  follow a device value through ``_helper(x)`` without inlining.
+
+Everything is a finite union lattice, transfers are monotone, so the
+worklist terminates.  Nested functions are opaque (analyze separately).
+"""
+from __future__ import annotations
+
+import ast
+from collections import deque
+from typing import Callable, Dict, FrozenSet, Iterable, Iterator, List, \
+    Optional, Tuple
+
+from .astutil import dotted_name
+from .cfg import (CFG, Block, Branch, ExceptBind, FuncNode, LoopBind,
+                  WithBind, build_cfg)
+
+__all__ = ["EMPTY", "TaintSpec", "TaintAnalysis", "ReachingDefs",
+           "Summaries", "FunctionSummary", "param_names", "element_exprs",
+           "scan_conditions"]
+
+EMPTY: FrozenSet = frozenset()
+
+Env = Dict[str, FrozenSet]
+
+
+def param_names(fn: FuncNode) -> List[str]:
+    a = fn.args
+    out = [p.arg for p in
+           list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)]
+    if a.vararg:
+        out.append(a.vararg.arg)
+    if a.kwarg:
+        out.append(a.kwarg.arg)
+    return out
+
+
+def element_exprs(elem) -> List[ast.expr]:
+    """The expressions evaluated by one CFG element (bodies of compound
+    statements live in other blocks and are NOT included)."""
+    if isinstance(elem, Branch):
+        return [elem.test]
+    if isinstance(elem, LoopBind):
+        return [elem.iter]
+    if isinstance(elem, WithBind):
+        return [it.context_expr for it in elem.items]
+    if isinstance(elem, ExceptBind):
+        return []
+    out = []
+    for child in ast.iter_child_nodes(elem):
+        if isinstance(child, ast.expr):
+            out.append(child)
+    return out
+
+
+def _join_env(dst: Env, src: Env) -> bool:
+    changed = False
+    for k, v in src.items():
+        old = dst.get(k, EMPTY)
+        new = old | v
+        if new != old:
+            dst[k] = new
+            changed = True
+    return changed
+
+
+# ---------------------------------------------------------------------------
+# taint propagation
+# ---------------------------------------------------------------------------
+
+class TaintSpec:
+    """Policy object for :class:`TaintAnalysis`.
+
+    ``untaint_attrs`` — attribute reads that are trace-static (reading
+    ``x.shape`` of a device array yields a host tuple); ``untaint_calls``
+    — call names whose result is always host/static.  ``source`` may
+    return a label set to mark an expression as a fresh source.
+    ``summaries`` (optional) routes same-module helper calls through
+    :class:`Summaries`.
+    """
+
+    untaint_attrs: FrozenSet[str] = frozenset(
+        {"shape", "ndim", "dtype", "size"})
+    untaint_calls: FrozenSet[str] = frozenset(
+        {"len", "isinstance", "type", "id", "range", "enumerate_len"})
+    summaries: Optional["Summaries"] = None
+
+    def source(self, expr: ast.expr,
+               ev: Callable[[ast.expr], FrozenSet]) -> Optional[FrozenSet]:
+        return None
+
+    def call_effect(self, call: ast.Call, fname: Optional[str],
+                    recv: FrozenSet, args: List[FrozenSet],
+                    kwargs: List[FrozenSet]) -> FrozenSet:
+        if self.summaries is not None and isinstance(call.func, ast.Name):
+            s = self.summaries.get(call.func.id)
+            if s is not None:
+                out = set()
+                for lbl in s.return_labels:
+                    if isinstance(lbl, int):
+                        if lbl < len(args):
+                            out |= args[lbl]
+                    else:
+                        out.add(lbl)
+                for kw in kwargs:
+                    out |= kw
+                return frozenset(out)
+        out = set(recv)
+        for a in args:
+            out |= a
+        for a in kwargs:
+            out |= a
+        return frozenset(out)
+
+
+class TaintAnalysis:
+    """Forward taint/abstract-value propagation over one function."""
+
+    def __init__(self, fn: FuncNode, spec: TaintSpec,
+                 seeds: Optional[Env] = None):
+        self.fn = fn
+        self.spec = spec
+        self.seeds: Env = dict(seeds or {})
+        self.cfg: CFG = build_cfg(fn)
+        self.block_in: Dict[int, Env] = {}
+        self._solve()
+
+    # ----------------------------------------------------------- solving
+    def _solve(self) -> None:
+        self.block_in = {b.id: {} for b in self.cfg.blocks}
+        self.block_in[self.cfg.entry.id] = dict(self.seeds)
+        work = deque(self.cfg.blocks)
+        while work:
+            b = work.popleft()
+            env = dict(self.block_in[b.id])
+            for elem in b.elems:
+                self.transfer(elem, env)
+            for succ in b.succs:
+                if _join_env(self.block_in[succ.id], env):
+                    if succ not in work:
+                        work.append(succ)
+
+    def walk(self) -> Iterator[Tuple[object, Env]]:
+        """Yield every (element, env-before-element) in deterministic
+        block order after the fixpoint — the replay rules build findings
+        from."""
+        for b in self.cfg.blocks:
+            env = dict(self.block_in[b.id])
+            for elem in b.elems:
+                yield elem, env
+                self.transfer(elem, env)
+
+    # ---------------------------------------------------------- transfer
+    def transfer(self, elem, env: Env) -> None:
+        if isinstance(elem, Branch):
+            self.eval(elem.test, env)               # walrus effects
+        elif isinstance(elem, LoopBind):
+            self._bind_iter(elem.target, elem.iter, env)
+        elif isinstance(elem, WithBind):
+            for it in elem.items:
+                v = self.eval(it.context_expr, env)
+                if it.optional_vars is not None:
+                    self._bind(it.optional_vars, v, env)
+        elif isinstance(elem, ExceptBind):
+            if elem.name:
+                env[elem.name] = EMPTY
+        elif isinstance(elem, ast.Assign):
+            v = self.eval(elem.value, env)
+            for t in elem.targets:
+                self._bind(t, v, env)
+        elif isinstance(elem, ast.AnnAssign):
+            if elem.value is not None:
+                self._bind(elem.target, self.eval(elem.value, env), env)
+        elif isinstance(elem, ast.AugAssign):
+            v = self.eval(elem.value, env)
+            if isinstance(elem.target, ast.Name):
+                env[elem.target.id] = env.get(elem.target.id, EMPTY) | v
+        elif isinstance(elem, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            env[elem.name] = EMPTY
+        elif isinstance(elem, (ast.Import, ast.ImportFrom)):
+            for alias in elem.names:
+                env[(alias.asname or alias.name).split(".")[0]] = EMPTY
+        elif isinstance(elem, ast.Delete):
+            for t in elem.targets:
+                if isinstance(t, ast.Name):
+                    env.pop(t.id, None)
+        else:
+            for e in element_exprs(elem):
+                self.eval(e, env)                   # walrus effects
+
+    def _bind_iter(self, target: ast.expr, it: ast.expr,
+                   env: Env) -> None:
+        """Loop-target binding with per-element precision for the
+        zip()/enumerate() idioms — ``for k, r in zip(device, host)``
+        must not smear device taint onto the host element."""
+        if isinstance(it, ast.Call) and \
+                isinstance(target, (ast.Tuple, ast.List)):
+            leaf = (dotted_name(it.func) or "").rsplit(".", 1)[-1]
+            if leaf == "zip" and len(target.elts) == len(it.args):
+                for t, a in zip(target.elts, it.args):
+                    self._bind(t, self.eval(a, env), env)
+                return
+            if leaf == "enumerate" and len(target.elts) == 2 and it.args:
+                self._bind(target.elts[0], EMPTY, env)
+                self._bind(target.elts[1], self.eval(it.args[0], env),
+                           env)
+                return
+        self._bind(target, self.eval(it, env), env)
+
+    def _bind(self, target: ast.expr, v: FrozenSet, env: Env) -> None:
+        if isinstance(target, ast.Name):
+            env[target.id] = v
+        elif isinstance(target, ast.Starred):
+            self._bind(target.value, v, env)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for t in target.elts:
+                self._bind(t, v, env)
+        # Attribute/Subscript stores: object fields are not tracked
+
+    # -------------------------------------------------------- evaluation
+    def eval(self, e: ast.expr, env: Env) -> FrozenSet:
+        """Abstract value (label set) of ``e`` under ``env``."""
+        src = self.spec.source(e, lambda x: self.eval(x, env))
+        if src is not None:
+            return src
+        if isinstance(e, ast.Name):
+            return env.get(e.id, EMPTY)
+        if isinstance(e, ast.Constant):
+            return EMPTY
+        if isinstance(e, ast.Attribute):
+            if e.attr in self.spec.untaint_attrs:
+                self.eval(e.value, env)
+                return EMPTY
+            return self.eval(e.value, env)
+        if isinstance(e, ast.Subscript):
+            v = self.eval(e.value, env)
+            self.eval(e.slice, env)
+            return v
+        if isinstance(e, ast.Call):
+            fname = dotted_name(e.func)
+            recv = EMPTY
+            if isinstance(e.func, ast.Attribute):
+                recv = self.eval(e.func.value, env)
+            args = [self.eval(a, env) for a in e.args]
+            kwargs = [self.eval(k.value, env) for k in e.keywords]
+            if fname is not None and (
+                    fname in self.spec.untaint_calls
+                    or fname.rsplit(".", 1)[-1] in self.spec.untaint_calls):
+                return EMPTY
+            return self.spec.call_effect(e, fname, recv, args, kwargs)
+        if isinstance(e, ast.BinOp):
+            return self.eval(e.left, env) | self.eval(e.right, env)
+        if isinstance(e, ast.UnaryOp):
+            return self.eval(e.operand, env)
+        if isinstance(e, ast.BoolOp):
+            out = EMPTY
+            for v in e.values:
+                out |= self.eval(v, env)
+            return out
+        if isinstance(e, ast.Compare):
+            operands = self.eval(e.left, env)
+            for c in e.comparators:
+                operands |= self.eval(c, env)
+            # identity tests yield host bools, never device values
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in e.ops):
+                return EMPTY
+            # comparisons against string constants are host metadata
+            # dispatch (kind == "int", dt.name == "float") — a device
+            # array never equals a str
+            if any(isinstance(x, ast.Constant) and isinstance(x.value, str)
+                   for x in [e.left] + list(e.comparators)):
+                return EMPTY
+            return operands
+        if isinstance(e, ast.IfExp):
+            self.eval(e.test, env)
+            return self.eval(e.body, env) | self.eval(e.orelse, env)
+        if isinstance(e, (ast.Tuple, ast.List, ast.Set)):
+            out = EMPTY
+            for el in e.elts:
+                out |= self.eval(el, env)
+            return out
+        if isinstance(e, ast.Dict):
+            out = EMPTY
+            for k in e.keys:
+                if k is not None:
+                    out |= self.eval(k, env)
+            for v in e.values:
+                out |= self.eval(v, env)
+            return out
+        if isinstance(e, ast.Starred):
+            return self.eval(e.value, env)
+        if isinstance(e, (ast.JoinedStr, ast.FormattedValue)):
+            # formatting yields a host string; the FORCE of the format
+            # is the sink, which the rules flag separately
+            for child in ast.iter_child_nodes(e):
+                if isinstance(child, ast.expr):
+                    self.eval(child, env)
+            return EMPTY
+        if isinstance(e, ast.NamedExpr):
+            v = self.eval(e.value, env)
+            self._bind(e.target, v, env)
+            return v
+        if isinstance(e, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                          ast.DictComp)):
+            env2 = dict(env)
+            for gen in e.generators:
+                self._bind_iter(gen.target, gen.iter, env2)
+                for c in gen.ifs:
+                    self.eval(c, env2)
+            if isinstance(e, ast.DictComp):
+                return self.eval(e.key, env2) | self.eval(e.value, env2)
+            return self.eval(e.elt, env2)
+        if isinstance(e, ast.Lambda):
+            return EMPTY
+        if isinstance(e, (ast.Await, ast.YieldFrom)):
+            return self.eval(e.value, env)
+        if isinstance(e, ast.Yield):
+            return self.eval(e.value, env) if e.value else EMPTY
+        if isinstance(e, ast.Slice):
+            for part in (e.lower, e.upper, e.step):
+                if part is not None:
+                    self.eval(part, env)
+            return EMPTY
+        out = EMPTY
+        for child in ast.iter_child_nodes(e):
+            if isinstance(child, ast.expr):
+                out |= self.eval(child, env)
+        return out
+
+    # ----------------------------------------------------- scoped scans
+    def scan_expr(self, expr: ast.expr, env: Env,
+                  visit: Callable[[ast.expr, Env], None]) -> None:
+        """Visit every subexpression of ``expr`` with the env that holds
+        there (comprehension targets are bound from their iterables;
+        lambda bodies are opaque)."""
+        visit(expr, env)
+        if isinstance(expr, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                             ast.DictComp)):
+            env2 = dict(env)
+            for gen in expr.generators:
+                self.scan_expr(gen.iter, env2, visit)
+                self._bind_iter(gen.target, gen.iter, env2)
+                for c in gen.ifs:
+                    self.scan_expr(c, env2, visit)
+            if isinstance(expr, ast.DictComp):
+                self.scan_expr(expr.key, env2, visit)
+                self.scan_expr(expr.value, env2, visit)
+            else:
+                self.scan_expr(expr.elt, env2, visit)
+            return
+        if isinstance(expr, ast.Lambda):
+            return
+        for child in ast.iter_child_nodes(expr):
+            if isinstance(child, ast.expr):
+                self.scan_expr(child, env, visit)
+            elif isinstance(child, ast.keyword):
+                self.scan_expr(child.value, env, visit)
+
+
+def scan_conditions(analysis: TaintAnalysis,
+                    on_cond: Callable[[ast.expr, Env], None]) -> None:
+    """Invoke ``on_cond(expr, env)`` for every truthiness-evaluated
+    expression in the analyzed function: ``if``/``while``/``assert``
+    tests, ``and``/``or``/``not`` operands, conditional-expression and
+    comprehension conditions.  Compound boolean operators recurse to
+    their leaves (each leaf is what actually gets ``bool()``'d)."""
+
+    def leaf(e: ast.expr, env: Env) -> None:
+        if isinstance(e, (ast.BoolOp, ast.UnaryOp)):
+            return              # its own operands are visited below
+        on_cond(e, env)
+
+    def visit(node: ast.expr, env: Env) -> None:
+        if isinstance(node, ast.BoolOp):
+            for v in node.values:
+                leaf(v, env)
+        elif isinstance(node, ast.UnaryOp) and \
+                isinstance(node.op, ast.Not):
+            leaf(node.operand, env)
+        elif isinstance(node, ast.IfExp):
+            leaf(node.test, env)
+        elif isinstance(node, (ast.ListComp, ast.SetComp,
+                               ast.GeneratorExp, ast.DictComp)):
+            env2 = dict(env)
+            for gen in node.generators:
+                analysis._bind_iter(gen.target, gen.iter, env2)
+                for c in gen.ifs:
+                    leaf(c, env2)
+
+    for elem, env in analysis.walk():
+        if isinstance(elem, Branch):
+            leaf(elem.test, env)
+        elif isinstance(elem, ast.Assert):
+            leaf(elem.test, env)
+        for e in element_exprs(elem):
+            analysis.scan_expr(e, env, visit)
+
+
+# ---------------------------------------------------------------------------
+# reaching definitions
+# ---------------------------------------------------------------------------
+
+def _binding_names(elem) -> List[str]:
+    """Names (re)bound by one CFG element."""
+    out: List[str] = []
+
+    def targets(t):
+        if isinstance(t, ast.Name):
+            out.append(t.id)
+        elif isinstance(t, ast.Starred):
+            targets(t.value)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for el in t.elts:
+                targets(el)
+
+    if isinstance(elem, Branch):
+        pass
+    elif isinstance(elem, LoopBind):
+        targets(elem.target)
+    elif isinstance(elem, WithBind):
+        for it in elem.items:
+            if it.optional_vars is not None:
+                targets(it.optional_vars)
+    elif isinstance(elem, ExceptBind):
+        if elem.name:
+            out.append(elem.name)
+    elif isinstance(elem, ast.Assign):
+        for t in elem.targets:
+            targets(t)
+    elif isinstance(elem, ast.AnnAssign):
+        if elem.value is not None:
+            targets(elem.target)
+    elif isinstance(elem, ast.AugAssign):
+        targets(elem.target)
+    elif isinstance(elem, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+        out.append(elem.name)
+    elif isinstance(elem, (ast.Import, ast.ImportFrom)):
+        for alias in elem.names:
+            out.append((alias.asname or alias.name).split(".")[0])
+    for e in element_exprs(elem):
+        for node in ast.walk(e):
+            if isinstance(node, ast.NamedExpr):
+                targets(node.target)
+    return out
+
+
+class ReachingDefs:
+    """Reaching definitions over one function. A definition site is
+    either the CFG element that bound the name or the string "param"."""
+
+    def __init__(self, fn: FuncNode):
+        self.fn = fn
+        self.cfg = build_cfg(fn)
+        self._at: Dict[int, Dict[str, frozenset]] = {}
+        block_in: Dict[int, Dict[str, frozenset]] = {
+            b.id: {} for b in self.cfg.blocks}
+        block_in[self.cfg.entry.id] = {
+            p: frozenset(["param"]) for p in param_names(fn)}
+        work = deque(self.cfg.blocks)
+        while work:
+            b = work.popleft()
+            env = dict(block_in[b.id])
+            for elem in b.elems:
+                for name in _binding_names(elem):
+                    env[name] = frozenset([elem])     # kill + gen
+            for succ in b.succs:
+                if _join_env(block_in[succ.id], env):
+                    if succ not in work:
+                        work.append(succ)
+        for b in self.cfg.blocks:
+            env = dict(block_in[b.id])
+            for elem in b.elems:
+                self._at[id(elem)] = dict(env)
+                for name in _binding_names(elem):
+                    env[name] = frozenset([elem])
+
+    def defs_at(self, elem, name: str) -> frozenset:
+        """Definition sites of ``name`` that reach ``elem`` (a CFG
+        element of this function). Empty when unknown/free."""
+        return self._at.get(id(elem), {}).get(name, EMPTY)
+
+    def all_defs(self, name: str) -> List[object]:
+        """Every binding element of ``name`` anywhere in the function
+        (fallback when the program point is not a CFG element)."""
+        out = []
+        for b in self.cfg.blocks:
+            for elem in b.elems:
+                if name in _binding_names(elem):
+                    out.append(elem)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# same-module call summaries
+# ---------------------------------------------------------------------------
+
+class FunctionSummary:
+    """What a helper does with its parameters: ``return_labels`` is a
+    set of parameter indices (ints) and pass-through labels (e.g.
+    "@src" for sources originating inside the helper) that flow to its
+    return value; ``sinks`` is a list of (labels, description, lineno)
+    for sink expressions inside the helper reachable from parameters."""
+
+    __slots__ = ("return_labels", "sinks")
+
+    def __init__(self, return_labels: FrozenSet, sinks: List[Tuple]):
+        self.return_labels = return_labels
+        self.sinks = sinks
+
+
+class Summaries:
+    """Memoized taint summaries for the module-level ``def``s of one
+    file. ``make_spec(summaries)`` builds the TaintSpec used inside
+    helpers (so helper-of-helper calls resolve through us, cycles
+    degrade to all-params-flow-through)."""
+
+    def __init__(self, tree: ast.Module,
+                 make_spec: Callable[["Summaries"], TaintSpec],
+                 sink_scan: Optional[Callable[[TaintAnalysis],
+                                              List[Tuple]]] = None):
+        self.funcs: Dict[str, ast.AST] = {}
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.funcs[node.name] = node
+        self._make_spec = make_spec
+        self._sink_scan = sink_scan
+        self._memo: Dict[str, FunctionSummary] = {}
+        self._stack: set = set()
+
+    def get(self, name: str) -> Optional[FunctionSummary]:
+        fn = self.funcs.get(name)
+        if fn is None:
+            return None
+        if name in self._memo:
+            return self._memo[name]
+        if name in self._stack:      # recursion: conservative summary
+            return FunctionSummary(
+                frozenset(range(len(param_names(fn)))), [])
+        self._stack.add(name)
+        try:
+            params = param_names(fn)
+            seeds = {p: frozenset([i]) for i, p in enumerate(params)}
+            analysis = TaintAnalysis(fn, self._make_spec(self), seeds)
+            ret = set()
+            for elem, env in analysis.walk():
+                if isinstance(elem, ast.Return) and elem.value is not None:
+                    ret |= analysis.eval(elem.value, env)
+            sinks = self._sink_scan(analysis) if self._sink_scan else []
+            summ = FunctionSummary(frozenset(ret), sinks)
+            self._memo[name] = summ
+            return summ
+        finally:
+            self._stack.discard(name)

@@ -1,0 +1,116 @@
+"""Machine-readable tpulint output: ``--format=json`` / ``--format=sarif``.
+
+Both renderings are **byte-deterministic** for a given tree: findings
+are sorted on (path, line, rule, key), JSON is dumped with sorted keys
+and a fixed separator style, and nothing time- or host-dependent is
+embedded (paths are repo-relative).  CI can therefore diff two runs
+textually, cache on content hashes, and render SARIF results as inline
+PR annotations.
+
+JSON schema (documented contract, stable across versions unless the
+``version`` field moves):
+
+.. code-block:: json
+
+    {"version": 1,
+     "counts": {"new": 0, "baselined": 0, "suppressed": 0},
+     "findings": [{"rule": "...", "path": "rel/path.py", "line": 1,
+                   "col": 0, "message": "...", "key": "...",
+                   "fingerprint": "rule::path::key",
+                   "status": "new|baselined|suppressed"}]}
+
+SARIF output targets the 2.1.0 minimal schema: ``version``, one run
+with ``tool.driver`` (name + rules catalog) and one ``results`` entry
+per finding.  New findings have no ``suppressions``; baselined and
+inline-suppressed findings carry a ``suppressions`` entry so SARIF
+viewers show them muted instead of dropping them silently.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Sequence
+
+from .framework import Finding, LintResult, Rule
+
+__all__ = ["render_json", "render_sarif", "FORMATS"]
+
+FORMATS = ("human", "json", "sarif")
+
+_SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                 "master/Schemata/sarif-schema-2.1.0.json")
+
+
+def _ordered(result: LintResult) -> List:
+    rows = [(f, "new") for f in result.new] \
+        + [(f, "baselined") for f in result.baselined] \
+        + [(f, "suppressed") for f in result.suppressed]
+    rows.sort(key=lambda r: (r[0].path, r[0].line, r[0].rule,
+                             str(r[0].key), r[1]))
+    return rows
+
+
+def render_json(result: LintResult) -> str:
+    findings = []
+    for f, status in _ordered(result):
+        findings.append({
+            "rule": f.rule, "path": f.path.replace("\\", "/"),
+            "line": f.line, "col": f.col, "message": f.message,
+            "key": str(f.key), "fingerprint": f.fingerprint(),
+            "status": status,
+        })
+    doc = {"version": 1,
+           "counts": {"new": len(result.new),
+                      "baselined": len(result.baselined),
+                      "suppressed": len(result.suppressed)},
+           "findings": findings}
+    return json.dumps(doc, indent=1, sort_keys=True,
+                      separators=(",", ": ")) + "\n"
+
+
+def render_sarif(result: LintResult, rules: Sequence[Rule]) -> str:
+    rule_ids = sorted({r.name for r in rules}
+                      | {f.rule for f, _ in _ordered(result)})
+    contracts: Dict[str, str] = {r.name: r.contract for r in rules}
+    sarif_rules = [{"id": rid,
+                    "shortDescription": {
+                        "text": contracts.get(rid, rid)}}
+                   for rid in rule_ids]
+    index = {rid: i for i, rid in enumerate(rule_ids)}
+    results = []
+    for f, status in _ordered(result):
+        res = {
+            "ruleId": f.rule,
+            "ruleIndex": index[f.rule],
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f.path.replace("\\", "/"),
+                        "uriBaseId": "SRCROOT"},
+                    "region": {"startLine": max(1, f.line)},
+                }}],
+            "partialFingerprints": {"tpulint/v1": f.fingerprint()},
+        }
+        if status == "baselined":
+            res["suppressions"] = [{"kind": "external",
+                                    "justification": "baseline.json"}]
+        elif status == "suppressed":
+            res["suppressions"] = [{"kind": "inSource"}]
+        results.append(res)
+    doc = {
+        "$schema": _SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "tpulint",
+                "informationUri":
+                    "docs/static_analysis.md",
+                "rules": sarif_rules,
+            }},
+            "columnKind": "utf16CodeUnits",
+            "results": results,
+        }],
+    }
+    return json.dumps(doc, indent=1, sort_keys=True,
+                      separators=(",", ": ")) + "\n"

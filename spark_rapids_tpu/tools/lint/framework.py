@@ -20,7 +20,8 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 __all__ = ["Finding", "Rule", "FileRule", "ProjectRule", "FileContext",
            "LintResult", "lint_source", "run_lint", "load_baseline",
-           "write_baseline", "default_baseline_path", "iter_python_files"]
+           "write_baseline", "prune_baseline", "default_baseline_path",
+           "iter_python_files", "changed_python_files"]
 
 #: ``# tpulint: disable=rule-a,rule-b`` — suppresses on its own line (the
 #: next code line) or at end of a code line (that line)
@@ -163,12 +164,9 @@ def load_baseline(path: Optional[str] = None) -> Dict[str, int]:
         data = json.load(f)
     return {str(k): int(v) for k, v in data.get("findings", {}).items()}
 
-def write_baseline(findings: Sequence[Finding],
-                   path: Optional[str] = None) -> str:
-    path = path or default_baseline_path()
-    counts: Dict[str, int] = {}
-    for f in findings:
-        counts[f.fingerprint()] = counts.get(f.fingerprint(), 0) + 1
+def _dump_baseline(counts: Dict[str, int], path: str) -> str:
+    """The one serializer for baseline.json (write + prune share it so
+    the format can never diverge between the two)."""
     with open(path, "w") as fh:
         json.dump({"comment": "tpulint grandfathered findings; regenerate "
                               "with python -m spark_rapids_tpu.tools.lint "
@@ -176,6 +174,71 @@ def write_baseline(findings: Sequence[Finding],
                    "findings": dict(sorted(counts.items()))}, fh, indent=1)
         fh.write("\n")
     return path
+
+
+def write_baseline(findings: Sequence[Finding],
+                   path: Optional[str] = None) -> str:
+    path = path or default_baseline_path()
+    counts: Dict[str, int] = {}
+    for f in findings:
+        counts[f.fingerprint()] = counts.get(f.fingerprint(), 0) + 1
+    return _dump_baseline(counts, path)
+
+
+def prune_baseline(findings: Sequence[Finding],
+                   path: Optional[str] = None) -> Tuple[int, int]:
+    """Drop baseline entries the tree no longer produces (file deleted,
+    finding fixed, rule retired). ``findings`` is the current full
+    no-baseline finding set; each fingerprint keeps at most its current
+    occurrence count. Returns (kept, pruned) entry-count totals (an
+    entry with count N that shrinks to M<N counts as pruned)."""
+    path = path or default_baseline_path()
+    old = load_baseline(path)
+    current: Dict[str, int] = {}
+    for f in findings:
+        current[f.fingerprint()] = current.get(f.fingerprint(), 0) + 1
+    kept: Dict[str, int] = {}
+    kept_n = pruned_n = 0
+    for fp, n in old.items():
+        keep = min(n, current.get(fp, 0))
+        kept_n += keep
+        pruned_n += n - keep
+        if keep > 0:
+            kept[fp] = keep
+    _dump_baseline(kept, path)
+    return kept_n, pruned_n
+
+
+def changed_python_files(base: str, root: str) -> Optional[List[str]]:
+    """Python files changed vs ``base`` per ``git diff --name-only``
+    (plus untracked ones), absolute paths. None when git is unavailable
+    or errors — callers fall back to the full tree."""
+    import subprocess
+    try:
+        # --relative: names come back relative to cwd (=root), not the
+        # git toplevel — a repo vendored as a subdirectory would
+        # otherwise join-and-miss every file and "lint" nothing
+        out = subprocess.run(
+            ["git", "diff", "--name-only", "--relative", base, "--"],
+            cwd=root, capture_output=True, text=True, timeout=30)
+        if out.returncode != 0:
+            return None
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=root, capture_output=True, text=True, timeout=30)
+        names = out.stdout.splitlines()
+        if untracked.returncode == 0:
+            names += untracked.stdout.splitlines()
+    except Exception:
+        return None
+    files = []
+    for name in sorted(set(names)):
+        if not name.endswith(".py"):
+            continue
+        p = os.path.join(root, name)
+        if os.path.isfile(p):
+            files.append(os.path.abspath(p))
+    return files
 
 
 def _apply_baseline(result: LintResult, baseline: Dict[str, int]):

@@ -15,13 +15,20 @@ or carry an inline suppression with their justification.
 
 Scopes checked: functions named ``eval_device``, and functions decorated
 with ``jax.jit`` / ``functools.partial(jax.jit, ...)``.
+
+The scalar-conversion heuristic this rule used to carry (``float()`` of
+a name that merely LOOKED device-ish) is retired: the ``host-sync-flow``
+rule (rules_hostsyncflow.py) now tracks actual value flow from device
+sources into ``float()``/``int()``/``bool()``, truthiness tests and
+f-strings with the dataflow engine.  This rule keeps only the direct
+sync calls, which need no flow analysis.
 """
 from __future__ import annotations
 
 import ast
 from typing import Iterable, List, Optional
 
-from .astutil import FuncNode, call_name, dotted_name, walk_scope
+from .astutil import FuncNode, call_name, is_jit_decorated
 from .framework import FileContext, FileRule, Finding
 
 #: call names that ARE a host sync on a device value, no argument
@@ -31,39 +38,6 @@ _SYNC_CALLS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
                "onp.asarray", "onp.array"}
 #: method names that force a sync on any jax array receiver
 _SYNC_METHODS = {"item", "block_until_ready", "tolist", "to_py"}
-#: names whose conversion to a python scalar inside a traced scope is a
-#: sync (int()/float() on anything derived from these)
-_DEVICE_HINTS = {"ctx", "data", "validity", "num_rows", "lengths", "bytes_"}
-
-
-def _is_jit_decorated(fn: ast.AST) -> bool:
-    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
-        return False
-    for dec in fn.decorator_list:
-        name = dotted_name(dec) or ""
-        if name.endswith("jax.jit") or name == "jit":
-            return True
-        if isinstance(dec, ast.Call):
-            cn = call_name(dec) or ""
-            if cn.endswith("jax.jit") or cn == "jit":
-                return True
-            if cn.endswith("partial") and dec.args:
-                inner = dotted_name(dec.args[0]) or ""
-                if inner.endswith("jax.jit") or inner == "jit":
-                    return True
-    return False
-
-
-def _mentions_device_value(expr: ast.AST) -> bool:
-    """Heuristic: the expression dereferences something that is a traced
-    device value in these scopes (ctx.*, .data/.validity attributes,
-    DVal fields)."""
-    for node in ast.walk(expr):
-        if isinstance(node, ast.Attribute) and node.attr in _DEVICE_HINTS:
-            return True
-        if isinstance(node, ast.Name) and node.id in _DEVICE_HINTS:
-            return True
-    return False
 
 
 class HostSyncRule(FileRule):
@@ -79,7 +53,7 @@ class HostSyncRule(FileRule):
                 continue
             if node.name == "eval_device":
                 findings.extend(self._check_scope(ctx, node, "eval_device"))
-            elif _is_jit_decorated(node):
+            elif is_jit_decorated(node):
                 findings.extend(self._check_scope(
                     ctx, node, f"jit kernel {node.name}"))
         return findings
@@ -109,8 +83,4 @@ class HostSyncRule(FileRule):
                     and not node.args:
                 emit(node, f".{node.func.attr}()",
                      f"method:{node.func.attr}")
-            elif name in ("float", "int", "bool") and node.args \
-                    and _mentions_device_value(node.args[0]):
-                emit(node, f"{name}() of device data",
-                     f"scalar:{name}")
         return out

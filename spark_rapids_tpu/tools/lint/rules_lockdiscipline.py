@@ -1,0 +1,695 @@
+"""lock-discipline: guarded shared state is only touched under its lock.
+
+Contract (ROADMAP item 5 — multi-tenant serving hammers every
+process-global registry/cache from N sessions at once): the 20+
+``threading.Lock``-holding modules each pair some mutable state with a
+lock, but nothing enforced the pairing.  This rule adds a guarded-field
+registry:
+
+* **declared**: ``# tpulint: guarded-by <lockattr>`` on an assignment —
+  ``self._peers = {}  # tpulint: guarded-by _lock`` in ``__init__`` for
+  instance fields, on a class-body assignment for class fields (the
+  lock attr then names a class-level lock), or on a module-level
+  assignment for module globals (the lock attr names a module-level
+  lock).  The annotation may also sit on its own comment line directly
+  above the assignment.
+* **auto-seeded**: an unannotated field initialized in ``__init__`` (or
+  a module global) whose every non-``__init__`` access today happens
+  inside ``with <owner>.<lock>:`` is registered implicitly — the
+  current discipline becomes the enforced contract without a single
+  annotation.
+
+Checks, all receiver-aware (``m.value`` needs ``with m._lock:``, not
+someone else's lock):
+
+* reads/writes of a guarded field outside the declaring lock;
+* double-acquire of a non-reentrant ``threading.Lock`` (self-deadlock);
+* inconsistent lock-acquisition-order pairs across the whole tree
+  (A-then-B somewhere, B-then-A elsewhere — the classic deadlock seed).
+
+Same-module private helpers (``_name``) called *only* from lock-held
+regions inherit the lock (call-summary support — the ``_evict`` idiom
+in shuffle/heartbeat.py); a private helper whose name escapes as a
+value (``Thread(target=_helper)``) inherits nothing.  ``__init__`` /
+``__new__`` bodies and import-time module code are exempt
+(single-threaded by construction).  Intentionally lock-free fast paths
+carry a ``# tpulint: disable=lock-discipline`` suppression with a
+justification (docs/static_analysis.md).
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .astutil import dotted_name, local_names
+from .framework import FileContext, Finding, ProjectRule
+
+__all__ = ["LockDisciplineRule"]
+
+_GUARDED_RE = re.compile(r"#\s*tpulint:\s*guarded-by\s+([\w.]+)")
+
+#: threading constructors that create a lock-like object, with
+#: reentrancy (RLock may be re-acquired by its holder; Lock may not)
+_LOCK_CTORS = {"Lock": "lock", "RLock": "rlock", "Condition": "rlock"}
+
+_FUNC = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _lock_kind(value: ast.expr) -> Optional[str]:
+    """'lock' / 'rlock' when ``value`` constructs a threading lock."""
+    if isinstance(value, ast.Call):
+        name = dotted_name(value.func) or ""
+        leaf = name.rsplit(".", 1)[-1]
+        if leaf in _LOCK_CTORS:
+            return _LOCK_CTORS[leaf]
+    return None
+
+
+def _walk_pruned(expr: ast.expr):
+    """Walk an expression tree without descending into lambda bodies
+    (they execute later, under whatever locks their caller holds)."""
+    stack = [expr]
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, ast.Lambda):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _self_attr(node: ast.expr) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and \
+            node.value.id in ("self", "cls"):
+        return node.attr
+    return None
+
+
+class _Guard:
+    """One guarded field: owner is a class name or None (module)."""
+
+    __slots__ = ("owner", "field", "lock", "declared", "line")
+
+    def __init__(self, owner: Optional[str], field: str, lock: str,
+                 declared: bool, line: int):
+        self.owner = owner
+        self.field = field
+        self.lock = lock
+        self.declared = declared      # vs auto-seeded
+        self.line = line
+
+
+class _Access:
+    __slots__ = ("node", "recv", "name", "func", "store", "line")
+
+    def __init__(self, node, recv: str, name: str, func: str,
+                 store: bool, line: int):
+        self.node = node
+        self.recv = recv              # "self"/"cls"/other name/"" (global)
+        self.name = name
+        self.func = func              # function key
+        self.store = store
+        self.line = line
+
+
+class _FileLocks:
+    """Per-file lock/guard model: locks, annotations, accesses,
+    acquisitions, call graph for held-at-entry summaries."""
+
+    def __init__(self, ctx: FileContext):
+        self.ctx = ctx
+        self.module_locks: Dict[str, str] = {}          # name -> kind
+        #: class -> {lockattr: kind} (instance + class-level locks)
+        self.class_locks: Dict[str, Dict[str, str]] = {}
+        self.annotations: Dict[int, str] = {}           # line -> lockattr
+        self.guards: Dict[Tuple[Optional[str], str], _Guard] = {}
+        self.bad_annotations: List[Finding] = []
+        #: per function key: [(token, lexical_held, line, class_name)]
+        self.acquires: Dict[str, List[Tuple]] = {}
+        #: accesses of candidate guarded names, with lexical held sets
+        self.accesses: List[Tuple[_Access, frozenset]] = []
+        #: function key -> class name (or None)
+        self.func_class: Dict[str, Optional[str]] = {}
+        #: function key -> locally bound names (module-name accesses to
+        #: a shadowing local are not global accesses)
+        self.func_locals: Dict[str, Set[str]] = {}
+        #: callee key -> [(caller key, lexical held, is_method_call)]
+        self.call_sites: Dict[str, List[Tuple[str, frozenset, bool]]] = {}
+        #: private functions whose name escapes as a value
+        self.escaped: Set[str] = set()
+        #: module-level (import-time) assigned names
+        self.module_names: Dict[str, int] = {}
+        self._scan_annotations()
+        self._scan_module()
+        self._walk_functions()
+
+    # ------------------------------------------------------- annotations
+    def _scan_annotations(self) -> None:
+        lines = self.ctx.lines
+        for i, text in enumerate(lines, start=1):
+            m = _GUARDED_RE.search(text)
+            if not m:
+                continue
+            if text.lstrip().startswith("#"):
+                j = i + 1
+                while j <= len(lines) and (
+                        not lines[j - 1].strip()
+                        or lines[j - 1].lstrip().startswith("#")):
+                    j += 1
+                self.annotations[j] = m.group(1)
+            else:
+                self.annotations[i] = m.group(1)
+
+    def _annotation_for(self, node: ast.stmt) -> Optional[str]:
+        for line in range(node.lineno, (node.end_lineno or node.lineno) + 1):
+            if line in self.annotations:
+                return self.annotations[line]
+        return None
+
+    # ---------------------------------------------------- module & class
+    def _scan_module(self) -> None:
+        tree = self.ctx.tree
+        for node in tree.body:
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = [t for t in node.targets
+                           if isinstance(t, ast.Name)]
+            elif isinstance(node, ast.AnnAssign) and \
+                    isinstance(node.target, ast.Name) and node.value:
+                targets = [node.target]
+            if targets:
+                kind = _lock_kind(node.value)
+                for t in targets:
+                    if kind:
+                        self.module_locks[t.id] = kind
+                    else:
+                        self.module_names[t.id] = node.lineno
+                ann = self._annotation_for(node)
+                if ann and not kind:
+                    for t in targets:
+                        self._declare(None, t.id, ann, node.lineno)
+            elif isinstance(node, ast.ClassDef):
+                self._scan_class(node)
+
+    def _scan_class(self, cls: ast.ClassDef) -> None:
+        locks = self.class_locks.setdefault(cls.name, {})
+        # class-level lock attrs + annotated class fields
+        for node in cls.body:
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = [t for t in node.targets
+                           if isinstance(t, ast.Name)]
+            elif isinstance(node, ast.AnnAssign) and \
+                    isinstance(node.target, ast.Name) and node.value:
+                targets = [node.target]
+            if not targets:
+                continue
+            kind = _lock_kind(node.value)
+            if kind:
+                for t in targets:
+                    locks[t.id] = kind
+                continue
+            ann = self._annotation_for(node)
+            if ann:
+                for t in targets:
+                    self._declare(cls.name, t.id, ann, node.lineno)
+        # __init__: instance locks + annotated instance fields
+        for node in cls.body:
+            if isinstance(node, _FUNC) and node.name == "__init__":
+                for stmt in ast.walk(node):
+                    if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                        continue
+                    tgts = stmt.targets if isinstance(stmt, ast.Assign) \
+                        else [stmt.target]
+                    value = stmt.value
+                    if value is None:
+                        continue
+                    for t in tgts:
+                        attr = _self_attr(t)
+                        if attr is None:
+                            continue
+                        kind = _lock_kind(value)
+                        if kind:
+                            locks[attr] = kind
+                        else:
+                            ann = self._annotation_for(stmt)
+                            if ann:
+                                self._declare(cls.name, attr, ann,
+                                              stmt.lineno)
+
+    def _declare(self, owner: Optional[str], field: str, lock: str,
+                 line: int) -> None:
+        self.guards[(owner, field)] = _Guard(owner, field, lock, True, line)
+
+    # ----------------------------------------------------- function walk
+    def _walk_functions(self) -> None:
+        """Record accesses/acquires/call sites per function scope with
+        lexical held-lock tokens. A token is (receiver_text, lockname);
+        module locks use receiver ''. Nested defs/lambdas are separate
+        scopes holding nothing lexically."""
+        tree = self.ctx.tree
+
+        def scope_key(stack: List[str]) -> str:
+            return ".".join(stack)
+
+        def visit_scope(fn, stack: List[str], cls: Optional[str]):
+            key = scope_key(stack)
+            self.func_class[key] = cls
+            self.func_locals[key] = local_names(fn)
+            body = fn.body if not isinstance(fn, ast.Lambda) else [fn.body]
+            self._walk(body if isinstance(body, list) else [body],
+                       frozenset(), key, cls, stack)
+
+        def top(node, stack: List[str], cls: Optional[str]):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    top(child, stack + [child.name], child.name)
+                elif isinstance(child, _FUNC):
+                    visit_scope(child, stack + [child.name], cls)
+
+        top(tree, [], None)
+
+    def _lock_token(self, expr: ast.expr) -> Optional[Tuple[str, str]]:
+        """(receiver_text, name) when ``expr`` looks like a lock (a
+        known module lock name, or any dotted ``recv.attr``)."""
+        if isinstance(expr, ast.Name):
+            if expr.id in self.module_locks:
+                return ("", expr.id)
+            return None
+        if isinstance(expr, ast.Attribute):
+            recv = dotted_name(expr.value)
+            if recv is not None:
+                return (recv, expr.attr)
+        return None
+
+    def _walk(self, stmts, held: frozenset, func: str,
+              cls: Optional[str], stack: List[str]) -> None:
+        for node in stmts:
+            self._walk_node(node, held, func, cls, stack)
+
+    def _walk_node(self, node, held: frozenset, func: str,
+                   cls: Optional[str], stack: List[str]) -> None:
+        if isinstance(node, _FUNC) or isinstance(node, ast.Lambda):
+            # nested scope: runs later, holds nothing lexically
+            key = ".".join(stack + [getattr(node, "name", "<lambda>")])
+            self.func_class[key] = cls
+            self.func_locals[key] = local_names(node)
+            body = node.body if not isinstance(node, ast.Lambda) \
+                else [node.body]
+            self._walk(body if isinstance(body, list) else [body],
+                       frozenset(), key, cls,
+                       stack + [getattr(node, "name", "<lambda>")])
+            return
+        if isinstance(node, ast.ClassDef):
+            return                      # runtime class defs: out of scope
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            new = set(held)
+            for it in node.items:
+                # the item expression itself evaluates BEFORE this
+                # item's lock is held (guarded reads / helper calls in
+                # `with self._compute(x):` must not be invisible)
+                self._scan_expr(it.context_expr, frozenset(new),
+                                func, cls)
+                tok = self._lock_token(it.context_expr)
+                if tok is not None:
+                    self.acquires.setdefault(func, []).append(
+                        (tok, frozenset(new), it.context_expr.lineno, cls))
+                    new.add(tok)
+            for s in node.body:
+                self._walk_node(s, frozenset(new), func, cls, stack)
+            return
+        # expressions & simple statements: record accesses + call sites
+        # from this statement's OWN expressions (nested statements are
+        # recursed with their own held sets)
+        for e in ast.iter_child_nodes(node):
+            if isinstance(e, ast.expr):
+                self._scan_expr(e, held, func, cls)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.stmt):
+                self._walk_node(child, held, func, cls, stack)
+            elif not isinstance(child, ast.expr) and \
+                    isinstance(getattr(child, "body", None), list):
+                # non-stmt statement carriers (except handlers, match
+                # cases): their bodies run under the same held set —
+                # error-path mutations of shared state are exactly
+                # where races hide
+                for s in child.body:
+                    if isinstance(s, ast.stmt):
+                        self._walk_node(s, held, func, cls, stack)
+
+    #: receiver methods that mutate a container in place — for
+    #: store/read classification of dict/list/set shared state
+    _MUTATORS = frozenset({"append", "add", "pop", "popitem", "clear",
+                           "update", "remove", "discard", "extend",
+                           "setdefault", "insert", "move_to_end",
+                           "appendleft", "popleft"})
+
+    def _scan_expr(self, expr: ast.expr, held: frozenset, func: str,
+                   cls: Optional[str]) -> None:
+        call_funcs = set()      # Name/Attribute nodes in call position
+        mutated = set()         # receivers of subscript-stores/mutators
+        for node in _walk_pruned(expr):
+            if isinstance(node, ast.Call):
+                call_funcs.add(id(node.func))
+                if isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in self._MUTATORS:
+                    mutated.add(id(node.func.value))
+            elif isinstance(node, ast.Subscript) and \
+                    isinstance(node.ctx, (ast.Store, ast.Del)):
+                mutated.add(id(node.value))
+
+        def is_store(node) -> bool:
+            return isinstance(node.ctx, (ast.Store, ast.Del)) \
+                or id(node) in mutated
+
+        for node in _walk_pruned(expr):
+            if isinstance(node, ast.Attribute):
+                if id(node) in call_funcs:
+                    continue    # a method reference, not a field access
+                # a private METHOD referenced as a value (Thread target,
+                # callback) escapes its lock summary like a bare name
+                if node.attr.startswith("_") and \
+                        _self_attr(node) is not None:
+                    self.escaped.add(node.attr)
+                recv = dotted_name(node.value)
+                if recv is not None:
+                    self.accesses.append((
+                        _Access(node, recv, node.attr, func,
+                                is_store(node), node.lineno), held))
+            elif isinstance(node, ast.Name):
+                if id(node) not in call_funcs:
+                    self.accesses.append((
+                        _Access(node, "", node.id, func,
+                                is_store(node), node.lineno), held))
+                # a private helper escaping as a value (Thread target,
+                # callback registration) can run with no lock held
+                if not isinstance(node.ctx, ast.Store) and \
+                        id(node) not in call_funcs:
+                    self.escaped.add(node.id)
+            if isinstance(node, ast.Call):
+                callee = self._callee_key(node, cls)
+                if callee is not None:
+                    self.call_sites.setdefault(callee, []).append(
+                        (func, held,
+                         isinstance(node.func, ast.Attribute)))
+
+    def _callee_key(self, call: ast.Call, cls: Optional[str]) \
+            -> Optional[str]:
+        f = call.func
+        if isinstance(f, ast.Name) and f.id.startswith("_"):
+            return f.id                              # module-level helper
+        attr = _self_attr(f)
+        if attr is not None and attr.startswith("_") and cls is not None:
+            return f"{cls}.{attr}"                   # private method
+        return None
+
+
+class LockDisciplineRule(ProjectRule):
+    name = "lock-discipline"
+    contract = ("guarded shared state (declared with '# tpulint: "
+                "guarded-by <lock>' or auto-seeded from today's "
+                "with-lock discipline) is only read/written under its "
+                "lock; no double-acquire of a plain Lock; no inverted "
+                "lock-order pairs")
+
+    def check_project(self, ctxs: Sequence[FileContext],
+                      root: str) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        #: lock-order pairs: (idA, idB) -> [(rel, func, line)]
+        pairs: Dict[Tuple[str, str], List[Tuple[str, str, int]]] = {}
+        for ctx in ctxs:
+            if ctx.tree is None:
+                continue
+            fa = _FileLocks(ctx)
+            entry = self._entry_held(fa)
+            self._seed_guards(fa, entry)
+            findings.extend(self._check_accesses(fa, entry))
+            findings.extend(self._check_acquires(fa, entry, pairs))
+        findings.extend(self._order_findings(pairs))
+        return findings
+
+    # ----------------------------------------------------- call summaries
+    @staticmethod
+    def _entry_held(fa: _FileLocks) -> Dict[str, frozenset]:
+        """Locks a private helper provably holds on entry: intersection
+        over every in-module call site (including the caller's own
+        entry-held set), empty if its name escapes as a value or it is
+        never called here. Fixpoint so helper-of-helper chains
+        resolve."""
+        # callee key -> full function keys it resolves to: a bare "_f"
+        # is the top-level def _f or a def nested in the CALLER's scope
+        # (never a same-named method of an unrelated class — that would
+        # gift it locks from call sites that never reach it); "Cls._m"
+        # is the method key whose trailing components match
+        resolve: Dict[str, List[str]] = {}
+        for callee, sites in fa.call_sites.items():
+            matches = set()
+            if "." in callee:
+                for key in fa.func_class:
+                    if key == callee or key.endswith("." + callee):
+                        matches.add(key)
+            else:
+                if callee in fa.func_class:
+                    matches.add(callee)
+                for caller, _held, _m in sites:
+                    nested = f"{caller}.{callee}"
+                    if nested in fa.func_class:
+                        matches.add(nested)
+            resolve[callee] = sorted(matches)
+        entry: Dict[str, frozenset] = {}
+        for _ in range(5):
+            changed = False
+            for callee, sites in fa.call_sites.items():
+                leaf = callee.rsplit(".", 1)[-1]
+                if leaf in fa.escaped:
+                    new = frozenset()
+                else:
+                    common: Optional[set] = None
+                    for caller, held, is_method in sites:
+                        # construction is single-threaded: an __init__
+                        # call site holds "every" lock conceptually and
+                        # must not zero the intersection
+                        if caller.rsplit(".", 1)[-1] in ("__init__",
+                                                         "__new__"):
+                            continue
+                        eff = held | entry.get(caller, frozenset())
+                        trans = {t for t in eff
+                                 if t[0] == ""
+                                 or (t[0] in ("self", "cls")
+                                     and is_method)}
+                        common = trans if common is None \
+                            else common & trans
+                    new = frozenset(common or ())
+                for fkey in resolve.get(callee, []):
+                    if entry.get(fkey, frozenset()) != new:
+                        entry[fkey] = new
+                        changed = True
+            if not changed:
+                break
+        return entry
+
+    # -------------------------------------------------------- auto-seeding
+    @staticmethod
+    def _majority_lock(helds: List[frozenset], known,
+                       receivers: Tuple[str, ...]) -> Optional[str]:
+        """The lock attr guarding a field by prevailing discipline: at
+        least half of the accesses (and at least one) hold a common
+        known lock.  A strict every-access criterion would be
+        self-defeating — the regression that ADDS an unlocked access
+        would disqualify the seed that should flag it; majority keeps
+        the gate armed while never seeding genuinely lock-free state."""
+        stores = sum(1 for _eff, store in helds if store)
+        if stores == 0:
+            return None          # immutable after __init__: no lock needed
+        counts: Dict[str, int] = {}
+        for eff, _store in helds:
+            for recv, name in eff:
+                if recv in receivers and name in known:
+                    counts[name] = counts.get(name, 0) + 1
+        best = sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))
+        if best and best[0][1] * 2 >= len(helds):
+            return best[0][0]
+        return None
+
+    def _seed_guards(self, fa: _FileLocks,
+                     entry: Dict[str, frozenset]) -> None:
+        """Register unannotated fields whose accesses today are
+        (majority-)lock-held — the existing discipline becomes the
+        contract without a single annotation."""
+        # candidate instance/class fields per class: assigned in
+        # __init__ or class body, touched elsewhere via self/cls
+        per_field: Dict[Tuple[str, str], List[frozenset]] = {}
+        for acc, held in fa.accesses:
+            if acc.recv not in ("self", "cls"):
+                continue
+            cls = fa.func_class.get(acc.func)
+            if cls is None or (cls, acc.name) in fa.guards:
+                continue
+            if acc.func.rsplit(".", 1)[-1] in ("__init__", "__new__"):
+                continue
+            per_field.setdefault((cls, acc.name), []).append(
+                (held | entry.get(acc.func, frozenset()), acc.store))
+        for (cls, field), helds in per_field.items():
+            locks = fa.class_locks.get(cls, {})
+            if not locks:
+                continue
+            lock = self._majority_lock(helds, locks, ("self", "cls"))
+            if lock is not None:
+                fa.guards[(cls, field)] = _Guard(cls, field, lock,
+                                                False, 0)
+        # module globals
+        per_mod: Dict[str, List[frozenset]] = {}
+        for acc, held in fa.accesses:
+            if acc.recv != "" or acc.name not in fa.module_names:
+                continue
+            if (None, acc.name) in fa.guards:
+                continue
+            if acc.name in fa.func_locals.get(acc.func, ()):
+                continue             # a shadowing local, not the global
+            per_mod.setdefault(acc.name, []).append(
+                (held | entry.get(acc.func, frozenset()), acc.store))
+        for name, helds in per_mod.items():
+            lock = self._majority_lock(helds, fa.module_locks, ("",))
+            if lock is not None:
+                fa.guards[(None, name)] = _Guard(None, name, lock,
+                                                 False, 0)
+
+    # ----------------------------------------------------- guarded access
+    def _check_accesses(self, fa: _FileLocks,
+                        entry: Dict[str, frozenset]) -> List[Finding]:
+        out: List[Finding] = []
+        counts: Dict[str, int] = {}
+        rel = fa.ctx.rel
+        # validate declared guards name a real lock
+        for guard in fa.guards.values():
+            if not guard.declared:
+                continue
+            known = fa.module_locks if guard.owner is None \
+                else fa.class_locks.get(guard.owner, {})
+            if guard.lock not in known:
+                out.append(Finding(
+                    self.name, rel, guard.line,
+                    f"guarded-by names unknown lock '{guard.lock}' for "
+                    f"'{guard.field}' — declare the lock in the same "
+                    "scope (threading.Lock()/RLock()) or fix the "
+                    "annotation",
+                    key=f"badguard:{guard.owner}.{guard.field}"))
+        for acc, held in fa.accesses:
+            guard = self._guard_for(fa, acc)
+            if guard is None:
+                continue
+            fn_leaf = acc.func.rsplit(".", 1)[-1]
+            if acc.recv in ("self", "cls") and fn_leaf in (
+                    "__init__", "__new__"):
+                continue        # construction is single-threaded
+            eff = held | entry.get(acc.func, frozenset())
+            if (acc.recv, guard.lock) in eff:
+                continue
+            mode = "write" if acc.store else "read"
+            holder = f"{acc.recv + '.' if acc.recv else ''}{guard.lock}"
+            n = counts.get(f"{guard.field}:{acc.func}", 0)
+            counts[f"{guard.field}:{acc.func}"] = n + 1
+            out.append(Finding(
+                self.name, rel, acc.line,
+                f"{mode} of '{acc.name}' (guarded by "
+                f"{guard.owner + '.' if guard.owner else ''}{guard.lock})"
+                f" without holding {holder} — wrap in 'with {holder}:' "
+                "or suppress with a lock-free-by-design justification",
+                key=f"guard:{guard.owner}.{guard.field}:{acc.func}:{n}"))
+        return out
+
+    @staticmethod
+    def _guard_for(fa: _FileLocks, acc: _Access) -> Optional[_Guard]:
+        if acc.recv == "":
+            if acc.name in fa.func_locals.get(acc.func, ()):
+                return None          # a shadowing local, not the global
+            return fa.guards.get((None, acc.name))
+        cls = fa.func_class.get(acc.func)
+        if acc.recv in ("self", "cls"):
+            if cls is None:
+                return None
+            return fa.guards.get((cls, acc.name))
+        # non-self receiver: any DECLARED guard of that field name in
+        # this module (the registry-snapshot-reads-counter-fields case)
+        matches = sorted(
+            ((owner, guard) for (owner, field), guard in
+             fa.guards.items()
+             if field == acc.name and owner is not None
+             and guard.declared), key=lambda t: t[0])
+        return matches[0][1] if matches else None
+
+    # ------------------------------------------- double-acquire and order
+    def _check_acquires(self, fa: _FileLocks, entry: Dict[str, frozenset],
+                        pairs: Dict) -> List[Finding]:
+        out: List[Finding] = []
+        rel = fa.ctx.rel
+        for func, acqs in sorted(fa.acquires.items()):
+            for tok, lex_held, line, cls in acqs:
+                eff = lex_held | entry.get(func, frozenset())
+                if tok in eff and self._kind(fa, tok, cls) == "lock":
+                    out.append(Finding(
+                        self.name, rel, line,
+                        f"double acquire of non-reentrant lock "
+                        f"{tok[0] + '.' if tok[0] else ''}{tok[1]} — "
+                        "already held here (self-deadlock); use RLock "
+                        "or hoist the outer acquire",
+                        key=f"double:{tok[1]}:{func}"))
+                tid = self._lock_id(fa, tok, cls)
+                if tid is None:
+                    continue
+                for other in eff:
+                    if other == tok:
+                        continue
+                    oid = self._lock_id(fa, other, cls)
+                    if oid is None:
+                        continue
+                    pairs.setdefault((oid, tid), []).append(
+                        (rel, func, line))
+        return out
+
+    @staticmethod
+    def _kind(fa: _FileLocks, tok: Tuple[str, str],
+              cls: Optional[str]) -> Optional[str]:
+        recv, name = tok
+        if recv == "":
+            return fa.module_locks.get(name)
+        if recv in ("self", "cls") and cls is not None:
+            return fa.class_locks.get(cls, {}).get(name)
+        return None
+
+    @staticmethod
+    def _lock_id(fa: _FileLocks, tok: Tuple[str, str],
+                 cls: Optional[str]) -> Optional[str]:
+        recv, name = tok
+        if recv == "":
+            return f"{fa.ctx.rel}::{name}"
+        if recv in ("self", "cls") and cls is not None and \
+                name in fa.class_locks.get(cls, {}):
+            return f"{fa.ctx.rel}::{cls}.{name}"
+        return None
+
+    def _order_findings(self, pairs: Dict) -> List[Finding]:
+        out: List[Finding] = []
+        for (a, b), sites in sorted(pairs.items()):
+            if (b, a) not in pairs or a >= b:
+                continue        # report each unordered pair once (a < b)
+            other = sorted(pairs[(b, a)])[0]
+            for rel, func, line in sorted(sites):
+                out.append(Finding(
+                    self.name, rel, line,
+                    f"lock-order inversion: {a} is acquired before {b} "
+                    f"here, but {b} before {a} at {other[0]}:{other[2]} "
+                    "— pick one order (deadlock seed under concurrent "
+                    "sessions)",
+                    key=f"order:{a}->{b}:{func}"))
+            for rel, func, line in sorted(pairs[(b, a)]):
+                site = sorted(sites)[0]
+                out.append(Finding(
+                    self.name, rel, line,
+                    f"lock-order inversion: {b} is acquired before {a} "
+                    f"here, but {a} before {b} at {site[0]}:{site[2]} "
+                    "— pick one order (deadlock seed under concurrent "
+                    "sessions)",
+                    key=f"order:{b}->{a}:{func}"))
+        return out

@@ -1,0 +1,440 @@
+"""retrace-risk: shapes that silently defeat the executable cache.
+
+Contract (plan/exec_cache.py + ISSUE 6/8): a repeat query must reuse
+the SAME jitted callable (in-process tier) and the same serialized XLA
+module (persistent tier).  Three code shapes quietly break that without
+tripping ``adhoc-jit``:
+
+* **volatile closure captures** — a jit kernel defined inside a builder
+  function that closes over the builder's *arguments*, *loop
+  variables*, or locals bound to Python scalars / unhashable
+  list-dict-set displays.  The captured value is baked in at trace
+  time: when it varies per query, either the kernel silently computes
+  with a stale constant or the builder re-jits per call (per-query
+  recompile, the r5 warm-cliff bug class).  Builders routed through
+  ``exec_cache.get_or_build`` (their name appears as the build callback
+  of a key-resolved call) are exempt — the cache key owns the
+  variation.  Module-level captures are process-stable and fine.
+* **static-arg value branching** — Python ``if``/``while`` on a
+  ``static_argnums``/``static_argnames`` parameter *value* inside a
+  jitted body: every distinct value traces a whole new program.
+  (Branching on *traced* values is a tracing break and belongs to
+  ``host-sync-flow``.)
+* **set/dict iteration feeding cache keys** — a ``set`` iterated into
+  a ``get_or_build``/``fused_key``/``digest_of`` argument (directly or
+  via ``tuple()``/``list()`` of a set-typed local): set order is
+  process-dependent (PYTHONHASHSEED), so the same logical kernel hashes
+  to different keys in different processes and the persistent tier
+  never hits.  ``sorted()`` launders the order.  A raw list/dict/set
+  display as a key component is additionally unhashable and would
+  throw at runtime.  Set iteration *inside* a jitted body is flagged
+  for the same reason: the traced program order differs per process.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .astutil import dotted_name, is_jit_decorated, jit_static_params, \
+    local_names
+from .cfg import LoopBind
+from .dataflow import ReachingDefs, TaintAnalysis, TaintSpec, \
+    scan_conditions
+from .framework import FileContext, FileRule, Finding
+
+__all__ = ["RetraceRiskRule"]
+
+#: call leaf-names that resolve a kernel through the executable cache;
+#: a builder passed into one of these is keyed, so its captures are
+#: covered by the cache key
+_KEYED_RESOLVERS = frozenset({"get_or_build", "_resolve_cached"})
+
+#: call leaf-names whose arguments become cache-key components
+_KEY_FUNCS = frozenset({"get_or_build", "fused_key", "digest_of",
+                        "_resolve_cached"})
+
+_FUNC = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _is_scalar_expr(e: ast.expr) -> bool:
+    """Python-scalar valued: a number/bool literal, int()/float()/
+    bool()/len() calls, or arithmetic over those (Names are allowed as
+    leaves when at least one literal/scalar-call anchors the type —
+    ``n * 2`` is a scalar, ``a * b`` is unknowable)."""
+
+    def leaf_ok(x: ast.expr) -> bool:
+        return _is_scalar_expr(x) or isinstance(x, ast.Name)
+
+    if isinstance(e, ast.Constant):
+        return isinstance(e.value, (int, float, bool))
+    if isinstance(e, ast.BinOp):
+        return leaf_ok(e.left) and leaf_ok(e.right) and \
+            (_is_scalar_expr(e.left) or _is_scalar_expr(e.right))
+    if isinstance(e, ast.UnaryOp):
+        return _is_scalar_expr(e.operand)
+    if isinstance(e, ast.Call):
+        name = dotted_name(e.func) or ""
+        return name.rsplit(".", 1)[-1] in ("int", "float", "bool", "len")
+    return False
+
+
+def _is_unhashable_display(e: ast.expr) -> bool:
+    return isinstance(e, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                          ast.DictComp, ast.SetComp))
+
+
+def _is_set_expr(e: ast.expr) -> bool:
+    if isinstance(e, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(e, ast.Call):
+        name = (dotted_name(e.func) or "").rsplit(".", 1)[-1]
+        return name in ("set", "frozenset")
+    return False
+
+
+class RetraceRiskRule(FileRule):
+    name = "retrace-risk"
+    contract = ("no jit-cache-busting shapes: volatile closure captures "
+                "in unkeyed kernel builders, Python branching on "
+                "static-arg values inside jitted bodies, set iteration "
+                "feeding exec_cache keys or traced programs")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.tree is None:
+            return []
+        findings: List[Finding] = []
+        parents = self._parent_functions(ctx.tree)
+        keyed = self._keyed_builders(ctx.tree) \
+            | self._memoized_builders(ctx.tree)
+        rd_cache: Dict[int, ReachingDefs] = {}
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, _FUNC) or not is_jit_decorated(node):
+                continue
+            findings.extend(self._check_captures(
+                ctx, node, parents.get(id(node)), keyed, rd_cache))
+            findings.extend(self._check_static_branching(ctx, node))
+            findings.extend(self._check_set_iteration(
+                ctx, node, parents.get(id(node))))
+        findings.extend(self._check_key_args(ctx, parents))
+        return findings
+
+    # ------------------------------------------------------- structure
+    @staticmethod
+    def _parent_functions(tree: ast.Module) -> Dict[int, ast.AST]:
+        """id(inner def) -> immediately enclosing function node."""
+        out: Dict[int, ast.AST] = {}
+
+        def walk(node, fn):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, _FUNC):
+                    if fn is not None:
+                        out[id(child)] = fn
+                    walk(child, child)
+                elif isinstance(child, ast.Lambda):
+                    walk(child, child)
+                else:
+                    walk(child, fn)
+
+        walk(tree, None)
+        return out
+
+    @staticmethod
+    def _keyed_builders(tree: ast.Module) -> Set[str]:
+        """Leaf names of functions passed as the build callback of a
+        cache-key-resolved call (``get_or_build(key, self._build)``)."""
+        out: Set[str] = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            leaf = (dotted_name(node.func) or "").rsplit(".", 1)[-1]
+            # functools.lru_cache(...)(build) keys the builder too
+            if isinstance(node.func, ast.Call):
+                inner = (dotted_name(node.func.func) or "").rsplit(
+                    ".", 1)[-1]
+                if inner in ("lru_cache", "cache"):
+                    leaf = "get_or_build"
+            if leaf not in _KEYED_RESOLVERS:
+                continue
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                if isinstance(arg, ast.Name):
+                    out.add(arg.id)
+                elif isinstance(arg, ast.Attribute):
+                    out.add(arg.attr)
+        return out
+
+    @staticmethod
+    def _memoized_builders(tree: ast.Module) -> Set[str]:
+        """Leaf names of builder functions whose call result is stored
+        into a subscript (the module-level kernel-memo idiom:
+        ``kern = _build(...); _CACHE[key] = kern`` or
+        ``_CACHE[key] = _build(...)`` or ``cache.setdefault(k,
+        _build(...))``) — the memo key owns the captured variation."""
+        out: Set[str] = set()
+        assigned_from: Dict[str, Set[str]] = {}
+        #: alias = other_builder / (a if c else b) over builder names
+        aliases: Dict[str, Set[str]] = {}
+        stored_names: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "setdefault":
+                for a in node.args:
+                    if isinstance(a, ast.Call):
+                        leaf = (dotted_name(a.func) or "").rsplit(
+                            ".", 1)[-1]
+                        if leaf:
+                            out.add(leaf)
+            if not isinstance(node, ast.Assign):
+                continue
+            val = node.value
+            callee = None
+            if isinstance(val, ast.Call):
+                callee = (dotted_name(val.func) or "").rsplit(".", 1)[-1]
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    if callee:
+                        assigned_from.setdefault(t.id, set()).add(callee)
+                    elif isinstance(val, (ast.Name, ast.IfExp)):
+                        names = {n.id for n in ast.walk(val)
+                                 if isinstance(n, ast.Name)
+                                 and isinstance(n.ctx, ast.Load)}
+                        aliases.setdefault(t.id, set()).update(names)
+                elif isinstance(t, ast.Subscript):
+                    if callee:
+                        out.add(callee)
+                    elif isinstance(val, ast.Name):
+                        stored_names.add(val.id)
+        for name in stored_names:
+            out |= assigned_from.get(name, set())
+        # a def whose NAME is subscript-stored is memoized directly
+        # (the _AGG_KERNEL_CACHE[key] = fast idiom inside a builder)
+        out |= stored_names
+        # expand call-through-alias: k = build(...) where build is
+        # (a if cond else b)
+        for alias, names in aliases.items():
+            if alias in out:
+                out |= names
+        out.discard("")
+        return out
+
+    # -------------------------------------------------------- captures
+    def _check_captures(self, ctx: FileContext, fn, parent,
+                        keyed: Set[str],
+                        rd_cache: Dict[int, ReachingDefs]) \
+            -> List[Finding]:
+        if parent is None or isinstance(parent, ast.Lambda):
+            return []       # module-level captures are process-stable
+        if parent.name in keyed or fn.name in keyed:
+            return []       # cache key owns the builder's variation
+        for dec in parent.decorator_list:
+            leaf = (dotted_name(dec.func if isinstance(dec, ast.Call)
+                                else dec) or "").rsplit(".", 1)[-1]
+            if leaf in ("lru_cache", "cache"):
+                return []   # memoized builder: args ARE the key
+        fn_locals = local_names(fn)
+        parent_locals = local_names(parent)
+        rd = rd_cache.get(id(parent))
+        if rd is None:
+            rd = rd_cache[id(parent)] = ReachingDefs(parent)
+        seen: Set[str] = set()
+        reasons: List[str] = []
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Name)
+                    and isinstance(node.ctx, ast.Load)):
+                continue
+            name = node.id
+            if name in seen or name in fn_locals or \
+                    name not in parent_locals or \
+                    name in ("self", "cls"):
+                continue
+            seen.add(name)
+            defs = rd.defs_at(fn, name) or frozenset(rd.all_defs(name))
+            reason = self._classify_capture(name, defs)
+            if reason is not None:
+                reasons.append(reason)
+        if not reasons:
+            return []
+        # anchor on the decorator so a standalone suppression comment
+        # directly above ``@jax.jit`` applies
+        line = fn.decorator_list[0].lineno if fn.decorator_list \
+            else fn.lineno
+        return [Finding(
+            self.name, ctx.rel, line,
+            f"jit kernel '{fn.name}' closes over volatile state from "
+            f"unkeyed builder '{parent.name}': "
+            f"{', '.join(sorted(reasons))} — each value is baked in at "
+            "trace time, so a change means a stale kernel or a "
+            "per-call re-jit; memoize the builder on a key covering "
+            "these (or route it through exec_cache.get_or_build)",
+            key=f"capture:{fn.name}")]
+
+    @staticmethod
+    def _classify_capture(name: str, defs) -> Optional[str]:
+        for d in defs:
+            if d == "param":
+                return f"builder argument '{name}'"
+            if isinstance(d, LoopBind):
+                return f"loop variable '{name}'"
+            value = getattr(d, "value", None)
+            if value is None:
+                continue
+            if _is_scalar_expr(value):
+                return f"Python scalar '{name}'"
+            if _is_unhashable_display(value):
+                return f"unhashable {type(value).__name__.lower()} " \
+                       f"'{name}'"
+        return None
+
+    # ----------------------------------------- static-arg branching
+    def _check_static_branching(self, ctx: FileContext, fn) \
+            -> List[Finding]:
+        static = jit_static_params(fn)
+        if not static:
+            return []
+        seeds = {p: frozenset(["@static"]) for p in static}
+        analysis = TaintAnalysis(fn, TaintSpec(), seeds)
+        out: List[Finding] = []
+        counts: Dict[str, int] = {}
+
+        def on_cond(expr, env):
+            if "@static" in analysis.eval(expr, env):
+                n = counts.get(fn.name, 0)
+                counts[fn.name] = n + 1
+                out.append(Finding(
+                    self.name, ctx.rel, expr.lineno,
+                    f"Python branch on a static-arg value inside jit "
+                    f"kernel '{fn.name}' — every distinct value traces "
+                    "and compiles a whole new program; fold the branch "
+                    "into the traced computation (jnp.where/lax.cond) "
+                    "or accept it into the cache key deliberately",
+                    key=f"staticbranch:{fn.name}:{n}"))
+
+        scan_conditions(analysis, on_cond)
+        return out
+
+    # ------------------------------------------------- set iteration
+    def _check_set_iteration(self, ctx: FileContext, fn,
+                             parent=None) -> List[Finding]:
+        out: List[Finding] = []
+        set_locals: Set[str] = set()
+        scopes = [fn]
+        if parent is not None and not isinstance(parent, ast.Lambda):
+            scopes.append(parent)   # captured set-typed builder locals
+        for scope in scopes:
+            for node in ast.walk(scope):
+                if isinstance(node, ast.Assign) and \
+                        _is_set_expr(node.value):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            set_locals.add(t.id)
+
+        def is_set_like(e) -> bool:
+            return _is_set_expr(e) or (
+                isinstance(e, ast.Name) and e.id in set_locals)
+
+        seen: Set[int] = set()
+        for node in ast.walk(fn):
+            it = None
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                it = node.iter
+            elif isinstance(node, ast.comprehension):
+                it = node.iter
+            if it is not None and is_set_like(it) and \
+                    it.lineno not in seen:
+                # key on the ITERABLE's line: ast.comprehension nodes
+                # carry no lineno of their own
+                seen.add(it.lineno)
+                out.append(Finding(
+                    self.name, ctx.rel, it.lineno,
+                    f"set iteration inside jit kernel '{fn.name}' — "
+                    "set order is process-dependent (PYTHONHASHSEED), "
+                    "so the traced program differs across processes "
+                    "and the persistent executable tier never hits; "
+                    "iterate sorted(...) instead",
+                    key=f"setiter:{fn.name}:{len(seen)}"))
+        return out
+
+    # -------------------------------------------------- cache-key args
+    @staticmethod
+    def _scope_nodes(scope) -> List[ast.AST]:
+        """Nodes of one scope, not descending into nested functions
+        (each function resolves its own locals)."""
+        out: List[ast.AST] = []
+        stack = list(ast.iter_child_nodes(scope))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (_FUNC[0], _FUNC[1], ast.Lambda)):
+                continue
+            out.append(node)
+            stack.extend(ast.iter_child_nodes(node))
+        return out
+
+    def _check_key_args(self, ctx: FileContext,
+                        parents: Dict[int, ast.AST]) -> List[Finding]:
+        out: List[Finding] = []
+        tree = ctx.tree
+        counts: Dict[str, int] = {}
+        scopes = [tree] + [n for n in ast.walk(tree)
+                           if isinstance(n, _FUNC)]
+        for scope in scopes:
+            nodes = self._scope_nodes(scope)
+            # flow-insensitive name -> values map PER SCOPE: a local in
+            # one function must not contaminate a same-named local in
+            # another
+            assigned: Dict[str, List[ast.expr]] = {}
+            for node in nodes:
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            assigned.setdefault(t.id, []).append(
+                                node.value)
+            out.extend(self._key_args_in_scope(ctx, nodes, assigned,
+                                               counts))
+        return out
+
+    def _key_args_in_scope(self, ctx: FileContext, nodes,
+                           assigned: Dict[str, List[ast.expr]],
+                           counts: Dict[str, int]) -> List[Finding]:
+        out: List[Finding] = []
+
+        def resolve(e: ast.expr) -> List[ast.expr]:
+            if isinstance(e, ast.Name):
+                return assigned.get(e.id, [])
+            return [e]
+
+        def flag(call, what: str):
+            leaf = (dotted_name(call.func) or "?").rsplit(".", 1)[-1]
+            n = counts.get(leaf, 0)
+            counts[leaf] = n + 1
+            out.append(Finding(
+                self.name, ctx.rel, call.lineno,
+                f"{what} feeds a {leaf}() cache-key argument — "
+                "unhashable components throw at runtime and "
+                "unsorted set/dict iteration hashes differently per "
+                "process (persistent-tier miss); use sorted(...) "
+                "tuples of hashables",
+                key=f"keyarg:{leaf}:{n}"))
+
+        for node in nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            leaf = (dotted_name(node.func) or "").rsplit(".", 1)[-1]
+            if leaf not in _KEY_FUNCS:
+                continue
+            for arg in node.args:
+                for e in resolve(arg):
+                    if _is_unhashable_display(e):
+                        flag(node, f"an unhashable "
+                                   f"{type(e).__name__.lower()}")
+                        break
+                    if isinstance(e, ast.Call):
+                        cn = (dotted_name(e.func) or "").rsplit(
+                            ".", 1)[-1]
+                        if cn in ("tuple", "list") and e.args:
+                            inner = e.args[0]
+                            for iv in resolve(inner):
+                                if _is_set_expr(iv):
+                                    flag(node, "tuple()/list() of an "
+                                               "unsorted set")
+                                    break
+        return out

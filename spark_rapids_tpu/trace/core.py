@@ -118,11 +118,11 @@ class Tracer:
         #: driver place THIS process's monotonic timestamps onto the
         #: shared cross-process timeline
         self.epoch_ns = time.time_ns() - time.perf_counter_ns()
-        self._buf: deque = deque(maxlen=max(16, int(max_events)))
         self._lock = threading.Lock()
-        self.dropped = 0
+        self._buf: deque = deque(maxlen=max(16, int(max_events)))  # tpulint: guarded-by _lock
+        self.dropped = 0             # tpulint: guarded-by _lock
         #: pid -> process name, for lanes ingested from other processes
-        self.proc_names: Dict[int, str] = {self.pid: self.proc_name}
+        self.proc_names: Dict[int, str] = {self.pid: self.proc_name}  # tpulint: guarded-by _lock
 
     # ------------------------------------------------------------ record
     def now(self) -> int:
@@ -209,8 +209,11 @@ class Tracer:
         timeline, per-process pid/tid lanes preserved."""
         got = pickle.loads(payload)
         shift = got["epoch_ns"] - self.epoch_ns
-        self.proc_names[got["pid"]] = got["proc"]
-        self.dropped += got.get("dropped", 0)
+        # the driver ingests worker payloads while its own query thread
+        # still emits: lane-name/drop bookkeeping shares the buffer lock
+        with self._lock:
+            self.proc_names[got["pid"]] = got["proc"]
+            self.dropped += got.get("dropped", 0)
         evs = got["events"]
         for ev in evs:
             ev["ts"] = ev["ts"] + shift
@@ -226,6 +229,8 @@ _INSTALL_LOCK = threading.Lock()
 
 
 def active_tracer() -> Optional[Tracer]:
+    # tpulint: disable=lock-discipline — lock-free by design: the
+    # disabled-path contract is one unlocked reference read per site
     return TRACER
 
 
@@ -242,9 +247,11 @@ def ensure_tracer_from_conf(conf) -> Optional[Tracer]:
     conf lookup, paid per ExecContext construction, never per event."""
     global TRACER
     if not conf.get(TRACE_ENABLED):
+        # tpulint: disable=lock-discipline — lock-free by design:
+        # tracing-off fast path; installation itself locks below
         return TRACER
     with _INSTALL_LOCK:
         if TRACER is None:
             TRACER = Tracer(max_events=int(conf.get(TRACE_BUFFER_SPANS)),
                             proc_name="driver")
-    return TRACER
+        return TRACER

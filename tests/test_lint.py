@@ -11,11 +11,14 @@ import textwrap
 import pytest
 
 from spark_rapids_tpu.tools.lint import (ALL_RULES, BatchLifetimeRule,
-                                         ConfigKeyDriftRule, HostSyncRule,
-                                         OpsDocDriftRule,
+                                         ConfigKeyDriftRule,
+                                         HostSyncFlowRule, HostSyncRule,
+                                         LockDisciplineRule,
+                                         OpsDocDriftRule, RetraceRiskRule,
                                          RetryIdempotenceRule, lint_source)
 from spark_rapids_tpu.tools.lint.framework import (FileContext, Finding,
-                                                   load_baseline, run_lint,
+                                                   load_baseline,
+                                                   prune_baseline, run_lint,
                                                    write_baseline)
 
 
@@ -232,14 +235,23 @@ class TestHostSync:
             """, self.RULE)
         assert any(".item()" in f.message for f in fs)
 
-    def test_float_of_device_data_in_eval_device(self):
+    def test_scalar_conversion_is_flow_rules_job_now(self):
+        # the pattern rule retired its float()-of-device-hint heuristic:
+        # host-sync-flow tracks the actual value flow instead
         fs = _lint("""
             class Op:
                 def eval_device(self, ctx):
                     lo = float(ctx.scalar(0))
                     return jnp.clip(ctx.column(1).data, lo, None)
             """, self.RULE)
-        assert any("float() of device data" in f.message for f in fs)
+        assert fs == []
+        fs = _lint("""
+            class Op:
+                def eval_device(self, ctx):
+                    lo = float(ctx.scalar(0))
+                    return jnp.clip(ctx.column(1).data, lo, None)
+            """, HostSyncFlowRule())
+        assert any("float() conversion" in f.message for f in fs)
 
     def test_clean_pure_jnp_eval_device(self):
         fs = _lint("""
@@ -474,3 +486,766 @@ class TestCli:
         out = capsys.readouterr().out
         for rule in ALL_RULES:
             assert rule.name in out
+
+
+# ============================================================ host-sync-flow
+class TestHostSyncFlow:
+    RULE = HostSyncFlowRule()
+
+    def test_taint_through_assignment_into_float(self):
+        fs = _lint("""
+            @jax.jit
+            def kernel(data):
+                x = data * 2
+                y = x + 1
+                n = float(y)
+                return n
+            """, self.RULE)
+        assert any("float() conversion" in f.message for f in fs)
+
+    def test_truthiness_of_device_value(self):
+        fs = _lint("""
+            @jax.jit
+            def kernel(data):
+                total = jnp.sum(data)
+                if total:
+                    return data
+                return data * 0
+            """, self.RULE)
+        assert any("truthiness test" in f.message for f in fs)
+
+    def test_reassignment_kills_taint(self):
+        # flow sensitivity: after rebinding to a host constant the name
+        # is clean — a path-insensitive "mentions device" check would FP
+        fs = _lint("""
+            @jax.jit
+            def kernel(data):
+                n = jnp.sum(data)
+                n = 3
+                if n:
+                    return data
+                return data
+            """, self.RULE)
+        assert fs == []
+
+    def test_metadata_launders_taint(self):
+        fs = _lint("""
+            class Op:
+                def eval_device(self, ctx):
+                    c = ctx.column(0)
+                    if c.validity is None:
+                        return c
+                    if jnp.issubdtype(c.data.dtype, jnp.floating):
+                        return c
+                    if c.data.shape[0] > 4:
+                        return c
+                    if c.dtype.name == "float":
+                        return c
+                    n = len(c.data)
+                    return bool(n)
+            """, self.RULE)
+        assert fs == []
+
+    def test_zip_keeps_host_lane_clean(self):
+        # for k, r in zip(device, host): branching on r is fine
+        fs = _lint("""
+            @jax.jit
+            def kernel(cols):
+                flags = [True, False]
+                out = []
+                for c, f in zip(cols, flags):
+                    if f:
+                        out.append(c)
+                return out
+            """, self.RULE)
+        assert fs == []
+
+    def test_fstring_sink(self):
+        fs = _lint("""
+            class Op:
+                def eval_device(self, ctx):
+                    v = ctx.column(0).data
+                    raise ValueError(f"bad value {v}")
+            """, self.RULE)
+        assert any("f-string" in f.message for f in fs)
+
+    def test_helper_sink_reported_at_call_site(self):
+        fs = _lint("""
+            def _clamp(x, lo):
+                if x > lo:
+                    return x
+                return lo
+
+            class Op:
+                def eval_device(self, ctx):
+                    return _clamp(ctx.column(0).data, 0)
+            """, self.RULE)
+        assert any("inside helper '_clamp'" in f.message for f in fs)
+
+    def test_helper_return_propagates_taint(self):
+        fs = _lint("""
+            def _double(x):
+                return x * 2
+
+            class Op:
+                def eval_device(self, ctx):
+                    y = _double(ctx.column(0).data)
+                    return float(y)
+            """, self.RULE)
+        assert any("float() conversion" in f.message for f in fs)
+
+    def test_helper_untainted_args_clean(self):
+        fs = _lint("""
+            def _clamp(x, lo):
+                if x > lo:
+                    return x
+                return lo
+
+            class Op:
+                def eval_device(self, ctx):
+                    n = _clamp(3, 1)
+                    return ctx.column(0).data * n
+            """, self.RULE)
+        assert fs == []
+
+    def test_static_argnums_param_not_traced(self):
+        fs = _lint("""
+            @functools.partial(jax.jit, static_argnums=(1,))
+            def kernel(data, padded_len):
+                if padded_len > 8:
+                    return data
+                return data * 0
+            """, self.RULE)
+        assert fs == []
+
+    def test_nested_def_inside_eval_device_covered(self):
+        # nested helpers are trace-time code: a sink inside one must
+        # not hide behind the opaque-nested-def CFG boundary
+        fs = _lint("""
+            class Op:
+                def eval_device(self, ctx):
+                    def go(col):
+                        return float(col.data)
+                    return go(ctx.column(0))
+            """, self.RULE)
+        assert any("float() conversion" in f.message
+                   and "nested def go" in f.message for f in fs)
+
+    def test_suppression(self):
+        fs = _lint("""
+            class Op:
+                def eval_device(self, ctx):
+                    n = ctx.num_rows
+                    # the per-window count fetch IS the sync point
+                    return int(n)  # tpulint: disable=host-sync-flow
+            """, self.RULE)
+        assert fs == []
+
+
+# =========================================================== lock-discipline
+def _lock_lint(src, rel="mod.py"):
+    ctx = FileContext(rel, textwrap.dedent(src), rel=rel)
+    rule = LockDisciplineRule()
+    return [f for f in rule.check_project([ctx], "/nonexistent")
+            if not ctx.suppressed(f)]
+
+
+class TestLockDiscipline:
+    def test_annotated_module_global_flagged_outside_lock(self):
+        fs = _lock_lint("""
+            import threading
+            _LOCK = threading.Lock()
+            _CACHE = {}   # tpulint: guarded-by _LOCK
+
+            def bad(k, v):
+                _CACHE[k] = v
+
+            def good(k):
+                with _LOCK:
+                    return _CACHE.get(k)
+            """)
+        assert len(fs) == 1 and fs[0].line == 7, fs
+        assert "write of '_CACHE'" in fs[0].message
+
+    def test_standalone_annotation_line_applies_to_next(self):
+        fs = _lock_lint("""
+            import threading
+            _LOCK = threading.Lock()
+            # tpulint: guarded-by _LOCK
+            _STATE = {}
+
+            def bad():
+                return _STATE.copy()
+            """)
+        assert len(fs) == 1 and "'_STATE'" in fs[0].message
+
+    def test_unknown_lock_annotation_is_a_finding(self):
+        fs = _lock_lint("""
+            _TABLE = {}   # tpulint: guarded-by _NO_SUCH_LOCK
+            """)
+        assert any("unknown lock '_NO_SUCH_LOCK'" in f.message for f in fs)
+
+    def test_instance_field_and_helper_summary(self):
+        # the _evict idiom: a private helper called only under the lock
+        # inherits it; an unlocked public read is flagged
+        fs = _lock_lint("""
+            import threading
+
+            class Registry:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._peers = {}   # tpulint: guarded-by _lock
+
+                def register(self, k, v):
+                    with self._lock:
+                        self._peers[k] = v
+                        self._evict()
+
+                def _evict(self):
+                    self._peers.clear()
+
+                def racy_len(self):
+                    return len(self._peers)
+            """)
+        assert len(fs) == 1 and "racy_len" not in fs[0].message
+        assert fs[0].line == 18, fs
+
+    def test_escaped_helper_loses_lock_summary(self):
+        # a helper handed to Thread(target=...) can run with no lock
+        fs = _lock_lint("""
+            import threading
+
+            class Registry:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._peers = {}   # tpulint: guarded-by _lock
+
+                def register(self, k, v):
+                    with self._lock:
+                        self._peers[k] = v
+                        self._evict()
+                    threading.Thread(target=self._evict).start()
+
+                def _evict(self):
+                    self._peers.clear()
+            """)
+        assert len(fs) == 1
+        assert "'_peers'" in fs[0].message
+
+    def test_receiver_aware_cross_object_access(self):
+        fs = _lock_lint("""
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.value = 0   # tpulint: guarded-by _lock
+
+                def inc(self):
+                    with self._lock:
+                        self.value += 1
+
+            def snapshot_bad(m):
+                return m.value
+
+            def snapshot_good(m):
+                with m._lock:
+                    return m.value
+            """)
+        assert len(fs) == 1 and fs[0].line == 14, fs
+
+    def test_auto_seed_majority_catches_regression(self):
+        # no annotation anywhere: three locked writes seed the guard,
+        # the one unlocked write is the regression it must catch
+        fs = _lock_lint("""
+            import threading
+
+            class Store:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = {}
+
+                def put(self, k, v):
+                    with self._lock:
+                        self._items[k] = v
+
+                def drop(self, k):
+                    with self._lock:
+                        self._items.pop(k, None)
+
+                def clear(self):
+                    with self._lock:
+                        self._items.clear()
+
+                def regression(self, k, v):
+                    self._items[k] = v
+            """)
+        assert len(fs) == 1 and fs[0].line == 22, fs
+
+    def test_readonly_field_never_seeded(self):
+        fs = _lock_lint("""
+            import threading
+
+            class Cfg:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.path = "/tmp/x"
+
+                def locked_read(self):
+                    with self._lock:
+                        return self.path
+
+                def free_read(self):
+                    return self.path
+            """)
+        assert fs == []
+
+    def test_double_acquire_plain_lock_flagged_rlock_not(self):
+        fs = _lock_lint("""
+            import threading
+
+            class A:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._rl = threading.RLock()
+
+                def boom(self):
+                    with self._lock:
+                        with self._lock:
+                            pass
+
+                def fine(self):
+                    with self._rl:
+                        with self._rl:
+                            pass
+            """)
+        assert len(fs) == 1 and "double acquire" in fs[0].message
+
+    def test_lock_order_inversion(self):
+        fs = _lock_lint("""
+            import threading
+            _A = threading.Lock()
+            _B = threading.Lock()
+
+            def one():
+                with _A:
+                    with _B:
+                        pass
+
+            def two():
+                with _B:
+                    with _A:
+                        pass
+            """)
+        assert len(fs) == 2
+        assert all("lock-order inversion" in f.message for f in fs)
+
+    def test_suppression_with_justification(self):
+        fs = _lock_lint("""
+            import threading
+            _LOCK = threading.Lock()
+            _REF = {}   # tpulint: guarded-by _LOCK
+
+            def install(v):
+                with _LOCK:
+                    _REF["x"] = v
+
+            def fast_path():
+                # tpulint: disable=lock-discipline — lock-free by design
+                return _REF.get("x")
+            """)
+        assert fs == []
+
+
+# ============================================================= retrace-risk
+class TestRetraceRisk:
+    RULE = RetraceRiskRule()
+
+    def test_scalar_capture_in_unkeyed_builder(self):
+        fs = _lint("""
+            def build(n):
+                scale = n * 2
+                @jax.jit
+                def kernel(x):
+                    return x * scale
+                return kernel
+            """, self.RULE)
+        assert len(fs) == 1
+        assert "Python scalar 'scale'" in fs[0].message
+        assert "builder argument 'n'" not in fs[0].message
+
+    def test_builder_arg_and_unhashable_capture(self):
+        fs = _lint("""
+            def build(dtypes, mode):
+                recon = [d for d in dtypes]
+                @jax.jit
+                def kernel(x):
+                    for r in recon:
+                        x = x + mode
+                    return x
+                return kernel
+            """, self.RULE)
+        assert len(fs) == 1
+        assert "builder argument 'mode'" in fs[0].message
+        assert "unhashable listcomp 'recon'" in fs[0].message
+
+    def test_loop_variable_capture(self):
+        fs = _lint("""
+            def build_all(specs):
+                out = []
+                for spec in specs:
+                    @jax.jit
+                    def kernel(x):
+                        return x * spec
+                    out.append(kernel)
+                return out
+            """, self.RULE)
+        assert any("loop variable 'spec'" in f.message for f in fs)
+
+    def test_get_or_build_routed_builder_exempt(self):
+        fs = _lint("""
+            def _build(n):
+                scale = n * 2
+                @jax.jit
+                def kernel(x):
+                    return x * scale
+                return kernel
+
+            def resolve(n):
+                from spark_rapids_tpu.plan import exec_cache
+                return exec_cache.get_or_build(("k", n), _build)
+            """, self.RULE)
+        assert fs == []
+
+    def test_memo_dict_builder_exempt(self):
+        fs = _lint("""
+            _CACHE = {}
+
+            def _build(n):
+                scale = n * 2
+                @jax.jit
+                def kernel(x):
+                    return x * scale
+                return kernel
+
+            def resolve(n):
+                kern = _CACHE.get(n)
+                if kern is None:
+                    kern = _build(n)
+                    _CACHE[n] = kern
+                return kern
+            """, self.RULE)
+        assert fs == []
+
+    def test_lru_cache_builder_exempt(self):
+        fs = _lint("""
+            @functools.lru_cache(maxsize=64)
+            def _build(n):
+                scale = n * 2
+                @jax.jit
+                def kernel(x):
+                    return x * scale
+                return kernel
+            """, self.RULE)
+        assert fs == []
+
+    def test_module_level_captures_fine(self):
+        fs = _lint("""
+            SCALE = 4
+
+            @jax.jit
+            def kernel(x):
+                return x * SCALE
+            """, self.RULE)
+        assert fs == []
+
+    def test_static_arg_value_branching(self):
+        fs = _lint("""
+            @functools.partial(jax.jit, static_argnums=(1,))
+            def kernel(x, n):
+                if n > 100:
+                    return x[:n]
+                return x
+            """, self.RULE)
+        assert len(fs) == 1
+        assert "static-arg value" in fs[0].message
+
+    def test_traced_branching_is_hostsyncflow_not_retrace(self):
+        src = """
+            @jax.jit
+            def kernel(x):
+                if x.sum() > 0:
+                    return x
+                return -x
+            """
+        assert _lint(src, self.RULE) == []
+        assert any("truthiness" in f.message
+                   for f in _lint(src, HostSyncFlowRule()))
+
+    def test_set_iteration_in_kernel(self):
+        fs = _lint("""
+            def build(names):
+                wanted = set(names)
+                @jax.jit
+                def kernel(x):
+                    for n in wanted:
+                        x = x + 1
+                    return x
+                return kernel
+            """, self.RULE)
+        assert any("set iteration" in f.message for f in fs)
+
+    def test_sorted_set_iteration_clean(self):
+        fs = _lint("""
+            def build(names):
+                wanted = sorted(set(names))
+                @jax.jit
+                def kernel(x):
+                    for n in wanted:
+                        x = x + 1
+                    return x
+                return kernel
+            """, self.RULE)
+        assert not any("set iteration" in f.message for f in fs)
+
+    def test_unhashable_key_component(self):
+        fs = _lint("""
+            def resolve(exprs, build):
+                from spark_rapids_tpu.plan import exec_cache
+                return exec_cache.get_or_build([e.key() for e in exprs],
+                                               build)
+            """, self.RULE)
+        assert any("unhashable" in f.message for f in fs)
+
+    def test_key_arg_locals_scoped_per_function(self):
+        # a set-typed local in one function must not contaminate a
+        # same-named tuple local feeding a key in another function
+        fs = _lint("""
+            def a(xs):
+                parts = {1, 2}
+                return sorted(parts)
+
+            def b(cols, build, fused_key):
+                parts = tuple(cols)
+                return fused_key("d", parts)
+            """, self.RULE)
+        assert fs == []
+
+    def test_set_tuple_into_key(self):
+        fs = _lint("""
+            def resolve(names, build, fused_key):
+                cols = set(names)
+                key = fused_key("agg", tuple(cols))
+                return key
+            """, self.RULE)
+        assert any("unsorted set" in f.message for f in fs)
+
+    def test_sorted_tuple_key_clean(self):
+        fs = _lint("""
+            def resolve(names, build, fused_key):
+                key = fused_key("agg", tuple(sorted(set(names))))
+                return key
+            """, self.RULE)
+        assert fs == []
+
+    def test_suppression(self):
+        fs = _lint("""
+            def build(n):
+                scale = n * 2
+                # tpulint: disable=retrace-risk — rebuilt at most twice
+                @jax.jit
+                def kernel(x):
+                    return x * scale
+                return kernel
+            """, self.RULE)
+        assert fs == []
+
+
+# ========================================================== dataflow engine
+class TestCfgDataflow:
+    def _fn(self, src, name=None):
+        import ast as _ast
+        tree = _ast.parse(textwrap.dedent(src))
+        for node in _ast.walk(tree):
+            if isinstance(node, _ast.FunctionDef) and \
+                    (name is None or node.name == name):
+                return node
+        raise AssertionError("no function found")
+
+    def test_reaching_defs_kill(self):
+        import ast as _ast
+        from spark_rapids_tpu.tools.lint.dataflow import ReachingDefs
+        fn = self._fn("""
+            def f(a):
+                x = 1
+                x = 2
+                return x
+            """)
+        rd = ReachingDefs(fn)
+        ret = [e for b in rd.cfg.blocks for e in b.elems
+               if isinstance(e, _ast.Return)][0]
+        defs = rd.defs_at(ret, "x")
+        assert len(defs) == 1
+        (d,) = defs
+        assert d.value.value == 2          # only the second assign reaches
+
+    def test_reaching_defs_join_over_branches(self):
+        import ast as _ast
+        from spark_rapids_tpu.tools.lint.dataflow import ReachingDefs
+        fn = self._fn("""
+            def f(c):
+                x = 1
+                if c:
+                    x = 2
+                return x
+            """)
+        rd = ReachingDefs(fn)
+        ret = [e for b in rd.cfg.blocks for e in b.elems
+               if isinstance(e, _ast.Return)][0]
+        assert len(rd.defs_at(ret, "x")) == 2   # both defs reach the join
+
+    def test_taint_joins_over_branches(self):
+        import ast as _ast
+        from spark_rapids_tpu.tools.lint.dataflow import (TaintAnalysis,
+                                                          TaintSpec)
+        fn = self._fn("""
+            def f(src, c):
+                x = 0
+                if c:
+                    x = src
+                y = x
+                return y
+            """)
+        ta = TaintAnalysis(fn, TaintSpec(),
+                           seeds={"src": frozenset(["T"])})
+        rets = [(e, env) for e, env in ta.walk()
+                if isinstance(e, _ast.Return)]
+        (ret, env), = rets
+        assert ta.eval(ret.value, env) == frozenset(["T"])
+
+    def test_loop_taint_reaches_fixpoint(self):
+        import ast as _ast
+        from spark_rapids_tpu.tools.lint.dataflow import (TaintAnalysis,
+                                                          TaintSpec)
+        fn = self._fn("""
+            def f(src, n):
+                acc = 0
+                for i in range(n):
+                    acc = acc + src
+                return acc
+            """)
+        ta = TaintAnalysis(fn, TaintSpec(),
+                           seeds={"src": frozenset(["T"])})
+        (ret, env), = [(e, env) for e, env in ta.walk()
+                       if isinstance(e, _ast.Return)]
+        assert "T" in ta.eval(ret.value, env)
+
+    def test_summaries_return_and_param_flow(self):
+        import ast as _ast
+        from spark_rapids_tpu.tools.lint.dataflow import (Summaries,
+                                                          TaintSpec)
+        tree = _ast.parse(textwrap.dedent("""
+            def ident(a, b):
+                return b
+            """))
+        summ = Summaries(tree, lambda s: TaintSpec())
+        s = summ.get("ident")
+        assert s.return_labels == frozenset([1])
+
+
+# ======================================================= formats + baseline
+class TestFormatsAndBaseline:
+    def _result(self, tmp_path):
+        p = tmp_path / "mod.py"
+        p.write_text(textwrap.dedent(VIOLATING))
+        return run_lint([str(p)], rules=[BatchLifetimeRule()],
+                        root=str(tmp_path))
+
+    def test_json_deterministic_and_counted(self, tmp_path):
+        import json as _json
+        from spark_rapids_tpu.tools.lint.formats import render_json
+        res = self._result(tmp_path)
+        one, two = render_json(res), render_json(res)
+        assert one == two
+        doc = _json.loads(one)
+        assert doc["version"] == 1
+        assert doc["counts"]["new"] == len(res.new) == 1
+        f = doc["findings"][0]
+        assert f["status"] == "new" and f["rule"] == "batch-lifetime"
+        assert f["fingerprint"].startswith("batch-lifetime::")
+
+    def test_sarif_minimal_schema_and_determinism(self, tmp_path):
+        import json as _json
+        from spark_rapids_tpu.tools.lint.formats import render_sarif
+        res = self._result(tmp_path)
+        rules = [BatchLifetimeRule()]
+        one, two = render_sarif(res, rules), render_sarif(res, rules)
+        assert one == two
+        doc = _json.loads(one)
+        assert doc["version"] == "2.1.0"
+        run = doc["runs"][0]
+        assert run["tool"]["driver"]["name"] == "tpulint"
+        ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+        assert "batch-lifetime" in ids
+        res0 = run["results"][0]
+        assert res0["message"]["text"]
+        loc = res0["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"].endswith("mod.py")
+        assert loc["region"]["startLine"] >= 1
+        assert "suppressions" not in res0      # new finding
+
+    def test_sarif_marks_baselined_suppressed(self, tmp_path):
+        import json as _json
+        from spark_rapids_tpu.tools.lint.formats import render_sarif
+        p = tmp_path / "mod.py"
+        p.write_text(textwrap.dedent(VIOLATING))
+        bl = str(tmp_path / "bl.json")
+        first = run_lint([str(p)], rules=[BatchLifetimeRule()],
+                         root=str(tmp_path))
+        write_baseline(first.new, bl)
+        res = run_lint([str(p)], rules=[BatchLifetimeRule()],
+                       baseline=load_baseline(bl), root=str(tmp_path))
+        doc = _json.loads(render_sarif(res, [BatchLifetimeRule()]))
+        res0 = doc["runs"][0]["results"][0]
+        assert res0["suppressions"][0]["kind"] == "external"
+
+    def test_prune_baseline_drops_stale(self, tmp_path):
+        p = tmp_path / "mod.py"
+        p.write_text(textwrap.dedent(VIOLATING))
+        bl = str(tmp_path / "bl.json")
+        first = run_lint([str(p)], rules=[BatchLifetimeRule()],
+                         root=str(tmp_path))
+        write_baseline(first.new, bl)
+        # fix the violation: the baseline entry goes stale
+        p.write_text("def f():\n    return 1\n")
+        cur = run_lint([str(p)], rules=[BatchLifetimeRule()],
+                       root=str(tmp_path))
+        kept, pruned = prune_baseline(cur.findings, bl)
+        assert (kept, pruned) == (0, 1)
+        assert load_baseline(bl) == {}
+
+    def test_prune_baseline_keeps_live(self, tmp_path):
+        p = tmp_path / "mod.py"
+        p.write_text(textwrap.dedent(VIOLATING))
+        bl = str(tmp_path / "bl.json")
+        first = run_lint([str(p)], rules=[BatchLifetimeRule()],
+                         root=str(tmp_path))
+        write_baseline(first.new, bl)
+        cur = run_lint([str(p)], rules=[BatchLifetimeRule()],
+                       root=str(tmp_path))
+        kept, pruned = prune_baseline(cur.findings, bl)
+        assert (kept, pruned) == (1, 0)
+
+    def test_changed_files_git_unavailable_returns_none(self, tmp_path):
+        from spark_rapids_tpu.tools.lint.framework import \
+            changed_python_files
+        assert changed_python_files("HEAD", str(tmp_path)) is None
+
+    def test_cli_format_json_on_clean_file(self, tmp_path, capsys):
+        import json as _json
+        from spark_rapids_tpu.tools.lint.__main__ import main
+        p = tmp_path / "clean.py"
+        p.write_text("def f(x):\n    return x + 1\n")
+        assert main([str(p), "--format=json"]) == 0
+        doc = _json.loads(capsys.readouterr().out)
+        assert doc["counts"]["new"] == 0

@@ -38,6 +38,38 @@ def test_repo_is_lint_clean():
         f"a justification (docs/static_analysis.md):\n{listing}")
 
 
+def test_dataflow_rules_registered():
+    """The tpulint v2 dataflow rules ship in ALL_RULES (so the clean-tree
+    gate above transitively enforces lock discipline, host-sync flow and
+    retrace risk on every pytest run) and carry contracts for
+    --list-rules."""
+    names = {r.name for r in ALL_RULES}
+    for rule in ("lock-discipline", "host-sync-flow", "retrace-risk"):
+        assert rule in names, f"{rule} not registered"
+    for r in ALL_RULES:
+        assert r.contract, f"{r.name} has no contract line"
+
+
+def test_lock_discipline_guards_annotated_modules():
+    """The guarded-by annotations across the lock-holding modules parse
+    and resolve (a broken annotation is itself a finding, which the
+    clean-tree gate would catch — this asserts the inverse: they exist)."""
+    import re
+    pat = re.compile(r"#\s*tpulint:\s*guarded-by\s+[\w.]+")
+    annotated = []
+    for dirpath, _dirs, files in os.walk(PKG_ROOT):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            with open(os.path.join(dirpath, fn), encoding="utf-8") as fh:
+                if pat.search(fh.read()):
+                    annotated.append(fn)
+    # the shared caches/registries the serving roadmap depends on
+    for expected in ("exec_cache.py", "registry.py", "manager.py",
+                     "heartbeat.py"):
+        assert expected in annotated, (expected, sorted(annotated))
+
+
 def test_no_tool_errors():
     # a rule crashing (or the registries failing to import) degrades to
     # tool-error findings; those must never be baselined away silently
