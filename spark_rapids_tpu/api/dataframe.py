@@ -766,24 +766,72 @@ class DataFrame:
         # record_engine_wall / record_op_wall exec-cache-hit keying)
         from ..plan import exec_cache
         cache_before = exec_cache.stats()
-        t0 = _time.perf_counter()
-        ok = False
-        try:
+        # ---------------- query-lifecycle controller (ISSUE 14) --------
+        # cooperative deadline: every operator checks it per produced
+        # batch and the semaphore polls it, so a timed-out query unwinds
+        # through the normal exception path (permits released, batches
+        # closed — the zero-leak audit holds)
+        from ..config import QUERY_TIMEOUT
+        from ..mem.manager import (OutOfDeviceMemory, RetryOOM,
+                                   SplitAndRetryOOM)
+        from ..mem.semaphore import QueryTimeout
+        qt = float(self.session.conf.get(QUERY_TIMEOUT))
+        ctx.set_query_deadline(_time.monotonic() + qt if qt > 0 else None)
+        ctx.take_oom_degradations()          # per-query reset
+        degs: List[dict] = []
+
+        def _attempt(p):
+            """One full run of the plan through the execution pipeline,
+            with the speculative-sizing overflow retry inside (plans
+            with side effects run with speculation off, so this inner
+            retry can never duplicate output files)."""
             try:
                 out = DeviceDumpHandler(self.session.conf).wrap(
-                    lambda: consume(physical, ctx), physical)
+                    lambda: consume(p, ctx), p)
                 ctx.check_speculations()
-                ok = True
                 return out
             except SpeculativeOverflow:
                 ctx.speculate = False
                 ctx.speculations.clear()
                 ctx.metrics.clear()
-                out = DeviceDumpHandler(self.session.conf).wrap(
-                    lambda: consume(physical, ctx), physical)
+                return DeviceDumpHandler(self.session.conf).wrap(
+                    lambda: consume(p, ctx), p)
+
+        def _note_timeout():
+            from ..metrics import registry as _mr
+            if _mr.REGISTRY is not None:
+                _mr.REGISTRY.counter("srtpu_query_timeout_total").inc()
+
+        t0 = _time.perf_counter()
+        ok = False
+        try:
+            try:
+                out = _attempt(physical)
                 ok = True
                 return out
+            except (RetryOOM, SplitAndRetryOOM, OutOfDeviceMemory) as e:
+                # an OOM escaped every operator-level retry frame (a
+                # reserve outside any with_retry scope, or a ladder with
+                # host fallback disabled). Side-effecting plans must not
+                # re-run — a retry could duplicate output files.
+                if side_effects:
+                    raise
+                try:
+                    out = self._oom_query_ladder(e, physical, ctx,
+                                                 _attempt, consume)
+                except QueryTimeout:
+                    # raised from inside this handler, so the sibling
+                    # except below never sees it — count it here
+                    _note_timeout()
+                    raise
+                ok = True
+                return out
+            except QueryTimeout:
+                _note_timeout()
+                raise
         finally:
+            ctx.set_query_deadline(None)
+            degs = ctx.take_oom_degradations()
             prof.maybe_stop()
             self.session.last_query_metrics = tm.finish()
             if tracer is not None:
@@ -809,6 +857,17 @@ class DataFrame:
                         logging.getLogger(__name__).warning(
                             "could not write trace to %s: %s",
                             out_path, e)
+            if degs and report is not None:
+                # runtime pressure degradations join the query's coded
+                # placement report: explain-analyze renderers, the
+                # session summary and the event log all see the operator
+                # that fell back (the only tag recorded AFTER planning)
+                from ..plan.tags import OOM_PRESSURE_HOST, make_tag
+                for d in degs:
+                    report.plan_tags.append(make_tag(
+                        OOM_PRESSURE_HOST, d["detail"], node=d["op"]))
+                placement_summary = report.summary()
+                self.session.last_placement_report = placement_summary
             from ..metrics import registry as metrics_registry
             mreg = metrics_registry.REGISTRY
             wall_s = _time.perf_counter() - t0
@@ -818,14 +877,24 @@ class DataFrame:
                 mreg.histogram("srtpu_query_seconds").observe(wall_s)
             if elog is not None:
                 from ..aux.metrics import metrics_to_json
-                elog.write({"event": "queryEnd", "queryId": qid,
-                            "planDigest": digest, "ok": ok,
-                            "durationMs": round(wall_s * 1000.0, 3),
-                            "metrics": metrics_to_json(
-                                self.session.last_query_metrics),
-                            "faultStats": self.session.last_fault_stats,
-                            "trace": trace_path})
-            if ok and not side_effects:
+                end_rec = {"event": "queryEnd", "queryId": qid,
+                           "planDigest": digest, "ok": ok,
+                           "durationMs": round(wall_s * 1000.0, 3),
+                           "metrics": metrics_to_json(
+                               self.session.last_query_metrics),
+                           "faultStats": self.session.last_fault_stats,
+                           "trace": trace_path}
+                if degs:
+                    # queryStart already shipped the plan-time summary;
+                    # degradations are runtime facts, so the END record
+                    # carries them (and the refreshed placement summary
+                    # tools/qualify prefers when present)
+                    end_rec["oomDegradations"] = degs
+                    end_rec["placement"] = placement_summary
+                elog.write(end_rec)
+            if ok and not side_effects and not degs:
+                # (a degraded run's wall mixes failed attempts and the
+                # emergency host path — never feed it to the cost model)
                 # measured whole-query wall per (shape, engine placement):
                 # the cost optimizer prefers these over its model, so a
                 # mispriced engine choice self-corrects on the next
@@ -869,6 +938,39 @@ class DataFrame:
                         digest = getattr(physical, "plan_digest", None)
                     if digest is not None:
                         exec_cache.record_plan_compiled(digest)
+
+    def _oom_query_ladder(self, err, physical, ctx, attempt, consume):
+        """Query-level OOM escalation — the controller's backstop for an
+        OOM that escaped every operator retry frame (a reserve outside
+        any with_retry scope). Rung A: spill EVERY live session's
+        spillables and re-run the plan once on the device. Rung B
+        (``spark.rapids.tpu.oom.hostFallback.enabled``): re-plan the
+        query onto the host engine and run it under an unbudgeted
+        pressure grant, recorded as a whole-query OOM_PRESSURE_HOST
+        degradation — pressure degrades *placement*, never results."""
+        from ..mem.manager import (MemoryManager, OutOfDeviceMemory,
+                                   RetryOOM, SplitAndRetryOOM)
+        MemoryManager.spill_all_sessions()
+        ctx.memory.spill_everything()    # explicit managers too
+        ctx.metrics.clear()
+        ctx.speculations.clear()
+        try:
+            return attempt(physical)
+        except (RetryOOM, SplitAndRetryOOM, OutOfDeviceMemory) as e2:
+            from ..config import OOM_HOST_FALLBACK_ENABLED
+            if not bool(self.session.conf.get(OOM_HOST_FALLBACK_ENABLED)):
+                raise
+            ctx.record_oom_degradation(
+                "Query", "whole-query host degradation after "
+                f"{type(e2).__name__}: {e2}")
+            host_conf = self.session.conf.set(
+                "spark.rapids.tpu.sql.enabled", False)
+            host_physical = plan_query(self.plan, host_conf)
+            ctx.metrics.clear()
+            ctx.speculations.clear()
+            ctx.speculate = False
+            with ctx.memory.pressure_host_grant():
+                return consume(host_physical, ctx)
 
     def collect_arrow(self):
         return self._execute_wrapped(lambda p, ctx: p.collect(ctx))

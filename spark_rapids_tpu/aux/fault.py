@@ -45,12 +45,16 @@ CORE_DUMP_PATH = register(
 
 CHAOS_SPEC = register(
     "spark.rapids.tpu.chaos.spec", "",
-    "Fault-injection spec for the distributed runtime; empty disables. "
+    "Fault-injection spec for the runtime; empty disables. "
     "Semicolon-separated `site=when` entries where `when` is an integer "
     "N (fire exactly on the Nth hit of that site), `pX` (fire with "
-    "probability X per hit, seeded), or `*` (every hit). Sites: "
-    "put.corrupt, put.drop, put.delay, fetch.corrupt, fetch.delay, "
-    "task.delay, worker.kill. The distributed analog of the OOM "
+    "probability X per hit, seeded), or `*` (every hit). Transport/"
+    "cluster sites: put.corrupt, put.drop, put.delay, fetch.corrupt, "
+    "fetch.delay, task.delay, worker.kill. Memory/semaphore sites "
+    "(docs/fault_tolerance.md): mem.oom (MemoryManager.reserve raises "
+    "an injected RetryOOM), mem.reserve.delay (reserve sleeps delayMs), "
+    "sem.stall (a successful semaphore acquire stalls delayMs while "
+    "HOLDING the permit). The config-driven analog of the OOM "
     "injection hooks (ref RmmSpark.forceRetryOOM).")
 
 CHAOS_SEED = register(
@@ -127,7 +131,10 @@ class DeviceDumpHandler:
 #: spec error — named sites are the contract between the controller and
 #: the transport/cluster hooks, like the reference's typed message enum)
 CHAOS_SITES = ("put.corrupt", "put.drop", "put.delay", "fetch.corrupt",
-               "fetch.delay", "task.delay", "worker.kill")
+               "fetch.delay", "task.delay", "worker.kill",
+               # memory / semaphore sites (mem/manager.py reserve(),
+               # mem/semaphore.py acquire()) — ISSUE 14 pressure battery
+               "mem.oom", "mem.reserve.delay", "sem.stall")
 
 
 class ChaosController:
@@ -148,6 +155,10 @@ class ChaosController:
         self._lock = threading.Lock()
         self._counts: Dict[str, int] = {}  # tpulint: guarded-by _lock
         self._fired: List[Tuple[str, int]] = []  # tpulint: guarded-by _lock
+        # site -> distinct caller contexts that fired (mem.* sites record
+        # the operator-level reserve site so the chaos battery can assert
+        # coverage breadth, e.g. "mem.oom hit >= 3 distinct reserve sites")
+        self._contexts: Dict[str, set] = {}  # tpulint: guarded-by _lock
         self._rules: Dict[str, Tuple[str, float]] = {}
         self._rngs: Dict[str, "object"] = {}
         for entry in str(spec).split(";"):
@@ -218,6 +229,17 @@ class ChaosController:
     def maybe_delay(self, site: str) -> None:
         if self.fires(site):
             time.sleep(self.delay_ms / 1000.0)
+
+    def note_context(self, site: str, detail: str) -> None:
+        """Record the caller context of a fired injection (mem.* sites
+        pass the operator-level reserve site, e.g. 'sort.py:do_sort')."""
+        with self._lock:
+            self._contexts.setdefault(site, set()).add(detail)
+
+    def contexts(self, site: str) -> List[str]:
+        """Distinct caller contexts recorded for a site, sorted."""
+        with self._lock:
+            return sorted(self._contexts.get(site, ()))
 
     def fired(self) -> List[Tuple[str, int]]:
         with self._lock:

@@ -170,6 +170,25 @@ OOM_RETRY_ENABLED = register(
     "Enable the per-thread OOM retry/split state machine "
     "(ref RmmRapidsRetryIterator.scala:33).")
 
+OOM_MAX_SPLIT_DEPTH = register(
+    "spark.rapids.tpu.oom.maxSplitDepth", 8,
+    "How many times a single input batch may be halved by the "
+    "SplitAndRetryOOM rung of the retry state machine before the "
+    "escalation ladder moves on (cross-session pressure spill, then the "
+    "OOM_PRESSURE_HOST degradation rung — mem/retry.py, "
+    "docs/fault_tolerance.md). Depth 8 means pieces as small as "
+    "1/256th of the original batch.")
+
+OOM_HOST_FALLBACK_ENABLED = register(
+    "spark.rapids.tpu.oom.hostFallback.enabled", True,
+    "Allow the final rung of the OOM escalation ladder: after retries, "
+    "splits and a cross-session pressure spill all fail, run the one "
+    "starving operator on the host backend under an unbudgeted memory "
+    "grant instead of failing the query (recorded as an "
+    "OOM_PRESSURE_HOST placement tag and counted by "
+    "srtpu_oom_host_fallback_total). Off = the ladder ends in "
+    "OutOfDeviceMemory, the pre-r14 behavior.")
+
 ADAPTIVE_ENABLED = register(
     "spark.rapids.tpu.sql.adaptive.enabled", True,
     "Adaptive execution: post-shuffle partition coalescing by observed "
@@ -334,6 +353,26 @@ CPU_FALLBACK_ENABLED = register(
 TASK_TIMEOUT = register(
     "spark.rapids.tpu.task.semaphore.timeoutSeconds", 600,
     "Max seconds a task waits on the device semaphore before erroring.")
+
+SEMAPHORE_WEDGE_TIMEOUT_MS = register(
+    "spark.rapids.tpu.semaphore.wedgeTimeoutMs", 10000,
+    "Wedge-watchdog horizon for the device semaphore: a task blocked in "
+    "acquire() for this long wakes up, dumps a holder/waiter/held-bytes "
+    "diagnostic, and force-releases permits whose holder THREAD is dead "
+    "(a killed worker can no longer wedge every later query; counted by "
+    "srtpu_semaphore_wedge_total). <= 0 disables the watchdog — waits "
+    "block until task.semaphore.timeoutSeconds as before.")
+
+QUERY_TIMEOUT = register(
+    "spark.rapids.tpu.query.timeout", 0.0,
+    "Whole-query deadline in seconds, enforced by cooperative "
+    "cancellation: every operator checks the deadline at each produced "
+    "batch (and semaphore waits poll it), so a timed-out query unwinds "
+    "through the normal exception path — the device semaphore is "
+    "released and every spillable batch is closed (the zero-leak audit "
+    "holds). Raises QueryTimeout; counted by srtpu_query_timeout_total. "
+    "0 disables (ref spark.sql.broadcastTimeout / spark.network.timeout "
+    "query-level analogs).")
 
 
 class TpuConf:

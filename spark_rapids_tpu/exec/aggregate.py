@@ -1203,7 +1203,7 @@ class TpuHashAggregateExec(TpuExec):
             def run_fast():
                 with ctx.semaphore.held():
                     return self._fast_single_batch(ctx, first, update_k)
-            out = with_retry_no_split(run_fast, ctx.memory)
+            out = with_retry_no_split(run_fast, ctx=ctx, op=self._exec_id)
             if out is not None:
                 disp_m.add(1)    # fused update+finalize: one module
                 _FAST_GROUPS[self._kernel_key] = out.num_rows
@@ -1259,7 +1259,8 @@ class TpuHashAggregateExec(TpuExec):
                     import numpy as _np
                     return [int(x) for x in
                             _np.asarray(jnp.stack([w[1] for w in window]))]
-                counts = with_retry_no_split(resolve_counts, ctx.memory)
+                counts = with_retry_no_split(resolve_counts, ctx=ctx,
+                                             op=self._exec_id)
             for (outs, _, dispatch, base, n_disp), n in zip(window,
                                                             counts):
                 if n > spec:
@@ -1272,7 +1273,8 @@ class TpuHashAggregateExec(TpuExec):
                     def redo(d=dispatch):
                         with ctx.semaphore.held():
                             return d()[0]
-                    outs = with_retry_no_split(redo, ctx.memory)
+                    outs = with_retry_no_split(redo, ctx=ctx,
+                                               op=self._exec_id)
                 pb = self._slice_to_count(outs, n, self._partial_schema)
                 for val_o, pos_o in pos_partials:
                     vcol, pcol = pb.columns[val_o], pb.columns[pos_o]
@@ -1284,58 +1286,67 @@ class TpuHashAggregateExec(TpuExec):
                 partials.append(SpillableBatch(pb, ctx.memory))
             window.clear()
 
-        for batch in itertools.chain(pending, it):
-            batch = batch.ensure_device()
-            if self._rect_mode:
-                batch = self._ensure_rect_cols(
-                    batch, self._rect_key_ordinals_for(batch))
-            direct = self._direct_update_args(batch)
-            if direct is not None:
-                kern, (cards, pairs, remaps) = direct
-                _check_scalar_slots(kern, self._upd_scalars)
-                n_disp = 1
-                disp_m.add(n_disp)
+        try:
+            for batch in itertools.chain(pending, it):
+                batch = batch.ensure_device()
+                if self._rect_mode:
+                    batch = self._ensure_rect_cols(
+                        batch, self._rect_key_ordinals_for(batch))
+                direct = self._direct_update_args(batch)
+                if direct is not None:
+                    kern, (cards, pairs, remaps) = direct
+                    _check_scalar_slots(kern, self._upd_scalars)
+                    n_disp = 1
+                    disp_m.add(n_disp)
 
-                def dispatch(b=batch, k=kern, c=cards, p=pairs, r=remaps):
-                    base_cols = [(cc.data, cc.validity)
-                                 if isinstance(cc, DeviceColumn) else None
-                                 for cc in b.columns]
-                    ko, po, ng = k(base_cols, jnp.int32(b.num_rows_raw),
-                                   b.padded_len, c, self._upd_scalars,
-                                   p, r)
-                    return list(ko) + list(po), ng
-            else:
-                codes = [] if self._rect_mode else self._augment(batch)
-                n_disp = getattr(update_k_split, "n_dispatches", 1)
-                disp_m.add(n_disp)
+                    def dispatch(b=batch, k=kern, c=cards, p=pairs, r=remaps):
+                        base_cols = [(cc.data, cc.validity)
+                                     if isinstance(cc, DeviceColumn) else None
+                                     for cc in b.columns]
+                        ko, po, ng = k(base_cols, jnp.int32(b.num_rows_raw),
+                                       b.padded_len, c, self._upd_scalars,
+                                       p, r)
+                        return list(ko) + list(po), ng
+                else:
+                    codes = [] if self._rect_mode else self._augment(batch)
+                    n_disp = getattr(update_k_split, "n_dispatches", 1)
+                    disp_m.add(n_disp)
 
-                def dispatch(b=batch, extra=codes):
-                    return self._run_kernel_raw(
-                        update_k_split, b, extra_cols=extra,
-                        scalars=self._upd_scalars)
+                    def dispatch(b=batch, extra=codes):
+                        return self._run_kernel_raw(
+                            update_k_split, b, extra_cols=extra,
+                            scalars=self._upd_scalars)
 
-            def _spec_slice(d_, v):
-                from ..exprs.base import StrVal
-                if isinstance(d_, StrVal):
-                    if spec < d_.bytes_.shape[0]:
-                        return (StrVal(d_.bytes_[:spec],
-                                       d_.lengths[:spec]), v[:spec])
+                def _spec_slice(d_, v):
+                    from ..exprs.base import StrVal
+                    if isinstance(d_, StrVal):
+                        if spec < d_.bytes_.shape[0]:
+                            return (StrVal(d_.bytes_[:spec],
+                                           d_.lengths[:spec]), v[:spec])
+                        return (d_, v)
+                    if spec < d_.shape[0]:
+                        return (d_[:spec], v[:spec])
                     return (d_, v)
-                if spec < d_.shape[0]:
-                    return (d_[:spec], v[:spec])
-                return (d_, v)
 
-            def first_pass(d=dispatch):
-                with ctx.semaphore.held():
-                    outs, ng = d()
-                    return [_spec_slice(d_, v) for d_, v in outs], ng
-            # idempotent over the input batch -> retry-safe
-            outs, ng = with_retry_no_split(first_pass, ctx.memory)
-            window.append((outs, ng, dispatch, row_base, n_disp))
-            row_base += batch.padded_len
-            if len(window) >= WINDOW:
-                flush_window()
-        flush_window()
+                def first_pass(d=dispatch):
+                    with ctx.semaphore.held():
+                        outs, ng = d()
+                        return [_spec_slice(d_, v) for d_, v in outs], ng
+                # idempotent over the input batch -> retry-safe
+                outs, ng = with_retry_no_split(first_pass, ctx=ctx,
+                                               op=self._exec_id)
+                window.append((outs, ng, dispatch, row_base, n_disp))
+                row_base += batch.padded_len
+                if len(window) >= WINDOW:
+                    flush_window()
+            flush_window()
+        except BaseException:
+            # fatal error (or cooperative QueryTimeout) mid-update:
+            # accumulated partials would outlive the query and pin
+            # pool budget — the zero-leak audit's contract
+            for sb in partials:
+                sb.close()
+            raise
 
         total = sum(sb.device_bytes() for sb in partials)
         if (self.groupings and partials
@@ -1414,7 +1425,8 @@ class TpuHashAggregateExec(TpuExec):
                         return self._run_kernel(merge_k, big,
                                                 self._partial_schema)
                 try:
-                    merged = with_retry_no_split(merge_part, ctx.memory)
+                    merged = with_retry_no_split(merge_part, ctx=ctx,
+                                                 op=self._exec_id)
                 finally:
                     for s in parts:
                         s.close()
@@ -1460,59 +1472,74 @@ class TpuHashAggregateExec(TpuExec):
                   max(sb.padded_len for sb in partials))
         level: List[SpillableBatch] = list(partials)
 
-        while len(level) > 1 and \
-                sum(sb.padded_len for sb in level) > cap:
-            # greedy chunking by padded length
-            chunks, cur, acc = [], [], 0
-            for sb in level:
-                if cur and acc + sb.padded_len > cap:
-                    chunks.append(cur)
-                    cur, acc = [], 0
-                cur.append(sb)
-                acc += sb.padded_len
-            chunks.append(cur)
-            raws = []
-            for chunk in chunks:
-                if len(chunk) == 1:
-                    raws.append(chunk[0])    # spillable passthrough
-                    continue
+        merged_level: List = []
+        try:
+            while len(level) > 1 and \
+                    sum(sb.padded_len for sb in level) > cap:
+                # greedy chunking by padded length
+                chunks, cur, acc = [], [], 0
+                for sb in level:
+                    if cur and acc + sb.padded_len > cap:
+                        chunks.append(cur)
+                        cur, acc = [], 0
+                    cur.append(sb)
+                    acc += sb.padded_len
+                chunks.append(cur)
+                raws = []
+                for chunk in chunks:
+                    if len(chunk) == 1:
+                        raws.append(chunk[0])    # spillable passthrough
+                        continue
 
-                def level_merge(c=chunk):
-                    with ctx.semaphore.held():
-                        big = concat_batches([s.get() for s in c])
-                        if self._rect_mode:
-                            big = self._ensure_rect_cols(
-                                big, range(len(self.groupings)))
-                        return self._run_kernel_raw(merge_k, big)
-                raws.append(with_retry_no_split(level_merge, ctx.memory))
-            ngs = [r[1] for r in raws if isinstance(r, tuple)]
-            if len(ngs) > 1:
-                def resolve():
-                    import numpy as _np
-                    return [int(x) for x in _np.asarray(jnp.stack(ngs))]
-                counts = iter(with_retry_no_split(resolve, ctx.memory))
-            else:
-                counts = iter([int(ngs[0])] if ngs else [])
-            merged_level = []
-            for r in raws:
-                if not isinstance(r, tuple):
-                    merged_level.append(r)
-                    continue
-                pb = self._slice_to_count(r[0], next(counts),
-                                          self._partial_schema)
-                merged_level.append(SpillableBatch(pb, ctx.memory))
-            # consumed chunk inputs can release now (their content lives
-            # on in the level outputs)
-            for sb in level:
-                if sb not in merged_level:
-                    sb.close()
-            if len(merged_level) >= len(level):
-                # no progress (every chunk was a singleton — all partials
-                # at cap size): fall through to one oversized merge rather
-                # than loop forever
+                    def level_merge(c=chunk):
+                        with ctx.semaphore.held():
+                            big = concat_batches([s.get() for s in c])
+                            if self._rect_mode:
+                                big = self._ensure_rect_cols(
+                                    big, range(len(self.groupings)))
+                            return self._run_kernel_raw(merge_k, big)
+                    raws.append(with_retry_no_split(level_merge, ctx=ctx,
+                                                    op=self._exec_id))
+                ngs = [r[1] for r in raws if isinstance(r, tuple)]
+                if len(ngs) > 1:
+                    def resolve():
+                        import numpy as _np
+                        return [int(x) for x in _np.asarray(jnp.stack(ngs))]
+                    counts = iter(with_retry_no_split(resolve, ctx=ctx,
+                                                      op=self._exec_id))
+                else:
+                    counts = iter([int(ngs[0])] if ngs else [])
+                merged_level = []
+                for r in raws:
+                    if not isinstance(r, tuple):
+                        merged_level.append(r)
+                        continue
+                    pb = self._slice_to_count(r[0], next(counts),
+                                              self._partial_schema)
+                    merged_level.append(SpillableBatch(pb, ctx.memory))
+                # consumed chunk inputs can release now (their content lives
+                # on in the level outputs)
+                for sb in level:
+                    if sb not in merged_level:
+                        sb.close()
+                if len(merged_level) >= len(level):
+                    # no progress (every chunk was a singleton — all partials
+                    # at cap size): fall through to one oversized merge rather
+                    # than loop forever
+                    level = merged_level
+                    break
                 level = merged_level
-                break
-            level = merged_level
+        except BaseException:
+            # fatal error (or QueryTimeout) mid-tree: the current
+            # level's inputs AND any outputs already merged at this
+            # level must release (close() is idempotent — items that
+            # moved between the lists close once)
+            for sb in level:
+                sb.close()
+            for sb in merged_level:
+                if isinstance(sb, SpillableBatch):
+                    sb.close()
+            raise
 
         def do_merge() -> ColumnarBatch:
             with ctx.semaphore.held():
@@ -1529,7 +1556,7 @@ class TpuHashAggregateExec(TpuExec):
         try:
             if len(level) == 1:
                 return level[0].get()
-            return with_retry_no_split(do_merge, ctx.memory)
+            return with_retry_no_split(do_merge, ctx=ctx, op=self._exec_id)
         finally:
             for sb in level:
                 sb.close()

@@ -7,16 +7,18 @@ the device semaphore gates concurrent device work (GpuSemaphore.scala:51).
 from __future__ import annotations
 
 import itertools
+import threading
 import time
 from typing import Dict, Iterator, List, Optional
 
 from ..columnar import ColumnarBatch
 from ..config import TpuConf
+from ..mem.semaphore import QueryTimeout
 from ..trace import core as trace_core
 from ..types import Schema
 
 __all__ = ["ExecContext", "TpuExec", "Metric", "ESSENTIAL", "MODERATE",
-           "DEBUG"]
+           "DEBUG", "QueryTimeout"]
 
 ESSENTIAL, MODERATE, DEBUG = "ESSENTIAL", "MODERATE", "DEBUG"
 
@@ -59,16 +61,69 @@ class ExecContext:
         # never per kernel — plan/exec_cache.py)
         from ..plan import exec_cache
         exec_cache.configure_from_conf(self.conf)
-        self.semaphore = semaphore or DeviceSemaphore(
-            self.conf.concurrent_tpu_tasks)
+        from ..config import SEMAPHORE_WEDGE_TIMEOUT_MS, TASK_TIMEOUT
         self.memory = memory or MemoryManager.get(self.conf)
+        self.semaphore = semaphore or DeviceSemaphore(
+            self.conf.concurrent_tpu_tasks,
+            timeout_s=float(self.conf.get(TASK_TIMEOUT)),
+            wedge_timeout_ms=int(self.conf.get(SEMAPHORE_WEDGE_TIMEOUT_MS)),
+            memory=self.memory)
         self.metrics: Dict[str, Dict[str, Metric]] = {}
         self._cleanups = []
+        #: query-lifecycle cooperative deadline (time.monotonic instant,
+        #: None = no timeout); checked per produced batch and polled by
+        #: semaphore waits (api/dataframe.py sets it per query)
+        self.deadline: Optional[float] = None
+        self._oom_lock = threading.Lock()
+        #: runtime OOM_PRESSURE_HOST degradations recorded by the retry
+        #: ladder (mem/retry.py): [{"op", "detail"}, ...]; drained per
+        #: query by api/dataframe._execute_wrapped
+        self.oom_degradations: List[dict] = []  # tpulint: guarded-by _oom_lock
         #: speculative output sizing (joins skip the count->host sync and
         #: guess the bucket); the FINAL sink calls check_speculations() once
         self.speculate = self.conf.join_speculative_sizing
         #: [(device total, capacity, join stat key), ...]
         self.speculations = []
+
+    # --------------------------------------------- query-lifecycle control
+    def set_query_deadline(self, deadline: Optional[float]) -> None:
+        """Install (or with None clear) this query's cooperative
+        cancellation deadline; the semaphore polls the same instant
+        (per-thread — a shared semaphore must not leak one query's
+        deadline into another's wait) so a blocked acquire cancels
+        promptly too."""
+        self.deadline = deadline
+        self.semaphore.set_thread_deadline(deadline)
+
+    def check_cancelled(self) -> None:
+        """Cooperative cancellation point: raises QueryTimeout past the
+        deadline. Called at every produced batch (TpuExec.execute) and
+        from the retry ladder — the exception unwinds through the normal
+        cleanup paths, releasing the semaphore and closing spillables."""
+        dl = self.deadline
+        if dl is not None and time.monotonic() > dl:
+            raise QueryTimeout(
+                "query exceeded spark.rapids.tpu.query.timeout "
+                f"(deadline passed by {time.monotonic() - dl:.3f}s)")
+
+    def record_oom_degradation(self, op: str, detail: str) -> None:
+        """The retry ladder's host-degradation rung fired for ``op``:
+        remembered for the query's PlacementReport / event-log record
+        and counted into the metric families immediately."""
+        with self._oom_lock:
+            self.oom_degradations.append({"op": op, "detail": detail})
+        from ..metrics import registry as metrics_registry
+        mr = metrics_registry.REGISTRY
+        if mr is not None:
+            mr.counter("srtpu_oom_host_fallback_total", op=op).inc()
+            mr.counter("srtpu_placement_fallback_total",
+                       code="OOM_PRESSURE_HOST", op=op).inc()
+
+    def take_oom_degradations(self) -> List[dict]:
+        """Drain the recorded degradations (per-query reset)."""
+        with self._oom_lock:
+            out, self.oom_degradations = self.oom_degradations, []
+        return out
 
     def check_speculations(self) -> None:
         """Validate every speculatively-sized output (ONE batched fetch of
@@ -157,6 +212,10 @@ class TpuExec:
         # subtracting the children's cumulative) + produced batches
         it = self._metered_iter(
             it, m, ctx.metric(self._exec_id, "numOutputBatches"))
+        if ctx.deadline is not None:
+            # cooperative cancellation: one deadline check per produced
+            # batch at every operator (zero cost with no timeout set)
+            it = self._cancel_iter(it, ctx)
         sig = getattr(self, "plan_sig", None)
         if sig is not None:
             it = self._record_rows(it, sig)
@@ -181,6 +240,17 @@ class TpuExec:
                 return
             m_time.add(time.perf_counter() - t0)
             m_batches.add(1)
+            yield b
+
+    @staticmethod
+    def _cancel_iter(it, ctx):
+        """Raise QueryTimeout at the first batch boundary past the
+        query deadline (spark.rapids.tpu.query.timeout). The exception
+        unwinds through the generator stack: semaphore permits release
+        via their with-scopes, spillables close via the operators'
+        cleanup handlers — cancellation leaks nothing."""
+        for b in it:
+            ctx.check_cancelled()
             yield b
 
     def _traced_iter(self, it, tr):

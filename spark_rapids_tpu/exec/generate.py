@@ -101,8 +101,10 @@ class TpuGenerateExec(TpuExec):
         rows_m = ctx.metric(self._exec_id, "numOutputRows", ESSENTIAL)
         for batch in self.children[0].execute(ctx):
             sb = SpillableBatch(batch, ctx.memory)
-            for out in with_retry([sb], lambda b: self._generate_one(ctx, b),
-                                  mm=ctx.memory):
+            for out in with_retry([sb],
+                                  lambda b: self._generate_one(ctx, b),
+                                  mm=ctx.memory, ctx=ctx,
+                                  op=self._exec_id):
                 rows_m.add(out.num_rows)
                 yield out
 

@@ -36,7 +36,8 @@ from ..columnar.bucketing import bucket_for
 from ..exprs.base import DVal, EvalContext, Expression
 from ..exprs.compiler import (_compact_kernel, eval_predicate_device,
                               filter_batch_device, gather_batch_device)
-from ..mem import SpillableBatch, with_retry_no_split
+from ..mem import (SpillableBatch, with_retry_no_split,
+                   wrap_spillable_sides)
 from ..types import BOOL, Schema, StructField
 from .base import ESSENTIAL, ExecContext, TpuExec
 from .encoding import grouping_operands, operands_equal
@@ -397,12 +398,12 @@ class TpuHashJoinExec(TpuExec):
         # (ref GpuShuffledHashJoinExec build-side semantics)
         # list payloads materialize host-side: the join gather kernels move
         # 1D lanes only (columnar/nested.py with_lists_on_host)
-        right_batches = [SpillableBatch(
-            b.ensure_device().with_lists_on_host(), ctx.memory)
-            for b in self.children[1].execute(ctx)]
-        left_batches = [SpillableBatch(
-            b.ensure_device().with_lists_on_host(), ctx.memory)
-            for b in self.children[0].execute(ctx)]
+        right_batches, left_batches = wrap_spillable_sides(
+            ctx.memory,
+            (b.ensure_device().with_lists_on_host()
+             for b in self.children[1].execute(ctx)),
+            (b.ensure_device().with_lists_on_host()
+             for b in self.children[0].execute(ctx)))
         ls, rs = (self.children[0].output_schema(),
                   self.children[1].output_schema())
         total_bytes = sum(s.device_bytes() for s in right_batches +
@@ -425,7 +426,7 @@ class TpuHashJoinExec(TpuExec):
                 return self._join(lb, rb, ctx)
 
         try:
-            out = with_retry_no_split(run, ctx.memory)
+            out = with_retry_no_split(run, ctx=ctx, op=self._exec_id)
             sigs = getattr(self, "side_sigs", None)
             if sigs is not None:
                 # AQE stage stats (ref GpuCustomShuffleReaderExec): record
@@ -572,7 +573,7 @@ class TpuHashJoinExec(TpuExec):
             return outs
 
         try:
-            outs = with_retry_no_split(run, ctx.memory)
+            outs = with_retry_no_split(run, ctx=ctx, op=self._exec_id)
         finally:
             for s in left_batches + right_batches:
                 s.close()
@@ -809,12 +810,12 @@ class TpuNestedLoopJoinExec(TpuExec):
                   self.children[1].output_schema())
         # list payloads materialize host-side: the join gather kernels move
         # 1D lanes only (columnar/nested.py with_lists_on_host)
-        right_batches = [SpillableBatch(
-            b.ensure_device().with_lists_on_host(), ctx.memory)
-            for b in self.children[1].execute(ctx)]
-        left_batches = [SpillableBatch(
-            b.ensure_device().with_lists_on_host(), ctx.memory)
-            for b in self.children[0].execute(ctx)]
+        right_batches, left_batches = wrap_spillable_sides(
+            ctx.memory,
+            (b.ensure_device().with_lists_on_host()
+             for b in self.children[1].execute(ctx)),
+            (b.ensure_device().with_lists_on_host()
+             for b in self.children[0].execute(ctx)))
 
         def run():
             with ctx.semaphore.held():
@@ -843,7 +844,7 @@ class TpuNestedLoopJoinExec(TpuExec):
                                          live, self.condition, self._schema)
 
         try:
-            out = with_retry_no_split(run, ctx.memory)
+            out = with_retry_no_split(run, ctx=ctx, op=self._exec_id)
         finally:
             for s in right_batches + left_batches:
                 s.close()
@@ -910,7 +911,8 @@ class TpuBroadcastHashJoinExec(TpuHashJoinExec):
                 with ctx.semaphore.held():
                     return self._build_bloom(
                         ctx, self.children[0].output_schema(), bb)
-            bloom = with_retry_no_split(build_bloom_run, ctx.memory)
+            bloom = with_retry_no_split(build_bloom_run, ctx=ctx,
+                                        op=self._exec_id)
         else:
             bloom = None
         produced = False
@@ -924,7 +926,7 @@ class TpuBroadcastHashJoinExec(TpuHashJoinExec):
                         sb2 = sb
                     return (self._join(sb2, bb, ctx) if bi == 1
                             else self._join(bb, sb2, ctx))
-            out = with_retry_no_split(run, ctx.memory)
+            out = with_retry_no_split(run, ctx=ctx, op=self._exec_id)
             rows_m.add(out.num_rows_raw)
             produced = True
             yield out
@@ -935,7 +937,7 @@ class TpuBroadcastHashJoinExec(TpuHashJoinExec):
                 with ctx.semaphore.held():
                     return (self._join(empty, bb, ctx) if bi == 1
                             else self._join(bb, empty, ctx))
-            yield with_retry_no_split(run_empty, ctx.memory)
+            yield with_retry_no_split(run_empty, ctx=ctx, op=self._exec_id)
 
     def describe(self):
         return "Broadcast" + super().describe()[:-1] + \

@@ -30,7 +30,7 @@ import numpy as np
 
 from ..columnar import ColumnarBatch, DeviceColumn, concat_batches
 from ..exprs.base import DVal, EvalContext
-from ..mem import SpillableBatch, with_retry_no_split
+from ..mem import SpillableBatch, with_retry_no_split, wrap_spillables
 from ..plan.logical import SortOrder
 from ..types import Schema
 from .base import ExecContext, TpuExec
@@ -204,9 +204,9 @@ class TpuSortExec(TpuExec):
                         self.orders,
                         batch.ensure_device().with_lists_on_host())
             return
-        spillables = [SpillableBatch(
-            b.ensure_device().with_lists_on_host(), ctx.memory)
-            for b in self.children[0].execute(ctx)]
+        spillables = wrap_spillables(
+            (b.ensure_device().with_lists_on_host()
+             for b in self.children[0].execute(ctx)), ctx.memory)
         if not spillables:
             return
         total = sum(s.device_bytes() for s in spillables)
@@ -221,7 +221,7 @@ class TpuSortExec(TpuExec):
                 return sort_batch_device(self.orders, big)
 
         try:
-            out = with_retry_no_split(do_sort, ctx.memory)
+            out = with_retry_no_split(do_sort, ctx=ctx, op=self._exec_id)
         finally:
             for sb in spillables:
                 sb.close()
@@ -257,7 +257,8 @@ class TpuSortExec(TpuExec):
                             np.linspace(0, n - 1, num=k, dtype=np.int64))
                         samp = [np.asarray(jnp.take(op, idx)) for op in ops]
                         return SpillableBatch(run, ctx.memory), samp
-                run_sb, samp = with_retry_no_split(sort_one, ctx.memory)
+                run_sb, samp = with_retry_no_split(sort_one, ctx=ctx,
+                                                   op=self._exec_id)
                 sb.close()
                 runs.append(run_sb)
                 if samp is not None:
@@ -308,7 +309,8 @@ class TpuSortExec(TpuExec):
                         big = concat_batches([p.get() for p in parts])
                         return sort_batch_device(self.orders, big)
                 try:
-                    out = with_retry_no_split(merge_bucket, ctx.memory)
+                    out = with_retry_no_split(merge_bucket, ctx=ctx,
+                                              op=self._exec_id)
                 finally:
                     for p in parts:
                         p.close()

@@ -36,7 +36,7 @@ from ..exprs.base import DVal, EvalContext
 from ..exprs.window_fns import (DenseRank, Lag, Lead, NthValue, NTile,
                                 PercentRank, Rank, RowNumber,
                                 WindowFunction)
-from ..mem import SpillableBatch, with_retry_no_split
+from ..mem import SpillableBatch, with_retry_no_split, wrap_spillables
 from ..plan.logical import WindowSpec
 from ..types import FLOAT64, INT32, INT64, Schema, StructField
 from .base import ExecContext, TpuExec
@@ -662,9 +662,9 @@ class TpuWindowExec(TpuExec):
             kern = _build_window_kernel(self.window_exprs, cs)
             _WIN_CACHE[key] = kern
         # window needs whole partitions: single-batch goal
-        spill = [SpillableBatch(
-            b.ensure_device().with_lists_on_host(), ctx.memory)
-            for b in self.children[0].execute(ctx)]
+        spill = wrap_spillables(
+            (b.ensure_device().with_lists_on_host()
+             for b in self.children[0].execute(ctx)), ctx.memory)
         if not spill:
             return
 
@@ -695,7 +695,7 @@ class TpuWindowExec(TpuExec):
                 return ColumnarBatch(new_cols, batch.num_rows, self._schema)
 
         try:
-            out = with_retry_no_split(run, ctx.memory)
+            out = with_retry_no_split(run, ctx=ctx, op=self._exec_id)
         finally:
             for s in spill:
                 s.close()

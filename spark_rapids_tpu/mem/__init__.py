@@ -1,11 +1,14 @@
 from .manager import (MemoryManager, OutOfDeviceMemory, RetryOOM,
                       SplitAndRetryOOM)
-from .retry import (RetryStats, split_batch_in_half, with_retry,
-                    with_retry_no_split)
-from .semaphore import DeviceSemaphore
+from .retry import (CheckpointRestore, RetryStats, split_batch_in_half,
+                    with_retry, with_retry_no_split, wrap_spillable_sides,
+                    wrap_spillables)
+from .semaphore import DeviceSemaphore, QueryTimeout
 from .spillable import SpillableBatch, SpillPriorities
 
 __all__ = ["MemoryManager", "OutOfDeviceMemory", "RetryOOM",
            "SplitAndRetryOOM", "RetryStats", "split_batch_in_half",
-           "with_retry", "with_retry_no_split", "DeviceSemaphore",
+           "with_retry", "with_retry_no_split", "wrap_spillables",
+           "wrap_spillable_sides",
+           "CheckpointRestore", "DeviceSemaphore", "QueryTimeout",
            "SpillableBatch", "SpillPriorities"]

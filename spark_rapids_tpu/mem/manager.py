@@ -17,7 +17,10 @@ RmmSpark.forceRetryOOM — the backbone of the reference's OOM test suites
 from __future__ import annotations
 
 import logging
+import sys
 import threading
+import time
+from contextlib import contextmanager
 from typing import Dict, List, Optional
 
 from ..config import (ALLOC_FRACTION, HBM_LIMIT_BYTES, HOST_SPILL_LIMIT,
@@ -91,6 +94,14 @@ class MemoryManager:
         self._next_handle = 0        # tpulint: guarded-by _lock
         # fault injection: thread-ident -> [(kind, remaining_skips, count)]
         self._inject: Dict[int, List] = {}  # tpulint: guarded-by _lock
+        #: bytes admitted by the OOM_PRESSURE_HOST degradation rung —
+        #: host-backed emergency grants OUTSIDE the device budget
+        #: (mem/retry.py ladder; SpillableBatch accounts here while a
+        #: pressure grant is active on its creating thread)
+        self.pressure_granted = 0    # tpulint: guarded-by _lock
+        #: per-thread pressure-grant depth (threading.local: no lock —
+        #: each thread reads/writes only its own slot)
+        self._grant = threading.local()
         #: alloc/free logging (ref spark.rapids.memory.gpu.debug=STDOUT,
         #: RapidsConf.scala:376)
         self.debug_log = False
@@ -152,6 +163,21 @@ class MemoryManager:
         (ref DeviceMemoryEventHandler.onAllocFailure -> store.spill)."""
         if self.debug_log:
             log.info("alloc %d B (used %d B)", nbytes, self.device_used)
+        if self.in_pressure_grant():
+            # the degradation rung must never fail a granted thread's
+            # reserve — checked FIRST so the native allocator (whose
+            # budget enforcement and injections have no grant notion)
+            # and the chaos/injection hooks are all bypassed. Bytes land
+            # in the unbudgeted pressure pool, with a thread-local
+            # ledger so the matching release() inside the grant drains
+            # the SAME pool instead of under-counting other buffers'
+            # device bytes (SpillableBatch skips reserve() entirely and
+            # handles cross-grant-boundary symmetry with its _granted
+            # flag).
+            self._grant.ledger = getattr(self._grant, "ledger", 0) + nbytes
+            self.reserve_granted(nbytes)
+            return
+        self._maybe_chaos()
         if self._native is not None:
             rc = self._native.reserve(nbytes, block_ms=0)
             if rc == 0:
@@ -208,11 +234,81 @@ class MemoryManager:
         if self.debug_log:
             log.info("free  %d B (used %d B)", nbytes,
                      self.device_used - nbytes)
+        if self.in_pressure_grant():
+            # symmetric with the grant branch in reserve(): bytes this
+            # thread reserved UNDER the grant (ledger) drain the grant
+            # pool; anything beyond the ledger is a pre-grant buffer
+            # being closed under the grant and falls through to the
+            # normal device accounting
+            led = getattr(self._grant, "ledger", 0)
+            if led > 0:
+                take = min(nbytes, led)
+                self._grant.ledger = led - take
+                self.release_granted(take)
+                nbytes -= take
+                if nbytes <= 0:
+                    return
         if self._native is not None:
             self._native.release(nbytes)
             return
         with self._lock:
             self._py_device_used = max(0, self._py_device_used - nbytes)
+
+    def reserve_absorbing_retries(self, nbytes: int, attempts: int = 10):
+        """``reserve`` that absorbs transient RetryOOMs at the allocation
+        site itself: spill-and-retry a bounded number of times before
+        letting the OOM escape to the caller's retry frame (ref RMM's
+        alloc loop re-entering the spill callback before GpuRetryOOM
+        reaches the task thread). SpillableBatch wraps reserve through
+        this, so a bare ``[SpillableBatch(b, mm) for b in ...]``
+        comprehension survives an injected or transient OOM without every
+        call site needing its own retry closure. SplitAndRetryOOM is
+        NEVER absorbed — only the caller can split its input."""
+        last: Optional[BaseException] = None
+        for attempt in range(max(1, attempts)):
+            try:
+                return self.reserve(nbytes)
+            except RetryOOM as e:
+                last = e
+                tr = trace_core.TRACER
+                if tr is not None:
+                    tr.instant("oom.retry", cat="mem",
+                               args={"attempt": attempt, "site": "reserve"})
+                from ..metrics import registry as metrics_registry
+                mr = metrics_registry.REGISTRY
+                if mr is not None:
+                    mr.counter("srtpu_oom_retries_total").inc()
+                self.spill_device(nbytes)
+                time.sleep(0)        # yield so other tasks can release
+        raise last
+
+    # --------------------------------------------------- pressure grants
+    def in_pressure_grant(self) -> bool:
+        """True while the calling thread runs under the OOM escalation
+        ladder's host degradation rung (mem/retry.py)."""
+        return getattr(self._grant, "depth", 0) > 0
+
+    @contextmanager
+    def pressure_host_grant(self):
+        """Admit the calling thread's new spillables OUTSIDE the device
+        budget for the duration: the final escalation rung after retries,
+        splits and a cross-session pressure spill all failed. Buffers
+        created under the grant account into ``pressure_granted`` (their
+        own flag keeps release symmetric) and reserve-time fault
+        injection is suppressed — the work is off the device path."""
+        self._grant.depth = getattr(self._grant, "depth", 0) + 1
+        try:
+            yield self
+        finally:
+            self._grant.depth -= 1
+
+    def reserve_granted(self, nbytes: int):
+        with self._lock:
+            self.pressure_granted += nbytes
+
+    def release_granted(self, nbytes: int):
+        with self._lock:
+            self.pressure_granted = max(0, self.pressure_granted - nbytes)
 
     def reserve_host(self, nbytes: int):
         with self._lock:
@@ -250,6 +346,33 @@ class MemoryManager:
             over = self.host_used - self.host_limit
         if over > 0:
             self.spill_host(over)
+        return freed
+
+    def spill_everything(self) -> int:
+        """Spill EVERY device-tier spillable this manager tracks (and
+        cascade host pressure to disk): the cross-session pressure rung
+        of the OOM escalation ladder — other sessions' builds, broadcast
+        relations and parked partials all move off-device so one starving
+        operator gets the whole budget (ref synchronousSpill(store, 0))."""
+        with self._lock:
+            need = sum(s.device_bytes() for s in self._spillables.values()
+                       if s.tier == "device")
+        return self.spill_device(need) if need > 0 else 0
+
+    @classmethod
+    def spill_all_sessions(cls) -> int:
+        """``spill_everything`` across every live budget singleton — the
+        process-wide pressure valve the retry ladder pulls before the
+        host degradation rung. Returns total bytes freed."""
+        with cls._global_lock:
+            insts = list(cls._instances.values())
+        freed = 0
+        for mm in insts:
+            freed += mm.spill_everything()
+        from ..metrics import registry as metrics_registry
+        mr = metrics_registry.REGISTRY
+        if mr is not None:
+            mr.counter("srtpu_oom_pressure_spills_total").inc()
         return freed
 
     def spill_host(self, need_bytes: int) -> int:
@@ -297,7 +420,36 @@ class MemoryManager:
         with self._lock:
             self._inject.clear()
 
+    def _maybe_chaos(self):
+        """Config-armed chaos sites at the reserve entry point (the
+        process-global ChaosController, aux/fault.py): ``mem.oom`` raises
+        an injected RetryOOM, ``mem.reserve.delay`` stalls the reserve.
+        One list-read when chaos is disarmed; suppressed entirely under a
+        pressure grant (the thread is already off the device path)."""
+        from ..aux.fault import active_chaos
+        ctl = active_chaos()
+        if ctl is None or self.in_pressure_grant():
+            return
+        if ctl.wants("mem.reserve.delay"):
+            ctl.maybe_delay("mem.reserve.delay")
+        if ctl.wants("mem.oom") and ctl.fires("mem.oom"):
+            # record the OPERATOR-level reserve site (first frame outside
+            # mem/) so the chaos battery can assert injection breadth
+            f = sys._getframe(1)
+            while f is not None and ("/mem/" in
+                                     f.f_code.co_filename.replace("\\", "/")):
+                f = f.f_back
+            if f is not None:
+                import os as _os
+                ctl.note_context(
+                    "mem.oom",
+                    f"{_os.path.basename(f.f_code.co_filename)}:"
+                    f"{f.f_code.co_name}")
+            raise RetryOOM("chaos: injected mem.oom at reserve()")
+
     def _maybe_inject(self):
+        if self.in_pressure_grant():
+            return
         tid = threading.get_ident()
         with self._lock:
             queue = self._inject.get(tid)
@@ -369,4 +521,5 @@ class MemoryManager:
                     "budget": self.budget,
                     "spill_to_host_bytes": self.spill_to_host_bytes,
                     "spill_to_disk_bytes": self.spill_to_disk_bytes,
+                    "pressure_granted": self.pressure_granted,
                     "num_spillables": len(self._spillables)}

@@ -1,21 +1,41 @@
-"""The OOM retry / split-and-retry framework.
+"""The OOM retry / split-and-retry escalation ladder.
 
 Reference analog: RmmRapidsRetryIterator.scala:33-200 (withRetry /
 withRetryNoSplit / splitAndRetry), driven by GpuRetryOOM /
-GpuSplitAndRetryOOM thrown from the allocator. Semantics preserved:
+GpuSplitAndRetryOOM thrown from the allocator, plus the Retryable.scala
+CheckpointRestore contract that keeps retried operator state
+side-effect-free. The r14 rebuild turns the original split-in-half
+helper into a full state machine with four rungs:
 
-  * the attempted function must be idempotent over its (spillable) input
-  * RetryOOM     -> spill happened (or will), just run again
-  * SplitAndRetryOOM -> halve the input and process the pieces recursively
-  * bounded attempts, then OutOfDeviceMemory
+1. **retry**   — ``RetryOOM``: restore checkpoints, spill this
+   manager's device tier, run the attempt again (bounded).
+2. **split**   — ``SplitAndRetryOOM``: halve the input and process the
+   pieces recursively, bounded by ``spark.rapids.tpu.oom.maxSplitDepth``
+   (ref splitSpillableInHalfByRows).
+3. **pressure** — cross-session spill: every live MemoryManager's
+   spillables (other sessions' builds, broadcasts, parked partials)
+   move off-device so the one starving operator gets the whole budget.
+4. **host degradation** — ``spark.rapids.tpu.oom.hostFallback.enabled``:
+   the attempt runs ONCE more on the host backend under an unbudgeted
+   pressure grant instead of failing the query. Recorded as an
+   ``OOM_PRESSURE_HOST`` placement tag (plan/tags.py) and counted by
+   ``srtpu_oom_host_fallback_total``.
 
-Used by every memory-hungry operator (aggregate merge, sort, join build,
-coalesce) exactly like the reference wraps theirs.
+Invariants the ladder preserves:
+
+  * the attempted function must be idempotent over its (spillable)
+    input; mutable operator state passes a :class:`CheckpointRestore`
+    via ``retryable=`` and is restored before every re-attempt
+  * ``close()`` idempotence lets every rung release exactly what it was
+    handed — no path leaks a registered spillable
+
+Used by every memory-hungry operator (aggregate merge, sort, join
+build, coalesce) exactly like the reference wraps theirs.
 """
 from __future__ import annotations
 
 import time
-from typing import Callable, Iterator, List, Optional, TypeVar
+from typing import Callable, Iterable, Iterator, List, Optional, TypeVar
 
 from ..metrics import registry as metrics_registry
 from ..trace import core as trace_core
@@ -24,16 +44,34 @@ from .manager import (MemoryManager, OutOfDeviceMemory, RetryOOM,
 from .spillable import SpillableBatch
 
 __all__ = ["with_retry_no_split", "with_retry", "split_batch_in_half",
-           "RetryStats"]
+           "RetryStats", "CheckpointRestore", "wrap_spillables"]
 
 T = TypeVar("T")
 MAX_RETRIES = 100
+#: extra attempts granted after the cross-session pressure rung fires
+PRESSURE_ATTEMPTS = 2
 
 
 class RetryStats:
     def __init__(self):
         self.retries = 0
         self.splits = 0
+        self.pressure_spills = 0
+        self.host_fallbacks = 0
+
+
+class CheckpointRestore:
+    """Mutable operator state that must survive OOM retries (ref
+    Retryable.scala CheckpointRestore): ``checkpoint()`` is called once
+    before the first attempt, ``restore()`` before every re-attempt, so
+    an attempt that mutated its state then OOM'd re-runs from the same
+    starting point — retries stay side-effect-free by construction."""
+
+    def checkpoint(self) -> None:
+        raise NotImplementedError
+
+    def restore(self) -> None:
+        raise NotImplementedError
 
 
 def _trace_oom(kind: str, attempt: int) -> None:
@@ -42,38 +80,211 @@ def _trace_oom(kind: str, attempt: int) -> None:
         tr.instant(kind, cat="mem", args={"attempt": attempt})
     mr = metrics_registry.REGISTRY   # same contract for the registry
     if mr is not None:
-        mr.counter("srtpu_oom_retries_total" if kind == "oom.retry"
-                   else "srtpu_oom_splits_total").inc()
+        if kind == "oom.retry":
+            mr.counter("srtpu_oom_retries_total").inc()
+        elif kind == "oom.split":
+            mr.counter("srtpu_oom_splits_total").inc()
 
 
-def with_retry_no_split(fn: Callable[[], T], mm: Optional[MemoryManager] = None,
-                        stats: Optional[RetryStats] = None) -> T:
-    """Run fn; on RetryOOM spill+retry; SplitAndRetryOOM is fatal here
-    (ref withRetryNoSplit)."""
-    mm = mm or MemoryManager.get()
-    last = None
-    for attempt in range(MAX_RETRIES):
+def wrap_spillable_sides(mm: MemoryManager, *batch_iters: Iterable
+                         ) -> List[List[SpillableBatch]]:
+    """``wrap_spillables`` over several input streams (a join's build
+    and stream sides) with CROSS-stream cleanup: if wrapping a later
+    stream fails, every batch already wrapped from the earlier streams
+    closes too before the exception re-raises."""
+    sides: List[List[SpillableBatch]] = []
+    try:
+        for it in batch_iters:
+            sides.append(wrap_spillables(it, mm))
+        return sides
+    except BaseException:
+        for side in sides:
+            for sb in side:
+                sb.close()
+        raise
+
+
+def wrap_spillables(batches: Iterable, mm: MemoryManager
+                    ) -> List[SpillableBatch]:
+    """Exception-safe bulk wrap: ``[SpillableBatch(b, mm) for b in it]``
+    leaks every already-wrapped batch when a later wrap (or the
+    producing iterator — e.g. a cooperative QueryTimeout) raises. This
+    closes the partial list before re-raising, so cancellation and OOM
+    paths hold the zero-leak audit."""
+    out: List[SpillableBatch] = []
+    try:
+        for b in batches:
+            out.append(SpillableBatch(b, mm))
+        return out
+    except BaseException:
+        for sb in out:
+            sb.close()
+        raise
+
+
+class _Ladder:
+    """Shared escalation state for one with_retry / with_retry_no_split
+    call: checkpointed retryables, the one-shot pressure rung, and the
+    host degradation rung."""
+
+    def __init__(self, mm: MemoryManager, stats: Optional[RetryStats],
+                 retryable, ctx, op: Optional[str], host_fallback):
+        self.mm = mm
+        self.stats = stats
+        self.retryables = ([] if retryable is None else
+                           list(retryable) if isinstance(retryable,
+                                                         (list, tuple))
+                           else [retryable])
+        self.ctx = ctx
+        self.op = op
+        self.host_fallback = host_fallback
+        self.pressured = False
+        for r in self.retryables:
+            r.checkpoint()
+
+    # ------------------------------------------------------------ helpers
+    def check_cancelled(self) -> None:
+        if self.ctx is not None:
+            self.ctx.check_cancelled()
+
+    def restore(self) -> None:
+        for r in self.retryables:
+            r.restore()
+
+    def note_retry(self, attempt: int) -> None:
+        if self.stats is not None:
+            self.stats.retries += 1
+        _trace_oom("oom.retry", attempt)
+        self.restore()
+
+    def note_split(self, attempt: int) -> None:
+        if self.stats is not None:
+            self.stats.splits += 1
+        _trace_oom("oom.split", attempt)
+        self.restore()
+
+    def _conf(self):
+        if self.ctx is not None:
+            return self.ctx.conf
+        from ..config import DEFAULT
+        return DEFAULT
+
+    def max_split_depth(self, override: Optional[int]) -> int:
+        if override is not None:
+            return int(override)
+        from ..config import OOM_MAX_SPLIT_DEPTH
+        return int(self._conf().get(OOM_MAX_SPLIT_DEPTH))
+
+    # -------------------------------------------------------- rung 3 / 4
+    def pressure_spill(self) -> None:
+        """Rung 3, fired at most once per ladder: spill EVERY live
+        session's spillables (this manager first — a directly-
+        constructed manager may not be in the singleton table)."""
+        self.pressured = True
+        if self.stats is not None:
+            self.stats.pressure_spills += 1
+        tr = trace_core.TRACER
+        freed = self.mm.spill_everything()
+        freed += MemoryManager.spill_all_sessions()
+        if tr is not None:
+            tr.instant("oom.pressure_spill", cat="mem",
+                       args={"freed_bytes": freed, "op": self.op})
+
+    def degrade(self, thunk: Callable[[], T], detail: str,
+                prefer_fallback: bool = True) -> T:
+        """Rung 4: run the attempt on the host backend under an
+        unbudgeted pressure grant instead of failing the query. The
+        operator-provided ``host_fallback`` wins when given (it knows a
+        cheaper host path); otherwise the SAME attempt runs with new
+        buffers admitted outside the budget and jax pointed at the host
+        platform — identical kernels, host-resident working set."""
+        from ..config import OOM_HOST_FALLBACK_ENABLED
+        if not bool(self._conf().get(OOM_HOST_FALLBACK_ENABLED)):
+            raise OutOfDeviceMemory(detail)
+        self.restore()
+        if self.stats is not None:
+            self.stats.host_fallbacks += 1
+        op_kind = (self.op or "op").split("@")[0]
+        tr = trace_core.TRACER
+        if tr is not None:
+            tr.instant("oom.host_fallback", cat="mem",
+                       args={"op": op_kind, "detail": detail})
+        if self.ctx is not None:
+            self.ctx.record_oom_degradation(op_kind, detail)
+        else:
+            mr = metrics_registry.REGISTRY
+            if mr is not None:
+                mr.counter("srtpu_oom_host_fallback_total",
+                           op=op_kind).inc()
+        if prefer_fallback and self.host_fallback is not None:
+            return self.host_fallback()
+        cpu = None
+        try:
+            import jax
+            cpu = jax.devices("cpu")[0]
+        except Exception:
+            pass
+        with self.mm.pressure_host_grant():
+            if cpu is not None:
+                import jax
+                with jax.default_device(cpu):
+                    return thunk()
+            return thunk()
+
+
+def with_retry_no_split(fn: Callable[[], T], mm: Optional[MemoryManager]
+                        = None, stats: Optional[RetryStats] = None, *,
+                        retryable=None, ctx=None, op: Optional[str] = None,
+                        host_fallback: Optional[Callable[[], T]] = None
+                        ) -> T:
+    """Run fn through the escalation ladder without splitting (ref
+    withRetryNoSplit): RetryOOM -> spill+retry; SplitAndRetryOOM cannot
+    be honored here, so it escalates straight to the pressure spill and
+    then the host degradation rung (pre-r14 this was fatal)."""
+    mm = mm or (ctx.memory if ctx is not None else MemoryManager.get())
+    lad = _Ladder(mm, stats, retryable, ctx, op, host_fallback)
+    attempts = 0
+    budget = MAX_RETRIES
+    while True:
+        lad.check_cancelled()
         try:
             return fn()
         except RetryOOM as e:
-            last = e
-            stats and setattr(stats, "retries", stats.retries + 1)
-            _trace_oom("oom.retry", attempt)
+            attempts += 1
+            lad.note_retry(attempts)
+            if attempts > budget:
+                if not lad.pressured:
+                    lad.pressure_spill()
+                    budget = attempts + PRESSURE_ATTEMPTS
+                    continue
+                return lad.degrade(
+                    fn, f"exceeded {attempts} OOM retries even after a "
+                        f"cross-session pressure spill: {e}")
             mm.spill_device(0)
             time.sleep(0)  # yield so other tasks can release
         except SplitAndRetryOOM as e:
-            raise OutOfDeviceMemory(
-                f"operation cannot split its input: {e}") from e
-    raise OutOfDeviceMemory(f"exceeded {MAX_RETRIES} OOM retries: {last}")
+            lad.restore()
+            if not lad.pressured:
+                # a pressure spill can turn an unsatisfiable reserve into
+                # a satisfiable one when other sessions held the budget
+                lad.pressure_spill()
+                budget = attempts + PRESSURE_ATTEMPTS
+                continue
+            return lad.degrade(
+                fn, f"operation cannot split its input and the pressure "
+                    f"spill did not free enough: {e}")
 
 
 def split_batch_in_half(sb: SpillableBatch) -> List[SpillableBatch]:
-    """Default splitter (ref RmmRapidsRetryIterator splitSpillableInHalfByRows).
+    """Default splitter (ref RmmRapidsRetryIterator
+    splitSpillableInHalfByRows).
 
-    Exception-safe: the input is closed whether or not the split
-    succeeds, and a piece already wrapped when the second slice or
-    wrap raises is closed too — a half-built split must not pin pool
-    budget (the caller's retry loop closes only what it was handed)."""
+    On success the input is consumed (closed) — the pieces replace it.
+    On failure the pieces are closed but the INPUT STAYS OPEN: the
+    retry ladder still owns it and may escalate (pressure spill, host
+    degradation) with the data intact; pre-r14 a failed split closed
+    the input too, so nothing above it could ever retry. A batch of
+    < 2 rows raises OutOfDeviceMemory (unsplittable)."""
     pieces: List[SpillableBatch] = []
     try:
         batch = sb.get()
@@ -84,51 +295,94 @@ def split_batch_in_half(sb: SpillableBatch) -> List[SpillableBatch]:
         mm = sb.memory_manager
         pieces.append(SpillableBatch(batch.slice(0, mid), mm))
         pieces.append(SpillableBatch(batch.slice(mid, n - mid), mm))
-        return pieces
     except BaseException:
         for p in pieces:
             p.close()
         raise
-    finally:
-        sb.close()
+    sb.close()
+    return pieces
 
 
 def with_retry(inputs: List[SpillableBatch],
                fn: Callable[[SpillableBatch], T],
                mm: Optional[MemoryManager] = None,
                splitter: Callable = split_batch_in_half,
-               stats: Optional[RetryStats] = None) -> Iterator[T]:
-    """Process each spillable input through fn with retry+split semantics
-    (ref withRetry + RetryIterator). Yields one result per (possibly split)
-    input piece, in order."""
-    mm = mm or MemoryManager.get()
-    queue: List[SpillableBatch] = list(inputs)
+               stats: Optional[RetryStats] = None, *,
+               retryable=None, ctx=None, op: Optional[str] = None,
+               host_fallback: Optional[Callable] = None,
+               max_split_depth: Optional[int] = None) -> Iterator[T]:
+    """Process each spillable input through fn with the full escalation
+    ladder (ref withRetry + RetryIterator). Yields one result per
+    (possibly split) input piece, in order. Splitting is bounded by
+    ``spark.rapids.tpu.oom.maxSplitDepth`` (or the ``max_split_depth``
+    override); a piece that still cannot fit at max depth — or cannot
+    split at all — escalates to the pressure spill and then runs on the
+    host degradation rung (``host_fallback(item)`` when provided)."""
+    mm = mm or (ctx.memory if ctx is not None else MemoryManager.get())
+    lad = _Ladder(mm, stats, retryable, ctx, op, host_fallback)
+    depth_cap = lad.max_split_depth(max_split_depth)
+    queue: List[tuple] = [(sb, 0) for sb in inputs]
     item: Optional[SpillableBatch] = None
     try:
         while queue:
-            item = queue.pop(0)
+            item, depth = queue.pop(0)
             attempts = 0
+            budget = MAX_RETRIES
             while True:
+                lad.check_cancelled()
                 try:
-                    yield fn(item)
+                    out = fn(item)
+                    item = None
+                    yield out
                     break
-                except RetryOOM:
+                except RetryOOM as e:
                     attempts += 1
-                    stats and setattr(stats, "retries", stats.retries + 1)
-                    _trace_oom("oom.retry", attempts)
-                    if attempts > MAX_RETRIES:
-                        raise OutOfDeviceMemory("retry limit exceeded")
+                    lad.note_retry(attempts)
+                    if attempts > budget:
+                        if not lad.pressured:
+                            lad.pressure_spill()
+                            budget = attempts + PRESSURE_ATTEMPTS
+                            continue
+                        out = _degrade_item(lad, fn, item,
+                                            f"retry limit exceeded after "
+                                            f"pressure spill: {e}")
+                        item = None
+                        yield out
+                        break
                     mm.spill_device(0)
-                except SplitAndRetryOOM:
-                    stats and setattr(stats, "splits", stats.splits + 1)
-                    _trace_oom("oom.split", attempts)
-                    pieces = splitter(item)
+                except SplitAndRetryOOM as e:
+                    lad.note_split(attempts)
+                    if depth >= depth_cap:
+                        if not lad.pressured:
+                            lad.pressure_spill()
+                            continue
+                        out = _degrade_item(
+                            lad, fn, item,
+                            f"split depth {depth} reached "
+                            f"oom.maxSplitDepth={depth_cap}: {e}")
+                        item = None
+                        yield out
+                        break
+                    try:
+                        pieces = splitter(item)
+                    except (OutOfDeviceMemory, RetryOOM) as se:
+                        # unsplittable (< 2 rows), or the split itself
+                        # could not reserve its pieces even after the
+                        # allocation-site absorb loop: either way the
+                        # input is still open — escalate with the data
+                        # intact instead of aborting the ladder
+                        if not lad.pressured:
+                            lad.pressure_spill()
+                            continue
+                        out = _degrade_item(lad, fn, item,
+                                            f"split failed: {se}")
+                        item = None
+                        yield out
+                        break
                     # process pieces in order before the rest of the queue
-                    queue = pieces + queue
+                    queue = [(p, depth + 1) for p in pieces] + queue
                     item = None
                     break
-            if item is None:
-                continue
     except BaseException:
         # fatal error or abandoned consumer: the iterator owns every input
         # still queued — release them or they pin pool budget forever
@@ -136,6 +390,16 @@ def with_retry(inputs: List[SpillableBatch],
         # no-op; ref RmmRapidsRetryIterator closes its attempt on throw)
         if item is not None:
             item.close()
-        for sb in queue:
+        for sb, _ in queue:
             sb.close()
         raise
+
+
+def _degrade_item(lad: _Ladder, fn, item, detail: str):
+    """Host-degradation rung for one queue item: the operator-provided
+    fallback receives the item (it consumes it exactly like fn)."""
+    if lad.host_fallback is not None:
+        thunk = lambda: lad.host_fallback(item)   # noqa: E731
+    else:
+        thunk = lambda: fn(item)                  # noqa: E731
+    return lad.degrade(thunk, detail, prefer_fallback=False)

@@ -48,9 +48,17 @@ class SpillableBatch:
                           if hasattr(c, "padded_len")), None)
         self.schema = batch.schema
         self._device_bytes = batch.device_size_bytes()
-        self._mm.reserve(self._device_bytes)
-        self._handle = self._mm.register_spillable(self)
+        #: True while the resident device bytes were admitted by an
+        #: OOM_PRESSURE_HOST emergency grant instead of the budget —
+        #: the matching release must come from the same pool
+        self._granted = False        # tpulint: guarded-by _lock
         self._closed = False
+        self._reserve_device(self._device_bytes)
+        # register LAST: the moment the handle exists, another thread's
+        # spill_device() may pick this batch up — every field the spill
+        # paths read must already be published (the r14 concurrency
+        # battery caught a half-constructed batch being spilled)
+        self._handle = self._mm.register_spillable(self)
         #: creation site for the leak auditor (MemoryCleaner analog) —
         #: only captured in debug mode, a traceback walk per wrap is not
         #: free on the hot path
@@ -59,6 +67,29 @@ class SpillableBatch:
         if os.environ.get("SRTPU_LEAK_DEBUG"):
             import traceback
             self.created_at = "".join(traceback.format_stack(limit=6)[:-1])
+
+    def _reserve_device(self, nbytes: int) -> None:
+        """Admit ``nbytes`` of device residency: through the budget with
+        allocation-site RetryOOM absorption (spill-and-retry a bounded
+        number of times before the OOM escapes — bare
+        ``[SpillableBatch(b, mm) for b in ...]`` comprehensions survive
+        transient pressure), or through the unbudgeted pressure pool when
+        the creating thread runs under the escalation ladder's host
+        degradation rung (mem/retry.py)."""
+        self._device_bytes = nbytes
+        if self._mm.in_pressure_grant():
+            self._granted = True
+            self._mm.reserve_granted(nbytes)
+        else:
+            self._granted = False
+            self._mm.reserve_absorbing_retries(nbytes)
+
+    def _release_device(self, nbytes: int) -> None:
+        if self._granted:
+            self._granted = False
+            self._mm.release_granted(nbytes)
+        else:
+            self._mm.release(nbytes)
 
     @property
     def memory_manager(self) -> MemoryManager:
@@ -98,7 +129,7 @@ class SpillableBatch:
             nbytes = self._device_bytes
             self._batch = None
             self.tier = "host"
-            self._mm.release(nbytes)
+            self._release_device(nbytes)
             self._mm.reserve_host(self._host_table.nbytes)
             self._mm.spill_to_host_bytes += nbytes
             return nbytes
@@ -140,29 +171,37 @@ class SpillableBatch:
         return get_store(self._mm.spill_dir)
 
     def _unspill(self) -> ColumnarBatch:
+        """Migrate back to device. The device reservation happens BEFORE
+        the source tier is dismantled: a failed reserve (real or injected
+        RetryOOM) must leave this batch intact in its current tier — the
+        pre-r14 order released the host table / freed the disk block
+        first, so an OOM mid-unspill lost the only copy of the data."""
         import pyarrow as pa
         if self.tier == "host":
             table = self._host_table
+            batch = ColumnarBatch.from_arrow(table)
+            self._reserve_device(batch.device_size_bytes())  # may raise
             self._mm.release_host(table.nbytes)
             self._host_table = None
         elif self._disk_block is not None:
             data = self._native_store().read(self._disk_block)
             table = pa.ipc.open_file(pa.BufferReader(data)).read_all()
+            batch = ColumnarBatch.from_arrow(table)
+            self._reserve_device(batch.device_size_bytes())  # may raise
             self._native_store().free(self._disk_block)
             self._mm.disk_used -= self._disk_bytes
             self._disk_block, self._disk_bytes = None, 0
         else:  # per-file fallback tier
             with pa.memory_map(self._disk_path, "rb") as f:
                 table = pa.ipc.open_file(f).read_all()
+            batch = ColumnarBatch.from_arrow(table)
+            self._reserve_device(batch.device_size_bytes())  # may raise
             try:
                 self._mm.disk_used -= os.path.getsize(self._disk_path)
                 os.unlink(self._disk_path)
             except OSError:
                 pass
             self._disk_path = None
-        batch = ColumnarBatch.from_arrow(table)
-        self._device_bytes = batch.device_size_bytes()
-        self._mm.reserve(self._device_bytes)
         self.tier = "device"
         return batch
 
@@ -188,7 +227,7 @@ class SpillableBatch:
             self._closed = True
             self._mm.unregister_spillable(self._handle)
             if self.tier == "device":
-                self._mm.release(self._device_bytes)
+                self._release_device(self._device_bytes)
             elif self.tier == "host" and self._host_table is not None:
                 self._mm.release_host(self._host_table.nbytes)
                 self._host_table = None

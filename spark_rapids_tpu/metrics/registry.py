@@ -334,6 +334,23 @@ declare_metric("srtpu_oom_retries_total", "counter",
                "RetryOOM events absorbed by the retry framework.")
 declare_metric("srtpu_oom_splits_total", "counter",
                "SplitAndRetryOOM events (input halved and retried).")
+declare_metric("srtpu_oom_pressure_spills_total", "counter",
+               "Cross-session pressure spills: the escalation rung that "
+               "spills EVERY live session's spillables before the host "
+               "degradation rung (mem/retry.py ladder).")
+declare_metric("srtpu_oom_host_fallback_total", "counter",
+               "Operators (or whole queries, op=Query) degraded to the "
+               "host backend by the final OOM escalation rung instead of "
+               "failing — labeled op=<operator kind>; each is also "
+               "recorded as an OOM_PRESSURE_HOST placement tag.")
+declare_metric("srtpu_semaphore_wedge_total", "counter",
+               "Dead device-semaphore holders force-released by the "
+               "wedge watchdog (spark.rapids.tpu.semaphore."
+               "wedgeTimeoutMs): a holder thread died without releasing "
+               "and its permit was reclaimed.")
+declare_metric("srtpu_query_timeout_total", "counter",
+               "Queries cancelled by the spark.rapids.tpu.query.timeout "
+               "cooperative deadline.")
 declare_metric("srtpu_queries_total", "counter",
                "Materialized queries, labeled status=ok|failed.")
 declare_metric("srtpu_query_seconds", "histogram",

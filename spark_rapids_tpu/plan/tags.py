@@ -38,7 +38,7 @@ __all__ = ["PLACEMENT_EXPLAIN", "REASON_CODES", "PlacementTag",
            "EXPR_UNSUPPORTED", "DTYPE_HOST_ONLY", "LIST_KEY_HOST",
            "HASH_KEY_HOST", "AGG_DISTINCT_HOST", "EXPR_DICT_EVAL",
            "OP_UNSUPPORTED", "CONF_DISABLED", "COST_MODEL_HOST",
-           "WHOLE_PLAN_HOST_REVERT"]
+           "WHOLE_PLAN_HOST_REVERT", "OOM_PRESSURE_HOST"]
 
 PLACEMENT_EXPLAIN = register(
     "spark.rapids.tpu.explain", "NONE",
@@ -65,6 +65,7 @@ OP_UNSUPPORTED = "OP_UNSUPPORTED"
 CONF_DISABLED = "CONF_DISABLED"
 COST_MODEL_HOST = "COST_MODEL_HOST"
 WHOLE_PLAN_HOST_REVERT = "WHOLE_PLAN_HOST_REVERT"
+OOM_PRESSURE_HOST = "OOM_PRESSURE_HOST"
 
 #: code -> one-line meaning; the single source the explain renderers,
 #: the qualify CLI and docs/placement.md share. CLOSED: make_tag raises
@@ -106,6 +107,15 @@ REASON_CODES: Dict[str, str] = {
         "the cost optimizer reverted the WHOLE plan to the host "
         "engine (per-query device floor, measured-wall arbitration, "
         "or the native-shape re-plan after TPU-targeted rewrites)",
+    OOM_PRESSURE_HOST:
+        "device memory pressure degraded execution to the host at "
+        "RUNTIME: the OOM escalation ladder (retry -> split -> "
+        "cross-session pressure spill) was exhausted and the starving "
+        "operator — or, at the query rung, the whole query — ran on "
+        "the host backend under an unbudgeted grant instead of "
+        "failing (mem/retry.py; the only code recorded after "
+        "planning, so it appears on the EXECUTED query's report, the "
+        "queryEnd event record and srtpu_oom_host_fallback_total)",
 }
 
 

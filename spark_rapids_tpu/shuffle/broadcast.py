@@ -50,8 +50,9 @@ class BroadcastExchangeExec(TpuExec):
         sb = cache.get(self._exec_id)
         if sb is None:
             size_m = ctx.metric(self._exec_id, "dataSize", ESSENTIAL)
-            spill = [SpillableBatch(b, ctx.memory)
-                     for b in self.children[0].execute(ctx)]
+            from ..mem import wrap_spillables
+            spill = wrap_spillables(self.children[0].execute(ctx),
+                                    ctx.memory)
             try:
                 with ctx.semaphore.held():
                     if spill:

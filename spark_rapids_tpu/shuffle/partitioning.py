@@ -193,7 +193,8 @@ def scatter_spillables(ctx, spillables, make_parts, n_parts: int):
                         s.close()
                     raise
                 return out
-            for p, s in with_retry_no_split(split_one, ctx.memory):
+            for p, s in with_retry_no_split(split_one, ctx=ctx,
+                                            op="scatter"):
                 slots[p].append(s)
             sb.close()
     except Exception:

@@ -107,7 +107,11 @@ def analyze(path: str) -> dict:
             d["n"] += 1
             if rec.get("ok") and rec.get("durationMs") is not None:
                 d["walls"].append(float(rec["durationMs"]))
-            pl = (st or {}).get("placement")
+            # a queryEnd carrying its own placement summary wins over
+            # the start's: the run degraded at RUNTIME (OOM pressure
+            # host fallback, r14) and the end summary includes the
+            # OOM_PRESSURE_HOST tags the plan-time summary cannot
+            pl = rec.get("placement") or (st or {}).get("placement")
             if pl:
                 d["placement"] = pl
                 d["completed_pl"] = True

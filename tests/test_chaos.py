@@ -111,10 +111,13 @@ def test_battery_byte_identical_under_corruption_and_delay(cluster3):
     try:
         got = _run_battery(cluster3)
         fired = cluster3.clients["worker-0"].task("chaos_stats")
+        census = cluster3.chaos_stats()
     finally:
         cluster3.set_chaos("")
     assert ("put.corrupt", 2) in fired, fired
     assert ("put.delay", 1) in fired, fired
+    # the driver-side aggregator sees the same worker census in one call
+    assert census.get("worker-0") == fired, census
     # byte-identity IS the acceptance bar: the chaos-on run equals the
     # fault-free run of the same cluster bit for bit (bid-ordered block
     # concatenation makes repeat runs deterministic to begin with)

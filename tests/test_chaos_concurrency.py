@@ -1,0 +1,297 @@
+"""Concurrent memory-pressure chaos battery (ISSUE 14 tentpole).
+
+Four sessions run a mixed agg/join/sort battery CONCURRENTLY against
+one shared MemoryManager and one shared DeviceSemaphore while the
+chaos controller injects OOMs and stalls at the memory/semaphore sites
+(`mem.oom`, `mem.reserve.delay`, `sem.stall`) and a holder thread is
+killed while holding a permit. The acceptance bar:
+
+* every query's result equals the fault-free run (pressure degrades
+  placement, never results);
+* the semaphore is never wedged past ``wedgeTimeoutMs`` — the dead
+  holder's permit is force-released by the watchdog;
+* the post-run leak audit reports ZERO live batches (no cross-session
+  spillable leakage);
+* the fault counters are visible through the metrics registry.
+
+Everything is seeded and `not slow` (the `chaos` marker keeps it in
+tier-1), like tests/test_chaos.py for the distributed runtime.
+"""
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from harness import tpu_session
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.aux.fault import ChaosController, install_chaos
+from spark_rapids_tpu.exec.base import ExecContext
+from spark_rapids_tpu.mem import (DeviceSemaphore, MemoryManager,
+                                  QueryTimeout)
+
+pytestmark = pytest.mark.chaos
+
+_RNG = np.random.RandomState(14)
+_N = 4096
+#: integer-only tables: every battery aggregate is exact, so results
+#: compare EQUAL no matter which engine/rung produced them
+_T = pa.table({
+    "k": pa.array(_RNG.randint(0, 17, _N)),
+    "g": pa.array(_RNG.randint(0, 5, _N)),
+    "v": pa.array(_RNG.randint(0, 1000, _N).astype(np.int64)),
+    "u": pa.array(np.arange(_N)),          # unique: total sort order
+})
+_R = pa.table({
+    "k2": pa.array(_RNG.randint(0, 17, _N // 2)),
+    "w": pa.array(_RNG.randint(0, 100, _N // 2).astype(np.int64)),
+})
+
+
+def _mk_session(mm, sem, extra=None):
+    conf = {"spark.rapids.tpu.semaphore.wedgeTimeoutMs": 300,
+            "spark.rapids.tpu.metrics.enabled": True,
+            "spark.rapids.tpu.metrics.sample.intervalMs": 0,
+            # pin the memory-managed operator pipeline: the auto-mesh
+            # distributed pipeline AND the single-chip fused fragment
+            # compiler run whole fragments as one XLA program with their
+            # own memory story — neither touches the reserve sites this
+            # battery pressures
+            "spark.rapids.tpu.distributed.enabled": False,
+            "spark.rapids.tpu.sql.fusedPipeline.enabled": False}
+    conf.update(extra or {})
+    s = tpu_session(conf)
+    s._ctx = ExecContext(s.conf, semaphore=sem, memory=mm)
+    return s
+
+
+def _battery(s):
+    agg = (s.create_dataframe(_T, num_partitions=3).group_by("k", "g")
+           .agg(F.sum(F.col("v")).with_name("sv"),
+                F.count_star().with_name("n"),
+                F.min(F.col("v")).with_name("mn"),
+                F.max(F.col("v")).with_name("mx")))
+    join = (s.create_dataframe(_T, num_partitions=2)
+            .join(s.create_dataframe(_R),
+                  on=[(F.col("k"), F.col("k2"))], how="inner")
+            .group_by("k")
+            .agg(F.sum(F.col("w")).with_name("sw"),
+                 F.count_star().with_name("n")))
+    sort = (s.create_dataframe(_T, num_partitions=2)
+            .filter(F.col("v") > 10)
+            .order_by(F.col("u").asc()))
+    return [agg, join, sort]
+
+
+def _canon(df: pd.DataFrame) -> pd.DataFrame:
+    return (df.sort_values(by=list(df.columns), kind="mergesort")
+            .reset_index(drop=True))
+
+
+def _run_battery(s, rounds=2):
+    out = []
+    for _ in range(rounds):
+        for q in _battery(s):
+            out.append(_canon(q.to_pandas()))
+    return out
+
+
+def test_concurrent_sessions_under_injected_pressure():
+    mm = MemoryManager(64 * 1024 * 1024, 1 << 30,
+                       "/tmp/srtpu_chaos_battery")
+    sem = DeviceSemaphore(2, timeout_s=120.0, wedge_timeout_ms=300,
+                          memory=mm)
+    # fault-free baseline through the SAME shared manager/semaphore
+    base_s = _mk_session(mm, sem)
+    want = _run_battery(base_s, rounds=1)
+    base_s._ctx.close()
+
+    ctl = ChaosController(
+        "mem.oom=p0.12;mem.reserve.delay=p0.05;sem.stall=2",
+        seed=7, delay_ms=40)
+    install_chaos(ctl)
+    results = {}
+    errors = {}
+
+    def tenant(i):
+        try:
+            s = _mk_session(mm, sem)
+            try:
+                results[i] = _run_battery(s, rounds=2)
+            finally:
+                s._ctx.close()
+        except BaseException as e:   # noqa: BLE001 - surfaced below
+            errors[i] = e
+
+    def dead_holder():
+        # a "killed worker": takes a permit and dies without releasing —
+        # the wedge watchdog must reclaim it or half the battery hangs
+        sem.acquire()
+
+    threads = [threading.Thread(target=tenant, args=(i,),
+                                name=f"tenant-{i}") for i in range(4)]
+    killer = threading.Thread(target=dead_holder, name="killed-worker")
+    # the worker dies holding BEFORE the tenants start, and stays dead
+    # past the wedge horizon — so the very first tenant acquire must
+    # find it overdue and reclaim the permit (deterministic regardless
+    # of how fast warm-cache queries finish)
+    killer.start()
+    killer.join()
+    time.sleep(0.35)
+    for t in threads:
+        t.start()
+    t0 = time.monotonic()
+    for t in threads:
+        t.join(timeout=180)
+        assert not t.is_alive(), "battery thread wedged"
+    install_chaos(None)
+    assert not errors, f"queries failed under chaos: {errors}"
+
+    # byte-equality: pressure (retries, splits, degradations) must be
+    # invisible in results — each tenant saw both rounds identical to
+    # the fault-free baseline
+    for i, got in results.items():
+        assert len(got) == 2 * len(want)
+        for j, g in enumerate(got):
+            pd.testing.assert_frame_equal(g, want[j % len(want)],
+                                          check_exact=True)
+
+    # the dead holder's permit was force-released within the wedge
+    # horizon (the battery completing at all proves no permanent wedge;
+    # the counter proves the watchdog did it, not luck)
+    assert sem.wedges >= 1
+    assert sem.diagnostics()["holders"] == []
+    assert time.monotonic() - t0 < 180
+
+    # ---- phase 2: saturation pass. mem.oom=* fires on EVERY reserve,
+    # so each battery query type deterministically records its first
+    # reserve site before escalating through the query ladder — the
+    # ">= 3 distinct reserve sites" bar cannot depend on how the
+    # probabilistic phase's draws landed across thread interleavings.
+    ctl2 = ChaosController("mem.oom=*")
+    install_chaos(ctl2)
+    try:
+        sat_s = _mk_session(mm, sem)
+        try:
+            got_sat = _run_battery(sat_s, rounds=1)
+        finally:
+            sat_s._ctx.close()
+    finally:
+        install_chaos(None)
+    for j, g in enumerate(got_sat):
+        pd.testing.assert_frame_equal(g, want[j], check_exact=True)
+
+    # injection coverage: mem.oom hit >= 3 DISTINCT reserve sites
+    # (operator-level, recorded at fire time)
+    sites = set(ctl.contexts("mem.oom")) | set(ctl2.contexts("mem.oom"))
+    assert len(sites) >= 3, sites
+    fired_sites = {site for site, _ in ctl.fired() + ctl2.fired()}
+    assert "mem.oom" in fired_sites
+    assert "sem.stall" in fired_sites
+
+    # zero cross-session spillable leakage: nothing still registered
+    assert mm.audit_leaks() == []
+
+    # fault counters exported through the metrics registry
+    from spark_rapids_tpu.metrics import registry as mreg
+    snap = mreg.REGISTRY.snapshot()
+    assert snap["srtpu_oom_retries_total"]["series"][0]["value"] > 0
+    assert snap["srtpu_semaphore_wedge_total"]["series"][0]["value"] >= 1
+
+
+def test_persistent_oom_degrades_query_to_host_not_failure():
+    """mem.oom=* (EVERY reserve raises): the escalation ladder must
+    still complete the query — ultimately via the whole-query host
+    rung — with correct results, an OOM_PRESSURE_HOST tag on the
+    session's refreshed placement summary, and zero leaked batches."""
+    s = tpu_session({"spark.rapids.tpu.metrics.enabled": True,
+                     "spark.rapids.tpu.metrics.sample.intervalMs": 0})
+    df = (s.create_dataframe(_T, num_partitions=2)
+          .group_by("k").agg(F.sum(F.col("v")).with_name("sv")))
+    want = _canon(df.to_pandas())
+    install_chaos(ChaosController("mem.oom=*"))
+    try:
+        got = _canon(df.to_pandas())
+    finally:
+        install_chaos(None)
+    pd.testing.assert_frame_equal(got, want, check_exact=True)
+    codes = s.last_placement_report["codes"]
+    assert codes.get("OOM_PRESSURE_HOST", 0) >= 1, codes
+    from spark_rapids_tpu.metrics import registry as mreg
+    snap = mreg.REGISTRY.snapshot()
+    series = snap["srtpu_oom_host_fallback_total"]["series"]
+    assert sum(x["value"] for x in series) >= 1
+    from spark_rapids_tpu.mem import MemoryManager as MM
+    assert MM.audit_all_leaks() == []
+
+
+def test_query_timeout_cancels_releases_semaphore_and_leaks_nothing():
+    """Cooperative cancellation contract: a query cancelled by
+    spark.rapids.tpu.query.timeout raises QueryTimeout, leaves the
+    semaphore fully available (no stuck holder), and closes every
+    spillable it had in flight (zero-leak audit)."""
+    mm = MemoryManager(1 << 30, 1 << 30, "/tmp/srtpu_chaos_qt")
+    sem = DeviceSemaphore(2, timeout_s=60.0, wedge_timeout_ms=200,
+                          memory=mm)
+    s = _mk_session(mm, sem,
+                    {"spark.rapids.tpu.query.timeout": 0.4})
+
+    def slow(pdf):
+        time.sleep(0.2)
+        return pdf
+
+    # sort wraps its child's batches spillable, so cancellation fires
+    # with registered batches in flight — exactly what must not leak
+    df = (s.create_dataframe(_T, num_partitions=6)
+          .map_in_pandas(slow, _T.schema)
+          .order_by(F.col("u").asc()))
+    with pytest.raises(QueryTimeout):
+        df.to_pandas()
+    # the semaphore is fully released: both permits acquirable from
+    # fresh threads simultaneously
+    got = []
+
+    def taker():
+        sem.acquire()
+        got.append(1)
+
+    ts = [threading.Thread(target=taker) for _ in range(2)]
+    [t.start() for t in ts]
+    [t.join(timeout=5) for t in ts]
+    assert got == [1, 1]
+    assert mm.audit_leaks() == []
+    from spark_rapids_tpu.metrics import registry as mreg
+    snap = mreg.REGISTRY.snapshot()
+    assert snap["srtpu_query_timeout_total"]["series"][0]["value"] >= 1
+    s._ctx.close()
+
+
+def test_query_timeout_while_waiting_on_semaphore():
+    """A query whose task is parked INSIDE semaphore.acquire() still
+    honors the deadline: the wait loop polls it and raises QueryTimeout
+    (not the semaphore's own 10-minute TimeoutError)."""
+    mm = MemoryManager(1 << 30, 1 << 30, "/tmp/srtpu_chaos_qt2")
+    sem = DeviceSemaphore(1, timeout_s=60.0, wedge_timeout_ms=100,
+                          memory=mm)
+    s = _mk_session(mm, sem, {"spark.rapids.tpu.query.timeout": 0.3})
+    evt = threading.Event()
+
+    def hog():
+        with sem.held():
+            evt.wait(5.0)
+
+    t = threading.Thread(target=hog)
+    t.start()
+    time.sleep(0.05)          # let the hog take the only permit
+    df = (s.create_dataframe(_T, num_partitions=1)
+          .group_by("k").agg(F.sum(F.col("v")).with_name("sv")))
+    try:
+        with pytest.raises(QueryTimeout):
+            df.to_pandas()
+    finally:
+        evt.set()
+        t.join(timeout=5)
+    assert mm.audit_leaks() == []
+    s._ctx.close()

@@ -43,11 +43,43 @@ class TestRetryFramework:
         assert with_retry_no_split(work, mm) == "ok"
         assert len(attempts) == 3  # two injected failures + success
 
-    def test_split_and_retry_is_fatal_without_splitter(self):
+    def test_split_and_retry_without_splitter_degrades_to_host(self):
+        """r14 ladder: SplitAndRetryOOM in a no-split frame is no longer
+        fatal — it escalates through the pressure spill to the host
+        degradation rung and the attempt completes under an unbudgeted
+        grant (recorded as a host fallback)."""
+        from spark_rapids_tpu.mem.retry import RetryStats
         mm = _mm()
-        mm.force_split_and_retry_oom(1)
+        # one raise per rung: first attempt + the post-pressure retry,
+        # so the ladder must reach the degradation rung to succeed
+        mm.force_split_and_retry_oom(2)
+        stats = RetryStats()
+        seen = []
+
+        def work():
+            mm.reserve(10)
+            mm.release(10)
+            seen.append(mm.in_pressure_grant())
+            return "ok"
+
+        assert with_retry_no_split(work, mm, stats) == "ok"
+        assert stats.pressure_spills == 1
+        assert stats.host_fallbacks == 1
+        assert seen == [True]          # the attempt ran under the grant
+
+    def test_split_and_retry_fatal_when_host_fallback_disabled(self):
+        """spark.rapids.tpu.oom.hostFallback.enabled=false restores the
+        pre-r14 contract: the ladder ends in OutOfDeviceMemory."""
+        from spark_rapids_tpu.config import TpuConf
+        from spark_rapids_tpu.exec.base import ExecContext
+        mm = _mm()
+        ctx = ExecContext(TpuConf(
+            {"spark.rapids.tpu.oom.hostFallback.enabled": False}),
+            memory=mm)
+        mm.force_split_and_retry_oom(2)
         with pytest.raises(OutOfDeviceMemory):
-            with_retry_no_split(lambda: mm.reserve(10), mm)
+            with_retry_no_split(lambda: mm.reserve(10), mm, ctx=ctx)
+        mm.clear_injections()
 
     def test_with_retry_splits_input(self):
         mm = _mm()
@@ -67,20 +99,25 @@ class TestRetryFramework:
         assert len(seen) == 2  # split in half
         assert sorted(seen) == [50, 50]
 
-    def test_split_batch_closes_everything_on_failure(self):
-        """If the SECOND piece's wrap blows up mid-split, the input and
-        the already-wrapped first piece must both be closed — a
-        half-built split pinning pool budget is a leak the suite-wide
-        zero-leak fixture would flag."""
+    def test_split_batch_closes_pieces_keeps_input_on_failure(self):
+        """If the SECOND piece's wrap blows up mid-split, the
+        already-wrapped first piece must close (a half-built split must
+        not pin pool budget) but the INPUT stays open — the r14 ladder
+        still owns it and can escalate (pressure spill, host
+        degradation) with the data intact. RetryOOM is absorbed at the
+        allocation site now, so the failure here is a SplitAndRetryOOM
+        (never absorbed: only the caller can split)."""
         from spark_rapids_tpu.mem.retry import split_batch_in_half
         mm = _mm()
         sb = SpillableBatch(_batch(100), mm)
         # skip piece 1's reserve, fail piece 2's
-        mm.force_retry_oom(1, skip=1)
-        with pytest.raises(RetryOOM):
+        mm.force_split_and_retry_oom(1, skip=1)
+        with pytest.raises(SplitAndRetryOOM):
             split_batch_in_half(sb)
         mm.clear_injections()
-        assert sb._closed
+        assert not sb._closed
+        assert len(mm.audit_leaks()) == 1   # the still-open input only
+        sb.close()
         assert mm.audit_leaks() == []
 
     def test_split_batch_uses_public_manager_accessor(self):
@@ -102,6 +139,155 @@ class TestRetryFramework:
         mm.reserve(1)
         with pytest.raises(RetryOOM):
             mm.reserve(1)
+
+
+class TestCheckpointRestore:
+    """Satellite regression guard: an operator that MUTATES its input
+    state then OOMs must produce byte-identical output after the retry
+    (ref Retryable.scala CheckpointRestore)."""
+
+    class _Acc:
+        def __init__(self):
+            self.rows = []
+
+        def checkpoint(self):
+            self._saved = list(self.rows)
+
+        def restore(self):
+            self.rows = list(self._saved)
+
+    def test_mutating_operator_retries_byte_identical(self):
+        mm = _mm()
+        acc = self._Acc()
+
+        def work():
+            acc.rows.extend(range(100))   # side effect BEFORE the OOM
+            mm.reserve(1)
+            mm.release(1)
+            return list(acc.rows)
+
+        mm.force_retry_oom(1)
+        out = with_retry_no_split(work, mm, retryable=acc)
+        # restored between attempts: rows appear ONCE, not twice
+        assert out == list(range(100))
+
+    def test_without_checkpoint_the_mutation_doubles(self):
+        """The failure mode the contract exists for: no retryable means
+        the second attempt re-appends onto mutated state."""
+        mm = _mm()
+        acc = self._Acc()
+
+        def work():
+            acc.rows.extend(range(10))
+            mm.reserve(1)
+            mm.release(1)
+            return list(acc.rows)
+
+        mm.force_retry_oom(1)
+        out = with_retry_no_split(work, mm)
+        assert len(out) == 20             # doubled — retry was not clean
+
+
+class TestSplitDepthLadder:
+    def test_split_depth_bound_escalates_to_host_rung(self):
+        """A piece that still cannot fit at oom.maxSplitDepth escalates:
+        pressure spill, then the host degradation rung completes it
+        under the grant — all 64 input rows processed, zero leaks."""
+        from spark_rapids_tpu.mem.retry import RetryStats
+        mm = _mm()
+        sb = SpillableBatch(_batch(64), mm)
+        stats = RetryStats()
+        calls = []
+
+        def fn(item):
+            b = item.get()
+            if not mm.in_pressure_grant() and b.num_rows > 1:
+                raise SplitAndRetryOOM("still too big")
+            calls.append(b.num_rows)
+            item.close()
+            return b.num_rows
+
+        total = sum(with_retry([sb], fn, mm, stats=stats,
+                               max_split_depth=2))
+        assert total == 64
+        # depth cap 2 means no piece smaller than 64/4 was ever split
+        assert min(calls) >= 16
+        assert stats.splits >= 2
+        assert stats.pressure_spills == 1
+        assert stats.host_fallbacks >= 1
+        assert mm.audit_leaks() == []
+
+    def test_unsplittable_single_row_degrades(self):
+        mm = _mm()
+        sb = SpillableBatch(_batch(1), mm)
+        seen = []
+
+        def fn(item):
+            b = item.get()
+            if not mm.in_pressure_grant():
+                raise SplitAndRetryOOM("cannot ever fit")
+            seen.append(b.num_rows)
+            item.close()
+            return b.num_rows
+
+        assert list(with_retry([sb], fn, mm)) == [1]
+        assert seen == [1]
+        assert mm.audit_leaks() == []
+
+
+class TestMemoryChaosSites:
+    def test_mem_oom_site_fires_on_exact_nth_reserve(self):
+        from spark_rapids_tpu.aux.fault import (ChaosController,
+                                                install_chaos)
+        mm = _mm()
+        install_chaos(ChaosController("mem.oom=2"))
+        try:
+            mm.reserve(1)                 # hit 1: clean
+            with pytest.raises(RetryOOM):
+                mm.reserve(1)             # hit 2: injected
+            mm.reserve(1)                 # hit 3: clean again
+        finally:
+            install_chaos(None)
+        mm.release(2)
+
+    def test_mem_reserve_delay_site_stalls(self):
+        import time
+        from spark_rapids_tpu.aux.fault import (ChaosController,
+                                                install_chaos)
+        mm = _mm()
+        install_chaos(ChaosController("mem.reserve.delay=1",
+                                      delay_ms=80))
+        try:
+            t0 = time.perf_counter()
+            mm.reserve(1)
+            assert time.perf_counter() - t0 >= 0.08
+        finally:
+            install_chaos(None)
+        mm.release(1)
+
+    def test_pressure_grant_suppresses_injection_and_chaos(self):
+        from spark_rapids_tpu.aux.fault import (ChaosController,
+                                                install_chaos)
+        mm = _mm(budget=100)
+        install_chaos(ChaosController("mem.oom=*"))
+        try:
+            with mm.pressure_host_grant():
+                assert mm.in_pressure_grant()
+                mm.reserve(1000)          # over budget AND chaos-armed
+                assert mm.stats()["pressure_granted"] == 1000
+                mm.release(1000)          # drains the grant pool, not
+                assert mm.stats()["pressure_granted"] == 0  # device_used
+        finally:
+            install_chaos(None)
+
+    def test_spill_all_sessions_spills_registered_instances(self):
+        mm = _mm()
+        sb = SpillableBatch(_batch(500), mm)
+        assert sb.tier == "device"
+        freed = mm.spill_everything()
+        assert freed > 0 and sb.tier == "host"
+        assert sb.get().num_rows == 500   # unspill round-trips
+        sb.close()
 
 
 class TestSpill:
@@ -177,6 +363,68 @@ class TestSemaphore:
                 pass
         with sem.held():
             pass
+
+    def test_wedge_watchdog_force_releases_dead_holder(self):
+        """A holder thread that dies without releasing (a killed
+        worker) must not wedge the semaphore: the watchdog detects the
+        dead thread and reclaims its permit within wedgeTimeoutMs."""
+        sem = DeviceSemaphore(1, timeout_s=10.0, wedge_timeout_ms=150)
+        t = threading.Thread(target=sem.acquire, name="doomed")
+        t.start()
+        t.join()
+        assert len(sem.diagnostics()["holders"]) == 1
+        import time
+        t0 = time.monotonic()
+        with sem.held():                  # recovers via force-release
+            pass
+        assert time.monotonic() - t0 < 5.0
+        assert sem.wedges == 1
+        assert sem.diagnostics()["holders"] == []
+
+    def test_wedge_diagnostics_in_timeout_error(self):
+        """A LIVE stalled holder is never force-released; the waiter's
+        TimeoutError carries the holder/waiter diagnostics dump."""
+        import time
+        sem = DeviceSemaphore(1, timeout_s=0.4, wedge_timeout_ms=100)
+        evt = threading.Event()
+
+        def hog():
+            with sem.held():
+                evt.wait(5.0)
+
+        t = threading.Thread(target=hog, name="hog")
+        t.start()
+        time.sleep(0.05)
+        try:
+            with pytest.raises(TimeoutError, match="holders"):
+                sem.acquire()
+        finally:
+            evt.set()
+            t.join(timeout=5)
+        assert sem.wedges == 0            # live holders are untouchable
+
+    def test_sem_stall_chaos_site_stalls_holder(self):
+        import time
+        from spark_rapids_tpu.aux.fault import (ChaosController,
+                                                install_chaos)
+        ctl = ChaosController("sem.stall=1", delay_ms=80)
+        install_chaos(ctl)
+        sem = DeviceSemaphore(2)
+        try:
+            t0 = time.perf_counter()
+            with sem.held():
+                held_at = time.perf_counter() - t0
+            assert held_at >= 0.08        # stalled WHILE holding
+            assert ("sem.stall", 1) in ctl.fired()
+        finally:
+            install_chaos(None)
+
+    def test_diagnostics_carry_memory_stats(self):
+        mm = _mm()
+        sem = DeviceSemaphore(2, memory=mm)
+        d = sem.diagnostics()
+        assert d["permits"] == 2
+        assert "budget" in d["memory"]
 
 
 class TestAggregateUnderOOM:
