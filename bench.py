@@ -134,15 +134,23 @@ def check_strings(res, base):
 
 # ---------------------------------------------------------------------------
 
+def _bench_round_no(p):
+    m = re.search(r"r(\d+)", os.path.basename(p))
+    return int(m.group(1)) if m else -1
+
+
+def _bench_artifacts():
+    """Every BENCH_r*.json beside this script, oldest round first — ONE
+    discovery for the regression gate and the regress-delta emitter."""
+    return sorted(glob.glob(os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "BENCH_r*.json")),
+        key=_bench_round_no)
+
+
 def previous_bench():
     """Newest BENCH_r*.json with a parsed summary (regression gate)."""
-    def round_no(p):
-        m = re.search(r"r(\d+)", os.path.basename(p))
-        return int(m.group(1)) if m else -1
-
     best = None
-    for p in sorted(glob.glob(os.path.join(os.path.dirname(
-            os.path.abspath(__file__)), "BENCH_r*.json")), key=round_no):
+    for p in _bench_artifacts():
         try:
             j = json.load(open(p))
         except Exception:
@@ -699,6 +707,24 @@ def main():
         "wall_s": round(time.perf_counter() - START, 1),
         "details": details,
     }))
+    # one-line machine-checkable delta vs the newest prior BENCH_r*.json
+    # (ISSUE 15 satellite): the SAME differ the tools/regress CLI
+    # exposes, so ladder rounds land with evidence, not eyeballed
+    # geomeans — golden-tested in tests/test_ops.py
+    try:
+        from spark_rapids_tpu.tools.regress import (
+            diff_bench, format_bench_delta, load_bench, normalize_bench)
+        priors = _bench_artifacts()
+        if priors and details:
+            cur = normalize_bench({"geomean": round(geo, 3),
+                                   "placement_counts": placement_counts,
+                                   "details": details})
+            delta = diff_bench(load_bench(priors[-1]), cur)
+            log("bench: " + format_bench_delta(
+                delta, os.path.basename(priors[-1])))
+    except Exception as e:                           # noqa: BLE001
+        log(f"bench: regress delta unavailable: {e}")
+
     if wrong or (failed and not details):
         # correctness regressions ALWAYS fail the run; infra failures
         # only when nothing completed (a partial ladder with real

@@ -747,9 +747,21 @@ class DataFrame:
                 d = plan_digest(self.plan)
             return d
 
-        if elog is not None:
+        # live ops plane (ISSUE 15): one module-global load + branch per
+        # consumer when nothing is configured — the trace/metrics
+        # disabled-path contract
+        from ..ops import flight as flight_mod
+        from ..ops import sentinel as sentinel_mod
+        from ..ops import server as ops_server_mod
+        frec = flight_mod.RECORDER
+        sentinel = sentinel_mod.SENTINEL
+        _srv = ops_server_mod.SERVER
+        tracker = _srv.tracker if _srv is not None else None
+        if (elog is not None or tracker is not None or frec is not None
+                or sentinel is not None):
             qid = next(self.session._query_seq)
             digest = _resolve_digest()
+        if elog is not None:
             elog.write({"event": "queryStart", "queryId": qid,
                         "planDigest": digest,
                         "root": type(self.plan).__name__,
@@ -758,6 +770,16 @@ class DataFrame:
                         "placement": placement_summary,
                         "conf": {k: str(v) for k, v
                                  in sorted(self.session.conf.raw.items())}})
+        track_tok = None
+        if tracker is not None:
+            track_tok = tracker.begin(
+                qid, digest, (placement_summary or {}).get("verdict"),
+                root=type(self.plan).__name__)
+        if frec is not None:
+            # anomaly dumps fired from THIS thread (semaphore wedge, OOM
+            # ladder) carry the in-flight query's digest + coded report
+            frec.set_query({"queryId": qid, "planDigest": digest,
+                            "placement": placement_summary})
         trace_path = None
         import time as _time
         # executable-cache counters around the run: zero in-process
@@ -766,6 +788,11 @@ class DataFrame:
         # record_engine_wall / record_op_wall exec-cache-hit keying)
         from ..plan import exec_cache
         cache_before = exec_cache.stats()
+        # warm-digest recompile detector (ops/flight.py): this digest's
+        # executables were vouched warm — any backend-compile seconds
+        # the run pays anyway is an anomaly worth a bundle
+        was_warm = (frec is not None and digest is not None
+                    and exec_cache.plan_digest_cached(digest))
         # ---------------- query-lifecycle controller (ISSUE 14) --------
         # cooperative deadline: every operator checks it per produced
         # batch and the semaphore polls it, so a timed-out query unwinds
@@ -778,6 +805,7 @@ class DataFrame:
         qt = float(self.session.conf.get(QUERY_TIMEOUT))
         ctx.set_query_deadline(_time.monotonic() + qt if qt > 0 else None)
         ctx.take_oom_degradations()          # per-query reset
+        ctx.take_ladder_rung()               # per-query reset
         degs: List[dict] = []
 
         def _attempt(p):
@@ -801,9 +829,16 @@ class DataFrame:
             from ..metrics import registry as _mr
             if _mr.REGISTRY is not None:
                 _mr.REGISTRY.counter("srtpu_query_timeout_total").inc()
+            if frec is not None:
+                frec.trigger(
+                    "query_timeout",
+                    detail=f"query {qid if qid is not None else '?'} "
+                           f"(digest {digest or '?'}) cancelled by "
+                           "spark.rapids.tpu.query.timeout")
 
         t0 = _time.perf_counter()
         ok = False
+        fail_reason = None
         try:
             try:
                 out = _attempt(physical)
@@ -829,9 +864,16 @@ class DataFrame:
             except QueryTimeout:
                 _note_timeout()
                 raise
+        except BaseException as e:
+            # satellite fix (ISSUE 15): the event log only distinguished
+            # ok/exception — a cancelled or failed query now records WHY
+            # (tools/history renders the reason column)
+            fail_reason = f"{type(e).__name__}: {e}"
+            raise
         finally:
             ctx.set_query_deadline(None)
             degs = ctx.take_oom_degradations()
+            ladder_rung = ctx.take_ladder_rung()
             prof.maybe_stop()
             self.session.last_query_metrics = tm.finish()
             if tracer is not None:
@@ -871,6 +913,26 @@ class DataFrame:
             from ..metrics import registry as metrics_registry
             mreg = metrics_registry.REGISTRY
             wall_s = _time.perf_counter() - t0
+            # PROCESS-global counter delta (the compile_free_since
+            # contract): a concurrent query's compile lands in this
+            # delta too. Both consumers err conservative with it — the
+            # sentinel treats the run as cold (skips, never
+            # false-flags) and warm_recompile is rate-limited — but a
+            # page's compileSeconds can over-attribute under mixed
+            # concurrent traffic, exactly like the learned-cost feeds.
+            compile_s_paid = round(
+                exec_cache.stats()["compile_s"]
+                - cache_before["compile_s"], 4)
+            # one reason for every consumer (event log, /queries): a
+            # failed query carries its exception, a rung-4 degraded one
+            # carries which operators fell back
+            if not ok:
+                reason = fail_reason
+            elif degs:
+                reason = ("degraded: " + "; ".join(
+                    f"{d['op']}: {d['detail']}" for d in degs))[:500]
+            else:
+                reason = None
             if mreg is not None:
                 mreg.counter("srtpu_queries_total",
                              status="ok" if ok else "failed").inc()
@@ -880,10 +942,21 @@ class DataFrame:
                 end_rec = {"event": "queryEnd", "queryId": qid,
                            "planDigest": digest, "ok": ok,
                            "durationMs": round(wall_s * 1000.0, 3),
+                           # satellite (ISSUE 15): cancellation and
+                           # degradation are first-class outcomes, not
+                           # just "ok": false — the sentinel and
+                           # tools/history read these four directly
+                           "degraded": bool(degs),
+                           "ladderRung": ladder_rung,
+                           "compileSeconds": compile_s_paid,
+                           "placementVerdict": (placement_summary
+                                                or {}).get("verdict"),
                            "metrics": metrics_to_json(
                                self.session.last_query_metrics),
                            "faultStats": self.session.last_fault_stats,
                            "trace": trace_path}
+                if reason:
+                    end_rec["reason"] = reason
                 if degs:
                     # queryStart already shipped the plan-time summary;
                     # degradations are runtime facts, so the END record
@@ -892,6 +965,30 @@ class DataFrame:
                     end_rec["oomDegradations"] = degs
                     end_rec["placement"] = placement_summary
                 elog.write(end_rec)
+            if frec is not None:
+                if was_warm and compile_s_paid > 0:
+                    # warm-digest recompile: the compiled-plan set
+                    # vouched for this digest, yet the run paid real XLA
+                    # compile — a retrace cliff or an evicted tier
+                    frec.trigger(
+                        "warm_recompile",
+                        detail=f"digest {digest} is in the compiled-"
+                               f"plan set but paid {compile_s_paid}s "
+                               "of backend compile")
+                frec.set_query(None)
+            if sentinel is not None and digest is not None:
+                # fold AFTER the event record: the sentinel sees exactly
+                # what a tools/regress replay of this log would see
+                sentinel.fold({"digest": digest,
+                               "wallMs": round(wall_s * 1000.0, 3),
+                               "verdict": (placement_summary
+                                           or {}).get("verdict"),
+                               "rung": ladder_rung, "ok": ok,
+                               "compileS": compile_s_paid})
+            if tracker is not None and track_tok is not None:
+                tracker.end(track_tok, ok=ok,
+                            wall_ms=wall_s * 1000.0, rung=ladder_rung,
+                            reason=reason, degraded=bool(degs))
             if ok and not side_effects and not degs:
                 # (a degraded run's wall mixes failed attempts and the
                 # emergency host path — never feed it to the cost model)
@@ -950,6 +1047,9 @@ class DataFrame:
         degradation — pressure degrades *placement*, never results."""
         from ..mem.manager import (MemoryManager, OutOfDeviceMemory,
                                    RetryOOM, SplitAndRetryOOM)
+        ctx.note_ladder_rung(
+            3, f"query-level pressure spill after {type(err).__name__} "
+               "escaped every operator retry frame")
         MemoryManager.spill_all_sessions()
         ctx.memory.spill_everything()    # explicit managers too
         ctx.metrics.clear()

@@ -61,6 +61,11 @@ class ExecContext:
         # never per kernel — plan/exec_cache.py)
         from ..plan import exec_cache
         exec_cache.configure_from_conf(self.conf)
+        # live ops plane: HTTP endpoint, flight recorder, regression
+        # sentinel — same install pattern; with nothing configured this
+        # is three conf lookups and no threads (ops/__init__.py)
+        from ..ops import ensure_ops_plane_from_conf
+        ensure_ops_plane_from_conf(self.conf)
         from ..config import SEMAPHORE_WEDGE_TIMEOUT_MS, TASK_TIMEOUT
         self.memory = memory or MemoryManager.get(self.conf)
         self.semaphore = semaphore or DeviceSemaphore(
@@ -79,6 +84,11 @@ class ExecContext:
         #: ladder (mem/retry.py): [{"op", "detail"}, ...]; drained per
         #: query by api/dataframe._execute_wrapped
         self.oom_degradations: List[dict] = []  # tpulint: guarded-by _oom_lock
+        #: highest OOM-escalation rung any ladder reached this query
+        #: (1 retry / 2 split / 3 pressure spill / 4 host degradation);
+        #: drained per query next to oom_degradations — the queryEnd
+        #: record, /queries and the regression sentinel all read it
+        self.max_ladder_rung = 0  # tpulint: guarded-by _oom_lock
         #: speculative output sizing (joins skip the count->host sync and
         #: guess the bucket); the FINAL sink calls check_speculations() once
         self.speculate = self.conf.join_speculative_sizing
@@ -118,6 +128,30 @@ class ExecContext:
             mr.counter("srtpu_oom_host_fallback_total", op=op).inc()
             mr.counter("srtpu_placement_fallback_total",
                        code="OOM_PRESSURE_HOST", op=op).inc()
+        self.note_ladder_rung(4, f"{op}: {detail}")
+
+    def note_ladder_rung(self, rung: int, detail: str = "") -> None:
+        """Record the OOM-escalation rung a ladder just reached (the
+        per-query max survives to the queryEnd record). Crossing into
+        rung >= 3 for the first time this query fires the flight
+        recorder's ``oom_ladder`` trigger — the PR-14 anomaly sites
+        dumped diagnostics only into exception strings before."""
+        with self._oom_lock:
+            prev = self.max_ladder_rung
+            self.max_ladder_rung = max(prev, int(rung))
+        if rung >= 3 and rung > prev and prev < 3:
+            from ..ops import flight as flight_mod
+            fr = flight_mod.RECORDER
+            if fr is not None:
+                fr.trigger("oom_ladder",
+                           detail=detail
+                           or f"OOM escalation reached rung {rung}")
+
+    def take_ladder_rung(self) -> int:
+        """Drain the per-query max escalation rung (per-query reset)."""
+        with self._oom_lock:
+            rung, self.max_ladder_rung = self.max_ladder_rung, 0
+        return rung
 
     def take_oom_degradations(self) -> List[dict]:
         """Drain the recorded degradations (per-query reset)."""

@@ -504,7 +504,8 @@ class MemoryManager:
             insts = list(cls._instances.values())
         out = {"device_used": 0, "host_used": 0, "disk_used": 0,
                "max_device_used": 0, "budget": 0,
-               "spill_to_host_bytes": 0, "spill_to_disk_bytes": 0}
+               "spill_to_host_bytes": 0, "spill_to_disk_bytes": 0,
+               "pressure_granted": 0}
         for mm in insts:
             st = mm.stats()
             for k in out:

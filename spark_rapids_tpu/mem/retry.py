@@ -155,12 +155,16 @@ class _Ladder:
         if self.stats is not None:
             self.stats.retries += 1
         _trace_oom("oom.retry", attempt)
+        if self.ctx is not None:
+            self.ctx.note_ladder_rung(1)
         self.restore()
 
     def note_split(self, attempt: int) -> None:
         if self.stats is not None:
             self.stats.splits += 1
         _trace_oom("oom.split", attempt)
+        if self.ctx is not None:
+            self.ctx.note_ladder_rung(2)
         self.restore()
 
     def _conf(self):
@@ -189,6 +193,17 @@ class _Ladder:
         if tr is not None:
             tr.instant("oom.pressure_spill", cat="mem",
                        args={"freed_bytes": freed, "op": self.op})
+        detail = (f"rung-3 cross-session pressure spill for "
+                  f"op={self.op or '?'} freed {freed} bytes")
+        if self.ctx is not None:
+            self.ctx.note_ladder_rung(3, detail)
+        else:
+            # no ExecContext (a bare with_retry outside any query): the
+            # anomaly still pages — trigger the flight recorder directly
+            from ..ops import flight as flight_mod
+            fr = flight_mod.RECORDER
+            if fr is not None:
+                fr.trigger("oom_ladder", detail=detail)
 
     def degrade(self, thunk: Callable[[], T], detail: str,
                 prefer_fallback: bool = True) -> T:
@@ -216,6 +231,12 @@ class _Ladder:
             if mr is not None:
                 mr.counter("srtpu_oom_host_fallback_total",
                            op=op_kind).inc()
+            from ..ops import flight as flight_mod
+            fr = flight_mod.RECORDER
+            if fr is not None:
+                fr.trigger("oom_ladder",
+                           detail=f"rung-4 host degradation for "
+                                  f"op={op_kind}: {detail}")
         if prefer_fallback and self.host_fallback is not None:
             return self.host_fallback()
         cpu = None

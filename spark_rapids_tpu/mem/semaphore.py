@@ -142,6 +142,13 @@ class DeviceSemaphore:
             mr = metrics_registry.REGISTRY
             if mr is not None:
                 mr.counter("srtpu_semaphore_wedge_total").inc()
+            from ..ops import flight as flight_mod
+            fr = flight_mod.RECORDER
+            if fr is not None:
+                fr.trigger("semaphore_wedge",
+                           detail=f"reclaimed permit of dead thread "
+                                  f"{stale['name']!r} (recycled ident); "
+                                  f"diagnostics: {self.diagnostics()}")
         if tr is not None:
             tr.complete("semaphore.wait", t0n, cat="sem",
                         args={"permits": self._permits})
@@ -236,6 +243,19 @@ class DeviceSemaphore:
             mr = metrics_registry.REGISTRY
             if mr is not None:
                 mr.counter("srtpu_semaphore_wedge_total").inc()
+        if released:
+            # anomaly hook (ISSUE 15): a force-release previously left
+            # its census only in the log — dump a flight bundle while
+            # the holder table still shows the wedge
+            from ..ops import flight as flight_mod
+            fr = flight_mod.RECORDER
+            if fr is not None:
+                fr.trigger(
+                    "semaphore_wedge",
+                    detail=f"force-released {len(released)} permit(s) "
+                           f"of dead holder(s) "
+                           f"{[h['name'] for h in released]}; "
+                           f"diagnostics: {self.diagnostics()}")
         if released or stuck:
             log.warning("semaphore diagnostics: %s", self.diagnostics())
         return released

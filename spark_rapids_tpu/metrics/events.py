@@ -24,14 +24,33 @@ import logging
 import os
 import threading
 import time
-from typing import Optional
+import weakref
+from typing import List, Optional
 
 from ..config import register
 
-__all__ = ["EventLogWriter", "plan_digest", "EVENT_LOG_ENABLED",
-           "EVENT_LOG_DIR", "EVENT_LOG_MAX_BYTES", "ACTIVE_NAME"]
+__all__ = ["EventLogWriter", "plan_digest", "writer_health",
+           "EVENT_LOG_ENABLED", "EVENT_LOG_DIR", "EVENT_LOG_MAX_BYTES",
+           "ACTIVE_NAME"]
 
 log = logging.getLogger(__name__)
+
+#: live writers, observed by the ops /healthz event-log-lag section;
+#: weak so a closed session's writer just drops out of the census
+_WRITERS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def writer_health() -> List[dict]:
+    """Per-writer write/error recency for the ops /healthz verdicts
+    (ops/server.py): a writer whose newest attempt FAILED — or that has
+    not landed a record in far too long — degrades the section."""
+    out = []
+    for w in list(_WRITERS):
+        with w._lock:
+            out.append({"dir": w.dir,
+                        "lastWriteTs": w.last_write_ts,
+                        "lastErrorTs": w.last_error_ts})
+    return sorted(out, key=lambda d: d["dir"])
 
 EVENT_LOG_ENABLED = register(
     "spark.rapids.tpu.eventLog.enabled", False,
@@ -75,6 +94,11 @@ class EventLogWriter:
         self.max_bytes = int(max_bytes)
         self._lock = threading.Lock()
         self._seq = self._next_seq()  # tpulint: guarded-by _lock
+        #: wall-clock of the last successful append / failed attempt
+        #: (the ops /healthz event-log-lag inputs)
+        self.last_write_ts: Optional[float] = None  # tpulint: guarded-by _lock
+        self.last_error_ts: Optional[float] = None  # tpulint: guarded-by _lock
+        _WRITERS.add(self)
 
     @classmethod
     def from_conf(cls, conf) -> Optional["EventLogWriter"]:
@@ -114,11 +138,14 @@ class EventLogWriter:
                     f.write(line)
                     f.flush()
                     size = f.tell()
+                self.last_write_ts = time.time()
                 if 0 < self.max_bytes < size:
                     self._rotate()
         except Exception as e:  # noqa: BLE001 - never fail a query
             log.warning("event log write to %s failed: %s",
                         self.dir, e)
+            with self._lock:
+                self.last_error_ts = time.time()
             return False
         from .registry import REGISTRY
         if REGISTRY is not None:

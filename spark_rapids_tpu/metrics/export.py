@@ -98,10 +98,22 @@ def json_text(snapshot: dict, indent: Optional[int] = 2) -> str:
 def merge_snapshots(snapshots: Dict[str, dict]) -> dict:
     """{lane_name: snapshot} -> one snapshot whose every series carries
     a ``worker`` label naming its source lane. Series are sorted, so
-    the merged view is deterministic regardless of arrival order."""
+    the merged view is deterministic regardless of arrival order.
+
+    Every lane is also stamped with its snapshot's capture instant: a
+    ``srtpu_worker_last_seen_ms`` gauge series per worker plus a
+    ``__lanes__`` metadata map. A dead (or wedged) worker's final
+    counters keep being merged — re-emitting them as if fresh was the
+    bug: now the exposition itself carries each lane's staleness, and
+    the ops ``/healthz`` heartbeat-age verdicts read it."""
     out: Dict[str, dict] = {}
+    lanes_meta: Dict[str, dict] = {}
     for lane in sorted(snapshots):
         snap = snapshots[lane] or {}
+        ts = snap.get("__ts__")
+        if ts is not None:
+            lanes_meta[lane] = {
+                "last_seen_ms": round(float(ts) * 1000.0, 1)}
         for name, ent in snap.items():
             if name.startswith("__"):
                 continue
@@ -113,6 +125,13 @@ def merge_snapshots(snapshots: Dict[str, dict]) -> dict:
                 labels["worker"] = lane
                 s2["labels"] = labels
                 dst["series"].append(s2)
+    if lanes_meta:
+        out["srtpu_worker_last_seen_ms"] = {"kind": "gauge", "series": [
+            {"labels": {"worker": lane},
+             "value": lanes_meta[lane]["last_seen_ms"]}
+            for lane in sorted(lanes_meta)]}
     for ent in out.values():
         ent["series"].sort(key=lambda s: sorted(s["labels"].items()))
+    if lanes_meta:
+        out["__lanes__"] = lanes_meta
     return out

@@ -374,6 +374,31 @@ declare_metric("srtpu_compile_seconds_total", "counter",
                "actually paid (persistent-tier hits pay none).")
 declare_metric("srtpu_event_log_records_total", "counter",
                "Records appended to the session event log.")
+declare_metric("srtpu_hbm_pressure_grant_bytes", "gauge",
+               "Bytes currently admitted OUTSIDE the device budget under "
+               "the rung-4 pressure host grant (mem/manager.py); any "
+               "nonzero value means an emergency host degradation is in "
+               "flight and degrades the ops /healthz memory verdict.")
+declare_metric("srtpu_worker_last_seen_ms", "gauge",
+               "Wall-clock milliseconds of each merged metric lane's "
+               "newest snapshot (merge_snapshots stamps one series per "
+               "worker label): the exposition itself says how stale a "
+               "lane's counters are, and the ops /healthz worker "
+               "verdicts read heartbeat age from it.")
+declare_metric("srtpu_ops_requests_total", "counter",
+               "HTTP requests served by the live ops endpoint, labeled "
+               "endpoint=/metrics|/healthz|/queries (ops/server.py).")
+declare_metric("srtpu_flight_dumps_total", "counter",
+               "Flight-recorder bundles written, labeled "
+               "trigger=<kind from the ops/flight.py closed taxonomy> "
+               "(semaphore_wedge, oom_ladder, query_timeout, "
+               "worker_evicted, warm_recompile, placement_revert, "
+               "sentinel_regression — docs/ops.md); rate-limited "
+               "suppressions are not counted.")
+declare_metric("srtpu_query_regressions_total", "counter",
+               "Regressions flagged by the per-digest sentinel, labeled "
+               "kind=warm_slowdown|verdict_flip|rung_escalation "
+               "(ops/sentinel.py, docs/ops.md).")
 declare_metric("srtpu_placement_fallback_total", "counter",
                "Operators/expressions kept off the device at plan time, "
                "labeled code=<reason code from the plan/tags.py closed "

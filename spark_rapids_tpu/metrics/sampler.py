@@ -44,6 +44,10 @@ def sample_now(reg: MetricRegistry) -> None:
     reg.gauge("srtpu_hbm_max_used_bytes").set(mm["max_device_used"])
     reg.gauge("srtpu_spill_store_host_bytes").set(mm["host_used"])
     reg.gauge("srtpu_spill_store_disk_bytes").set(mm["disk_used"])
+    # rung-4 emergency pool: nonzero means a host degradation is live
+    # (the ops /healthz memory verdict reads the same accounting)
+    reg.gauge("srtpu_hbm_pressure_grant_bytes").set(
+        mm["pressure_granted"])
     reg.counter("srtpu_spill_to_host_bytes_total").set_total(
         mm["spill_to_host_bytes"])
     reg.counter("srtpu_spill_to_disk_bytes_total").set_total(

@@ -1,0 +1,55 @@
+"""Live operations plane (ISSUE 15).
+
+The runtime's *serving-side* observability surface — the role the Spark
+live UI / history server plus the RAPIDS profiling tool play for the
+reference stack, collapsed into three cooperating modules:
+
+* :mod:`.server` — a stdlib ``http.server`` daemon thread (gated by
+  ``spark.rapids.tpu.ops.port``) serving ``/metrics`` (Prometheus
+  exposition, cluster-merged when a LocalCluster is live), ``/healthz``
+  (JSON verdicts over the semaphore, memory tiers, exec cache, worker
+  heartbeats and event-log lag) and ``/queries`` (in-flight + recent
+  queries with digest, placement verdict, elapsed and ladder rung);
+* :mod:`.flight` — an anomaly-triggered flight recorder: a bounded
+  always-on diagnostic ring plus trigger hooks at the PR-14 anomaly
+  sites (semaphore wedge, OOM ladder rung >= 3, query timeout,
+  chaos-free worker eviction) and two detectors (warm-digest recompile,
+  placement revert) that atomically dump ONE redacted bundle directory
+  per trigger, rate-limited per trigger kind;
+* :mod:`.sentinel` — a per-digest regression sentinel folding every
+  ``queryEnd`` into rolling baselines (median wall, compile seconds,
+  placement verdict, ladder rung) and flagging warm-digest slowdowns,
+  verdict flips and new rung-3+ escalations.
+
+Contract (the trace/metrics pattern): when nothing is configured the
+plane installs NO threads and every instrumented site costs one
+module-global load + branch.
+"""
+from __future__ import annotations
+
+__all__ = ["ensure_ops_plane_from_conf", "shutdown_ops_plane"]
+
+
+def ensure_ops_plane_from_conf(conf):
+    """Install the configured pieces of the ops plane (server, flight
+    recorder, sentinel) — one conf lookup each, paid per ExecContext
+    construction, never per event. Returns (server, recorder, sentinel),
+    any of which may be None."""
+    from .flight import ensure_flight_from_conf
+    from .sentinel import ensure_sentinel_from_conf
+    from .server import ensure_ops_from_conf
+    srv = ensure_ops_from_conf(conf)
+    rec = ensure_flight_from_conf(conf)
+    sen = ensure_sentinel_from_conf(conf)
+    return srv, rec, sen
+
+
+def shutdown_ops_plane() -> None:
+    """Stop the ops server thread (if any) and uninstall the flight
+    recorder and sentinel — the per-test reset (conftest)."""
+    from .flight import install_flight
+    from .sentinel import install_sentinel
+    from .server import shutdown_ops
+    shutdown_ops()
+    install_flight(None)
+    install_sentinel(None)

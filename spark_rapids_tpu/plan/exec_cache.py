@@ -43,10 +43,10 @@ from typing import Callable, Dict, Optional, Tuple
 from ..config import register
 
 __all__ = ["COMPILE_CACHE_DIR", "COMPILE_CACHE_MAX_BYTES",
-           "get_or_build", "fused_key", "stats", "reset_stats",
-           "clear", "configure_from_conf", "trim_persistent",
-           "device_kind", "record_plan_compiled", "plan_digest_cached",
-           "compile_free_since"]
+           "get_or_build", "fused_key", "stats", "hit_rate",
+           "reset_stats", "clear", "configure_from_conf",
+           "trim_persistent", "device_kind", "record_plan_compiled",
+           "plan_digest_cached", "compile_free_since"]
 
 COMPILE_CACHE_DIR = register(
     "spark.rapids.tpu.compile.cache.dir", "",
@@ -270,6 +270,16 @@ def stats() -> Dict[str, float]:
     these around each rung for the cold/warm compile split)."""
     with _LOCK:
         return dict(_STATS)
+
+
+def hit_rate() -> Optional[float]:
+    """In-process tier hit rate over the process lifetime, or None
+    before the first lookup — the ops ``/healthz`` exec-cache verdict
+    input (a warm serving process living below ~0.5 is recompiling
+    kernels it should be reusing)."""
+    st = stats()
+    lookups = st["hits"] + st["misses"]
+    return (st["hits"] / lookups) if lookups else None
 
 
 def reset_stats() -> None:

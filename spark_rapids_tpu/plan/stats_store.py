@@ -61,6 +61,14 @@ def _path() -> str:
     return os.path.join(cache, "adaptive_stats.json")
 
 
+def store_path() -> str:
+    """Public location of the adaptive-stats file — siblings (the
+    regression sentinel's baseline table, ops/sentinel.py) persist in
+    the same directory so one SRTPU_STATS_PATH override relocates the
+    whole learned-state family."""
+    return _path()
+
+
 def _persistable(sig: str) -> bool:
     return not _LOCAL_TAG.search(sig)
 

@@ -68,6 +68,16 @@ class ShuffleHeartbeatManager:
             self._evict(time.monotonic())
             return sorted(self._peers)
 
+    def peer_ages(self) -> Dict[str, float]:
+        """Seconds since each registered peer's last heartbeat, WITHOUT
+        evicting: the ops /healthz worker verdicts need to SEE a peer
+        that stopped heartbeating (age past the eviction horizon reads
+        degraded), not have it silently vanish from the census."""
+        now = time.monotonic()
+        with self._lock:
+            return {k: round(now - v["last"], 3)
+                    for k, v in self._peers.items()}
+
     def peer_details(self) -> List[dict]:
         """Live peers with their addresses (driver-side attach of
         externally-launched multi-host workers)."""

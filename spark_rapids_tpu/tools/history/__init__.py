@@ -105,7 +105,8 @@ def build_history(events: List[dict]) -> List[dict]:
                  "root": rec.get("root"),
                  "startTs": rec.get("ts"),
                  "status": "lost", "durationMs": None,
-                 "trace": None, "faultStats": None, "metrics": None}
+                 "trace": None, "faultStats": None, "metrics": None,
+                 "reason": None, "degraded": False}
             starts[rec.get("queryId")] = q
             out.append(q)
         elif kind == "queryEnd":
@@ -120,6 +121,12 @@ def build_history(events: List[dict]) -> List[dict]:
             q["trace"] = rec.get("trace")
             q["faultStats"] = rec.get("faultStats")
             q["metrics"] = rec.get("metrics")
+            # outcome detail (ISSUE 15): why a query failed (timeout,
+            # OOM) or ran degraded on the rung-4 host ladder
+            q["reason"] = rec.get("reason")
+            q["degraded"] = bool(rec.get("degraded"))
+            if q["degraded"] and q["status"] == "ok":
+                q["status"] = "degraded"
     return out
 
 
@@ -130,20 +137,26 @@ def _fmt_ms(v) -> str:
 def format_history(history: List[dict], skipped: int = 0,
                    source: str = "") -> str:
     lines = [f"== Query history ({source or 'event log'}) ==",
-             f"{'id':>4}  {'status':<7} {'ms':>10}  "
-             f"{'digest':<16}  root"]
+             f"{'id':>4}  {'status':<8} {'ms':>10}  "
+             f"{'digest':<16}  root  reason"]
     for q in history:
+        reason = q.get("reason") or ""
         lines.append(
             f"{str(q.get('queryId') or '?'):>4}  "
-            f"{q.get('status') or '?':<7} "
+            f"{q.get('status') or '?':<8} "
             f"{_fmt_ms(q.get('durationMs'))}  "
             f"{str(q.get('planDigest') or '?'):<16}  "
-            f"{q.get('root') or '?'}")
+            f"{q.get('root') or '?'}"
+            + (f"  {reason[:80]}" if reason else ""))
     ok = sum(1 for q in history if q.get("status") == "ok")
     failed = sum(1 for q in history if q.get("status") == "failed")
     lost = sum(1 for q in history if q.get("status") == "lost")
-    lines.append(f"{len(history)} queries: {ok} ok, {failed} failed, "
-                 f"{lost} lost; {skipped} undecodable line(s) skipped")
+    degraded = sum(1 for q in history if q.get("status") == "degraded")
+    tail = (f"{len(history)} queries: {ok} ok, {failed} failed, "
+            f"{lost} lost")
+    if degraded:
+        tail += f", {degraded} degraded"
+    lines.append(f"{tail}; {skipped} undecodable line(s) skipped")
     return "\n".join(lines) + "\n"
 
 

@@ -98,6 +98,10 @@ def _load_registries():
               "spark_rapids_tpu.trace.core",
               "spark_rapids_tpu.metrics.registry",
               "spark_rapids_tpu.metrics.events",
+              "spark_rapids_tpu.ops.server",
+              "spark_rapids_tpu.ops.flight",
+              "spark_rapids_tpu.ops.sentinel",
+              "spark_rapids_tpu.tools.regress",
               "spark_rapids_tpu.udf.compiler",
               "spark_rapids_tpu.delta.table",
               "spark_rapids_tpu.delta.scan",

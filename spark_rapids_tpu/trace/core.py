@@ -169,6 +169,15 @@ class Tracer:
         with self._lock:
             return list(self._buf)
 
+    def tail(self, n: int = 512) -> List[dict]:
+        """Copy of up to the NEWEST ``n`` buffered events (oldest of
+        those first) — the flight recorder's trace-ring section
+        (ops/flight.py). Never drains: an anomaly dump must not eat the
+        events the query's own trace artifact will export."""
+        with self._lock:
+            buf = list(self._buf)
+        return buf[-max(0, int(n)):]
+
     def drain(self) -> List[dict]:
         """Remove and return every buffered event (drop count intact)."""
         with self._lock:

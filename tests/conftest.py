@@ -76,6 +76,17 @@ def _clear_metrics():
 
 
 @pytest.fixture(autouse=True)
+def _clear_ops_plane():
+    """The ops server thread, flight recorder and regression sentinel
+    are process-global (ops/, same install pattern as the tracer); a
+    test that arms them must not leave an HTTP thread — or anomaly
+    dumps firing — behind its back."""
+    yield
+    from spark_rapids_tpu.ops import shutdown_ops_plane
+    shutdown_ops_plane()
+
+
+@pytest.fixture(autouse=True)
 def _assert_no_leaked_spillables():
     """Suite-wide zero-leak check (ref cudf MemoryCleaner at shutdown,
     Plugin.scala:573-588): every SpillableBatch must be closed by the

@@ -268,6 +268,79 @@ def test_query_timeout_cancels_releases_semaphore_and_leaks_nothing():
     s._ctx.close()
 
 
+def test_each_anomaly_kind_produces_exactly_one_flight_bundle(tmp_path):
+    """ISSUE 15 acceptance: with the ops plane armed, an injected
+    semaphore wedge, an OOM ladder run reaching rung >= 3, and a query
+    timeout each produce exactly ONE flight-recorder bundle (the
+    per-kind rate limiter absorbs the repeats), each bundle carrying
+    all five required sections."""
+    import os
+    from spark_rapids_tpu.ops import flight as fl_mod
+    flight_conf = {"spark.rapids.tpu.flight.enabled": True,
+                   "spark.rapids.tpu.flight.dir":
+                       str(tmp_path / "flight"),
+                   "spark.rapids.tpu.metrics.enabled": True,
+                   "spark.rapids.tpu.metrics.sample.intervalMs": 0}
+
+    # ---- anomaly 1: OOM ladder. mem.oom=* fails every reserve, so the
+    # ladder escalates through rung 3 (pressure spill) to rung 4 (host
+    # degradation) — one oom_ladder bundle despite many trigger calls.
+    s = tpu_session(flight_conf)
+    df = (s.create_dataframe(_T, num_partitions=2)
+          .group_by("k").agg(F.sum(F.col("v")).with_name("sv")))
+    want = _canon(df.to_pandas())
+    install_chaos(ChaosController("mem.oom=*"))
+    try:
+        got = _canon(df.to_pandas())
+    finally:
+        install_chaos(None)
+    pd.testing.assert_frame_equal(got, want, check_exact=True)
+
+    # ---- anomaly 2: semaphore wedge. A holder thread dies without
+    # releasing; the watchdog force-releases its permit.
+    mm = MemoryManager(1 << 30, 1 << 30, "/tmp/srtpu_flight_wedge")
+    sem = DeviceSemaphore(2, timeout_s=30.0, wedge_timeout_ms=100,
+                          memory=mm)
+    killer = threading.Thread(target=sem.acquire, name="killed-holder")
+    killer.start()
+    killer.join()
+    released = sem.check_wedged()
+    assert len(released) == 1
+
+    # ---- anomaly 3: query timeout.
+    s2 = tpu_session({**flight_conf,
+                      "spark.rapids.tpu.query.timeout": 0.3})
+
+    def slow(pdf):
+        time.sleep(0.25)
+        return pdf
+
+    with pytest.raises(QueryTimeout):
+        (s2.create_dataframe(_T, num_partitions=4)
+         .map_in_pandas(slow, _T.schema)
+         .order_by(F.col("u").asc()).to_pandas())
+
+    rec = fl_mod.RECORDER
+    assert rec is not None
+    assert rec.stats()["dumps"] == {"oom_ladder": 1,
+                                    "semaphore_wedge": 1,
+                                    "query_timeout": 1}
+    for bundle in rec.stats()["bundles"]:
+        assert sorted(os.listdir(bundle)) == [
+            "config.json", "metrics.json", "placement.json",
+            "state.json", "trace.json"], bundle
+    # the oom_ladder bundle carries the in-flight query's digest +
+    # coded placement summary (the thread-local query context)
+    oom_bundle = [b for b in rec.stats()["bundles"]
+                  if "oom_ladder" in b][0]
+    import json as _json
+    placement = _json.load(open(os.path.join(oom_bundle,
+                                             "placement.json")))
+    assert placement["query"]["planDigest"]
+    assert placement["query"]["placement"]["verdict"] in ("device",
+                                                          "host")
+
+
 def test_query_timeout_while_waiting_on_semaphore():
     """A query whose task is parked INSIDE semaphore.acquire() still
     honors the deadline: the wait loop polls it and raises QueryTimeout

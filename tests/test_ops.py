@@ -1,0 +1,595 @@
+"""Live ops plane tests (ISSUE 15): HTTP endpoint round-trips, flight
+recorder bundles, regression sentinel, tools/regress goldens, and the
+queryEnd reason/degraded satellite."""
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from harness import tpu_session
+from spark_rapids_tpu.api import functions as F
+
+_RNG = np.random.RandomState(15)
+_N = 2048
+_T = pa.table({
+    "k": pa.array(_RNG.randint(0, 13, _N)),
+    "v": pa.array(_RNG.randint(0, 1000, _N).astype(np.int64)),
+    "u": pa.array(np.arange(_N)),
+})
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+BUNDLE_SECTIONS = ["config.json", "metrics.json", "placement.json",
+                   "state.json", "trace.json"]
+
+
+def _get(port, path, timeout=10):
+    r = urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                               timeout=timeout)
+    return r.status, r.read().decode("utf-8")
+
+
+def _get_any(port, path, timeout=10):
+    """GET tolerating non-2xx replies (healthz serves 503 when any
+    section is degraded — under full-suite ordering, leftovers from
+    earlier tests (dead holders, drained budgets) can legitimately
+    degrade process-wide sections)."""
+    try:
+        return _get(port, path, timeout)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode("utf-8")
+
+
+def _start_server():
+    from spark_rapids_tpu.ops import server as srv_mod
+    return srv_mod.install_ops(srv_mod.OpsServer(0).start())
+
+
+def _agg_df(s):
+    return (s.create_dataframe(_T, num_partitions=2).group_by("k")
+            .agg(F.sum(F.col("v")).with_name("sv"),
+                 F.count_star().with_name("n")))
+
+
+# ---------------------------------------------------------------------------
+# disabled path
+# ---------------------------------------------------------------------------
+
+def test_disabled_path_no_threads_no_recorder():
+    """No ops conf: no server thread, no recorder, no sentinel — every
+    instrumented site sees a None module global."""
+    from spark_rapids_tpu.ops import flight as fl_mod
+    from spark_rapids_tpu.ops import sentinel as sen_mod
+    from spark_rapids_tpu.ops import server as srv_mod
+    before = {t.name for t in threading.enumerate()}
+    s = tpu_session()
+    _agg_df(s).collect_arrow()
+    assert srv_mod.SERVER is None
+    assert fl_mod.RECORDER is None
+    assert sen_mod.SENTINEL is None
+    after = {t.name for t in threading.enumerate()}
+    assert not [n for n in after - before if n.startswith("srtpu-ops")]
+
+
+def test_conf_gated_server_install(tmp_path):
+    """spark.rapids.tpu.ops.port > 0 starts the daemon thread once and
+    serves; port 0 (default) never does."""
+    import socket
+    from spark_rapids_tpu.ops import server as srv_mod
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+    s = tpu_session({"spark.rapids.tpu.ops.port": port})
+    _agg_df(s).collect_arrow()
+    assert srv_mod.SERVER is not None and srv_mod.SERVER.port == port
+    status, body = _get_any(port, "/healthz")
+    doc = json.loads(body)
+    # 200/ok in a fresh process; earlier tests in a shared suite run
+    # may leave legitimately-degraded process-wide state (503)
+    assert (status, doc["status"]) in ((200, "ok"), (503, "degraded"))
+    assert "semaphore" in doc and "memory" in doc
+    # idempotent: a second session re-uses the same server
+    s2 = tpu_session({"spark.rapids.tpu.ops.port": port})
+    s2.exec_context()
+    assert srv_mod.SERVER.port == port
+
+
+# ---------------------------------------------------------------------------
+# /metrics over real HTTP (satellite: exposition round-trip)
+# ---------------------------------------------------------------------------
+
+def test_metrics_exposition_http_roundtrip():
+    """Label escaping and histogram bucket invariants survive the wire:
+    what a real Prometheus scrape of the ops server sees parses back to
+    the registry's own exposition."""
+    from spark_rapids_tpu.metrics import (install_metrics,
+                                          prometheus_text,
+                                          registry_snapshot)
+    from spark_rapids_tpu.metrics.registry import MetricRegistry
+    reg = install_metrics(MetricRegistry())
+    reg.counter("srtpu_queries_total", status='we"ird\\la\nbel').inc(3)
+    h = reg.histogram("srtpu_query_seconds")
+    for v in (0.003, 0.04, 0.8, 2.0, 120.0):
+        h.observe(v)
+    srv = _start_server()
+    status, body = _get(srv.port, "/metrics")
+    assert status == 200
+    local = prometheus_text(registry_snapshot(reg))
+    assert body == local
+    # escaping: backslash, quote, newline all encoded per the text spec
+    assert 'status="we\\"ird\\\\la\\nbel"' in body
+    lines = body.splitlines()
+    # exposition-level invariants over the wire
+    buckets = []
+    count = hsum = None
+    for ln in lines:
+        if ln.startswith("srtpu_query_seconds_bucket"):
+            le = ln.split('le="', 1)[1].split('"', 1)[0]
+            buckets.append((le, float(ln.rsplit(" ", 1)[1])))
+        elif ln.startswith("srtpu_query_seconds_count"):
+            count = float(ln.rsplit(" ", 1)[1])
+        elif ln.startswith("srtpu_query_seconds_sum"):
+            hsum = float(ln.rsplit(" ", 1)[1])
+    assert buckets and buckets[-1][0] == "+Inf"
+    counts = [c for _, c in buckets]
+    assert counts == sorted(counts), "buckets must be cumulative"
+    assert count == 5 and buckets[-1][1] == count
+    assert hsum == pytest.approx(122.843)
+    # HELP/TYPE headers present for every family
+    assert "# TYPE srtpu_query_seconds histogram" in body
+    assert "# TYPE srtpu_queries_total counter" in body
+    # the scrape itself is counted once installed
+    status2, body2 = _get(srv.port, "/metrics")
+    assert 'srtpu_ops_requests_total{endpoint="/metrics"} ' in body2
+
+
+def test_metrics_endpoint_without_registry():
+    srv = _start_server()
+    status, body = _get(srv.port, "/metrics")
+    assert status == 200 and "no metric registry" in body
+
+
+# ---------------------------------------------------------------------------
+# /healthz + /queries
+# ---------------------------------------------------------------------------
+
+def test_healthz_sections_and_degraded_semaphore():
+    from spark_rapids_tpu.mem import DeviceSemaphore
+    srv = _start_server()
+    status0, body0 = _get_any(srv.port, "/healthz")
+    doc0 = json.loads(body0)
+    for section in ("semaphore", "memory", "execCache", "workers",
+                    "eventLog", "flight", "sentinel"):
+        assert doc0[section]["verdict"] in ("ok", "degraded"), section
+    # the report is internally consistent: 200 iff every section ok
+    all_ok = all(doc0[s]["verdict"] == "ok" for s in
+                 ("semaphore", "memory", "execCache", "workers",
+                  "eventLog", "flight", "sentinel"))
+    assert (status0 == 200) == all_ok == (doc0["status"] == "ok")
+    dead0 = doc0["semaphore"]["deadHolders"]
+    # a holder thread that died without releasing degrades /healthz
+    sem = DeviceSemaphore(2, timeout_s=30.0, wedge_timeout_ms=0)
+    t = threading.Thread(target=sem.acquire, name="dead-holder")
+    t.start()
+    t.join()
+    try:
+        code, body = _get_any(srv.port, "/healthz")
+        assert code == 503
+        doc = json.loads(body)
+        assert doc["status"] == "degraded"
+        assert doc["semaphore"]["verdict"] == "degraded"
+        assert doc["semaphore"]["deadHolders"] >= dead0 + 1
+    finally:
+        sem.check_wedged()     # reclaim so later tests see a clean sem
+
+
+def test_queries_endpoint_tracks_history():
+    srv = _start_server()
+    s = tpu_session()
+    for _ in range(3):
+        _agg_df(s).collect_arrow()
+    status, body = _get(srv.port, "/queries")
+    doc = json.loads(body)
+    assert status == 200
+    assert doc["inflight"] == []
+    assert len(doc["recent"]) == 3
+    rec = doc["recent"][-1]
+    assert rec["status"] == "ok" and rec["wallMs"] > 0
+    assert rec["planDigest"] and rec["ladderRung"] == 0
+    assert rec["placement"] in ("device", "host")
+    assert rec["root"] == "Aggregate"
+    # a failing query lands with status failed + reason
+    with pytest.raises(Exception):
+        s.create_dataframe(_T).select(F.col("nope")).collect_arrow()
+    doc = json.loads(_get(srv.port, "/queries")[1])
+    # planning fails before the tracker begins: only executed queries
+    # appear — run one that fails DURING execution instead
+    def boom(pdf):
+        raise RuntimeError("kaboom")
+    with pytest.raises(Exception):
+        s.create_dataframe(_T).map_in_pandas(boom, _T.schema) \
+            .collect_arrow()
+    doc = json.loads(_get(srv.port, "/queries")[1])
+    failed = [r for r in doc["recent"] if r["status"] == "failed"]
+    assert failed and "kaboom" in failed[-1]["reason"]
+
+
+def test_healthz_event_log_lag(tmp_path):
+    from spark_rapids_tpu.metrics.events import EventLogWriter
+    srv = _start_server()
+    w = EventLogWriter(str(tmp_path / "elog"))
+    w.write({"event": "queryStart", "queryId": 1})
+    doc = json.loads(_get_any(srv.port, "/healthz")[1])
+    writers = [x for x in doc["eventLog"]["writers"]
+               if x["dir"] == str(tmp_path / "elog")]
+    assert writers and writers[0]["lagS"] >= 0
+    assert writers[0]["lastErrorTs"] is None
+    # a writer whose newest attempt FAILS degrades the section
+    bad = EventLogWriter(str(tmp_path / "not-a-dir" / ("x" * 300)))
+    assert bad.write({"event": "queryStart"}) is False
+    doc = json.loads(_get_any(srv.port, "/healthz")[1])
+    assert doc["eventLog"]["verdict"] == "degraded"
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+def _mk_recorder(tmp_path, rate_limit_ms=60000, conf=None):
+    from spark_rapids_tpu.ops import flight as fl_mod
+    rec = fl_mod.FlightRecorder(str(tmp_path / "flight"),
+                                rate_limit_ms=rate_limit_ms, conf=conf)
+    return fl_mod.install_flight(rec)
+
+
+def test_flight_bundle_sections_and_atomicity(tmp_path):
+    rec = _mk_recorder(tmp_path)
+    path = rec.trigger("semaphore_wedge", detail="unit test")
+    assert path and os.path.isdir(path)
+    assert sorted(os.listdir(path)) == BUNDLE_SECTIONS
+    # no temp droppings next to the committed bundle
+    assert all(not n.startswith(".tmp-")
+               for n in os.listdir(os.path.dirname(path)))
+    state = json.load(open(os.path.join(path, "state.json")))
+    assert "memory" in state and "execCache" in state
+    assert "pressure_granted" in state["memory"]
+    placement = json.load(open(os.path.join(path, "placement.json")))
+    assert placement["trigger"] == "semaphore_wedge"
+    assert placement["detail"] == "unit test"
+    trace = json.load(open(os.path.join(path, "trace.json")))
+    assert any(b["kind"] == "flight.trigger"
+               for b in trace["breadcrumbs"])
+
+
+def test_flight_rate_limit_and_unknown_kind(tmp_path):
+    rec = _mk_recorder(tmp_path, rate_limit_ms=60000)
+    p1 = rec.trigger("oom_ladder", detail="first")
+    p2 = rec.trigger("oom_ladder", detail="suppressed")
+    p3 = rec.trigger("query_timeout", detail="different kind")
+    assert p1 and p3 and p2 is None
+    st = rec.stats()
+    assert st["dumps"] == {"oom_ladder": 1, "query_timeout": 1}
+    assert st["suppressed"] == {"oom_ladder": 1}
+    with pytest.raises(ValueError):
+        rec.trigger("not_a_registered_kind")
+
+
+def test_flight_config_redaction(tmp_path):
+    from spark_rapids_tpu.config import TpuConf
+    conf = TpuConf({"spark.rapids.tpu.eventLog.dir": "/data/elog",
+                    "spark.hadoop.fs.s3a.secret.key": "SUPERSECRET",
+                    "my.service.authToken": "abc123"})
+    rec = _mk_recorder(tmp_path, conf=conf)
+    path = rec.trigger("worker_evicted", detail="redaction test")
+    cfg = json.load(open(os.path.join(path, "config.json")))
+    ov = cfg["overridesFromDefaults"]
+    assert ov["spark.rapids.tpu.eventLog.dir"] == "/data/elog"
+    assert ov["spark.hadoop.fs.s3a.secret.key"] == "<redacted>"
+    assert ov["my.service.authToken"] == "<redacted>"
+    assert "SUPERSECRET" not in json.dumps(cfg)
+
+
+def test_flight_metric_declared_and_counted(tmp_path):
+    from spark_rapids_tpu.metrics import install_metrics
+    from spark_rapids_tpu.metrics.registry import MetricRegistry
+    reg = install_metrics(MetricRegistry())
+    rec = _mk_recorder(tmp_path)
+    rec.trigger("placement_revert", detail="x")
+    snap = reg.snapshot()
+    series = snap["srtpu_flight_dumps_total"]["series"]
+    assert [s for s in series
+            if s["labels"] == {"trigger": "placement_revert"}
+            and s["value"] == 1]
+
+
+def test_warm_digest_recompile_trigger(tmp_path):
+    """A digest in the compiled-plan set that pays backend-compile
+    seconds anyway fires the warm_recompile trigger; a cold digest
+    paying the same compile does not."""
+    from spark_rapids_tpu.ops import flight as fl_mod
+    from spark_rapids_tpu.plan import exec_cache
+
+    def fake_compile(pdf):
+        # runs MID-QUERY: simulates jax reporting real XLA compile work
+        exec_cache._on_duration(
+            "/jax/core/compile/backend_compile_duration", duration=0.25)
+        return pdf
+
+    s = tpu_session({"spark.rapids.tpu.flight.enabled": True,
+                     "spark.rapids.tpu.flight.dir":
+                         str(tmp_path / "flight"),
+                     "spark.rapids.tpu.eventLog.enabled": True,
+                     "spark.rapids.tpu.eventLog.dir":
+                         str(tmp_path / "elog")})
+    df = s.create_dataframe(_T).map_in_pandas(fake_compile, _T.schema)
+    df.collect_arrow()                  # cold: digest unknown
+    rec = fl_mod.RECORDER
+    assert rec.stats()["dumps"].get("warm_recompile") is None
+    from spark_rapids_tpu.tools.history import load_events
+    events, _ = load_events(str(tmp_path / "elog"))
+    digest = [e for e in events
+              if e.get("event") == "queryStart"][-1]["planDigest"]
+    exec_cache.record_plan_compiled(digest)   # now vouched warm
+    df.collect_arrow()
+    assert rec.stats()["dumps"].get("warm_recompile") == 1
+    bundle = rec.stats()["bundles"][-1]
+    placement = json.load(open(os.path.join(bundle, "placement.json")))
+    assert digest in placement["detail"]
+
+
+# ---------------------------------------------------------------------------
+# sentinel
+# ---------------------------------------------------------------------------
+
+def test_sentinel_flags_and_persistence(tmp_path):
+    from spark_rapids_tpu.metrics import install_metrics
+    from spark_rapids_tpu.metrics.registry import MetricRegistry
+    from spark_rapids_tpu.ops.sentinel import RegressionSentinel
+    reg = install_metrics(MetricRegistry())
+    rec = _mk_recorder(tmp_path)
+    path = str(tmp_path / "baselines.json")
+    sen = RegressionSentinel(path, wall_factor=3.0, min_samples=3)
+    for ms in (100.0, 101.0, 99.0):
+        assert sen.fold({"digest": "dA", "wallMs": ms,
+                         "verdict": "device", "rung": 0, "ok": True}) == []
+    regs = sen.fold({"digest": "dA", "wallMs": 500.0,
+                     "verdict": "device", "rung": 0, "ok": True})
+    assert [r["kind"] for r in regs] == ["warm_slowdown"]
+    regs = sen.fold({"digest": "dA", "wallMs": 100.0,
+                     "verdict": "host", "rung": 3, "ok": True})
+    assert sorted(r["kind"] for r in regs) == ["rung_escalation",
+                                               "verdict_flip"]
+    # flight fan-out: the verdict flip uses the placement_revert trigger
+    dumps = rec.stats()["dumps"]
+    assert dumps.get("placement_revert") == 1
+    assert dumps.get("sentinel_regression") == 1
+    snap = reg.snapshot()
+    kinds = {tuple(s["labels"].items())[0][1]: s["value"] for s in
+             snap["srtpu_query_regressions_total"]["series"]}
+    assert kinds == {"warm_slowdown": 1, "verdict_flip": 1,
+                     "rung_escalation": 1}
+    # persistence roundtrip: a fresh sentinel inherits the baselines
+    sen2 = RegressionSentinel(path, wall_factor=3.0, min_samples=3)
+    b = sen2.baselines()["dA"]
+    assert b["verdict"] == "host" and b["maxRung"] == 3
+    assert b["n"] == 5
+
+
+def test_sentinel_cold_run_never_flags(tmp_path):
+    from spark_rapids_tpu.ops.sentinel import fold_record
+    baselines = {}
+    for ms in (50.0, 51.0, 49.0):
+        fold_record(baselines, {"digest": "d", "wallMs": ms,
+                                "verdict": "device", "ok": True})
+    # a compiling (cold) run is exempt from the slowdown check AND its
+    # wall never pollutes the warm window
+    regs = fold_record(baselines, {"digest": "d", "wallMs": 900.0,
+                                   "verdict": "device", "ok": True,
+                                   "compileS": 2.0})
+    assert regs == []
+    assert 900.0 not in baselines["d"]["walls"]
+    # failed runs are exempt too
+    regs = fold_record(baselines, {"digest": "d", "wallMs": 900.0,
+                                   "verdict": "device", "ok": False})
+    assert regs == []
+
+
+def test_sentinel_live_fold_from_queries(tmp_path):
+    """The wired path: queries folded per queryEnd, baselines persisted
+    beside the stats store path override."""
+    from spark_rapids_tpu.ops import sentinel as sen_mod
+    path = str(tmp_path / "b.json")
+    s = tpu_session({"spark.rapids.tpu.sentinel.enabled": True,
+                     "spark.rapids.tpu.sentinel.path": path})
+    for _ in range(3):
+        _agg_df(s).collect_arrow()
+    sen = sen_mod.SENTINEL
+    assert sen is not None and sen.path == path
+    bl = sen.baselines()
+    assert len(bl) == 1
+    (b,) = bl.values()
+    assert b["n"] == 3
+    # persistence is debounced on clean folds; an explicit save lands
+    assert sen.save() and os.path.exists(path)
+
+
+# ---------------------------------------------------------------------------
+# tools/regress
+# ---------------------------------------------------------------------------
+
+def test_regress_replay_golden(capsys):
+    from spark_rapids_tpu.tools.history import load_events
+    from spark_rapids_tpu.tools.regress import (format_replay,
+                                                replay_events)
+    fixture = os.path.join(FIXTURES, "regress_eventlog.jsonl")
+    events, skipped = load_events(fixture)
+    result = replay_events(events)
+    got = format_replay(result, source="FIXTURE", skipped=skipped)
+    want = open(os.path.join(FIXTURES, "regress_golden.txt")).read()
+    assert got == want
+    kinds = [r["kind"] for r in result["regressions"]]
+    assert kinds == ["warm_slowdown", "verdict_flip", "rung_escalation"]
+    flip = result["regressions"][1]
+    assert (flip["from"], flip["to"]) == ("device", "host")
+    slow = result["regressions"][0]
+    assert slow["factor"] == pytest.approx(3.49, abs=0.01)
+
+
+def test_regress_cli_deterministic(capsys):
+    from spark_rapids_tpu.tools.regress import main
+    fixture = os.path.join(FIXTURES, "regress_eventlog.jsonl")
+    assert main([fixture, "--json"]) == 1     # regressions -> rc 1
+    out1 = capsys.readouterr().out
+    assert main([fixture, "--json"]) == 1
+    out2 = capsys.readouterr().out
+    assert out1 == out2
+    doc = json.loads(out1)
+    assert doc["records"] == 12 and doc["skipped"] == 1
+    assert len(doc["regressions"]) == 3
+
+
+def test_regress_bench_diff(tmp_path, capsys):
+    from spark_rapids_tpu.tools.regress import (diff_bench,
+                                                format_bench_delta,
+                                                load_bench, main)
+    base = {"geomean": 1.2, "placement_counts": {"device": 2, "host": 1},
+            "details": {"q1": {"speedup": 2.0, "placement": "device"},
+                        "q6": {"speedup": 1.5, "placement": "device"},
+                        "strings": {"speedup": 0.4,
+                                    "placement": "host"}}}
+    new = {"geomean": 0.9, "placement_counts": {"device": 1, "host": 2},
+           "details": {"q1": {"speedup": 0.8, "placement": "host"},
+                       "q6": {"speedup": 1.45, "placement": "device"},
+                       "q9": {"speedup": 3.0, "placement": "device"}}}
+    bp, np_ = str(tmp_path / "BENCH_r01.json"), str(tmp_path
+                                                    / "BENCH_r02.json")
+    json.dump(base, open(bp, "w"))
+    json.dump(new, open(np_, "w"))
+    delta = diff_bench(load_bench(bp), load_bench(np_))
+    assert delta["regressions"] == [
+        {"rung": "q1", "base": 2.0, "now": 0.8, "ratio": 0.4}]
+    assert delta["placement_flips"] == [
+        {"rung": "q1", "from": "device", "to": "host"}]
+    assert delta["only_base"] == ["strings"]
+    assert delta["only_new"] == ["q9"]
+    line = format_bench_delta(delta, "BENCH_r01.json")
+    assert line == (
+        "delta vs BENCH_r01.json: geomean 1.200x -> 0.900x, placement "
+        "2dev/1host -> 1dev/2host, 1 regressed rung(s), 1 placement "
+        "flip(s) over 2 shared rung(s); worst q1 2.0x -> 0.8x; "
+        "flip q1 device->host")
+    # CLI path: same differ, rc 1 on regression
+    assert main(["--bench", bp, np_]) == 1
+    assert capsys.readouterr().out.strip() == line
+    # the driver-captured wrapper shape loads too
+    wp = str(tmp_path / "BENCH_r03.json")
+    json.dump({"parsed": new, "tail": ""}, open(wp, "w"))
+    assert load_bench(wp)["details"] == load_bench(np_)["details"]
+    assert main(["--bench", np_, wp]) == 0    # identical: no regression
+
+
+# ---------------------------------------------------------------------------
+# queryEnd reason/degraded satellite
+# ---------------------------------------------------------------------------
+
+def test_query_end_reason_on_timeout(tmp_path, capsys):
+    from spark_rapids_tpu.mem.semaphore import QueryTimeout
+    elog = str(tmp_path / "elog")
+    s = tpu_session({"spark.rapids.tpu.eventLog.enabled": True,
+                     "spark.rapids.tpu.eventLog.dir": elog,
+                     "spark.rapids.tpu.query.timeout": 0.3})
+
+    def slow(pdf):
+        time.sleep(0.25)
+        return pdf
+
+    df = (s.create_dataframe(_T, num_partitions=4)
+          .map_in_pandas(slow, _T.schema).order_by(F.col("u").asc()))
+    with pytest.raises(QueryTimeout):
+        df.collect_arrow()
+    from spark_rapids_tpu.tools.history import (build_history,
+                                                format_history,
+                                                load_events)
+    events, _ = load_events(elog)
+    ends = [e for e in events if e.get("event") == "queryEnd"]
+    assert ends and ends[-1]["ok"] is False
+    assert ends[-1]["reason"].startswith("QueryTimeout:")
+    assert ends[-1]["degraded"] is False
+    hist = build_history(events)
+    assert hist[-1]["status"] == "failed"
+    txt = format_history(hist)
+    assert "QueryTimeout" in txt and "reason" in txt.splitlines()[1]
+
+
+def test_query_end_degraded_reason_on_rung4(tmp_path):
+    from spark_rapids_tpu.aux.fault import ChaosController, install_chaos
+    elog = str(tmp_path / "elog")
+    s = tpu_session({"spark.rapids.tpu.eventLog.enabled": True,
+                     "spark.rapids.tpu.eventLog.dir": elog,
+                     "spark.rapids.tpu.metrics.enabled": True,
+                     "spark.rapids.tpu.metrics.sample.intervalMs": 0})
+    df = _agg_df(s)
+    df.collect_arrow()                        # healthy first
+    install_chaos(ChaosController("mem.oom=*"))
+    try:
+        df.collect_arrow()
+    finally:
+        install_chaos(None)
+    from spark_rapids_tpu.tools.history import build_history, load_events
+    events, _ = load_events(elog)
+    ends = [e for e in events if e.get("event") == "queryEnd"]
+    last = ends[-1]
+    assert last["ok"] is True and last["degraded"] is True
+    assert last["reason"].startswith("degraded:")
+    assert last["ladderRung"] == 4
+    hist = build_history(events)
+    assert hist[-1]["status"] == "degraded"
+    # clean first run recorded rung 0
+    assert ends[0]["ladderRung"] == 0 and ends[0]["degraded"] is False
+
+
+# ---------------------------------------------------------------------------
+# merge_snapshots last_seen satellite
+# ---------------------------------------------------------------------------
+
+def test_merge_snapshots_stamps_last_seen():
+    from spark_rapids_tpu.metrics.export import (merge_snapshots,
+                                                 prometheus_text)
+    snaps = {
+        "worker-0": {"__ts__": 1000.0, "srtpu_queries_total": {
+            "kind": "counter",
+            "series": [{"labels": {"status": "ok"}, "value": 4}]}},
+        "worker-1": {"__ts__": 1600.5, "srtpu_queries_total": {
+            "kind": "counter",
+            "series": [{"labels": {"status": "ok"}, "value": 2}]}},
+    }
+    merged = merge_snapshots(snaps)
+    lanes = merged["__lanes__"]
+    assert lanes["worker-0"]["last_seen_ms"] == 1000000.0
+    assert lanes["worker-1"]["last_seen_ms"] == 1600500.0
+    series = merged["srtpu_worker_last_seen_ms"]["series"]
+    assert [(s["labels"]["worker"], s["value"]) for s in series] == [
+        ("worker-0", 1000000.0), ("worker-1", 1600500.0)]
+    txt = prometheus_text(merged)
+    assert 'srtpu_worker_last_seen_ms{worker="worker-0"} 1000000.0' \
+        in txt
+    # a stale lane's counters are still merged but its staleness is now
+    # visible in the same exposition
+    assert 'srtpu_queries_total{status="ok",worker="worker-0"} 4' in txt
+
+
+def test_inventory_covers_new_metrics():
+    from spark_rapids_tpu.metrics.registry import metric_inventory
+    inv = metric_inventory()
+    for name, kind in (("srtpu_flight_dumps_total", "counter"),
+                       ("srtpu_query_regressions_total", "counter"),
+                       ("srtpu_worker_last_seen_ms", "gauge"),
+                       ("srtpu_hbm_pressure_grant_bytes", "gauge"),
+                       ("srtpu_ops_requests_total", "counter")):
+        assert inv[name]["kind"] == kind, name
