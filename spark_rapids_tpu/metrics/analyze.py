@@ -69,7 +69,7 @@ def record_learned_op_costs(physical, ctx, compile_free: bool) -> None:
     keying).
 
     What a DEVICE self-time measures — deliberately: device kernels
-    dispatch asynchronously (the host-sync lint rule bans mid-pipeline
+    dispatch asynchronously (the host-sync-flow lint rule bans mid-pipeline
     forces), so a device operator's metered wall is its dispatch + any
     host-side prep, while the device wait drains in the sink's single
     packed fetch, which the per-query floor already prices. That makes

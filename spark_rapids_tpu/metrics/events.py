@@ -125,6 +125,7 @@ class EventLogWriter:
                     continue
         return max(seqs) + 1 if seqs else 0
 
+    # tpulint: never-raise
     def write(self, record: dict) -> bool:
         """Append one record (stamped with a wall-clock ``ts``).
         Returns False — never raises — on I/O failure."""

@@ -150,6 +150,7 @@ class FlightRecorder:
         self._query = threading.local()
 
     # ------------------------------------------------------------- notes
+    # tpulint: never-raise
     def note(self, kind: str, **info) -> None:
         """Append one breadcrumb to the always-on ring (never dumps)."""
         ev = {"ts": round(time.time(), 6), "kind": str(kind)}
@@ -181,12 +182,16 @@ class FlightRecorder:
                     "bundles": list(self.bundles)}
 
     # ----------------------------------------------------------- trigger
+    # tpulint: never-raise
     def trigger(self, kind: str, detail: str = "",
                 query: Optional[dict] = None) -> Optional[str]:
         """Fire one trigger: rate-limit per kind, then atomically write
         a bundle directory. Returns the bundle path, or None when
         rate-limited or the write failed (never raises)."""
         if kind not in TRIGGERS:
+            # tpulint: disable=never-raise — an unregistered kind is a
+            # PROGRAMMING error caught by the taxonomy tests, not a
+            # runtime failure of a failing call site; it must be loud
             raise ValueError(
                 f"unknown flight trigger {kind!r}; registered kinds: "
                 f"{TRIGGERS} (ops/flight.py — add it to the taxonomy "

@@ -199,9 +199,17 @@ class RegressionSentinel:
             # must never fail a query over its own persistence
             pass
 
+    # tpulint: never-raise
     def save(self) -> bool:
         """Atomic best-effort persist (tmp + replace, serialized by
-        ``_save_lock``); returns False on I/O failure, never raises."""
+        ``_save_lock``); returns False on failure, never raises.
+
+        The catch is deliberately ``Exception``, not just ``OSError``: a
+        baseline record that picked up a non-JSON value (a numpy scalar
+        riding in through a folded query record) makes ``json.dump``
+        raise ``TypeError``, and that must degrade to an unsaved
+        baseline, not fail the query-completion path that called
+        ``fold``."""
         with self._save_lock:
             with self._lock:
                 doc = {"digests": {k: dict(v) for k, v
@@ -215,16 +223,17 @@ class RegressionSentinel:
                     json.dump(doc, f, sort_keys=True)
                 os.replace(tmp, self.path)
                 return True
-            except OSError as e:
+            except Exception as e:  # noqa: BLE001 - never-raise surface
                 log.warning("sentinel baselines not persisted to %s: "
                             "%s", self.path, e)
                 try:
                     os.unlink(tmp)
-                except OSError:
+                except Exception:  # noqa: BLE001 - best-effort cleanup
                     pass
                 return False
 
     # -------------------------------------------------------------- fold
+    # tpulint: never-raise
     def fold(self, rec: dict) -> List[dict]:
         """Fold one live query record; flags fan out to the metric
         registry and the flight recorder. Never raises."""
@@ -244,20 +253,27 @@ class RegressionSentinel:
             log.warning("sentinel fold failed: %s", e)
             return []
         if regs:
-            from ..metrics import registry as metrics_registry
-            mr = metrics_registry.REGISTRY
-            from .flight import RECORDER as _frec
-            for r in regs:
-                if mr is not None:
-                    mr.counter("srtpu_query_regressions_total",
-                               kind=r["kind"]).inc()
-                if _frec is not None:
-                    trig = ("placement_revert"
-                            if r["kind"] == "verdict_flip"
-                            else "sentinel_regression")
-                    _frec.trigger(trig, detail=json.dumps(
-                        r, sort_keys=True))
-                log.warning("regression sentinel: %s", r)
+            # the fan-out is fallible too — json.dumps raises TypeError
+            # when a flag record carries a non-JSON value (numpy scalars
+            # from a folded metric), and nothing here may escape into
+            # the query-completion path that called fold
+            try:
+                from ..metrics import registry as metrics_registry
+                mr = metrics_registry.REGISTRY
+                from .flight import RECORDER as _frec
+                for r in regs:
+                    if mr is not None:
+                        mr.counter("srtpu_query_regressions_total",
+                                   kind=r["kind"]).inc()
+                    if _frec is not None:
+                        trig = ("placement_revert"
+                                if r["kind"] == "verdict_flip"
+                                else "sentinel_regression")
+                        _frec.trigger(trig, detail=json.dumps(
+                            r, sort_keys=True))
+                    log.warning("regression sentinel: %s", r)
+            except Exception as e:  # noqa: BLE001 - observability only
+                log.warning("sentinel flag fan-out failed: %s", e)
         if save_due:
             # debounced persist: re-serializing the whole baseline
             # table per queryEnd would tax the completion path of a

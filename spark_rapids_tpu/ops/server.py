@@ -134,16 +134,21 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # noqa: A003 - stdlib signature
         log.debug("ops: " + fmt, *args)
 
+    # tpulint: never-raise
     def do_GET(self):  # noqa: N802 - stdlib naming
         ops: "OpsServer" = self.server.ops  # type: ignore[attr-defined]
         path = self.path.split("?", 1)[0].rstrip("/") or "/"
-        if path in ("/metrics", "/healthz", "/queries"):
-            from ..metrics import registry as metrics_registry
-            mr = metrics_registry.REGISTRY
-            if mr is not None:
-                mr.counter("srtpu_ops_requests_total",
-                           endpoint=path).inc()
         try:
+            # the request counter is part of the guarded body: a registry
+            # error in the fan-out must degrade to a 500, not escape into
+            # socketserver's handle_error (stderr traceback + a dropped
+            # connection — exactly what this handler promises never to do)
+            if path in ("/metrics", "/healthz", "/queries"):
+                from ..metrics import registry as metrics_registry
+                mr = metrics_registry.REGISTRY
+                if mr is not None:
+                    mr.counter("srtpu_ops_requests_total",
+                               endpoint=path).inc()
             if path == "/metrics":
                 body = ops.metrics_text().encode("utf-8")
                 self._reply(200, body,
@@ -171,8 +176,9 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(500, json.dumps(
                     {"error": str(e)}).encode("utf-8"),
                     "application/json")
-            except OSError:
-                pass               # client went away mid-reply
+            except Exception:  # noqa: BLE001 - client went away
+                pass           # mid-reply (or the error body itself
+                #                failed to build): nothing left to do
 
     def _reply(self, code: int, body: bytes, ctype: str) -> None:
         self.send_response(code)

@@ -120,6 +120,19 @@ def main(argv=None) -> int:
     result = run_lint(paths, rules=ALL_RULES, baseline=baseline,
                       root=repo_root)
 
+    if args.update_baseline or args.prune_baseline:
+        # a callgraph/engine failure means the run UNDER-reports: any
+        # baseline rewrite from it would silently drop grandfathered
+        # entries the broken analysis failed to reproduce
+        errors = [f for f in result.findings if f.rule == "tool-error"]
+        if errors:
+            for f in errors:
+                print(f"{f.path}:{f.line}: [{f.rule}] {f.message}",
+                      file=sys.stderr)
+            print("tpulint: refusing to rewrite the baseline while the "
+                  "analysis itself is failing (fix the tool-error "
+                  "findings above first)", file=sys.stderr)
+            return 2
     if args.update_baseline:
         out = write_baseline(result.findings, baseline_path)
         print(f"tpulint: wrote {len(result.findings)} finding(s) to {out}")

@@ -1,0 +1,565 @@
+"""Project-wide call graph + ownership/raise summaries (tpulint v3).
+
+The v2 dataflow layer (cfg.py/dataflow.py) is strictly per-function:
+``Summaries`` follows a taint label through same-module helpers, but no
+rule can ask "does this callee CLOSE the batch I hand it?" or "can this
+callee let an exception escape?".  This module adds that layer:
+
+* :class:`CallGraph` — an index of every ``def`` in the linted tree
+  (module-level functions, methods keyed by class, nested defs), with
+  honest lint-grade resolution: bare names resolve to the same module
+  first and then to a *unique* project-wide match; ``self.x``/``cls.x``
+  resolve within the enclosing class; anything ambiguous or dotted
+  through an object stays unresolved and the rules fall back to their
+  conservative default.
+* :class:`OwnershipSummary` — per-function: which parameter indices the
+  function **consumes** (takes over the caller's close obligation),
+  which of those it actually **closes**, which it **mutates** (attribute
+  stores / mutator-method calls), and whether its return value is a
+  fresh **owned** resource the caller must discharge.  Memoized and
+  cycle-tolerant (recursion degrades to consumes-everything, the
+  no-false-positive direction for the leak checks).
+* escape analysis — :meth:`CallGraph.escape_sites` / ``may_escape``:
+  the statements of a function from which an exception can escape past
+  a logging catch.  "Risky" is an explicit list (``raise``, I/O-shaped
+  stdlib calls, resolved project callees that may themselves escape);
+  unresolved external calls are assumed safe, which keeps the
+  never-raise rule honest about what it actually proves (see
+  docs/static_analysis.md).
+
+The transfer helpers of mem/ are modeled intrinsically — summaries for
+``wrap_spillables`` / ``wrap_spillable_sides`` / ``split_batch_in_half``
+/ ``SpillableBatch(...)`` / ``with_retry`` are hard knowledge, not
+inferred, because their contracts (exception-safe bulk wrap, consume on
+success only, generator that closes its queue) are load-bearing and
+deliberately more precise than syntactic inference could be.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, FrozenSet, Iterator, List, Optional, Sequence, \
+    Set, Tuple
+
+from .astutil import base_name, call_name, walk_scope
+from .dataflow import param_names
+
+__all__ = ["CallGraph", "FunctionInfo", "OwnershipSummary",
+           "OWNING_CONSTRUCTORS", "INTRINSIC_CONSUMES",
+           "INTRINSIC_OWNED_RESULTS", "functions_with_class",
+           "catch_all_handler", "get_callgraph"]
+
+#: constructors whose result owns device-pool budget until closed
+#: (mem/spillable.py: reservation happens AT construction)
+OWNING_CONSTRUCTORS = frozenset({"SpillableBatch"})
+
+#: transfer helpers: short name -> positional indices whose ownership the
+#: call takes over.  split_batch_in_half consumes its input (closes it on
+#: success; on failure it closes its own pieces and leaves the input
+#: open — either way the caller's handle is dead after a successful
+#: return, which is what the MOVED state models).  with_retry consumes
+#: its input list the same way (the ladder closes items + queue on any
+#: path).  wrap_spillables/_sides take RAW device batches, not owned
+#: spillables, so they consume nothing.
+INTRINSIC_CONSUMES: Dict[str, FrozenSet[int]] = {
+    "split_batch_in_half": frozenset({0}),
+    "with_retry": frozenset({0}),
+    "wrap_spillables": frozenset(),
+    "wrap_spillable_sides": frozenset(),
+}
+
+#: calls whose result the caller OWNS (must close / hand off)
+INTRINSIC_OWNED_RESULTS = frozenset(
+    {"wrap_spillables", "wrap_spillable_sides", "split_batch_in_half",
+     "with_retry"}) | OWNING_CONSTRUCTORS
+
+#: receiver methods that only read the batch (no ownership effect)
+BORROWING_METHODS = frozenset(
+    {"get", "get_batch", "device_bytes", "host_bytes", "spill_to_host",
+     "spill_to_disk", "is_spilled", "num_rows"})
+
+#: mutator method names — calling one on (an attribute of) a parameter
+#: is externally-visible mutation of the argument object
+_MUTATOR_METHODS = frozenset(
+    {"append", "extend", "insert", "add", "update", "pop", "popitem",
+     "remove", "discard", "clear", "setdefault", "appendleft",
+     "extendleft", "write"})
+
+#: call-name prefixes the escape analysis treats as fallible (I/O and
+#: serialization — the bug class the never-raise surfaces guard against)
+_RISKY_PREFIXES = ("os.", "json.", "shutil.", "subprocess.", "socket.",
+                   "pickle.", "tempfile.")
+#: prefixes that override _RISKY_PREFIXES back to safe (pure path /
+#: environment metadata)
+_SAFE_PREFIXES = ("os.path.", "os.environ.get", "os.getpid", "os.sep",
+                  "json.JSONDecodeError")
+#: bare call names that are fallible
+_RISKY_BARE = frozenset({"open"})
+
+
+class FunctionInfo:
+    """One ``def`` in the linted tree."""
+
+    __slots__ = ("ctx", "node", "name", "cls", "qualname")
+
+    def __init__(self, ctx, node: ast.AST, cls: Optional[str]):
+        self.ctx = ctx
+        self.node = node
+        self.name = node.name
+        self.cls = cls
+        self.qualname = (f"{ctx.rel}::{cls}.{node.name}" if cls
+                         else f"{ctx.rel}::{node.name}")
+
+    def __repr__(self):
+        return f"<FunctionInfo {self.qualname}>"
+
+
+class OwnershipSummary:
+    """What one function does with its parameters (indices into
+    ``param_names``, ``self``/``cls`` included at index 0 for methods)."""
+
+    __slots__ = ("param_names", "consumes", "closes", "mutates",
+                 "returns_owned")
+
+    def __init__(self, params: Sequence[str], consumes: FrozenSet[int],
+                 closes: FrozenSet[int], mutates: FrozenSet[int],
+                 returns_owned: bool):
+        self.param_names = tuple(params)
+        self.consumes = consumes
+        self.closes = closes
+        self.mutates = mutates
+        self.returns_owned = returns_owned
+
+    def __repr__(self):
+        return (f"<OwnershipSummary consumes={sorted(self.consumes)} "
+                f"closes={sorted(self.closes)} "
+                f"mutates={sorted(self.mutates)} "
+                f"returns_owned={self.returns_owned}>")
+
+
+def functions_with_class(tree: ast.Module) -> Iterator[
+        Tuple[ast.AST, Optional[str]]]:
+    """Every (FunctionDef/AsyncFunctionDef, enclosing-class-name) of a
+    module, nested defs included (their class is the innermost one)."""
+    def visit(node, cls):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                yield from visit(child, child.name)
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                yield child, cls
+                yield from visit(child, cls)
+            else:
+                yield from visit(child, cls)
+    yield from visit(tree, None)
+
+
+def accumulating_store(node: ast.AST) -> Optional[str]:
+    """Base name of an attribute/subscript store that COMPOUNDS prior
+    state (``self.n += 1``, ``self.n = self.n + x``) — the mutation
+    shape a replayed retry attempt doubles.  Idempotent overwrites
+    (``self._flag = False``, cache fills) return None: re-running them
+    converges."""
+    if isinstance(node, ast.AugAssign) and \
+            isinstance(node.target, (ast.Attribute, ast.Subscript)):
+        return base_name(node.target)
+    if isinstance(node, ast.Assign) and node.value is not None:
+        try:
+            rhs = ast.unparse(node.value)
+        except Exception:
+            return None
+        for t in node.targets:
+            if isinstance(t, (ast.Attribute, ast.Subscript)):
+                try:
+                    if ast.unparse(t) in rhs:
+                        return base_name(t)
+                except Exception:
+                    continue
+    return None
+
+
+def catch_all_handler(handler: ast.ExceptHandler) -> bool:
+    """True when the handler stops every (non-exit) exception: bare
+    ``except``, ``except Exception``/``BaseException`` or a tuple
+    containing one of them."""
+    t = handler.type
+    if t is None:
+        return True
+    types = t.elts if isinstance(t, ast.Tuple) else [t]
+    for x in types:
+        name = (call_name(x) if isinstance(x, ast.Call) else None) or \
+            (x.id if isinstance(x, ast.Name) else None) or \
+            (x.attr if isinstance(x, ast.Attribute) else None)
+        if name in ("Exception", "BaseException"):
+            return True
+    return False
+
+
+class _Cycle(Exception):
+    pass
+
+
+class CallGraph:
+    """Function index + memoized ownership / escape summaries over the
+    whole linted tree.  Construction never imports the linted code —
+    everything is derived from the already-parsed ASTs."""
+
+    def __init__(self, ctxs: Sequence):
+        #: rel path -> {name: FunctionInfo} for module-level defs
+        self.module_funcs: Dict[str, Dict[str, FunctionInfo]] = {}
+        #: (rel, class, method) -> FunctionInfo
+        self.methods: Dict[Tuple[str, str, str], FunctionInfo] = {}
+        #: short name -> every FunctionInfo carrying it
+        self.by_name: Dict[str, List[FunctionInfo]] = {}
+        self.infos: List[FunctionInfo] = []
+        for ctx in ctxs:
+            if ctx.tree is None:
+                continue
+            for node in ctx.tree.body:
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    info = FunctionInfo(ctx, node, None)
+                    self.module_funcs.setdefault(
+                        ctx.rel, {})[node.name] = info
+                    self._index(info)
+            for fn, cls in functions_with_class(ctx.tree):
+                if cls is not None:
+                    info = FunctionInfo(ctx, fn, cls)
+                    self.methods[(ctx.rel, cls, fn.name)] = info
+                    self._index(info)
+        self._own_memo: Dict[str, OwnershipSummary] = {}
+        self._own_stack: Set[str] = set()
+        self._esc_memo: Dict[str, List[Tuple[int, str]]] = {}
+        self._esc_stack: Set[str] = set()
+
+    def _index(self, info: FunctionInfo) -> None:
+        self.infos.append(info)
+        self.by_name.setdefault(info.name, []).append(info)
+
+    # ------------------------------------------------------- resolution
+    def resolve(self, ctx, call: ast.Call,
+                cls: Optional[str] = None) -> Optional[FunctionInfo]:
+        """The project function a call statically targets, or None.
+        Resolution order: ``self.x``/``cls.x`` within the enclosing
+        class; bare names in the same module; bare names with exactly
+        one project-wide definition.  Dotted calls through objects and
+        ambiguous names stay unresolved."""
+        name = call_name(call)
+        if name is None:
+            return None
+        if "." in name:
+            head, _, meth = name.partition(".")
+            if head in ("self", "cls") and cls is not None \
+                    and "." not in meth:
+                return self.methods.get((ctx.rel, cls, meth))
+            return None
+        local = self.module_funcs.get(ctx.rel, {}).get(name)
+        if local is not None:
+            return local
+        cands = self.by_name.get(name, ())
+        if len(cands) == 1:
+            return cands[0]
+        return None
+
+    # ------------------------------------------------ ownership summary
+    def summary(self, info: FunctionInfo) -> OwnershipSummary:
+        """Memoized ownership summary; recursion degrades to
+        consumes-everything (discharges the caller's obligation — the
+        direction that cannot create a false leak finding)."""
+        key = info.qualname
+        if key in self._own_memo:
+            return self._own_memo[key]
+        params = param_names(info.node)
+        if key in self._own_stack:
+            all_idx = frozenset(range(len(params)))
+            return OwnershipSummary(params, all_idx, frozenset(),
+                                    all_idx, False)
+        self._own_stack.add(key)
+        try:
+            summ = self._compute_summary(info, params)
+            self._own_memo[key] = summ
+            return summ
+        finally:
+            self._own_stack.discard(key)
+
+    def _compute_summary(self, info: FunctionInfo,
+                         params: List[str]) -> OwnershipSummary:
+        fn = info.node
+        index = {p: i for i, p in enumerate(params)}
+        consumes: Set[int] = set()
+        closes: Set[int] = set()
+        mutates: Set[int] = set()
+        returns_owned = False
+        #: loop vars drawn from a parameter (``for s in parts``): a
+        #: close of the loop var is a close of the parameter's elements
+        aliases: Dict[str, Set[str]] = {}
+        #: locals bound to an owned-result construction (for
+        #: returns_owned detection through one assignment)
+        owned_locals: Set[str] = set()
+        for node in walk_scope(fn):
+            if isinstance(node, (ast.For, ast.AsyncFor)) and \
+                    isinstance(node.target, ast.Name):
+                for sub in ast.walk(node.iter):
+                    if isinstance(sub, ast.Name) and sub.id in index:
+                        aliases.setdefault(node.target.id,
+                                           set()).add(sub.id)
+            elif isinstance(node, ast.Assign) and \
+                    len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name):
+                if self._owned_result_expr(node.value):
+                    owned_locals.add(node.targets[0].id)
+
+        def param_idx(name: Optional[str]) -> List[int]:
+            if name is None:
+                return []
+            if name in index:
+                return [index[name]]
+            return [index[s] for s in aliases.get(name, ())
+                    if s in index]
+
+        for node in walk_scope(fn):
+            if isinstance(node, ast.Call):
+                if isinstance(node.func, ast.Attribute):
+                    recv = base_name(node.func.value)
+                    meth = node.func.attr
+                    if meth == "close" and \
+                            isinstance(node.func.value, ast.Name):
+                        for i in param_idx(node.func.value.id):
+                            closes.add(i)
+                            consumes.add(i)
+                        continue
+                    if meth in _MUTATOR_METHODS:
+                        for i in param_idx(recv):
+                            mutates.add(i)
+                    if meth in BORROWING_METHODS:
+                        continue
+                # parameters riding into another call: resolved callees
+                # propagate their own verbs, everything else consumes
+                # (the conservative no-false-leak default)
+                self._call_args_into(info, node, index, consumes,
+                                     closes, mutates)
+            elif isinstance(node, (ast.Assign, ast.AugAssign,
+                                   ast.AnnAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                acc = accumulating_store(node)
+                for t in targets:
+                    if isinstance(t, (ast.Attribute, ast.Subscript)):
+                        b = base_name(t)
+                        if acc is not None and b == acc:
+                            # only COMPOUNDING stores count as mutation
+                            # (retry-purity semantics: idempotent
+                            # overwrites/cache fills replay safely)
+                            for i in param_idx(b):
+                                mutates.add(i)
+                        # a param stored INTO something escapes there
+                        if node.value is not None:
+                            for sub in _walk_no_nested(node.value):
+                                if isinstance(sub, ast.Name):
+                                    for i in param_idx(sub.id):
+                                        consumes.add(i)
+            elif isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+                val = node.value
+                if val is None:
+                    continue
+                if self._owned_result_expr(val):
+                    returns_owned = True
+                # returning sb.num_rows() returns a READ of sb, not sb —
+                # the receiver of a borrowing-method call is not consumed
+                borrow_recv = {
+                    id(c.func.value) for c in _walk_no_nested(val)
+                    if isinstance(c, ast.Call)
+                    and isinstance(c.func, ast.Attribute)
+                    and c.func.attr in BORROWING_METHODS
+                    and isinstance(c.func.value, ast.Name)}
+                for sub in _walk_no_nested(val):
+                    if isinstance(sub, ast.Name) and \
+                            id(sub) not in borrow_recv:
+                        if sub.id in owned_locals:
+                            returns_owned = True
+                        for i in param_idx(sub.id):
+                            consumes.add(i)
+        return OwnershipSummary(params, frozenset(consumes),
+                                frozenset(closes), frozenset(mutates),
+                                returns_owned)
+
+    def _call_args_into(self, info: FunctionInfo, call: ast.Call,
+                        index: Dict[str, int], consumes: Set[int],
+                        closes: Set[int], mutates: Set[int]) -> None:
+        name = call_name(call)
+        leaf = name.rsplit(".", 1)[-1] if name else None
+        intrinsic = INTRINSIC_CONSUMES.get(leaf) if leaf else None
+        callee = None
+        if intrinsic is None:
+            callee = self.resolve(info.ctx, call, info.cls)
+        callee_summ = self.summary(callee) if callee is not None else None
+        shift = 1 if (callee is not None and callee.cls is not None
+                      and isinstance(call.func, ast.Attribute)) else 0
+        for pos, arg in enumerate(call.args):
+            if not isinstance(arg, ast.Name) or arg.id not in index:
+                for sub in _walk_no_nested(arg):
+                    if isinstance(sub, ast.Name) and sub.id in index:
+                        consumes.add(index[sub.id])
+                continue
+            i = index[arg.id]
+            if intrinsic is not None:
+                if pos in intrinsic:
+                    consumes.add(i)
+            elif callee_summ is not None:
+                cpos = pos + shift
+                if cpos in callee_summ.closes:
+                    closes.add(i)
+                    consumes.add(i)
+                elif cpos in callee_summ.consumes:
+                    consumes.add(i)
+                if cpos in callee_summ.mutates:
+                    mutates.add(i)
+            else:
+                consumes.add(i)
+        for kw in call.keywords:
+            for sub in _walk_no_nested(kw.value):
+                if isinstance(sub, ast.Name) and sub.id in index:
+                    consumes.add(index[sub.id])
+
+    @staticmethod
+    def _owned_result_expr(expr: ast.AST) -> bool:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                name = call_name(node)
+                if name and name.rsplit(".", 1)[-1] in \
+                        INTRINSIC_OWNED_RESULTS:
+                    return True
+        return False
+
+    # -------------------------------------------------- escape analysis
+    def escape_sites(self, info: FunctionInfo) -> List[Tuple[int, str]]:
+        """(line, description) for every statement of ``info`` from
+        which an exception can escape the function: unguarded ``raise``,
+        fallible I/O calls, and resolved project callees that may
+        themselves escape.  Guarded means an enclosing ``try`` body
+        whose handlers include a catch-all.  Unresolved external calls
+        are assumed safe — this analysis is deliberately optimistic so
+        the never-raise gate stays actionable (docs/static_analysis.md
+        spells out the trade)."""
+        key = info.qualname
+        if key in self._esc_memo:
+            return self._esc_memo[key]
+        if key in self._esc_stack:
+            return []        # recursion: optimistic
+        self._esc_stack.add(key)
+        try:
+            sites = self._compute_escapes(info)
+            self._esc_memo[key] = sites
+            return sites
+        finally:
+            self._esc_stack.discard(key)
+
+    def may_escape(self, info: FunctionInfo) -> bool:
+        return bool(self.escape_sites(info))
+
+    def _compute_escapes(self, info: FunctionInfo) -> List[Tuple[int, str]]:
+        sites: List[Tuple[int, str]] = []
+
+        def header_nodes(stmt: ast.stmt):
+            """The statement's own expressions — nested statements are
+            visited separately (they may carry a different protection
+            context, e.g. a try nested inside an unprotected with)."""
+            stack = [c for c in ast.iter_child_nodes(stmt)
+                     if not isinstance(c, ast.stmt)]
+            while stack:
+                cur = stack.pop()
+                yield cur
+                if isinstance(cur, (ast.Lambda, ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    continue
+                stack.extend(c for c in ast.iter_child_nodes(cur)
+                             if not isinstance(c, ast.stmt))
+
+        def risky_calls(stmt: ast.stmt) -> None:
+            for node in header_nodes(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node)
+                if name is None:
+                    continue
+                if name.startswith(_SAFE_PREFIXES):
+                    continue
+                if name in _RISKY_BARE or name.startswith(_RISKY_PREFIXES):
+                    sites.append((node.lineno,
+                                  f"fallible call {name}()"))
+                    continue
+                callee = self.resolve(info.ctx, node, info.cls)
+                if callee is not None and callee.node is not info.node \
+                        and self.may_escape(callee):
+                    sites.append((node.lineno,
+                                  f"call to '{name}' which may raise"))
+
+        def visit(stmts, protected: bool) -> None:
+            for s in stmts:
+                if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                    continue
+                if isinstance(s, ast.Try):
+                    body_protected = protected or any(
+                        catch_all_handler(h) for h in s.handlers)
+                    visit(s.body, body_protected)
+                    visit(s.orelse, protected)
+                    for h in s.handlers:
+                        visit(h.body, protected)
+                    visit(s.finalbody, protected)
+                    continue
+                if not protected:
+                    if isinstance(s, ast.Raise):
+                        sites.append((s.lineno, "raise"))
+                    else:
+                        risky_calls(s)
+                for attr in ("body", "orelse"):
+                    sub = getattr(s, attr, None)
+                    if isinstance(sub, list) and sub and \
+                            isinstance(sub[0], ast.stmt):
+                        visit(sub, protected)
+
+        fn = info.node
+        visit(fn.body, False)
+        sites.sort()
+        return sites
+
+
+def _walk_no_nested(node: ast.AST):
+    """ast.walk skipping comprehensions and lambdas — mentions there
+    are reads, not ownership transfers."""
+    stack = [node]
+    while stack:
+        cur = stack.pop()
+        yield cur
+        if isinstance(cur, (ast.ListComp, ast.SetComp, ast.DictComp,
+                            ast.GeneratorExp, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(cur))
+
+
+#: one-slot cache so the three contract rules sharing a run_lint pass
+#: build the project call graph once, not once per rule
+_CG_CACHE: List[Tuple[tuple, "CallGraph"]] = []
+
+
+def get_callgraph(ctxs: Sequence) -> CallGraph:
+    key = tuple(id(c) for c in ctxs)
+    if _CG_CACHE and _CG_CACHE[0][0] == key:
+        return _CG_CACHE[0][1]
+    cg = CallGraph(ctxs)
+    _CG_CACHE[:] = [(key, cg)]
+    return cg
+
+
+#: ``# tpulint: never-raise`` on (or directly above) a def marks it as a
+#: never-raise surface for rules_contracts.NeverRaiseRule
+NEVER_RAISE_RE = re.compile(r"#\s*tpulint:\s*never-raise\b")
+
+
+def never_raise_marked(ctx, fn: ast.AST) -> bool:
+    for lineno in (fn.lineno, fn.lineno - 1):
+        if 1 <= lineno <= len(ctx.lines) and \
+                NEVER_RAISE_RE.search(ctx.lines[lineno - 1]):
+            return True
+    return False

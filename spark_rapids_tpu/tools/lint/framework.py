@@ -306,8 +306,10 @@ def run_lint(paths: Sequence[str], rules: Optional[Sequence[Rule]] = None,
 
 def lint_source(source: str, rules: Sequence[Rule],
                 path: str = "<test>") -> List[Finding]:
-    """Test/fixture helper: run file rules over a source snippet, with
-    suppression comments honored but no baseline."""
+    """Test/fixture helper: run rules over a source snippet, with
+    suppression comments honored but no baseline.  Project rules see a
+    one-file project (enough for the callgraph-backed rules; the drift
+    rules want a real root and are tested through run_lint instead)."""
     ctx = FileContext(path, source)
     if ctx.parse_error is not None:
         raise ctx.parse_error
@@ -315,4 +317,7 @@ def lint_source(source: str, rules: Sequence[Rule],
     for rule in rules:
         if isinstance(rule, FileRule):
             out.extend(f for f in rule.check(ctx) if not ctx.suppressed(f))
+        elif isinstance(rule, ProjectRule):
+            out.extend(f for f in rule.check_project([ctx], os.getcwd())
+                       if not ctx.suppressed(f))
     return out

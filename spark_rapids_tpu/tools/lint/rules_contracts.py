@@ -1,0 +1,341 @@
+"""Contract rules on the callgraph engine (tpulint v3).
+
+Three invariants PR 14's retry ladder and PR 15's ops plane introduced,
+now enforced statically:
+
+* **retry-purity** — an attempt body handed to ``with_retry`` /
+  ``with_retry_no_split`` must not mutate ``self``/captured object
+  state unless a ``CheckpointRestore`` rides along as ``retryable=``
+  (the ladder restores it before every re-attempt; without it, a
+  replayed attempt doubles its output — the exact bug PR 14's
+  checkpoint tests demonstrate).  Interprocedural: a closure that calls
+  ``self._accumulate(...)`` is caught when the helper's summary says it
+  mutates its receiver.
+* **never-raise** — a function marked ``# tpulint: never-raise``
+  (flight-recorder triggers, event-log writes, trace-artifact export,
+  sentinel folds) must not let exceptions escape past a logging catch:
+  every ``raise``, fallible I/O call, and call to a project function
+  that may itself escape has to sit under a catch-all ``try``.  The
+  analysis is deliberately optimistic about unresolved external calls
+  (callgraph.py documents the trade) so the gate stays actionable.
+* **grant-pairing** — ``pressure_host_grant()`` is a context manager
+  and must be entered with ``with``; a ``reserve_granted(n)`` call must
+  either record the grant in an attribute flag/ledger (the
+  ``_granted`` discipline of mem/spillable.py) or reach a
+  ``release_granted`` on every CFG path to function exit.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .astutil import (FuncNode, base_name, call_name, find_local_funcdef,
+                      in_cleanup_block, local_names, walk_scope)
+from .callgraph import (CallGraph, accumulating_store,
+                        functions_with_class, get_callgraph,
+                        never_raise_marked)
+from .cfg import build_cfg
+from .framework import FileContext, FileRule, Finding, ProjectRule
+from .rules_retry import RETRY_ENTRY_POINTS, _MUTATORS, has_retryable
+
+__all__ = ["RetryPurityRule", "NeverRaiseRule", "GrantPairingRule"]
+
+
+# ---------------------------------------------------------------------------
+# retry-purity
+# ---------------------------------------------------------------------------
+
+class RetryPurityRule(ProjectRule):
+    name = "retry-purity"
+    contract = ("with_retry attempt bodies must not mutate self/captured "
+                "object state (directly or through helpers) unless a "
+                "CheckpointRestore is passed as retryable= — the ladder "
+                "restores it before every re-attempt (mem/retry.py)")
+
+    def check_project(self, ctxs: Sequence[FileContext],
+                      root: str) -> Iterable[Finding]:
+        try:
+            cg = get_callgraph(ctxs)
+        except Exception as e:
+            return [Finding("tool-error", "spark_rapids_tpu/tools/lint",
+                            0, f"callgraph build failed: {e!r}")]
+        out: List[Finding] = []
+        for ctx in ctxs:
+            if ctx.tree is None or "with_retry" not in ctx.source:
+                continue
+            for scope, cls in functions_with_class(ctx.tree):
+                for node in walk_scope(scope):
+                    if isinstance(node, ast.Call):
+                        out.extend(self._check_call(ctx, scope, cls,
+                                                    node, cg))
+        return out
+
+    def _check_call(self, ctx: FileContext, scope, cls,
+                    call: ast.Call, cg: CallGraph) -> List[Finding]:
+        name = call_name(call)
+        if name is None:
+            return []
+        idx = RETRY_ENTRY_POINTS.get(name.rsplit(".", 1)[-1])
+        if idx is None or len(call.args) <= idx:
+            return []
+        arg = call.args[idx]
+        closure: Optional[FuncNode] = None
+        if isinstance(arg, ast.Lambda):
+            closure = arg
+        elif isinstance(arg, ast.Name):
+            closure = find_local_funcdef(scope, arg.id)
+        if closure is None:
+            return []
+        if has_retryable(call):
+            return []     # checkpointed: the ladder restores the state
+        return self._check_closure(ctx, closure, cls, cg,
+                                   getattr(scope, 'name', '<module>'))
+
+    def _check_closure(self, ctx: FileContext, closure: FuncNode,
+                       cls, cg: CallGraph,
+                       scope_name: str) -> List[Finding]:
+        locals_: Set[str] = local_names(closure)
+        out: List[Finding] = []
+        cname = getattr(closure, "name", "<lambda>")
+
+        def captured(nm: Optional[str]) -> bool:
+            return nm is not None and nm not in locals_
+
+        def emit(node, what: str, key: str) -> None:
+            if in_cleanup_block(closure, node):
+                return
+            out.append(Finding(
+                self.name, ctx.rel, node.lineno,
+                f"retry attempt '{cname}' {what} with no CheckpointRestore "
+                "passed as retryable= — a replayed attempt applies the "
+                "mutation twice (pass a checkpoint or keep the attempt "
+                "pure; mem/retry.py contract)", key=f"{scope_name}:{cname}:{key}"))
+
+        for node in walk_scope(closure):
+            if isinstance(node, (ast.Assign, ast.AugAssign,
+                                 ast.AnnAssign)):
+                # only COMPOUNDING stores (+=, x = x + ...) double on
+                # replay; idempotent overwrites and cache fills are safe
+                b = accumulating_store(node)
+                if captured(b):
+                    emit(node, f"compounds captured object '{b}' state",
+                         f"store:{b}")
+            elif isinstance(node, ast.Call):
+                if isinstance(node.func, ast.Attribute):
+                    b = base_name(node.func.value)
+                    meth = node.func.attr
+                    if meth in _MUTATORS and captured(b) and \
+                            not isinstance(node.func.value, ast.Name):
+                        # self._parts.append(...): mutator on an
+                        # ATTRIBUTE of a captured object (the Name form
+                        # is retry-idempotence's, kept disjoint)
+                        emit(node, f"mutates '{b}' state via "
+                                   f".{meth}()", f"mutate:{b}.{meth}")
+                callee = cg.resolve(ctx, node, cls)
+                if callee is None or callee.cls is None:
+                    continue
+                summ = cg.summary(callee)
+                if 0 in summ.mutates and \
+                        isinstance(node.func, ast.Attribute):
+                    b = base_name(node.func.value)
+                    if captured(b):
+                        emit(node, f"mutates captured '{b}' through "
+                                   f"helper '{callee.name}' (its summary "
+                                   "says it mutates its receiver)",
+                             f"helper:{callee.name}")
+        return out
+
+
+# ---------------------------------------------------------------------------
+# never-raise
+# ---------------------------------------------------------------------------
+
+class NeverRaiseRule(ProjectRule):
+    name = "never-raise"
+    contract = ("functions marked '# tpulint: never-raise' (flight "
+                "trigger, event-log write, trace export, sentinel fold "
+                "surfaces) must not let exceptions escape past a "
+                "catch-all logging handler — ops/flight.py's 'trigger "
+                "never raises into its failing call site'")
+
+    def check_project(self, ctxs: Sequence[FileContext],
+                      root: str) -> Iterable[Finding]:
+        try:
+            cg = get_callgraph(ctxs)
+        except Exception as e:
+            return [Finding("tool-error", "spark_rapids_tpu/tools/lint",
+                            0, f"callgraph build failed: {e!r}")]
+        out: List[Finding] = []
+        for ctx in ctxs:
+            if ctx.tree is None or "never-raise" not in ctx.source:
+                continue
+            for fn, cls in functions_with_class(ctx.tree):
+                if not never_raise_marked(ctx, fn):
+                    continue
+                info = self._info_for(cg, ctx, fn, cls)
+                counts: Dict[str, int] = {}
+                for line, desc in cg.escape_sites(info):
+                    n = counts.get(desc, 0)
+                    counts[desc] = n + 1
+                    out.append(Finding(
+                        self.name, ctx.rel, line,
+                        f"{desc} can escape never-raise function "
+                        f"'{fn.name}' — wrap it in a catch-all logging "
+                        "handler (an exception here propagates into an "
+                        "already-failing caller or fails a healthy "
+                        "query)", key=f"{fn.name}:{desc}:{n}"))
+        return out
+
+    @staticmethod
+    def _info_for(cg: CallGraph, ctx, fn, cls):
+        if cls is not None:
+            info = cg.methods.get((ctx.rel, cls, fn.name))
+            if info is not None and info.node is fn:
+                return info
+        info = cg.module_funcs.get(ctx.rel, {}).get(fn.name)
+        if info is not None and info.node is fn:
+            return info
+        from .callgraph import FunctionInfo
+        return FunctionInfo(ctx, fn, cls)
+
+
+# ---------------------------------------------------------------------------
+# grant-pairing
+# ---------------------------------------------------------------------------
+
+class GrantPairingRule(FileRule):
+    name = "grant-pairing"
+    contract = ("pressure_host_grant() only as a with-statement; every "
+                "reserve_granted must record the grant in a flag/ledger "
+                "attribute or reach release_granted on all CFG paths — "
+                "the _granted discipline of mem/spillable.py")
+
+    #: the accounting primitives themselves (and the context manager)
+    #: are the mechanism, not call sites of it
+    _PRIMITIVES = frozenset({"reserve_granted", "release_granted",
+                             "pressure_host_grant"})
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.tree is None or "grant" not in ctx.source:
+            return []
+        out: List[Finding] = []
+        with_items: Set[int] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    with_items.add(id(item.context_expr))
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if node.name in self._PRIMITIVES:
+                continue
+            out.extend(self._check_function(ctx, node, with_items))
+        return out
+
+    def _check_function(self, ctx: FileContext, fn,
+                        with_items: Set[int]) -> List[Finding]:
+        out: List[Finding] = []
+        reserves: List[ast.Call] = []
+        has_grant_store = False
+        for node in walk_scope(fn):
+            if isinstance(node, ast.Call):
+                name = call_name(node) or ""
+                leaf = name.rsplit(".", 1)[-1]
+                if leaf == "pressure_host_grant" and \
+                        id(node) not in with_items:
+                    out.append(Finding(
+                        self.name, ctx.rel, node.lineno,
+                        f"pressure_host_grant() in {fn.name}() is not "
+                        "entered with a with-statement — the grant depth "
+                        "is a context manager; calling it bare leaks "
+                        "(or never takes) the thread-local grant",
+                        key=f"{fn.name}:bare-grant"))
+                elif leaf == "reserve_granted":
+                    reserves.append(node)
+            elif isinstance(node, (ast.Assign, ast.AugAssign,
+                                   ast.AnnAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if isinstance(t, ast.Attribute) and \
+                            "grant" in t.attr:
+                        has_grant_store = True
+                    elif isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Attribute) and \
+                            "grant" in t.value.attr:
+                        has_grant_store = True
+        if not reserves or has_grant_store:
+            return out
+        # a release inside a ``finally`` covers every path out of its
+        # try — including the return/raise edges the CFG routes straight
+        # to exit (cfg.py models finally on the fall-through path only)
+        for t in ast.walk(fn):
+            if isinstance(t, ast.Try):
+                for fb in t.finalbody:
+                    for c in ast.walk(fb):
+                        if isinstance(c, ast.Call) and \
+                                (call_name(c) or "").rsplit(".", 1)[-1] \
+                                == "release_granted":
+                            return out
+        cfg = build_cfg(fn)
+        for call in reserves:
+            if self._exit_reachable_without_release(cfg, call):
+                out.append(Finding(
+                    self.name, ctx.rel, call.lineno,
+                    f"reserve_granted() in {fn.name}() has no symmetric "
+                    "release_granted on some path to function exit, and "
+                    "no _granted-style flag/ledger store records the "
+                    "obligation — pressure_granted accounting leaks "
+                    "(mem/manager.py discipline)",
+                    key=f"{fn.name}:unpaired-reserve"))
+        return out
+
+    @staticmethod
+    def _exit_reachable_without_release(cfg, call: ast.Call) -> bool:
+        def has_call(elem, leaf: str, target=None) -> bool:
+            for e in ast.walk(elem) if isinstance(elem, ast.AST) else ():
+                if isinstance(e, ast.Call):
+                    if target is not None and e is target:
+                        return True
+                    if target is None:
+                        nm = call_name(e) or ""
+                        if nm.rsplit(".", 1)[-1] == leaf:
+                            return True
+            return False
+
+        # locate the element holding this reserve call
+        start = None
+        for b in cfg.blocks:
+            for i, elem in enumerate(b.elems):
+                node = getattr(elem, "node", elem)
+                if isinstance(node, ast.AST) and \
+                        has_call(node, "", target=call):
+                    start = (b, i)
+                    break
+            if start:
+                break
+        if start is None:
+            return False
+        releases = lambda elem: has_call(  # noqa: E731
+            getattr(elem, "node", elem), "release_granted")
+        b0, i0 = start
+        # walk forward: remaining elements of the block, then successors
+        seen: Set[int] = set()
+        stack: List[Tuple[object, int]] = [(b0, i0 + 1)]
+        while stack:
+            b, i = stack.pop()
+            blocked = False
+            for elem in b.elems[i:]:
+                if releases(elem):
+                    blocked = True
+                    break
+            if blocked:
+                continue
+            if b is cfg.exit:
+                return True
+            for succ in b.succs:
+                if succ.id not in seen:
+                    seen.add(succ.id)
+                    stack.append((succ, 0))
+        return False

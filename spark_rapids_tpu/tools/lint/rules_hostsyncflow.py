@@ -1,6 +1,6 @@
 """host-sync-flow: device values must not FLOW into implicit host syncs.
 
-The pattern-based ``host-sync`` rule catches the direct shapes —
+The retired pattern-based ``host-sync`` rule caught the direct shapes —
 ``np.asarray(x)``, ``.item()``, ``jax.device_get`` — but a device value
 that travels through a couple of assignments or a helper before hitting
 ``float()`` or an ``if`` was invisible to it.  This rule runs the
@@ -27,24 +27,35 @@ paths of exprs/base.py and the compiled kernels):
   tunnel round trip per batch — or an outright TracerBoolConversion /
   ConcretizationError under trace.
 
-The scalar-conversion heuristic the pattern rule used to carry
-(``float()`` of a name that merely *looked* device-ish) is retired in
-favor of this dataflow version; the direct-call patterns stay in
-``host-sync`` because they need no flow analysis.  Intentional sync
-points carry an inline suppression with their justification
-(docs/static_analysis.md).
+This is the ONE host-sync rule surface (tpulint v3): the direct sync
+shapes that need no flow analysis — ``np.asarray(x)`` / ``.item()`` /
+``jax.device_get`` on anything inside a hot scope — are folded in here
+too (they were a separate ``host-sync`` pattern rule through v2).  The
+scalar-conversion heuristic that rule ALSO used to carry (``float()``
+of a name that merely *looked* device-ish) stays retired in favor of
+the dataflow version.  Intentional sync points carry an inline
+suppression with their justification (docs/static_analysis.md).
 """
 from __future__ import annotations
 
 import ast
 from typing import Dict, Iterable, List, Optional, Tuple
 
-from .astutil import dotted_name, is_jit_decorated, jit_static_params
+from .astutil import call_name, dotted_name, is_jit_decorated, \
+    jit_static_params
 from .dataflow import (Summaries, TaintAnalysis, TaintSpec,
                        element_exprs, scan_conditions)
 from .framework import FileContext, FileRule, Finding
 
 __all__ = ["HostSyncFlowRule"]
+
+#: call names that ARE a host sync on a device value, no argument
+#: analysis needed
+_SYNC_CALLS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+               "jax.device_get", "device_get", "_np.asarray", "_np.array",
+               "onp.asarray", "onp.array"}
+#: method names that force a sync on any jax array receiver
+_SYNC_METHODS = {"item", "block_until_ready", "tolist", "to_py"}
 
 #: call prefixes whose results live on device (trace-time values)
 _DEVICE_CALL_PREFIXES = ("jnp.", "jax.numpy.", "jax.lax.", "lax.",
@@ -97,11 +108,12 @@ class _FlowSpec(TaintSpec):
 
 class HostSyncFlowRule(FileRule):
     name = "host-sync-flow"
-    contract = ("no device-derived value may FLOW (through assignments "
-                "or same-module helpers) into float()/int()/bool(), a "
-                "truthiness test, or an f-string inside eval_device or "
-                "a jit kernel — each is an implicit host sync or a "
-                "tracing break")
+    contract = ("no device->host sync inside eval_device or a jit "
+                "kernel: neither a direct one (np.asarray/device_get/"
+                ".item()) nor a device-derived value FLOWING (through "
+                "assignments or same-module helpers) into float()/int()/"
+                "bool(), a truthiness test, or an f-string — each is a "
+                "full tunnel round trip per batch or a tracing break")
 
     def check(self, ctx: FileContext) -> Iterable[Finding]:
         if ctx.tree is None:
@@ -115,6 +127,9 @@ class HostSyncFlowRule(FileRule):
                 scopes.append((node, "eval_device"))
             elif is_jit_decorated(node):
                 scopes.append((node, f"jit kernel {node.name}"))
+        direct: List[Finding] = []
+        for fn, where in scopes:
+            direct.extend(self._direct_syncs(ctx, fn, where))
         # nested (non-jit) defs inside a hot scope are trace-time code
         # too — the CFG treats them as opaque, so analyze each as its
         # own scope (params of a helper defined under trace receive
@@ -132,10 +147,41 @@ class HostSyncFlowRule(FileRule):
             return []
         summaries = Summaries(ctx.tree, lambda s: _FlowSpec(s),
                               sink_scan=self._summary_sinks)
-        findings: List[Finding] = []
+        findings: List[Finding] = list(direct)
         for fn, where in scopes:
             findings.extend(self._check_scope(ctx, fn, where, summaries))
         return findings
+
+    # ------------------------------------------------- direct sync calls
+    def _direct_syncs(self, ctx: FileContext, fn,
+                      where: str) -> List[Finding]:
+        """The no-flow-analysis shapes absorbed from the retired
+        ``host-sync`` pattern rule.  Nested defs inside a hot scope are
+        still trace-time code, so walk everything (ast.walk) — this runs
+        on the TOP-level scopes only, before nested-def expansion, so
+        each call site reports once."""
+        out: List[Finding] = []
+        fname = getattr(fn, "name", "<lambda>")
+
+        def emit(node, what, key):
+            out.append(Finding(
+                self.name, ctx.rel, node.lineno,
+                f"{what} inside {where} — this synchronizes the device "
+                "to the host (a full tunnel round trip per batch) or "
+                "breaks XLA tracing", key=f"{fname}:{key}"))
+
+        for node in ast.walk(fn) if fn.body else []:
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name in _SYNC_CALLS:
+                emit(node, f"{name}() on a traced value", f"{name}")
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _SYNC_METHODS \
+                    and not node.args:
+                emit(node, f".{node.func.attr}()",
+                     f"method:{node.func.attr}")
+        return out
 
     # ------------------------------------------------------------ scopes
     @staticmethod

@@ -1,0 +1,583 @@
+"""ownership: flow-sensitive batch-lifetime verification (tpulint v3).
+
+Replaces the PR-1 pattern matcher (``batch-lifetime``) with a forward
+may-analysis over the tpulint CFG on an owned/borrowed/moved/closed
+lattice, interprocedural through callgraph.py summaries:
+
+* a local binding of an owning construction (``SpillableBatch(...)``,
+  ``wrap_spillables``/``wrap_spillable_sides`` results,
+  ``split_batch_in_half`` halves, a project function whose summary says
+  its result is owned) starts **owned**;
+* ``x.close()`` (directly or via ``for s in x: s.close()``) moves it to
+  **closed**; ``with x`` / returning / yielding / storing / passing it
+  to a call that keeps it moves it to an escaped form of **moved**;
+* parameters start **borrowed** — the caller owns them — and only a
+  consuming transfer (``split_batch_in_half``, ``with_retry``'s input
+  list, a resolved callee that closes them) changes that;
+* rebinding a tracked name kills its state (kill-on-rebind: the lattice
+  follows the NEW value; leaking the old generation is out of scope by
+  design, exactly like the rule it replaces);
+* ``try`` bodies conservatively edge into every handler (cfg.py), so
+  states join across exception paths instead of guessing.
+
+Findings:
+
+* **leak** — an owned value can reach function exit still owned;
+* **exc-leak** — fallible work runs while a value is owned, outside any
+  ``try`` whose handler/finally mentions it and not under ``with`` —
+  the batch leaks on the exception path (the zero-leak fixture's OOM
+  injection trips exactly this);
+* **use-after-move** — touching a handle after a consuming transfer
+  (``split_batch_in_half`` closed your input on success);
+* **double-close** — a close whose every inbound path already closed
+  the same handle (idempotence makes it safe at runtime, but the
+  second close is always a sign the ownership story is confused);
+* **escape-without-owner** — an owning construction whose result
+  nobody holds (discarded expression, or passed to a resolved callee
+  that only borrows it).
+
+Interprocedural sharpening vs the old rule: passing a batch to a
+*resolved* project function that merely borrows it no longer discharges
+the close obligation — only unresolved calls keep the old "someone else
+owns it now" benefit of the doubt.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, Iterable, List, Optional, Sequence, \
+    Set, Tuple
+
+from .astutil import base_name, call_name
+from .callgraph import (BORROWING_METHODS, CallGraph, INTRINSIC_CONSUMES,
+                        INTRINSIC_OWNED_RESULTS, OWNING_CONSTRUCTORS,
+                        functions_with_class, get_callgraph)
+from .cfg import Branch, ExceptBind, LoopBind, WithBind, build_cfg
+from .dataflow import EMPTY, element_exprs, param_names
+from .framework import FileContext, Finding, ProjectRule
+
+__all__ = ["OwnershipRule"]
+
+#: a file without any of these cannot produce a finding — skip the CFG
+#: work (the linter runs on every pytest invocation)
+_TRIGGER_TOKENS = ("SpillableBatch", "split_batch_in_half",
+                   "wrap_spillable", "with_retry")
+
+_OWNED = "owned"
+_BORROWED = "borrowed"
+_ESCAPED = "escaped"
+# closed/moved states carry provenance (the element id / line that
+# caused them) so a close re-entered via a loop back edge does not
+# read as a second close of an already-closed handle
+
+
+def _is_closed(tag) -> bool:
+    return isinstance(tag, tuple) and tag[0] == "closed"
+
+
+def _is_moved(tag) -> bool:
+    return isinstance(tag, tuple) and tag[0] == "moved"
+
+
+def _all_closed(state: FrozenSet) -> bool:
+    return bool(state) and all(_is_closed(t) for t in state)
+
+
+def _all_moved(state: FrozenSet) -> bool:
+    return bool(state) and all(_is_moved(t) for t in state)
+
+
+#: calls treated as infallible when hunting exception-path leaks —
+#: borrowed reads on the handle itself, close(), and cheap builtins;
+#: anything else between construction and close flags the path
+_SAFE_BUILTINS = frozenset({
+    "len", "isinstance", "issubclass", "getattr", "hasattr", "min",
+    "max", "abs", "int", "float", "str", "bool", "bytes", "list",
+    "tuple", "dict", "set", "frozenset", "id", "repr", "type",
+    "enumerate", "zip", "range", "sorted", "print",
+})
+
+
+def _fallible_call(call: ast.Call) -> bool:
+    if isinstance(call.func, ast.Attribute) and \
+            (call.func.attr in BORROWING_METHODS or
+             call.func.attr == "close"):
+        return False
+    if isinstance(call.func, ast.Name) and \
+            call.func.id in _SAFE_BUILTINS:
+        return False
+    return True
+
+
+def _constructs_owner(expr: ast.AST) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name and name.rsplit(".", 1)[-1] in OWNING_CONSTRUCTORS:
+                return True
+    return False
+
+
+def _walk_no_nested(node: ast.AST):
+    stack = [node]
+    while stack:
+        cur = stack.pop()
+        yield cur
+        if isinstance(cur, (ast.ListComp, ast.SetComp, ast.DictComp,
+                            ast.GeneratorExp, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(cur))
+
+
+def _try_protection(fn) -> Dict[int, List[ast.Try]]:
+    """id(stmt) -> the enclosing ``try`` statements whose BODY holds it
+    (handlers/finally/orelse do not protect themselves)."""
+    out: Dict[int, List[ast.Try]] = {}
+
+    def visit(stmts, stack):
+        for s in stmts:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+                continue
+            out[id(s)] = list(stack)
+            if isinstance(s, ast.Try):
+                visit(s.body, stack + [s])
+                visit(s.orelse, stack)
+                for h in s.handlers:
+                    visit(h.body, stack)
+                visit(s.finalbody, stack)
+                continue
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(s, attr, None)
+                if isinstance(sub, list) and sub and \
+                        isinstance(sub[0], ast.stmt):
+                    visit(sub, stack)
+            for h in getattr(s, "handlers", ()):
+                visit(h.body, stack)
+
+    visit(fn.body, [])
+    return out
+
+
+def _mentions(nodes: Sequence[ast.AST], name: str) -> bool:
+    for n in nodes:
+        for sub in ast.walk(n):
+            if isinstance(sub, ast.Name) and sub.id == name:
+                return True
+    return False
+
+
+class _Analysis:
+    """One function's ownership fixpoint + finding replay."""
+
+    def __init__(self, ctx: FileContext, fn, cls: Optional[str],
+                 cg: CallGraph):
+        self.ctx = ctx
+        self.fn = fn
+        self.cls = cls
+        self.cg = cg
+        self.cfg = build_cfg(fn)
+        self.origins: Dict[str, int] = {}      # owned local -> def line
+        self.protection = _try_protection(fn)
+        #: loop var -> tracked lists it iterates (``for s in halves``)
+        self.aliases: Dict[str, Set[str]] = {}
+        self._find_aliases()
+        #: vars discharged by a per-element close inside a loop — the
+        #: zero-trip path keeps them "owned" at the join, so the final
+        #: leak check exempts them (for-each-close is the idiom, not a
+        #: leak)
+        self.alias_closed: Set[str] = set()
+        self.block_in: Dict[int, Dict[str, FrozenSet]] = {}
+        self._solve()
+
+    # --------------------------------------------------------- prepass
+    def _candidate_names(self) -> Set[str]:
+        out = set(p for p in param_names(self.fn)
+                  if p not in ("self", "cls"))
+        for node in ast.walk(self.fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and self._owning_expr(node.value):
+                out.add(node.targets[0].id)
+        return out
+
+    def _find_aliases(self):
+        cands = self._candidate_names()
+        for node in ast.walk(self.fn):
+            if isinstance(node, (ast.For, ast.AsyncFor)) and \
+                    isinstance(node.target, ast.Name):
+                for sub in ast.walk(node.iter):
+                    if isinstance(sub, ast.Name) and sub.id in cands:
+                        self.aliases.setdefault(
+                            node.target.id, set()).add(sub.id)
+
+    def _owning_expr(self, expr: ast.AST) -> bool:
+        if _constructs_owner(expr):
+            return True
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            leaf = name.rsplit(".", 1)[-1] if name else None
+            if leaf in INTRINSIC_OWNED_RESULTS:
+                return True
+            callee = self.cg.resolve(self.ctx, node, self.cls)
+            if callee is not None and \
+                    self.cg.summary(callee).returns_owned:
+                return True
+        return False
+
+    # --------------------------------------------------------- solving
+    def _seed(self) -> Dict[str, FrozenSet]:
+        return {p: frozenset([_BORROWED])
+                for p in param_names(self.fn) if p not in ("self", "cls")}
+
+    def _solve(self):
+        from collections import deque
+        self.block_in = {b.id: {} for b in self.cfg.blocks}
+        self.block_in[self.cfg.entry.id] = self._seed()
+        work = deque(self.cfg.blocks)
+        while work:
+            b = work.popleft()
+            env = dict(self.block_in[b.id])
+            for elem in b.elems:
+                self.transfer(elem, env)
+            for succ in b.succs:
+                changed = False
+                dst = self.block_in[succ.id]
+                for k, v in env.items():
+                    new = dst.get(k, EMPTY) | v
+                    if new != dst.get(k, EMPTY):
+                        dst[k] = new
+                        changed = True
+                if changed and succ not in work:
+                    work.append(succ)
+
+    # -------------------------------------------------------- transfer
+    def transfer(self, elem, env: Dict[str, FrozenSet],
+                 report=None) -> None:
+        if isinstance(elem, Branch):
+            self._expr_events(elem.test, env, report)
+        elif isinstance(elem, LoopBind):
+            self._expr_events(elem.iter, env, report)
+            if isinstance(elem.target, ast.Name):
+                env.pop(elem.target.id, None)     # kill-on-rebind
+        elif isinstance(elem, WithBind):
+            for item in elem.items:
+                cm = item.context_expr
+                self._expr_events(cm, env, report, with_scope=True)
+                if isinstance(cm, ast.Name) and cm.id in env:
+                    env[cm.id] = frozenset([_ESCAPED])
+                elif self._owning_expr(cm) and \
+                        isinstance(item.optional_vars, ast.Name):
+                    env[item.optional_vars.id] = frozenset([_ESCAPED])
+        elif isinstance(elem, ExceptBind):
+            if elem.name:
+                env.pop(elem.name, None)
+        elif isinstance(elem, ast.Assign):
+            self._expr_events(elem.value, env, report)
+            owned = self._owning_expr(elem.value)
+            for t in elem.targets:
+                if isinstance(t, ast.Name):
+                    if owned:
+                        env[t.id] = frozenset([_OWNED])
+                        self.origins.setdefault(t.id, elem.lineno)
+                    elif isinstance(elem.value, ast.Name) and \
+                            elem.value.id in env:
+                        # pure alias: the new name carries the state,
+                        # the old one is shared (not re-reported)
+                        env[t.id] = env[elem.value.id]
+                        env[elem.value.id] = frozenset([_ESCAPED])
+                    else:
+                        env.pop(t.id, None)       # kill-on-rebind
+                elif isinstance(t, (ast.Attribute, ast.Subscript)):
+                    self._escape_names(elem.value, env)
+                elif isinstance(t, (ast.Tuple, ast.List)):
+                    for sub in t.elts:
+                        if isinstance(sub, ast.Name):
+                            env.pop(sub.id, None)
+        elif isinstance(elem, (ast.AugAssign, ast.AnnAssign)):
+            if elem.value is not None:
+                self._expr_events(elem.value, env, report)
+            t = elem.target
+            if isinstance(t, (ast.Attribute, ast.Subscript)) and \
+                    elem.value is not None:
+                self._escape_names(elem.value, env)
+        elif isinstance(elem, (ast.Return, ast.Raise)):
+            for e in element_exprs(elem):
+                self._expr_events(e, env, report)
+            if isinstance(elem, ast.Return) and elem.value is not None:
+                self._escape_names(elem.value, env)
+        elif isinstance(elem, ast.Expr):
+            self._expr_events(elem.value, env, report)
+            if report is not None and isinstance(elem.value, ast.Call) \
+                    and self._fresh_owner_call(elem.value):
+                report.no_owner(elem.value)
+        elif isinstance(elem, ast.Delete):
+            for t in elem.targets:
+                if isinstance(t, ast.Name):
+                    env.pop(t.id, None)
+        else:
+            for e in element_exprs(elem):
+                self._expr_events(e, env, report)
+
+    def _fresh_owner_call(self, call: ast.Call) -> bool:
+        name = call_name(call)
+        return bool(name) and \
+            name.rsplit(".", 1)[-1] in OWNING_CONSTRUCTORS
+
+    def _escape_names(self, expr: ast.AST,
+                      env: Dict[str, FrozenSet]) -> None:
+        for sub in _walk_no_nested(expr):
+            if isinstance(sub, ast.Name) and sub.id in env:
+                env[sub.id] = frozenset([_ESCAPED])
+
+    def _expr_events(self, expr: ast.AST, env: Dict[str, FrozenSet],
+                     report=None, with_scope: bool = False) -> None:
+        for node in _walk_no_nested(expr):
+            if isinstance(node, (ast.Yield, ast.YieldFrom)) and \
+                    node.value is not None:
+                self._escape_names(node.value, env)
+            elif isinstance(node, ast.Call):
+                self._call_event(node, env, report, with_scope)
+
+    def _call_event(self, call: ast.Call, env: Dict[str, FrozenSet],
+                    report, with_scope: bool) -> None:
+        # x.close() — directly or through a loop alias
+        if isinstance(call.func, ast.Attribute) and \
+                call.func.attr == "close" and \
+                isinstance(call.func.value, ast.Name):
+            recv = call.func.value.id
+            targets = [recv] if recv in env else \
+                [s for s in self.aliases.get(recv, ()) if s in env]
+            direct = recv in env
+            for v in targets:
+                if report is not None and direct and \
+                        _all_closed(env[v]) and \
+                        not any(t[1] == id(call) for t in env[v]):
+                    report.double_close(call, v)
+                if not direct:
+                    self.alias_closed.add(v)
+                env[v] = frozenset([("closed", id(call), call.lineno)])
+            return
+        if isinstance(call.func, ast.Attribute) and \
+                call.func.attr in BORROWING_METHODS:
+            return
+        name = call_name(call)
+        leaf = name.rsplit(".", 1)[-1] if name else None
+        intrinsic = INTRINSIC_CONSUMES.get(leaf) if leaf else None
+        callee = summ = None
+        if intrinsic is None:
+            callee = self.cg.resolve(self.ctx, call, self.cls)
+            if callee is not None:
+                summ = self.cg.summary(callee)
+        shift = 1 if (callee is not None and callee.cls is not None
+                      and isinstance(call.func, ast.Attribute)) else 0
+        for pos, arg in enumerate(call.args):
+            if isinstance(arg, ast.Name) and arg.id in env:
+                if intrinsic is not None:
+                    if pos in intrinsic:
+                        env[arg.id] = frozenset(
+                            [("moved", call.lineno)])
+                    # else: borrows — state unchanged
+                elif summ is not None:
+                    cpos = pos + shift
+                    if cpos in summ.closes:
+                        env[arg.id] = frozenset(
+                            [("closed", id(call), call.lineno)])
+                    elif cpos in summ.consumes:
+                        env[arg.id] = frozenset([_ESCAPED])
+                    # else: resolved borrow — obligation stays here
+                else:
+                    env[arg.id] = frozenset([_ESCAPED])
+            else:
+                if intrinsic is not None and pos in intrinsic and \
+                        isinstance(arg, (ast.List, ast.Tuple)):
+                    # with_retry([sb], ...): the input list literal is
+                    # consumed element-wise (the ladder closes items)
+                    for sub in arg.elts:
+                        if isinstance(sub, ast.Name) and sub.id in env:
+                            env[sub.id] = frozenset(
+                                [("moved", call.lineno)])
+                    continue
+                if report is not None and isinstance(arg, ast.Call) \
+                        and self._fresh_owner_call(arg) \
+                        and summ is not None and not with_scope:
+                    cpos = pos + shift
+                    if cpos not in summ.consumes and \
+                            cpos not in summ.closes and \
+                            cpos < len(summ.param_names):
+                        report.no_owner(arg, via=callee.name)
+                self._escape_nested(arg, env, intrinsic, summ)
+        for kw in call.keywords:
+            self._escape_nested(kw.value, env, intrinsic, summ)
+
+    def _escape_nested(self, arg: ast.AST, env: Dict[str, FrozenSet],
+                       intrinsic, summ) -> None:
+        """Escape tracked names buried inside a non-Name argument to an
+        unresolved call — except names whose only role in the argument
+        is attribute/method *receiver* (``risky(sb.get_batch())``,
+        ``f(sb.batch)``): those hand out a borrowed view, and the close
+        obligation stays with the caller (closeOnExcept discipline)."""
+        if intrinsic is not None or summ is not None:
+            return
+        receiver_ids = {id(a.value) for a in _walk_no_nested(arg)
+                        if isinstance(a, ast.Attribute)
+                        and isinstance(a.value, ast.Name)}
+        for sub in _walk_no_nested(arg):
+            if isinstance(sub, ast.Name) and sub.id in env and \
+                    id(sub) not in receiver_ids:
+                env[sub.id] = frozenset([_ESCAPED])
+
+
+class _Report:
+    """Finding accumulator with per-(kind, var) dedupe."""
+
+    def __init__(self, rule: "OwnershipRule", ctx: FileContext, fname: str):
+        self.rule = rule
+        self.ctx = ctx
+        self.fname = fname
+        self.findings: List[Finding] = []
+        self._seen: Set[str] = set()
+        self._no_owner_n = 0
+
+    def _emit(self, line: int, msg: str, key: str) -> None:
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        self.findings.append(Finding(self.rule.name, self.ctx.rel, line,
+                                     msg, key=f"{self.fname}:{key}"))
+
+    def double_close(self, call: ast.Call, var: str) -> None:
+        self._emit(call.lineno,
+                   f"'{var}' ({self.fname}()) is already closed on every "
+                   "path reaching this close() — the second close means "
+                   "the ownership story is confused (double release of "
+                   "accounting in another holder)",
+                   f"double-close:{var}")
+
+    def use_after_move(self, node: ast.AST, var: str, moved_line) -> None:
+        self._emit(node.lineno,
+                   f"'{var}' ({self.fname}()) is used after its "
+                   f"ownership moved at line {moved_line} "
+                   "(split_batch_in_half/with_retry consumed it) — the "
+                   "handle is closed or owned elsewhere",
+                   f"use-after-move:{var}")
+
+    def leak(self, line: int, var: str) -> None:
+        self._emit(line,
+                   f"owned batch '{var}' ({self.fname}()) can reach "
+                   "function exit still owned — never closed, returned, "
+                   "or handed off on some path; it pins device-pool "
+                   "budget forever (mem/spillable.py contract)",
+                   f"leak:{var}")
+
+    def exc_leak(self, line: int, var: str, at: int) -> None:
+        self._emit(line,
+                   f"owned batch '{var}' ({self.fname}()) leaks on the "
+                   f"exception path: the work at line {at} can raise "
+                   "while it is owned, and no with-block or try handler/"
+                   "finally covering it closes the batch "
+                   "(wrap_spillables/try-finally is the idiom)",
+                   f"exc-leak:{var}")
+
+    def no_owner(self, node: ast.AST, via: Optional[str] = None) -> None:
+        n = self._no_owner_n
+        self._no_owner_n += 1
+        how = (f"passed to '{via}' which only borrows it" if via
+               else "discarded without a binding")
+        self._emit(node.lineno,
+                   f"owning construction in {self.fname}() is {how} — "
+                   "nobody holds the close obligation "
+                   "(escape-without-owner)",
+                   f"no-owner:{n}")
+
+
+class OwnershipRule(ProjectRule):
+    name = "ownership"
+    contract = ("flow-sensitive batch lifetime on an owned/borrowed/"
+                "moved/closed lattice, interprocedural through callgraph "
+                "summaries: no leak (incl. exception paths), no "
+                "use-after-move, no double-close, no owner-less escape — "
+                "mem/spillable.py + mem/retry.py contracts")
+
+    def check_project(self, ctxs: Sequence[FileContext],
+                      root: str) -> Iterable[Finding]:
+        try:
+            cg = get_callgraph(ctxs)
+        except Exception as e:   # degrade, never crash the whole run
+            return [Finding("tool-error", "spark_rapids_tpu/tools/lint",
+                            0, f"callgraph build failed: {e!r}")]
+        out: List[Finding] = []
+        for ctx in ctxs:
+            if ctx.tree is None:
+                continue
+            if not any(tok in ctx.source for tok in _TRIGGER_TOKENS):
+                continue
+            for fn, cls in functions_with_class(ctx.tree):
+                try:
+                    out.extend(self._check_function(ctx, fn, cls, cg))
+                except RecursionError:
+                    out.append(Finding(
+                        "tool-error", ctx.rel, fn.lineno,
+                        f"ownership analysis blew the stack in "
+                        f"{fn.name}()"))
+        return out
+
+    def _check_function(self, ctx: FileContext, fn, cls, cg) -> \
+            List[Finding]:
+        ana = _Analysis(ctx, fn, cls, cg)
+        report = _Report(self, ctx, fn.name)
+        exc_candidates: Dict[str, Tuple[int, int]] = {}
+        for b in ana.cfg.blocks:
+            env = dict(ana.block_in[b.id])
+            for elem in b.elems:
+                node = getattr(elem, "node", elem)
+                # use-after-move: a read whose every reaching state is
+                # a moved one
+                for e in element_exprs(elem):
+                    for sub in _walk_no_nested(e):
+                        if isinstance(sub, ast.Name) and \
+                                isinstance(sub.ctx, ast.Load) and \
+                                sub.id in env and _all_moved(env[sub.id]):
+                            moved_line = min(t[1] for t in env[sub.id])
+                            report.use_after_move(sub, sub.id,
+                                                  moved_line)
+                # exc-leak candidates: fallible element while owned
+                before = [v for v, s in env.items()
+                          if v in ana.origins and _OWNED in s]
+                if before and isinstance(node, ast.stmt):
+                    may_raise = isinstance(elem, ast.Raise) or any(
+                        isinstance(s, ast.Call) and _fallible_call(s)
+                        for e in element_exprs(elem)
+                        for s in _walk_no_nested(e))
+                    if may_raise:
+                        after = dict(env)
+                        ana.transfer(elem, after)
+                        for v in before:
+                            if _OWNED in after.get(v, EMPTY) and \
+                                    v not in exc_candidates and \
+                                    not self._protected(ana, node, v):
+                                exc_candidates[v] = (ana.origins[v],
+                                                     node.lineno)
+                ana.transfer(elem, env, report)
+        # leaks: owned at exit wins over the exception-path refinement
+        exit_env = ana.block_in[ana.cfg.exit.id]
+        for v, line in ana.origins.items():
+            if v in ana.alias_closed:
+                continue
+            if _OWNED in exit_env.get(v, EMPTY):
+                report.leak(line, v)
+            elif v in exc_candidates:
+                origin, at = exc_candidates[v]
+                report.exc_leak(origin, v, at)
+        return report.findings
+
+    @staticmethod
+    def _protected(ana: "_Analysis", stmt: ast.stmt, var: str) -> bool:
+        for t in ana.protection.get(id(stmt), ()):
+            cleanup: List[ast.AST] = list(t.finalbody)
+            for h in t.handlers:
+                cleanup.extend(h.body)
+            if _mentions(cleanup, var):
+                return True
+        return False

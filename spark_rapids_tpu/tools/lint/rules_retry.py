@@ -18,6 +18,14 @@ externally-visible state change made by a partial attempt happens twice
 Cleanup inside ``except``/``finally`` handlers is exempt: undoing a
 failed attempt's own partial output (the joins/_subpartitioned idiom)
 is exactly how a closure STAYS idempotent.
+
+Calls that pass a ``retryable=`` CheckpointRestore are exempt from the
+STATE-mutation findings (stores and mutator calls): the ladder restores
+the checkpointed object before every re-attempt, so those mutations
+replay from a clean snapshot (the retry-purity rule owns the inverse
+contract — mutation WITHOUT a checkpoint).  ``next()`` on a captured
+iterator and ``close()`` of a captured batch stay flagged even then: a
+checkpoint cannot rewind an iterator or resurrect a closed handle.
 """
 from __future__ import annotations
 
@@ -37,6 +45,17 @@ RETRY_ENTRY_POINTS = {"with_retry_no_split": 0, "with_retry": 1}
 _MUTATORS = {"append", "extend", "insert", "add", "update", "pop",
              "popitem", "remove", "discard", "clear", "setdefault",
              "appendleft", "extendleft", "write"}
+
+
+def has_retryable(call: ast.Call) -> bool:
+    """True when the retry entry point is passed a non-None
+    ``retryable=`` (a CheckpointRestore the ladder restores before
+    every re-attempt); an explicit ``retryable=None`` does not count."""
+    for kw in call.keywords:
+        if kw.arg == "retryable":
+            return not (isinstance(kw.value, ast.Constant)
+                        and kw.value.value is None)
+    return False
 
 
 def _closure_arg(call: ast.Call) -> Optional[ast.AST]:
@@ -79,11 +98,12 @@ class RetryIdempotenceRule(FileRule):
                     closure = find_local_funcdef(scope, arg.id)
                 if closure is None:
                     continue   # non-local callable: out of reach for AST
-                findings.extend(self._check_closure(ctx, closure))
+                findings.extend(self._check_closure(
+                    ctx, closure, checkpointed=has_retryable(node)))
         return findings
 
-    def _check_closure(self, ctx: FileContext,
-                       closure: FuncNode) -> List[Finding]:
+    def _check_closure(self, ctx: FileContext, closure: FuncNode,
+                       checkpointed: bool = False) -> List[Finding]:
         locals_: Set[str] = local_names(closure)
         declared_outer: Set[str] = set()
         for node in walk_scope(closure):
@@ -115,7 +135,7 @@ class RetryIdempotenceRule(FileRule):
                              f"rebind:{t.id}")
                     elif isinstance(t, (ast.Subscript, ast.Attribute)):
                         base = base_name(t)
-                        if captured(base):
+                        if captured(base) and not checkpointed:
                             kind = ("element" if isinstance(t, ast.Subscript)
                                     else "attribute")
                             emit(node, f"writes an {kind} of captured "
@@ -137,7 +157,8 @@ class RetryIdempotenceRule(FileRule):
                                    "(a retry would reuse a closed input)",
                              f"close:{base}")
                     elif meth in _MUTATORS and captured(base) \
-                            and isinstance(node.func.value, ast.Name):
+                            and isinstance(node.func.value, ast.Name) \
+                            and not checkpointed:
                         emit(node, f"mutates captured '{base}' via "
                                    f".{meth}() (replayed on retry)",
                              f"mutate:{base}.{meth}")

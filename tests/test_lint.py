@@ -10,12 +10,14 @@ import textwrap
 
 import pytest
 
-from spark_rapids_tpu.tools.lint import (ALL_RULES, BatchLifetimeRule,
-                                         ConfigKeyDriftRule,
-                                         HostSyncFlowRule, HostSyncRule,
-                                         LockDisciplineRule,
-                                         OpsDocDriftRule, RetraceRiskRule,
-                                         RetryIdempotenceRule, lint_source)
+from spark_rapids_tpu.tools.lint import (ALL_RULES, ConfigKeyDriftRule,
+                                         GrantPairingRule,
+                                         HostSyncFlowRule,
+                                         LockDisciplineRule, NeverRaiseRule,
+                                         OpsDocDriftRule, OwnershipRule,
+                                         RetraceRiskRule,
+                                         RetryIdempotenceRule,
+                                         RetryPurityRule, lint_source)
 from spark_rapids_tpu.tools.lint.framework import (FileContext, Finding,
                                                    load_baseline,
                                                    prune_baseline, run_lint,
@@ -132,9 +134,9 @@ class TestRetryIdempotence:
         assert fs == []
 
 
-# ================================================================== lifetime
-class TestBatchLifetime:
-    RULE = BatchLifetimeRule()
+# ================================================================= ownership
+class TestOwnership:
+    RULE = OwnershipRule()
 
     def test_never_closed_leaks(self):
         fs = _lint("""
@@ -142,7 +144,7 @@ class TestBatchLifetime:
                 sb = SpillableBatch(batch, ctx.memory)
                 return transform(batch)
             """, self.RULE)
-        assert _rules(fs) == ["batch-lifetime"]
+        assert _rules(fs) == ["ownership"]
         assert "never closed" in fs[0].message
 
     def test_close_after_fallible_work_flags_exception_path(self):
@@ -210,12 +212,108 @@ class TestBatchLifetime:
                 xs = [SpillableBatch(b, ctx.memory) for b in batches]
                 metric.add(sum(s.bytes() for s in xs))
             """, self.RULE)
-        assert _rules(fs) == ["batch-lifetime"]
+        assert _rules(fs) == ["ownership"]
+
+    def test_use_after_move(self):
+        # split_batch_in_half consumed the input: touching it afterwards
+        # reads a closed (or otherwise-owned) handle
+        fs = _lint("""
+            def f(ctx, sb):
+                left, right = split_batch_in_half(sb, ctx.memory)
+                n = sb.num_rows()
+                return left, right, n
+            """, self.RULE)
+        assert any("used after its ownership moved" in f.message
+                   for f in fs)
+
+    def test_double_close(self):
+        fs = _lint("""
+            def f(ctx, batch):
+                sb = SpillableBatch(batch, ctx.memory)
+                sb.close()
+                sb.close()
+            """, self.RULE)
+        assert any("already closed on every path" in f.message
+                   for f in fs)
+
+    def test_close_in_loop_body_not_double_close(self):
+        # the loop back edge re-enters the SAME close: provenance-tagged
+        # closed states keep this from reading as a second close
+        fs = _lint("""
+            def f(ctx, batches):
+                for b in batches:
+                    sb = SpillableBatch(b, ctx.memory)
+                    sb.close()
+            """, self.RULE)
+        assert fs == []
+
+    def test_resolved_borrowing_callee_keeps_obligation(self):
+        # interprocedural sharpening vs the retired pattern rule: a
+        # RESOLVED project callee that only borrows does NOT discharge
+        # the close obligation
+        fs = _lint("""
+            def _count(sb):
+                return sb.num_rows()
+
+            def f(ctx, batch):
+                sb = SpillableBatch(batch, ctx.memory)
+                n = _count(sb)
+                return n
+            """, self.RULE)
+        assert any("'sb'" in f.message and f.rule == "ownership"
+                   for f in fs)
+
+    def test_discarded_construction_has_no_owner(self):
+        fs = _lint("""
+            def f(ctx, batch):
+                SpillableBatch(batch, ctx.memory)
+            """, self.RULE)
+        assert any("escape-without-owner" in f.message for f in fs)
+
+    def test_construction_passed_to_borrowing_callee_no_owner(self):
+        fs = _lint("""
+            def _count(sb):
+                return sb.num_rows()
+
+            def f(ctx, batch):
+                return _count(SpillableBatch(batch, ctx.memory))
+            """, self.RULE)
+        assert any("only borrows it" in f.message for f in fs)
+
+    def test_transfer_through_consuming_helper_clean(self):
+        # a resolved callee that CLOSES its parameter discharges it
+        fs = _lint("""
+            def _finish(sb):
+                sb.close()
+                return 1
+
+            def f(ctx, batch):
+                sb = SpillableBatch(batch, ctx.memory)
+                return _finish(sb)
+            """, self.RULE)
+        assert fs == []
+
+    def test_double_close_through_helper_summary(self):
+        fs = _lint("""
+            def _finish(sb):
+                sb.close()
+                return 1
+
+            def f(ctx, batch):
+                sb = SpillableBatch(batch, ctx.memory)
+                _finish(sb)
+                sb.close()
+            """, self.RULE)
+        assert any("already closed on every path" in f.message
+                   for f in fs)
 
 
-# ================================================================= host-sync
-class TestHostSync:
-    RULE = HostSyncRule()
+# ================================================= host-sync (direct shapes)
+class TestHostSyncDirect:
+    """The no-flow-analysis sync shapes the retired ``host-sync``
+    pattern rule carried, now folded into host-sync-flow (one host-sync
+    rule surface)."""
+    RULE = HostSyncFlowRule()
 
     def test_np_asarray_in_eval_device(self):
         fs = _lint("""
@@ -224,7 +322,8 @@ class TestHostSync:
                     x = ctx.column(0)
                     return np.asarray(x.data)
             """, self.RULE)
-        assert _rules(fs) == ["host-sync"]
+        assert _rules(fs) == ["host-sync-flow"]
+        assert any("np.asarray" in f.message for f in fs)
 
     def test_item_in_jit_kernel(self):
         fs = _lint("""
@@ -235,22 +334,15 @@ class TestHostSync:
             """, self.RULE)
         assert any(".item()" in f.message for f in fs)
 
-    def test_scalar_conversion_is_flow_rules_job_now(self):
-        # the pattern rule retired its float()-of-device-hint heuristic:
-        # host-sync-flow tracks the actual value flow instead
+    def test_scalar_conversion_is_the_flow_layer(self):
+        # the float()-of-device-hint heuristic stays retired: the flow
+        # analysis tracks the actual value instead
         fs = _lint("""
             class Op:
                 def eval_device(self, ctx):
                     lo = float(ctx.scalar(0))
                     return jnp.clip(ctx.column(1).data, lo, None)
             """, self.RULE)
-        assert fs == []
-        fs = _lint("""
-            class Op:
-                def eval_device(self, ctx):
-                    lo = float(ctx.scalar(0))
-                    return jnp.clip(ctx.column(1).data, lo, None)
-            """, HostSyncFlowRule())
         assert any("float() conversion" in f.message for f in fs)
 
     def test_clean_pure_jnp_eval_device(self):
@@ -267,6 +359,229 @@ class TestHostSync:
         fs = _lint("""
             def to_pandas(batch):
                 return np.asarray(batch.data)
+            """, self.RULE)
+        assert fs == []
+
+
+# =============================================================== retry-purity
+class TestRetryPurity:
+    RULE = RetryPurityRule()
+
+    def test_compounding_self_store(self):
+        fs = _lint("""
+            class Agg:
+                def run(self, mm, sb):
+                    def attempt():
+                        out = transform(sb)
+                        self.count = self.count + 1
+                        return out
+                    return with_retry_no_split(attempt, mm)
+            """, self.RULE)
+        assert _rules(fs) == ["retry-purity"]
+        assert any("compounds captured object" in f.message for f in fs)
+
+    def test_mutator_on_self_attribute(self):
+        fs = _lint("""
+            class Agg:
+                def run(self, mm):
+                    def attempt():
+                        self._parts.append(make_batch())
+                        return self._parts
+                    return with_retry_no_split(attempt, mm)
+            """, self.RULE)
+        assert any(".append()" in f.message for f in fs)
+
+    def test_helper_mutation_caught_through_summary(self):
+        # the closure looks pure; the helper's callgraph summary says it
+        # mutates its receiver
+        fs = _lint("""
+            class Agg:
+                def _accumulate(self, x):
+                    self._total += x
+
+                def run(self, mm, xs):
+                    def attempt():
+                        for x in xs:
+                            self._accumulate(x)
+                        return self._total
+                    return with_retry_no_split(attempt, mm)
+            """, self.RULE)
+        assert any("helper '_accumulate'" in f.message for f in fs)
+
+    def test_checkpointed_attempt_exempt(self):
+        # a CheckpointRestore passed as retryable= restores the state
+        # before every re-attempt: the mutation replays from a snapshot
+        fs = _lint("""
+            class Agg:
+                def run(self, mm, ck):
+                    def attempt():
+                        self._parts.append(make_batch())
+                        return self._parts
+                    return with_retry_no_split(attempt, mm, retryable=ck)
+            """, self.RULE)
+        assert fs == []
+
+    def test_explicit_retryable_none_does_not_exempt(self):
+        fs = _lint("""
+            class Agg:
+                def run(self, mm):
+                    def attempt():
+                        self._parts.append(make_batch())
+                        return self._parts
+                    return with_retry_no_split(attempt, mm,
+                                               retryable=None)
+            """, self.RULE)
+        assert _rules(fs) == ["retry-purity"]
+
+    def test_idempotent_cache_fill_clean(self):
+        # an overwrite (not a compounding store) replays safely
+        fs = _lint("""
+            class Agg:
+                def run(self, mm):
+                    def attempt():
+                        self._fast_k = compute_k()
+                        return self._fast_k
+                    return with_retry_no_split(attempt, mm)
+            """, self.RULE)
+        assert fs == []
+
+
+# ================================================================ never-raise
+class TestNeverRaise:
+    RULE = NeverRaiseRule()
+
+    def test_unprotected_fallible_call(self):
+        fs = _lint("""
+            # tpulint: never-raise
+            def persist(doc, path):
+                with open(path, "w") as f:
+                    json.dump(doc, f)
+            """, self.RULE)
+        assert fs and all(f.rule == "never-raise" for f in fs)
+        assert any("json.dump" in f.message for f in fs)
+
+    def test_catch_all_protection_clean(self):
+        fs = _lint("""
+            # tpulint: never-raise
+            def persist(doc, path):
+                try:
+                    with open(path, "w") as f:
+                        json.dump(doc, f)
+                except Exception as e:
+                    log.warning("persist failed: %s", e)
+            """, self.RULE)
+        assert fs == []
+
+    def test_narrow_catch_is_not_protection(self):
+        # the sentinel.save() defect this rule found: except OSError
+        # lets json.dump's TypeError (non-JSON value) escape
+        fs = _lint("""
+            # tpulint: never-raise
+            def persist(doc, path):
+                try:
+                    with open(path, "w") as f:
+                        json.dump(doc, f)
+                except OSError as e:
+                    log.warning("persist failed: %s", e)
+            """, self.RULE)
+        assert fs and all(f.rule == "never-raise" for f in fs)
+
+    def test_raise_flagged(self):
+        fs = _lint("""
+            def check(kind):  # tpulint: never-raise
+                if kind not in KINDS:
+                    raise ValueError(kind)
+                return KINDS[kind]
+            """, self.RULE)
+        assert fs and all("check" in f.message for f in fs)
+
+    def test_deliberate_raise_suppressible(self):
+        # the ops/flight.py idiom: an unregistered kind is a
+        # programming error and must stay loud, with a justification
+        fs = _lint("""
+            def check(kind):  # tpulint: never-raise
+                if kind not in KINDS:
+                    # tpulint: disable=never-raise — taxonomy bug
+                    raise ValueError(kind)
+                return KINDS[kind]
+            """, self.RULE)
+        assert fs == []
+
+    def test_transitive_project_callee(self):
+        # the marked function itself is clean; the helper it calls may
+        # escape, and the callgraph summary carries that through
+        fs = _lint("""
+            def _flush(path, doc):
+                with open(path, "w") as f:
+                    f.write(doc)
+
+            # tpulint: never-raise
+            def persist(doc, path):
+                _flush(path, doc)
+            """, self.RULE)
+        assert any("_flush" in f.message for f in fs)
+
+    def test_unmarked_function_out_of_scope(self):
+        fs = _lint("""
+            def persist(doc, path):
+                with open(path, "w") as f:
+                    json.dump(doc, f)
+            """, self.RULE)
+        assert fs == []
+
+
+# ============================================================== grant-pairing
+class TestGrantPairing:
+    RULE = GrantPairingRule()
+
+    def test_bare_grant_call_flagged(self):
+        fs = _lint("""
+            def f(mm, n):
+                pressure_host_grant(mm, n)
+                return do_work()
+            """, self.RULE)
+        assert any("with-statement" in f.message for f in fs)
+
+    def test_with_grant_clean(self):
+        fs = _lint("""
+            def f(mm, n):
+                with pressure_host_grant(mm, n):
+                    return do_work()
+            """, self.RULE)
+        assert fs == []
+
+    def test_unpaired_reserve_flagged(self):
+        # the early return skips the release: accounting leaks
+        fs = _lint("""
+            def f(mm, n):
+                mm.reserve_granted(n)
+                out = do_work()
+                if out is None:
+                    return None
+                mm.release_granted(n)
+                return out
+            """, self.RULE)
+        assert any("no symmetric" in f.message for f in fs)
+
+    def test_try_finally_release_clean(self):
+        fs = _lint("""
+            def f(mm, n):
+                mm.reserve_granted(n)
+                try:
+                    return do_work()
+                finally:
+                    mm.release_granted(n)
+            """, self.RULE)
+        assert fs == []
+
+    def test_granted_flag_store_clean(self):
+        # the mem/spillable.py discipline: the grant obligation is
+        # recorded in a _granted-style attribute and released elsewhere
+        fs = _lint("""
+            class Holder:
+                def take(self, mm, n):
+                    mm.reserve_granted(n)
+                    self._granted = n
             """, self.RULE)
         assert fs == []
 
@@ -356,31 +671,31 @@ class TestSuppression:
         src = VIOLATING.replace(
             "sb = SpillableBatch(batch, ctx.memory)",
             "sb = SpillableBatch(batch, ctx.memory)"
-            "  # tpulint: disable=batch-lifetime")
-        assert lint_source(src, [BatchLifetimeRule()]) == []
+            "  # tpulint: disable=ownership")
+        assert lint_source(src, [OwnershipRule()]) == []
 
     def test_standalone_comment_disables_next_code_line(self):
         src = VIOLATING.replace(
             "    sb = SpillableBatch",
-            "    # tpulint: disable=batch-lifetime\n    sb = SpillableBatch")
-        assert lint_source(src, [BatchLifetimeRule()]) == []
+            "    # tpulint: disable=ownership\n    sb = SpillableBatch")
+        assert lint_source(src, [OwnershipRule()]) == []
 
     def test_standalone_comment_skips_blank_lines(self):
         src = VIOLATING.replace(
             "    sb = SpillableBatch",
-            "    # tpulint: disable=batch-lifetime\n\n    sb = SpillableBatch")
-        assert lint_source(src, [BatchLifetimeRule()]) == []
+            "    # tpulint: disable=ownership\n\n    sb = SpillableBatch")
+        assert lint_source(src, [OwnershipRule()]) == []
 
     def test_file_level_disable(self):
-        src = "# tpulint: disable-file=batch-lifetime\n" + VIOLATING
-        assert lint_source(src, [BatchLifetimeRule()]) == []
+        src = "# tpulint: disable-file=ownership\n" + VIOLATING
+        assert lint_source(src, [OwnershipRule()]) == []
 
     def test_other_rule_disable_does_not_suppress(self):
         src = VIOLATING.replace(
             "sb = SpillableBatch(batch, ctx.memory)",
             "sb = SpillableBatch(batch, ctx.memory)"
-            "  # tpulint: disable=host-sync")
-        assert len(lint_source(src, [BatchLifetimeRule()])) == 1
+            "  # tpulint: disable=retry-idempotence")
+        assert len(lint_source(src, [OwnershipRule()])) == 1
 
 
 # ================================================================== baseline
@@ -392,7 +707,7 @@ class TestBaseline:
 
     def test_baselined_finding_does_not_fail(self, tmp_path):
         p = self._write_violation(tmp_path)
-        rules = [BatchLifetimeRule()]
+        rules = [OwnershipRule()]
         first = run_lint([str(p)], rules=rules, root=str(tmp_path))
         assert len(first.new) == 1
         bl_path = str(tmp_path / "baseline.json")
@@ -407,7 +722,7 @@ class TestBaseline:
         # fingerprints carry no line numbers: shifting the finding down
         # by adding code above it must not resurface it
         p = self._write_violation(tmp_path)
-        rules = [BatchLifetimeRule()]
+        rules = [OwnershipRule()]
         bl_path = str(tmp_path / "baseline.json")
         write_baseline(run_lint([str(p)], rules=rules,
                                 root=str(tmp_path)).new, bl_path)
@@ -418,7 +733,7 @@ class TestBaseline:
 
     def test_new_finding_beyond_baseline_fails(self, tmp_path):
         p = self._write_violation(tmp_path)
-        rules = [BatchLifetimeRule()]
+        rules = [OwnershipRule()]
         bl_path = str(tmp_path / "baseline.json")
         write_baseline(run_lint([str(p)], rules=rules,
                                 root=str(tmp_path)).new, bl_path)
@@ -449,11 +764,21 @@ class TestCli:
                         results.append(make_batch())
                     return with_retry_no_split(attempt, mm)
                 """,
-            "batch-lifetime": VIOLATING,
-            "host-sync": """
+            "ownership": VIOLATING,
+            "host-sync-flow": """
                 class Op:
                     def eval_device(self, ctx):
                         return np.asarray(ctx.column(0).data)
+                """,
+            "grant-pairing": """
+                def f(mm, n):
+                    pressure_host_grant(mm, n)
+                """,
+            "never-raise": """
+                # tpulint: never-raise
+                def persist(doc, path):
+                    with open(path, "w") as f:
+                        json.dump(doc, f)
                 """,
         }
         for rule, src in fixtures.items():
@@ -486,6 +811,43 @@ class TestCli:
         out = capsys.readouterr().out
         for rule in ALL_RULES:
             assert rule.name in out
+
+    def test_changed_sarif_gate(self):
+        """Tier-1 gate for the pre-commit fast path: --changed
+        --format=sarif over the live repo exits 0 and emits parseable
+        SARIF (empty run or all-suppressed on a clean tree)."""
+        import json as _json
+        import subprocess
+        import sys
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        proc = subprocess.run(
+            [sys.executable, "-m", "spark_rapids_tpu.tools.lint",
+             "--changed", "--format=sarif"],
+            cwd=repo, capture_output=True, text=True, timeout=600)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        doc = _json.loads(proc.stdout)
+        assert doc["version"] == "2.1.0"
+        for res in doc["runs"][0]["results"]:
+            assert res.get("suppressions"), res
+
+    def test_baseline_rewrite_refused_on_tool_error(self, tmp_path,
+                                                    monkeypatch, capsys):
+        """--update-baseline/--prune-baseline must refuse when the
+        analysis itself failed (a broken callgraph under-reports — a
+        rewrite would silently shrink the baseline)."""
+        from spark_rapids_tpu.tools.lint import __main__ as cli
+        from spark_rapids_tpu.tools.lint.framework import LintResult
+        res = LintResult()
+        res.findings.append(Finding(
+            "tool-error", "spark_rapids_tpu/tools/lint", 0,
+            "callgraph build failed: RecursionError()"))
+        monkeypatch.setattr(cli, "run_lint", lambda *a, **k: res)
+        bl = tmp_path / "bl.json"
+        for flag in ("--update-baseline", "--prune-baseline"):
+            rc = cli.main([flag, "--baseline", str(bl)])
+            assert rc == 2
+            assert not bl.exists()
+            assert "refusing" in capsys.readouterr().err
 
 
 # ============================================================ host-sync-flow
@@ -1158,7 +1520,7 @@ class TestFormatsAndBaseline:
     def _result(self, tmp_path):
         p = tmp_path / "mod.py"
         p.write_text(textwrap.dedent(VIOLATING))
-        return run_lint([str(p)], rules=[BatchLifetimeRule()],
+        return run_lint([str(p)], rules=[OwnershipRule()],
                         root=str(tmp_path))
 
     def test_json_deterministic_and_counted(self, tmp_path):
@@ -1171,14 +1533,14 @@ class TestFormatsAndBaseline:
         assert doc["version"] == 1
         assert doc["counts"]["new"] == len(res.new) == 1
         f = doc["findings"][0]
-        assert f["status"] == "new" and f["rule"] == "batch-lifetime"
-        assert f["fingerprint"].startswith("batch-lifetime::")
+        assert f["status"] == "new" and f["rule"] == "ownership"
+        assert f["fingerprint"].startswith("ownership::")
 
     def test_sarif_minimal_schema_and_determinism(self, tmp_path):
         import json as _json
         from spark_rapids_tpu.tools.lint.formats import render_sarif
         res = self._result(tmp_path)
-        rules = [BatchLifetimeRule()]
+        rules = [OwnershipRule()]
         one, two = render_sarif(res, rules), render_sarif(res, rules)
         assert one == two
         doc = _json.loads(one)
@@ -1186,7 +1548,7 @@ class TestFormatsAndBaseline:
         run = doc["runs"][0]
         assert run["tool"]["driver"]["name"] == "tpulint"
         ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
-        assert "batch-lifetime" in ids
+        assert "ownership" in ids
         res0 = run["results"][0]
         assert res0["message"]["text"]
         loc = res0["locations"][0]["physicalLocation"]
@@ -1200,12 +1562,12 @@ class TestFormatsAndBaseline:
         p = tmp_path / "mod.py"
         p.write_text(textwrap.dedent(VIOLATING))
         bl = str(tmp_path / "bl.json")
-        first = run_lint([str(p)], rules=[BatchLifetimeRule()],
+        first = run_lint([str(p)], rules=[OwnershipRule()],
                          root=str(tmp_path))
         write_baseline(first.new, bl)
-        res = run_lint([str(p)], rules=[BatchLifetimeRule()],
+        res = run_lint([str(p)], rules=[OwnershipRule()],
                        baseline=load_baseline(bl), root=str(tmp_path))
-        doc = _json.loads(render_sarif(res, [BatchLifetimeRule()]))
+        doc = _json.loads(render_sarif(res, [OwnershipRule()]))
         res0 = doc["runs"][0]["results"][0]
         assert res0["suppressions"][0]["kind"] == "external"
 
@@ -1213,12 +1575,12 @@ class TestFormatsAndBaseline:
         p = tmp_path / "mod.py"
         p.write_text(textwrap.dedent(VIOLATING))
         bl = str(tmp_path / "bl.json")
-        first = run_lint([str(p)], rules=[BatchLifetimeRule()],
+        first = run_lint([str(p)], rules=[OwnershipRule()],
                          root=str(tmp_path))
         write_baseline(first.new, bl)
         # fix the violation: the baseline entry goes stale
         p.write_text("def f():\n    return 1\n")
-        cur = run_lint([str(p)], rules=[BatchLifetimeRule()],
+        cur = run_lint([str(p)], rules=[OwnershipRule()],
                        root=str(tmp_path))
         kept, pruned = prune_baseline(cur.findings, bl)
         assert (kept, pruned) == (0, 1)
@@ -1228,10 +1590,10 @@ class TestFormatsAndBaseline:
         p = tmp_path / "mod.py"
         p.write_text(textwrap.dedent(VIOLATING))
         bl = str(tmp_path / "bl.json")
-        first = run_lint([str(p)], rules=[BatchLifetimeRule()],
+        first = run_lint([str(p)], rules=[OwnershipRule()],
                          root=str(tmp_path))
         write_baseline(first.new, bl)
-        cur = run_lint([str(p)], rules=[BatchLifetimeRule()],
+        cur = run_lint([str(p)], rules=[OwnershipRule()],
                        root=str(tmp_path))
         kept, pruned = prune_baseline(cur.findings, bl)
         assert (kept, pruned) == (1, 0)

@@ -39,13 +39,20 @@ def test_repo_is_lint_clean():
 
 
 def test_dataflow_rules_registered():
-    """The tpulint v2 dataflow rules ship in ALL_RULES (so the clean-tree
-    gate above transitively enforces lock discipline, host-sync flow and
-    retrace risk on every pytest run) and carry contracts for
-    --list-rules."""
+    """The tpulint v2 dataflow rules and the v3 callgraph-backed rules
+    ship in ALL_RULES (so the clean-tree gate above transitively
+    enforces lock discipline, host-sync flow, retrace risk, batch
+    ownership and the PR-14/15 contracts on every pytest run) and carry
+    contracts for --list-rules."""
     names = {r.name for r in ALL_RULES}
-    for rule in ("lock-discipline", "host-sync-flow", "retrace-risk"):
+    for rule in ("lock-discipline", "host-sync-flow", "retrace-risk",
+                 "ownership", "retry-purity", "never-raise",
+                 "grant-pairing"):
         assert rule in names, f"{rule} not registered"
+    # the v1/v2 surfaces these replaced are really gone — one rule
+    # surface per contract (no double reporting)
+    for retired in ("batch-lifetime", "host-sync"):
+        assert retired not in names, f"{retired} should be retired"
     for r in ALL_RULES:
         assert r.contract, f"{r.name} has no contract line"
 

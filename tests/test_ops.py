@@ -155,6 +155,25 @@ def test_metrics_endpoint_without_registry():
     assert status == 200 and "no metric registry" in body
 
 
+def test_ops_endpoint_survives_broken_registry(monkeypatch):
+    """never-raise regression (tpulint v3 crop): the request-counter
+    fan-out sits INSIDE do_GET's guarded body — a raising registry
+    degrades to a 500 JSON error instead of escaping into
+    socketserver's handle_error (stderr traceback + dropped
+    connection)."""
+    from spark_rapids_tpu.metrics import registry as metrics_registry
+
+    class _Boom:
+        def counter(self, *a, **k):
+            raise RuntimeError("registry exploded")
+
+    srv = _start_server()
+    monkeypatch.setattr(metrics_registry, "REGISTRY", _Boom())
+    status, body = _get_any(srv.port, "/metrics")
+    assert status == 500
+    assert "registry exploded" in json.loads(body)["error"]
+
+
 # ---------------------------------------------------------------------------
 # /healthz + /queries
 # ---------------------------------------------------------------------------
@@ -398,6 +417,47 @@ def test_sentinel_cold_run_never_flags(tmp_path):
     regs = fold_record(baselines, {"digest": "d", "wallMs": 900.0,
                                    "verdict": "device", "ok": False})
     assert regs == []
+
+
+def test_sentinel_save_tolerates_unserializable_baseline(tmp_path):
+    """never-raise regression (tpulint v3 crop): a baseline record that
+    picked up a non-JSON value (a numpy scalar riding in through a
+    folded query record makes json.dump raise TypeError, not OSError)
+    must degrade to an unsaved baseline, not raise out of the
+    query-completion path that called fold()."""
+    from spark_rapids_tpu.ops.sentinel import RegressionSentinel
+    path = str(tmp_path / "b.json")
+    sen = RegressionSentinel(path)
+    with sen._lock:
+        sen._baselines["d"] = {"walls": [], "poison": object()}
+    assert sen.save() is False
+    assert not os.path.exists(path)
+    # the failed attempt's tmp file is cleaned up too
+    assert [n for n in os.listdir(str(tmp_path))
+            if n.startswith("b.json.tmp")] == []
+
+
+def test_sentinel_fold_fanout_never_raises(tmp_path, monkeypatch):
+    """never-raise regression (tpulint v3 crop): the flag fan-out
+    (metrics counter + flight trigger + json.dumps of the flag record)
+    is fallible; a raising recorder must not escape fold() — the regs
+    still come back and the query completes."""
+    from spark_rapids_tpu.ops import flight as fl_mod
+    from spark_rapids_tpu.ops.sentinel import RegressionSentinel
+
+    class _BoomRecorder:
+        def trigger(self, kind, detail=None):
+            raise RuntimeError("recorder exploded")
+
+    monkeypatch.setattr(fl_mod, "RECORDER", _BoomRecorder())
+    sen = RegressionSentinel(str(tmp_path / "b.json"), wall_factor=3.0,
+                             min_samples=3)
+    for ms in (100.0, 101.0, 99.0):
+        assert sen.fold({"digest": "d", "wallMs": ms,
+                         "verdict": "device", "ok": True}) == []
+    regs = sen.fold({"digest": "d", "wallMs": 900.0,
+                     "verdict": "device", "ok": True})
+    assert [r["kind"] for r in regs] == ["warm_slowdown"]
 
 
 def test_sentinel_live_fold_from_queries(tmp_path):
