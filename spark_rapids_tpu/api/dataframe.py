@@ -76,6 +76,11 @@ class TpuSession:
         self.event_log = EventLogWriter.from_conf(self.conf)
         import itertools as _it
         self._query_seq = _it.count(1)
+        #: tenant id this session's queries run as — the admission
+        #: controller's priority/fairness unit and the memory manager's
+        #: quota unit (sched/admission.py; empty conf = anonymous None)
+        from ..sched.admission import TENANT_ID
+        self.tenant = str(self.conf.get(TENANT_ID)) or None
         #: fault_stats of the last LocalCluster.execute on this session
         #: (the event log's queryEnd picks it up)
         self.last_fault_stats = None
@@ -166,6 +171,8 @@ class TpuSession:
         self.profiler = Profiler(self.conf)
         from ..metrics.events import EventLogWriter
         self.event_log = EventLogWriter.from_conf(self.conf)
+        from ..sched.admission import TENANT_ID
+        self.tenant = str(self.conf.get(TENANT_ID)) or None
         return self
 
     def exec_context(self) -> ExecContext:
@@ -774,7 +781,8 @@ class DataFrame:
         if tracker is not None:
             track_tok = tracker.begin(
                 qid, digest, (placement_summary or {}).get("verdict"),
-                root=type(self.plan).__name__)
+                root=type(self.plan).__name__,
+                tenant=self.session.tenant)
         if frec is not None:
             # anomaly dumps fired from THIS thread (semaphore wedge, OOM
             # ladder) carry the in-flight query's digest + coded report
@@ -836,10 +844,49 @@ class DataFrame:
                            f"(digest {digest or '?'}) cancelled by "
                            "spark.rapids.tpu.query.timeout")
 
+        # ------------- multi-tenant admission front door (ISSUE 18) ----
+        # one module-global load + branch when admission is off; with a
+        # controller installed the query queues HERE — before any device
+        # work — so an overloaded or pressure-degraded process refuses
+        # work with a structured AdmissionRejected (retry-after hint)
+        # instead of piling onto the semaphore
+        from ..sched import admission as adm_mod
+        adm = adm_mod.CONTROLLER
+        adm_ticket = None
+        queued_ms = None
+        admission_status = None
+        tenant = self.session.tenant
+        if tenant is not None:
+            # per-tenant HBM quota attribution for every buffer this
+            # query retains (mem/manager.py census; cleared in finally)
+            from ..sched.admission import TENANT_HBM_SHARE
+            share = float(self.session.conf.get(TENANT_HBM_SHARE))
+            ctx.memory.set_thread_tenant(
+                tenant, int(share * ctx.memory.budget)
+                if share > 0 else 0)
         t0 = _time.perf_counter()
         ok = False
         fail_reason = None
         try:
+            if adm is not None:
+                if tracker is not None and track_tok is not None:
+                    tracker.admission(track_tok, "queued")
+                from ..sched.admission import TENANT_PRIORITY
+                try:
+                    adm_ticket = adm.admit(
+                        tenant=tenant,
+                        priority=int(
+                            self.session.conf.get(TENANT_PRIORITY)),
+                        deadline=ctx.deadline)
+                except adm_mod.AdmissionRejected:
+                    admission_status = "shed"
+                    if tracker is not None and track_tok is not None:
+                        tracker.admission(track_tok, "shed")
+                    raise
+                admission_status = "admitted"
+                queued_ms = adm_ticket.queued_ms
+                if tracker is not None and track_tok is not None:
+                    tracker.admission(track_tok, "admitted", queued_ms)
             try:
                 out = _attempt(physical)
                 ok = True
@@ -871,6 +918,10 @@ class DataFrame:
             fail_reason = f"{type(e).__name__}: {e}"
             raise
         finally:
+            if adm_ticket is not None:
+                adm.release(adm_ticket)   # idempotent; never raises
+            if tenant is not None:
+                ctx.memory.set_thread_tenant(None)
             ctx.set_query_deadline(None)
             degs = ctx.take_oom_degradations()
             ladder_rung = ctx.take_ladder_rung()
@@ -948,6 +999,11 @@ class DataFrame:
                            # tools/history read these four directly
                            "degraded": bool(degs),
                            "ladderRung": ladder_rung,
+                           # multi-tenant serving fields (ISSUE 18):
+                           # which tenant ran it and the admission
+                           # wait it paid at the front door
+                           "tenant": tenant,
+                           "queuedMs": queued_ms,
                            "compileSeconds": compile_s_paid,
                            "placementVerdict": (placement_summary
                                                 or {}).get("verdict"),
@@ -957,6 +1013,8 @@ class DataFrame:
                            "trace": trace_path}
                 if reason:
                     end_rec["reason"] = reason
+                if admission_status:
+                    end_rec["admission"] = admission_status
                 if degs:
                     # queryStart already shipped the plan-time summary;
                     # degradations are runtime facts, so the END record

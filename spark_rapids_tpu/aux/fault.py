@@ -54,8 +54,11 @@ CHAOS_SPEC = register(
     "(docs/fault_tolerance.md): mem.oom (MemoryManager.reserve raises "
     "an injected RetryOOM), mem.reserve.delay (reserve sleeps delayMs), "
     "sem.stall (a successful semaphore acquire stalls delayMs while "
-    "HOLDING the permit). The config-driven analog of the OOM "
-    "injection hooks (ref RmmSpark.forceRetryOOM).")
+    "HOLDING the permit). Admission sites (sched/admission.py, "
+    "docs/serving.md): admit.delay (an admission attempt stalls "
+    "delayMs before queueing), admit.reject (an admission attempt is "
+    "refused with AdmissionRejected(reason=chaos)). The config-driven "
+    "analog of the OOM injection hooks (ref RmmSpark.forceRetryOOM).")
 
 CHAOS_SEED = register(
     "spark.rapids.tpu.chaos.seed", 0,
@@ -134,7 +137,10 @@ CHAOS_SITES = ("put.corrupt", "put.drop", "put.delay", "fetch.corrupt",
                "fetch.delay", "task.delay", "worker.kill",
                # memory / semaphore sites (mem/manager.py reserve(),
                # mem/semaphore.py acquire()) — ISSUE 14 pressure battery
-               "mem.oom", "mem.reserve.delay", "sem.stall")
+               "mem.oom", "mem.reserve.delay", "sem.stall",
+               # admission sites (sched/admission.py admit()) — ISSUE 18
+               # mixed-tenant serving battery
+               "admit.delay", "admit.reject")
 
 
 class ChaosController:

@@ -66,6 +66,12 @@ class ExecContext:
         # is three conf lookups and no threads (ops/__init__.py)
         from ..ops import ensure_ops_plane_from_conf
         ensure_ops_plane_from_conf(self.conf)
+        # multi-tenant admission controller (ISSUE 18): installed iff
+        # spark.rapids.tpu.admission.enabled — same one-conf-lookup
+        # install-once pattern; disabled it stays None and each query
+        # pays one module-global load + branch (sched/admission.py)
+        from ..sched.admission import ensure_admission_from_conf
+        ensure_admission_from_conf(self.conf)
         from ..config import SEMAPHORE_WEDGE_TIMEOUT_MS, TASK_TIMEOUT
         self.memory = memory or MemoryManager.get(self.conf)
         self.semaphore = semaphore or DeviceSemaphore(

@@ -99,9 +99,26 @@ class MemoryManager:
         #: (mem/retry.py ladder; SpillableBatch accounts here while a
         #: pressure grant is active on its creating thread)
         self.pressure_granted = 0    # tpulint: guarded-by _lock
+        #: monotonic instant the pressure pool was last seen nonzero —
+        #: the /healthz memory verdict clears once the pool has been
+        #: empty past a short horizon instead of flapping per grant
+        #: (ISSUE 18 satellite); None = never granted
+        self._grant_last_nonzero: Optional[float] = None  # tpulint: guarded-by _lock
         #: per-thread pressure-grant depth (threading.local: no lock —
         #: each thread reads/writes only its own slot)
         self._grant = threading.local()
+        #: tenant the calling thread's reserves run as (threading.local:
+        #: _execute_wrapped sets it per query from
+        #: spark.rapids.tpu.tenant.*)
+        self._tenant = threading.local()
+        #: handle -> owning tenant for registered spillables: tenant
+        #: usage is a CENSUS over live registrations, so a spilled or
+        #: closed buffer leaves its tenant's account by construction —
+        #: cross-tenant leakage is structurally impossible
+        self._spillable_tenant: Dict[int, str] = {}  # tpulint: guarded-by _lock
+        #: last-declared quota per tenant (bytes; telemetry only — the
+        #: enforcing quota is the calling thread's own)
+        self._tenant_quota: Dict[str, int] = {}  # tpulint: guarded-by _lock
         #: alloc/free logging (ref spark.rapids.memory.gpu.debug=STDOUT,
         #: RapidsConf.scala:376)
         self.debug_log = False
@@ -144,15 +161,97 @@ class MemoryManager:
 
     # ----------------------------------------------------------- registration
     def register_spillable(self, spillable) -> int:
+        tenant = getattr(self._tenant, "name", None)
         with self._lock:
             h = self._next_handle
             self._next_handle += 1
             self._spillables[h] = spillable
+            if tenant:
+                # stamp the owner at registration: quota enforcement and
+                # per-tenant telemetry census over this map
+                self._spillable_tenant[h] = tenant
             return h
 
     def unregister_spillable(self, handle: int):
         with self._lock:
             self._spillables.pop(handle, None)
+            self._spillable_tenant.pop(handle, None)
+
+    # ------------------------------------------------------------- tenants
+    def set_thread_tenant(self, tenant: Optional[str],
+                          quota_bytes: int = 0) -> None:
+        """Attribute the calling thread's retained buffers to ``tenant``
+        (None clears). With ``quota_bytes > 0``, :meth:`reserve`
+        enforces the per-tenant HBM share: a breach first spills the
+        tenant's OWN spillables, then raises into the tenant's own
+        rung-1/2 retry ladder — never a rung-3 cross-session spill on
+        other tenants (ISSUE 18)."""
+        self._tenant.name = tenant or None
+        self._tenant.quota = max(0, int(quota_bytes))
+        if tenant and quota_bytes > 0:
+            with self._lock:
+                self._tenant_quota[tenant] = int(quota_bytes)
+
+    def thread_tenant(self) -> Optional[str]:
+        return getattr(self._tenant, "name", None)
+
+    def tenant_device_used(self, tenant: str) -> int:
+        """Device-resident bytes retained by ``tenant``'s live
+        spillables (the quota census)."""
+        with self._lock:
+            return self._tenant_used_locked(tenant)
+
+    def _tenant_used_locked(self, tenant: str) -> int:
+        return sum(s.device_bytes()
+                   for h, s in self._spillables.items()
+                   if s.tier == "device"
+                   and self._spillable_tenant.get(h) == tenant)
+
+    def _spill_tenant(self, tenant: str, need_bytes: int) -> int:
+        """Spill ``tenant``'s OWN device spillables in priority order —
+        the quota breach's self-help step, deliberately blind to every
+        other tenant's buffers."""
+        with self._lock:
+            candidates = sorted(
+                (s for h, s in self._spillables.items()
+                 if s.tier == "device"
+                 and self._spillable_tenant.get(h) == tenant),
+                key=lambda s: s.spill_priority)
+        freed = 0
+        for s in candidates:
+            if freed >= need_bytes:
+                break
+            freed += s.spill_to_host()
+        return freed
+
+    def _enforce_tenant_quota(self, nbytes: int) -> None:
+        """Per-tenant HBM share gate (reserve-time, BEFORE the global
+        budget): over quota, spill the tenant's own buffers; still over,
+        raise RetryOOM (rung 1) or SplitAndRetryOOM when this single
+        allocation alone exceeds the share (rung 2). The raise precedes
+        any global-budget pressure, so a quota breach rides the
+        breaching tenant's own ladder instead of forcing a cross-session
+        spill on everyone else."""
+        tenant = getattr(self._tenant, "name", None)
+        quota = getattr(self._tenant, "quota", 0)
+        if not tenant or quota <= 0:
+            return
+        with self._lock:
+            used = self._tenant_used_locked(tenant)
+        if used + nbytes <= quota:
+            return
+        self._spill_tenant(tenant, used + nbytes - quota)
+        with self._lock:
+            used = self._tenant_used_locked(tenant)
+        if used + nbytes <= quota:
+            return
+        if nbytes > quota:
+            raise SplitAndRetryOOM(
+                f"tenant {tenant}: allocation of {nbytes} exceeds the "
+                f"whole tenant HBM share {quota}")
+        raise RetryOOM(
+            f"tenant {tenant}: reserve of {nbytes} would exceed the "
+            f"tenant HBM share (used={used}, quota={quota})")
 
     # ------------------------------------------------------------ accounting
     def reserve(self, nbytes: int, allow_spill: bool = True):
@@ -178,6 +277,10 @@ class MemoryManager:
             self.reserve_granted(nbytes)
             return
         self._maybe_chaos()
+        # per-tenant HBM share (ISSUE 18): gated BEFORE the global
+        # budget so a breaching tenant self-spills / splits on its own
+        # ladder instead of pressuring everyone else's buffers
+        self._enforce_tenant_quota(nbytes)
         if self._native is not None:
             rc = self._native.reserve(nbytes, block_ms=0)
             if rc == 0:
@@ -234,20 +337,25 @@ class MemoryManager:
         if self.debug_log:
             log.info("free  %d B (used %d B)", nbytes,
                      self.device_used - nbytes)
-        if self.in_pressure_grant():
-            # symmetric with the grant branch in reserve(): bytes this
-            # thread reserved UNDER the grant (ledger) drain the grant
-            # pool; anything beyond the ledger is a pre-grant buffer
-            # being closed under the grant and falls through to the
-            # normal device accounting
-            led = getattr(self._grant, "ledger", 0)
-            if led > 0:
-                take = min(nbytes, led)
-                self._grant.ledger = led - take
-                self.release_granted(take)
-                nbytes -= take
-                if nbytes <= 0:
-                    return
+        # symmetric with the grant branch in reserve(): bytes this
+        # thread reserved UNDER the grant (ledger) drain the grant
+        # pool; anything beyond the ledger is a pre-grant buffer
+        # being closed under the grant and falls through to the
+        # normal device accounting. The ledger is drained even when
+        # the grant scope has already EXITED (ISSUE 18 satellite): a
+        # reserve made under the grant whose release lands after the
+        # scope closed used to strand its bytes in pressure_granted
+        # forever — degrading the /healthz memory verdict with zero
+        # live granted bytes — while the normal accounting was
+        # under-counted by the same amount.
+        led = getattr(self._grant, "ledger", 0)
+        if led > 0:
+            take = min(nbytes, led)
+            self._grant.ledger = led - take
+            self.release_granted(take)
+            nbytes -= take
+            if nbytes <= 0:
+                return
         if self._native is not None:
             self._native.release(nbytes)
             return
@@ -305,9 +413,16 @@ class MemoryManager:
     def reserve_granted(self, nbytes: int):
         with self._lock:
             self.pressure_granted += nbytes
+            if self.pressure_granted > 0:
+                self._grant_last_nonzero = time.monotonic()
 
     def release_granted(self, nbytes: int):
         with self._lock:
+            if self.pressure_granted > 0:
+                # stamp the drain instant: pressure_grant_idle_s (and
+                # the /healthz clear horizon) measure from the moment
+                # the pool was LAST nonzero, not from first grant
+                self._grant_last_nonzero = time.monotonic()
             self.pressure_granted = max(0, self.pressure_granted - nbytes)
 
     def reserve_host(self, nbytes: int):
@@ -506,15 +621,32 @@ class MemoryManager:
                "max_device_used": 0, "budget": 0,
                "spill_to_host_bytes": 0, "spill_to_disk_bytes": 0,
                "pressure_granted": 0}
+        tenant_used: Dict[str, int] = {}
+        tenant_quota: Dict[str, int] = {}
+        idle = None
         for mm in insts:
             st = mm.stats()
             for k in out:
                 out[k] += st[k]
+            for t, v in (st.get("tenant_used") or {}).items():
+                tenant_used[t] = tenant_used.get(t, 0) + v
+            for t, v in (st.get("tenant_quota") or {}).items():
+                tenant_quota[t] = tenant_quota.get(t, 0) + v
+            i = st.get("pressure_grant_idle_s")
+            if i is not None:
+                # MIN across instances: the most recent grant activity
+                # anywhere governs the process-wide clear horizon
+                idle = i if idle is None else min(idle, i)
+        out["tenant_used"] = tenant_used
+        out["tenant_quota"] = tenant_quota
+        out["pressure_grant_idle_s"] = idle
         return out
 
     # ------------------------------------------------------------------ stats
     def stats(self) -> Dict[str, int]:
         with self._lock:
+            tenants = sorted(set(self._spillable_tenant.values())
+                             | set(self._tenant_quota))
             return {"device_used": self.device_used,
                     "host_used": self.host_used,
                     "disk_used": self.disk_used,
@@ -523,4 +655,19 @@ class MemoryManager:
                     "spill_to_host_bytes": self.spill_to_host_bytes,
                     "spill_to_disk_bytes": self.spill_to_disk_bytes,
                     "pressure_granted": self.pressure_granted,
+                    # seconds since the pressure pool was last nonzero
+                    # (0.0 while nonzero; None = never granted): the
+                    # /healthz memory verdict's clear horizon and the
+                    # admission shed check both read this
+                    "pressure_grant_idle_s": (
+                        0.0 if self.pressure_granted > 0
+                        else (round(time.monotonic()
+                                    - self._grant_last_nonzero, 3)
+                              if self._grant_last_nonzero is not None
+                              else None)),
+                    # per-tenant device residency census (ISSUE 18):
+                    # live registered spillables per owning tenant
+                    "tenant_used": {t: self._tenant_used_locked(t)
+                                    for t in tenants},
+                    "tenant_quota": dict(self._tenant_quota),
                     "num_spillables": len(self._spillables)}

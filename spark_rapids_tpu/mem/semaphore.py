@@ -24,7 +24,7 @@ from typing import Dict, List, Optional
 
 from ..trace import core as trace_core
 
-__all__ = ["DeviceSemaphore", "QueryTimeout"]
+__all__ = ["DeviceSemaphore", "QueryTimeout", "wedged_census"]
 
 log = logging.getLogger(__name__)
 
@@ -32,6 +32,26 @@ log = logging.getLogger(__name__)
 #: totals across every in-flight query context); weak so a finished
 #: query's semaphore just drops out of the sums
 _SEMAPHORES: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def wedged_census() -> Dict[str, int]:
+    """Dead/overdue holder counts across every live semaphore — the
+    cheap process-wide wedge probe shared by the ops ``/healthz``
+    semaphore verdict (ops/server.py) and the admission controller's
+    shed check (sched/admission.py): a holder whose thread died, or
+    one past the wedge horizon, means new low-priority work should be
+    refused rather than queued behind a wedge."""
+    dead = overdue = 0
+    for s in list(_SEMAPHORES):
+        d = s.diagnostics()
+        horizon_s = (s.wedge_timeout_ms / 1000.0
+                     if s.wedge_timeout_ms > 0 else None)
+        for h in d["holders"]:
+            if h.get("alive") is False:
+                dead += 1
+            elif horizon_s is not None and h["held_s"] >= horizon_s:
+                overdue += 1
+    return {"dead": dead, "overdue": overdue}
 
 
 class QueryTimeout(RuntimeError):

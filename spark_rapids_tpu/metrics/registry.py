@@ -393,8 +393,8 @@ declare_metric("srtpu_flight_dumps_total", "counter",
                "trigger=<kind from the ops/flight.py closed taxonomy> "
                "(semaphore_wedge, oom_ladder, query_timeout, "
                "worker_evicted, warm_recompile, placement_revert, "
-               "sentinel_regression — docs/ops.md); rate-limited "
-               "suppressions are not counted.")
+               "sentinel_regression, admission_shed — docs/ops.md); "
+               "rate-limited suppressions are not counted.")
 declare_metric("srtpu_query_regressions_total", "counter",
                "Regressions flagged by the per-digest sentinel, labeled "
                "kind=warm_slowdown|verdict_flip|rung_escalation "
@@ -405,3 +405,26 @@ declare_metric("srtpu_placement_fallback_total", "counter",
                "registry> and op=<logical operator>; incremented once "
                "per executed query with that query's PlacementReport "
                "tag counts (docs/placement.md).")
+declare_metric("srtpu_admission_admitted_total", "counter",
+               "Queries admitted through the multi-tenant admission "
+               "controller (sched/admission.py), labeled tenant=<id or "
+               "'default'>; only counted when spark.rapids.tpu."
+               "admission.enabled is on (docs/serving.md).")
+declare_metric("srtpu_admission_rejected_total", "counter",
+               "Admissions refused with AdmissionRejected, labeled "
+               "reason=queue_full|deadline|shed|chaos "
+               "(sched/admission.py, docs/serving.md).")
+declare_metric("srtpu_admission_wait_seconds", "histogram",
+               "Time admitted queries spent queued in the admission "
+               "controller before their permit (seconds).")
+declare_metric("srtpu_admission_queue_depth", "gauge",
+               "Queries currently queued in the admission controller "
+               "waiting for an in-flight slot (sampler snapshot).")
+declare_metric("srtpu_tenant_hbm_used_bytes", "gauge",
+               "Device-tier spillable bytes attributed to each tenant "
+               "by the memory manager's ownership census, labeled "
+               "tenant=<id> (mem/manager.py, docs/serving.md).")
+declare_metric("srtpu_tenant_hbm_quota_bytes", "gauge",
+               "Per-tenant HBM quota in bytes (spark.rapids.tpu."
+               "tenant.hbmShare x the device budget), labeled "
+               "tenant=<id>; 0 rows are not exported.")

@@ -52,6 +52,20 @@ def sample_now(reg: MetricRegistry) -> None:
         mm["spill_to_host_bytes"])
     reg.counter("srtpu_spill_to_disk_bytes_total").set_total(
         mm["spill_to_disk_bytes"])
+    # per-tenant HBM ownership census + quotas (ISSUE 18): one labeled
+    # series per tenant that owns device-tier spillables or has a quota
+    for t, used in (mm.get("tenant_used") or {}).items():
+        reg.gauge("srtpu_tenant_hbm_used_bytes", tenant=t).set(used)
+    for t, quota in (mm.get("tenant_quota") or {}).items():
+        reg.gauge("srtpu_tenant_hbm_quota_bytes", tenant=t).set(quota)
+
+    from ..sched import admission as adm_mod
+    adm = adm_mod.CONTROLLER
+    if adm is not None:
+        # the racy accessor, NOT stats(): a flight bundle's metrics
+        # section runs this pass from inside the controller's reject
+        # path — taking the admission lock here could deadlock
+        reg.gauge("srtpu_admission_queue_depth").set(adm.queue_depth())
 
     sems = list(sem_mod._SEMAPHORES)
     reg.gauge("srtpu_semaphore_queue_depth").set(

@@ -25,7 +25,11 @@ Trigger taxonomy (closed — :data:`TRIGGERS`; docs/ops.md):
 * ``placement_revert``   — a digest whose history says device planned
   host (fired by the regression sentinel's verdict-flip check);
 * ``sentinel_regression``— any other sentinel flag (warm-digest
-  slowdown, new rung-3+ escalation).
+  slowdown, new rung-3+ escalation);
+* ``admission_shed``     — a burst of admission rejections past the
+  controller's rate threshold (``spark.rapids.tpu.admission.shed.*``):
+  the bundle names the pressured section the shed verdict blamed
+  (sched/admission.py, docs/serving.md).
 
 Bundle layout — five sections, written atomically (a temp directory
 renamed into place, so a reader never sees a partial bundle):
@@ -100,7 +104,7 @@ FLIGHT_RING_EVENTS = register(
 #: undocumented trigger)
 TRIGGERS = ("semaphore_wedge", "oom_ladder", "query_timeout",
             "worker_evicted", "warm_recompile", "placement_revert",
-            "sentinel_regression")
+            "sentinel_regression", "admission_shed")
 
 #: the process-global recorder; ``None`` means the flight recorder is
 #: OFF and every trigger site costs exactly one attribute load + branch

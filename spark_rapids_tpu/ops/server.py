@@ -66,6 +66,13 @@ _CACHE_HIT_RATE_FLOOR = 0.5
 _CACHE_MIN_LOOKUPS = 64
 #: /healthz memory verdict: device tier fuller than this is degraded
 _HBM_DEGRADED_FRACTION = 0.95
+#: /healthz memory verdict: seconds the pressure-grant pool must stay
+#: EMPTY before the degraded verdict clears (hysteresis keyed off the
+#: pool's last-nonzero instant, mem/manager.py): a pool that flickers
+#: empty between rung-4 grants must not flap the verdict, and a drained
+#: one must clear instead of degrading forever (ISSUE 18 satellite).
+#: The admission shed check (sched/admission.py) reads the same horizon.
+_GRANT_CLEAR_HORIZON_S = 2.0
 #: /healthz worker verdict: a peer older than this fraction of the
 #: eviction horizon reads degraded — strictly BELOW 1.0, because
 #: _evict (run by every heartbeat/live_peers call) removes the peer at
@@ -86,9 +93,11 @@ class QueryTracker:
             maxlen=max(1, int(recent)))   # tpulint: guarded-by _lock
 
     def begin(self, query_id, digest: Optional[str],
-              verdict: Optional[str], root: Optional[str] = None) -> int:
+              verdict: Optional[str], root: Optional[str] = None,
+              tenant: Optional[str] = None) -> int:
         rec = {"queryId": query_id, "planDigest": digest,
                "placement": verdict, "root": root,
+               "tenant": tenant,
                "startedMs": round(time.time() * 1000.0, 1),
                "_t0": time.monotonic()}
         with self._lock:
@@ -96,6 +105,20 @@ class QueryTracker:
             tok = self._seq
             self._inflight[tok] = rec
         return tok
+
+    def admission(self, token: int, status: str,
+                  queued_ms: Optional[float] = None) -> None:
+        """Record the query's admission-controller outcome (ISSUE 18):
+        ``queued`` while it waits at the front door, then ``admitted``
+        (with the wait it paid) or ``shed``. /queries renders it live,
+        and end() carries it into the recency ring."""
+        with self._lock:
+            rec = self._inflight.get(token)
+            if rec is None:
+                return
+            rec["admission"] = status
+            if queued_ms is not None:
+                rec["queuedMs"] = round(float(queued_ms), 3)
 
     def end(self, token: int, ok: bool, wall_ms: Optional[float] = None,
             rung: int = 0, reason: Optional[str] = None,
@@ -252,6 +275,7 @@ class OpsServer:
     def healthz(self) -> dict:
         sections = {"semaphore": self._health_semaphore(),
                     "memory": self._health_memory(),
+                    "admission": self._health_admission(),
                     "execCache": self._health_exec_cache(),
                     "workers": self._health_workers(),
                     "eventLog": self._health_event_log(),
@@ -294,10 +318,34 @@ class OpsServer:
         budget = st.get("budget") or 0
         used = st.get("device_used") or 0
         grant = st.get("pressure_granted") or 0
-        degraded = bool(grant) or (
+        # the grant pool degrades while nonzero AND for a short horizon
+        # after it drains (last-nonzero hysteresis) — then CLEARS: a
+        # pool back to zero live bytes must not read degraded forever
+        # (ISSUE 18 satellite; mem/manager.py pressure_grant_idle_s)
+        idle = st.get("pressure_grant_idle_s")
+        grant_hot = bool(grant) or (
+            idle is not None and idle < _GRANT_CLEAR_HORIZON_S)
+        degraded = grant_hot or (
             budget > 0 and used > _HBM_DEGRADED_FRACTION * budget)
         out = dict(st)
         out["verdict"] = "degraded" if degraded else "ok"
+        return out
+
+    def _health_admission(self) -> dict:
+        from ..sched import admission as adm_mod
+        ctl = adm_mod.CONTROLLER
+        if ctl is None:
+            return {"enabled": False, "verdict": "ok"}
+        st = ctl.stats()
+        shed = adm_mod.shed_reason()
+        out = {"enabled": True, "shedActive": shed is not None,
+               **st}
+        if shed is not None:
+            out["shedReason"] = shed
+        # shedding mirrors the memory/semaphore pressure verdicts —
+        # report it here too so a load balancer reading only this
+        # section still sees the front door is refusing work
+        out["verdict"] = "degraded" if shed is not None else "ok"
         return out
 
     def _health_exec_cache(self) -> dict:

@@ -1,0 +1,507 @@
+"""Admission controller: priority queueing, deadlines, shedding.
+
+Reference analog: the bounded concurrent-task admission of
+``GpuSemaphore`` (GpuSemaphore.scala:51) plus the concurrent-query
+scheduling model of "Accelerating Presto with GPUs" — a front door that
+decides whether a query may even start competing for the device, so an
+overload burst degrades into structured refusals instead of a pile-up
+on the semaphore.
+
+Mechanics:
+
+* one :class:`threading.Condition` guards the whole scheduler state
+  (in-flight count, queued-ticket table, hold-time estimator);
+* each ``admit()`` creates a ticket and waits until it is the *head* of
+  the queue — the queued ticket with the highest effective priority,
+  FIFO within a class — AND an in-flight slot is free;
+* effective priority ages upward every
+  ``spark.rapids.tpu.admission.agingMs`` spent queued, so a continuous
+  stream of high-priority admissions can delay but never indefinitely
+  starve a low-priority ticket;
+* a ticket whose query deadline would expire while queued (estimated
+  from an EWMA of recent admission hold times) is rejected up front;
+  one that outlives its deadline in the queue is rejected on wake;
+* while the process is pressure-degraded (:func:`shed_reason` — the
+  same HBM/pressure-grant/wedge conditions the ops ``/healthz``
+  memory and semaphore verdicts read) admissions with priority below
+  ``spark.rapids.tpu.admission.shed.priorityFloor`` are refused.
+
+Every refusal raises :class:`AdmissionRejected` carrying a machine
+``reason`` and a ``retry_after_s`` hint, and is counted into
+``srtpu_admission_rejected_total{reason=...}``. A rejection burst past
+``spark.rapids.tpu.admission.shed.burst`` inside
+``spark.rapids.tpu.admission.shed.windowMs`` fires the flight
+recorder's ``admission_shed`` trigger naming the pressured section.
+
+The reject path is leak-free by construction: a ticket is removed from
+the queued table in the same critical section that decides to reject
+it, and rejection happens strictly before the in-flight count is
+incremented — a refused query can never strand a slot or a queued
+deadline timer (release() on a never-admitted ticket is a no-op).
+"""
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from ..config import register
+
+__all__ = ["AdmissionController", "AdmissionRejected", "AdmissionTicket",
+           "install_admission", "ensure_admission_from_conf",
+           "active_admission", "shed_reason",
+           "ADMISSION_ENABLED", "ADMISSION_MAX_IN_FLIGHT",
+           "ADMISSION_MAX_QUEUED", "ADMISSION_AGING_MS",
+           "ADMISSION_RETRY_AFTER_MS", "ADMISSION_SHED_PRIORITY_FLOOR",
+           "ADMISSION_SHED_BURST", "ADMISSION_SHED_WINDOW_MS",
+           "TENANT_ID", "TENANT_PRIORITY", "TENANT_HBM_SHARE"]
+
+log = logging.getLogger(__name__)
+
+ADMISSION_ENABLED = register(
+    "spark.rapids.tpu.admission.enabled", False,
+    "Route every materializing query through the multi-tenant admission "
+    "controller (sched/admission.py): priority-queued entry over the "
+    "device semaphore, deadline-aware queueing and graceful shedding "
+    "under pressure (docs/serving.md). Off by default: no controller is "
+    "installed and each query pays one module-global load + branch.",
+    commonly_used=True)
+
+ADMISSION_MAX_IN_FLIGHT = register(
+    "spark.rapids.tpu.admission.maxInFlight", 0,
+    "Queries admitted concurrently past the controller; 0 means match "
+    "spark.rapids.tpu.sql.concurrentTpuTasks (admission then mirrors "
+    "the device-semaphore width one level up, where refusal is still "
+    "cheap).")
+
+ADMISSION_MAX_QUEUED = register(
+    "spark.rapids.tpu.admission.maxQueued", 32,
+    "Queries allowed to wait for admission; one more is refused with "
+    "AdmissionRejected(reason=queue_full) and a retry-after hint "
+    "instead of deepening the pile-up.")
+
+ADMISSION_AGING_MS = register(
+    "spark.rapids.tpu.admission.agingMs", 1000,
+    "Milliseconds of queued wait per one step of priority aging: a "
+    "queued ticket's effective priority rises by one class per "
+    "interval, so high-priority streams cannot indefinitely starve a "
+    "low-priority query. <= 0 disables aging.")
+
+ADMISSION_RETRY_AFTER_MS = register(
+    "spark.rapids.tpu.admission.retryAfterMs", 100,
+    "Base retry-after hint (milliseconds) carried by AdmissionRejected; "
+    "queue_full refusals scale it by the queue depth.")
+
+ADMISSION_SHED_PRIORITY_FLOOR = register(
+    "spark.rapids.tpu.admission.shed.priorityFloor", 2,
+    "While the process is pressure-degraded (the /healthz memory/"
+    "semaphore conditions), new admissions with tenant priority "
+    "STRICTLY BELOW this are shed with AdmissionRejected(reason=shed). "
+    "The default (2) sheds default-priority (1) tenants and lets "
+    "priority >= 2 tenants through.")
+
+ADMISSION_SHED_BURST = register(
+    "spark.rapids.tpu.admission.shed.burst", 8,
+    "Rejections inside admission.shed.windowMs that count as a shed "
+    "burst: the flight recorder's admission_shed trigger dumps one "
+    "bundle naming the pressured section (docs/ops.md).")
+
+ADMISSION_SHED_WINDOW_MS = register(
+    "spark.rapids.tpu.admission.shed.windowMs", 1000,
+    "Window (milliseconds) over which admission rejections are counted "
+    "toward the admission_shed flight-recorder burst threshold.")
+
+TENANT_ID = register(
+    "spark.rapids.tpu.tenant.id", "",
+    "Tenant this session's queries run as: the admission controller's "
+    "priority/fairness unit and the memory manager's quota unit "
+    "(docs/serving.md). Empty means the anonymous default tenant (no "
+    "quota attribution).", commonly_used=True)
+
+TENANT_PRIORITY = register(
+    "spark.rapids.tpu.tenant.priority", 1,
+    "Admission priority class of this session's tenant; higher admits "
+    "first. FIFO within a class; queued tickets age upward per "
+    "spark.rapids.tpu.admission.agingMs.")
+
+TENANT_HBM_SHARE = register(
+    "spark.rapids.tpu.tenant.hbmShare", 0.0,
+    "Fraction (0..1] of the HBM budget this tenant may keep resident "
+    "in retained device buffers; 0 disables the quota. A breach first "
+    "spills the tenant's OWN spillables, then raises into the tenant's "
+    "own rung-1/2 retry ladder — it can never force a rung-3 "
+    "cross-session spill on other tenants (mem/manager.py).")
+
+
+class AdmissionRejected(RuntimeError):
+    """A query was refused at the admission front door.
+
+    Structured fields (the serving contract, docs/serving.md):
+
+    * ``reason`` — ``queue_full`` / ``deadline`` / ``shed`` / ``chaos``;
+    * ``retry_after_s`` — hint: seconds after which a retry has a
+      reasonable chance (load balancers map it to Retry-After);
+    * ``tenant`` — the refused tenant id (None for anonymous).
+    """
+
+    def __init__(self, reason: str, detail: str,
+                 retry_after_s: float = 0.1,
+                 tenant: Optional[str] = None):
+        super().__init__(f"admission rejected ({reason}): {detail} "
+                         f"[retry after {retry_after_s:.3f}s]")
+        self.reason = reason
+        self.retry_after_s = float(retry_after_s)
+        self.tenant = tenant
+
+
+class AdmissionTicket:
+    """One query's pass through the controller. ``queued_ms`` is the
+    wait the query paid before admission (0.0 for an uncontended fast
+    path); ``release()`` via the controller is idempotent."""
+
+    __slots__ = ("tenant", "priority", "seq", "enqueued_at", "queued_ms",
+                 "deadline", "admitted", "released")
+
+    def __init__(self, tenant: Optional[str], priority: int, seq: int,
+                 deadline: Optional[float]):
+        self.tenant = tenant
+        self.priority = int(priority)
+        self.seq = seq
+        self.enqueued_at = time.monotonic()
+        self.queued_ms = 0.0
+        self.deadline = deadline
+        self.admitted = False
+        self.released = False
+
+
+def shed_reason() -> Optional[str]:
+    """Why the process is pressure-degraded, or None when healthy — the
+    SAME conditions the ops ``/healthz`` memory and semaphore verdicts
+    read (ops/server.py thresholds, including the pressure-grant clear
+    horizon), so shedding and the 503 the load balancer sees always
+    agree."""
+    from ..mem.manager import MemoryManager
+    from ..ops import server as ops_server
+    st = MemoryManager.stats_all()
+    budget = st.get("budget") or 0
+    used = st.get("device_used") or 0
+    if st.get("pressure_granted"):
+        return "memory: pressure-grant pool active"
+    idle = st.get("pressure_grant_idle_s")
+    if idle is not None and idle < ops_server._GRANT_CLEAR_HORIZON_S:
+        return (f"memory: pressure-grant pool drained only "
+                f"{idle:.2f}s ago")
+    if budget > 0 and used > ops_server._HBM_DEGRADED_FRACTION * budget:
+        return (f"memory: HBM {used}/{budget} B past the "
+                "degraded fraction")
+    from ..mem import semaphore as sem_mod
+    census = sem_mod.wedged_census()
+    if census["dead"] or census["overdue"]:
+        return (f"semaphore: {census['dead']} dead / "
+                f"{census['overdue']} overdue holder(s)")
+    return None
+
+
+class AdmissionController:
+    """Priority-queued, deadline-aware, shedding admission gate.
+
+    One condition variable guards all scheduler state; every waiter
+    re-evaluates headship on wake, so a released slot always goes to
+    the queued ticket with the highest effective (aged) priority,
+    FIFO within a class."""
+
+    def __init__(self, max_in_flight: int, max_queued: int,
+                 aging_ms: int = 1000, retry_after_ms: int = 100,
+                 shed_priority_floor: int = 2, shed_burst: int = 8,
+                 shed_window_ms: int = 1000):
+        self.max_in_flight = max(1, int(max_in_flight))
+        self.max_queued = max(0, int(max_queued))
+        self.aging_ms = int(aging_ms)
+        self.retry_after_ms = max(1, int(retry_after_ms))
+        self.shed_priority_floor = int(shed_priority_floor)
+        self.shed_burst = max(1, int(shed_burst))
+        self.shed_window_ms = max(1, int(shed_window_ms))
+        self._cv = threading.Condition()
+        self._seq = itertools.count(1)
+        self.in_flight = 0                 # tpulint: guarded-by _cv
+        self._queued: List[AdmissionTicket] = []  # tpulint: guarded-by _cv
+        #: EWMA of seconds an admitted query holds its slot — the
+        #: queue-wait estimator behind up-front deadline rejection
+        self._hold_ewma_s = 0.0            # tpulint: guarded-by _cv
+        self.admitted_total = 0            # tpulint: guarded-by _cv
+        self.rejected: Dict[str, int] = {}  # tpulint: guarded-by _cv
+        #: monotonic instants of recent rejections (burst detector)
+        self._reject_times: deque = deque(
+            maxlen=self.shed_burst)        # tpulint: guarded-by _cv
+
+    # ------------------------------------------------------------ admit
+    def admit(self, tenant: Optional[str] = None, priority: int = 1,
+              deadline: Optional[float] = None) -> AdmissionTicket:
+        """Block until admitted; raise :class:`AdmissionRejected` when
+        the queue is full, the deadline cannot be met, pressure sheds
+        this priority class, or chaos injects a refusal. ``deadline``
+        is a ``time.monotonic`` instant (the query's cooperative
+        timeout instant), None for no deadline."""
+        try:
+            return self._admit(tenant, priority, deadline)
+        except AdmissionRejected as e:
+            # metric export and the burst flight dump run strictly
+            # OUTSIDE _cv: a bundle's metrics section samples this
+            # controller, and sampling under our own lock would deadlock
+            self._note_rejected(e)
+            raise
+
+    def _admit(self, tenant: Optional[str], priority: int,
+               deadline: Optional[float]) -> AdmissionTicket:
+        from ..aux.fault import active_chaos
+        ctl = active_chaos()
+        if ctl is not None:
+            if ctl.wants("admit.delay"):
+                ctl.maybe_delay("admit.delay")
+            if ctl.wants("admit.reject") and ctl.fires("admit.reject"):
+                self._reject("chaos", tenant,
+                             "chaos: injected admit.reject")
+        now = time.monotonic()
+        if deadline is not None and now >= deadline:
+            self._reject("deadline", tenant,
+                         "query deadline already passed at admission")
+        shed = shed_reason()
+        if shed is not None and int(priority) < self.shed_priority_floor:
+            self._reject(
+                "shed", tenant,
+                f"pressure-degraded ({shed}); priority {priority} is "
+                f"below admission.shed.priorityFloor "
+                f"{self.shed_priority_floor}", section=shed)
+        with self._cv:
+            if len(self._queued) >= self.max_queued \
+                    and self.in_flight >= self.max_in_flight:
+                self._reject_locked(
+                    "queue_full", tenant,
+                    f"{self.in_flight} in flight, {len(self._queued)} "
+                    f"queued (admission.maxQueued={self.max_queued})",
+                    retry_scale=len(self._queued) + 1)
+            if deadline is not None and self._hold_ewma_s > 0:
+                # up-front deadline check: with every slot busy, this
+                # ticket waits roughly one EWMA hold per queue "wave"
+                # ahead of it — admit-to-fail-later wastes a slot the
+                # whole wait, so refuse now while it is still free
+                waves = (len(self._queued) + self.in_flight
+                         - (self.max_in_flight - 1)) / self.max_in_flight
+                est_wait_s = max(0.0, waves) * self._hold_ewma_s
+                if now + est_wait_s >= deadline:
+                    self._reject_locked(
+                        "deadline", tenant,
+                        f"estimated queue wait {est_wait_s:.3f}s "
+                        f"exceeds the remaining query.timeout budget "
+                        f"{deadline - now:.3f}s")
+            t = AdmissionTicket(tenant, priority, next(self._seq),
+                                deadline)
+            self._queued.append(t)
+            try:
+                while not (self.in_flight < self.max_in_flight
+                           and self._head_locked() is t):
+                    if t.deadline is not None \
+                            and time.monotonic() >= t.deadline:
+                        self._reject_locked(
+                            "deadline", tenant,
+                            "query deadline expired while queued "
+                            f"({(time.monotonic() - t.enqueued_at):.3f}s "
+                            "in queue)")
+                    # bounded wait slices: re-evaluate aging promotion
+                    # and the deadline even when no release wakes us
+                    self._cv.wait(timeout=min(
+                        0.05, self.aging_ms / 1000.0
+                        if self.aging_ms > 0 else 0.05))
+            except BaseException:
+                # reject/timeout/interrupt: the ticket must leave the
+                # queued table in the same critical section — a
+                # stranded entry would block every later head check
+                self._queued.remove(t)
+                self._cv.notify_all()
+                raise
+            self._queued.remove(t)
+            self.in_flight += 1
+            t.admitted = True
+            t.queued_ms = round(
+                (time.monotonic() - t.enqueued_at) * 1000.0, 3)
+            self.admitted_total += 1
+        from ..metrics import registry as metrics_registry
+        mr = metrics_registry.REGISTRY
+        if mr is not None:
+            mr.counter("srtpu_admission_admitted_total",
+                       tenant=tenant or "default").inc()
+            mr.histogram("srtpu_admission_wait_seconds").observe(
+                t.queued_ms / 1000.0)
+        return t
+
+    def _effective_priority(self, t: AdmissionTicket, now: float) -> int:
+        if self.aging_ms <= 0:
+            return t.priority
+        waited_ms = (now - t.enqueued_at) * 1000.0
+        return t.priority + int(waited_ms // self.aging_ms)
+
+    def _head_locked(self) -> Optional[AdmissionTicket]:
+        """The queued ticket next in line: highest effective (aged)
+        priority, FIFO (lowest seq) within a class. Caller holds _cv."""
+        if not self._queued:
+            return None
+        now = time.monotonic()
+        return max(self._queued,
+                   key=lambda t: (self._effective_priority(t, now),
+                                  -t.seq))
+
+    # ---------------------------------------------------------- release
+    # tpulint: never-raise
+    def release(self, ticket: AdmissionTicket) -> None:
+        """Return an admitted ticket's slot (idempotent; a ticket that
+        was never admitted is a no-op). Runs on every query exit path,
+        so it must never raise into an already-unwinding query."""
+        try:
+            with self._cv:
+                if not ticket.admitted or ticket.released:
+                    return
+                ticket.released = True
+                self.in_flight = max(0, self.in_flight - 1)
+                held_s = max(0.0, time.monotonic() - ticket.enqueued_at
+                             - ticket.queued_ms / 1000.0)
+                self._hold_ewma_s = (held_s if self._hold_ewma_s == 0.0
+                                     else 0.8 * self._hold_ewma_s
+                                     + 0.2 * held_s)
+                self._cv.notify_all()
+        except Exception:  # noqa: BLE001 - release must never raise
+            log.exception("admission release failed")
+
+    # ----------------------------------------------------------- reject
+    def _reject(self, reason: str, tenant: Optional[str], detail: str,
+                retry_scale: int = 1,
+                section: Optional[str] = None) -> None:
+        with self._cv:
+            self._reject_locked(reason, tenant, detail,
+                                retry_scale=retry_scale, section=section)
+
+    def _reject_locked(self, reason: str, tenant: Optional[str],
+                       detail: str, retry_scale: int = 1,
+                       section: Optional[str] = None) -> None:
+        """Count, burst-detect and raise one refusal. Caller holds _cv;
+        the raise happens BEFORE any slot/queue state is taken for this
+        request, so a rejection can never leak a permit. Side effects
+        with their own locks (metrics, flight dump) are deferred to
+        :meth:`_note_rejected` on the unlocked unwind path."""
+        now = time.monotonic()
+        self.rejected[reason] = self.rejected.get(reason, 0) + 1
+        self._reject_times.append(now)
+        burst = (len(self._reject_times) >= self.shed_burst
+                 and (now - self._reject_times[0]) * 1000.0
+                 <= self.shed_window_ms)
+        retry_s = self.retry_after_ms * max(1, retry_scale) / 1000.0
+        e = AdmissionRejected(reason, detail, retry_after_s=retry_s,
+                              tenant=tenant)
+        e.burst_section = (section or detail) if burst else None
+        raise e
+
+    def _note_rejected(self, e: AdmissionRejected) -> None:
+        """Unlocked rejection side effects: the reason-labeled counter
+        and, on a burst, ONE admission_shed flight bundle naming the
+        pressured section (rate-limited further by the recorder)."""
+        from ..metrics import registry as metrics_registry
+        mr = metrics_registry.REGISTRY
+        if mr is not None:
+            mr.counter("srtpu_admission_rejected_total",
+                       reason=e.reason).inc()
+        section = getattr(e, "burst_section", None)
+        if section is not None:
+            from ..ops import flight as flight_mod
+            fr = flight_mod.RECORDER
+            if fr is not None:
+                fr.trigger(
+                    "admission_shed",
+                    detail=f"{self.shed_burst} admission rejections "
+                           f"within {self.shed_window_ms}ms; last "
+                           f"reason={e.reason}; pressured section: "
+                           f"{section}")
+
+    # ------------------------------------------------------------ stats
+    def stats(self) -> dict:
+        """One consistent scheduler snapshot (the /healthz admission
+        section and the load tests read this)."""
+        with self._cv:
+            now = time.monotonic()
+            queued = [{"tenant": t.tenant, "priority": t.priority,
+                       "effectivePriority":
+                           self._effective_priority(t, now),
+                       "queuedMs": round(
+                           (now - t.enqueued_at) * 1000.0, 1)}
+                      for t in self._queued]
+            return {"inFlight": self.in_flight,
+                    "maxInFlight": self.max_in_flight,
+                    "queued": queued,
+                    "maxQueued": self.max_queued,
+                    "admitted": self.admitted_total,
+                    "rejected": dict(self.rejected),
+                    "holdEwmaS": round(self._hold_ewma_s, 4)}
+
+    def queue_depth(self) -> int:
+        """Queued-ticket count for the metrics sampler — deliberately
+        NOT under _cv so a sampler pass (which a flight bundle may run
+        while an admission path holds the lock) can never deadlock."""
+        # tpulint: disable=lock-discipline — lock-free by design: a
+        # racy len() read for a telemetry gauge
+        return len(self._queued)
+
+
+# ---------------------------------------------------------------------------
+# installation (the trace/metrics/ops pattern)
+# ---------------------------------------------------------------------------
+
+#: the process-global controller; ``None`` means admission control is
+#: OFF and every query costs exactly one attribute load + branch
+CONTROLLER: Optional[AdmissionController] = None
+
+_INSTALL_LOCK = threading.Lock()
+
+
+def active_admission() -> Optional[AdmissionController]:
+    # tpulint: disable=lock-discipline — lock-free by design: the
+    # disabled-path contract is one unlocked reference read per query
+    return CONTROLLER
+
+
+def install_admission(
+        ctl: Optional[AdmissionController]) -> Optional[AdmissionController]:
+    """Install (or with ``None`` remove) the process-global controller
+    (tests / the per-test reset)."""
+    global CONTROLLER
+    with _INSTALL_LOCK:
+        CONTROLLER = ctl
+    return ctl
+
+
+def ensure_admission_from_conf(conf) -> Optional[AdmissionController]:
+    """Install the controller iff ``spark.rapids.tpu.admission.enabled``
+    — one conf lookup per ExecContext construction. First enabled conf
+    wins for the process lifetime (the install-once registry pattern:
+    admission is a process property, like the ops port)."""
+    global CONTROLLER
+    if not bool(conf.get(ADMISSION_ENABLED)):
+        # tpulint: disable=lock-discipline — lock-free by design:
+        # admission-off fast path; installation itself locks below
+        return CONTROLLER
+    with _INSTALL_LOCK:
+        if CONTROLLER is None:
+            from ..config import CONCURRENT_TPU_TASKS
+            max_if = int(conf.get(ADMISSION_MAX_IN_FLIGHT))
+            if max_if <= 0:
+                max_if = int(conf.get(CONCURRENT_TPU_TASKS))
+            CONTROLLER = AdmissionController(
+                max_in_flight=max_if,
+                max_queued=int(conf.get(ADMISSION_MAX_QUEUED)),
+                aging_ms=int(conf.get(ADMISSION_AGING_MS)),
+                retry_after_ms=int(conf.get(ADMISSION_RETRY_AFTER_MS)),
+                shed_priority_floor=int(
+                    conf.get(ADMISSION_SHED_PRIORITY_FLOOR)),
+                shed_burst=int(conf.get(ADMISSION_SHED_BURST)),
+                shed_window_ms=int(conf.get(ADMISSION_SHED_WINDOW_MS)))
+        return CONTROLLER

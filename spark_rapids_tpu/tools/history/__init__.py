@@ -106,7 +106,8 @@ def build_history(events: List[dict]) -> List[dict]:
                  "startTs": rec.get("ts"),
                  "status": "lost", "durationMs": None,
                  "trace": None, "faultStats": None, "metrics": None,
-                 "reason": None, "degraded": False}
+                 "reason": None, "degraded": False,
+                 "tenant": None, "queuedMs": None, "admission": None}
             starts[rec.get("queryId")] = q
             out.append(q)
         elif kind == "queryEnd":
@@ -125,6 +126,11 @@ def build_history(events: List[dict]) -> List[dict]:
             # OOM) or ran degraded on the rung-4 host ladder
             q["reason"] = rec.get("reason")
             q["degraded"] = bool(rec.get("degraded"))
+            # multi-tenant serving detail (ISSUE 18): which tenant ran
+            # the query and how the admission controller treated it
+            q["tenant"] = rec.get("tenant")
+            q["queuedMs"] = rec.get("queuedMs")
+            q["admission"] = rec.get("admission")
             if q["degraded"] and q["status"] == "ok":
                 q["status"] = "degraded"
     return out
@@ -138,14 +144,24 @@ def format_history(history: List[dict], skipped: int = 0,
                    source: str = "") -> str:
     lines = [f"== Query history ({source or 'event log'}) ==",
              f"{'id':>4}  {'status':<8} {'ms':>10}  "
-             f"{'digest':<16}  root  reason"]
+             f"{'digest':<16}  {'tenant':<10}  root  reason"]
     for q in history:
         reason = q.get("reason") or ""
+        # admission detail (ISSUE 18): shed queries surface as the
+        # admission status; admitted-after-queueing shows the queue wait
+        adm = q.get("admission")
+        if adm == "shed":
+            reason = (f"admission=shed; {reason}" if reason
+                      else "admission=shed")
+        elif q.get("queuedMs"):
+            reason = (f"queued {q['queuedMs']}ms; {reason}" if reason
+                      else f"queued {q['queuedMs']}ms")
         lines.append(
             f"{str(q.get('queryId') or '?'):>4}  "
             f"{q.get('status') or '?':<8} "
             f"{_fmt_ms(q.get('durationMs'))}  "
             f"{str(q.get('planDigest') or '?'):<16}  "
+            f"{str(q.get('tenant') or '-'):<10}  "
             f"{q.get('root') or '?'}"
             + (f"  {reason[:80]}" if reason else ""))
     ok = sum(1 for q in history if q.get("status") == "ok")

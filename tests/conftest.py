@@ -87,6 +87,17 @@ def _clear_ops_plane():
 
 
 @pytest.fixture(autouse=True)
+def _clear_admission():
+    """The admission controller is process-global (sched/admission.py,
+    same install pattern as the flight recorder); a test that enables
+    multi-tenant admission must not leave every later query in the
+    suite passing through its queue."""
+    yield
+    from spark_rapids_tpu.sched.admission import install_admission
+    install_admission(None)
+
+
+@pytest.fixture(autouse=True)
 def _assert_no_leaked_spillables():
     """Suite-wide zero-leak check (ref cudf MemoryCleaner at shutdown,
     Plugin.scala:573-588): every SpillableBatch must be closed by the

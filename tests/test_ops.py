@@ -183,13 +183,13 @@ def test_healthz_sections_and_degraded_semaphore():
     srv = _start_server()
     status0, body0 = _get_any(srv.port, "/healthz")
     doc0 = json.loads(body0)
-    for section in ("semaphore", "memory", "execCache", "workers",
-                    "eventLog", "flight", "sentinel"):
+    for section in ("semaphore", "memory", "admission", "execCache",
+                    "workers", "eventLog", "flight", "sentinel"):
         assert doc0[section]["verdict"] in ("ok", "degraded"), section
     # the report is internally consistent: 200 iff every section ok
     all_ok = all(doc0[s]["verdict"] == "ok" for s in
-                 ("semaphore", "memory", "execCache", "workers",
-                  "eventLog", "flight", "sentinel"))
+                 ("semaphore", "memory", "admission", "execCache",
+                  "workers", "eventLog", "flight", "sentinel"))
     assert (status0 == 200) == all_ok == (doc0["status"] == "ok")
     dead0 = doc0["semaphore"]["deadHolders"]
     # a holder thread that died without releasing degrades /healthz
@@ -651,5 +651,144 @@ def test_inventory_covers_new_metrics():
                        ("srtpu_query_regressions_total", "counter"),
                        ("srtpu_worker_last_seen_ms", "gauge"),
                        ("srtpu_hbm_pressure_grant_bytes", "gauge"),
-                       ("srtpu_ops_requests_total", "counter")):
+                       ("srtpu_ops_requests_total", "counter"),
+                       ("srtpu_admission_admitted_total", "counter"),
+                       ("srtpu_admission_rejected_total", "counter"),
+                       ("srtpu_admission_wait_seconds", "histogram"),
+                       ("srtpu_admission_queue_depth", "gauge"),
+                       ("srtpu_tenant_hbm_used_bytes", "gauge"),
+                       ("srtpu_tenant_hbm_quota_bytes", "gauge")):
         assert inv[name]["kind"] == kind, name
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 18: grant-pool hysteresis, tenant/admission rendering, overload
+# ---------------------------------------------------------------------------
+
+def test_memory_verdict_clears_after_grant_pool_drains(tmp_path,
+                                                       monkeypatch):
+    """Satellite regression: a release() arriving AFTER its pressure
+    grant's scope exits used to strand bytes in the pool forever, and
+    the /healthz memory verdict degraded permanently. The pool must
+    drain to zero, and the verdict must clear once the pool has been
+    empty past the clear horizon (hysteresis, not an instant flap)."""
+    from spark_rapids_tpu.mem.manager import MemoryManager
+    from spark_rapids_tpu.ops import server as srv_mod
+    mm = MemoryManager(1 << 20, 1 << 30, str(tmp_path / "sp"))
+    monkeypatch.setattr(MemoryManager, "_instances",
+                        {("grant-clear-test",): mm})
+    srv = _start_server()
+    with mm.pressure_host_grant():
+        mm.reserve(100)                 # lands in the unbudgeted pool
+    mm.release(100)                     # arrives AFTER the scope exit
+    st = mm.stats()
+    assert st["pressure_granted"] == 0, "pool residue leaked"
+    assert st["device_used"] == 0
+    assert st["pressure_grant_idle_s"] is not None
+    # hysteresis: just drained -> the verdict holds degraded...
+    doc = json.loads(_get_any(srv.port, "/healthz")[1])
+    assert doc["memory"]["verdict"] == "degraded"
+    # ...and CLEARS once the pool has been empty past the horizon
+    monkeypatch.setattr(srv_mod, "_GRANT_CLEAR_HORIZON_S", 0.05)
+    time.sleep(0.06)
+    doc = json.loads(_get_any(srv.port, "/healthz")[1])
+    assert doc["memory"]["verdict"] == "ok"
+    assert doc["memory"]["pressure_grant_idle_s"] >= 0.05
+
+
+def test_queries_and_history_render_tenant_admission(tmp_path):
+    """Satellite: /queries rows and tools/history carry the tenant id
+    and the admission outcome; queryEnd records tenant + queuedMs."""
+    srv = _start_server()
+    elog = str(tmp_path / "elog")
+    s = tpu_session({"spark.rapids.tpu.admission.enabled": True,
+                     "spark.rapids.tpu.tenant.id": "team-a",
+                     "spark.rapids.tpu.eventLog.enabled": True,
+                     "spark.rapids.tpu.eventLog.dir": elog})
+    _agg_df(s).collect_arrow()
+    doc = json.loads(_get(srv.port, "/queries")[1])
+    rec = doc["recent"][-1]
+    assert rec["tenant"] == "team-a"
+    assert rec["admission"] == "admitted"
+    assert rec["queuedMs"] >= 0
+    from spark_rapids_tpu.tools.history import (build_history,
+                                                format_history,
+                                                load_events)
+    events, _ = load_events(elog)
+    ends = [e for e in events if e.get("event") == "queryEnd"]
+    assert ends[-1]["tenant"] == "team-a"
+    assert ends[-1]["queuedMs"] is not None
+    assert ends[-1]["admission"] == "admitted"
+    hist = build_history(events)
+    assert hist[-1]["tenant"] == "team-a"
+    assert hist[-1]["admission"] == "admitted"
+    txt = format_history(hist)
+    assert "tenant" in txt.splitlines()[1]
+    assert "team-a" in txt
+
+
+def test_overload_sheds_and_ops_plane_stays_responsive(tmp_path,
+                                                       monkeypatch):
+    """Acceptance (ISSUE 18): overload never wedges the process — with
+    every slot held and the queue full, refusals are structured
+    (AdmissionRejected + retry-after), the ops endpoints still answer,
+    /healthz serves 503 with verdicts, /queries + /healthz list the
+    queued/shed state, and admission recovers once the pressure
+    clears."""
+    from spark_rapids_tpu.mem.manager import MemoryManager
+    from spark_rapids_tpu.sched import admission as adm_mod
+    srv = _start_server()
+    ctl = adm_mod.install_admission(adm_mod.AdmissionController(
+        max_in_flight=1, max_queued=1, retry_after_ms=50))
+    holder = ctl.admit(tenant="hog", priority=3)
+    queued_done = threading.Event()
+
+    def waiter():
+        t = ctl.admit(tenant="patient", priority=3)
+        ctl.release(t)
+        queued_done.set()
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    deadline = time.monotonic() + 10
+    while not ctl.stats()["queued"]:
+        assert time.monotonic() < deadline, "waiter never queued"
+        time.sleep(0.005)
+    # queue full: the next admission is REFUSED, not parked forever
+    with pytest.raises(adm_mod.AdmissionRejected) as ei:
+        ctl.admit(tenant="burst", priority=3)
+    assert ei.value.reason == "queue_full"
+    assert ei.value.retry_after_s >= 0.05   # scaled retry-after hint
+    # memory pressure on top: /healthz degrades with verdicts while the
+    # ops plane stays fully responsive under the overload
+    mm = MemoryManager(1 << 20, 1 << 30, str(tmp_path / "sp"))
+    monkeypatch.setattr(MemoryManager, "_instances",
+                        {("overload-test",): mm})
+    mm.reserve_granted(1)
+    try:
+        code, body = _get_any(srv.port, "/healthz")
+        doc = json.loads(body)
+        assert code == 503 and doc["status"] == "degraded"
+        assert doc["memory"]["verdict"] == "degraded"
+        adm = doc["admission"]
+        assert adm["enabled"] and adm["shedActive"]
+        assert adm["verdict"] == "degraded"
+        assert "pressure-grant" in adm["shedReason"]
+        assert adm["inFlight"] == 1
+        assert [q["tenant"] for q in adm["queued"]] == ["patient"]
+        # low-priority admissions are shed with the pressured section
+        with pytest.raises(adm_mod.AdmissionRejected) as ei2:
+            ctl.admit(tenant="batch", priority=1)
+        assert ei2.value.reason == "shed"
+        assert ei2.value.tenant == "batch"
+    finally:
+        mm.release_granted(1)
+    # pressure gone, holder releases: the queued ticket admits — the
+    # overload degraded service, it never wedged it
+    ctl.release(holder)
+    assert queued_done.wait(10), "queued admission wedged"
+    th.join(timeout=5)
+    st = ctl.stats()
+    assert st["inFlight"] == 0 and st["queued"] == []
+    assert st["rejected"]["queue_full"] == 1
+    assert st["rejected"]["shed"] == 1

@@ -1,0 +1,260 @@
+"""Sustained mixed-tenant serving load test (ISSUE 18 tentpole bar).
+
+Four tenant sessions — distinct priorities and HBM shares — drive a
+Zipf-repeated query mix CONCURRENTLY through one shared MemoryManager,
+one shared DeviceSemaphore and one admission controller, while:
+
+* the chaos controller injects ``mem.oom`` at the reserve sites,
+* a semaphore holder thread is killed mid-run (the wedge watchdog must
+  reclaim its permit),
+* a pressure burst (nonzero grant pool) forces the controller to SHED
+  low-priority admissions, which must recover once the pool drains.
+
+Acceptance asserted here (the ISSUE 18 bar):
+
+* every admitted query's result is byte-identical to the fault-free
+  baseline;
+* admission latency is bounded (p99 over the event-logged queuedMs);
+* the per-tenant quota census never attributes bytes across tenants
+  and drains to zero (plus the suite-wide zero-leak audit);
+* shed admissions carry a retry-after hint and succeed on retry after
+  the pressure clears;
+* the run is recorded as a BENCH-style ``SERVE_r01.json`` artifact
+  that ``tools/regress.load_bench`` parses (per-tenant throughput as
+  the speedup column).
+"""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from harness import tpu_session
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.aux.fault import ChaosController, install_chaos
+from spark_rapids_tpu.exec.base import ExecContext
+from spark_rapids_tpu.mem import DeviceSemaphore, MemoryManager
+
+pytestmark = pytest.mark.chaos
+
+_RNG = np.random.RandomState(18)
+_N = 4096
+#: integer-only: every result compares EXACTLY across engines/rungs
+_T = pa.table({
+    "k": pa.array(_RNG.randint(0, 17, _N)),
+    "g": pa.array(_RNG.randint(0, 5, _N)),
+    "v": pa.array(_RNG.randint(0, 1000, _N).astype(np.int64)),
+    "u": pa.array(np.arange(_N)),
+})
+
+#: (tenant id, admission priority, HBM share)
+_TENANTS = [("alpha", 3, 0.5), ("beta", 2, 0.5),
+            ("gamma", 1, 0.5), ("delta", 1, 0.5)]
+
+#: Zipf-ish repetition over the query shapes: shape 0 dominates, the
+#: tail shapes recur rarely — the serving access pattern the exec cache
+#: and the admission hold-time EWMA both see in practice
+_ZIPF_MIX = [0, 0, 0, 0, 1, 1, 2]
+
+
+def _mk_session(mm, sem, tenant, priority, share, elog_dir):
+    conf = {"spark.rapids.tpu.admission.enabled": True,
+            "spark.rapids.tpu.admission.maxInFlight": 2,
+            "spark.rapids.tpu.admission.maxQueued": 16,
+            "spark.rapids.tpu.tenant.id": tenant,
+            "spark.rapids.tpu.tenant.priority": priority,
+            "spark.rapids.tpu.tenant.hbmShare": share,
+            "spark.rapids.tpu.eventLog.enabled": True,
+            "spark.rapids.tpu.eventLog.dir": elog_dir,
+            "spark.rapids.tpu.semaphore.wedgeTimeoutMs": 300,
+            # pin the memory-managed operator pipeline (the fused/
+            # distributed paths have their own memory story and skip
+            # the reserve sites this battery pressures)
+            "spark.rapids.tpu.distributed.enabled": False,
+            "spark.rapids.tpu.sql.fusedPipeline.enabled": False}
+    s = tpu_session(conf)
+    s._ctx = ExecContext(s.conf, semaphore=sem, memory=mm)
+    return s
+
+
+def _shapes(s):
+    agg = (s.create_dataframe(_T, num_partitions=3).group_by("k", "g")
+           .agg(F.sum(F.col("v")).with_name("sv"),
+                F.count_star().with_name("n")))
+    flt = (s.create_dataframe(_T, num_partitions=2)
+           .filter(F.col("v") > 500).group_by("k")
+           .agg(F.max(F.col("v")).with_name("mx")))
+    srt = (s.create_dataframe(_T, num_partitions=2)
+           .filter(F.col("g") == 2).order_by(F.col("u").asc()))
+    return [agg, flt, srt]
+
+
+def _canon(df: pd.DataFrame) -> pd.DataFrame:
+    return (df.sort_values(by=list(df.columns), kind="mergesort")
+            .reset_index(drop=True))
+
+
+def _run_mix(s):
+    shapes = _shapes(s)
+    return [(i, _canon(shapes[i].to_pandas())) for i in _ZIPF_MIX]
+
+
+def test_mixed_tenant_serving_under_chaos(tmp_path, monkeypatch):
+    mm = MemoryManager(64 * 1024 * 1024, 1 << 30,
+                       str(tmp_path / "spill"))
+    sem = DeviceSemaphore(2, timeout_s=120.0, wedge_timeout_ms=300,
+                          memory=mm)
+    elogs = {t: str(tmp_path / f"elog_{t}") for t, _, _ in _TENANTS}
+
+    # ---- fault-free baseline through the SAME shared runtime --------
+    base = _mk_session(mm, sem, "baseline", 3, 0.0,
+                       str(tmp_path / "elog_base"))
+    want = {i: df for i, df in _run_mix(base)}
+    base._ctx.close()
+
+    from spark_rapids_tpu.sched import admission as adm_mod
+    ctl = adm_mod.CONTROLLER
+    assert ctl is not None, "admission.enabled did not install"
+
+    # ---- killed semaphore holder: dies HOLDING a permit -------------
+    killer = threading.Thread(target=sem.acquire, name="killed-holder")
+    killer.start()
+    killer.join()
+    time.sleep(0.35)           # past the wedge horizon before load
+
+    # ---- chaos-armed mixed-tenant load ------------------------------
+    install_chaos(ChaosController("mem.oom=p0.08", seed=18))
+    results, errors = {}, {}
+
+    def tenant_run(tenant, priority, share):
+        try:
+            s = _mk_session(mm, sem, tenant, priority, share,
+                            elogs[tenant])
+            try:
+                results[tenant] = _run_mix(s)
+            finally:
+                s._ctx.close()
+        except BaseException as e:   # noqa: BLE001 - surfaced below
+            errors[tenant] = e
+
+    threads = [threading.Thread(target=tenant_run, args=spec,
+                                name=f"tenant-{spec[0]}")
+               for spec in _TENANTS]
+    t_load0 = time.monotonic()
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=180)
+        assert not th.is_alive(), "tenant thread wedged"
+    load_wall_s = time.monotonic() - t_load0
+    install_chaos(None)
+    assert not errors, f"tenant queries failed under chaos: {errors}"
+
+    # fault-free-identical results for every tenant, every repetition
+    for tenant, got in results.items():
+        assert len(got) == len(_ZIPF_MIX)
+        for i, df in got:
+            pd.testing.assert_frame_equal(df, want[i], check_exact=True)
+
+    # the dead holder's permit was reclaimed, not wedged around forever
+    assert sem.wedges >= 1
+
+    # ---- quota census: attribution clean, fully drained -------------
+    st = mm.stats()
+    assert set(st["tenant_used"]) <= {t for t, _, _ in _TENANTS}
+    assert all(v == 0 for v in st["tenant_used"].values()), \
+        f"tenant census residue: {st['tenant_used']}"
+    for t, _, share in _TENANTS:
+        assert st["tenant_quota"][t] == int(share * mm.budget)
+    assert mm.audit_leaks() == []
+
+    # ---- bounded admission latency (p99 over logged queuedMs) -------
+    from spark_rapids_tpu.tools.history import load_events
+    queued_ms = []
+    per_tenant_n = {}
+    for t, d in elogs.items():
+        events, _ = load_events(d)
+        ends = [e for e in events if e.get("event") == "queryEnd"
+                and e.get("ok")]
+        per_tenant_n[t] = len(ends)
+        for e in ends:
+            assert e.get("tenant") == t
+            assert e.get("admission") == "admitted"
+            queued_ms.append(float(e.get("queuedMs")))
+    assert len(queued_ms) == len(_TENANTS) * len(_ZIPF_MIX)
+    p99 = float(np.percentile(queued_ms, 99))
+    assert p99 < 60_000.0, f"unbounded admission latency: p99={p99}ms"
+
+    # ---- shed burst: pressure refuses low-priority, then recovers ---
+    key = ("serve-load-shed",)
+    MemoryManager._instances[key] = mm
+    shed_sess = _mk_session(mm, sem, "gamma", 1, 0.5, elogs["gamma"])
+    try:
+        mm.reserve_granted(1)         # pressure pool nonzero
+        with pytest.raises(adm_mod.AdmissionRejected) as ei:
+            _shapes(shed_sess)[0].collect_arrow()
+        assert ei.value.reason == "shed"
+        assert ei.value.retry_after_s > 0
+        assert ei.value.tenant == "gamma"
+        mm.release_granted(1)
+        # recovery: pool drained past the clear horizon -> the SAME
+        # query admits and returns the baseline bytes
+        from spark_rapids_tpu.ops import server as srv_mod
+        monkeypatch.setattr(srv_mod, "_GRANT_CLEAR_HORIZON_S", 0.0)
+        retry = _canon(_shapes(shed_sess)[0].to_pandas())
+        pd.testing.assert_frame_equal(retry, want[0], check_exact=True)
+    finally:
+        MemoryManager._instances.pop(key, None)
+        shed_sess._ctx.close()
+    shed_events, _ = load_events(elogs["gamma"])
+    shed_recs = [e for e in shed_events if e.get("event") == "queryEnd"
+                 and e.get("admission") == "shed"]
+    assert shed_recs and "AdmissionRejected" in shed_recs[-1]["reason"]
+
+    # ---- controller bookkeeping survived the battery ----------------
+    cst = ctl.stats()
+    assert cst["inFlight"] == 0 and cst["queued"] == []
+    assert cst["admitted"] >= len(queued_ms)
+    assert cst["rejected"].get("shed", 0) >= 1
+
+    # ---- BENCH-style serving artifact (tools/regress-parseable) -----
+    details = {}
+    for t, _, _ in _TENANTS:
+        thr = per_tenant_n[t] / max(load_wall_s, 1e-6)
+        details[t] = {"speedup": round(thr, 3), "placement": "device",
+                      "queries": per_tenant_n[t]}
+    thrs = [d["speedup"] for d in details.values()]
+    artifact = {
+        "geomean": round(float(np.exp(np.mean(np.log(thrs)))), 3),
+        "placement_counts": {"device": len(details)},
+        "details": details,
+        "admission": {"p99QueuedMs": round(p99, 1),
+                      "admitted": cst["admitted"],
+                      "rejected": cst["rejected"]},
+    }
+    out = os.environ.get("SRTPU_SERVE_ARTIFACT",
+                         str(tmp_path / "SERVE_r01.json"))
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump(artifact, f, indent=2, sort_keys=True)
+    from spark_rapids_tpu.tools.regress import load_bench
+    parsed = load_bench(out)
+    assert set(parsed["details"]) == {t for t, _, _ in _TENANTS}
+    assert all(d["speedup"] > 0 for d in parsed["details"].values())
+    assert parsed["geomean"] > 0
+
+
+def test_committed_serve_artifact_parses():
+    """The committed SERVE_r01.json (one recorded run of the battery
+    above) stays tools/regress-parseable — the serving analog of the
+    BENCH_r* regression artifacts."""
+    from spark_rapids_tpu.tools.regress import load_bench
+    path = os.path.join(os.path.dirname(__file__), os.pardir,
+                        "SERVE_r01.json")
+    parsed = load_bench(path)
+    assert set(parsed["details"]) == {t for t, _, _ in _TENANTS}
+    assert parsed["geomean"] > 0
+    assert parsed["placement_counts"] == {"device": 4}
