@@ -634,6 +634,15 @@ def main():
                          for k in cache_warm},
             },
         }
+        # adaptive-execution decisions the LAST engine run made
+        # (ISSUE 19; kind -> count, {} when none fired — schema note in
+        # docs/tuning.md): the ladder artifact shows WHETHER runtime
+        # re-planning touched a rung, not just how fast it went
+        aqe_counts = {}
+        for d in getattr(last_session[0], "last_aqe_decisions",
+                         None) or []:
+            aqe_counts[d["kind"]] = aqe_counts.get(d["kind"], 0) + 1
+        details[name]["aqe"] = aqe_counts
         # emit the metric line NOW — a later failure or timeout (even a
         # wedged best-effort trace run below) must never discard a
         # finished workload's result

@@ -145,11 +145,80 @@ def main(iters: int = 3) -> None:
     finally:
         cl.shutdown()
 
+    # --- skewed_join micro-rung (ISSUE 19): a Zipf key column puts most
+    # of one join side into a single hash partition, so the AQE
+    # read-side re-plan must salt-split it (and coalesce the tiny
+    # remainder) — timed with AQE on, byte-identical vs AQE off
+    from spark_rapids_tpu.config import TpuConf
+    nk = n // 8
+    # zipf(2.5) puts ~75% of rows on key 0: with 3 reduce partitions the
+    # hot partition clears threshold x mean (2.0 x 1/3). Integer values
+    # + a total order keep the differential exact: int sums are
+    # associative, so split/coalesced partial aggs cannot drift
+    zk = np.minimum(rng.zipf(2.5, nk), 64).astype(np.int64) - 1
+    left = pa.table({"k": pa.array(zk),
+                     "v": pa.array(rng.randint(0, 1000, nk)
+                                   .astype(np.int64))})
+    # small multiplicity (~16 matches/key): the rung times the skew
+    # re-plan, not a multiplicative join blow-up
+    right = pa.table({"k2": pa.array(rng.randint(0, 64, 1024)
+                                     .astype(np.int64)),
+                      "w": pa.array(rng.randint(0, 100, 1024)
+                                    .astype(np.int64))})
+
+    def skew_query(s):
+        df = s.create_dataframe(left)
+        return (df.join(s.create_dataframe(right),
+                        on=[(F.col("k"), F.col("k2"))], how="inner")
+                .group_by("k")
+                .agg(F.sum(F.col("v")).with_name("sv"),
+                     F.count_star().with_name("n"))
+                .order_by(F.col("k").asc()))
+
+    def skew_conf(on: bool):
+        # skew.minBytes drops so the CPU-rung byte counts clear the
+        # don't-bother floor; the decision thresholds themselves stay
+        # at their defaults
+        return (TpuConf()
+                .set("spark.rapids.tpu.aqe.enabled", on)
+                .set("spark.rapids.tpu.aqe.skew.minBytes", 64 * 1024))
+
+    best_skew = float("inf")
+    aqe_counts: dict = {}
+    cl = LocalCluster(3, shuffle_join_min_rows=1024, conf=skew_conf(True))
+    try:
+        s = session()
+        sgot = None
+        for _ in range(max(iters, 1)):
+            t0 = time.perf_counter()
+            sgot = cl.execute(skew_query(s)).to_pandas()
+            best_skew = min(best_skew, time.perf_counter() - t0)
+        for d in (s.last_aqe_decisions or []):
+            aqe_counts[d["kind"]] = aqe_counts.get(d["kind"], 0) + 1
+        assert aqe_counts.get("skew_split", 0) >= 1, aqe_counts
+        assert aqe_counts.get("coalesce_partitions", 0) >= 1, aqe_counts
+    finally:
+        cl.shutdown()
+    cl = LocalCluster(3, shuffle_join_min_rows=1024, conf=skew_conf(False))
+    try:
+        s = session()
+        soff = cl.execute(skew_query(s)).to_pandas()
+        assert not (s.last_aqe_decisions or []), s.last_aqe_decisions
+    finally:
+        cl.shutdown()
+    # byte-identity, not allclose: re-planning may only change the
+    # execution shape, never the answer
+    import pandas.testing as pdt
+    pdt.assert_frame_equal(sgot, soff)
+
     print(json.dumps({"q3_s": round(best_q3, 3),
                       "agg_s": round(best_agg, 3),
                       "xproc_sort_s": round(best_sort, 3),
                       "xproc_window_s": round(best_win, 3),
                       "xproc_rows": nc,
+                      "skewed_join_s": round(best_skew, 3),
+                      "skewed_join_rows": nk,
+                      "aqe": aqe_counts,
                       "n_devices": n_dev, "rows": n, "ok": True}))
 
 

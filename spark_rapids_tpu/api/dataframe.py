@@ -84,6 +84,12 @@ class TpuSession:
         #: fault_stats of the last LocalCluster.execute on this session
         #: (the event log's queryEnd picks it up)
         self.last_fault_stats = None
+        #: AqeDecision summaries of the last query (aqe/__init__.py):
+        #: a list of {"kind", "detail", "parts", "shuffle"?} dicts for
+        #: every adaptive re-planning decision the run recorded —
+        #: explain("analyze") renders them, bench.py counts them per
+        #: rung, queryEnd/clusterQuery records carry the kind->count
+        self.last_aqe_decisions = None
         #: engine that ran the last materialized query: "device"/"host"
         self.last_placement = None
         #: coded PlacementReport summary of the last planned query
@@ -678,11 +684,44 @@ class DataFrame:
     def columns(self) -> List[str]:
         return self.plan.schema().names()
 
-    def _physical(self):
-        return plan_query(self.plan, self.session.conf,
+    def _physical(self, conf=None):
+        return plan_query(self.plan, conf or self.session.conf,
                           mesh=getattr(self.session, "mesh", None),
                           mesh_auto=getattr(self.session, "mesh_is_auto",
                                             False))
+
+    def _aqe_feedback_conf(self, aqe_log):
+        """Sentinel-history feedback (ISSUE 19, aqe/feedback.py): a
+        digest whose baseline shows repeated rung>=3 escalation or
+        warm-slowdown flags is admitted with an overlay conf — smaller
+        target batches or host placement — BEFORE planning. Returns the
+        overlay conf, or None on the (common) clean-history path."""
+        if aqe_log is None:
+            return None
+        from .. import aqe as aqe_mod
+        conf = self.session.conf
+        if not bool(conf.get(aqe_mod.AQE_FEEDBACK_ENABLED)):
+            return None
+        from ..ops import sentinel as sentinel_mod
+        sent = sentinel_mod.SENTINEL
+        if sent is None:
+            return None
+        from ..aqe.feedback import plan_feedback
+        from ..metrics.events import plan_digest
+        digest = plan_digest(self.plan)
+        fb = plan_feedback(digest, sent.baselines().get(digest), conf)
+        if fb is None:
+            return None
+        over = conf
+        for k, v in sorted(fb.settings.items()):
+            over = over.set(k, v)
+        try:  # tpulint: never-raise
+            aqe_log.record(aqe_mod.make_decision(
+                aqe_mod.FEEDBACK_REPLAN, detail=fb.reason,
+                parts=len(fb.settings)))
+        except Exception:  # noqa: BLE001 - observability only
+            pass
+        return over
 
     def _execute_wrapped(self, consume):
         """Run the physical plan through the full execution pipeline
@@ -700,7 +739,17 @@ class DataFrame:
         self.session.last_query_metrics = None
         self.session.last_fault_stats = None
         self.session.last_placement_report = None
-        physical = self._physical()
+        self.session.last_aqe_decisions = None
+        # closed-loop AQE (ISSUE 19): install the decision log up front
+        # and mark it, so the finally below can slice out exactly THIS
+        # query's decisions (thread-ident attribution); the feedback
+        # hook may hand back an overlay conf the whole run then uses
+        from .. import aqe as aqe_mod
+        import threading as _threading
+        aqe_log = aqe_mod.ensure_aqe_from_conf(self.session.conf)
+        aqe_mark = aqe_log.mark() if aqe_log is not None else 0
+        run_conf = self._aqe_feedback_conf(aqe_log)
+        physical = self._physical(run_conf)
         report = getattr(physical, "placement_report", None)
         # one summary, three consumers (session attribute, queryStart
         # record, metric increments) — computed once
@@ -719,8 +768,15 @@ class DataFrame:
         from ..aux.metrics import TaskMetrics
         from ..columnar.batch import SpeculativeOverflow
         from ..trace import core as trace_core
-        physical = lore_wrap(physical, self.session.conf)
+        physical = lore_wrap(physical, run_conf or self.session.conf)
         ctx = self.session.exec_context()
+        if run_conf is not None:
+            # batch targets are consumed at EXEC time through ctx.conf
+            # (exec/basic.py), so a feedback overlay needs a context
+            # carrying it — sharing the session context's memory manager
+            # and semaphore so budgets/permits stay per-process
+            ctx = ExecContext(run_conf, semaphore=ctx.semaphore,
+                              memory=ctx.memory)
         from ..metrics import registry as metrics_registry
         mreg0 = metrics_registry.REGISTRY   # installed by the ctx above
         if mreg0 is not None and placement_summary is not None:
@@ -988,6 +1044,16 @@ class DataFrame:
                 mreg.counter("srtpu_queries_total",
                              status="ok" if ok else "failed").inc()
                 mreg.histogram("srtpu_query_seconds").observe(wall_s)
+            # one drain for every consumer (session attribute, queryEnd
+            # record, /queries): this thread drove every decision site
+            # of this query, so the thread filter is the attribution
+            aqe_decs = (aqe_log.since(aqe_mark,
+                                      thread=_threading.get_ident())
+                        if aqe_log is not None else [])
+            aqe_summary = (aqe_mod.summarize(aqe_decs)
+                           if aqe_decs else None)
+            self.session.last_aqe_decisions = \
+                [d.summary() for d in aqe_decs] if aqe_decs else None
             if elog is not None:
                 from ..aux.metrics import metrics_to_json
                 end_rec = {"event": "queryEnd", "queryId": qid,
@@ -1015,6 +1081,11 @@ class DataFrame:
                     end_rec["reason"] = reason
                 if admission_status:
                     end_rec["admission"] = admission_status
+                if aqe_summary:
+                    # compact kind -> count map (ISSUE 19); the full
+                    # per-decision details ride the session attribute
+                    # and the trace, not every event record
+                    end_rec["aqe"] = aqe_summary
                 if degs:
                     # queryStart already shipped the plan-time summary;
                     # degradations are runtime facts, so the END record
@@ -1046,7 +1117,8 @@ class DataFrame:
             if tracker is not None and track_tok is not None:
                 tracker.end(track_tok, ok=ok,
                             wall_ms=wall_s * 1000.0, rung=ladder_rung,
-                            reason=reason, degraded=bool(degs))
+                            reason=reason, degraded=bool(degs),
+                            aqe=aqe_summary)
             if ok and not side_effects and not degs:
                 # (a degraded run's wall mixes failed attempts and the
                 # emergency host path — never feed it to the cost model)
@@ -1267,6 +1339,14 @@ class DataFrame:
         decision = getattr(holder["physical"], "placement_decision", None)
         if decision:
             out = f"placement: {decision}\n" + out
+        if self.session.last_aqe_decisions:
+            # the run's closed-taxonomy AQE decisions (ISSUE 19,
+            # docs/aqe.md): ANALYZE output alone shows what the
+            # adaptive layer changed about the plan it just executed
+            lines = "".join(
+                f"  {d['kind']}: {d['detail']}\n"
+                for d in self.session.last_aqe_decisions)
+            out += "adaptive execution decisions:\n" + lines
         return out
 
 

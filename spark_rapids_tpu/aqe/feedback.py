@@ -1,0 +1,87 @@
+"""Sentinel-history feedback: self-healing admission re-planning.
+
+The regression sentinel (ops/sentinel.py) folds every queryEnd into a
+per-digest baseline; since ISSUE 19 that baseline also counts how often
+the digest escalated the OOM ladder to rung >= 3 (``highRungs``) and
+how often it flagged warm-slowdown (``warmSlowdowns``). This module
+turns those counters into an admission-time overlay: BEFORE the plan is
+lowered, a digest with a bad history is re-planned
+
+* with QUARTERED target batch sizes when it repeatedly hit rung >= 3 —
+  the ladder's rung-2 split, applied pre-emptively so the query never
+  pays the failed full-size attempts again; or
+* onto the HOST engine when it repeatedly flagged warm-slowdown on the
+  device — the same conf the query-level OOM ladder's final rung uses
+  (``spark.rapids.tpu.sql.enabled=false``), chosen up front.
+
+The overlay is a derived conf, not a mutation: the session conf — and
+every other digest — is untouched, and the decision is recorded as a
+``feedback_replan`` AqeDecision on the query's record (docs/aqe.md).
+Thresholds are deliberately sticky: a digest that needed rung 3 twice
+keeps its smaller batches even after the re-planned runs come back
+healthy — the baseline remembers WHY they are healthy.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+#: how many rung>=3 folds / warm-slowdown flags a digest's baseline
+#: must accumulate before feedback re-plans it (2 = "repeatedly":
+#: one bad run can be noise, two is a pattern)
+HIGH_RUNG_REPEATS = 2
+WARM_SLOWDOWN_REPEATS = 2
+
+#: the smaller-batch overlay divides both batch targets by this
+#: (mirrors one SplitAndRetry halving applied twice, the ladder's
+#: observed stable point for repeat offenders)
+BATCH_SHRINK_FACTOR = 4
+MIN_BATCH_BYTES = 1 << 20
+MIN_BATCH_ROWS = 4096
+
+__all__ = ["FeedbackPlan", "plan_feedback", "HIGH_RUNG_REPEATS",
+           "WARM_SLOWDOWN_REPEATS", "BATCH_SHRINK_FACTOR"]
+
+
+class FeedbackPlan:
+    """One admission-time re-plan: conf ``settings`` to overlay and the
+    human-readable ``reason`` the AqeDecision carries."""
+
+    __slots__ = ("mode", "settings", "reason")
+
+    def __init__(self, mode: str, settings: dict, reason: str):
+        self.mode = mode            # smaller_batches | host
+        self.settings = settings
+        self.reason = reason
+
+
+def plan_feedback(digest: Optional[str], baseline: Optional[dict],
+                  conf) -> Optional[FeedbackPlan]:
+    """Consult one digest's sentinel baseline; returns the overlay to
+    apply at admission, or None when history is clean (the common
+    path: two dict lookups)."""
+    if not digest or not baseline:
+        return None
+    high = int(baseline.get("highRungs") or 0)
+    warm = int(baseline.get("warmSlowdowns") or 0)
+    if high >= HIGH_RUNG_REPEATS:
+        from ..config import BATCH_SIZE_BYTES, BATCH_SIZE_ROWS
+        cur_b = int(conf.get(BATCH_SIZE_BYTES))
+        cur_r = int(conf.get(BATCH_SIZE_ROWS))
+        new_b = max(MIN_BATCH_BYTES, cur_b // BATCH_SHRINK_FACTOR)
+        new_r = max(MIN_BATCH_ROWS, cur_r // BATCH_SHRINK_FACTOR)
+        if new_b >= cur_b and new_r >= cur_r:
+            return None         # already at the floor: nothing to shrink
+        return FeedbackPlan(
+            "smaller_batches",
+            {"spark.rapids.tpu.sql.batchSizeBytes": new_b,
+             "spark.rapids.tpu.sql.batchSizeRows": new_r},
+            f"digest {digest} hit OOM ladder rung>=3 {high}x — admitted "
+            f"with batchSizeBytes {cur_b}->{new_b}, "
+            f"batchSizeRows {cur_r}->{new_r}")
+    if warm >= WARM_SLOWDOWN_REPEATS:
+        return FeedbackPlan(
+            "host",
+            {"spark.rapids.tpu.sql.enabled": False},
+            f"digest {digest} flagged warm-slowdown {warm}x on the "
+            "device — admitted on the host engine")
+    return None

@@ -1,0 +1,149 @@
+"""Shuffle-boundary re-planning: observed partition statistics -> read
+units (the Spark AQE OptimizeSkewedJoin / CoalesceShufflePartitions
+analog, planned from real MapOutputStatistics instead of estimates).
+
+The cluster driver folds every map task's per-partition (rows, bytes)
+into a :class:`ShuffleStats` snapshot at materialization time
+(shuffle/cluster.py ``_materialize``), then — before any reducer
+launches — asks :func:`plan_reduce_units` how the reduce side should
+read the shuffle. Pure functions over plain data: the same stats always
+yield the same units, which is what keeps lineage re-execution (and the
+chaos battery's byte-identity contract) safe with AQE on.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["ShuffleStats", "ReadUnit", "plan_reduce_units", "split_width"]
+
+
+class ShuffleStats:
+    """Observed per-partition statistics of one materialized shuffle."""
+
+    __slots__ = ("shuffle_id", "rows", "bytes", "n_parts")
+
+    def __init__(self, shuffle_id: int,
+                 part_stats: Dict[int, Tuple[int, int]], n_parts: int):
+        self.shuffle_id = shuffle_id
+        self.rows = {p: int(rb[0]) for p, rb in part_stats.items()}
+        self.bytes = {p: int(rb[1]) for p, rb in part_stats.items()}
+        self.n_parts = int(n_parts)
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(self.bytes.values())
+
+    @property
+    def total_rows(self) -> int:
+        return sum(self.rows.values())
+
+    @property
+    def mean_bytes(self) -> float:
+        return self.total_bytes / self.n_parts if self.n_parts else 0.0
+
+    def part_bytes(self, p: int) -> int:
+        return self.bytes.get(p, 0)
+
+    def summary(self) -> dict:
+        return {"shuffle": self.shuffle_id, "parts": self.n_parts,
+                "rows": self.total_rows, "bytes": self.total_bytes,
+                "max": max(self.bytes.values(), default=0)}
+
+
+class ReadUnit:
+    """One reduce-side task after re-planning: which partitions of
+    which shuffle it reads, and which partition's owner runs it.
+    ``order`` keeps driver-side concatenation in partition order (sort
+    ranges stay globally ordered through coalescing; split sub-parts
+    slot where their parent partition sat)."""
+
+    __slots__ = ("sid", "parts", "owner_part", "order", "kind")
+
+    def __init__(self, sid: int, parts: List[int], owner_part: int,
+                 order: Tuple[int, int], kind: str = "plain"):
+        self.sid = sid
+        self.parts = list(parts)
+        self.owner_part = int(owner_part)
+        self.order = order
+        self.kind = kind            # plain | coalesced | split
+
+    def __repr__(self):
+        return (f"ReadUnit(sid={self.sid}, parts={self.parts}, "
+                f"owner={self.owner_part}, kind={self.kind})")
+
+
+def is_skewed(size: int, mean: float, ratio: float, min_bytes: int) -> bool:
+    """The profiler's skew condition (tools/profile SKEW_RATIO /
+    SKEW_MIN_BYTES), now a planning predicate."""
+    return size >= min_bytes and mean > 0 and size > ratio * mean
+
+
+def split_width(size: int, mean: float, n_parts: int) -> int:
+    """How many sub-partitions a skewed partition splits into: its
+    multiple of the mean, clamped to [2, n_parts] (sub-partition j
+    lands on the j-th owner, so the cluster width is the ceiling)."""
+    k = int(round(size / mean)) if mean > 0 else 2
+    return max(2, min(int(n_parts), k))
+
+
+def plan_reduce_units(stats: ShuffleStats, *, target_bytes: int,
+                      skew_threshold: float, skew_min_bytes: int,
+                      allow_split: bool = True,
+                      allow_coalesce: bool = True
+                      ) -> Tuple[List[ReadUnit], Dict[int, int], int]:
+    """Re-plan one shuffle's reduce side from its observed stats.
+
+    Returns ``(units, splits, coalesced_groups)`` where ``units``
+    covers every partition exactly once in partition order and
+    ``splits`` maps each skewed partition to its sub-partition width.
+    A skewed partition (``allow_split``) becomes a placeholder split
+    unit per sub-partition (``sid`` = -1) — the caller materializes the
+    salted re-shuffle and rewrites ``sid`` to the new shuffle id. Runs
+    of consecutive non-skewed partitions whose combined bytes stay
+    under ``target_bytes`` merge into one unit (``allow_coalesce``);
+    empty partitions ride along with their neighbors.
+    """
+    n = stats.n_parts
+    mean = stats.mean_bytes
+    splits: Dict[int, int] = {}
+    if allow_split:
+        for p in range(n):
+            if is_skewed(stats.part_bytes(p), mean,
+                         skew_threshold, skew_min_bytes):
+                splits[p] = split_width(stats.part_bytes(p), mean, n)
+    split_set = set(splits)
+    units: List[ReadUnit] = []
+    coalesced = 0
+    group: List[int] = []
+    acc = 0
+
+    def flush():
+        nonlocal group, acc, coalesced
+        if not group:
+            return
+        kind = "coalesced" if len(group) > 1 else "plain"
+        if kind == "coalesced":
+            coalesced += 1
+        units.append(ReadUnit(stats.shuffle_id, group, group[0],
+                              (group[0], 0), kind=kind))
+        group, acc = [], 0
+
+    for p in range(n):
+        if p in split_set:
+            flush()
+            for j in range(splits[p]):
+                units.append(ReadUnit(-1, [j], j, (p, j), kind="split"))
+            continue
+        b = stats.part_bytes(p)
+        if not allow_coalesce:
+            group, acc = [p], b
+            flush()
+            continue
+        if group and acc + b > target_bytes:
+            flush()
+        group.append(p)
+        acc += b
+        if acc >= target_bytes:
+            flush()
+    flush()
+    return units, splits, coalesced

@@ -72,6 +72,11 @@ class ExecContext:
         # pays one module-global load + branch (sched/admission.py)
         from ..sched.admission import ensure_admission_from_conf
         ensure_admission_from_conf(self.conf)
+        # adaptive query execution (ISSUE 19): the closed-taxonomy
+        # decision log, installed iff spark.rapids.tpu.aqe.enabled —
+        # off, every decision site is one module load + branch
+        from ..aqe import ensure_aqe_from_conf
+        ensure_aqe_from_conf(self.conf)
         from ..config import SEMAPHORE_WEDGE_TIMEOUT_MS, TASK_TIMEOUT
         self.memory = memory or MemoryManager.get(self.conf)
         self.semaphore = semaphore or DeviceSemaphore(

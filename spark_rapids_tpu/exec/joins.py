@@ -899,8 +899,25 @@ class TpuBroadcastHashJoinExec(TpuHashJoinExec):
             # over-eager broadcast flips back to shuffled next planning
             from ..plan.cost import record_runtime_size
             frac = bb.num_rows / max(bb.padded_len or bb.num_rows, 1)
-            record_runtime_size(sigs[bi],
-                                int(bb.device_size_bytes() * frac))
+            measured = int(bb.device_size_bytes() * frac)
+            record_runtime_size(sigs[bi], measured)
+            from .. import aqe as aqe_mod
+            log = aqe_mod.LOG
+            if log is not None:
+                from ..aqe import AQE_BROADCAST_DEMOTE_ENABLED
+                from ..config import AUTO_BROADCAST_THRESHOLD
+                thr = int(ctx.conf.get(AUTO_BROADCAST_THRESHOLD))
+                if (thr >= 0 and measured > thr
+                        and ctx.conf.get(AQE_BROADCAST_DEMOTE_ENABLED)):
+                    try:  # tpulint: never-raise
+                        log.record(aqe_mod.make_decision(
+                            aqe_mod.BROADCAST_DEMOTE,
+                            detail=f"build side measured {measured}B > "
+                                   f"threshold {thr}B; next planning "
+                                   "uses shuffled join",
+                            parts=1))
+                    except Exception:
+                        pass
         # runtime bloom filter: built ONCE from the broadcast build side,
         # applied to every stream batch (build side must be right — the
         # filter drops stream=left rows whose keys cannot match). Like

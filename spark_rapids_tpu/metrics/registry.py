@@ -428,3 +428,22 @@ declare_metric("srtpu_tenant_hbm_quota_bytes", "gauge",
                "Per-tenant HBM quota in bytes (spark.rapids.tpu."
                "tenant.hbmShare x the device budget), labeled "
                "tenant=<id>; 0 rows are not exported.")
+declare_metric("srtpu_aqe_replans_total", "counter",
+               "Adaptive-execution decisions recorded by the AQE log, "
+               "labeled kind=<decision kind from the aqe/ closed "
+               "taxonomy: coalesce_partitions|skew_split|"
+               "broadcast_demote|broadcast_promote|cost_replan|"
+               "feedback_replan> (aqe/__init__.py, docs/aqe.md).")
+declare_metric("srtpu_aqe_coalesced_partitions_total", "counter",
+               "Shuffle partitions merged into larger reduce units by "
+               "AQE coalescing (cluster boundary re-planning plus the "
+               "single-process adaptive reader).")
+declare_metric("srtpu_aqe_skew_splits_total", "counter",
+               "Sub-partitions created by AQE skew splits (salted "
+               "re-partition of oversized shuffle partitions; for "
+               "shuffled joins both sides split co-partitioned).")
+declare_metric("srtpu_aqe_broadcast_demotions_total", "counter",
+               "Broadcast build sides observed LARGER than the "
+               "auto-broadcast threshold at materialization; the "
+               "measured size re-plans the next run of the shape to a "
+               "shuffled join (exec/joins.py, docs/aqe.md).")

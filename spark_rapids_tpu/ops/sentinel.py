@@ -147,13 +147,22 @@ def fold_record(baselines: Dict[str, dict], rec: dict, *,
                          "baselineRung": int(b.get("maxRung") or 0)})
     if b is None:
         b = baselines[digest] = {"walls": [], "verdict": None,
-                                 "maxRung": 0, "compileS": 0.0, "n": 0}
+                                 "maxRung": 0, "compileS": 0.0, "n": 0,
+                                 "highRungs": 0, "warmSlowdowns": 0}
     if ok and compile_free and wall is not None:
         b["walls"] = (b.get("walls") or []) + [round(float(wall), 3)]
         b["walls"] = b["walls"][-max(1, int(window)):]
     if verdict in ("device", "host"):
         b["verdict"] = verdict
     b["maxRung"] = max(int(b.get("maxRung") or 0), rung)
+    # AQE feedback counters (ISSUE 19, aqe/feedback.py): how OFTEN this
+    # digest hit the pressure-spill rung or a warm slowdown — maxRung
+    # says "ever", the feedback loop needs "repeatedly". .get-defaulted
+    # so baselines persisted before these keys existed keep folding.
+    if rung >= 3:
+        b["highRungs"] = int(b.get("highRungs") or 0) + 1
+    if any(r["kind"] == "warm_slowdown" for r in regs):
+        b["warmSlowdowns"] = int(b.get("warmSlowdowns") or 0) + 1
     b["compileS"] = round(float(b.get("compileS") or 0.0)
                           + float(rec.get("compileS") or 0.0), 4)
     b["n"] = int(b.get("n") or 0) + 1

@@ -122,7 +122,8 @@ class QueryTracker:
 
     def end(self, token: int, ok: bool, wall_ms: Optional[float] = None,
             rung: int = 0, reason: Optional[str] = None,
-            degraded: bool = False) -> None:
+            degraded: bool = False,
+            aqe: Optional[dict] = None) -> None:
         with self._lock:
             rec = self._inflight.pop(token, None)
             if rec is None:
@@ -136,6 +137,10 @@ class QueryTracker:
             rec["ladderRung"] = int(rung or 0)
             if reason:
                 rec["reason"] = str(reason)
+            if aqe:
+                # AQE decision summary (ISSUE 19): kind -> count, the
+                # same compact map the queryEnd record carries
+                rec["aqe"] = dict(aqe)
             self._recent.append(rec)
 
     def snapshot(self) -> dict:

@@ -664,18 +664,54 @@ class JoinMeta(PlanMeta):
             # subtree beats any estimate (the AQE stage-stats analog,
             # ref GpuCustomShuffleReaderExec)
             meas = runtime_size(plan_signature(child))
-            return meas if meas is not None \
-                else estimated_size_bytes(child)
+            est = estimated_size_bytes(child)
+            return (meas if meas is not None else est), meas, est
         r_ok = p.join_type in ("inner", "left", "leftsemi", "leftanti")
         l_ok = p.join_type in ("inner", "right")
-        rs = side_size(p.children[1]) if r_ok else None
-        ls = side_size(p.children[0]) if l_ok else None
-        cand = []
-        if rs is not None and rs <= thr:
-            cand.append((rs, "right"))
-        if ls is not None and ls <= thr:
-            cand.append((ls, "left"))
-        return min(cand)[1] if cand else None
+        rs, rm, re_ = side_size(p.children[1]) if r_ok else (None,) * 3
+        ls, lm, le = side_size(p.children[0]) if l_ok else (None,) * 3
+        cand, est_cand = [], []
+        for sz, est, side in ((rs, re_, "right"), (ls, le, "left")):
+            if sz is not None and sz <= thr:
+                cand.append((sz, side))
+            if est is not None and est <= thr:
+                est_cand.append((est, side))
+        choice = min(cand)[1] if cand else None
+        est_choice = min(est_cand)[1] if est_cand else None
+        if choice != est_choice:
+            self._aqe_broadcast_decision(choice, est_choice, thr,
+                                         {"right": rm, "left": lm})
+        return choice
+
+    def _aqe_broadcast_decision(self, choice, est_choice, thr, measured):
+        """AQE join-strategy switch surfaced as a decision: a MEASURED
+        side size flipped the broadcast pick away from what the
+        plan-time estimate alone would have chosen."""
+        from .. import aqe as aqe_mod
+        log = aqe_mod.LOG
+        if log is None:
+            return
+        try:  # tpulint: never-raise
+            from ..aqe import AQE_BROADCAST_DEMOTE_ENABLED
+            if choice is None:
+                # estimate said broadcast, measurement came in over
+                if not self.conf.get(AQE_BROADCAST_DEMOTE_ENABLED):
+                    return
+                log.record(aqe_mod.make_decision(
+                    aqe_mod.BROADCAST_DEMOTE,
+                    detail=f"{est_choice} side measured "
+                           f"{measured.get(est_choice)}B > threshold "
+                           f"{thr}B -> shuffled join", parts=1))
+            else:
+                # estimate said shuffle (or the other side), the
+                # measured side came in under the threshold
+                log.record(aqe_mod.make_decision(
+                    aqe_mod.BROADCAST_PROMOTE,
+                    detail=f"{choice} side measured "
+                           f"{measured.get(choice)}B <= threshold "
+                           f"{thr}B -> broadcast join", parts=1))
+        except Exception:
+            pass
 
     def convert_to_tpu(self, children):
         from ..exec.joins import (TpuBroadcastHashJoinExec, TpuHashJoinExec,

@@ -95,6 +95,18 @@ class ShuffleExchangeExec(TpuExec):
         def flush():
             if len(pending) > 1:     # metric counts actual merges only
                 coalesced_m.add(len(pending))
+                from .. import aqe as aqe_mod
+                log = aqe_mod.LOG
+                if log is not None:
+                    try:  # tpulint: never-raise
+                        log.record(aqe_mod.make_decision(
+                            aqe_mod.COALESCE_PARTITIONS,
+                            detail=f"merged {len(pending)} shuffle "
+                                   f"partitions (~{pending_bytes}B) "
+                                   f"under target {target}B",
+                            parts=len(pending)))
+                    except Exception:
+                        pass
             return (pending[0] if len(pending) == 1
                     else concat_batches(pending))
 

@@ -107,7 +107,8 @@ def build_history(events: List[dict]) -> List[dict]:
                  "status": "lost", "durationMs": None,
                  "trace": None, "faultStats": None, "metrics": None,
                  "reason": None, "degraded": False,
-                 "tenant": None, "queuedMs": None, "admission": None}
+                 "tenant": None, "queuedMs": None, "admission": None,
+                 "aqe": None}
             starts[rec.get("queryId")] = q
             out.append(q)
         elif kind == "queryEnd":
@@ -131,6 +132,9 @@ def build_history(events: List[dict]) -> List[dict]:
             q["tenant"] = rec.get("tenant")
             q["queuedMs"] = rec.get("queuedMs")
             q["admission"] = rec.get("admission")
+            # adaptive execution summary (ISSUE 19): the queryEnd
+            # record's kind -> count map of AqeDecisions
+            q["aqe"] = rec.get("aqe")
             if q["degraded"] and q["status"] == "ok":
                 q["status"] = "degraded"
     return out
@@ -156,6 +160,11 @@ def format_history(history: List[dict], skipped: int = 0,
         elif q.get("queuedMs"):
             reason = (f"queued {q['queuedMs']}ms; {reason}" if reason
                       else f"queued {q['queuedMs']}ms")
+        if q.get("aqe"):
+            # compact AQE summary (ISSUE 19): aqe=kind:count,...
+            aqe_txt = "aqe=" + ",".join(
+                f"{k}:{q['aqe'][k]}" for k in sorted(q["aqe"]))
+            reason = f"{aqe_txt}; {reason}" if reason else aqe_txt
         lines.append(
             f"{str(q.get('queryId') or '?'):>4}  "
             f"{q.get('status') or '?':<8} "

@@ -180,6 +180,16 @@ def analyze(events: List[dict]) -> dict:
         s["mean"] = mean
         s["skew"] = (s["max"] / mean) if mean > 0 else 0.0
 
+    # AQE decisions ride the trace as aqe.<kind> instants (ISSUE 19,
+    # aqe/__init__.py AqeLog.record): count them by kind so the report
+    # — and the skew recommendation — can tell whether the adaptive
+    # layer already acted on what the histogram shows
+    aqe: Dict[str, int] = defaultdict(int)
+    for e in events:
+        if e.get("ph") == "i" and str(e.get("name", "")).startswith("aqe."):
+            aqe[e["name"][len("aqe."):]] += 1
+    aqe = dict(aqe)
+
     total_exec_us = sum(v["self_us"] for v in ops.values())
     workers = sorted({(e.get("args") or {}).get("worker")
                       for e in events
@@ -208,10 +218,11 @@ def analyze(events: List[dict]) -> dict:
                         "crc_rejects": crc_rejects},
             "total_exec_us": total_exec_us,
             "workers": workers, "lanes": lanes,
+            "aqe": aqe,
             "recommendations": _recommend(
                 shuffles, retries, splits, spill_n, sem_us,
                 total_exec_us, h2d_n, h2d_b, h2d_us, d2h_us,
-                compile_us)}
+                compile_us, aqe=aqe)}
 
 
 #: thresholds for the recommendation rules (module-level so tests and
@@ -224,8 +235,10 @@ SMALL_H2D_BYTES = 4 << 20
 
 def _recommend(shuffles, retries, splits, spills, sem_us,
                total_exec_us, h2d_n, h2d_b, h2d_us, d2h_us,
-               compile_us: float = 0.0) -> List[str]:
+               compile_us: float = 0.0,
+               aqe: Optional[Dict[str, int]] = None) -> List[str]:
     recs: List[str] = []
+    aqe = aqe or {}
     if total_exec_us > 0 and compile_us > 0.5 * total_exec_us:
         recs.append(
             f"compile time ({_ms(compile_us)}) rivals exec self time: "
@@ -241,13 +254,27 @@ def _recommend(shuffles, retries, splits, spills, sem_us,
                 f"(raise spark.rapids.tpu.sql.autoBroadcastJoinThreshold "
                 f"above {s['bytes']})")
         if s["skew"] >= SKEW_RATIO and s["max"] >= SKEW_MIN_BYTES:
-            recs.append(
-                f"shuffle {sid} is skewed: largest partition "
-                f"{_fmt_bytes(s['max'])} vs mean "
-                f"{_fmt_bytes(int(s['mean']))} "
-                f"({s['skew']:.1f}x) — raise "
-                f"spark.rapids.tpu.sql.shuffle.partitions or salt the "
-                f"hot key")
+            if aqe.get("skew_split"):
+                # the adaptive layer already split this run's skewed
+                # partitions; the histogram shows the PRE-split sizes
+                recs.append(
+                    f"shuffle {sid} is skewed: largest partition "
+                    f"{_fmt_bytes(s['max'])} vs mean "
+                    f"{_fmt_bytes(int(s['mean']))} ({s['skew']:.1f}x) — "
+                    f"AQE split it at run time "
+                    f"({aqe['skew_split']} skew_split decision(s)); "
+                    f"tune spark.rapids.tpu.aqe.skew.threshold if the "
+                    f"reduce is still imbalanced")
+            else:
+                recs.append(
+                    f"shuffle {sid} is skewed: largest partition "
+                    f"{_fmt_bytes(s['max'])} vs mean "
+                    f"{_fmt_bytes(int(s['mean']))} "
+                    f"({s['skew']:.1f}x) — enable "
+                    f"spark.rapids.tpu.aqe.enabled so the runtime "
+                    f"salt-splits it, or raise "
+                    f"spark.rapids.tpu.sql.shuffle.partitions / salt "
+                    f"the hot key")
     if retries + splits > 0 or spills > 0:
         recs.append(
             f"memory pressure ({retries} OOM retries, {splits} splits, "
@@ -359,6 +386,13 @@ def format_report(a: dict, source: str = "") -> str:
                  f"CRC rejects: {sh['crc_rejects']}")
     else:
         L.append("(no shuffle spans in trace)")
+    if a.get("aqe"):
+        # only when the trace carries aqe.<kind> instants — traces from
+        # aqe-off runs (and pre-AQE goldens) render unchanged
+        L.append("")
+        L.append("== Adaptive execution decisions ==")
+        for kind in sorted(a["aqe"]):
+            L.append(f"{kind}: {a['aqe'][kind]}")
     L.append("")
     L.append("== Recommendations ==")
     for i, r in enumerate(a["recommendations"], 1):

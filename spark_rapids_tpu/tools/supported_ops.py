@@ -102,6 +102,7 @@ def _load_registries():
               "spark_rapids_tpu.ops.flight",
               "spark_rapids_tpu.ops.sentinel",
               "spark_rapids_tpu.sched.admission",
+              "spark_rapids_tpu.aqe",
               "spark_rapids_tpu.tools.regress",
               "spark_rapids_tpu.udf.compiler",
               "spark_rapids_tpu.delta.table",

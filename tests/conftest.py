@@ -98,6 +98,16 @@ def _clear_admission():
 
 
 @pytest.fixture(autouse=True)
+def _clear_aqe():
+    """The AQE decision log is process-global (aqe/__init__.py, same
+    install pattern as the tracer) and aqe.enabled defaults ON; never
+    let one test's decisions leak into another's per-query drain."""
+    yield
+    from spark_rapids_tpu.aqe import install_aqe
+    install_aqe(None)
+
+
+@pytest.fixture(autouse=True)
 def _assert_no_leaked_spillables():
     """Suite-wide zero-leak check (ref cudf MemoryCleaner at shutdown,
     Plugin.scala:573-588): every SpillableBatch must be closed by the

@@ -1,0 +1,434 @@
+"""Closed-loop adaptive query execution (ISSUE 19, aqe/):
+shuffle-boundary re-planning from observed partition statistics, the
+closed decision taxonomy, sentinel-history feedback, and every
+observability surface the decisions flow to. Reference analog: Spark
+AQE + the plugin's GpuCustomShuffleReaderExec stage re-optimization.
+
+The acceptance bar throughout: AQE may only change the EXECUTION SHAPE
+of a query, never its answer — the skewed-join battery asserts
+byte-identity against an AQE-off run of the same cluster shape."""
+import threading
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from harness import tpu_session
+from spark_rapids_tpu.api import functions as F
+
+pytestmark = pytest.mark.filterwarnings("ignore::ResourceWarning")
+
+
+# ---------------------------------------------------------------------------
+# planner: pure re-planning over observed stats
+# ---------------------------------------------------------------------------
+
+def _stats(sizes, sid=7):
+    from spark_rapids_tpu.aqe.planner import ShuffleStats
+    return ShuffleStats(sid, {i: (max(1, s // 8), s)
+                              for i, s in enumerate(sizes)}, len(sizes))
+
+
+def test_planner_coalesces_small_runs():
+    from spark_rapids_tpu.aqe.planner import plan_reduce_units
+    units, splits, coalesced = plan_reduce_units(
+        _stats([100] * 8), target_bytes=450,
+        skew_threshold=2.0, skew_min_bytes=1 << 20)
+    assert not splits
+    assert coalesced == 2               # two runs of 4 x 100B under 450B
+    # every partition covered exactly once, in partition order
+    assert [p for u in units for p in u.parts] == list(range(8))
+    assert {u.kind for u in units} == {"coalesced"}
+
+
+def test_planner_splits_skewed_partition():
+    from spark_rapids_tpu.aqe.planner import plan_reduce_units
+    units, splits, coalesced = plan_reduce_units(
+        _stats([100, 100, 100_000, 100]), target_bytes=1000,
+        skew_threshold=2.0, skew_min_bytes=1024)
+    # part 2 is ~4x the mean: split into 4 sub-partitions, clamped to n
+    assert splits == {2: 4}
+    sub = [u for u in units if u.kind == "split"]
+    assert len(sub) == 4
+    # placeholder sid until the caller materializes the salted shuffle
+    assert all(u.sid == -1 for u in sub)
+    # sub-partitions slot where the parent partition sat
+    orders = [u.order for u in units]
+    assert orders == sorted(orders)
+
+
+def test_planner_respects_gates_and_empty_stats():
+    from spark_rapids_tpu.aqe.planner import plan_reduce_units
+    units, splits, coalesced = plan_reduce_units(
+        _stats([]), target_bytes=100, skew_threshold=2.0,
+        skew_min_bytes=10)
+    assert units == [] and splits == {} and coalesced == 0
+    # min-bytes floor: a "skewed" ratio below the absolute floor never
+    # splits (splitting tiny partitions only adds task overhead)
+    units, splits, _ = plan_reduce_units(
+        _stats([10, 10, 10_000, 10]), target_bytes=5,
+        skew_threshold=2.0, skew_min_bytes=1 << 20)
+    assert not splits and all(u.kind == "plain" for u in units)
+    # allow_split/allow_coalesce off (sort keeps ranges, window keeps
+    # hash partitions): one plain unit per partition
+    units, splits, coalesced = plan_reduce_units(
+        _stats([100, 100, 100_000, 100]), target_bytes=10**9,
+        skew_threshold=2.0, skew_min_bytes=1024,
+        allow_split=False, allow_coalesce=False)
+    assert not splits and coalesced == 0
+    assert [u.parts for u in units] == [[0], [1], [2], [3]]
+
+
+# ---------------------------------------------------------------------------
+# the closed taxonomy + log attribution
+# ---------------------------------------------------------------------------
+
+def test_decision_taxonomy_is_closed():
+    from spark_rapids_tpu import aqe
+    with pytest.raises(ValueError):
+        aqe.make_decision("repartition_everything")
+    d = aqe.make_decision(aqe.SKEW_SPLIT, detail="x", shuffle=3, parts=4)
+    assert d.summary() == {"kind": "skew_split", "detail": "x",
+                           "parts": 4, "shuffle": 3}
+    # every per-kind metric row maps back to a registered kind
+    assert set(aqe._KIND_COUNTER) <= set(aqe.DECISION_KINDS)
+
+
+def test_log_mark_since_thread_attribution():
+    from spark_rapids_tpu import aqe
+    log = aqe.AqeLog()
+    mark = log.mark()
+    log.record(aqe.make_decision(aqe.COALESCE_PARTITIONS, parts=3))
+    t = threading.Thread(target=lambda: log.record(
+        aqe.make_decision(aqe.SKEW_SPLIT, parts=2)))
+    t.start()
+    t.join()
+    # the thread filter slices out exactly this query-driving thread's
+    # decisions (per-query attribution under concurrent sessions)
+    mine = log.since(mark, thread=threading.get_ident())
+    assert [d.kind for d in mine] == ["coalesce_partitions"]
+    assert aqe.summarize(log.since(mark)) == {"coalesce_partitions": 1,
+                                              "skew_split": 1}
+
+
+def test_decision_fans_out_to_metrics_and_trace():
+    from spark_rapids_tpu import aqe
+    from spark_rapids_tpu.metrics import install_metrics
+    from spark_rapids_tpu.metrics.registry import MetricRegistry
+    from spark_rapids_tpu.trace import install_tracer
+    from spark_rapids_tpu.trace.core import Tracer
+    reg = install_metrics(MetricRegistry())
+    tr = install_tracer(Tracer())
+    log = aqe.install_aqe(aqe.AqeLog())
+    log.record(aqe.make_decision(aqe.SKEW_SPLIT, parts=3, shuffle=9))
+    snap = reg.snapshot()
+    replans = snap["srtpu_aqe_replans_total"]["series"]
+    assert [(s["labels"], s["value"]) for s in replans] == \
+        [({"kind": "skew_split"}, 1)]
+    splits = snap["srtpu_aqe_skew_splits_total"]["series"]
+    assert splits[0]["value"] == 3          # counts sub-partitions
+    evs = [e for e in tr.drain() if e.get("name") == "aqe.skew_split"]
+    assert len(evs) == 1 and evs[0]["args"]["parts"] == 3
+
+
+# ---------------------------------------------------------------------------
+# single-process surfaces: adaptive reader, explain, event log, history
+# ---------------------------------------------------------------------------
+
+def _kv_table(n=4000, seed=3):
+    rng = np.random.RandomState(seed)
+    return pa.table({"k": pa.array(rng.randint(0, 16, n).astype(np.int64)),
+                     "v": pa.array(rng.randint(0, 100, n).astype(np.int64))})
+
+
+def _adaptive_query(s, t):
+    # repartition WITHOUT an explicit count is adaptive_ok: the
+    # exchange's adaptive reader may coalesce sub-target partitions
+    return (s.create_dataframe(t).repartition(F.col("k"))
+            .group_by("k").agg(F.sum(F.col("v")).with_name("sv"))
+            .order_by(F.col("k").asc()))
+
+
+def test_adaptive_reader_records_coalesce_and_explain_analyze():
+    t = _kv_table()
+    s = tpu_session()
+    df = _adaptive_query(s, t)
+    got = df.collect_arrow().to_pandas()
+    decs = s.last_aqe_decisions or []
+    assert any(d["kind"] == "coalesce_partitions" for d in decs), decs
+    txt = df.explain("analyze")
+    assert "adaptive execution decisions:" in txt, txt
+    assert "coalesce_partitions:" in txt, txt
+    # answers unchanged by the merge
+    want = (t.to_pandas().groupby("k", as_index=False)
+            .agg(sv=("v", "sum")).sort_values("k").reset_index(drop=True))
+    np.testing.assert_array_equal(got["k"], want["k"])
+    np.testing.assert_array_equal(got["sv"], want["sv"])
+
+
+def test_aqe_disabled_records_nothing():
+    from spark_rapids_tpu import aqe
+    aqe.install_aqe(None)
+    s = tpu_session({"spark.rapids.tpu.aqe.enabled": False})
+    df = _adaptive_query(s, _kv_table())
+    df.collect_arrow()
+    assert not (s.last_aqe_decisions or [])
+    assert aqe.LOG is None
+    assert "adaptive execution decisions" not in df.explain("analyze")
+
+
+def test_query_end_and_history_carry_aqe_summary(tmp_path):
+    from spark_rapids_tpu.tools.history import (build_history,
+                                                format_history,
+                                                load_events)
+    d = str(tmp_path / "elog")
+    s = tpu_session({"spark.rapids.tpu.eventLog.enabled": True,
+                     "spark.rapids.tpu.eventLog.dir": d})
+    _adaptive_query(s, _kv_table()).collect_arrow()
+    events, skipped = load_events(d)
+    assert skipped == 0
+    ends = [e for e in events if e.get("event") == "queryEnd"]
+    assert ends and ends[0].get("aqe", {}).get(
+        "coalesce_partitions", 0) >= 1, ends
+    # replayed history renders the same summary (satellite 4)
+    hist = build_history(events)
+    withaqe = [q for q in hist if q.get("aqe")]
+    assert withaqe and withaqe[0]["aqe"]["coalesce_partitions"] >= 1
+    txt = format_history(hist)
+    assert "aqe=coalesce_partitions:" in txt, txt
+
+
+def test_queries_endpoint_renders_aqe():
+    import json
+    import urllib.request
+    from spark_rapids_tpu.ops import server as srv_mod
+    srv = srv_mod.install_ops(srv_mod.OpsServer(0).start())
+    s = tpu_session()
+    _adaptive_query(s, _kv_table()).collect_arrow()
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/queries", timeout=5) as r:
+        doc = json.loads(r.read())
+    recs = [q for q in doc["recent"] if q.get("aqe")]
+    assert recs and recs[-1]["aqe"].get("coalesce_partitions", 0) >= 1, \
+        doc["recent"]
+
+
+# ---------------------------------------------------------------------------
+# broadcast demotion: observed build size flips the next plan
+# ---------------------------------------------------------------------------
+
+def test_broadcast_demote_on_observed_oversize():
+    """The build side's plan-time estimate (4000B, its Arrow size)
+    clears the threshold, but its MEASURED device size (int8 lanes
+    widen on device) comes in over: run 1 records a broadcast_demote
+    decision at materialization, run 2 re-plans to a shuffled join —
+    with identical results."""
+    rng = np.random.RandomState(0)
+    n = 50000
+    big = pa.table({"k": pa.array(rng.randint(0, 2000, n)
+                                  .astype(np.int64)),
+                    "v": pa.array(rng.standard_normal(n))})
+    dim = pa.table({"k2": pa.array(rng.randint(0, 128, 2000)
+                                   .astype(np.int8)),
+                    "w": pa.array(rng.randint(0, 100, 2000)
+                                  .astype(np.int8))})
+    s = tpu_session({
+        "spark.rapids.tpu.sql.autoBroadcastJoinThreshold": 4096,
+        # operator pipeline: fused explain would hide the join node
+        "spark.rapids.tpu.sql.fusedPipeline.enabled": False})
+
+    def q():
+        return (s.create_dataframe(big)
+                .join(s.create_dataframe(dim),
+                      on=[(F.col("k"), F.col("k2"))], how="inner")
+                .group_by("k").agg(F.max(F.col("w")).with_name("mw")))
+
+    q1 = q()
+    assert "BroadcastHashJoin" in q1._physical().tree_string()
+    r1 = q1.collect_arrow()
+    decs1 = s.last_aqe_decisions or []
+    assert any(d["kind"] == "broadcast_demote" for d in decs1), decs1
+    q2 = q()
+    tree2 = q2._physical().tree_string()
+    assert "BroadcastHashJoin" not in tree2, tree2   # measured size won
+    r2 = q2.collect_arrow()
+    decs2 = s.last_aqe_decisions or []
+    assert any(d["kind"] == "broadcast_demote" for d in decs2), decs2
+    g1 = r1.to_pandas().sort_values("k").reset_index(drop=True)
+    g2 = r2.to_pandas().sort_values("k").reset_index(drop=True)
+    np.testing.assert_array_equal(g1["k"], g2["k"])
+    np.testing.assert_array_equal(g1["mw"], g2["mw"])
+
+
+def test_broadcast_demote_disabled_by_conf():
+    rng = np.random.RandomState(0)
+    n = 50000
+    big = pa.table({"k": pa.array(rng.randint(0, 2000, n)
+                                  .astype(np.int64)),
+                    "v": pa.array(rng.standard_normal(n))})
+    dim = pa.table({"k2": pa.array(rng.randint(0, 128, 2000)
+                                   .astype(np.int8)),
+                    "w": pa.array(rng.randint(0, 100, 2000)
+                                  .astype(np.int8))})
+    s = tpu_session({
+        "spark.rapids.tpu.sql.autoBroadcastJoinThreshold": 4096,
+        "spark.rapids.tpu.aqe.broadcast.demote.enabled": False,
+        "spark.rapids.tpu.sql.fusedPipeline.enabled": False})
+    df = (s.create_dataframe(big)
+          .join(s.create_dataframe(dim),
+                on=[(F.col("k"), F.col("k2"))], how="inner")
+          .group_by("k").agg(F.max(F.col("w")).with_name("mw")))
+    df.collect_arrow()
+    assert not any(d["kind"] == "broadcast_demote"
+                   for d in (s.last_aqe_decisions or []))
+
+
+# ---------------------------------------------------------------------------
+# sentinel-history feedback: self-healing admission
+# ---------------------------------------------------------------------------
+
+def test_feedback_replan_after_repeated_high_rungs(tmp_path):
+    from spark_rapids_tpu.metrics.events import plan_digest
+    from spark_rapids_tpu.ops.sentinel import (RegressionSentinel,
+                                               install_sentinel)
+    t = _kv_table()
+    s = tpu_session()
+    df = (s.create_dataframe(t).group_by("k")
+          .agg(F.sum(F.col("v")).with_name("sv"))
+          .order_by(F.col("k").asc()))
+    digest = plan_digest(df.plan)
+    sen = install_sentinel(RegressionSentinel(str(tmp_path / "b.json")))
+    # one bad run is noise, not a pattern: no overlay yet
+    sen.fold({"digest": digest, "wallMs": 50.0, "verdict": "device",
+              "rung": 3, "ok": True})
+    df.collect_arrow()
+    assert not any(d["kind"] == "feedback_replan"
+                   for d in (s.last_aqe_decisions or []))
+    # second rung>=3 fold crosses HIGH_RUNG_REPEATS: the digest is now
+    # admitted with quartered batch targets, recorded on the query
+    sen.fold({"digest": digest, "wallMs": 50.0, "verdict": "device",
+              "rung": 3, "ok": True})
+    assert sen.baselines()[digest]["highRungs"] == 2
+    got = df.collect_arrow().to_pandas()
+    fr = [d for d in (s.last_aqe_decisions or [])
+          if d["kind"] == "feedback_replan"]
+    assert fr, s.last_aqe_decisions
+    assert "batchSizeBytes" in fr[0]["detail"], fr
+    # the overlay never leaks into the session conf
+    from spark_rapids_tpu.config import BATCH_SIZE_BYTES
+    from spark_rapids_tpu.aqe.feedback import BATCH_SHRINK_FACTOR
+    assert int(s.conf.get(BATCH_SIZE_BYTES)) > 0
+    assert BATCH_SHRINK_FACTOR == 4
+    # answers unchanged under the smaller batches
+    want = (t.to_pandas().groupby("k", as_index=False)
+            .agg(sv=("v", "sum")).sort_values("k").reset_index(drop=True))
+    np.testing.assert_array_equal(got["k"], want["k"])
+    np.testing.assert_array_equal(got["sv"], want["sv"])
+    install_sentinel(None)
+
+
+def test_feedback_plan_modes_and_floor():
+    """plan_feedback unit behavior: rung history -> smaller batches,
+    warm-slowdown history -> host, floors respected, clean -> None."""
+    from spark_rapids_tpu.aqe.feedback import (MIN_BATCH_BYTES,
+                                               MIN_BATCH_ROWS,
+                                               plan_feedback)
+    from spark_rapids_tpu.config import TpuConf
+    conf = TpuConf()
+    assert plan_feedback("d", None, conf) is None
+    assert plan_feedback(None, {"highRungs": 9}, conf) is None
+    assert plan_feedback("d", {"highRungs": 1, "warmSlowdowns": 0},
+                         conf) is None
+    fb = plan_feedback("d", {"highRungs": 2}, conf)
+    assert fb.mode == "smaller_batches"
+    assert set(fb.settings) == {"spark.rapids.tpu.sql.batchSizeBytes",
+                                "spark.rapids.tpu.sql.batchSizeRows"}
+    fb = plan_feedback("d", {"warmSlowdowns": 2}, conf)
+    assert fb.mode == "host"
+    assert fb.settings == {"spark.rapids.tpu.sql.enabled": False}
+    # already at the floor: nothing to shrink, no churn
+    floor = (TpuConf()
+             .set("spark.rapids.tpu.sql.batchSizeBytes", MIN_BATCH_BYTES)
+             .set("spark.rapids.tpu.sql.batchSizeRows", MIN_BATCH_ROWS))
+    assert plan_feedback("d", {"highRungs": 5}, floor) is None
+
+
+# ---------------------------------------------------------------------------
+# the cluster acceptance battery: Zipf skew through 3 workers
+# ---------------------------------------------------------------------------
+
+def _zipf_sides(n=24000, seed=7):
+    # zipf(2.5) puts ~75% of rows on key 0: with 3 reduce partitions
+    # the hot partition clears skew.threshold (2.0) x mean. Integer
+    # values keep sums associative — byte-identity is checkable. The
+    # right side stays small-multiplicity (~20 matches/key) so the
+    # join output — not the skew — does not dominate the test wall.
+    rng = np.random.RandomState(seed)
+    zk = np.minimum(rng.zipf(2.5, n), 64).astype(np.int64) - 1
+    left = pa.table({"k": pa.array(zk),
+                     "v": pa.array(rng.randint(0, 1000, n)
+                                   .astype(np.int64))})
+    right = pa.table({"k2": pa.array(rng.randint(0, 64, 1280)
+                                     .astype(np.int64)),
+                      "w": pa.array(rng.randint(0, 100, 1280)
+                                    .astype(np.int64))})
+    return left, right
+
+
+def _zipf_join(s, left, right):
+    return (s.create_dataframe(left)
+            .join(s.create_dataframe(right),
+                  on=[(F.col("k"), F.col("k2"))], how="inner")
+            .group_by("k")
+            .agg(F.sum(F.col("v")).with_name("sv"),
+                 F.count_star().with_name("n"))
+            .order_by(F.col("k").asc()))
+
+
+def _cluster_conf(aqe_on: bool):
+    from spark_rapids_tpu.config import TpuConf
+    return (TpuConf({"spark.rapids.tpu.shuffle.fetch.retryBackoffMs": 20})
+            .set("spark.rapids.tpu.aqe.enabled", aqe_on)
+            # CPU-test byte counts must clear the don't-bother floor;
+            # the ratio thresholds themselves stay at their defaults
+            .set("spark.rapids.tpu.aqe.skew.minBytes", 4096))
+
+
+def test_cluster_skew_split_coalesce_byte_identical():
+    """ISSUE 19 acceptance: the Zipf join on a 3-worker cluster must
+    salt-split the hot partition AND coalesce the small remainder, and
+    the re-planned run must be byte-identical to AQE off."""
+    from spark_rapids_tpu.aqe import install_aqe
+    from spark_rapids_tpu.shuffle.cluster import LocalCluster
+    left, right = _zipf_sides()
+    cl = LocalCluster(3, shuffle_join_min_rows=1000,
+                      conf=_cluster_conf(True))
+    try:
+        s = tpu_session()
+        got = cl.execute(_zipf_join(s, left, right))
+        decs = s.last_aqe_decisions or []
+        kinds = {}
+        for d in decs:
+            kinds[d["kind"]] = kinds.get(d["kind"], 0) + 1
+        assert kinds.get("skew_split", 0) >= 1, decs
+        assert kinds.get("coalesce_partitions", 0) >= 1, decs
+        # flip the SAME cluster to AQE off (a second 3-worker spawn
+        # would pay every worker's compile again against the tier-1
+        # wall): tear the log down and stop execute() reinstalling it
+        install_aqe(None)
+        cl.conf = _cluster_conf(False)
+        s2 = tpu_session()
+        want = cl.execute(_zipf_join(s2, left, right))
+        assert not (s2.last_aqe_decisions or [])
+    finally:
+        cl.shutdown()
+    assert got.equals(want), "AQE changed query results"
+    # and both match an independent engine
+    pj = left.to_pandas().merge(right.to_pandas(),
+                                left_on="k", right_on="k2")
+    w = (pj.groupby("k", as_index=False)
+         .agg(sv=("v", "sum"), n=("v", "size")).sort_values("k"))
+    g = got.to_pandas()
+    np.testing.assert_array_equal(g["k"], w["k"])
+    np.testing.assert_array_equal(g["sv"], w["sv"])
+    np.testing.assert_array_equal(g["n"], w["n"])

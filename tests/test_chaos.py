@@ -127,6 +127,67 @@ def test_battery_byte_identical_under_corruption_and_delay(cluster3):
 
 # ---------------------------------------------------------------------------
 # worker kill: heartbeat eviction + lineage recomputation
+def test_battery_byte_identical_with_aqe_replanning():
+    """ISSUE 19 acceptance: AQE re-planning FIRES (skew split +
+    coalesce on a Zipf-hot join) while chaos corrupts and delays block
+    transfers — and the result still equals a fault-free AQE-off run
+    byte for byte. Integer aggregates + a total order make identity
+    checkable (partial-sum association never drifts)."""
+    from spark_rapids_tpu.aqe import install_aqe
+    from spark_rapids_tpu.shuffle.cluster import LocalCluster
+    rng = np.random.RandomState(5)
+    n = 12000
+    zk = np.minimum(rng.zipf(2.5, n), 23).astype(np.int64) - 1
+    skewed = pa.table({"k": pa.array(zk),
+                       "v": pa.array(rng.randint(0, 1000, n)
+                                     .astype(np.int64))})
+    dim = pa.table({"k2": pa.array(rng.randint(0, 23, 1500)
+                                   .astype(np.int64)),
+                    "w": pa.array(rng.randint(0, 100, 1500)
+                                  .astype(np.int64))})
+
+    def q(s):
+        return (s.create_dataframe(skewed)
+                .join(s.create_dataframe(dim),
+                      on=[(F.col("k"), F.col("k2"))], how="inner")
+                .group_by("k")
+                .agg(F.sum(F.col("v")).with_name("sv"),
+                     F.count_star().with_name("n"))
+                .order_by(F.col("k").asc()))
+
+    def aqe_conf(on):
+        # CPU-test byte counts must clear the skew don't-bother floor,
+        # and the hot hash bucket lands at ~1.9x the mean combined
+        # (left+right) bytes here — under the 2.0 default, so tune the
+        # ratio down the way an operator chasing a hot key would
+        return _conf(**{"spark.rapids.tpu.aqe.enabled": on,
+                        "spark.rapids.tpu.aqe.skew.minBytes": 4096,
+                        "spark.rapids.tpu.aqe.skew.threshold": 1.5})
+
+    cl = LocalCluster(3, shuffle_join_min_rows=1000, conf=aqe_conf(True))
+    try:
+        s = tpu_session()
+        cl.set_chaos("put.corrupt=1;put.delay=1", seed=7, delay_ms=100,
+                     workers=["worker-0"])
+        try:
+            got = cl.execute(q(s))
+        finally:
+            cl.set_chaos("")
+        decs = s.last_aqe_decisions or []
+        assert any(d["kind"] == "skew_split" for d in decs), decs
+        assert any(d["kind"] == "coalesce_partitions" for d in decs), decs
+        # flip the SAME cluster to AQE off + chaos off for the oracle
+        # run (a fresh spawn would re-pay every worker compile)
+        install_aqe(None)
+        cl.conf = aqe_conf(False)
+        s2 = tpu_session()
+        want = cl.execute(q(s2))
+        assert not (s2.last_aqe_decisions or [])
+    finally:
+        cl.shutdown()
+    assert got.equals(want), "AQE under chaos changed query results"
+
+
 # ---------------------------------------------------------------------------
 
 def test_worker_killed_mid_map_recovers_from_lineage():
