@@ -703,13 +703,17 @@ class DataFrame:
         if not bool(conf.get(aqe_mod.AQE_FEEDBACK_ENABLED)):
             return None
         from ..ops import sentinel as sentinel_mod
+        from ..ops import slo as slo_mod
         sent = sentinel_mod.SENTINEL
-        if sent is None:
+        if sent is None and slo_mod.TRACKER is None:
             return None
         from ..aqe.feedback import plan_feedback
         from ..metrics.events import plan_digest
         digest = plan_digest(self.plan)
-        fb = plan_feedback(digest, sent.baselines().get(digest), conf)
+        fb = plan_feedback(
+            digest,
+            sent.baselines().get(digest) if sent is not None else None,
+            conf)
         if fb is None:
             return None
         over = conf
@@ -816,12 +820,14 @@ class DataFrame:
         from ..ops import flight as flight_mod
         from ..ops import sentinel as sentinel_mod
         from ..ops import server as ops_server_mod
+        from ..ops import slo as slo_mod
         frec = flight_mod.RECORDER
         sentinel = sentinel_mod.SENTINEL
+        slo = slo_mod.TRACKER
         _srv = ops_server_mod.SERVER
         tracker = _srv.tracker if _srv is not None else None
         if (elog is not None or tracker is not None or frec is not None
-                or sentinel is not None):
+                or sentinel is not None or slo is not None):
             qid = next(self.session._query_seq)
             digest = _resolve_digest()
         if elog is not None:
@@ -857,6 +863,10 @@ class DataFrame:
         # the run pays anyway is an anomaly worth a bundle
         was_warm = (frec is not None and digest is not None
                     and exec_cache.plan_digest_cached(digest))
+        # bundle census before the run: any bundle beyond this count was
+        # written DURING this query, so an SLO exemplar can link to it
+        bundles_before = (len(frec.stats()["bundles"])
+                          if frec is not None else 0)
         # ---------------- query-lifecycle controller (ISSUE 14) --------
         # cooperative deadline: every operator checks it per produced
         # batch and the semaphore polls it, so a timed-out query unwinds
@@ -1043,7 +1053,22 @@ class DataFrame:
             if mreg is not None:
                 mreg.counter("srtpu_queries_total",
                              status="ok" if ok else "failed").inc()
-                mreg.histogram("srtpu_query_seconds").observe(wall_s)
+                # per-tenant tail accounting (ISSUE 20): the wall lands
+                # in the tenant's histogram lane AND in two mergeable
+                # quantile sketches — per tenant for SLO burn math, per
+                # plan digest (bounded: overflow -> "other") so /slo can
+                # rank digests by tail contribution
+                mtenant = tenant or "default"
+                mreg.histogram("srtpu_query_seconds",
+                               tenant=mtenant).observe(wall_s)
+                mreg.summary("srtpu_query_latency_seconds",
+                             tenant=mtenant).observe(wall_s)
+                if digest is not None:
+                    mreg.summary(
+                        "srtpu_digest_latency_seconds",
+                        digest=mreg.bounded_label(
+                            "srtpu_digest_latency_seconds", "digest",
+                            digest)).observe(wall_s)
             # one drain for every consumer (session attribute, queryEnd
             # record, /queries): this thread drove every decision site
             # of this query, so the thread filter is the attribution
@@ -1114,6 +1139,20 @@ class DataFrame:
                                            or {}).get("verdict"),
                                "rung": ladder_rung, "ok": ok,
                                "compileS": compile_s_paid})
+            if slo is not None:
+                # SLO fold AFTER the trace write and any flight dump:
+                # an over-target exemplar links the artifacts this very
+                # query produced (the trace above; the newest bundle if
+                # one landed during the run)
+                flight_path = None
+                if frec is not None:
+                    _bundles = frec.stats()["bundles"]
+                    if len(_bundles) > bundles_before:
+                        flight_path = _bundles[-1]
+                slo.observe(tenant=tenant, wall_ms=wall_s * 1000.0,
+                            ok=ok, query_id=qid, digest=digest,
+                            trace_path=trace_path,
+                            flight_path=flight_path)
             if tracker is not None and track_tok is not None:
                 tracker.end(track_tok, ok=ok,
                             wall_ms=wall_s * 1000.0, rung=ladder_rung,

@@ -30,6 +30,11 @@ from typing import Optional
 #: one bad run can be noise, two is a pattern)
 HIGH_RUNG_REPEATS = 2
 WARM_SLOWDOWN_REPEATS = 2
+#: how many over-SLO-target runs the live SLO tracker must attribute
+#: to a digest before feedback shrinks its batches (ISSUE 20): the
+#: burn alert sheds at the front door; this is the slower, per-digest
+#: repair that removes the cause
+SLO_BREACH_REPEATS = 2
 
 #: the smaller-batch overlay divides both batch targets by this
 #: (mirrors one SplitAndRetry halving applied twice, the ladder's
@@ -39,7 +44,8 @@ MIN_BATCH_BYTES = 1 << 20
 MIN_BATCH_ROWS = 4096
 
 __all__ = ["FeedbackPlan", "plan_feedback", "HIGH_RUNG_REPEATS",
-           "WARM_SLOWDOWN_REPEATS", "BATCH_SHRINK_FACTOR"]
+           "WARM_SLOWDOWN_REPEATS", "SLO_BREACH_REPEATS",
+           "BATCH_SHRINK_FACTOR"]
 
 
 class FeedbackPlan:
@@ -54,27 +60,38 @@ class FeedbackPlan:
         self.reason = reason
 
 
+def _shrink_overlay(conf):
+    """The quartered-batch settings, or None at the floor (shared by
+    the rung-history and SLO-tail branches)."""
+    from ..config import BATCH_SIZE_BYTES, BATCH_SIZE_ROWS
+    cur_b = int(conf.get(BATCH_SIZE_BYTES))
+    cur_r = int(conf.get(BATCH_SIZE_ROWS))
+    new_b = max(MIN_BATCH_BYTES, cur_b // BATCH_SHRINK_FACTOR)
+    new_r = max(MIN_BATCH_ROWS, cur_r // BATCH_SHRINK_FACTOR)
+    if new_b >= cur_b and new_r >= cur_r:
+        return None             # already at the floor: nothing to shrink
+    return ({"spark.rapids.tpu.sql.batchSizeBytes": new_b,
+             "spark.rapids.tpu.sql.batchSizeRows": new_r},
+            cur_b, new_b, cur_r, new_r)
+
+
 def plan_feedback(digest: Optional[str], baseline: Optional[dict],
                   conf) -> Optional[FeedbackPlan]:
-    """Consult one digest's sentinel baseline; returns the overlay to
-    apply at admission, or None when history is clean (the common
-    path: two dict lookups)."""
-    if not digest or not baseline:
+    """Consult one digest's sentinel baseline and the live SLO
+    tracker's per-digest breach counts; returns the overlay to apply
+    at admission, or None when history is clean (the common path: two
+    dict lookups and one None check)."""
+    if not digest:
         return None
-    high = int(baseline.get("highRungs") or 0)
-    warm = int(baseline.get("warmSlowdowns") or 0)
+    high = int((baseline or {}).get("highRungs") or 0)
+    warm = int((baseline or {}).get("warmSlowdowns") or 0)
     if high >= HIGH_RUNG_REPEATS:
-        from ..config import BATCH_SIZE_BYTES, BATCH_SIZE_ROWS
-        cur_b = int(conf.get(BATCH_SIZE_BYTES))
-        cur_r = int(conf.get(BATCH_SIZE_ROWS))
-        new_b = max(MIN_BATCH_BYTES, cur_b // BATCH_SHRINK_FACTOR)
-        new_r = max(MIN_BATCH_ROWS, cur_r // BATCH_SHRINK_FACTOR)
-        if new_b >= cur_b and new_r >= cur_r:
-            return None         # already at the floor: nothing to shrink
+        shrunk = _shrink_overlay(conf)
+        if shrunk is None:
+            return None
+        settings, cur_b, new_b, cur_r, new_r = shrunk
         return FeedbackPlan(
-            "smaller_batches",
-            {"spark.rapids.tpu.sql.batchSizeBytes": new_b,
-             "spark.rapids.tpu.sql.batchSizeRows": new_r},
+            "smaller_batches", settings,
             f"digest {digest} hit OOM ladder rung>=3 {high}x — admitted "
             f"with batchSizeBytes {cur_b}->{new_b}, "
             f"batchSizeRows {cur_r}->{new_r}")
@@ -84,4 +101,21 @@ def plan_feedback(digest: Optional[str], baseline: Optional[dict],
             {"spark.rapids.tpu.sql.enabled": False},
             f"digest {digest} flagged warm-slowdown {warm}x on the "
             "device — admitted on the host engine")
+    # SLO tail coupling (ISSUE 20): a digest the live tracker has
+    # repeatedly attributed over-target walls to gets the same
+    # pre-emptive batch shrink as a rung offender — smaller batches
+    # shorten the longest device occupancy a single query can pin
+    from ..ops import slo as slo_mod
+    slo = slo_mod.TRACKER
+    if slo is not None:
+        breaches = slo.digest_breaches(digest)
+        if breaches >= SLO_BREACH_REPEATS:
+            shrunk = _shrink_overlay(conf)
+            if shrunk is not None:
+                settings, cur_b, new_b, cur_r, new_r = shrunk
+                return FeedbackPlan(
+                    "smaller_batches", settings,
+                    f"digest {digest} exceeded its SLO target "
+                    f"{breaches}x — admitted with batchSizeBytes "
+                    f"{cur_b}->{new_b}, batchSizeRows {cur_r}->{new_r}")
     return None

@@ -11,24 +11,26 @@ docs/monitoring.md for the metric catalog and event-log schema.
 """
 from .registry import (Counter, Gauge, Histogram, MetricRegistry,
                        METRICS_ENABLED, METRICS_SAMPLE_INTERVAL_MS,
-                       active_registry, declare_metric,
+                       Summary, active_registry, declare_metric,
                        ensure_metrics_from_conf, install_metrics,
                        metric_inventory, shutdown_metrics)
 from .sampler import (SAMPLER_THREAD_NAME, sample_now, sampler_thread,
                       start_sampler, stop_sampler)
-from .export import (json_text, merge_snapshots, prometheus_text,
-                     registry_snapshot)
+from .sketch import QuantileSketch, fold_sketches
+from .export import (SUMMARY_QUANTILES, json_text, merge_snapshots,
+                     prometheus_text, registry_snapshot)
 from .events import (ACTIVE_NAME, EVENT_LOG_DIR, EVENT_LOG_ENABLED,
                      EVENT_LOG_MAX_BYTES, EventLogWriter, plan_digest)
 from .analyze import render_analyzed_plan
 
-__all__ = ["Counter", "Gauge", "Histogram", "MetricRegistry",
+__all__ = ["Counter", "Gauge", "Histogram", "MetricRegistry", "Summary",
            "METRICS_ENABLED", "METRICS_SAMPLE_INTERVAL_MS",
            "active_registry", "declare_metric", "ensure_metrics_from_conf",
            "install_metrics", "metric_inventory", "shutdown_metrics",
            "SAMPLER_THREAD_NAME", "sample_now", "sampler_thread",
            "start_sampler", "stop_sampler", "json_text",
            "merge_snapshots", "prometheus_text", "registry_snapshot",
+           "QuantileSketch", "fold_sketches", "SUMMARY_QUANTILES",
            "ACTIVE_NAME", "EVENT_LOG_DIR", "EVENT_LOG_ENABLED",
            "EVENT_LOG_MAX_BYTES", "EventLogWriter", "plan_digest",
            "render_analyzed_plan"]

@@ -20,7 +20,7 @@ from typing import Dict, List, Optional
 from .registry import MetricRegistry, metric_inventory
 
 __all__ = ["prometheus_text", "json_text", "merge_snapshots",
-           "registry_snapshot"]
+           "registry_snapshot", "SUMMARY_QUANTILES"]
 
 
 def registry_snapshot(reg: MetricRegistry,
@@ -54,6 +54,23 @@ def _fmt_value(v) -> str:
     return repr(float(v))
 
 
+def _fmt_exemplar(ex: dict) -> str:
+    """OpenMetrics exemplar suffix: `` # {labels} value [ts]``. An
+    exemplar links a tail observation back to its on-disk artifact
+    (trace path, flight bundle) — the p99-outlier-to-evidence hop the
+    SLO layer exists for (ops/slo.py, docs/monitoring.md)."""
+    labels = _fmt_labels(dict(ex.get("labels") or {})) or "{}"
+    out = f" # {labels} {_fmt_value(ex.get('value', 0))}"
+    if ex.get("ts") is not None:
+        out += f" {_fmt_value(ex['ts'])}"
+    return out
+
+
+#: the quantile ladder summaries expose (Summary.QUANTILES mirror —
+#: exposition renders from snapshots, which don't carry class attrs)
+SUMMARY_QUANTILES = (0.5, 0.95, 0.99)
+
+
 def prometheus_text(snapshot: dict,
                     extra_labels: Optional[Dict[str, str]] = None) -> str:
     """Snapshot -> Prometheus exposition text. ``extra_labels`` are
@@ -70,6 +87,7 @@ def prometheus_text(snapshot: dict,
         for s in ent["series"]:
             labels = dict(s.get("labels") or {})
             labels.update(extra)
+            ex = s.get("exemplar")
             if ent["kind"] == "histogram":
                 for le, c in s["buckets"]:
                     bl = dict(labels)
@@ -83,10 +101,25 @@ def prometheus_text(snapshot: dict,
                 out.append(f"{name}_sum{_fmt_labels(labels)} "
                            f"{_fmt_value(s['sum'])}")
                 out.append(f"{name}_count{_fmt_labels(labels)} "
-                           f"{s['count']}")
+                           f"{s['count']}"
+                           + (_fmt_exemplar(ex) if ex else ""))
+            elif ent["kind"] == "summary":
+                from .sketch import QuantileSketch
+                sk = QuantileSketch.from_json(s.get("sketch") or {})
+                for q in SUMMARY_QUANTILES:
+                    ql = dict(labels)
+                    ql["quantile"] = f"{q:g}"
+                    out.append(f"{name}{_fmt_labels(ql)} "
+                               f"{_fmt_value(sk.quantile(q))}")
+                out.append(f"{name}_sum{_fmt_labels(labels)} "
+                           f"{_fmt_value(s['sum'])}")
+                out.append(f"{name}_count{_fmt_labels(labels)} "
+                           f"{s['count']}"
+                           + (_fmt_exemplar(ex) if ex else ""))
             else:
                 out.append(f"{name}{_fmt_labels(labels)} "
-                           f"{_fmt_value(s['value'])}")
+                           f"{_fmt_value(s['value'])}"
+                           + (_fmt_exemplar(ex) if ex else ""))
     return "\n".join(out) + ("\n" if out else "")
 
 

@@ -31,7 +31,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..config import register
 
-__all__ = ["MetricRegistry", "Counter", "Gauge", "Histogram",
+__all__ = ["MetricRegistry", "Counter", "Gauge", "Histogram", "Summary",
            "declare_metric", "metric_inventory", "active_registry",
            "install_metrics", "shutdown_metrics",
            "ensure_metrics_from_conf", "METRICS_ENABLED",
@@ -62,19 +62,26 @@ DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
 #: instrumentation site costs exactly one attribute load + branch
 REGISTRY: Optional["MetricRegistry"] = None
 
-#: name -> {"kind", "help"}; the closed catalog every registry enforces
-_INVENTORY: Dict[str, Dict[str, str]] = {}
+#: name -> {"kind", "help"[, "buckets"]}; the closed catalog every
+#: registry enforces
+_INVENTORY: Dict[str, Dict[str, object]] = {}
 
 
-def declare_metric(name: str, kind: str, help_text: str) -> str:
+def declare_metric(name: str, kind: str, help_text: str,
+                   buckets: Optional[Tuple[float, ...]] = None) -> str:
     """Declare a metric name in the process-wide inventory (import
     time). Idempotent for identical declarations; a kind conflict is a
-    programming error and raises."""
+    programming error and raises. ``buckets`` declares a histogram's
+    per-metric bucket ladder — the fix for DEFAULT_BUCKETS saturating
+    at 60 s while queries run to the 600 s timeout."""
     prev = _INVENTORY.get(name)
     if prev is not None and prev["kind"] != kind:
         raise ValueError(f"metric {name} redeclared as {kind}, "
                          f"was {prev['kind']}")
-    _INVENTORY[name] = {"kind": kind, "help": help_text}
+    ent: Dict[str, object] = {"kind": kind, "help": help_text}
+    if buckets is not None:
+        ent["buckets"] = tuple(sorted(buckets))
+    _INVENTORY[name] = ent
     return name
 
 
@@ -168,6 +175,32 @@ class Histogram:
             self.count += 1
 
 
+class Summary:
+    """Quantile summary over a mergeable relative-error sketch
+    (metrics/sketch.py). Exposed as Prometheus
+    ``name{quantile="0.5|0.95|0.99"}`` lines plus ``_sum``/``_count``;
+    snapshots carry the serialized sketch so ``merge_snapshots`` ships
+    it worker-labeled like any other series and the driver can fold a
+    cluster-wide tail without raw samples."""
+
+    __slots__ = ("name", "labels", "sketch", "_lock")
+    kind = "summary"
+
+    #: the exported quantile ladder
+    QUANTILES = (0.5, 0.95, 0.99)
+
+    def __init__(self, name: str, labels: Tuple[Tuple[str, str], ...]):
+        from .sketch import QuantileSketch
+        self.name = name
+        self.labels = labels
+        self.sketch = QuantileSketch()  # tpulint: guarded-by _lock
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self.sketch.observe(v)
+
+
 class MetricRegistry:
     """Thread-safe store of live metric instances, keyed on
     (name, sorted labels). Snapshots are plain dicts — the interchange
@@ -177,6 +210,9 @@ class MetricRegistry:
         # tpulint: guarded-by _lock
         self._metrics: Dict[Tuple[str, Tuple[Tuple[str, str], ...]],
                             object] = {}
+        # bounded-cardinality label admission per (metric, label) pair
+        self._label_seen: Dict[Tuple[str, str],
+                               set] = {}  # tpulint: guarded-by _lock
         self._lock = threading.Lock()
 
     def _get(self, cls, name: str, labels: dict, **kw):
@@ -202,9 +238,34 @@ class MetricRegistry:
     def gauge(self, name: str, **labels) -> Gauge:
         return self._get(Gauge, name, labels)
 
-    def histogram(self, name: str, buckets=DEFAULT_BUCKETS,
+    def histogram(self, name: str, buckets=None,
                   **labels) -> Histogram:
+        if buckets is None:
+            buckets = (_INVENTORY.get(name, {}).get("buckets")
+                       or DEFAULT_BUCKETS)
         return self._get(Histogram, name, labels, buckets=buckets)
+
+    def summary(self, name: str, **labels) -> Summary:
+        return self._get(Summary, name, labels)
+
+    def bounded_label(self, name: str, label: str, value: str,
+                      cap: int = 32) -> str:
+        """Admit a label value under a per-(metric, label) cardinality
+        cap: the first ``cap`` distinct values keep their identity,
+        later ones collapse to ``"other"`` — an unbounded plan-digest
+        stream must not mint unbounded series. Deterministic for a
+        given observation order; reset with the registry (per-test
+        ``shutdown_metrics``)."""
+        value = str(value)
+        key = (name, label)
+        with self._lock:
+            seen = self._label_seen.setdefault(key, set())
+            if value in seen:
+                return value
+            if len(seen) < cap:
+                seen.add(value)
+                return value
+        return "other"
 
     # ------------------------------------------------------------- read
     def snapshot(self) -> dict:
@@ -223,6 +284,15 @@ class MetricRegistry:
                                     zip(m.buckets, m.bucket_counts)]
                     s["sum"] = m.sum
                     s["count"] = m.count
+            elif m.kind == "summary":
+                with m._lock:
+                    s["sketch"] = m.sketch.to_json()
+                    # tpulint: disable=lock-discipline — lock-free by
+                    # design: the summary's _lock (held here) is the
+                    # sketch's guard; the sketch itself is unsynchronized
+                    s["sum"] = m.sketch.sum
+                    # tpulint: disable=lock-discipline — same guard
+                    s["count"] = m.sketch.count
             else:
                 with m._lock:
                     # a torn scalar read is survivable, but exporting a
@@ -354,7 +424,11 @@ declare_metric("srtpu_query_timeout_total", "counter",
 declare_metric("srtpu_queries_total", "counter",
                "Materialized queries, labeled status=ok|failed.")
 declare_metric("srtpu_query_seconds", "histogram",
-               "Whole-query wall time distribution (seconds).")
+               "Whole-query wall time distribution (seconds), labeled "
+               "tenant=<id or 'default'>. Per-metric buckets extend to "
+               "600 s so queries near spark.rapids.tpu.query.timeout "
+               "are not collapsed into +Inf.",
+               buckets=DEFAULT_BUCKETS + (120.0, 300.0, 600.0))
 declare_metric("srtpu_sampler_ticks_total", "counter",
                "Background sampler passes completed.")
 declare_metric("srtpu_compile_cache_hits_total", "counter",
@@ -387,18 +461,20 @@ declare_metric("srtpu_worker_last_seen_ms", "gauge",
                "verdicts read heartbeat age from it.")
 declare_metric("srtpu_ops_requests_total", "counter",
                "HTTP requests served by the live ops endpoint, labeled "
-               "endpoint=/metrics|/healthz|/queries (ops/server.py).")
+               "endpoint=/metrics|/healthz|/queries|/slo "
+               "(ops/server.py).")
 declare_metric("srtpu_flight_dumps_total", "counter",
                "Flight-recorder bundles written, labeled "
                "trigger=<kind from the ops/flight.py closed taxonomy> "
                "(semaphore_wedge, oom_ladder, query_timeout, "
                "worker_evicted, warm_recompile, placement_revert, "
-               "sentinel_regression, admission_shed — docs/ops.md); "
-               "rate-limited suppressions are not counted.")
+               "sentinel_regression, admission_shed, slo_burn — "
+               "docs/ops.md); rate-limited suppressions are not "
+               "counted.")
 declare_metric("srtpu_query_regressions_total", "counter",
                "Regressions flagged by the per-digest sentinel, labeled "
-               "kind=warm_slowdown|verdict_flip|rung_escalation "
-               "(ops/sentinel.py, docs/ops.md).")
+               "kind=warm_slowdown|verdict_flip|rung_escalation|"
+               "tail_regression (ops/sentinel.py, docs/ops.md).")
 declare_metric("srtpu_placement_fallback_total", "counter",
                "Operators/expressions kept off the device at plan time, "
                "labeled code=<reason code from the plan/tags.py closed "
@@ -416,7 +492,8 @@ declare_metric("srtpu_admission_rejected_total", "counter",
                "(sched/admission.py, docs/serving.md).")
 declare_metric("srtpu_admission_wait_seconds", "histogram",
                "Time admitted queries spent queued in the admission "
-               "controller before their permit (seconds).")
+               "controller before their permit (seconds), labeled "
+               "tenant=<id or 'default'>.")
 declare_metric("srtpu_admission_queue_depth", "gauge",
                "Queries currently queued in the admission controller "
                "waiting for an in-flight slot (sampler snapshot).")
@@ -447,3 +524,38 @@ declare_metric("srtpu_aqe_broadcast_demotions_total", "counter",
                "auto-broadcast threshold at materialization; the "
                "measured size re-plans the next run of the shape to a "
                "shuffled join (exec/joins.py, docs/aqe.md).")
+declare_metric("srtpu_query_latency_seconds", "summary",
+               "Whole-query wall time quantile summary (relative-error "
+               "sketch, metrics/sketch.py), labeled tenant=<id or "
+               "'default'>; exported as quantile=0.5|0.95|0.99 lines "
+               "and mergeable across workers (docs/monitoring.md).")
+declare_metric("srtpu_digest_latency_seconds", "summary",
+               "Per-plan-digest wall time quantile summary, labeled "
+               "digest=<plan digest, bounded cardinality — past the "
+               "cap new digests collapse into digest=\"other\">; the "
+               "tail-contribution ranking /slo serves reads it.")
+declare_metric("srtpu_admission_wait_latency_seconds", "summary",
+               "Admission-queue wait quantile summary, labeled "
+               "tenant=<id or 'default'> (sched/admission.py, "
+               "docs/serving.md).")
+declare_metric("srtpu_worker_task_seconds", "summary",
+               "Worker-side task wall time quantile summary, labeled "
+               "task=<worker task name> (shuffle/cluster.py); per-lane "
+               "sketches merge into the cluster-wide task tail.")
+declare_metric("srtpu_slo_events_total", "counter",
+               "Queries folded into the SLO tracker (ops/slo.py), "
+               "labeled tenant=<id or 'default'> and status=good|bad "
+               "(bad = over the tenant's latency target or failed).")
+declare_metric("srtpu_slo_burn_rate", "gauge",
+               "Error-budget burn rate per tenant and window, labeled "
+               "tenant=<id> window=short|long; 1.0 burns the budget "
+               "exactly at the objective's allowance, >1 burns faster "
+               "(ops/slo.py, docs/serving.md).")
+declare_metric("srtpu_slo_error_budget_remaining", "gauge",
+               "Fraction of the long-window error budget left per "
+               "tenant, labeled tenant=<id>; 1.0 = untouched, 0.0 = "
+               "exhausted (ops/slo.py).")
+declare_metric("srtpu_slo_burn_alerts_total", "counter",
+               "Multi-window SLO burn alerts fired, labeled "
+               "tenant=<id>; each also fires the flight recorder's "
+               "slo_burn trigger (ops/slo.py, docs/ops.md).")

@@ -59,6 +59,14 @@ def sample_now(reg: MetricRegistry) -> None:
     for t, quota in (mm.get("tenant_quota") or {}).items():
         reg.gauge("srtpu_tenant_hbm_quota_bytes", tenant=t).set(quota)
 
+    # per-tenant SLO burn/budget gauges (ISSUE 20): re-evaluated from
+    # the current clock so burn rates decay on /metrics as bad events
+    # age out of their windows, not only when a new query lands
+    from ..ops import slo as slo_mod
+    slo = slo_mod.TRACKER
+    if slo is not None:
+        slo.export_gauges(reg)
+
     from ..sched import admission as adm_mod
     adm = adm_mod.CONTROLLER
     if adm is not None:

@@ -1,0 +1,196 @@
+"""Deterministic relative-error quantile sketch (DDSketch family).
+
+The tail-latency substrate (ISSUE 20): a ``Summary`` metric's storage.
+Log-boundary buckets give a *relative* accuracy guarantee — the
+estimate of any quantile q is within ``alpha`` (default 1%) of the true
+value, whether that value is 5 ms or 500 s — which is exactly the
+property fixed-boundary histograms lose when a latency distribution
+outgrows its ladder (the ``DEFAULT_BUCKETS``-saturation bug this PR
+fixes for ``srtpu_query_seconds``).
+
+Three contracts everything downstream leans on:
+
+* **deterministic** — bucket keys are a pure function of the value and
+  ``alpha``; quantile estimates are a pure function of the bucket
+  contents. Same observations (any order, any grouping) -> identical
+  JSON, identical quantiles. The 3-worker merge test and the SLO
+  replay (``tools/history --slo``) both pin this.
+* **mergeable** — :meth:`merge` sums bucket counts; merging per-worker
+  sketches equals one sketch that saw every observation. This is what
+  lets ``merge_snapshots`` ship sketches as plain series dicts and the
+  driver fold a cluster-wide p99 without raw samples.
+* **JSON-serializable** — :meth:`to_json` / :meth:`from_json` round-trip
+  through the snapshot interchange format (plain dicts, string bucket
+  keys) so sketches ride task-completion RPCs, ``SERVE_r*.json``
+  artifacts and sentinel baselines unchanged.
+
+Memory is bounded: at most ``max_bins`` live buckets; on overflow the
+*lowest* buckets collapse into one (tail accuracy is the product; the
+cheap end degrades first).
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, Iterable, List, Optional, Tuple
+
+__all__ = ["QuantileSketch", "DEFAULT_ALPHA", "fold_sketches"]
+
+#: default relative accuracy (1%): p99 of a 10 s tail is known +-100 ms
+DEFAULT_ALPHA = 0.01
+
+#: values at or below this collapse into the zero bucket (sub-nanosecond
+#: latencies carry no signal and their log keys would be huge negatives)
+MIN_VALUE = 1e-9
+
+#: live-bucket cap; ~2048 buckets span MIN_VALUE..1e9 s at alpha=0.01
+DEFAULT_MAX_BINS = 2048
+
+
+class QuantileSketch:
+    """Mergeable log-boundary quantile sketch.
+
+    Bucket key of a value v is ``ceil(log(v) / log(gamma))`` with
+    ``gamma = (1 + alpha) / (1 - alpha)``; every value in bucket k lies
+    in ``(gamma^(k-1), gamma^k]`` and is estimated by the bucket
+    midpoint ``2 * gamma^k / (gamma + 1)`` — within ``alpha`` of the
+    true value, relatively.
+
+    NOT thread-safe by itself; the registry's ``Summary`` wraps it in a
+    lock. Pure-Python, stdlib-only, deterministic.
+    """
+
+    __slots__ = ("alpha", "gamma", "_log_gamma", "max_bins", "bins",
+                 "zero_count", "count", "sum", "min", "max")
+
+    def __init__(self, alpha: float = DEFAULT_ALPHA,
+                 max_bins: int = DEFAULT_MAX_BINS):
+        if not 0.0 < alpha < 1.0:
+            raise ValueError(f"alpha must be in (0, 1), got {alpha}")
+        self.alpha = float(alpha)
+        self.gamma = (1.0 + self.alpha) / (1.0 - self.alpha)
+        self._log_gamma = math.log(self.gamma)
+        self.max_bins = int(max_bins)
+        self.bins: Dict[int, int] = {}
+        self.zero_count = 0
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+
+    # ------------------------------------------------------------ write
+    def key_of(self, v: float) -> int:
+        """The bucket key of a positive value (pure, deterministic)."""
+        return int(math.ceil(math.log(v) / self._log_gamma))
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        if math.isnan(v):
+            return
+        if v < 0.0:
+            v = 0.0
+        if v <= MIN_VALUE:
+            self.zero_count += 1
+        else:
+            k = self.key_of(v)
+            self.bins[k] = self.bins.get(k, 0) + 1
+            if len(self.bins) > self.max_bins:
+                self._collapse()
+        self.count += 1
+        self.sum += v
+        if v < self.min:
+            self.min = v
+        if v > self.max:
+            self.max = v
+
+    def _collapse(self) -> None:
+        """Fold the lowest buckets together until within ``max_bins``.
+        Collapsing low keys preserves tail (high-quantile) accuracy."""
+        keys = sorted(self.bins)
+        while len(keys) > self.max_bins:
+            lo, nxt = keys[0], keys[1]
+            self.bins[nxt] += self.bins.pop(lo)
+            keys.pop(0)
+
+    def merge(self, other: "QuantileSketch") -> "QuantileSketch":
+        """Fold ``other`` into self (commutative + associative on the
+        bucket contents: any merge order yields identical state)."""
+        if abs(other.alpha - self.alpha) > 1e-12:
+            raise ValueError(
+                f"cannot merge sketches with different alpha "
+                f"({self.alpha} vs {other.alpha})")
+        for k, c in other.bins.items():
+            self.bins[k] = self.bins.get(k, 0) + c
+        if len(self.bins) > self.max_bins:
+            self._collapse()
+        self.zero_count += other.zero_count
+        self.count += other.count
+        self.sum += other.sum
+        if other.min < self.min:
+            self.min = other.min
+        if other.max > self.max:
+            self.max = other.max
+        return self
+
+    # ------------------------------------------------------------- read
+    def quantile(self, q: float) -> float:
+        """Estimate the q-quantile (0 <= q <= 1); 0.0 when empty."""
+        if self.count <= 0:
+            return 0.0
+        q = min(1.0, max(0.0, float(q)))
+        rank = q * (self.count - 1)
+        cum = self.zero_count
+        if rank < cum:
+            return 0.0
+        for k in sorted(self.bins):
+            cum += self.bins[k]
+            if rank < cum:
+                return 2.0 * (self.gamma ** k) / (self.gamma + 1.0)
+        # numerically-unreachable fallback: the recorded maximum
+        return self.max if self.max > -math.inf else 0.0
+
+    def quantiles(self, qs: Iterable[float]) -> List[float]:
+        return [self.quantile(q) for q in qs]
+
+    # ------------------------------------------------ JSON interchange
+    def to_json(self) -> dict:
+        """Plain-dict form (string bucket keys — JSON object keys)."""
+        return {"alpha": self.alpha,
+                "bins": {str(k): c for k, c in sorted(self.bins.items())},
+                "zero": self.zero_count,
+                "count": self.count,
+                "sum": self.sum,
+                "min": self.min if self.count else None,
+                "max": self.max if self.count else None}
+
+    @classmethod
+    def from_json(cls, doc: dict, max_bins: int = DEFAULT_MAX_BINS) \
+            -> "QuantileSketch":
+        sk = cls(alpha=float(doc.get("alpha", DEFAULT_ALPHA)),
+                 max_bins=max_bins)
+        for k, c in (doc.get("bins") or {}).items():
+            sk.bins[int(k)] = int(c)
+        sk.zero_count = int(doc.get("zero", 0))
+        sk.count = int(doc.get("count", 0))
+        sk.sum = float(doc.get("sum", 0.0))
+        mn, mx = doc.get("min"), doc.get("max")
+        sk.min = float(mn) if mn is not None else math.inf
+        sk.max = float(mx) if mx is not None else -math.inf
+        if len(sk.bins) > sk.max_bins:
+            sk._collapse()
+        return sk
+
+
+def fold_sketches(docs: Iterable[Optional[dict]]) -> QuantileSketch:
+    """Merge serialized sketch dicts (e.g. per-worker summary series
+    from ``merge_snapshots``) into one sketch. ``None`` entries are
+    skipped; an empty input folds to an empty sketch."""
+    out: Optional[QuantileSketch] = None
+    for doc in docs:
+        if not doc:
+            continue
+        sk = QuantileSketch.from_json(doc)
+        if out is None:
+            out = sk
+        else:
+            out.merge(sk)
+    return out if out is not None else QuantileSketch()

@@ -32,24 +32,30 @@ __all__ = ["ensure_ops_plane_from_conf", "shutdown_ops_plane"]
 
 def ensure_ops_plane_from_conf(conf):
     """Install the configured pieces of the ops plane (server, flight
-    recorder, sentinel) — one conf lookup each, paid per ExecContext
-    construction, never per event. Returns (server, recorder, sentinel),
-    any of which may be None."""
+    recorder, sentinel, SLO tracker) — one conf lookup each, paid per
+    ExecContext construction, never per event. Returns (server,
+    recorder, sentinel), any of which may be None; the SLO tracker is
+    installed as the ``ops.slo.TRACKER`` module global."""
     from .flight import ensure_flight_from_conf
     from .sentinel import ensure_sentinel_from_conf
     from .server import ensure_ops_from_conf
+    from .slo import ensure_slo_from_conf
     srv = ensure_ops_from_conf(conf)
     rec = ensure_flight_from_conf(conf)
     sen = ensure_sentinel_from_conf(conf)
+    ensure_slo_from_conf(conf)
     return srv, rec, sen
 
 
 def shutdown_ops_plane() -> None:
     """Stop the ops server thread (if any) and uninstall the flight
-    recorder and sentinel — the per-test reset (conftest)."""
+    recorder, sentinel and SLO tracker — the per-test reset
+    (conftest)."""
     from .flight import install_flight
     from .sentinel import install_sentinel
     from .server import shutdown_ops
+    from .slo import install_slo
     shutdown_ops()
     install_flight(None)
     install_sentinel(None)
+    install_slo(None)

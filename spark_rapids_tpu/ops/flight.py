@@ -104,7 +104,7 @@ FLIGHT_RING_EVENTS = register(
 #: undocumented trigger)
 TRIGGERS = ("semaphore_wedge", "oom_ladder", "query_timeout",
             "worker_evicted", "warm_recompile", "placement_revert",
-            "sentinel_regression", "admission_shed")
+            "sentinel_regression", "admission_shed", "slo_burn")
 
 #: the process-global recorder; ``None`` means the flight recorder is
 #: OFF and every trigger site costs exactly one attribute load + branch

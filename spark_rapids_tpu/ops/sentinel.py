@@ -15,7 +15,13 @@ regressed, not at the next manual diff:
   item 1) — fires the flight recorder's ``placement_revert`` trigger;
 * ``rung_escalation`` — a digest that never escalated past rung 2
   reached the cross-session pressure spill (rung 3) or the host
-  degradation rung (rung 4).
+  degradation rung (rung 4);
+* ``tail_regression``  — a compile-free ok run exceeded
+  ``sentinel.tailFactor`` x the digest's baselined p99 (a rolling
+  relative-error sketch, metrics/sketch.py). The median-based
+  ``warm_slowdown`` is blind to a digest whose typical wall is fine
+  but whose tail stretched; this is the per-digest half of the SLO
+  layer (ISSUE 20, ops/slo.py).
 
 Each flag increments ``srtpu_query_regressions_total{kind=...}`` and
 fires the flight recorder. Baselines persist beside the adaptive stats
@@ -42,7 +48,8 @@ __all__ = ["RegressionSentinel", "fold_record", "REGRESSION_KINDS",
            "install_sentinel", "ensure_sentinel_from_conf",
            "active_sentinel", "default_baselines_path",
            "SENTINEL_ENABLED", "SENTINEL_WALL_FACTOR",
-           "SENTINEL_MIN_SAMPLES", "SENTINEL_WINDOW", "SENTINEL_PATH"]
+           "SENTINEL_MIN_SAMPLES", "SENTINEL_WINDOW", "SENTINEL_PATH",
+           "SENTINEL_TAIL_FACTOR"]
 
 log = logging.getLogger(__name__)
 
@@ -77,8 +84,16 @@ SENTINEL_PATH = register(
     "Baseline persistence file; empty uses sentinel_baselines.json "
     "beside the adaptive stats store (SRTPU_STATS_PATH directory).")
 
+SENTINEL_TAIL_FACTOR = register(
+    "spark.rapids.tpu.sentinel.tailFactor", 2.0,
+    "A compile-free run slower than this multiple of the digest's "
+    "baselined p99 (rolling quantile sketch) is flagged as a "
+    "tail_regression — the tail-latency analog of sentinel.wallFactor "
+    "(docs/ops.md).")
+
 #: closed regression taxonomy (docs/ops.md)
-REGRESSION_KINDS = ("warm_slowdown", "verdict_flip", "rung_escalation")
+REGRESSION_KINDS = ("warm_slowdown", "verdict_flip", "rung_escalation",
+                    "tail_regression")
 
 #: persist baselines at most every N clean folds (every regression
 #: persists immediately) — durability without a whole-table JSON
@@ -107,7 +122,7 @@ def _median(xs: List[float]) -> float:
 
 def fold_record(baselines: Dict[str, dict], rec: dict, *,
                 wall_factor: float = 3.0, min_samples: int = 3,
-                window: int = 32) -> List[dict]:
+                window: int = 32, tail_factor: float = 2.0) -> List[dict]:
     """Fold ONE query record into ``baselines`` (mutated in place) and
     return the regressions it triggered. Pure and deterministic — the
     single code path shared by the live sentinel and the
@@ -145,6 +160,19 @@ def fold_record(baselines: Dict[str, dict], rec: dict, *,
             regs.append({"kind": "rung_escalation", "digest": digest,
                          "rung": rung,
                          "baselineRung": int(b.get("maxRung") or 0)})
+        # per-digest p99 check: the median is blind to a stretched tail
+        # (ISSUE 20). The flagged wall still folds into the sketch
+        # below, so a persistent shift re-baselines like the median.
+        if ok and compile_free and wall is not None and b.get("tail"):
+            from ..metrics.sketch import QuantileSketch
+            sk = QuantileSketch.from_json(b["tail"])
+            p99 = sk.quantile(0.99)
+            if (sk.count >= min_samples and p99 > 0
+                    and float(wall) > tail_factor * p99):
+                regs.append({"kind": "tail_regression", "digest": digest,
+                             "wallMs": round(float(wall), 3),
+                             "p99Ms": round(p99, 3),
+                             "factor": round(float(wall) / p99, 2)})
     if b is None:
         b = baselines[digest] = {"walls": [], "verdict": None,
                                  "maxRung": 0, "compileS": 0.0, "n": 0,
@@ -152,6 +180,21 @@ def fold_record(baselines: Dict[str, dict], rec: dict, *,
     if ok and compile_free and wall is not None:
         b["walls"] = (b.get("walls") or []) + [round(float(wall), 3)]
         b["walls"] = b["walls"][-max(1, int(window)):]
+        # rolling tail sketch (JSON-able — rides baseline persistence);
+        # .get-defaulted so pre-ISSUE-20 baselines keep folding. A
+        # sketch has no eviction, so decay by halving bin counts once
+        # it holds 4x the wall window: old observations lose weight
+        # deterministically and a persistent tail shift re-baselines
+        # within ~2 windows instead of never.
+        from ..metrics.sketch import QuantileSketch
+        sk = QuantileSketch.from_json(b.get("tail") or {})
+        sk.observe(float(wall))
+        if sk.count >= 4 * max(1, int(window)):
+            sk.bins = {k: c // 2 for k, c in sk.bins.items() if c // 2}
+            sk.zero_count //= 2
+            sk.count = sk.zero_count + sum(sk.bins.values())
+            sk.sum /= 2.0
+        b["tail"] = sk.to_json()
     if verdict in ("device", "host"):
         b["verdict"] = verdict
     b["maxRung"] = max(int(b.get("maxRung") or 0), rung)
@@ -174,11 +217,13 @@ class RegressionSentinel:
     best-effort atomic persistence and metric/flight fan-out."""
 
     def __init__(self, path: str, wall_factor: float = 3.0,
-                 min_samples: int = 3, window: int = 32):
+                 min_samples: int = 3, window: int = 32,
+                 tail_factor: float = 2.0):
         self.path = str(path)
         self.wall_factor = float(wall_factor)
         self.min_samples = int(min_samples)
         self.window = int(window)
+        self.tail_factor = float(tail_factor)
         self._lock = threading.Lock()
         #: serializes whole-file persists: two concurrent save()s share
         #: one pid-derived tmp name, so an unserialized pair could
@@ -251,7 +296,8 @@ class RegressionSentinel:
                 regs = fold_record(self._baselines, rec,
                                    wall_factor=self.wall_factor,
                                    min_samples=self.min_samples,
-                                   window=self.window)
+                                   window=self.window,
+                                   tail_factor=self.tail_factor)
                 self.flagged.extend(regs)
                 # /healthz shows recent flags, not unbounded history
                 del self.flagged[:-64]
@@ -338,5 +384,6 @@ def ensure_sentinel_from_conf(conf) -> Optional[RegressionSentinel]:
                 path,
                 wall_factor=float(conf.get(SENTINEL_WALL_FACTOR)),
                 min_samples=int(conf.get(SENTINEL_MIN_SAMPLES)),
-                window=int(conf.get(SENTINEL_WINDOW)))
+                window=int(conf.get(SENTINEL_WINDOW)),
+                tail_factor=float(conf.get(SENTINEL_TAIL_FACTOR)))
         return SENTINEL

@@ -171,7 +171,7 @@ class _Handler(BaseHTTPRequestHandler):
             # error in the fan-out must degrade to a 500, not escape into
             # socketserver's handle_error (stderr traceback + a dropped
             # connection — exactly what this handler promises never to do)
-            if path in ("/metrics", "/healthz", "/queries"):
+            if path in ("/metrics", "/healthz", "/queries", "/slo"):
                 from ..metrics import registry as metrics_registry
                 mr = metrics_registry.REGISTRY
                 if mr is not None:
@@ -191,9 +191,14 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(200, json.dumps(
                     ops.queries(), indent=2, sort_keys=True,
                     default=str).encode("utf-8"), "application/json")
+            elif path == "/slo":
+                self._reply(200, json.dumps(
+                    ops.slo(), indent=2, sort_keys=True,
+                    default=str).encode("utf-8"), "application/json")
             elif path == "/":
                 self._reply(200, json.dumps(
-                    {"endpoints": ["/metrics", "/healthz", "/queries"]}
+                    {"endpoints": ["/metrics", "/healthz", "/queries",
+                                   "/slo"]}
                 ).encode("utf-8"), "application/json")
             else:
                 self._reply(404, b'{"error": "not found"}',
@@ -274,7 +279,14 @@ class OpsServer:
             return ("# spark.rapids.tpu.metrics.enabled is off: "
                     "no metric registry installed\n")
         from ..metrics.export import prometheus_text, registry_snapshot
-        return prometheus_text(registry_snapshot(reg))
+        snap = registry_snapshot(reg)
+        from .slo import TRACKER as _slo
+        if _slo is not None:
+            # OpenMetrics exemplars: each tenant's newest over-target
+            # query rides its summary series, linking the quantile line
+            # to the on-disk trace/flight artifact (ops/slo.py)
+            snap = _slo.decorate_snapshot(snap)
+        return prometheus_text(snap)
 
     # --------------------------------------------------------- /healthz
     def healthz(self) -> dict:
@@ -285,7 +297,8 @@ class OpsServer:
                     "workers": self._health_workers(),
                     "eventLog": self._health_event_log(),
                     "flight": self._health_flight(),
-                    "sentinel": self._health_sentinel()}
+                    "sentinel": self._health_sentinel(),
+                    "slo": self._health_slo()}
         status = ("ok" if all(s.get("verdict") == "ok"
                               for s in sections.values())
                   else "degraded")
@@ -424,9 +437,32 @@ class OpsServer:
         return {"enabled": True, "recentFlags": flags[-8:],
                 "flaggedTotal": len(flags), "verdict": "ok"}
 
+    def _health_slo(self) -> dict:
+        from .slo import TRACKER
+        if TRACKER is None:
+            return {"enabled": False, "verdict": "ok"}
+        h = TRACKER.healthz()
+        return {"enabled": True,
+                "burningTenants": h["burningTenants"],
+                "alertsFired": h["alertsFired"],
+                "shedActive": h["shedActive"],
+                "exemplars": h["exemplars"],
+                "verdict": ("degraded" if h["status"] == "degraded"
+                            else "ok")}
+
     # --------------------------------------------------------- /queries
     def queries(self) -> dict:
         return self.tracker.snapshot()
+
+    # ------------------------------------------------------------- /slo
+    def slo(self) -> dict:
+        """The GET /slo report: burn rates, error-budget remaining,
+        worst digests by tail contribution, exemplars — or an
+        ``enabled: false`` stub when the tracker is off."""
+        from .slo import TRACKER
+        if TRACKER is None:
+            return {"enabled": False}
+        return {"enabled": True, **TRACKER.report()}
 
 
 # ---------------------------------------------------------------------------

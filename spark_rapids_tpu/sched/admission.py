@@ -202,6 +202,16 @@ def shed_reason() -> Optional[str]:
     if census["dead"] or census["overdue"]:
         return (f"semaphore: {census['dead']} dead / "
                 f"{census['overdue']} overdue holder(s)")
+    # SLO burn -> shed coupling (ISSUE 20): while a multi-window burn
+    # alert is live (and slo.shed.enabled), the process sheds below the
+    # priority floor exactly as it does under memory pressure — the
+    # error budget is a resource too (ops/slo.py, docs/serving.md)
+    from ..ops import slo as slo_mod
+    slo = slo_mod.TRACKER
+    if slo is not None:
+        hint = slo.shed_hint()
+        if hint:
+            return f"slo: error-budget burn alert live ({hint})"
     return None
 
 
@@ -331,10 +341,16 @@ class AdmissionController:
         from ..metrics import registry as metrics_registry
         mr = metrics_registry.REGISTRY
         if mr is not None:
+            wait_s = t.queued_ms / 1000.0
             mr.counter("srtpu_admission_admitted_total",
                        tenant=tenant or "default").inc()
-            mr.histogram("srtpu_admission_wait_seconds").observe(
-                t.queued_ms / 1000.0)
+            mr.histogram("srtpu_admission_wait_seconds",
+                         tenant=tenant or "default").observe(wait_s)
+            # tail view of the same wait: mergeable quantile sketch
+            # (ISSUE 20) — the per-tenant p99 the /slo report and the
+            # mixed-tenant battery read
+            mr.summary("srtpu_admission_wait_latency_seconds",
+                       tenant=tenant or "default").observe(wait_s)
         return t
 
     def _effective_priority(self, t: AdmissionTicket, now: float) -> int:

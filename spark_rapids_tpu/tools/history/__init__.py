@@ -27,7 +27,8 @@ from typing import Dict, List, Optional, Tuple
 
 __all__ = ["load_events", "build_history", "format_history",
            "format_slowest", "diff_histories", "format_diff",
-           "summarize_metrics_file", "main"]
+           "summarize_metrics_file", "slo_replay", "format_slo",
+           "main"]
 
 #: registry series the metrics-snapshot summary surfaces (must exist in
 #: the MetricRegistry inventory — enforced by the metric-name-drift
@@ -252,6 +253,78 @@ def format_diff(rows: List[dict], a: str, b: str) -> str:
     return "\n".join(lines) + "\n"
 
 
+def slo_replay(events: List[dict], *, target_ms: float,
+               objective: float = 0.99, short_window_s: float = 60.0,
+               long_window_s: float = 600.0) -> dict:
+    """Offline SLO report over an event log: replays every queryEnd
+    through the SAME pure fold the live ``SloTracker`` runs
+    (ops/slo.py ``fold_slo_event``/``burn_rate``/``budget_remaining``)
+    and the same quantile sketch the ``Summary`` metric kind uses, so
+    a replayed log and the live ``/slo`` endpoint agree by
+    construction. Deterministic: identical logs yield identical
+    reports."""
+    from ...metrics.sketch import QuantileSketch
+    from ...ops.slo import (budget_remaining, burn_rate,
+                            fold_slo_event, new_slo_state)
+    state = new_slo_state()
+    sketches: Dict[str, QuantileSketch] = {}
+    last_ts = 0.0
+    for rec in events:
+        if rec.get("event") != "queryEnd":
+            continue
+        ts = float(rec.get("ts") or 0.0)
+        last_ts = max(last_ts, ts)
+        tenant = str(rec.get("tenant") or "default")
+        wall = rec.get("durationMs")
+        bad = (not rec.get("ok")
+               or (wall is not None and float(wall) > target_ms))
+        fold_slo_event(state, tenant=tenant, ts=ts, bad=bad,
+                       long_window_s=long_window_s)
+        if wall is not None and float(wall) > 0:
+            sketches.setdefault(tenant, QuantileSketch()).observe(
+                float(wall))
+    tenants = {}
+    for tenant in sorted(state):
+        t = state[tenant]
+        sk = sketches.get(tenant)
+        p50, p95, p99 = (sk.quantiles((0.5, 0.95, 0.99))
+                         if sk is not None else (0.0, 0.0, 0.0))
+        tenants[tenant] = {
+            "good": t["good"], "bad": t["bad"],
+            "burn": {
+                "short": round(burn_rate(
+                    t, now=last_ts, window_s=short_window_s,
+                    objective=objective), 4),
+                "long": round(burn_rate(
+                    t, now=last_ts, window_s=long_window_s,
+                    objective=objective), 4)},
+            "errorBudgetRemaining": round(
+                budget_remaining(t, objective=objective), 4),
+            "p50Ms": round(p50, 3), "p95Ms": round(p95, 3),
+            "p99Ms": round(p99, 3)}
+    return {"targetMs": target_ms, "objective": objective,
+            "windows": {"shortS": short_window_s,
+                        "longS": long_window_s},
+            "tenants": tenants}
+
+
+def format_slo(report: dict, source: str = "") -> str:
+    lines = [f"== SLO replay ({source or 'event log'}): "
+             f"target {report['targetMs']:g} ms, "
+             f"objective {report['objective']:g} ==",
+             f"{'tenant':<12} {'good':>6} {'bad':>6} {'burn_s':>8} "
+             f"{'burn_l':>8} {'budget':>7} {'p50 ms':>10} "
+             f"{'p95 ms':>10} {'p99 ms':>10}"]
+    for tenant in sorted(report.get("tenants") or {}):
+        t = report["tenants"][tenant]
+        lines.append(
+            f"{tenant:<12} {t['good']:>6} {t['bad']:>6} "
+            f"{t['burn']['short']:>8.2f} {t['burn']['long']:>8.2f} "
+            f"{t['errorBudgetRemaining']:>7.3f} {t['p50Ms']:>10.1f} "
+            f"{t['p95Ms']:>10.1f} {t['p99Ms']:>10.1f}")
+    return "\n".join(lines) + "\n"
+
+
 def summarize_metrics_file(path: str) -> str:
     """Render the KEY_METRICS series of a JSON snapshot artifact (the
     ``details[rung]["metrics"]`` file bench.py emits)."""
@@ -289,6 +362,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "is the baseline)")
     ap.add_argument("--metrics-file", metavar="SNAP",
                     help="summarize a JSON metrics-snapshot artifact")
+    ap.add_argument("--slo", type=float, metavar="TARGET_MS",
+                    help="replay the log through the SLO fold with this "
+                         "latency target (ms) and render per-tenant "
+                         "burn rates, budget and p50/p95/p99")
+    ap.add_argument("--slo-objective", type=float, default=0.99,
+                    metavar="FRAC",
+                    help="availability objective for --slo "
+                         "(default 0.99)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output")
     args = ap.parse_args(argv)
@@ -303,6 +384,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         ap.error("an event-log path is required (or --metrics-file)")
     events, skipped = load_events(args.log)
     history = build_history(events)
+    if args.slo is not None:
+        report = slo_replay(events, target_ms=args.slo,
+                            objective=args.slo_objective)
+        if args.json:
+            print(json.dumps(report, sort_keys=True))
+        else:
+            print(format_slo(report, source=args.log), end="")
+        return 0
     if args.diff:
         other_events, _ = load_events(args.diff)
         other = build_history(other_events)

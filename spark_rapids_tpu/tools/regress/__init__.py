@@ -69,7 +69,8 @@ def _fold_records(events: List[dict]) -> List[dict]:
 
 
 def replay_events(events: List[dict], *, wall_factor: float = 3.0,
-                  min_samples: int = 3, window: int = 32) -> dict:
+                  min_samples: int = 3, window: int = 32,
+                  tail_factor: float = 2.0) -> dict:
     """Replay an event log through the live sentinel's fold. Returns
     ``{"records", "regressions", "baselines"}`` — regressions in log
     order (each stamped with the queryId that tripped it), baselines
@@ -80,7 +81,8 @@ def replay_events(events: List[dict], *, wall_factor: float = 3.0,
     records = _fold_records(events)
     for rec in records:
         regs = fold_record(baselines, rec, wall_factor=wall_factor,
-                           min_samples=min_samples, window=window)
+                           min_samples=min_samples, window=window,
+                           tail_factor=tail_factor)
         for r in regs:
             r["queryId"] = rec.get("queryId")
         regressions.extend(regs)
@@ -101,6 +103,9 @@ def format_replay(result: dict, source: str = "",
                       f"{r['medianMs']:.1f} ms ({r['factor']}x)")
         elif kind == "verdict_flip":
             detail = f"{r['from']} -> {r['to']}"
+        elif kind == "tail_regression":
+            detail = (f"wall {r['wallMs']:.1f} ms vs p99 "
+                      f"{r['p99Ms']:.1f} ms ({r['factor']}x)")
         else:
             detail = (f"rung {r['rung']} (baseline "
                       f"{r['baselineRung']})")
@@ -145,6 +150,11 @@ def normalize_bench(doc: dict) -> dict:
             if isinstance(d, dict) and d.get("speedup") is not None:
                 details[k] = {"speedup": float(d["speedup"]),
                               "placement": d.get("placement")}
+                # serving artifacts (SERVE_r02+) carry sketch-derived
+                # per-tenant tail latencies; keep them round-trippable
+                for q in ("p50Ms", "p95Ms", "p99Ms"):
+                    if d.get(q) is not None:
+                        details[k][q] = float(d[q])
         if parsed.get("geomean") is not None:
             geomean = float(parsed["geomean"])
         elif parsed.get("value") is not None:
@@ -256,6 +266,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "engages (default 3)")
     ap.add_argument("--window", type=int, default=32,
                     help="rolling baseline window (default 32)")
+    ap.add_argument("--tail-factor", type=float, default=2.0,
+                    help="tail_regression threshold over the baselined "
+                         "p99 (default 2.0)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output")
     args = ap.parse_args(argv)
@@ -274,7 +287,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     events, skipped = load_events(args.log)
     result = replay_events(events, wall_factor=args.wall_factor,
                            min_samples=args.min_samples,
-                           window=args.window)
+                           window=args.window,
+                           tail_factor=args.tail_factor)
     if args.json:
         print(json.dumps({"records": result["records"],
                           "regressions": result["regressions"],

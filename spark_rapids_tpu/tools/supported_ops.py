@@ -101,6 +101,8 @@ def _load_registries():
               "spark_rapids_tpu.ops.server",
               "spark_rapids_tpu.ops.flight",
               "spark_rapids_tpu.ops.sentinel",
+              "spark_rapids_tpu.ops.slo",
+              "spark_rapids_tpu.metrics.sketch",
               "spark_rapids_tpu.sched.admission",
               "spark_rapids_tpu.aqe",
               "spark_rapids_tpu.tools.regress",

@@ -77,10 +77,10 @@ def _clear_metrics():
 
 @pytest.fixture(autouse=True)
 def _clear_ops_plane():
-    """The ops server thread, flight recorder and regression sentinel
-    are process-global (ops/, same install pattern as the tracer); a
-    test that arms them must not leave an HTTP thread — or anomaly
-    dumps firing — behind its back."""
+    """The ops server thread, flight recorder, regression sentinel and
+    SLO tracker are process-global (ops/, same install pattern as the
+    tracer); a test that arms them must not leave an HTTP thread — or
+    anomaly dumps or burn alerts firing — behind its back."""
     yield
     from spark_rapids_tpu.ops import shutdown_ops_plane
     shutdown_ops_plane()

@@ -138,6 +138,97 @@ def test_snapshot_merge_stamps_worker_label():
     assert got == {"worker-0": 2, "worker-1": 5}
 
 
+def test_summary_exposition_quantiles_and_exemplar_parse():
+    """The Summary kind renders as Prometheus quantile series plus
+    _sum/_count, and an attached exemplar survives the wire in
+    OpenMetrics syntax (`` # {labels} value ts``)."""
+    reg = MetricRegistry()
+    sm = reg.summary("srtpu_query_latency_seconds", tenant="a")
+    for i in range(1, 101):
+        sm.observe(i / 100.0)
+    snap = reg.snapshot()
+    # decorate the series the way ops/slo.decorate_snapshot does
+    snap["srtpu_query_latency_seconds"]["series"][0]["exemplar"] = {
+        "labels": {"trace_path": "/tmp/trace.json", "query_id": "7"},
+        "value": 1.0, "ts": 1700000000.0}
+    txt = prometheus_text(snap)
+    lines = txt.splitlines()
+    assert "# TYPE srtpu_query_latency_seconds summary" in lines
+    for q in ("0.5", "0.95", "0.99"):
+        ql = [l for l in lines
+              if f'quantile="{q}"' in l and 'tenant="a"' in l]
+        assert ql, (q, txt)
+    p99 = [l for l in lines if 'quantile="0.99"' in l]
+    assert abs(float(p99[0].rsplit(" ", 1)[1]) - 0.99) < 0.05
+    count = [l for l in lines
+             if l.startswith("srtpu_query_latency_seconds_count")]
+    assert count and " # {" in count[0]
+    m = re.match(r'^(\S+)\{(.*)\} (\S+) # \{(.*)\} (\S+) (\S+)$',
+                 count[0])
+    assert m, count[0]
+    assert 'trace_path="/tmp/trace.json"' in m.group(4)
+    assert float(m.group(3)) == 100.0
+
+
+def test_summary_merge_is_deterministic_and_exact():
+    """Three shard registries merged through merge_snapshots fold to
+    EXACTLY the single-process sketch — bucket counts are integers, so
+    distribution across workers cannot drift the quantiles."""
+    from spark_rapids_tpu.metrics import QuantileSketch, fold_sketches
+    rng = np.random.RandomState(5)
+    vals = [float(v) for v in rng.lognormal(0.0, 1.0, 3000)]
+    whole = MetricRegistry()
+    shards = [MetricRegistry() for _ in range(3)]
+    for i, v in enumerate(vals):
+        whole.summary("srtpu_query_latency_seconds",
+                      tenant="a").observe(v)
+        shards[i % 3].summary("srtpu_query_latency_seconds",
+                              tenant="a").observe(v)
+    merged = merge_snapshots({f"worker-{i}": r.snapshot()
+                              for i, r in enumerate(shards)})
+    series = merged["srtpu_query_latency_seconds"]["series"]
+    assert [s["labels"]["worker"] for s in series] == \
+        ["worker-0", "worker-1", "worker-2"]
+    folded = fold_sketches([s["sketch"] for s in series])
+    want = QuantileSketch.from_json(
+        whole.snapshot()["srtpu_query_latency_seconds"]
+        ["series"][0]["sketch"])
+    # integer bucket counts: the shard split cannot drift a quantile
+    assert folded.bins == want.bins and folded.count == want.count
+    assert folded.quantiles((0.5, 0.95, 0.99)) == \
+        want.quantiles((0.5, 0.95, 0.99))
+
+
+def test_per_metric_buckets_and_600s_ceiling():
+    """srtpu_query_seconds carries its own inventory buckets topping at
+    600s (the 60s default ceiling saturated on long queries), while
+    explicit buckets= still win over the inventory."""
+    from spark_rapids_tpu.metrics import metric_inventory
+    reg = MetricRegistry()
+    h = reg.histogram("srtpu_query_seconds", tenant="a")
+    assert h.buckets[-1] == 600.0
+    h.observe(300.0)                       # lands in a real bucket now
+    snap = reg.snapshot()
+    buckets = dict(snap["srtpu_query_seconds"]["series"][0]["buckets"])
+    assert buckets[300.0] == 1 and buckets[120.0] == 0
+    assert metric_inventory()["srtpu_query_seconds"]["buckets"][-1] \
+        == 600.0
+    # explicit buckets still beat the inventory (the PR-5 contract)
+    reg2 = MetricRegistry()
+    h2 = reg2.histogram("srtpu_query_seconds", buckets=(1.0, 2.0))
+    assert h2.buckets == (1.0, 2.0)
+
+
+def test_bounded_label_caps_cardinality():
+    reg = MetricRegistry()
+    seen = {reg.bounded_label("srtpu_digest_latency_seconds", "digest",
+                              f"d{i}", cap=4) for i in range(10)}
+    assert seen == {"d0", "d1", "d2", "d3", "other"}
+    # identity is sticky for values admitted before the cap
+    assert reg.bounded_label("srtpu_digest_latency_seconds", "digest",
+                             "d2", cap=4) == "d2"
+
+
 def test_registry_snapshot_samples_runtime_gauges():
     """One synchronous sample pass populates the hbm/spill/semaphore/
     shuffle gauges even with the sampler thread off."""
@@ -228,6 +319,20 @@ def test_three_worker_snapshot_merge(tmp_path):
                view["aggregate"]["srtpu_shuffle_put_bytes_total"]["series"]
                if se["labels"]["worker"].startswith("worker-")]
         assert sum(put) > 0
+        # worker task-wall summaries (ISSUE 20): every worker lane
+        # ships a serialized quantile sketch that survives the merge
+        # and renders as quantile series in the merged exposition
+        task_ent = view["aggregate"]["srtpu_worker_task_seconds"]
+        assert task_ent["kind"] == "summary"
+        task_series = [se for se in task_ent["series"]
+                       if se["labels"]["worker"].startswith("worker-")]
+        assert task_series, "no worker task summaries merged"
+        assert all(se["count"] >= 1 and se["sketch"]["bins"]
+                   for se in task_series)
+        q_pat = re.compile(
+            r'^srtpu_worker_task_seconds\{[^}]*quantile="0\.99"'
+            r'[^}]*worker="worker-\d+"[^}]*\} ', re.M)
+        assert q_pat.search(txt), "no worker p99 line in exposition"
     finally:
         cl.shutdown()
         shutdown_metrics()

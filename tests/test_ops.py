@@ -379,7 +379,10 @@ def test_sentinel_flags_and_persistence(tmp_path):
                          "verdict": "device", "rung": 0, "ok": True}) == []
     regs = sen.fold({"digest": "dA", "wallMs": 500.0,
                      "verdict": "device", "rung": 0, "ok": True})
-    assert [r["kind"] for r in regs] == ["warm_slowdown"]
+    # a 5x spike over a tight baseline trips both the median and the
+    # tail check — the run is slower than 3x median AND 2x p99
+    assert [r["kind"] for r in regs] == ["warm_slowdown",
+                                         "tail_regression"]
     regs = sen.fold({"digest": "dA", "wallMs": 100.0,
                      "verdict": "host", "rung": 3, "ok": True})
     assert sorted(r["kind"] for r in regs) == ["rung_escalation",
@@ -391,8 +394,8 @@ def test_sentinel_flags_and_persistence(tmp_path):
     snap = reg.snapshot()
     kinds = {tuple(s["labels"].items())[0][1]: s["value"] for s in
              snap["srtpu_query_regressions_total"]["series"]}
-    assert kinds == {"warm_slowdown": 1, "verdict_flip": 1,
-                     "rung_escalation": 1}
+    assert kinds == {"warm_slowdown": 1, "tail_regression": 1,
+                     "verdict_flip": 1, "rung_escalation": 1}
     # persistence roundtrip: a fresh sentinel inherits the baselines
     sen2 = RegressionSentinel(path, wall_factor=3.0, min_samples=3)
     b = sen2.baselines()["dA"]
@@ -457,7 +460,8 @@ def test_sentinel_fold_fanout_never_raises(tmp_path, monkeypatch):
                          "verdict": "device", "ok": True}) == []
     regs = sen.fold({"digest": "d", "wallMs": 900.0,
                      "verdict": "device", "ok": True})
-    assert [r["kind"] for r in regs] == ["warm_slowdown"]
+    assert [r["kind"] for r in regs] == ["warm_slowdown",
+                                         "tail_regression"]
 
 
 def test_sentinel_live_fold_from_queries(tmp_path):
@@ -494,8 +498,9 @@ def test_regress_replay_golden(capsys):
     want = open(os.path.join(FIXTURES, "regress_golden.txt")).read()
     assert got == want
     kinds = [r["kind"] for r in result["regressions"]]
-    assert kinds == ["warm_slowdown", "verdict_flip", "rung_escalation"]
-    flip = result["regressions"][1]
+    assert kinds == ["warm_slowdown", "tail_regression", "verdict_flip",
+                     "rung_escalation"]
+    flip = result["regressions"][2]
     assert (flip["from"], flip["to"]) == ("device", "host")
     slow = result["regressions"][0]
     assert slow["factor"] == pytest.approx(3.49, abs=0.01)
@@ -511,7 +516,7 @@ def test_regress_cli_deterministic(capsys):
     assert out1 == out2
     doc = json.loads(out1)
     assert doc["records"] == 12 and doc["skipped"] == 1
-    assert len(doc["regressions"]) == 3
+    assert len(doc["regressions"]) == 4
 
 
 def test_regress_bench_diff(tmp_path, capsys):

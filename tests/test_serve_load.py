@@ -19,9 +19,12 @@ Acceptance asserted here (the ISSUE 18 bar):
   and drains to zero (plus the suite-wide zero-leak audit);
 * shed admissions carry a retry-after hint and succeed on retry after
   the pressure clears;
-* the run is recorded as a BENCH-style ``SERVE_r01.json`` artifact
+* the run is recorded as a BENCH-style ``SERVE_r02.json`` artifact
   that ``tools/regress.load_bench`` parses (per-tenant throughput as
-  the speedup column).
+  the speedup column), now carrying sketch-derived per-tenant
+  p50/p95/p99 latencies (ISSUE 20): every event-logged duration folds
+  through the SAME ``QuantileSketch`` the live ``Summary`` metric kind
+  and the ``/slo`` endpoint use.
 """
 import json
 import os
@@ -173,18 +176,22 @@ def test_mixed_tenant_serving_under_chaos(tmp_path, monkeypatch):
     assert mm.audit_leaks() == []
 
     # ---- bounded admission latency (p99 over logged queuedMs) -------
+    from spark_rapids_tpu.metrics.sketch import QuantileSketch
     from spark_rapids_tpu.tools.history import load_events
     queued_ms = []
     per_tenant_n = {}
+    tail_sketches = {}
     for t, d in elogs.items():
         events, _ = load_events(d)
         ends = [e for e in events if e.get("event") == "queryEnd"
                 and e.get("ok")]
         per_tenant_n[t] = len(ends)
+        sk = tail_sketches.setdefault(t, QuantileSketch())
         for e in ends:
             assert e.get("tenant") == t
             assert e.get("admission") == "admitted"
             queued_ms.append(float(e.get("queuedMs")))
+            sk.observe(float(e.get("durationMs")))
     assert len(queued_ms) == len(_TENANTS) * len(_ZIPF_MIX)
     p99 = float(np.percentile(queued_ms, 99))
     assert p99 < 60_000.0, f"unbounded admission latency: p99={p99}ms"
@@ -222,11 +229,17 @@ def test_mixed_tenant_serving_under_chaos(tmp_path, monkeypatch):
     assert cst["rejected"].get("shed", 0) >= 1
 
     # ---- BENCH-style serving artifact (tools/regress-parseable) -----
+    # per-tenant tail latencies come from the quantile SKETCH, not a
+    # sorted array: the artifact records exactly what the live /slo
+    # endpoint and merged /metrics quantiles would have reported
     details = {}
     for t, _, _ in _TENANTS:
         thr = per_tenant_n[t] / max(load_wall_s, 1e-6)
+        p50, p95, p99t = tail_sketches[t].quantiles((0.5, 0.95, 0.99))
         details[t] = {"speedup": round(thr, 3), "placement": "device",
-                      "queries": per_tenant_n[t]}
+                      "queries": per_tenant_n[t],
+                      "p50Ms": round(p50, 3), "p95Ms": round(p95, 3),
+                      "p99Ms": round(p99t, 3)}
     thrs = [d["speedup"] for d in details.values()]
     artifact = {
         "geomean": round(float(np.exp(np.mean(np.log(thrs)))), 3),
@@ -237,13 +250,15 @@ def test_mixed_tenant_serving_under_chaos(tmp_path, monkeypatch):
                       "rejected": cst["rejected"]},
     }
     out = os.environ.get("SRTPU_SERVE_ARTIFACT",
-                         str(tmp_path / "SERVE_r01.json"))
+                         str(tmp_path / "SERVE_r02.json"))
     with open(out, "w", encoding="utf-8") as f:
         json.dump(artifact, f, indent=2, sort_keys=True)
     from spark_rapids_tpu.tools.regress import load_bench
     parsed = load_bench(out)
     assert set(parsed["details"]) == {t for t, _, _ in _TENANTS}
     assert all(d["speedup"] > 0 for d in parsed["details"].values())
+    assert all(d["p99Ms"] >= d["p50Ms"] > 0
+               for d in parsed["details"].values())
     assert parsed["geomean"] > 0
 
 
@@ -258,3 +273,18 @@ def test_committed_serve_artifact_parses():
     assert set(parsed["details"]) == {t for t, _, _ in _TENANTS}
     assert parsed["geomean"] > 0
     assert parsed["placement_counts"] == {"device": 4}
+
+
+def test_committed_serve_r02_artifact_parses():
+    """The committed SERVE_r02.json (one recorded run of the battery
+    above, ISSUE 20) carries sketch-derived per-tenant p50/p95/p99
+    and stays tools/regress-parseable."""
+    from spark_rapids_tpu.tools.regress import load_bench
+    path = os.path.join(os.path.dirname(__file__), os.pardir,
+                        "SERVE_r02.json")
+    parsed = load_bench(path)
+    assert set(parsed["details"]) == {t for t, _, _ in _TENANTS}
+    assert parsed["geomean"] > 0
+    for d in parsed["details"].values():
+        assert d["p50Ms"] > 0
+        assert d["p50Ms"] <= d["p95Ms"] <= d["p99Ms"]

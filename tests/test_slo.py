@@ -1,0 +1,532 @@
+"""Tail-latency SLO layer tests (ISSUE 20): quantile sketch accuracy
+and merge determinism, the pure burn-rate fold, the live SloTracker
+(exemplars, per-digest attribution, burn alerts, shed hint), the
+admission and AQE-feedback couplings, the sentinel ``tail_regression``
+kind, the ``tools/history --slo`` replay, and the live-HTTP acceptance
+bar: a ``GET /slo`` exemplar for an injected slow query resolves to an
+actual on-disk trace artifact."""
+import json
+import math
+import os
+import socket
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from harness import tpu_session
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.metrics.sketch import (QuantileSketch,
+                                             fold_sketches)
+from spark_rapids_tpu.ops.slo import (SloTracker, budget_remaining,
+                                      burn_rate, fold_slo_event,
+                                      install_slo, new_slo_state,
+                                      parse_tenant_overrides)
+
+_RNG = np.random.RandomState(20)
+_N = 2048
+_T = pa.table({
+    "k": pa.array(_RNG.randint(0, 13, _N)),
+    "v": pa.array(_RNG.randint(0, 1000, _N).astype(np.int64)),
+})
+
+
+def _get(port, path, timeout=10):
+    r = urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                               timeout=timeout)
+    return r.status, r.read().decode("utf-8")
+
+
+def _get_any(port, path, timeout=10):
+    try:
+        return _get(port, path, timeout)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode("utf-8")
+
+
+# ---------------------------------------------------------------------------
+# quantile sketch
+# ---------------------------------------------------------------------------
+
+def test_sketch_relative_error_bound():
+    """Every quantile estimate is within the configured relative
+    accuracy of the exact order statistic (the DDSketch guarantee)."""
+    rng = np.random.RandomState(7)
+    vals = np.abs(rng.lognormal(3.0, 1.5, 5000)) + 1e-6
+    sk = QuantileSketch(alpha=0.01)
+    for v in vals:
+        sk.observe(float(v))
+    exact = np.sort(vals)
+    for q in (0.5, 0.9, 0.95, 0.99):
+        est = sk.quantile(q)
+        truth = float(exact[min(len(exact) - 1,
+                                int(math.ceil(q * len(exact))) - 1)])
+        assert abs(est - truth) <= 0.02 * truth, (q, est, truth)
+
+
+def test_sketch_merge_equals_single_pass():
+    """Merging N shard sketches is EXACTLY the single-pass sketch —
+    bucket counts are integers, so merge order cannot drift."""
+    rng = np.random.RandomState(11)
+    vals = [float(v) for v in np.abs(rng.gamma(2.0, 50.0, 3000)) + 1e-6]
+    whole = QuantileSketch()
+    shards = [QuantileSketch() for _ in range(3)]
+    for i, v in enumerate(vals):
+        whole.observe(v)
+        shards[i % 3].observe(v)
+    merged = QuantileSketch()
+    for sh in shards:
+        merged.merge(sh)
+
+    def bins_of(sk):
+        d = sk.to_json()
+        return {k: d[k] for k in ("alpha", "bins", "zero", "count",
+                                  "min", "max")}
+    # bucket counts are integers: merge == single pass EXACTLY (the
+    # float running sum is the one field fp associativity can drift)
+    assert bins_of(merged) == bins_of(whole)
+    assert merged.sum == pytest.approx(whole.sum)
+    folded = fold_sketches([sh.to_json() for sh in shards])
+    assert bins_of(folded) == bins_of(whole)
+    # and therefore every quantile is bit-identical
+    qs = (0.5, 0.9, 0.95, 0.99)
+    assert merged.quantiles(qs) == whole.quantiles(qs)
+    assert folded.quantiles(qs) == whole.quantiles(qs)
+
+
+def test_sketch_json_roundtrip_and_zero():
+    sk = QuantileSketch()
+    sk.observe(0.0)                       # below MIN_VALUE: zero bucket
+    sk.observe(2.5)
+    doc = json.loads(json.dumps(sk.to_json()))
+    back = QuantileSketch.from_json(doc)
+    assert back.count == 2
+    assert back.to_json() == sk.to_json()
+    assert QuantileSketch().quantile(0.99) == 0.0
+
+
+def test_sketch_bin_cap_collapses_lowest():
+    sk = QuantileSketch(max_bins=32)
+    for v in np.geomspace(1e-6, 1e6, 500):
+        sk.observe(float(v))
+    assert len(sk.bins) <= 32
+    # the collapse folds LOW buckets: the tail (what an SLO layer
+    # actually reads) survives the cap
+    assert sk.quantile(1.0) <= 1e6 * 1.03
+    assert sk.quantile(0.99) > 4e5
+
+
+# ---------------------------------------------------------------------------
+# pure burn-rate fold
+# ---------------------------------------------------------------------------
+
+def test_fold_prunes_to_long_window_and_counts():
+    st = new_slo_state()
+    for i in range(10):
+        fold_slo_event(st, tenant="a", ts=float(i), bad=(i % 2 == 0),
+                       long_window_s=4.0)
+    t = st["a"]
+    assert t["good"] == 5 and t["bad"] == 5        # cumulative
+    assert all(ts >= 9.0 - 4.0 for ts, _ in t["events"])  # pruned
+
+
+def test_burn_rate_math():
+    st = new_slo_state()
+    # 10 events in-window, 2 bad, objective 0.99 -> burn = 0.2/0.01
+    for i in range(10):
+        fold_slo_event(st, tenant="a", ts=100.0 + i, bad=i < 2,
+                       long_window_s=600.0)
+    burn = burn_rate(st["a"], now=110.0, window_s=60.0, objective=0.99)
+    assert abs(burn - 20.0) < 1e-9
+    assert burn_rate(st["a"], now=110.0, window_s=60.0,
+                     objective=1.0) == 1e9
+    assert burn_rate({"events": []}, now=0.0, window_s=60.0,
+                     objective=0.99) == 0.0
+
+
+def test_budget_remaining_math():
+    st = new_slo_state()
+    for i in range(100):
+        fold_slo_event(st, tenant="a", ts=float(i), bad=i < 2,
+                       long_window_s=1e9)
+    # 2 bad of 100 with a 1% budget: budget fully spent (clamped 0)
+    assert budget_remaining(st["a"], objective=0.99) == 0.0
+    assert budget_remaining({"events": []}, objective=0.99) == 1.0
+
+
+def test_parse_tenant_overrides():
+    ov = parse_tenant_overrides("alpha=500:0.999, beta=2000, bad, x=")
+    assert ov["alpha"] == (500.0, 0.999)
+    assert ov["beta"] == (2000.0, None)
+    assert set(ov) == {"alpha", "beta"}
+
+
+# ---------------------------------------------------------------------------
+# live tracker
+# ---------------------------------------------------------------------------
+
+def _tracker(**kw):
+    base = dict(target_ms=100.0, objective=0.9, short_window_s=10.0,
+                long_window_s=60.0, burn_threshold=2.0, exemplar_cap=4,
+                shed_enabled=True, digest_cap=3)
+    base.update(kw)
+    return SloTracker(**base)
+
+
+def test_tracker_exemplars_and_digest_attribution():
+    tr = _tracker()
+    for i in range(6):
+        tr.observe(tenant="a", wall_ms=50.0, ok=True, query_id=i,
+                   digest="fast", ts=100.0 + i)
+    tr.observe(tenant="a", wall_ms=400.0, ok=True, query_id=99,
+               digest="slow", trace_path="/tmp/t.json",
+               flight_path="/tmp/fb", ts=107.0)
+    exs = tr.exemplars()
+    assert len(exs) == 1 and exs[0]["queryId"] == 99
+    assert exs[0]["trace"] == "/tmp/t.json"
+    assert exs[0]["flight"] == "/tmp/fb"
+    assert tr.digest_breaches("slow") == 1
+    assert tr.digest_breaches("fast") == 0
+    rep = tr.report(now=108.0)
+    assert rep["worstDigests"][0]["digest"] == "slow"
+    assert rep["worstDigests"][0]["excessMs"] == 300.0
+    a = rep["tenants"]["a"]
+    assert a["good"] == 6 and a["bad"] == 1
+
+
+def test_tracker_exemplar_ring_and_digest_caps():
+    tr = _tracker()
+    for i in range(10):
+        tr.observe(tenant="a", wall_ms=200.0, ok=True, query_id=i,
+                   digest=f"d{i}", ts=100.0 + i)
+    assert len(tr.exemplars()) == 4                 # exemplar_cap
+    rep = tr.report(now=111.0)
+    digs = {d["digest"] for d in rep["worstDigests"]}
+    assert "other" in digs                          # digest_cap overflow
+    assert len(digs) <= 4                           # 3 + "other"
+
+
+def test_tracker_burn_alert_shed_hint_and_expiry():
+    tr = _tracker()
+    # every event bad: both windows burn at 1/0.1 = 10x >= threshold
+    for i in range(5):
+        tr.observe(tenant="a", wall_ms=500.0, ok=True, query_id=i,
+                   digest="d", ts=100.0 + i)
+    assert tr.alerts_fired == 1                     # cooldown: once
+    assert tr.shed_hint(now=105.0) == "slo_burn:a"
+    # the hint self-expires one short window after the last bad fold
+    assert tr.shed_hint(now=104.0 + 10.0 + 0.1) is None
+    h = tr.healthz(now=105.0)
+    assert h["status"] == "degraded" and h["burningTenants"] == ["a"]
+    # after the windows drain, healthz recovers without new events
+    assert tr.healthz(now=300.0)["status"] == "ok"
+
+
+def test_tracker_shed_disabled_never_hints():
+    tr = _tracker(shed_enabled=False)
+    for i in range(5):
+        tr.observe(tenant="a", wall_ms=500.0, ok=True, ts=100.0 + i)
+    assert tr.shed_hint(now=105.0) is None
+
+
+def test_tracker_tenant_overrides():
+    tr = _tracker(tenant_overrides={"gold": (50.0, 0.999)})
+    assert tr.target_for("gold") == (50.0, 0.999)
+    assert tr.target_for("default") == (100.0, 0.9)
+    tr.observe(tenant="gold", wall_ms=80.0, ok=True, ts=100.0)
+    assert tr.report(now=100.0)["tenants"]["gold"]["bad"] == 1
+
+
+def test_tracker_failed_query_is_bad_even_under_target():
+    tr = _tracker()
+    tr.observe(tenant="a", wall_ms=10.0, ok=False, ts=100.0)
+    rep = tr.report(now=100.0)
+    assert rep["tenants"]["a"]["bad"] == 1
+    assert rep["exemplars"] == []      # not over target: no exemplar
+
+
+def test_tracker_never_raises_on_garbage():
+    tr = _tracker()
+    tr.observe(tenant=None, wall_ms=float("nan"), ok=True,
+               ts=100.0)                 # must not raise
+
+
+def test_admission_shed_reason_couples_to_burn():
+    from spark_rapids_tpu.sched.admission import shed_reason
+    tr = _tracker()
+    install_slo(tr)
+    try:
+        assert shed_reason() is None
+        import time as _time
+        now = _time.time()
+        for i in range(5):
+            tr.observe(tenant="a", wall_ms=500.0, ok=True, ts=now)
+        r = shed_reason()
+        assert r is not None and "slo_burn:a" in r
+    finally:
+        install_slo(None)
+
+
+def test_aqe_feedback_shrinks_batches_on_repeat_breaches():
+    from spark_rapids_tpu.aqe.feedback import plan_feedback
+    from spark_rapids_tpu.config import TpuConf
+    tr = _tracker()
+    install_slo(tr)
+    try:
+        conf = TpuConf()
+        assert plan_feedback("dg", None, conf) is None
+        for i in range(2):
+            tr.observe(tenant="a", wall_ms=300.0, ok=True, digest="dg",
+                       ts=100.0 + i)
+        fb = plan_feedback("dg", None, conf)
+        assert fb is not None and fb.mode == "smaller_batches"
+        assert "SLO target 2x" in fb.reason
+        assert set(fb.settings) == {
+            "spark.rapids.tpu.sql.batchSizeBytes",
+            "spark.rapids.tpu.sql.batchSizeRows"}
+    finally:
+        install_slo(None)
+
+
+def test_slo_burn_fires_flight_trigger(tmp_path):
+    from spark_rapids_tpu.ops import flight as fl_mod
+    rec = fl_mod.FlightRecorder(str(tmp_path), rate_limit_ms=0)
+    fl_mod.install_flight(rec)
+    tr = _tracker()
+    install_slo(tr)
+    try:
+        for i in range(5):
+            tr.observe(tenant="a", wall_ms=500.0, ok=True, query_id=i,
+                       digest="d", ts=100.0 + i)
+        bundles = rec.stats()["bundles"]
+        assert bundles and "slo_burn" in os.path.basename(bundles[-1])
+        with open(os.path.join(bundles[-1], "placement.json"),
+                  encoding="utf-8") as f:
+            placement = json.load(f)
+        assert placement["trigger"] == "slo_burn"
+        detail = json.loads(placement["detail"])
+        assert detail["tenant"] == "a"
+        assert detail["exemplars"]
+    finally:
+        install_slo(None)
+        fl_mod.install_flight(None)
+
+
+# ---------------------------------------------------------------------------
+# conf gating
+# ---------------------------------------------------------------------------
+
+def test_slo_disabled_by_default_no_tracker():
+    from spark_rapids_tpu.ops import slo as slo_mod
+    s = tpu_session()
+    (s.create_dataframe(_T, num_partitions=2).group_by("k")
+     .agg(F.sum(F.col("v")).with_name("sv"))).collect_arrow()
+    assert slo_mod.TRACKER is None
+
+
+def test_slo_conf_install_and_overrides():
+    from spark_rapids_tpu.ops import slo as slo_mod
+    s = tpu_session({
+        "spark.rapids.tpu.slo.enabled": True,
+        "spark.rapids.tpu.slo.targetMs": 250.0,
+        "spark.rapids.tpu.slo.objective": 0.95,
+        "spark.rapids.tpu.slo.tenant.overrides": "gold=50:0.999",
+        "spark.rapids.tpu.slo.burn.threshold": 3.0})
+    s.exec_context()
+    tr = slo_mod.TRACKER
+    assert tr is not None
+    assert tr.target_ms == 250.0 and tr.objective == 0.95
+    assert tr.burn_threshold == 3.0
+    assert tr.target_for("gold") == (50.0, 0.999)
+
+
+# ---------------------------------------------------------------------------
+# sentinel tail_regression
+# ---------------------------------------------------------------------------
+
+def test_sentinel_tail_regression_flags_injected_p99_shift():
+    from spark_rapids_tpu.ops.sentinel import fold_record
+    baselines = {}
+    rng = np.random.RandomState(3)
+    # stable baseline: walls around 100ms with mild spread
+    for _ in range(24):
+        regs = fold_record(
+            baselines, {"digest": "dg", "ok": True, "compileS": 0.0,
+                        "wallMs": float(100.0 + rng.uniform(-5, 5))},
+            wall_factor=1e9, tail_factor=2.0)
+        assert regs == []
+    # injected per-digest p99 regression: >2x the baselined p99
+    regs = fold_record(
+        baselines, {"digest": "dg", "ok": True, "compileS": 0.0,
+                    "wallMs": 260.0},
+        wall_factor=1e9, tail_factor=2.0)
+    assert [r["kind"] for r in regs] == ["tail_regression"]
+    assert regs[0]["digest"] == "dg"
+    assert regs[0]["wallMs"] == 260.0
+    assert regs[0]["factor"] >= 2.0
+    # the flagged wall still folded in: persistently slower walls
+    # re-baseline instead of alarming forever
+    assert QuantileSketch.from_json(
+        baselines["dg"]["tail"]).count >= 25
+
+
+def test_sentinel_tail_sketch_decays_deterministically():
+    from spark_rapids_tpu.ops.sentinel import fold_record
+    baselines = {}
+    for i in range(4 * 8 + 1):
+        fold_record(baselines,
+                    {"digest": "dg", "ok": True, "compileS": 0.0,
+                     "wallMs": 100.0},
+                    wall_factor=1e9, window=8, tail_factor=1e9)
+    sk = QuantileSketch.from_json(baselines["dg"]["tail"])
+    assert sk.count < 4 * 8          # halved at the 4x-window horizon
+    assert abs(sk.quantile(0.99) - 100.0) / 100.0 < 0.02
+
+
+def test_sentinel_cold_run_never_feeds_or_flags_tail():
+    from spark_rapids_tpu.ops.sentinel import fold_record
+    baselines = {}
+    for _ in range(8):
+        fold_record(baselines,
+                    {"digest": "dg", "ok": True, "compileS": 0.0,
+                     "wallMs": 100.0}, wall_factor=1e9)
+    regs = fold_record(
+        baselines, {"digest": "dg", "ok": True, "compileS": 1.5,
+                    "wallMs": 5000.0}, wall_factor=1e9, tail_factor=2.0)
+    assert regs == []                # compiled run: cold, exempt
+    assert QuantileSketch.from_json(
+        baselines["dg"]["tail"]).count == 8
+
+
+def test_regress_replay_renders_tail_regression(tmp_path):
+    from spark_rapids_tpu.tools.regress import (format_replay,
+                                                replay_events)
+    log = tmp_path / "events.jsonl"
+    recs = [{"event": "queryEnd", "queryId": i, "planDigest": "dg",
+             "ok": True, "compileSeconds": 0.0, "durationMs": 100.0}
+            for i in range(10)]
+    recs.append({"event": "queryEnd", "queryId": 10,
+                 "planDigest": "dg", "ok": True,
+                 "compileSeconds": 0.0, "durationMs": 300.0})
+    log.write_text("\n".join(json.dumps(r) for r in recs) + "\n",
+                   encoding="utf-8")
+    from spark_rapids_tpu.tools.history import load_events
+    events, _ = load_events(str(log))
+    report = replay_events(events, wall_factor=1e9, tail_factor=2.0)
+    kinds = [r["kind"] for r in report["regressions"]]
+    assert "tail_regression" in kinds
+    txt = format_replay(report)
+    assert "TAIL_REGRESSION" in txt and "p99" in txt
+
+
+# ---------------------------------------------------------------------------
+# tools/history --slo replay
+# ---------------------------------------------------------------------------
+
+def _slo_log(tmp_path):
+    recs = []
+    for i in range(20):
+        recs.append({"event": "queryEnd", "queryId": i, "ts": 100.0 + i,
+                     "tenant": "alpha", "ok": True,
+                     "durationMs": 50.0 + i})
+    for i in range(10):
+        recs.append({"event": "queryEnd", "queryId": 100 + i,
+                     "ts": 100.0 + i, "tenant": "beta",
+                     "ok": i % 2 == 0, "durationMs": 400.0})
+    d = tmp_path / "elog"
+    d.mkdir()
+    (d / "events.jsonl").write_text(
+        "\n".join(json.dumps(r) for r in recs) + "\n", encoding="utf-8")
+    return d
+
+
+def test_history_slo_replay_report(tmp_path):
+    from spark_rapids_tpu.tools.history import (format_slo, load_events,
+                                                slo_replay)
+    events, _ = load_events(str(_slo_log(tmp_path)))
+    rep = slo_replay(events, target_ms=200.0, objective=0.9)
+    a, b = rep["tenants"]["alpha"], rep["tenants"]["beta"]
+    assert a["bad"] == 0 and a["good"] == 20
+    assert b["bad"] == 10 and b["good"] == 0    # all over 200ms target
+    assert b["burn"]["long"] == 10.0            # 1.0 bad frac / 0.1
+    assert a["errorBudgetRemaining"] == 1.0
+    assert b["errorBudgetRemaining"] == 0.0
+    assert 50.0 <= a["p50Ms"] <= 62.0
+    assert abs(b["p99Ms"] - 400.0) / 400.0 < 0.02
+    # identical logs -> identical report (replay determinism)
+    assert rep == slo_replay(events, target_ms=200.0, objective=0.9)
+    txt = format_slo(rep, source="elog")
+    assert "alpha" in txt and "beta" in txt and "p99" in txt
+
+
+def test_history_slo_cli_json(tmp_path, capsys):
+    from spark_rapids_tpu.tools.history import main
+    assert main([str(_slo_log(tmp_path)), "--slo", "200",
+                 "--slo-objective", "0.9", "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert set(doc["tenants"]) == {"alpha", "beta"}
+    assert doc["targetMs"] == 200.0
+
+
+# ---------------------------------------------------------------------------
+# the live-HTTP acceptance bar
+# ---------------------------------------------------------------------------
+
+def test_slo_endpoint_stub_when_tracker_off():
+    from spark_rapids_tpu.ops import server as srv_mod
+    srv = srv_mod.install_ops(srv_mod.OpsServer(0).start())
+    _, body = _get(srv.port, "/slo")
+    assert json.loads(body) == {"enabled": False}
+
+
+def test_live_http_slo_exemplar_resolves_to_artifacts(tmp_path):
+    """The acceptance bar: an injected slow query (target 0.01ms — any
+    real wall is over it) surfaces on GET /slo as an exemplar whose
+    trace path is an actual artifact on disk, /metrics carries the
+    OpenMetrics exemplar on the tenant's quantile series, and /healthz
+    grows the slo section."""
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+    trace_out = str(tmp_path / "trace.json")
+    s = tpu_session({
+        "spark.rapids.tpu.ops.port": port,
+        "spark.rapids.tpu.metrics.enabled": True,
+        "spark.rapids.tpu.trace.enabled": True,
+        "spark.rapids.tpu.trace.output": trace_out,
+        "spark.rapids.tpu.slo.enabled": True,
+        "spark.rapids.tpu.slo.targetMs": 0.01})
+    (s.create_dataframe(_T, num_partitions=2).group_by("k")
+     .agg(F.sum(F.col("v")).with_name("sv"))).collect_arrow()
+
+    _, body = _get(port, "/slo")
+    doc = json.loads(body)
+    assert doc["enabled"] is True
+    assert doc["tenants"]["default"]["bad"] >= 1
+    exs = doc["exemplars"]
+    assert exs, "over-target query recorded no exemplar"
+    ex = exs[0]
+    assert ex["tenant"] == "default" and ex["wallMs"] > 0.01
+    assert ex["trace"] == trace_out and os.path.exists(ex["trace"])
+    with open(ex["trace"], encoding="utf-8") as f:
+        assert json.load(f).get("traceEvents")
+    assert doc["worstDigests"][0]["digest"] == ex["planDigest"]
+
+    _, mbody = _get(port, "/metrics")
+    qlines = [ln for ln in mbody.splitlines()
+              if ln.startswith("srtpu_query_latency_seconds")]
+    assert any('quantile="0.99"' in ln and 'tenant="default"' in ln
+               for ln in qlines)
+    excount = [ln for ln in qlines if "_count" in ln and " # {" in ln]
+    assert excount, "no OpenMetrics exemplar on the summary series"
+    assert "trace_path=" in excount[0]
+
+    _, hbody = _get_any(port, "/healthz")
+    hdoc = json.loads(hbody)
+    assert "slo" in hdoc
+    assert hdoc["slo"]["enabled"] is True
+    assert hdoc["slo"]["verdict"] in ("ok", "degraded")
+    assert hdoc["slo"]["exemplars"] >= 1
